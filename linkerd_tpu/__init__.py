@@ -1,0 +1,33 @@
+"""linkerd_tpu — a TPU-native service-mesh framework.
+
+A ground-up rebuild of the capabilities of linkerd v1 (the Scala/Finagle
+L5/L7 router; see SURVEY.md) on a Python-asyncio + C++ host data plane with
+JAX/XLA on TPU for the inline ML-inference telemeter.
+
+Layers (mirroring SURVEY.md §1, re-designed idiomatically):
+
+- ``core``      — Path / Dtab / NameTree algebra and the reactive Var/Activity
+                  cells every namer, balancer and control-plane stream rides on
+                  (ref: finagle Name/Dtab + com.twitter.util.{Var,Activity}).
+- ``config``    — YAML/JSON ``kind:``-polymorphic plugin config registry
+                  (ref: config/ + LoadService, Parser.scala).
+- ``router``    — the data-plane heart: identify -> bind -> balance -> dispatch
+                  with the four-level binding cache, retries, timeouts, failure
+                  accrual (ref: router/core).
+- ``namer``     — pluggable service discovery (fs, k8s, consul, ...) and
+                  dtab interpreters (ref: namer/*, interpreter/*).
+- ``protocol``  — wire protocols: HTTP/1.1, h2+gRPC, thrift (ref: linkerd/protocol/*,
+                  finagle/h2).
+- ``telemetry`` — MetricsTree, Telemeter SPI, exporters, and the
+                  ``io.l5d.jaxAnomaly`` TPU scorer telemeter (ref: telemetry/*).
+- ``admin``     — admin HTTP surface (ref: admin/, linkerd/admin).
+- ``namerd``    — control plane: DtabStore + streaming resolution APIs
+                  (ref: namerd/*, mesh/core).
+- ``models``    — JAX/flax anomaly models (autoencoder, MLP classifier).
+- ``ops``       — Pallas TPU kernels for the scoring hot path.
+- ``parallel``  — jax.sharding Mesh construction, dp/tp partition specs,
+                  collective-aware train/score steps.
+- ``utils``     — small shared helpers.
+"""
+
+__version__ = "0.1.0"

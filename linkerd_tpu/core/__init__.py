@@ -1,0 +1,19 @@
+"""Core naming algebra and reactive cells.
+
+Reference parity: finagle's ``Path``/``Dtab``/``NameTree``/``Name`` and
+``com.twitter.util.{Var, Activity}`` as used throughout
+``/root/reference/namer/core`` and ``/root/reference/router/core``.
+"""
+
+from linkerd_tpu.core.path import Path
+from linkerd_tpu.core.nametree import NameTree, Leaf, Alt, Union, Neg, Empty, Fail, Weighted
+from linkerd_tpu.core.dtab import Dentry, Dtab
+from linkerd_tpu.core.var import Var, Closable
+from linkerd_tpu.core.activity import Activity, Pending, Ok, Failed
+from linkerd_tpu.core.addr import Addr, Address
+
+__all__ = [
+    "Path", "NameTree", "Leaf", "Alt", "Union", "Neg", "Empty", "Fail",
+    "Weighted", "Dentry", "Dtab", "Var", "Closable", "Activity", "Pending",
+    "Ok", "Failed", "Addr", "Address",
+]

@@ -1,0 +1,186 @@
+"""Tests for the Path / NameTree / Dtab algebra.
+
+Mirrors the reference's dtab-evaluation fidelity requirements (SURVEY.md §7
+hard part 5: alt/union/weights, wildcards, precedence).
+"""
+
+import pytest
+
+from linkerd_tpu.core import Path, Dtab, Dentry
+from linkerd_tpu.core.dtab import Prefix
+from linkerd_tpu.core.nametree import (
+    Alt, Empty, Fail, Leaf, Neg, Union, Weighted, NEG, parse,
+)
+
+
+class TestPath:
+    def test_read_show_roundtrip(self):
+        p = Path.read("/svc/users")
+        assert tuple(p) == ("svc", "users")
+        assert p.show == "/svc/users"
+        assert Path.read("/").show == "/"
+        assert Path().show == "/"
+
+    def test_read_rejects_relative(self):
+        with pytest.raises(ValueError):
+            Path.read("svc/users")
+
+    def test_ops(self):
+        p = Path.read("/a/b/c")
+        assert p.starts_with(Path.read("/a/b"))
+        assert not p.starts_with(Path.read("/a/x"))
+        assert p.drop(1).show == "/b/c"
+        assert p.take(2).show == "/a/b"
+        assert (Path.read("/a") + Path.read("/b")).show == "/a/b"
+        assert p.child("d").show == "/a/b/c/d"
+
+    def test_segments_validated(self):
+        with pytest.raises(ValueError):
+            Path(("a/b",))
+
+    def test_hashable_dict_key(self):
+        d = {Path.read("/svc/a"): 1}
+        assert d[Path.read("/svc/a")] == 1
+
+
+class TestNameTreeParse:
+    def test_leaf(self):
+        assert parse("/a/b") == Leaf(Path.read("/a/b"))
+
+    def test_alt(self):
+        t = parse("/a | /b | /c")
+        assert isinstance(t, Alt)
+        assert [x.value.show for x in t.trees] == ["/a", "/b", "/c"]
+
+    def test_union_weights(self):
+        t = parse("0.7 * /a & 0.3 * /b")
+        assert isinstance(t, Union)
+        assert [(w.weight, w.tree.value.show) for w in t.weighted] == [
+            (0.7, "/a"), (0.3, "/b")]
+
+    def test_union_default_weight(self):
+        t = parse("/a & /b")
+        assert isinstance(t, Union)
+        assert all(w.weight == 1.0 for w in t.weighted)
+
+    def test_specials(self):
+        assert isinstance(parse("~"), Neg)
+        assert isinstance(parse("$"), Empty)
+        assert isinstance(parse("!"), Fail)
+
+    def test_nested_parens(self):
+        t = parse("(/a | /b) & 2 * (/c | ~)")
+        assert isinstance(t, Union)
+        assert isinstance(t.weighted[0].tree, Alt)
+        assert t.weighted[1].weight == 2.0
+
+    def test_trailing_garbage(self):
+        with pytest.raises(ValueError):
+            parse("/a ,")
+
+    def test_alt_binds_loosest(self):
+        # finagle precedence: '0.9 * /a & 0.1 * /b | /fallback' is
+        # Alt(Union(...), /fallback) — fallback is last-resort, not 10%.
+        t = parse("0.9 * /a & 0.1 * /b | /fallback")
+        assert isinstance(t, Alt)
+        assert isinstance(t.trees[0], Union)
+        assert t.trees[1] == Leaf(Path.read("/fallback"))
+
+    def test_weight_inside_alt_branch(self):
+        t = parse("/a | 0.5 * /b & 0.5 * /c")
+        assert isinstance(t, Alt)
+        assert t.trees[0] == Leaf(Path.read("/a"))
+        assert isinstance(t.trees[1], Union)
+
+
+class TestNameTreeEval:
+    def test_alt_first_usable_wins(self):
+        t = Alt(Neg(), Leaf("b"), Leaf("c"))
+        assert t.eval() == frozenset(["b"])
+
+    def test_alt_all_neg(self):
+        assert Alt(Neg(), Neg()).eval() is None
+
+    def test_fail_shortcircuits_alt(self):
+        t = Alt(Fail(), Leaf("b"))
+        assert t.eval() is None
+
+    def test_union_merges(self):
+        t = Union(Weighted(0.5, Leaf("a")), Weighted(0.5, Leaf("b")))
+        assert t.eval() == frozenset(["a", "b"])
+
+    def test_union_skips_neg_branches(self):
+        t = Union(Weighted(0.5, Neg()), Weighted(0.5, Leaf("b")))
+        assert t.eval() == frozenset(["b"])
+
+    def test_empty_evals_to_empty_set(self):
+        assert Empty().eval() == frozenset()
+
+    def test_union_keeps_empty(self):
+        # An empty replica set is a binding (fail requests), not a
+        # non-binding: simplify must NOT turn it into Neg.
+        t = Union(Weighted(1.0, Empty()))
+        assert isinstance(t.simplified, Empty)
+        assert t.eval() == frozenset()
+
+    def test_union_single_branch_collapses_any_weight(self):
+        t = Union(Weighted(0.5, Leaf("x")), Weighted(0.5, Neg()))
+        assert t.simplified == Leaf("x")
+
+    def test_simplified_collapses(self):
+        t = Alt(Neg(), Alt(Neg(), Leaf("x")))
+        assert t.simplified == Leaf("x")
+
+    def test_map(self):
+        t = parse("/a | /b").map(lambda p: p.child("x"))
+        assert t.trees[0].value.show == "/a/x"
+
+
+class TestDtab:
+    def test_read_show(self):
+        d = Dtab.read("/svc => /host; /host => /srv ;")
+        assert len(d) == 2
+        assert d.show == "/svc => /host;/host => /srv"
+
+    def test_lookup_rewrites_with_residual(self):
+        d = Dtab.read("/svc => /host")
+        t = d.lookup(Path.read("/svc/users"))
+        assert t == Leaf(Path.read("/host/users"))
+
+    def test_lookup_no_match_is_neg(self):
+        d = Dtab.read("/svc => /host")
+        assert d.lookup(Path.read("/other/x")) == NEG
+
+    def test_later_entries_take_precedence(self):
+        d = Dtab.read("/svc => /old; /svc => /new")
+        t = d.lookup(Path.read("/svc/a"))
+        assert isinstance(t, Alt)
+        # later entry first
+        assert t.trees[0] == Leaf(Path.read("/new/a"))
+        assert t.trees[1] == Leaf(Path.read("/old/a"))
+        assert t.eval() == frozenset([Path.read("/new/a")])
+
+    def test_wildcard_prefix(self):
+        d = Dtab.read("/svc/*/users => /users-cluster")
+        t = d.lookup(Path.read("/svc/east/users/extra"))
+        assert t == Leaf(Path.read("/users-cluster/extra"))
+        assert d.lookup(Path.read("/svc/east/other")) == NEG
+
+    def test_alt_dst(self):
+        d = Dtab.read("/svc => /a | /b")
+        t = d.lookup(Path.read("/svc/x")).simplified
+        assert isinstance(t, Alt)
+        assert t.trees[0] == Leaf(Path.read("/a/x"))
+
+    def test_concat(self):
+        base = Dtab.read("/svc => /a")
+        local = Dtab.read("/svc => /b")
+        t = (base + local).lookup(Path.read("/svc/x"))
+        assert t.eval() == frozenset([Path.read("/b/x")])
+
+    def test_prefix_matching(self):
+        p = Prefix.read("/a/*/c")
+        assert p.matches(Path.read("/a/b/c"))
+        assert p.matches(Path.read("/a/zzz/c/d"))
+        assert not p.matches(Path.read("/a/b"))
+        assert not p.matches(Path.read("/a/b/x"))

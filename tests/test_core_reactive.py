@@ -1,0 +1,206 @@
+"""Tests for Var / Activity reactive cells.
+
+Modeled on the reference's Events.takeStates-style assertions over state
+sequences (/root/reference/test-util/.../Events.scala — SURVEY.md §4).
+"""
+
+import asyncio
+
+import pytest
+
+from linkerd_tpu.core import Var, Activity
+from linkerd_tpu.core.activity import Ok, Failed, Pending, PENDING
+
+
+class TestVar:
+    def test_sample_update(self):
+        v = Var(1)
+        assert v.sample() == 1
+        assert v.update(2)
+        assert v.sample() == 2
+
+    def test_dedup(self):
+        v = Var(1)
+        seen = []
+        v.observe(seen.append)
+        assert seen == [1]
+        assert not v.update(1)  # dedup
+        v.update(2)
+        v.update(2)
+        assert seen == [1, 2]
+        assert v.version == 1
+
+    def test_observe_close_detaches(self):
+        v = Var(1)
+        seen = []
+        h = v.observe(seen.append)
+        h.close()
+        v.update(2)
+        assert seen == [1]
+        assert v.observer_count == 0
+
+    def test_map(self):
+        v = Var(1)
+        m = v.map(lambda x: x * 10)
+        assert m.sample() == 10
+        v.update(3)
+        assert m.sample() == 30
+
+    def test_derived_close_detaches(self):
+        v = Var(1)
+        m = v.map(lambda x: x * 10)
+        v.update(3)
+        assert m.sample() == 30
+        assert v.observer_count == 1
+        m.close()
+        assert v.observer_count == 0
+        v.update(5)
+        assert m.sample() == 30  # frozen after close
+
+    def test_collect_close_detaches(self):
+        a, b = Var(1), Var(2)
+        c = Var.collect([a, b])
+        c.close()
+        assert a.observer_count == 0 and b.observer_count == 0
+
+    def test_observer_exception_isolated(self):
+        v = Var(1)
+        seen = []
+
+        def bad(_):
+            raise RuntimeError("boom")
+
+        v.observe(bad, run_now=False)
+        v.observe(seen.append, run_now=False)
+        v.update(2)  # must not raise, and must reach the second observer
+        assert seen == [2]
+
+    def test_collect(self):
+        a, b = Var(1), Var(2)
+        c = Var.collect([a, b])
+        assert c.sample() == (1, 2)
+        a.update(5)
+        assert c.sample() == (5, 2)
+
+    def test_changes_stream(self):
+        async def run():
+            v = Var(0)
+            out = []
+
+            async def consume():
+                async for x in v.changes():
+                    out.append(x)
+                    if x >= 2:
+                        break
+
+            task = asyncio.create_task(consume())
+            await asyncio.sleep(0.01)
+            v.update(1)
+            await asyncio.sleep(0.01)
+            v.update(2)
+            await asyncio.wait_for(task, 2)
+            return out
+
+        assert asyncio.run(run()) == [0, 1, 2]
+
+    def test_changes_conflates(self):
+        """Burst updates between polls conflate to the latest state."""
+        async def run():
+            v = Var(0)
+            out = []
+
+            async def consume():
+                async for x in v.changes():
+                    out.append(x)
+                    if x == 99:
+                        break
+
+            task = asyncio.create_task(consume())
+            await asyncio.sleep(0.01)
+            for i in range(1, 100):
+                v.update(i)
+            await asyncio.wait_for(task, 2)
+            return out
+
+        out = asyncio.run(run())
+        assert out[0] == 0
+        assert out[-1] == 99
+        assert len(out) < 100  # conflated
+
+
+class TestActivity:
+    def test_states(self):
+        a = Activity.pending()
+        assert isinstance(a.current, Pending)
+        with pytest.raises(RuntimeError):
+            a.sample()
+        a.set_value(42)
+        assert a.sample() == 42
+        a.set_exception(ValueError("boom"))
+        with pytest.raises(ValueError):
+            a.sample()
+
+    def test_failed_dedup(self):
+        a = Activity.pending()
+        seen = []
+        a.states.observe(seen.append)
+        a.set_exception(ValueError("x"))
+        a.set_exception(ValueError("x"))  # same type+args: dedup
+        assert len(seen) == 2
+
+    def test_map(self):
+        a = Activity.value(2)
+        m = a.map(lambda x: x + 1)
+        assert m.sample() == 3
+        a.update(Ok(10))
+        assert m.sample() == 11
+
+    def test_map_failure_becomes_failed(self):
+        a = Activity.value(0)
+        m = a.map(lambda x: 1 // x)
+        assert isinstance(m.current, Failed)
+
+    def test_flat_map_switches_inner(self):
+        inner1 = Activity.value("one")
+        inner2 = Activity.value("two")
+        table = {1: inner1, 2: inner2}
+        a = Activity.value(1)
+        fm = a.flat_map(lambda k: table[k])
+        assert fm.sample() == "one"
+        a.set_value(2)
+        assert fm.sample() == "two"
+        # updates to the now-detached inner1 don't leak through
+        inner1.set_value("stale")
+        assert fm.sample() == "two"
+        # updates to the live inner propagate
+        inner2.set_value("two!")
+        assert fm.sample() == "two!"
+
+    def test_flat_map_pending_upstream(self):
+        a = Activity.pending()
+        fm = a.flat_map(lambda _: Activity.value(1))
+        assert isinstance(fm.current, Pending)
+        a.set_value(0)
+        assert fm.sample() == 1
+
+    def test_collect(self):
+        a, b = Activity.value(1), Activity.pending()
+        c = Activity.collect([a, b])
+        assert isinstance(c.current, Pending)
+        b.set_value(2)
+        assert c.sample() == (1, 2)
+        b.set_exception(RuntimeError("down"))
+        assert isinstance(c.current, Failed)
+
+    def test_to_future(self):
+        async def run():
+            a = Activity.pending()
+
+            async def later():
+                await asyncio.sleep(0.01)
+                a.set_value("done")
+
+            asyncio.create_task(later())
+            return await asyncio.wait_for(a.to_future(), 2)
+
+        assert asyncio.run(run()) == "done"
