@@ -1,0 +1,77 @@
+"""Headline benchmark: anomaly-scorer throughput on the real TPU chip.
+
+Measures the full sidecar scoring loop the ``io.l5d.jaxAnomaly`` telemeter
+drives: host-side feature micro-batches (numpy) -> device transfer -> fused
+scorer -> scores back on host. That is the per-request work the mesh does on
+TPU, so rows/second here is "requests scored per second".
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+baseline is the north-star target of 50k req/s scored (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from linkerd_tpu.models.anomaly import AnomalyModelConfig, init_params
+    from linkerd_tpu.ops.scoring import best_scorer, fused_available
+
+    cfg = AnomalyModelConfig()
+    params = init_params(jax.random.key(0), cfg)
+    scorer = best_scorer(cfg)
+
+    batch = 4096
+    n_iters = 200
+    rng = np.random.default_rng(0)
+    # Pre-generate host-side feature batches (the micro-batcher's output).
+    host_batches = [
+        rng.standard_normal((batch, cfg.in_dim), dtype=np.float32)
+        for _ in range(8)
+    ]
+
+    # Warm up / compile.
+    out = scorer(params, jnp.asarray(host_batches[0]))
+    jax.block_until_ready(out)
+
+    # Timed loop: device_put + score + fetch, pipelined by async dispatch.
+    t0 = time.perf_counter()
+    outs = []
+    for i in range(n_iters):
+        x = jax.device_put(host_batches[i % len(host_batches)])
+        outs.append(scorer(params, x))
+        if len(outs) >= 4:  # bounded in-flight queue, like the telemeter's
+            np.asarray(outs.pop(0))
+    for o in outs:
+        np.asarray(o)
+    dt = time.perf_counter() - t0
+
+    rows_per_s = batch * n_iters / dt
+    baseline = 50_000.0  # north-star: >=50k req/s scored (BASELINE.md)
+    print(json.dumps({
+        "metric": "anomaly_scorer_throughput",
+        "value": round(rows_per_s, 1),
+        "unit": "req/s",
+        "vs_baseline": round(rows_per_s / baseline, 3),
+        "detail": {
+            "batch": batch,
+            "iters": n_iters,
+            "fused_pallas": fused_available(),
+            "wall_s": round(dt, 3),
+            "device": str(jax.devices()[0]),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -160,7 +160,11 @@ class Activity(Generic[T]):
             return Ok(tuple(vals))
 
         joined = Var.collect([a.states for a in acts])
-        return Activity(joined.map(combine))
+        out = joined.map(combine)
+        # joined is owned exclusively by this chain: cascade close so
+        # Activity.collect(...).close() fully detaches from every input.
+        out._upstream.append(Closable(joined.close))
+        return Activity(out)
 
     # -- watching ---------------------------------------------------------
     async def changes(self) -> AsyncIterator[State[T]]:

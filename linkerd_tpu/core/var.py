@@ -130,14 +130,16 @@ class Var(Generic[T]):
 
         handle = self.observe(wake, run_now=False)
         try:
-            last_seen = object()
+            # Track versions, not values: values may not support bool(==)
+            # (e.g. numpy/JAX arrays), and update() already deduplicated.
+            last_version = -1
             while True:
-                cur = self._value
-                if cur != last_seen:
-                    last_seen = cur
-                    yield cur
+                if self._version != last_version:
+                    last_version = self._version
+                    yield self._value
+                    continue
                 event.clear()
-                if self._value != last_seen:
+                if self._version != last_version:
                     continue
                 await event.wait()
         finally:

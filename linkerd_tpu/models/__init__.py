@@ -1,0 +1,22 @@
+"""JAX anomaly models for the inline ML-inference telemeter.
+
+This is the flagship model family of the framework: the ``io.l5d.jaxAnomaly``
+telemeter (BASELINE.json north star) extracts per-request feature vectors from
+the router stack, micro-batches them, and scores them on TPU with the
+autoencoder + classifier below.
+"""
+
+from linkerd_tpu.models.features import FEATURE_DIM, FeatureVector, featurize
+from linkerd_tpu.models.anomaly import (
+    AnomalyModelConfig,
+    init_params,
+    apply_model,
+    anomaly_scores,
+    loss_fn,
+)
+
+__all__ = [
+    "FEATURE_DIM", "FeatureVector", "featurize",
+    "AnomalyModelConfig", "init_params", "apply_model", "anomaly_scores",
+    "loss_fn",
+]

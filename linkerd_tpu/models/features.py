@@ -1,0 +1,92 @@
+"""Per-request feature extraction for the anomaly telemeter.
+
+The feature schema is the seam between the host data plane (router filters
+observing requests — ref: the stats the reference's StatsFilter/
+StatusCodeStatsFilter/StreamStatsFilter record, SURVEY.md §2.1) and the TPU
+scorer. Host side produces fixed-width float32 vectors; everything after the
+ring buffer is batched ndarray work, so no Python-per-request cost on the
+TPU path.
+
+Layout (FEATURE_DIM = 32):
+
+    [0]      log1p(latency_ms)
+    [1:6]    status-class one-hot (1xx..5xx)
+    [6]      retryable-failure flag
+    [7]      retry count
+    [8]      log1p(request bytes)
+    [9]      log1p(response bytes)
+    [10]     in-flight concurrency at dispatch (log1p)
+    [11]     balancer EWMA latency of chosen endpoint (log1p ms)
+    [12]     queue wait ms (log1p)
+    [13]     1.0 if response was an exception (no status)
+    [14:30]  dst service path, feature-hashed (16 buckets, signed)
+    [30]     requests-per-second to this dst (log1p)
+    [31]     bias (1.0)
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+FEATURE_DIM = 32
+_PATH_HASH_OFF = 14
+_PATH_HASH_DIM = 16
+
+
+@dataclass
+class FeatureVector:
+    """Raw per-request observation recorded by the router filter."""
+
+    latency_ms: float = 0.0
+    status: int = 200
+    retries: int = 0
+    request_bytes: int = 0
+    response_bytes: int = 0
+    concurrency: int = 0
+    ewma_ms: float = 0.0
+    queue_ms: float = 0.0
+    exception: bool = False
+    retryable: bool = False
+    dst_path: str = "/"
+    dst_rps: float = 0.0
+
+
+def _hash_path(path: str, out: np.ndarray) -> None:
+    """Signed feature hashing of the dst path into 16 buckets."""
+    h = zlib.crc32(path.encode())
+    bucket = h % _PATH_HASH_DIM
+    sign = 1.0 if (h >> 16) & 1 else -1.0
+    out[_PATH_HASH_OFF + bucket] += sign
+
+
+def featurize(fv: FeatureVector, out: Optional[np.ndarray] = None) -> np.ndarray:
+    """Encode one observation into a float32[FEATURE_DIM] vector."""
+    x = out if out is not None else np.zeros(FEATURE_DIM, dtype=np.float32)
+    x[0] = np.log1p(max(fv.latency_ms, 0.0))
+    sc = fv.status // 100
+    if 1 <= sc <= 5:
+        x[1 + sc - 1] = 1.0
+    x[6] = 1.0 if fv.retryable else 0.0
+    x[7] = float(fv.retries)
+    x[8] = np.log1p(max(fv.request_bytes, 0))
+    x[9] = np.log1p(max(fv.response_bytes, 0))
+    x[10] = np.log1p(max(fv.concurrency, 0))
+    x[11] = np.log1p(max(fv.ewma_ms, 0.0))
+    x[12] = np.log1p(max(fv.queue_ms, 0.0))
+    x[13] = 1.0 if fv.exception else 0.0
+    _hash_path(fv.dst_path, x)
+    x[30] = np.log1p(max(fv.dst_rps, 0.0))
+    x[31] = 1.0
+    return x
+
+
+def featurize_batch(fvs: Sequence[FeatureVector]) -> np.ndarray:
+    """Encode a micro-batch: float32[len(fvs), FEATURE_DIM]."""
+    out = np.zeros((len(fvs), FEATURE_DIM), dtype=np.float32)
+    for i, fv in enumerate(fvs):
+        featurize(fv, out[i])
+    return out

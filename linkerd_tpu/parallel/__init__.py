@@ -1,0 +1,22 @@
+"""Device-mesh construction and sharded train/score steps.
+
+The device fabric is reached only through XLA collectives over ICI/DCN —
+this package owns the jax.sharding Mesh, the PartitionSpecs (batch over
+"data", hidden axes over "model"), and the jitted steps. The host data
+plane never touches device communication directly (SURVEY.md §5
+distributed-communication backend mapping).
+"""
+
+from linkerd_tpu.parallel.mesh import (
+    make_mesh,
+    param_shardings,
+    batch_sharding,
+    make_train_step,
+    make_score_step,
+    replicated,
+)
+
+__all__ = [
+    "make_mesh", "param_shardings", "batch_sharding", "make_train_step",
+    "make_score_step", "replicated",
+]

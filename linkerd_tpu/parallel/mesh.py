@@ -1,0 +1,153 @@
+"""Mesh + sharding for the anomaly model: dp x tp GSPMD.
+
+TPU-first design (scaling-book recipe): pick a mesh, annotate shardings with
+NamedSharding/PartitionSpec, let XLA insert the collectives (all-gather /
+reduce-scatter / psum ride ICI), profile, iterate. We do NOT hand-write
+collectives for the MLP: GSPMD partitioning of Megatron-style column/row
+parallel matmuls is exactly what the compiler does from the specs below.
+
+Axes:
+- ``data``  — batch-dim data parallelism (gradient psum inserted by XLA).
+- ``model`` — tensor parallelism over hidden dims: encoder layer i alternates
+  column-/row-parallel so activations stay sharded between layers.
+
+Sequence/pipeline/expert parallelism intentionally do not apply at this
+model's scale (per-request feature vectors, no sequence dim, single dense
+model — SURVEY.md §5 "Long-context" scopes ring-attention/Ulysses out);
+the mesh machinery here is what a wider model family would extend.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from linkerd_tpu.models.anomaly import (
+    AnomalyModelConfig, Params, init_params, anomaly_scores, loss_fn,
+)
+
+
+def make_mesh(
+    devices: Optional[list] = None,
+    tp: Optional[int] = None,
+    axis_names: Tuple[str, str] = ("data", "model"),
+) -> Mesh:
+    """Build a dp x tp mesh over ``devices`` (default: all local devices).
+
+    ``tp`` defaults to 2 when the device count is even and > 1, else 1 —
+    enough to exercise both axes; callers override for real topologies.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if tp is None:
+        tp = 2 if n % 2 == 0 and n > 1 else 1
+    if n % tp != 0:
+        raise ValueError(f"device count {n} not divisible by tp={tp}")
+    arr = np.array(devices).reshape(n // tp, tp)
+    return Mesh(arr, axis_names)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch rows over the data axis; feature dim replicated."""
+    return NamedSharding(mesh, P("data", None))
+
+
+def _layer_specs(n_layers: int, first_col: bool = True):
+    """Alternating column-/row-parallel specs for a dense chain.
+
+    Column-parallel layer: w [in, out] sharded (None, "model"), b sharded.
+    Row-parallel layer: w sharded ("model", None), b replicated (XLA adds
+    the psum over the contracted axis).
+    """
+    specs = []
+    col = first_col
+    for _ in range(n_layers):
+        if col:
+            specs.append({"w": P(None, "model"), "b": P("model")})
+        else:
+            specs.append({"w": P("model", None), "b": P()})
+        col = not col
+    return specs
+
+
+def param_specs(params: Params) -> Params:
+    """PartitionSpec pytree matching an anomaly-model param pytree."""
+    return {
+        "enc": _layer_specs(len(params["enc"])),
+        "dec": _layer_specs(len(params["dec"])),
+        "cls": _layer_specs(len(params["cls"])),
+    }
+
+
+def param_shardings(mesh: Mesh, params: Params) -> Params:
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        param_specs(params),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_params(mesh: Mesh, params: Params) -> Params:
+    return jax.device_put(params, param_shardings(mesh, params))
+
+
+def make_score_step(
+    mesh: Mesh, cfg: AnomalyModelConfig = AnomalyModelConfig()
+) -> Callable[[Params, jax.Array], jax.Array]:
+    """Jitted scoring step: features [B, D] -> scores [B]."""
+    xs = batch_sharding(mesh)
+
+    @jax.jit
+    def score(params: Params, x: jax.Array) -> jax.Array:
+        x = jax.lax.with_sharding_constraint(x, xs)
+        return anomaly_scores(params, x, cfg)
+
+    return score
+
+
+def make_train_step(
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    cfg: AnomalyModelConfig = AnomalyModelConfig(),
+):
+    """Jitted train step over the dp x tp mesh.
+
+    Gradients are averaged over "data" and hidden-dim partial sums reduced
+    over "model" by XLA-inserted collectives; we only annotate shardings.
+    """
+    xs = batch_sharding(mesh)
+    vs = NamedSharding(mesh, P("data"))
+
+    @jax.jit
+    def train_step(params: Params, opt_state, x, labels, label_mask):
+        x = jax.lax.with_sharding_constraint(x, xs)
+        labels = jax.lax.with_sharding_constraint(labels, vs)
+        label_mask = jax.lax.with_sharding_constraint(label_mask, vs)
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, labels, label_mask, cfg)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+def init_sharded(
+    mesh: Mesh,
+    key: jax.Array,
+    optimizer: optax.GradientTransformation,
+    cfg: AnomalyModelConfig = AnomalyModelConfig(),
+):
+    """Initialize params + opt state and place them per the tp specs."""
+    params = shard_params(mesh, init_params(key, cfg))
+    opt_state = optimizer.init(params)
+    return params, opt_state

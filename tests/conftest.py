@@ -9,11 +9,20 @@ the real device topology.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-override: the ambient environment pins JAX onto the real TPU tunnel
+# (axon, registered by a sitecustomize that overrides JAX_PLATFORMS); tests
+# must run on the virtual 8-device CPU mesh. Backends initialize lazily, so
+# setting jax.config before the first device use is sufficient even though
+# jax was already imported at interpreter start.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

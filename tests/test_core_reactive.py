@@ -192,6 +192,37 @@ class TestActivity:
         b.set_exception(RuntimeError("down"))
         assert isinstance(c.current, Failed)
 
+    def test_collect_close_detaches_inputs(self):
+        a, b = Activity.value(1), Activity.value(2)
+        c = Activity.collect([a, b])
+        assert a.states.observer_count == 1
+        c.close()
+        assert a.states.observer_count == 0
+        assert b.states.observer_count == 0
+
+    def test_changes_with_array_values(self):
+        """Vars of numpy arrays must not crash the watch stream on
+        ambiguous array __eq__ (version-based change detection)."""
+        import numpy as np
+
+        async def run():
+            v = Var(np.zeros(4))
+            out = []
+
+            async def consume():
+                async for x in v.changes():
+                    out.append(x.sum())
+                    if x.sum() >= 4:
+                        break
+
+            task = asyncio.create_task(consume())
+            await asyncio.sleep(0.01)
+            v.update(np.ones(4))
+            await asyncio.wait_for(task, 2)
+            return out
+
+        assert asyncio.run(run()) == [0.0, 4.0]
+
     def test_to_future(self):
         async def run():
             a = Activity.pending()
