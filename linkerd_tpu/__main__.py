@@ -1,0 +1,65 @@
+"""linkerd_tpu CLI: ``python -m linkerd_tpu path/to/config.yaml``.
+
+Reference parity: linkerd/main/.../Main.scala:25-49 — load config, build the
+linker, serve admin + routers + telemeters, await signals, drain gracefully.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import signal
+import sys
+
+from linkerd_tpu.admin.server import AdminServer
+from linkerd_tpu.linker import DEFAULT_ADMIN_PORT, load_linker
+
+log = logging.getLogger("linkerd_tpu")
+
+
+async def amain(config_text: str) -> None:
+    linker = load_linker(config_text)
+    await linker.start()
+
+    admin_spec = linker.spec.admin
+    admin = AdminServer(
+        linker.metrics, linker.config_dict,
+        host=admin_spec.ip if admin_spec else "127.0.0.1",
+        port=admin_spec.port if admin_spec else DEFAULT_ADMIN_PORT)
+    for t in linker.telemeters:
+        admin.add_handlers(t.admin_handlers())
+    await admin.start()
+
+    telemeter_tasks = [asyncio.create_task(t.run()) for t in linker.telemeters]
+
+    for r in linker.routers:
+        log.info("router %s serving on %s", r.label, r.server_ports)
+    log.info("admin serving on %s:%s", admin.host, admin.bound_port)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+
+    log.info("shutting down")
+    for task in telemeter_tasks:
+        task.cancel()
+    await admin.close()
+    await linker.close()
+
+
+def main() -> None:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    if len(sys.argv) != 2:
+        print("usage: python -m linkerd_tpu <config.yaml>", file=sys.stderr)
+        raise SystemExit(64)
+    with open(sys.argv[1], "r", encoding="utf-8") as f:
+        text = f.read()
+    asyncio.run(amain(text))
+
+
+if __name__ == "__main__":
+    main()

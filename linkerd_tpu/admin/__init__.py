@@ -1,0 +1,5 @@
+"""Admin HTTP surface (ref: /root/reference/admin, linkerd/admin)."""
+
+from linkerd_tpu.admin.server import AdminServer, Handler
+
+__all__ = ["AdminServer", "Handler"]

@@ -1,0 +1,23 @@
+"""``kind:``-polymorphic YAML/JSON plugin configuration.
+
+Reference parity: the ``config`` module's Jackson-based polymorphic parsing +
+JVM ServiceLoader plugin discovery (/root/reference/config/.../Parser.scala:38-90,
+LoadService registration) rebuilt as an explicit registry: plugins register a
+config dataclass under a (category, kind) pair; the parser sniffs YAML vs
+JSON, enforces unique kinds, rejects unknown fields and duplicate keys, and
+instantiates the registered class for each ``kind:``-discriminated object.
+"""
+
+from linkerd_tpu.config.registry import (
+    ConfigError, register, lookup, kinds, registered_categories, clear_category,
+)
+from linkerd_tpu.config.parser import (
+    parse_config, parse_file, instantiate, instantiate_list,
+)
+from linkerd_tpu.config.types import Port, HostAndPort
+
+__all__ = [
+    "ConfigError", "register", "lookup", "kinds", "registered_categories",
+    "clear_category", "parse_config", "parse_file", "instantiate",
+    "instantiate_list", "Port", "HostAndPort",
+]

@@ -1,0 +1,70 @@
+"""Plugin registry: (category, kind) -> config class.
+
+Reference parity: LoadService/META-INF SPI discovery + the unique-kind
+enforcement in Parser.scala:68-90. Categories mirror the 10 SPI kinds the
+reference Linker loads (Linker.scala:64-75): protocol, namer, interpreter,
+transformer, identifier, classifier, telemeter, announcer, failureAccrual,
+logger — plus namerd's storage and iface.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, Optional, Tuple, Type
+
+
+class ConfigError(Exception):
+    """Raised for malformed or unknown configuration."""
+
+
+_REGISTRY: Dict[str, Dict[str, type]] = {}
+
+CATEGORIES = (
+    "protocol", "namer", "interpreter", "transformer", "identifier",
+    "classifier", "telemeter", "announcer", "failureAccrual", "logger",
+    "storage", "iface",
+)
+
+
+def register(category: str, kind: str, *, experimental: bool = False,
+             aliases: Iterable[str] = ()) -> Callable[[type], type]:
+    """Class decorator registering a config class for ``kind`` in ``category``.
+
+    Kind ids must be unique within a category (duplicate registration is a
+    programming error, matching the reference's startup check).
+    """
+
+    def deco(cls: type) -> type:
+        cat = _REGISTRY.setdefault(category, {})
+        for k in (kind, *aliases):
+            if k in cat and cat[k] is not cls:
+                raise ConfigError(
+                    f"duplicate kind {k!r} in category {category!r}: "
+                    f"{cat[k].__name__} vs {cls.__name__}")
+            cat[k] = cls
+        cls.kind = kind
+        cls.experimental = experimental
+        return cls
+
+    return deco
+
+
+def lookup(category: str, kind: str) -> type:
+    try:
+        return _REGISTRY[category][kind]
+    except KeyError:
+        known = sorted(_REGISTRY.get(category, ()))
+        raise ConfigError(
+            f"unknown {category} kind {kind!r}; known kinds: {known}") from None
+
+
+def kinds(category: str) -> Tuple[str, ...]:
+    return tuple(sorted(_REGISTRY.get(category, ())))
+
+
+def registered_categories() -> Tuple[str, ...]:
+    return tuple(sorted(_REGISTRY))
+
+
+def clear_category(category: str) -> None:
+    """Test helper: drop all registrations in a category."""
+    _REGISTRY.pop(category, None)

@@ -1,0 +1,244 @@
+"""Linker: parse config -> namers -> routers -> servers.
+
+Reference parity: linkerd/core/.../Linker.scala:101-196 (LinkerConfig.mk:
+metrics tree, telemeters, namers, per-router interpreter + binding params,
+port-conflict checks) and linkerd/core/.../Router.scala / Server.scala /
+ProtocolInitializer for the per-router assembly; Main wiring per
+linkerd/main/.../Main.scala:25-49.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from linkerd_tpu.config import (
+    ConfigError, instantiate, instantiate_list, parse_config,
+)
+from linkerd_tpu.config.parser import instantiate_as
+from linkerd_tpu.core import Activity, Dtab, Path
+from linkerd_tpu.core.addr import Address, BoundName
+from linkerd_tpu.namer import ConfiguredDtabNamer, Namer
+from linkerd_tpu.protocol.http.client import HttpClient
+from linkerd_tpu.protocol.http.identifiers import compose_identifiers
+from linkerd_tpu.protocol.http.message import Request, Response
+from linkerd_tpu.protocol.http.server import HttpServer
+from linkerd_tpu.router.balancer import mk_balancer
+from linkerd_tpu.router.binding import DstBindingFactory, DstPath
+from linkerd_tpu.router.routing import (
+    ErrorResponder, PerDstPathStatsFilter, RoutingService, StatsFilter,
+    StatusCodeStatsFilter,
+)
+from linkerd_tpu.router.service import Service, filters_to_service
+from linkerd_tpu.telemetry.metrics import MetricsTree
+
+# Ensure built-in plugin registrations are loaded.
+import linkerd_tpu.namer.fs  # noqa: F401
+import linkerd_tpu.protocol.http.identifiers  # noqa: F401
+
+DEFAULT_ADMIN_PORT = 9990  # ref: Linker.scala:37
+DEFAULT_HTTP_PORT = 4140   # ref: linkerd http router default
+
+
+@dataclass
+class ServerSpec:
+    port: int = 0
+    ip: str = "127.0.0.1"
+    maxConcurrentRequests: Optional[int] = None
+
+
+@dataclass
+class BalancerSpec:
+    kind: str = "p2c"
+
+
+@dataclass
+class ClientSpec:
+    loadBalancer: Optional[BalancerSpec] = None
+    hostConnectionPool: int = 64
+    connectTimeoutMs: int = 3000
+
+
+@dataclass
+class RouterSpec:
+    protocol: str = "http"
+    label: Optional[str] = None
+    dtab: str = ""
+    dstPrefix: str = "/svc"
+    identifier: Optional[Any] = None      # kind-discriminated mapping(s)
+    servers: Optional[List[ServerSpec]] = None
+    client: Optional[ClientSpec] = None
+    bindingTimeoutMs: int = 10000
+    bindingCache: Optional[Dict[str, Any]] = None
+
+
+@dataclass
+class AdminSpec:
+    port: int = DEFAULT_ADMIN_PORT
+    ip: str = "127.0.0.1"
+
+
+@dataclass
+class LinkerSpec:
+    routers: List[RouterSpec] = field(default_factory=list)
+    namers: Optional[List[Any]] = None     # kind-discriminated mappings
+    telemetry: Optional[List[Any]] = None  # kind-discriminated mappings
+    admin: Optional[AdminSpec] = None
+
+
+def parse_linker_spec(text: str) -> LinkerSpec:
+    data = parse_config(text)
+    if not isinstance(data, dict):
+        raise ConfigError("linker config must be a mapping")
+    spec = instantiate_as(LinkerSpec, data)
+    if not spec.routers:
+        raise ConfigError("config needs at least one router")
+    return spec
+
+
+class Router:
+    """One configured router: routing service + its servers."""
+
+    def __init__(self, spec: RouterSpec, label: str, service: Service,
+                 binding: DstBindingFactory, servers: List[HttpServer]):
+        self.spec = spec
+        self.label = label
+        self.service = service
+        self.binding = binding
+        self.servers = servers
+
+    @property
+    def server_ports(self) -> List[int]:
+        return [s.bound_port for s in self.servers]
+
+    async def start(self) -> None:
+        for s in self.servers:
+            await s.start()
+
+    async def close(self) -> None:
+        for s in self.servers:
+            await s.close()
+        await self.service.close()
+
+
+class Linker:
+    def __init__(self, spec: LinkerSpec, config_dict: Any = None):
+        self.spec = spec
+        self.config_dict = config_dict
+        self.metrics = MetricsTree()
+        self.namers: List[Tuple[Path, Namer]] = []
+        self.routers: List[Router] = []
+        self.telemeters: List[Any] = []
+        self._build()
+
+    # -- assembly ---------------------------------------------------------
+    def _build(self) -> None:
+        for ncfg in instantiate_list("namer", self.spec.namers, "namers"):
+            prefix = Path.read(getattr(ncfg, "prefix", f"/{ncfg.kind}"))
+            self.namers.append((prefix, ncfg.mk()))
+
+        for tcfg in instantiate_list("telemeter", self.spec.telemetry, "telemetry"):
+            self.telemeters.append(tcfg.mk(self.metrics))
+
+        labels_seen: Dict[str, int] = {}
+        for rspec in self.spec.routers:
+            if rspec.protocol != "http":
+                raise ConfigError(
+                    f"protocol {rspec.protocol!r} not yet supported")
+            label = rspec.label or rspec.protocol
+            n = labels_seen.get(label, 0)
+            labels_seen[label] = n + 1
+            if n:
+                label = f"{label}-{n}"
+            self.routers.append(self._mk_http_router(rspec, label))
+
+        # port-conflict check (ref: Linker.scala:189-195)
+        ports = [
+            (s.ip, s.port)
+            for r in self.routers for s in (r.spec.servers or [])
+            if s.port
+        ]
+        if len(ports) != len(set(ports)):
+            raise ConfigError(f"server port conflict: {ports}")
+
+    def _mk_http_router(self, rspec: RouterSpec, label: str) -> Router:
+        base_dtab = Dtab.read(rspec.dtab) if rspec.dtab else Dtab.empty()
+        prefix = Path.read(rspec.dstPrefix)
+
+        # identifier(s)
+        id_cfgs = rspec.identifier
+        if id_cfgs is None:
+            id_cfgs = [{"kind": "io.l5d.header.token"}]
+        elif isinstance(id_cfgs, dict):
+            id_cfgs = [id_cfgs]
+        identifiers = [
+            instantiate("identifier", c, f"{label}.identifier").mk(prefix, base_dtab)
+            for c in id_cfgs
+        ]
+        identifier = compose_identifiers(identifiers)
+
+        interpreter = ConfiguredDtabNamer(self.namers)
+
+        cspec = rspec.client or ClientSpec()
+        bal_kind = (cspec.loadBalancer or BalancerSpec()).kind
+        metrics = self.metrics
+
+        def endpoint_factory(addr: Address) -> Service:
+            return HttpClient(
+                addr.host, addr.port,
+                max_connections=cspec.hostConnectionPool,
+                connect_timeout=cspec.connectTimeoutMs / 1e3)
+
+        def client_factory(bound: BoundName) -> Service:
+            cid = bound.id_.show.lstrip("/").replace("/", ".") or "client"
+            bal = mk_balancer(bal_kind, bound.addr, endpoint_factory)
+            stats = StatsFilter(metrics, "rt", label, "client", cid)
+            metrics.scope("rt", label, "client", cid).gauge(
+                "endpoints", fn=lambda b=bal: b.size)
+            return stats.and_then(bal)
+
+        def path_filters(dst: DstPath, svc: Service) -> Service:
+            name = dst.path.show.lstrip("/").replace("/", ".") or "root"
+            return StatsFilter(metrics, "rt", label, "service", name).and_then(svc)
+
+        cache_cfg = rspec.bindingCache or {}
+        binding = DstBindingFactory(
+            interpreter, client_factory, path_filters=path_filters,
+            capacity=int(cache_cfg.get("capacity", 1000)),
+            idle_ttl=float(cache_cfg.get("idleTtlSecs", 600.0)),
+            bind_timeout=rspec.bindingTimeoutMs / 1e3)
+
+        routing = RoutingService(identifier, binding)
+        # Stats outermost so they observe ErrorResponder's mapped statuses.
+        server_stack = filters_to_service([
+            StatsFilter(metrics, "rt", label, "server"),
+            StatusCodeStatsFilter(metrics, "rt", label, "server"),
+            ErrorResponder(),
+        ], routing)
+
+        servers = [
+            HttpServer(server_stack, s.ip, s.port,
+                       max_concurrency=s.maxConcurrentRequests)
+            for s in (rspec.servers or [ServerSpec()])
+        ]
+        return Router(rspec, label, server_stack, binding, servers)
+
+    # -- lifecycle --------------------------------------------------------
+    async def start(self) -> "Linker":
+        for r in self.routers:
+            await r.start()
+        return self
+
+    async def close(self) -> None:
+        for r in self.routers:
+            await r.close()
+        for _, namer in self.namers:
+            namer.close()
+        for t in self.telemeters:
+            t.close()
+
+
+def load_linker(text: str) -> Linker:
+    """Parse a YAML/JSON config into an (unstarted) Linker."""
+    return Linker(parse_linker_spec(text), parse_config(text))

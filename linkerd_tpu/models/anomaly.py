@@ -115,17 +115,11 @@ def loss_fn(
     (fault-injection traces) and 0.0 for unlabeled traffic. Pure arithmetic —
     no data-dependent control flow, so it jits to one fused XLA computation.
     """
+    import optax
+
     recon, _, logits = apply_model(params, x, cfg)
     recon_loss = jnp.mean(jnp.square(recon - x))
-    bce = optax_sigmoid_bce(logits, labels)
+    bce = optax.sigmoid_binary_cross_entropy(logits, labels)
     denom = jnp.maximum(jnp.sum(label_mask), 1.0)
     cls_loss = jnp.sum(bce * label_mask) / denom
     return recon_loss + cls_loss
-
-
-def optax_sigmoid_bce(logits: jax.Array, labels: jax.Array) -> jax.Array:
-    """Numerically-stable sigmoid binary cross-entropy (elementwise)."""
-    zeros = jnp.zeros_like(logits)
-    relu_logits = jnp.where(logits < 0, zeros, logits)
-    neg_abs = jnp.where(logits < 0, logits, -logits)
-    return relu_logits - logits * labels + jnp.log1p(jnp.exp(neg_abs))

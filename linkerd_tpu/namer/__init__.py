@@ -1,0 +1,15 @@
+"""Naming & interpretation: Namer SPI, dtab interpreter, namers.
+
+Reference parity: /root/reference/namer/core (NamerInitializer,
+ConfiguredDtabNamer, Paths) and the namer plugins.
+"""
+
+from linkerd_tpu.namer.core import (
+    Namer, NameInterpreter, ConfiguredDtabNamer, bind_leaves,
+    CONFIGURED_PREFIX, UTILITY_PREFIX,
+)
+
+__all__ = [
+    "Namer", "NameInterpreter", "ConfiguredDtabNamer", "bind_leaves",
+    "CONFIGURED_PREFIX", "UTILITY_PREFIX",
+]

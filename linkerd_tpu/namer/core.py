@@ -1,0 +1,206 @@
+"""Namer SPI and the recursive dtab interpreter.
+
+Reference parity:
+- ``Namer`` — finagle Namer: lookup(path) -> Activity[NameTree[Name]] where
+  leaves are either terminal bound names or paths to delegate further.
+- ``ConfiguredDtabNamer`` — namer/core/.../ConfiguredDtabNamer.scala:14-42:
+  recursive dtab lookup with ``/#/`` configured-namer prefixes (Paths.scala)
+  and ``/$/`` utility namers, leaf-by-leaf grafting, and a recursion limit.
+
+Here a NameTree's leaves during interpretation are either ``BoundName``
+(terminal — carries the live Var[Addr]) or ``Path`` (delegate further).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+from linkerd_tpu.core import (
+    Activity, Addr, Address, Dtab, Path, Var,
+)
+from linkerd_tpu.core.addr import ADDR_NEG, AddrFailed, Bound, BoundName
+from linkerd_tpu.core.nametree import (
+    Alt, EMPTY, FAIL, Leaf, NameTree, NEG, Neg, Union as TreeUnion, Weighted,
+)
+
+CONFIGURED_PREFIX = "#"  # /#/<namer-prefix>/... -> configured namer
+UTILITY_PREFIX = "$"     # /$/<utility>/...      -> utility namer
+MAX_DEPTH = 100
+
+Name = Union[BoundName, Path]
+
+
+class Namer(abc.ABC):
+    """Resolves residual paths under a configured prefix."""
+
+    @abc.abstractmethod
+    def lookup(self, path: Path) -> Activity[NameTree[Name]]: ...
+
+    def close(self) -> None:
+        return
+
+
+class NameInterpreter(abc.ABC):
+    """Binds logical paths through a delegation table
+    (ref: finagle NameInterpreter; remote implementations are the namerd
+    client interpreters, SURVEY.md §3.3)."""
+
+    @abc.abstractmethod
+    def bind(self, dtab: Dtab, path: Path) -> Activity[NameTree[BoundName]]: ...
+
+
+def bind_leaves(
+    tree: NameTree, f: Callable[[Path], Activity[NameTree[BoundName]]]
+) -> Activity[NameTree[BoundName]]:
+    """Substitute every Path leaf of ``tree`` with its resolved subtree.
+
+    Combines leaf Activities with Activity.collect and grafts results back
+    in position, preserving Alt/Union structure and weights.
+    """
+    leaves: List[Path] = []
+
+    def collect(t: NameTree) -> None:
+        if isinstance(t, Leaf):
+            if isinstance(t.value, Path):
+                leaves.append(t.value)
+        elif isinstance(t, Alt):
+            for s in t.trees:
+                collect(s)
+        elif isinstance(t, TreeUnion):
+            for w in t.weighted:
+                collect(w.tree)
+
+    collect(tree)
+    if not leaves:
+        return Activity.value(tree)
+
+    acts = [f(p) for p in leaves]
+
+    def graft(subs: tuple) -> NameTree[BoundName]:
+        it = iter(subs)
+
+        def walk(t: NameTree) -> NameTree:
+            if isinstance(t, Leaf):
+                if isinstance(t.value, Path):
+                    return next(it)
+                return t
+            if isinstance(t, Alt):
+                return Alt(*[walk(s) for s in t.trees])
+            if isinstance(t, TreeUnion):
+                return TreeUnion(*[Weighted(w.weight, walk(w.tree))
+                                   for w in t.weighted])
+            return t
+
+        return walk(tree)
+
+    return Activity.collect(acts).map(graft)
+
+
+# -- utility namers (/$/...) -------------------------------------------------
+
+UtilityNamer = Callable[[Path], NameTree[Name]]
+_UTILITY: Dict[str, UtilityNamer] = {}
+
+
+def register_utility(name: str) -> Callable[[UtilityNamer], UtilityNamer]:
+    def deco(fn: UtilityNamer) -> UtilityNamer:
+        _UTILITY[name] = fn
+        return fn
+    return deco
+
+
+@register_utility("inet")
+def _inet(residual: Path) -> NameTree[Name]:
+    """``/$/inet/<host>/<port>[/residual...]`` -> bound address
+    (ref: finagle's IN-process inet namer used throughout linkerd configs)."""
+    if len(residual) < 2:
+        return FAIL
+    host, port_s = residual[0], residual[1]
+    try:
+        port = int(port_s)
+    except ValueError:
+        return FAIL
+    addr: Var[Addr] = Var(Bound.of(Address.mk(host, port)))
+    bid = Path.of("$", "inet", host, port_s)
+    return Leaf(BoundName(bid, addr, residual.drop(2)))
+
+
+@register_utility("nil")
+def _nil(residual: Path) -> NameTree[Name]:
+    return EMPTY
+
+
+@register_utility("fail")
+def _fail(residual: Path) -> NameTree[Name]:
+    return FAIL
+
+
+def utility_lookup(path: Path) -> NameTree[Name]:
+    """Resolve a ``/$/<utility>/...`` path; unknown utilities are Neg."""
+    if len(path) < 2 or path[0] != UTILITY_PREFIX:
+        return NEG
+    fn = _UTILITY.get(path[1])
+    if fn is None:
+        return NEG
+    return fn(path.drop(2))
+
+
+# -- the interpreter ---------------------------------------------------------
+
+
+class TooDeep(Exception):
+    pass
+
+
+class ConfiguredDtabNamer(NameInterpreter):
+    """Recursive dtab interpretation over configured namers.
+
+    ``namers`` is an ordered list of (prefix, Namer); a path ``/#/pfx/rest``
+    is delegated to the first namer whose prefix matches (most-specific
+    config wins by list order, matching the reference's first-match
+    semantics). The base dtab is reactive: an Activity[Dtab] so control-plane
+    dtab updates re-bind live paths.
+    """
+
+    def __init__(self, namers: Sequence[Tuple[Path, Namer]] = (),
+                 dtab: Optional[Activity] = None):
+        self.namers = list(namers)
+        self.dtab_activity: Activity = (
+            dtab if dtab is not None else Activity.value(Dtab.empty()))
+
+    def bind(self, local_dtab: Dtab, path: Path) -> Activity[NameTree[BoundName]]:
+        def with_dtab(base: Dtab) -> Activity[NameTree[BoundName]]:
+            dtab = base + local_dtab
+            return self._bind(dtab, path, 0)
+
+        return self.dtab_activity.flat_map(with_dtab)
+
+    # -- internals --------------------------------------------------------
+    def _bind(self, dtab: Dtab, path: Path, depth: int
+              ) -> Activity[NameTree[BoundName]]:
+        if depth > MAX_DEPTH:
+            return Activity.exception(
+                TooDeep(f"dtab delegation exceeded {MAX_DEPTH} levels at "
+                        f"{path.show}"))
+        if len(path) > 0 and path[0] == UTILITY_PREFIX:
+            tree = utility_lookup(path)
+            return bind_leaves(
+                tree, lambda p: self._bind(dtab, p, depth + 1))
+        if len(path) > 0 and path[0] == CONFIGURED_PREFIX:
+            return self._lookup_configured(dtab, path, depth)
+        tree = dtab.lookup(path)
+        if isinstance(tree, Neg):
+            return Activity.value(NEG)
+        return bind_leaves(tree, lambda p: self._bind(dtab, p, depth + 1))
+
+    def _lookup_configured(self, dtab: Dtab, path: Path, depth: int
+                           ) -> Activity[NameTree[BoundName]]:
+        rest = path.drop(1)  # strip '#'
+        for prefix, namer in self.namers:
+            if rest.starts_with(prefix):
+                act = namer.lookup(rest.drop(len(prefix)))
+                return act.flat_map(
+                    lambda tree: bind_leaves(
+                        tree, lambda p: self._bind(dtab, p, depth + 1)))
+        return Activity.value(NEG)

@@ -39,7 +39,8 @@ def _score_kernel(x_ref, *refs, n_enc: int, n_dec: int, n_cls: int,
     """Pallas kernel body: refs = [w0, b0, w1, b1, ..., out_ref]."""
     out_ref = refs[-1]
     wb = refs[:-1]
-    x = x_ref[...].astype(compute_dtype)
+    x32 = x_ref[...].astype(jnp.float32)
+    x = x32.astype(compute_dtype)
 
     def run(h, lo, n, final_act):
         for i in range(n):
@@ -55,7 +56,9 @@ def _score_kernel(x_ref, *refs, n_enc: int, n_dec: int, n_cls: int,
     recon = run(z, n_enc, n_dec, final_act=False)
     logits = run(z, n_enc + n_dec, n_cls, final_act=False)
 
-    err = jnp.mean(jnp.square(recon.astype(jnp.float32) - x.astype(jnp.float32)),
+    # reconstruction error against the ORIGINAL f32 input, matching
+    # models.anomaly.anomaly_scores (not the bf16-rounded copy)
+    err = jnp.mean(jnp.square(recon.astype(jnp.float32) - x32),
                    axis=-1, keepdims=True)
     recon_score = jnp.tanh(err)
     cls_score = jax.nn.sigmoid(logits.astype(jnp.float32))
@@ -72,13 +75,15 @@ def fused_anomaly_scores(
 ) -> jax.Array:
     """Score ``x`` [B, D] -> [B] with the fused kernel.
 
-    ``B`` must be a multiple of ``block_rows`` (the micro-batcher pads).
-    Weights are broadcast to every grid step (index_map -> block 0) so they
-    load into VMEM once and stay resident.
+    Ragged batches are zero-padded up to a multiple of ``block_rows`` and
+    the padding rows sliced off the result. Weights are broadcast to every
+    grid step (index_map -> block 0) so they load into VMEM once and stay
+    resident.
     """
-    b, d = x.shape
-    if b % block_rows != 0:
-        raise ValueError(f"batch {b} not a multiple of block_rows {block_rows}")
+    orig_b, d = x.shape
+    b = ((orig_b + block_rows - 1) // block_rows) * block_rows
+    if b != orig_b:
+        x = jnp.pad(x, ((0, b - orig_b), (0, 0)))
     layers = _flatten_layers(params)
     n_enc = len(params["enc"])
     n_dec = len(params["dec"])
@@ -107,15 +112,15 @@ def fused_anomaly_scores(
         out_shape=jax.ShapeDtypeStruct((b, 1), jnp.float32),
         interpret=interpret,
     )(x, *flat_args)
-    return out[:, 0]
+    return out[:orig_b, 0]
 
 
-@functools.cache
-def fused_available() -> bool:
-    """Probe whether the fused kernel compiles+runs on the current backend."""
+@functools.lru_cache(maxsize=16)
+def fused_available(cfg: AnomalyModelConfig = AnomalyModelConfig()) -> bool:
+    """Probe whether the fused kernel compiles+runs for THIS config on the
+    current backend (cached per config)."""
     try:
         from linkerd_tpu.models.anomaly import init_params
-        cfg = AnomalyModelConfig()
         params = init_params(jax.random.key(0), cfg)
         x = jnp.zeros((256, cfg.in_dim), jnp.float32)
         got = jax.jit(lambda p, v: fused_anomaly_scores(p, v, cfg))(params, x)
@@ -127,6 +132,6 @@ def fused_available() -> bool:
 
 def best_scorer(cfg: AnomalyModelConfig = AnomalyModelConfig()):
     """Return a jitted scorer: the fused kernel when available, else XLA."""
-    if fused_available():
+    if fused_available(cfg):
         return jax.jit(lambda p, v: fused_anomaly_scores(p, v, cfg))
     return jax.jit(lambda p, v: anomaly_scores(p, v, cfg))

@@ -1,0 +1,1 @@
+"""Wire protocols: HTTP/1.1 now; h2+gRPC and thrift follow (SURVEY.md §7)."""

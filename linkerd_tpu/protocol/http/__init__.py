@@ -1,0 +1,9 @@
+"""HTTP/1.1 protocol: message model, codec, server, client pool.
+
+Reference parity: linkerd's http protocol support (router/http,
+linkerd/protocol/http) minus the Netty engine — rebuilt on asyncio streams.
+"""
+
+from linkerd_tpu.protocol.http.message import Headers, Request, Response
+
+__all__ = ["Headers", "Request", "Response"]

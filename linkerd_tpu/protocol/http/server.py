@@ -1,0 +1,120 @@
+"""HTTP/1.1 server: asyncio listener dispatching requests into a Service.
+
+Reference parity: the server side of ProtocolInitializer
+(linkerd/core/.../ProtocolInitializer.scala:92-102 serves the adapted router
+service) with keep-alive, pipelined-sequential request handling, and error
+responses for framing failures.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional, Tuple
+
+from linkerd_tpu.protocol.http import codec
+from linkerd_tpu.protocol.http.message import Request, Response
+from linkerd_tpu.router.service import Service
+
+log = logging.getLogger(__name__)
+
+
+class HttpServer:
+    def __init__(self, service: Service[Request, Response],
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_body: int = codec.MAX_BODY,
+                 max_concurrency: Optional[int] = None):
+        self.service = service
+        self.host = host
+        self.port = port
+        self.max_body = max_body
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._sem = (asyncio.Semaphore(max_concurrency)
+                     if max_concurrency else None)
+        self._conns: set = set()
+
+    @property
+    def bound_port(self) -> int:
+        assert self._server is not None, "server not started"
+        return self._server.sockets[0].getsockname()[1]
+
+    async def start(self) -> "HttpServer":
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port)
+        return self
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for w in list(self._conns):
+            try:
+                w.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        self._conns.add(writer)
+        try:
+            while True:
+                try:
+                    req = await codec.read_request(reader, self.max_body)
+                except EOFError:
+                    return
+                except codec.BodyTooLarge:
+                    codec.write_response(writer, Response(status=413))
+                    await writer.drain()
+                    return
+                except codec.HttpCodecError as e:
+                    codec.write_response(
+                        writer, Response(status=400, body=str(e).encode()))
+                    await writer.drain()
+                    return
+
+                req.ctx["client_addr"] = writer.get_extra_info("peername")
+                if self._sem is not None:
+                    # Admission control (ref: maxConcurrentRequests ->
+                    # RequestSemaphoreFilter, Server.scala:89-97)
+                    if self._sem.locked():
+                        rsp = Response(status=503, body=b"too many requests")
+                        codec.write_response(writer, rsp)
+                        await writer.drain()
+                        continue
+                    async with self._sem:
+                        rsp = await self._dispatch(req)
+                else:
+                    rsp = await self._dispatch(req)
+
+                conn_close = (
+                    (req.headers.get("connection") or "").lower() == "close"
+                    or req.version == "HTTP/1.0"
+                )
+                if conn_close:
+                    rsp.headers.set("Connection", "close")
+                codec.write_response(writer, rsp)
+                await writer.drain()
+                if conn_close:
+                    return
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        except Exception:  # noqa: BLE001
+            log.exception("connection handler error")
+        finally:
+            self._conns.discard(writer)
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _dispatch(self, req: Request) -> Response:
+        try:
+            return await self.service(req)
+        except Exception as e:  # noqa: BLE001 — last-resort error responder
+            log.debug("service error: %r", e)
+            return Response(status=502, body=repr(e).encode())
+
+
+async def serve(service: Service, host: str = "127.0.0.1",
+                port: int = 0, **kw) -> HttpServer:
+    return await HttpServer(service, host, port, **kw).start()

@@ -1,0 +1,11 @@
+"""Router core: identify -> bind -> balance -> dispatch.
+
+Reference parity: /root/reference/router/core (StackRouter, RoutingFactory,
+DstBindingFactory) re-designed as asyncio service composition.
+"""
+
+from linkerd_tpu.router.service import (
+    Service, ServiceFactory, Filter, FnService, Status,
+)
+
+__all__ = ["Service", "ServiceFactory", "Filter", "FnService", "Status"]

@@ -1,0 +1,259 @@
+"""Load balancers over live replica sets.
+
+Reference parity: the balancer kinds linkerd exposes
+(LoadBalancerConfig.scala:12-18 — p2c, ewma, aperture, heap, roundRobin)
+over finagle's Balancers, fed by ``Var[Addr]`` so address churn flows
+without re-binding (SURVEY.md §3.3).
+
+Endpoints materialize lazily from the Var[Addr]; removed addresses close
+their endpoint services. Load metrics:
+- p2c       — power-of-two-choices on (pending / weight)
+- ewma      — peak-EWMA latency x (pending+1), p2c choice
+- roundRobin— weight-ignoring cycle
+- heap      — global least-loaded
+- aperture  — p2c over a load-adaptive prefix of the endpoint list
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from linkerd_tpu.core import Var
+from linkerd_tpu.core.addr import (
+    Addr, Address, AddrFailed, AddrNeg, AddrPending, Bound,
+)
+from linkerd_tpu.router.service import Service, Status
+
+
+class NoBrokersAvailable(Exception):
+    """No endpoints to dispatch to (empty/neg/failed replica set)."""
+
+
+class Endpoint:
+    """One concrete replica: the endpoint service + load accounting."""
+
+    __slots__ = ("address", "service", "pending", "ewma_ms", "_decay")
+
+    def __init__(self, address: Address, service: Service):
+        self.address = address
+        self.service = service
+        self.pending = 0
+        self.ewma_ms = 0.0  # peak-EWMA latency estimate
+        self._decay = 0.1
+
+    @property
+    def weight(self) -> float:
+        return self.address.weight if self.address.weight > 0 else 1e-6
+
+    @property
+    def load(self) -> float:
+        return self.pending / self.weight
+
+    def observe_latency(self, ms: float) -> None:
+        # Peak-EWMA (ref: finagle ewma balancer): jump up instantly,
+        # decay down exponentially.
+        if ms > self.ewma_ms:
+            self.ewma_ms = ms
+        else:
+            self.ewma_ms += self._decay * (ms - self.ewma_ms)
+
+    @property
+    def status(self) -> Status:
+        return self.service.status
+
+
+class Balancer(Service):
+    """Base: maintains the endpoint set from a Var[Addr]."""
+
+    def __init__(self, addr: Var[Addr],
+                 endpoint_factory: Callable[[Address], Service],
+                 rng: Optional[random.Random] = None):
+        self._addr = addr
+        self._endpoint_factory = endpoint_factory
+        self._endpoints: Dict[Address, Endpoint] = {}
+        self._rng = rng or random.Random()
+        self._closed = False
+        self._to_close: List[Service] = []
+        self._obs = addr.observe(self._on_addr)
+
+    # -- replica-set maintenance -----------------------------------------
+    def _on_addr(self, addr: Addr) -> None:
+        if not isinstance(addr, Bound):
+            return  # keep last-known-good endpoints through blips
+        want = {a for a in addr.addresses}
+        for a in list(self._endpoints):
+            if a not in want:
+                ep = self._endpoints.pop(a)
+                self._to_close.append(ep.service)
+        for a in want:
+            if a not in self._endpoints:
+                self._endpoints[a] = Endpoint(a, self._endpoint_factory(a))
+
+    async def _reap(self) -> None:
+        to_close, self._to_close = self._to_close, []
+        for svc in to_close:
+            try:
+                await svc.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _usable(self) -> List[Endpoint]:
+        eps = [e for e in self._endpoints.values()
+               if e.status is Status.OPEN]
+        return eps or list(self._endpoints.values())
+
+    def _check_addr(self) -> None:
+        addr = self._addr.sample()
+        if not self._endpoints:
+            if isinstance(addr, AddrFailed):
+                raise NoBrokersAvailable(f"address failed: {addr.why}")
+            if isinstance(addr, (AddrNeg, AddrPending)) or (
+                    isinstance(addr, Bound) and not addr.addresses):
+                raise NoBrokersAvailable("empty replica set")
+
+    # -- Service ----------------------------------------------------------
+    @property
+    def status(self) -> Status:
+        if self._closed:
+            return Status.CLOSED
+        return Status.OPEN if self._endpoints else Status.BUSY
+
+    @property
+    def size(self) -> int:
+        return len(self._endpoints)
+
+    def pick(self) -> Endpoint:
+        raise NotImplementedError
+
+    async def __call__(self, req):
+        if self._to_close:
+            await self._reap()
+        self._check_addr()
+        ep = self.pick()
+        ep.pending += 1
+        t0 = time.monotonic()
+        try:
+            rsp = await ep.service(req)
+        finally:
+            ep.pending -= 1
+            ep.observe_latency((time.monotonic() - t0) * 1e3)
+        return rsp
+
+    async def close(self) -> None:
+        self._closed = True
+        self._obs.close()
+        for ep in self._endpoints.values():
+            self._to_close.append(ep.service)
+        self._endpoints.clear()
+        await self._reap()
+
+
+class P2CBalancer(Balancer):
+    """Power-of-two-choices least-loaded (ref: Balancers.p2c)."""
+
+    def pick(self) -> Endpoint:
+        eps = self._usable()
+        if not eps:
+            raise NoBrokersAvailable("no endpoints")
+        if len(eps) == 1:
+            return eps[0]
+        a, b = self._rng.sample(eps, 2)
+        return a if a.load <= b.load else b
+
+
+class EwmaBalancer(Balancer):
+    """Peak-EWMA p2c (ref: Balancers.p2cPeakEwma)."""
+
+    def pick(self) -> Endpoint:
+        eps = self._usable()
+        if not eps:
+            raise NoBrokersAvailable("no endpoints")
+        if len(eps) == 1:
+            return eps[0]
+        a, b = self._rng.sample(eps, 2)
+        sa = (a.ewma_ms + 1.0) * (a.pending + 1) / a.weight
+        sb = (b.ewma_ms + 1.0) * (b.pending + 1) / b.weight
+        return a if sa <= sb else b
+
+
+class RoundRobinBalancer(Balancer):
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._i = 0
+
+    def pick(self) -> Endpoint:
+        eps = self._usable()
+        if not eps:
+            raise NoBrokersAvailable("no endpoints")
+        self._i = (self._i + 1) % len(eps)
+        return eps[self._i]
+
+
+class HeapBalancer(Balancer):
+    """Global least-loaded (ref: Balancers.heap)."""
+
+    def pick(self) -> Endpoint:
+        eps = self._usable()
+        if not eps:
+            raise NoBrokersAvailable("no endpoints")
+        return min(eps, key=lambda e: e.load)
+
+
+class ApertureBalancer(Balancer):
+    """P2C over a load-adaptive aperture (ref: Balancers.aperture).
+
+    The aperture widens when average in-flight load per endpoint exceeds
+    ``high_load`` and narrows below ``low_load``, bounded to
+    [min_aperture, n].
+    """
+
+    def __init__(self, *args, min_aperture: int = 1, low_load: float = 0.5,
+                 high_load: float = 2.0, **kw):
+        super().__init__(*args, **kw)
+        self.min_aperture = min_aperture
+        self.low_load = low_load
+        self.high_load = high_load
+        self._aperture = min_aperture
+
+    def pick(self) -> Endpoint:
+        eps = self._usable()
+        if not eps:
+            raise NoBrokersAvailable("no endpoints")
+        n = len(eps)
+        width = max(self.min_aperture, min(self._aperture, n))
+        window = eps[:width]
+        total_pending = sum(e.pending for e in window)
+        avg = total_pending / max(1, width)
+        if avg > self.high_load and self._aperture < n:
+            self._aperture += 1
+        elif avg < self.low_load and self._aperture > self.min_aperture:
+            self._aperture -= 1
+        if len(window) == 1:
+            return window[0]
+        a, b = self._rng.sample(window, 2)
+        return a if a.load <= b.load else b
+
+
+BALANCER_KINDS = {
+    "p2c": P2CBalancer,
+    "ewma": EwmaBalancer,
+    "roundRobin": RoundRobinBalancer,
+    "heap": HeapBalancer,
+    "aperture": ApertureBalancer,
+}
+
+
+def mk_balancer(kind: str, addr: Var[Addr],
+                endpoint_factory: Callable[[Address], Service],
+                rng: Optional[random.Random] = None) -> Balancer:
+    try:
+        cls = BALANCER_KINDS[kind]
+    except KeyError:
+        raise ValueError(
+            f"unknown balancer kind {kind!r}; known: {sorted(BALANCER_KINDS)}"
+        ) from None
+    return cls(addr, endpoint_factory, rng)

@@ -1,0 +1,10 @@
+"""Telemetry: MetricsTree, Telemeter SPI, stats plumbing, exporters.
+
+Reference parity: /root/reference/telemetry/core (Telemeter.scala:11,
+MetricsTree.scala:9) and the exporter plugins (§2.3 of SURVEY.md).
+"""
+
+from linkerd_tpu.telemetry.metrics import MetricsTree, Counter, Gauge, Stat
+from linkerd_tpu.telemetry.telemeter import Telemeter
+
+__all__ = ["MetricsTree", "Counter", "Gauge", "Stat", "Telemeter"]

@@ -1,0 +1,48 @@
+"""Admin server surface tests."""
+
+import asyncio
+import json
+
+from linkerd_tpu.admin.server import AdminServer
+from linkerd_tpu.protocol.http import Request
+from linkerd_tpu.protocol.http.client import HttpClient
+from linkerd_tpu.telemetry.metrics import MetricsTree
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 15))
+
+
+class TestAdmin:
+    def test_endpoints(self):
+        async def go():
+            mt = MetricsTree()
+            mt.counter("rt", "http", "server", "requests").incr(7)
+            admin = AdminServer(mt, {"routers": [{"protocol": "http"}]}, port=0)
+            await admin.start()
+            client = HttpClient("127.0.0.1", admin.bound_port)
+            try:
+                r = await client(Request(uri="/ping"))
+                assert (r.status, r.body) == (200, b"pong")
+
+                r = await client(Request(uri="/config.json"))
+                assert json.loads(r.body) == {"routers": [{"protocol": "http"}]}
+
+                r = await client(Request(uri="/admin/metrics.json"))
+                flat = json.loads(r.body)
+                assert flat["rt/http/server/requests"] == 7
+
+                r = await client(Request(uri="/admin/metrics.json?tree=true"))
+                tree = json.loads(r.body)
+                assert tree["rt"]["http"]["server"]["requests"]["counter"] == 7
+
+                r = await client(Request(uri="/admin/metrics.json?q=rt/http"))
+                assert json.loads(r.body) != {}
+
+                r = await client(Request(uri="/nope"))
+                assert r.status == 404
+            finally:
+                await client.close()
+                await admin.close()
+
+        run(go())

@@ -1,0 +1,192 @@
+"""Tests for the polymorphic config system (parser + registry + scalars)."""
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import pytest
+
+from linkerd_tpu.config import (
+    ConfigError, register, lookup, kinds, clear_category,
+    parse_config, instantiate, instantiate_list, Port, HostAndPort,
+)
+from linkerd_tpu.config.parser import instantiate_as
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    clear_category("testcat")
+    yield
+    clear_category("testcat")
+
+
+@dataclass
+class Inner:
+    name: str
+    weight: float = 1.0
+
+
+def _register_sample():
+    @register("testcat", "io.l5d.sample")
+    @dataclass
+    class SampleConfig:
+        host: str
+        port: Port
+        inners: Optional[List[Inner]] = None
+        note: Optional[str] = None
+
+    return SampleConfig
+
+
+class TestParse:
+    def test_yaml_and_json_sniffing(self):
+        assert parse_config("a: 1\nb: [1, 2]\n") == {"a": 1, "b": [1, 2]}
+        assert parse_config('{"a": 1}') == {"a": 1}
+
+    def test_duplicate_keys_rejected(self):
+        with pytest.raises(ConfigError, match="duplicate key"):
+            parse_config("a: 1\na: 2\n")
+
+    def test_parse_error(self):
+        with pytest.raises(ConfigError):
+            parse_config("a: [unclosed\n- x:")
+
+
+class TestRegistry:
+    def test_register_lookup(self):
+        cls = _register_sample()
+        assert lookup("testcat", "io.l5d.sample") is cls
+        assert kinds("testcat") == ("io.l5d.sample",)
+
+    def test_duplicate_kind_rejected(self):
+        _register_sample()
+        with pytest.raises(ConfigError, match="duplicate kind"):
+            @register("testcat", "io.l5d.sample")
+            @dataclass
+            class Other:
+                pass
+
+    def test_unknown_kind(self):
+        with pytest.raises(ConfigError, match="unknown testcat kind"):
+            lookup("testcat", "io.l5d.nope")
+
+
+class TestInstantiate:
+    def test_full(self):
+        _register_sample()
+        cfg = instantiate("testcat", {
+            "kind": "io.l5d.sample",
+            "host": "web",
+            "port": 8080,
+            "inners": [{"name": "a"}, {"name": "b", "weight": 0.5}],
+        })
+        assert cfg.kind == "io.l5d.sample"
+        assert cfg.host == "web"
+        assert int(cfg.port) == 8080
+        assert cfg.inners[1].weight == 0.5
+        assert cfg.note is None
+
+    def test_unknown_field_rejected(self):
+        _register_sample()
+        with pytest.raises(ConfigError, match="unknown field 'prot'"):
+            instantiate("testcat", {"kind": "io.l5d.sample", "host": "h",
+                                    "port": 1, "prot": "x"})
+
+    def test_missing_required(self):
+        _register_sample()
+        with pytest.raises(ConfigError, match="missing required fields"):
+            instantiate("testcat", {"kind": "io.l5d.sample", "host": "h"})
+
+    def test_missing_kind(self):
+        with pytest.raises(ConfigError, match="missing 'kind'"):
+            instantiate("testcat", {"host": "h"})
+
+    def test_port_range(self):
+        _register_sample()
+        with pytest.raises(ConfigError, match="port out of range"):
+            instantiate("testcat", {"kind": "io.l5d.sample", "host": "h",
+                                    "port": 70000})
+
+    def test_list(self):
+        _register_sample()
+        out = instantiate_list("testcat", [
+            {"kind": "io.l5d.sample", "host": "a", "port": 1},
+            {"kind": "io.l5d.sample", "host": "b", "port": 2},
+        ])
+        assert [c.host for c in out] == ["a", "b"]
+        assert instantiate_list("testcat", None) == []
+
+    def test_type_mismatch_paths(self):
+        _register_sample()
+        with pytest.raises(ConfigError, match=r"\.inners"):
+            instantiate("testcat", {"kind": "io.l5d.sample", "host": "h",
+                                    "port": 1, "inners": "zzz"})
+
+    def test_hostandport(self):
+        assert HostAndPort.read("1.2.3.4:80") == HostAndPort("1.2.3.4", Port(80))
+        with pytest.raises(ConfigError):
+            HostAndPort.read("nohost")
+
+    def test_instantiate_as_plain(self):
+        inner = instantiate_as(Inner, {"name": "x", "weight": 2.0})
+        assert inner == Inner("x", 2.0)
+
+
+class TestMetrics:
+    def test_counter_gauge_stat(self):
+        from linkerd_tpu.telemetry import MetricsTree
+
+        mt = MetricsTree()
+        c = mt.counter("rt", "http", "server", "requests")
+        c.incr()
+        c.incr(4)
+        g = mt.gauge("rt", "http", "open_connections")
+        g.set(3)
+        s = mt.stat("rt", "http", "latency_ms")
+        for v in [1, 2, 3, 4, 100]:
+            s.add(v)
+        flat = mt.flatten()
+        assert flat["rt/http/server/requests"] == 5
+        assert flat["rt/http/open_connections"] == 3.0
+        assert flat["rt/http/latency_ms/count"] == 5
+        assert flat["rt/http/latency_ms/max"] == 100
+        assert flat["rt/http/latency_ms/p50"] >= 2
+
+    def test_same_leaf_shared(self):
+        from linkerd_tpu.telemetry import MetricsTree
+
+        mt = MetricsTree()
+        assert mt.counter("a", "b") is mt.counter("a", "b")
+        with pytest.raises(ValueError, match="type conflict"):
+            mt.stat("a", "b")
+
+    def test_prune(self):
+        from linkerd_tpu.telemetry import MetricsTree
+
+        mt = MetricsTree()
+        mt.counter("rt", "client", "x", "requests").incr()
+        mt.counter("rt", "client", "y", "requests").incr()
+        mt.prune("rt", "client", "x")
+        flat = mt.flatten()
+        assert "rt/client/x/requests" not in flat
+        assert flat["rt/client/y/requests"] == 1
+
+    def test_gauge_fn(self):
+        from linkerd_tpu.telemetry import MetricsTree
+
+        mt = MetricsTree()
+        items = [1, 2, 3]
+        mt.gauge("queue", "depth", fn=lambda: len(items))
+        assert mt.flatten()["queue/depth"] == 3
+        items.append(4)
+        assert mt.flatten()["queue/depth"] == 4
+
+    def test_percentiles_monotone(self):
+        from linkerd_tpu.telemetry import Stat
+
+        s = Stat()
+        for v in range(1000):
+            s.add(float(v))
+        snap = s.snapshot()
+        assert snap["p50"] <= snap["p90"] <= snap["p99"] <= snap["p999"]
+        assert 400 <= snap["p50"] <= 600

@@ -1,0 +1,165 @@
+"""HTTP/1.1 server + pooled client e2e over real sockets (in-process,
+ephemeral ports — the reference's e2e topology style, SURVEY.md §4)."""
+
+import asyncio
+
+import pytest
+
+from linkerd_tpu.protocol.http import Request, Response, Headers
+from linkerd_tpu.protocol.http.client import HttpClient
+from linkerd_tpu.protocol.http.server import serve
+from linkerd_tpu.protocol.http.codec import HttpCodecError, _body_framing
+from linkerd_tpu.router.service import FnService
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 15))
+
+
+async def echo_handler(req: Request) -> Response:
+    body = f"{req.method} {req.uri} host={req.host} len={len(req.body)}".encode()
+    return Response(status=200, body=body)
+
+
+class TestEndToEnd:
+    def test_get_roundtrip_and_keepalive(self):
+        async def go():
+            server = await serve(FnService(echo_handler))
+            client = HttpClient("127.0.0.1", server.bound_port)
+            try:
+                r1 = await client(Request(uri="/hello"))
+                assert r1.status == 200
+                assert b"GET /hello" in r1.body
+                r2 = await client(Request(method="POST", uri="/x",
+                                          body=b"abc" * 100))
+                assert b"POST /x" in r2.body and b"len=300" in r2.body
+                # keep-alive: second request reused the single connection
+                assert client._n_open == 1
+            finally:
+                await client.close()
+                await server.close()
+
+        run(go())
+
+    def test_concurrent_requests_pool_grows(self):
+        async def slow(req: Request) -> Response:
+            await asyncio.sleep(0.05)
+            return Response(body=b"ok")
+
+        async def go():
+            server = await serve(FnService(slow))
+            client = HttpClient("127.0.0.1", server.bound_port)
+            try:
+                out = await asyncio.gather(*[
+                    client(Request(uri=f"/{i}")) for i in range(8)])
+                assert all(r.status == 200 for r in out)
+                assert client._n_open >= 2  # parallelism forced extra conns
+            finally:
+                await client.close()
+                await server.close()
+
+        run(go())
+
+    def test_chunked_request_body(self):
+        async def go():
+            server = await serve(FnService(echo_handler))
+            try:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", server.bound_port)
+                writer.write(
+                    b"POST /c HTTP/1.1\r\nHost: x\r\n"
+                    b"Transfer-Encoding: chunked\r\n\r\n"
+                    b"3\r\nabc\r\n4\r\ndefg\r\n0\r\n\r\n")
+                await writer.drain()
+                data = await reader.readuntil(b"len=7")
+                assert b"200 OK" in data
+                writer.close()
+            finally:
+                await server.close()
+
+        run(go())
+
+    def test_malformed_request_400(self):
+        async def go():
+            server = await serve(FnService(echo_handler))
+            try:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", server.bound_port)
+                writer.write(b"BANANAS\r\n\r\n")
+                await writer.drain()
+                data = await reader.read(200)
+                assert b"400" in data.split(b"\r\n")[0]
+                writer.close()
+            finally:
+                await server.close()
+
+        run(go())
+
+    def test_service_exception_502(self):
+        async def boom(req: Request) -> Response:
+            raise RuntimeError("downstream exploded")
+
+        async def go():
+            server = await serve(FnService(boom))
+            client = HttpClient("127.0.0.1", server.bound_port)
+            try:
+                rsp = await client(Request(uri="/"))
+                assert rsp.status == 502
+            finally:
+                await client.close()
+                await server.close()
+
+        run(go())
+
+    def test_max_concurrency_admission_control(self):
+        gate = asyncio.Event()
+
+        async def waiting(req: Request) -> Response:
+            await gate.wait()
+            return Response(body=b"done")
+
+        async def go():
+            server = await serve(FnService(waiting), max_concurrency=2)
+            clients = [HttpClient("127.0.0.1", server.bound_port)
+                       for _ in range(3)]
+            try:
+                t1 = asyncio.create_task(clients[0](Request(uri="/1")))
+                t2 = asyncio.create_task(clients[1](Request(uri="/2")))
+                await asyncio.sleep(0.05)
+                r3 = await clients[2](Request(uri="/3"))
+                assert r3.status == 503  # over limit -> rejected, not queued
+                gate.set()
+                r1, r2 = await asyncio.gather(t1, t2)
+                assert r1.status == 200 and r2.status == 200
+            finally:
+                for c in clients:
+                    await c.close()
+                await server.close()
+
+        run(go())
+
+
+class TestFraming:
+    def test_conflicting_content_length_rejected(self):
+        h = Headers([("Content-Length", "5"), ("Content-Length", "6")])
+        with pytest.raises(HttpCodecError, match="conflicting"):
+            _body_framing(h)
+
+    def test_te_and_cl_rejected(self):
+        h = Headers([("Transfer-Encoding", "chunked"), ("Content-Length", "5")])
+        with pytest.raises(HttpCodecError):
+            _body_framing(h)
+
+    def test_headers_case_insensitive_ordered(self):
+        h = Headers()
+        h.add("X-A", "1")
+        h.add("x-a", "2")
+        assert h.get("X-A") == "1"
+        assert h.get_all("x-A") == ["1", "2"]
+        h.set("X-A", "3")
+        assert h.get_all("x-a") == ["3"]
+
+    def test_request_path_parsing(self):
+        assert Request(uri="/a/b?q=1").path == "/a/b"
+        assert Request(uri="http://host:80/a/b?z").path == "/a/b"
+        assert Request(uri="http://host").path == "/"

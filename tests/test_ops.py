@@ -36,8 +36,11 @@ class TestFusedScoring:
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    atol=1e-5, rtol=1e-5)
 
-    def test_rejects_ragged_batch(self, setup):
+    def test_ragged_batch_padded(self, setup):
         cfg, params, x = setup
-        with pytest.raises(ValueError):
-            fused_anomaly_scores(params, x[:300], cfg, block_rows=256,
-                                 interpret=True)
+        ref = anomaly_scores(params, x[:300], cfg)
+        got = fused_anomaly_scores(params, x[:300], cfg, block_rows=256,
+                                   interpret=True)
+        assert got.shape == (300,)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
