@@ -122,7 +122,10 @@ def instantiate_as(cls: Type[T], data: Dict[str, Any], path: str = "") -> T:
     hints = typing.get_type_hints(cls)
     kwargs: Dict[str, Any] = {}
     data = dict(data)
-    data.pop("kind", None)  # discriminator, not a field
+    if "kind" not in fields:
+        # 'kind' is the polymorphic discriminator for registered configs;
+        # plain specs that declare a real `kind` field keep it.
+        data.pop("kind", None)
     for key, value in data.items():
         if key not in fields:
             raise ConfigError(

@@ -26,6 +26,10 @@ from linkerd_tpu.protocol.http.message import Request, Response
 from linkerd_tpu.protocol.http.server import HttpServer
 from linkerd_tpu.router.balancer import mk_balancer
 from linkerd_tpu.router.binding import DstBindingFactory, DstPath
+from linkerd_tpu.router.failure_accrual import FailureAccrualService
+from linkerd_tpu.router.retries import (
+    ClassifiedRetries, RetryBudget, TotalTimeout, backoff_jittered,
+)
 from linkerd_tpu.router.routing import (
     ErrorResponder, PerDstPathStatsFilter, RoutingService, StatsFilter,
     StatusCodeStatsFilter,
@@ -36,9 +40,32 @@ from linkerd_tpu.telemetry.metrics import MetricsTree
 # Ensure built-in plugin registrations are loaded.
 import linkerd_tpu.namer.fs  # noqa: F401
 import linkerd_tpu.protocol.http.identifiers  # noqa: F401
+import linkerd_tpu.router.classifiers  # noqa: F401
+import linkerd_tpu.router.failure_accrual  # noqa: F401
+import linkerd_tpu.telemetry.anomaly  # noqa: F401
 
 DEFAULT_ADMIN_PORT = 9990  # ref: Linker.scala:37
 DEFAULT_HTTP_PORT = 4140   # ref: linkerd http router default
+
+
+class _PruneOnClose(Service):
+    """Delegates to a service; prunes a metrics subtree when closed."""
+
+    def __init__(self, inner: Service, metrics: MetricsTree, scope: tuple):
+        self._inner = inner
+        self._metrics = metrics
+        self._scope = scope
+
+    async def __call__(self, req):
+        return await self._inner(req)
+
+    @property
+    def status(self):
+        return self._inner.status
+
+    async def close(self) -> None:
+        await self._inner.close()
+        self._metrics.prune(*self._scope)
 
 
 @dataclass
@@ -58,6 +85,39 @@ class ClientSpec:
     loadBalancer: Optional[BalancerSpec] = None
     hostConnectionPool: int = 64
     connectTimeoutMs: int = 3000
+    failureAccrual: Optional[Dict[str, Any]] = None  # kind-discriminated
+
+
+@dataclass
+class BackoffSpec:
+    kind: str = "jittered"  # constant | jittered
+    ms: int = 0             # constant pause
+    minMs: int = 10         # jittered bounds
+    maxMs: int = 10000
+
+
+@dataclass
+class BudgetSpec:
+    ttlSecs: float = 10.0
+    minRetriesPerSec: float = 10.0
+    percentCanRetry: float = 0.2
+
+
+@dataclass
+class RetriesSpec:
+    backoff: Optional[BackoffSpec] = None
+    budget: Optional[BudgetSpec] = None
+    maxRetries: int = 25
+
+
+@dataclass
+class SvcSpec:
+    """Per-logical-name policy (ref: SvcConfig.scala — totalTimeout,
+    retries, classification)."""
+
+    totalTimeoutMs: Optional[int] = None
+    retries: Optional[RetriesSpec] = None
+    responseClassifier: Optional[Dict[str, Any]] = None  # kind-discriminated
 
 
 @dataclass
@@ -69,6 +129,7 @@ class RouterSpec:
     identifier: Optional[Any] = None      # kind-discriminated mapping(s)
     servers: Optional[List[ServerSpec]] = None
     client: Optional[ClientSpec] = None
+    service: Optional[SvcSpec] = None
     bindingTimeoutMs: int = 10000
     bindingCache: Optional[Dict[str, Any]] = None
 
@@ -184,11 +245,22 @@ class Linker:
         bal_kind = (cspec.loadBalancer or BalancerSpec()).kind
         metrics = self.metrics
 
+        fa_cfg = cspec.failureAccrual or {"kind": "io.l5d.consecutiveFailures"}
+        fa_config = instantiate("failureAccrual", fa_cfg, f"{label}.failureAccrual")
+        if getattr(fa_config, "needs_board", False):
+            board = self._anomaly_board()
+            mk_policy = lambda: fa_config.mk(board)  # noqa: E731
+        else:
+            mk_policy = fa_config.mk
+
         def endpoint_factory(addr: Address) -> Service:
-            return HttpClient(
+            client: Service = HttpClient(
                 addr.host, addr.port,
                 max_connections=cspec.hostConnectionPool,
                 connect_timeout=cspec.connectTimeoutMs / 1e3)
+            # per-endpoint accrual (ref: FailureAccrualFactory sits below
+            # the balancer in the client stack, Router.scala:318)
+            return FailureAccrualService(client, mk_policy())
 
         def client_factory(bound: BoundName) -> Service:
             cid = bound.id_.show.lstrip("/").replace("/", ".") or "client"
@@ -196,11 +268,47 @@ class Linker:
             stats = StatsFilter(metrics, "rt", label, "client", cid)
             metrics.scope("rt", label, "client", cid).gauge(
                 "endpoints", fn=lambda b=bal: b.size)
-            return stats.and_then(bal)
+            # Prune this client's metrics subtree on eviction so gauges
+            # don't pin the closed balancer or report stale values (ref:
+            # MetricsPruningModule.scala:39).
+            return _PruneOnClose(
+                stats.and_then(bal), metrics, ("rt", label, "client", cid))
+
+        sspec = rspec.service or SvcSpec()
+        classifier_cfg = sspec.responseClassifier or {
+            "kind": "io.l5d.http.nonRetryable5XX"}
+        classifier = instantiate(
+            "classifier", classifier_cfg, f"{label}.responseClassifier").mk()
+        budget_spec = (sspec.retries.budget if sspec.retries else None) or BudgetSpec()
+        shared_budget = RetryBudget(
+            budget_spec.ttlSecs, budget_spec.minRetriesPerSec,
+            budget_spec.percentCanRetry)
+
+        def mk_backoffs() -> List[float]:
+            bspec = (sspec.retries.backoff if sspec.retries else None)
+            max_retries = sspec.retries.maxRetries if sspec.retries else 25
+            if bspec is None:
+                return [0.0] * max_retries
+            if bspec.kind == "constant":
+                return [bspec.ms / 1e3] * max_retries
+            import itertools
+            return list(itertools.islice(
+                backoff_jittered(bspec.minMs / 1e3, bspec.maxMs / 1e3),
+                max_retries))
 
         def path_filters(dst: DstPath, svc: Service) -> Service:
+            # path stack order (ref: Router.scala:321-362): stats ->
+            # total timeout -> budget/classified retries -> dispatch
             name = dst.path.show.lstrip("/").replace("/", ".") or "root"
-            return StatsFilter(metrics, "rt", label, "service", name).and_then(svc)
+            filters: List[Any] = [
+                StatsFilter(metrics, "rt", label, "service", name)]
+            if sspec.totalTimeoutMs is not None:
+                filters.append(TotalTimeout(sspec.totalTimeoutMs / 1e3))
+            filters.append(ClassifiedRetries(
+                classifier, shared_budget, mk_backoffs(),
+                max_retries=(sspec.retries.maxRetries if sspec.retries else 25),
+                metrics=metrics, scope=("rt", label, "service", name)))
+            return filters_to_service(filters, svc)
 
         cache_cfg = rspec.bindingCache or {}
         binding = DstBindingFactory(
@@ -210,12 +318,17 @@ class Linker:
             bind_timeout=rspec.bindingTimeoutMs / 1e3)
 
         routing = RoutingService(identifier, binding)
-        # Stats outermost so they observe ErrorResponder's mapped statuses.
-        server_stack = filters_to_service([
+        # Stats outermost so they observe ErrorResponder's mapped statuses;
+        # anomaly feature recorders tap the same final view.
+        server_filters: List[Any] = [
             StatsFilter(metrics, "rt", label, "server"),
             StatusCodeStatsFilter(metrics, "rt", label, "server"),
-            ErrorResponder(),
-        ], routing)
+        ]
+        for t in self.telemeters:
+            if hasattr(t, "recorder"):
+                server_filters.append(t.recorder())
+        server_filters.append(ErrorResponder())
+        server_stack = filters_to_service(server_filters, routing)
 
         servers = [
             HttpServer(server_stack, s.ip, s.port,
@@ -223,6 +336,15 @@ class Linker:
             for s in (rspec.servers or [ServerSpec()])
         ]
         return Router(rspec, label, server_stack, binding, servers)
+
+    def _anomaly_board(self):
+        """ScoreBoard of the configured jaxAnomaly telemeter (or a detached
+        one so anomaly-aware policies degrade to their base behavior)."""
+        from linkerd_tpu.telemetry.anomaly import JaxAnomalyTelemeter, ScoreBoard
+        for t in self.telemeters:
+            if isinstance(t, JaxAnomalyTelemeter):
+                return t.board
+        return ScoreBoard()
 
     # -- lifecycle --------------------------------------------------------
     async def start(self) -> "Linker":

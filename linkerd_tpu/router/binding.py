@@ -138,14 +138,15 @@ class NameTreeFactory(Service):
             svc = self._bound_for(tree.value)
             return svc if svc.status is Status.OPEN else None
         if isinstance(tree, Alt):
-            last = None
+            # Trees here are pre-simplified (DynBoundService simplifies), so
+            # a Fail can only be the final branch; stop there.
             for sub in tree.trees:
                 if isinstance(sub, Fail):
                     break
                 got = self._select(sub)
                 if got is not None:
                     return got
-            return last
+            return None
         if isinstance(tree, TreeUnion):
             choices = [(w.weight, w.tree) for w in tree.weighted]
             total = sum(w for w, _ in choices)
@@ -188,6 +189,8 @@ class NameTreeFactory(Service):
             return self._bound_for(tree.value)
         if isinstance(tree, Alt):
             for sub in tree.trees:
+                if isinstance(sub, Fail):
+                    break  # Fail terminates an Alt; never fall past it
                 got = self._any_leaf(sub)
                 if got is not None:
                     return got

@@ -1,0 +1,133 @@
+"""Response classification: success / failure x retryability.
+
+Reference parity: linkerd/protocol/http/.../ResponseClassifiers.scala
+(NonRetryable5XX default, RetryableIdempotent5XX, RetryableRead5XX,
+AllSuccessful, HeaderRetryable) and router/core's response-class-driven
+retry/stats plumbing (ClassifiedRetries.scala, ResponseClassifierCtx).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from linkerd_tpu.config import register
+from linkerd_tpu.protocol.http.message import Request, Response
+
+
+class ResponseClass(enum.Enum):
+    SUCCESS = "success"
+    FAILURE = "failure"              # non-retryable failure
+    RETRYABLE_FAILURE = "retryable"  # safe to re-dispatch
+
+    @property
+    def is_failure(self) -> bool:
+        return self is not ResponseClass.SUCCESS
+
+    @property
+    def is_retryable(self) -> bool:
+        return self is ResponseClass.RETRYABLE_FAILURE
+
+
+Classifier = Callable[[Request, Optional[Response], Optional[BaseException]],
+                      ResponseClass]
+"""(request, response | None, exception | None) -> ResponseClass."""
+
+IDEMPOTENT_METHODS = frozenset({"GET", "HEAD", "OPTIONS", "TRACE", "PUT", "DELETE"})
+READ_METHODS = frozenset({"GET", "HEAD", "OPTIONS", "TRACE"})
+
+RETRYABLE_HEADER = "l5d-retryable"  # ref: HeaderRetryable / ClassifierFilter
+
+
+def _status_class(req: Request, rsp: Optional[Response],
+                  exc: Optional[BaseException],
+                  retryable_methods: frozenset) -> ResponseClass:
+    if exc is not None:
+        # connection-level failures are retryable for retryable methods
+        # (the write may not have reached the server)
+        if req.method in retryable_methods and isinstance(
+                exc, (ConnectionError, OSError, EOFError)):
+            return ResponseClass.RETRYABLE_FAILURE
+        return ResponseClass.FAILURE
+    assert rsp is not None
+    if rsp.status >= 500:
+        if req.method in retryable_methods:
+            return ResponseClass.RETRYABLE_FAILURE
+        return ResponseClass.FAILURE
+    return ResponseClass.SUCCESS
+
+
+@register("classifier", "io.l5d.http.nonRetryable5XX")
+@dataclass
+class NonRetryable5XX:
+    """5XX is failure, never retried (the linkerd default)."""
+
+    def mk(self) -> Classifier:
+        def classify(req, rsp, exc):
+            return _status_class(req, rsp, exc, frozenset())
+
+        return classify
+
+
+@register("classifier", "io.l5d.http.retryableIdempotent5XX")
+@dataclass
+class RetryableIdempotent5XX:
+    """5XX on idempotent methods is retryable."""
+
+    def mk(self) -> Classifier:
+        def classify(req, rsp, exc):
+            return _status_class(req, rsp, exc, IDEMPOTENT_METHODS)
+
+        return classify
+
+
+@register("classifier", "io.l5d.http.retryableRead5XX")
+@dataclass
+class RetryableRead5XX:
+    """5XX on read methods is retryable."""
+
+    def mk(self) -> Classifier:
+        def classify(req, rsp, exc):
+            return _status_class(req, rsp, exc, READ_METHODS)
+
+        return classify
+
+
+@register("classifier", "io.l5d.http.allSuccessful")
+@dataclass
+class AllSuccessful:
+    """Every response (even 5XX) is success; exceptions are failures."""
+
+    def mk(self) -> Classifier:
+        def classify(req, rsp, exc):
+            if exc is not None:
+                return ResponseClass.FAILURE
+            return ResponseClass.SUCCESS
+
+        return classify
+
+
+@register("classifier", "io.l5d.http.headerRetryable")
+@dataclass
+class HeaderRetryable:
+    """Trust the downstream's l5d-retryable response header; fall back to
+    the wrapped classifier (ref: HeaderRetryable + ClassifierFilter which
+    propagates classification upstream via header)."""
+
+    fallback: str = "io.l5d.http.nonRetryable5XX"
+
+    def mk(self) -> Classifier:
+        from linkerd_tpu.config import lookup
+        inner = lookup("classifier", self.fallback)().mk()
+
+        def classify(req, rsp, exc):
+            if rsp is not None and rsp.status >= 500:
+                hdr = rsp.headers.get(RETRYABLE_HEADER)
+                if hdr is not None:
+                    if hdr.lower() == "true":
+                        return ResponseClass.RETRYABLE_FAILURE
+                    return ResponseClass.FAILURE
+            return inner(req, rsp, exc)
+
+        return classify

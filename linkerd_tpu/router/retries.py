@@ -1,0 +1,157 @@
+"""Classified retries with budgets, and total timeout.
+
+Reference parity:
+- ``RetryBudget`` — finagle's token-bucket retry budget (ttl-windowed
+  deposits per request + a minimum retries-per-second floor; default 20%
+  + 10 rps), configured by RetryBudgetModule/RetryBudgetConfig
+  (router/core/.../RetryBudgetModule.scala).
+- ``ClassifiedRetries`` — response-class-driven retry filter with a
+  backoff schedule (router/core/.../ClassifiedRetries.scala:8), applied in
+  the path stack.
+- ``TotalTimeout`` — per-request end-to-end timeout including retries
+  (router/core/.../TotalTimeout.scala).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Iterable, Iterator, List, Optional
+
+from linkerd_tpu.protocol.http.message import Request, Response
+from linkerd_tpu.router.classifiers import Classifier, ResponseClass
+from linkerd_tpu.router.service import Filter, Service
+from linkerd_tpu.telemetry.metrics import MetricsTree
+
+
+class RetryBudget:
+    """Sliding-window token bucket: each request deposits ``percent_can_retry``
+    tokens; each retry withdraws one; ``min_retries_per_s`` is an unconditional
+    floor (ref: com.twitter.finagle.service.RetryBudget defaults).
+
+    O(1) per operation: deposits/withdrawals land in per-second buckets in
+    a fixed ring of ceil(ttl)+1 slots; balance sums the ring (hot-path cost
+    is ~ttl additions, independent of request rate).
+    """
+
+    def __init__(self, ttl_s: float = 10.0, min_retries_per_s: float = 10.0,
+                 percent_can_retry: float = 0.2):
+        self.ttl_s = ttl_s
+        self.min_retries_per_s = min_retries_per_s
+        self.percent_can_retry = percent_can_retry
+        n = max(1, int(ttl_s) + 1)
+        self._sec = [0] * n        # absolute second id owning each slot
+        self._earned = [0.0] * n
+        self._spent = [0.0] * n
+
+    def _slot(self, now: float) -> int:
+        sec = int(now)
+        i = sec % len(self._sec)
+        if self._sec[i] != sec:
+            self._sec[i] = sec
+            self._earned[i] = 0.0
+            self._spent[i] = 0.0
+        return i
+
+    def deposit(self) -> None:
+        i = self._slot(time.monotonic())
+        self._earned[i] += self.percent_can_retry
+
+    def balance(self) -> float:
+        now = time.monotonic()
+        self._slot(now)  # rotate the current slot
+        cutoff = int(now) - int(self.ttl_s)
+        earned = spent = 0.0
+        for sec, e, s in zip(self._sec, self._earned, self._spent):
+            if sec >= cutoff:
+                earned += e
+                spent += s
+        floor = self.min_retries_per_s * self.ttl_s
+        return max(earned, floor) - spent
+
+    def try_withdraw(self) -> bool:
+        if self.balance() < 1.0:
+            return False
+        i = self._slot(time.monotonic())
+        self._spent[i] += 1.0
+        return True
+
+
+def backoff_jittered(min_s: float, max_s: float) -> Iterator[float]:
+    """Decorrelated-jitter backoff stream (ref: SvcConfig retries backoff
+    kind 'jittered')."""
+    import random
+    cur = min_s
+    while True:
+        yield cur
+        cur = min(max_s, random.uniform(min_s, cur * 3))
+
+
+def backoff_constant(pause_s: float) -> Iterator[float]:
+    while True:
+        yield pause_s
+
+
+class ClassifiedRetries(Filter[Request, Response]):
+    """Re-dispatches retryable failures per the classifier, bounded by the
+    budget and the backoff schedule."""
+
+    def __init__(self, classifier: Classifier,
+                 budget: Optional[RetryBudget] = None,
+                 backoffs: Optional[Iterable[float]] = None,
+                 max_retries: int = 25,
+                 metrics: Optional[MetricsTree] = None,
+                 scope: tuple = ()):
+        self._classifier = classifier
+        self._budget = budget if budget is not None else RetryBudget()
+        self._backoffs = list(backoffs) if backoffs is not None else [0.0] * 25
+        self._max_retries = max_retries
+        node = (metrics.scope(*scope, "retries") if metrics is not None
+                else MetricsTree().scope("retries"))
+        self._retry_count = node.counter("total")
+        self._budget_exhausted = node.counter("budget_exhausted")
+
+    async def apply(self, req: Request, service: Service) -> Response:
+        self._budget.deposit()
+        attempt = 0
+        while True:
+            rsp: Optional[Response] = None
+            exc: Optional[BaseException] = None
+            try:
+                rsp = await service(req)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — classified below
+                exc = e
+            rc = self._classifier(req, rsp, exc)
+            req.ctx["response_class"] = rc
+            if not rc.is_retryable or attempt >= min(
+                    self._max_retries, len(self._backoffs)):
+                break
+            if not self._budget.try_withdraw():
+                self._budget_exhausted.incr()
+                break
+            pause = self._backoffs[attempt]
+            attempt += 1
+            self._retry_count.incr()
+            if pause > 0:
+                await asyncio.sleep(pause)
+        if exc is not None:
+            raise exc
+        assert rsp is not None
+        return rsp
+
+
+class TotalTimeout(Filter[Request, Response]):
+    """Caps total time (including retries) for a request
+    (ref: TotalTimeout.scala; -> 504 via ErrorResponder)."""
+
+    def __init__(self, timeout_s: float):
+        self.timeout_s = timeout_s
+
+    async def apply(self, req: Request, service: Service) -> Response:
+        try:
+            return await asyncio.wait_for(service(req), self.timeout_s)
+        except asyncio.TimeoutError:
+            raise TimeoutError(
+                f"total timeout of {self.timeout_s}s exceeded") from None

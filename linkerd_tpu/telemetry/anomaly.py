@@ -1,0 +1,405 @@
+"""``io.l5d.jaxAnomaly`` — the inline ML-inference telemeter (north star).
+
+BASELINE.json: a telemeter that taps the router stack, extracts per-request
+feature vectors, micro-batches them to a JAX/TPU anomaly scorer
+(autoencoder + classifier), and feeds scores back into failure-accrual /
+response-classification policy plus the admin metrics surface.
+
+Data path (all off the request critical path — the recorder filter does
+O(1) Python work per request; everything else is batched):
+
+    request -> FeatureRecorder filter -> ring buffer (deque)
+            -> micro-batcher task (drain + featurize -> float32[B, D])
+            -> scorer (in-process jit OR gRPC sidecar)
+            -> ScoreBoard (per-dst EWMA scores, Var + metrics gauges)
+            -> AnomalyFailureAccrualPolicy / admin handlers
+
+Reference parity: implements the Telemeter SPI (telemetry/core/.../
+Telemeter.scala:11) the way exporter telemeters do, but taps the stack the
+way the reference's stats filters do (PerDstPathStatsFilter.scala).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from linkerd_tpu.config import register
+from linkerd_tpu.core import Var
+from linkerd_tpu.models.features import FEATURE_DIM, FeatureVector, featurize_batch
+from linkerd_tpu.protocol.http.message import Request, Response
+from linkerd_tpu.router.service import Filter, Service
+from linkerd_tpu.telemetry.metrics import MetricsTree
+from linkerd_tpu.telemetry.telemeter import Telemeter
+
+log = logging.getLogger(__name__)
+
+
+class ScoreBoard:
+    """Per-dst anomaly scores: EWMA-smoothed, observable.
+
+    The Var publishes {dst_path: score}; failure-accrual policies and the
+    admin handler read it. Scores decay toward 0 when traffic stops.
+    """
+
+    def __init__(self, alpha: float = 0.3):
+        self.alpha = alpha
+        self.scores: Var[dict] = Var({})
+
+    def update_batch(self, dsts: List[str], scores: np.ndarray) -> None:
+        cur = dict(self.scores.sample())
+        per_dst: Dict[str, List[float]] = {}
+        for dst, s in zip(dsts, scores):
+            per_dst.setdefault(dst, []).append(float(s))
+        for dst, vals in per_dst.items():
+            mean = sum(vals) / len(vals)
+            prev = cur.get(dst, mean)
+            cur[dst] = prev + self.alpha * (mean - prev)
+        self.scores.update(cur)
+
+    def score_of(self, dst: str) -> float:
+        return self.scores.sample().get(dst, 0.0)
+
+
+class FeatureRecorder(Filter[Request, Response]):
+    """Tap the request path: record one FeatureVector per request into the
+    ring. O(1) appends; the deque drops oldest under overload (scoring is
+    best-effort, requests are never blocked)."""
+
+    def __init__(self, ring: Deque, concurrency_gauge: Optional[Callable] = None):
+        self.ring = ring
+        self._inflight = 0
+        self._rps_window: Deque[float] = collections.deque(maxlen=512)
+
+    async def apply(self, req: Request, service: Service) -> Response:
+        t0 = time.monotonic()
+        self._inflight += 1
+        exc: Optional[BaseException] = None
+        rsp: Optional[Response] = None
+        try:
+            rsp = await service(req)
+            return rsp
+        except BaseException as e:
+            exc = e
+            raise
+        finally:
+            self._inflight -= 1
+            now = time.monotonic()
+            self._rps_window.append(now)
+            latency_ms = (now - t0) * 1e3
+            dst = req.ctx.get("dst")
+            dst_path = dst.path.show if dst is not None else "/unidentified"
+            rc = req.ctx.get("response_class")
+            fv = FeatureVector(
+                latency_ms=latency_ms,
+                status=rsp.status if rsp is not None else 0,
+                retries=int(req.ctx.get("retries", 0)),
+                request_bytes=len(req.body),
+                response_bytes=len(rsp.body) if rsp is not None else 0,
+                concurrency=self._inflight + 1,
+                queue_ms=0.0,
+                exception=exc is not None,
+                retryable=bool(getattr(rc, "is_retryable", False)),
+                dst_path=dst_path,
+                dst_rps=self._rps(now),
+            )
+            # label for fault-injection evaluation rides along when present:
+            # from local ctx, or from the harness's response header
+            label = req.ctx.get("fault_label")
+            if label is None and rsp is not None:
+                hdr = rsp.headers.get("l5d-fault-label")
+                if hdr is not None:
+                    try:
+                        label = float(hdr)
+                    except ValueError:
+                        label = None  # untrusted header; never fail a request
+            self.ring.append((fv, label))
+
+    def _rps(self, now: float) -> float:
+        w = self._rps_window
+        if len(w) < 2:
+            return 0.0
+        span = now - w[0]
+        return len(w) / span if span > 0 else 0.0
+
+
+class Scorer:
+    """Scoring + online-training backends. ``score`` takes float32[B, D]
+    and returns float32[B] anomaly scores in [0, 1]."""
+
+    async def score(self, x: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    async def fit(self, x: np.ndarray, labels: np.ndarray,
+                  mask: np.ndarray) -> float:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        return
+
+
+class InProcessScorer(Scorer):
+    """Runs the JAX model in-process (single-chip or CPU). Device work is
+    dispatched from a worker thread so the event loop never blocks on
+    compilation or transfers."""
+
+    def __init__(self, seed: int = 0, learning_rate: float = 1e-3,
+                 recon_weight: float = 0.7, fit_steps: int = 4):
+        import jax
+        import optax
+        from linkerd_tpu.models.anomaly import AnomalyModelConfig, init_params
+        from linkerd_tpu.ops.scoring import best_scorer
+
+        self.cfg = AnomalyModelConfig(recon_weight=recon_weight)
+        self.params = init_params(jax.random.key(seed), self.cfg)
+        self._scorer = best_scorer(self.cfg)
+        self._opt = optax.adam(learning_rate)
+        self._opt_state = self._opt.init(self.params)
+        self._train_step = self._mk_train_step()
+        self.fit_steps = fit_steps
+        # Running feature normalization (updated on non-anomalous training
+        # rows): without it the autoencoder's reconstruction error is
+        # dominated by raw feature scale and tanh() saturates for normal
+        # AND anomalous traffic alike.
+        self._mu = np.zeros(self.cfg.in_dim, np.float32)
+        self._var = np.ones(self.cfg.in_dim, np.float32)
+        self._norm_momentum = 0.2
+        self._norm_initialized = False
+
+    def _normalize(self, x: np.ndarray) -> np.ndarray:
+        return ((x - self._mu) / np.sqrt(self._var + 1e-6)).astype(np.float32)
+
+    def _update_norm(self, x: np.ndarray, labels: np.ndarray,
+                     mask: np.ndarray) -> None:
+        # learn the "normal" distribution: exclude rows labeled anomalous
+        normal = x[(mask == 0.0) | (labels == 0.0)]
+        if len(normal) == 0:
+            return
+        mu = normal.mean(axis=0)
+        var = normal.var(axis=0) + 1e-6
+        if not self._norm_initialized:
+            self._mu, self._var = mu, var
+            self._norm_initialized = True
+        else:
+            m = self._norm_momentum
+            self._mu = (1 - m) * self._mu + m * mu
+            self._var = (1 - m) * self._var + m * var
+
+    def _mk_train_step(self):
+        import jax
+        import optax
+        from linkerd_tpu.models.anomaly import loss_fn
+
+        cfg = self.cfg
+        opt = self._opt
+
+        @jax.jit
+        def step(params, opt_state, x, labels, mask):
+            loss, grads = jax.value_and_grad(loss_fn)(params, x, labels, mask, cfg)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return step
+
+    async def score(self, x: np.ndarray) -> np.ndarray:
+        xn = self._normalize(x)
+
+        def run() -> np.ndarray:
+            return np.asarray(self._scorer(self.params, xn))
+
+        return await asyncio.to_thread(run)
+
+    async def fit(self, x: np.ndarray, labels: np.ndarray,
+                  mask: np.ndarray) -> float:
+        self._update_norm(x, labels, mask)
+        xn = self._normalize(x)
+
+        def run() -> float:
+            loss = float("nan")
+            for _ in range(self.fit_steps):
+                self.params, self._opt_state, loss = self._train_step(
+                    self.params, self._opt_state, xn, labels, mask)
+            return float(loss)
+
+        return await asyncio.to_thread(run)
+
+
+@register("telemeter", "io.l5d.jaxAnomaly")
+@dataclass
+class JaxAnomalyConfig:
+    maxBatch: int = 1024
+    intervalMs: int = 50
+    ringCapacity: int = 65536
+    scoreThreshold: float = 0.5
+    trainEveryBatches: int = 8      # online-fit cadence (0 = never train)
+    reconWeight: float = 0.7
+    learningRate: float = 0.001
+    sidecarAddress: Optional[str] = None  # host:port -> gRPC sidecar mode
+
+    def mk(self, metrics: MetricsTree) -> "JaxAnomalyTelemeter":
+        return JaxAnomalyTelemeter(self, metrics)
+
+
+class JaxAnomalyTelemeter(Telemeter):
+    def __init__(self, cfg: JaxAnomalyConfig, metrics: MetricsTree,
+                 scorer: Optional[Scorer] = None):
+        self.cfg = cfg
+        self.metrics = metrics
+        self.ring: Deque = collections.deque(maxlen=cfg.ringCapacity)
+        self.board = ScoreBoard()
+        self._scorer = scorer
+        self._stop = asyncio.Event()
+        self._node = metrics.scope("anomaly")
+        self._scored = self._node.counter("scored_total")
+        self._dropped = self._node.gauge("ring_depth", fn=lambda: len(self.ring))
+        self._batches = self._node.counter("batches")
+        self._train_loss = self._node.gauge("train_loss")
+        self._gauges: Dict[str, object] = {}
+        self._batch_i = 0
+
+    # -- stack tap --------------------------------------------------------
+    def recorder(self) -> FeatureRecorder:
+        return FeatureRecorder(self.ring)
+
+    # -- Telemeter --------------------------------------------------------
+    def _ensure_scorer(self) -> Scorer:
+        if self._scorer is None:
+            if self.cfg.sidecarAddress:
+                from linkerd_tpu.telemetry.sidecar import GrpcScorerClient
+                self._scorer = GrpcScorerClient(self.cfg.sidecarAddress)
+            else:
+                self._scorer = InProcessScorer(
+                    learning_rate=self.cfg.learningRate,
+                    recon_weight=self.cfg.reconWeight)
+        return self._scorer
+
+    async def run(self) -> None:
+        scorer = self._ensure_scorer()
+        interval = self.cfg.intervalMs / 1e3
+        try:
+            while not self._stop.is_set():
+                await asyncio.sleep(interval)
+                await self.drain_once(scorer)
+        except asyncio.CancelledError:
+            pass
+
+    async def drain_once(self, scorer: Optional[Scorer] = None) -> int:
+        """Drain one micro-batch through the scorer; returns rows scored."""
+        scorer = scorer or self._ensure_scorer()
+        n = min(len(self.ring), self.cfg.maxBatch)
+        if n == 0:
+            return 0
+        items = [self.ring.popleft() for _ in range(n)]
+        fvs = [fv for fv, _ in items]
+        labels = np.array(
+            [0.0 if lab is None else float(lab) for _, lab in items],
+            dtype=np.float32)
+        mask = np.array(
+            [0.0 if lab is None else 1.0 for _, lab in items],
+            dtype=np.float32)
+        x = featurize_batch(fvs)
+        scores = await scorer.score(x)
+        self._scored.incr(n)
+        self._batches.incr()
+        self.board.update_batch([fv.dst_path for fv in fvs], scores)
+        self._publish_gauges()
+        self._batch_i += 1
+        if (self.cfg.trainEveryBatches
+                and self._batch_i % self.cfg.trainEveryBatches == 0):
+            loss = await scorer.fit(x, labels, mask)
+            self._train_loss.set(loss)
+        return n
+
+    def _publish_gauges(self) -> None:
+        for dst, score in self.board.scores.sample().items():
+            key = dst.lstrip("/").replace("/", ".") or "root"
+            g = self._gauges.get(key)
+            if g is None:
+                g = self._node.scope("dst").gauge(key)
+                self._gauges[key] = g
+            g.set(score)
+
+    def admin_handlers(self):
+        from linkerd_tpu.admin.server import json_response
+
+        async def anomaly_json(req: Request) -> Response:
+            return json_response({
+                "scores": self.board.scores.sample(),
+                "threshold": self.cfg.scoreThreshold,
+                "ring_depth": len(self.ring),
+            })
+
+        return [("/anomaly.json", anomaly_json)]
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._scorer is not None:
+            self._scorer.close()
+
+
+# -- score-driven failure accrual -------------------------------------------
+
+
+@register("failureAccrual", "io.l5d.jaxAnomaly")
+@dataclass
+class AnomalyFailureAccrualConfig:
+    """Failure accrual that tightens when the anomaly scorer flags the mesh:
+    endpoints are marked dead after ``anomalousFailures`` consecutive
+    failures while the (EWMA) anomaly level exceeds ``threshold``, else
+    after ``failures`` — learned signal replacing the hand-tuned constant
+    (the BASELINE.json north-star feedback loop)."""
+
+    failures: int = 5
+    anomalousFailures: int = 2
+    threshold: float = 0.5
+
+    needs_board = True
+
+    def mk(self, board: Optional[ScoreBoard] = None):
+        from linkerd_tpu.router.failure_accrual import FailureAccrualPolicy
+        return AnomalyFailureAccrualPolicy(
+            board or ScoreBoard(), self.failures, self.anomalousFailures,
+            self.threshold)
+
+
+class AnomalyFailureAccrualPolicy:
+    """See AnomalyFailureAccrualConfig. Implements FailureAccrualPolicy."""
+
+    def __init__(self, board: ScoreBoard, failures: int,
+                 anomalous_failures: int, threshold: float,
+                 backoffs=None):
+        from linkerd_tpu.router.failure_accrual import _default_backoffs
+        self.board = board
+        self.failures = failures
+        self.anomalous_failures = anomalous_failures
+        self.threshold = threshold
+        self._consecutive = 0
+        self._mk_backoffs = (lambda: backoffs) if backoffs else _default_backoffs
+        self._backoffs = self._mk_backoffs()
+
+    def _anomaly_level(self) -> float:
+        scores = self.board.scores.sample()
+        return max(scores.values(), default=0.0)
+
+    def record_success(self) -> None:
+        self._consecutive = 0
+
+    def record_failure(self):
+        self._consecutive += 1
+        limit = (self.anomalous_failures
+                 if self._anomaly_level() >= self.threshold
+                 else self.failures)
+        if self._consecutive >= limit:
+            return next(self._backoffs)
+        return None
+
+    def revived(self) -> None:
+        self._consecutive = 0
+        self._backoffs = self._mk_backoffs()

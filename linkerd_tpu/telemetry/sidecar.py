@@ -1,0 +1,149 @@
+"""gRPC scorer sidecar: the TPU process serving anomaly scoring.
+
+Deployment shape per BASELINE.json: the mesh router micro-batches feature
+vectors over gRPC to a separate JAX/TPU process (this sidecar), so router
+restarts don't lose the model and one TPU serves many routers (the same
+topology as namerd serving many linkerds, SURVEY.md §2.4).
+
+Uses grpc generic handlers with a simple length-prefixed ndarray codec
+(no protoc codegen needed; the wire format is versioned by the method
+names). Methods (service ``io.l5d.anomaly.Scorer``):
+
+- ``Score``: request  = u32 n | u32 d | f32[n*d] features
+             response = f32[n] scores
+- ``Fit``:   request  = u32 n | u32 d | f32[n*d] x | f32[n] labels | f32[n] mask
+             response = f32[1] loss
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+SERVICE = "io.l5d.anomaly.Scorer"
+
+
+def encode_matrix(x: np.ndarray) -> bytes:
+    x = np.ascontiguousarray(x, dtype=np.float32)
+    n, d = x.shape
+    return struct.pack("<II", n, d) + x.tobytes()
+
+
+def decode_matrix(data: bytes) -> np.ndarray:
+    n, d = struct.unpack_from("<II", data)
+    arr = np.frombuffer(data, dtype=np.float32, offset=8, count=n * d)
+    return arr.reshape(n, d)
+
+
+def encode_fit(x: np.ndarray, labels: np.ndarray, mask: np.ndarray) -> bytes:
+    n = x.shape[0]
+    return (encode_matrix(x)
+            + np.ascontiguousarray(labels, np.float32).tobytes()
+            + np.ascontiguousarray(mask, np.float32).tobytes())
+
+
+def decode_fit(data: bytes) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    n, d = struct.unpack_from("<II", data)
+    off = 8
+    x = np.frombuffer(data, np.float32, n * d, off).reshape(n, d)
+    off += 4 * n * d
+    labels = np.frombuffer(data, np.float32, n, off)
+    off += 4 * n
+    mask = np.frombuffer(data, np.float32, n, off)
+    return x, labels, mask
+
+
+class ScorerSidecar:
+    """grpc.aio server wrapping an in-process Scorer."""
+
+    def __init__(self, scorer=None, host: str = "127.0.0.1", port: int = 0):
+        if scorer is None:
+            from linkerd_tpu.telemetry.anomaly import InProcessScorer
+            scorer = InProcessScorer()
+        self.scorer = scorer
+        self.host = host
+        self.port = port
+        self._server = None
+
+    async def start(self) -> "ScorerSidecar":
+        import grpc
+
+        scorer = self.scorer
+
+        async def score(request: bytes, context) -> bytes:
+            x = decode_matrix(request)
+            s = await scorer.score(x)
+            return np.ascontiguousarray(s, np.float32).tobytes()
+
+        async def fit(request: bytes, context) -> bytes:
+            x, labels, mask = decode_fit(request)
+            loss = await scorer.fit(x, labels, mask)
+            return np.float32([loss]).tobytes()
+
+        handler = grpc.method_handlers_generic_handler(SERVICE, {
+            "Score": grpc.unary_unary_rpc_method_handler(
+                score,
+                request_deserializer=None, response_serializer=None),
+            "Fit": grpc.unary_unary_rpc_method_handler(
+                fit,
+                request_deserializer=None, response_serializer=None),
+        })
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers((handler,))
+        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        await self._server.start()
+        return self
+
+    async def close(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=0.5)
+
+
+class GrpcScorerClient:
+    """Scorer implementation that ships micro-batches to a sidecar."""
+
+    def __init__(self, address: str, timeout_s: float = 5.0):
+        self.address = address
+        self.timeout_s = timeout_s
+        self._channel = None
+        self._score = None
+        self._fit = None
+
+    def _ensure(self) -> None:
+        if self._channel is None:
+            import grpc
+
+            self._channel = grpc.aio.insecure_channel(self.address)
+            self._score = self._channel.unary_unary(
+                f"/{SERVICE}/Score",
+                request_serializer=None, response_deserializer=None)
+            self._fit = self._channel.unary_unary(
+                f"/{SERVICE}/Fit",
+                request_serializer=None, response_deserializer=None)
+
+    async def score(self, x: np.ndarray) -> np.ndarray:
+        self._ensure()
+        rsp = await self._score(encode_matrix(x), timeout=self.timeout_s)
+        return np.frombuffer(rsp, np.float32)
+
+    async def fit(self, x: np.ndarray, labels: np.ndarray,
+                  mask: np.ndarray) -> float:
+        self._ensure()
+        rsp = await self._fit(encode_fit(x, labels, mask),
+                              timeout=self.timeout_s)
+        return float(np.frombuffer(rsp, np.float32)[0])
+
+    def close(self) -> None:
+        if self._channel is not None:
+            ch, self._channel = self._channel, None
+            try:
+                loop = asyncio.get_running_loop()
+                loop.create_task(ch.close())
+            except RuntimeError:
+                pass

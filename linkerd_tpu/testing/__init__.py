@@ -1,0 +1,5 @@
+"""Test/eval harnesses: fault injection, labeled traces."""
+
+from linkerd_tpu.testing.faults import FaultInjector, FaultSpec
+
+__all__ = ["FaultInjector", "FaultSpec"]

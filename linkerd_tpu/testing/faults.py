@@ -1,0 +1,94 @@
+"""Fault injection for labeled anomaly traces.
+
+The reference has no built-in fault injection (SURVEY.md §5); its tests
+script faults into fake services. This harness formalizes that: a filter
+wrapped around downstream services injects 5xx bursts and latency spikes
+per a schedule, and stamps ``fault_label`` into the request ctx so the
+anomaly pipeline can be evaluated with ground truth (AUC >= 0.9 target,
+BASELINE.md config 3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from linkerd_tpu.protocol.http.message import Request, Response
+from linkerd_tpu.router.service import Filter, Service
+
+
+@dataclass
+class FaultSpec:
+    """What to inject while active."""
+
+    error_rate: float = 0.0       # probability of injected 5xx
+    error_status: int = 503
+    latency_ms: float = 0.0       # added latency
+    latency_jitter_ms: float = 0.0
+
+
+class FaultInjector(Filter[Request, Response]):
+    """Wraps a downstream service; ``active`` toggles the fault window.
+
+    While active, affected requests get ``req.ctx['fault_label'] = 1.0``
+    (anomalous); all other requests get 0.0 (normal) so traces are fully
+    labeled.
+    """
+
+    def __init__(self, spec: FaultSpec, rng: Optional[random.Random] = None):
+        self.spec = spec
+        self.active = False
+        self._rng = rng or random.Random(1234)
+        self.injected = 0
+
+    LABEL_HEADER = "l5d-fault-label"
+
+    def _label(self, rsp: Response, label: float) -> Response:
+        # The label travels as a response header so it crosses the wire
+        # back to the proxy-side FeatureRecorder (the injector typically
+        # wraps a downstream in another process).
+        rsp.headers.set(self.LABEL_HEADER, "1" if label else "0")
+        return rsp
+
+    async def apply(self, req: Request, service: Service) -> Response:
+        if not self.active:
+            return self._label(await service(req), 0.0)
+        spec = self.spec
+        injected = False
+        if spec.latency_ms > 0:
+            delay = spec.latency_ms + self._rng.uniform(
+                0, spec.latency_jitter_ms)
+            await asyncio.sleep(delay / 1e3)
+            injected = True
+        if spec.error_rate > 0 and self._rng.random() < spec.error_rate:
+            self.injected += 1
+            return self._label(
+                Response(status=spec.error_status, body=b"injected fault"), 1.0)
+        if injected:
+            self.injected += 1
+        return self._label(await service(req), 1.0 if injected else 0.0)
+
+
+def auc(labels, scores) -> float:
+    """Area under the ROC curve via the rank-sum formulation (no sklearn)."""
+    pairs = sorted(zip(scores, labels))
+    n_pos = sum(1 for _, l in pairs if l > 0.5)
+    n_neg = len(pairs) - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return float("nan")
+    # average rank of positives (1-based), ties get average rank
+    rank_sum = 0.0
+    i = 0
+    while i < len(pairs):
+        j = i
+        while j < len(pairs) and pairs[j][0] == pairs[i][0]:
+            j += 1
+        avg_rank = (i + 1 + j) / 2.0
+        for k in range(i, j):
+            if pairs[k][1] > 0.5:
+                rank_sum += avg_rank
+        i = j
+    return (rank_sum - n_pos * (n_pos + 1) / 2.0) / (n_pos * n_neg)

@@ -1,0 +1,215 @@
+"""North-star pipeline tests: feature recorder -> micro-batch -> scorer ->
+scoreboard -> policy feedback, plus the labeled fault-injection AUC
+evaluation (BASELINE.md: AUC >= 0.9 on injected-fault traces)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from linkerd_tpu.linker import load_linker
+from linkerd_tpu.protocol.http import Request, Response
+from linkerd_tpu.protocol.http.client import HttpClient
+from linkerd_tpu.protocol.http.server import serve
+from linkerd_tpu.router.service import FnService
+from linkerd_tpu.telemetry.anomaly import (
+    AnomalyFailureAccrualPolicy, InProcessScorer, JaxAnomalyConfig,
+    ScoreBoard,
+)
+from linkerd_tpu.telemetry.metrics import MetricsTree
+from linkerd_tpu.testing.faults import FaultInjector, FaultSpec, auc
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 120))
+
+
+class TestAuc:
+    def test_auc_helper(self):
+        assert auc([0, 0, 1, 1], [0.1, 0.2, 0.8, 0.9]) == 1.0
+        assert auc([1, 1, 0, 0], [0.1, 0.2, 0.8, 0.9]) == 0.0
+        assert abs(auc([0, 1, 0, 1], [0.5, 0.5, 0.5, 0.5]) - 0.5) < 1e-9
+
+
+class TestScoreBoard:
+    def test_ewma_and_observability(self):
+        b = ScoreBoard(alpha=0.5)
+        b.update_batch(["/svc/a", "/svc/a", "/svc/b"],
+                       np.array([0.8, 0.6, 0.1]))
+        assert 0.6 <= b.score_of("/svc/a") <= 0.8
+        assert b.score_of("/svc/b") == pytest.approx(0.1)
+        b.update_batch(["/svc/b"], np.array([0.9]))
+        assert b.score_of("/svc/b") == pytest.approx(0.5)  # ewma moved
+
+
+class TestAnomalyPolicy:
+    def test_threshold_tightens_accrual(self):
+        board = ScoreBoard()
+        p = AnomalyFailureAccrualPolicy(
+            board, failures=5, anomalous_failures=2, threshold=0.5,
+            backoffs=iter([1.0, 1.0, 1.0]))
+        # calm mesh: needs 5 consecutive failures
+        for _ in range(4):
+            assert p.record_failure() is None
+        p.record_success()
+        # anomalous mesh: needs only 2
+        board.update_batch(["/svc/web"], np.array([0.9]))
+        assert p.record_failure() is None
+        assert p.record_failure() == 1.0
+
+
+class TestTelemeterPipeline:
+    def test_end_to_end_scoring_and_auc(self, tmp_path):
+        """Full linker with the jaxAnomaly telemeter: normal traffic, then
+        injected faults; anomaly scores must separate labeled traffic with
+        AUC >= 0.9 and raise the per-dst score."""
+        disco = tmp_path / "disco"
+        disco.mkdir()
+
+        injector = FaultInjector(FaultSpec(error_rate=0.9, latency_ms=40.0))
+
+        async def backend(req: Request) -> Response:
+            return Response(200, body=b"x" * 200)
+
+        async def go():
+            d = await serve(injector.and_then(FnService(backend)))
+            (disco / "web").write_text(f"127.0.0.1 {d.bound_port}\n")
+            cfg = f"""
+routers:
+- protocol: http
+  label: rt
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers: [{{port: 0}}]
+  client:
+    failureAccrual: {{kind: none}}
+telemetry:
+- kind: io.l5d.jaxAnomaly
+  maxBatch: 512
+  trainEveryBatches: 1
+  reconWeight: 1.0
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+            linker = load_linker(cfg)
+            await linker.start()
+            tele = linker.telemeters[0]
+            proxy = HttpClient("127.0.0.1", linker.routers[0].server_ports[0])
+            try:
+                async def send(n):
+                    for _ in range(n):
+                        req = Request(method="GET", uri="/")
+                        req.headers.set("Host", "web")
+                        await proxy(req)
+
+                # Phase A: normal traffic; train the autoencoder on it.
+                await send(120)
+                for _ in range(6):  # several train steps on normal batches
+                    ring_copy = list(tele.ring)
+                    await tele.drain_once()
+                    for item in ring_copy:  # refill so training sees more
+                        tele.ring.append(item)
+                    await tele.drain_once()
+                baseline = tele.board.score_of("/svc/web")
+
+                # Phase B: mixed window — alternating fault bursts and
+                # normal traffic, all labeled.
+                for _ in range(4):
+                    injector.active = True
+                    await send(30)
+                    injector.active = False
+                    await send(30)
+                # score the labeled window WITHOUT training on it
+                tele.cfg.trainEveryBatches = 0
+                items = list(tele.ring)
+                await tele.drain_once()
+                anomalous = tele.board.score_of("/svc/web")
+                assert anomalous > baseline  # score rose under faults
+
+                # AUC over the individually labeled window
+                from linkerd_tpu.models.features import featurize_batch
+                fvs = [fv for fv, _ in items]
+                labels = [lab for _, lab in items]
+                x = featurize_batch(fvs)
+                scorer = tele._ensure_scorer()
+                scores = await scorer.score(x)
+                mask = [(l, s) for l, s in zip(labels, scores)
+                        if l is not None]
+                got_auc = auc([l for l, _ in mask], [s for _, s in mask])
+                assert got_auc >= 0.9, f"AUC {got_auc}"
+            finally:
+                await proxy.close()
+                await linker.close()
+                await d.close()
+
+        run(go())
+
+    def test_scorer_metrics_and_admin_handler(self, tmp_path):
+        async def go():
+            mt = MetricsTree()
+            cfg = JaxAnomalyConfig(maxBatch=64, trainEveryBatches=0,
+                                   reconWeight=1.0)
+            tele = cfg.mk(mt)
+            rec = tele.recorder()
+
+            async def ok(req):
+                return Response(200)
+
+            svc = rec.and_then(FnService(ok))
+            for _ in range(10):
+                req = Request()
+                req.ctx["dst"] = type("D", (), {"path": None})
+                req.ctx["dst"].path = __import__(
+                    "linkerd_tpu.core.path", fromlist=["Path"]).Path.read("/svc/x")
+                await svc(req)
+            n = await tele.drain_once()
+            assert n == 10
+            flat = mt.flatten()
+            assert flat["anomaly/scored_total"] == 10
+            assert flat["anomaly/batches"] == 1
+            assert "anomaly/dst/svc.x" in flat
+
+            handlers = tele.admin_handlers()
+            assert handlers[0][0] == "/anomaly.json"
+            rsp = await handlers[0][1](Request())
+            assert rsp.status == 200
+            tele.close()
+
+        run(go())
+
+
+class TestGrpcSidecar:
+    def test_score_and_fit_over_grpc(self):
+        from linkerd_tpu.telemetry.sidecar import (
+            GrpcScorerClient, ScorerSidecar, decode_fit, encode_fit,
+            decode_matrix, encode_matrix,
+        )
+
+        # codec roundtrip
+        x = np.random.default_rng(0).standard_normal((5, 32)).astype(np.float32)
+        assert (decode_matrix(encode_matrix(x)) == x).all()
+        labels = np.ones(5, np.float32)
+        mask = np.zeros(5, np.float32)
+        x2, l2, m2 = decode_fit(encode_fit(x, labels, mask))
+        assert (x2 == x).all() and (l2 == labels).all() and (m2 == mask).all()
+
+        async def go():
+            sidecar = await ScorerSidecar().start()
+            client = GrpcScorerClient(f"127.0.0.1:{sidecar.port}")
+            try:
+                scores = await client.score(x)
+                assert scores.shape == (5,)
+                assert np.isfinite(scores).all()
+                loss = await client.fit(x, labels, np.ones(5, np.float32))
+                assert np.isfinite(loss)
+                # fit actually trains: loss decreases over steps
+                losses = [await client.fit(x, np.zeros(5, np.float32),
+                                           np.zeros(5, np.float32))
+                          for _ in range(10)]
+                assert losses[-1] < losses[0]
+            finally:
+                client.close()
+                await sidecar.close()
+
+        run(go())

@@ -131,6 +131,15 @@ class TestInstantiate:
         inner = instantiate_as(Inner, {"name": "x", "weight": 2.0})
         assert inner == Inner("x", 2.0)
 
+    def test_kind_field_preserved_on_plain_specs(self):
+        """Specs with a real `kind` dataclass field (e.g. loadBalancer)
+        must keep the configured value — regression for the silent
+        kind-drop bug."""
+        from linkerd_tpu.linker import BalancerSpec, ClientSpec
+
+        c = instantiate_as(ClientSpec, {"loadBalancer": {"kind": "ewma"}})
+        assert c.loadBalancer == BalancerSpec(kind="ewma")
+
 
 class TestMetrics:
     def test_counter_gauge_stat(self):

@@ -1,0 +1,262 @@
+"""Tests for classifiers, retries/budgets, timeouts, failure accrual —
+including e2e retry behavior through a full linker (modeled on the
+reference's RetriesEndToEndTest, SURVEY.md §4)."""
+
+import asyncio
+
+import pytest
+
+from linkerd_tpu.linker import load_linker
+from linkerd_tpu.protocol.http import Request, Response
+from linkerd_tpu.protocol.http.client import HttpClient
+from linkerd_tpu.protocol.http.server import serve
+from linkerd_tpu.router.classifiers import (
+    AllSuccessful, HeaderRetryable, NonRetryable5XX, ResponseClass,
+    RetryableIdempotent5XX,
+)
+from linkerd_tpu.router.failure_accrual import (
+    ConsecutiveFailuresPolicy, FailureAccrualService, SuccessRatePolicy,
+    SuccessRateWindowedPolicy,
+)
+from linkerd_tpu.router.retries import ClassifiedRetries, RetryBudget, TotalTimeout
+from linkerd_tpu.router.service import FnService, Status
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+class TestClassifiers:
+    def test_non_retryable_5xx(self):
+        c = NonRetryable5XX().mk()
+        assert c(Request(), Response(200), None) is ResponseClass.SUCCESS
+        assert c(Request(), Response(503), None) is ResponseClass.FAILURE
+        assert c(Request(), None, ConnectionError()) is ResponseClass.FAILURE
+
+    def test_retryable_idempotent(self):
+        c = RetryableIdempotent5XX().mk()
+        get = Request(method="GET")
+        post = Request(method="POST")
+        assert c(get, Response(503), None) is ResponseClass.RETRYABLE_FAILURE
+        assert c(post, Response(503), None) is ResponseClass.FAILURE
+        assert c(get, None, ConnectionError()) is ResponseClass.RETRYABLE_FAILURE
+
+    def test_all_successful(self):
+        c = AllSuccessful().mk()
+        assert c(Request(), Response(500), None) is ResponseClass.SUCCESS
+
+    def test_header_retryable(self):
+        c = HeaderRetryable().mk()
+        rsp = Response(503)
+        rsp.headers.set("l5d-retryable", "true")
+        assert c(Request(method="POST"), rsp, None) is ResponseClass.RETRYABLE_FAILURE
+        rsp2 = Response(503)
+        rsp2.headers.set("l5d-retryable", "false")
+        assert c(Request(method="GET"), rsp2, None) is ResponseClass.FAILURE
+
+
+class TestRetryBudget:
+    def test_floor_allows_minimum(self):
+        b = RetryBudget(ttl_s=10, min_retries_per_s=1, percent_can_retry=0.0)
+        assert b.try_withdraw()  # floor = 10 tokens
+
+    def test_exhaustion(self):
+        b = RetryBudget(ttl_s=1, min_retries_per_s=2, percent_can_retry=0.0)
+        allowed = sum(1 for _ in range(10) if b.try_withdraw())
+        assert allowed == 2
+
+    def test_deposits_earn_retries(self):
+        b = RetryBudget(ttl_s=10, min_retries_per_s=0, percent_can_retry=0.5)
+        for _ in range(10):
+            b.deposit()
+        allowed = sum(1 for _ in range(10) if b.try_withdraw())
+        assert allowed == 5
+
+
+class TestRetriesFilter:
+    def test_retries_until_success(self):
+        calls = []
+
+        async def flaky(req):
+            calls.append(1)
+            if len(calls) < 3:
+                return Response(503)
+            return Response(200)
+
+        async def go():
+            f = ClassifiedRetries(RetryableIdempotent5XX().mk())
+            rsp = await f.apply(Request(method="GET"), FnService(flaky))
+            assert rsp.status == 200
+            assert len(calls) == 3
+
+        run(go())
+
+    def test_non_retryable_not_retried(self):
+        calls = []
+
+        async def failing(req):
+            calls.append(1)
+            return Response(503)
+
+        async def go():
+            f = ClassifiedRetries(NonRetryable5XX().mk())
+            rsp = await f.apply(Request(method="GET"), FnService(failing))
+            assert rsp.status == 503
+            assert len(calls) == 1
+
+        run(go())
+
+    def test_budget_bounds_retries(self):
+        calls = []
+
+        async def always_fail(req):
+            calls.append(1)
+            return Response(503)
+
+        async def go():
+            budget = RetryBudget(ttl_s=10, min_retries_per_s=0.3,
+                                 percent_can_retry=0.0)
+            f = ClassifiedRetries(RetryableIdempotent5XX().mk(), budget)
+            rsp = await f.apply(Request(method="GET"), FnService(always_fail))
+            assert rsp.status == 503
+            assert len(calls) == 4  # 1 initial + floor(0.3*10)=3 retries
+
+        run(go())
+
+    def test_exception_retried_then_raised(self):
+        calls = []
+
+        async def broken(req):
+            calls.append(1)
+            raise ConnectionError("refused")
+
+        async def go():
+            budget = RetryBudget(ttl_s=1, min_retries_per_s=2,
+                                 percent_can_retry=0.0)
+            f = ClassifiedRetries(RetryableIdempotent5XX().mk(), budget)
+            with pytest.raises(ConnectionError):
+                await f.apply(Request(method="GET"), FnService(broken))
+            assert len(calls) == 3  # 1 + 2 budget
+
+        run(go())
+
+
+class TestTotalTimeout:
+    def test_timeout_fires(self):
+        async def slow(req):
+            await asyncio.sleep(1.0)
+            return Response(200)
+
+        async def go():
+            f = TotalTimeout(0.05)
+            with pytest.raises(TimeoutError):
+                await f.apply(Request(), FnService(slow))
+
+        run(go())
+
+
+class TestFailureAccrual:
+    def test_consecutive_failures_marks_dead(self):
+        async def failing(req):
+            return Response(500)
+
+        async def go():
+            svc = FailureAccrualService(
+                FnService(failing), ConsecutiveFailuresPolicy(failures=3))
+            for _ in range(3):
+                await svc(Request())
+            assert svc.status is Status.BUSY
+
+        run(go())
+
+    def test_probe_revives(self):
+        state = {"healthy": False}
+
+        async def flapping(req):
+            return Response(200 if state["healthy"] else 500)
+
+        async def go():
+            policy = ConsecutiveFailuresPolicy(
+                failures=2, backoffs=iter([0.01, 0.01, 0.01]))
+            svc = FailureAccrualService(FnService(flapping), policy)
+            await svc(Request())
+            await svc(Request())
+            assert svc.status is Status.BUSY
+            state["healthy"] = True
+            await asyncio.sleep(0.02)
+            assert svc.status is Status.OPEN  # probe window open
+            rsp = await svc(Request())  # successful probe revives
+            assert rsp.status == 200
+            assert svc.status is Status.OPEN
+            assert svc._dead_until is None
+
+        run(go())
+
+    def test_success_rate_policy(self):
+        p = SuccessRatePolicy(success_rate=0.9, requests=5,
+                              backoffs=iter([1.0]))
+        for _ in range(5):
+            p.record_success()
+        dead = None
+        for _ in range(5):
+            dead = p.record_failure()
+            if dead:
+                break
+        assert dead == 1.0
+
+    def test_windowed_policy(self):
+        p = SuccessRateWindowedPolicy(success_rate=0.5, window_s=30,
+                                      backoffs=iter([2.0]))
+        p.record_success()
+        assert p.record_failure() is None  # 1/2 = 0.5, not below
+        assert p.record_failure() == 2.0   # 1/3 < 0.5
+
+
+class TestRetriesEndToEnd:
+    def test_linker_retries_flaky_downstream(self, tmp_path):
+        disco = tmp_path / "disco"
+        disco.mkdir()
+        calls = []
+
+        async def flaky(req):
+            calls.append(1)
+            return Response(503 if len(calls) % 3 != 0 else 200, body=b"ok")
+
+        async def go():
+            d = await serve(FnService(flaky))
+            (disco / "web").write_text(f"127.0.0.1 {d.bound_port}\n")
+            cfg = f"""
+routers:
+- protocol: http
+  label: rt
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  service:
+    responseClassifier: {{kind: io.l5d.http.retryableIdempotent5XX}}
+    totalTimeoutMs: 5000
+  servers: [{{port: 0}}]
+  client:
+    failureAccrual: {{kind: none}}
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+            linker = load_linker(cfg)
+            await linker.start()
+            proxy = HttpClient("127.0.0.1", linker.routers[0].server_ports[0])
+            try:
+                req = Request(method="GET", uri="/")
+                req.headers.set("Host", "web")
+                rsp = await proxy(req)
+                assert rsp.status == 200  # retried through two 503s
+                assert len(calls) == 3
+                flat = linker.metrics.flatten()
+                assert flat["rt/rt/service/svc.web/retries/total"] == 2
+                # server saw ONE request; it succeeded after retries
+                assert flat["rt/rt/server/status/200"] == 1
+            finally:
+                await proxy.close()
+                await linker.close()
+                await d.close()
+
+        run(go())
