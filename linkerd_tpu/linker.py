@@ -10,6 +10,7 @@ linkerd/main/.../Main.scala:25-49.
 from __future__ import annotations
 
 import asyncio
+import logging
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -35,7 +36,11 @@ from linkerd_tpu.router.routing import (
     StatusCodeStatsFilter,
 )
 from linkerd_tpu.router.service import Service, filters_to_service
+from linkerd_tpu.router.tracing import (
+    AccessLogger, ClientTraceFilter, ServerTraceFilter,
+)
 from linkerd_tpu.telemetry.metrics import MetricsTree
+from linkerd_tpu.telemetry.telemeter import BroadcastTracer, NullTracer
 
 # Ensure built-in plugin registrations are loaded.
 import linkerd_tpu.namer.fs  # noqa: F401
@@ -43,6 +48,7 @@ import linkerd_tpu.protocol.http.identifiers  # noqa: F401
 import linkerd_tpu.router.classifiers  # noqa: F401
 import linkerd_tpu.router.failure_accrual  # noqa: F401
 import linkerd_tpu.telemetry.anomaly  # noqa: F401
+import linkerd_tpu.telemetry.exporters  # noqa: F401
 
 DEFAULT_ADMIN_PORT = 9990  # ref: Linker.scala:37
 DEFAULT_HTTP_PORT = 4140   # ref: linkerd http router default
@@ -132,6 +138,8 @@ class RouterSpec:
     service: Optional[SvcSpec] = None
     bindingTimeoutMs: int = 10000
     bindingCache: Optional[Dict[str, Any]] = None
+    sampleRate: float = 1.0               # trace sampling for new roots
+    httpAccessLog: Optional[str] = None   # path or "stdout"
 
 
 @dataclass
@@ -191,6 +199,7 @@ class Linker:
         self.namers: List[Tuple[Path, Namer]] = []
         self.routers: List[Router] = []
         self.telemeters: List[Any] = []
+        self._access_listeners: List[Tuple[Any, Any]] = []
         self._build()
 
     # -- assembly ---------------------------------------------------------
@@ -201,6 +210,9 @@ class Linker:
 
         for tcfg in instantiate_list("telemeter", self.spec.telemetry, "telemetry"):
             self.telemeters.append(tcfg.mk(self.metrics))
+        # broadcast tracer over all telemeter tracers (ref: Linker.scala:152-157)
+        tracers = [t.tracer for t in self.telemeters if t.tracer is not None]
+        self.tracer = BroadcastTracer(tracers) if tracers else NullTracer()
 
         labels_seen: Dict[str, int] = {}
         for rspec in self.spec.routers:
@@ -265,14 +277,17 @@ class Linker:
         def client_factory(bound: BoundName) -> Service:
             cid = bound.id_.show.lstrip("/").replace("/", ".") or "client"
             bal = mk_balancer(bal_kind, bound.addr, endpoint_factory)
-            stats = StatsFilter(metrics, "rt", label, "client", cid)
+            filters: List[Any] = [StatsFilter(metrics, "rt", label, "client", cid)]
+            if not isinstance(self.tracer, NullTracer):
+                filters.append(ClientTraceFilter(self.tracer, cid))
             metrics.scope("rt", label, "client", cid).gauge(
                 "endpoints", fn=lambda b=bal: b.size)
             # Prune this client's metrics subtree on eviction so gauges
             # don't pin the closed balancer or report stale values (ref:
             # MetricsPruningModule.scala:39).
             return _PruneOnClose(
-                stats.and_then(bal), metrics, ("rt", label, "client", cid))
+                filters_to_service(filters, bal), metrics,
+                ("rt", label, "client", cid))
 
         sspec = rspec.service or SvcSpec()
         classifier_cfg = sspec.responseClassifier or {
@@ -324,6 +339,13 @@ class Linker:
             StatsFilter(metrics, "rt", label, "server"),
             StatusCodeStatsFilter(metrics, "rt", label, "server"),
         ]
+        if not isinstance(self.tracer, NullTracer):
+            # only pay per-request span construction when a sink exists
+            server_filters.insert(
+                0, ServerTraceFilter(self.tracer, label, rspec.sampleRate))
+        if rspec.httpAccessLog:
+            server_filters.append(AccessLogger(
+                self._mk_access_emit(label, rspec.httpAccessLog)))
         for t in self.telemeters:
             if hasattr(t, "recorder"):
                 server_filters.append(t.recorder())
@@ -336,6 +358,25 @@ class Linker:
             for s in (rspec.servers or [ServerSpec()])
         ]
         return Router(rspec, label, server_stack, binding, servers)
+
+    def _mk_access_emit(self, label: str, target: str):
+        """Access-log sink: off-event-loop disk writes via QueueListener;
+        handlers are per-Linker (no global logger registry) and closed by
+        Linker.close()."""
+        if target == "stdout":
+            return print
+        import logging.handlers
+        import queue
+
+        q: queue.SimpleQueue = queue.SimpleQueue()
+        fh = logging.FileHandler(target)
+        fh.setFormatter(logging.Formatter("%(message)s"))
+        listener = logging.handlers.QueueListener(q, fh)
+        listener.start()
+        self._access_listeners.append((listener, fh))
+        alog = logging.Logger(f"access.{label}")  # standalone, not registered
+        alog.addHandler(logging.handlers.QueueHandler(q))
+        return alog.info
 
     def _anomaly_board(self):
         """ScoreBoard of the configured jaxAnomaly telemeter (or a detached
@@ -359,6 +400,10 @@ class Linker:
             namer.close()
         for t in self.telemeters:
             t.close()
+        for listener, fh in self._access_listeners:
+            listener.stop()
+            fh.close()
+        self._access_listeners.clear()
 
 
 def load_linker(text: str) -> Linker:

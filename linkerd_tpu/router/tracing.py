@@ -1,0 +1,174 @@
+"""Distributed tracing: context propagation + span recording.
+
+Reference parity: finagle Trace threaded through every stack (SURVEY.md §5):
+per-protocol TraceInitializers decode/encode ids from wire headers
+(HttpTraceInitializer.scala:65), ``l5d-ctx-trace`` + ``l5d-sample`` headers
+(LinkerdHeaders.scala:24,117,291), router annotations for label/paths/
+classification (DstTracing.scala, ClassifiedTracing.scala). Span sinks are
+telemeter Tracers (zipkin/tracelog/recentRequests).
+
+Wire format for ``l5d-ctx-trace``: ``<trace_id>-<span_id>-<parent_id>-<flags>``
+hex fields (128/64/64-bit), flags bit0 = sampled.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from linkerd_tpu.protocol.http.message import Request, Response
+from linkerd_tpu.router.service import Filter, Service
+from linkerd_tpu.telemetry.telemeter import Tracer
+
+CTX_TRACE = "l5d-ctx-trace"
+SAMPLE_HEADER = "l5d-sample"
+
+_rng = random.Random()
+
+
+@dataclass
+class TraceId:
+    trace_id: int
+    span_id: int
+    parent_id: int = 0
+    sampled: bool = True
+
+    def encode(self) -> str:
+        flags = 1 if self.sampled else 0
+        return (f"{self.trace_id:032x}-{self.span_id:016x}-"
+                f"{self.parent_id:016x}-{flags:02x}")
+
+    @staticmethod
+    def decode(s: str) -> Optional["TraceId"]:
+        parts = s.strip().split("-")
+        if len(parts) != 4:
+            return None
+        try:
+            return TraceId(
+                trace_id=int(parts[0], 16),
+                span_id=int(parts[1], 16),
+                parent_id=int(parts[2], 16),
+                sampled=bool(int(parts[3], 16) & 1))
+        except ValueError:
+            return None
+
+    @staticmethod
+    def mk_root(sampled: bool = True) -> "TraceId":
+        return TraceId(_rng.getrandbits(128), _rng.getrandbits(64), 0, sampled)
+
+    def child(self) -> "TraceId":
+        return TraceId(self.trace_id, _rng.getrandbits(64), self.span_id,
+                       self.sampled)
+
+
+class ServerTraceFilter(Filter[Request, Response]):
+    """Server-side trace init: join the caller's trace from l5d-ctx-trace
+    or start a new root; record the server span to the tracer."""
+
+    def __init__(self, tracer: Tracer, router_label: str,
+                 sample_rate: float = 1.0):
+        self.tracer = tracer
+        self.router_label = router_label
+        self.sample_rate = sample_rate
+
+    async def apply(self, req: Request, service: Service) -> Response:
+        hdr = req.headers.get(CTX_TRACE)
+        parent = TraceId.decode(hdr) if hdr else None
+        if parent is not None:
+            span = parent.child()
+        else:
+            sample_hdr = req.headers.get(SAMPLE_HEADER)
+            if sample_hdr is not None:
+                try:
+                    sampled = _rng.random() < float(sample_hdr)
+                except ValueError:
+                    sampled = _rng.random() < self.sample_rate
+            else:
+                sampled = _rng.random() < self.sample_rate
+            span = TraceId.mk_root(sampled)
+        req.ctx["trace"] = span
+        t0 = time.time()
+        status = None
+        try:
+            rsp = await service(req)
+            status = rsp.status
+            return rsp
+        finally:
+            if span.sampled:
+                dst = req.ctx.get("dst")
+                self.tracer.record({
+                    "traceId": f"{span.trace_id:032x}",
+                    "id": f"{span.span_id:016x}",
+                    "parentId": (f"{span.parent_id:016x}"
+                                 if span.parent_id else None),
+                    "kind": "SERVER",
+                    "name": f"{req.method} {req.path}",
+                    "timestamp": int(t0 * 1e6),
+                    "duration": int((time.time() - t0) * 1e6),
+                    "localEndpoint": {"serviceName": self.router_label},
+                    "tags": {
+                        "router.label": self.router_label,
+                        "dst.path": dst.path.show if dst else "",
+                        "http.status_code": str(status) if status else "error",
+                        "response.class": str(
+                            getattr(req.ctx.get("response_class"), "value", "")),
+                    },
+                })
+
+
+class ClientTraceFilter(Filter[Request, Response]):
+    """Client-side: propagate the child trace ctx downstream via headers
+    and record the client span."""
+
+    def __init__(self, tracer: Tracer, client_id: str):
+        self.tracer = tracer
+        self.client_id = client_id
+
+    async def apply(self, req: Request, service: Service) -> Response:
+        span: Optional[TraceId] = req.ctx.get("trace")  # type: ignore[assignment]
+        if span is None:
+            return await service(req)
+        child = span.child()
+        req.headers.set(CTX_TRACE, child.encode())
+        t0 = time.time()
+        status = None
+        try:
+            rsp = await service(req)
+            status = rsp.status
+            return rsp
+        finally:
+            if child.sampled:
+                self.tracer.record({
+                    "traceId": f"{child.trace_id:032x}",
+                    "id": f"{child.span_id:016x}",
+                    "parentId": f"{child.parent_id:016x}",
+                    "kind": "CLIENT",
+                    "name": f"{req.method} {req.path}",
+                    "timestamp": int(t0 * 1e6),
+                    "duration": int((time.time() - t0) * 1e6),
+                    "localEndpoint": {"serviceName": self.client_id},
+                    "tags": {
+                        "client.id": self.client_id,
+                        "http.status_code": str(status) if status else "error",
+                    },
+                })
+
+
+class AccessLogger(Filter[Request, Response]):
+    """Common Log Format access logging (ref: AccessLogger.scala:8)."""
+
+    def __init__(self, emit):
+        self._emit = emit  # callable(str)
+
+    async def apply(self, req: Request, service: Service) -> Response:
+        t0 = time.time()
+        rsp = await service(req)
+        peer = req.ctx.get("client_addr") or ("-",)
+        host = peer[0] if isinstance(peer, tuple) else "-"
+        ts = time.strftime("%d/%b/%Y:%H:%M:%S +0000", time.gmtime(t0))
+        self._emit(
+            f'{host} - - [{ts}] "{req.method} {req.uri} {req.version}" '
+            f"{rsp.status} {len(rsp.body)}")
+        return rsp

@@ -1,0 +1,369 @@
+"""Exporter telemeters: prometheus, influxdb, statsd, tracelog,
+recentRequests.
+
+Reference parity (SURVEY.md §2.3): telemetry/prometheus
+(label-rewriting text exposition, PrometheusTelemeter.scala:62-80),
+telemetry/influxdb (LINE protocol for Telegraf pull), telemetry/statsd
+(dogstatsd push), telemetry/tracelog (sampled span logging),
+telemetry/recent-requests (in-memory ring + admin table).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import json
+import logging
+import re
+import time
+from dataclasses import dataclass
+from typing import Deque, Dict, List, Optional, Tuple
+
+from linkerd_tpu.config import register
+from linkerd_tpu.protocol.http.message import Request, Response
+from linkerd_tpu.telemetry.metrics import Counter, Gauge, MetricsTree, Stat
+from linkerd_tpu.telemetry.telemeter import Telemeter, Tracer
+
+log = logging.getLogger(__name__)
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+_STATSD_RE = re.compile(r"[^a-zA-Z0-9_.]")
+
+
+def _sanitize(name: str) -> str:
+    return _NAME_RE.sub("_", name)
+
+
+def _sanitize_statsd(name: str) -> str:
+    return _STATSD_RE.sub("_", name)
+
+
+def _labeled_name(names: Tuple[str, ...]) -> Tuple[str, Dict[str, str]]:
+    """Rewrite the rt/<router>/{server,service/<svc>,client/<id>} scope
+    convention into labels (ref: PrometheusTelemeter.scala:62-80)."""
+    labels: Dict[str, str] = {}
+    rest = list(names)
+    if len(rest) >= 2 and rest[0] == "rt":
+        labels["rt"] = rest[1]
+        rest = rest[2:]
+        if rest and rest[0] == "server":
+            rest = rest[1:]
+        elif len(rest) >= 2 and rest[0] in ("service", "client"):
+            labels[rest[0]] = rest[1]
+            rest = rest[2:]
+    return _sanitize("_".join(rest) or "value"), labels
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def prometheus_text(metrics: MetricsTree) -> str:
+    lines: List[str] = []
+    for names, metric in metrics.walk():
+        name, labels = _labeled_name(names)
+        if isinstance(metric, Counter):
+            lines.append(f"{name}{_fmt_labels(labels)} {metric.value}")
+        elif isinstance(metric, Gauge):
+            lines.append(f"{name}{_fmt_labels(labels)} {metric.value}")
+        elif isinstance(metric, Stat):
+            snap = metric.snapshot()
+            if snap["count"] == 0:
+                continue
+            quantiles = {"p50": "0.5", "p90": "0.9", "p95": "0.95",
+                         "p99": "0.99", "p999": "0.999"}
+            for q, qv in quantiles.items():
+                ql = dict(labels)
+                ql["quantile"] = qv
+                lines.append(f"{name}{_fmt_labels(ql)} {snap[q]}")
+            lines.append(f"{name}_count{_fmt_labels(labels)} {snap['count']}")
+            lines.append(f"{name}_sum{_fmt_labels(labels)} {snap['sum']}")
+            lines.append(f"{name}_avg{_fmt_labels(labels)} {snap['avg']}")
+    return "\n".join(lines) + "\n"
+
+
+def influxdb_line(metrics: MetricsTree, host: str = "localhost") -> str:
+    """LINE protocol, one measurement per scope prefix
+    (ref: InfluxDbTelemeter.scala:17)."""
+    by_prefix: Dict[Tuple[Tuple[str, ...], Tuple[Tuple[str, str], ...]],
+                    Dict[str, float]] = {}
+    for names, metric in metrics.walk():
+        if len(names) < 1:
+            continue
+        name, labels = _labeled_name(names)
+        key_prefix = tuple(sorted(labels.items()))
+        measurement = names[0] if names[0] != "rt" else "rt"
+        if isinstance(metric, (Counter, Gauge)):
+            fields = {name: float(metric.value)}
+        else:
+            snap = metric.snapshot()
+            if snap["count"] == 0:
+                continue
+            fields = {f"{name}_{k}": float(v) for k, v in snap.items()}
+        by_prefix.setdefault((measurement, key_prefix), {}).update(fields)
+    lines = []
+    for (measurement, labels), fields in sorted(by_prefix.items()):
+        tag_str = "".join(f",{k}={v}" for k, v in labels)
+        field_str = ",".join(f"{k}={v}" for k, v in sorted(fields.items()))
+        lines.append(f"{measurement},host={host}{tag_str} {field_str}")
+    return "\n".join(lines) + "\n"
+
+
+@register("telemeter", "io.l5d.prometheus")
+@dataclass
+class PrometheusConfig:
+    path: str = "/admin/metrics/prometheus"
+
+    def mk(self, metrics: MetricsTree) -> Telemeter:
+        return PrometheusTelemeter(metrics, self.path)
+
+
+class PrometheusTelemeter(Telemeter):
+    def __init__(self, metrics: MetricsTree, path: str):
+        self.metrics = metrics
+        self.path = path
+
+    def admin_handlers(self):
+        async def handler(req: Request) -> Response:
+            rsp = Response(body=prometheus_text(self.metrics).encode())
+            rsp.headers.set("Content-Type", "text/plain; version=0.0.4")
+            return rsp
+
+        return [(self.path, handler)]
+
+
+@register("telemeter", "io.l5d.influxdb")
+@dataclass
+class InfluxDbConfig:
+    path: str = "/admin/metrics/influxdb"
+
+    def mk(self, metrics: MetricsTree) -> Telemeter:
+        return InfluxDbTelemeter(metrics, self.path)
+
+
+class InfluxDbTelemeter(Telemeter):
+    def __init__(self, metrics: MetricsTree, path: str):
+        self.metrics = metrics
+        self.path = path
+
+    def admin_handlers(self):
+        async def handler(req: Request) -> Response:
+            rsp = Response(body=influxdb_line(self.metrics).encode())
+            rsp.headers.set("Content-Type", "text/plain")
+            return rsp
+
+        return [(self.path, handler)]
+
+
+@register("telemeter", "io.l5d.statsd", experimental=True)
+@dataclass
+class StatsDConfig:
+    host: str = "127.0.0.1"
+    port: int = 8125
+    prefix: str = "linkerd"
+    gaugeIntervalMs: int = 10000
+
+    def mk(self, metrics: MetricsTree) -> Telemeter:
+        return StatsDTelemeter(metrics, self)
+
+
+class StatsDTelemeter(Telemeter):
+    """Pushes counters (as deltas) and gauges over UDP dogstatsd lines
+    every gaugeIntervalMs (ref: StatsDTelemeter.scala:9)."""
+
+    def __init__(self, metrics: MetricsTree, cfg: StatsDConfig):
+        self.metrics = metrics
+        self.cfg = cfg
+        self._last_counters: Dict[str, int] = {}
+        self._transport = None
+        self._stop = asyncio.Event()
+
+    async def run(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._transport, _ = await loop.create_datagram_endpoint(
+            asyncio.DatagramProtocol,
+            remote_addr=(self.cfg.host, self.cfg.port))
+        try:
+            while not self._stop.is_set():
+                await asyncio.sleep(self.cfg.gaugeIntervalMs / 1e3)
+                self.flush()
+        except asyncio.CancelledError:
+            pass
+        finally:
+            if self._transport:
+                self._transport.close()
+
+    def flush(self) -> None:
+        if self._transport is None:
+            return
+        out = []
+        for names, metric in self.metrics.walk():
+            key = f"{self.cfg.prefix}.{'.'.join(names)}"
+            key = _sanitize_statsd(key.replace("/", "."))
+            if isinstance(metric, Counter):
+                delta = metric.value - self._last_counters.get(key, 0)
+                self._last_counters[key] = metric.value
+                if delta:
+                    out.append(f"{key}:{delta}|c")
+            elif isinstance(metric, Gauge):
+                out.append(f"{key}:{metric.value}|g")
+            elif isinstance(metric, Stat):
+                snap = metric.snapshot()
+                if snap["count"]:
+                    out.append(f"{key}.p99:{snap['p99']}|g")
+                    out.append(f"{key}.p50:{snap['p50']}|g")
+        for line in out:
+            self._transport.sendto(line.encode())
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+@register("telemeter", "io.l5d.tracelog")
+@dataclass
+class TracelogConfig:
+    sampleRate: float = 1.0
+    level: str = "INFO"
+
+    def mk(self, metrics: MetricsTree) -> Telemeter:
+        return TracelogTelemeter(self)
+
+
+class TracelogTelemeter(Telemeter):
+    """Logs sampled spans (ref: TracelogInitializer.scala:47)."""
+
+    def __init__(self, cfg: TracelogConfig):
+        self.cfg = cfg
+        self._log = logging.getLogger("linkerd_tpu.tracelog")
+        self._level = getattr(logging, cfg.level.upper(), logging.INFO)
+        self._tracer = _FnTracer(self._record)
+        import random
+        self._rng = random.Random()
+
+    def _record(self, span: dict) -> None:
+        if self._rng.random() < self.cfg.sampleRate:
+            self._log.log(self._level, "trace %s span %s %s %sus %s",
+                          span.get("traceId"), span.get("id"),
+                          span.get("name"), span.get("duration"),
+                          span.get("tags"))
+
+    @property
+    def tracer(self) -> Tracer:
+        return self._tracer
+
+
+class _FnTracer(Tracer):
+    def __init__(self, fn):
+        self._fn = fn
+
+    def record(self, span: dict) -> None:
+        self._fn(span)
+
+
+@register("telemeter", "io.l5d.recentRequests")
+@dataclass
+class RecentRequestsConfig:
+    sampleRate: float = 1.0
+    capacity: int = 100
+
+    def mk(self, metrics: MetricsTree) -> Telemeter:
+        return RecentRequestsTelemeter(self)
+
+
+class RecentRequestsTelemeter(Telemeter):
+    """In-memory ring of sampled spans + /requests admin table
+    (ref: RecentRequetsTracer.scala:14)."""
+
+    def __init__(self, cfg: RecentRequestsConfig):
+        self.cfg = cfg
+        self.ring: Deque[dict] = collections.deque(maxlen=cfg.capacity)
+        import random
+        self._rng = random.Random()
+        self._tracer = _FnTracer(self._record)
+
+    def _record(self, span: dict) -> None:
+        if span.get("kind") == "SERVER" and (
+                self._rng.random() < self.cfg.sampleRate):
+            self.ring.append(span)
+
+    @property
+    def tracer(self) -> Tracer:
+        return self._tracer
+
+    def admin_handlers(self):
+        from linkerd_tpu.admin.server import json_response
+
+        async def requests(req: Request) -> Response:
+            return json_response(list(self.ring))
+
+        return [("/requests.json", requests)]
+
+
+@register("telemeter", "io.l5d.zipkin")
+@dataclass
+class ZipkinConfig:
+    host: str = "127.0.0.1"
+    port: int = 9411
+    sampleRate: float = 0.001
+    batchIntervalMs: int = 1000
+
+    def mk(self, metrics: MetricsTree) -> Telemeter:
+        return ZipkinTelemeter(self)
+
+
+class ZipkinTelemeter(Telemeter):
+    """Zipkin v2 JSON span sink over HTTP POST /api/v2/spans.
+
+    The reference ships scribe-thrift (ZipkinInitializer.scala:27-60, a
+    2017-era protocol); the v2 HTTP API is the modern equivalent of the
+    same component. Spans batch on an interval; send failures drop the
+    batch (telemetry must never block the data plane).
+    """
+
+    def __init__(self, cfg: ZipkinConfig):
+        self.cfg = cfg
+        self._buf: List[dict] = []
+        self._tracer = _FnTracer(self._buf.append)
+        self._stop = asyncio.Event()
+
+    @property
+    def tracer(self) -> Tracer:
+        return self._tracer
+
+    @property
+    def sample_rate(self) -> float:
+        return self.cfg.sampleRate
+
+    async def run(self) -> None:
+        from linkerd_tpu.protocol.http.client import HttpClient
+
+        client = HttpClient(self.cfg.host, self.cfg.port, max_connections=2)
+        try:
+            while not self._stop.is_set():
+                await asyncio.sleep(self.cfg.batchIntervalMs / 1e3)
+                await self.flush(client)
+        except asyncio.CancelledError:
+            pass
+        finally:
+            await client.close()
+
+    async def flush(self, client) -> None:
+        if not self._buf:
+            return
+        batch, self._buf = self._buf, []
+        req = Request(method="POST", uri="/api/v2/spans",
+                      body=json.dumps(batch).encode())
+        req.headers.set("Content-Type", "application/json")
+        req.headers.set("Host", f"{self.cfg.host}:{self.cfg.port}")
+        try:
+            rsp = await client(req)
+            if rsp.status >= 300:
+                log.warning("zipkin rejected spans: %s", rsp.status)
+        except Exception as e:  # noqa: BLE001 — drop batch, keep serving
+            log.debug("zipkin send failed: %r", e)
+
+    def close(self) -> None:
+        self._stop.set()

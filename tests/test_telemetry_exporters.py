@@ -1,0 +1,189 @@
+"""Exporter + tracing tests: prometheus text, influxdb lines, statsd
+push, tracelog/recentRequests/zipkin tracers, trace propagation e2e."""
+
+import asyncio
+import json
+
+import pytest
+
+from linkerd_tpu.linker import load_linker
+from linkerd_tpu.protocol.http import Request, Response
+from linkerd_tpu.protocol.http.client import HttpClient
+from linkerd_tpu.protocol.http.server import serve
+from linkerd_tpu.router.service import FnService
+from linkerd_tpu.router.tracing import CTX_TRACE, TraceId
+from linkerd_tpu.telemetry.exporters import (
+    influxdb_line, prometheus_text, RecentRequestsConfig, StatsDConfig,
+    ZipkinConfig,
+)
+from linkerd_tpu.telemetry.metrics import MetricsTree
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+def sample_metrics() -> MetricsTree:
+    mt = MetricsTree()
+    mt.counter("rt", "out", "server", "requests").incr(10)
+    mt.counter("rt", "out", "service", "svc.web", "success").incr(9)
+    mt.counter("rt", "out", "client", "fs.web", "failures").incr(1)
+    s = mt.stat("rt", "out", "server", "request_latency_ms")
+    for v in (1.0, 2.0, 3.0):
+        s.add(v)
+    return mt
+
+
+class TestPrometheus:
+    def test_label_rewriting(self):
+        text = prometheus_text(sample_metrics())
+        assert 'requests{rt="out"} 10' in text
+        assert 'success{rt="out",service="svc.web"} 9' in text
+        assert 'failures{client="fs.web",rt="out"} 1' in text
+        assert 'request_latency_ms{quantile="0.5",rt="out"}' in text
+        assert 'request_latency_ms_count{rt="out"} 3' in text
+
+    def test_sanitization(self):
+        mt = MetricsTree()
+        mt.counter("weird-name", "a b").incr()
+        text = prometheus_text(mt)
+        assert "weird_name_a_b 1" in text
+
+
+class TestInfluxDb:
+    def test_line_protocol(self):
+        text = influxdb_line(sample_metrics(), host="h1")
+        assert any(line.startswith("rt,host=h1,rt=out ")
+                   for line in text.splitlines())
+        assert "requests=10.0" in text
+
+
+class TestTraceId:
+    def test_roundtrip(self):
+        t = TraceId.mk_root()
+        assert TraceId.decode(t.encode()) == t
+
+    def test_child_links(self):
+        t = TraceId.mk_root()
+        c = t.child()
+        assert c.trace_id == t.trace_id
+        assert c.parent_id == t.span_id
+        assert c.span_id != t.span_id
+
+    def test_decode_garbage(self):
+        assert TraceId.decode("nope") is None
+        assert TraceId.decode("zz-yy-xx-ww") is None
+
+
+class TestTracingEndToEnd:
+    def test_spans_recorded_and_propagated(self, tmp_path):
+        disco = tmp_path / "disco"
+        disco.mkdir()
+        seen_headers = []
+
+        async def backend(req: Request) -> Response:
+            seen_headers.append(req.headers.get(CTX_TRACE))
+            return Response(200, body=b"ok")
+
+        async def go():
+            d = await serve(FnService(backend))
+            (disco / "web").write_text(f"127.0.0.1 {d.bound_port}\n")
+            cfg = f"""
+routers:
+- protocol: http
+  label: tr
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers: [{{port: 0}}]
+telemetry:
+- kind: io.l5d.recentRequests
+  capacity: 10
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+            linker = load_linker(cfg)
+            await linker.start()
+            proxy = HttpClient("127.0.0.1", linker.routers[0].server_ports[0])
+            try:
+                # caller supplies a trace context
+                root = TraceId.mk_root()
+                req = Request(uri="/")
+                req.headers.set("Host", "web")
+                req.headers.set(CTX_TRACE, root.encode())
+                await proxy(req)
+
+                # downstream received a child of the caller's trace
+                assert seen_headers[0] is not None
+                ds = TraceId.decode(seen_headers[0])
+                assert ds.trace_id == root.trace_id
+                assert ds.parent_id != root.span_id  # server child's child
+
+                # recentRequests captured the server span
+                tele = linker.telemeters[0]
+                assert len(tele.ring) == 1
+                span = tele.ring[0]
+                assert span["tags"]["dst.path"] == "/svc/web"
+                assert span["traceId"] == f"{root.trace_id:032x}"
+
+                # admin handler serves it
+                handlers = dict(tele.admin_handlers())
+                rsp = await handlers["/requests.json"](Request())
+                assert json.loads(rsp.body)[0]["kind"] == "SERVER"
+            finally:
+                await proxy.close()
+                await linker.close()
+                await d.close()
+
+        run(go())
+
+
+class TestStatsD:
+    def test_flush_sends_udp(self):
+        async def go():
+            received = []
+
+            class Proto(asyncio.DatagramProtocol):
+                def datagram_received(self, data, addr):
+                    received.append(data.decode())
+
+            loop = asyncio.get_running_loop()
+            transport, _ = await loop.create_datagram_endpoint(
+                Proto, local_addr=("127.0.0.1", 0))
+            port = transport.get_extra_info("sockname")[1]
+
+            mt = sample_metrics()
+            cfg = StatsDConfig(port=port, gaugeIntervalMs=50)
+            tele = cfg.mk(mt)
+            task = asyncio.create_task(tele.run())
+            await asyncio.sleep(0.2)
+            tele.close()
+            task.cancel()
+            transport.close()
+            assert any("linkerd.rt.out.server.requests:10|c" in r
+                       for r in received)
+
+        run(go())
+
+
+class TestZipkin:
+    def test_flush_posts_spans(self):
+        async def go():
+            posted = []
+
+            async def collector(req: Request) -> Response:
+                posted.append(json.loads(req.body))
+                return Response(status=202)
+
+            srv = await serve(FnService(collector))
+            cfg = ZipkinConfig(port=srv.bound_port, batchIntervalMs=50)
+            tele = cfg.mk(MetricsTree())
+            tele.tracer.record({"traceId": "ab", "id": "cd", "kind": "SERVER"})
+            from linkerd_tpu.protocol.http.client import HttpClient as HC
+            client = HC("127.0.0.1", srv.bound_port)
+            await tele.flush(client)
+            assert posted and posted[0][0]["traceId"] == "ab"
+            await client.close()
+            await srv.close()
+
+        run(go())
