@@ -20,6 +20,8 @@ from linkerd_tpu.config import (
 from linkerd_tpu.config.parser import instantiate_as
 from linkerd_tpu.core import Activity, Dtab, Path
 from linkerd_tpu.core.addr import Address, BoundName
+from linkerd_tpu.core.pathmatcher import PathMatcher
+from linkerd_tpu.protocol.tls import TlsClientConfig, TlsServerConfig
 from linkerd_tpu.namer import ConfiguredDtabNamer, Namer
 from linkerd_tpu.protocol.http.client import HttpClient
 from linkerd_tpu.protocol.http.identifiers import compose_identifiers
@@ -79,6 +81,7 @@ class ServerSpec:
     port: int = 0
     ip: str = "127.0.0.1"
     maxConcurrentRequests: Optional[int] = None
+    tls: Optional[TlsServerConfig] = None
 
 
 @dataclass
@@ -92,6 +95,7 @@ class ClientSpec:
     hostConnectionPool: int = 64
     connectTimeoutMs: int = 3000
     failureAccrual: Optional[Dict[str, Any]] = None  # kind-discriminated
+    tls: Optional[TlsClientConfig] = None
 
 
 @dataclass
@@ -134,8 +138,12 @@ class RouterSpec:
     dstPrefix: str = "/svc"
     identifier: Optional[Any] = None      # kind-discriminated mapping(s)
     servers: Optional[List[ServerSpec]] = None
-    client: Optional[ClientSpec] = None
-    service: Optional[SvcSpec] = None
+    # Plain mapping = one config for all clients/services; or
+    # {kind: io.l5d.static, configs: [{prefix: ..., <fields>}]} for
+    # per-prefix overrides (ref: Client.scala/Svc.scala StaticClient/
+    # StaticSvc; PerClientParams Router.scala:271-303).
+    client: Optional[Any] = None
+    service: Optional[Any] = None
     bindingTimeoutMs: int = 10000
     bindingCache: Optional[Dict[str, Any]] = None
     sampleRate: float = 1.0               # trace sampling for new roots
@@ -154,6 +162,65 @@ class LinkerSpec:
     namers: Optional[List[Any]] = None     # kind-discriminated mappings
     telemetry: Optional[List[Any]] = None  # kind-discriminated mappings
     admin: Optional[AdminSpec] = None
+
+
+def per_prefix_lookup(raw: Any, cls: type, where: str,
+                      validate: Optional[Callable[[Any], None]] = None,
+                      ) -> Callable[[Path], Tuple[Any, Dict[str, str]]]:
+    """Resolve a client/svc config block into ``path -> (spec, vars)``.
+
+    ``raw`` is either a plain mapping (one spec for every path), or the
+    static form ``{kind: io.l5d.static, configs: [{prefix, <fields>}...]}``
+    where every matching prefix's fields are merged in order (later configs
+    override) and the PathMatcher's captured variables are returned for
+    substitution (e.g. into a TLS commonName). Ref: Client.scala/Svc.scala,
+    Router.scala:271-303 (PerClientParams).
+    """
+    if raw is None:
+        default = cls()
+        return lambda _p: (default, {})
+    if not isinstance(raw, dict):
+        raise ConfigError(f"{where}: expected a mapping")
+    if raw.get("kind") == "io.l5d.static":
+        unknown = set(raw) - {"kind", "configs"}
+        if unknown:
+            raise ConfigError(
+                f"{where}: unknown fields {sorted(unknown)} "
+                f"(io.l5d.static takes only 'configs')")
+        configs = raw.get("configs")
+        if not isinstance(configs, list):
+            raise ConfigError(f"{where}.configs: expected a list")
+        entries: List[Tuple[PathMatcher, Dict[str, Any]]] = []
+        for i, c in enumerate(configs):
+            if not isinstance(c, dict):
+                raise ConfigError(f"{where}.configs[{i}]: expected a mapping")
+            c = dict(c)
+            prefix = c.pop("prefix", None)
+            if prefix is None:
+                raise ConfigError(f"{where}.configs[{i}]: missing 'prefix'")
+            # Validate the entry's own fields (and nested kinds) at load
+            # time so typos fail startup, not the first matching request
+            # (ref: Parser strictness, Parser.scala:84).
+            entry_spec = instantiate_as(cls, c, f"{where}.configs[{i}]")
+            if validate is not None:
+                validate(entry_spec)
+            entries.append((PathMatcher(str(prefix)), c))
+
+        def lookup(path: Path) -> Tuple[Any, Dict[str, str]]:
+            merged: Dict[str, Any] = {}
+            vars_: Dict[str, str] = {}
+            for matcher, fields in entries:
+                captured = matcher.extract(path)
+                if captured is not None:
+                    merged.update(fields)
+                    vars_.update(captured)
+            return instantiate_as(cls, merged, where), vars_
+
+        return lookup
+    spec = instantiate_as(cls, raw, where)
+    if validate is not None:
+        validate(spec)
+    return lambda _p: (spec, {})
 
 
 def parse_linker_spec(text: str) -> LinkerSpec:
@@ -253,29 +320,53 @@ class Linker:
 
         interpreter = ConfiguredDtabNamer(self.namers)
 
-        cspec = rspec.client or ClientSpec()
-        bal_kind = (cspec.loadBalancer or BalancerSpec()).kind
+        def validate_client(spec: ClientSpec) -> None:
+            if spec.failureAccrual is not None:
+                instantiate("failureAccrual", spec.failureAccrual,
+                            f"{label}.failureAccrual")
+            if spec.loadBalancer is not None:
+                mk_balancer(spec.loadBalancer.kind, None, None, dry_run=True)
+
+        def validate_svc(spec: SvcSpec) -> None:
+            if spec.responseClassifier is not None:
+                instantiate("classifier", spec.responseClassifier,
+                            f"{label}.responseClassifier")
+
+        client_lookup = per_prefix_lookup(
+            rspec.client, ClientSpec, f"{label}.client", validate_client)
         metrics = self.metrics
 
-        fa_cfg = cspec.failureAccrual or {"kind": "io.l5d.consecutiveFailures"}
-        fa_config = instantiate("failureAccrual", fa_cfg, f"{label}.failureAccrual")
-        if getattr(fa_config, "needs_board", False):
-            board = self._anomaly_board()
-            mk_policy = lambda: fa_config.mk(board)  # noqa: E731
-        else:
-            mk_policy = fa_config.mk
-
-        def endpoint_factory(addr: Address) -> Service:
-            client: Service = HttpClient(
-                addr.host, addr.port,
-                max_connections=cspec.hostConnectionPool,
-                connect_timeout=cspec.connectTimeoutMs / 1e3)
-            # per-endpoint accrual (ref: FailureAccrualFactory sits below
-            # the balancer in the client stack, Router.scala:318)
-            return FailureAccrualService(client, mk_policy())
+        def mk_policy_factory(cspec: ClientSpec):
+            fa_cfg = cspec.failureAccrual or {
+                "kind": "io.l5d.consecutiveFailures"}
+            fa_config = instantiate(
+                "failureAccrual", fa_cfg, f"{label}.failureAccrual")
+            if getattr(fa_config, "needs_board", False):
+                board = self._anomaly_board()
+                return lambda: fa_config.mk(board)
+            return fa_config.mk
 
         def client_factory(bound: BoundName) -> Service:
             cid = bound.id_.show.lstrip("/").replace("/", ".") or "client"
+            cspec, cvars = client_lookup(bound.id_)
+            mk_policy = mk_policy_factory(cspec)
+
+            ssl_ctx = sni = None
+            if cspec.tls is not None:
+                sni = cspec.tls.server_hostname(cvars)
+                ssl_ctx = cspec.tls.mk_context(sni)
+
+            def endpoint_factory(addr: Address) -> Service:
+                client: Service = HttpClient(
+                    addr.host, addr.port,
+                    max_connections=cspec.hostConnectionPool,
+                    connect_timeout=cspec.connectTimeoutMs / 1e3,
+                    ssl_context=ssl_ctx, server_hostname=sni)
+                # per-endpoint accrual (ref: FailureAccrualFactory sits below
+                # the balancer in the client stack, Router.scala:318)
+                return FailureAccrualService(client, mk_policy())
+
+            bal_kind = (cspec.loadBalancer or BalancerSpec()).kind
             bal = mk_balancer(bal_kind, bound.addr, endpoint_factory)
             filters: List[Any] = [StatsFilter(metrics, "rt", label, "client", cid)]
             if not isinstance(self.tracer, NullTracer):
@@ -289,17 +380,10 @@ class Linker:
                 filters_to_service(filters, bal), metrics,
                 ("rt", label, "client", cid))
 
-        sspec = rspec.service or SvcSpec()
-        classifier_cfg = sspec.responseClassifier or {
-            "kind": "io.l5d.http.nonRetryable5XX"}
-        classifier = instantiate(
-            "classifier", classifier_cfg, f"{label}.responseClassifier").mk()
-        budget_spec = (sspec.retries.budget if sspec.retries else None) or BudgetSpec()
-        shared_budget = RetryBudget(
-            budget_spec.ttlSecs, budget_spec.minRetriesPerSec,
-            budget_spec.percentCanRetry)
+        svc_lookup = per_prefix_lookup(
+            rspec.service, SvcSpec, f"{label}.service", validate_svc)
 
-        def mk_backoffs() -> List[float]:
+        def mk_backoffs(sspec: SvcSpec) -> List[float]:
             bspec = (sspec.retries.backoff if sspec.retries else None)
             max_retries = sspec.retries.maxRetries if sspec.retries else 25
             if bspec is None:
@@ -313,14 +397,27 @@ class Linker:
 
         def path_filters(dst: DstPath, svc: Service) -> Service:
             # path stack order (ref: Router.scala:321-362): stats ->
-            # total timeout -> budget/classified retries -> dispatch
+            # total timeout -> budget/classified retries -> dispatch.
+            # The budget is per path-stack instance, matching the
+            # reference's per-materialized-stack RetryBudgetModule.
+            sspec, _ = svc_lookup(dst.path)
+            classifier_cfg = sspec.responseClassifier or {
+                "kind": "io.l5d.http.nonRetryable5XX"}
+            classifier = instantiate(
+                "classifier", classifier_cfg,
+                f"{label}.responseClassifier").mk()
+            budget_spec = (
+                sspec.retries.budget if sspec.retries else None) or BudgetSpec()
+            budget = RetryBudget(
+                budget_spec.ttlSecs, budget_spec.minRetriesPerSec,
+                budget_spec.percentCanRetry)
             name = dst.path.show.lstrip("/").replace("/", ".") or "root"
             filters: List[Any] = [
                 StatsFilter(metrics, "rt", label, "service", name)]
             if sspec.totalTimeoutMs is not None:
                 filters.append(TotalTimeout(sspec.totalTimeoutMs / 1e3))
             filters.append(ClassifiedRetries(
-                classifier, shared_budget, mk_backoffs(),
+                classifier, budget, mk_backoffs(sspec),
                 max_retries=(sspec.retries.maxRetries if sspec.retries else 25),
                 metrics=metrics, scope=("rt", label, "service", name)))
             return filters_to_service(filters, svc)
@@ -354,7 +451,8 @@ class Linker:
 
         servers = [
             HttpServer(server_stack, s.ip, s.port,
-                       max_concurrency=s.maxConcurrentRequests)
+                       max_concurrency=s.maxConcurrentRequests,
+                       ssl_context=(s.tls.mk_context() if s.tls else None))
             for s in (rspec.servers or [ServerSpec()])
         ]
         return Router(rspec, label, server_stack, binding, servers)

@@ -44,13 +44,20 @@ class HttpClient(Service[Request, Response]):
                  max_connections: int = 64,
                  idle_ttl: float = 60.0,
                  connect_timeout: float = 3.0,
-                 max_body: int = codec.MAX_BODY):
+                 max_body: int = codec.MAX_BODY,
+                 ssl_context=None,
+                 server_hostname: Optional[str] = None):
         self.host = host
         self.port = port
         self.max_connections = max_connections
         self.idle_ttl = idle_ttl
         self.connect_timeout = connect_timeout
         self.max_body = max_body
+        # TLS origination (ref: TlsClientConfig.scala; per-client tls in
+        # ClientConfig.scala). server_hostname carries the (possibly
+        # PathMatcher-substituted) commonName for SNI + verification.
+        self.ssl_context = ssl_context
+        self.server_hostname = server_hostname
         self._idle: List[_Conn] = []
         self._n_open = 0
         self._waiters: asyncio.Queue = asyncio.Queue()
@@ -75,8 +82,13 @@ class HttpClient(Service[Request, Response]):
             return conn
         await self._sem.acquire()
         try:
+            kw = {}
+            if self.ssl_context is not None:
+                kw["ssl"] = self.ssl_context
+                if self.server_hostname is not None:
+                    kw["server_hostname"] = self.server_hostname
             reader, writer = await asyncio.wait_for(
-                asyncio.open_connection(self.host, self.port),
+                asyncio.open_connection(self.host, self.port, **kw),
                 self.connect_timeout)
         except Exception:
             self._sem.release()

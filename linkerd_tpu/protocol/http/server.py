@@ -23,11 +23,14 @@ class HttpServer:
     def __init__(self, service: Service[Request, Response],
                  host: str = "127.0.0.1", port: int = 0,
                  max_body: int = codec.MAX_BODY,
-                 max_concurrency: Optional[int] = None):
+                 max_concurrency: Optional[int] = None,
+                 ssl_context=None):
         self.service = service
         self.host = host
         self.port = port
         self.max_body = max_body
+        # TLS termination (ref: TlsServerConfig.scala via ServerConfig tls)
+        self.ssl_context = ssl_context
         self._server: Optional[asyncio.base_events.Server] = None
         self._sem = (asyncio.Semaphore(max_concurrency)
                      if max_concurrency else None)
@@ -40,7 +43,7 @@ class HttpServer:
 
     async def start(self) -> "HttpServer":
         self._server = await asyncio.start_server(
-            self._handle_conn, self.host, self.port)
+            self._handle_conn, self.host, self.port, ssl=self.ssl_context)
         return self
 
     async def close(self) -> None:

@@ -1,0 +1,301 @@
+"""PathMatcher, per-prefix static client/svc config, and TLS both sides.
+
+Reference parity: finagle/buoyant PathMatcher.scala unit behavior;
+linkerd/core Client.scala/Svc.scala static per-prefix configs; the TLS
+integration tests (linkerd/protocol/http/src/integration/.../TlsUtils.scala
+shells out for certs; TlsTerminationTest / TlsStaticValidationTest).
+"""
+
+import asyncio
+import ssl
+import subprocess
+
+import pytest
+
+from linkerd_tpu.core.path import Path
+from linkerd_tpu.core.pathmatcher import PathMatcher
+from linkerd_tpu.linker import ClientSpec, SvcSpec, load_linker, per_prefix_lookup
+from linkerd_tpu.protocol.http import Request, Response
+from linkerd_tpu.protocol.http.client import HttpClient
+from linkerd_tpu.protocol.http.server import HttpServer, serve
+from linkerd_tpu.protocol.tls import TlsClientConfig, TlsServerConfig
+from linkerd_tpu.router.service import FnService
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+class TestPathMatcher:
+    def test_literal_prefix(self):
+        m = PathMatcher("/svc/web")
+        assert m.matches(Path.read("/svc/web"))
+        assert m.matches(Path.read("/svc/web/extra"))
+        assert not m.matches(Path.read("/svc/db"))
+        assert not m.matches(Path.read("/svc"))
+
+    def test_capture_and_wildcard(self):
+        m = PathMatcher("/#/io.l5d.fs/{service}")
+        assert m.extract(Path.read("/#/io.l5d.fs/web")) == {"service": "web"}
+        assert m.extract(Path.read("/#/other/web")) is None
+        w = PathMatcher("/svc/*/admin")
+        assert w.matches(Path.read("/svc/anything/admin"))
+        assert not w.matches(Path.read("/svc/anything/user"))
+
+    def test_substitute(self):
+        m = PathMatcher("/#/io.l5d.fs/{service}")
+        assert (m.substitute(Path.read("/#/io.l5d.fs/web"),
+                             "{service}.example.com")
+                == "web.example.com")
+        assert m.substitute(Path.read("/nope"), "{service}.x") is None
+        # unresolved var -> None
+        assert m.substitute(Path.read("/#/io.l5d.fs/web"), "{other}.x") is None
+
+
+class TestPerPrefixLookup:
+    def test_plain_mapping_applies_everywhere(self):
+        lookup = per_prefix_lookup({"hostConnectionPool": 7}, ClientSpec, "t")
+        spec, vars_ = lookup(Path.read("/anything"))
+        assert spec.hostConnectionPool == 7
+        assert vars_ == {}
+
+    def test_static_merges_in_order(self):
+        raw = {
+            "kind": "io.l5d.static",
+            "configs": [
+                {"prefix": "/#/io.l5d.fs", "hostConnectionPool": 4},
+                {"prefix": "/#/io.l5d.fs/{service}", "connectTimeoutMs": 99},
+            ],
+        }
+        lookup = per_prefix_lookup(raw, ClientSpec, "t")
+        spec, vars_ = lookup(Path.read("/#/io.l5d.fs/web"))
+        assert spec.hostConnectionPool == 4       # first match
+        assert spec.connectTimeoutMs == 99        # second overlays
+        assert vars_ == {"service": "web"}
+        spec2, _ = lookup(Path.read("/#/io.l5d.fs"))
+        assert spec2.hostConnectionPool == 4
+        assert spec2.connectTimeoutMs == 3000     # default, no second match
+        spec3, _ = lookup(Path.read("/#/elsewhere"))
+        assert spec3.hostConnectionPool == 64     # defaults only
+
+    def test_per_path_service_policy(self):
+        raw = {
+            "kind": "io.l5d.static",
+            "configs": [{"prefix": "/svc/slow", "totalTimeoutMs": 1234}],
+        }
+        lookup = per_prefix_lookup(raw, SvcSpec, "t")
+        assert lookup(Path.read("/svc/slow"))[0].totalTimeoutMs == 1234
+        assert lookup(Path.read("/svc/fast"))[0].totalTimeoutMs is None
+
+
+class TestLoadTimeValidation:
+    def test_bad_classifier_kind_fails_startup(self, tmp_path):
+        cfg = """
+routers:
+- protocol: http
+  dtab: "/svc => /$/inet/127.0.0.1/1 ;"
+  service:
+    responseClassifier: {kind: io.l5d.typo}
+"""
+        from linkerd_tpu.config import ConfigError
+        with pytest.raises(ConfigError):
+            load_linker(cfg)
+
+    def test_static_entry_field_typo_fails_startup(self):
+        cfg = """
+routers:
+- protocol: http
+  dtab: "/svc => /$/inet/127.0.0.1/1 ;"
+  client:
+    kind: io.l5d.static
+    configs:
+    - prefix: /#/never-matched
+      connectTimeoutMS: 5
+"""
+        from linkerd_tpu.config import ConfigError
+        with pytest.raises(ConfigError):
+            load_linker(cfg)
+
+    def test_static_unknown_toplevel_key_fails(self):
+        cfg = """
+routers:
+- protocol: http
+  dtab: "/svc => /$/inet/127.0.0.1/1 ;"
+  client:
+    kind: io.l5d.static
+    tls: {commonName: x}
+    configs: []
+"""
+        from linkerd_tpu.config import ConfigError
+        with pytest.raises(ConfigError):
+            load_linker(cfg)
+
+    def test_unresolved_common_name_var_raises(self):
+        from linkerd_tpu.config import ConfigError
+        tls = TlsClientConfig(commonName="{service}.example.com")
+        with pytest.raises(ConfigError):
+            tls.server_hostname({})
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    """Self-signed cert for CN=web (SAN web, localhost) like TlsUtils."""
+    d = tmp_path_factory.mktemp("certs")
+    cert, key = d / "cert.pem", d / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "2",
+         "-subj", "/CN=web",
+         "-addext", "subjectAltName=DNS:web,DNS:localhost,IP:127.0.0.1"],
+        check=True, capture_output=True)
+    return str(cert), str(key)
+
+
+def tls_downstream(name: str, certs):
+    cert, key = certs
+
+    async def handler(req: Request) -> Response:
+        return Response(status=200, body=name.encode())
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert, key)
+    return HttpServer(FnService(handler), ssl_context=ctx)
+
+
+class TestTls:
+    def test_client_originates_tls_with_cn_substitution(self, certs, tmp_path):
+        """Router speaks TLS to the downstream, verifying against the CA
+        with a commonName substituted from the client prefix capture."""
+        cert, _key = certs
+        disco = tmp_path / "disco"
+        disco.mkdir()
+
+        cfg = f"""
+routers:
+- protocol: http
+  label: tlsout
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers:
+  - port: 0
+  client:
+    kind: io.l5d.static
+    configs:
+    - prefix: "/#/io.l5d.fs/{{service}}"
+      tls:
+        commonName: "{{service}}"
+        trustCerts: ["{cert}"]
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+
+        async def go():
+            down = tls_downstream("secure-web", certs)
+            await down.start()
+            (disco / "web").write_text(f"127.0.0.1 {down.bound_port}\n")
+            linker = load_linker(cfg)
+            await linker.start()
+            proxy = HttpClient("127.0.0.1", linker.routers[0].server_ports[0])
+            try:
+                req = Request(uri="/")
+                req.headers.set("Host", "web")
+                r = await proxy(req)
+                assert (r.status, r.body) == (200, b"secure-web")
+            finally:
+                await proxy.close()
+                await linker.close()
+                await down.close()
+
+        run(go())
+
+    def test_server_terminates_tls(self, certs, tmp_path):
+        cert, key = certs
+        disco = tmp_path / "disco"
+        disco.mkdir()
+
+        cfg = f"""
+routers:
+- protocol: http
+  label: tlsin
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers:
+  - port: 0
+    tls:
+      certPath: "{cert}"
+      keyPath: "{key}"
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+
+        async def go():
+            down = await serve(FnService(
+                lambda req: _ok(b"plain-web")))
+            (disco / "web").write_text(f"127.0.0.1 {down.bound_port}\n")
+            linker = load_linker(cfg)
+            await linker.start()
+            cctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            cctx.load_verify_locations(cafile=cert)
+            proxy = HttpClient(
+                "127.0.0.1", linker.routers[0].server_ports[0],
+                ssl_context=cctx, server_hostname="web")
+            try:
+                req = Request(uri="/")
+                req.headers.set("Host", "web")
+                r = await proxy(req)
+                assert (r.status, r.body) == (200, b"plain-web")
+            finally:
+                await proxy.close()
+                await linker.close()
+                await down.close()
+
+        run(go())
+
+    def test_static_validation_failure(self, certs, tmp_path):
+        """Wrong commonName -> handshake fails -> 502 from the router
+        (ref: TlsStaticValidationTest)."""
+        cert, _key = certs
+        disco = tmp_path / "disco"
+        disco.mkdir()
+
+        cfg = f"""
+routers:
+- protocol: http
+  label: tlsbad
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers:
+  - port: 0
+  client:
+    tls:
+      commonName: "not-the-right-name"
+      trustCerts: ["{cert}"]
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+
+        async def go():
+            down = tls_downstream("x", certs)
+            await down.start()
+            (disco / "web").write_text(f"127.0.0.1 {down.bound_port}\n")
+            linker = load_linker(cfg)
+            await linker.start()
+            proxy = HttpClient("127.0.0.1", linker.routers[0].server_ports[0])
+            try:
+                req = Request(uri="/")
+                req.headers.set("Host", "web")
+                r = await proxy(req)
+                assert r.status >= 500
+            finally:
+                await proxy.close()
+                await linker.close()
+                await down.close()
+
+        run(go())
+
+
+async def _ok(body: bytes) -> Response:
+    return Response(status=200, body=body)
