@@ -48,6 +48,16 @@ class PathMatcher:
     def matches(self, path: Path) -> bool:
         return self.extract(path) is not None
 
+    @property
+    def var_names(self) -> frozenset:
+        """Names this pattern captures (for load-time template checks)."""
+        out = set()
+        for seg in self._segments:
+            m = _VAR_RE.fullmatch(seg)
+            if m is not None:
+                out.add(m.group(1))
+        return frozenset(out)
+
     def substitute(self, path: Path, template: str) -> Optional[str]:
         """``template`` with ``{var}`` replaced by captures from ``path``;
         None if the path doesn't match or a referenced var wasn't captured.

@@ -201,10 +201,11 @@ def per_prefix_lookup(raw: Any, cls: type, where: str,
             # Validate the entry's own fields (and nested kinds) at load
             # time so typos fail startup, not the first matching request
             # (ref: Parser strictness, Parser.scala:84).
+            matcher = PathMatcher(str(prefix))
             entry_spec = instantiate_as(cls, c, f"{where}.configs[{i}]")
             if validate is not None:
-                validate(entry_spec)
-            entries.append((PathMatcher(str(prefix)), c))
+                validate(entry_spec, matcher.var_names)
+            entries.append((matcher, c))
 
         def lookup(path: Path) -> Tuple[Any, Dict[str, str]]:
             merged: Dict[str, Any] = {}
@@ -219,7 +220,7 @@ def per_prefix_lookup(raw: Any, cls: type, where: str,
         return lookup
     spec = instantiate_as(cls, raw, where)
     if validate is not None:
-        validate(spec)
+        validate(spec, frozenset())
     return lambda _p: (spec, {})
 
 
@@ -320,14 +321,21 @@ class Linker:
 
         interpreter = ConfiguredDtabNamer(self.namers)
 
-        def validate_client(spec: ClientSpec) -> None:
+        def validate_client(spec: ClientSpec, var_names=frozenset()) -> None:
             if spec.failureAccrual is not None:
                 instantiate("failureAccrual", spec.failureAccrual,
                             f"{label}.failureAccrual")
             if spec.loadBalancer is not None:
-                mk_balancer(spec.loadBalancer.kind, None, None, dry_run=True)
+                from linkerd_tpu.router.balancer import BALANCER_KINDS
+                if spec.loadBalancer.kind not in BALANCER_KINDS:
+                    raise ConfigError(
+                        f"{label}.client: unknown balancer kind "
+                        f"{spec.loadBalancer.kind!r} "
+                        f"(known: {sorted(BALANCER_KINDS)})")
+            if spec.tls is not None:
+                spec.tls.validate(var_names)
 
-        def validate_svc(spec: SvcSpec) -> None:
+        def validate_svc(spec: SvcSpec, var_names=frozenset()) -> None:
             if spec.responseClassifier is not None:
                 instantiate("classifier", spec.responseClassifier,
                             f"{label}.responseClassifier")

@@ -1,0 +1,86 @@
+"""HTTP/2 client: one multiplexed connection per endpoint.
+
+Reference parity: finagle/h2/.../H2.scala:29 — the client uses a
+SingletonPool: all streams to an endpoint multiplex over a single h2
+connection, re-established on failure.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from linkerd_tpu.protocol.h2.connection import H2Connection
+from linkerd_tpu.protocol.h2.messages import H2Request, H2Response
+from linkerd_tpu.router.service import Service, Status
+
+
+class H2Client(Service[H2Request, H2Response]):
+    """A singleton-connection h2 client for one host:port endpoint."""
+
+    def __init__(self, host: str, port: int,
+                 connect_timeout: float = 3.0,
+                 ssl_context=None, server_hostname: Optional[str] = None):
+        self.host = host
+        self.port = port
+        self.connect_timeout = connect_timeout
+        if ssl_context is not None:
+            ssl_context.set_alpn_protocols(["h2"])
+        self.ssl_context = ssl_context
+        self.server_hostname = server_hostname
+        self._conn: Optional[H2Connection] = None
+        self._connecting: Optional[asyncio.Future] = None
+        self._closed = False
+        self.pending = 0  # live balancer instrumentation
+
+    @property
+    def status(self) -> Status:
+        return Status.CLOSED if self._closed else Status.OPEN
+
+    async def _get_conn(self) -> H2Connection:
+        if self._conn is not None and not self._conn.is_closed \
+                and not self._conn.goaway_received:
+            return self._conn
+        if self._connecting is not None:
+            return await asyncio.shield(self._connecting)
+        loop = asyncio.get_running_loop()
+        self._connecting = loop.create_future()
+        try:
+            kw = {}
+            if self.ssl_context is not None:
+                kw["ssl"] = self.ssl_context
+                if self.server_hostname is not None:
+                    kw["server_hostname"] = self.server_hostname
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port, **kw),
+                self.connect_timeout)
+            conn = H2Connection(reader, writer, is_client=True)
+            await conn.start()
+            self._conn = conn
+            self._connecting.set_result(conn)
+            return conn
+        except BaseException as e:
+            self._connecting.set_exception(e)
+            fut, self._connecting = self._connecting, None
+            # consume the exception if nobody else awaited it
+            fut.exception()
+            raise
+        finally:
+            if self._connecting is not None and self._connecting.done():
+                self._connecting = None
+
+    async def __call__(self, req: H2Request) -> H2Response:
+        if self._closed:
+            raise ConnectionError(f"h2 client {self.host}:{self.port} closed")
+        conn = await self._get_conn()
+        self.pending += 1
+        try:
+            return await conn.request(req)
+        finally:
+            self.pending -= 1
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._conn is not None:
+            await self._conn.close()
+            self._conn = None

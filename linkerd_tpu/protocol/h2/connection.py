@@ -1,0 +1,606 @@
+"""HTTP/2 connection engine: multiplexed streams on one asyncio transport.
+
+Reference parity: finagle/h2/.../netty4/Netty4DispatcherBase.scala,
+Netty4ClientDispatcher.scala, Netty4ServerDispatcher.scala (stream-id
+allocation, GOAWAY, ping) and Netty4StreamTransport.scala:53-70 (the RFC
+7540 §5.1 stream state machine). One engine class serves both roles; the
+client allocates odd stream ids, the server even (we never push).
+
+Flow control: the peer's send rate into us is bounded by the windows we
+advertise; credit returns when the application release()s DataFrames
+(the reference's Stream.release() semantics, Stream.scala:20). Our send
+rate is bounded by peer windows; senders block on a condition until
+WINDOW_UPDATE arrives.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable, Dict, List, Optional, Tuple
+
+from linkerd_tpu.protocol.h2 import frames, hpack
+from linkerd_tpu.protocol.h2.frames import (
+    CONNECTION_PREFACE, DEFAULT_INITIAL_WINDOW, DEFAULT_MAX_FRAME_SIZE,
+    H2ProtocolError,
+)
+from linkerd_tpu.protocol.h2.messages import H2Request, H2Response
+from linkerd_tpu.protocol.h2.stream import (
+    DataFrame, H2Stream, StreamReset, Trailers,
+)
+
+log = logging.getLogger(__name__)
+
+# We advertise a 1MB stream window (SETTINGS) and grow the connection
+# window to 4MB — long-haul streams shouldn't stall on the default 64KB
+# (ref: flow-control window params, finagle/h2/.../param.scala).
+LOCAL_INITIAL_WINDOW = 1 << 20
+LOCAL_CONN_WINDOW = 4 << 20
+MAX_HEADER_LIST = 64 * 1024
+
+
+class _StreamState:
+    __slots__ = ("id", "recv_stream", "send_window", "recv_window",
+                 "send_closed", "recv_closed", "got_headers",
+                 "response_fut", "pump_task", "reset_sent")
+
+    def __init__(self, sid: int, send_window: int, recv_window: int):
+        self.id = sid
+        self.recv_stream = H2Stream()
+        self.send_window = send_window
+        self.recv_window = recv_window
+        self.send_closed = False
+        self.recv_closed = False
+        self.got_headers = False      # first HEADERS seen (vs trailers)
+        self.response_fut: Optional[asyncio.Future] = None
+        self.pump_task: Optional[asyncio.Task] = None
+        self.reset_sent = False
+
+
+class H2Connection:
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter, is_client: bool,
+                 handler: Optional[Callable[[H2Request],
+                                            Awaitable[H2Response]]] = None,
+                 huffman: bool = False):
+        self._reader = reader
+        self._writer = writer
+        self.is_client = is_client
+        self._handler = handler
+        self._encoder = hpack.Encoder(huffman=huffman)
+        self._decoder = hpack.Decoder()
+        self._streams: Dict[int, _StreamState] = {}
+        self._next_stream_id = 1 if is_client else 2
+        self._send_window = DEFAULT_INITIAL_WINDOW
+        self._recv_window = DEFAULT_INITIAL_WINDOW
+        self._peer_initial_window = DEFAULT_INITIAL_WINDOW
+        self._peer_max_frame = DEFAULT_MAX_FRAME_SIZE
+        self._window_cond = asyncio.Condition()
+        self._read_task: Optional[asyncio.Task] = None
+        self._closed = False
+        self.goaway_received = False
+        self._last_peer_stream = 0
+        self._settings_acked = asyncio.Event()
+        self._handler_tasks: set = set()
+        # contiguous header-block assembly state
+        self._hdr_accum: Optional[Tuple[int, int, bytearray]] = None
+
+    # ── lifecycle ────────────────────────────────────────────────────────
+    async def start(self) -> "H2Connection":
+        settings = [
+            (frames.SETTINGS_INITIAL_WINDOW_SIZE, LOCAL_INITIAL_WINDOW),
+            (frames.SETTINGS_MAX_FRAME_SIZE, DEFAULT_MAX_FRAME_SIZE),
+            (frames.SETTINGS_MAX_HEADER_LIST_SIZE, MAX_HEADER_LIST),
+        ]
+        if self.is_client:
+            self._writer.write(CONNECTION_PREFACE)
+            settings.append((frames.SETTINGS_ENABLE_PUSH, 0))
+        else:
+            preface = await self._reader.readexactly(len(CONNECTION_PREFACE))
+            if preface != CONNECTION_PREFACE:
+                raise H2ProtocolError(frames.PROTOCOL_ERROR, "bad preface")
+        self._writer.write(frames.pack_settings(settings))
+        self._writer.write(frames.pack_window_update(
+            0, LOCAL_CONN_WINDOW - DEFAULT_INITIAL_WINDOW))
+        self._recv_window = LOCAL_CONN_WINDOW
+        await self._writer.drain()
+        self._read_task = asyncio.get_running_loop().create_task(
+            self._read_loop())
+        return self
+
+    @property
+    def is_closed(self) -> bool:
+        return self._closed
+
+    @property
+    def active_streams(self) -> int:
+        return len(self._streams)
+
+    async def close(self, code: int = frames.NO_ERROR) -> None:
+        first = not self._closed
+        self._closed = True
+        if first:
+            try:
+                self._writer.write(
+                    frames.pack_goaway(self._last_peer_stream, code))
+                await self._writer.drain()
+            except Exception:  # noqa: BLE001
+                pass
+        if self._read_task is not None and not self._read_task.done():
+            self._read_task.cancel()
+            try:
+                await self._read_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        self._fail_all(StreamReset(frames.CANCEL, "connection closed"))
+        for t in list(self._handler_tasks):
+            t.cancel()
+        # Always close the transport, even if the read loop already marked
+        # us closed on EOF — a still-attached transport wedges
+        # Server.wait_closed().
+        try:
+            self._writer.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _fail_all(self, err: StreamReset) -> None:
+        for st in list(self._streams.values()):
+            st.recv_stream.reset(err.error_code, str(err))
+            if st.response_fut is not None and not st.response_fut.done():
+                st.response_fut.set_exception(
+                    StreamReset(err.error_code, str(err)))
+            if st.pump_task is not None:
+                st.pump_task.cancel()
+        self._streams.clear()
+        # wake any senders blocked on flow-control so they observe closure
+        loop = asyncio.get_event_loop()
+        loop.create_task(self._notify_windows())
+
+    # ── client API ───────────────────────────────────────────────────────
+    async def request(self, req: H2Request) -> H2Response:
+        """Dispatch one request; resolves when response HEADERS arrive.
+
+        The response body streams through rsp.stream afterwards
+        (ref: Netty4ClientDispatcher request/response offer).
+        """
+        assert self.is_client
+        if self._closed or self.goaway_received:
+            raise ConnectionError("h2 connection closed/goaway")
+        sid = self._next_stream_id
+        self._next_stream_id += 2
+        st = _StreamState(sid, self._peer_initial_window,
+                          LOCAL_INITIAL_WINDOW)
+        st.response_fut = asyncio.get_running_loop().create_future()
+        self._streams[sid] = st
+
+        body = _poll_const_body(req.stream)
+        if body is not None:
+            data, trailers = body
+            if trailers is None and not data:
+                self._send_headers(sid, req.to_header_list(), end_stream=True)
+            else:
+                self._send_headers(sid, req.to_header_list(),
+                                   end_stream=False)
+                if data:
+                    await self._send_data(st, data, eos=trailers is None)
+                if trailers is not None:
+                    self._send_headers(sid, trailers, end_stream=True)
+            st.send_closed = True
+            await self._writer.drain()
+        else:
+            self._send_headers(sid, req.to_header_list(), end_stream=False)
+            await self._writer.drain()
+            st.pump_task = asyncio.get_running_loop().create_task(
+                self._pump_out(st, req.stream))
+        try:
+            rsp: H2Response = await st.response_fut
+        except BaseException:
+            if not st.reset_sent and sid in self._streams:
+                self._rst(st, frames.CANCEL)
+            raise
+        return rsp
+
+    # ── internals: sending ───────────────────────────────────────────────
+    def _send_headers(self, sid: int, header_list: List[Tuple[str, str]],
+                      end_stream: bool) -> None:
+        # encode + write must not interleave with another encode (shared
+        # HPACK dynamic table); both are synchronous here, which is the
+        # serialization (single event loop, no await between them).
+        block = self._encoder.encode(header_list)
+        flags = frames.FLAG_END_HEADERS | (
+            frames.FLAG_END_STREAM if end_stream else 0)
+        max_frag = self._peer_max_frame
+        if len(block) <= max_frag:
+            self._writer.write(frames.pack_frame(
+                frames.HEADERS, flags, sid, block))
+        else:
+            first, rest = block[:max_frag], block[max_frag:]
+            self._writer.write(frames.pack_frame(
+                frames.HEADERS,
+                flags & ~frames.FLAG_END_HEADERS, sid, first))
+            while rest:
+                frag, rest = rest[:max_frag], rest[max_frag:]
+                cflags = frames.FLAG_END_HEADERS if not rest else 0
+                self._writer.write(frames.pack_frame(
+                    frames.CONTINUATION, cflags, sid, frag))
+
+    async def _pump_out(self, st: _StreamState, stream: H2Stream) -> None:
+        """Copy an app stream into DATA/trailer frames w/ flow control."""
+        try:
+            while not stream.at_end:
+                frame = await stream.read()
+                if isinstance(frame, Trailers):
+                    self._send_headers(st.id, frame.headers, end_stream=True)
+                    st.send_closed = True
+                    await self._writer.drain()
+                    break
+                await self._send_data(st, frame.data, frame.eos)
+                frame.release()
+                if frame.eos:
+                    st.send_closed = True
+        except StreamReset as e:
+            if not st.reset_sent:
+                self._rst(st, e.error_code)
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        except Exception:  # noqa: BLE001
+            log.exception("h2 outbound pump failed (stream %d)", st.id)
+            if not st.reset_sent:
+                self._rst(st, frames.INTERNAL_ERROR)
+        finally:
+            self._maybe_gc(st)
+
+    async def _send_data(self, st: _StreamState, data: bytes,
+                         eos: bool) -> None:
+        view = memoryview(data)
+        offset = 0
+        while offset < len(data) or (eos and len(data) == 0):
+            if self._closed:
+                raise ConnectionError("connection closed")
+            n = min(len(data) - offset, self._peer_max_frame,
+                    self._send_window, st.send_window)
+            if st.reset_sent or st.id not in self._streams:
+                raise StreamReset(frames.STREAM_CLOSED, "stream reset")
+            if n <= 0 and len(data) - offset > 0:
+                async with self._window_cond:
+                    await self._window_cond.wait()
+                continue
+            chunk = bytes(view[offset:offset + n])
+            offset += n
+            last = offset >= len(data)
+            self._send_window -= n
+            st.send_window -= n
+            self._writer.write(frames.pack_frame(
+                frames.DATA,
+                frames.FLAG_END_STREAM if (eos and last) else 0,
+                st.id, chunk))
+            await self._writer.drain()
+            if last:
+                break
+
+    def _rst(self, st: _StreamState, code: int) -> None:
+        st.reset_sent = True
+        if not self._closed:
+            try:
+                self._writer.write(frames.pack_rst(st.id, code))
+            except Exception:  # noqa: BLE001
+                pass
+        st.recv_stream.reset(code)
+        self._streams.pop(st.id, None)
+
+    async def _notify_windows(self) -> None:
+        async with self._window_cond:
+            self._window_cond.notify_all()
+
+    # ── internals: receiving ─────────────────────────────────────────────
+    async def _read_loop(self) -> None:
+        try:
+            while not self._closed:
+                hdr = await self._reader.readexactly(9)
+                fh = frames.unpack_header(hdr)
+                if fh.length > DEFAULT_MAX_FRAME_SIZE + 1024:
+                    raise H2ProtocolError(frames.FRAME_SIZE_ERROR,
+                                          f"frame too large: {fh.length}")
+                payload = (await self._reader.readexactly(fh.length)
+                           if fh.length else b"")
+                # CONTINUATION contiguity (RFC 7540 §6.2)
+                if self._hdr_accum is not None and fh.type != frames.CONTINUATION:
+                    raise H2ProtocolError(frames.PROTOCOL_ERROR,
+                                          "expected CONTINUATION")
+                await self._dispatch(fh, payload)
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                BrokenPipeError, EOFError):
+            self._closed = True
+            self._fail_all(StreamReset(frames.CANCEL, "connection lost"))
+            try:
+                self._writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+        except asyncio.CancelledError:
+            raise
+        except H2ProtocolError as e:
+            log.warning("h2 protocol error: %s", e)
+            self._closed = True
+            try:
+                self._writer.write(frames.pack_goaway(
+                    self._last_peer_stream, e.code))
+                await self._writer.drain()
+                self._writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._fail_all(StreamReset(frames.PROTOCOL_ERROR, str(e)))
+        except Exception:  # noqa: BLE001
+            log.exception("h2 read loop crashed")
+            self._closed = True
+            self._fail_all(StreamReset(frames.INTERNAL_ERROR, "read loop"))
+
+    async def _dispatch(self, fh: frames.FrameHeader, payload: bytes) -> None:
+        t = fh.type
+        if t == frames.DATA:
+            await self._on_data(fh, payload)
+        elif t == frames.HEADERS:
+            payload = frames.strip_padding(fh.flags, payload)
+            if fh.flags & frames.FLAG_PRIORITY:
+                payload = payload[5:]
+            if fh.flags & frames.FLAG_END_HEADERS:
+                self._on_header_block(fh.stream_id, payload,
+                                      bool(fh.flags & frames.FLAG_END_STREAM))
+            else:
+                self._hdr_accum = (fh.stream_id,
+                                   fh.flags & frames.FLAG_END_STREAM,
+                                   bytearray(payload))
+        elif t == frames.CONTINUATION:
+            if self._hdr_accum is None or self._hdr_accum[0] != fh.stream_id:
+                raise H2ProtocolError(frames.PROTOCOL_ERROR,
+                                      "unexpected CONTINUATION")
+            sid, es_flag, buf = self._hdr_accum
+            buf += payload
+            if len(buf) > MAX_HEADER_LIST * 2:
+                raise H2ProtocolError(frames.ENHANCE_YOUR_CALM,
+                                      "header block too large")
+            if fh.flags & frames.FLAG_END_HEADERS:
+                self._hdr_accum = None
+                self._on_header_block(sid, bytes(buf), bool(es_flag))
+        elif t == frames.SETTINGS:
+            if fh.flags & frames.FLAG_ACK:
+                self._settings_acked.set()
+                return
+            self._apply_settings(frames.unpack_settings(payload))
+            self._writer.write(frames.pack_settings([], ack=True))
+        elif t == frames.WINDOW_UPDATE:
+            if len(payload) != 4:
+                raise H2ProtocolError(frames.FRAME_SIZE_ERROR, "bad WU size")
+            inc = int.from_bytes(payload, "big") & 0x7FFFFFFF
+            if inc == 0:
+                raise H2ProtocolError(frames.PROTOCOL_ERROR, "WU of 0")
+            if fh.stream_id == 0:
+                self._send_window += inc
+            else:
+                st = self._streams.get(fh.stream_id)
+                if st is not None:
+                    st.send_window += inc
+            await self._notify_windows()
+        elif t == frames.RST_STREAM:
+            code = int.from_bytes(payload[:4], "big")
+            st = self._streams.pop(fh.stream_id, None)
+            if st is not None:
+                st.reset_sent = True  # no further sends on this stream
+                st.recv_stream.reset(code, f"peer RST ({code:#x})")
+                if st.response_fut is not None and not st.response_fut.done():
+                    st.response_fut.set_exception(StreamReset(code, "peer RST"))
+                if st.pump_task is not None:
+                    st.pump_task.cancel()
+                # wake any sender parked on flow control for this stream
+                await self._notify_windows()
+        elif t == frames.PING:
+            if not fh.flags & frames.FLAG_ACK:
+                self._writer.write(frames.pack_ping(payload[:8], ack=True))
+        elif t == frames.GOAWAY:
+            self.goaway_received = True
+            last_sid = int.from_bytes(payload[:4], "big") & 0x7FFFFFFF
+            # fail only streams the peer will never process
+            for sid in list(self._streams):
+                if self.is_client and sid > last_sid:
+                    st = self._streams.pop(sid)
+                    err = StreamReset(frames.REFUSED_STREAM, "goaway")
+                    st.recv_stream.reset(err.error_code, str(err))
+                    if st.response_fut is not None and not st.response_fut.done():
+                        st.response_fut.set_exception(err)
+        elif t in (frames.PRIORITY, frames.PUSH_PROMISE):
+            if t == frames.PUSH_PROMISE:
+                raise H2ProtocolError(frames.PROTOCOL_ERROR,
+                                      "push not enabled")
+        # unknown frame types are ignored (RFC 7540 §4.1)
+
+    async def _on_data(self, fh: frames.FrameHeader, payload: bytes) -> None:
+        data = frames.strip_padding(fh.flags, payload)
+        flow = len(payload)  # padding counts toward flow control
+        self._recv_window -= flow
+        eos = bool(fh.flags & frames.FLAG_END_STREAM)
+        st = self._streams.get(fh.stream_id)
+        if st is None or st.recv_closed:
+            # stream gone (e.g. reset); return the connection credit we
+            # just consumed (local accounting AND the peer's view)
+            if flow:
+                self._recv_window += flow
+                self._writer.write(frames.pack_window_update(0, flow))
+            return
+        st.recv_window -= flow
+        if st.recv_window < 0 or self._recv_window < 0:
+            raise H2ProtocolError(frames.FLOW_CONTROL_ERROR,
+                                  "peer overran window")
+        sid = st.id
+
+        def credit(n: int, _sid: int = sid) -> None:
+            # called from app-land release(); returns window to the peer
+            if self._closed:
+                return
+            self._recv_window += n
+            try:
+                self._writer.write(frames.pack_window_update(0, n))
+                stt = self._streams.get(_sid)
+                if stt is not None and not stt.recv_closed:
+                    stt.recv_window += n
+                    self._writer.write(frames.pack_window_update(_sid, n))
+            except Exception:  # noqa: BLE001
+                pass
+
+        st.recv_stream.offer(DataFrame(data, eos, release=credit))
+        if eos:
+            st.recv_closed = True
+            self._maybe_gc(st)
+
+    def _on_header_block(self, sid: int, block: bytes, end_stream: bool) -> None:
+        try:
+            headers = self._decoder.decode(block)
+        except hpack.HpackError as e:
+            raise H2ProtocolError(frames.COMPRESSION_ERROR, str(e)) from e
+        st = self._streams.get(sid)
+        if self.is_client:
+            if st is None:
+                return  # stale/reset stream
+            if not st.got_headers:
+                st.got_headers = True
+                status = next((v for n, v in headers if n == ":status"), "200")
+                if status.startswith("1"):  # 1xx interim: not final
+                    st.got_headers = False
+                    return
+                rsp = H2Response.from_header_list(headers)
+                rsp.stream = st.recv_stream
+                if end_stream:
+                    st.recv_stream.offer(DataFrame(b"", eos=True))
+                    st.recv_closed = True
+                if st.response_fut is not None and not st.response_fut.done():
+                    st.response_fut.set_result(rsp)
+                self._maybe_gc(st)
+            else:  # trailers
+                st.recv_stream.offer(Trailers(headers))
+                st.recv_closed = True
+                self._maybe_gc(st)
+        else:
+            if st is None:
+                if sid <= self._last_peer_stream or sid % 2 == 0:
+                    raise H2ProtocolError(frames.PROTOCOL_ERROR,
+                                          f"bad stream id {sid}")
+                self._last_peer_stream = sid
+                st = _StreamState(sid, self._peer_initial_window,
+                                  LOCAL_INITIAL_WINDOW)
+                st.got_headers = True
+                self._streams[sid] = st
+                req = H2Request.from_header_list(headers)
+                req.stream = st.recv_stream
+                if end_stream:
+                    st.recv_stream.offer(DataFrame(b"", eos=True))
+                    st.recv_closed = True
+                task = asyncio.get_running_loop().create_task(
+                    self._serve_stream(st, req))
+                self._handler_tasks.add(task)
+                task.add_done_callback(self._handler_tasks.discard)
+            else:  # request trailers
+                st.recv_stream.offer(Trailers(headers))
+                st.recv_closed = True
+
+    async def _serve_stream(self, st: _StreamState, req: H2Request) -> None:
+        """Run the app handler for one server stream and write its response
+        (ref: Netty4ServerDispatcher serve)."""
+        try:
+            rsp = await self._handler(req)
+        except StreamReset as e:
+            self._rst(st, e.error_code)
+            return
+        except Exception:  # noqa: BLE001
+            log.exception("h2 handler error (stream %d)", st.id)
+            if st.id in self._streams and not self._closed:
+                self._send_headers(st.id, [(":status", "500")],
+                                   end_stream=True)
+                st.send_closed = True
+                try:
+                    await self._writer.drain()
+                except Exception:  # noqa: BLE001
+                    pass
+                self._maybe_gc(st)
+            return
+        if self._closed or st.id not in self._streams:
+            return
+        body = _poll_const_body(rsp.stream)
+        try:
+            if body is not None:
+                data, trailers = body
+                if trailers is None:
+                    if data:
+                        self._send_headers(st.id, rsp.to_header_list(),
+                                           end_stream=False)
+                        await self._send_data(st, data, eos=True)
+                    else:
+                        self._send_headers(st.id, rsp.to_header_list(),
+                                           end_stream=True)
+                else:
+                    self._send_headers(st.id, rsp.to_header_list(),
+                                       end_stream=False)
+                    if data:
+                        await self._send_data(st, data, eos=False)
+                    self._send_headers(st.id, trailers, end_stream=True)
+                st.send_closed = True
+                await self._writer.drain()
+                self._maybe_gc(st)
+            else:
+                self._send_headers(st.id, rsp.to_header_list(),
+                                   end_stream=False)
+                await self._writer.drain()
+                await self._pump_out(st, rsp.stream)
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+
+    def _maybe_gc(self, st: _StreamState) -> None:
+        if st.recv_closed and st.send_closed:
+            self._streams.pop(st.id, None)
+
+    def _apply_settings(self, settings: List[Tuple[int, int]]) -> None:
+        for key, value in settings:
+            if key == frames.SETTINGS_INITIAL_WINDOW_SIZE:
+                if value > frames.MAX_WINDOW:
+                    raise H2ProtocolError(frames.FLOW_CONTROL_ERROR,
+                                          "window > 2^31-1")
+                delta = value - self._peer_initial_window
+                self._peer_initial_window = value
+                for st in self._streams.values():
+                    st.send_window += delta
+            elif key == frames.SETTINGS_MAX_FRAME_SIZE:
+                if not (16384 <= value <= (1 << 24) - 1):
+                    raise H2ProtocolError(frames.PROTOCOL_ERROR,
+                                          "bad max frame size")
+                self._peer_max_frame = value
+            elif key == frames.SETTINGS_HEADER_TABLE_SIZE:
+                self._encoder.set_max_table_size(value)
+        loop = asyncio.get_event_loop()
+        loop.create_task(self._notify_windows())
+
+
+def _poll_const_body(stream: H2Stream):
+    """(body, trailers|None) if the stream is fully buffered right now,
+    else None (must pump live). Lets unary messages skip the pump task."""
+    try:
+        q = stream._q  # noqa: SLF001 — engine-internal fast path
+        items = list(q._queue)  # type: ignore[attr-defined]
+    except Exception:  # noqa: BLE001
+        return None
+    if not items or not getattr(items[-1], "eos", False):
+        return None
+    chunks: List[bytes] = []
+    trailers = None
+    for it in items:
+        if isinstance(it, Trailers):
+            trailers = it.headers
+        elif isinstance(it, DataFrame):
+            chunks.append(it.data)
+        else:
+            return None
+    # drain the queue so at_end bookkeeping stays consistent, returning
+    # each frame's flow credit (frames may originate from another h2
+    # connection when a handler forwards a received stream)
+    while not q.empty():
+        item = q.get_nowait()
+        if isinstance(item, DataFrame):
+            item.release()
+    stream.at_end = True
+    return b"".join(chunks), trailers

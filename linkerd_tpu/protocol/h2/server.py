@@ -1,0 +1,80 @@
+"""HTTP/2 server listener (prior-knowledge h2c, or TLS with ALPN h2).
+
+Reference parity: finagle/h2/.../H2.scala server side +
+Netty4H2Listener.scala. Each accepted connection runs one H2Connection
+engine dispatching streams into the Service.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from linkerd_tpu.protocol.h2.connection import H2Connection
+from linkerd_tpu.protocol.h2.messages import H2Request, H2Response
+from linkerd_tpu.router.service import Service
+
+log = logging.getLogger(__name__)
+
+
+class H2Server:
+    def __init__(self, service: Service[H2Request, H2Response],
+                 host: str = "127.0.0.1", port: int = 0,
+                 ssl_context=None):
+        self.service = service
+        self.host = host
+        self.port = port
+        if ssl_context is not None:
+            ssl_context.set_alpn_protocols(["h2"])
+        self.ssl_context = ssl_context
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._conns: set = set()
+
+    @property
+    def bound_port(self) -> int:
+        assert self._server is not None, "server not started"
+        return self._server.sockets[0].getsockname()[1]
+
+    async def start(self) -> "H2Server":
+        self._server = await asyncio.start_server(
+            self._on_conn, self.host, self.port, ssl=self.ssl_context)
+        return self
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        # Close live connections BEFORE wait_closed(): on Python >=3.12.1
+        # wait_closed blocks until every connection handler returns, and
+        # handlers run for the life of their connection's read loop.
+        for conn in list(self._conns):
+            await conn.close()
+        if self._server is not None:
+            await self._server.wait_closed()
+
+    async def _on_conn(self, reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter) -> None:
+        conn = H2Connection(reader, writer, is_client=False,
+                            handler=self._dispatch)
+        self._conns.add(conn)
+        try:
+            await conn.start()
+            # the connection lives as long as its read loop
+            await asyncio.shield(conn._read_task)  # noqa: SLF001
+        except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            pass
+        finally:
+            self._conns.discard(conn)
+            await conn.close()
+
+    async def _dispatch(self, req: H2Request) -> H2Response:
+        try:
+            return await self.service(req)
+        except Exception as e:  # noqa: BLE001 — last-resort responder
+            log.debug("h2 service error: %r", e)
+            return H2Response(status=502, body=repr(e).encode())
+
+
+async def serve_h2(service: Service, host: str = "127.0.0.1",
+                   port: int = 0, **kw) -> H2Server:
+    return await H2Server(service, host, port, **kw).start()

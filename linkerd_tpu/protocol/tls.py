@@ -57,6 +57,24 @@ class TlsClientConfig:
                                 self.clientAuth.keyPath or None)
         return ctx
 
+    def validate(self, var_names=frozenset()) -> None:
+        """Load-time checks: commonName template vars must be capturable by
+        the owning prefix, and validation needs a name or an explicit
+        opt-out — so misconfig fails startup, not the first request."""
+        if not self.disableValidation and self.commonName is None:
+            raise ConfigError(
+                "tls client config needs a commonName unless "
+                "disableValidation is set")
+        if self.commonName is not None:
+            import re
+            refs = set(re.findall(r"\{([^}/]+)\}", self.commonName))
+            missing = refs - set(var_names)
+            if missing:
+                raise ConfigError(
+                    f"tls commonName {self.commonName!r} references "
+                    f"{sorted(missing)} not captured by the client prefix "
+                    f"(captures: {sorted(var_names)})")
+
     def server_hostname(self, vars_: Optional[Dict[str, str]] = None
                         ) -> Optional[str]:
         """The SNI / verified name, with ``{var}`` substitution applied."""

@@ -249,14 +249,11 @@ BALANCER_KINDS = {
 
 def mk_balancer(kind: str, addr: Var[Addr],
                 endpoint_factory: Callable[[Address], Service],
-                rng: Optional[random.Random] = None,
-                dry_run: bool = False) -> Optional[Balancer]:
+                rng: Optional[random.Random] = None) -> Balancer:
     try:
         cls = BALANCER_KINDS[kind]
     except KeyError:
         raise ValueError(
             f"unknown balancer kind {kind!r}; known: {sorted(BALANCER_KINDS)}"
         ) from None
-    if dry_run:  # config validation only (ref: LoadBalancerConfig kinds)
-        return None
     return cls(addr, endpoint_factory, rng)

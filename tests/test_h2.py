@@ -1,0 +1,312 @@
+"""HTTP/2 stack: hpack, streams, flow control, e2e, curl interop.
+
+Reference parity: finagle/h2 tests + router/h2 e2e
+(FlowControlEndToEndTest, ConcurrentStreamsEndToEndTest,
+LargeStreamEndToEndTest styles).
+"""
+
+import asyncio
+import shutil
+import subprocess
+
+import pytest
+
+from linkerd_tpu.protocol.h2 import hpack
+from linkerd_tpu.protocol.h2.client import H2Client
+from linkerd_tpu.protocol.h2.messages import H2Request, H2Response, Headers
+from linkerd_tpu.protocol.h2.server import serve_h2
+from linkerd_tpu.protocol.h2.stream import (
+    BufferedStream, DataFrame, H2Stream, StreamReset, Trailers, stream_of,
+)
+from linkerd_tpu.router.service import FnService
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+class TestHpack:
+    def test_roundtrip_with_dynamic_table(self):
+        enc, dec = hpack.Encoder(), hpack.Decoder()
+        hs = [(":method", "POST"), (":path", "/x/y"), (":scheme", "https"),
+              (":authority", "svc.local"), ("x-custom", "v1"),
+              ("cookie", "secret=1")]
+        first = enc.encode(hs)
+        assert dec.decode(first) == hs
+        second = enc.encode(hs)
+        assert len(second) < len(first)
+        assert dec.decode(second) == hs
+
+    def test_huffman_all_bytes(self):
+        data = bytes(range(256))
+        assert hpack.huffman_decode(hpack.huffman_encode(data)) == data
+
+    def test_huffman_encoding_shrinks_ascii(self):
+        raw = b"www.example.com"
+        assert len(hpack.huffman_encode(raw)) < len(raw)
+        # RFC 7541 C.4.1 canonical vector
+        assert hpack.huffman_encode(raw) == bytes.fromhex(
+            "f1e3c2e5f23a6ba0ab90f4ff")
+
+    def test_rfc_c_3_request_vectors(self):
+        # RFC 7541 C.3: three requests without huffman on one connection
+        dec = hpack.Decoder()
+        r1 = bytes.fromhex("828684410f7777772e6578616d706c652e636f6d")
+        assert dec.decode(r1) == [
+            (":method", "GET"), (":scheme", "http"), (":path", "/"),
+            (":authority", "www.example.com")]
+        r2 = bytes.fromhex("828684be58086e6f2d6361636865")
+        assert dec.decode(r2) == [
+            (":method", "GET"), (":scheme", "http"), (":path", "/"),
+            (":authority", "www.example.com"), ("cache-control", "no-cache")]
+        r3 = bytes.fromhex(
+            "828785bf400a637573746f6d2d6b65790c637573746f6d2d76616c7565")
+        assert dec.decode(r3) == [
+            (":method", "GET"), (":scheme", "https"), (":path", "/index.html"),
+            (":authority", "www.example.com"), ("custom-key", "custom-value")]
+
+    def test_table_size_update_over_settings_rejected(self):
+        dec = hpack.Decoder(max_table_size=100)
+        with pytest.raises(hpack.HpackError):
+            dec.decode(bytes([0x3F, 0xE1, 0x1F]))  # update to 4096 > 100
+
+
+class TestStreamModel:
+    def test_read_all_and_release(self):
+        released = []
+        s = H2Stream()
+        s.offer(DataFrame(b"abc", release=released.append))
+        s.offer(DataFrame(b"def", eos=True, release=released.append))
+
+        async def go():
+            body, trailers = await s.read_all()
+            assert body == b"abcdef"
+            assert trailers is None
+            assert released == [3, 3]
+
+        run(go())
+
+    def test_trailers(self):
+        s = stream_of(b"payload", trailers=[("grpc-status", "0")])
+
+        async def go():
+            body, trailers = await s.read_all()
+            assert body == b"payload"
+            assert trailers.headers == [("grpc-status", "0")]
+
+        run(go())
+
+    def test_reset_propagates(self):
+        s = H2Stream()
+        s.reset(0x8, "cancelled")
+
+        async def go():
+            with pytest.raises(StreamReset):
+                await s.read()
+
+        run(go())
+
+    def test_buffered_stream_fork_and_overflow(self):
+        async def go():
+            src = H2Stream()
+            buf = BufferedStream(src, capacity=10)
+            f1 = buf.fork()
+            src.offer(DataFrame(b"12345", eos=False))
+            src.offer(DataFrame(b"678", eos=True))
+            b1, _ = await f1.read_all()
+            assert b1 == b"12345678"
+            # replay from buffer
+            f2 = buf.fork()
+            b2, _ = await f2.read_all()
+            assert b2 == b"12345678"
+            await buf.close()
+
+            # overflow: capacity 4 < 8 bytes
+            src2 = H2Stream()
+            buf2 = BufferedStream(src2, capacity=4)
+            g1 = buf2.fork()
+            src2.offer(DataFrame(b"12345", eos=False))
+            src2.offer(DataFrame(b"678", eos=True))
+            bb, _ = await g1.read_all()
+            assert bb == b"12345678"
+            assert buf2.overflowed
+            with pytest.raises(RuntimeError):
+                buf2.fork()
+            await buf2.close()
+
+        run(go())
+
+
+def echo_service():
+    async def handler(req: H2Request) -> H2Response:
+        body, _ = await req.stream.read_all()
+        rsp = H2Response(status=200, body=b"echo:" + body)
+        rsp.headers.set("x-method", req.method)
+        rsp.headers.set("x-path", req.path)
+        return rsp
+
+    return FnService(handler)
+
+
+class TestH2EndToEnd:
+    def test_get_and_post_roundtrip(self):
+        async def go():
+            server = await serve_h2(echo_service())
+            client = H2Client("127.0.0.1", server.bound_port)
+            try:
+                rsp = await client(H2Request(
+                    method="GET", path="/hello", authority="test"))
+                body, _ = await rsp.stream.read_all()
+                assert rsp.status == 200
+                assert body == b"echo:"
+                assert rsp.headers.get("x-path") == "/hello"
+
+                rsp2 = await client(H2Request(
+                    method="POST", path="/p", authority="test",
+                    body=b"payload"))
+                body2, _ = await rsp2.stream.read_all()
+                assert body2 == b"echo:payload"
+            finally:
+                await client.close()
+                await server.close()
+
+        run(go())
+
+    def test_concurrent_streams_multiplex(self):
+        # ref: ConcurrentStreamsEndToEndTest
+        async def go():
+            server = await serve_h2(echo_service())
+            client = H2Client("127.0.0.1", server.bound_port)
+            try:
+                async def one(i: int):
+                    rsp = await client(H2Request(
+                        method="POST", path=f"/{i}", authority="t",
+                        body=f"msg-{i}".encode()))
+                    body, _ = await rsp.stream.read_all()
+                    return body
+
+                results = await asyncio.gather(*(one(i) for i in range(20)))
+                assert results == [f"echo:msg-{i}".encode()
+                                   for i in range(20)]
+                # all multiplexed over ONE connection
+                assert client._conn is not None
+            finally:
+                await client.close()
+                await server.close()
+
+        run(go())
+
+    def test_large_stream_flow_control(self):
+        # ref: LargeStreamEndToEndTest / FlowControlEndToEndTest: a body
+        # far larger than the 64KB default window must flow once the
+        # consumer releases frames.
+        big = bytes(1024) * 2048  # 2MB
+
+        async def go():
+            server = await serve_h2(echo_service())
+            client = H2Client("127.0.0.1", server.bound_port)
+            try:
+                rsp = await client(H2Request(
+                    method="POST", path="/big", authority="t", body=big))
+                body, _ = await rsp.stream.read_all()
+                assert body == b"echo:" + big
+            finally:
+                await client.close()
+                await server.close()
+
+        run(go())
+
+    def test_streaming_response_with_trailers(self):
+        async def handler(req: H2Request) -> H2Response:
+            out = H2Stream()
+            rsp = H2Response(status=200, stream=out)
+
+            async def produce():
+                for i in range(5):
+                    out.offer(DataFrame(f"chunk{i};".encode()))
+                    await asyncio.sleep(0)
+                out.offer(Trailers([("grpc-status", "0")]))
+
+            asyncio.get_running_loop().create_task(produce())
+            return rsp
+
+        async def go():
+            server = await serve_h2(FnService(handler))
+            client = H2Client("127.0.0.1", server.bound_port)
+            try:
+                rsp = await client(H2Request(path="/s", authority="t"))
+                body, trailers = await rsp.stream.read_all()
+                assert body == b"chunk0;chunk1;chunk2;chunk3;chunk4;"
+                assert trailers.headers == [("grpc-status", "0")]
+            finally:
+                await client.close()
+                await server.close()
+
+        run(go())
+
+    def test_handler_exception_maps_to_502(self):
+        async def boom(req):
+            raise RuntimeError("kaboom")
+
+        async def go():
+            server = await serve_h2(FnService(boom))
+            client = H2Client("127.0.0.1", server.bound_port)
+            try:
+                rsp = await client(H2Request(path="/x", authority="t"))
+                assert rsp.status == 502
+            finally:
+                await client.close()
+                await server.close()
+
+        run(go())
+
+
+@pytest.mark.skipif(shutil.which("curl") is None, reason="curl not available")
+class TestCurlInterop:
+    """nghttp2 (curl) speaks to our server — huffman-encoded HPACK,
+    real-world settings, h2c prior knowledge."""
+
+    def test_curl_http2_prior_knowledge(self):
+        async def go():
+            server = await serve_h2(echo_service())
+            port = server.bound_port
+            try:
+                proc = await asyncio.create_subprocess_exec(
+                    "curl", "-sS", "--http2-prior-knowledge",
+                    "-d", "hello-from-curl",
+                    f"http://127.0.0.1:{port}/post-path",
+                    "-w", "\n%{http_code} %{http_version}",
+                    stdout=asyncio.subprocess.PIPE,
+                    stderr=asyncio.subprocess.PIPE)
+                out, err = await proc.communicate()
+                assert proc.returncode == 0, err.decode()
+                text = out.decode()
+                assert "echo:hello-from-curl" in text
+                assert "200 2" in text
+            finally:
+                await server.close()
+
+        run(go())
+
+    def test_curl_sequential_fresh_connections(self):
+        # NB: curl 7.88 on this image returns error 16 when REUSING an h2
+        # connection across URLs even against grpcio's reference server
+        # (verified), so connection-reuse interop is covered by our own
+        # client's multiplexing test; here each request is a fresh conn.
+        async def go():
+            server = await serve_h2(echo_service())
+            port = server.bound_port
+            try:
+                for i in range(3):
+                    proc = await asyncio.create_subprocess_exec(
+                        "curl", "-sS", "--http2-prior-knowledge",
+                        f"http://127.0.0.1:{port}/r{i}",
+                        stdout=asyncio.subprocess.PIPE,
+                        stderr=asyncio.subprocess.PIPE)
+                    out, err = await proc.communicate()
+                    assert proc.returncode == 0, err.decode()
+                    assert out.decode() == f"echo:"
+            finally:
+                await server.close()
+
+        run(go())
