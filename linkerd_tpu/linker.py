@@ -202,10 +202,17 @@ def per_prefix_lookup(raw: Any, cls: type, where: str,
             # time so typos fail startup, not the first matching request
             # (ref: Parser strictness, Parser.scala:84).
             matcher = PathMatcher(str(prefix))
-            entry_spec = instantiate_as(cls, c, f"{where}.configs[{i}]")
-            if validate is not None:
-                validate(entry_spec, matcher.var_names)
+            instantiate_as(cls, c, f"{where}.configs[{i}]")
             entries.append((matcher, c))
+        if validate is not None:
+            # Runtime lookup() merges captures across ALL matching
+            # prefixes, so a template var is satisfiable if ANY entry
+            # captures it — validate against the union, not per-entry.
+            all_vars = frozenset().union(
+                *(m.var_names for m, _ in entries))
+            for i, (m, fields) in enumerate(entries):
+                validate(instantiate_as(
+                    cls, fields, f"{where}.configs[{i}]"), all_vars)
 
         def lookup(path: Path) -> Tuple[Any, Dict[str, str]]:
             merged: Dict[str, Any] = {}

@@ -195,6 +195,8 @@ class H2Connection:
         try:
             rsp: H2Response = await st.response_fut
         except BaseException:
+            if st.pump_task is not None:
+                st.pump_task.cancel()
             if not st.reset_sent and sid in self._streams:
                 self._rst(st, frames.CANCEL)
             raise
@@ -252,16 +254,26 @@ class H2Connection:
 
     async def _send_data(self, st: _StreamState, data: bytes,
                          eos: bool) -> None:
-        view = memoryview(data)
-        offset = 0
-        while offset < len(data) or (eos and len(data) == 0):
-            if self._closed:
-                raise ConnectionError("connection closed")
-            n = min(len(data) - offset, self._peer_max_frame,
-                    self._send_window, st.send_window)
+        if eos and not data:
+            # an empty END_STREAM DATA frame consumes no flow-control
+            # credit, so it may be sent even when a window is negative
+            # (peer shrank SETTINGS_INITIAL_WINDOW_SIZE, RFC 7540 §6.9.2)
             if st.reset_sent or st.id not in self._streams:
                 raise StreamReset(frames.STREAM_CLOSED, "stream reset")
-            if n <= 0 and len(data) - offset > 0:
+            self._writer.write(frames.pack_frame(
+                frames.DATA, frames.FLAG_END_STREAM, st.id, b""))
+            await self._writer.drain()
+            return
+        view = memoryview(data)
+        offset = 0
+        while offset < len(data):
+            if self._closed:
+                raise ConnectionError("connection closed")
+            n = max(0, min(len(data) - offset, self._peer_max_frame,
+                           self._send_window, st.send_window))
+            if st.reset_sent or st.id not in self._streams:
+                raise StreamReset(frames.STREAM_CLOSED, "stream reset")
+            if n <= 0:
                 async with self._window_cond:
                     await self._window_cond.wait()
                 continue

@@ -25,13 +25,15 @@ class StreamReset(Exception):
         self.error_code = error_code
 
 
-RST_NO_ERROR = 0x0
-RST_PROTOCOL_ERROR = 0x1
-RST_INTERNAL_ERROR = 0x2
-RST_FLOW_CONTROL_ERROR = 0x3
-RST_STREAM_CLOSED = 0x5
-RST_REFUSED_STREAM = 0x7
-RST_CANCEL = 0x8
+from linkerd_tpu.protocol.h2.frames import (  # noqa: E402
+    CANCEL as RST_CANCEL,
+    FLOW_CONTROL_ERROR as RST_FLOW_CONTROL_ERROR,
+    INTERNAL_ERROR as RST_INTERNAL_ERROR,
+    NO_ERROR as RST_NO_ERROR,
+    PROTOCOL_ERROR as RST_PROTOCOL_ERROR,
+    REFUSED_STREAM as RST_REFUSED_STREAM,
+    STREAM_CLOSED as RST_STREAM_CLOSED,
+)
 
 
 class DataFrame:
