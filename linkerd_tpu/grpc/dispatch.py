@@ -15,7 +15,7 @@ from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Type
 
 from linkerd_tpu.grpc.codec import Codec
 from linkerd_tpu.grpc.status import (
-    GrpcError, GrpcStatus, INTERNAL, OK, UNIMPLEMENTED,
+    GrpcError, GrpcStatus, INTERNAL, OK, UNAVAILABLE, UNIMPLEMENTED, UNKNOWN,
 )
 from linkerd_tpu.grpc.stream import DecodingStream, EncodingStream, GrpcStream
 from linkerd_tpu.grpc.proto import ProtoMessage
@@ -61,9 +61,13 @@ async def _drain_into(result: Any, enc: EncodingStream) -> None:
         if hasattr(result, "__aiter__"):
             async for msg in result:
                 enc.send(msg)
+                if enc.is_broken:
+                    break
         else:
             for msg in result:
                 enc.send(msg)
+                if enc.is_broken:
+                    break
         enc.close(GrpcStatus(OK))
     except GrpcError as e:
         enc.close(e.status)
@@ -197,7 +201,24 @@ class ClientDispatcher:
         except Exception:
             pump.cancel()
             raise
-        return DecodingStream(rsp.stream, Codec(rpc.rep_cls))
+        reps = DecodingStream(rsp.stream, Codec(rpc.rep_cls))
+        # Trailers-Only responses (single HEADERS + END_STREAM carrying
+        # grpc-status — how conformant servers send immediate errors) and
+        # non-200 proxy responses resolve the status up front.
+        if rsp.status != 200:
+            reps.resolve_status(GrpcStatus(
+                UNAVAILABLE, f"non-200 response: {rsp.status}"))
+        else:
+            code_s = rsp.headers.get("grpc-status")
+            if code_s is not None:
+                from urllib.parse import unquote
+                try:
+                    code = int(code_s)
+                except ValueError:
+                    code = UNKNOWN
+                reps.resolve_status(GrpcStatus(
+                    code, unquote(rsp.headers.get("grpc-message") or "")))
+        return reps
 
     async def unary(self, svc_def: ServiceDef, rpc_name: str,
                     req_msg: ProtoMessage) -> ProtoMessage:

@@ -97,6 +97,12 @@ class DecodingStream(Generic[T]):
         """Terminal status; None until the stream completes."""
         return self._status
 
+    def resolve_status(self, status: GrpcStatus) -> None:
+        """Pre-resolve the terminal status (Trailers-Only responses,
+        transport-level failures mapped by the caller)."""
+        if self._status is None:
+            self._status = status
+
     async def recv(self) -> T:
         while True:
             if self._ready:
@@ -148,6 +154,12 @@ class EncodingStream:
     def __init__(self, h2: H2Stream, codec: Codec):
         self._h2 = h2
         self._codec = codec
+
+    @property
+    def is_broken(self) -> bool:
+        """True once the consumer is gone (stream reset) — long-lived
+        producers should stop emitting."""
+        return self._h2.is_reset
 
     def send(self, msg) -> None:
         self._h2.offer(DataFrame(self._codec.encode_frame(msg)))

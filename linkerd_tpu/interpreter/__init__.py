@@ -1,0 +1,10 @@
+"""Interpreters: how a router binds logical names.
+
+Ref: interpreter/ in the reference — in-process (default ConfiguredDtabNamer,
+``io.l5d.fs`` watched-file dtab) or remote via namerd (``io.l5d.mesh`` gRPC
+streams with backoff-reconnect, interpreter/mesh/.../Client.scala).
+"""
+
+from linkerd_tpu.interpreter.mesh import MeshClientInterpreter
+
+__all__ = ["MeshClientInterpreter"]

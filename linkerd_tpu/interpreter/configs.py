@@ -1,0 +1,48 @@
+"""Interpreter config kinds.
+
+Ref: namer/core/.../InterpreterInitializer.scala:9-57 (SPI) and
+interpreter/mesh/.../MeshInterpreterInitializer.scala:79 (kind io.l5d.mesh:
+dst + root). The default interpreter is the in-process recursive dtab
+namer (DefaultInterpreterInitializer.scala).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from linkerd_tpu.config import ConfigError, register
+from linkerd_tpu.core import Path
+from linkerd_tpu.interpreter.mesh import MeshClientInterpreter
+from linkerd_tpu.namer.core import ConfiguredDtabNamer, NameInterpreter
+
+
+@register("interpreter", "default")
+@dataclass
+class DefaultInterpreterConfig:
+    def mk(self, namers) -> NameInterpreter:
+        return ConfiguredDtabNamer(namers)
+
+
+def parse_inet_dst(dst: str) -> tuple:
+    """``/$/inet/<host>/<port>`` -> (host, port) (the reference's mesh dst
+    syntax, MeshInterpreterInitializer.scala dst param)."""
+    p = Path.read(dst)
+    if len(p) != 4 or p[0] != "$" or p[1] != "inet":
+        raise ConfigError(
+            f"mesh dst must look like /$/inet/<host>/<port>, got {dst!r}")
+    try:
+        return p[2], int(p[3])
+    except ValueError:
+        raise ConfigError(f"mesh dst port not a number: {dst!r}")
+
+
+@register("interpreter", "io.l5d.mesh")
+@dataclass
+class MeshInterpreterConfig:
+    dst: str = "/$/inet/127.0.0.1/4321"
+    root: str = "/default"
+
+    def mk(self, namers) -> NameInterpreter:
+        host, port = parse_inet_dst(self.dst)
+        return MeshClientInterpreter(host, port, root=self.root)

@@ -45,6 +45,7 @@ from linkerd_tpu.telemetry.metrics import MetricsTree
 from linkerd_tpu.telemetry.telemeter import BroadcastTracer, NullTracer
 
 # Ensure built-in plugin registrations are loaded.
+import linkerd_tpu.interpreter.configs  # noqa: F401
 import linkerd_tpu.namer.fs  # noqa: F401
 import linkerd_tpu.protocol.http.identifiers  # noqa: F401
 import linkerd_tpu.router.classifiers  # noqa: F401
@@ -137,6 +138,7 @@ class RouterSpec:
     dtab: str = ""
     dstPrefix: str = "/svc"
     identifier: Optional[Any] = None      # kind-discriminated mapping(s)
+    interpreter: Optional[Dict[str, Any]] = None  # kind-discriminated
     servers: Optional[List[ServerSpec]] = None
     # Plain mapping = one config for all clients/services; or
     # {kind: io.l5d.static, configs: [{prefix: ..., <fields>}]} for
@@ -202,22 +204,21 @@ def per_prefix_lookup(raw: Any, cls: type, where: str,
             # time so typos fail startup, not the first matching request
             # (ref: Parser strictness, Parser.scala:84).
             matcher = PathMatcher(str(prefix))
-            instantiate_as(cls, c, f"{where}.configs[{i}]")
-            entries.append((matcher, c))
+            entry_spec = instantiate_as(cls, c, f"{where}.configs[{i}]")
+            entries.append((matcher, c, entry_spec))
         if validate is not None:
             # Runtime lookup() merges captures across ALL matching
             # prefixes, so a template var is satisfiable if ANY entry
             # captures it — validate against the union, not per-entry.
             all_vars = frozenset().union(
-                *(m.var_names for m, _ in entries))
-            for i, (m, fields) in enumerate(entries):
-                validate(instantiate_as(
-                    cls, fields, f"{where}.configs[{i}]"), all_vars)
+                *(mch.var_names for mch, _, _ in entries))
+            for mch, _, entry_spec in entries:
+                validate(entry_spec, all_vars)
 
         def lookup(path: Path) -> Tuple[Any, Dict[str, str]]:
             merged: Dict[str, Any] = {}
             vars_: Dict[str, str] = {}
-            for matcher, fields in entries:
+            for matcher, fields, _spec in entries:
                 captured = matcher.extract(path)
                 if captured is not None:
                     merged.update(fields)
@@ -326,7 +327,12 @@ class Linker:
         ]
         identifier = compose_identifiers(identifiers)
 
-        interpreter = ConfiguredDtabNamer(self.namers)
+        if rspec.interpreter is not None:
+            interpreter = instantiate(
+                "interpreter", rspec.interpreter,
+                f"{label}.interpreter").mk(self.namers)
+        else:
+            interpreter = ConfiguredDtabNamer(self.namers)
 
         def validate_client(spec: ClientSpec, var_names=frozenset()) -> None:
             if spec.failureAccrual is not None:

@@ -1,0 +1,19 @@
+"""namerd: the centralized naming control plane.
+
+Ref: namerd/ in the reference — DtabStore-backed namespaces, served to
+linkerds over the gRPC mesh API (namerd/iface/mesh) and an HTTP control
+API (namerd/iface/control-http), assembled by NamerdConfig
+(namerd/core/.../NamerdConfig.scala:28-95).
+"""
+
+from linkerd_tpu.namerd.store import (
+    DtabStore, DtabNamespaceAlreadyExists, DtabNamespaceDoesNotExist,
+    DtabVersionMismatch, InMemoryDtabStore, VersionedDtab,
+)
+from linkerd_tpu.namerd.core import Namerd, NamespacedInterpreters
+
+__all__ = [
+    "DtabStore", "DtabNamespaceAlreadyExists", "DtabNamespaceDoesNotExist",
+    "DtabVersionMismatch", "InMemoryDtabStore", "VersionedDtab",
+    "Namerd", "NamespacedInterpreters",
+]

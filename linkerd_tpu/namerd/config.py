@@ -1,0 +1,154 @@
+"""namerd config parsing and process assembly.
+
+Ref: namerd/core/.../NamerdConfig.scala:28-95 (storage + namers + ifaces ->
+Namerd of Servables) and namerd/main/.../Main.scala:10-55. YAML shape:
+
+    storage: {kind: io.l5d.inMemory | io.l5d.fs, ...}
+    namers: [{kind: io.l5d.fs, rootDir: ...}]
+    interfaces:
+      - {kind: io.l5d.mesh, port: 4321}
+      - {kind: io.l5d.httpController, port: 4180}
+    admin: {port: 9991}
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from linkerd_tpu.config import ConfigError, register
+from linkerd_tpu.config.parser import (
+    instantiate, instantiate_as, instantiate_list, parse_config,
+)
+from linkerd_tpu.core import Dtab, Path
+from linkerd_tpu.namerd.core import Namerd
+from linkerd_tpu.namerd.http_api import HttpControlService
+from linkerd_tpu.namerd.mesh_iface import DEFAULT_MESH_PORT, MeshIface
+from linkerd_tpu.namerd.store import (
+    DtabStore, FsDtabStore, InMemoryDtabStore,
+)
+from linkerd_tpu.protocol.h2.server import H2Server
+from linkerd_tpu.protocol.http.server import HttpServer
+
+DEFAULT_HTTP_CONTROL_PORT = 4180
+
+# Ensure built-in plugin registrations are loaded (the LoadService
+# analogue; ref: Linker.scala:64-75 SPI loading).
+import linkerd_tpu.namer.fs  # noqa: E402,F401
+
+
+# ---- storage kinds ---------------------------------------------------------
+
+@register("dtabStore", "io.l5d.inMemory")
+@dataclass
+class InMemoryStoreConfig:
+    namespaces: Optional[Dict[str, str]] = None  # ns -> dtab text
+
+    def mk(self) -> DtabStore:
+        initial = {ns: Dtab.read(text)
+                   for ns, text in (self.namespaces or {}).items()}
+        return InMemoryDtabStore(initial)
+
+
+@register("dtabStore", "io.l5d.fs")
+@dataclass
+class FsStoreConfig:
+    directory: str
+
+    def mk(self) -> DtabStore:
+        return FsDtabStore(self.directory)
+
+
+# ---- interface kinds -------------------------------------------------------
+
+@register("namerdIface", "io.l5d.mesh")
+@dataclass
+class MeshIfaceConfig:
+    port: int = DEFAULT_MESH_PORT
+    ip: str = "127.0.0.1"
+
+    def mk(self, namerd: Namerd):
+        iface = MeshIface(namerd)
+        return H2Server(iface.dispatcher, host=self.ip, port=self.port)
+
+
+@register("namerdIface", "io.l5d.httpController")
+@dataclass
+class HttpControllerConfig:
+    port: int = DEFAULT_HTTP_CONTROL_PORT
+    ip: str = "127.0.0.1"
+
+    def mk(self, namerd: Namerd):
+        return HttpServer(HttpControlService(namerd),
+                          host=self.ip, port=self.port)
+
+
+# ---- assembly --------------------------------------------------------------
+
+@dataclass
+class NamerdSpec:
+    storage: Dict[str, Any]
+    interfaces: List[Any] = field(default_factory=list)
+    namers: Optional[List[Any]] = None
+    admin: Optional[Dict[str, Any]] = None
+
+
+def parse_namerd_spec(text: str) -> NamerdSpec:
+    data = parse_config(text)
+    if not isinstance(data, dict):
+        raise ConfigError("namerd config must be a mapping")
+    spec = instantiate_as(NamerdSpec, data)
+    if not spec.storage:
+        raise ConfigError("namerd config needs 'storage'")
+    if not spec.interfaces:
+        raise ConfigError("namerd config needs at least one interface")
+    return spec
+
+
+class NamerdProcess:
+    """Assembled namerd: store + namers + iface servers (+ admin)."""
+
+    def __init__(self, spec: NamerdSpec, config_dict: Any = None):
+        self.spec = spec
+        self.config_dict = config_dict
+        store = instantiate("dtabStore", spec.storage, "storage").mk()
+        namers: List[Tuple[Path, Any]] = []
+        for ncfg in instantiate_list("namer", spec.namers, "namers"):
+            prefix = Path.read(getattr(ncfg, "prefix", f"/{ncfg.kind}"))
+            namers.append((prefix, ncfg.mk()))
+        self.namerd = Namerd(store, namers)
+        self._iface_cfgs = instantiate_list(
+            "namerdIface", spec.interfaces, "interfaces")
+        self.servers: List[Any] = []
+        self.admin_server = None
+
+    async def start(self) -> "NamerdProcess":
+        for cfg in self._iface_cfgs:
+            server = cfg.mk(self.namerd)
+            await server.start()
+            self.servers.append(server)
+        if self.spec.admin is not None:
+            from linkerd_tpu.admin.server import AdminServer
+            from linkerd_tpu.telemetry.metrics import MetricsTree
+            self.admin_server = AdminServer(
+                MetricsTree(), config_dict=self.config_dict,
+                port=int(self.spec.admin.get("port", 9991)))
+            await self.admin_server.start()
+        return self
+
+    @property
+    def bound_ports(self) -> List[int]:
+        return [s.bound_port for s in self.servers]
+
+    async def close(self) -> None:
+        if self.admin_server is not None:
+            await self.admin_server.close()
+        for s in self.servers:
+            await s.close()
+        await self.namerd.close()
+
+
+async def serve_namerd(config_text: str) -> NamerdProcess:
+    spec = parse_namerd_spec(config_text)
+    return await NamerdProcess(spec, parse_config(config_text)).start()

@@ -1,0 +1,55 @@
+"""Namerd assembly: store-backed namespaced interpreters.
+
+Ref: namerd/core/.../NamerdConfig.scala:28-95 (mk: storage + namers +
+ifaces) and ConfiguredDtabNamer wiring — each namespace's interpreter is a
+recursive dtab interpreter whose base dtab is the *live* stored dtab, so a
+dtab write re-binds every watching linkerd without reconnects.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from linkerd_tpu.core import Activity, Dtab, Path
+from linkerd_tpu.namer.core import ConfiguredDtabNamer, Namer, NameInterpreter
+from linkerd_tpu.namerd.store import DtabStore, VersionedDtab
+
+
+class NamespacedInterpreters:
+    """ns -> NameInterpreter over the store's live dtab (cached)."""
+
+    def __init__(self, store: DtabStore,
+                 namers: Sequence[Tuple[Path, Namer]] = ()):
+        self._store = store
+        self._namers = list(namers)
+        self._cache: Dict[str, NameInterpreter] = {}
+
+    def interpreter(self, ns: str) -> NameInterpreter:
+        interp = self._cache.get(ns)
+        if interp is None:
+            dtab_act: Activity[Dtab] = self._store.observe(ns).map(
+                lambda vd: vd.dtab if vd is not None else Dtab.empty())
+            interp = ConfiguredDtabNamer(self._namers, dtab=dtab_act)
+            self._cache[ns] = interp
+        return interp
+
+
+class Namerd:
+    """The assembled control plane: store + namers + servable interfaces."""
+
+    def __init__(self, store: DtabStore,
+                 namers: Sequence[Tuple[Path, Namer]] = ()):
+        self.store = store
+        self.namers = list(namers)
+        self.interpreters = NamespacedInterpreters(store, namers)
+        self._servers: List = []
+
+    def interpreter(self, ns: str) -> NameInterpreter:
+        return self.interpreters.interpreter(ns)
+
+    async def close(self) -> None:
+        for s in self._servers:
+            await s.close()
+        for _, n in self.namers:
+            n.close()
+        self.store.close()

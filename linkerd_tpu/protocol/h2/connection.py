@@ -243,12 +243,18 @@ class H2Connection:
         except StreamReset as e:
             if not st.reset_sent:
                 self._rst(st, e.error_code)
+            # peer RST'd an active stream: the producer must see it too
+            stream.reset(e.error_code, "consumer gone")
         except (ConnectionError, asyncio.CancelledError):
-            pass
+            # consumer is gone (peer RST / connection teardown): reset the
+            # app-side source so long-lived producers (e.g. gRPC watch
+            # streams) observe the death instead of pumping into the void
+            stream.reset(frames.CANCEL, "consumer gone")
         except Exception:  # noqa: BLE001
             log.exception("h2 outbound pump failed (stream %d)", st.id)
             if not st.reset_sent:
                 self._rst(st, frames.INTERNAL_ERROR)
+            stream.reset(frames.INTERNAL_ERROR, "pump failed")
         finally:
             self._maybe_gc(st)
 

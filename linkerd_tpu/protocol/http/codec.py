@@ -202,3 +202,24 @@ def write_response(writer: asyncio.StreamWriter, rsp: Response) -> None:
     lines += [f"{k}: {v}\r\n" for k, v in rsp.headers]
     lines.append("\r\n")
     writer.write("".join(lines).encode("latin-1") + rsp.body)
+
+
+async def write_streaming_response(writer: asyncio.StreamWriter,
+                                   rsp: Response) -> None:
+    """Write a chunked response from ``rsp.body_stream`` (an async iterator
+    of bytes), draining after every chunk so watchers see updates live."""
+    rsp.headers.remove("content-length")
+    rsp.headers.set("Transfer-Encoding", "chunked")
+    lines = [f"{rsp.version} {rsp.status} {rsp.reason}\r\n"]
+    lines += [f"{k}: {v}\r\n" for k, v in rsp.headers]
+    lines.append("\r\n")
+    writer.write("".join(lines).encode("latin-1"))
+    await writer.drain()
+    async for chunk in rsp.body_stream:
+        if not chunk:
+            continue
+        writer.write(f"{len(chunk):x}\r\n".encode("latin-1")
+                     + chunk + b"\r\n")
+        await writer.drain()
+    writer.write(b"0\r\n\r\n")
+    await writer.drain()

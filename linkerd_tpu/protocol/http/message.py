@@ -101,17 +101,23 @@ class Request:
 
 
 class Response:
-    __slots__ = ("status", "reason", "version", "headers", "body", "ctx")
+    __slots__ = ("status", "reason", "version", "headers", "body",
+                 "body_stream", "ctx")
 
     def __init__(self, status: int = 200, reason: Optional[str] = None,
                  version: str = "HTTP/1.1",
                  headers: Optional[Headers] = None,
-                 body: bytes = b""):
+                 body: bytes = b"",
+                 body_stream: Optional[object] = None):
         self.status = status
         self.reason = reason if reason is not None else REASONS.get(status, "Unknown")
         self.version = version
         self.headers = headers if headers is not None else Headers()
         self.body = body
+        # async iterator of bytes -> Transfer-Encoding: chunked streaming
+        # (the watch=true control-API path, ref: HttpControlService
+        # streaming responses). When set, ``body`` is ignored.
+        self.body_stream = body_stream
         self.ctx: Dict[str, object] = {}
 
     def __repr__(self) -> str:

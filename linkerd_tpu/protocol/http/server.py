@@ -35,6 +35,7 @@ class HttpServer:
         self._sem = (asyncio.Semaphore(max_concurrency)
                      if max_concurrency else None)
         self._conns: set = set()
+        self._conn_tasks: set = set()
 
     @property
     def bound_port(self) -> int:
@@ -49,16 +50,25 @@ class HttpServer:
     async def close(self) -> None:
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
         for w in list(self._conns):
             try:
                 w.close()
             except Exception:  # noqa: BLE001
                 pass
+        # cancel parked handlers (e.g. watch streams blocked on state
+        # changes) — 3.12's wait_closed() waits for ALL handlers
+        for t in list(self._conn_tasks):
+            t.cancel()
+        if self._server is not None:
+            await self._server.wait_closed()
 
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter) -> None:
         self._conns.add(writer)
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
+            task.add_done_callback(self._conn_tasks.discard)
         try:
             while True:
                 try:
@@ -95,6 +105,19 @@ class HttpServer:
                 )
                 if conn_close:
                     rsp.headers.set("Connection", "close")
+                if rsp.body_stream is not None:
+                    # watch-style chunked stream; terminal for this conn
+                    # (the stream usually ends only when the client goes)
+                    try:
+                        await codec.write_streaming_response(writer, rsp)
+                    finally:
+                        aclose = getattr(rsp.body_stream, "aclose", None)
+                        if aclose is not None:
+                            try:
+                                await aclose()
+                            except Exception:  # noqa: BLE001
+                                pass
+                    return
                 codec.write_response(writer, rsp)
                 await writer.drain()
                 if conn_close:

@@ -129,9 +129,26 @@ class Balancer(Service):
     def pick(self) -> Endpoint:
         raise NotImplementedError
 
+    # How long a request queues while the replica set is still Pending
+    # (finagle balancers queue on Addr.Pending rather than failing —
+    # matters on first dispatch through a freshly-opened resolver watch).
+    PENDING_TIMEOUT = 10.0
+
+    async def _await_nonpending(self) -> None:
+        if self._endpoints or not isinstance(self._addr.sample(), AddrPending):
+            return
+        try:
+            async with asyncio.timeout(self.PENDING_TIMEOUT):
+                async for a in self._addr.changes():
+                    if not isinstance(a, AddrPending):
+                        return
+        except TimeoutError:
+            return  # _check_addr reports the empty set
+
     async def __call__(self, req):
         if self._to_close:
             await self._reap()
+        await self._await_nonpending()
         self._check_addr()
         ep = self.pick()
         ep.pending += 1

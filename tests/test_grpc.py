@@ -224,3 +224,46 @@ def test_var_event_stream_coalesces():
         assert loop.run_until_complete(go()) == (10, 40)
     finally:
         loop.close()
+
+
+def test_trailers_only_error_response(grpc_pair):
+    """Conformant servers send immediate errors as HEADERS+END_STREAM with
+    grpc-status (Trailers-Only); the client must surface that status."""
+    loop, _client = grpc_pair
+    from linkerd_tpu.grpc.dispatch import ClientDispatcher
+    from linkerd_tpu.protocol.h2.messages import H2Response, Headers
+    from linkerd_tpu.protocol.h2.stream import DataFrame, H2Stream
+    from linkerd_tpu.router.service import FnService
+
+    async def trailers_only(req):
+        s = H2Stream()
+        s.offer(DataFrame(b"", eos=True))
+        return H2Response(status=200, headers=Headers(
+            [("grpc-status", "7"), ("grpc-message", "denied")]), stream=s)
+
+    async def go():
+        client = ClientDispatcher(FnService(trailers_only))
+        with pytest.raises(GrpcError) as ei:
+            await client.unary(SVC, "Say", Echo(text="x"))
+        assert ei.value.status.code == 7
+        assert ei.value.status.message == "denied"
+
+    loop.run_until_complete(go())
+
+
+def test_non200_response_maps_to_unavailable(grpc_pair):
+    loop, _client = grpc_pair
+    from linkerd_tpu.grpc.dispatch import ClientDispatcher
+    from linkerd_tpu.protocol.h2.messages import H2Response
+    from linkerd_tpu.router.service import FnService
+
+    async def proxy_503(req):
+        return H2Response(status=503, body=b"<html>overloaded</html>")
+
+    async def go():
+        client = ClientDispatcher(FnService(proxy_503))
+        with pytest.raises(GrpcError) as ei:
+            await client.unary(SVC, "Say", Echo(text="x"))
+        assert ei.value.status.code == 14  # UNAVAILABLE
+
+    loop.run_until_complete(go())

@@ -1,0 +1,442 @@
+"""namerd control plane: store, mesh iface, HTTP control API, mesh client.
+
+Mirrors the reference's namerd tests: InMemoryDtabStore CAS semantics,
+mesh iface streaming (namerd/iface/mesh), control-http CRUD/bind/addr
+(namerd/iface/control-http/.../HttpControlServiceTest style), and the
+io.l5d.mesh interpreter client with reconnect (interpreter/mesh).
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from linkerd_tpu.core import Dtab, Path, Var
+from linkerd_tpu.core.activity import Ok
+from linkerd_tpu.core.addr import Bound
+from linkerd_tpu.grpc import ClientDispatcher, GrpcError
+from linkerd_tpu.grpc.status import NOT_FOUND
+from linkerd_tpu.interpreter.mesh import MeshClientInterpreter
+from linkerd_tpu.mesh import (
+    DELEGATOR_SVC, INTERPRETER_SVC, RESOLVER_SVC, converters, messages as m,
+)
+from linkerd_tpu.namer.fs import FsNamer
+from linkerd_tpu.namerd import (
+    DtabNamespaceAlreadyExists, DtabVersionMismatch, InMemoryDtabStore,
+    Namerd,
+)
+from linkerd_tpu.namerd.http_api import HttpControlService
+from linkerd_tpu.namerd.mesh_iface import MeshIface
+from linkerd_tpu.namerd.store import FsDtabStore
+from linkerd_tpu.protocol.h2.client import H2Client
+from linkerd_tpu.protocol.h2.server import H2Server
+from linkerd_tpu.protocol.http.message import Headers, Request
+from linkerd_tpu.protocol.http.server import HttpServer
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+# ---- store -----------------------------------------------------------------
+
+class TestInMemoryStore:
+    def test_crud_and_cas(self):
+        async def go():
+            store = InMemoryDtabStore()
+            await store.create("default", Dtab.read("/svc => /#/io.l5d.fs;"))
+            with pytest.raises(DtabNamespaceAlreadyExists):
+                await store.create("default", Dtab.empty())
+            vd = await store.observe("default").to_future()
+            assert "/svc" in vd.dtab.show
+
+            # CAS with stale version fails
+            with pytest.raises(DtabVersionMismatch):
+                await store.update("default", Dtab.empty(), b"bogus")
+            await store.update("default",
+                               Dtab.read("/svc => /#/other;"), vd.version)
+            vd2 = await store.observe("default").to_future()
+            assert vd2.version != vd.version
+            assert "/#/other" in vd2.dtab.show
+
+            # observe is live
+            states = []
+            obs = store.observe("default")
+            obs.states.observe(lambda st: states.append(st))
+            await store.put("default", Dtab.read("/svc => /#/third;"))
+            assert isinstance(states[-1], Ok)
+            assert "/#/third" in states[-1].value.dtab.show
+
+            assert store.list().sample() == frozenset({"default"})
+            await store.delete("default")
+            assert store.list().sample() == frozenset()
+        run(go())
+
+    def test_fs_store_persists(self, tmp_path):
+        async def go():
+            store = FsDtabStore(str(tmp_path))
+            await store.create("prod", Dtab.read("/svc => /#/io.l5d.fs;"))
+            store2 = FsDtabStore(str(tmp_path))
+            vd = await store2.observe("prod").to_future()
+            assert "/svc" in vd.dtab.show
+        run(go())
+
+
+# ---- proto converters ------------------------------------------------------
+
+def test_dtab_proto_roundtrip():
+    dtab = Dtab.read("/svc/* => /#/io.l5d.fs | /$/fail; /x => /y & /z;")
+    back = converters.dtab_from_proto(
+        m.MDtab.decode(converters.dtab_to_proto(dtab).encode()))
+    assert back.show == dtab.show
+
+
+# ---- end-to-end: namerd serving mesh + control-http ------------------------
+
+def _mk_namerd(disco_dir) -> Namerd:
+    store = InMemoryDtabStore(
+        {"default": Dtab.read("/svc => /#/io.l5d.fs;")})
+    namer = FsNamer(str(disco_dir), poll_interval=0.05)
+    return Namerd(store, [(Path.read("/io.l5d.fs"), namer)])
+
+
+@pytest.fixture
+def disco(tmp_path):
+    d = tmp_path / "disco"
+    d.mkdir()
+    (d / "web").write_text("127.0.0.1 8080\n127.0.0.1 8081\n")
+    return d
+
+
+class TestMeshIface:
+    def test_get_and_stream_bound_tree(self, disco):
+        async def go():
+            namerd = _mk_namerd(disco)
+            server = await H2Server(MeshIface(namerd).dispatcher).start()
+            client = ClientDispatcher(
+                H2Client("127.0.0.1", server.bound_port))
+
+            req = m.MBindReq(
+                root=converters.path_to_proto(Path.read("/default")),
+                name=converters.path_to_proto(Path.read("/svc/web")))
+            rsp = await client.unary(INTERPRETER_SVC, "GetBoundTree", req)
+            assert rsp.tree.leaf is not None
+            assert converters.path_from_proto(
+                rsp.tree.leaf.id).show == "/#/io.l5d.fs/web"
+
+            # dtab switch re-streams the bound tree
+            stream = await client.server_stream(
+                INTERPRETER_SVC, "StreamBoundTree", req)
+            first = await stream.recv()
+            assert first.tree.leaf is not None
+            vd = await namerd.store.observe("default").to_future()
+            await namerd.store.update(
+                "default", Dtab.read("/svc => /$/fail;"), vd.version)
+            second = await asyncio.wait_for(stream.recv(), 5)
+            assert second.tree.fail is not None
+
+            await server.close()
+            await namerd.close()
+        run(go())
+
+    def test_resolver_streams_addr_churn(self, disco):
+        async def go():
+            namerd = _mk_namerd(disco)
+            server = await H2Server(MeshIface(namerd).dispatcher).start()
+            client = ClientDispatcher(
+                H2Client("127.0.0.1", server.bound_port))
+
+            req = m.MReplicasReq(id=converters.path_to_proto(
+                Path.read("/#/io.l5d.fs/web")))
+            rep = await client.unary(RESOLVER_SVC, "GetReplicas", req)
+            assert rep.bound is not None
+            ports = sorted(ep.port for ep in rep.bound.endpoints)
+            assert ports == [8080, 8081]
+
+            stream = await client.server_stream(
+                RESOLVER_SVC, "StreamReplicas", req)
+            first = await asyncio.wait_for(stream.recv(), 5)
+            assert first.bound is not None
+            (disco / "web").write_text("127.0.0.1 9090\n")
+            second = await asyncio.wait_for(stream.recv(), 5)
+            assert [ep.port for ep in second.bound.endpoints] == [9090]
+
+            await server.close()
+            await namerd.close()
+        run(go())
+
+    def test_delegator_dtab(self, disco):
+        async def go():
+            namerd = _mk_namerd(disco)
+            server = await H2Server(MeshIface(namerd).dispatcher).start()
+            client = ClientDispatcher(
+                H2Client("127.0.0.1", server.bound_port))
+            rsp = await client.unary(
+                DELEGATOR_SVC, "GetDtab",
+                m.MDtabReq(root=converters.path_to_proto(
+                    Path.read("/default"))))
+            dtab = converters.dtab_from_proto(rsp.dtab.dtab)
+            assert "/#/io.l5d.fs" in dtab.show
+            with pytest.raises(GrpcError) as ei:
+                await client.unary(
+                    DELEGATOR_SVC, "GetDtab",
+                    m.MDtabReq(root=converters.path_to_proto(
+                        Path.read("/nope"))))
+            assert ei.value.status.code == NOT_FOUND
+            await server.close()
+            await namerd.close()
+        run(go())
+
+
+class TestMeshInterpreterClient:
+    def test_bind_via_remote_namerd_with_live_addrs(self, disco):
+        async def go():
+            namerd = _mk_namerd(disco)
+            server = await H2Server(MeshIface(namerd).dispatcher).start()
+            interp = MeshClientInterpreter(
+                "127.0.0.1", server.bound_port, root="default",
+                backoff_base=0.05, backoff_max=0.2)
+
+            act = interp.bind(Dtab.empty(), Path.read("/svc/web"))
+            tree = await asyncio.wait_for(act.to_future(), 5)
+            from linkerd_tpu.core.nametree import Leaf
+            assert isinstance(tree, Leaf)
+            bn = tree.value
+            assert bn.id_.show == "/#/io.l5d.fs/web"
+
+            # addr var fed by StreamReplicas
+            for _ in range(100):
+                if isinstance(bn.addr.sample(), Bound):
+                    break
+                await asyncio.sleep(0.05)
+            addr = bn.addr.sample()
+            assert isinstance(addr, Bound)
+            assert sorted(a.port for a in addr.addresses) == [8080, 8081]
+
+            # file edit -> namerd fs namer -> resolver stream -> client var
+            (disco / "web").write_text("127.0.0.1 7070\n")
+            for _ in range(100):
+                a = bn.addr.sample()
+                if isinstance(a, Bound) and \
+                        sorted(x.port for x in a.addresses) == [7070]:
+                    break
+                await asyncio.sleep(0.05)
+            assert sorted(x.port for x in bn.addr.sample().addresses) == [7070]
+
+            await interp.aclose()
+            await server.close()
+            await namerd.close()
+        run(go())
+
+
+# ---- HTTP control API ------------------------------------------------------
+
+async def _http_get(port: int, uri: str, headers=None):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    hdrs = "".join(f"{k}: {v}\r\n" for k, v in (headers or {}).items())
+    writer.write(f"GET {uri} HTTP/1.1\r\nHost: t\r\n{hdrs}"
+                 f"Connection: close\r\n\r\n".encode())
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    status = int(head.split(b" ", 2)[1])
+    hdr_map = {}
+    for line in head.split(b"\r\n")[1:]:
+        k, _, v = line.partition(b": ")
+        hdr_map[k.decode().lower()] = v.decode()
+    if hdr_map.get("transfer-encoding") == "chunked":
+        # de-chunk
+        out = b""
+        rest = body
+        while rest:
+            ln, _, rest = rest.partition(b"\r\n")
+            n = int(ln, 16)
+            if n == 0:
+                break
+            out += rest[:n]
+            rest = rest[n + 2:]
+        body = out
+    return status, hdr_map, body
+
+
+async def _http_req(port: int, method: str, uri: str, body: bytes = b"",
+                    headers=None):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    hdrs = "".join(f"{k}: {v}\r\n" for k, v in (headers or {}).items())
+    writer.write(
+        f"{method} {uri} HTTP/1.1\r\nHost: t\r\n{hdrs}"
+        f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n".encode()
+        + body)
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, _, rbody = raw.partition(b"\r\n\r\n")
+    return int(head.split(b" ", 2)[1]), rbody
+
+
+class TestHttpControlApi:
+    def test_dtab_crud_and_bind(self, disco):
+        async def go():
+            namerd = _mk_namerd(disco)
+            server = await HttpServer(HttpControlService(namerd)).start()
+            port = server.bound_port
+
+            status, hdrs, body = await _http_get(port, "/api/1/dtabs")
+            assert status == 200 and json.loads(body) == ["default"]
+
+            status, hdrs, body = await _http_get(port, "/api/1/dtabs/default")
+            assert status == 200
+            assert json.loads(body) == [
+                {"prefix": "/svc", "dst": "/#/io.l5d.fs"}]
+            etag = hdrs["etag"]
+
+            # CAS PUT with ETag
+            st, _ = await _http_req(
+                port, "PUT", "/api/1/dtabs/default",
+                b"/svc => /#/updated;",
+                headers={"If-Match": etag, "Content-Type": "application/dtab"})
+            assert st == 204
+            st, _ = await _http_req(
+                port, "PUT", "/api/1/dtabs/default", b"/svc => /#/x;",
+                headers={"If-Match": etag})
+            assert st == 412  # stale version
+
+            # create + delete
+            st, _ = await _http_req(port, "POST", "/api/1/dtabs/stage",
+                                    b"/svc => /$/fail;")
+            assert st == 204
+            st, _ = await _http_req(port, "POST", "/api/1/dtabs/stage", b"")
+            assert st == 409
+            st, _ = await _http_req(port, "DELETE", "/api/1/dtabs/stage")
+            assert st == 204
+            st, _ = await _http_req(port, "DELETE", "/api/1/dtabs/stage")
+            assert st == 404
+
+            # bind + addr
+            status, _, body = await _http_get(
+                port, "/api/1/dtabs/default")
+            assert json.loads(body)[0]["dst"] == "/#/updated"
+            st, _ = await _http_req(
+                port, "PUT", "/api/1/dtabs/default",
+                b"/svc => /#/io.l5d.fs;")
+            status, _, body = await _http_get(
+                port, "/api/1/bind/default?path=/svc/web")
+            tree = json.loads(body)
+            assert tree["type"] == "leaf" and tree["id"] == "/#/io.l5d.fs/web"
+
+            status, _, body = await _http_get(
+                port, "/api/1/addr/default?path=/svc/web")
+            addr = json.loads(body)
+            assert addr["type"] == "bound"
+            assert sorted(a["port"] for a in addr["addrs"]) == [8080, 8081]
+
+            await server.close()
+            await namerd.close()
+        run(go())
+
+    def test_watch_streams_dtab_updates(self, disco):
+        async def go():
+            namerd = _mk_namerd(disco)
+            server = await HttpServer(HttpControlService(namerd)).start()
+            port = server.bound_port
+
+            async def watch():
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port)
+                writer.write(b"GET /api/1/dtabs/default?watch=true "
+                             b"HTTP/1.1\r\nHost: t\r\n\r\n")
+                await writer.drain()
+                lines = []
+                # skip headers
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b""):
+                        break
+                # read 2 chunks (initial + updated)
+                while len(lines) < 2:
+                    ln = await reader.readline()  # chunk size
+                    if not ln.strip():
+                        continue
+                    n = int(ln, 16)
+                    data = await reader.readexactly(n)
+                    await reader.readline()
+                    lines.append(json.loads(data))
+                writer.close()
+                return lines
+
+            task = asyncio.ensure_future(watch())
+            await asyncio.sleep(0.2)
+            await namerd.store.put(
+                "default", Dtab.read("/svc => /#/flipped;"))
+            lines = await asyncio.wait_for(task, 10)
+            assert lines[0][0]["dst"] == "/#/io.l5d.fs"
+            assert lines[1][0]["dst"] == "/#/flipped"
+
+            await server.close()
+            await namerd.close()
+        run(go())
+
+
+# ---- full loop: linkerd router -> mesh interpreter -> namerd ---------------
+
+class TestLinkerdViaNamerd:
+    def test_router_binds_through_namerd_and_dtab_flip_reroutes(self, disco):
+        """The reference validator scenario (validator/.../Validator.scala):
+        traffic through linkerd, dtab flipped in namerd, re-routes live."""
+        from linkerd_tpu.linker import load_linker
+        from linkerd_tpu.protocol.http import Request, Response
+        from linkerd_tpu.protocol.http.client import HttpClient
+        from linkerd_tpu.protocol.http.server import serve
+        from linkerd_tpu.router.service import FnService
+
+        def downstream(name):
+            async def handler(req):
+                return Response(status=200, body=name.encode())
+            return FnService(handler)
+
+        async def go():
+            d_a = await serve(downstream("A"))
+            d_b = await serve(downstream("B"))
+            (disco / "web").write_text(f"127.0.0.1 {d_a.bound_port}\n")
+            (disco / "web2").write_text(f"127.0.0.1 {d_b.bound_port}\n")
+
+            namerd = _mk_namerd(disco)
+            mesh_srv = await H2Server(MeshIface(namerd).dispatcher).start()
+
+            cfg = f"""
+routers:
+- protocol: http
+  label: out
+  interpreter:
+    kind: io.l5d.mesh
+    dst: /$/inet/127.0.0.1/{mesh_srv.bound_port}
+    root: /default
+  servers:
+  - port: 0
+"""
+            linker = load_linker(cfg)
+            await linker.start()
+            proxy = HttpClient("127.0.0.1",
+                               linker.routers[0].server_ports[0])
+            try:
+                req = Request(uri="/")
+                req.headers.set("Host", "web")
+                r = await proxy(req)
+                assert (r.status, r.body) == (200, b"A")
+
+                # flip the dtab in namerd -> routes to web2 (B), live
+                await namerd.store.put(
+                    "default", Dtab.read("/svc/web => /#/io.l5d.fs/web2;"))
+                for _ in range(100):
+                    r = await proxy(req)
+                    if r.body == b"B":
+                        break
+                    await asyncio.sleep(0.05)
+                assert r.body == b"B"
+            finally:
+                await proxy.close()
+                await linker.close()
+                await mesh_srv.close()
+                await namerd.close()
+        run(go())
