@@ -47,6 +47,8 @@ from linkerd_tpu.telemetry.telemeter import BroadcastTracer, NullTracer
 # Ensure built-in plugin registrations are loaded.
 import linkerd_tpu.interpreter.configs  # noqa: F401
 import linkerd_tpu.namer.fs  # noqa: F401
+import linkerd_tpu.protocol.h2.classifiers  # noqa: F401
+import linkerd_tpu.protocol.h2.identifiers  # noqa: F401
 import linkerd_tpu.protocol.http.identifiers  # noqa: F401
 import linkerd_tpu.router.classifiers  # noqa: F401
 import linkerd_tpu.router.failure_accrual  # noqa: F401
@@ -292,7 +294,7 @@ class Linker:
 
         labels_seen: Dict[str, int] = {}
         for rspec in self.spec.routers:
-            if rspec.protocol != "http":
+            if rspec.protocol not in ("http", "h2"):
                 raise ConfigError(
                     f"protocol {rspec.protocol!r} not yet supported")
             label = rspec.label or rspec.protocol
@@ -300,7 +302,10 @@ class Linker:
             labels_seen[label] = n + 1
             if n:
                 label = f"{label}-{n}"
-            self.routers.append(self._mk_http_router(rspec, label))
+            if rspec.protocol == "h2":
+                self.routers.append(self._mk_h2_router(rspec, label))
+            else:
+                self.routers.append(self._mk_http_router(rspec, label))
 
         # port-conflict check (ref: Linker.scala:189-195)
         ports = [
@@ -310,6 +315,170 @@ class Linker:
         ]
         if len(ports) != len(set(ports)):
             raise ConfigError(f"server port conflict: {ports}")
+
+    # -- shared router assembly helpers (http + h2) -----------------------
+    def _mk_interpreter(self, rspec: RouterSpec, label: str):
+        if rspec.interpreter is not None:
+            return instantiate(
+                "interpreter", rspec.interpreter,
+                f"{label}.interpreter").mk(self.namers)
+        return ConfiguredDtabNamer(self.namers)
+
+    def _mk_client_validator(self, label: str):
+        def validate_client(spec: ClientSpec, var_names=frozenset()) -> None:
+            if spec.failureAccrual is not None:
+                instantiate("failureAccrual", spec.failureAccrual,
+                            f"{label}.failureAccrual")
+            if spec.loadBalancer is not None:
+                from linkerd_tpu.router.balancer import BALANCER_KINDS
+                if spec.loadBalancer.kind not in BALANCER_KINDS:
+                    raise ConfigError(
+                        f"{label}.client: unknown balancer kind "
+                        f"{spec.loadBalancer.kind!r} "
+                        f"(known: {sorted(BALANCER_KINDS)})")
+            if spec.tls is not None:
+                spec.tls.validate(var_names)
+        return validate_client
+
+    def _mk_policy_factory_fn(self, label: str):
+        def mk_policy_factory(cspec: ClientSpec):
+            fa_cfg = cspec.failureAccrual or {
+                "kind": "io.l5d.consecutiveFailures"}
+            fa_config = instantiate(
+                "failureAccrual", fa_cfg, f"{label}.failureAccrual")
+            if getattr(fa_config, "needs_board", False):
+                board = self._anomaly_board()
+                return lambda: fa_config.mk(board)
+            return fa_config.mk
+        return mk_policy_factory
+
+    @staticmethod
+    def _mk_backoffs(sspec: SvcSpec) -> List[float]:
+        bspec = (sspec.retries.backoff if sspec.retries else None)
+        max_retries = sspec.retries.maxRetries if sspec.retries else 25
+        if bspec is None:
+            return [0.0] * max_retries
+        if bspec.kind == "constant":
+            return [bspec.ms / 1e3] * max_retries
+        import itertools
+        return list(itertools.islice(
+            backoff_jittered(bspec.minMs / 1e3, bspec.maxMs / 1e3),
+            max_retries))
+
+    def _mk_h2_router(self, rspec: RouterSpec, label: str) -> Router:
+        """h2 router: stream-aware stats/retries/classification
+        (ref: router/h2 H2.scala:16-105 + linkerd/protocol/h2 H2Config)."""
+        from linkerd_tpu.protocol.h2.client import H2Client
+        from linkerd_tpu.protocol.h2.server import H2Server
+        from linkerd_tpu.router.h2_layer import (
+            H2ClassifiedRetries, H2ErrorResponder, H2StreamStatsFilter,
+        )
+
+        base_dtab = Dtab.read(rspec.dtab) if rspec.dtab else Dtab.empty()
+        prefix = Path.read(rspec.dstPrefix)
+
+        id_cfgs = rspec.identifier
+        if id_cfgs is None:
+            id_cfgs = [{"kind": "io.l5d.header.token"}]
+        elif isinstance(id_cfgs, dict):
+            id_cfgs = [id_cfgs]
+        identifiers = [
+            instantiate("h2identifier", c, f"{label}.identifier")
+            .mk(prefix, base_dtab)
+            for c in id_cfgs
+        ]
+        identifier = compose_identifiers(identifiers)
+        interpreter = self._mk_interpreter(rspec, label)
+
+        def validate_svc(spec: SvcSpec, var_names=frozenset()) -> None:
+            if spec.responseClassifier is not None:
+                instantiate("h2classifier", spec.responseClassifier,
+                            f"{label}.responseClassifier")
+
+        client_lookup = per_prefix_lookup(
+            rspec.client, ClientSpec, f"{label}.client",
+            self._mk_client_validator(label))
+        metrics = self.metrics
+        mk_policy_factory = self._mk_policy_factory_fn(label)
+
+        def client_factory(bound: BoundName) -> Service:
+            cid = bound.id_.show.lstrip("/").replace("/", ".") or "client"
+            cspec, cvars = client_lookup(bound.id_)
+            mk_policy = mk_policy_factory(cspec)
+            ssl_ctx = sni = None
+            if cspec.tls is not None:
+                sni = cspec.tls.server_hostname(cvars)
+                ssl_ctx = cspec.tls.mk_context(sni)
+
+            def endpoint_factory(addr: Address) -> Service:
+                client: Service = H2Client(
+                    addr.host, addr.port,
+                    connect_timeout=cspec.connectTimeoutMs / 1e3,
+                    ssl_context=ssl_ctx, server_hostname=sni)
+                return FailureAccrualService(client, mk_policy())
+
+            bal_kind = (cspec.loadBalancer or BalancerSpec()).kind
+            bal = mk_balancer(bal_kind, bound.addr, endpoint_factory)
+            filters: List[Any] = [
+                H2StreamStatsFilter(metrics, "rt", label, "client", cid)]
+            metrics.scope("rt", label, "client", cid).gauge(
+                "endpoints", fn=lambda b=bal: b.size)
+            return _PruneOnClose(
+                filters_to_service(filters, bal), metrics,
+                ("rt", label, "client", cid))
+
+        svc_lookup = per_prefix_lookup(
+            rspec.service, SvcSpec, f"{label}.service", validate_svc)
+        mk_backoffs = self._mk_backoffs
+
+        def path_filters(dst: DstPath, svc: Service) -> Service:
+            sspec, _ = svc_lookup(dst.path)
+            classifier_cfg = sspec.responseClassifier or {
+                "kind": "io.l5d.h2.nonRetryable5XX"}
+            classifier = instantiate(
+                "h2classifier", classifier_cfg,
+                f"{label}.responseClassifier").mk()
+            budget_spec = (
+                sspec.retries.budget if sspec.retries else None) or BudgetSpec()
+            budget = RetryBudget(
+                budget_spec.ttlSecs, budget_spec.minRetriesPerSec,
+                budget_spec.percentCanRetry)
+            name = dst.path.show.lstrip("/").replace("/", ".") or "root"
+            filters: List[Any] = [
+                H2StreamStatsFilter(metrics, "rt", label, "service", name)]
+            if sspec.totalTimeoutMs is not None:
+                filters.append(TotalTimeout(sspec.totalTimeoutMs / 1e3))
+            filters.append(H2ClassifiedRetries(
+                classifier, budget, mk_backoffs(sspec),
+                max_retries=(sspec.retries.maxRetries
+                             if sspec.retries else 25),
+                metrics=metrics, scope=("rt", label, "service", name)))
+            return filters_to_service(filters, svc)
+
+        cache_cfg = rspec.bindingCache or {}
+        binding = DstBindingFactory(
+            interpreter, client_factory, path_filters=path_filters,
+            capacity=int(cache_cfg.get("capacity", 1000)),
+            idle_ttl=float(cache_cfg.get("idleTtlSecs", 600.0)),
+            bind_timeout=rspec.bindingTimeoutMs / 1e3)
+
+        routing = RoutingService(identifier, binding)
+        server_filters: List[Any] = [
+            H2StreamStatsFilter(metrics, "rt", label, "server"),
+        ]
+        for t in self.telemeters:
+            if hasattr(t, "recorder"):
+                server_filters.append(t.recorder())
+        server_filters.append(H2ErrorResponder())
+        server_stack = filters_to_service(server_filters, routing)
+
+        servers = [
+            H2Server(server_stack, s.ip, s.port,
+                     max_concurrency=s.maxConcurrentRequests,
+                     ssl_context=(s.tls.mk_context() if s.tls else None))
+            for s in (rspec.servers or [ServerSpec()])
+        ]
+        return Router(rspec, label, server_stack, binding, servers)
 
     def _mk_http_router(self, rspec: RouterSpec, label: str) -> Router:
         base_dtab = Dtab.read(rspec.dtab) if rspec.dtab else Dtab.empty()
@@ -326,27 +495,7 @@ class Linker:
             for c in id_cfgs
         ]
         identifier = compose_identifiers(identifiers)
-
-        if rspec.interpreter is not None:
-            interpreter = instantiate(
-                "interpreter", rspec.interpreter,
-                f"{label}.interpreter").mk(self.namers)
-        else:
-            interpreter = ConfiguredDtabNamer(self.namers)
-
-        def validate_client(spec: ClientSpec, var_names=frozenset()) -> None:
-            if spec.failureAccrual is not None:
-                instantiate("failureAccrual", spec.failureAccrual,
-                            f"{label}.failureAccrual")
-            if spec.loadBalancer is not None:
-                from linkerd_tpu.router.balancer import BALANCER_KINDS
-                if spec.loadBalancer.kind not in BALANCER_KINDS:
-                    raise ConfigError(
-                        f"{label}.client: unknown balancer kind "
-                        f"{spec.loadBalancer.kind!r} "
-                        f"(known: {sorted(BALANCER_KINDS)})")
-            if spec.tls is not None:
-                spec.tls.validate(var_names)
+        interpreter = self._mk_interpreter(rspec, label)
 
         def validate_svc(spec: SvcSpec, var_names=frozenset()) -> None:
             if spec.responseClassifier is not None:
@@ -354,18 +503,10 @@ class Linker:
                             f"{label}.responseClassifier")
 
         client_lookup = per_prefix_lookup(
-            rspec.client, ClientSpec, f"{label}.client", validate_client)
+            rspec.client, ClientSpec, f"{label}.client",
+            self._mk_client_validator(label))
         metrics = self.metrics
-
-        def mk_policy_factory(cspec: ClientSpec):
-            fa_cfg = cspec.failureAccrual or {
-                "kind": "io.l5d.consecutiveFailures"}
-            fa_config = instantiate(
-                "failureAccrual", fa_cfg, f"{label}.failureAccrual")
-            if getattr(fa_config, "needs_board", False):
-                board = self._anomaly_board()
-                return lambda: fa_config.mk(board)
-            return fa_config.mk
+        mk_policy_factory = self._mk_policy_factory_fn(label)
 
         def client_factory(bound: BoundName) -> Service:
             cid = bound.id_.show.lstrip("/").replace("/", ".") or "client"
@@ -403,18 +544,7 @@ class Linker:
 
         svc_lookup = per_prefix_lookup(
             rspec.service, SvcSpec, f"{label}.service", validate_svc)
-
-        def mk_backoffs(sspec: SvcSpec) -> List[float]:
-            bspec = (sspec.retries.backoff if sspec.retries else None)
-            max_retries = sspec.retries.maxRetries if sspec.retries else 25
-            if bspec is None:
-                return [0.0] * max_retries
-            if bspec.kind == "constant":
-                return [bspec.ms / 1e3] * max_retries
-            import itertools
-            return list(itertools.islice(
-                backoff_jittered(bspec.minMs / 1e3, bspec.maxMs / 1e3),
-                max_retries))
+        mk_backoffs = self._mk_backoffs
 
         def path_filters(dst: DstPath, svc: Service) -> Service:
             # path stack order (ref: Router.scala:321-362): stats ->

@@ -1,0 +1,171 @@
+"""Stream-aware h2 response classification, including gRPC.
+
+Ref: finagle/h2 service/H2Classifiers.scala (classification over
+``H2ReqRepFrame`` — a response is judged on its *final frame*, because for
+gRPC success/failure lives in the ``grpc-status`` trailer) and
+linkerd/protocol/h2 grpc/GrpcClassifier.scala:77 (kinds
+``io.l5d.h2.grpc.{default,alwaysRetryable,neverRetryable,
+retryableStatusCodes}``).
+
+An H2Classifier has two phases:
+- ``early(req, rsp)``: a verdict from response headers alone, or None if
+  the stream end is needed (gRPC always needs trailers);
+- ``classify(req, rsp, trailers, exc)``: the final verdict.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from linkerd_tpu.config import register
+from linkerd_tpu.protocol.h2.messages import H2Request, H2Response
+from linkerd_tpu.protocol.h2.stream import Trailers
+from linkerd_tpu.router.classifiers import ResponseClass
+
+GRPC_STATUS = "grpc-status"
+# gRPC codes the default classifier deems safe to retry
+# (GrpcClassifier.scala default: UNAVAILABLE)
+RETRYABLE_GRPC_CODES = frozenset({14})
+
+IDEMPOTENT_METHODS = frozenset(
+    {"GET", "HEAD", "OPTIONS", "TRACE", "PUT", "DELETE"})
+READ_METHODS = frozenset({"GET", "HEAD", "OPTIONS", "TRACE"})
+
+
+class H2Classifier:
+    def early(self, req: H2Request,
+              rsp: Optional[H2Response]) -> Optional[ResponseClass]:
+        """Verdict from headers alone, or None to wait for stream end."""
+        return None
+
+    def classify(self, req: H2Request, rsp: Optional[H2Response],
+                 trailers: Optional[Trailers],
+                 exc: Optional[BaseException]) -> ResponseClass:
+        raise NotImplementedError
+
+
+def _grpc_code(rsp: Optional[H2Response],
+               trailers: Optional[Trailers]) -> Optional[int]:
+    """grpc-status from trailers, or headers (Trailers-Only)."""
+    raw = None
+    if trailers is not None:
+        for k, v in trailers.headers:
+            if k == GRPC_STATUS:
+                raw = v
+    if raw is None and rsp is not None:
+        raw = rsp.headers.get(GRPC_STATUS)
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+class _StatusClassifier(H2Classifier):
+    """HTTP-status based classification; retryability by method policy."""
+
+    def __init__(self, retryable_methods: frozenset):
+        self._retryable = retryable_methods
+
+    def early(self, req, rsp):
+        if rsp is None:
+            return None
+        if rsp.status < 500:
+            return ResponseClass.SUCCESS
+        if req.method in self._retryable:
+            return ResponseClass.RETRYABLE_FAILURE
+        return ResponseClass.FAILURE
+
+    def classify(self, req, rsp, trailers, exc):
+        if exc is not None:
+            return (ResponseClass.RETRYABLE_FAILURE
+                    if req.method in self._retryable
+                    else ResponseClass.FAILURE)
+        got = self.early(req, rsp)
+        assert got is not None
+        return got
+
+
+@register("h2classifier", "io.l5d.h2.nonRetryable5XX")
+@dataclass
+class H2NonRetryable5XX:
+    def mk(self) -> H2Classifier:
+        return _StatusClassifier(frozenset())
+
+
+@register("h2classifier", "io.l5d.h2.retryableRead5XX")
+@dataclass
+class H2RetryableRead5XX:
+    def mk(self) -> H2Classifier:
+        return _StatusClassifier(READ_METHODS)
+
+
+@register("h2classifier", "io.l5d.h2.retryableIdempotent5XX")
+@dataclass
+class H2RetryableIdempotent5XX:
+    def mk(self) -> H2Classifier:
+        return _StatusClassifier(IDEMPOTENT_METHODS)
+
+
+class _GrpcClassifier(H2Classifier):
+    """Success iff grpc-status == 0; retryability of failures per policy.
+    Falls back to HTTP-status classification for non-gRPC responses."""
+
+    def __init__(self, retryable_codes: frozenset, always: bool = False,
+                 never: bool = False):
+        self._codes = retryable_codes
+        self._always = always
+        self._never = never
+
+    def _failure(self, code: int) -> ResponseClass:
+        if self._never:
+            return ResponseClass.FAILURE
+        if self._always or code in self._codes:
+            return ResponseClass.RETRYABLE_FAILURE
+        return ResponseClass.FAILURE
+
+    def classify(self, req, rsp, trailers, exc):
+        if exc is not None:
+            return (ResponseClass.RETRYABLE_FAILURE if self._always
+                    else ResponseClass.FAILURE)
+        code = _grpc_code(rsp, trailers)
+        if code is None:
+            # not gRPC: treat like HTTP status
+            if rsp is not None and rsp.status < 500:
+                return ResponseClass.SUCCESS
+            return self._failure(-1)
+        if code == 0:
+            return ResponseClass.SUCCESS
+        return self._failure(code)
+
+
+@register("h2classifier", "io.l5d.h2.grpc.default")
+@dataclass
+class GrpcDefault:
+    def mk(self) -> H2Classifier:
+        return _GrpcClassifier(RETRYABLE_GRPC_CODES)
+
+
+@register("h2classifier", "io.l5d.h2.grpc.alwaysRetryable")
+@dataclass
+class GrpcAlwaysRetryable:
+    def mk(self) -> H2Classifier:
+        return _GrpcClassifier(frozenset(), always=True)
+
+
+@register("h2classifier", "io.l5d.h2.grpc.neverRetryable")
+@dataclass
+class GrpcNeverRetryable:
+    def mk(self) -> H2Classifier:
+        return _GrpcClassifier(frozenset(), never=True)
+
+
+@register("h2classifier", "io.l5d.h2.grpc.retryableStatusCodes")
+@dataclass
+class GrpcRetryableStatusCodes:
+    retryableStatusCodes: List[int] = field(default_factory=list)
+
+    def mk(self) -> H2Classifier:
+        return _GrpcClassifier(frozenset(self.retryableStatusCodes))

@@ -1,0 +1,72 @@
+"""h2 request identifiers: H2Request -> logical Dst path.
+
+Ref: linkerd/protocol/h2 identifiers — HeaderTokenIdentifier (default
+``:authority``, H2Config.scala identifier default) and HeaderPathIdentifier.
+Registered under the ``h2identifier`` category; the h2 router's default is
+``io.l5d.header.token``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from linkerd_tpu.config import register
+from linkerd_tpu.core import Dtab, Path
+from linkerd_tpu.protocol.h2.messages import H2Request
+from linkerd_tpu.router.binding import DstPath
+from linkerd_tpu.router.routing import (
+    DTAB_HEADER, IdentificationError, Identifier,
+)
+
+
+def _local_dtab(req: H2Request) -> Dtab:
+    raw = req.headers.get_all(DTAB_HEADER)
+    if not raw:
+        return Dtab.empty()
+    try:
+        return Dtab.read(";".join(raw))
+    except ValueError as e:
+        raise IdentificationError(f"bad {DTAB_HEADER} header: {e}") from None
+
+
+@register("h2identifier", "io.l5d.header.token")
+@dataclass
+class H2HeaderTokenIdentifier:
+    """``/<prefix>/<token>`` from a header; default ``:authority``
+    (ref: HeaderTokenIdentifier.scala — the h2 default)."""
+
+    header: str = ":authority"
+
+    def mk(self, prefix: Path, base_dtab: Dtab) -> Identifier:
+        def identify(req: H2Request) -> DstPath:
+            if self.header == ":authority":
+                token = (req.authority or "").split(":", 1)[0].lower()
+            else:
+                token = req.headers.get(self.header.lower()) or ""
+            if not token:
+                raise IdentificationError(f"no {self.header} header")
+            p = Path.read(token) if token.startswith("/") else Path.of(token)
+            return DstPath(prefix + p, base_dtab, _local_dtab(req))
+
+        return identify
+
+
+@register("h2identifier", "io.l5d.header.path")
+@dataclass
+class H2HeaderPathIdentifier:
+    """``/<prefix>/<first-N-:path-segments>``
+    (ref: HeaderPathIdentifier.scala)."""
+
+    segments: int = 1
+
+    def mk(self, prefix: Path, base_dtab: Dtab) -> Identifier:
+        def identify(req: H2Request) -> DstPath:
+            path_part = req.path.split("?", 1)[0]
+            segs = [s for s in path_part.split("/") if s]
+            if len(segs) < self.segments:
+                raise IdentificationError(
+                    f":path has fewer than {self.segments} segments")
+            return DstPath(prefix + Path(segs[:self.segments]),
+                           base_dtab, _local_dtab(req))
+
+        return identify

@@ -21,7 +21,8 @@ log = logging.getLogger(__name__)
 class H2Server:
     def __init__(self, service: Service[H2Request, H2Response],
                  host: str = "127.0.0.1", port: int = 0,
-                 ssl_context=None):
+                 ssl_context=None,
+                 max_concurrency: Optional[int] = None):
         self.service = service
         self.host = host
         self.port = port
@@ -30,6 +31,10 @@ class H2Server:
         self.ssl_context = ssl_context
         self._server: Optional[asyncio.base_events.Server] = None
         self._conns: set = set()
+        # admission control (ref: maxConcurrentRequests ->
+        # RequestSemaphoreFilter, Server.scala:89-97)
+        self._sem = (asyncio.Semaphore(max_concurrency)
+                     if max_concurrency else None)
 
     @property
     def bound_port(self) -> int:
@@ -69,6 +74,11 @@ class H2Server:
 
     async def _dispatch(self, req: H2Request) -> H2Response:
         try:
+            if self._sem is not None:
+                if self._sem.locked():
+                    return H2Response(status=503, body=b"too many requests")
+                async with self._sem:
+                    return await self.service(req)
             return await self.service(req)
         except Exception as e:  # noqa: BLE001 — last-resort responder
             log.debug("h2 service error: %r", e)

@@ -232,6 +232,20 @@ class BufferedStream:
             self._buffer.append(item)
             self._buffered_bytes += size
 
+    def release_buffer(self) -> None:
+        """Stop buffering (no further forks) but keep pumping live frames
+        to existing forks — used once a response is committed and replay
+        will never be needed (ref: BufferedStream discardBuffer)."""
+        self.overflowed = True
+        self._buffer.clear()
+        self._buffered_bytes = 0
+
+    def unfork(self, stream: H2Stream) -> None:
+        """Detach an abandoned consumer (e.g. a failed attempt's request
+        stream) so its queue stops accumulating frames."""
+        if stream in self._forks:
+            self._forks.remove(stream)
+
     async def close(self) -> None:
         if self._pump_task is not None:
             self._pump_task.cancel()

@@ -1,0 +1,189 @@
+"""h2 router end-to-end: YAML linker, h2 downstreams, gRPC through proxy.
+
+Mirrors the reference's router/h2 e2e suite
+(router/h2/src/e2e/.../H2EndToEndTest, RetriesEndToEndTest) and the gRPC
+classifier behavior (linkerd/protocol/h2 grpc/GrpcClassifier.scala).
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from linkerd_tpu.grpc import (
+    ClientDispatcher, Field, GrpcError, ProtoMessage, Rpc, ServerDispatcher,
+    ServiceDef,
+)
+from linkerd_tpu.linker import load_linker
+from linkerd_tpu.protocol.h2.client import H2Client
+from linkerd_tpu.protocol.h2.messages import H2Request, H2Response
+from linkerd_tpu.protocol.h2.server import H2Server
+from linkerd_tpu.router.service import FnService
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+def h2_downstream(name: str):
+    async def handler(req: H2Request) -> H2Response:
+        body, _ = await req.stream.read_all()
+        return H2Response(status=200, body=f"{name}:{body.decode()}".encode())
+    return FnService(handler)
+
+
+def mk_cfg(disco, extra_svc: str = "") -> str:
+    return f"""
+routers:
+- protocol: h2
+  label: h2out
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers:
+  - port: 0
+{extra_svc}
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+
+
+@pytest.fixture
+def disco(tmp_path):
+    d = tmp_path / "disco"
+    d.mkdir()
+    return d
+
+
+class TestH2Router:
+    def test_routes_by_authority(self, disco):
+        async def go():
+            d_a = await H2Server(h2_downstream("svc-a")).start()
+            (disco / "web").write_text(f"127.0.0.1 {d_a.bound_port}\n")
+            linker = load_linker(mk_cfg(disco))
+            await linker.start()
+            proxy = H2Client("127.0.0.1", linker.routers[0].server_ports[0])
+            try:
+                req = H2Request(method="POST", path="/x", authority="web",
+                                body=b"hello")
+                rsp = await proxy(req)
+                body, _ = await rsp.stream.read_all()
+                assert (rsp.status, body) == (200, b"svc-a:hello")
+
+                # unknown authority -> 400 + l5d-err
+                bad = await proxy(H2Request(path="/", authority="nope"))
+                assert bad.status == 400
+                assert bad.headers.get("l5d-err") is not None
+
+                flat = linker.metrics.flatten()
+                assert flat["rt/h2out/server/requests"] == 2
+                assert flat["rt/h2out/server/status/200"] == 1
+                assert flat["rt/h2out/server/status/400"] == 1
+                assert flat["rt/h2out/service/svc.web/requests"] == 1
+            finally:
+                await proxy.close()
+                await linker.close()
+                await d_a.close()
+        run(go())
+
+    def test_retries_5xx_when_read_classifier(self, disco):
+        calls = {"n": 0}
+
+        async def flaky(req: H2Request) -> H2Response:
+            calls["n"] += 1
+            if calls["n"] < 3:
+                return H2Response(status=503, body=b"unavailable")
+            return H2Response(status=200, body=b"finally")
+
+        async def go():
+            d = await H2Server(FnService(flaky)).start()
+            (disco / "web").write_text(f"127.0.0.1 {d.bound_port}\n")
+            svc_cfg = """  service:
+    responseClassifier:
+      kind: io.l5d.h2.retryableRead5XX
+"""
+            linker = load_linker(mk_cfg(disco, svc_cfg))
+            await linker.start()
+            proxy = H2Client("127.0.0.1", linker.routers[0].server_ports[0])
+            try:
+                rsp = await proxy(H2Request(method="GET", path="/",
+                                            authority="web"))
+                body, _ = await rsp.stream.read_all()
+                assert (rsp.status, body) == (200, b"finally")
+                assert calls["n"] == 3
+                flat = linker.metrics.flatten()
+                assert flat["rt/h2out/service/svc.web/retries/total"] == 2
+            finally:
+                await proxy.close()
+                await linker.close()
+                await d.close()
+        run(go())
+
+
+class Ping(ProtoMessage):
+    FIELDS = {"text": Field(1, "string"), "fail_times": Field(2, "int32")}
+
+
+GRPC_SVC = ServiceDef("test.Pinger", [
+    Rpc("Ping", Ping, Ping),
+    Rpc("Watch", Ping, Ping, server_streaming=True),
+])
+
+
+class TestGrpcThroughProxy:
+    def test_grpc_unary_and_stream_via_h2_router(self, disco):
+        state = {"fails": 0}
+        disp = ServerDispatcher()
+
+        async def ping(req: Ping) -> Ping:
+            if state["fails"] < req.fail_times:
+                state["fails"] += 1
+                raise GrpcError.of(14, "try again")  # UNAVAILABLE
+            return Ping(text=f"pong {req.text}")
+
+        async def watch(req: Ping):
+            async def gen():
+                for i in range(3):
+                    yield Ping(text=f"ev{i}")
+            return gen()
+
+        disp.register_all(GRPC_SVC, {"Ping": ping, "Watch": watch})
+
+        async def go():
+            d = await H2Server(disp).start()
+            (disco / "grpcsvc").write_text(f"127.0.0.1 {d.bound_port}\n")
+            svc_cfg = """  service:
+    responseClassifier:
+      kind: io.l5d.h2.grpc.default
+"""
+            linker = load_linker(mk_cfg(disco, svc_cfg))
+            await linker.start()
+            proxy_client = ClientDispatcher(
+                H2Client("127.0.0.1", linker.routers[0].server_ports[0]),
+                authority="grpcsvc")
+            try:
+                # plain unary through the router
+                rep = await proxy_client.unary(GRPC_SVC, "Ping",
+                                               Ping(text="x"))
+                assert rep.text == "pong x"
+
+                # UNAVAILABLE failures are retried by the router
+                # (grpc-status trailer classification + buffered replay)
+                rep = await proxy_client.unary(
+                    GRPC_SVC, "Ping", Ping(text="y", fail_times=2))
+                assert rep.text == "pong y"
+
+                # server-streaming passes through
+                reps = await proxy_client.server_stream(
+                    GRPC_SVC, "Watch", Ping())
+                texts = [m.text async for m in reps]
+                assert texts == ["ev0", "ev1", "ev2"]
+
+                flat = linker.metrics.flatten()
+                assert flat[
+                    "rt/h2out/service/svc.grpcsvc/retries/total"] == 2
+            finally:
+                await proxy_client._svc.close()
+                await linker.close()
+                await d.close()
+        run(go())
