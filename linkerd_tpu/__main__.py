@@ -26,6 +26,8 @@ async def amain(config_text: str) -> None:
         linker.metrics, linker.config_dict,
         host=admin_spec.ip if admin_spec else "127.0.0.1",
         port=admin_spec.port if admin_spec else DEFAULT_ADMIN_PORT)
+    from linkerd_tpu.admin.handlers import linkerd_admin_handlers
+    admin.add_handlers(linkerd_admin_handlers(linker))
     for t in linker.telemeters:
         admin.add_handlers(t.admin_handlers())
     await admin.start()

@@ -1,0 +1,106 @@
+"""Linkerd admin handlers: delegator, bound names, log levels.
+
+Ref: linkerd/admin/.../LinkerdAdmin.scala:71-109 (composition),
+admin/.../names/DelegateApiHandler.scala:331 (delegate JSON API),
+admin/.../BoundNamesHandler, admin/.../LoggingHandler.scala:95.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import TYPE_CHECKING, Any, List, Tuple
+from urllib.parse import parse_qsl, urlsplit
+
+from linkerd_tpu.admin.server import json_response
+from linkerd_tpu.core import Dtab, Path
+from linkerd_tpu.namer.core import ConfiguredDtabNamer
+from linkerd_tpu.namer.delegate import Delegator, delegate_json
+from linkerd_tpu.protocol.http.message import Request, Response
+
+if TYPE_CHECKING:  # pragma: no cover
+    from linkerd_tpu.linker import Linker
+
+
+def _query(req: Request) -> dict:
+    return dict(parse_qsl(urlsplit(req.uri).query))
+
+
+def mk_delegator_handler(linker: "Linker"):
+    """``/delegator.json?router=<label>&path=/svc/x[&dtab=...]`` —
+    step-by-step delegation explanation (DelegateApiHandler)."""
+
+    async def handler(req: Request) -> Response:
+        q = _query(req)
+        label = q.get("router") or (
+            linker.routers[0].label if linker.routers else None)
+        router = next((r for r in linker.routers if r.label == label), None)
+        if router is None:
+            return json_response(
+                {"error": f"no router {label!r}"}, status=404)
+        if not isinstance(router.interpreter, ConfiguredDtabNamer):
+            return json_response(
+                {"error": "delegation is only explainable for in-process "
+                          "interpreters; query namerd for remote ones"},
+                status=501)
+        path_s = q.get("path")
+        if not path_s:
+            return json_response({"error": "missing ?path="}, status=400)
+        try:
+            path = Path.read(path_s)
+            extra = Dtab.read(q["dtab"]) if q.get("dtab") else Dtab.empty()
+        except ValueError as e:
+            return json_response({"error": str(e)}, status=400)
+        base = Dtab.read(router.spec.dtab) if router.spec.dtab else Dtab.empty()
+        tree = Delegator(router.interpreter).delegate(base + extra, path)
+        return json_response(delegate_json(tree))
+
+    return handler
+
+
+def mk_bound_names_handler(linker: "Linker"):
+    """``/bound-names.json`` — per-router live binding-cache contents
+    (BoundNamesHandler + PathRegistry)."""
+
+    async def handler(req: Request) -> Response:
+        out = {}
+        for r in linker.routers:
+            out[r.label] = {
+                "paths": sorted(
+                    k.path.show for k in r.binding.paths._entries),
+                "clients": sorted(
+                    k.show for k in r.binding.clients._entries),
+            }
+        return json_response(out)
+
+    return handler
+
+
+async def logging_handler(req: Request) -> Response:
+    """``/logging.json`` — GET lists logger levels; POST/PUT
+    ``?logger=<name>&level=DEBUG`` sets one at runtime
+    (LoggingHandler.scala:95)."""
+    q = _query(req)
+    if req.method in ("POST", "PUT"):
+        name = q.get("logger", "")
+        level = (q.get("level") or "").upper()
+        if level not in ("DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"):
+            return json_response({"error": f"bad level {level!r}"},
+                                 status=400)
+        logging.getLogger(name or None).setLevel(level)
+        return json_response({"logger": name or "root", "level": level})
+    loggers = {"root": logging.getLevelName(logging.getLogger().level)}
+    for name in sorted(logging.root.manager.loggerDict):
+        lg = logging.root.manager.loggerDict[name]
+        if isinstance(lg, logging.Logger) and lg.level != logging.NOTSET:
+            loggers[name] = logging.getLevelName(lg.level)
+    return json_response(loggers)
+
+
+def linkerd_admin_handlers(linker: "Linker") -> List[Tuple[str, Any]]:
+    """The standard linkerd admin surface (LinkerdAdmin.apply)."""
+    return [
+        ("/delegator.json", mk_delegator_handler(linker)),
+        ("/bound-names.json", mk_bound_names_handler(linker)),
+        ("/logging.json", logging_handler),
+    ]

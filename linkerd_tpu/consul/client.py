@@ -1,0 +1,106 @@
+"""Minimal asyncio Consul HTTP API client with blocking queries.
+
+Ref: consul/src/main/scala/io/buoyant/consul/v1/{BaseApi,ConsulApi}.scala —
+the blocking-index protocol: pass ``index=<last>`` + ``wait=``, the server
+holds the request until the index advances; ``X-Consul-Index`` carries the
+new index. An index that goes backwards means reset (start over from 0),
+per Consul's documented semantics (SvcAddr.scala:44-60 loop).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Dict, Optional, Tuple
+
+
+class ConsulApiError(Exception):
+    def __init__(self, status: int, body: str):
+        super().__init__(f"consul api {status}: {body[:200]}")
+        self.status = status
+
+
+class ConsulApi:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8500,
+                 token: Optional[str] = None, wait: str = "5m"):
+        self.host = host
+        self.port = port
+        self.token = token
+        self.wait = wait
+
+    async def get(self, path: str,
+                  index: Optional[int] = None,
+                  extra_timeout: float = 330.0
+                  ) -> Tuple[Any, Optional[int]]:
+        """One (possibly blocking) GET -> (parsed json, X-Consul-Index)."""
+        sep = "&" if "?" in path else "?"
+        uri = path
+        if index is not None:
+            uri += f"{sep}index={index}&wait={self.wait}"
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        try:
+            headers = f"GET {uri} HTTP/1.1\r\nHost: {self.host}\r\n"
+            if self.token:
+                headers += f"X-Consul-Token: {self.token}\r\n"
+            headers += "Connection: close\r\n\r\n"
+            writer.write(headers.encode())
+            await writer.drain()
+
+            async def read_rsp():
+                status_line = await reader.readline()
+                status = int(status_line.split(b" ", 2)[1])
+                hdrs: Dict[str, str] = {}
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = line.decode("latin-1").partition(":")
+                    hdrs[k.strip().lower()] = v.strip()
+                if hdrs.get("transfer-encoding", "").lower() == "chunked":
+                    body = b""
+                    while True:
+                        n = int((await reader.readline()).strip() or b"0", 16)
+                        if n == 0:
+                            await reader.readline()
+                            break
+                        body += await reader.readexactly(n)
+                        await reader.readline()
+                else:
+                    n = int(hdrs.get("content-length", "0"))
+                    body = await reader.readexactly(n) if n else await reader.read()
+                return status, hdrs, body
+
+            status, hdrs, body = await asyncio.wait_for(
+                read_rsp(), extra_timeout)
+            if status != 200:
+                raise ConsulApiError(status, body.decode("utf-8", "replace"))
+            new_index: Optional[int] = None
+            if "x-consul-index" in hdrs:
+                try:
+                    new_index = int(hdrs["x-consul-index"])
+                except ValueError:
+                    pass
+            return json.loads(body) if body else None, new_index
+        finally:
+            writer.close()
+
+    async def health_service(self, name: str, dc: Optional[str] = None,
+                             tag: Optional[str] = None,
+                             index: Optional[int] = None):
+        path = f"/v1/health/service/{name}?passing=true"
+        if dc:
+            path += f"&dc={dc}"
+        if tag:
+            path += f"&tag={tag}"
+        return await self.get(path, index)
+
+    async def catalog_datacenters(self):
+        data, _ = await self.get("/v1/catalog/datacenters")
+        return data or []
+
+    async def catalog_services(self, dc: Optional[str] = None,
+                               index: Optional[int] = None):
+        path = "/v1/catalog/services"
+        if dc:
+            path += f"?dc={dc}"
+        return await self.get(path, index)

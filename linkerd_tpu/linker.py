@@ -45,8 +45,11 @@ from linkerd_tpu.telemetry.metrics import MetricsTree
 from linkerd_tpu.telemetry.telemeter import BroadcastTracer, NullTracer
 
 # Ensure built-in plugin registrations are loaded.
+import linkerd_tpu.consul.namer  # noqa: F401
 import linkerd_tpu.interpreter.configs  # noqa: F401
+import linkerd_tpu.k8s.namer  # noqa: F401
 import linkerd_tpu.namer.fs  # noqa: F401
+import linkerd_tpu.namer.marathon  # noqa: F401
 import linkerd_tpu.protocol.h2.classifiers  # noqa: F401
 import linkerd_tpu.protocol.h2.identifiers  # noqa: F401
 import linkerd_tpu.protocol.http.identifiers  # noqa: F401
@@ -131,6 +134,10 @@ class SvcSpec:
     totalTimeoutMs: Optional[int] = None
     retries: Optional[RetriesSpec] = None
     responseClassifier: Optional[Dict[str, Any]] = None  # kind-discriminated
+    # h2 only: how long a response is held awaiting its classifying final
+    # frame (grpc-status trailer) before forfeiting retryability and
+    # streaming through (see H2ClassifiedRetries.rsp_hold_s)
+    classificationTimeoutMs: int = 1000
 
 
 @dataclass
@@ -248,12 +255,14 @@ class Router:
     """One configured router: routing service + its servers."""
 
     def __init__(self, spec: RouterSpec, label: str, service: Service,
-                 binding: DstBindingFactory, servers: List[HttpServer]):
+                 binding: DstBindingFactory, servers: List[HttpServer],
+                 interpreter=None):
         self.spec = spec
         self.label = label
         self.service = service
         self.binding = binding
         self.servers = servers
+        self.interpreter = interpreter
 
     @property
     def server_ports(self) -> List[int]:
@@ -352,6 +361,28 @@ class Linker:
             return fa_config.mk
         return mk_policy_factory
 
+    def _mk_identifier(self, rspec: RouterSpec, label: str,
+                       category: str, default_kind: str,
+                       prefix: Path, base_dtab: Dtab):
+        id_cfgs = rspec.identifier
+        if id_cfgs is None:
+            id_cfgs = [{"kind": default_kind}]
+        elif isinstance(id_cfgs, dict):
+            id_cfgs = [id_cfgs]
+        return compose_identifiers([
+            instantiate(category, c, f"{label}.identifier")
+            .mk(prefix, base_dtab)
+            for c in id_cfgs
+        ])
+
+    @staticmethod
+    def _mk_svc_validator(label: str, category: str):
+        def validate_svc(spec: SvcSpec, var_names=frozenset()) -> None:
+            if spec.responseClassifier is not None:
+                instantiate(category, spec.responseClassifier,
+                            f"{label}.responseClassifier")
+        return validate_svc
+
     @staticmethod
     def _mk_backoffs(sspec: SvcSpec) -> List[float]:
         bspec = (sspec.retries.backoff if sspec.retries else None)
@@ -376,24 +407,11 @@ class Linker:
 
         base_dtab = Dtab.read(rspec.dtab) if rspec.dtab else Dtab.empty()
         prefix = Path.read(rspec.dstPrefix)
-
-        id_cfgs = rspec.identifier
-        if id_cfgs is None:
-            id_cfgs = [{"kind": "io.l5d.header.token"}]
-        elif isinstance(id_cfgs, dict):
-            id_cfgs = [id_cfgs]
-        identifiers = [
-            instantiate("h2identifier", c, f"{label}.identifier")
-            .mk(prefix, base_dtab)
-            for c in id_cfgs
-        ]
-        identifier = compose_identifiers(identifiers)
+        identifier = self._mk_identifier(
+            rspec, label, "h2identifier", "io.l5d.header.token",
+            prefix, base_dtab)
         interpreter = self._mk_interpreter(rspec, label)
-
-        def validate_svc(spec: SvcSpec, var_names=frozenset()) -> None:
-            if spec.responseClassifier is not None:
-                instantiate("h2classifier", spec.responseClassifier,
-                            f"{label}.responseClassifier")
+        validate_svc = self._mk_svc_validator(label, "h2classifier")
 
         client_lookup = per_prefix_lookup(
             rspec.client, ClientSpec, f"{label}.client",
@@ -452,7 +470,8 @@ class Linker:
                 classifier, budget, mk_backoffs(sspec),
                 max_retries=(sspec.retries.maxRetries
                              if sspec.retries else 25),
-                metrics=metrics, scope=("rt", label, "service", name)))
+                metrics=metrics, scope=("rt", label, "service", name),
+                rsp_hold_s=sspec.classificationTimeoutMs / 1e3))
             return filters_to_service(filters, svc)
 
         cache_cfg = rspec.bindingCache or {}
@@ -478,29 +497,17 @@ class Linker:
                      ssl_context=(s.tls.mk_context() if s.tls else None))
             for s in (rspec.servers or [ServerSpec()])
         ]
-        return Router(rspec, label, server_stack, binding, servers)
+        return Router(rspec, label, server_stack, binding, servers,
+                      interpreter=interpreter)
 
     def _mk_http_router(self, rspec: RouterSpec, label: str) -> Router:
         base_dtab = Dtab.read(rspec.dtab) if rspec.dtab else Dtab.empty()
         prefix = Path.read(rspec.dstPrefix)
-
-        # identifier(s)
-        id_cfgs = rspec.identifier
-        if id_cfgs is None:
-            id_cfgs = [{"kind": "io.l5d.header.token"}]
-        elif isinstance(id_cfgs, dict):
-            id_cfgs = [id_cfgs]
-        identifiers = [
-            instantiate("identifier", c, f"{label}.identifier").mk(prefix, base_dtab)
-            for c in id_cfgs
-        ]
-        identifier = compose_identifiers(identifiers)
+        identifier = self._mk_identifier(
+            rspec, label, "identifier", "io.l5d.header.token",
+            prefix, base_dtab)
         interpreter = self._mk_interpreter(rspec, label)
-
-        def validate_svc(spec: SvcSpec, var_names=frozenset()) -> None:
-            if spec.responseClassifier is not None:
-                instantiate("classifier", spec.responseClassifier,
-                            f"{label}.responseClassifier")
+        validate_svc = self._mk_svc_validator(label, "classifier")
 
         client_lookup = per_prefix_lookup(
             rspec.client, ClientSpec, f"{label}.client",
@@ -606,7 +613,8 @@ class Linker:
                        ssl_context=(s.tls.mk_context() if s.tls else None))
             for s in (rspec.servers or [ServerSpec()])
         ]
-        return Router(rspec, label, server_stack, binding, servers)
+        return Router(rspec, label, server_stack, binding, servers,
+                      interpreter=interpreter)
 
     def _mk_access_emit(self, label: str, target: str):
         """Access-log sink: off-event-loop disk writes via QueueListener;

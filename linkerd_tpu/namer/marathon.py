@@ -1,0 +1,182 @@
+"""``io.l5d.marathon`` — Marathon (DC/OS) app-id namer.
+
+Ref: marathon/ client (v2.Api.scala:195, AppIdNamer.scala:147 watch loop)
+and namer/marathon MarathonInitializer.scala:166. Paths
+``/#/io.l5d.marathon/<app-id-segments...>`` map to the app's running
+tasks (host:port of the first port mapping), refreshed by polling
+``/v2/apps/<id>/tasks`` (the reference polls too — Marathon has no watch
+API; ttlMs default 5000).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import random
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from linkerd_tpu.config import register
+from linkerd_tpu.core import Activity, Path, Var
+from linkerd_tpu.core.activity import Ok, PENDING
+from linkerd_tpu.core.addr import ADDR_PENDING, Addr, Address, Bound, BoundName
+from linkerd_tpu.core.nametree import Leaf, NameTree, NEG
+from linkerd_tpu.namer.core import Namer
+
+log = logging.getLogger(__name__)
+
+
+class MarathonApi:
+    """Minimal /v2 client (GET JSON over a per-call connection)."""
+
+    def __init__(self, host: str, port: int = 8080,
+                 auth_token: Optional[str] = None):
+        self.host = host
+        self.port = port
+        self.auth_token = auth_token
+
+    async def get_json(self, path: str):
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        try:
+            req = (f"GET {path} HTTP/1.1\r\nHost: {self.host}\r\n"
+                   f"Accept: application/json\r\n")
+            if self.auth_token:
+                req += f"Authorization: token={self.auth_token}\r\n"
+            req += "Connection: close\r\n\r\n"
+            writer.write(req.encode())
+            await writer.drain()
+            status_line = await reader.readline()
+            status = int(status_line.split(b" ", 2)[1])
+            hdrs = {}
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = line.decode("latin-1").partition(":")
+                hdrs[k.strip().lower()] = v.strip()
+            if hdrs.get("transfer-encoding", "").lower() == "chunked":
+                body = b""
+                while True:
+                    n = int((await reader.readline()).strip() or b"0", 16)
+                    if n == 0:
+                        await reader.readline()
+                        break
+                    body += await reader.readexactly(n)
+                    await reader.readline()
+            elif "content-length" in hdrs:
+                body = await reader.readexactly(int(hdrs["content-length"]))
+            else:
+                body = await reader.read()
+            try:
+                parsed = json.loads(body) if body else None
+            except ValueError:
+                parsed = None
+            return status, parsed
+        finally:
+            writer.close()
+
+
+def _tasks_to_addr(data: Optional[dict]) -> Addr:
+    addresses = []
+    for t in (data or {}).get("tasks") or []:
+        host = t.get("host")
+        ports = t.get("ports") or []
+        if host and ports:
+            addresses.append(Address.mk(host, int(ports[0])))
+    return Bound(frozenset(addresses))
+
+
+class _AppPoll:
+    def __init__(self, api: MarathonApi, app_id: str, ttl_s: float):
+        self.addr: Var[Addr] = Var(ADDR_PENDING)
+        self.exists = Var(None)  # None until first poll; then bool
+        self._api = api
+        self._app_id = app_id
+        self._ttl = ttl_s
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_event_loop().create_task(self._run())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                status, data = await self._api.get_json(
+                    f"/v2/apps{self._app_id}/tasks")
+                if status == 404:
+                    self.exists.update(False)
+                elif status == 200:
+                    self.exists.update(True)
+                    self.addr.update(_tasks_to_addr(data))
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 - keep polling
+                log.debug("marathon poll %s: %s", self._app_id, e)
+            await asyncio.sleep(self._ttl * (0.75 + random.random() / 2))
+
+
+class MarathonNamer(Namer):
+    """Longest-matching app-id binding: for ``/a/b/c`` tries app id
+    ``/a/b/c``, then ``/a/b`` (residual ``/c``), then ``/a``
+    (ref: AppIdNamer matches the longest existing app path)."""
+
+    def __init__(self, api: MarathonApi, id_prefix: str = "io.l5d.marathon",
+                 ttl_s: float = 5.0):
+        self._api = api
+        self._id_prefix = id_prefix
+        self._ttl = ttl_s
+        self._polls: Dict[str, _AppPoll] = {}
+
+    def _poll(self, app_id: str) -> _AppPoll:
+        p = self._polls.get(app_id)
+        if p is None:
+            p = _AppPoll(self._api, app_id, self._ttl)
+            self._polls[app_id] = p
+        p.start()
+        return p
+
+    def lookup(self, path: Path) -> Activity[NameTree]:
+        if len(path) == 0:
+            return Activity.value(NEG)
+        # try longest prefix first (reference Alt over candidate ids)
+        candidates = []
+        for n in range(len(path), 0, -1):
+            app_id = "/" + "/".join(path.take(n))
+            candidates.append((n, app_id, self._poll(app_id)))
+
+        exist_vars = [p.exists for _, _, p in candidates]
+
+        def to_state(exists_states):
+            for (n, app_id, poll), exists in zip(candidates, exists_states):
+                if exists is None:
+                    return PENDING  # still determining
+                if exists:
+                    bid = Path.of("#", self._id_prefix).concat(path.take(n))
+                    return Ok(Leaf(BoundName(bid, poll.addr, path.drop(n))))
+            return Ok(NEG)
+
+        return Activity(Var.collect(exist_vars).map(to_state))
+
+    def close(self) -> None:
+        for p in self._polls.values():
+            p.stop()
+
+
+@register("namer", "io.l5d.marathon")
+@dataclass
+class MarathonNamerConfig:
+    host: str = "marathon.mesos"
+    port: int = 8080
+    ttlMs: int = 5000
+    prefix: str = "/io.l5d.marathon"
+
+    def mk(self) -> Namer:
+        return MarathonNamer(MarathonApi(self.host, self.port),
+                             ttl_s=self.ttlMs / 1e3)

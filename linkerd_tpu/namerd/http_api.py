@@ -146,6 +146,8 @@ class HttpControlService(Service[Request, Response]):
                 return await self._addr(segs[3], q, watch)
             if segs[:3] == ["api", "1", "resolve"] and len(segs) == 4:
                 return await self._resolve(segs[3], q, watch)
+            if segs[:3] == ["api", "1", "delegate"] and len(segs) == 4:
+                return await self._delegate(segs[3], q)
         except DtabNamespaceDoesNotExist as e:
             return _err(404, str(e))
         except DtabNamespaceAlreadyExists as e:
@@ -274,3 +276,16 @@ class HttpControlService(Service[Request, Response]):
     async def _resolve(self, ns: str, q, watch: bool) -> Response:
         # bind + addr of the tree's first live leaf (ResolveHandler)
         return await self._addr(ns, q, watch)
+
+    async def _delegate(self, ns: str, q) -> Response:
+        """Step-by-step delegation explanation
+        (ref: HttpControlService /api/1/delegate + DelegateApiHandler)."""
+        from linkerd_tpu.namer.core import ConfiguredDtabNamer
+        from linkerd_tpu.namer.delegate import Delegator, delegate_json
+        path = Path.read(q["path"])
+        extra = Dtab.read(q["dtab"]) if q.get("dtab") else Dtab.empty()
+        interp = self._namerd.interpreter(ns)
+        if not isinstance(interp, ConfiguredDtabNamer):
+            return _err(501, "delegation unsupported for this interpreter")
+        return _json_rsp(delegate_json(
+            Delegator(interp).delegate(extra, path)))

@@ -21,16 +21,14 @@ from typing import List, Optional
 from linkerd_tpu.config import register
 from linkerd_tpu.protocol.h2.messages import H2Request, H2Response
 from linkerd_tpu.protocol.h2.stream import Trailers
-from linkerd_tpu.router.classifiers import ResponseClass
+from linkerd_tpu.router.classifiers import (
+    IDEMPOTENT_METHODS, READ_METHODS, ResponseClass,
+)
 
 GRPC_STATUS = "grpc-status"
 # gRPC codes the default classifier deems safe to retry
 # (GrpcClassifier.scala default: UNAVAILABLE)
 RETRYABLE_GRPC_CODES = frozenset({14})
-
-IDEMPOTENT_METHODS = frozenset(
-    {"GET", "HEAD", "OPTIONS", "TRACE", "PUT", "DELETE"})
-READ_METHODS = frozenset({"GET", "HEAD", "OPTIONS", "TRACE"})
 
 
 class H2Classifier:

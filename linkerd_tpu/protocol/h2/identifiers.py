@@ -15,18 +15,12 @@ from linkerd_tpu.core import Dtab, Path
 from linkerd_tpu.protocol.h2.messages import H2Request
 from linkerd_tpu.router.binding import DstPath
 from linkerd_tpu.router.routing import (
-    DTAB_HEADER, IdentificationError, Identifier,
+    IdentificationError, Identifier, parse_local_dtab,
 )
 
-
-def _local_dtab(req: H2Request) -> Dtab:
-    raw = req.headers.get_all(DTAB_HEADER)
-    if not raw:
-        return Dtab.empty()
-    try:
-        return Dtab.read(";".join(raw))
-    except ValueError as e:
-        raise IdentificationError(f"bad {DTAB_HEADER} header: {e}") from None
+# parse_local_dtab only touches headers.get_all, which h2 Headers
+# provides, so the HTTP/1 implementation is shared verbatim
+_local_dtab = parse_local_dtab
 
 
 @register("h2identifier", "io.l5d.header.token")

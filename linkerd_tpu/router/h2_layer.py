@@ -73,15 +73,20 @@ class _TapStream:
 
 
 class _ReplayStream:
-    """Replays collected frames, then (optionally) follows a live tail."""
+    """Replays collected frames, then follows a live tail, propagates a
+    terminal reset, or ends cleanly — in that priority order."""
 
-    def __init__(self, frames: Iterable, tail=None):
+    def __init__(self, frames: Iterable, tail=None,
+                 terminal_reset: Optional[StreamReset] = None):
         self._frames = list(frames)
         self._tail = tail
+        self._terminal_reset = terminal_reset
         self.at_end = False
 
     @property
     def is_reset(self) -> bool:
+        if self._terminal_reset is not None:
+            return True
         return self._tail.is_reset if self._tail is not None else False
 
     def reset(self, *a, **kw) -> None:
@@ -95,10 +100,16 @@ class _ReplayStream:
             if isinstance(frame, Trailers) or (
                     isinstance(frame, DataFrame) and frame.eos):
                 self.at_end = True
-            if not self._frames and self._tail is None and not self.at_end:
+            if (not self._frames and self._tail is None
+                    and self._terminal_reset is None and not self.at_end):
                 # collected frames ended without EOS marker
                 self.at_end = True
             return frame
+        if self._terminal_reset is not None:
+            # the buffered response ended in a reset: propagate it so the
+            # downstream client doesn't see a truncated-but-clean body
+            self.at_end = True
+            raise self._terminal_reset
         if self._tail is not None:
             frame = await self._tail.read()
             self.at_end = self._tail.at_end
@@ -209,8 +220,11 @@ class H2ClassifiedRetries(Filter[H2Request, H2Response]):
 
     The request stream is teed through a BufferedStream (so it can be
     replayed); the response is buffered up to ``rsp_buffer_bytes`` while
-    awaiting the classifying frame. Either buffer overflowing forfeits
-    the retry and streams through (ref: ClassifiedRetryFilter.scala).
+    awaiting the classifying frame, bounded by ``rsp_hold_s`` — the
+    retryability-vs-streaming-latency knob: a final frame (e.g.
+    grpc-status trailer) later than this forfeits the retry and streams
+    the response through. Either buffer overflowing does the same
+    (ref: ClassifiedRetryFilter.scala).
     """
 
     def __init__(self, classifier: H2Classifier,
@@ -221,7 +235,7 @@ class H2ClassifiedRetries(Filter[H2Request, H2Response]):
                  scope: tuple = (),
                  req_buffer_bytes: int = BufferedStream.DEFAULT_CAPACITY,
                  rsp_buffer_bytes: int = 64 * 1024,
-                 rsp_hold_s: float = 0.1):
+                 rsp_hold_s: float = 1.0):
         self._classifier = classifier
         self._budget = budget if budget is not None else RetryBudget()
         self._backoffs = list(backoffs) if backoffs is not None else [0.0] * 25
@@ -275,13 +289,16 @@ class H2ClassifiedRetries(Filter[H2Request, H2Response]):
                     rsp.stream, self._rsp_buffer, self._rsp_hold_s)
                 if gave_up:
                     # response won't end soon / too big: commit and
-                    # stream through; no retry
-                    req.ctx["response_class"] = ResponseClass.SUCCESS
+                    # stream through; no retry. Only claim SUCCESS when
+                    # the classifier had an early verdict saying so —
+                    # otherwise the class is simply unknown-yet.
+                    if early is not None:
+                        req.ctx["response_class"] = early
                     rsp.stream = _ReplayStream(frames, tail=rsp.stream)
                     buffered.release_buffer()
                     return rsp
                 rc = self._classifier.classify(req, rsp, trailers, rst)
-                replay = _ReplayStream(frames)
+                replay = _ReplayStream(frames, terminal_reset=rst)
             else:
                 rc = self._classifier.classify(req, None, None, exc)
                 replay = None

@@ -100,8 +100,10 @@ class FeatureRecorder(Filter[Request, Response]):
                 latency_ms=latency_ms,
                 status=rsp.status if rsp is not None else 0,
                 retries=int(req.ctx.get("retries", 0)),
-                request_bytes=len(req.body),
-                response_bytes=len(rsp.body) if rsp is not None else 0,
+                # h2 messages carry streams, not bodies; size 0 there
+                request_bytes=len(getattr(req, "body", b"") or b""),
+                response_bytes=(len(getattr(rsp, "body", b"") or b"")
+                                if rsp is not None else 0),
                 concurrency=self._inflight + 1,
                 queue_ms=0.0,
                 exception=exc is not None,

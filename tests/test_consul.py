@@ -1,0 +1,177 @@
+"""Consul namer against a scripted fake agent (blocking-index long-poll).
+
+Reference test model: namer/consul tests with Service.mk stubs replaying
+index-stamped health responses (SvcAddr.scala loop behavior: long-poll,
+index advance, index reset)."""
+
+import asyncio
+import json
+
+import pytest
+
+from linkerd_tpu.core import Path
+from linkerd_tpu.core.addr import Bound
+from linkerd_tpu.core.nametree import Leaf, Neg
+from linkerd_tpu.consul.client import ConsulApi
+from linkerd_tpu.consul.namer import ConsulNamer, _entries_to_addr
+from linkerd_tpu.protocol.http.message import Request, Response
+from linkerd_tpu.protocol.http.server import HttpServer
+from linkerd_tpu.router.service import FnService
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+def entry(ip, port, node="node1", svc_addr=None):
+    return {"Node": {"Node": node, "Address": ip},
+            "Service": {"Address": svc_addr or ip, "Port": port}}
+
+
+class FakeConsul:
+    def __init__(self):
+        self.index = 10
+        self.entries = [entry("10.1.1.1", 8300), entry("10.1.1.2", 8300)]
+        self._changed = asyncio.Event()
+
+    def set_entries(self, entries, index=None):
+        self.entries = entries
+        self.index = index if index is not None else self.index + 1
+        self._changed.set()
+        self._changed = asyncio.Event()
+
+    def service(self):
+        async def handler(req: Request) -> Response:
+            assert req.uri.startswith("/v1/health/service/web")
+            from urllib.parse import parse_qsl, urlsplit
+            q = dict(parse_qsl(urlsplit(req.uri).query))
+            want = int(q["index"]) if "index" in q else None
+            if want is not None and want >= self.index:
+                # blocking query: park until the index advances (or a
+                # short fake-timeout returns the same data)
+                changed = self._changed
+                try:
+                    await asyncio.wait_for(changed.wait(), 5.0)
+                except asyncio.TimeoutError:
+                    pass
+            rsp = Response(status=200,
+                           body=json.dumps(self.entries).encode())
+            rsp.headers.set("X-Consul-Index", str(self.index))
+            return rsp
+        return FnService(handler)
+
+
+def test_entries_to_addr_prefers_service_address():
+    e = [entry("10.0.0.1", 9000, svc_addr="192.168.1.1")]
+    bound = _entries_to_addr(e, prefer_service_addr=True)
+    assert [a.host for a in bound.addresses] == ["192.168.1.1"]
+    bound2 = _entries_to_addr(e, prefer_service_addr=False)
+    assert [a.host for a in bound2.addresses] == ["10.0.0.1"]
+
+
+class TestConsulNamer:
+    def test_bind_and_longpoll_updates(self):
+        async def go():
+            fake = FakeConsul()
+            server = await HttpServer(fake.service()).start()
+            api = ConsulApi("127.0.0.1", server.bound_port, wait="1s")
+            namer = ConsulNamer(api)
+
+            act = namer.lookup(Path.read("/dc1/web/rest"))
+            from linkerd_tpu.core.activity import Ok
+            for _ in range(100):
+                if isinstance(act.current, Ok):
+                    break
+                await asyncio.sleep(0.02)
+            tree = act.sample()
+            assert isinstance(tree, Leaf)
+            bn = tree.value
+            assert bn.id_.show == "/#/io.l5d.consul/dc1/web"
+            assert bn.residual.show == "/rest"
+            assert sorted(a.host for a in bn.addr.sample().addresses) == [
+                "10.1.1.1", "10.1.1.2"]
+
+            # long-poll pushes the change
+            fake.set_entries([entry("10.2.2.2", 8300)])
+            for _ in range(200):
+                hosts = [a.host for a in bn.addr.sample().addresses]
+                if hosts == ["10.2.2.2"]:
+                    break
+                await asyncio.sleep(0.02)
+            assert [a.host for a in bn.addr.sample().addresses] == [
+                "10.2.2.2"]
+
+            namer.close()
+            await server.close()
+        run(go())
+
+    def test_unknown_service_is_neg(self):
+        async def go():
+            fake = FakeConsul()
+            fake.entries = []
+            server = await HttpServer(fake.service()).start()
+            api = ConsulApi("127.0.0.1", server.bound_port, wait="1s")
+            namer = ConsulNamer(api)
+            act = namer.lookup(Path.read("/dc1/web"))
+            from linkerd_tpu.core.activity import Ok
+            for _ in range(100):
+                if isinstance(act.current, Ok):
+                    break
+                await asyncio.sleep(0.02)
+            assert isinstance(act.sample(), Neg)
+            namer.close()
+            await server.close()
+        run(go())
+
+
+class TestMarathonNamer:
+    def test_longest_app_id_binding_and_poll(self):
+        from linkerd_tpu.namer.marathon import MarathonApi, MarathonNamer
+
+        apps = {"/users/api": {"tasks": [
+            {"host": "10.3.3.3", "ports": [31001]}]}}
+
+        async def handler(req: Request) -> Response:
+            path = req.uri.split("?")[0]
+            assert path.startswith("/v2/apps/")
+            app_id = path[len("/v2/apps"):-len("/tasks")]
+            if app_id in apps:
+                return Response(status=200,
+                                body=json.dumps(apps[app_id]).encode())
+            return Response(status=404, body=b'{"message":"not found"}')
+
+        async def go():
+            server = await HttpServer(FnService(handler)).start()
+            api = MarathonApi("127.0.0.1", server.bound_port)
+            namer = MarathonNamer(api, ttl_s=0.05)
+            act = namer.lookup(Path.read("/users/api/v1"))
+            from linkerd_tpu.core.activity import Ok
+            for _ in range(100):
+                if isinstance(act.current, Ok):
+                    break
+                await asyncio.sleep(0.02)
+            tree = act.sample()
+            assert isinstance(tree, Leaf)
+            bn = tree.value
+            assert bn.id_.show == "/#/io.l5d.marathon/users/api"
+            assert bn.residual.show == "/v1"
+            for _ in range(100):
+                if isinstance(bn.addr.sample(), Bound) and \
+                        bn.addr.sample().addresses:
+                    break
+                await asyncio.sleep(0.02)
+            assert [(a.host, a.port) for a in bn.addr.sample().addresses] \
+                == [("10.3.3.3", 31001)]
+
+            # scale: new task appears on next poll
+            apps["/users/api"]["tasks"].append(
+                {"host": "10.3.3.4", "ports": [31002]})
+            for _ in range(100):
+                if len(bn.addr.sample().addresses) == 2:
+                    break
+                await asyncio.sleep(0.02)
+            assert len(bn.addr.sample().addresses) == 2
+
+            namer.close()
+            await server.close()
+        run(go())

@@ -1,0 +1,148 @@
+"""DelegateTree explanations + admin handlers.
+
+Ref test models: namer/core DelegateTree tests and the admin
+DelegateApiHandler JSON shapes.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from linkerd_tpu.core import Dtab, Path
+from linkerd_tpu.namer.core import ConfiguredDtabNamer
+from linkerd_tpu.namer.delegate import (
+    DAlt, DDelegate, DLeaf, DNeg, Delegator, delegate_json,
+)
+from linkerd_tpu.namer.fs import FsNamer
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+@pytest.fixture
+def interp(tmp_path):
+    d = tmp_path / "disco"
+    d.mkdir()
+    (d / "web").write_text("127.0.0.1 8080\n")
+    namer = FsNamer(str(d))
+    namer.refresh()
+    return ConfiguredDtabNamer([(Path.read("/io.l5d.fs"), namer)])
+
+
+class TestDelegator:
+    def test_single_rewrite_chain(self, interp):
+        dtab = Dtab.read("/svc => /#/io.l5d.fs;")
+        tree = Delegator(interp).delegate(dtab, Path.read("/svc/web"))
+        # /svc/web -[/svc => /#/io.l5d.fs]-> /#/io.l5d.fs/web -> bound leaf
+        assert isinstance(tree, DDelegate)
+        assert tree.path.show == "/svc/web"
+        assert tree.dentry is not None and tree.dentry.prefix.show == "/svc"
+        leaf = tree.child
+        assert isinstance(leaf, DLeaf)
+        assert leaf.bound is not None
+        assert leaf.bound.id_.show == "/#/io.l5d.fs/web"
+
+    def test_neg_when_no_rule(self, interp):
+        tree = Delegator(interp).delegate(Dtab.empty(), Path.read("/nope"))
+        assert isinstance(tree, DNeg)
+
+    def test_alt_precedence_order(self, interp):
+        dtab = Dtab.read(
+            "/svc => /#/io.l5d.fs; /svc/web => /#/io.l5d.fs/web;")
+        tree = Delegator(interp).delegate(dtab, Path.read("/svc/web"))
+        # both dentries match -> Alt with LATER entry first (precedence)
+        assert isinstance(tree, DAlt)
+        first = tree.children[0]
+        assert first.dentry.prefix.show == "/svc/web"
+        j = delegate_json(tree)
+        assert j["type"] == "alt"
+        assert j["alt"][0]["dentry"]["prefix"] == "/svc/web"
+
+    def test_unknown_namer_is_neg(self, interp):
+        dtab = Dtab.read("/svc => /#/io.l5d.nothere;")
+        tree = Delegator(interp).delegate(dtab, Path.read("/svc/web"))
+        assert isinstance(tree, DDelegate)
+        assert isinstance(tree.child, DNeg)
+
+
+class TestAdminDelegator:
+    def test_delegator_and_bound_names_handlers(self, tmp_path):
+        from linkerd_tpu.admin.handlers import linkerd_admin_handlers
+        from linkerd_tpu.linker import load_linker
+        from linkerd_tpu.protocol.http.message import Request
+
+        d = tmp_path / "disco"
+        d.mkdir()
+        (d / "web").write_text("127.0.0.1 8080\n")
+        cfg = f"""
+routers:
+- protocol: http
+  label: out
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers: [{{port: 0}}]
+namers:
+- kind: io.l5d.fs
+  rootDir: {d}
+"""
+
+        async def go():
+            linker = load_linker(cfg)
+            handlers = dict(linkerd_admin_handlers(linker))
+            rsp = await handlers["/delegator.json"](
+                Request(uri="/delegator.json?router=out&path=/svc/web"))
+            data = json.loads(rsp.body)
+            assert data["type"] == "delegate"
+            assert data["delegate"]["type"] == "leaf"
+            assert data["delegate"]["bound"]["id"] == "/#/io.l5d.fs/web"
+
+            rsp = await handlers["/bound-names.json"](
+                Request(uri="/bound-names.json"))
+            assert json.loads(rsp.body) == {
+                "out": {"paths": [], "clients": []}}
+
+            rsp = await handlers["/logging.json"](
+                Request(method="POST",
+                        uri="/logging.json?logger=test.x&level=DEBUG"))
+            assert json.loads(rsp.body)["level"] == "DEBUG"
+            import logging
+            assert logging.getLogger("test.x").level == logging.DEBUG
+            await linker.close()
+        run(go())
+
+
+class TestNamerdDelegateApi:
+    def test_api_delegate(self, tmp_path):
+        from linkerd_tpu.namer.fs import FsNamer
+        from linkerd_tpu.namerd import InMemoryDtabStore, Namerd
+        from linkerd_tpu.namerd.http_api import HttpControlService
+        from linkerd_tpu.protocol.http.server import HttpServer
+
+        d = tmp_path / "disco"
+        d.mkdir()
+        (d / "api").write_text("127.0.0.1 9000\n")
+
+        async def go():
+            store = InMemoryDtabStore(
+                {"default": Dtab.read("/svc => /#/io.l5d.fs;")})
+            namer = FsNamer(str(d))
+            namer.refresh()
+            namerd = Namerd(store, [(Path.read("/io.l5d.fs"), namer)])
+            server = await HttpServer(HttpControlService(namerd)).start()
+
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", server.bound_port)
+            writer.write(b"GET /api/1/delegate/default?path=/svc/api "
+                         b"HTTP/1.1\r\nHost: t\r\nConnection: close\r\n\r\n")
+            await writer.drain()
+            raw = await reader.read()
+            writer.close()
+            body = raw.partition(b"\r\n\r\n")[2]
+            data = json.loads(body)
+            assert data["type"] == "delegate"
+            assert data["delegate"]["bound"]["id"] == "/#/io.l5d.fs/api"
+            await server.close()
+            await namerd.close()
+        run(go())
