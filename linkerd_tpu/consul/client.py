@@ -33,56 +33,27 @@ class ConsulApi:
                   extra_timeout: float = 330.0
                   ) -> Tuple[Any, Optional[int]]:
         """One (possibly blocking) GET -> (parsed json, X-Consul-Index)."""
+        from linkerd_tpu.protocol.http.simple_client import get as http_get
         sep = "&" if "?" in path else "?"
         uri = path
         if index is not None:
             uri += f"{sep}index={index}&wait={self.wait}"
-        reader, writer = await asyncio.open_connection(self.host, self.port)
-        try:
-            headers = f"GET {uri} HTTP/1.1\r\nHost: {self.host}\r\n"
-            if self.token:
-                headers += f"X-Consul-Token: {self.token}\r\n"
-            headers += "Connection: close\r\n\r\n"
-            writer.write(headers.encode())
-            await writer.drain()
-
-            async def read_rsp():
-                status_line = await reader.readline()
-                status = int(status_line.split(b" ", 2)[1])
-                hdrs: Dict[str, str] = {}
-                while True:
-                    line = await reader.readline()
-                    if line in (b"\r\n", b"\n", b""):
-                        break
-                    k, _, v = line.decode("latin-1").partition(":")
-                    hdrs[k.strip().lower()] = v.strip()
-                if hdrs.get("transfer-encoding", "").lower() == "chunked":
-                    body = b""
-                    while True:
-                        n = int((await reader.readline()).strip() or b"0", 16)
-                        if n == 0:
-                            await reader.readline()
-                            break
-                        body += await reader.readexactly(n)
-                        await reader.readline()
-                else:
-                    n = int(hdrs.get("content-length", "0"))
-                    body = await reader.readexactly(n) if n else await reader.read()
-                return status, hdrs, body
-
-            status, hdrs, body = await asyncio.wait_for(
-                read_rsp(), extra_timeout)
-            if status != 200:
-                raise ConsulApiError(status, body.decode("utf-8", "replace"))
-            new_index: Optional[int] = None
-            if "x-consul-index" in hdrs:
-                try:
-                    new_index = int(hdrs["x-consul-index"])
-                except ValueError:
-                    pass
-            return json.loads(body) if body else None, new_index
-        finally:
-            writer.close()
+        headers = {}
+        if self.token:
+            headers["X-Consul-Token"] = self.token
+        rsp = await http_get(self.host, self.port, uri, headers=headers,
+                             timeout=extra_timeout)
+        if rsp.status != 200:
+            raise ConsulApiError(rsp.status,
+                                 rsp.body.decode("utf-8", "replace"))
+        new_index: Optional[int] = None
+        raw_index = rsp.headers.get("x-consul-index")
+        if raw_index is not None:
+            try:
+                new_index = int(raw_index)
+            except ValueError:
+                pass
+        return (json.loads(rsp.body) if rsp.body else None), new_index
 
     async def health_service(self, name: str, dc: Optional[str] = None,
                              tag: Optional[str] = None,

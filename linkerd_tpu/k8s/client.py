@@ -107,19 +107,25 @@ class K8sApi:
 
     # -- API --------------------------------------------------------------
     async def get_json(self, path: str):
-        reader, writer = await self._connect()
-        try:
-            writer.write(self._request_head(path))
-            await writer.drain()
-            status, headers = await self._read_head(reader)
-            body = await self._read_body(reader, headers)
-            if status == 410:
-                raise GoneError(status, body.decode("utf-8", "replace"))
-            if status != 200:
-                raise K8sApiError(status, body.decode("utf-8", "replace"))
-            return json.loads(body)
-        finally:
-            writer.close()
+        """GET; 404 returns the parsed Status object (callers map a
+        missing resource to a negative binding, not an error)."""
+        from linkerd_tpu.protocol.http.simple_client import get as http_get
+        headers = {}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        rsp = await http_get(self.host, self.port, path, headers=headers,
+                             ssl=self._ssl, timeout=30.0)
+        if rsp.status == 410:
+            raise GoneError(rsp.status, rsp.body.decode("utf-8", "replace"))
+        if rsp.status == 404:
+            try:
+                return json.loads(rsp.body)  # a k8s Status object
+            except ValueError:
+                return {"kind": "Status", "code": 404}
+        if rsp.status != 200:
+            raise K8sApiError(rsp.status,
+                              rsp.body.decode("utf-8", "replace"))
+        return json.loads(rsp.body)
 
     async def watch_events(self, path: str,
                            resource_version: Optional[str] = None

@@ -202,6 +202,9 @@ class ServiceNamer(EndpointsNamer):
 # ---- config kinds ----------------------------------------------------------
 
 def _mk_api(host: str, port: int, useTls: bool) -> K8sApi:
+    """``host: ""`` selects in-cluster service-account auth; the default
+    ``localhost:8001`` targets a kubectl proxy (the reference's default,
+    ClientConfig.scala)."""
     if host:
         return K8sApi(host, port, use_tls=useTls)
     return K8sApi.from_service_account()
@@ -210,39 +213,37 @@ def _mk_api(host: str, port: int, useTls: bool) -> K8sApi:
 @register("namer", "io.l5d.k8s")
 @dataclass
 class K8sNamerConfig:
-    host: str = ""            # empty -> in-cluster service account
+    host: str = "localhost"   # "" -> in-cluster service account
     port: int = 8001          # ref default: localhost:8001 kubectl proxy
     useTls: bool = False
     prefix: str = "/io.l5d.k8s"
 
     def mk(self) -> Namer:
-        return EndpointsNamer(_mk_api(self.host or "localhost",
-                                      self.port, self.useTls))
+        return EndpointsNamer(_mk_api(self.host, self.port, self.useTls))
 
 
 @register("namer", "io.l5d.k8s.ns")
 @dataclass
 class K8sNamespacedConfig:
     namespace: str = "default"
-    host: str = ""
+    host: str = "localhost"   # "" -> in-cluster service account
     port: int = 8001
     useTls: bool = False
     prefix: str = "/io.l5d.k8s.ns"
 
     def mk(self) -> Namer:
         return EndpointsNamer(
-            _mk_api(self.host or "localhost", self.port, self.useTls),
+            _mk_api(self.host, self.port, self.useTls),
             id_prefix="io.l5d.k8s.ns", fixed_namespace=self.namespace)
 
 
 @register("namer", "io.l5d.k8s.external")
 @dataclass
 class K8sExternalConfig:
-    host: str = ""
+    host: str = "localhost"   # "" -> in-cluster service account
     port: int = 8001
     useTls: bool = False
     prefix: str = "/io.l5d.k8s.external"
 
     def mk(self) -> Namer:
-        return ServiceNamer(_mk_api(self.host or "localhost",
-                                    self.port, self.useTls))
+        return ServiceNamer(_mk_api(self.host, self.port, self.useTls))

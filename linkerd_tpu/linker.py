@@ -37,7 +37,7 @@ from linkerd_tpu.router.routing import (
     ErrorResponder, PerDstPathStatsFilter, RoutingService, StatsFilter,
     StatusCodeStatsFilter,
 )
-from linkerd_tpu.router.service import Service, filters_to_service
+from linkerd_tpu.router.service import Filter, Service, filters_to_service
 from linkerd_tpu.router.tracing import (
     AccessLogger, ClientTraceFilter, ServerTraceFilter,
 )
@@ -88,6 +88,9 @@ class ServerSpec:
     ip: str = "127.0.0.1"
     maxConcurrentRequests: Optional[int] = None
     tls: Optional[TlsServerConfig] = None
+    # strip inbound l5d-* headers at this server edge (untrusted callers;
+    # ref: ServerConfig clearContext, Server.scala:77-117)
+    clearContext: bool = False
 
 
 @dataclass
@@ -159,6 +162,10 @@ class RouterSpec:
     bindingCache: Optional[Dict[str, Any]] = None
     sampleRate: float = 1.0               # trace sampling for new roots
     httpAccessLog: Optional[str] = None   # path or "stdout"
+    addForwardedHeader: bool = False      # RFC 7239 (AddForwardedHeader)
+    # thrift only: method name as the dst path element instead of the
+    # static "thrift" dst (ref: router/thrift Identifier.scala:34)
+    thriftMethodInDst: bool = False
 
 
 @dataclass
@@ -303,7 +310,7 @@ class Linker:
 
         labels_seen: Dict[str, int] = {}
         for rspec in self.spec.routers:
-            if rspec.protocol not in ("http", "h2"):
+            if rspec.protocol not in ("http", "h2", "thrift"):
                 raise ConfigError(
                     f"protocol {rspec.protocol!r} not yet supported")
             label = rspec.label or rspec.protocol
@@ -313,6 +320,8 @@ class Linker:
                 label = f"{label}-{n}"
             if rspec.protocol == "h2":
                 self.routers.append(self._mk_h2_router(rspec, label))
+            elif rspec.protocol == "thrift":
+                self.routers.append(self._mk_thrift_router(rspec, label))
             else:
                 self.routers.append(self._mk_http_router(rspec, label))
 
@@ -491,10 +500,158 @@ class Linker:
         server_filters.append(H2ErrorResponder())
         server_stack = filters_to_service(server_filters, routing)
 
+        from linkerd_tpu.router.h2_layer import H2ClearContextFilter
+
+        def per_server_stack(s: ServerSpec) -> Service:
+            if s.clearContext:
+                return filters_to_service(
+                    [H2ClearContextFilter()], server_stack)
+            return server_stack
+
         servers = [
-            H2Server(server_stack, s.ip, s.port,
+            H2Server(per_server_stack(s), s.ip, s.port,
                      max_concurrency=s.maxConcurrentRequests,
                      ssl_context=(s.tls.mk_context() if s.tls else None))
+            for s in (rspec.servers or [ServerSpec()])
+        ]
+        return Router(rspec, label, server_stack, binding, servers,
+                      interpreter=interpreter)
+
+    def _mk_thrift_router(self, rspec: RouterSpec, label: str) -> Router:
+        """Thrift router: static (or method) identification, framed
+        transport passthrough (ref: router/thrift + ThriftInitializer)."""
+        from linkerd_tpu.protocol.thrift import ThriftCall, ThriftClient
+        from linkerd_tpu.protocol.thrift.codec import EXCEPTION
+        from linkerd_tpu.protocol.thrift.server import ThriftServer
+
+        # reject config we'd otherwise silently ignore (a plaintext
+        # listener the operator believes is TLS is worse than an error)
+        for i, s in enumerate(rspec.servers or []):
+            if s.tls is not None:
+                raise ConfigError(f"{label}.servers[{i}].tls: "
+                                  f"not supported for thrift servers")
+            if s.maxConcurrentRequests is not None:
+                raise ConfigError(
+                    f"{label}.servers[{i}].maxConcurrentRequests: "
+                    f"not supported for thrift servers")
+            if s.clearContext:
+                raise ConfigError(
+                    f"{label}.servers[{i}].clearContext: "
+                    f"not supported for thrift servers")
+
+        base_dtab = Dtab.read(rspec.dtab) if rspec.dtab else Dtab.empty()
+        prefix = Path.read(rspec.dstPrefix)
+        method_in_dst = rspec.thriftMethodInDst
+
+        def identifier(call: ThriftCall) -> DstPath:
+            seg = call.name if method_in_dst else "thrift"
+            return DstPath(prefix + Path.of(seg), base_dtab, Dtab.empty())
+
+        interpreter = self._mk_interpreter(rspec, label)
+        client_lookup = per_prefix_lookup(
+            rspec.client, ClientSpec, f"{label}.client",
+            self._mk_client_validator(label))
+        metrics = self.metrics
+        mk_policy_factory = self._mk_policy_factory_fn(label)
+
+        def thrift_classifier(req, rsp, exc):
+            from linkerd_tpu.router.classifiers import ResponseClass
+            from linkerd_tpu.protocol.thrift.codec import (
+                parse_message_header,
+            )
+            if exc is not None:
+                return ResponseClass.RETRYABLE_FAILURE \
+                    if isinstance(exc, ConnectionError) \
+                    else ResponseClass.FAILURE
+            try:
+                _, _, mtype = parse_message_header(rsp or b"")
+                if mtype == EXCEPTION:
+                    return ResponseClass.FAILURE
+            except Exception:  # noqa: BLE001 - unparseable: assume ok
+                pass
+            return ResponseClass.SUCCESS
+
+        class ThriftStatsFilter(Filter):
+            def __init__(self, node):
+                self._requests = node.counter("requests")
+                self._success = node.counter("success")
+                self._failures = node.counter("failures")
+                self._latency = node.stat("request_latency_ms")
+
+            async def apply(self, req, service):
+                import time as _t
+                self._requests.incr()
+                t0 = _t.monotonic()
+                try:
+                    rsp = await service(req)
+                except BaseException:
+                    self._failures.incr()
+                    self._latency.add((_t.monotonic() - t0) * 1e3)
+                    raise
+                self._latency.add((_t.monotonic() - t0) * 1e3)
+                from linkerd_tpu.router.classifiers import ResponseClass
+                if thrift_classifier(req, rsp, None) \
+                        is ResponseClass.SUCCESS:
+                    self._success.incr()
+                else:
+                    self._failures.incr()
+                return rsp
+
+        def client_factory(bound: BoundName) -> Service:
+            cid = bound.id_.show.lstrip("/").replace("/", ".") or "client"
+            cspec, _cvars = client_lookup(bound.id_)
+            mk_policy = mk_policy_factory(cspec)
+
+            def endpoint_factory(addr: Address) -> Service:
+                client: Service = ThriftClient(
+                    addr.host, addr.port,
+                    connect_timeout=cspec.connectTimeoutMs / 1e3)
+                return FailureAccrualService(client, mk_policy())
+
+            bal_kind = (cspec.loadBalancer or BalancerSpec()).kind
+            bal = mk_balancer(bal_kind, bound.addr, endpoint_factory)
+            metrics.scope("rt", label, "client", cid).gauge(
+                "endpoints", fn=lambda b=bal: b.size)
+            return _PruneOnClose(
+                filters_to_service(
+                    [ThriftStatsFilter(
+                        metrics.scope("rt", label, "client", cid))], bal),
+                metrics, ("rt", label, "client", cid))
+
+        svc_lookup = per_prefix_lookup(
+            rspec.service, SvcSpec, f"{label}.service")
+
+        def path_filters(dst: DstPath, svc: Service) -> Service:
+            sspec, _ = svc_lookup(dst.path)
+            budget_spec = (
+                sspec.retries.budget if sspec.retries else None) or BudgetSpec()
+            budget = RetryBudget(
+                budget_spec.ttlSecs, budget_spec.minRetriesPerSec,
+                budget_spec.percentCanRetry)
+            name = dst.path.show.lstrip("/").replace("/", ".") or "root"
+            filters: List[Any] = [
+                ThriftStatsFilter(metrics.scope("rt", label, "service", name))]
+            if sspec.totalTimeoutMs is not None:
+                filters.append(TotalTimeout(sspec.totalTimeoutMs / 1e3))
+            filters.append(ClassifiedRetries(
+                thrift_classifier, budget, self._mk_backoffs(sspec),
+                max_retries=(sspec.retries.maxRetries
+                             if sspec.retries else 25),
+                metrics=metrics, scope=("rt", label, "service", name)))
+            return filters_to_service(filters, svc)
+
+        cache_cfg = rspec.bindingCache or {}
+        binding = DstBindingFactory(
+            interpreter, client_factory, path_filters=path_filters,
+            capacity=int(cache_cfg.get("capacity", 1000)),
+            idle_ttl=float(cache_cfg.get("idleTtlSecs", 600.0)),
+            bind_timeout=rspec.bindingTimeoutMs / 1e3)
+        routing = RoutingService(identifier, binding)
+        server_stack = filters_to_service(
+            [ThriftStatsFilter(metrics.scope("rt", label, "server"))],
+            routing)
+        servers = [
+            ThriftServer(server_stack, s.ip, s.port)
             for s in (rspec.servers or [ServerSpec()])
         ]
         return Router(rspec, label, server_stack, binding, servers,
@@ -537,7 +694,11 @@ class Linker:
 
             bal_kind = (cspec.loadBalancer or BalancerSpec()).kind
             bal = mk_balancer(bal_kind, bound.addr, endpoint_factory)
-            filters: List[Any] = [StatsFilter(metrics, "rt", label, "client", cid)]
+            from linkerd_tpu.protocol.http.filters import DstHeadersFilter
+            filters: List[Any] = [
+                StatsFilter(metrics, "rt", label, "client", cid),
+                DstHeadersFilter(cid),
+            ]
             if not isinstance(self.tracer, NullTracer):
                 filters.append(ClientTraceFilter(self.tracer, cid))
             metrics.scope("rt", label, "client", cid).gauge(
@@ -604,11 +765,29 @@ class Linker:
         for t in self.telemeters:
             if hasattr(t, "recorder"):
                 server_filters.append(t.recorder())
+        # protocol-surgery filters (ref: HttpConfig.scala:69-81 order)
+        from linkerd_tpu.protocol.http.filters import (
+            AddForwardedHeaderFilter, ClearContextFilter, FramingFilter,
+            ProxyRewriteFilter, StripHopByHopHeadersFilter,
+            ViaHeaderAppenderFilter,
+        )
+        server_filters += [
+            FramingFilter(), ProxyRewriteFilter(),
+            StripHopByHopHeadersFilter(), ViaHeaderAppenderFilter(),
+        ]
+        if rspec.addForwardedHeader:
+            server_filters.append(AddForwardedHeaderFilter())
         server_filters.append(ErrorResponder())
         server_stack = filters_to_service(server_filters, routing)
 
+        def per_server_stack(s: ServerSpec) -> Service:
+            if s.clearContext:
+                return filters_to_service(
+                    [ClearContextFilter()], server_stack)
+            return server_stack
+
         servers = [
-            HttpServer(server_stack, s.ip, s.port,
+            HttpServer(per_server_stack(s), s.ip, s.port,
                        max_concurrency=s.maxConcurrentRequests,
                        ssl_context=(s.tls.mk_context() if s.tls else None))
             for s in (rspec.servers or [ServerSpec()])

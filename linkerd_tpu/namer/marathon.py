@@ -37,44 +37,17 @@ class MarathonApi:
         self.auth_token = auth_token
 
     async def get_json(self, path: str):
-        reader, writer = await asyncio.open_connection(self.host, self.port)
+        from linkerd_tpu.protocol.http.simple_client import get as http_get
+        headers = {}
+        if self.auth_token:
+            headers["Authorization"] = f"token={self.auth_token}"
+        rsp = await http_get(self.host, self.port, path,
+                             headers=headers, timeout=30.0)
         try:
-            req = (f"GET {path} HTTP/1.1\r\nHost: {self.host}\r\n"
-                   f"Accept: application/json\r\n")
-            if self.auth_token:
-                req += f"Authorization: token={self.auth_token}\r\n"
-            req += "Connection: close\r\n\r\n"
-            writer.write(req.encode())
-            await writer.drain()
-            status_line = await reader.readline()
-            status = int(status_line.split(b" ", 2)[1])
-            hdrs = {}
-            while True:
-                line = await reader.readline()
-                if line in (b"\r\n", b"\n", b""):
-                    break
-                k, _, v = line.decode("latin-1").partition(":")
-                hdrs[k.strip().lower()] = v.strip()
-            if hdrs.get("transfer-encoding", "").lower() == "chunked":
-                body = b""
-                while True:
-                    n = int((await reader.readline()).strip() or b"0", 16)
-                    if n == 0:
-                        await reader.readline()
-                        break
-                    body += await reader.readexactly(n)
-                    await reader.readline()
-            elif "content-length" in hdrs:
-                body = await reader.readexactly(int(hdrs["content-length"]))
-            else:
-                body = await reader.read()
-            try:
-                parsed = json.loads(body) if body else None
-            except ValueError:
-                parsed = None
-            return status, parsed
-        finally:
-            writer.close()
+            parsed = json.loads(rsp.body) if rsp.body else None
+        except ValueError:
+            parsed = None
+        return rsp.status, parsed
 
 
 def _tasks_to_addr(data: Optional[dict]) -> Addr:

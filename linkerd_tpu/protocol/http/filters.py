@@ -1,0 +1,157 @@
+"""HTTP/1 protocol filters for the router stacks.
+
+Ref: router/http filters — FramingFilter (dup/conflicting Content-Length
+-> 4xx/502), StripHopByHopHeadersFilter, ViaHeaderAppenderFilter,
+AddForwardedHeader.scala:185 (RFC 7239), ProxyRewriteFilter (absolute-URI
+proxy requests), and linkerd/protocol/http LinkerdHeaders ``l5d-dst-*``
+context headers (LinkerdHeaders.scala:49-502) + ServerConfig clearContext
+(ClearContext.scala).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+from urllib.parse import urlsplit
+
+from linkerd_tpu.protocol.http.message import Request, Response
+from linkerd_tpu.router.service import Filter, Service
+
+VIA_VALUE = "1.1 linkerd"
+
+# RFC 7230 §6.1 + TTwitter legacy set (StripHopByHopHeadersFilter.scala)
+HOP_BY_HOP = frozenset({
+    "connection", "keep-alive", "proxy-authenticate",
+    "proxy-authorization", "te", "trailer", "transfer-encoding", "upgrade",
+    "proxy-connection",
+})
+
+L5D_CTX_PREFIX = "l5d-ctx-"
+L5D_DST_SERVICE = "l5d-dst-service"
+L5D_DST_CLIENT = "l5d-dst-client"
+L5D_DST_RESIDUAL = "l5d-dst-residual"
+L5D_REQID = "l5d-reqid"
+
+
+class FramingFilter(Filter[Request, Response]):
+    """Reject messages with conflicting Content-Length headers
+    (request-smuggling defence; ref: FramingFilter.scala — 4xx for
+    requests, 502 for responses)."""
+
+    @staticmethod
+    def _bad(msg: "Request | Response") -> bool:
+        lens = {v.strip() for v in msg.headers.get_all("content-length")}
+        return len(lens) > 1
+
+    async def apply(self, req: Request, service: Service) -> Response:
+        if self._bad(req):
+            return Response(status=400,
+                            body=b"conflicting Content-Length headers")
+        rsp = await service(req)
+        if self._bad(rsp):
+            return Response(status=502,
+                            body=b"upstream sent conflicting Content-Length")
+        return rsp
+
+
+class StripHopByHopHeadersFilter(Filter[Request, Response]):
+    """Remove hop-by-hop headers (and anything named by Connection)
+    in both directions (ref: StripHopByHopHeadersFilter.scala)."""
+
+    @staticmethod
+    def _strip(msg) -> None:
+        named = set()
+        for v in msg.headers.get_all("connection"):
+            named.update(t.strip().lower() for t in v.split(",") if t.strip())
+        for name in HOP_BY_HOP | named:
+            msg.headers.remove(name)
+
+    async def apply(self, req: Request, service: Service) -> Response:
+        self._strip(req)
+        rsp = await service(req)
+        self._strip(rsp)
+        return rsp
+
+
+class ViaHeaderAppenderFilter(Filter[Request, Response]):
+    """Append ``Via: 1.1 linkerd`` on request and response
+    (ref: ViaHeaderAppenderFilter.scala)."""
+
+    @staticmethod
+    def _append(msg) -> None:
+        existing = msg.headers.get("via")
+        msg.headers.set("Via", f"{existing}, {VIA_VALUE}"
+                        if existing else VIA_VALUE)
+
+    async def apply(self, req: Request, service: Service) -> Response:
+        self._append(req)
+        rsp = await service(req)
+        self._append(rsp)
+        return rsp
+
+
+class AddForwardedHeaderFilter(Filter[Request, Response]):
+    """RFC 7239 ``Forwarded: for=...;by=...`` (ref:
+    AddForwardedHeader.scala:185; config-gated, off by default since it
+    adds per-request allocation)."""
+
+    @staticmethod
+    def _elem(addr: Optional[tuple]) -> str:
+        if not addr:
+            return "unknown"
+        host = addr[0]
+        if ":" in host:  # IPv6 must be bracketed+quoted per RFC 7239
+            return f'"[{host}]"'
+        return host
+
+    async def apply(self, req: Request, service: Service) -> Response:
+        client = req.ctx.get("client_addr")
+        server = req.ctx.get("server_addr")
+        elem = f"for={self._elem(client)};by={self._elem(server)}"
+        existing = req.headers.get("forwarded")
+        req.headers.set("Forwarded",
+                        f"{existing}, {elem}" if existing else elem)
+        return await service(req)
+
+
+class ProxyRewriteFilter(Filter[Request, Response]):
+    """Accept absolute-URI (proxy-form) requests: rewrite to origin-form
+    and set Host from the URI authority (ref: ProxyRewriteFilter.scala)."""
+
+    async def apply(self, req: Request, service: Service) -> Response:
+        if req.uri.startswith("http://") or req.uri.startswith("https://"):
+            parts = urlsplit(req.uri)
+            if parts.netloc:
+                req.headers.set("Host", parts.netloc)
+                path = parts.path or "/"
+                if parts.query:
+                    path += f"?{parts.query}"
+                req.uri = path
+        return await service(req)
+
+
+class ClearContextFilter(Filter[Request, Response]):
+    """Strip inbound linkerd context headers at the server edge
+    (ref: ServerConfig clearContext -> ClearContext.scala) so untrusted
+    callers can't inject trace ids or dtab overrides."""
+
+    async def apply(self, req: Request, service: Service) -> Response:
+        doomed = [n for n, _ in req.headers.items()
+                  if n.lower().startswith("l5d-")]
+        for n in doomed:
+            req.headers.remove(n)
+        return await service(req)
+
+
+class DstHeadersFilter(Filter[Request, Response]):
+    """Client-side ``l5d-dst-*`` headers telling the next hop how this
+    request was routed (ref: LinkerdHeaders.Dst, LinkerdHeaders.scala)."""
+
+    def __init__(self, client_id: str):
+        self._client_id = client_id
+
+    async def apply(self, req: Request, service: Service) -> Response:
+        dst = req.ctx.get("dst")
+        if dst is not None:
+            req.headers.set(L5D_DST_SERVICE, dst.path.show)
+        req.headers.set(L5D_DST_CLIENT, self._client_id)
+        return await service(req)

@@ -86,6 +86,7 @@ class HttpServer:
                     return
 
                 req.ctx["client_addr"] = writer.get_extra_info("peername")
+                req.ctx["server_addr"] = writer.get_extra_info("sockname")
                 if self._sem is not None:
                     # Admission control (ref: maxConcurrentRequests ->
                     # RequestSemaphoreFilter, Server.scala:89-97)

@@ -1,0 +1,95 @@
+"""Framed-thrift client: one pooled connection per endpoint, serial
+request/response (framed thrift is not multiplexed — finagle pools
+connections the same way; ref: ThriftClientPrep).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from linkerd_tpu.protocol.thrift.codec import (
+    ThriftCall, read_framed, write_framed,
+)
+from linkerd_tpu.router.service import Service, Status
+
+log = logging.getLogger(__name__)
+
+
+class ThriftClient(Service[ThriftCall, Optional[bytes]]):
+    def __init__(self, host: str, port: int, connect_timeout: float = 3.0):
+        self.host = host
+        self.port = port
+        self.connect_timeout = connect_timeout
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._lock = asyncio.Lock()
+        self._closed = False
+        self.pending = 0
+
+    @property
+    def status(self) -> Status:
+        return Status.CLOSED if self._closed else Status.OPEN
+
+    async def _ensure_conn(self) -> None:
+        if self._writer is None or self._writer.is_closing():
+            self._reader, self._writer = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port),
+                self.connect_timeout)
+
+    async def __call__(self, call: ThriftCall) -> Optional[bytes]:
+        self.pending += 1
+        try:
+            # serial per connection: frame pairs must not interleave
+            async with self._lock:
+                await self._ensure_conn()
+                try:
+                    write_framed(self._writer, call.payload)
+                    await self._writer.drain()
+                    if call.oneway:
+                        return None
+                    reply = await read_framed(self._reader)
+                except (ConnectionResetError, BrokenPipeError,
+                        asyncio.IncompleteReadError) as e:
+                    self._teardown()
+                    raise ConnectionError(f"thrift backend: {e}") from None
+                except asyncio.CancelledError:
+                    # canceled mid-exchange (e.g. total timeout): the
+                    # connection has an in-flight reply -> unusable
+                    self._teardown()
+                    raise
+                if reply is None:
+                    self._teardown()
+                    raise ConnectionError("thrift backend closed connection")
+                # Verify the reply matches this request; a mismatched
+                # seqid means a stale/desynced exchange (never serve
+                # caller A's payload to caller B).
+                try:
+                    from linkerd_tpu.protocol.thrift.codec import (
+                        parse_message_header,
+                    )
+                    _, seqid, _ = parse_message_header(reply)
+                except Exception:  # noqa: BLE001 - unparseable reply
+                    self._teardown()
+                    raise ConnectionError("unparseable thrift reply")
+                if seqid != call.seqid:
+                    self._teardown()
+                    raise ConnectionError(
+                        f"thrift seqid mismatch (got {seqid}, "
+                        f"want {call.seqid})")
+                return reply
+        finally:
+            self.pending -= 1
+
+    def _teardown(self) -> None:
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._reader = self._writer = None
+
+    async def close(self) -> None:
+        self._closed = True
+        self._teardown()

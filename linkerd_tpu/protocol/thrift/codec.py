@@ -1,0 +1,96 @@
+"""Thrift framed transport + TBinaryProtocol message header.
+
+Framed transport: 4-byte big-endian length prefix per message.
+TBinaryProtocol (strict) message header: i32 (VERSION_1 | type),
+len-prefixed name, i32 seqid. The proxy only needs the header — payloads
+pass through opaque (ref: router/thrift treats args as unparsed).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+VERSION_1 = 0x80010000
+VERSION_MASK = 0xFFFF0000
+
+CALL, REPLY, EXCEPTION, ONEWAY = 1, 2, 3, 4
+
+MAX_FRAME = 16 * 1024 * 1024
+
+
+class ThriftCodecError(Exception):
+    pass
+
+
+@dataclass
+class ThriftCall:
+    """One framed thrift message with its parsed header."""
+
+    payload: bytes        # the full message (header + args)
+    name: str
+    seqid: int
+    type: int
+    ctx: Dict[str, object] = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.ctx is None:
+            self.ctx = {}
+
+    @property
+    def oneway(self) -> bool:
+        return self.type == ONEWAY
+
+
+def parse_message_header(payload: bytes) -> Tuple[str, int, int]:
+    """-> (name, seqid, type). Supports strict and legacy encoding."""
+    if len(payload) < 4:
+        raise ThriftCodecError("message too short")
+    first = struct.unpack(">i", payload[:4])[0]
+    if first < 0:  # strict: version word
+        # python's & on a negative int yields the positive masked value
+        if (first & VERSION_MASK) != VERSION_1:
+            raise ThriftCodecError(f"bad thrift version {first:#x}")
+        mtype = first & 0xFF
+        (nlen,) = struct.unpack(">I", payload[4:8])
+        name = payload[8:8 + nlen].decode("utf-8")
+        (seqid,) = struct.unpack(">i", payload[8 + nlen:12 + nlen])
+        return name, seqid, mtype
+    # legacy: len-prefixed name, byte type, i32 seqid
+    nlen = first
+    name = payload[4:4 + nlen].decode("utf-8")
+    mtype = payload[4 + nlen]
+    (seqid,) = struct.unpack(">i", payload[5 + nlen:9 + nlen])
+    return name, seqid, mtype
+
+
+def encode_exception(name: str, seqid: int, message: str) -> bytes:
+    """A TApplicationException(INTERNAL_ERROR) reply frame."""
+    nb = name.encode("utf-8")
+    mb = message.encode("utf-8")
+    out = struct.pack(">I", (VERSION_1 | EXCEPTION) & 0xFFFFFFFF)
+    out += struct.pack(">I", len(nb)) + nb
+    out += struct.pack(">i", seqid)
+    # TApplicationException struct: field 1 message (string), field 2 type
+    out += b"\x0b" + struct.pack(">hI", 1, len(mb)) + mb
+    out += b"\x08" + struct.pack(">hi", 2, 6)  # INTERNAL_ERROR = 6
+    out += b"\x00"  # stop
+    return out
+
+
+async def read_framed(reader: asyncio.StreamReader) -> Optional[bytes]:
+    """One framed message; None on clean EOF."""
+    try:
+        head = await reader.readexactly(4)
+    except asyncio.IncompleteReadError:
+        return None
+    (n,) = struct.unpack(">I", head)
+    if n > MAX_FRAME:
+        raise ThriftCodecError(f"frame of {n} bytes exceeds max")
+    return await reader.readexactly(n)
+
+
+def write_framed(writer: asyncio.StreamWriter, payload: bytes) -> None:
+    writer.write(struct.pack(">I", len(payload)) + payload)

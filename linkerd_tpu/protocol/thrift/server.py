@@ -1,0 +1,98 @@
+"""Framed-thrift server: per-connection sequential dispatch.
+
+Ref: finagle-thrift server semantics as used by router/thrift — one
+request at a time per connection (thrift framed transport is not
+multiplexed), responses matched by seqid.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from linkerd_tpu.protocol.thrift.codec import (
+    ThriftCall, encode_exception, parse_message_header, read_framed,
+    write_framed,
+)
+from linkerd_tpu.router.service import Service
+
+log = logging.getLogger(__name__)
+
+
+class ThriftServer:
+    def __init__(self, service: Service[ThriftCall, Optional[bytes]],
+                 host: str = "127.0.0.1", port: int = 0):
+        self.service = service
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._conns: set = set()
+        self._conn_tasks: set = set()
+
+    @property
+    def bound_port(self) -> int:
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def start(self) -> "ThriftServer":
+        self._server = await asyncio.start_server(
+            self._on_conn, self.host, self.port)
+        return self
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        for w in list(self._conns):
+            try:
+                w.close()
+            except Exception:  # noqa: BLE001
+                pass
+        for t in list(self._conn_tasks):
+            t.cancel()
+        if self._server is not None:
+            await self._server.wait_closed()
+
+    async def _on_conn(self, reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter) -> None:
+        self._conns.add(writer)
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
+            task.add_done_callback(self._conn_tasks.discard)
+        try:
+            while True:
+                payload = await read_framed(reader)
+                if payload is None:
+                    return
+                try:
+                    name, seqid, mtype = parse_message_header(payload)
+                except Exception as e:  # noqa: BLE001 - bad frame: drop conn
+                    log.debug("bad thrift frame: %s", e)
+                    return
+                call = ThriftCall(payload, name, seqid, mtype)
+                try:
+                    reply = await self.service(call)
+                except Exception as e:  # noqa: BLE001 -> thrift exception
+                    reply = encode_exception(name, seqid, repr(e))
+                if not call.oneway and reply is not None:
+                    write_framed(writer, reply)
+                    await writer.drain()
+        except (ConnectionResetError, BrokenPipeError,
+                asyncio.IncompleteReadError):
+            pass
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001
+            log.exception("thrift connection handler error")
+        finally:
+            self._conns.discard(writer)
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+async def serve_thrift(service: Service, host: str = "127.0.0.1",
+                       port: int = 0) -> ThriftServer:
+    return await ThriftServer(service, host, port).start()

@@ -332,6 +332,19 @@ class H2ClassifiedRetries(Filter[H2Request, H2Response]):
         return rsp
 
 
+class H2ClearContextFilter(Filter[H2Request, H2Response]):
+    """Strip inbound ``l5d-*`` context headers at the server edge
+    (ref: ServerConfig clearContext — same semantics as the HTTP/1
+    ClearContextFilter, over h2 headers)."""
+
+    async def apply(self, req: H2Request, service: Service) -> H2Response:
+        doomed = [n for n, _ in req.headers.items()
+                  if n.lower().startswith("l5d-")]
+        for n in doomed:
+            req.headers.remove(n)
+        return await service(req)
+
+
 class H2ErrorResponder(Filter[H2Request, H2Response]):
     """Maps routing/dispatch failures to h2 responses with ``l5d-err``
     (ref: linkerd/protocol/h2 ErrorReseter + LinkerdHeaders err)."""

@@ -1,0 +1,159 @@
+"""HTTP protocol filters: framing, hop-by-hop, Via, Forwarded, proxy
+rewrite, clearContext, l5d-dst headers.
+
+Ref tests: router/http filter suites (FramingFilterTest,
+StripHopByHopHeadersFilterTest, AddForwardedHeaderTest etc.).
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from linkerd_tpu.linker import load_linker
+from linkerd_tpu.protocol.http.filters import (
+    AddForwardedHeaderFilter, ClearContextFilter, FramingFilter,
+    ProxyRewriteFilter, StripHopByHopHeadersFilter, ViaHeaderAppenderFilter,
+)
+from linkerd_tpu.protocol.http.message import Headers, Request, Response
+from linkerd_tpu.protocol.http.server import serve
+from linkerd_tpu.router.service import FnService, filters_to_service
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+def echo_service(seen):
+    async def handler(req: Request) -> Response:
+        seen.append(req)
+        return Response(status=200, body=b"ok")
+    return FnService(handler)
+
+
+class TestFilters:
+    def test_framing_rejects_conflicting_content_length(self):
+        async def go():
+            svc = filters_to_service([FramingFilter()], echo_service([]))
+            req = Request(uri="/")
+            req.headers.add("Content-Length", "5")
+            req.headers.add("Content-Length", "7")
+            rsp = await svc(req)
+            assert rsp.status == 400
+        run(go())
+
+    def test_strip_hop_by_hop_and_connection_named(self):
+        async def go():
+            seen = []
+            svc = filters_to_service(
+                [StripHopByHopHeadersFilter()], echo_service(seen))
+            req = Request(uri="/")
+            req.headers.set("Connection", "close, X-Custom")
+            req.headers.set("X-Custom", "1")
+            req.headers.set("Keep-Alive", "timeout=5")
+            req.headers.set("X-Keep", "yes")
+            await svc(req)
+            got = seen[0]
+            assert got.headers.get("x-custom") is None
+            assert got.headers.get("keep-alive") is None
+            assert got.headers.get("connection") is None
+            assert got.headers.get("x-keep") == "yes"
+        run(go())
+
+    def test_via_appended_both_ways(self):
+        async def go():
+            seen = []
+            svc = filters_to_service(
+                [ViaHeaderAppenderFilter()], echo_service(seen))
+            req = Request(uri="/")
+            req.headers.set("Via", "1.0 upstream")
+            rsp = await svc(req)
+            assert seen[0].headers.get("via") == "1.0 upstream, 1.1 linkerd"
+            assert rsp.headers.get("via") == "1.1 linkerd"
+        run(go())
+
+    def test_forwarded_rfc7239(self):
+        async def go():
+            seen = []
+            svc = filters_to_service(
+                [AddForwardedHeaderFilter()], echo_service(seen))
+            req = Request(uri="/")
+            req.ctx["client_addr"] = ("10.0.0.9", 55555)
+            req.ctx["server_addr"] = ("10.0.0.1", 4140)
+            await svc(req)
+            assert seen[0].headers.get("forwarded") == \
+                "for=10.0.0.9;by=10.0.0.1"
+        run(go())
+
+    def test_proxy_rewrite_absolute_uri(self):
+        async def go():
+            seen = []
+            svc = filters_to_service(
+                [ProxyRewriteFilter()], echo_service(seen))
+            await svc(Request(method="GET",
+                              uri="http://web.example.com/a/b?x=1"))
+            got = seen[0]
+            assert got.uri == "/a/b?x=1"
+            assert got.headers.get("host") == "web.example.com"
+        run(go())
+
+    def test_clear_context_strips_l5d(self):
+        async def go():
+            seen = []
+            svc = filters_to_service(
+                [ClearContextFilter()], echo_service(seen))
+            req = Request(uri="/")
+            req.headers.set("l5d-ctx-trace", "abc")
+            req.headers.set("l5d-dtab", "/a=>/b")
+            req.headers.set("X-Ok", "1")
+            await svc(req)
+            got = seen[0]
+            assert got.headers.get("l5d-ctx-trace") is None
+            assert got.headers.get("l5d-dtab") is None
+            assert got.headers.get("x-ok") == "1"
+        run(go())
+
+
+class TestThroughLinker:
+    def test_dst_headers_and_via_end_to_end(self, tmp_path):
+        disco = tmp_path / "disco"
+        disco.mkdir()
+
+        async def go():
+            seen = []
+            d = await serve(echo_service(seen))
+            (disco / "web").write_text(f"127.0.0.1 {d.bound_port}\n")
+            cfg = f"""
+routers:
+- protocol: http
+  label: out
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers:
+  - port: 0
+    clearContext: true
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+            linker = load_linker(cfg)
+            await linker.start()
+            from linkerd_tpu.protocol.http.client import HttpClient
+            proxy = HttpClient("127.0.0.1",
+                               linker.routers[0].server_ports[0])
+            try:
+                req = Request(uri="/")
+                req.headers.set("Host", "web")
+                req.headers.set("l5d-dtab", "/svc => /$/fail;")  # cleared
+                rsp = await proxy(req)
+                assert rsp.status == 200  # injected dtab was stripped
+                got = seen[0]
+                assert got.headers.get("l5d-dst-service") == "/svc/web"
+                assert got.headers.get("l5d-dst-client") == "#.io.l5d.fs.web"
+                assert got.headers.get("via") == "1.1 linkerd"
+                assert rsp.headers.get("via") == "1.1 linkerd"
+            finally:
+                await proxy.close()
+                await linker.close()
+                await d.close()
+        run(go())

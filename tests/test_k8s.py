@@ -206,3 +206,29 @@ namers:
                 await k8s_srv.close()
                 await downstream.close()
         run(go())
+
+
+class TestMissingService:
+    def test_404_service_resolves_neg(self):
+        """A nonexistent service must bind Neg (falling through dtab
+        alternatives), not hang Pending (ref: Api 404 -> Status)."""
+        async def handler(req: Request) -> Response:
+            return Response(status=404, body=json.dumps(
+                {"kind": "Status", "code": 404,
+                 "message": "endpoints \"ghost\" not found"}).encode())
+
+        async def go():
+            server = await HttpServer(FnService(handler)).start()
+            api = K8sApi("127.0.0.1", server.bound_port, use_tls=False)
+            namer = EndpointsNamer(api)
+            act = namer.lookup(Path.read("/prod/http/ghost"))
+            from linkerd_tpu.core.activity import Ok
+            from linkerd_tpu.core.nametree import Neg
+            for _ in range(100):
+                if isinstance(act.current, Ok):
+                    break
+                await asyncio.sleep(0.02)
+            assert isinstance(act.sample(), Neg)
+            namer.close()
+            await server.close()
+        run(go())

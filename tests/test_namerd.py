@@ -440,3 +440,16 @@ routers:
                 await mesh_srv.close()
                 await namerd.close()
         run(go())
+
+
+class TestDelegateApiErrors:
+    def test_missing_path_is_400(self, disco):
+        async def go():
+            namerd = _mk_namerd(disco)
+            server = await HttpServer(HttpControlService(namerd)).start()
+            st, body = await _http_req(
+                server.bound_port, "GET", "/api/1/delegate/default")
+            assert st == 400
+            await server.close()
+            await namerd.close()
+        run(go())

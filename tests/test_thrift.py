@@ -1,0 +1,154 @@
+"""Thrift router end-to-end: framed transport, static identification,
+method-in-dst, exception replies.
+
+Ref: router/thrift e2e + ThriftInitializer behavior.
+"""
+
+import asyncio
+import struct
+
+import pytest
+
+from linkerd_tpu.linker import load_linker
+from linkerd_tpu.protocol.thrift.codec import (
+    CALL, EXCEPTION, REPLY, VERSION_1, encode_exception,
+    parse_message_header, read_framed, write_framed,
+)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+def mk_call(name: str, seqid: int, args: bytes = b"\x00") -> bytes:
+    nb = name.encode()
+    return (struct.pack(">I", (VERSION_1 | CALL) & 0xFFFFFFFF)
+            + struct.pack(">I", len(nb)) + nb
+            + struct.pack(">i", seqid) + args)
+
+
+def mk_reply(name: str, seqid: int, body: bytes = b"\x00") -> bytes:
+    nb = name.encode()
+    return (struct.pack(">I", (VERSION_1 | REPLY) & 0xFFFFFFFF)
+            + struct.pack(">I", len(nb)) + nb
+            + struct.pack(">i", seqid) + body)
+
+
+def test_header_roundtrip():
+    msg = mk_call("getUser", 42)
+    name, seqid, mtype = parse_message_header(msg)
+    assert (name, seqid, mtype) == ("getUser", 42, CALL)
+    exc = encode_exception("getUser", 42, "boom")
+    name, seqid, mtype = parse_message_header(exc)
+    assert (name, seqid, mtype) == ("getUser", 42, EXCEPTION)
+
+
+async def fake_backend(tag: bytes):
+    """A framed-thrift echo server tagging its replies."""
+    async def on_conn(reader, writer):
+        try:
+            while True:
+                payload = await read_framed(reader)
+                if payload is None:
+                    return
+                name, seqid, _ = parse_message_header(payload)
+                write_framed(writer, mk_reply(name, seqid, b"\x0b" + tag))
+                await writer.drain()
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+    return await asyncio.start_server(on_conn, "127.0.0.1", 0)
+
+
+class TestThriftRouter:
+    def test_routes_and_replies(self, tmp_path):
+        disco = tmp_path / "disco"
+        disco.mkdir()
+
+        async def go():
+            backend = await fake_backend(b"B1")
+            port = backend.sockets[0].getsockname()[1]
+            (disco / "thrift").write_text(f"127.0.0.1 {port}\n")
+            cfg = f"""
+routers:
+- protocol: thrift
+  label: tr
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers: [{{port: 0}}]
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+            linker = load_linker(cfg)
+            await linker.start()
+            rport = linker.routers[0].server_ports[0]
+
+            reader, writer = await asyncio.open_connection("127.0.0.1", rport)
+            write_framed(writer, mk_call("ping", 7))
+            await writer.drain()
+            reply = await read_framed(reader)
+            name, seqid, mtype = parse_message_header(reply)
+            assert (name, seqid, mtype) == ("ping", 7, REPLY)
+            assert reply.endswith(b"B1")
+
+            # second call reuses the pooled backend conn
+            write_framed(writer, mk_call("ping", 8))
+            await writer.drain()
+            reply2 = await read_framed(reader)
+            assert parse_message_header(reply2)[1] == 8
+
+            flat = linker.metrics.flatten()
+            assert flat["rt/tr/server/requests"] == 2
+            assert flat["rt/tr/server/success"] == 2
+            assert flat["rt/tr/service/svc.thrift/requests"] == 2
+
+            writer.close()
+            await linker.close()
+            backend.close()
+        run(go())
+
+    def test_method_in_dst_and_unbound_exception(self, tmp_path):
+        disco = tmp_path / "disco"
+        disco.mkdir()
+
+        async def go():
+            backend = await fake_backend(b"M")
+            port = backend.sockets[0].getsockname()[1]
+            (disco / "getUser").write_text(f"127.0.0.1 {port}\n")
+            cfg = f"""
+routers:
+- protocol: thrift
+  label: tm
+  thriftMethodInDst: true
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers: [{{port: 0}}]
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+            linker = load_linker(cfg)
+            await linker.start()
+            rport = linker.routers[0].server_ports[0]
+            reader, writer = await asyncio.open_connection("127.0.0.1", rport)
+
+            # known method routes
+            write_framed(writer, mk_call("getUser", 1))
+            await writer.drain()
+            reply = await read_framed(reader)
+            assert parse_message_header(reply)[2] == REPLY
+
+            # unknown method -> unbound -> thrift exception reply
+            write_framed(writer, mk_call("noSuchMethod", 2))
+            await writer.drain()
+            reply = await read_framed(reader)
+            name, seqid, mtype = parse_message_header(reply)
+            assert (name, seqid, mtype) == ("noSuchMethod", 2, EXCEPTION)
+
+            writer.close()
+            await linker.close()
+            backend.close()
+        run(go())
