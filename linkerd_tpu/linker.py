@@ -44,6 +44,11 @@ from linkerd_tpu.router.tracing import (
 from linkerd_tpu.telemetry.metrics import MetricsTree
 from linkerd_tpu.telemetry.telemeter import BroadcastTracer, NullTracer
 
+# Build/load the native hot-path codecs at import (process startup) so the
+# g++ shell-out never happens on the event loop (see native.ensure_built).
+from linkerd_tpu import native as _native_codecs
+_native_codecs.ensure_built()
+
 # Ensure built-in plugin registrations are loaded.
 import linkerd_tpu.consul.namer  # noqa: F401
 import linkerd_tpu.interpreter.configs  # noqa: F401

@@ -1,0 +1,139 @@
+"""ctypes loader for the native hot-path library.
+
+Mirrors the reference's optional-native pattern (Netty loads its epoll
+transport when present, falls back to NIO): if ``libl5d_native.so`` is
+missing, it is built on first import when a toolchain is available;
+failing that, callers fall back to the pure-Python implementations.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import sys
+from typing import List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+_SO_PATH = os.path.join(os.path.dirname(__file__), "libl5d_native.so")
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    build_py = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
+                            "native", "build.py")
+    build_py = os.path.abspath(build_py)
+    if not os.path.exists(build_py):
+        return False
+    try:
+        subprocess.run([sys.executable, build_py], check=True,
+                       capture_output=True, timeout=120)
+        return True
+    except Exception as e:  # noqa: BLE001 - fall back to pure python
+        log.debug("native build failed: %s", e)
+        return False
+
+
+def ensure_built() -> bool:
+    """Build + load the native library if possible. Call at process
+    startup (linker/namerd assembly) — NEVER from the data path: the
+    compile shells out to g++ and would freeze the event loop."""
+    global _tried
+    if not os.path.exists(_SO_PATH):
+        _build()
+    _tried = False  # allow lib() to (re)load
+    return lib() is not None
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_SO_PATH):
+        return None  # ensure_built() (startup) does the building
+    try:
+        cdll = ctypes.CDLL(_SO_PATH)
+        cdll.l5d_huffman_decode.restype = ctypes.c_long
+        cdll.l5d_huffman_decode.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_size_t]
+        cdll.l5d_huffman_encode.restype = ctypes.c_long
+        cdll.l5d_huffman_encode.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_size_t]
+        cdll.l5d_parse_http1_head.restype = ctypes.c_long
+        cdll.l5d_parse_http1_head.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_size_t]
+        _lib = cdll
+    except OSError as e:
+        log.debug("native lib load failed: %s", e)
+    return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+def huffman_decode(data: bytes) -> Optional[bytes]:
+    """None => native unavailable or refused (caller falls back /
+    raises per its own validation)."""
+    cdll = lib()
+    if cdll is None:
+        return None
+    cap = max(16, len(data) * 2)
+    for _ in range(2):
+        out = ctypes.create_string_buffer(cap)
+        n = cdll.l5d_huffman_decode(data, len(data), out, cap)
+        if n == -2:
+            cap *= 4
+            continue
+        if n < 0:
+            return None  # malformed: let the python path raise precisely
+        return out.raw[:n]
+    return None
+
+
+def huffman_encode(data: bytes) -> Optional[bytes]:
+    cdll = lib()
+    if cdll is None:
+        return None
+    # rare symbols are up to 30 bits (3.75 bytes) each
+    cap = len(data) * 4 + 8
+    out = ctypes.create_string_buffer(cap)
+    n = cdll.l5d_huffman_encode(data, len(data), out, cap)
+    if n < 0:
+        return None
+    return out.raw[:n]
+
+
+MAX_HEADERS = 1024
+_SPANS = ctypes.c_int32 * (6 + MAX_HEADERS * 4)
+
+
+def parse_http1_head(head: bytes
+                     ) -> Optional[Tuple[str, str, str,
+                                         List[Tuple[str, str]]]]:
+    """Parse a full request head block -> (method, uri, version, headers).
+    None => native unavailable or malformed (caller falls back)."""
+    cdll = lib()
+    if cdll is None:
+        return None
+    spans = _SPANS()
+    n = cdll.l5d_parse_http1_head(head, len(head), spans, MAX_HEADERS)
+    if n < 0:
+        return None
+    method = head[spans[0]:spans[0] + spans[1]].decode("latin-1")
+    uri = head[spans[2]:spans[2] + spans[3]].decode("latin-1")
+    version = head[spans[4]:spans[4] + spans[5]].decode("latin-1")
+    headers = []
+    for i in range(n):
+        o = 6 + i * 4
+        name = head[spans[o]:spans[o] + spans[o + 1]].decode("latin-1")
+        val = head[spans[o + 2]:spans[o + 2] + spans[o + 3]].decode("latin-1")
+        headers.append((name, val))
+    return method, uri, version, headers

@@ -11,6 +11,11 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+try:  # native Huffman fast path (falls back to pure python)
+    from linkerd_tpu import native as _native
+except ImportError:  # pragma: no cover
+    _native = None
+
 
 class HpackError(Exception):
     """A COMPRESSION_ERROR-grade decoding failure (RFC 7540 §4.3)."""
@@ -185,6 +190,11 @@ _DECODE_TREE = _build_decode_tree()
 
 
 def huffman_decode(data: bytes) -> bytes:
+    native_out = _native.huffman_decode(data) if _native is not None else None
+    if native_out is not None:
+        return native_out
+    # pure-python path: also reached for malformed input so the precise
+    # HpackError below is raised
     out = bytearray()
     node = _DECODE_TREE
     # Track bits consumed since the last emitted symbol for padding checks.
@@ -214,6 +224,9 @@ def huffman_decode(data: bytes) -> bytes:
 
 
 def huffman_encode(data: bytes) -> bytes:
+    native_out = _native.huffman_encode(data) if _native is not None else None
+    if native_out is not None:
+        return native_out
     acc = 0
     nbits = 0
     out = bytearray()

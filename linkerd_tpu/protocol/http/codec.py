@@ -14,6 +14,11 @@ from typing import Optional, Tuple
 
 from linkerd_tpu.protocol.http.message import Headers, Request, Response
 
+try:  # native head parser fast path (falls back to pure python)
+    from linkerd_tpu import native as _native
+except ImportError:  # pragma: no cover
+    _native = None
+
 MAX_LINE = 8 * 1024
 MAX_HEADERS_BYTES = 64 * 1024
 MAX_BODY = 8 * 1024 * 1024
@@ -133,9 +138,79 @@ async def _read_body(reader: asyncio.StreamReader, framing: Tuple[str, int],
             raise HttpCodecError("bad chunk terminator")
 
 
+def _parse_head_bytes(head: bytes) -> Tuple[str, str, str, Headers]:
+    """Pure-Python head parsing over an in-memory block, enforcing the
+    same rules as the streaming _read_line/_read_headers path."""
+    lines = head.split(b"\r\n")
+    # head ends with CRLFCRLF -> two trailing empties
+    while lines and not lines[-1]:
+        lines.pop()
+    if not lines:
+        raise HttpCodecError("empty request head")
+    if len(lines[0]) > MAX_LINE:
+        raise HttpCodecError("line too long")
+    parts = lines[0].decode("latin-1").split(" ")
+    if len(parts) != 3:
+        raise HttpCodecError(f"malformed request line: {lines[0][:64]!r}")
+    method, uri, version = parts
+    if version not in ("HTTP/1.1", "HTTP/1.0"):
+        raise HttpCodecError(f"unsupported version: {version!r}")
+    headers = Headers()
+    total = 0
+    for line in lines[1:]:
+        if len(line) > MAX_LINE:
+            raise HttpCodecError("line too long")
+        total += len(line)
+        if total > MAX_HEADERS_BYTES:
+            raise HttpCodecError("headers too large")
+        if line[0:1] in (b" ", b"\t"):
+            raise HttpCodecError("obsolete header folding rejected")
+        idx = line.find(b":")
+        if idx <= 0:
+            raise HttpCodecError(f"malformed header line: {line[:64]!r}")
+        name = line[:idx].decode("latin-1").strip()
+        value = line[idx + 1:].decode("latin-1").strip()
+        if not name or any(c in name for c in " \t"):
+            raise HttpCodecError(f"malformed header name: {name!r}")
+        headers.add(name, value)
+    return method, uri, version, headers
+
+
 async def read_request(reader: asyncio.StreamReader,
                        max_body: int = MAX_BODY) -> Request:
-    """Read one request; raises EOFError on clean close before a request."""
+    """Read one request; raises EOFError on clean close before a request.
+
+    Fast path: the whole head is block-read (one readuntil) and parsed by
+    the native C parser (linkerd_tpu.native); the line-by-line pure-Python
+    path handles native-unavailable and anything the strict native parser
+    refuses, so error behavior is unchanged.
+    """
+    native = _native  # read once: the global may be toggled at runtime
+    if native is not None and native.available():
+        try:
+            head = await reader.readuntil(b"\r\n\r\n")
+        except asyncio.IncompleteReadError as e:
+            if not e.partial:
+                raise EOFError("connection closed") from None
+            raise HttpCodecError("truncated head") from None
+        except asyncio.LimitOverrunError:
+            raise HttpCodecError("head too large") from None
+        if len(head) > MAX_HEADERS_BYTES + MAX_LINE:
+            raise HttpCodecError("head too large")
+        parsed = native.parse_http1_head(head)
+        if parsed is not None:
+            method, uri, version, header_list = parsed
+            if version not in ("HTTP/1.1", "HTTP/1.0"):
+                raise HttpCodecError(f"unsupported version: {version!r}")
+            headers = Headers(header_list)
+        else:
+            # native refused (stricter caps or malformed): re-parse the
+            # already-consumed head with the pure-Python rules so accept/
+            # reject behavior and error text match the fallback path
+            method, uri, version, headers = _parse_head_bytes(head)
+        body = await _read_body(reader, _body_framing(headers), max_body)
+        return Request(method=method, uri=uri, version=version,
+                       headers=headers, body=body)
     line = await _read_line(reader)
     parts = line.decode("latin-1").split(" ")
     if len(parts) != 3:

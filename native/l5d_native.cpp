@@ -1,0 +1,204 @@
+// l5d_native: hot-path codecs for the linkerd_tpu proxy.
+//
+// The reference offloads its transport hot path to native code (Netty's
+// epoll transport + boringssl, project/Deps.scala:24); here the analogous
+// hot spots in the asyncio data plane are HPACK Huffman coding (every h2
+// header block) and HTTP/1 head parsing (every proxied request). Exposed
+// as a plain C ABI consumed via ctypes — no pybind11 dependency.
+//
+// Build: python native/build.py   (emits linkerd_tpu/native/libl5d_native.so)
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+#include "huffman_table.h"  // generated from hpack.py: HUFF_CODES/HUFF_BITS
+
+namespace {
+
+// ---- huffman decode tree (RFC 7541 appendix B) ----------------------------
+// Node: children[2] -> index; sym >= 0 at leaves; built once, lazily.
+struct Node {
+    int32_t child[2];
+    int32_t sym;
+};
+
+Node g_tree[1024];
+int g_tree_size = 0;
+bool g_tree_built = false;
+
+int new_node() {
+    int i = g_tree_size++;
+    g_tree[i].child[0] = g_tree[i].child[1] = -1;
+    g_tree[i].sym = -1;
+    return i;
+}
+
+void build_tree() {
+    if (g_tree_built) return;
+    g_tree_size = 0;
+    new_node();  // root = 0
+    for (int sym = 0; sym < 257; sym++) {
+        uint32_t code = HUFF_CODES[sym];
+        int bits = HUFF_BITS[sym];
+        int node = 0;
+        for (int b = bits - 1; b >= 0; b--) {
+            int bit = (code >> b) & 1;
+            if (g_tree[node].child[bit] < 0)
+                g_tree[node].child[bit] = new_node();
+            node = g_tree[node].child[bit];
+        }
+        g_tree[node].sym = sym;
+    }
+    g_tree_built = true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode HPACK-huffman `in` into `out` (cap `out_cap`).
+// Returns decoded length, -1 on malformed input, -2 if out_cap too small.
+long l5d_huffman_decode(const uint8_t* in, size_t in_len,
+                        uint8_t* out, size_t out_cap) {
+    build_tree();
+    size_t out_len = 0;
+    int node = 0;
+    // RFC 7541 §5.2 padding check, mirroring hpack.py exactly: count ALL
+    // bits since the last emitted symbol and whether every one was a 1.
+    int pad_bits = 0;
+    bool pad_ones = true;
+    for (size_t i = 0; i < in_len; i++) {
+        uint8_t byte = in[i];
+        for (int b = 7; b >= 0; b--) {
+            int bit = (byte >> b) & 1;
+            pad_bits++;
+            pad_ones = pad_ones && bit == 1;
+            node = g_tree[node].child[bit];
+            if (node < 0) return -1;
+            int sym = g_tree[node].sym;
+            if (sym >= 0) {
+                if (sym == 256) return -1;  // EOS in data is an error
+                if (out_len >= out_cap) return -2;
+                out[out_len++] = (uint8_t)sym;
+                node = 0;
+                pad_bits = 0;
+                pad_ones = true;
+            }
+        }
+    }
+    // leftover bits must be a strict EOS prefix: fewer than 8, all ones
+    if (pad_bits >= 8 || !pad_ones) return -1;
+    return (long)out_len;
+}
+
+// Encode `in` with HPACK huffman. Returns encoded length, -2 if cap small.
+long l5d_huffman_encode(const uint8_t* in, size_t in_len,
+                        uint8_t* out, size_t out_cap) {
+    uint64_t acc = 0;
+    int acc_bits = 0;
+    size_t out_len = 0;
+    for (size_t i = 0; i < in_len; i++) {
+        uint32_t code = HUFF_CODES[in[i]];
+        int bits = HUFF_BITS[in[i]];
+        acc = (acc << bits) | code;
+        acc_bits += bits;
+        while (acc_bits >= 8) {
+            if (out_len >= out_cap) return -2;
+            out[out_len++] = (uint8_t)(acc >> (acc_bits - 8));
+            acc_bits -= 8;
+        }
+    }
+    if (acc_bits > 0) {
+        if (out_len >= out_cap) return -2;
+        // pad with EOS prefix (1-bits)
+        out[out_len++] = (uint8_t)((acc << (8 - acc_bits))
+                                   | ((1u << (8 - acc_bits)) - 1));
+    }
+    return (long)out_len;
+}
+
+// ---- HTTP/1 head parser ----------------------------------------------------
+// Parses "METHOD SP URI SP VERSION CRLF (name: value CRLF)* CRLF" from buf.
+// Fills `spans` with byte offsets: [m_off,m_len, u_off,u_len, v_off,v_len,
+// then per header: n_off,n_len, val_off,val_len ...].
+// Returns number of headers (>=0), or -1 malformed, -2 too many headers.
+// `len` must be the exact length of the head INCLUDING the final CRLFCRLF
+// (caller finds the boundary; asyncio readuntil does this for free).
+//
+// Strictness matches the pure-Python codec's smuggling defences:
+// tokens are line-bounded (no CRLF injection through the URI), control
+// characters in the request line are rejected, obs-fold continuation
+// lines are rejected, header names must be whitespace/CTL-free, and
+// every line obeys the same MAX_LINE as the Python path.
+
+static const size_t MAX_LINE_BYTES = 8 * 1024;  // == codec.MAX_LINE
+
+long l5d_parse_http1_head(const char* buf, size_t len,
+                          int32_t* spans, size_t max_headers) {
+    // request line, bounded by the FIRST newline
+    const char* nl = (const char*)memchr(buf, '\n', len);
+    if (!nl) return -1;
+    size_t rl_end = (size_t)(nl - buf);
+    if (rl_end > 0 && buf[rl_end - 1] == '\r') rl_end--;
+    if (rl_end > MAX_LINE_BYTES) return -1;
+    for (size_t i = 0; i < rl_end; i++)
+        if ((uint8_t)buf[i] < 0x20) return -1;  // CTLs incl. \t
+    const char* sp1 = (const char*)memchr(buf, ' ', rl_end);
+    if (!sp1) return -1;
+    size_t m_len = (size_t)(sp1 - buf);
+    size_t u_off = m_len + 1;
+    const char* sp2 = (const char*)memchr(buf + u_off, ' ', rl_end - u_off);
+    if (!sp2) return -1;
+    size_t u_len = (size_t)(sp2 - buf) - u_off;
+    size_t v_off = u_off + u_len + 1;
+    // exactly three tokens: no further space inside the version
+    if (memchr(buf + v_off, ' ', rl_end - v_off)) return -1;
+    if (m_len == 0 || u_len == 0 || rl_end == v_off) return -1;
+    spans[0] = 0; spans[1] = (int32_t)m_len;
+    spans[2] = (int32_t)u_off; spans[3] = (int32_t)u_len;
+    spans[4] = (int32_t)v_off; spans[5] = (int32_t)(rl_end - v_off);
+    size_t pos = (size_t)(nl - buf) + 1;
+
+    size_t n = 0;
+    while (pos < len) {
+        const char* line_end = (const char*)memchr(buf + pos, '\n',
+                                                   len - pos);
+        size_t end = line_end ? (size_t)(line_end - buf) : len;
+        size_t trimmed_end = end;
+        if (trimmed_end > pos && buf[trimmed_end - 1] == '\r') trimmed_end--;
+        if (trimmed_end - pos > MAX_LINE_BYTES) return -1;
+        if (trimmed_end == pos) break;  // blank line: end of head
+        // obs-fold continuation lines are a smuggling vector: reject
+        if (buf[pos] == ' ' || buf[pos] == '\t') return -1;
+        const char* colon = (const char*)memchr(buf + pos, ':',
+                                                trimmed_end - pos);
+        if (!colon) return -1;
+        size_t n_off = pos;
+        size_t n_len = (size_t)(colon - buf) - pos;
+        if (n_len == 0) return -1;
+        // header names: no whitespace or CTLs anywhere
+        for (size_t i = n_off; i < n_off + n_len; i++) {
+            uint8_t c = (uint8_t)buf[i];
+            if (c <= 0x20 || c == 0x7f) return -1;
+        }
+        size_t val_off = (size_t)(colon - buf) + 1;
+        while (val_off < trimmed_end
+               && (buf[val_off] == ' ' || buf[val_off] == '\t')) val_off++;
+        size_t val_end = trimmed_end;
+        while (val_end > val_off
+               && (buf[val_end - 1] == ' ' || buf[val_end - 1] == '\t'))
+            val_end--;
+        if (n >= max_headers) return -2;
+        spans[6 + n * 4 + 0] = (int32_t)n_off;
+        spans[6 + n * 4 + 1] = (int32_t)n_len;
+        spans[6 + n * 4 + 2] = (int32_t)val_off;
+        spans[6 + n * 4 + 3] = (int32_t)(val_end - val_off);
+        n++;
+        if (!line_end) break;
+        pos = (size_t)(line_end - buf) + 1;
+    }
+    return (long)n;
+}
+
+}  // extern "C"

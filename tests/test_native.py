@@ -1,0 +1,58 @@
+"""Native codec parity: C++ fast paths must match the pure-Python rules.
+
+The smuggling-defence cases mirror the reference's FramingFilter /
+strict-parsing posture; huffman parity is fuzzed against hpack.py.
+Skipped cleanly when no toolchain is available (pure-Python fallback).
+"""
+
+import random
+
+import pytest
+
+from linkerd_tpu import native
+from linkerd_tpu.protocol.h2 import hpack
+
+pytestmark = pytest.mark.skipif(
+    not native.ensure_built(), reason="native toolchain unavailable")
+
+
+class TestHeadParser:
+    def test_good_request(self):
+        got = native.parse_http1_head(
+            b"POST /p?q=1 HTTP/1.1\r\nHost: h\r\nA: b  \r\n\r\n")
+        assert got == ("POST", "/p?q=1", "HTTP/1.1",
+                       [("Host", "h"), ("A", "b")])
+
+    @pytest.mark.parametrize("head", [
+        b"GET /x\r\nA: HTTP/1.1\r\n\r\n",           # CRLF smuggling in URI
+        b"GET / HTTP/1.1\r\nHost: a\r\n X: v\r\n\r\n",  # obs-fold
+        b"GET / HTTP/1.1\r\nX E: v\r\n\r\n",        # ws in header name
+        b"GET /a\tb HTTP/1.1\r\n\r\n",              # tab in request line
+        b"GET /a b HTTP/1.1\r\n\r\n",               # four tokens
+        b"GET /" + b"a" * 9000 + b" HTTP/1.1\r\n\r\n",  # line too long
+        b"GET / HTTP/1.1\r\nNoColon\r\n\r\n",
+    ])
+    def test_rejects(self, head):
+        assert native.parse_http1_head(head) is None
+
+
+class TestHuffmanParity:
+    def test_fuzz_roundtrip_matches_python(self):
+        random.seed(11)
+        for _ in range(200):
+            data = bytes(random.randrange(256)
+                         for _ in range(random.randrange(300)))
+            enc_py = hpack.huffman_encode(data)
+            assert native.huffman_encode(data) == enc_py
+            assert native.huffman_decode(enc_py) == data
+
+    def test_invalid_padding_rejected_like_python(self):
+        bad = bytes([0b00011110])  # 'a' + padding containing a 0-bit
+        with pytest.raises(hpack.HpackError):
+            saved = hpack._native
+            hpack._native = None
+            try:
+                hpack.huffman_decode(bad)
+            finally:
+                hpack._native = saved
+        assert native.huffman_decode(bad) is None
