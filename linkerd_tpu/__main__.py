@@ -34,6 +34,18 @@ async def amain(config_text: str) -> None:
 
     telemeter_tasks = [asyncio.create_task(t.run()) for t in linker.telemeters]
 
+    # usage telemetry is opt-out (ref: Linker.scala:116-125 implicit
+    # telemeters; disable with `usage: {enabled: false}`)
+    usage_cfg = linker.spec.usage or {}
+    if usage_cfg.get("enabled", True):
+        from linkerd_tpu.telemetry.usage import UsageDataTelemeter
+        usage = UsageDataTelemeter(
+            linker.spec, orgId=str(usage_cfg.get("orgId", "")))
+        log.info("anonymized usage telemetry enabled -> %s "
+                 "(disable with `usage: {enabled: false}`)",
+                 usage._host)
+        telemeter_tasks.append(asyncio.create_task(usage.run()))
+
     for r in linker.routers:
         log.info("router %s serving on %s", r.label, r.server_ports)
     log.info("admin serving on %s:%s", admin.host, admin.bound_port)

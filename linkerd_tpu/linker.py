@@ -53,8 +53,10 @@ _native_codecs.ensure_built()
 import linkerd_tpu.consul.namer  # noqa: F401
 import linkerd_tpu.interpreter.configs  # noqa: F401
 import linkerd_tpu.k8s.namer  # noqa: F401
+import linkerd_tpu.announcer  # noqa: F401
 import linkerd_tpu.namer.fs  # noqa: F401
 import linkerd_tpu.namer.marathon  # noqa: F401
+import linkerd_tpu.namer.transformers  # noqa: F401
 import linkerd_tpu.protocol.h2.classifiers  # noqa: F401
 import linkerd_tpu.protocol.h2.identifiers  # noqa: F401
 import linkerd_tpu.protocol.http.identifiers  # noqa: F401
@@ -96,6 +98,8 @@ class ServerSpec:
     # strip inbound l5d-* headers at this server edge (untrusted callers;
     # ref: ServerConfig clearContext, Server.scala:77-117)
     clearContext: bool = False
+    # announce paths, e.g. ["/#/io.l5d.fs/web"] (ref: servers[].announce)
+    announce: Optional[List[str]] = None
 
 
 @dataclass
@@ -184,7 +188,9 @@ class LinkerSpec:
     routers: List[RouterSpec] = field(default_factory=list)
     namers: Optional[List[Any]] = None     # kind-discriminated mappings
     telemetry: Optional[List[Any]] = None  # kind-discriminated mappings
+    announcers: Optional[List[Any]] = None  # kind-discriminated mappings
     admin: Optional[AdminSpec] = None
+    usage: Optional[Dict[str, Any]] = None  # {enabled, orgId}
 
 
 def per_prefix_lookup(raw: Any, cls: type, where: str,
@@ -296,6 +302,8 @@ class Linker:
         self.config_dict = config_dict
         self.metrics = MetricsTree()
         self.namers: List[Tuple[Path, Namer]] = []
+        self.announcers: List[Tuple[Path, Any]] = []
+        self._announcements: List[Any] = []
         self.routers: List[Router] = []
         self.telemeters: List[Any] = []
         self._access_listeners: List[Tuple[Any, Any]] = []
@@ -303,9 +311,35 @@ class Linker:
 
     # -- assembly ---------------------------------------------------------
     def _build(self) -> None:
-        for ncfg in instantiate_list("namer", self.spec.namers, "namers"):
+        from linkerd_tpu.namer.transformers import TransformingNamer
+        for i, raw in enumerate(self.spec.namers or []):
+            if not isinstance(raw, dict):
+                raise ConfigError(f"namers[{i}]: expected a mapping")
+            raw = dict(raw)
+            t_cfgs = raw.pop("transformers", None) or []
+            ncfg = instantiate("namer", raw, f"namers[{i}]")
             prefix = Path.read(getattr(ncfg, "prefix", f"/{ncfg.kind}"))
-            self.namers.append((prefix, ncfg.mk()))
+            namer = ncfg.mk()
+            if t_cfgs:
+                transformers = [
+                    instantiate("transformer", t,
+                                f"namers[{i}].transformers[{j}]").mk()
+                    for j, t in enumerate(t_cfgs)
+                ]
+                namer = TransformingNamer(namer, transformers)
+            self.namers.append((prefix, namer))
+
+        for acfg in instantiate_list(
+                "announcer", self.spec.announcers, "announcers"):
+            self.announcers.append(
+                (Path.read(getattr(acfg, "prefix", f"/{acfg.kind}")),
+                 acfg.mk()))
+        # validate announce paths now, before any socket is bound
+        from linkerd_tpu.announcer import match_announcer
+        for rspec in self.spec.routers:
+            for s in rspec.servers or []:
+                for raw in s.announce or []:
+                    match_announcer(self.announcers, Path.read(raw))
 
         for tcfg in instantiate_list("telemeter", self.spec.telemetry, "telemetry"):
             self.telemeters.append(tcfg.mk(self.metrics))
@@ -832,9 +866,22 @@ class Linker:
     async def start(self) -> "Linker":
         for r in self.routers:
             await r.start()
+        # announce bound servers (ref: Main.announce, Main.scala:97-130)
+        from linkerd_tpu.announcer import match_announcer
+        for r in self.routers:
+            for spec, server in zip(
+                    r.spec.servers or [ServerSpec()], r.servers):
+                for raw in spec.announce or []:
+                    ann, rest = match_announcer(
+                        self.announcers, Path.read(raw))
+                    self._announcements.append(
+                        ann.announce(spec.ip, server.bound_port, rest))
         return self
 
     async def close(self) -> None:
+        for c in self._announcements:
+            c.close()
+        self._announcements.clear()
         for r in self.routers:
             await r.close()
         for _, namer in self.namers:

@@ -1,0 +1,223 @@
+"""NameTree transformers: rewrite bound trees / replica sets after binding.
+
+Ref: namer/core/.../NameTreeTransformer.scala:146 + DelegatingNameTree
+Transformer; plugin kinds under interpreter/per-host and interpreter/subnet
+(PortTransformer.scala:40, LocalhostTransformer, SpecificHostTransformer,
+Netmask.scala/SubnetGatewayTransformer.scala). Transformed bound ids are
+prefixed ``/%/<kind>`` (the reference's transformer prefix) so binding
+caches never conflate transformed and untransformed clients.
+"""
+
+from __future__ import annotations
+
+import abc
+import ipaddress
+import socket
+from dataclasses import dataclass, field
+from typing import FrozenSet, List, Optional
+
+from linkerd_tpu.config import ConfigError, register
+from linkerd_tpu.core import Activity, Path, Var
+from linkerd_tpu.core.addr import Addr, Address, Bound, BoundName
+from linkerd_tpu.core.nametree import (
+    Alt, Leaf, NameTree, Union, Weighted,
+)
+from linkerd_tpu.namer.core import Namer
+
+TRANSFORMER_PREFIX = "%"  # /%/<kind>/... (ref: TransformerPrefix)
+
+
+class AddressTransformer(abc.ABC):
+    """Rewrites the concrete replica set of every bound leaf."""
+
+    def __init__(self, kind: str):
+        self.kind = kind
+
+    @abc.abstractmethod
+    def transform_addresses(
+            self, addresses: FrozenSet[Address]) -> FrozenSet[Address]: ...
+
+    def transform_addr(self, addr: Addr) -> Addr:
+        if isinstance(addr, Bound):
+            return Bound(self.transform_addresses(addr.addresses), addr.meta)
+        return addr
+
+    def transform_leaf(self, bound: BoundName) -> BoundName:
+        new_id = Path.of(TRANSFORMER_PREFIX, *self.kind.split("/")).concat(
+            bound.id_)
+        return BoundName(new_id, bound.addr.map(self.transform_addr),
+                        bound.residual)
+
+    def transform_tree(self, tree: NameTree) -> NameTree:
+        if isinstance(tree, Leaf):
+            return Leaf(self.transform_leaf(tree.value))
+        if isinstance(tree, Alt):
+            return Alt(*(self.transform_tree(t) for t in tree.trees))
+        if isinstance(tree, Union):
+            return Union(*(Weighted(w.weight, self.transform_tree(w.tree))
+                           for w in tree.weighted))
+        return tree
+
+
+class TransformingNamer(Namer):
+    """Applies a transformer chain to a namer's bind results."""
+
+    def __init__(self, inner: Namer,
+                 transformers: List[AddressTransformer]):
+        self._inner = inner
+        self._transformers = transformers
+
+    def lookup(self, path: Path) -> Activity[NameTree]:
+        act = self._inner.lookup(path)
+        for t in self._transformers:
+            act = act.map(t.transform_tree)
+        return act
+
+    def close(self) -> None:
+        self._inner.close()
+
+
+# ---- kinds -----------------------------------------------------------------
+
+class PortTransformer(AddressTransformer):
+    """Every endpoint's port replaced (ref: PortTransformer.scala:40 —
+    route to a fixed proxy port on each discovered host)."""
+
+    def __init__(self, port: int):
+        super().__init__("io.l5d.port")
+        self.port = port
+
+    def transform_addresses(self, addresses):
+        return frozenset(
+            Address(a.host, self.port, a.weight, a.meta) for a in addresses)
+
+
+def _local_ips() -> FrozenSet[str]:
+    ips = {"127.0.0.1", "::1"}
+    try:
+        hostname = socket.gethostname()
+        for info in socket.getaddrinfo(hostname, None):
+            ips.add(info[4][0])
+    except OSError:
+        pass
+    return frozenset(ips)
+
+
+class LocalhostTransformer(AddressTransformer):
+    """Keep only endpoints on this host (DaemonSet-style per-host routing,
+    ref: LocalhostTransformer)."""
+
+    def __init__(self, local_ips: Optional[FrozenSet[str]] = None):
+        super().__init__("io.l5d.localhost")
+        self.local_ips = local_ips if local_ips is not None else _local_ips()
+
+    def transform_addresses(self, addresses):
+        return frozenset(a for a in addresses if a.host in self.local_ips)
+
+
+class SpecificHostTransformer(AddressTransformer):
+    """Keep only endpoints on one configured host
+    (ref: SpecificHostTransformer)."""
+
+    def __init__(self, host: str):
+        super().__init__("io.l5d.specificHost")
+        self.host = host
+
+    def transform_addresses(self, addresses):
+        return frozenset(a for a in addresses if a.host == self.host)
+
+
+class SubnetGatewayTransformer(AddressTransformer):
+    """Replace each endpoint with the gateway sharing its subnet
+    (DaemonSet routing across nodes; ref: SubnetGatewayTransformer.scala:78
+    + Netmask.scala). Gateways come from a live Var[Addr] (e.g. a
+    DaemonSet's endpoints)."""
+
+    def __init__(self, gateways: Var, netmask: str):
+        super().__init__("io.l5d.subnet")
+        self._gateways = gateways
+        try:
+            self._prefix = int(netmask) if not ("." in netmask) else \
+                ipaddress.ip_network(f"0.0.0.0/{netmask}").prefixlen
+        except ValueError as e:
+            raise ConfigError(f"bad netmask {netmask!r}: {e}") from None
+
+    def _subnet(self, host: str):
+        try:
+            return ipaddress.ip_network(
+                f"{host}/{self._prefix}", strict=False)
+        except ValueError:
+            return None
+
+    def transform_addresses(self, addresses):
+        gaddr = self._gateways.sample()
+        gateways = gaddr.addresses if isinstance(gaddr, Bound) else frozenset()
+        by_subnet = {}
+        for g in gateways:
+            net = self._subnet(g.host)
+            if net is not None:
+                by_subnet[net] = g
+        out = set()
+        for a in addresses:
+            net = self._subnet(a.host)
+            if net is not None and net in by_subnet:
+                g = by_subnet[net]
+                out.add(Address(g.host, g.port, a.weight, a.meta))
+        return frozenset(out)
+
+
+# ---- config kinds ----------------------------------------------------------
+
+@register("transformer", "io.l5d.port")
+@dataclass
+class PortTransformerConfig:
+    port: int = 4140
+
+    def mk(self) -> AddressTransformer:
+        return PortTransformer(self.port)
+
+
+@register("transformer", "io.l5d.localhost")
+@dataclass
+class LocalhostTransformerConfig:
+    def mk(self) -> AddressTransformer:
+        return LocalhostTransformer()
+
+
+@register("transformer", "io.l5d.specificHost")
+@dataclass
+class SpecificHostTransformerConfig:
+    host: str = "127.0.0.1"
+
+    def mk(self) -> AddressTransformer:
+        return SpecificHostTransformer(self.host)
+
+
+@register("transformer", "io.l5d.replace")
+@dataclass
+class ReplaceTransformerConfig:
+    """Replace every replica set with a static one (the reference's
+    ConstTransformer/ReplaceTransformer pair, used to force traffic
+    through a fixed gateway)."""
+
+    addrs: List[str] = field(default_factory=list)  # "host port" lines
+
+    def mk(self) -> AddressTransformer:
+        parsed = []
+        for line in self.addrs:
+            parts = line.split()
+            if len(parts) != 2:
+                raise ConfigError(
+                    f"io.l5d.replace addrs: expected 'host port', "
+                    f"got {line!r}")
+            parsed.append(Address.mk(parts[0], int(parts[1])))
+        const = frozenset(parsed)
+
+        class _Replace(AddressTransformer):
+            def __init__(self):
+                super().__init__("io.l5d.replace")
+
+            def transform_addresses(self, addresses):
+                return const
+
+        return _Replace()

@@ -41,9 +41,45 @@ async def _read_line(reader: asyncio.StreamReader) -> bytes:
         raise HttpCodecError("truncated line") from None
     except asyncio.LimitOverrunError:
         raise HttpCodecError("line too long") from None
+    line = line[:-2]
     if len(line) > MAX_LINE:
         raise HttpCodecError("line too long")
-    return line[:-2]
+    return line
+
+
+def _parse_request_line(line: bytes) -> Tuple[str, str, str]:
+    """One shared implementation for the streaming and block paths
+    (CRLF already stripped)."""
+    if len(line) > MAX_LINE:
+        raise HttpCodecError("line too long")
+    parts = line.decode("latin-1").split(" ")
+    if len(parts) != 3:
+        raise HttpCodecError(f"malformed request line: {line[:64]!r}")
+    method, uri, version = parts
+    if version not in ("HTTP/1.1", "HTTP/1.0"):
+        raise HttpCodecError(f"unsupported version: {version!r}")
+    return method, uri, version
+
+
+def _parse_header_line(line: bytes, headers: Headers, total: int) -> int:
+    """Validate + add one header line (CRLF stripped); returns the new
+    running byte total. Shared by streaming and block paths."""
+    if len(line) > MAX_LINE:
+        raise HttpCodecError("line too long")
+    total += len(line)
+    if total > MAX_HEADERS_BYTES:
+        raise HttpCodecError("headers too large")
+    if line[0:1] in (b" ", b"\t"):
+        raise HttpCodecError("obsolete header folding rejected")
+    idx = line.find(b":")
+    if idx <= 0:
+        raise HttpCodecError(f"malformed header line: {line[:64]!r}")
+    name = line[:idx].decode("latin-1").strip()
+    value = line[idx + 1:].decode("latin-1").strip()
+    if not name or any(c in name for c in " \t"):
+        raise HttpCodecError(f"malformed header name: {name!r}")
+    headers.add(name, value)
+    return total
 
 
 async def _read_headers(reader: asyncio.StreamReader) -> Headers:
@@ -53,19 +89,7 @@ async def _read_headers(reader: asyncio.StreamReader) -> Headers:
         line = await _read_line(reader)
         if not line:
             return headers
-        total += len(line)
-        if total > MAX_HEADERS_BYTES:
-            raise HttpCodecError("headers too large")
-        if line[0:1] in (b" ", b"\t"):
-            raise HttpCodecError("obsolete header folding rejected")
-        idx = line.find(b":")
-        if idx <= 0:
-            raise HttpCodecError(f"malformed header line: {line[:64]!r}")
-        name = line[:idx].decode("latin-1").strip()
-        value = line[idx + 1:].decode("latin-1").strip()
-        if not name or any(c in name for c in " \t"):
-            raise HttpCodecError(f"malformed header name: {name!r}")
-        headers.add(name, value)
+        total = _parse_header_line(line, headers, total)
 
 
 def _body_framing(headers: Headers) -> Tuple[str, int]:
@@ -139,40 +163,19 @@ async def _read_body(reader: asyncio.StreamReader, framing: Tuple[str, int],
 
 
 def _parse_head_bytes(head: bytes) -> Tuple[str, str, str, Headers]:
-    """Pure-Python head parsing over an in-memory block, enforcing the
-    same rules as the streaming _read_line/_read_headers path."""
+    """Pure-Python head parsing over an in-memory block; same rules as
+    the streaming path via the shared line parsers."""
     lines = head.split(b"\r\n")
     # head ends with CRLFCRLF -> two trailing empties
     while lines and not lines[-1]:
         lines.pop()
     if not lines:
         raise HttpCodecError("empty request head")
-    if len(lines[0]) > MAX_LINE:
-        raise HttpCodecError("line too long")
-    parts = lines[0].decode("latin-1").split(" ")
-    if len(parts) != 3:
-        raise HttpCodecError(f"malformed request line: {lines[0][:64]!r}")
-    method, uri, version = parts
-    if version not in ("HTTP/1.1", "HTTP/1.0"):
-        raise HttpCodecError(f"unsupported version: {version!r}")
+    method, uri, version = _parse_request_line(lines[0])
     headers = Headers()
     total = 0
     for line in lines[1:]:
-        if len(line) > MAX_LINE:
-            raise HttpCodecError("line too long")
-        total += len(line)
-        if total > MAX_HEADERS_BYTES:
-            raise HttpCodecError("headers too large")
-        if line[0:1] in (b" ", b"\t"):
-            raise HttpCodecError("obsolete header folding rejected")
-        idx = line.find(b":")
-        if idx <= 0:
-            raise HttpCodecError(f"malformed header line: {line[:64]!r}")
-        name = line[:idx].decode("latin-1").strip()
-        value = line[idx + 1:].decode("latin-1").strip()
-        if not name or any(c in name for c in " \t"):
-            raise HttpCodecError(f"malformed header name: {name!r}")
-        headers.add(name, value)
+        total = _parse_header_line(line, headers, total)
     return method, uri, version, headers
 
 
@@ -212,12 +215,7 @@ async def read_request(reader: asyncio.StreamReader,
         return Request(method=method, uri=uri, version=version,
                        headers=headers, body=body)
     line = await _read_line(reader)
-    parts = line.decode("latin-1").split(" ")
-    if len(parts) != 3:
-        raise HttpCodecError(f"malformed request line: {line[:64]!r}")
-    method, uri, version = parts
-    if version not in ("HTTP/1.1", "HTTP/1.0"):
-        raise HttpCodecError(f"unsupported version: {version!r}")
+    method, uri, version = _parse_request_line(line)
     headers = await _read_headers(reader)
     body = await _read_body(reader, _body_framing(headers), max_body)
     return Request(method=method, uri=uri, version=version,

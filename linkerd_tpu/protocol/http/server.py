@@ -43,8 +43,12 @@ class HttpServer:
         return self._server.sockets[0].getsockname()[1]
 
     async def start(self) -> "HttpServer":
+        # the stream limit must exceed the largest legal head so the
+        # block-read fast path (readuntil in codec.read_request) never
+        # trips LimitOverrunError before the codec's own size checks
         self._server = await asyncio.start_server(
-            self._handle_conn, self.host, self.port, ssl=self.ssl_context)
+            self._handle_conn, self.host, self.port, ssl=self.ssl_context,
+            limit=codec.MAX_HEADERS_BYTES + 2 * codec.MAX_LINE)
         return self
 
     async def close(self) -> None:

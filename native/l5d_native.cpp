@@ -134,13 +134,22 @@ long l5d_huffman_encode(const uint8_t* in, size_t in_len,
 
 static const size_t MAX_LINE_BYTES = 8 * 1024;  // == codec.MAX_LINE
 
+// whitespace trimmed from header-value edges; matches python str.strip()
+// for chars that can appear inside a line (no \r\n by construction)
+static inline bool is_ows(char c) {
+    return c == ' ' || c == '\t' || c == '\f' || c == '\v';
+}
+
 long l5d_parse_http1_head(const char* buf, size_t len,
                           int32_t* spans, size_t max_headers) {
-    // request line, bounded by the FIRST newline
+    // request line, bounded by the FIRST newline; lines MUST end CRLF
+    // (bare-LF acceptance would make this parser disagree with the
+    // pure-Python one — a request-smuggling vector)
     const char* nl = (const char*)memchr(buf, '\n', len);
     if (!nl) return -1;
     size_t rl_end = (size_t)(nl - buf);
-    if (rl_end > 0 && buf[rl_end - 1] == '\r') rl_end--;
+    if (rl_end == 0 || buf[rl_end - 1] != '\r') return -1;
+    rl_end--;
     if (rl_end > MAX_LINE_BYTES) return -1;
     for (size_t i = 0; i < rl_end; i++)
         if ((uint8_t)buf[i] < 0x20) return -1;  // CTLs incl. \t
@@ -164,11 +173,12 @@ long l5d_parse_http1_head(const char* buf, size_t len,
     while (pos < len) {
         const char* line_end = (const char*)memchr(buf + pos, '\n',
                                                    len - pos);
-        size_t end = line_end ? (size_t)(line_end - buf) : len;
-        size_t trimmed_end = end;
-        if (trimmed_end > pos && buf[trimmed_end - 1] == '\r') trimmed_end--;
+        if (!line_end) return -1;  // every line must end CRLF
+        size_t end = (size_t)(line_end - buf);
+        if (end == pos || buf[end - 1] != '\r') return -1;
+        size_t trimmed_end = end - 1;
         if (trimmed_end - pos > MAX_LINE_BYTES) return -1;
-        if (trimmed_end == pos) break;  // blank line: end of head
+        if (trimmed_end == pos) break;  // blank CRLF line: end of head
         // obs-fold continuation lines are a smuggling vector: reject
         if (buf[pos] == ' ' || buf[pos] == '\t') return -1;
         const char* colon = (const char*)memchr(buf + pos, ':',
@@ -183,19 +193,15 @@ long l5d_parse_http1_head(const char* buf, size_t len,
             if (c <= 0x20 || c == 0x7f) return -1;
         }
         size_t val_off = (size_t)(colon - buf) + 1;
-        while (val_off < trimmed_end
-               && (buf[val_off] == ' ' || buf[val_off] == '\t')) val_off++;
+        while (val_off < trimmed_end && is_ows(buf[val_off])) val_off++;
         size_t val_end = trimmed_end;
-        while (val_end > val_off
-               && (buf[val_end - 1] == ' ' || buf[val_end - 1] == '\t'))
-            val_end--;
+        while (val_end > val_off && is_ows(buf[val_end - 1])) val_end--;
         if (n >= max_headers) return -2;
         spans[6 + n * 4 + 0] = (int32_t)n_off;
         spans[6 + n * 4 + 1] = (int32_t)n_len;
         spans[6 + n * 4 + 2] = (int32_t)val_off;
         spans[6 + n * 4 + 3] = (int32_t)(val_end - val_off);
         n++;
-        if (!line_end) break;
         pos = (size_t)(line_end - buf) + 1;
     }
     return (long)n;

@@ -56,3 +56,20 @@ class TestHuffmanParity:
             finally:
                 hpack._native = saved
         assert native.huffman_decode(bad) is None
+
+
+class TestCrlfStrictness:
+    @pytest.mark.parametrize("head", [
+        b"GET / HTTP/1.1\nHost: a\r\n\r\n",       # bare-LF request line
+        b"GET / HTTP/1.1\r\nA: 1\n\nTE: x\r\n\r\n",  # LF-LF fake blank
+        b"GET / HTTP/1.1\r\nHost: a\n\r\n",       # bare-LF header line
+    ])
+    def test_bare_lf_rejected(self, head):
+        assert native.parse_http1_head(head) is None
+
+    def test_value_trim_matches_python_strip(self):
+        got = native.parse_http1_head(
+            b"GET / HTTP/1.1\r\nX-A: \x0cv\x0c \r\n\r\n")
+        assert got is not None
+        # python: " \x0cv\x0c ".strip() == "v"; native must agree
+        assert got[3] == [("X-A", "v")]

@@ -1,0 +1,143 @@
+"""Transformers, announcers, usage report.
+
+Ref: interpreter/per-host + subnet transformer tests, announcer wiring
+(Main.announce), UsageDataTelemeter anonymization.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from linkerd_tpu.core import Path, Var
+from linkerd_tpu.core.addr import Address, Bound
+from linkerd_tpu.core.nametree import Leaf
+from linkerd_tpu.linker import load_linker, parse_linker_spec
+from linkerd_tpu.namer.transformers import (
+    LocalhostTransformer, PortTransformer, SpecificHostTransformer,
+    SubnetGatewayTransformer,
+)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+def bound(*hostports):
+    return Bound(frozenset(Address.mk(h, p) for h, p in hostports))
+
+
+class TestAddressTransformers:
+    def test_port_transformer(self):
+        t = PortTransformer(4141)
+        got = t.transform_addr(bound(("10.0.0.1", 8080), ("10.0.0.2", 9090)))
+        assert {(a.host, a.port) for a in got.addresses} == {
+            ("10.0.0.1", 4141), ("10.0.0.2", 4141)}
+
+    def test_localhost_transformer(self):
+        t = LocalhostTransformer(local_ips=frozenset({"10.0.0.1"}))
+        got = t.transform_addr(bound(("10.0.0.1", 1), ("10.0.0.2", 2)))
+        assert {(a.host, a.port) for a in got.addresses} == {("10.0.0.1", 1)}
+
+    def test_specific_host(self):
+        t = SpecificHostTransformer("10.0.0.2")
+        got = t.transform_addr(bound(("10.0.0.1", 1), ("10.0.0.2", 2)))
+        assert {(a.host, a.port) for a in got.addresses} == {("10.0.0.2", 2)}
+
+    def test_subnet_gateway(self):
+        gateways = Var(bound(("10.0.1.200", 4140), ("10.0.2.200", 4140)))
+        t = SubnetGatewayTransformer(gateways, "255.255.255.0")
+        got = t.transform_addr(
+            bound(("10.0.1.7", 8080), ("10.0.2.9", 8080),
+                  ("10.0.9.1", 8080)))
+        # each endpoint replaced by its subnet's gateway; no-gateway
+        # subnet endpoints are dropped
+        assert {(a.host, a.port) for a in got.addresses} == {
+            ("10.0.1.200", 4140), ("10.0.2.200", 4140)}
+
+    def test_transformed_leaf_id_prefixed(self):
+        t = PortTransformer(4141)
+        from linkerd_tpu.core.addr import BoundName
+        bn = BoundName(Path.read("/#/io.l5d.fs/web"), Var(bound()))
+        got = t.transform_leaf(bn)
+        assert got.id_.show == "/%/io.l5d.port/#/io.l5d.fs/web"
+
+
+class TestTransformerWiring:
+    def test_namer_transformers_from_config(self, tmp_path):
+        disco = tmp_path / "disco"
+        disco.mkdir()
+        (disco / "web").write_text("10.0.0.1 8080\n10.0.0.2 9090\n")
+        cfg = f"""
+routers:
+- protocol: http
+  servers: [{{port: 0}}]
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+  transformers:
+  - kind: io.l5d.port
+    port: 4141
+"""
+        async def go():
+            linker = load_linker(cfg)
+            namer = linker.namers[0][1]
+            act = namer.lookup(Path.read("/web"))
+            tree = act.sample()
+            assert isinstance(tree, Leaf)
+            addrs = tree.value.addr.sample()
+            assert {(a.host, a.port) for a in addrs.addresses} == {
+                ("10.0.0.1", 4141), ("10.0.0.2", 4141)}
+            await linker.close()
+        run(go())
+
+
+class TestAnnouncer:
+    def test_fs_announce_and_withdraw(self, tmp_path):
+        """A linkerd announces its server; another discovers it through
+        the fs namer pointing at the same directory (the serversets
+        pattern, file-backed)."""
+        disco = tmp_path / "disco"
+
+        cfg = f"""
+routers:
+- protocol: http
+  label: out
+  servers:
+  - port: 0
+    announce: ["/#/io.l5d.fs/web"]
+announcers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+        async def go():
+            linker = load_linker(cfg)
+            await linker.start()
+            port = linker.routers[0].server_ports[0]
+            content = (disco / "web").read_text()
+            assert content.strip() == f"127.0.0.1 {port}"
+            await linker.close()
+            assert not (disco / "web").exists()  # withdrawn
+        run(go())
+
+
+class TestUsageReport:
+    def test_report_is_anonymized(self):
+        from linkerd_tpu.telemetry.usage import build_report
+        spec = parse_linker_spec("""
+routers:
+- protocol: http
+  dtab: |
+    /svc/secret-service => /#/io.l5d.fs ;
+  identifier: {kind: io.l5d.methodAndHost}
+  servers: [{port: 0}]
+namers:
+- kind: io.l5d.fs
+  rootDir: /secret/path
+""")
+        report = build_report(spec, orgId="acme", instance_id="i",
+                              start_time=0)
+        text = json.dumps(report)
+        assert "secret" not in text       # no dtabs/paths leak
+        assert report["namers"] == ["io.l5d.fs"]
+        assert report["routers"][0]["identifiers"] == ["io.l5d.methodAndHost"]

@@ -1,0 +1,190 @@
+"""Assembled-binary validator.
+
+Ref: validator/src/main/scala/io/buoyant/namerd/Validator.scala:13-80 +
+``validator/validateAssembled`` (project/LinkerdBuild.scala:620-634):
+spawn the REAL linkerd and namerd executables as subprocesses, stand up
+downstream HTTP servers, drive dtab flips through namerd's HTTP control
+API, and assert traffic re-routes within bounded staleness.
+
+Usage: python tools/validator.py   (exit 0 = pass)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+NAMERD_HTTP = 24180
+NAMERD_MESH = 24321
+LINKERD_PORT = 24140
+STALENESS_S = 5.0
+
+
+def http(method: str, url: str, body: bytes = b"", headers=None) -> tuple:
+    req = urllib.request.Request(url, data=body or None, method=method,
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as rsp:
+            return rsp.status, dict(rsp.headers), rsp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+async def downstream(name: str, port: int):
+    async def on_conn(reader, writer):
+        try:
+            while True:
+                head = await reader.readuntil(b"\r\n\r\n")
+                if not head:
+                    return
+                body = name.encode()
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\nContent-Length: "
+                    + str(len(body)).encode() + b"\r\n\r\n" + body)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+    return await asyncio.start_server(on_conn, "127.0.0.1", port)
+
+
+async def wait_for(predicate, timeout: float, what: str):
+    """Polls in a worker thread so the in-process downstreams (which run
+    on this event loop) keep serving while we wait."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            if await asyncio.to_thread(predicate):
+                return
+        except Exception:
+            pass
+        await asyncio.sleep(0.2)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+async def main() -> int:
+    work = tempfile.mkdtemp(prefix="l5d-validate-")
+    disco = os.path.join(work, "disco")
+    dtabs = os.path.join(work, "dtabs")
+    os.makedirs(disco)
+
+    d_a = await downstream("A", 24801)
+    d_b = await downstream("B", 24802)
+    with open(os.path.join(disco, "svc-a"), "w") as f:
+        f.write("127.0.0.1 24801\n")
+    with open(os.path.join(disco, "svc-b"), "w") as f:
+        f.write("127.0.0.1 24802\n")
+
+    namerd_yaml = os.path.join(work, "namerd.yaml")
+    with open(namerd_yaml, "w") as f:
+        f.write(f"""
+storage:
+  kind: io.l5d.fs
+  directory: {dtabs}
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+interfaces:
+- kind: io.l5d.mesh
+  port: {NAMERD_MESH}
+- kind: io.l5d.httpController
+  port: {NAMERD_HTTP}
+""")
+    linkerd_yaml = os.path.join(work, "linkerd.yaml")
+    with open(linkerd_yaml, "w") as f:
+        f.write(f"""
+routers:
+- protocol: http
+  label: validated
+  interpreter:
+    kind: io.l5d.mesh
+    dst: /$/inet/127.0.0.1/{NAMERD_MESH}
+    root: /default
+  servers:
+  - port: {LINKERD_PORT}
+admin:
+  port: 24990
+""")
+
+    env = dict(os.environ, PYTHONPATH=REPO)
+    procs = []
+    try:
+        # spawn the two real binaries (ref: Validator spawns assembled jars)
+        namerd = subprocess.Popen(
+            [sys.executable, "-m", "linkerd_tpu.namerd", namerd_yaml],
+            env=env, cwd=work)
+        procs.append(namerd)
+        await wait_for(lambda: http(
+            "GET", f"http://127.0.0.1:{NAMERD_HTTP}/api/1/dtabs"
+        )[0] == 200, 15, "namerd http controller")
+
+        st, _, _ = await asyncio.to_thread(http,
+            "POST", f"http://127.0.0.1:{NAMERD_HTTP}/api/1/dtabs/default",
+            b"/svc => /#/io.l5d.fs/svc-a;")
+        assert st == 204, f"dtab create: {st}"
+
+        linkerd = subprocess.Popen(
+            [sys.executable, "-m", "linkerd_tpu", linkerd_yaml],
+            env=env, cwd=work)
+        procs.append(linkerd)
+        await wait_for(lambda: http(
+            "GET", f"http://127.0.0.1:{LINKERD_PORT}/",
+            headers={"Host": "web"})[2] == b"A", 15, "route to A")
+        print("validator: initial route -> A ok")
+
+        # flip the dtab (CAS) -> expect B within bounded staleness
+        st, hdrs, _ = await asyncio.to_thread(http,
+            "GET", f"http://127.0.0.1:{NAMERD_HTTP}/api/1/dtabs/default")
+        etag = hdrs.get("ETag")
+        st, _, _ = await asyncio.to_thread(http,
+            "PUT", f"http://127.0.0.1:{NAMERD_HTTP}/api/1/dtabs/default",
+            b"/svc => /#/io.l5d.fs/svc-b;", headers={"If-Match": etag})
+        assert st == 204, f"dtab flip: {st}"
+        t0 = time.time()
+        await wait_for(lambda: http(
+            "GET", f"http://127.0.0.1:{LINKERD_PORT}/",
+            headers={"Host": "web"})[2] == b"B",
+            STALENESS_S, "re-route to B")
+        print(f"validator: dtab flip re-routed in {time.time() - t0:.2f}s")
+
+        # stale CAS must fail
+        st, _, _ = await asyncio.to_thread(http,
+            "PUT", f"http://127.0.0.1:{NAMERD_HTTP}/api/1/dtabs/default",
+            b"/svc => /#/io.l5d.fs/svc-a;", headers={"If-Match": etag})
+        assert st == 412, f"stale CAS should 412, got {st}"
+        print("validator: stale CAS rejected (412)")
+
+        # delegate API agrees with live routing
+        st, _, body = await asyncio.to_thread(http,
+            "GET", f"http://127.0.0.1:{NAMERD_HTTP}"
+                   f"/api/1/delegate/default?path=/svc/web")
+        tree = json.loads(body)
+        assert "svc-b" in json.dumps(tree), tree
+        print("validator: delegation explanation matches")
+        print("VALIDATOR PASS")
+        return 0
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        d_a.close()
+        d_b.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(asyncio.run(main()))
