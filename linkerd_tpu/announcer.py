@@ -44,20 +44,29 @@ class FsAnnouncer(Announcer):
         return os.path.join(self.root, "-".join(name))
 
     def _rewrite(self, path: str, drop: str, add: str = "") -> None:
-        lines: List[str] = []
-        if os.path.exists(path):
-            with open(path) as f:
-                lines = [ln for ln in f.read().splitlines()
-                         if ln.strip() and ln.strip() != drop]
-        if add:
-            lines.append(add)
-        if lines:
-            tmp = path + ".tmp"
-            with open(tmp, "w") as f:
-                f.write("\n".join(lines) + "\n")
-            os.replace(tmp, path)
-        elif os.path.exists(path):
-            os.unlink(path)
+        # Multiple linkerds announce into one shared directory, so the
+        # read-modify-write must be serialized across PROCESSES: flock on
+        # a sidecar lock file (the serversets analogue of ZK's atomicity).
+        import fcntl
+        with open(path + ".lock", "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            try:
+                lines: List[str] = []
+                if os.path.exists(path):
+                    with open(path) as f:
+                        lines = [ln for ln in f.read().splitlines()
+                                 if ln.strip() and ln.strip() != drop]
+                if add:
+                    lines.append(add)
+                if lines:
+                    tmp = path + ".tmp"
+                    with open(tmp, "w") as f:
+                        f.write("\n".join(lines) + "\n")
+                    os.replace(tmp, path)
+                elif os.path.exists(path):
+                    os.unlink(path)
+            finally:
+                fcntl.flock(lock, fcntl.LOCK_UN)
 
     def announce(self, host: str, port: int, name: Path) -> Closable:
         path = self._file(name)

@@ -34,7 +34,8 @@ from linkerd_tpu.router.retries import (
     ClassifiedRetries, RetryBudget, TotalTimeout, backoff_jittered,
 )
 from linkerd_tpu.router.routing import (
-    ErrorResponder, PerDstPathStatsFilter, RoutingService, StatsFilter,
+    BasicStatsFilter, ErrorResponder, IdentificationError,
+    PerDstPathStatsFilter, RoutingService, StatsFilter,
     StatusCodeStatsFilter,
 )
 from linkerd_tpu.router.service import Filter, Service, filters_to_service
@@ -349,7 +350,8 @@ class Linker:
 
         labels_seen: Dict[str, int] = {}
         for rspec in self.spec.routers:
-            if rspec.protocol not in ("http", "h2", "thrift"):
+            if rspec.protocol not in (
+                    "http", "h2", "thrift", "mux", "thriftmux"):
                 raise ConfigError(
                     f"protocol {rspec.protocol!r} not yet supported")
             label = rspec.label or rspec.protocol
@@ -361,6 +363,10 @@ class Linker:
                 self.routers.append(self._mk_h2_router(rspec, label))
             elif rspec.protocol == "thrift":
                 self.routers.append(self._mk_thrift_router(rspec, label))
+            elif rspec.protocol in ("mux", "thriftmux"):
+                self.routers.append(self._mk_mux_router(
+                    rspec, label,
+                    thrift_semantics=(rspec.protocol == "thriftmux")))
             else:
                 self.routers.append(self._mk_http_router(rspec, label))
 
@@ -556,6 +562,110 @@ class Linker:
         return Router(rspec, label, server_stack, binding, servers,
                       interpreter=interpreter)
 
+    def _mk_mux_router(self, rspec: RouterSpec, label: str,
+                       thrift_semantics: bool) -> Router:
+        """mux / thriftmux routers (ref: router/mux Mux.scala:83 +
+        router/thriftmux ThriftMux.scala:66). mux identifies by the
+        Tdispatch ``dest`` path; thriftmux identifies like thrift
+        (static dst, or the thrift method with thriftMethodInDst)."""
+        from linkerd_tpu.protocol.mux.client import MuxClient
+        from linkerd_tpu.protocol.mux.codec import Tdispatch
+        from linkerd_tpu.protocol.mux.server import MuxServer
+        from linkerd_tpu.protocol.thrift.codec import parse_message_header
+
+        for i, s in enumerate(rspec.servers or []):
+            if s.tls is not None or s.clearContext or \
+                    s.maxConcurrentRequests is not None:
+                raise ConfigError(
+                    f"{label}.servers[{i}]: tls/clearContext/"
+                    f"maxConcurrentRequests not supported for "
+                    f"{rspec.protocol} servers")
+
+        base_dtab = Dtab.read(rspec.dtab) if rspec.dtab else Dtab.empty()
+        prefix = Path.read(rspec.dstPrefix)
+        method_in_dst = rspec.thriftMethodInDst
+
+        def identifier(td: Tdispatch) -> DstPath:
+            local = Dtab.empty()
+            if td.dtab:
+                try:
+                    local = Dtab.read(";".join(
+                        f"{src} => {dst}" for src, dst in td.dtab))
+                except ValueError as e:
+                    raise IdentificationError(
+                        f"bad mux dtab: {e}") from None
+            if thrift_semantics:
+                seg = "thriftmux"
+                if method_in_dst:
+                    try:
+                        seg, _, _ = parse_message_header(td.payload)
+                    except Exception:  # noqa: BLE001
+                        raise IdentificationError(
+                            "unparseable thrift message") from None
+                return DstPath(prefix + Path.of(seg), base_dtab, local)
+            if td.dest.startswith("/"):
+                return DstPath(prefix + Path.read(td.dest),
+                               base_dtab, local)
+            return DstPath(prefix + Path.of("mux"), base_dtab, local)
+
+        interpreter = self._mk_interpreter(rspec, label)
+        client_lookup = per_prefix_lookup(
+            rspec.client, ClientSpec, f"{label}.client",
+            self._mk_client_validator(label))
+        metrics = self.metrics
+        mk_policy_factory = self._mk_policy_factory_fn(label)
+
+        MuxStatsFilter = BasicStatsFilter
+
+        def client_factory(bound: BoundName) -> Service:
+            cid = bound.id_.show.lstrip("/").replace("/", ".") or "client"
+            cspec, _cvars = client_lookup(bound.id_)
+            mk_policy = mk_policy_factory(cspec)
+
+            def endpoint_factory(addr: Address) -> Service:
+                client: Service = MuxClient(
+                    addr.host, addr.port,
+                    connect_timeout=cspec.connectTimeoutMs / 1e3)
+                return FailureAccrualService(client, mk_policy())
+
+            bal_kind = (cspec.loadBalancer or BalancerSpec()).kind
+            bal = mk_balancer(bal_kind, bound.addr, endpoint_factory)
+            metrics.scope("rt", label, "client", cid).gauge(
+                "endpoints", fn=lambda b=bal: b.size)
+            return _PruneOnClose(
+                filters_to_service(
+                    [MuxStatsFilter(
+                        metrics.scope("rt", label, "client", cid))], bal),
+                metrics, ("rt", label, "client", cid))
+
+        svc_lookup = per_prefix_lookup(
+            rspec.service, SvcSpec, f"{label}.service")
+
+        def path_filters(dst: DstPath, svc: Service) -> Service:
+            sspec, _ = svc_lookup(dst.path)
+            name = dst.path.show.lstrip("/").replace("/", ".") or "root"
+            filters: List[Any] = [MuxStatsFilter(
+                metrics.scope("rt", label, "service", name))]
+            if sspec.totalTimeoutMs is not None:
+                filters.append(TotalTimeout(sspec.totalTimeoutMs / 1e3))
+            return filters_to_service(filters, svc)
+
+        cache_cfg = rspec.bindingCache or {}
+        binding = DstBindingFactory(
+            interpreter, client_factory, path_filters=path_filters,
+            capacity=int(cache_cfg.get("capacity", 1000)),
+            idle_ttl=float(cache_cfg.get("idleTtlSecs", 600.0)),
+            bind_timeout=rspec.bindingTimeoutMs / 1e3)
+        routing = RoutingService(identifier, binding)
+        server_stack = filters_to_service(
+            [MuxStatsFilter(metrics.scope("rt", label, "server"))], routing)
+        servers = [
+            MuxServer(server_stack, s.ip, s.port)
+            for s in (rspec.servers or [ServerSpec()])
+        ]
+        return Router(rspec, label, server_stack, binding, servers,
+                      interpreter=interpreter)
+
     def _mk_thrift_router(self, rspec: RouterSpec, label: str) -> Router:
         """Thrift router: static (or method) identification, framed
         transport passthrough (ref: router/thrift + ThriftInitializer)."""
@@ -610,31 +720,12 @@ class Linker:
                 pass
             return ResponseClass.SUCCESS
 
-        class ThriftStatsFilter(Filter):
-            def __init__(self, node):
-                self._requests = node.counter("requests")
-                self._success = node.counter("success")
-                self._failures = node.counter("failures")
-                self._latency = node.stat("request_latency_ms")
+        from linkerd_tpu.router.classifiers import ResponseClass
 
-            async def apply(self, req, service):
-                import time as _t
-                self._requests.incr()
-                t0 = _t.monotonic()
-                try:
-                    rsp = await service(req)
-                except BaseException:
-                    self._failures.incr()
-                    self._latency.add((_t.monotonic() - t0) * 1e3)
-                    raise
-                self._latency.add((_t.monotonic() - t0) * 1e3)
-                from linkerd_tpu.router.classifiers import ResponseClass
-                if thrift_classifier(req, rsp, None) \
-                        is ResponseClass.SUCCESS:
-                    self._success.incr()
-                else:
-                    self._failures.incr()
-                return rsp
+        def ThriftStatsFilter(node):
+            return BasicStatsFilter(
+                node, classify=lambda req, rsp: thrift_classifier(
+                    req, rsp, None) is ResponseClass.SUCCESS)
 
         def client_factory(bound: BoundName) -> Service:
             cid = bound.id_.show.lstrip("/").replace("/", ".") or "client"

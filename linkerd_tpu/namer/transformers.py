@@ -161,8 +161,9 @@ class SubnetGatewayTransformer(AddressTransformer):
         for a in addresses:
             net = self._subnet(a.host)
             if net is not None and net in by_subnet:
-                g = by_subnet[net]
-                out.add(Address(g.host, g.port, a.weight, a.meta))
+                # the gateway address itself (NOT per-pod weight/meta):
+                # N pods behind one gateway must dedup to one endpoint
+                out.add(by_subnet[net])
         return frozenset(out)
 
 

@@ -36,6 +36,7 @@ DEFAULT_HTTP_CONTROL_PORT = 4180
 # Ensure built-in plugin registrations are loaded (the LoadService
 # analogue; ref: Linker.scala:64-75 SPI loading).
 import linkerd_tpu.namer.fs  # noqa: E402,F401
+import linkerd_tpu.namerd.stores  # noqa: E402,F401
 
 
 # ---- storage kinds ---------------------------------------------------------

@@ -1,0 +1,292 @@
+"""Remote DtabStore backends: etcd and consul KV.
+
+Ref: namerd/storage/etcd/.../EtcdDtabStore.scala:121 over the etcd v2 key
+API (etcd/.../{Etcd,Key,NodeOp}.scala — CAS via prevIndex, recursive
+watch) and namerd/storage/consul/.../ConsulDtabStore.scala:160 over the
+consul KV API (consul/.../KvApi.scala — CAS via ModifyIndex, blocking-
+index watch). Both hold one watch loop per store feeding the namespace
+Activities, with jittered-backoff reconnect.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+import random
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, Optional
+from urllib.parse import quote
+
+from linkerd_tpu.config import ConfigError, register
+from linkerd_tpu.core import Activity, Dtab, Var
+from linkerd_tpu.core.activity import Ok
+from linkerd_tpu.namerd.store import (
+    DtabNamespaceAlreadyExists, DtabNamespaceDoesNotExist, DtabStore,
+    DtabVersionMismatch, VersionedDtab,
+)
+from linkerd_tpu.protocol.http import codec as http_codec
+from linkerd_tpu.protocol.http.message import Headers, Request
+from linkerd_tpu.protocol.http.simple_client import get as http_get
+
+log = logging.getLogger(__name__)
+
+
+async def _http_call(host: str, port: int, method: str, path: str,
+                     body: bytes = b"",
+                     content_type: str = "application/x-www-form-urlencoded",
+                     timeout: float = 30.0,
+                     extra_headers: Optional[Dict[str, str]] = None):
+    """One-shot request -> Response (shares the http codec)."""
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, port), timeout)
+    try:
+        hdrs = Headers([("Host", host), ("Connection", "close"),
+                        ("Content-Type", content_type)])
+        for k, v in (extra_headers or {}).items():
+            hdrs.set(k, v)
+        req = Request(method=method, uri=path, headers=hdrs, body=body)
+        http_codec.write_request(writer, req)
+        await writer.drain()
+        return await asyncio.wait_for(
+            http_codec.read_response(reader, request_method=method), timeout)
+    finally:
+        writer.close()
+
+
+class _PolledRemoteStore(DtabStore):
+    """Common machinery: a poll/watch loop maintains the full ns->dtab
+    map; writes go straight to the backend (CAS there), and the loop
+    publishes convergent state."""
+
+    def __init__(self, poll_interval: float = 1.0):
+        self._acts: Dict[str, Activity] = {}
+        self._list: Var[FrozenSet[str]] = Var(frozenset())
+        self._known: Dict[str, VersionedDtab] = {}
+        self._poll_interval = poll_interval
+        self._task: Optional[asyncio.Task] = None
+
+    # subclass: fetch all namespaces -> Dict[str, VersionedDtab]
+    async def _fetch_all(self) -> Dict[str, VersionedDtab]:
+        raise NotImplementedError
+
+    def _ensure_task(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_event_loop().create_task(self._run())
+
+    async def _run(self) -> None:
+        attempt = 0
+        while True:
+            try:
+                state = await self._fetch_all()
+                attempt = 0
+                self._publish(state)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 - retry forever
+                log.debug("dtab store poll: %s", e)
+                attempt = min(attempt + 1, 8)
+            await asyncio.sleep(
+                self._poll_interval * (2 ** min(attempt, 4))
+                * (0.75 + random.random() / 2))
+
+    def _publish(self, state: Dict[str, VersionedDtab]) -> None:
+        self._known = state
+        self._list.update(frozenset(state))
+        for ns, act in self._acts.items():
+            act.update(Ok(state.get(ns)))
+
+    def list(self) -> Var[FrozenSet[str]]:
+        self._ensure_task()
+        return self._list
+
+    def observe(self, ns: str) -> Activity:
+        self._ensure_task()
+        if ns not in self._acts:
+            self._acts[ns] = Activity.mutable(Ok(self._known.get(ns)))
+        return self._acts[ns]
+
+    async def _refresh_now(self) -> None:
+        try:
+            self._publish(await self._fetch_all())
+        except Exception as e:  # noqa: BLE001
+            log.debug("dtab store refresh: %s", e)
+
+    def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+
+class EtcdDtabStore(_PolledRemoteStore):
+    """etcd v2 keys API under ``/v2/keys/<root>/`` (kind io.l5d.etcd)."""
+
+    def __init__(self, host: str, port: int, root: str = "/namerd/dtabs",
+                 poll_interval: float = 1.0):
+        super().__init__(poll_interval)
+        self.host = host
+        self.port = port
+        self.root = root.rstrip("/")
+
+    def _key(self, ns: str) -> str:
+        return f"/v2/keys{self.root}/{quote(ns)}"
+
+    async def _fetch_all(self) -> Dict[str, VersionedDtab]:
+        rsp = await http_get(self.host, self.port,
+                             f"/v2/keys{self.root}/?recursive=true",
+                             timeout=10.0)
+        if rsp.status == 404:
+            return {}
+        data = json.loads(rsp.body)
+        out: Dict[str, VersionedDtab] = {}
+        for node in (data.get("node") or {}).get("nodes") or []:
+            ns = node["key"].rsplit("/", 1)[-1]
+            try:
+                dtab = Dtab.read(node.get("value") or "")
+            except ValueError:
+                continue
+            version = str(node.get("modifiedIndex", "")).encode()
+            out[ns] = VersionedDtab(dtab, version)
+        return out
+
+    async def create(self, ns: str, dtab: Dtab) -> None:
+        body = f"value={quote(dtab.show)}&prevExist=false".encode()
+        rsp = await _http_call(self.host, self.port, "PUT",
+                               self._key(ns), body)
+        if rsp.status == 412:
+            raise DtabNamespaceAlreadyExists(ns)
+        if rsp.status not in (200, 201):
+            raise RuntimeError(f"etcd create: {rsp.status}")
+        await self._refresh_now()
+
+    async def update(self, ns: str, dtab: Dtab, version: bytes) -> None:
+        idx = version.decode()
+        body = f"value={quote(dtab.show)}&prevIndex={idx}".encode()
+        rsp = await _http_call(self.host, self.port, "PUT",
+                               self._key(ns), body)
+        if rsp.status == 412:
+            raise DtabVersionMismatch(ns)
+        if rsp.status == 404:
+            raise DtabNamespaceDoesNotExist(ns)
+        if rsp.status != 200:
+            raise RuntimeError(f"etcd update: {rsp.status}")
+        await self._refresh_now()
+
+    async def put(self, ns: str, dtab: Dtab) -> None:
+        body = f"value={quote(dtab.show)}".encode()
+        rsp = await _http_call(self.host, self.port, "PUT",
+                               self._key(ns), body)
+        if rsp.status not in (200, 201):
+            raise RuntimeError(f"etcd put: {rsp.status}")
+        await self._refresh_now()
+
+    async def delete(self, ns: str) -> None:
+        rsp = await _http_call(self.host, self.port, "DELETE", self._key(ns))
+        if rsp.status == 404:
+            raise DtabNamespaceDoesNotExist(ns)
+        if rsp.status != 200:
+            raise RuntimeError(f"etcd delete: {rsp.status}")
+        await self._refresh_now()
+
+
+class ConsulDtabStore(_PolledRemoteStore):
+    """Consul KV under ``<root>/<ns>`` (kind io.l5d.consul), CAS via
+    ModifyIndex (ref: ConsulDtabStore.scala)."""
+
+    def __init__(self, host: str, port: int, root: str = "namerd/dtabs",
+                 token: Optional[str] = None, poll_interval: float = 1.0):
+        super().__init__(poll_interval)
+        self.host = host
+        self.port = port
+        self.root = root.strip("/")
+        self.token = token
+
+    def _kv(self, ns: str, query: str = "") -> str:
+        q = f"?{query}" if query else ""
+        return f"/v1/kv/{self.root}/{quote(ns)}{q}"
+
+    def _auth(self) -> Dict[str, str]:
+        return {"X-Consul-Token": self.token} if self.token else {}
+
+    async def _fetch_all(self) -> Dict[str, VersionedDtab]:
+        rsp = await http_get(self.host, self.port,
+                             f"/v1/kv/{self.root}/?recurse=true",
+                             headers=self._auth(), timeout=10.0)
+        if rsp.status == 404:
+            return {}
+        out: Dict[str, VersionedDtab] = {}
+        for entry in json.loads(rsp.body) or []:
+            ns = entry["Key"].rsplit("/", 1)[-1]
+            if not ns:
+                continue
+            raw = base64.b64decode(entry.get("Value") or "")
+            try:
+                dtab = Dtab.read(raw.decode("utf-8"))
+            except ValueError:
+                continue
+            out[ns] = VersionedDtab(
+                dtab, str(entry.get("ModifyIndex", "")).encode())
+        return out
+
+    async def _cas_put(self, ns: str, dtab: Dtab, cas: Optional[str]
+                       ) -> bool:
+        query = f"cas={cas}" if cas is not None else ""
+        rsp = await _http_call(self.host, self.port, "PUT",
+                               self._kv(ns, query), dtab.show.encode(),
+                               content_type="text/plain",
+                               extra_headers=self._auth())
+        if rsp.status != 200:
+            raise RuntimeError(f"consul kv put: {rsp.status}")
+        return rsp.body.strip() == b"true"
+
+    async def create(self, ns: str, dtab: Dtab) -> None:
+        if not await self._cas_put(ns, dtab, cas="0"):  # 0 = only-if-absent
+            raise DtabNamespaceAlreadyExists(ns)
+        await self._refresh_now()
+
+    async def update(self, ns: str, dtab: Dtab, version: bytes) -> None:
+        state = await self._fetch_all()
+        if ns not in state:
+            raise DtabNamespaceDoesNotExist(ns)
+        if not await self._cas_put(ns, dtab, cas=version.decode()):
+            raise DtabVersionMismatch(ns)
+        await self._refresh_now()
+
+    async def put(self, ns: str, dtab: Dtab) -> None:
+        await self._cas_put(ns, dtab, cas=None)
+        await self._refresh_now()
+
+    async def delete(self, ns: str) -> None:
+        state = await self._fetch_all()
+        if ns not in state:
+            raise DtabNamespaceDoesNotExist(ns)
+        rsp = await _http_call(self.host, self.port, "DELETE",
+                               self._kv(ns), extra_headers=self._auth())
+        if rsp.status != 200:
+            raise RuntimeError(f"consul kv delete: {rsp.status}")
+        await self._refresh_now()
+
+
+@register("dtabStore", "io.l5d.etcd")
+@dataclass
+class EtcdStoreConfig:
+    host: str = "127.0.0.1"
+    port: int = 2379
+    pathPrefix: str = "/namerd/dtabs"
+
+    def mk(self) -> DtabStore:
+        return EtcdDtabStore(self.host, self.port, self.pathPrefix)
+
+
+@register("dtabStore", "io.l5d.consul")
+@dataclass
+class ConsulStoreConfig:
+    host: str = "127.0.0.1"
+    port: int = 8500
+    pathPrefix: str = "namerd/dtabs"
+    token: Optional[str] = None
+
+    def mk(self) -> DtabStore:
+        return ConsulDtabStore(self.host, self.port, self.pathPrefix,
+                               token=self.token)

@@ -52,6 +52,11 @@ def _parse_request_line(line: bytes) -> Tuple[str, str, str]:
     (CRLF already stripped)."""
     if len(line) > MAX_LINE:
         raise HttpCodecError("line too long")
+    if b"\n" in line or b"\r" in line:
+        # a bare LF/CR inside a line is a parser-differential smuggling
+        # vector (lines are CRLF-delimited; embedded ones re-serialize
+        # as new lines downstream)
+        raise HttpCodecError("bare CR/LF in request line")
     parts = line.decode("latin-1").split(" ")
     if len(parts) != 3:
         raise HttpCodecError(f"malformed request line: {line[:64]!r}")
@@ -66,6 +71,8 @@ def _parse_header_line(line: bytes, headers: Headers, total: int) -> int:
     running byte total. Shared by streaming and block paths."""
     if len(line) > MAX_LINE:
         raise HttpCodecError("line too long")
+    if b"\n" in line or b"\r" in line:
+        raise HttpCodecError("bare CR/LF in header line")
     total += len(line)
     if total > MAX_HEADERS_BYTES:
         raise HttpCodecError("headers too large")

@@ -1,0 +1,154 @@
+"""Mux client: one multiplexed connection per endpoint, tag-matched
+concurrent exchanges (ref: finagle mux ClientDispatcher)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional
+
+from linkerd_tpu.protocol.mux.codec import (
+    MuxCodecError, RDISPATCH, RERR, RNACK, ROK, RPING, TDISCARDED,
+    TDISPATCH, TPING, Tdispatch, decode_rdispatch, encode_tdispatch,
+    read_mux_frame, write_mux_frame,
+)
+from linkerd_tpu.router.service import Service, Status
+
+log = logging.getLogger(__name__)
+
+MAX_TAG = 0x7FFFFF
+
+
+class MuxApplicationError(Exception):
+    """Rdispatch status != ok or an Rerr reply."""
+
+
+class MuxClient(Service[Tdispatch, bytes]):
+    def __init__(self, host: str, port: int, connect_timeout: float = 3.0):
+        self.host = host
+        self.port = port
+        self.connect_timeout = connect_timeout
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._read_task: Optional[asyncio.Task] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._next_tag = 1
+        self._lock = asyncio.Lock()
+        self._closed = False
+        self.pending = 0
+
+    @property
+    def status(self) -> Status:
+        return Status.CLOSED if self._closed else Status.OPEN
+
+    async def _ensure_conn(self) -> None:
+        if self._writer is not None and not self._writer.is_closing():
+            return
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(self.host, self.port),
+            self.connect_timeout)
+        self._writer = writer
+        self._read_task = asyncio.get_running_loop().create_task(
+            self._read_loop(reader))
+
+    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
+        try:
+            while True:
+                msg = await read_mux_frame(reader)
+                if msg is None:
+                    break
+                fut = self._pending.pop(msg.tag, None)
+                if fut is None or fut.done():
+                    continue
+                if msg.type == RDISPATCH:
+                    try:
+                        status, payload = decode_rdispatch(msg)
+                    except MuxCodecError as e:
+                        fut.set_exception(e)
+                        continue
+                    if status == ROK:
+                        fut.set_result(payload)
+                    elif status == RNACK:
+                        fut.set_exception(
+                            ConnectionError("mux backend nack"))
+                    else:
+                        fut.set_exception(MuxApplicationError(
+                            payload.decode("utf-8", "replace")))
+                elif msg.type == RERR:
+                    fut.set_exception(MuxApplicationError(
+                        msg.body.decode("utf-8", "replace")))
+                elif msg.type == RPING:
+                    fut.set_result(b"")
+        except (ConnectionResetError, asyncio.IncompleteReadError,
+                MuxCodecError) as e:
+            log.debug("mux client read loop: %s", e)
+        finally:
+            err = ConnectionError("mux connection closed")
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(err)
+            self._pending.clear()
+            if self._writer is not None:
+                try:
+                    self._writer.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                self._writer = None
+
+    def _alloc_tag(self) -> int:
+        for _ in range(MAX_TAG):
+            tag = self._next_tag
+            self._next_tag = self._next_tag % MAX_TAG + 1
+            if tag not in self._pending:
+                return tag
+        raise ConnectionError("mux tags exhausted")
+
+    async def __call__(self, td: Tdispatch) -> bytes:
+        self.pending += 1
+        try:
+            async with self._lock:
+                await self._ensure_conn()
+                tag = self._alloc_tag()
+                fut = asyncio.get_running_loop().create_future()
+                self._pending[tag] = fut
+                write_mux_frame(self._writer, *encode_tdispatch(
+                    tag, td.contexts, td.dest, td.dtab, td.payload))
+                await self._writer.drain()
+            try:
+                return await fut
+            except asyncio.CancelledError:
+                self._pending.pop(tag, None)
+                # tell the server to abandon the exchange so a late reply
+                # can't be misdelivered if the tag is reused (the mux
+                # Tdiscarded handshake exists exactly for this)
+                if self._writer is not None and \
+                        not self._writer.is_closing():
+                    try:
+                        write_mux_frame(
+                            self._writer, TDISCARDED, 0,
+                            tag.to_bytes(3, "big") + b"canceled")
+                    except Exception:  # noqa: BLE001 - best effort
+                        pass
+                raise
+        finally:
+            self.pending -= 1
+
+    async def ping(self) -> None:
+        async with self._lock:
+            await self._ensure_conn()
+            tag = self._alloc_tag()
+            fut = asyncio.get_running_loop().create_future()
+            self._pending[tag] = fut
+            write_mux_frame(self._writer, TPING, tag, b"")
+            await self._writer.drain()
+        await fut
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._read_task is not None:
+            self._read_task.cancel()
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._writer = None

@@ -115,6 +115,36 @@ class StatsFilter(Filter[Request, Response]):
         return rsp
 
 
+class BasicStatsFilter(Filter):
+    """Protocol-agnostic requests/success/failures + latency under a
+    metrics node; success judged by an optional ``classify(req, rsp)``
+    callable (default: everything that returns is a success). Used by
+    the byte-oriented routers (thrift, mux)."""
+
+    def __init__(self, node, classify=None):
+        self._requests = node.counter("requests")
+        self._success = node.counter("success")
+        self._failures = node.counter("failures")
+        self._latency = node.stat("request_latency_ms")
+        self._classify = classify
+
+    async def apply(self, req, service):
+        self._requests.incr()
+        t0 = time.monotonic()
+        try:
+            rsp = await service(req)
+        except BaseException:
+            self._failures.incr()
+            self._latency.add((time.monotonic() - t0) * 1e3)
+            raise
+        self._latency.add((time.monotonic() - t0) * 1e3)
+        if self._classify is None or self._classify(req, rsp):
+            self._success.incr()
+        else:
+            self._failures.incr()
+        return rsp
+
+
 class StatusCodeStatsFilter(Filter[Request, Response]):
     """Per-status-code counters (ref: StatusCodeStatsFilter.scala)."""
 

@@ -1,0 +1,162 @@
+"""mux / thriftmux: codec, multiplexed client/server, routing.
+
+Ref: router/mux + router/thriftmux e2e; finagle mux framing semantics
+(tag-matched concurrent exchanges, Tping, Rerr).
+"""
+
+import asyncio
+import struct
+
+import pytest
+
+from linkerd_tpu.linker import load_linker
+from linkerd_tpu.protocol.mux.client import MuxApplicationError, MuxClient
+from linkerd_tpu.protocol.mux.codec import (
+    Tdispatch, decode_tdispatch, encode_tdispatch, MuxMessage,
+)
+from linkerd_tpu.protocol.mux.server import MuxServer
+from linkerd_tpu.router.service import FnService
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+def test_tdispatch_roundtrip():
+    mtype, tag, body = encode_tdispatch(
+        7, [(b"ctx", b"v")], "/svc/users", [("/a", "/b")], b"PAYLOAD")
+    td = decode_tdispatch(MuxMessage(mtype, tag, body))
+    assert td.tag == 7
+    assert td.contexts == [(b"ctx", b"v")]
+    assert td.dest == "/svc/users"
+    assert td.dtab == [("/a", "/b")]
+    assert td.payload == b"PAYLOAD"
+
+
+class TestMuxClientServer:
+    def test_concurrent_tag_matched_exchanges(self):
+        async def go():
+            async def handler(td: Tdispatch) -> bytes:
+                # reply after a delay proportional to the payload so
+                # replies come back OUT of request order
+                delay = int(td.payload) / 100
+                await asyncio.sleep(delay)
+                return b"r" + td.payload
+
+            server = await MuxServer(FnService(handler)).start()
+            client = MuxClient("127.0.0.1", server.bound_port)
+            results = await asyncio.gather(*(
+                client(Tdispatch(0, [], "/svc", [], str(n).encode()))
+                for n in (3, 1, 2)))
+            assert results == [b"r3", b"r1", b"r2"]
+            await client.ping()  # Tping round-trip
+            await client.close()
+            await server.close()
+        run(go())
+
+    def test_handler_error_becomes_rerr(self):
+        async def go():
+            async def boom(td):
+                raise RuntimeError("kapow")
+            server = await MuxServer(FnService(boom)).start()
+            client = MuxClient("127.0.0.1", server.bound_port)
+            with pytest.raises(MuxApplicationError):
+                await client(Tdispatch(0, [], "/svc", [], b""))
+            await client.close()
+            await server.close()
+        run(go())
+
+
+class TestMuxRouter:
+    def test_routes_by_dest_with_inline_dtab(self, tmp_path):
+        disco = tmp_path / "disco"
+        disco.mkdir()
+
+        async def go():
+            async def backend(td: Tdispatch) -> bytes:
+                return b"be:" + td.payload
+            be = await MuxServer(FnService(backend)).start()
+            (disco / "users").write_text(f"127.0.0.1 {be.bound_port}\n")
+
+            cfg = f"""
+routers:
+- protocol: mux
+  label: mx
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers: [{{port: 0}}]
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+            linker = load_linker(cfg)
+            await linker.start()
+            client = MuxClient("127.0.0.1",
+                               linker.routers[0].server_ports[0])
+            # dest "/users" + dstPrefix "/svc" -> /svc/users through dtab
+            # (ref: Mux.scala:36 prefix ++ destination)
+            rsp = await client(Tdispatch(0, [], "/users", [], b"hi"))
+            assert rsp == b"be:hi"
+
+            # per-request dtab override (mux carries dtabs natively)
+            (disco / "other").write_text(f"127.0.0.1 {be.bound_port}\n")
+            rsp = await client(Tdispatch(
+                0, [], "/nothere",
+                [("/svc/nothere", "/#/io.l5d.fs/other")], b"x"))
+            assert rsp == b"be:x"
+
+            flat = linker.metrics.flatten()
+            assert flat["rt/mx/server/requests"] == 2
+            await client.close()
+            await linker.close()
+            await be.close()
+        run(go())
+
+
+class TestThriftMuxRouter:
+    def test_thrift_over_mux(self, tmp_path):
+        from linkerd_tpu.protocol.thrift.codec import (
+            CALL, REPLY, VERSION_1, parse_message_header,
+        )
+
+        def mk_call(name, seqid):
+            nb = name.encode()
+            return (struct.pack(">I", (VERSION_1 | CALL) & 0xFFFFFFFF)
+                    + struct.pack(">I", len(nb)) + nb
+                    + struct.pack(">i", seqid) + b"\x00")
+
+        disco = tmp_path / "disco"
+        disco.mkdir()
+
+        async def go():
+            async def backend(td: Tdispatch) -> bytes:
+                name, seqid, _ = parse_message_header(td.payload)
+                nb = name.encode()
+                return (struct.pack(">I", (VERSION_1 | REPLY) & 0xFFFFFFFF)
+                        + struct.pack(">I", len(nb)) + nb
+                        + struct.pack(">i", seqid) + b"\x00")
+            be = await MuxServer(FnService(backend)).start()
+            (disco / "thriftmux").write_text(f"127.0.0.1 {be.bound_port}\n")
+
+            cfg = f"""
+routers:
+- protocol: thriftmux
+  label: tmx
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers: [{{port: 0}}]
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+            linker = load_linker(cfg)
+            await linker.start()
+            client = MuxClient("127.0.0.1",
+                               linker.routers[0].server_ports[0])
+            rsp = await client(Tdispatch(0, [], "", [], mk_call("ping", 3)))
+            name, seqid, mtype = parse_message_header(rsp)
+            assert (name, seqid, mtype) == ("ping", 3, REPLY)
+            await client.close()
+            await linker.close()
+            await be.close()
+        run(go())

@@ -1,0 +1,194 @@
+"""etcd / consul-KV dtab stores against scripted fake backends.
+
+Ref test models: etcd integration fixtures (EtcdDtabStoreIntegrationTest)
+and ConsulDtabStore tests — here with in-process fake APIs implementing
+just the CAS + list semantics the stores rely on.
+"""
+
+import asyncio
+import base64
+import json
+from urllib.parse import parse_qsl, unquote, urlsplit
+
+import pytest
+
+from linkerd_tpu.core import Dtab
+from linkerd_tpu.namerd.store import (
+    DtabNamespaceAlreadyExists, DtabVersionMismatch,
+)
+from linkerd_tpu.namerd.stores import ConsulDtabStore, EtcdDtabStore
+from linkerd_tpu.protocol.http.message import Request, Response
+from linkerd_tpu.protocol.http.server import HttpServer
+from linkerd_tpu.router.service import FnService
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+class FakeEtcd:
+    """Just enough of the v2 keys API: PUT w/ prevExist/prevIndex CAS,
+    DELETE, recursive GET."""
+
+    def __init__(self):
+        self.nodes = {}  # key -> (value, modifiedIndex)
+        self.index = 100
+
+    def service(self):
+        async def handler(req: Request) -> Response:
+            parts = urlsplit(req.uri)
+            assert parts.path.startswith("/v2/keys")
+            key = unquote(parts.path[len("/v2/keys"):]).rstrip("/")
+            q = dict(parse_qsl(parts.query))
+            if req.method == "GET":
+                if q.get("recursive") == "true":
+                    nodes = [
+                        {"key": k, "value": v, "modifiedIndex": idx}
+                        for k, (v, idx) in self.nodes.items()
+                        if k.startswith(key + "/")
+                    ]
+                    return Response(status=200, body=json.dumps(
+                        {"node": {"key": key, "dir": True,
+                                  "nodes": nodes}}).encode())
+                if key in self.nodes:
+                    v, idx = self.nodes[key]
+                    return Response(status=200, body=json.dumps(
+                        {"node": {"key": key, "value": v,
+                                  "modifiedIndex": idx}}).encode())
+                return Response(status=404, body=b"{}")
+            if req.method == "PUT":
+                form = dict(parse_qsl(req.body.decode()))
+                if form.get("prevExist") == "false" and key in self.nodes:
+                    return Response(status=412, body=b"{}")
+                if "prevIndex" in form:
+                    if key not in self.nodes:
+                        return Response(status=404, body=b"{}")
+                    if str(self.nodes[key][1]) != form["prevIndex"]:
+                        return Response(status=412, body=b"{}")
+                self.index += 1
+                self.nodes[key] = (form["value"], self.index)
+                return Response(status=200, body=b"{}")
+            if req.method == "DELETE":
+                if key not in self.nodes:
+                    return Response(status=404, body=b"{}")
+                del self.nodes[key]
+                return Response(status=200, body=b"{}")
+            return Response(status=405)
+        return FnService(handler)
+
+
+class FakeConsulKv:
+    def __init__(self):
+        self.kv = {}  # key -> (value bytes, ModifyIndex)
+        self.index = 50
+
+    def service(self):
+        async def handler(req: Request) -> Response:
+            parts = urlsplit(req.uri)
+            assert parts.path.startswith("/v1/kv/")
+            key = unquote(parts.path[len("/v1/kv/"):])
+            q = dict(parse_qsl(parts.query))
+            if req.method == "GET":
+                if q.get("recurse") == "true":
+                    prefix = key
+                    entries = [
+                        {"Key": k,
+                         "Value": base64.b64encode(v).decode(),
+                         "ModifyIndex": idx}
+                        for k, (v, idx) in self.kv.items()
+                        if k.startswith(prefix)
+                    ]
+                    if not entries:
+                        return Response(status=404, body=b"[]")
+                    return Response(status=200,
+                                    body=json.dumps(entries).encode())
+                return Response(status=404)
+            if req.method == "PUT":
+                if "cas" in q:
+                    cas = int(q["cas"])
+                    cur = self.kv.get(key)
+                    if cas == 0 and cur is not None:
+                        return Response(status=200, body=b"false")
+                    if cas != 0 and (cur is None or cur[1] != cas):
+                        return Response(status=200, body=b"false")
+                self.index += 1
+                self.kv[key] = (req.body, self.index)
+                return Response(status=200, body=b"true")
+            if req.method == "DELETE":
+                self.kv.pop(key, None)
+                return Response(status=200, body=b"true")
+            return Response(status=405)
+        return FnService(handler)
+
+
+async def _store_contract(store, fake_refresh=None):
+    """The DtabStore contract (mirrors TestInMemoryStore behavior)."""
+    await store.create("default", Dtab.read("/svc => /#/io.l5d.fs;"))
+    with pytest.raises(DtabNamespaceAlreadyExists):
+        await store.create("default", Dtab.empty())
+    vd = await store.observe("default").to_future()
+    assert "/#/io.l5d.fs" in vd.dtab.show
+
+    with pytest.raises(DtabVersionMismatch):
+        await store.update("default", Dtab.read("/x=>/y;"), b"99999")
+    await store.update("default", Dtab.read("/svc => /#/other;"), vd.version)
+    vd2 = await store.observe("default").to_future()
+    assert "/#/other" in vd2.dtab.show and vd2.version != vd.version
+
+    await store.put("extra", Dtab.read("/a => /b;"))
+    for _ in range(50):
+        if store.list().sample() == frozenset({"default", "extra"}):
+            break
+        await asyncio.sleep(0.05)
+    assert store.list().sample() == frozenset({"default", "extra"})
+
+    await store.delete("extra")
+    assert "extra" not in store.list().sample()
+    store.close()
+
+
+class TestEtcdStore:
+    def test_contract(self):
+        async def go():
+            fake = FakeEtcd()
+            server = await HttpServer(fake.service()).start()
+            store = EtcdDtabStore("127.0.0.1", server.bound_port,
+                                  poll_interval=0.1)
+            await _store_contract(store)
+            await server.close()
+        run(go())
+
+
+class TestConsulKvStore:
+    def test_contract(self):
+        async def go():
+            fake = FakeConsulKv()
+            server = await HttpServer(fake.service()).start()
+            store = ConsulDtabStore("127.0.0.1", server.bound_port,
+                                    poll_interval=0.1)
+            await _store_contract(store)
+            await server.close()
+        run(go())
+
+    def test_external_write_visible_via_poll(self):
+        async def go():
+            fake = FakeConsulKv()
+            server = await HttpServer(fake.service()).start()
+            store = ConsulDtabStore("127.0.0.1", server.bound_port,
+                                    poll_interval=0.05)
+            act = store.observe("ops")
+            # another namerd (or operator) writes directly to consul
+            fake.index += 1
+            fake.kv["namerd/dtabs/ops"] = (b"/svc => /#/io.l5d.fs;",
+                                           fake.index)
+            for _ in range(100):
+                vd = act.current.value if hasattr(act.current, "value") \
+                    else None
+                if vd is not None:
+                    break
+                await asyncio.sleep(0.05)
+            vd = await act.to_future()
+            assert vd is not None and "/#/io.l5d.fs" in vd.dtab.show
+            store.close()
+            await server.close()
+        run(go())
