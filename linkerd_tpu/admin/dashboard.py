@@ -1,0 +1,108 @@
+"""Admin dashboard: a self-contained HTML page at ``/``.
+
+Ref: the reference's D3 dashboard (admin/src/main/resources/io/buoyant/
+admin/js, 46 files) reimagined as one dependency-free page: live
+request/success/latency tiles per router (polling /admin/metrics.json),
+client tables, and the dtab playground backed by /delegator.json.
+"""
+
+from __future__ import annotations
+
+from linkerd_tpu.protocol.http.message import Headers, Request, Response
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>linkerd-tpu admin</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:0;background:#f4f5f7;color:#1c2330}
+ header{background:#0a295c;color:#fff;padding:12px 20px;font-size:18px}
+ header span{opacity:.65;font-size:13px;margin-left:10px}
+ main{padding:20px;max-width:1100px;margin:auto}
+ .tiles{display:flex;gap:12px;flex-wrap:wrap;margin-bottom:18px}
+ .tile{background:#fff;border-radius:8px;padding:12px 18px;min-width:150px;
+       box-shadow:0 1px 3px rgba(0,0,0,.08)}
+ .tile b{display:block;font-size:24px}
+ .tile small{color:#667}
+ table{border-collapse:collapse;width:100%;background:#fff;border-radius:8px;
+       box-shadow:0 1px 3px rgba(0,0,0,.08);margin-bottom:18px}
+ th,td{padding:8px 12px;text-align:left;border-bottom:1px solid #eef}
+ th{background:#fafbfd;font-weight:600;font-size:13px;color:#456}
+ h2{font-size:15px;color:#345;margin:18px 0 8px}
+ input{padding:6px 10px;border:1px solid #ccd;border-radius:6px;width:320px}
+ button{padding:6px 14px;border:0;border-radius:6px;background:#0a295c;
+        color:#fff;cursor:pointer}
+ pre{background:#0e1726;color:#cfe3ff;padding:12px;border-radius:8px;
+     overflow:auto;font-size:12px}
+ .ok{color:#0a7d38}.bad{color:#b3261e}
+</style></head><body>
+<header>linkerd-tpu<span>service-mesh router &mdash; admin</span></header>
+<main>
+ <div class="tiles" id="tiles"></div>
+ <h2>routers</h2><table id="routers"><thead>
+  <tr><th>router</th><th>requests</th><th>success</th><th>failures</th>
+      <th>p50 ms</th><th>p99 ms</th></tr></thead><tbody></tbody></table>
+ <h2>clients</h2><table id="clients"><thead>
+  <tr><th>client</th><th>requests</th><th>failures</th><th>endpoints</th>
+  </tr></thead><tbody></tbody></table>
+ <h2>dtab playground</h2>
+ <p><input id="dpath" placeholder="/svc/web" value="/svc/web">
+    <button onclick="delegate()">delegate</button></p>
+ <pre id="dout">&mdash;</pre>
+</main>
+<script>
+async function refresh(){
+ try{
+  const m = await (await fetch('/admin/metrics.json')).json();
+  const routers = {}, clients = {};
+  let total=0, fails=0;
+  for(const [k,v] of Object.entries(m)){
+   const parts = k.split('/');
+   if(parts[0]!=='rt') continue;
+   const rt = parts[1];
+   if(parts[2]==='server'){
+    routers[rt] = routers[rt]||{};
+    if(parts[3]==='requests'){routers[rt].req=v; total+=v;}
+    if(parts[3]==='success') routers[rt].ok=v;
+    if(parts[3]==='failures'){routers[rt].fail=v; fails+=v;}
+    if(parts[3]==='request_latency_ms'&&parts[4]==='p50')routers[rt].p50=v;
+    if(parts[3]==='request_latency_ms'&&parts[4]==='p99')routers[rt].p99=v;
+   }
+   if(parts[2]==='client'){
+    const c = rt+'/'+parts[3]; clients[c]=clients[c]||{};
+    if(parts[4]==='requests') clients[c].req=v;
+    if(parts[4]==='failures') clients[c].fail=v;
+    if(parts[4]==='endpoints') clients[c].eps=v;
+   }
+  }
+  document.getElementById('tiles').innerHTML =
+   tile(total,'total requests')+tile(fails,'failures',fails?'bad':'ok')+
+   tile(Object.keys(routers).length,'routers')+
+   tile(Object.keys(clients).length,'live clients');
+  document.querySelector('#routers tbody').innerHTML =
+   Object.entries(routers).map(([r,s])=>
+    `<tr><td>${r}</td><td>${s.req||0}</td><td>${s.ok||0}</td>`+
+    `<td>${s.fail||0}</td><td>${fmt(s.p50)}</td><td>${fmt(s.p99)}</td></tr>`
+   ).join('');
+  document.querySelector('#clients tbody').innerHTML =
+   Object.entries(clients).map(([c,s])=>
+    `<tr><td>${c}</td><td>${s.req||0}</td><td>${s.fail||0}</td>`+
+    `<td>${s.eps??''}</td></tr>`).join('');
+ }catch(e){ /* keep last view */ }
+}
+function tile(v,label,cls){return `<div class="tile"><b class="${cls||''}">${v}</b><small>${label}</small></div>`}
+function fmt(v){return v==null?'':(+v).toFixed(1)}
+async function delegate(){
+ const p = document.getElementById('dpath').value;
+ const r = await fetch('/delegator.json?path='+encodeURIComponent(p));
+ document.getElementById('dout').textContent =
+   JSON.stringify(await r.json(), null, 2);
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>
+"""
+
+
+async def dashboard_handler(req: Request) -> Response:
+    return Response(status=200,
+                    headers=Headers([("Content-Type",
+                                      "text/html; charset=utf-8")]),
+                    body=_PAGE.encode())

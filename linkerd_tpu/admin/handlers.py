@@ -99,7 +99,9 @@ async def logging_handler(req: Request) -> Response:
 
 def linkerd_admin_handlers(linker: "Linker") -> List[Tuple[str, Any]]:
     """The standard linkerd admin surface (LinkerdAdmin.apply)."""
+    from linkerd_tpu.admin.dashboard import dashboard_handler
     return [
+        ("/", dashboard_handler),
         ("/delegator.json", mk_delegator_handler(linker)),
         ("/bound-names.json", mk_bound_names_handler(linker)),
         ("/logging.json", logging_handler),

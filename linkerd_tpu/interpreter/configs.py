@@ -46,3 +46,18 @@ class MeshInterpreterConfig:
     def mk(self, namers) -> NameInterpreter:
         host, port = parse_inet_dst(self.dst)
         return MeshClientInterpreter(host, port, root=self.root)
+
+
+@register("interpreter", "io.l5d.namerd.http")
+@dataclass
+class NamerdHttpInterpreterConfig:
+    """Ref: NamerdHttpInterpreterInitializer.scala:94 — namerd's HTTP
+    control API with chunked-watch streams."""
+
+    dst: str = "/$/inet/127.0.0.1/4180"
+    namespace: str = "default"
+
+    def mk(self, namers) -> NameInterpreter:
+        from linkerd_tpu.interpreter.namerd_http import NamerdHttpInterpreter
+        host, port = parse_inet_dst(self.dst)
+        return NamerdHttpInterpreter(host, port, namespace=self.namespace)

@@ -72,6 +72,10 @@ class H2Client(Service[H2Request, H2Response]):
     async def __call__(self, req: H2Request) -> H2Response:
         if self._closed:
             raise ConnectionError(f"h2 client {self.host}:{self.port} closed")
+        if not req.authority:
+            # :authority is mandatory for gRPC peers (grpc-go/grpcio
+            # reject requests without it); default to the endpoint
+            req.authority = f"{self.host}:{self.port}"
         conn = await self._get_conn()
         self.pending += 1
         try:

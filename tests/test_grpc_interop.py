@@ -1,0 +1,132 @@
+"""Cross-interop with the official grpcio implementation.
+
+Ref: grpc/interop — the reference runs the upstream gRPC interop suite
+against its own stack (LocalInteropTest, NetworkedEndToEndTest). Here:
+our server <- grpcio client, and our client -> grpcio server, over real
+sockets, including error-status and server-streaming semantics.
+"""
+
+import asyncio
+from concurrent import futures
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from linkerd_tpu.grpc import (  # noqa: E402
+    ClientDispatcher, Field, GrpcError, ProtoMessage, Rpc,
+    ServerDispatcher, ServiceDef,
+)
+from linkerd_tpu.protocol.h2.client import H2Client  # noqa: E402
+from linkerd_tpu.protocol.h2.server import H2Server  # noqa: E402
+
+
+class Echo(ProtoMessage):
+    FIELDS = {"text": Field(1, "string"), "n": Field(2, "int32")}
+
+
+SVC = ServiceDef("interop.Echo", [
+    Rpc("Say", Echo, Echo),
+    Rpc("Count", Echo, Echo, server_streaming=True),
+])
+
+# grpcio generic handlers use raw bytes with our wire-compatible codec
+def _ser(msg: Echo) -> bytes:
+    return msg.encode()
+
+
+def _deser(raw: bytes) -> Echo:
+    return Echo.decode(raw)
+
+
+class TestGrpcioClientAgainstOurServer:
+    def test_unary_stream_and_error(self):
+        loop = asyncio.new_event_loop()
+        disp = ServerDispatcher()
+
+        async def say(req: Echo) -> Echo:
+            if req.text == "nope":
+                raise GrpcError.of(5, "not here")
+            return Echo(text=f"hi {req.text}")
+
+        async def count(req: Echo):
+            async def gen():
+                for i in range(req.n):
+                    yield Echo(n=i)
+            return gen()
+
+        disp.register_all(SVC, {"Say": say, "Count": count})
+        server = loop.run_until_complete(H2Server(disp).start())
+        port = server.bound_port
+
+        def client_work():
+            channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+            say_rpc = channel.unary_unary(
+                "/interop.Echo/Say", request_serializer=_ser,
+                response_deserializer=_deser)
+            rep = say_rpc(Echo(text="grpcio"), timeout=10)
+            assert rep.text == "hi grpcio"
+
+            with pytest.raises(grpc.RpcError) as ei:
+                say_rpc(Echo(text="nope"), timeout=10)
+            assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+            assert "not here" in ei.value.details()
+
+            count_rpc = channel.unary_stream(
+                "/interop.Echo/Count", request_serializer=_ser,
+                response_deserializer=_deser)
+            got = [m.n for m in count_rpc(Echo(n=4), timeout=10)]
+            assert got == [0, 1, 2, 3]
+            channel.close()
+
+        # grpcio is blocking: run it in a thread while our loop serves
+        task = loop.run_in_executor(None, client_work)
+        loop.run_until_complete(asyncio.wait_for(task, 30))
+        loop.run_until_complete(server.close())
+        loop.close()
+
+
+class TestOurClientAgainstGrpcioServer:
+    def test_unary_stream_and_error(self):
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, details):
+                if details.method == "/interop.Echo/Say":
+                    def say(req, ctx):
+                        if req.text == "nope":
+                            ctx.abort(grpc.StatusCode.NOT_FOUND, "not here")
+                        return Echo(text=f"srv {req.text}")
+                    return grpc.unary_unary_rpc_method_handler(
+                        say, request_deserializer=_deser,
+                        response_serializer=_ser)
+                if details.method == "/interop.Echo/Count":
+                    def count(req, ctx):
+                        for i in range(req.n):
+                            yield Echo(n=i * 10)
+                    return grpc.unary_stream_rpc_method_handler(
+                        count, request_deserializer=_deser,
+                        response_serializer=_ser)
+                return None
+
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        server.add_generic_rpc_handlers([Handler()])
+        port = server.add_insecure_port("127.0.0.1:0")
+        server.start()
+
+        async def go():
+            client = ClientDispatcher(H2Client("127.0.0.1", port))
+            rep = await client.unary(SVC, "Say", Echo(text="ours"))
+            assert rep.text == "srv ours"
+
+            with pytest.raises(GrpcError) as ei:
+                await client.unary(SVC, "Say", Echo(text="nope"))
+            assert ei.value.status.code == 5
+            assert "not here" in ei.value.status.message
+
+            reps = await client.server_stream(SVC, "Count", Echo(n=3))
+            got = [m.n async for m in reps]
+            assert got == [0, 10, 20]
+            assert reps.status.ok
+            await client._svc.close()
+
+        asyncio.run(asyncio.wait_for(go(), 30))
+        server.stop(None)

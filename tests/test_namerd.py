@@ -453,3 +453,47 @@ class TestDelegateApiErrors:
             await server.close()
             await namerd.close()
         run(go())
+
+
+class TestNamerdHttpInterpreter:
+    def test_bind_via_http_watch_with_dtab_flip(self, disco):
+        """io.l5d.namerd.http: binds + addrs stream over the control
+        API's chunked watches (StreamingNamerClient.scala behavior)."""
+        from linkerd_tpu.interpreter.namerd_http import NamerdHttpInterpreter
+        from linkerd_tpu.core.nametree import Leaf
+
+        async def go():
+            namerd = _mk_namerd(disco)
+            server = await HttpServer(HttpControlService(namerd)).start()
+            interp = NamerdHttpInterpreter(
+                "127.0.0.1", server.bound_port, namespace="default",
+                backoff_base=0.05, backoff_max=0.2)
+
+            act = interp.bind(Dtab.empty(), Path.read("/svc/web"))
+            tree = await asyncio.wait_for(act.to_future(), 5)
+            assert isinstance(tree, Leaf)
+            bn = tree.value
+            assert bn.id_.show == "/#/io.l5d.fs/web"
+            for _ in range(100):
+                if isinstance(bn.addr.sample(), Bound):
+                    break
+                await asyncio.sleep(0.05)
+            assert sorted(a.port for a in bn.addr.sample().addresses) == \
+                [8080, 8081]
+
+            # dtab flip in namerd propagates through the HTTP watch
+            await namerd.store.put(
+                "default", Dtab.read("/svc => /$/fail;"))
+            from linkerd_tpu.core.nametree import Fail
+            for _ in range(100):
+                st = act.current
+                from linkerd_tpu.core.activity import Ok
+                if isinstance(st, Ok) and isinstance(st.value, Fail):
+                    break
+                await asyncio.sleep(0.05)
+            assert isinstance(act.sample(), Fail)
+
+            await interp.aclose()
+            await server.close()
+            await namerd.close()
+        run(go())
