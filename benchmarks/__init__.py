@@ -1,0 +1,12 @@
+"""Runnable data-plane benchmarks reproducing BASELINE.md configs 1-3.
+
+Each bench is a standalone module (`python -m benchmarks.config1_http`)
+printing a JSON dict of metrics; `benchmarks.run_all` aggregates them and
+`bench.py` (repo root) folds the headline numbers into the driver's single
+JSON line.
+
+Process layout: the system-under-test (a full Linker loaded from YAML) and
+the load generator run in SEPARATE processes so the proxy's event loop is
+measured, not the generator's — mirroring the reference's wrk-vs-linkerd
+split (BASELINE.md config 1).
+"""

@@ -1,0 +1,114 @@
+"""BASELINE.md config 1: single HTTP/1.1 router, io.l5d.fs namer,
+io.l5d.recentRequests telemeter, closed-loop load -> one echo backend.
+
+Measures:
+  - proxy_req_s          closed-loop saturation throughput through the proxy
+  - direct_req_s         same load straight at the downstream (harness ceiling)
+  - added_p99_ms         paced-rate p99(proxy) - p99(direct)
+  - paced_rate_rps       the rate the added-latency run was paced at
+
+Usage: python -m benchmarks.config1_http [--duration 10] [--rate 10000]
+       [--fastpath]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.common import (  # noqa: E402
+    Proc, lat_stats, run_load, run_paced_load,
+)
+
+CONFIG = """
+admin: {{port: 0}}
+telemetry:
+- kind: io.l5d.recentRequests
+  sampleRate: 0.02
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+routers:
+- protocol: http
+  label: bench
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  identifier: {{kind: io.l5d.methodAndHost}}
+  servers:
+  - port: 0
+{extra}
+"""
+
+
+def main() -> dict:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--duration", type=float, default=8.0)
+    ap.add_argument("--rate", type=float, default=10_000.0)
+    ap.add_argument("--connections", type=int, default=8)
+    ap.add_argument("--window", type=int, default=32)
+    ap.add_argument("--fastpath", action="store_true",
+                    help="enable the native C++ data-plane engine")
+    args = ap.parse_args()
+
+    tmp = tempfile.TemporaryDirectory(prefix="l5d-bench-")
+    disco = os.path.join(tmp.name, "disco")
+    os.makedirs(disco)
+
+    echo = Proc(["-m", "benchmarks.serve_echo"])
+    echo_port = echo.wait_ready()["port"]
+    with open(os.path.join(disco, "web"), "w") as f:
+        f.write(f"127.0.0.1 {echo_port}\n")
+
+    extra = "  fastPath: true\n" if args.fastpath else ""
+    cfg_path = os.path.join(tmp.name, "linker.yaml")
+    with open(cfg_path, "w") as f:
+        f.write(CONFIG.format(disco=disco, extra=extra))
+    linker = Proc(["-m", "benchmarks.serve_linker", cfg_path])
+    proxy_port = linker.wait_ready()["ports"][0]
+
+    out: dict = {"config": 1, "fastpath": args.fastpath}
+    try:
+        rps, lats = asyncio.run(run_load(
+            "127.0.0.1", echo_port, min(3.0, args.duration),
+            connections=args.connections, window=args.window))
+        out["direct_req_s"] = round(rps, 1)
+        out["direct_lat"] = lat_stats(lats)
+
+        # warm the binding path, then measure throughput
+        asyncio.run(run_load("127.0.0.1", proxy_port, 1.0,
+                             connections=2, window=4))
+        rps, lats = asyncio.run(run_load(
+            "127.0.0.1", proxy_port, args.duration,
+            connections=args.connections, window=args.window))
+        out["proxy_req_s"] = round(rps, 1)
+        out["proxy_lat"] = lat_stats(lats)
+
+        # paced open-loop for added latency (cap at 80% of capacity so the
+        # number reflects queuing delay of the proxy, not saturation)
+        rate = min(args.rate, 0.8 * rps)
+        ar, dlats, dsat = asyncio.run(run_paced_load(
+            "127.0.0.1", echo_port, min(5.0, args.duration), rate))
+        ar2, plats, psat = asyncio.run(run_paced_load(
+            "127.0.0.1", proxy_port, min(5.0, args.duration), rate))
+        dstats, pstats = lat_stats(dlats), lat_stats(plats)
+        out["paced_rate_rps"] = round(rate, 0)
+        out["paced_direct"] = dstats
+        out["paced_proxy"] = pstats
+        out["paced_saturated"] = bool(dsat or psat)
+        out["added_p99_ms"] = round(pstats["p99_ms"] - dstats["p99_ms"], 3)
+        out["added_p50_ms"] = round(pstats["p50_ms"] - dstats["p50_ms"], 3)
+    finally:
+        linker.stop()
+        echo.stop()
+        tmp.cleanup()
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(main()))

@@ -1,0 +1,27 @@
+"""Child process: HTTP/1.1 echo downstream. Prints {"port": N} when ready,
+serves until SIGTERM. Usage: python -m benchmarks.serve_echo [delay_ms]"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import signal
+import sys
+
+
+async def main() -> None:
+    from benchmarks.common import start_echo
+
+    delay_s = (float(sys.argv[1]) / 1e3) if len(sys.argv) > 1 else 0.0
+    server, port = await start_echo(delay_s=delay_s)
+    print(json.dumps({"port": port}), flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    loop.add_signal_handler(signal.SIGTERM, stop.set)
+    loop.add_signal_handler(signal.SIGINT, stop.set)
+    await stop.wait()
+    server.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,38 @@
+"""Child process: boots a full Linker from a YAML file and serves until
+SIGTERM. Prints {"ports": [...], "admin_port": N} when ready.
+
+Usage: python -m benchmarks.serve_linker <config.yaml>
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import signal
+import sys
+
+
+async def main() -> None:
+    from linkerd_tpu.linker import load_linker
+    from linkerd_tpu import native
+
+    native.ensure_built()
+    with open(sys.argv[1]) as f:
+        cfg = f.read()
+    linker = load_linker(cfg)
+    await linker.start()
+    ports = []
+    for router in linker.routers:
+        ports.extend(router.server_ports)
+    admin_port = getattr(getattr(linker, "admin", None), "bound_port", None)
+    print(json.dumps({"ports": ports, "admin_port": admin_port}), flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    loop.add_signal_handler(signal.SIGTERM, stop.set)
+    loop.add_signal_handler(signal.SIGINT, stop.set)
+    await stop.wait()
+    await linker.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

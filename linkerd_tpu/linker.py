@@ -176,6 +176,10 @@ class RouterSpec:
     # thrift only: method name as the dst path element instead of the
     # static "thrift" dst (ref: router/thrift Identifier.scala:34)
     thriftMethodInDst: bool = False
+    # http only: serve the data plane from the native C++ epoll engine
+    # (native/fastpath.cpp); Python remains the control plane (naming,
+    # route install, stats/feature drain). Requires a built native lib.
+    fastPath: bool = False
 
 
 @dataclass
@@ -295,6 +299,38 @@ class Router:
         for s in self.servers:
             await s.close()
         await self.service.close()
+
+
+class _FastPathRouter(Router):
+    """Router facade over a FastPathController (fastPath: true)."""
+
+    class _ServerHandle:
+        """Port carrier so Linker.start's announce zip sees fastpath
+        listeners exactly like Python HttpServers."""
+
+        def __init__(self, port: int):
+            self.bound_port = port
+
+    def __init__(self, spec: RouterSpec, label: str, controller,
+                 ports: List[int], interpreter=None):
+        self.spec = spec
+        self.label = label
+        self.controller = controller
+        self._ports = ports
+        self.service = None
+        self.binding = None
+        self.servers = [self._ServerHandle(p) for p in ports]
+        self.interpreter = interpreter
+
+    @property
+    def server_ports(self) -> List[int]:
+        return list(self._ports)
+
+    async def start(self) -> None:
+        await self.controller.start()
+
+    async def close(self) -> None:
+        await self.controller.close()
 
 
 class Linker:
@@ -787,7 +823,33 @@ class Linker:
         return Router(rspec, label, server_stack, binding, servers,
                       interpreter=interpreter)
 
+    def _mk_fastpath_router(self, rspec: RouterSpec, label: str) -> Router:
+        """HTTP router served by the native engine (fastPath: true).
+
+        The engine owns the listeners and the request hot loop; naming,
+        stats, and anomaly features flow through FastPathController."""
+        from linkerd_tpu import native
+        from linkerd_tpu.router.fastpath import FastPathController
+
+        if not native.ensure_built():
+            raise ConfigError(
+                f"{label}: fastPath requires the native library "
+                "(no toolchain available to build it)")
+        base_dtab = Dtab.read(rspec.dtab) if rspec.dtab else Dtab.empty()
+        prefix = Path.read(rspec.dstPrefix)
+        interpreter = self._mk_interpreter(rspec, label)
+        engine = native.FastPathEngine()
+        specs = rspec.servers or [ServerSpec()]
+        ports = [engine.listen(s.ip, s.port) for s in specs]
+        ctl = FastPathController(
+            engine, interpreter, base_dtab, prefix, label, self.metrics,
+            telemeters=self.telemeters)
+        return _FastPathRouter(rspec, label, ctl, ports,
+                               interpreter=interpreter)
+
     def _mk_http_router(self, rspec: RouterSpec, label: str) -> Router:
+        if rspec.fastPath:
+            return self._mk_fastpath_router(rspec, label)
         base_dtab = Dtab.read(rspec.dtab) if rspec.dtab else Dtab.empty()
         prefix = Path.read(rspec.dstPrefix)
         identifier = self._mk_identifier(

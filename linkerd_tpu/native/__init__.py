@@ -40,11 +40,17 @@ def _build() -> bool:
 def ensure_built() -> bool:
     """Build + load the native library if possible. Call at process
     startup (linker/namerd assembly) — NEVER from the data path: the
-    compile shells out to g++ and would freeze the event loop."""
+    compile shells out to g++ and would freeze the event loop.
+
+    A stale .so (from an older source revision, missing newer symbols)
+    is rebuilt once: lib() refuses to load it, so we retry the build."""
     global _tried
     if not os.path.exists(_SO_PATH):
         _build()
     _tried = False  # allow lib() to (re)load
+    if lib() is None and os.path.exists(_SO_PATH):
+        _build()
+        _tried = False
     return lib() is not None
 
 
@@ -57,6 +63,7 @@ def lib() -> Optional[ctypes.CDLL]:
         return None  # ensure_built() (startup) does the building
     try:
         cdll = ctypes.CDLL(_SO_PATH)
+        _declare_fastpath(cdll)
         cdll.l5d_huffman_decode.restype = ctypes.c_long
         cdll.l5d_huffman_decode.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t,
@@ -70,7 +77,9 @@ def lib() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p, ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_int32), ctypes.c_size_t]
         _lib = cdll
-    except OSError as e:
+    except (OSError, AttributeError) as e:
+        # AttributeError => stale .so missing newer symbols; treat as
+        # unavailable so ensure_built() can rebuild it
         log.debug("native lib load failed: %s", e)
     return _lib
 
@@ -109,6 +118,127 @@ def huffman_encode(data: bytes) -> Optional[bytes]:
     if n < 0:
         return None
     return out.raw[:n]
+
+
+def _declare_fastpath(cdll: ctypes.CDLL) -> None:
+    cdll.fp_create.restype = ctypes.c_void_p
+    cdll.fp_create.argtypes = []
+    cdll.fp_start.restype = ctypes.c_int
+    cdll.fp_start.argtypes = [ctypes.c_void_p]
+    cdll.fp_listen.restype = ctypes.c_int
+    cdll.fp_listen.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_int]
+    cdll.fp_set_route.restype = ctypes.c_int
+    cdll.fp_set_route.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_char_p]
+    cdll.fp_remove_route.restype = ctypes.c_int
+    cdll.fp_remove_route.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    cdll.fp_drain_misses.restype = ctypes.c_long
+    cdll.fp_drain_misses.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_size_t]
+    cdll.fp_stats_json.restype = ctypes.c_long
+    cdll.fp_stats_json.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_size_t]
+    cdll.fp_drain_features.restype = ctypes.c_long
+    cdll.fp_drain_features.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_float),
+                                       ctypes.c_long]
+    cdll.fp_shutdown.restype = None
+    cdll.fp_shutdown.argtypes = [ctypes.c_void_p]
+
+
+class FastPathEngine:
+    """Handle on the native epoll proxy data plane (native/fastpath.cpp).
+
+    Python is the control plane: it binds listeners before start(), then
+    installs/updates concrete routes (host -> [(ip, port), ...]) as the
+    naming system publishes address changes, and periodically drains route
+    misses, stats, and per-request feature rows.
+    """
+
+    FEATURE_DIM = 6  # route_id, latency_ms, status, req_b, rsp_b, ts_s
+
+    def __init__(self):
+        cdll = lib()
+        if cdll is None:
+            raise RuntimeError("native library unavailable; fastPath "
+                               "requires a working toolchain")
+        self._lib = cdll
+        self._e = cdll.fp_create()
+        self._started = False
+        self._closed = False
+        self._miss_buf = ctypes.create_string_buffer(64 * 1024)
+        self._stats_buf = ctypes.create_string_buffer(1024 * 1024)
+        self._feat_rows = 16384
+        self._feat_buf = (ctypes.c_float
+                          * (self._feat_rows * self.FEATURE_DIM))()
+
+    def listen(self, ip: str, port: int) -> int:
+        """Bind a listener; returns the bound port. Call before start()."""
+        assert not self._started
+        got = self._lib.fp_listen(self._e, ip.encode(), port)
+        if got < 0:
+            raise OSError(f"fastpath listen {ip}:{port} failed")
+        return got
+
+    def start(self) -> None:
+        if not self._started:
+            if self._lib.fp_start(self._e) != 0:
+                raise RuntimeError("fastpath thread start failed")
+            self._started = True
+
+    @staticmethod
+    def _key(host: str) -> bytes:
+        # Header bytes are latin-1; bytes.lower() folds ASCII only —
+        # exactly matching the engine's lower() keying (fastpath.cpp).
+        return host.encode("latin-1", "replace").lower()
+
+    def set_route(self, host: str, endpoints: List[Tuple[str, int]]) -> None:
+        eps = " ".join(f"{ip}:{port}" for ip, port in endpoints) + " "
+        self._lib.fp_set_route(self._e, self._key(host), eps.encode())
+
+    def remove_route(self, host: str) -> None:
+        self._lib.fp_remove_route(self._e, self._key(host))
+
+    def drain_misses(self) -> List[str]:
+        n = self._lib.fp_drain_misses(self._e, self._miss_buf,
+                                      len(self._miss_buf))
+        if n <= 0:
+            return []
+        return self._miss_buf.value.decode("latin-1").split("\n")[:n]
+
+    def stats(self) -> dict:
+        import json
+        for _ in range(6):
+            n = self._lib.fp_stats_json(self._e, self._stats_buf,
+                                        len(self._stats_buf))
+            if n == -2:  # buffer too small: grow (capped at 64MB)
+                if len(self._stats_buf) >= 64 << 20:
+                    log.warning("fastpath stats exceed 64MB; dropping")
+                    return {}
+                self._stats_buf = ctypes.create_string_buffer(
+                    len(self._stats_buf) * 4)
+                continue
+            if n < 0:
+                return {}
+            return json.loads(self._stats_buf.value.decode("latin-1"))
+        return {}
+
+    def drain_features(self):
+        """-> float32 ndarray [n, FEATURE_DIM] of per-request rows."""
+        import numpy as np
+        n = self._lib.fp_drain_features(self._e, self._feat_buf,
+                                        self._feat_rows)
+        if n <= 0:
+            return np.zeros((0, self.FEATURE_DIM), dtype=np.float32)
+        arr = np.ctypeslib.as_array(self._feat_buf)
+        return arr[:n * self.FEATURE_DIM].reshape(
+            n, self.FEATURE_DIM).copy()
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._lib.fp_shutdown(self._e)
 
 
 MAX_HEADERS = 1024

@@ -1,0 +1,236 @@
+"""FastPath controller: Python control plane for the native data plane.
+
+The native engine (native/fastpath.cpp) serves the HTTP/1.1 hot loop; this
+module keeps it honest with the naming system:
+
+- route misses surfaced by the engine are resolved through the SAME
+  interpreter/dtab machinery the Python path uses (identify -> bind, ref:
+  RoutingFactory.scala:154-187), and the resulting address sets are
+  installed with ``fp_set_route``;
+- every bind Activity and leaf ``Var[Addr]`` stays observed, so namer
+  updates (fs file edits, k8s endpoint churn, consul index bumps)
+  re-install routes live — address churn flows WITHOUT re-binding, the
+  same invariant as DstBindingFactory (SURVEY.md §3.3);
+- engine stats feed the MetricsTree under the standard
+  ``rt/<label>/fastpath`` scope, and per-request feature rows feed the
+  ``io.l5d.jaxAnomaly`` telemeter ring so fastpath traffic is scored on
+  TPU exactly like Python-path traffic (BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from linkerd_tpu.core import Dtab, Path
+from linkerd_tpu.core.activity import Failed, Ok
+from linkerd_tpu.core.addr import Bound as AddrBound, BoundName
+from linkerd_tpu.core.nametree import (
+    Alt, Empty, Fail, Leaf, NameTree, Neg, Union as TreeUnion,
+)
+
+log = logging.getLogger(__name__)
+
+
+def _collect_leaves(tree: NameTree) -> List[BoundName]:
+    """Leaves the engine should balance over: all union branches, first
+    viable Alt branch (the engine has no per-request failover, so Alt
+    degrades to its primary branch — misses fall back to later branches
+    only on re-bind)."""
+    if isinstance(tree, Leaf):
+        return [tree.value]
+    if isinstance(tree, TreeUnion):
+        out: List[BoundName] = []
+        for w in tree.weighted:
+            out.extend(_collect_leaves(w.tree))
+        return out
+    if isinstance(tree, Alt):
+        for sub in tree.trees:
+            if isinstance(sub, (Neg, Empty, Fail)):
+                continue
+            got = _collect_leaves(sub)
+            if got:
+                return got
+    return []
+
+
+class _HostRoute:
+    """Live resolution of one host: bind activity + leaf addr watches."""
+
+    def __init__(self, ctl: "FastPathController", host: str):
+        self.ctl = ctl
+        self.host = host
+        self._leaf_handles: list = []
+        self._leaves: List[BoundName] = []
+        path = ctl.prefix + Path.read("/" + host)
+        self.activity = ctl.interpreter.bind(ctl.dtab, path)
+        self._act_handle = self.activity.states.observe(self._on_state)
+
+    def _on_state(self, st) -> None:
+        if isinstance(st, Ok):
+            tree = st.value.simplified
+            leaves = _collect_leaves(tree)
+            self._rewatch(leaves)
+            self._push()
+        elif isinstance(st, Failed):
+            # keep the last installed route (fail-static, like balancers
+            # keeping their last good replica set on namer failure)
+            log.debug("fastpath bind failed for %s: %r", self.host, st.exc)
+
+    def _rewatch(self, leaves: List[BoundName]) -> None:
+        for h in self._leaf_handles:
+            h.close()
+        self._leaf_handles = []
+        self._leaves = leaves
+        for leaf in leaves:
+            self._leaf_handles.append(
+                leaf.addr.observe(lambda _a: self._push(), run_now=False))
+
+    def _push(self) -> None:
+        eps: List[Tuple[str, int]] = []
+        for leaf in self._leaves:
+            addr = leaf.addr.sample()
+            if isinstance(addr, AddrBound):
+                for a in addr.addresses:
+                    eps.append((a.host, a.port))
+        if eps:
+            self.ctl.engine.set_route(self.host, sorted(set(eps)))
+        else:
+            # Neg everywhere / no replicas: drop the route so the engine
+            # answers 400 (parity with UnboundError -> 4xx)
+            self.ctl.engine.remove_route(self.host)
+
+    def close(self) -> None:
+        for h in self._leaf_handles:
+            h.close()
+        self._leaf_handles = []
+        self._act_handle.close()
+        self.activity.close()
+
+
+class FastPathController:
+    """Owns a FastPathEngine for one router: listeners, miss resolution,
+    stats export, and anomaly-feature forwarding."""
+
+    def __init__(self, engine, interpreter, base_dtab: Dtab, prefix: Path,
+                 label: str, metrics, telemeters=(),
+                 miss_poll_s: float = 0.01, stats_poll_s: float = 1.0,
+                 max_hosts: int = 10_000):
+        self.engine = engine
+        self.interpreter = interpreter
+        self.dtab = base_dtab
+        self.prefix = prefix
+        self.label = label
+        self.metrics = metrics
+        self.telemeters = list(telemeters)
+        self.miss_poll_s = miss_poll_s
+        self.stats_poll_s = stats_poll_s
+        self.max_hosts = max_hosts
+        self._routes: Dict[str, _HostRoute] = {}
+        self._tasks: List[asyncio.Task] = []
+        self._last_stats: Dict[str, Dict[str, int]] = {}
+        self._id_to_host: Dict[int, str] = {}
+        self._scope = metrics.scope("rt", label, "fastpath")
+
+    async def start(self) -> None:
+        self.engine.start()
+        self._tasks = [
+            asyncio.create_task(self._miss_loop(), name=f"fp-miss-{self.label}"),
+            asyncio.create_task(self._stats_loop(), name=f"fp-stats-{self.label}"),
+        ]
+
+    def resolve(self, host: str) -> None:
+        """Begin (or refresh) resolution for a host."""
+        host = host.lower()
+        if host in self._routes:
+            return
+        if len(self._routes) >= self.max_hosts:
+            log.warning("fastpath host watch limit reached; ignoring %s", host)
+            return
+        try:
+            self._routes[host] = _HostRoute(self, host)
+        except Exception:  # noqa: BLE001 — a bad host must not kill the loop
+            log.exception("fastpath resolution setup failed for %r", host)
+
+    async def _miss_loop(self) -> None:
+        while True:
+            try:
+                misses = self.engine.drain_misses()
+                for host in misses:
+                    if host:
+                        self.resolve(host)
+                await asyncio.sleep(self.miss_poll_s)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001
+                log.exception("fastpath miss loop error")
+                await asyncio.sleep(0.5)
+
+    async def _stats_loop(self) -> None:
+        while True:
+            try:
+                await asyncio.sleep(self.stats_poll_s)
+                self._export_stats()
+                self._forward_features()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001
+                log.exception("fastpath stats loop error")
+
+    def _export_stats(self) -> None:
+        snap = self.engine.stats()
+        for host, s in snap.get("routes", {}).items():
+            if "id" in s:
+                self._id_to_host[int(s["id"])] = host
+            prev = self._last_stats.get(host, {})
+            scope = self._scope.scope("route", host)
+            for key in ("requests", "success", "f4xx", "f5xx", "conn_fail"):
+                delta = int(s.get(key, 0)) - int(prev.get(key, 0))
+                if delta > 0:
+                    scope.counter(key).incr(delta)
+            self._last_stats[host] = {
+                k: int(s.get(k, 0))
+                for k in ("requests", "success", "f4xx", "f5xx", "conn_fail")}
+
+    def _forward_features(self) -> None:
+        rings = []
+        for t in self.telemeters:
+            ring = getattr(t, "ring", None)
+            if ring is not None and hasattr(t, "board"):
+                rings.append(ring)
+        rows = self.engine.drain_features()
+        if not len(rows) or not rings:
+            return
+        from linkerd_tpu.telemetry.anomaly import FeatureVector
+        for row in rows:
+            host = self._id_to_host.get(int(row[0]), f"fp-{int(row[0])}")
+            fv = FeatureVector(
+                latency_ms=float(row[1]),
+                status=int(row[2]),
+                retries=0,
+                request_bytes=int(row[3]),
+                response_bytes=int(row[4]),
+                concurrency=1,
+                queue_ms=0.0,
+                exception=False,
+                retryable=False,
+                dst_path=f"{self.prefix.show}/{host}",
+                dst_rps=0.0,
+            )
+            for ring in rings:
+                ring.append((fv, None))
+
+    async def close(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        self._tasks = []
+        for r in self._routes.values():
+            r.close()
+        self._routes.clear()
+        self.engine.close()
