@@ -105,8 +105,8 @@ namers:
 
                 # Phase A: normal traffic; train the autoencoder on it.
                 await send(120)
-                for _ in range(6):  # several train steps on normal batches
-                    ring_copy = list(tele.ring)
+                ring_copy = list(tele.ring)  # snapshot once: each epoch
+                for _ in range(6):           # re-trains on the same batch
                     await tele.drain_once()
                     for item in ring_copy:  # refill so training sees more
                         tele.ring.append(item)
