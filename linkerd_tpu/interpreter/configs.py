@@ -61,3 +61,21 @@ class NamerdHttpInterpreterConfig:
         from linkerd_tpu.interpreter.namerd_http import NamerdHttpInterpreter
         host, port = parse_inet_dst(self.dst)
         return NamerdHttpInterpreter(host, port, namespace=self.namespace)
+
+
+@register("interpreter", "io.l5d.namerd")
+@dataclass
+class NamerdThriftInterpreterConfig:
+    """The thrift long-poll interpreter — the reference's default remote
+    interpreter (ref: NamerdInterpreterInitializer.scala:133, client
+    ThriftNamerClient.scala:1-347)."""
+
+    dst: str = "/$/inet/127.0.0.1/4100"
+    namespace: str = "default"
+
+    def mk(self, namers) -> NameInterpreter:
+        from linkerd_tpu.interpreter.namerd_thrift import (
+            ThriftNamerInterpreter,
+        )
+        host, port = parse_inet_dst(self.dst)
+        return ThriftNamerInterpreter(host, port, namespace=self.namespace)

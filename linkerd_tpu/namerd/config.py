@@ -74,6 +74,25 @@ class MeshIfaceConfig:
         return H2Server(iface.dispatcher, host=self.ip, port=self.port)
 
 
+@register("namerdIface", "io.l5d.thriftNameInterpreter")
+@dataclass
+class ThriftIfaceConfig:
+    """The stamped long-poll thrift interface (the reference's default
+    linkerd<->namerd protocol; ref ThriftNamerInterface.scala:1-573)."""
+
+    port: int = 4100
+    ip: str = "127.0.0.1"
+    bindingCacheActive: int = 1000
+    addrCacheActive: int = 1000
+
+    def mk(self, namerd: Namerd):
+        from linkerd_tpu.namerd.thrift_iface import ThriftNamerIface
+        return ThriftNamerIface(
+            namerd, host=self.ip, port=self.port,
+            binding_cache=self.bindingCacheActive,
+            addr_cache=self.addrCacheActive)
+
+
 @register("namerdIface", "io.l5d.httpController")
 @dataclass
 class HttpControllerConfig:

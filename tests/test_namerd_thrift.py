@@ -1,0 +1,262 @@
+"""namerd thrift long-poll interface + io.l5d.namerd interpreter.
+
+Covers the third (and reference-default) control-plane protocol: the
+TBinaryProtocol struct DSL, stamped long-poll semantics on the server
+(ThriftNamerInterface.scala parity), and the client interpreter's
+bind/addr watch loops with live updates on dtab flips and address churn
+(ThriftNamerClient.scala parity).
+"""
+
+import asyncio
+
+import pytest
+
+from linkerd_tpu.core import Dtab, Path, Var
+from linkerd_tpu.core.activity import Ok
+from linkerd_tpu.core.addr import Address, Bound
+from linkerd_tpu.core.nametree import Leaf
+from linkerd_tpu.interpreter.namerd_thrift import ThriftNamerInterpreter
+from linkerd_tpu.namer.fs import FsNamer
+from linkerd_tpu.namerd import InMemoryDtabStore, Namerd
+from linkerd_tpu.namerd import thrift_idl as idl
+from linkerd_tpu.namerd.thrift_iface import ThriftNamerIface
+from linkerd_tpu.protocol.thrift.binary import (
+    decode_struct, encode_struct,
+)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+class TestBinaryProtocol:
+    def test_struct_roundtrip(self):
+        ref = idl.NameRef(stamp=b"\x00\x01", name=[b"svc", b"web"],
+                          ns="default")
+        req = idl.BindReq(dtab="/a => /b;", name=ref, clientId=[b"l5d"])
+        out = decode_struct(idl.BindReq, encode_struct(req))
+        assert out.dtab == "/a => /b;"
+        assert out.name.ns == "default"
+        assert out.name.name == [b"svc", b"web"]
+        assert out.name.stamp == b"\x00\x01"
+
+    def test_union_and_map_roundtrip(self):
+        tree = idl.BoundTree(
+            root=idl.BoundNode(weighted=[
+                idl.WeightedNodeId(weight=0.5, id=0),
+                idl.WeightedNodeId(weight=0.5, id=1),
+            ]),
+            nodes={
+                0: idl.BoundNode(leaf=idl.TBoundName(
+                    id=[b"#", b"io.l5d.fs", b"a"], residual=[])),
+                1: idl.BoundNode(neg=idl.TVoid()),
+            })
+        out = decode_struct(idl.BoundTree, encode_struct(tree))
+        assert out.root.union_field() == "weighted"
+        assert len(out.root.weighted) == 2
+        assert out.nodes[0].union_field() == "leaf"
+        assert out.nodes[0].leaf.id == [b"#", b"io.l5d.fs", b"a"]
+        assert out.nodes[1].union_field() == "neg"
+
+    def test_unknown_fields_skipped(self):
+        # decoding BindReq bytes as NameRef-only reader must not crash:
+        # unknown/mistyped fields are skipped for forward compat
+        req = idl.BindReq(dtab="/a => /b;",
+                          name=idl.NameRef(ns="x"), clientId=[b"c"])
+        out = decode_struct(idl.DtabReq, encode_struct(req))
+        assert out is not None
+
+
+def mk_world(tmp_path, dtab="/svc => /#/io.l5d.fs ;"):
+    disco = tmp_path / "disco"
+    disco.mkdir(exist_ok=True)
+    namer = FsNamer(str(disco))
+    store = InMemoryDtabStore()
+    # namer prefixes register WITHOUT /#/ — the configured-namer prefix
+    # is applied during dtab lookup (namer/core.py CONFIGURED_PREFIX)
+    namerd = Namerd(store, [(Path.read("/io.l5d.fs"), namer)])
+    return disco, namer, store, namerd, dtab
+
+
+class TestThriftIfaceEndToEnd:
+    def test_bind_addr_and_live_updates(self, tmp_path):
+        disco, namer, store, namerd, dtab = mk_world(tmp_path)
+
+        async def go():
+            (disco / "web").write_text("127.0.0.1 8080\n")
+            namer.refresh()
+            await store.create("default", Dtab.read(dtab))
+            iface = await ThriftNamerIface(namerd).start()
+            interp = ThriftNamerInterpreter(
+                "127.0.0.1", iface.bound_port, namespace="default")
+            try:
+                act = interp.bind(Dtab.empty(), Path.read("/svc/web"))
+                for _ in range(100):
+                    st = act.current
+                    if isinstance(st, Ok):
+                        break
+                    await asyncio.sleep(0.05)
+                tree = act.sample().simplified
+                assert isinstance(tree, Leaf)
+                assert "io.l5d.fs" in tree.value.id_.show
+
+                # addresses stream through the addr op
+                leaf = tree.value
+                for _ in range(100):
+                    addr = leaf.addr.sample()
+                    if isinstance(addr, Bound) and addr.addresses:
+                        break
+                    await asyncio.sleep(0.05)
+                addr = leaf.addr.sample()
+                assert Address("127.0.0.1", 8080) in addr.addresses
+
+                # live addr churn: fs file edit -> addr long-poll pushes
+                (disco / "web").write_text("127.0.0.1 9090\n")
+                namer.refresh()
+                for _ in range(100):
+                    addr = leaf.addr.sample()
+                    if (isinstance(addr, Bound) and
+                            Address("127.0.0.1", 9090) in addr.addresses):
+                        break
+                    await asyncio.sleep(0.05)
+                assert Address("127.0.0.1", 9090) in leaf.addr.sample().addresses
+
+                # live dtab flip: store update -> bind long-poll re-binds
+                (disco / "web2").write_text("127.0.0.1 7070\n")
+                vd = await store.observe("default").to_future()
+                await store.update(
+                    "default", Dtab.read("/svc/web => /#/io.l5d.fs/web2;"),
+                    vd.version)
+                for _ in range(100):
+                    st = act.current
+                    if (isinstance(st, Ok) and
+                            isinstance(st.value.simplified, Leaf) and
+                            st.value.simplified.value.id_.show.endswith(
+                                "web2")):
+                        break
+                    await asyncio.sleep(0.05)
+                tree2 = act.sample().simplified
+                assert tree2.value.id_.show.endswith("web2")
+            finally:
+                interp.close()
+                await iface.close()
+                await namerd.close()
+
+        run(go())
+
+    def test_unbound_host_is_neg(self, tmp_path):
+        disco, namer, store, namerd, dtab = mk_world(tmp_path)
+
+        async def go():
+            await store.create("default", Dtab.read(dtab))
+            iface = await ThriftNamerIface(namerd).start()
+            interp = ThriftNamerInterpreter(
+                "127.0.0.1", iface.bound_port, namespace="default")
+            try:
+                act = interp.bind(Dtab.empty(), Path.read("/svc/ghost"))
+                from linkerd_tpu.core.nametree import Neg
+                for _ in range(100):
+                    st = act.current
+                    if isinstance(st, Ok):
+                        break
+                    await asyncio.sleep(0.05)
+                assert isinstance(act.sample().simplified, Neg)
+            finally:
+                interp.close()
+                await iface.close()
+                await namerd.close()
+
+        run(go())
+
+    def test_dtab_op_long_poll(self, tmp_path):
+        disco, namer, store, namerd, dtab = mk_world(tmp_path)
+
+        async def go():
+            await store.create("default", Dtab.read(dtab))
+            iface = await ThriftNamerIface(namerd).start()
+            from linkerd_tpu.interpreter.namerd_thrift import _encode_call, _decode_reply
+            from linkerd_tpu.protocol.thrift.client import ThriftClient
+            from linkerd_tpu.protocol.thrift.codec import CALL, ThriftCall
+            client = ThriftClient("127.0.0.1", iface.bound_port)
+            try:
+                payload = _encode_call("dtab", 1, idl.DtabReq(
+                    stamp=b"", ns="default", clientId=[b"t"]))
+                reply = await client(ThriftCall(
+                    payload=payload, name="dtab", seqid=1, type=CALL))
+                ref = _decode_reply(reply, idl.DtabRef, idl.DtabFailure)
+                assert "/svc" in ref.dtab
+                stamp1 = ref.stamp
+
+                # same stamp parks until the store changes
+                async def poll_again():
+                    p2 = _encode_call("dtab", 2, idl.DtabReq(
+                        stamp=stamp1, ns="default", clientId=[b"t"]))
+                    r2 = await client(ThriftCall(
+                        payload=p2, name="dtab", seqid=2, type=CALL))
+                    return _decode_reply(r2, idl.DtabRef, idl.DtabFailure)
+
+                task = asyncio.create_task(poll_again())
+                await asyncio.sleep(0.2)
+                assert not task.done()  # parked
+                vd = await store.observe("default").to_future()
+                await store.update(
+                    "default", Dtab.read("/svc => /#/changed;"), vd.version)
+                ref2 = await asyncio.wait_for(task, 5)
+                assert "/#/changed" in ref2.dtab
+                assert ref2.stamp != stamp1
+            finally:
+                await client.close()
+                await iface.close()
+                await namerd.close()
+
+        run(go())
+
+    def test_delegate_op(self, tmp_path):
+        disco, namer, store, namerd, dtab = mk_world(tmp_path)
+
+        async def go():
+            (disco / "web").write_text("127.0.0.1 8080\n")
+            await store.create("default", Dtab.read(dtab))
+            iface = await ThriftNamerIface(namerd).start()
+            from linkerd_tpu.interpreter.namerd_thrift import _encode_call, _decode_reply
+            from linkerd_tpu.protocol.thrift.client import ThriftClient
+            from linkerd_tpu.protocol.thrift.codec import CALL, ThriftCall
+            client = ThriftClient("127.0.0.1", iface.bound_port)
+            try:
+                req = idl.DelegateReq(
+                    dtab="",
+                    delegation=idl.Delegation(
+                        ns="default",
+                        tree=idl.TDelegateTree(root=idl.DelegateNode(
+                            path=[b"svc", b"web"], dentry=""))),
+                    clientId=[b"t"])
+                payload = _encode_call("delegate", 1, req)
+                reply = await client(ThriftCall(
+                    payload=payload, name="delegate", seqid=1, type=CALL))
+                d = _decode_reply(reply, idl.Delegation, idl.DelegationFailure)
+                # root delegates through the dtab down to a bound leaf
+                assert d.tree is not None
+                found_leaf = []
+
+                def walk(node):
+                    kind = node.contents.union_field()
+                    if kind == "boundLeaf":
+                        found_leaf.append(node.contents.boundLeaf)
+                    elif kind == "delegate":
+                        walk(d.tree.nodes[node.contents.delegate])
+                    elif kind == "alt":
+                        for i in node.contents.alt:
+                            walk(d.tree.nodes[i])
+                    elif kind == "weighted":
+                        for w in node.contents.weighted:
+                            walk(d.tree.nodes[w.id])
+
+                walk(d.tree.root)
+                assert found_leaf, "no bound leaf in delegation"
+                assert b"io.l5d.fs" in found_leaf[0].id
+            finally:
+                await client.close()
+                await iface.close()
+                await namerd.close()
+
+        run(go())

@@ -6,7 +6,13 @@ spawn the REAL linkerd and namerd executables as subprocesses, stand up
 downstream HTTP servers, drive dtab flips through namerd's HTTP control
 API, and assert traffic re-routes within bounded staleness.
 
-Usage: python tools/validator.py   (exit 0 = pass)
+Runs the full flip sequence once per control-plane protocol: the gRPC
+mesh iface (io.l5d.mesh), the thrift long-poll iface (io.l5d.namerd over
+io.l5d.thriftNameInterpreter), and the chunked-HTTP interpreter
+(io.l5d.namerd.http) — all three of the reference's linkerd<->namerd
+protocols.
+
+Usage: python tools/validator.py [mesh|thrift|http ...]  (exit 0 = pass)
 """
 
 from __future__ import annotations
@@ -24,10 +30,35 @@ import urllib.request
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-NAMERD_HTTP = 24180
-NAMERD_MESH = 24321
-LINKERD_PORT = 24140
 STALENESS_S = 5.0
+
+# per-protocol port blocks so back-to-back runs never collide
+PORTS = {
+    "mesh":   {"http": 24180, "iface": 24321, "linkerd": 24140,
+               "admin": 24990, "a": 24801, "b": 24802},
+    "thrift": {"http": 25180, "iface": 25100, "linkerd": 25140,
+               "admin": 25990, "a": 25801, "b": 25802},
+    "http":   {"http": 26180, "iface": 26180, "linkerd": 26140,
+               "admin": 26990, "a": 26801, "b": 26802},
+}
+
+IFACE_YAML = {
+    "mesh": "- kind: io.l5d.mesh\n  port: {iface}\n",
+    "thrift": "- kind: io.l5d.thriftNameInterpreter\n  port: {iface}\n",
+    "http": "",  # the control API itself is the interpreter's protocol
+}
+
+INTERP_YAML = {
+    "mesh": ("    kind: io.l5d.mesh\n"
+             "    dst: /$/inet/127.0.0.1/{iface}\n"
+             "    root: /default\n"),
+    "thrift": ("    kind: io.l5d.namerd\n"
+               "    dst: /$/inet/127.0.0.1/{iface}\n"
+               "    namespace: default\n"),
+    "http": ("    kind: io.l5d.namerd.http\n"
+             "    dst: /$/inet/127.0.0.1/{iface}\n"
+             "    namespace: default\n"),
+}
 
 
 def http(method: str, url: str, body: bytes = b"", headers=None) -> tuple:
@@ -73,18 +104,21 @@ async def wait_for(predicate, timeout: float, what: str):
     raise AssertionError(f"timed out waiting for {what}")
 
 
-async def main() -> int:
-    work = tempfile.mkdtemp(prefix="l5d-validate-")
+async def validate(protocol: str) -> None:
+    ports = PORTS[protocol]
+    NAMERD_HTTP = ports["http"]
+    LINKERD_PORT = ports["linkerd"]
+    work = tempfile.mkdtemp(prefix=f"l5d-validate-{protocol}-")
     disco = os.path.join(work, "disco")
     dtabs = os.path.join(work, "dtabs")
     os.makedirs(disco)
 
-    d_a = await downstream("A", 24801)
-    d_b = await downstream("B", 24802)
+    d_a = await downstream("A", ports["a"])
+    d_b = await downstream("B", ports["b"])
     with open(os.path.join(disco, "svc-a"), "w") as f:
-        f.write("127.0.0.1 24801\n")
+        f.write(f"127.0.0.1 {ports['a']}\n")
     with open(os.path.join(disco, "svc-b"), "w") as f:
-        f.write("127.0.0.1 24802\n")
+        f.write(f"127.0.0.1 {ports['b']}\n")
 
     namerd_yaml = os.path.join(work, "namerd.yaml")
     with open(namerd_yaml, "w") as f:
@@ -96,9 +130,7 @@ namers:
 - kind: io.l5d.fs
   rootDir: {disco}
 interfaces:
-- kind: io.l5d.mesh
-  port: {NAMERD_MESH}
-- kind: io.l5d.httpController
+{IFACE_YAML[protocol].format(**ports)}- kind: io.l5d.httpController
   port: {NAMERD_HTTP}
 """)
     linkerd_yaml = os.path.join(work, "linkerd.yaml")
@@ -108,13 +140,10 @@ routers:
 - protocol: http
   label: validated
   interpreter:
-    kind: io.l5d.mesh
-    dst: /$/inet/127.0.0.1/{NAMERD_MESH}
-    root: /default
-  servers:
+{INTERP_YAML[protocol].format(**ports)}  servers:
   - port: {LINKERD_PORT}
 admin:
-  port: 24990
+  port: {ports['admin']}
 """)
 
     env = dict(os.environ, PYTHONPATH=REPO)
@@ -141,7 +170,7 @@ admin:
         await wait_for(lambda: http(
             "GET", f"http://127.0.0.1:{LINKERD_PORT}/",
             headers={"Host": "web"})[2] == b"A", 15, "route to A")
-        print("validator: initial route -> A ok")
+        print(f"validator[{protocol}]: initial route -> A ok")
 
         # flip the dtab (CAS) -> expect B within bounded staleness
         st, hdrs, _ = await asyncio.to_thread(http,
@@ -156,14 +185,15 @@ admin:
             "GET", f"http://127.0.0.1:{LINKERD_PORT}/",
             headers={"Host": "web"})[2] == b"B",
             STALENESS_S, "re-route to B")
-        print(f"validator: dtab flip re-routed in {time.time() - t0:.2f}s")
+        print(f"validator[{protocol}]: dtab flip re-routed "
+              f"in {time.time() - t0:.2f}s")
 
         # stale CAS must fail
         st, _, _ = await asyncio.to_thread(http,
             "PUT", f"http://127.0.0.1:{NAMERD_HTTP}/api/1/dtabs/default",
             b"/svc => /#/io.l5d.fs/svc-a;", headers={"If-Match": etag})
         assert st == 412, f"stale CAS should 412, got {st}"
-        print("validator: stale CAS rejected (412)")
+        print(f"validator[{protocol}]: stale CAS rejected (412)")
 
         # delegate API agrees with live routing
         st, _, body = await asyncio.to_thread(http,
@@ -171,9 +201,7 @@ admin:
                    f"/api/1/delegate/default?path=/svc/web")
         tree = json.loads(body)
         assert "svc-b" in json.dumps(tree), tree
-        print("validator: delegation explanation matches")
-        print("VALIDATOR PASS")
-        return 0
+        print(f"validator[{protocol}]: delegation explanation matches")
     finally:
         for p in procs:
             p.send_signal(signal.SIGTERM)
@@ -184,6 +212,14 @@ admin:
                 p.kill()
         d_a.close()
         d_b.close()
+
+
+async def main() -> int:
+    protocols = sys.argv[1:] or ["mesh", "thrift", "http"]
+    for protocol in protocols:
+        await validate(protocol)
+    print(f"VALIDATOR PASS ({', '.join(protocols)})")
+    return 0
 
 
 if __name__ == "__main__":
