@@ -38,14 +38,19 @@ class K8sApi:
     def __init__(self, host: str, port: int = 443,
                  token: Optional[str] = None,
                  ca_cert_path: Optional[str] = None,
-                 use_tls: bool = True):
+                 use_tls: bool = True,
+                 insecure_skip_verify: bool = False):
         self.host = host
         self.port = port
         self.token = token
         self._ssl: Optional[ssl.SSLContext] = None
         if use_tls:
+            # Verify against the given CA, else the system trust store.
+            # Verification is only ever disabled by the EXPLICIT
+            # insecure_skip_verify opt-in — never silently (a MITM on the
+            # API server could otherwise inject endpoint addresses).
             self._ssl = ssl.create_default_context(cafile=ca_cert_path)
-            if ca_cert_path is None:
+            if insecure_skip_verify:
                 self._ssl.check_hostname = False
                 self._ssl.verify_mode = ssl.CERT_NONE
 

@@ -201,12 +201,16 @@ class ServiceNamer(EndpointsNamer):
 
 # ---- config kinds ----------------------------------------------------------
 
-def _mk_api(host: str, port: int, useTls: bool) -> K8sApi:
+def _mk_api(host: str, port: int, useTls: bool,
+            caCertPath=None, insecureSkipVerify: bool = False) -> K8sApi:
     """``host: ""`` selects in-cluster service-account auth; the default
     ``localhost:8001`` targets a kubectl proxy (the reference's default,
-    ClientConfig.scala)."""
+    ClientConfig.scala). TLS verifies against caCertPath or the system
+    trust store; only insecureSkipVerify: true disables verification."""
     if host:
-        return K8sApi(host, port, use_tls=useTls)
+        return K8sApi(host, port, use_tls=useTls,
+                      ca_cert_path=caCertPath,
+                      insecure_skip_verify=insecureSkipVerify)
     return K8sApi.from_service_account()
 
 
@@ -216,10 +220,14 @@ class K8sNamerConfig:
     host: str = "localhost"   # "" -> in-cluster service account
     port: int = 8001          # ref default: localhost:8001 kubectl proxy
     useTls: bool = False
+    caCertPath: Optional[str] = None
+    insecureSkipVerify: bool = False
     prefix: str = "/io.l5d.k8s"
 
     def mk(self) -> Namer:
-        return EndpointsNamer(_mk_api(self.host, self.port, self.useTls))
+        return EndpointsNamer(_mk_api(
+            self.host, self.port, self.useTls,
+            self.caCertPath, self.insecureSkipVerify))
 
 
 @register("namer", "io.l5d.k8s.ns")
@@ -229,11 +237,14 @@ class K8sNamespacedConfig:
     host: str = "localhost"   # "" -> in-cluster service account
     port: int = 8001
     useTls: bool = False
+    caCertPath: Optional[str] = None
+    insecureSkipVerify: bool = False
     prefix: str = "/io.l5d.k8s.ns"
 
     def mk(self) -> Namer:
         return EndpointsNamer(
-            _mk_api(self.host, self.port, self.useTls),
+            _mk_api(self.host, self.port, self.useTls,
+                    self.caCertPath, self.insecureSkipVerify),
             id_prefix="io.l5d.k8s.ns", fixed_namespace=self.namespace)
 
 
@@ -243,7 +254,11 @@ class K8sExternalConfig:
     host: str = "localhost"   # "" -> in-cluster service account
     port: int = 8001
     useTls: bool = False
+    caCertPath: Optional[str] = None
+    insecureSkipVerify: bool = False
     prefix: str = "/io.l5d.k8s.external"
 
     def mk(self) -> Namer:
-        return ServiceNamer(_mk_api(self.host, self.port, self.useTls))
+        return ServiceNamer(_mk_api(
+            self.host, self.port, self.useTls,
+            self.caCertPath, self.insecureSkipVerify))

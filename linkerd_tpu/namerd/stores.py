@@ -55,19 +55,30 @@ async def _http_call(host: str, port: int, method: str, path: str,
         writer.close()
 
 
-class _PolledRemoteStore(DtabStore):
-    """Common machinery: a poll/watch loop maintains the full ns->dtab
-    map; writes go straight to the backend (CAS there), and the loop
-    publishes convergent state."""
+class _WatchedRemoteStore(DtabStore):
+    """Common machinery: a backend watch loop (consul blocking index /
+    etcd waitIndex — NOT polling) maintains the full ns->dtab map; writes
+    go straight to the backend (CAS there), and the loop publishes
+    convergent state. ``poll_interval`` survives only as the backoff base
+    after watch errors.
+
+    Observations seed as Pending until the first successful fetch, so a
+    namespace is never transiently reported missing at startup."""
 
     def __init__(self, poll_interval: float = 1.0):
         self._acts: Dict[str, Activity] = {}
         self._list: Var[FrozenSet[str]] = Var(frozenset())
         self._known: Dict[str, VersionedDtab] = {}
-        self._poll_interval = poll_interval
+        self._primed = False  # first successful fetch published
+        self._backoff_base = poll_interval
         self._task: Optional[asyncio.Task] = None
 
-    # subclass: fetch all namespaces -> Dict[str, VersionedDtab]
+    # subclass: run ONE watch cycle: fetch-or-block, then publish via the
+    # provided callback; raising triggers backoff + retry.
+    async def _watch_once(self) -> None:
+        raise NotImplementedError
+
+    # subclass: one full fetch (used by writes for read-your-write)
     async def _fetch_all(self) -> Dict[str, VersionedDtab]:
         raise NotImplementedError
 
@@ -79,20 +90,20 @@ class _PolledRemoteStore(DtabStore):
         attempt = 0
         while True:
             try:
-                state = await self._fetch_all()
+                await self._watch_once()
                 attempt = 0
-                self._publish(state)
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # noqa: BLE001 - retry forever
-                log.debug("dtab store poll: %s", e)
+                log.debug("dtab store watch: %s", e)
                 attempt = min(attempt + 1, 8)
-            await asyncio.sleep(
-                self._poll_interval * (2 ** min(attempt, 4))
-                * (0.75 + random.random() / 2))
+                await asyncio.sleep(
+                    self._backoff_base * (2 ** min(attempt, 4))
+                    * (0.75 + random.random() / 2))
 
     def _publish(self, state: Dict[str, VersionedDtab]) -> None:
         self._known = state
+        self._primed = True
         self._list.update(frozenset(state))
         for ns, act in self._acts.items():
             act.update(Ok(state.get(ns)))
@@ -104,7 +115,10 @@ class _PolledRemoteStore(DtabStore):
     def observe(self, ns: str) -> Activity:
         self._ensure_task()
         if ns not in self._acts:
-            self._acts[ns] = Activity.mutable(Ok(self._known.get(ns)))
+            # Pending (not Ok(None) = "missing") until the backend answers
+            self._acts[ns] = (
+                Activity.mutable(Ok(self._known.get(ns)))
+                if self._primed else Activity.mutable())
         return self._acts[ns]
 
     async def _refresh_now(self) -> None:
@@ -119,8 +133,14 @@ class _PolledRemoteStore(DtabStore):
             self._task = None
 
 
-class EtcdDtabStore(_PolledRemoteStore):
-    """etcd v2 keys API under ``/v2/keys/<root>/`` (kind io.l5d.etcd)."""
+class EtcdDtabStore(_WatchedRemoteStore):
+    """etcd v2 keys API under ``/v2/keys/<root>/`` (kind io.l5d.etcd).
+
+    Watch semantics per the reference's Key.watch (etcd/.../Key.scala:281):
+    an initial recursive GET establishes state + X-Etcd-Index, then
+    ``?wait=true&waitIndex=N`` blocks until the next change, which is
+    applied incrementally; an outdated index (400/401, "event index
+    cleared") falls back to a fresh re-list."""
 
     def __init__(self, host: str, port: int, root: str = "/namerd/dtabs",
                  poll_interval: float = 1.0):
@@ -128,17 +148,13 @@ class EtcdDtabStore(_PolledRemoteStore):
         self.host = host
         self.port = port
         self.root = root.rstrip("/")
+        self._watch_index: Optional[int] = None
 
     def _key(self, ns: str) -> str:
         return f"/v2/keys{self.root}/{quote(ns)}"
 
-    async def _fetch_all(self) -> Dict[str, VersionedDtab]:
-        rsp = await http_get(self.host, self.port,
-                             f"/v2/keys{self.root}/?recursive=true",
-                             timeout=10.0)
-        if rsp.status == 404:
-            return {}
-        data = json.loads(rsp.body)
+    @staticmethod
+    def _parse_nodes(data) -> Dict[str, VersionedDtab]:
         out: Dict[str, VersionedDtab] = {}
         for node in (data.get("node") or {}).get("nodes") or []:
             ns = node["key"].rsplit("/", 1)[-1]
@@ -149,6 +165,74 @@ class EtcdDtabStore(_PolledRemoteStore):
             version = str(node.get("modifiedIndex", "")).encode()
             out[ns] = VersionedDtab(dtab, version)
         return out
+
+    async def _list_nodes(self):
+        """One recursive GET -> (state, response); shared by writes'
+        _fetch_all and the watch bootstrap so list semantics can't
+        diverge. (Named to avoid the base class's ``_list`` Var.)"""
+        rsp = await http_get(self.host, self.port,
+                             f"/v2/keys{self.root}/?recursive=true",
+                             timeout=10.0)
+        if rsp.status == 404:
+            return {}, rsp
+        if rsp.status != 200:
+            raise RuntimeError(f"etcd list: {rsp.status}")
+        return self._parse_nodes(json.loads(rsp.body)), rsp
+
+    async def _fetch_all(self) -> Dict[str, VersionedDtab]:
+        state, _ = await self._list_nodes()
+        return state
+
+    async def _watch_once(self) -> None:
+        if self._watch_index is None:
+            # (re-)list and capture the index to watch from
+            state, rsp = await self._list_nodes()
+            max_mod = 0
+            if rsp.status == 200:
+                data = json.loads(rsp.body)
+                for node in (data.get("node") or {}).get("nodes") or []:
+                    max_mod = max(max_mod, int(node.get("modifiedIndex", 0)))
+            etcd_index = rsp.headers.get("X-Etcd-Index")
+            self._watch_index = (int(etcd_index) if etcd_index
+                                 else max_mod) + 1
+            self._publish(state)
+            return
+        try:
+            rsp = await http_get(
+                self.host, self.port,
+                f"/v2/keys{self.root}/?recursive=true&wait=true"
+                f"&waitIndex={self._watch_index}",
+                timeout=70.0)
+        except (asyncio.TimeoutError, EOFError):
+            return  # quiet window / server closed the watch: re-issue
+        if rsp.status in (400, 401):
+            # "The event in requested index is outdated and cleared"
+            self._watch_index = None
+            return
+        if rsp.status != 200:
+            raise RuntimeError(f"etcd watch: {rsp.status}")
+        data = json.loads(rsp.body)
+        action = data.get("action", "set")
+        node = data.get("node") or {}
+        key = node.get("key", "")
+        if node.get("dir") or key.rstrip("/") == self.root:
+            # a directory-level event (e.g. recursive delete of the
+            # root) isn't a single-namespace change: re-list from scratch
+            self._watch_index = None
+            return
+        ns = key.rsplit("/", 1)[-1]
+        mod = int(node.get("modifiedIndex", self._watch_index))
+        state = dict(self._known)
+        if action in ("delete", "expire", "compareAndDelete"):
+            state.pop(ns, None)
+        else:
+            try:
+                state[ns] = VersionedDtab(
+                    Dtab.read(node.get("value") or ""), str(mod).encode())
+            except ValueError:
+                pass  # unparseable dtab value: ignore the key
+        self._watch_index = mod + 1
+        self._publish(state)
 
     async def create(self, ns: str, dtab: Dtab) -> None:
         body = f"value={quote(dtab.show)}&prevExist=false".encode()
@@ -190,17 +274,21 @@ class EtcdDtabStore(_PolledRemoteStore):
         await self._refresh_now()
 
 
-class ConsulDtabStore(_PolledRemoteStore):
+class ConsulDtabStore(_WatchedRemoteStore):
     """Consul KV under ``<root>/<ns>`` (kind io.l5d.consul), CAS via
-    ModifyIndex (ref: ConsulDtabStore.scala)."""
+    ModifyIndex, watch via blocking index on the recursive read
+    (ref: ConsulDtabStore.scala's use of KvApi blocking queries)."""
 
     def __init__(self, host: str, port: int, root: str = "namerd/dtabs",
-                 token: Optional[str] = None, poll_interval: float = 1.0):
+                 token: Optional[str] = None, poll_interval: float = 1.0,
+                 wait: str = "30s"):
         super().__init__(poll_interval)
         self.host = host
         self.port = port
         self.root = root.strip("/")
         self.token = token
+        self.wait = wait
+        self._consul_index: Optional[int] = None
 
     def _kv(self, ns: str, query: str = "") -> str:
         q = f"?{query}" if query else ""
@@ -209,14 +297,10 @@ class ConsulDtabStore(_PolledRemoteStore):
     def _auth(self) -> Dict[str, str]:
         return {"X-Consul-Token": self.token} if self.token else {}
 
-    async def _fetch_all(self) -> Dict[str, VersionedDtab]:
-        rsp = await http_get(self.host, self.port,
-                             f"/v1/kv/{self.root}/?recurse=true",
-                             headers=self._auth(), timeout=10.0)
-        if rsp.status == 404:
-            return {}
+    @staticmethod
+    def _parse_entries(body: bytes) -> Dict[str, VersionedDtab]:
         out: Dict[str, VersionedDtab] = {}
-        for entry in json.loads(rsp.body) or []:
+        for entry in json.loads(body) or []:
             ns = entry["Key"].rsplit("/", 1)[-1]
             if not ns:
                 continue
@@ -228,6 +312,47 @@ class ConsulDtabStore(_PolledRemoteStore):
             out[ns] = VersionedDtab(
                 dtab, str(entry.get("ModifyIndex", "")).encode())
         return out
+
+    async def _fetch_all(self) -> Dict[str, VersionedDtab]:
+        rsp = await http_get(self.host, self.port,
+                             f"/v1/kv/{self.root}/?recurse=true",
+                             headers=self._auth(), timeout=10.0)
+        if rsp.status == 404:
+            return {}
+        return self._parse_entries(rsp.body)
+
+    async def _watch_once(self) -> None:
+        query = f"/v1/kv/{self.root}/?recurse=true"
+        if self._consul_index is not None:
+            query += f"&index={self._consul_index}&wait={self.wait}"
+        try:
+            rsp = await http_get(self.host, self.port, query,
+                                 headers=self._auth(), timeout=70.0)
+        except (asyncio.TimeoutError, EOFError):
+            return  # blocking query elapsed server-side: re-issue
+        if rsp.status == 404:
+            state: Dict[str, VersionedDtab] = {}
+        elif rsp.status == 200:
+            state = self._parse_entries(rsp.body)
+        else:
+            raise RuntimeError(f"consul kv watch: {rsp.status}")
+        idx_hdr = rsp.headers.get("X-Consul-Index")
+        if idx_hdr is not None:
+            try:
+                idx = int(idx_hdr)
+            except ValueError:
+                idx = None
+            # per consul docs: reset the index if it goes backwards or 0
+            if idx is None or idx <= 0 or (
+                    self._consul_index is not None
+                    and idx < self._consul_index):
+                self._consul_index = None
+            else:
+                self._consul_index = idx
+        else:
+            # backend without blocking support: don't spin
+            await asyncio.sleep(self._backoff_base)
+        self._publish(state)
 
     async def _cas_put(self, ns: str, dtab: Dtab, cas: Optional[str]
                        ) -> bool:

@@ -212,6 +212,13 @@ async def read_request(reader: asyncio.StreamReader,
             method, uri, version, header_list = parsed
             if version not in ("HTTP/1.1", "HTTP/1.0"):
                 raise HttpCodecError(f"unsupported version: {version!r}")
+            # enforce the pure-Python path's running-total cap so both
+            # parsers accept exactly the same inputs (the block check
+            # above allows up to MAX_HEADERS_BYTES + MAX_LINE)
+            first_eol = head.find(b"\r\n")
+            total = len(head) - first_eol - 4 - 2 * len(header_list)
+            if total > MAX_HEADERS_BYTES:
+                raise HttpCodecError("headers too large")
             headers = Headers(header_list)
         else:
             # native refused (stricter caps or malformed): re-parse the

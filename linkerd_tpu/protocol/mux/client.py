@@ -46,18 +46,31 @@ class MuxClient(Service[Tdispatch, bytes]):
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(self.host, self.port),
             self.connect_timeout)
+        # fresh pending map per connection generation: the read loop
+        # tears down ONLY its own generation's state, so a stale loop's
+        # cleanup can never close a freshly reconnected writer or fail
+        # the new connection's in-flight futures
+        pending: Dict[int, asyncio.Future] = {}
         self._writer = writer
+        self._pending = pending
         self._read_task = asyncio.get_running_loop().create_task(
-            self._read_loop(reader))
+            self._read_loop(reader, writer, pending))
 
-    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
+    async def _read_loop(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter,
+                         pending: Dict[int, asyncio.Future]) -> None:
         try:
             while True:
                 msg = await read_mux_frame(reader)
                 if msg is None:
                     break
-                fut = self._pending.pop(msg.tag, None)
+                fut = pending.pop(msg.tag, None)
                 if fut is None or fut.done():
+                    continue
+                if msg.fragment:
+                    # fragmentation is never negotiated by this client
+                    fut.set_exception(MuxApplicationError(
+                        "mux fragmentation not supported"))
                     continue
                 if msg.type == RDISPATCH:
                     try:
@@ -82,16 +95,17 @@ class MuxClient(Service[Tdispatch, bytes]):
                 MuxCodecError) as e:
             log.debug("mux client read loop: %s", e)
         finally:
+            # tear down THIS generation only (see _ensure_conn)
             err = ConnectionError("mux connection closed")
-            for fut in self._pending.values():
+            for fut in pending.values():
                 if not fut.done():
                     fut.set_exception(err)
-            self._pending.clear()
-            if self._writer is not None:
-                try:
-                    self._writer.close()
-                except Exception:  # noqa: BLE001
-                    pass
+            pending.clear()
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+            if self._writer is writer:
                 self._writer = None
 
     def _alloc_tag(self) -> int:
@@ -107,24 +121,28 @@ class MuxClient(Service[Tdispatch, bytes]):
         try:
             async with self._lock:
                 await self._ensure_conn()
+                # capture THIS generation's writer+pending: by the time
+                # the cancel path runs, a reconnect may have swapped in a
+                # new generation that reuses the same tag numbers
+                writer = self._writer
+                pending = self._pending
                 tag = self._alloc_tag()
                 fut = asyncio.get_running_loop().create_future()
-                self._pending[tag] = fut
-                write_mux_frame(self._writer, *encode_tdispatch(
+                pending[tag] = fut
+                write_mux_frame(writer, *encode_tdispatch(
                     tag, td.contexts, td.dest, td.dtab, td.payload))
-                await self._writer.drain()
+                await writer.drain()
             try:
                 return await fut
             except asyncio.CancelledError:
-                self._pending.pop(tag, None)
+                pending.pop(tag, None)
                 # tell the server to abandon the exchange so a late reply
                 # can't be misdelivered if the tag is reused (the mux
                 # Tdiscarded handshake exists exactly for this)
-                if self._writer is not None and \
-                        not self._writer.is_closing():
+                if not writer.is_closing():
                     try:
                         write_mux_frame(
-                            self._writer, TDISCARDED, 0,
+                            writer, TDISCARDED, 0,
                             tag.to_bytes(3, "big") + b"canceled")
                     except Exception:  # noqa: BLE001 - best effort
                         pass

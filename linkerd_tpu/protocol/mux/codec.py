@@ -38,6 +38,7 @@ class MuxMessage:
     type: int
     tag: int
     body: bytes
+    fragment: bool = False  # tag MSB: fragmented frame (not supported)
 
 
 @dataclass
@@ -61,8 +62,13 @@ async def read_mux_frame(reader: asyncio.StreamReader
         raise MuxCodecError(f"bad mux frame length {n}")
     buf = await reader.readexactly(n)
     mtype = buf[0]
-    tag = int.from_bytes(buf[1:4], "big") & 0x7FFFFF
-    return MuxMessage(mtype, tag, buf[4:])
+    raw_tag = int.from_bytes(buf[1:4], "big")
+    # The tag MSB is the fragment bit (finagle mux framing). This codec
+    # does not reassemble fragments, so silently masking it would corrupt
+    # payloads from a peer that negotiated fragmentation — surface it for
+    # the caller to reject with Rerr instead.
+    return MuxMessage(mtype, raw_tag & 0x7FFFFF, buf[4:],
+                      fragment=bool(raw_tag & 0x800000))
 
 
 def write_mux_frame(writer: asyncio.StreamWriter, mtype: int, tag: int,

@@ -90,6 +90,12 @@ class MuxServer:
                 msg = await read_mux_frame(reader)
                 if msg is None:
                     return
+                if msg.fragment:
+                    # this codec never negotiates fragmentation (Rinit
+                    # advertises no params); reject rather than misparse
+                    await reply(*encode_rerr(
+                        msg.tag, "mux fragmentation not supported"))
+                    continue
                 if msg.type == TDISPATCH:
                     task = asyncio.get_running_loop().create_task(
                         dispatch(msg))
@@ -97,7 +103,11 @@ class MuxServer:
                 elif msg.type == TPING:
                     await reply(RPING, msg.tag, b"")
                 elif msg.type == TINIT:
-                    await reply(RINIT, msg.tag, msg.body)
+                    # advertise OUR params (none — in particular, no
+                    # fragmentation) instead of echoing the client's,
+                    # which would imply agreement to whatever it proposed
+                    version = msg.body[:2] if len(msg.body) >= 2 else b"\x00\x01"
+                    await reply(RINIT, msg.tag, version)
                 elif msg.type == TDISCARDED:
                     # body: 3-byte tag being discarded + why
                     if len(msg.body) >= 3:

@@ -22,6 +22,7 @@ traverse (SURVEY.md §7 hard part 3).
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Dict, Generic, Optional, Tuple, TypeVar
@@ -34,6 +35,8 @@ from linkerd_tpu.core.nametree import (
 )
 from linkerd_tpu.namer.core import NameInterpreter
 from linkerd_tpu.router.service import Service, Status
+
+log = logging.getLogger(__name__)
 
 K = TypeVar("K")
 
@@ -110,12 +113,17 @@ class ServiceCache(Generic[K]):
                 pass
 
 
+def _log_close_error(t: "asyncio.Task") -> None:
+    if not t.cancelled() and t.exception() is not None:
+        log.warning("evicted service close failed: %r", t.exception())
+
+
 def _close_async(svc: Service) -> None:
     try:
         loop = asyncio.get_running_loop()
     except RuntimeError:
         return
-    loop.create_task(svc.close())
+    loop.create_task(svc.close()).add_done_callback(_log_close_error)
 
 
 class NameTreeFactory(Service):

@@ -28,11 +28,24 @@ def run(coro):
 
 class FakeEtcd:
     """Just enough of the v2 keys API: PUT w/ prevExist/prevIndex CAS,
-    DELETE, recursive GET."""
+    DELETE, recursive GET, and ``wait=true&waitIndex=N`` watches with an
+    event history + X-Etcd-Index headers (what the watch loop uses)."""
 
     def __init__(self):
         self.nodes = {}  # key -> (value, modifiedIndex)
         self.index = 100
+        self.events = []  # (index, action, key, value|None)
+        self._changed = asyncio.Event()
+
+    def _record(self, action, key, value):
+        self.index += 1
+        self.events.append((self.index, action, key, value))
+        self._changed.set()
+        self._changed = asyncio.Event()
+
+    def _hdrs(self):
+        from linkerd_tpu.protocol.http.message import Headers
+        return Headers([("X-Etcd-Index", str(self.index))])
 
     def service(self):
         async def handler(req: Request) -> Response:
@@ -41,21 +54,37 @@ class FakeEtcd:
             key = unquote(parts.path[len("/v2/keys"):]).rstrip("/")
             q = dict(parse_qsl(parts.query))
             if req.method == "GET":
+                if q.get("wait") == "true":
+                    wait_idx = int(q.get("waitIndex", 0))
+                    while True:
+                        for idx, action, k, v in self.events:
+                            if idx >= wait_idx and k.startswith(key + "/"):
+                                node = {"key": k, "modifiedIndex": idx}
+                                if v is not None:
+                                    node["value"] = v
+                                return Response(
+                                    status=200, headers=self._hdrs(),
+                                    body=json.dumps({
+                                        "action": action,
+                                        "node": node}).encode())
+                        await self._changed.wait()
                 if q.get("recursive") == "true":
                     nodes = [
                         {"key": k, "value": v, "modifiedIndex": idx}
                         for k, (v, idx) in self.nodes.items()
                         if k.startswith(key + "/")
                     ]
-                    return Response(status=200, body=json.dumps(
+                    return Response(status=200, headers=self._hdrs(),
+                                    body=json.dumps(
                         {"node": {"key": key, "dir": True,
                                   "nodes": nodes}}).encode())
                 if key in self.nodes:
                     v, idx = self.nodes[key]
-                    return Response(status=200, body=json.dumps(
+                    return Response(status=200, headers=self._hdrs(),
+                                    body=json.dumps(
                         {"node": {"key": key, "value": v,
                                   "modifiedIndex": idx}}).encode())
-                return Response(status=404, body=b"{}")
+                return Response(status=404, headers=self._hdrs(), body=b"{}")
             if req.method == "PUT":
                 form = dict(parse_qsl(req.body.decode()))
                 if form.get("prevExist") == "false" and key in self.nodes:
@@ -65,22 +94,36 @@ class FakeEtcd:
                         return Response(status=404, body=b"{}")
                     if str(self.nodes[key][1]) != form["prevIndex"]:
                         return Response(status=412, body=b"{}")
-                self.index += 1
+                self._record("set", key, form["value"])
                 self.nodes[key] = (form["value"], self.index)
                 return Response(status=200, body=b"{}")
             if req.method == "DELETE":
                 if key not in self.nodes:
                     return Response(status=404, body=b"{}")
                 del self.nodes[key]
+                self._record("delete", key, None)
                 return Response(status=200, body=b"{}")
             return Response(status=405)
         return FnService(handler)
 
 
 class FakeConsulKv:
+    """Consul KV with CAS + blocking-index queries (``index=N&wait=..``
+    parks until self.index moves past N) + X-Consul-Index headers."""
+
     def __init__(self):
         self.kv = {}  # key -> (value bytes, ModifyIndex)
         self.index = 50
+        self._changed = asyncio.Event()
+
+    def _bump(self):
+        self.index += 1
+        self._changed.set()
+        self._changed = asyncio.Event()
+
+    def _hdrs(self):
+        from linkerd_tpu.protocol.http.message import Headers
+        return Headers([("X-Consul-Index", str(self.index))])
 
     def service(self):
         async def handler(req: Request) -> Response:
@@ -90,6 +133,10 @@ class FakeConsulKv:
             q = dict(parse_qsl(parts.query))
             if req.method == "GET":
                 if q.get("recurse") == "true":
+                    if "index" in q:
+                        want = int(q["index"])
+                        while self.index <= want:
+                            await self._changed.wait()
                     prefix = key
                     entries = [
                         {"Key": k,
@@ -99,10 +146,11 @@ class FakeConsulKv:
                         if k.startswith(prefix)
                     ]
                     if not entries:
-                        return Response(status=404, body=b"[]")
-                    return Response(status=200,
+                        return Response(status=404, headers=self._hdrs(),
+                                        body=b"[]")
+                    return Response(status=200, headers=self._hdrs(),
                                     body=json.dumps(entries).encode())
-                return Response(status=404)
+                return Response(status=404, headers=self._hdrs())
             if req.method == "PUT":
                 if "cas" in q:
                     cas = int(q["cas"])
@@ -111,11 +159,12 @@ class FakeConsulKv:
                         return Response(status=200, body=b"false")
                     if cas != 0 and (cur is None or cur[1] != cas):
                         return Response(status=200, body=b"false")
-                self.index += 1
+                self._bump()
                 self.kv[key] = (req.body, self.index)
                 return Response(status=200, body=b"true")
             if req.method == "DELETE":
                 self.kv.pop(key, None)
+                self._bump()
                 return Response(status=200, body=b"true")
             return Response(status=405)
         return FnService(handler)
@@ -170,25 +219,94 @@ class TestConsulKvStore:
             await server.close()
         run(go())
 
-    def test_external_write_visible_via_poll(self):
+    def test_external_write_visible_via_blocking_watch(self):
+        """An out-of-band write must land through the blocking-index
+        watch — fast (<100ms), no polling sleeps involved."""
+        import time
+
         async def go():
             fake = FakeConsulKv()
             server = await HttpServer(fake.service()).start()
-            store = ConsulDtabStore("127.0.0.1", server.bound_port,
-                                    poll_interval=0.05)
+            store = ConsulDtabStore("127.0.0.1", server.bound_port)
             act = store.observe("ops")
+            # wait until the store holds a parked blocking query
+            for _ in range(100):
+                if store._consul_index is not None:
+                    break
+                await asyncio.sleep(0.01)
             # another namerd (or operator) writes directly to consul
-            fake.index += 1
+            t0 = time.perf_counter()
+            fake._bump()
             fake.kv["namerd/dtabs/ops"] = (b"/svc => /#/io.l5d.fs;",
                                            fake.index)
-            for _ in range(100):
-                vd = act.current.value if hasattr(act.current, "value") \
-                    else None
+            while True:
+                st = act.current
+                vd = getattr(st, "value", None)
                 if vd is not None:
                     break
-                await asyncio.sleep(0.05)
-            vd = await act.to_future()
-            assert vd is not None and "/#/io.l5d.fs" in vd.dtab.show
+                await asyncio.sleep(0.005)
+            elapsed = time.perf_counter() - t0
+            assert "/#/io.l5d.fs" in vd.dtab.show
+            assert elapsed < 0.5, f"watch took {elapsed:.3f}s"
+            store.close()
+            await server.close()
+        run(go())
+
+
+class TestEtcdWatch:
+    def test_external_write_visible_via_watch(self):
+        import time
+
+        async def go():
+            fake = FakeEtcd()
+            server = await HttpServer(fake.service()).start()
+            store = EtcdDtabStore("127.0.0.1", server.bound_port)
+            act = store.observe("ops")
+            for _ in range(100):
+                if store._watch_index is not None:
+                    break
+                await asyncio.sleep(0.01)
+            t0 = time.perf_counter()
+            fake._record("set", "/namerd/dtabs/ops", "/svc => /#/io.l5d.fs;")
+            fake.nodes["/namerd/dtabs/ops"] = (
+                "/svc => /#/io.l5d.fs;", fake.index)
+            while True:
+                st = act.current
+                vd = getattr(st, "value", None)
+                if vd is not None:
+                    break
+                await asyncio.sleep(0.005)
+            elapsed = time.perf_counter() - t0
+            assert "/#/io.l5d.fs" in vd.dtab.show
+            assert elapsed < 0.5, f"watch took {elapsed:.3f}s"
+
+            # delete propagates through the watch too
+            del fake.nodes["/namerd/dtabs/ops"]
+            fake._record("delete", "/namerd/dtabs/ops", None)
+            for _ in range(100):
+                st = act.current
+                if getattr(st, "value", object()) is None:
+                    break
+                await asyncio.sleep(0.01)
+            assert getattr(act.current, "value", object()) is None
+            store.close()
+            await server.close()
+        run(go())
+
+    def test_observe_pending_until_first_fetch(self):
+        """Startup must not transiently report namespaces as missing
+        (Pending, not Ok(None), before the first backend answer)."""
+        from linkerd_tpu.core.activity import Pending
+
+        async def go():
+            fake = FakeEtcd()
+            fake.nodes["/namerd/dtabs/boot"] = ("/a => /b;", 101)
+            server = await HttpServer(fake.service()).start()
+            store = EtcdDtabStore("127.0.0.1", server.bound_port)
+            act = store.observe("boot")
+            assert isinstance(act.current, Pending)
+            vd = await asyncio.wait_for(act.to_future(), 5)
+            assert vd is not None and "/a" in vd.dtab.show
             store.close()
             await server.close()
         run(go())

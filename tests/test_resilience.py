@@ -260,3 +260,41 @@ namers:
                 await d.close()
 
         run(go())
+
+
+class TestCacheEvictionUnderLoad:
+    def test_evict_while_request_inflight(self):
+        """Evicting a cached service must not break a request already
+        dispatched through it (in-flight requests hold direct references;
+        ref DstBindingFactory eviction semantics, SURVEY.md §7 hard 3)."""
+        import asyncio
+        from linkerd_tpu.router.binding import ServiceCache
+        from linkerd_tpu.router.service import Service
+
+        class SlowService(Service):
+            def __init__(self):
+                self.closed = False
+                self.gate = asyncio.Event()
+
+            async def __call__(self, req):
+                await self.gate.wait()
+                return f"ok-{req}"
+
+            async def close(self):
+                self.closed = True
+
+        async def go():
+            cache = ServiceCache("t", capacity=1)
+            a = SlowService()
+            b = SlowService()
+            got_a = cache.get("a", lambda: a)
+            task = asyncio.ensure_future(got_a("r1"))
+            await asyncio.sleep(0)
+            # inserting "b" evicts "a" (capacity 1) while r1 is in flight
+            cache.get("b", lambda: b)
+            await asyncio.sleep(0)  # let the async close task run
+            assert a.closed  # evicted -> closed
+            a.gate.set()
+            assert await asyncio.wait_for(task, 5) == "ok-r1"
+
+        asyncio.run(asyncio.wait_for(go(), 15))
