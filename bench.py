@@ -24,16 +24,19 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 def scorer_throughput() -> dict:
+    """Micro-batch scoring throughput through the telemeter's OWN serving
+    path (InProcessScorer.score — normalization, padding, worker-thread
+    dispatch, mesh sharding when >1 device), not a stripped-down loop."""
+    import asyncio
+
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
-    from linkerd_tpu.models.anomaly import AnomalyModelConfig, init_params
-    from linkerd_tpu.ops.scoring import best_scorer, fused_available
+    from linkerd_tpu.ops.scoring import fused_available
+    from linkerd_tpu.telemetry.anomaly import InProcessScorer
 
-    cfg = AnomalyModelConfig()
-    params = init_params(jax.random.key(0), cfg)
-    scorer = best_scorer(cfg)
+    scorer = InProcessScorer()
+    cfg = scorer.cfg
 
     batch = 4096
     n_iters = 200
@@ -42,26 +45,32 @@ def scorer_throughput() -> dict:
         rng.standard_normal((batch, cfg.in_dim), dtype=np.float32)
         for _ in range(8)
     ]
-    out = scorer(params, jnp.asarray(host_batches[0]))
-    jax.block_until_ready(out)
 
-    t0 = time.perf_counter()
-    outs = []
-    for i in range(n_iters):
-        x = jax.device_put(host_batches[i % len(host_batches)])
-        outs.append(scorer(params, x))
-        if len(outs) >= 4:  # bounded in-flight queue, like the telemeter's
-            np.asarray(outs.pop(0))
-    for o in outs:
-        np.asarray(o)
-    dt = time.perf_counter() - t0
+    async def drive() -> float:
+        await scorer.score(host_batches[0])  # warm / compile
+        t0 = time.perf_counter()
+        inflight = []
+        for i in range(n_iters):
+            inflight.append(asyncio.ensure_future(
+                scorer.score(host_batches[i % len(host_batches)])))
+            if len(inflight) >= 4:  # bounded queue, like the telemeter's
+                await inflight.pop(0)
+        for f in inflight:
+            await f
+        return time.perf_counter() - t0
+
+    dt = asyncio.run(drive())
     return {
         "rows_per_s": batch * n_iters / dt,
         "batch": batch,
         "iters": n_iters,
-        "fused_pallas": fused_available(),
+        # the mesh path uses plain XLA sharding, never the fused kernel
+        "fused_pallas": scorer.mesh is None and fused_available(),
+        "sharded_mesh": (dict(scorer.mesh.shape)
+                         if scorer.mesh is not None else None),
         "wall_s": round(dt, 3),
         "device": str(jax.devices()[0]),
+        "n_devices": len(jax.devices()),
     }
 
 

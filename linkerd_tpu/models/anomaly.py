@@ -108,17 +108,27 @@ def loss_fn(
     labels: jax.Array,
     label_mask: jax.Array,
     cfg: AnomalyModelConfig = AnomalyModelConfig(),
+    row_mask: jax.Array = None,
 ) -> jax.Array:
     """Reconstruction MSE + masked BCE on labeled rows.
 
     ``labels`` in {0,1} float, ``label_mask`` 1.0 where the row is labeled
-    (fault-injection traces) and 0.0 for unlabeled traffic. Pure arithmetic —
-    no data-dependent control flow, so it jits to one fused XLA computation.
+    (fault-injection traces) and 0.0 for unlabeled traffic. ``row_mask``
+    (1.0 = real row) excludes padding rows added for mesh divisibility
+    from BOTH loss terms; None means all rows are real. Pure arithmetic —
+    no data-dependent control flow, so it jits to one fused XLA
+    computation.
     """
     import optax
 
     recon, _, logits = apply_model(params, x, cfg)
-    recon_loss = jnp.mean(jnp.square(recon - x))
+    sq = jnp.mean(jnp.square(recon - x), axis=-1)
+    if row_mask is None:
+        recon_loss = jnp.mean(sq)
+    else:
+        recon_loss = (jnp.sum(sq * row_mask)
+                      / jnp.maximum(jnp.sum(row_mask), 1.0))
+        label_mask = label_mask * row_mask
     bce = optax.sigmoid_binary_cross_entropy(logits, labels)
     denom = jnp.maximum(jnp.sum(label_mask), 1.0)
     cls_loss = jnp.sum(bce * label_mask) / denom

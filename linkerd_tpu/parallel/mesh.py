@@ -129,11 +129,15 @@ def make_train_step(
     vs = NamedSharding(mesh, P("data"))
 
     @jax.jit
-    def train_step(params: Params, opt_state, x, labels, label_mask):
+    def train_step(params: Params, opt_state, x, labels, label_mask,
+                   row_mask=None):
         x = jax.lax.with_sharding_constraint(x, xs)
         labels = jax.lax.with_sharding_constraint(labels, vs)
         label_mask = jax.lax.with_sharding_constraint(label_mask, vs)
-        loss, grads = jax.value_and_grad(loss_fn)(params, x, labels, label_mask, cfg)
+        if row_mask is not None:
+            row_mask = jax.lax.with_sharding_constraint(row_mask, vs)
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, x, labels, label_mask, cfg, row_mask)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

@@ -147,23 +147,47 @@ class Scorer:
 
 
 class InProcessScorer(Scorer):
-    """Runs the JAX model in-process (single-chip or CPU). Device work is
-    dispatched from a worker thread so the event loop never blocks on
-    compilation or transfers."""
+    """Runs the JAX model in-process. Device work is dispatched from a
+    worker thread so the event loop never blocks on compilation or
+    transfers.
+
+    With more than one device the SAME serving path runs sharded: a
+    dp x tp mesh from parallel/mesh.py, params placed per the Megatron
+    column/row specs, micro-batches sharded over ``data`` — XLA inserts
+    the ICI collectives. Single-chip keeps the fused-Pallas kernel
+    (ops/scoring.best_scorer)."""
 
     def __init__(self, seed: int = 0, learning_rate: float = 1e-3,
-                 recon_weight: float = 0.7, fit_steps: int = 4):
+                 recon_weight: float = 0.7, fit_steps: int = 4,
+                 devices=None):
         import jax
         import optax
         from linkerd_tpu.models.anomaly import AnomalyModelConfig, init_params
         from linkerd_tpu.ops.scoring import best_scorer
 
         self.cfg = AnomalyModelConfig(recon_weight=recon_weight)
-        self.params = init_params(jax.random.key(seed), self.cfg)
-        self._scorer = best_scorer(self.cfg)
         self._opt = optax.adam(learning_rate)
-        self._opt_state = self._opt.init(self.params)
-        self._train_step = self._mk_train_step()
+        devices = list(devices if devices is not None else jax.devices())
+        self.mesh = None
+        self._batch_multiple = 1
+        if len(devices) > 1:
+            from linkerd_tpu.parallel.mesh import (
+                init_sharded, make_mesh, make_score_step, make_train_step,
+            )
+            self.mesh = make_mesh(devices)
+            self.params, self._opt_state = init_sharded(
+                self.mesh, jax.random.key(seed), self._opt, self.cfg)
+            self._scorer = make_score_step(self.mesh, self.cfg)
+            self._train_step = make_train_step(self.mesh, self._opt, self.cfg)
+            self._batch_multiple = self.mesh.shape["data"]
+        else:
+            params = init_params(jax.random.key(seed), self.cfg)
+            # honor an explicit device choice (e.g. pin to the second
+            # chip); jit follows the committed placement of the params
+            self.params = jax.device_put(params, devices[0])
+            self._opt_state = self._opt.init(self.params)
+            self._scorer = best_scorer(self.cfg)
+            self._train_step = self._mk_train_step()
         self.fit_steps = fit_steps
         # Running feature normalization (updated on non-anomalous training
         # rows): without it the autoencoder's reconstruction error is
@@ -202,32 +226,52 @@ class InProcessScorer(Scorer):
         opt = self._opt
 
         @jax.jit
-        def step(params, opt_state, x, labels, mask):
-            loss, grads = jax.value_and_grad(loss_fn)(params, x, labels, mask, cfg)
+        def step(params, opt_state, x, labels, mask, row_mask=None):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, x, labels, mask, cfg, row_mask)
             updates, opt_state = opt.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss
 
         return step
 
+    def _pad_rows(self, arr: np.ndarray) -> np.ndarray:
+        """Pad the batch dim to a multiple of the data-axis size (sharded
+        arrays must divide evenly over the mesh)."""
+        m = self._batch_multiple
+        if m <= 1 or len(arr) % m == 0:
+            return arr
+        pad = m - len(arr) % m
+        widths = ((0, pad),) + ((0, 0),) * (arr.ndim - 1)
+        return np.pad(arr, widths)
+
     async def score(self, x: np.ndarray) -> np.ndarray:
-        xn = self._normalize(x)
+        n = len(x)
+        xn = self._pad_rows(self._normalize(x))
 
         def run() -> np.ndarray:
-            return np.asarray(self._scorer(self.params, xn))
+            return np.asarray(self._scorer(self.params, xn))[:n]
 
         return await asyncio.to_thread(run)
 
     async def fit(self, x: np.ndarray, labels: np.ndarray,
                   mask: np.ndarray) -> float:
+        n = len(x)
         self._update_norm(x, labels, mask)
-        xn = self._normalize(x)
+        xn = self._pad_rows(self._normalize(x))
+        labels = self._pad_rows(np.asarray(labels, np.float32))
+        mask = self._pad_rows(np.asarray(mask, np.float32))
+        # row_mask excludes the padding rows from BOTH loss terms so the
+        # sharded and single-chip paths train on the same objective
+        row_mask = (self._pad_rows(np.ones(n, np.float32))
+                    if len(xn) != n else None)
 
         def run() -> float:
             loss = float("nan")
             for _ in range(self.fit_steps):
                 self.params, self._opt_state, loss = self._train_step(
-                    self.params, self._opt_state, xn, labels, mask)
+                    self.params, self._opt_state, xn, labels, mask,
+                    row_mask)
             return float(loss)
 
         return await asyncio.to_thread(run)

@@ -139,6 +139,13 @@ class GrpcScorerClient:
                               timeout=self.timeout_s)
         return float(np.frombuffer(rsp, np.float32)[0])
 
+    async def aclose(self) -> None:
+        """Close the channel, awaiting completion (use before the event
+        loop shuts down)."""
+        if self._channel is not None:
+            ch, self._channel = self._channel, None
+            await ch.close()
+
     def close(self) -> None:
         if self._channel is not None:
             ch, self._channel = self._channel, None
