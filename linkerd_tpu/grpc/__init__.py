@@ -7,7 +7,7 @@ a field-descriptor DSL (`proto.py`) that speaks the protobuf wire format, so
 service definitions live next to the code that uses them (mesh API, scorer).
 """
 
-from linkerd_tpu.grpc.proto import Enum, Field, ProtoMessage
+from linkerd_tpu.grpc.proto import Enum, Field, MapField, ProtoMessage
 from linkerd_tpu.grpc.codec import Codec, GrpcFramer
 from linkerd_tpu.grpc.status import GrpcStatus, GrpcError
 from linkerd_tpu.grpc.stream import GrpcStream, DecodingStream, EncodingStream
@@ -17,7 +17,7 @@ from linkerd_tpu.grpc.dispatch import (
 from linkerd_tpu.grpc.var_event import VarEventStream
 
 __all__ = [
-    "Enum", "Field", "ProtoMessage", "Codec", "GrpcFramer",
+    "Enum", "Field", "MapField", "ProtoMessage", "Codec", "GrpcFramer",
     "GrpcStatus", "GrpcError", "GrpcStream", "DecodingStream",
     "EncodingStream", "ClientDispatcher", "Rpc", "ServerDispatcher",
     "ServiceDef", "VarEventStream",

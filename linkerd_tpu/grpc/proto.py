@@ -105,6 +105,60 @@ _PROTO_DEFAULTS: Dict[str, Any] = {
 }
 
 
+class MapField:
+    """A proto3 ``map<K, V>`` field: a dict on the message, encoded as
+    repeated entry submessages {1: key, 2: value} per the spec."""
+
+    __slots__ = ("number", "key_kind", "val_kind", "val_message")
+
+    def __init__(self, number: int, key_kind: str, val_kind: str,
+                 val_message: Optional[type] = None):
+        if key_kind not in _SCALAR_WIRE or key_kind in ("message", "bytes",
+                                                        "float", "double"):
+            raise ValueError(f"invalid map key kind {key_kind!r}")
+        if val_kind not in _SCALAR_WIRE:
+            raise ValueError(f"unknown map value kind {val_kind!r}")
+        if val_kind == "message" and val_message is None:
+            raise ValueError("message-valued maps need a message class")
+        self.number = number
+        self.key_kind = key_kind
+        self.val_kind = val_kind
+        self.val_message = val_message
+
+    def encode_entries(self, d: Dict[Any, Any]) -> bytes:
+        out = bytearray()
+        tag = encode_varint((self.number << 3) | _LEN)
+        ktag = encode_varint((1 << 3) | _SCALAR_WIRE[self.key_kind])
+        vtag = encode_varint((2 << 3) | _SCALAR_WIRE[self.val_kind])
+        for k, v in d.items():
+            payload = ktag + _encode_scalar(self.key_kind, k)
+            if not (self.val_kind != "message" and
+                    v == _PROTO_DEFAULTS.get(self.val_kind)):
+                payload += vtag + _encode_scalar(self.val_kind, v)
+            out += tag
+            out += encode_varint(len(payload))
+            out += payload
+        return bytes(out)
+
+    def decode_entry(self, chunk: bytes) -> Tuple[Any, Any]:
+        key = _PROTO_DEFAULTS.get(self.key_kind)
+        val = (self.val_message() if self.val_kind == "message"
+               else _PROTO_DEFAULTS.get(self.val_kind))
+        pos = 0
+        while pos < len(chunk):
+            k, pos = decode_varint(chunk, pos)
+            number, wire = k >> 3, k & 0x7
+            if number == 1:
+                key, pos = _decode_scalar(self.key_kind, None, chunk, pos,
+                                          wire)
+            elif number == 2:
+                val, pos = _decode_scalar(self.val_kind, self.val_message,
+                                          chunk, pos, wire)
+            else:
+                pos = _skip(chunk, pos, wire)
+        return key, val
+
+
 def _encode_scalar(kind: str, value: Any) -> bytes:
     if kind in ("int32", "int64", "uint32", "uint64", "enum"):
         return encode_varint(int(value))
@@ -200,6 +254,8 @@ class ProtoMessage:
         for name, fd in self.FIELDS.items():
             if name in kwargs:
                 v = kwargs.pop(name)
+            elif isinstance(fd, MapField):
+                v = {}
             elif fd.repeated:
                 v = []
             else:
@@ -213,6 +269,10 @@ class ProtoMessage:
         out = bytearray()
         for name, fd in self.FIELDS.items():
             value = getattr(self, name)
+            if isinstance(fd, MapField):
+                if value:
+                    out += fd.encode_entries(value)
+                continue
             wire = _SCALAR_WIRE[fd.kind]
             tag = encode_varint((fd.number << 3) | wire)
             if fd.repeated:
@@ -252,6 +312,18 @@ class ProtoMessage:
                 pos = _skip(data, pos, wire)
                 continue
             name, fd = entry
+            if isinstance(fd, MapField):
+                if wire != _LEN:
+                    pos = _skip(data, pos, wire)
+                    continue
+                ln, pos = decode_varint(data, pos)
+                chunk = data[pos:pos + ln]
+                if len(chunk) != ln:
+                    raise ValueError("truncated map entry")
+                pos += ln
+                k, v = fd.decode_entry(chunk)
+                getattr(msg, name)[k] = v
+                continue
             if fd.repeated and wire == _LEN and \
                     _SCALAR_WIRE[fd.kind] != _LEN:
                 # packed numeric run
@@ -279,7 +351,8 @@ class ProtoMessage:
         parts = []
         for name, fd in self.FIELDS.items():
             v = getattr(self, name)
-            if v is None or (fd.repeated and not v):
+            if v is None or (isinstance(fd, MapField) and not v) or (
+                    not isinstance(fd, MapField) and fd.repeated and not v):
                 continue
             parts.append(f"{name}={v!r}")
         return f"{type(self).__name__}({', '.join(parts)})"

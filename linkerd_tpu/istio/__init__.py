@@ -1,0 +1,19 @@
+"""Istio integration: Pilot discovery (SDS/RDS/apiserver), route/cluster
+caches, the istio namer + interpreter, mixer telemetry, and request
+identifiers.
+
+Reference parity: /root/reference/k8s/src/main/scala/io/buoyant/k8s/istio/
+(MixerClient.scala:131, IstioNamer.scala:79, RouteCache.scala,
+ClusterCache.scala, DiscoveryClient.scala, ApiserverClient.scala,
+IstioIdentifierBase.scala) and
+/root/reference/interpreter/k8s/.../IstioInterpreter.scala. The mixer
+protobuf surface (mixer_pb.py) is GENERATED from istio's .proto files by
+tools/proto_gen.py — the codegen path the reference drives through its
+protoc plugin (grpc/gen/.../Generator.scala).
+"""
+
+from linkerd_tpu.istio.pilot import (  # noqa: F401
+    ApiserverClient, ClusterCache, DiscoveryClient, RouteCache, RouteRule,
+)
+from linkerd_tpu.istio.namer import IstioNamer  # noqa: F401
+from linkerd_tpu.istio.mixer import MixerClient  # noqa: F401

@@ -1,0 +1,229 @@
+"""Istio request identifiers (http + h2): route each request through the
+cluster cache + route-rules.
+
+Logic (ref IstioIdentifierBase.scala:1-127):
+  authority -> ClusterCache -> Cluster(dest, port)
+    no vhost              -> /<pfx>/ext/<host>/<port>   (external)
+    rules for dest        -> filter by match conditions, take max
+                             precedence:
+        redirect rule     -> answer 302 directly
+        otherwise         -> apply rewrite, route to
+                             /<pfx>/route/<ruleName>/<port>
+    no matching rule      -> /<pfx>/dest/<dest>/::/<port>
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from linkerd_tpu.config import register
+from linkerd_tpu.core import Dtab, Path
+from linkerd_tpu.istio.pilot import (
+    ApiserverClient, Cluster, ClusterCache, DiscoveryClient, RouteCache,
+    RouteRule, StringMatch,
+)
+from linkerd_tpu.router.binding import DstPath
+from linkerd_tpu.router.routing import IdentificationError, parse_local_dtab
+
+
+@dataclass
+class RequestMeta:
+    """Normalized request view shared by http and h2
+    (ref IstioRequestMeta)."""
+
+    uri: str
+    scheme: str
+    method: str
+    authority: str
+    get_header: Callable[[str], Optional[str]]
+
+
+def header_matches(value: str, sm: StringMatch) -> bool:
+    return sm.matches(value)
+
+
+def matches_all_conditions(meta: RequestMeta,
+                           headers: Dict[str, StringMatch]) -> bool:
+    for name, sm in headers.items():
+        if name == "uri":
+            got: Optional[str] = meta.uri
+        elif name == "scheme":
+            got = meta.scheme
+        elif name == "method":
+            got = meta.method
+        elif name == "authority":
+            got = meta.authority
+        else:
+            got = meta.get_header(name)
+        if got is None or not sm.matches(got):
+            return False
+    return True
+
+
+def filter_rules(rules: Dict[str, RouteRule], dest: str,
+                 meta: RequestMeta) -> List[Tuple[str, RouteRule]]:
+    return [
+        (name, r) for name, r in rules.items()
+        if r.destination == dest
+        and matches_all_conditions(meta, r.match_headers)
+    ]
+
+
+def max_precedence(rules: List[Tuple[str, RouteRule]]
+                   ) -> Optional[Tuple[str, RouteRule]]:
+    if not rules:
+        return None
+    return max(rules, key=lambda nr: nr[1].precedence)
+
+
+def http_rewrite(rule: RouteRule, meta: RequestMeta
+                 ) -> Tuple[str, Optional[str]]:
+    """-> (uri, authority) after the rule's rewrite
+    (ref IstioIdentifierBase.httpRewrite)."""
+    uri = meta.uri
+    if rule.rewrite_uri is not None:
+        m = rule.match_headers.get("uri")
+        if m is not None and m.prefix is not None and \
+                uri.startswith(m.prefix):
+            uri = rule.rewrite_uri + uri[len(m.prefix):]
+        else:
+            uri = rule.rewrite_uri
+    authority = rule.rewrite_authority or meta.authority
+    return uri, authority
+
+
+def external_path(pfx: Path, host: str) -> Path:
+    parts = host.split(":")
+    if len(parts) == 2:
+        return pfx + Path.of("ext", parts[0], parts[1])
+    if len(parts) == 1:
+        return pfx + Path.of("ext", parts[0], "80")
+    raise IdentificationError(f"unable to parse host {host!r}")
+
+
+class IstioIdentifierLogic:
+    """Protocol-independent identification over the caches."""
+
+    def __init__(self, cluster_cache: ClusterCache, route_cache: RouteCache,
+                 prefix: Path, base_dtab: Dtab):
+        self.clusters = cluster_cache
+        self.routes = route_cache
+        self.prefix = prefix
+        self.base_dtab = base_dtab
+
+    async def identify(self, meta: RequestMeta, local_dtab: Dtab,
+                       apply_rewrite: Callable[[str, Optional[str]], None],
+                       mk_redirect: Callable[[str, str], object]):
+        """-> DstPath, or the value of mk_redirect(uri, authority)."""
+        cluster = await self.clusters.get(meta.authority)
+        if cluster is None:
+            path = external_path(self.prefix, meta.authority)
+            return DstPath(path, self.base_dtab, local_dtab)
+        rules = await self.routes.get_rules()
+        best = max_precedence(filter_rules(rules, cluster.dest, meta))
+        if best is None:
+            path = self.prefix + Path.of(
+                "dest", cluster.dest, "::", cluster.port)
+            return DstPath(path, self.base_dtab, local_dtab)
+        name, rule = best
+        if rule.is_redirect:
+            return mk_redirect(rule.redirect_uri or meta.uri,
+                               rule.redirect_authority or meta.authority)
+        uri, authority = http_rewrite(rule, meta)
+        apply_rewrite(uri, authority)
+        path = self.prefix + Path.of("route", name, cluster.port)
+        return DstPath(path, self.base_dtab, local_dtab)
+
+
+def _mk_caches(host: str, port: int, discovery_port: int,
+               interval_s: float) -> Tuple[ClusterCache, RouteCache]:
+    discovery = DiscoveryClient(host, discovery_port, interval=interval_s)
+    apiserver = ApiserverClient(host, port, interval=interval_s)
+    return ClusterCache(discovery), RouteCache(apiserver)
+
+
+@register("identifier", "io.l5d.k8s.istio")
+@dataclass
+class IstioIdentifierConfig:
+    """HTTP istio identifier (ref IstioIdentifier.scala; kind
+    io.l5d.k8s.istio). ``host``/``port`` point at Pilot's apiserver,
+    ``discoveryPort`` at its discovery service (RDS)."""
+
+    host: str = "istio-pilot"
+    port: int = 8081
+    discoveryPort: int = 8080
+    pollIntervalMs: int = 5000
+
+    def mk(self, prefix: Path, base_dtab: Dtab):
+        from linkerd_tpu.protocol.http.message import Request, Response
+
+        clusters, routes = _mk_caches(
+            self.host, self.port, self.discoveryPort,
+            self.pollIntervalMs / 1e3)
+        logic = IstioIdentifierLogic(clusters, routes, prefix, base_dtab)
+
+        async def identify(req: Request):
+            host = req.host or ""
+            meta = RequestMeta(
+                uri=req.uri, scheme="http", method=req.method,
+                authority=host, get_header=req.headers.get)
+
+            def apply_rewrite(uri: str, authority: Optional[str]) -> None:
+                req.uri = uri
+                if authority is not None:
+                    req.headers.set("Host", authority)
+
+            def mk_redirect(uri: str, authority: str) -> Response:
+                rsp = Response(status=302)
+                rsp.headers.set("Location", f"http://{authority}{uri}")
+                return rsp
+
+            return await logic.identify(
+                meta, parse_local_dtab(req), apply_rewrite, mk_redirect)
+
+        return identify
+
+
+@register("h2identifier", "io.l5d.k8s.istio")
+@dataclass
+class IstioH2IdentifierConfig:
+    """H2 istio identifier (ref the h2 IstioIdentifier variant)."""
+
+    host: str = "istio-pilot"
+    port: int = 8081
+    discoveryPort: int = 8080
+    pollIntervalMs: int = 5000
+
+    def mk(self, prefix: Path, base_dtab: Dtab):
+        from linkerd_tpu.protocol.h2.messages import H2Request, H2Response
+
+        clusters, routes = _mk_caches(
+            self.host, self.port, self.discoveryPort,
+            self.pollIntervalMs / 1e3)
+        logic = IstioIdentifierLogic(clusters, routes, prefix, base_dtab)
+
+        async def identify(req: H2Request):
+            meta = RequestMeta(
+                uri=req.path, scheme=req.scheme or "http",
+                method=req.method, authority=req.authority or "",
+                get_header=req.headers.get)
+
+            def apply_rewrite(uri: str, authority: Optional[str]) -> None:
+                req.path = uri
+                if authority is not None:
+                    req.authority = authority
+
+            def mk_redirect(uri: str, authority: str) -> H2Response:
+                rsp = H2Response(status=302)
+                rsp.headers.set("location", f"http://{authority}{uri}")
+                return rsp
+
+            local = Dtab.empty()
+            raw = req.headers.get_all("l5d-dtab")
+            if raw:
+                local = Dtab.read(";".join(raw))
+            return await logic.identify(
+                meta, local, apply_rewrite, mk_redirect)
+
+        return identify

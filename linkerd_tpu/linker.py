@@ -53,6 +53,10 @@ _native_codecs.ensure_built()
 # Ensure built-in plugin registrations are loaded.
 import linkerd_tpu.consul.namer  # noqa: F401
 import linkerd_tpu.interpreter.configs  # noqa: F401
+import linkerd_tpu.istio.identifier  # noqa: F401
+import linkerd_tpu.istio.interpreter  # noqa: F401
+import linkerd_tpu.istio.namer  # noqa: F401
+import linkerd_tpu.istio.telemeter  # noqa: F401
 import linkerd_tpu.k8s.namer  # noqa: F401
 import linkerd_tpu.announcer  # noqa: F401
 import linkerd_tpu.namer.fs  # noqa: F401

@@ -40,6 +40,13 @@ class RoutingService(Service[Request, Response]):
 
     async def __call__(self, req: Request) -> Response:
         dst = self._identifier(req)  # raises IdentificationError
+        if hasattr(dst, "__await__"):
+            # async identifiers (e.g. istio: cluster + route-rule lookups)
+            dst = await dst
+        if not isinstance(dst, DstPath):
+            # identifier answered directly (istio redirect responses —
+            # ref IstioIdentifierBase.redirectRequest)
+            return dst
         req.ctx["dst"] = dst
         svc = self._binding.path_service(dst)
         return await svc(req)

@@ -1,0 +1,383 @@
+"""proto -> FIELDS generator: .proto files in, a Python module out.
+
+The reference ships a protoc plugin emitting Scala case classes + codecs
+(ref: grpc/gen/src/main/scala/io/buoyant/grpc/gen/Generator.scala:14-794,
+driven from sbt). The TPU build's equivalent: this tool parses a proto3
+subset directly (no protoc needed) and emits ProtoMessage subclasses over
+the in-repo wire DSL (linkerd_tpu/grpc/proto.py) plus ServiceDef tables
+for the gRPC runtime — so new .proto surfaces (e.g. istio mixer) are
+generated, not hand-transcribed.
+
+Supported: messages (nested), enums, scalar/repeated/map fields, oneof
+(flattened to plain optional fields, matching proto3 wire format),
+imports (all files must be passed together; types resolve by name),
+services (unary/streaming rpcs). Ignored: options, extensions, reserved,
+groups.
+
+Usage:
+  python tools/proto_gen.py OUT.py IN1.proto [IN2.proto ...]
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+SCALARS = {
+    "double", "float", "int32", "int64", "uint32", "uint64", "sint32",
+    "sint64", "fixed32", "fixed64", "sfixed32", "sfixed64", "bool",
+    "string", "bytes",
+}
+
+
+@dataclass
+class FieldDef:
+    name: str
+    number: int
+    type_name: str          # scalar name or message/enum type reference
+    repeated: bool = False
+    map_key: Optional[str] = None   # set for map<K,V>: key scalar
+
+
+@dataclass
+class MessageDef:
+    name: str               # python class name (nesting flattened with _)
+    proto_name: str         # fully qualified proto name
+    fields: List[FieldDef] = field(default_factory=list)
+
+
+@dataclass
+class EnumDef:
+    name: str
+    proto_name: str
+    values: List[Tuple[str, int]] = field(default_factory=list)
+
+
+@dataclass
+class RpcDef:
+    name: str
+    request: str
+    response: str
+    client_streaming: bool = False
+    server_streaming: bool = False
+
+
+@dataclass
+class ServiceDef_:
+    name: str
+    proto_name: str          # package-qualified
+    rpcs: List[RpcDef] = field(default_factory=list)
+
+
+def strip_comments(text: str) -> str:
+    text = re.sub(r"//[^\n]*", "", text)
+    text = re.sub(r"/\*.*?\*/", "", text, flags=re.S)
+    return text
+
+
+def tokenize(text: str) -> List[str]:
+    return re.findall(
+        r"[A-Za-z_][A-Za-z0-9_.]*|\d+|\"(?:[^\"\\]|\\.)*\"|[{}()\[\]<>=;,]",
+        text)
+
+
+class Parser:
+    def __init__(self, tokens: List[str]):
+        self.toks = tokens
+        self.i = 0
+        self.package = ""
+        self.messages: List[MessageDef] = []
+        self.enums: List[EnumDef] = []
+        self.services: List[ServiceDef_] = []
+
+    def peek(self) -> Optional[str]:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self) -> str:
+        tok = self.toks[self.i]
+        self.i += 1
+        return tok
+
+    def expect(self, tok: str) -> None:
+        got = self.next()
+        if got != tok:
+            raise SyntaxError(f"expected {tok!r}, got {got!r} @{self.i}")
+
+    def skip_statement(self) -> None:
+        """Skip to the matching ';' or balanced '{...}'."""
+        depth = 0
+        while self.i < len(self.toks):
+            t = self.next()
+            if t == "{":
+                depth += 1
+            elif t == "}":
+                depth -= 1
+                if depth == 0:
+                    return
+            elif t == ";" and depth == 0:
+                return
+
+    def skip_brackets(self) -> None:
+        """Skip a '[...]' option block."""
+        depth = 0
+        while self.i < len(self.toks):
+            t = self.next()
+            if t == "[":
+                depth += 1
+            elif t == "]":
+                depth -= 1
+                if depth == 0:
+                    return
+
+    def parse(self) -> None:
+        while self.i < len(self.toks):
+            t = self.next()
+            if t == "package":
+                self.package = self.next()
+                self.expect(";")
+            elif t == "message":
+                self.parse_message(self.next(), [])
+            elif t == "enum":
+                self.parse_enum(self.next(), [])
+            elif t == "service":
+                self.parse_service(self.next())
+            elif t in ("syntax", "import", "option"):
+                while self.next() != ";":
+                    pass
+            # stray tokens (e.g. from skipped constructs): ignore
+
+    def parse_message(self, name: str, outer: List[str]) -> None:
+        scope = outer + [name]
+        msg = MessageDef(name="_".join(scope),
+                         proto_name=f"{self.package}.{'.'.join(scope)}")
+        self.messages.append(msg)
+        self.expect("{")
+        while True:
+            t = self.next()
+            if t == "}":
+                return
+            if t == "message":
+                self.parse_message(self.next(), scope)
+            elif t == "enum":
+                self.parse_enum(self.next(), scope)
+            elif t == "oneof":
+                self.next()  # oneof name (flattened away)
+                self.expect("{")
+                while self.peek() != "}":
+                    self.parse_field(msg, self.next())
+                self.expect("}")
+            elif t in ("option", "reserved", "extensions"):
+                self.skip_statement()
+            elif t == ";":
+                continue
+            else:
+                self.parse_field(msg, t)
+
+    def parse_field(self, msg: MessageDef, first: str) -> None:
+        repeated = False
+        map_key = None
+        if first in ("repeated", "optional", "required"):
+            repeated = first == "repeated"
+            first = self.next()
+        if first == "map":
+            self.expect("<")
+            map_key = self.next()
+            self.expect(",")
+            type_name = self.next()
+            self.expect(">")
+        else:
+            type_name = first
+        name = self.next()
+        self.expect("=")
+        number = int(self.next())
+        if self.peek() == "[":
+            self.skip_brackets()
+        self.expect(";")
+        msg.fields.append(FieldDef(name=name, number=number,
+                                   type_name=type_name, repeated=repeated,
+                                   map_key=map_key))
+
+    def parse_enum(self, name: str, outer: List[str]) -> None:
+        scope = outer + [name]
+        en = EnumDef(name="_".join(scope),
+                     proto_name=f"{self.package}.{'.'.join(scope)}")
+        self.enums.append(en)
+        self.expect("{")
+        while True:
+            t = self.next()
+            if t == "}":
+                return
+            if t in ("option", "reserved"):
+                self.skip_statement()
+                continue
+            if t == ";":
+                continue
+            vname = t
+            self.expect("=")
+            value = int(self.next())
+            if self.peek() == "[":
+                self.skip_brackets()
+            self.expect(";")
+            en.values.append((vname, value))
+
+    def parse_service(self, name: str) -> None:
+        svc = ServiceDef_(name=name, proto_name=f"{self.package}.{name}")
+        self.services.append(svc)
+        self.expect("{")
+        while True:
+            t = self.next()
+            if t == "}":
+                return
+            if t == "option":
+                self.skip_statement()
+                continue
+            if t != "rpc":
+                continue
+            rpc_name = self.next()
+            self.expect("(")
+            client_streaming = False
+            req = self.next()
+            if req == "stream":
+                client_streaming = True
+                req = self.next()
+            self.expect(")")
+            assert self.next() == "returns"
+            self.expect("(")
+            server_streaming = False
+            rsp = self.next()
+            if rsp == "stream":
+                server_streaming = True
+                rsp = self.next()
+            self.expect(")")
+            if self.peek() == "{":
+                self.skip_statement()  # empty options body
+            elif self.peek() == ";":
+                self.next()
+            svc.rpcs.append(RpcDef(rpc_name, req, rsp,
+                                   client_streaming, server_streaming))
+
+
+def resolve(type_name: str, messages: Dict[str, MessageDef],
+            enums: Dict[str, EnumDef]) -> Tuple[str, Optional[str]]:
+    """-> (kind, message_class_name|None). Types resolve by the longest
+    dotted suffix against everything parsed."""
+    if type_name in SCALARS:
+        return type_name, None
+    # try full name then progressively shorter suffixes
+    parts = type_name.split(".")
+    for start in range(len(parts)):
+        suffix = ".".join(parts[start:])
+        for m in messages.values():
+            if m.proto_name == type_name or \
+                    m.proto_name.endswith("." + suffix) or \
+                    m.name == suffix.replace(".", "_"):
+                return "message", m.name
+        for e in enums.values():
+            if e.proto_name == type_name or \
+                    e.proto_name.endswith("." + suffix) or \
+                    e.name == suffix.replace(".", "_"):
+                return "enum", None
+    raise KeyError(f"cannot resolve proto type {type_name!r}")
+
+
+def generate(paths: List[str]) -> str:
+    all_messages: Dict[str, MessageDef] = {}
+    all_enums: Dict[str, EnumDef] = {}
+    all_services: List[ServiceDef_] = []
+    for path in paths:
+        with open(path) as f:
+            p = Parser(tokenize(strip_comments(f.read())))
+        p.parse()
+        for m in p.messages:
+            all_messages[m.proto_name] = m
+        for e in p.enums:
+            all_enums[e.proto_name] = e
+        all_services.extend(p.services)
+
+    out: List[str] = []
+    out.append('"""GENERATED by tools/proto_gen.py — do not edit.\n')
+    out.append("Sources:")
+    for path in paths:
+        out.append(f"  {path}")
+    out.append('"""\n')
+    out.append("from linkerd_tpu.grpc import (  # noqa: F401")
+    out.append("    Enum, Field, MapField, ProtoMessage, Rpc, ServiceDef,")
+    out.append(")\n")
+
+    for e in all_enums.values():
+        out.append(f"class {e.name}(Enum):")
+        if not e.values:
+            out.append("    pass")
+        for vname, value in e.values:
+            out.append(f"    {vname} = {value}")
+        out.append("\n")
+
+    # classes first (empty), FIELDS after — handles forward/recursive refs
+    for m in all_messages.values():
+        out.append(f"class {m.name}(ProtoMessage):")
+        out.append(f'    """proto: {m.proto_name}"""\n')
+
+    for m in all_messages.values():
+        lines = [f"{m.name}.FIELDS = {{"]
+        for fd in m.fields:
+            kind, msg_cls = resolve(fd.type_name, all_messages, all_enums)
+            if fd.map_key is not None:
+                if kind == "message":
+                    lines.append(
+                        f'    "{fd.name}": MapField({fd.number}, '
+                        f'"{fd.map_key}", "message", '
+                        f'val_message={msg_cls}),')
+                else:
+                    vk = "enum" if kind == "enum" else kind
+                    lines.append(
+                        f'    "{fd.name}": MapField({fd.number}, '
+                        f'"{fd.map_key}", "{vk}"),')
+            elif kind == "message":
+                rep = ", repeated=True" if fd.repeated else ""
+                lines.append(
+                    f'    "{fd.name}": Field({fd.number}, "message", '
+                    f'message={msg_cls}{rep}),')
+            else:
+                k = "enum" if kind == "enum" else kind
+                rep = ", repeated=True" if fd.repeated else ""
+                lines.append(
+                    f'    "{fd.name}": Field({fd.number}, "{k}"{rep}),')
+        lines.append("}\n")
+        out.extend(lines)
+
+    for svc in all_services:
+        const = svc.name.upper() + "_SVC"
+        out.append(f'{const} = ServiceDef("{svc.proto_name}", [')
+        for rpc in svc.rpcs:
+            _, req_cls = resolve(rpc.request, all_messages, all_enums)
+            _, rsp_cls = resolve(rpc.response, all_messages, all_enums)
+            opts = ""
+            if rpc.client_streaming:
+                opts += ", client_streaming=True"
+            if rpc.server_streaming:
+                opts += ", server_streaming=True"
+            out.append(f'    Rpc("{rpc.name}", {req_cls}, {rsp_cls}{opts}),')
+        out.append("])\n")
+
+    return "\n".join(out)
+
+
+def main() -> int:
+    if len(sys.argv) < 3:
+        print(__doc__)
+        return 2
+    out_path, paths = sys.argv[1], sys.argv[2:]
+    code = generate(paths)
+    with open(out_path, "w") as f:
+        f.write(code)
+    print(f"generated {out_path} from {len(paths)} proto file(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, __import__("os").path.dirname(
+        __import__("os").path.dirname(__import__("os").path.abspath(
+            __file__))))
+    raise SystemExit(main())
