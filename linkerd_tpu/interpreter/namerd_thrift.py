@@ -199,11 +199,11 @@ class ThriftNamerInterpreter(NameInterpreter):
                     id_=id_path, addr=self._addr_var(id_path),
                     residual=path_from_wire(leaf.residual)))
             if kind == "alt":
-                return Alt(tuple(
+                return Alt(*(
                     conv(nodes[i]) for i in (node.alt or [])
                     if i in nodes))
             if kind == "weighted":
-                return TreeUnion(tuple(
+                return TreeUnion(*(
                     Weighted(w.weight, conv(nodes[w.id]))
                     for w in (node.weighted or []) if w.id in nodes))
             return Neg()

@@ -47,7 +47,7 @@ def mk_dentry(name: str, rule: RouteRule) -> List[Dentry]:
             f"{ISTIO_PFX}/{cluster}/{_label_segment(wd.tags)}")
         branches.append(Weighted(float(wd.weight), Leaf(dst_path)))
     if branches:
-        dst: NameTree = TreeUnion(tuple(branches))
+        dst: NameTree = TreeUnion(*branches)
     else:
         dst = Leaf(Path.read(f"{ISTIO_PFX}/{rule.destination}/::"))
     return [Dentry(Prefix.read(f"/svc/route/{name}"), dst)]

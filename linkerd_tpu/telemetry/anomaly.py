@@ -236,14 +236,34 @@ class InProcessScorer(Scorer):
         return step
 
     def _pad_rows(self, arr: np.ndarray) -> np.ndarray:
-        """Pad the batch dim to a multiple of the data-axis size (sharded
-        arrays must divide evenly over the mesh)."""
+        """Pad the batch dim up to the next power of two (and a multiple of
+        the data-axis size: sharded arrays must divide evenly over the
+        mesh). Bucketing batch shapes bounds the number of distinct XLA
+        compilations to ~log2(maxBatch) instead of one per batch size."""
+        n = len(arr)
+        target = 1 << max(0, (n - 1)).bit_length()
         m = self._batch_multiple
-        if m <= 1 or len(arr) % m == 0:
+        if m > 1 and target % m:
+            target += m - target % m
+        if target == n:
             return arr
-        pad = m - len(arr) % m
-        widths = ((0, pad),) + ((0, 0),) * (arr.ndim - 1)
+        widths = ((0, target - n),) + ((0, 0),) * (arr.ndim - 1)
         return np.pad(arr, widths)
+
+    async def warmup(self, rows: int = 4) -> None:
+        """Trigger compilation of the score and fit paths without letting
+        the dummy rows contaminate normalization stats or parameters."""
+        rows = max(rows, self._batch_multiple, 1)
+        x = np.zeros((rows, self.cfg.in_dim), np.float32)
+        params, opt_state = self.params, self._opt_state
+        mu, var, init = self._mu, self._var, self._norm_initialized
+        try:
+            await self.score(x)
+            await self.fit(x, np.zeros(rows, np.float32),
+                           np.zeros(rows, np.float32))
+        finally:
+            self.params, self._opt_state = params, opt_state
+            self._mu, self._var, self._norm_initialized = mu, var, init
 
     async def score(self, x: np.ndarray) -> np.ndarray:
         n = len(x)

@@ -62,13 +62,15 @@ def decode_fit(data: bytes) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
 class ScorerSidecar:
     """grpc.aio server wrapping an in-process Scorer."""
 
-    def __init__(self, scorer=None, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, scorer=None, host: str = "127.0.0.1", port: int = 0,
+                 warmup_rows: int = 0):
         if scorer is None:
             from linkerd_tpu.telemetry.anomaly import InProcessScorer
             scorer = InProcessScorer()
         self.scorer = scorer
         self.host = host
         self.port = port
+        self.warmup_rows = warmup_rows
         self._server = None
 
     async def start(self) -> "ScorerSidecar":
@@ -97,6 +99,12 @@ class ScorerSidecar:
         self._server = grpc.aio.server()
         self._server.add_generic_rpc_handlers((handler,))
         self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        # Warm up BEFORE serving so no real Fit/Score can race the warmup
+        # window (warmup restores pre-warmup scorer state when it finishes).
+        if self.warmup_rows:
+            warmup = getattr(scorer, "warmup", None)
+            if warmup is not None:
+                await warmup(self.warmup_rows)
         await self._server.start()
         return self
 
@@ -108,12 +116,29 @@ class ScorerSidecar:
 class GrpcScorerClient:
     """Scorer implementation that ships micro-batches to a sidecar."""
 
-    def __init__(self, address: str, timeout_s: float = 5.0):
+    def __init__(self, address: str, timeout_s: float = 5.0,
+                 first_timeout_s: float = 60.0):
+        # The first call on each RPC gets a long deadline to absorb the
+        # sidecar's XLA compile (~20-40s on TPU); afterwards the short
+        # steady-state deadline keeps failure detection responsive.
         self.address = address
         self.timeout_s = timeout_s
+        self.first_timeout_s = first_timeout_s
+        self._warm: set = set()
         self._channel = None
         self._score = None
         self._fit = None
+
+    @staticmethod
+    def _bucket(rpc: str, rows: int) -> tuple:
+        # The sidecar buckets batch sizes to powers of two, and each bucket
+        # is a distinct XLA compilation (~20-40s on TPU). Warm state is
+        # keyed by (rpc, bucket) so the first call into any bucket gets the
+        # long deadline while compiled buckets keep the short one.
+        return (rpc, 1 << max(0, rows - 1).bit_length())
+
+    def _deadline(self, key: tuple) -> float:
+        return self.timeout_s if key in self._warm else self.first_timeout_s
 
     def _ensure(self) -> None:
         if self._channel is None:
@@ -129,14 +154,19 @@ class GrpcScorerClient:
 
     async def score(self, x: np.ndarray) -> np.ndarray:
         self._ensure()
-        rsp = await self._score(encode_matrix(x), timeout=self.timeout_s)
+        key = self._bucket("score", len(x))
+        rsp = await self._score(encode_matrix(x),
+                                timeout=self._deadline(key))
+        self._warm.add(key)
         return np.frombuffer(rsp, np.float32)
 
     async def fit(self, x: np.ndarray, labels: np.ndarray,
                   mask: np.ndarray) -> float:
         self._ensure()
+        key = self._bucket("fit", len(x))
         rsp = await self._fit(encode_fit(x, labels, mask),
-                              timeout=self.timeout_s)
+                              timeout=self._deadline(key))
+        self._warm.add(key)
         return float(np.frombuffer(rsp, np.float32)[0])
 
     async def aclose(self) -> None:

@@ -132,8 +132,7 @@ class TestMixerClient:
                     yield pb.ReportResponse(request_index=r.request_index)
             return gen()
 
-        disp.register(pb.MIXER_SVC, "Report", report,
-                      client_streaming=True, server_streaming=True)
+        disp.register(pb.MIXER_SVC, "Report", report)
 
         async def go():
             server = await H2Server(disp).start()

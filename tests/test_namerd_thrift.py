@@ -29,6 +29,37 @@ def run(coro):
     return asyncio.run(asyncio.wait_for(coro, 30))
 
 
+class TestTreeFromWire:
+    def test_weighted_and_alt_wire_trees_convert_and_map(self):
+        """Regression: weighted/alt wire nodes must splat into Union/Alt
+        varargs — a single-tuple arg crashes NameTree.map downstream."""
+        import collections
+        interp = ThriftNamerInterpreter.__new__(ThriftNamerInterpreter)
+        interp._addrs = collections.OrderedDict()
+        interp._tasks = {}
+        interp.max_addr_watches = 16
+        interp._closed = True  # suppress addr watch loops in unit scope
+        leaf = idl.BoundNode(leaf=idl.TBoundName(
+            id=[b"#", b"io.l5d.fs", b"web"], residual=[]))
+        wire = idl.BoundTree(
+            root=idl.BoundNode(alt=[0, 1]),
+            nodes={
+                0: idl.BoundNode(weighted=[
+                    idl.WeightedNodeId(weight=0.75, id=2),
+                    idl.WeightedNodeId(weight=0.25, id=3),
+                ]),
+                1: idl.BoundNode(neg=idl.TVoid()),
+                2: leaf,
+                3: leaf,
+            })
+        tree = interp._tree_from_wire(wire)
+        mapped = tree.map(lambda b: b)  # must not raise
+        union = mapped.trees[0]
+        assert [w.weight for w in union.weighted] == [0.75, 0.25]
+        for w in union.weighted:
+            assert w.tree.value.id_.show == "/#/io.l5d.fs/web"
+
+
 class TestBinaryProtocol:
     def test_struct_roundtrip(self):
         ref = idl.NameRef(stamp=b"\x00\x01", name=[b"svc", b"web"],
