@@ -134,6 +134,7 @@ def main() -> None:
         g = grpc_bench()
         detail["grpc_req_s"] = g.get("grpc_req_s")
         detail["grpc_p99_ms"] = (g.get("grpc_lat") or {}).get("p99_ms")
+        detail["grpc_saturation_req_s"] = g.get("grpc_saturation_req_s")
         if "error" in g:
             detail["grpc_error"] = g["error"]
     except Exception as e:  # noqa: BLE001

@@ -108,6 +108,26 @@ async def bench(duration: float, rate: float) -> dict:
         out["grpc_req_s"] = round(len(latencies) / dt, 1)
         out["grpc_lat"] = lat_stats(latencies)
         out["target_rate_rps"] = rate
+
+        # Saturation: closed-loop, fixed concurrency, no pacing — reports
+        # what the stack can actually sustain on this host.
+        sat_n = 0
+        sat_deadline = time.perf_counter() + min(4.0, duration / 2)
+
+        async def sat_worker():
+            nonlocal sat_n
+            while time.perf_counter() < sat_deadline:
+                await client.unary(SVC, "Echo", msg)
+                sat_n += 1
+
+        t1 = time.perf_counter()
+        try:
+            await asyncio.gather(*[sat_worker() for _ in range(32)])
+            out["grpc_saturation_req_s"] = round(
+                sat_n / (time.perf_counter() - t1), 1)
+        except Exception as e:  # noqa: BLE001 — keep the paced numbers
+            out["grpc_saturation_error"] = repr(e)
+
         # prometheus telemeter must expose the router's stats
         text = prometheus_text(linker.metrics)
         out["prometheus_ok"] = ("h2bench" in text)

@@ -183,23 +183,29 @@ class ClientDispatcher:
         enc = EncodingStream(req_stream, Codec(rpc.req_cls))
         req = self._mk_request(svc_def.path_of(rpc_name), req_stream)
 
-        async def pump_reqs() -> None:
-            try:
-                if isinstance(req_msgs, list):
-                    for m in req_msgs:
-                        enc.send(m)
-                else:
+        if isinstance(req_msgs, list):
+            # Unary/known request set: encode synchronously so the h2
+            # engine sees a fully-buffered body (const-body fast path — no
+            # pump task, headers+data+eos coalesce into one write).
+            for m in req_msgs:
+                enc.send(m)
+            enc.close_eos()
+            pump = None
+        else:
+            async def pump_reqs() -> None:
+                try:
                     async for m in req_msgs:
                         enc.send(m)
-                enc.close_eos()
-            except Exception:  # noqa: BLE001 - reset request side
-                req_stream.reset()
+                    enc.close_eos()
+                except Exception:  # noqa: BLE001 - reset request side
+                    req_stream.reset()
 
-        pump = asyncio.ensure_future(pump_reqs())
+            pump = asyncio.ensure_future(pump_reqs())
         try:
             rsp = await self._svc(req)
         except Exception:
-            pump.cancel()
+            if pump is not None:
+                pump.cancel()
             raise
         reps = DecodingStream(rsp.stream, Codec(rpc.rep_cls))
         # Trailers-Only responses (single HEADERS + END_STREAM carrying

@@ -37,12 +37,21 @@ log = logging.getLogger(__name__)
 LOCAL_INITIAL_WINDOW = 1 << 20
 LOCAL_CONN_WINDOW = 4 << 20
 MAX_HEADER_LIST = 64 * 1024
+# Deferred-credit thresholds: WINDOW_UPDATEs are batched until this much
+# credit is pending, collapsing the per-DATA-frame update chatter (2 tiny
+# frames per received chunk) into one update per ~half window.
+CONN_CREDIT_THRESHOLD = LOCAL_CONN_WINDOW // 4
+STREAM_CREDIT_THRESHOLD = LOCAL_INITIAL_WINDOW // 2
+# Transport write buffer size above which senders yield to drain().
+WRITE_HIGH_WATER = 256 * 1024
+READ_CHUNK = 1 << 18
 
 
 class _StreamState:
     __slots__ = ("id", "recv_stream", "send_window", "recv_window",
                  "send_closed", "recv_closed", "got_headers",
-                 "response_fut", "pump_task", "reset_sent")
+                 "response_fut", "pump_task", "reset_sent",
+                 "pending_credit")
 
     def __init__(self, sid: int, send_window: int, recv_window: int):
         self.id = sid
@@ -55,6 +64,7 @@ class _StreamState:
         self.response_fut: Optional[asyncio.Future] = None
         self.pump_task: Optional[asyncio.Task] = None
         self.reset_sent = False
+        self.pending_credit = 0       # released but not yet WINDOW_UPDATEd
 
 
 class H2Connection:
@@ -84,28 +94,61 @@ class H2Connection:
         self._handler_tasks: set = set()
         # contiguous header-block assembly state
         self._hdr_accum: Optional[Tuple[int, int, bytearray]] = None
+        # write coalescing: frames written within one event-loop iteration
+        # are batched into a single transport write (one send() syscall)
+        self._wbuf = bytearray()
+        self._flush_scheduled = False
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._pending_conn_credit = 0
+
+    # ── coalesced writes ─────────────────────────────────────────────────
+    def _write(self, data: bytes) -> None:
+        self._wbuf += data
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            self._loop.call_soon(self._do_flush)
+
+    def _do_flush(self) -> None:
+        self._flush_scheduled = False
+        if self._wbuf:
+            data, self._wbuf = self._wbuf, bytearray()
+            try:
+                self._writer.write(data)
+            except Exception:  # noqa: BLE001 — transport torn down
+                pass
+
+    async def _drain(self) -> None:
+        """Flush now; apply backpressure only when the transport buffer is
+        actually backed up (plain drain() is an unconditional await)."""
+        self._do_flush()
+        try:
+            if (self._writer.transport.get_write_buffer_size()
+                    > WRITE_HIGH_WATER):
+                await self._writer.drain()
+        except Exception:  # noqa: BLE001
+            pass
 
     # ── lifecycle ────────────────────────────────────────────────────────
     async def start(self) -> "H2Connection":
+        self._loop = asyncio.get_running_loop()
         settings = [
             (frames.SETTINGS_INITIAL_WINDOW_SIZE, LOCAL_INITIAL_WINDOW),
             (frames.SETTINGS_MAX_FRAME_SIZE, DEFAULT_MAX_FRAME_SIZE),
             (frames.SETTINGS_MAX_HEADER_LIST_SIZE, MAX_HEADER_LIST),
         ]
         if self.is_client:
-            self._writer.write(CONNECTION_PREFACE)
+            self._write(CONNECTION_PREFACE)
             settings.append((frames.SETTINGS_ENABLE_PUSH, 0))
         else:
             preface = await self._reader.readexactly(len(CONNECTION_PREFACE))
             if preface != CONNECTION_PREFACE:
                 raise H2ProtocolError(frames.PROTOCOL_ERROR, "bad preface")
-        self._writer.write(frames.pack_settings(settings))
-        self._writer.write(frames.pack_window_update(
+        self._write(frames.pack_settings(settings))
+        self._write(frames.pack_window_update(
             0, LOCAL_CONN_WINDOW - DEFAULT_INITIAL_WINDOW))
         self._recv_window = LOCAL_CONN_WINDOW
-        await self._writer.drain()
-        self._read_task = asyncio.get_running_loop().create_task(
-            self._read_loop())
+        await self._drain()
+        self._read_task = self._loop.create_task(self._read_loop())
         return self
 
     @property
@@ -121,9 +164,9 @@ class H2Connection:
         self._closed = True
         if first:
             try:
-                self._writer.write(
-                    frames.pack_goaway(self._last_peer_stream, code))
-                await self._writer.drain()
+                self._wbuf += frames.pack_goaway(self._last_peer_stream, code)
+                self._do_flush()
+                await self._drain()
             except Exception:  # noqa: BLE001
                 pass
         if self._read_task is not None and not self._read_task.done():
@@ -186,10 +229,10 @@ class H2Connection:
                 if trailers is not None:
                     self._send_headers(sid, trailers, end_stream=True)
             st.send_closed = True
-            await self._writer.drain()
+            await self._drain()
         else:
             self._send_headers(sid, req.to_header_list(), end_stream=False)
-            await self._writer.drain()
+            await self._drain()
             st.pump_task = asyncio.get_running_loop().create_task(
                 self._pump_out(st, req.stream))
         try:
@@ -213,17 +256,17 @@ class H2Connection:
             frames.FLAG_END_STREAM if end_stream else 0)
         max_frag = self._peer_max_frame
         if len(block) <= max_frag:
-            self._writer.write(frames.pack_frame(
+            self._write(frames.pack_frame(
                 frames.HEADERS, flags, sid, block))
         else:
             first, rest = block[:max_frag], block[max_frag:]
-            self._writer.write(frames.pack_frame(
+            self._write(frames.pack_frame(
                 frames.HEADERS,
                 flags & ~frames.FLAG_END_HEADERS, sid, first))
             while rest:
                 frag, rest = rest[:max_frag], rest[max_frag:]
                 cflags = frames.FLAG_END_HEADERS if not rest else 0
-                self._writer.write(frames.pack_frame(
+                self._write(frames.pack_frame(
                     frames.CONTINUATION, cflags, sid, frag))
 
     async def _pump_out(self, st: _StreamState, stream: H2Stream) -> None:
@@ -234,7 +277,7 @@ class H2Connection:
                 if isinstance(frame, Trailers):
                     self._send_headers(st.id, frame.headers, end_stream=True)
                     st.send_closed = True
-                    await self._writer.drain()
+                    await self._drain()
                     break
                 await self._send_data(st, frame.data, frame.eos)
                 frame.release()
@@ -266,9 +309,9 @@ class H2Connection:
             # (peer shrank SETTINGS_INITIAL_WINDOW_SIZE, RFC 7540 §6.9.2)
             if st.reset_sent or st.id not in self._streams:
                 raise StreamReset(frames.STREAM_CLOSED, "stream reset")
-            self._writer.write(frames.pack_frame(
+            self._write(frames.pack_frame(
                 frames.DATA, frames.FLAG_END_STREAM, st.id, b""))
-            await self._writer.drain()
+            await self._drain()
             return
         view = memoryview(data)
         offset = 0
@@ -288,11 +331,11 @@ class H2Connection:
             last = offset >= len(data)
             self._send_window -= n
             st.send_window -= n
-            self._writer.write(frames.pack_frame(
+            self._write(frames.pack_frame(
                 frames.DATA,
                 frames.FLAG_END_STREAM if (eos and last) else 0,
                 st.id, chunk))
-            await self._writer.drain()
+            await self._drain()
             if last:
                 break
 
@@ -300,7 +343,7 @@ class H2Connection:
         st.reset_sent = True
         if not self._closed:
             try:
-                self._writer.write(frames.pack_rst(st.id, code))
+                self._write(frames.pack_rst(st.id, code))
             except Exception:  # noqa: BLE001
                 pass
         st.recv_stream.reset(code)
@@ -310,22 +353,56 @@ class H2Connection:
         async with self._window_cond:
             self._window_cond.notify_all()
 
+    def _conn_credit(self, n: int) -> None:
+        """Batch connection-level WINDOW_UPDATEs until a threshold of
+        credit is pending (the stream-update twin lives in _on_data)."""
+        self._recv_window += n
+        self._pending_conn_credit += n
+        if self._pending_conn_credit >= CONN_CREDIT_THRESHOLD:
+            self._write(frames.pack_window_update(
+                0, self._pending_conn_credit))
+            self._pending_conn_credit = 0
+
     # ── internals: receiving ─────────────────────────────────────────────
     async def _read_loop(self) -> None:
+        # Batched frame parsing: read whatever the transport has (many
+        # frames arrive per wakeup under load) and walk complete frames in
+        # the buffer — two readexactly() awaits per frame becomes one
+        # read() per TCP burst.
+        read = self._reader.read
+        buf = bytearray()
+        FrameHeader = frames.FrameHeader
+        CONTINUATION = frames.CONTINUATION
         try:
             while not self._closed:
-                hdr = await self._reader.readexactly(9)
-                fh = frames.unpack_header(hdr)
-                if fh.length > DEFAULT_MAX_FRAME_SIZE + 1024:
-                    raise H2ProtocolError(frames.FRAME_SIZE_ERROR,
-                                          f"frame too large: {fh.length}")
-                payload = (await self._reader.readexactly(fh.length)
-                           if fh.length else b"")
-                # CONTINUATION contiguity (RFC 7540 §6.2)
-                if self._hdr_accum is not None and fh.type != frames.CONTINUATION:
-                    raise H2ProtocolError(frames.PROTOCOL_ERROR,
-                                          "expected CONTINUATION")
-                await self._dispatch(fh, payload)
+                chunk = await read(READ_CHUNK)
+                if not chunk:
+                    raise EOFError("connection closed by peer")
+                buf += chunk
+                pos = 0
+                n = len(buf)
+                while n - pos >= 9:
+                    length = (buf[pos] << 16) | (buf[pos + 1] << 8) | buf[pos + 2]
+                    if length > DEFAULT_MAX_FRAME_SIZE + 1024:
+                        raise H2ProtocolError(frames.FRAME_SIZE_ERROR,
+                                              f"frame too large: {length}")
+                    end = pos + 9 + length
+                    if n < end:
+                        break
+                    ftype = buf[pos + 3]
+                    fh = FrameHeader(
+                        length, ftype, buf[pos + 4],
+                        ((buf[pos + 5] << 24) | (buf[pos + 6] << 16)
+                         | (buf[pos + 7] << 8) | buf[pos + 8]) & 0x7FFFFFFF)
+                    payload = bytes(buf[pos + 9:end]) if length else b""
+                    pos = end
+                    # CONTINUATION contiguity (RFC 7540 §6.2)
+                    if self._hdr_accum is not None and ftype != CONTINUATION:
+                        raise H2ProtocolError(frames.PROTOCOL_ERROR,
+                                              "expected CONTINUATION")
+                    await self._dispatch(fh, payload)
+                if pos:
+                    del buf[:pos]
         except (asyncio.IncompleteReadError, ConnectionResetError,
                 BrokenPipeError, EOFError):
             self._closed = True
@@ -340,9 +417,9 @@ class H2Connection:
             log.warning("h2 protocol error: %s", e)
             self._closed = True
             try:
-                self._writer.write(frames.pack_goaway(
+                self._write(frames.pack_goaway(
                     self._last_peer_stream, e.code))
-                await self._writer.drain()
+                await self._drain()
                 self._writer.close()
             except Exception:  # noqa: BLE001
                 pass
@@ -384,7 +461,7 @@ class H2Connection:
                 self._settings_acked.set()
                 return
             self._apply_settings(frames.unpack_settings(payload))
-            self._writer.write(frames.pack_settings([], ack=True))
+            self._write(frames.pack_settings([], ack=True))
         elif t == frames.WINDOW_UPDATE:
             if len(payload) != 4:
                 raise H2ProtocolError(frames.FRAME_SIZE_ERROR, "bad WU size")
@@ -412,7 +489,7 @@ class H2Connection:
                 await self._notify_windows()
         elif t == frames.PING:
             if not fh.flags & frames.FLAG_ACK:
-                self._writer.write(frames.pack_ping(payload[:8], ack=True))
+                self._write(frames.pack_ping(payload[:8], ack=True))
         elif t == frames.GOAWAY:
             self.goaway_received = True
             last_sid = int.from_bytes(payload[:4], "big") & 0x7FFFFFFF
@@ -440,8 +517,7 @@ class H2Connection:
             # stream gone (e.g. reset); return the connection credit we
             # just consumed (local accounting AND the peer's view)
             if flow:
-                self._recv_window += flow
-                self._writer.write(frames.pack_window_update(0, flow))
+                self._conn_credit(flow)
             return
         st.recv_window -= flow
         if st.recv_window < 0 or self._recv_window < 0:
@@ -450,16 +526,20 @@ class H2Connection:
         sid = st.id
 
         def credit(n: int, _sid: int = sid) -> None:
-            # called from app-land release(); returns window to the peer
+            # called from app-land release(); returns window to the peer.
+            # Credit is batched (thresholded) rather than sent per frame.
             if self._closed:
                 return
-            self._recv_window += n
             try:
-                self._writer.write(frames.pack_window_update(0, n))
+                self._conn_credit(n)
                 stt = self._streams.get(_sid)
                 if stt is not None and not stt.recv_closed:
                     stt.recv_window += n
-                    self._writer.write(frames.pack_window_update(_sid, n))
+                    stt.pending_credit += n
+                    if stt.pending_credit >= STREAM_CREDIT_THRESHOLD:
+                        self._write(frames.pack_window_update(
+                            _sid, stt.pending_credit))
+                        stt.pending_credit = 0
             except Exception:  # noqa: BLE001
                 pass
 
@@ -533,7 +613,7 @@ class H2Connection:
                                    end_stream=True)
                 st.send_closed = True
                 try:
-                    await self._writer.drain()
+                    await self._drain()
                 except Exception:  # noqa: BLE001
                     pass
                 self._maybe_gc(st)
@@ -559,12 +639,12 @@ class H2Connection:
                         await self._send_data(st, data, eos=False)
                     self._send_headers(st.id, trailers, end_stream=True)
                 st.send_closed = True
-                await self._writer.drain()
+                await self._drain()
                 self._maybe_gc(st)
             else:
                 self._send_headers(st.id, rsp.to_header_list(),
                                    end_stream=False)
-                await self._writer.drain()
+                await self._drain()
                 await self._pump_out(st, rsp.stream)
         except (ConnectionError, asyncio.CancelledError):
             pass
@@ -599,7 +679,7 @@ def _poll_const_body(stream: H2Stream):
     else None (must pump live). Lets unary messages skip the pump task."""
     try:
         q = stream._q  # noqa: SLF001 — engine-internal fast path
-        items = list(q._queue)  # type: ignore[attr-defined]
+        items = list(q)
     except Exception:  # noqa: BLE001
         return None
     if not items or not getattr(items[-1], "eos", False):
@@ -616,8 +696,8 @@ def _poll_const_body(stream: H2Stream):
     # drain the queue so at_end bookkeeping stays consistent, returning
     # each frame's flow credit (frames may originate from another h2
     # connection when a handler forwards a received stream)
-    while not q.empty():
-        item = q.get_nowait()
+    while q:
+        item = q.popleft()
         if isinstance(item, DataFrame):
             item.release()
     stream.at_end = True

@@ -240,8 +240,9 @@ class InProcessScorer(Scorer):
         the data-axis size: sharded arrays must divide evenly over the
         mesh). Bucketing batch shapes bounds the number of distinct XLA
         compilations to ~log2(maxBatch) instead of one per batch size."""
+        from linkerd_tpu.telemetry.sidecar import bucket_rows
         n = len(arr)
-        target = 1 << max(0, (n - 1)).bit_length()
+        target = bucket_rows(n)
         m = self._batch_multiple
         if m > 1 and target % m:
             target += m - target % m

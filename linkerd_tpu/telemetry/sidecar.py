@@ -29,6 +29,14 @@ log = logging.getLogger(__name__)
 SERVICE = "io.l5d.anomaly.Scorer"
 
 
+def bucket_rows(n: int) -> int:
+    """Power-of-two batch bucket. The single source of truth shared by the
+    scorer's padding (InProcessScorer._pad_rows) and the client's
+    warm-deadline keying — they must agree on what constitutes one XLA
+    compilation."""
+    return 1 << max(0, n - 1).bit_length()
+
+
 def encode_matrix(x: np.ndarray) -> bytes:
     x = np.ascontiguousarray(x, dtype=np.float32)
     n, d = x.shape
@@ -131,11 +139,11 @@ class GrpcScorerClient:
 
     @staticmethod
     def _bucket(rpc: str, rows: int) -> tuple:
-        # The sidecar buckets batch sizes to powers of two, and each bucket
-        # is a distinct XLA compilation (~20-40s on TPU). Warm state is
-        # keyed by (rpc, bucket) so the first call into any bucket gets the
-        # long deadline while compiled buckets keep the short one.
-        return (rpc, 1 << max(0, rows - 1).bit_length())
+        # Each power-of-two bucket is a distinct XLA compilation (~20-40s
+        # on TPU). Warm state is keyed by (rpc, bucket) so the first call
+        # into any bucket gets the long deadline while compiled buckets
+        # keep the short one.
+        return (rpc, bucket_rows(rows))
 
     def _deadline(self, key: tuple) -> float:
         return self.timeout_s if key in self._warm else self.first_timeout_s

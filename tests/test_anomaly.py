@@ -195,7 +195,9 @@ class TestGrpcSidecar:
         assert (x2 == x).all() and (l2 == labels).all() and (m2 == mask).all()
 
         async def go():
-            sidecar = await ScorerSidecar().start()
+            sidecar = await ScorerSidecar(warmup_rows=4).start()
+            # warmup must pre-compile without contaminating scorer state
+            assert sidecar.scorer._norm_initialized is False
             client = GrpcScorerClient(f"127.0.0.1:{sidecar.port}")
             try:
                 scores = await client.score(x)
