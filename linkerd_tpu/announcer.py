@@ -87,6 +87,107 @@ class FsAnnouncerConfig:
         return FsAnnouncer(self.rootDir, Path.read(self.prefix))
 
 
+class ZkAnnouncer(Announcer):
+    """Announce into a ZK serverset: an ephemeral-sequential ``member_``
+    node carrying serviceEndpoint JSON under ``{pathPrefix}{name}``
+    (kind ``io.l5d.serversets``; ref: linkerd/announcer/serversets/...
+    /ZkAnnouncer.scala:19 — ephemerality is the withdrawal mechanism, so
+    a crashed linkerd's announcement dies with its session)."""
+
+    def __init__(self, hosts: str, path_prefix: Path, prefix: Path,
+                 session_timeout_ms: int = 10000):
+        from linkerd_tpu.namer.zk import shared_zk
+
+        self.zk = shared_zk(hosts, session_timeout_ms)
+        self.path_prefix = path_prefix
+        self.prefix = prefix
+
+    def announce(self, host: str, port: int, name: Path) -> Closable:
+        import asyncio
+        import json
+        import logging
+
+        from linkerd_tpu.zk.client import ZkError, ZK_NONODE, zk_backoff
+
+        log = logging.getLogger(__name__)
+        zk_path = "/" + "/".join(self.path_prefix + name)
+        data = json.dumps({
+            "serviceEndpoint": {"host": host, "port": port},
+            "additionalEndpoints": {},
+            "status": "ALIVE",
+        }).encode("utf-8")
+        state = {"node": None}
+
+        async def maintain() -> None:
+            # Supervising loop: (re)create the ephemeral member and
+            # re-announce whenever it disappears (session expiry deletes
+            # ephemerals server-side; the watch — or the synthetic
+            # Disconnected event on session loss — wakes us to rejoin).
+            attempt = 0
+            try:
+                while True:
+                    try:
+                        if state["node"] is None:
+                            await self.zk.ensure_path(zk_path)
+                            state["node"] = await self.zk.create(
+                                f"{zk_path}/member_", data,
+                                ephemeral=True, sequential=True)
+                            log.info("announced %s at %s:%d",
+                                     state["node"], host, port)
+                        gone = asyncio.Event()
+                        stat = await self.zk.exists(
+                            state["node"], watch=lambda ev: gone.set())
+                        if stat is None:
+                            state["node"] = None
+                            continue
+                        attempt = 0
+                        await gone.wait()
+                        # re-check on the next iteration (exists) —
+                        # a data-change event is not a disappearance
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:  # noqa: BLE001 — keep trying
+                        log.debug("zk announce %s: %r", zk_path, e)
+                        attempt = await zk_backoff(attempt)
+            finally:
+                # withdraw: delete whatever we know we created. If a
+                # create was in flight when cancelled, the node is
+                # ephemeral and dies with the session.
+                node = state["node"]
+                if node is not None:
+                    try:
+                        await self.zk.delete(node)
+                    except ZkError as e:
+                        if e.code != ZK_NONODE:
+                            log.debug("zk withdraw %s: %r", node, e)
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        task = asyncio.get_event_loop().create_task(maintain())
+
+        def withdraw() -> None:
+            task.cancel()
+
+        return Closable(withdraw)
+
+
+@register("announcer", "io.l5d.serversets")
+@dataclass
+class ZkAnnouncerConfig:
+    zkAddrs: list = None  # type: ignore[assignment]
+    hosts: str = ""
+    pathPrefix: str = "/discovery"
+    prefix: str = "/io.l5d.serversets"
+    sessionTimeoutMs: int = 10000
+
+    def mk(self) -> Announcer:
+        from linkerd_tpu.namer.zk import parse_zk_addrs
+
+        connect = parse_zk_addrs(self.zkAddrs or [], self.hosts)
+        return ZkAnnouncer(connect, Path.read(self.pathPrefix),
+                           Path.read(self.prefix), self.sessionTimeoutMs)
+
+
 def match_announcer(announcers: List[Tuple[Path, Announcer]],
                     announce_path: Path) -> Tuple[Announcer, Path]:
     """``/#/io.l5d.fs/web`` -> (announcer, /web)

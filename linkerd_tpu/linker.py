@@ -61,6 +61,7 @@ import linkerd_tpu.k8s.namer  # noqa: F401
 import linkerd_tpu.announcer  # noqa: F401
 import linkerd_tpu.namer.fs  # noqa: F401
 import linkerd_tpu.namer.marathon  # noqa: F401
+import linkerd_tpu.namer.zk  # noqa: F401
 import linkerd_tpu.namer.transformers  # noqa: F401
 import linkerd_tpu.protocol.h2.classifiers  # noqa: F401
 import linkerd_tpu.protocol.h2.identifiers  # noqa: F401

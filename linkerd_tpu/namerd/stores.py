@@ -415,3 +415,181 @@ class ConsulStoreConfig:
     def mk(self) -> DtabStore:
         return ConsulDtabStore(self.host, self.port, self.pathPrefix,
                                token=self.token)
+
+
+class ZkDtabStore(DtabStore):
+    """Dtabs as znodes ``{pathPrefix}/{ns}`` with the znode version as the
+    CAS token (ref: namerd/storage/zk/.../ZkDtabStore.scala:166 + the
+    forked ZkSession.scala:200 watch machinery — here ZooKeeper's native
+    watches drive the Activities directly, no polling)."""
+
+    def __init__(self, hosts: str, path_prefix: str = "/dtabs",
+                 session_timeout_ms: int = 10000):
+        from linkerd_tpu.namer.zk import shared_zk
+
+        self.prefix = "/" + path_prefix.strip("/")
+        self.zk = shared_zk(hosts, session_timeout_ms)
+        self._acts: Dict[str, Activity] = {}
+        self._list: Var[FrozenSet[str]] = Var(frozenset())
+        self._list_task: Optional[asyncio.Task] = None
+        self._ns_tasks: Dict[str, asyncio.Task] = {}
+
+    def _node(self, ns: str) -> str:
+        return f"{self.prefix}/{ns}"
+
+    @staticmethod
+    def _version_bytes(version: int) -> bytes:
+        return version.to_bytes(4, "big", signed=True)
+
+    @staticmethod
+    def _version_int(version: bytes) -> int:
+        if len(version) != 4:
+            raise DtabVersionMismatch("bad version stamp")
+        return int.from_bytes(version, "big", signed=True)
+
+    # ── watches ──────────────────────────────────────────────────────────
+    async def _watch_list(self) -> None:
+        from linkerd_tpu.zk.client import ZK_NONODE, ZkError, zk_backoff
+        attempt = 0
+        while True:
+            event = asyncio.Event()
+            try:
+                kids = await self.zk.get_children(
+                    self.prefix, watch=lambda ev: event.set())
+                self._list.update(frozenset(kids))
+                attempt = 0
+            except ZkError as e:
+                if e.code == ZK_NONODE:
+                    self._list.update(frozenset())
+                    # arm a creation watch; if the node appeared between
+                    # the failed read and this exists(), re-read NOW (the
+                    # armed data watch would never fire for child churn)
+                    try:
+                        stat = await self.zk.exists(
+                            self.prefix, watch=lambda ev: event.set())
+                        if stat is not None:
+                            continue
+                    except Exception:  # noqa: BLE001
+                        pass
+                else:
+                    attempt = await zk_backoff(attempt)
+                    continue
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001
+                attempt = await zk_backoff(attempt)
+                continue
+            await event.wait()
+
+    async def _watch_ns(self, ns: str, act: Activity) -> None:
+        from linkerd_tpu.zk.client import ZK_NONODE, ZkError, zk_backoff
+        path = self._node(ns)
+        attempt = 0
+        while True:
+            event = asyncio.Event()
+            try:
+                data, stat = await self.zk.get_data(
+                    path, watch=lambda ev: event.set())
+                dtab = Dtab.read(data.decode("utf-8")) if data else Dtab.empty
+                act.update(Ok(VersionedDtab(
+                    dtab, self._version_bytes(stat.version))))
+                attempt = 0
+            except ZkError as e:
+                if e.code == ZK_NONODE:
+                    act.update(Ok(None))
+                    try:
+                        stat = await self.zk.exists(
+                            path, watch=lambda ev: event.set())
+                        if stat is not None:
+                            continue  # created meanwhile: re-read now
+                    except Exception:  # noqa: BLE001
+                        pass
+                else:
+                    attempt = await zk_backoff(attempt)
+                    continue
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                if not isinstance(act.current, Ok):
+                    act.set_exception(e)
+                attempt = await zk_backoff(attempt)
+                continue
+            await event.wait()
+
+    # ── DtabStore ────────────────────────────────────────────────────────
+    def list(self) -> Var[FrozenSet[str]]:
+        if self._list_task is None or self._list_task.done():
+            self._list_task = asyncio.get_event_loop().create_task(
+                self._watch_list())
+        return self._list
+
+    def observe(self, ns: str) -> Activity[Optional[VersionedDtab]]:
+        act = self._acts.get(ns)
+        if act is None:
+            act = Activity.mutable()
+            self._acts[ns] = act
+            self._ns_tasks[ns] = asyncio.get_event_loop().create_task(
+                self._watch_ns(ns, act))
+        return act
+
+    async def create(self, ns: str, dtab: Dtab) -> None:
+        from linkerd_tpu.zk.client import ZK_NODEEXISTS, ZkError
+        await self.zk.ensure_path(self.prefix)
+        try:
+            await self.zk.create(self._node(ns), dtab.show.encode("utf-8"))
+        except ZkError as e:
+            if e.code == ZK_NODEEXISTS:
+                raise DtabNamespaceAlreadyExists(ns) from e
+            raise
+
+    async def update(self, ns: str, dtab: Dtab, version: bytes) -> None:
+        from linkerd_tpu.zk.client import ZK_BADVERSION, ZK_NONODE, ZkError
+        try:
+            await self.zk.set_data(self._node(ns),
+                                   dtab.show.encode("utf-8"),
+                                   version=self._version_int(version))
+        except ZkError as e:
+            if e.code == ZK_BADVERSION:
+                raise DtabVersionMismatch(ns) from e
+            if e.code == ZK_NONODE:
+                raise DtabNamespaceDoesNotExist(ns) from e
+            raise
+
+    async def put(self, ns: str, dtab: Dtab) -> None:
+        from linkerd_tpu.zk.client import ZK_NONODE, ZkError
+        try:
+            await self.zk.set_data(self._node(ns),
+                                   dtab.show.encode("utf-8"), version=-1)
+        except ZkError as e:
+            if e.code != ZK_NONODE:
+                raise
+            await self.create(ns, dtab)
+
+    async def delete(self, ns: str) -> None:
+        from linkerd_tpu.zk.client import ZK_NONODE, ZkError
+        try:
+            await self.zk.delete(self._node(ns))
+        except ZkError as e:
+            if e.code == ZK_NONODE:
+                raise DtabNamespaceDoesNotExist(ns) from e
+            raise
+
+    def close(self) -> None:
+        if self._list_task is not None:
+            self._list_task.cancel()
+        for t in self._ns_tasks.values():
+            t.cancel()
+
+
+@register("dtabStore", "io.l5d.zk")
+@dataclass
+class ZkStoreConfig:
+    zkAddrs: Optional[list] = None
+    hosts: str = ""
+    pathPrefix: str = "/dtabs"
+    sessionTimeoutMs: int = 10000
+
+    def mk(self) -> DtabStore:
+        from linkerd_tpu.namer.zk import parse_zk_addrs
+        connect = parse_zk_addrs(self.zkAddrs or [], self.hosts)
+        return ZkDtabStore(connect, self.pathPrefix, self.sessionTimeoutMs)

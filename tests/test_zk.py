@@ -1,0 +1,376 @@
+"""ZooKeeper family against a wire-level fake ZK server.
+
+The reference's test technique (scripted fake SD backends, SURVEY.md §4
+pattern 2) applied to ZK: FakeZkServer speaks the jute protocol so the
+real asyncio ZkClient, the three namers, the dtab store, and the
+announcer are all exercised over real sockets.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from linkerd_tpu.core import Dtab, Path
+from linkerd_tpu.core.activity import Ok
+from linkerd_tpu.core.addr import Bound
+from linkerd_tpu.core.nametree import Leaf, Neg
+from linkerd_tpu.namer.zk import (
+    CuratorNamer, ServersetNamer, ZkLeaderNamer, shared_zk,
+)
+from linkerd_tpu.namerd.store import (
+    DtabNamespaceDoesNotExist, DtabVersionMismatch, VersionedDtab,
+)
+from linkerd_tpu.namerd.stores import ZkDtabStore
+from linkerd_tpu.testing.zkserver import FakeZkServer
+from linkerd_tpu.zk.client import ZkClient, ZkError, ZK_BADVERSION, ZK_NONODE
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+def member_json(host, port, status="ALIVE", extra_eps=None):
+    return json.dumps({
+        "serviceEndpoint": {"host": host, "port": port},
+        "additionalEndpoints": extra_eps or {},
+        "status": status,
+    }).encode()
+
+
+async def wait_for(fn, timeout=5.0, interval=0.02):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        v = fn()
+        if v:
+            return v
+        if asyncio.get_event_loop().time() > deadline:
+            raise AssertionError("condition not met in time")
+        await asyncio.sleep(interval)
+
+
+def hosts_of(addr) -> set:
+    assert isinstance(addr, Bound), addr
+    return {(a.host, a.port) for a in addr.addresses}
+
+
+class TestZkClient:
+    def test_crud_versions_and_watches(self):
+        async def go():
+            server = await FakeZkServer().start()
+            zk = ZkClient(server.hosts).start()
+            try:
+                await zk.ensure_path("/a/b")
+                path = await zk.create("/a/b/n1", b"v0")
+                assert path == "/a/b/n1"
+                data, stat = await zk.get_data("/a/b/n1")
+                assert data == b"v0" and stat.version == 0
+
+                # CAS on znode version
+                await zk.set_data("/a/b/n1", b"v1", version=0)
+                with pytest.raises(ZkError) as ei:
+                    await zk.set_data("/a/b/n1", b"v2", version=0)
+                assert ei.value.code == ZK_BADVERSION
+
+                # data watch fires on change
+                ev = asyncio.Event()
+                data, _ = await zk.get_data("/a/b/n1",
+                                            watch=lambda e: ev.set())
+                assert data == b"v1"
+                await zk.set_data("/a/b/n1", b"v2")
+                await asyncio.wait_for(ev.wait(), 5)
+
+                # children watch fires on create; sequential names order
+                ev2 = asyncio.Event()
+                kids = await zk.get_children("/a/b",
+                                             watch=lambda e: ev2.set())
+                assert kids == ["n1"]
+                s1 = await zk.create("/a/b/seq_", b"", sequential=True)
+                s2 = await zk.create("/a/b/seq_", b"", sequential=True)
+                assert s1 < s2
+                await asyncio.wait_for(ev2.wait(), 5)
+
+                with pytest.raises(ZkError) as ei:
+                    await zk.get_data("/nope")
+                assert ei.value.code == ZK_NONODE
+
+                await zk.delete("/a/b/n1")
+                assert await zk.exists("/a/b/n1") is None
+            finally:
+                await zk.close()
+                await server.close()
+
+        run(go())
+
+    def test_ephemerals_die_with_session(self):
+        async def go():
+            server = await FakeZkServer().start()
+            zk1 = ZkClient(server.hosts).start()
+            zk2 = ZkClient(server.hosts).start()
+            try:
+                await zk1.ensure_path("/ss")
+                await zk1.create("/ss/member_", member_json("h1", 1),
+                                 ephemeral=True, sequential=True)
+                kids = await zk2.get_children("/ss")
+                assert len(kids) == 1
+                ev = asyncio.Event()
+                await zk2.get_children("/ss", watch=lambda e: ev.set())
+                await zk1.close()  # session dies -> ephemeral reaped
+                await asyncio.wait_for(ev.wait(), 5)
+                kids = await zk2.get_children("/ss")
+                assert kids == []
+            finally:
+                await zk2.close()
+                await server.close()
+
+        run(go())
+
+
+class TestServersetNamer:
+    def test_bind_scale_and_endpoint(self):
+        async def go():
+            server = await FakeZkServer().start()
+            zk = ZkClient(server.hosts).start()
+            namer = ServersetNamer(zk, Path.of("#", "io.l5d.serversets"))
+            try:
+                server.set_node(
+                    "/discovery/prod/web/member_0000000001",
+                    member_json("10.0.0.1", 8080,
+                                extra_eps={"admin": {"host": "10.0.0.1",
+                                                     "port": 9990}}))
+                act = namer.lookup(Path.read("/discovery/prod/web"))
+                state = await wait_for(
+                    lambda: act.current if isinstance(act.current, Ok)
+                    else None)
+                tree = state.value
+                assert isinstance(tree, Leaf)
+                bound = tree.value
+                assert bound.id_.show == "/#/io.l5d.serversets/discovery/prod/web"
+                assert hosts_of(bound.addr.sample()) == {("10.0.0.1", 8080)}
+
+                # scale up: second member joins -> Var updates in place
+                server.set_node(
+                    "/discovery/prod/web/member_0000000002",
+                    member_json("10.0.0.2", 8080))
+                await wait_for(lambda: len(
+                    hosts_of(bound.addr.sample())) == 2)
+
+                # DEAD members are excluded
+                server.set_node(
+                    "/discovery/prod/web/member_0000000002",
+                    member_json("10.0.0.2", 8080, status="DEAD"))
+                await wait_for(lambda: len(
+                    hosts_of(bound.addr.sample())) == 1)
+
+                # :endpoint selects additionalEndpoints
+                act2 = namer.lookup(Path.read("/discovery/prod/web:admin"))
+                state2 = await wait_for(
+                    lambda: act2.current if isinstance(act2.current, Ok)
+                    else None)
+                bound2 = state2.value.value
+                assert hosts_of(bound2.addr.sample()) == {("10.0.0.1", 9990)}
+            finally:
+                namer.close()
+                await zk.close()
+                await server.close()
+
+        run(go())
+
+    def test_prefix_fallback_residual(self):
+        async def go():
+            server = await FakeZkServer().start()
+            zk = ZkClient(server.hosts).start()
+            namer = ServersetNamer(zk, Path.of("#", "io.l5d.serversets"))
+            try:
+                server.set_node("/discovery/prod/web/member_0000000001",
+                                member_json("10.0.0.1", 8080))
+                # extra segments fall into the residual
+                act = namer.lookup(Path.read("/discovery/prod/web/extra/seg"))
+                state = await wait_for(
+                    lambda: act.current if isinstance(act.current, Ok)
+                    else None)
+                bound = state.value.value
+                assert bound.residual.show == "/extra/seg"
+
+                # no serverset anywhere on the path -> Neg
+                act2 = namer.lookup(Path.read("/not/there"))
+                state2 = await wait_for(
+                    lambda: act2.current if isinstance(act2.current, Ok)
+                    else None)
+                assert isinstance(state2.value, Neg)
+            finally:
+                namer.close()
+                await zk.close()
+                await server.close()
+
+        run(go())
+
+
+class TestZkLeaderNamer:
+    def test_leader_failover(self):
+        async def go():
+            server = await FakeZkServer().start()
+            zk = ZkClient(server.hosts).start()
+            namer = ZkLeaderNamer(zk, Path.of("#", "io.l5d.zkLeader"))
+            try:
+                server.set_node("/election/svc/c_0000000001",
+                                b"10.0.0.1:9001")
+                server.set_node("/election/svc/c_0000000002",
+                                b"10.0.0.2:9002")
+                act = namer.lookup(Path.read("/election/svc"))
+                state = await wait_for(
+                    lambda: act.current if isinstance(act.current, Ok)
+                    else None)
+                bound = state.value.value
+                assert hosts_of(bound.addr.sample()) == {("10.0.0.1", 9001)}
+
+                # leader dies -> next lowest sequence takes over
+                server.delete_node("/election/svc/c_0000000001")
+                await wait_for(lambda: hosts_of(
+                    bound.addr.sample()) == {("10.0.0.2", 9002)})
+            finally:
+                namer.close()
+                await zk.close()
+                await server.close()
+
+        run(go())
+
+
+class TestCuratorNamer:
+    def test_instances_and_ssl(self):
+        async def go():
+            server = await FakeZkServer().start()
+            zk = ZkClient(server.hosts).start()
+            namer = CuratorNamer(zk, "/disco", Path.of("#", "io.l5d.curator"))
+            try:
+                server.set_node("/disco/api/i-1", json.dumps(
+                    {"name": "api", "id": "i-1", "address": "10.1.0.1",
+                     "port": 8080, "sslPort": None}).encode())
+                server.set_node("/disco/api/i-2", json.dumps(
+                    {"name": "api", "id": "i-2", "address": "10.1.0.2",
+                     "port": 8080, "sslPort": 8443}).encode())
+                act = namer.lookup(Path.read("/api/extra"))
+                state = await wait_for(
+                    lambda: act.current if isinstance(act.current, Ok)
+                    else None)
+                bound = state.value.value
+                # sslPort wins for the instance that has one
+                assert hosts_of(bound.addr.sample()) == {
+                    ("10.1.0.1", 8080), ("10.1.0.2", 8443)}
+                assert bound.residual.show == "/extra"
+                assert dict(bound.addr.sample().meta)["ssl"] is True
+            finally:
+                namer.close()
+                await zk.close()
+                await server.close()
+
+        run(go())
+
+
+class TestZkDtabStore:
+    def test_crud_cas_watch_and_list(self):
+        async def go():
+            server = await FakeZkServer().start()
+            store = ZkDtabStore(server.hosts, "/dtabs")
+            try:
+                await store.create("prod", Dtab.read("/svc => /#/io.l5d.fs"))
+                act = store.observe("prod")
+                state = await wait_for(
+                    lambda: act.current
+                    if isinstance(act.current, Ok) and act.current.value
+                    else None)
+                vd: VersionedDtab = state.value
+                assert "/svc=>/#/io.l5d.fs" in vd.dtab.show.replace(" ", "")
+
+                # CAS: stale version rejected, current accepted
+                with pytest.raises(DtabVersionMismatch):
+                    await store.update("prod", Dtab.read("/a => /b"),
+                                       b"\x00\x00\x00\x63")
+                await store.update("prod", Dtab.read("/a => /b"), vd.version)
+                await wait_for(
+                    lambda: isinstance(act.current, Ok)
+                    and act.current.value
+                    and "/a" in act.current.value.dtab.show)
+
+                # list is watch-driven
+                names = store.list()
+                await wait_for(lambda: "prod" in names.sample())
+                await store.put("stage", Dtab.read("/x => /y"))
+                await wait_for(lambda: "stage" in names.sample())
+
+                await store.delete("stage")
+                await wait_for(lambda: "stage" not in names.sample())
+                with pytest.raises(DtabNamespaceDoesNotExist):
+                    await store.delete("stage")
+            finally:
+                store.close()
+                from linkerd_tpu.namer.zk import _shared_clients
+                for c in _shared_clients.values():
+                    await c.close()
+                _shared_clients.clear()
+                await server.close()
+
+        run(go())
+
+
+class TestZkAnnouncerRoundTrip:
+    def test_announce_visible_via_serversets_namer(self):
+        async def go():
+            from linkerd_tpu.announcer import ZkAnnouncer
+
+            server = await FakeZkServer().start()
+            zk = ZkClient(server.hosts).start()
+            namer = ServersetNamer(zk, Path.of("#", "io.l5d.serversets"))
+            ann = ZkAnnouncer(server.hosts, Path.read("/discovery"),
+                              Path.read("/io.l5d.serversets"))
+            try:
+                closable = ann.announce("10.9.9.9", 4140, Path.read("/web"))
+                act = namer.lookup(Path.read("/discovery/web"))
+                state = await wait_for(
+                    lambda: (act.current
+                             if isinstance(act.current, Ok)
+                             and isinstance(act.current.value, Leaf)
+                             else None))
+                bound = state.value.value
+                assert hosts_of(bound.addr.sample()) == {("10.9.9.9", 4140)}
+
+                # withdrawal removes the member
+                closable.close()
+                await wait_for(
+                    lambda: not hosts_of(bound.addr.sample()))
+            finally:
+                namer.close()
+                from linkerd_tpu.namer.zk import _shared_clients
+                for c in _shared_clients.values():
+                    await c.close()
+                _shared_clients.clear()
+                await zk.close()
+                await server.close()
+
+        run(go())
+
+
+class TestZkConfigKinds:
+    def test_all_five_kinds_registered(self):
+        from linkerd_tpu.config import instantiate
+        import linkerd_tpu.linker  # noqa: F401 — loads plugin registrations
+
+        n1 = instantiate("namer", {
+            "kind": "io.l5d.serversets",
+            "zkAddrs": [{"host": "127.0.0.1", "port": 21810}]})
+        n2 = instantiate("namer", {
+            "kind": "io.l5d.zkLeader", "hosts": "127.0.0.1:21810"})
+        n3 = instantiate("namer", {
+            "kind": "io.l5d.curator", "hosts": "127.0.0.1:21810",
+            "basePath": "/svc-disco"})
+        st = instantiate("dtabStore", {
+            "kind": "io.l5d.zk", "hosts": "127.0.0.1:21810",
+            "pathPrefix": "/dtabs"})
+        an = instantiate("announcer", {
+            "kind": "io.l5d.serversets", "hosts": "127.0.0.1:21810",
+            "pathPrefix": "/discovery"})
+        assert n1.prefix == "/io.l5d.serversets"
+        assert n2.prefix == "/io.l5d.zkLeader"
+        assert n3.basePath == "/svc-disco"
+        assert st.pathPrefix == "/dtabs"
+        assert an.pathPrefix == "/discovery"
