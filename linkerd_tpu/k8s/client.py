@@ -111,6 +111,25 @@ class K8sApi:
         return await reader.readexactly(n) if n else b""
 
     # -- API --------------------------------------------------------------
+    async def request_json(self, method: str, path: str, obj=None,
+                           timeout: float = 30.0):
+        """One mutating API call; returns (status, parsed body|None).
+        Used by the dtab store (TPR writes) — reads go via get_json."""
+        from linkerd_tpu.protocol.http.simple_client import request
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        body = b"" if obj is None else json.dumps(obj).encode()
+        rsp = await request(self.host, self.port, method, path, body=body,
+                            headers=headers, ssl=self._ssl, timeout=timeout)
+        parsed = None
+        if rsp.body:
+            try:
+                parsed = json.loads(rsp.body)
+            except ValueError:
+                parsed = None
+        return rsp.status, parsed
+
     async def get_json(self, path: str):
         """GET; 404 returns the parsed Status object (callers map a
         missing resource to a negative binding, not an error)."""

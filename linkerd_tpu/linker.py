@@ -57,6 +57,7 @@ import linkerd_tpu.istio.identifier  # noqa: F401
 import linkerd_tpu.istio.interpreter  # noqa: F401
 import linkerd_tpu.istio.namer  # noqa: F401
 import linkerd_tpu.istio.telemeter  # noqa: F401
+import linkerd_tpu.k8s.ingress  # noqa: F401
 import linkerd_tpu.k8s.namer  # noqa: F401
 import linkerd_tpu.announcer  # noqa: F401
 import linkerd_tpu.namer.fs  # noqa: F401

@@ -593,3 +593,170 @@ class ZkStoreConfig:
         from linkerd_tpu.namer.zk import parse_zk_addrs
         connect = parse_zk_addrs(self.zkAddrs or [], self.hosts)
         return ZkDtabStore(connect, self.pathPrefix, self.sessionTimeoutMs)
+
+
+class K8sDtabStore(DtabStore):
+    """Dtabs as Kubernetes third-party resources (ref: namerd/storage/k8s/
+    .../K8sDtabStore.scala:163 — resources at
+    ``/apis/buoyant.io/v1/namespaces/{ns}/dtabs``, one ``DTab`` object per
+    dtab namespace, k8s resourceVersion as the CAS token, list+watch
+    feeding the Activities through the shared Watcher machinery)."""
+
+    API_PREFIX = "/apis/buoyant.io/v1"
+
+    def __init__(self, api, k8s_namespace: str = "default"):
+        from linkerd_tpu.k8s.client import Watcher
+
+        self.api = api
+        self.k8s_namespace = k8s_namespace
+        self._base = (f"{self.API_PREFIX}/namespaces/{k8s_namespace}/dtabs")
+        self._acts: Dict[str, Activity] = {}
+        self._list: Var[FrozenSet[str]] = Var(frozenset())
+        self._known: Dict[str, VersionedDtab] = {}
+        self._primed = False
+        self._watcher = Watcher(api, self._base, self._on_list,
+                                self._on_event)
+
+    # ── watch plumbing ───────────────────────────────────────────────────
+    @staticmethod
+    def _parse(obj: dict) -> Optional[tuple]:
+        meta = obj.get("metadata") or {}
+        name = meta.get("name")
+        version = meta.get("resourceVersion")
+        if not name or version is None:
+            return None
+        dentries = obj.get("dentries") or []
+        try:
+            dtab = Dtab.read(";".join(
+                f"{d['prefix']} => {d['dst']}" for d in dentries))
+        except Exception:  # noqa: BLE001 — tolerate bad records
+            return None
+        return name, VersionedDtab(dtab, str(version).encode())
+
+    def _on_list(self, obj: dict) -> None:
+        state: Dict[str, VersionedDtab] = {}
+        for item in obj.get("items") or []:
+            kv = self._parse(item)
+            if kv is not None:
+                state[kv[0]] = kv[1]
+        self._publish(state)
+
+    def _on_event(self, evt: dict) -> None:
+        obj = evt.get("object") or {}
+        etype = evt.get("type")
+        if etype == "DELETED":
+            # deletion only needs the name — a malformed object must not
+            # leave a deleted namespace live in the cache
+            name = (obj.get("metadata") or {}).get("name")
+            if name:
+                state = dict(self._known)
+                state.pop(name, None)
+                self._publish(state)
+            return
+        kv = self._parse(obj)
+        if kv is None:
+            return
+        state = dict(self._known)
+        state[kv[0]] = kv[1]
+        self._publish(state)
+
+    def _publish(self, state: Dict[str, VersionedDtab]) -> None:
+        self._known = state
+        self._primed = True
+        self._list.update(frozenset(state))
+        for ns, act in self._acts.items():
+            act.update(Ok(state.get(ns)))
+
+    def _ensure_watch(self) -> None:
+        self._watcher.start()
+
+    # ── DtabStore ────────────────────────────────────────────────────────
+    def list(self) -> Var[FrozenSet[str]]:
+        self._ensure_watch()
+        return self._list
+
+    def observe(self, ns: str) -> Activity[Optional[VersionedDtab]]:
+        self._ensure_watch()
+        act = self._acts.get(ns)
+        if act is None:
+            act = (Activity.mutable(Ok(self._known.get(ns)))
+                   if self._primed else Activity.mutable())
+            self._acts[ns] = act
+        return act
+
+    def _dtab_obj(self, ns: str, dtab: Dtab,
+                  version: Optional[str] = None) -> dict:
+        meta = {"name": ns}
+        if version is not None:
+            meta["resourceVersion"] = version
+        return {
+            "apiVersion": "buoyant.io/v1",
+            "kind": "DTab",
+            "metadata": meta,
+            "dentries": [{"prefix": d.prefix.show, "dst": d.dst.show}
+                         for d in dtab],
+        }
+
+    async def create(self, ns: str, dtab: Dtab) -> None:
+        status, _ = await self.api.request_json(
+            "POST", self._base, self._dtab_obj(ns, dtab))
+        if status == 409:
+            raise DtabNamespaceAlreadyExists(ns)
+        if status not in (200, 201):
+            raise RuntimeError(f"k8s dtab create: {status}")
+
+    async def update(self, ns: str, dtab: Dtab, version: bytes) -> None:
+        status, _ = await self.api.request_json(
+            "PUT", f"{self._base}/{ns}",
+            self._dtab_obj(ns, dtab, version.decode("utf-8", "replace")))
+        if status == 409:
+            raise DtabVersionMismatch(ns)
+        if status == 404:
+            raise DtabNamespaceDoesNotExist(ns)
+        if status not in (200, 201):
+            raise RuntimeError(f"k8s dtab update: {status}")
+
+    async def put(self, ns: str, dtab: Dtab) -> None:
+        # Unconditional upsert: a 404->create that loses a create race
+        # (409) must loop back to PUT, not surface AlreadyExists.
+        for _ in range(4):
+            status, _ = await self.api.request_json(
+                "PUT", f"{self._base}/{ns}", self._dtab_obj(ns, dtab))
+            if status in (200, 201):
+                return
+            if status != 404:
+                raise RuntimeError(f"k8s dtab put: {status}")
+            try:
+                await self.create(ns, dtab)
+                return
+            except DtabNamespaceAlreadyExists:
+                continue  # raced a concurrent creator; PUT again
+        raise RuntimeError(f"k8s dtab put {ns!r}: create/update race")
+
+    async def delete(self, ns: str) -> None:
+        status, _ = await self.api.request_json(
+            "DELETE", f"{self._base}/{ns}")
+        if status == 404:
+            raise DtabNamespaceDoesNotExist(ns)
+        if status not in (200, 202):
+            raise RuntimeError(f"k8s dtab delete: {status}")
+
+    def close(self) -> None:
+        self._watcher.stop()
+
+
+@register("dtabStore", "io.l5d.k8s")
+@dataclass
+class K8sStoreConfig:
+    host: str = "localhost"   # "" -> in-cluster service account
+    port: int = 8001
+    k8sNamespace: str = "default"
+    useTls: bool = False
+    caCertPath: Optional[str] = None
+    insecureSkipVerify: bool = False
+
+    def mk(self) -> DtabStore:
+        from linkerd_tpu.k8s.namer import _mk_api
+        api = _mk_api(self.host, self.port, self.useTls,
+                      self.caCertPath, self.insecureSkipVerify)
+        return K8sDtabStore(api, self.k8sNamespace)

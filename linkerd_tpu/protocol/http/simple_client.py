@@ -16,12 +16,14 @@ from linkerd_tpu.protocol.http import codec
 from linkerd_tpu.protocol.http.message import Headers, Request, Response
 
 
-async def get(host: str, port: int, path: str,
-              headers: Optional[Dict[str, str]] = None,
-              ssl=None, timeout: float = 330.0,
-              max_body: int = codec.MAX_BODY) -> Response:
-    """GET ``path`` with ``Connection: close``; returns the full Response.
-    ``timeout`` bounds the whole exchange (long-poll friendly default)."""
+async def request(host: str, port: int, method: str, path: str,
+                  body: bytes = b"",
+                  headers: Optional[Dict[str, str]] = None,
+                  ssl=None, timeout: float = 330.0,
+                  max_body: int = codec.MAX_BODY) -> Response:
+    """One ``method`` request with ``Connection: close``; returns the full
+    Response. ``timeout`` bounds the whole exchange (long-poll friendly
+    default)."""
 
     async def go() -> Response:
         reader, writer = await asyncio.open_connection(host, port, ssl=ssl)
@@ -30,9 +32,11 @@ async def get(host: str, port: int, path: str,
                             ("Connection", "close")])
             for k, v in (headers or {}).items():
                 hdrs.set(k, v)
-            codec.write_request(writer, Request(uri=path, headers=hdrs))
+            codec.write_request(writer, Request(
+                method=method, uri=path, headers=hdrs, body=body))
             await writer.drain()
-            return await codec.read_response(reader, max_body=max_body)
+            return await codec.read_response(
+                reader, max_body=max_body, request_method=method)
         finally:
             try:
                 writer.close()
@@ -40,3 +44,12 @@ async def get(host: str, port: int, path: str,
                 pass
 
     return await asyncio.wait_for(go(), timeout)
+
+
+async def get(host: str, port: int, path: str,
+              headers: Optional[Dict[str, str]] = None,
+              ssl=None, timeout: float = 330.0,
+              max_body: int = codec.MAX_BODY) -> Response:
+    """GET ``path`` with ``Connection: close``; returns the full Response."""
+    return await request(host, port, "GET", path, headers=headers,
+                         ssl=ssl, timeout=timeout, max_body=max_body)

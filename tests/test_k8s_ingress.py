@@ -1,0 +1,344 @@
+"""k8s ingress-controller story: IngressCache/identifiers + K8sDtabStore
+against scripted fake k8s API servers (the reference's test technique,
+EndpointsNamerTest-style watch replay)."""
+
+import asyncio
+import json
+
+import pytest
+
+from linkerd_tpu.core import Dtab, Path
+from linkerd_tpu.k8s.client import K8sApi
+from linkerd_tpu.k8s.ingress import (
+    IngressCache, IngressIdentifier, H2IngressIdentifier, parse_ingress,
+)
+from linkerd_tpu.namerd.store import (
+    DtabNamespaceDoesNotExist, DtabVersionMismatch,
+)
+from linkerd_tpu.namerd.stores import K8sDtabStore
+from linkerd_tpu.protocol.http.message import Request, Response
+from linkerd_tpu.protocol.http.server import HttpServer
+from linkerd_tpu.router.routing import IdentificationError
+from linkerd_tpu.router.service import FnService
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+def ingress_obj(name="web-ingress", ns="prod", host="example.com",
+                path="/api/.*", svc="api-svc", port="http",
+                annotations=None, version="10"):
+    return {
+        "kind": "Ingress",
+        "metadata": {"name": name, "namespace": ns,
+                     "resourceVersion": version,
+                     "annotations": annotations or {}},
+        "spec": {
+            "rules": [{
+                "host": host,
+                "http": {"paths": [{
+                    "path": path,
+                    "backend": {"serviceName": svc, "servicePort": port},
+                }]},
+            }],
+        },
+    }
+
+
+class FakeIngressApi:
+    def __init__(self, items=None):
+        self.items = items if items is not None else [ingress_obj()]
+        self.events: asyncio.Queue = asyncio.Queue()
+
+    def service(self):
+        async def handler(req: Request) -> Response:
+            assert "/ingresses" in req.uri
+            if "watch=true" not in req.uri:
+                return Response(status=200, body=json.dumps({
+                    "kind": "IngressList",
+                    "metadata": {"resourceVersion": "100"},
+                    "items": self.items,
+                }).encode())
+
+            async def gen():
+                while True:
+                    evt = await self.events.get()
+                    if evt is None:
+                        return
+                    yield (json.dumps(evt) + "\n").encode()
+            return Response(status=200, body_stream=gen())
+        return FnService(handler)
+
+
+class TestParseIngress:
+    def test_both_backend_shapes_and_annotation_filter(self):
+        spec = parse_ingress(ingress_obj(), "linkerd")
+        assert spec.rules[0].svc == "api-svc"
+        assert spec.rules[0].port == "http"
+
+        # networking.k8s.io/v1 shape
+        modern = {
+            "metadata": {"name": "m", "namespace": "prod"},
+            "spec": {
+                "defaultBackend": {"service": {
+                    "name": "fallback", "port": {"number": 8080}}},
+                "rules": [{"http": {"paths": [{
+                    "path": "/x",
+                    "backend": {"service": {"name": "svc-v1",
+                                            "port": {"name": "http"}}},
+                }]}}],
+            },
+        }
+        spec2 = parse_ingress(modern, "linkerd")
+        assert spec2.rules[0].svc == "svc-v1"
+        assert spec2.rules[0].port == "http"
+        assert spec2.fallback.svc == "fallback"
+        assert spec2.fallback.port == "8080"
+
+        # another controller's ingress is ignored
+        other = ingress_obj(
+            annotations={"kubernetes.io/ingress.class": "nginx"})
+        assert parse_ingress(other, "linkerd") is None
+        mine = ingress_obj(
+            annotations={"kubernetes.io/ingress.class": "linkerd"})
+        assert parse_ingress(mine, "linkerd") is not None
+
+
+class TestIngressIdentifier:
+    def test_identify_watch_update_and_h2(self):
+        async def go():
+            fake = FakeIngressApi()
+            server = await HttpServer(fake.service()).start()
+            cfg = IngressIdentifier(host="127.0.0.1",
+                                    port=server.bound_port)
+            identify = cfg.mk(Path.of("svc"), Dtab.empty())
+            try:
+                req = Request(method="GET", uri="/api/users",
+                              headers=None)
+                req.headers = __import__(
+                    "linkerd_tpu.protocol.http.message",
+                    fromlist=["Headers"]).Headers(
+                        [("Host", "example.com")])
+                dst = await identify(req)
+                # /<prefix>/<namespace>/<port>/<svc> (io.l5d.k8s shape)
+                assert dst.path.show == "/svc/prod/http/api-svc"
+
+                # non-matching host -> unidentified
+                req2 = Request(method="GET", uri="/api/users")
+                req2.headers.set("Host", "other.com")
+                with pytest.raises(IdentificationError):
+                    await identify(req2)
+
+                # watch event: rule added for other.com -> now identifies
+                fake.events.put_nowait({
+                    "type": "ADDED",
+                    "object": ingress_obj(name="other", host="other.com",
+                                          path="/api/.*", svc="other-svc",
+                                          port="8080", version="11")})
+                for _ in range(100):
+                    try:
+                        dst2 = await identify(req2)
+                        break
+                    except IdentificationError:
+                        await asyncio.sleep(0.02)
+                else:
+                    raise AssertionError("watch update not applied")
+                assert dst2.path.show == "/svc/prod/8080/other-svc"
+
+                # h2 twin matches on :authority/:path
+                h2cfg = H2IngressIdentifier(host="127.0.0.1",
+                                            port=server.bound_port)
+                h2id = h2cfg.mk(Path.of("svc"), Dtab.empty())
+                from linkerd_tpu.protocol.h2.messages import H2Request
+                h2req = H2Request(method="GET", path="/api/users",
+                                  scheme="http", authority="example.com:80")
+                h2dst = await h2id(h2req)
+                assert h2dst.path.show == "/svc/prod/http/api-svc"
+                h2cfg._cache.stop()
+            finally:
+                if cfg._cache is not None:
+                    cfg._cache.stop()
+                await server.close()
+
+        run(go())
+
+
+class FakeDtabApi:
+    """TPR dtab API: list/watch + POST/PUT/DELETE with resourceVersion CAS."""
+
+    def __init__(self):
+        self.dtabs = {}  # name -> (dentries, version)
+        self.gen = 100
+        self.events: asyncio.Queue = asyncio.Queue()
+
+    def _obj(self, name):
+        dentries, version = self.dtabs[name]
+        return {"apiVersion": "buoyant.io/v1", "kind": "DTab",
+                "metadata": {"name": name,
+                             "resourceVersion": str(version)},
+                "dentries": dentries}
+
+    def service(self):
+        async def handler(req: Request) -> Response:
+            assert "/apis/buoyant.io/v1/namespaces/default/dtabs" in req.uri
+            name = req.uri.split("?")[0].rsplit("/dtabs", 1)[1].lstrip("/")
+            if req.method == "GET" and "watch=true" in req.uri:
+                async def gen():
+                    while True:
+                        evt = await self.events.get()
+                        if evt is None:
+                            return
+                        yield (json.dumps(evt) + "\n").encode()
+                return Response(status=200, body_stream=gen())
+            if req.method == "GET":
+                return Response(status=200, body=json.dumps({
+                    "kind": "DTabList",
+                    "metadata": {"resourceVersion": str(self.gen)},
+                    "items": [self._obj(n) for n in self.dtabs],
+                }).encode())
+            if req.method == "POST":
+                obj = json.loads(req.body)
+                n = obj["metadata"]["name"]
+                if n in self.dtabs:
+                    return Response(status=409, body=b"{}")
+                self.gen += 1
+                self.dtabs[n] = (obj.get("dentries") or [], self.gen)
+                self.events.put_nowait(
+                    {"type": "ADDED", "object": self._obj(n)})
+                return Response(status=201, body=b"{}")
+            if req.method == "PUT":
+                obj = json.loads(req.body)
+                if name not in self.dtabs:
+                    return Response(status=404, body=b"{}")
+                want = obj["metadata"].get("resourceVersion")
+                _, cur = self.dtabs[name]
+                if want is not None and want != str(cur):
+                    return Response(status=409, body=b"{}")
+                self.gen += 1
+                self.dtabs[name] = (obj.get("dentries") or [], self.gen)
+                self.events.put_nowait(
+                    {"type": "MODIFIED", "object": self._obj(name)})
+                return Response(status=200, body=b"{}")
+            if req.method == "DELETE":
+                if name not in self.dtabs:
+                    return Response(status=404, body=b"{}")
+                obj = self._obj(name)
+                del self.dtabs[name]
+                self.events.put_nowait({"type": "DELETED", "object": obj})
+                return Response(status=200, body=b"{}")
+            return Response(status=405)
+        return FnService(handler)
+
+
+async def wait_until(fn, timeout=5.0):
+    for _ in range(int(timeout / 0.02)):
+        if fn():
+            return
+        await asyncio.sleep(0.02)
+    raise AssertionError("condition not met")
+
+
+class TestK8sDtabStore:
+    def test_crud_cas_and_watch(self):
+        async def go():
+            from linkerd_tpu.core.activity import Ok
+
+            fake = FakeDtabApi()
+            server = await HttpServer(fake.service()).start()
+            api = K8sApi("127.0.0.1", server.bound_port, use_tls=False)
+            store = K8sDtabStore(api, "default")
+            try:
+                await store.create("prod", Dtab.read("/svc => /#/io.l5d.fs"))
+                act = store.observe("prod")
+                await wait_until(
+                    lambda: isinstance(act.current, Ok)
+                    and act.current.value is not None)
+                vd = act.current.value
+                assert "io.l5d.fs" in vd.dtab.show
+
+                with pytest.raises(DtabVersionMismatch):
+                    await store.update("prod", Dtab.read("/a => /b"),
+                                       b"999999")
+                await store.update("prod", Dtab.read("/a => /b"), vd.version)
+                await wait_until(
+                    lambda: isinstance(act.current, Ok)
+                    and act.current.value
+                    and "/a" in act.current.value.dtab.show)
+
+                names = store.list()
+                await wait_until(lambda: "prod" in names.sample())
+                await store.put("stage", Dtab.read("/x => /y"))
+                await wait_until(lambda: "stage" in names.sample())
+
+                await store.delete("stage")
+                await wait_until(lambda: "stage" not in names.sample())
+                with pytest.raises(DtabNamespaceDoesNotExist):
+                    await store.delete("stage")
+            finally:
+                store.close()
+                await server.close()
+
+        run(go())
+
+
+class TestIngressEndToEnd:
+    def test_linker_routes_by_ingress_rule(self, tmp_path):
+        """Full linker: request identified by an Ingress rule from a
+        scripted k8s watch stream, bound through the fs namer, proxied to
+        a real downstream over sockets."""
+        async def go():
+            from linkerd_tpu.linker import load_linker
+            from linkerd_tpu.protocol.http.client import HttpClient
+            from linkerd_tpu.protocol.http.server import serve
+
+            fake = FakeIngressApi()
+            k8s_srv = await HttpServer(fake.service()).start()
+
+            async def backend_handler(req: Request) -> Response:
+                return Response(status=200, body=b"ingress-backend")
+            backend = await serve(FnService(backend_handler))
+
+            disco = tmp_path / "disco"
+            disco.mkdir()
+            (disco / "api-svc").write_text(
+                f"127.0.0.1 {backend.bound_port}\n")
+
+            cfg = f"""
+routers:
+- protocol: http
+  label: ingress
+  identifier:
+    kind: io.l5d.ingress
+    host: 127.0.0.1
+    port: {k8s_srv.bound_port}
+  dtab: |
+    /svc/prod/http => /#/io.l5d.fs ;
+  servers:
+  - port: 0
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+            linker = load_linker(cfg)
+            await linker.start()
+            proxy = HttpClient("127.0.0.1",
+                               linker.routers[0].server_ports[0])
+            try:
+                req = Request(uri="/api/users")
+                req.headers.set("Host", "example.com")
+                rsp = await proxy(req)
+                assert (rsp.status, rsp.body) == (200, b"ingress-backend")
+
+                # a request matching no ingress rule is unidentified (400)
+                bad = Request(uri="/nope")
+                bad.headers.set("Host", "example.com")
+                rsp2 = await proxy(bad)
+                assert rsp2.status == 400
+            finally:
+                await proxy.close()
+                await linker.close()
+                await backend.close()
+                await k8s_srv.close()
+
+        run(go())
