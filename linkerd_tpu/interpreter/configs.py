@@ -79,3 +79,8 @@ class NamerdThriftInterpreterConfig:
         )
         host, port = parse_inet_dst(self.dst)
         return ThriftNamerInterpreter(host, port, namespace=self.namespace)
+
+
+# file- and configmap-backed interpreters register on import
+import linkerd_tpu.interpreter.fs  # noqa: E402,F401
+import linkerd_tpu.interpreter.k8s_configmap  # noqa: E402,F401

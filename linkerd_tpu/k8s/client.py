@@ -225,6 +225,12 @@ class Watcher:
         if self._task is None or self._task.done():
             self._task = asyncio.get_event_loop().create_task(self._run())
 
+    def set_path(self, path: str) -> None:
+        """Re-point the watch (e.g. API-group fallover). The loop reads
+        self._path on every list/watch call, so the next cycle — forced
+        by raising from on_list, or the next reconnect — uses it."""
+        self._path = path
+
     def stop(self) -> None:
         if self._task is not None:
             self._task.cancel()

@@ -140,7 +140,7 @@ class IngressCache:
             if "/apis/extensions/v1beta1" in self._path:
                 self._path = self._path.replace(
                     "/apis/extensions/v1beta1", "/apis/networking.k8s.io/v1")
-                self._watcher._path = self._path  # noqa: SLF001
+                self._watcher.set_path(self._path)
                 raise K8sApiError(
                     404, "extensions/v1beta1 absent; retrying with "
                          "networking.k8s.io/v1")

@@ -38,7 +38,9 @@ from linkerd_tpu.router.routing import (
     PerDstPathStatsFilter, RoutingService, StatsFilter,
     StatusCodeStatsFilter,
 )
-from linkerd_tpu.router.service import Filter, Service, filters_to_service
+from linkerd_tpu.router.service import (
+    Filter, FnService, Service, filters_to_service,
+)
 from linkerd_tpu.router.tracing import (
     AccessLogger, ClientTraceFilter, ServerTraceFilter,
 )
@@ -182,6 +184,10 @@ class RouterSpec:
     # thrift only: method name as the dst path element instead of the
     # static "thrift" dst (ref: router/thrift Identifier.scala:34)
     thriftMethodInDst: bool = False
+    # thrift only: negotiate the TTwitter upgrade with servers/clients so
+    # trace ids + dtab overrides ride thrift hops
+    # (ref: ThriftInitializer.scala attemptTTwitterUpgrade)
+    attemptTTwitterUpgrade: bool = True
     # http only: serve the data plane from the native C++ epoll engine
     # (native/fastpath.cpp); Python remains the control plane (naming,
     # route install, stats/feature drain). Requires a built native lib.
@@ -516,6 +522,16 @@ class Linker:
         mk_policy_factory = self._mk_policy_factory_fn(label)
 
         def client_factory(bound: BoundName) -> Service:
+            from linkerd_tpu.namer.core import STATUS_NAMER_PREFIX
+            if bound.id_.starts_with(STATUS_NAMER_PREFIX):
+                from linkerd_tpu.protocol.h2.messages import H2Response
+                from linkerd_tpu.protocol.h2.stream import stream_of
+                code = int(bound.id_[len(STATUS_NAMER_PREFIX)])
+
+                async def const_status(req):
+                    return H2Response(status=code, stream=stream_of(b""))
+
+                return FnService(const_status)
             cid = bound.id_.show.lstrip("/").replace("/", ".") or "client"
             cspec, cvars = client_lookup(bound.id_)
             mk_policy = mk_policy_factory(cspec)
@@ -660,6 +676,11 @@ class Linker:
         MuxStatsFilter = BasicStatsFilter
 
         def client_factory(bound: BoundName) -> Service:
+            from linkerd_tpu.namer.core import STATUS_NAMER_PREFIX
+            if bound.id_.starts_with(STATUS_NAMER_PREFIX):
+                raise ConfigError(
+                    "/$/io.buoyant.http.status is only available to "
+                    "http/h2 routers")
             cid = bound.id_.show.lstrip("/").replace("/", ".") or "client"
             cspec, _cvars = client_lookup(bound.id_)
             mk_policy = mk_policy_factory(cspec)
@@ -736,7 +757,10 @@ class Linker:
 
         def identifier(call: ThriftCall) -> DstPath:
             seg = call.name if method_in_dst else "thrift"
-            return DstPath(prefix + Path.of(seg), base_dtab, Dtab.empty())
+            # an upgraded caller's dtab delegations act as the local dtab
+            # (the thrift analogue of the l5d-dtab header)
+            local = call.ctx.get("dtab") or Dtab.empty()
+            return DstPath(prefix + Path.of(seg), base_dtab, local)
 
         interpreter = self._mk_interpreter(rspec, label)
         client_lookup = per_prefix_lookup(
@@ -770,6 +794,11 @@ class Linker:
                     req, rsp, None) is ResponseClass.SUCCESS)
 
         def client_factory(bound: BoundName) -> Service:
+            from linkerd_tpu.namer.core import STATUS_NAMER_PREFIX
+            if bound.id_.starts_with(STATUS_NAMER_PREFIX):
+                raise ConfigError(
+                    "/$/io.buoyant.http.status is only available to "
+                    "http/h2 routers")
             cid = bound.id_.show.lstrip("/").replace("/", ".") or "client"
             cspec, _cvars = client_lookup(bound.id_)
             mk_policy = mk_policy_factory(cspec)
@@ -777,7 +806,9 @@ class Linker:
             def endpoint_factory(addr: Address) -> Service:
                 client: Service = ThriftClient(
                     addr.host, addr.port,
-                    connect_timeout=cspec.connectTimeoutMs / 1e3)
+                    connect_timeout=cspec.connectTimeoutMs / 1e3,
+                    attempt_ttwitter=rspec.attemptTTwitterUpgrade,
+                    dest=bound.id_.show, client_id=label)
                 return FailureAccrualService(client, mk_policy())
 
             bal_kind = (cspec.loadBalancer or BalancerSpec()).kind
@@ -823,7 +854,8 @@ class Linker:
             [ThriftStatsFilter(metrics.scope("rt", label, "server"))],
             routing)
         servers = [
-            ThriftServer(server_stack, s.ip, s.port)
+            ThriftServer(server_stack, s.ip, s.port,
+                         ttwitter=rspec.attemptTTwitterUpgrade)
             for s in (rspec.servers or [ServerSpec()])
         ]
         return Router(rspec, label, server_stack, binding, servers,
@@ -871,6 +903,16 @@ class Linker:
         mk_policy_factory = self._mk_policy_factory_fn(label)
 
         def client_factory(bound: BoundName) -> Service:
+            from linkerd_tpu.namer.core import STATUS_NAMER_PREFIX
+            if bound.id_.starts_with(STATUS_NAMER_PREFIX):
+                # /$/io.buoyant.http.status/<code>: an in-process constant
+                # responder, no socket (ref: router/http/.../status.scala)
+                code = int(bound.id_[len(STATUS_NAMER_PREFIX)])
+
+                async def const_status(req):
+                    return Response(status=code)
+
+                return FnService(const_status)
             cid = bound.id_.show.lstrip("/").replace("/", ".") or "client"
             cspec, cvars = client_lookup(bound.id_)
             mk_policy = mk_policy_factory(cspec)

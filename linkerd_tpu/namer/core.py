@@ -136,6 +136,171 @@ def _fail(residual: Path) -> NameTree[Name]:
     return FAIL
 
 
+# -- io.buoyant rewriting namers (ref: namer/core/.../http.scala:163,
+#    hostport.scala, rinet.scala — /$/-addressable path rewriters whose
+#    results re-enter dtab resolution) ---------------------------------------
+
+import re as _re  # noqa: E402
+
+_HOST_RE = _re.compile(r"^[A-Za-z0-9.:_-]+$")
+_METHOD_RE = _re.compile(r"^[A-Z]+$")
+# RFC 1035/1123 label (the reference's DNS_LABEL check for port names):
+# no leading or trailing hyphen
+_DNS_LABEL_RE = _re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+
+
+def _drop_port(hostname: str) -> str:
+    idx = hostname.find(":")
+    return hostname[:idx] if idx > 0 else hostname
+
+
+def _subdomain(domain: str, hostname: str) -> Optional[str]:
+    sfx = "." + domain
+    host = _drop_port(hostname)
+    return host[:-len(sfx)] if host.endswith(sfx) else None
+
+
+@register_utility("io.buoyant.http.anyMethod")
+def _any_method(residual: Path) -> NameTree[Name]:
+    """``/METHOD/rest`` -> ``/rest``."""
+    if len(residual) >= 1 and _METHOD_RE.match(residual[0]):
+        return Leaf(residual.drop(1))
+    return NEG
+
+
+@register_utility("io.buoyant.http.anyMethodPfx")
+def _any_method_pfx(residual: Path) -> NameTree[Name]:
+    """``/pfx/METHOD/rest`` -> ``/pfx/rest``."""
+    if len(residual) >= 2 and _METHOD_RE.match(residual[1]):
+        return Leaf(Path.of(residual[0]) + residual.drop(2))
+    return NEG
+
+
+@register_utility("io.buoyant.http.anyHost")
+def _any_host(residual: Path) -> NameTree[Name]:
+    """``/host/rest`` -> ``/rest``."""
+    if len(residual) >= 1 and _HOST_RE.match(residual[0]):
+        return Leaf(residual.drop(1))
+    return NEG
+
+
+@register_utility("io.buoyant.http.anyHostPfx")
+def _any_host_pfx(residual: Path) -> NameTree[Name]:
+    """``/pfx/host/rest`` -> ``/pfx/rest``."""
+    if len(residual) >= 2 and _HOST_RE.match(residual[1]):
+        return Leaf(Path.of(residual[0]) + residual.drop(2))
+    return NEG
+
+
+@register_utility("io.buoyant.http.subdomainOf")
+def _subdomain_of(residual: Path) -> NameTree[Name]:
+    """``/domain/sub.domain/rest`` -> ``/sub/rest``."""
+    if (len(residual) >= 2 and _HOST_RE.match(residual[0])
+            and _HOST_RE.match(residual[1])):
+        sub = _subdomain(residual[0], residual[1])
+        if sub:
+            return Leaf(Path.of(sub) + residual.drop(2))
+    return NEG
+
+
+@register_utility("io.buoyant.http.subdomainOfPfx")
+def _subdomain_of_pfx(residual: Path) -> NameTree[Name]:
+    """``/domain/pfx/sub.domain/rest`` -> ``/pfx/sub/rest``."""
+    if (len(residual) >= 3 and _HOST_RE.match(residual[0])
+            and _HOST_RE.match(residual[2])):
+        sub = _subdomain(residual[0], residual[2])
+        if sub:
+            return Leaf(Path.of(residual[1], sub) + residual.drop(3))
+    return NEG
+
+
+@register_utility("io.buoyant.http.domainToPath")
+def _domain_to_path(residual: Path) -> NameTree[Name]:
+    """``/foo.buoyant.io/rest`` -> ``/io/buoyant/foo/rest``."""
+    if len(residual) >= 1 and _HOST_RE.match(residual[0]):
+        return Leaf(
+            Path.of(*reversed(residual[0].split("."))) + residual.drop(1))
+    return NEG
+
+
+@register_utility("io.buoyant.http.domainToPathPfx")
+def _domain_to_path_pfx(residual: Path) -> NameTree[Name]:
+    """``/pfx/foo.buoyant.io/rest`` -> ``/pfx/io/buoyant/foo/rest``."""
+    if len(residual) >= 2 and _HOST_RE.match(residual[1]):
+        return Leaf(Path.of(residual[0],
+                            *reversed(residual[1].split(".")))
+                    + residual.drop(2))
+    return NEG
+
+
+STATUS_NAMER_PREFIX = Path.of("$", "io.buoyant.http.status")
+
+
+@register_utility("io.buoyant.http.status")
+def _http_status(residual: Path) -> NameTree[Name]:
+    """``/<code>/rest`` binds to an in-process service that always
+    responds with <code> (ref: router/http/.../status.scala — the http
+    client factory recognizes the bound id and short-circuits)."""
+    if len(residual) >= 1:
+        try:
+            code = int(residual[0])
+        except ValueError:
+            return NEG
+        if 100 <= code < 600:
+            bid = STATUS_NAMER_PREFIX + Path.of(residual[0])
+            addr: Var[Addr] = Var(Bound.of(Address.mk("0.0.0.0", code)))
+            return Leaf(BoundName(bid, addr, residual.drop(1)))
+    return NEG
+
+
+def _host_colon_port(seg: str) -> Optional[Tuple[str, str]]:
+    parts = seg.split(":")
+    if len(parts) != 2:
+        return None
+    host, port = parts
+    if not host or len(port) > 63 or not _DNS_LABEL_RE.match(port):
+        return None
+    return host, port
+
+
+@register_utility("io.buoyant.hostportPfx")
+def _hostport_pfx(residual: Path) -> NameTree[Name]:
+    """``/pfx/host:port/etc`` -> ``/pfx/host/port/etc``."""
+    if len(residual) >= 2:
+        hp = _host_colon_port(residual[1])
+        if hp is not None:
+            return Leaf(Path.of(residual[0], hp[0], hp[1])
+                        + residual.drop(2))
+    return NEG
+
+
+@register_utility("io.buoyant.porthostPfx")
+def _porthost_pfx(residual: Path) -> NameTree[Name]:
+    """``/pfx/host:port/etc`` -> ``/pfx/port/host/etc``."""
+    if len(residual) >= 2:
+        hp = _host_colon_port(residual[1])
+        if hp is not None:
+            return Leaf(Path.of(residual[0], hp[1], hp[0])
+                        + residual.drop(2))
+    return NEG
+
+
+@register_utility("io.buoyant.rinet")
+def _rinet(residual: Path) -> NameTree[Name]:
+    """``/$/io.buoyant.rinet/<port>/<host>`` == ``/$/inet/<host>/<port>``
+    (ref: rinet.scala)."""
+    if len(residual) < 2:
+        return NEG
+    port_s, host = residual[0], residual[1]
+    try:
+        port = int(port_s)
+    except ValueError:
+        return NEG
+    addr: Var[Addr] = Var(Bound.of(Address.mk(host, port)))
+    bid = Path.of("$", "io.buoyant.rinet", port_s, host)
+    return Leaf(BoundName(bid, addr, residual.drop(2)))
+
+
 def utility_lookup(path: Path) -> NameTree[Name]:
     """Resolve a ``/$/<utility>/...`` path; unknown utilities are Neg."""
     if len(path) < 2 or path[0] != UTILITY_PREFIX:

@@ -222,3 +222,153 @@ class ReplaceTransformerConfig:
                 return const
 
         return _Replace()
+
+
+class SubnetLocalTransformer(AddressTransformer):
+    """Keep only endpoints in the local address's subnet (ref:
+    interpreter/subnet/.../SubnetLocalTransformer.scala — the
+    io.l5d.k8s.localnode shape: route only to pods on this node)."""
+
+    def __init__(self, local_ip: str, netmask: str = "255.255.255.0",
+                 kind: str = "io.l5d.k8s.localnode"):
+        super().__init__(kind)
+        prefixlen = ipaddress.ip_network(f"0.0.0.0/{netmask}").prefixlen
+        self._net = ipaddress.ip_network(
+            f"{local_ip}/{prefixlen}", strict=False)
+
+    def transform_addresses(self, addresses):
+        out = set()
+        for a in addresses:
+            try:
+                if ipaddress.ip_address(a.host) in self._net:
+                    out.add(a)
+            except ValueError:
+                continue
+        return frozenset(out)
+
+
+class MetadataFilterTransformer(AddressTransformer):
+    """Keep only endpoints whose metadata key equals ``value`` (ref:
+    MetadataFiltertingNameTreeTransformer — hostNetwork localnode keyed
+    by nodeName)."""
+
+    def __init__(self, meta_key: str, value: str,
+                 kind: str = "io.l5d.k8s.localnode"):
+        super().__init__(kind)
+        self._key = meta_key
+        self._value = value
+
+    def transform_addresses(self, addresses):
+        return frozenset(
+            a for a in addresses
+            if dict(a.meta).get(self._key) == self._value)
+
+
+class MetadataGatewayTransformer(AddressTransformer):
+    """Replace each endpoint with the gateway sharing its metadata key
+    (hostNetwork DaemonSet routing: match pod nodeName -> gateway
+    nodeName; ref: MetadataGatewayTransformer)."""
+
+    def __init__(self, gateways: "Var", meta_key: str,
+                 kind: str = "io.l5d.k8s.daemonset"):
+        super().__init__(kind)
+        self._gateways = gateways
+        self._key = meta_key
+
+    def transform_addresses(self, addresses):
+        gaddr = self._gateways.sample()
+        gateways = gaddr.addresses if isinstance(gaddr, Bound) else frozenset()
+        by_key = {}
+        for g in gateways:
+            k = dict(g.meta).get(self._key)
+            if k is not None:
+                by_key[k] = g
+        out = set()
+        for a in addresses:
+            k = dict(a.meta).get(self._key)
+            if k is not None and k in by_key:
+                out.add(by_key[k])
+        return frozenset(out)
+
+
+class _BoundTreeAddrVar:
+    """Var[Addr]-shaped view over a namer lookup's Activity[NameTree]
+    (gateway sets for the daemonset transformer come from a live
+    EndpointsNamer binding)."""
+
+    def __init__(self, activity: Activity):
+        self._activity = activity
+
+    def sample(self) -> Addr:
+        from linkerd_tpu.core.activity import Ok
+        state = self._activity.current
+        if not isinstance(state, Ok):
+            return Bound(frozenset())
+        tree = state.value
+        if isinstance(tree, Leaf):
+            return tree.value.addr.sample()
+        return Bound(frozenset())
+
+
+@register("transformer", "io.l5d.k8s.daemonset")
+@dataclass
+class DaemonSetTransformerConfig:
+    """Route via the DaemonSet pod on each endpoint's node (ref:
+    DaemonSetTransformerInitializer.scala:54 — gateways are the
+    daemonset service's endpoints; subnet match by default, nodeName
+    metadata match with hostNetwork)."""
+
+    namespace: str = ""
+    service: str = ""
+    port: str = ""
+    k8sHost: str = "localhost"
+    k8sPort: int = 8001
+    hostNetwork: bool = False
+    netmask: str = "255.255.255.0"
+    useTls: bool = False
+    caCertPath: Optional[str] = None
+    insecureSkipVerify: bool = False
+
+    def mk(self) -> AddressTransformer:
+        if not (self.namespace and self.service and self.port):
+            raise ConfigError(
+                "io.l5d.k8s.daemonset needs namespace, service and port")
+        from linkerd_tpu.k8s.namer import EndpointsNamer, _mk_api
+        api = _mk_api(self.k8sHost, self.k8sPort, self.useTls,
+                      self.caCertPath, self.insecureSkipVerify)
+        namer = EndpointsNamer(api)
+        act = namer.lookup(
+            Path.of(self.namespace, self.port, self.service))
+        gateways = _BoundTreeAddrVar(act)
+        if self.hostNetwork:
+            return MetadataGatewayTransformer(
+                gateways, "nodeName", kind="io.l5d.k8s.daemonset")
+        t = SubnetGatewayTransformer(gateways, self.netmask)
+        t.kind = "io.l5d.k8s.daemonset"
+        return t
+
+
+@register("transformer", "io.l5d.k8s.localnode")
+@dataclass
+class LocalNodeTransformerConfig:
+    """Keep only endpoints on this node (ref:
+    LocalNodeTransformerInitializer.scala:42 — POD_IP subnet match, or
+    nodeName metadata match with hostNetwork)."""
+
+    hostNetwork: bool = False
+    netmask: str = "255.255.255.0"
+    podIp: str = ""      # overrides $POD_IP (tests)
+    nodeName: str = ""   # overrides $NODE_NAME (tests)
+
+    def mk(self) -> AddressTransformer:
+        import os
+        if self.hostNetwork:
+            node = self.nodeName or os.environ.get("NODE_NAME") or ""
+            if not node:
+                raise ConfigError(
+                    "io.l5d.k8s.localnode hostNetwork needs NODE_NAME")
+            return MetadataFilterTransformer("nodeName", node)
+        ip = self.podIp or os.environ.get("POD_IP") or ""
+        if not ip:
+            raise ConfigError("io.l5d.k8s.localnode needs POD_IP")
+        return SubnetLocalTransformer(ip, self.netmask)

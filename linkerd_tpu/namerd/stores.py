@@ -634,6 +634,13 @@ class K8sDtabStore(DtabStore):
         return name, VersionedDtab(dtab, str(version).encode())
 
     def _on_list(self, obj: dict) -> None:
+        if obj.get("kind") == "Status":
+            # 404: the DTab TPR/CRD isn't registered (yet). Raising keeps
+            # the Watcher re-listing instead of priming a permanently
+            # empty store (same contract as IngressCache._on_list).
+            from linkerd_tpu.k8s.client import K8sApiError
+            raise K8sApiError(int(obj.get("code") or 404),
+                              f"dtab list failed: {obj}")
         state: Dict[str, VersionedDtab] = {}
         for item in obj.get("items") or []:
             kv = self._parse(item)

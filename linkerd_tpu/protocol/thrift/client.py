@@ -18,10 +18,19 @@ log = logging.getLogger(__name__)
 
 
 class ThriftClient(Service[ThriftCall, Optional[bytes]]):
-    def __init__(self, host: str, port: int, connect_timeout: float = 3.0):
+    def __init__(self, host: str, port: int, connect_timeout: float = 3.0,
+                 attempt_ttwitter: bool = False, dest: str = "",
+                 client_id: str = ""):
         self.host = host
         self.port = port
         self.connect_timeout = connect_timeout
+        # Negotiate the TTwitter upgrade on connect; on success every
+        # request carries a RequestHeader with trace + dtab context
+        # (ref: TTwitterClientFilter, attemptTTwitterUpgrade)
+        self.attempt_ttwitter = attempt_ttwitter
+        self.dest = dest
+        self.client_id = client_id
+        self._upgraded = False
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._lock = asyncio.Lock()
@@ -34,9 +43,46 @@ class ThriftClient(Service[ThriftCall, Optional[bytes]]):
 
     async def _ensure_conn(self) -> None:
         if self._writer is None or self._writer.is_closing():
+            self._upgraded = False
             self._reader, self._writer = await asyncio.wait_for(
                 asyncio.open_connection(self.host, self.port),
                 self.connect_timeout)
+            if self.attempt_ttwitter:
+                await self._try_upgrade()
+
+    async def _try_upgrade(self) -> None:
+        from linkerd_tpu.protocol.thrift import ttwitter as ttw
+        from linkerd_tpu.protocol.thrift.codec import (
+            REPLY, parse_message_header,
+        )
+        try:
+            write_framed(self._writer, ttw.encode_upgrade_request(0))
+            await self._writer.drain()
+            reply = await asyncio.wait_for(
+                read_framed(self._reader), self.connect_timeout)
+            if reply is None:
+                raise ConnectionError("closed during ttwitter upgrade")
+            _, _, mtype = parse_message_header(reply)
+            # EXCEPTION means a plain server: fall back silently
+            self._upgraded = mtype == REPLY
+        except (ConnectionResetError, BrokenPipeError,
+                asyncio.IncompleteReadError, asyncio.TimeoutError,
+                Exception) as e:
+            # ANY failed probe leaves the connection desynced (its reply
+            # may still be in flight and could be served to a later
+            # caller) — never cache it
+            self._teardown()
+            raise ConnectionError(
+                f"thrift backend lost during upgrade: {e!r}") from None
+
+    def _wrap_request(self, call: ThriftCall) -> bytes:
+        from linkerd_tpu.protocol.thrift import ttwitter as ttw
+        header = ttw.mk_request_header(
+            trace=call.ctx.get("trace"),
+            dest=call.ctx.get("dest") or self.dest,
+            dtab=call.ctx.get("dtab"),
+            client_id=self.client_id)
+        return ttw.prepend_struct(header, call.payload)
 
     async def __call__(self, call: ThriftCall) -> Optional[bytes]:
         self.pending += 1
@@ -44,8 +90,10 @@ class ThriftClient(Service[ThriftCall, Optional[bytes]]):
             # serial per connection: frame pairs must not interleave
             async with self._lock:
                 await self._ensure_conn()
+                payload = (self._wrap_request(call) if self._upgraded
+                           else call.payload)
                 try:
-                    write_framed(self._writer, call.payload)
+                    write_framed(self._writer, payload)
                     await self._writer.drain()
                     if call.oneway:
                         return None
@@ -62,6 +110,15 @@ class ThriftClient(Service[ThriftCall, Optional[bytes]]):
                 if reply is None:
                     self._teardown()
                     raise ConnectionError("thrift backend closed connection")
+                if self._upgraded:
+                    from linkerd_tpu.protocol.thrift import ttwitter as ttw
+                    try:
+                        _, reply = ttw.peel_struct(
+                            ttw.TResponseHeader, reply)
+                    except Exception:  # noqa: BLE001 — desynced
+                        self._teardown()
+                        raise ConnectionError(
+                            "unparseable ttwitter response header")
                 # Verify the reply matches this request; a mismatched
                 # seqid means a stale/desynced exchange (never serve
                 # caller A's payload to caller B).

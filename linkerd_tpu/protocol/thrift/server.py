@@ -17,15 +17,23 @@ from linkerd_tpu.protocol.thrift.codec import (
 )
 from linkerd_tpu.router.service import Service
 
+from linkerd_tpu.protocol.thrift.ttwitter import (  # noqa: E402
+    CAN_TRACE_METHOD as _CAN_TRACE,
+)
+
 log = logging.getLogger(__name__)
 
 
 class ThriftServer:
     def __init__(self, service: Service[ThriftCall, Optional[bytes]],
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 ttwitter: bool = True):
         self.service = service
         self.host = host
         self.port = port
+        # answer TTwitter upgrade requests; upgraded connections carry
+        # RequestHeader/ResponseHeader framing (ref: TTwitterServerFilter)
+        self.ttwitter = ttwitter
         self._server: Optional[asyncio.base_events.Server] = None
         self._conns: set = set()
         self._conn_tasks: set = set()
@@ -60,22 +68,53 @@ class ThriftServer:
         if task is not None:
             self._conn_tasks.add(task)
             task.add_done_callback(self._conn_tasks.discard)
+        upgraded = False  # per-connection TTwitter state
         try:
             while True:
                 payload = await read_framed(reader)
                 if payload is None:
                     return
+                ctx: dict = {}
+                if upgraded:
+                    from linkerd_tpu.protocol.thrift import ttwitter as ttw
+                    try:
+                        header, payload = ttw.peel_struct(
+                            ttw.TRequestHeader, payload)
+                    except Exception as e:  # noqa: BLE001 — desynced conn
+                        log.debug("bad ttwitter header: %s", e)
+                        return
+                    trace = ttw.header_trace(header)
+                    if trace is not None:
+                        ctx["trace"] = trace
+                    ctx["dtab"] = ttw.header_dtab(header)
+                    if header.dest:
+                        ctx["dest"] = header.dest
+                    if header.client_id is not None:
+                        ctx["clientId"] = header.client_id.name
                 try:
                     name, seqid, mtype = parse_message_header(payload)
                 except Exception as e:  # noqa: BLE001 - bad frame: drop conn
                     log.debug("bad thrift frame: %s", e)
                     return
-                call = ThriftCall(payload, name, seqid, mtype)
+                if (self.ttwitter and not upgraded and mtype == 1
+                        and name == _CAN_TRACE):
+                    from linkerd_tpu.protocol.thrift import ttwitter as ttw
+                    upgraded = True
+                    write_framed(writer, ttw.encode_upgrade_reply(seqid))
+                    await writer.drain()
+                    continue
+                call = ThriftCall(payload, name, seqid, mtype, ctx=ctx)
                 try:
                     reply = await self.service(call)
                 except Exception as e:  # noqa: BLE001 -> thrift exception
                     reply = encode_exception(name, seqid, repr(e))
                 if not call.oneway and reply is not None:
+                    if upgraded:
+                        from linkerd_tpu.protocol.thrift import (
+                            ttwitter as ttw,
+                        )
+                        reply = ttw.prepend_struct(
+                            ttw.TResponseHeader(), reply)
                     write_framed(writer, reply)
                     await writer.drain()
         except (ConnectionResetError, BrokenPipeError,

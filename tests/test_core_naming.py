@@ -184,3 +184,100 @@ class TestDtab:
         assert p.matches(Path.read("/a/zzz/c/d"))
         assert not p.matches(Path.read("/a/b"))
         assert not p.matches(Path.read("/a/b/x"))
+
+
+class TestUtilityRewritingNamers:
+    """ref: namer/core/.../http.scala:163, hostport.scala, rinet.scala."""
+
+    def _interp(self):
+        from linkerd_tpu.namer.core import ConfiguredDtabNamer
+        return ConfiguredDtabNamer([])
+
+    def _bind_sync(self, interp, dtab, path):
+        from linkerd_tpu.core import Dtab, Path
+        act = interp.bind(Dtab.read(dtab), Path.read(path))
+        return act.sample().simplified
+
+    def test_http_family(self):
+        from linkerd_tpu.core.nametree import Leaf, Neg
+
+        interp = self._interp()
+        # anyMethodPfx: /svc/GET/web -> /svc/web
+        tree = self._bind_sync(
+            interp,
+            "/svc/web => /$/inet/127.0.0.1/8080 ;"
+            "/svc => /$/io.buoyant.http.anyMethodPfx/svc ;",
+            "/svc/GET/web")
+        assert isinstance(tree, Leaf)
+        assert "/inet/127.0.0.1/8080" in tree.value.id_.show
+
+        # anyHostPfx: /svc/example.com/web -> /svc/web
+        tree2 = self._bind_sync(
+            interp,
+            "/svc/web => /$/inet/127.0.0.1/8080 ;"
+            "/svc => /$/io.buoyant.http.anyHostPfx/svc ;",
+            "/svc/example.com/web")
+        assert isinstance(tree2, Leaf)
+
+        # subdomainOf: /web.example.com -> /web
+        tree3 = self._bind_sync(
+            interp,
+            "/host/web => /$/inet/127.0.0.1/8080 ;"
+            "/svc => /$/io.buoyant.http.subdomainOfPfx/example.com/host ;",
+            "/svc/web.example.com")
+        assert isinstance(tree3, Leaf)
+
+        # domainToPathPfx: /pfx/foo.buoyant.io -> /pfx/io/buoyant/foo
+        tree4 = self._bind_sync(
+            interp,
+            "/d/io/buoyant/foo => /$/inet/127.0.0.1/1 ;"
+            "/svc => /$/io.buoyant.http.domainToPathPfx/d ;",
+            "/svc/foo.buoyant.io")
+        assert isinstance(tree4, Leaf)
+
+        # non-method segment does not match anyMethodPfx
+        tree5 = self._bind_sync(
+            interp,
+            "/svc => /$/io.buoyant.http.anyMethodPfx/svc ;",
+            "/svc/lower/web")
+        assert isinstance(tree5, Neg)
+
+    def test_hostport_and_rinet(self):
+        from linkerd_tpu.core.nametree import Leaf
+
+        interp = self._interp()
+        # hostportPfx: /svc/web:8080 -> /svc/web/8080
+        tree = self._bind_sync(
+            interp,
+            "/pfx/web/8080 => /$/inet/127.0.0.1/8080 ;"
+            "/svc => /$/io.buoyant.hostportPfx/pfx ;",
+            "/svc/web:8080")
+        assert isinstance(tree, Leaf)
+
+        # porthostPfx: /svc/web:http -> /svc/http/web
+        tree2 = self._bind_sync(
+            interp,
+            "/pfx/http/web => /$/inet/127.0.0.1/80 ;"
+            "/svc => /$/io.buoyant.porthostPfx/pfx ;",
+            "/svc/web:http")
+        assert isinstance(tree2, Leaf)
+
+        # rinet: port before host
+        tree3 = self._bind_sync(
+            interp, "", "/$/io.buoyant.rinet/8080/web.example.com/rest")
+        assert isinstance(tree3, Leaf)
+        bn = tree3.value
+        assert bn.residual.show == "/rest"
+        a = next(iter(bn.addr.sample().addresses))
+        assert (a.host, a.port) == ("web.example.com", 8080)
+
+    def test_status_namer_binds(self):
+        from linkerd_tpu.core.nametree import Leaf, Neg
+
+        interp = self._interp()
+        tree = self._bind_sync(interp, "", "/$/io.buoyant.http.status/418/x")
+        assert isinstance(tree, Leaf)
+        assert tree.value.id_.show == "/$/io.buoyant.http.status/418"
+        assert isinstance(
+            self._bind_sync(interp, "", "/$/io.buoyant.http.status/999"),
+            Neg)

@@ -1,0 +1,143 @@
+"""fs and k8s-configMap interpreters: the base dtab followed live from a
+watched file / ConfigMap key (ref: FsInterpreterConfig.scala:35 and the
+configmap interpreter in interpreter/k8s)."""
+
+import asyncio
+import json
+
+from linkerd_tpu.config import instantiate
+from linkerd_tpu.core import Dtab, Path
+from linkerd_tpu.core.activity import Ok
+from linkerd_tpu.core.nametree import Leaf, Neg
+from linkerd_tpu.namer.fs import FsNamer
+from linkerd_tpu.protocol.http.message import Request, Response
+from linkerd_tpu.protocol.http.server import HttpServer
+from linkerd_tpu.router.service import FnService
+import linkerd_tpu.interpreter.configs  # noqa: F401 — registers kinds
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+async def wait_until(fn, timeout=5.0):
+    for _ in range(int(timeout / 0.02)):
+        v = fn()
+        if v:
+            return v
+        await asyncio.sleep(0.02)
+    raise AssertionError("condition not met")
+
+
+class TestFsInterpreter:
+    def test_dtab_follows_file(self, tmp_path):
+        async def go():
+            disco = tmp_path / "disco"
+            disco.mkdir()
+            (disco / "web").write_text("127.0.0.1 9999\n")
+            (disco / "api").write_text("127.0.0.1 8888\n")
+            dtab_file = tmp_path / "dtab"
+            dtab_file.write_text("/svc => /#/io.l5d.fs/web ;\n")
+
+            cfg = instantiate("interpreter", {
+                "kind": "io.l5d.fs", "dtabFile": str(dtab_file)})
+            namer = FsNamer(str(disco))
+            interp = cfg.mk([(Path.read("/io.l5d.fs"), namer)])
+
+            act = interp.bind(Dtab.empty(), Path.read("/svc"))
+            state = await wait_until(
+                lambda: act.current if isinstance(act.current, Ok) else None)
+            assert isinstance(state.value, Leaf)
+            bound = state.value.value
+            assert bound.id_.show == "/#/io.l5d.fs/web"
+            assert {a.port for a in bound.addr.sample().addresses} == {9999}
+
+            # editing the dtab file re-binds live
+            dtab_file.write_text("/svc => /#/io.l5d.fs/api ;\n")
+            interp._file_dtab.refresh()  # deterministic poll
+            act2 = interp.bind(Dtab.empty(), Path.read("/svc"))
+            state2 = await wait_until(
+                lambda: (act2.current
+                         if isinstance(act2.current, Ok)
+                         and isinstance(act2.current.value, Leaf)
+                         and act2.current.value.value.id_.show.endswith("api")
+                         else None))
+            assert state2.value.value.id_.show == "/#/io.l5d.fs/api"
+            interp._file_dtab.close()
+            namer.close()
+
+        run(go())
+
+
+class FakeConfigMapApi:
+    def __init__(self, dtab_text):
+        self.data = {"dtab": dtab_text}
+        self.version = 5
+        self.events: asyncio.Queue = asyncio.Queue()
+
+    def _obj(self):
+        return {"kind": "ConfigMap",
+                "metadata": {"name": "l5d-dtab", "namespace": "default",
+                             "resourceVersion": str(self.version)},
+                "data": dict(self.data)}
+
+    def service(self):
+        async def handler(req: Request) -> Response:
+            assert "/api/v1/namespaces/default/configmaps/l5d-dtab" in req.uri
+            if "watch=true" not in req.uri:
+                return Response(status=200,
+                                body=json.dumps(self._obj()).encode())
+
+            async def gen():
+                while True:
+                    evt = await self.events.get()
+                    if evt is None:
+                        return
+                    yield (json.dumps(evt) + "\n").encode()
+            return Response(status=200, body_stream=gen())
+        return FnService(handler)
+
+    def update(self, dtab_text):
+        self.data["dtab"] = dtab_text
+        self.version += 1
+        self.events.put_nowait({"type": "MODIFIED", "object": self._obj()})
+
+
+class TestConfigMapInterpreter:
+    def test_dtab_follows_configmap(self, tmp_path):
+        async def go():
+            disco = tmp_path / "disco"
+            disco.mkdir()
+            (disco / "web").write_text("127.0.0.1 9999\n")
+            (disco / "api").write_text("127.0.0.1 8888\n")
+
+            fake = FakeConfigMapApi("/svc => /#/io.l5d.fs/web ;")
+            server = await HttpServer(fake.service()).start()
+
+            cfg = instantiate("interpreter", {
+                "kind": "io.l5d.k8s.configMap", "name": "l5d-dtab",
+                "host": "127.0.0.1", "port": server.bound_port})
+            namer = FsNamer(str(disco))
+            interp = cfg.mk([(Path.read("/io.l5d.fs"), namer)])
+
+            act = interp.bind(Dtab.empty(), Path.read("/svc"))
+            state = await wait_until(
+                lambda: (act.current
+                         if isinstance(act.current, Ok)
+                         and isinstance(act.current.value, Leaf)
+                         else None))
+            assert state.value.value.id_.show == "/#/io.l5d.fs/web"
+
+            # configmap edit re-binds live through the watch stream
+            fake.update("/svc => /#/io.l5d.fs/api ;")
+            act2 = interp.bind(Dtab.empty(), Path.read("/svc"))
+            await wait_until(
+                lambda: (isinstance(act2.current, Ok)
+                         and isinstance(act2.current.value, Leaf)
+                         and act2.current.value.value.id_.show.endswith(
+                             "api")))
+            interp._configmap.close()
+            namer.close()
+            await server.close()
+
+        run(go())

@@ -44,7 +44,10 @@ def test_header_roundtrip():
 
 
 async def fake_backend(tag: bytes):
-    """A framed-thrift echo server tagging its replies."""
+    """A framed-thrift echo server tagging its replies. Like any real
+    non-TTwitter server it answers the upgrade probe's unknown method
+    with a TApplicationException (so the proxy falls back to plain
+    thrift)."""
     async def on_conn(reader, writer):
         try:
             while True:
@@ -52,6 +55,11 @@ async def fake_backend(tag: bytes):
                 if payload is None:
                     return
                 name, seqid, _ = parse_message_header(payload)
+                if name.startswith("__can__finagle__trace"):
+                    write_framed(writer, encode_exception(
+                        name, seqid, "Invalid method name"))
+                    await writer.drain()
+                    continue
                 write_framed(writer, mk_reply(name, seqid, b"\x0b" + tag))
                 await writer.drain()
         except (ConnectionResetError, asyncio.IncompleteReadError):
@@ -151,4 +159,72 @@ namers:
             writer.close()
             await linker.close()
             backend.close()
+        run(go())
+
+
+class TestTTwitterUpgrade:
+    def test_trace_and_dtab_survive_thrift_hop(self, tmp_path):
+        """An upgraded caller's trace id and dtab delegations cross the
+        proxy to an upgraded backend (ref: TTwitterClientFilter /
+        TTwitterServerFilter; VERDICT r2 item 7)."""
+        from linkerd_tpu.core import Path as CorePath
+        from linkerd_tpu.linker import load_linker
+        from linkerd_tpu.protocol.thrift import ttwitter as ttw
+        from linkerd_tpu.protocol.thrift.client import ThriftClient
+        from linkerd_tpu.protocol.thrift.codec import ThriftCall
+        from linkerd_tpu.protocol.thrift.server import ThriftServer
+        from linkerd_tpu.router.service import FnService
+        from linkerd_tpu.router.tracing import TraceId
+
+        disco = tmp_path / "disco"
+        disco.mkdir()
+        seen = {}
+
+        async def go():
+            async def handler(call):
+                seen["trace"] = call.ctx.get("trace")
+                seen["dtab"] = call.ctx.get("dtab")
+                seen["clientId"] = call.ctx.get("clientId")
+                return mk_reply(call.name, call.seqid, b"\x00")
+
+            backend = await ThriftServer(FnService(handler)).start()
+            (disco / "shadow").write_text(
+                f"127.0.0.1 {backend.bound_port}\n")
+            # base dtab routes nowhere useful; the CALLER's delegation
+            # overrides it to the live backend
+            cfg = f"""
+routers:
+- protocol: thrift
+  label: tt
+  dtab: |
+    /svc => /$/fail ;
+  servers: [{{port: 0}}]
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+            linker = load_linker(cfg)
+            await linker.start()
+            rport = linker.routers[0].server_ports[0]
+
+            client = ThriftClient("127.0.0.1", rport,
+                                  attempt_ttwitter=True)
+            trace = TraceId(trace_id=0xABCD1234, span_id=0x77,
+                            parent_id=0x55, sampled=True)
+            from linkerd_tpu.core import Dtab
+            call = ThriftCall(mk_call("getUser", 3), "getUser", 3, 1)
+            call.ctx["trace"] = trace
+            call.ctx["dtab"] = Dtab.read("/svc => /#/io.l5d.fs/shadow")
+            reply = await client(call)
+            assert parse_message_header(reply)[2] == REPLY
+
+            # the backend observed the caller's trace id through BOTH hops
+            assert seen["trace"] is not None
+            assert seen["trace"].trace_id == 0xABCD1234
+            # and the caller's dtab override actually routed the request
+            assert seen["dtab"] is not None
+            await client.close()
+            await linker.close()
+            await backend.close()
+
         run(go())

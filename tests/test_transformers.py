@@ -141,3 +141,49 @@ namers:
         assert "secret" not in text       # no dtabs/paths leak
         assert report["namers"] == ["io.l5d.fs"]
         assert report["routers"][0]["identifiers"] == ["io.l5d.methodAndHost"]
+
+
+class TestK8sTransformerKinds:
+    def test_localnode_subnet_and_hostnetwork(self):
+        from linkerd_tpu.config import instantiate
+        from linkerd_tpu.core.addr import Address
+
+        t = instantiate("transformer", {
+            "kind": "io.l5d.k8s.localnode", "podIp": "10.0.1.7"}).mk()
+        addrs = frozenset({Address.mk("10.0.1.20", 80),
+                           Address.mk("10.0.2.20", 80)})
+        out = t.transform_addresses(addrs)
+        assert {a.host for a in out} == {"10.0.1.20"}
+
+        t2 = instantiate("transformer", {
+            "kind": "io.l5d.k8s.localnode", "hostNetwork": True,
+            "nodeName": "node-a"}).mk()
+        addrs2 = frozenset({Address.mk("10.0.1.20", 80, nodeName="node-a"),
+                            Address.mk("10.0.2.20", 80, nodeName="node-b")})
+        out2 = t2.transform_addresses(addrs2)
+        assert {a.host for a in out2} == {"10.0.1.20"}
+
+    def test_daemonset_subnet_and_hostnetwork_gateways(self):
+        from linkerd_tpu.core import Var
+        from linkerd_tpu.core.addr import Address, Bound
+        from linkerd_tpu.namer.transformers import (
+            MetadataGatewayTransformer, SubnetGatewayTransformer,
+        )
+
+        gw = Var(Bound(frozenset({
+            Address.mk("10.0.1.1", 4140, nodeName="node-a"),
+            Address.mk("10.0.2.1", 4140, nodeName="node-b")})))
+        t = SubnetGatewayTransformer(gw, "255.255.255.0")
+        pods = frozenset({Address.mk("10.0.1.20", 80),
+                          Address.mk("10.0.1.21", 80),
+                          Address.mk("10.0.2.30", 80)})
+        out = t.transform_addresses(pods)
+        # pods collapse onto their subnet's gateway
+        assert {(a.host, a.port) for a in out} == {
+            ("10.0.1.1", 4140), ("10.0.2.1", 4140)}
+
+        t2 = MetadataGatewayTransformer(gw, "nodeName")
+        pods2 = frozenset({Address.mk("1.2.3.4", 80, nodeName="node-a"),
+                           Address.mk("5.6.7.8", 80, nodeName="node-x")})
+        out2 = t2.transform_addresses(pods2)
+        assert {a.host for a in out2} == {"10.0.1.1"}
