@@ -74,6 +74,83 @@ def scorer_throughput() -> dict:
     }
 
 
+def sharded_cpu8_scorer() -> dict:
+    """Scorer rows/s on the virtual 8-device CPU mesh (dp x tp GSPMD
+    path) vs 1 CPU device — keeps a tracked number on the sharded serving
+    path even on 1-chip hardware (VERDICT r2 item 8)."""
+    import subprocess
+
+    code = r"""
+import asyncio, json, time
+import numpy as np
+from linkerd_tpu.telemetry.anomaly import InProcessScorer
+
+async def measure():
+    scorer = InProcessScorer()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2048, scorer.cfg.in_dim), dtype=np.float32)
+    await scorer.score(x)  # compile
+    t0 = time.perf_counter()
+    for _ in range(30):
+        await scorer.score(x)
+    dt = time.perf_counter() - t0
+    import jax
+    return {"rows_per_s": round(2048 * 30 / dt, 1),
+            "n_devices": len(jax.devices()),
+            "mesh": dict(scorer.mesh.shape) if scorer.mesh else None}
+
+print(json.dumps(asyncio.run(measure())))
+"""
+    out = {}
+    for n in (1, 8):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count={n} "
+                            + env.get("XLA_FLAGS", ""))
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=env, capture_output=True,
+            text=True, timeout=600,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        key = f"cpu{n}"
+        if proc.returncode != 0:
+            out[key] = {"error": proc.stderr[-300:]}
+        else:
+            out[key] = json.loads(proc.stdout.strip().splitlines()[-1])
+    return out
+
+
+def subtle_auc_bench() -> dict:
+    """Configs 4 (k8s rolling restart) and 5 (istio 50-svc cascade):
+    subtle-fault AUC — latency-only inflation, partial error rates,
+    cascades (VERDICT r2 item 5)."""
+    import subprocess
+
+    out: dict = {}
+    aucs = []
+    labeled = 0
+    for mod, req in (("benchmarks.config4_k8s", "600"),
+                     ("benchmarks.config5_istio", "400")):
+        proc = subprocess.run(
+            [sys.executable, "-m", mod, "--requests", req],
+            capture_output=True, text=True, timeout=900,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        key = mod.rsplit(".", 1)[1]
+        if proc.returncode != 0:
+            out[key] = {"error": proc.stderr[-500:]}
+            continue
+        r = json.loads(proc.stdout.strip().splitlines()[-1])
+        out[key] = r
+        labeled += r.get("labeled_n", 0)
+        for k, v in r.items():
+            if k.startswith("fault_auc") and isinstance(v, float):
+                aucs.append(v)
+    if aucs:
+        out["fault_auc_subtle"] = round(min(aucs), 4)  # worst case rules
+        out["labeled_n_total"] = labeled
+    return out
+
+
 def proxy_bench() -> dict:
     """Config 1 through the fastpath engine, as subprocesses."""
     import subprocess
@@ -145,6 +222,19 @@ def main() -> None:
         detail["fault_auc"] = a.get("fault_auc")
     except Exception as e:  # noqa: BLE001
         detail["auc_error"] = repr(e)
+
+    try:
+        s = subtle_auc_bench()
+        detail["fault_auc_subtle"] = s.get("fault_auc_subtle")
+        detail["subtle"] = s
+    except Exception as e:  # noqa: BLE001
+        detail["subtle_auc_error"] = repr(e)
+
+    try:
+        detail.setdefault("scorer", {})["sharded_cpu8"] = \
+            sharded_cpu8_scorer()
+    except Exception as e:  # noqa: BLE001
+        detail["sharded_cpu8_error"] = repr(e)
 
     baseline = 50_000.0  # north-star: >=50k req/s scored (BASELINE.md)
     print(json.dumps({

@@ -46,11 +46,14 @@ class FileDtab:
             with open(self.path, "r", encoding="utf-8") as f:
                 text = f.read()
             self.activity.update(Ok(Dtab.read(text)))
-            self._mtime = mtime
         except Exception as e:  # noqa: BLE001 — bad dtab: keep last good
             log.warning("fs interpreter: bad dtab in %s: %s", self.path, e)
             if not isinstance(self.activity.current, Ok):
                 self.activity.set_exception(e)
+        finally:
+            # record the mtime even when parsing failed: a persistently
+            # bad file warns once per EDIT, not once per poll tick
+            self._mtime = mtime
 
     def start(self) -> "FileDtab":
         if self._task is None or self._task.done():
@@ -78,19 +81,7 @@ class FsInterpreterConfig:
         if not self.dtabFile:
             raise ConfigError("io.l5d.fs interpreter needs dtabFile")
         file_dtab = FileDtab(self.dtabFile, self.pollIntervalSecs)
-        try:
-            asyncio.get_running_loop()
-            file_dtab.start()
-        except RuntimeError:
-            # no loop yet (config time): the first bind's loop starts it
-            pass
-        interp = ConfiguredDtabNamer(list(namers), dtab=file_dtab.activity)
-        interp._file_dtab = file_dtab  # keep a handle for refresh/close
-        _orig_bind = interp.bind
-
-        def bind(local_dtab, path):
-            file_dtab.start()
-            return _orig_bind(local_dtab, path)
-
-        interp.bind = bind
+        interp = ConfiguredDtabNamer(list(namers), dtab=file_dtab.activity,
+                                     on_bind=lambda: file_dtab.start())
+        interp._file_dtab = file_dtab  # handle for refresh/close (tests)
         return interp

@@ -79,13 +79,7 @@ class ConfigMapInterpreterConfig:
         api = _mk_api(self.host, self.port, self.useTls,
                       self.caCertPath, self.insecureSkipVerify)
         cm = ConfigMapDtab(api, self.namespace, self.name, self.filename)
-        interp = ConfiguredDtabNamer(list(namers), dtab=cm.activity)
-        interp._configmap = cm
-        _orig_bind = interp.bind
-
-        def bind(local_dtab, path):
-            cm.start()
-            return _orig_bind(local_dtab, path)
-
-        interp.bind = bind
+        interp = ConfiguredDtabNamer(list(namers), dtab=cm.activity,
+                                     on_bind=lambda: cm.start())
+        interp._configmap = cm  # handle for close (tests)
         return interp

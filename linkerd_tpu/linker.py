@@ -78,6 +78,17 @@ DEFAULT_ADMIN_PORT = 9990  # ref: Linker.scala:37
 DEFAULT_HTTP_PORT = 4140   # ref: linkerd http router default
 
 
+
+def _status_code_of(bound) -> Optional[int]:
+    """The constant-response code when ``bound`` is the in-process
+    /$/io.buoyant.http.status namer, else None (single source for all
+    protocol client factories)."""
+    from linkerd_tpu.namer.core import STATUS_NAMER_PREFIX
+    if bound.id_.starts_with(STATUS_NAMER_PREFIX):
+        return int(bound.id_[len(STATUS_NAMER_PREFIX)])
+    return None
+
+
 class _PruneOnClose(Service):
     """Delegates to a service; prunes a metrics subtree when closed."""
 
@@ -522,14 +533,13 @@ class Linker:
         mk_policy_factory = self._mk_policy_factory_fn(label)
 
         def client_factory(bound: BoundName) -> Service:
-            from linkerd_tpu.namer.core import STATUS_NAMER_PREFIX
-            if bound.id_.starts_with(STATUS_NAMER_PREFIX):
+            code = _status_code_of(bound)
+            if code is not None:
                 from linkerd_tpu.protocol.h2.messages import H2Response
                 from linkerd_tpu.protocol.h2.stream import stream_of
-                code = int(bound.id_[len(STATUS_NAMER_PREFIX)])
 
-                async def const_status(req):
-                    return H2Response(status=code, stream=stream_of(b""))
+                async def const_status(req, _c=code):
+                    return H2Response(status=_c, stream=stream_of(b""))
 
                 return FnService(const_status)
             cid = bound.id_.show.lstrip("/").replace("/", ".") or "client"
@@ -676,8 +686,7 @@ class Linker:
         MuxStatsFilter = BasicStatsFilter
 
         def client_factory(bound: BoundName) -> Service:
-            from linkerd_tpu.namer.core import STATUS_NAMER_PREFIX
-            if bound.id_.starts_with(STATUS_NAMER_PREFIX):
+            if _status_code_of(bound) is not None:
                 raise ConfigError(
                     "/$/io.buoyant.http.status is only available to "
                     "http/h2 routers")
@@ -794,8 +803,7 @@ class Linker:
                     req, rsp, None) is ResponseClass.SUCCESS)
 
         def client_factory(bound: BoundName) -> Service:
-            from linkerd_tpu.namer.core import STATUS_NAMER_PREFIX
-            if bound.id_.starts_with(STATUS_NAMER_PREFIX):
+            if _status_code_of(bound) is not None:
                 raise ConfigError(
                     "/$/io.buoyant.http.status is only available to "
                     "http/h2 routers")
@@ -903,14 +911,12 @@ class Linker:
         mk_policy_factory = self._mk_policy_factory_fn(label)
 
         def client_factory(bound: BoundName) -> Service:
-            from linkerd_tpu.namer.core import STATUS_NAMER_PREFIX
-            if bound.id_.starts_with(STATUS_NAMER_PREFIX):
+            code = _status_code_of(bound)
+            if code is not None:
                 # /$/io.buoyant.http.status/<code>: an in-process constant
                 # responder, no socket (ref: router/http/.../status.scala)
-                code = int(bound.id_[len(STATUS_NAMER_PREFIX)])
-
-                async def const_status(req):
-                    return Response(status=code)
+                async def const_status(req, _c=code):
+                    return Response(status=_c)
 
                 return FnService(const_status)
             cid = bound.id_.show.lstrip("/").replace("/", ".") or "client"

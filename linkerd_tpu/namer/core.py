@@ -329,12 +329,20 @@ class ConfiguredDtabNamer(NameInterpreter):
     """
 
     def __init__(self, namers: Sequence[Tuple[Path, Namer]] = (),
-                 dtab: Optional[Activity] = None):
+                 dtab: Optional[Activity] = None,
+                 on_bind: Optional[Callable[[], None]] = None):
         self.namers = list(namers)
         self.dtab_activity: Activity = (
             dtab if dtab is not None else Activity.value(Dtab.empty()))
+        # lazy-start hook: watched-dtab interpreters (fs file, k8s
+        # configmap) start their watch loop on first bind, when an event
+        # loop is guaranteed to exist
+        self.on_bind = on_bind
 
     def bind(self, local_dtab: Dtab, path: Path) -> Activity[NameTree[BoundName]]:
+        if self.on_bind is not None:
+            self.on_bind()
+
         def with_dtab(base: Dtab) -> Activity[NameTree[BoundName]]:
             dtab = base + local_dtab
             return self._bind(dtab, path, 0)

@@ -232,9 +232,17 @@ class SubnetLocalTransformer(AddressTransformer):
     def __init__(self, local_ip: str, netmask: str = "255.255.255.0",
                  kind: str = "io.l5d.k8s.localnode"):
         super().__init__(kind)
-        prefixlen = ipaddress.ip_network(f"0.0.0.0/{netmask}").prefixlen
-        self._net = ipaddress.ip_network(
-            f"{local_ip}/{prefixlen}", strict=False)
+        # same syntaxes as SubnetGatewayTransformer: prefix length or
+        # dotted mask; bad values are config errors, not tracebacks
+        try:
+            prefixlen = int(netmask) if "." not in netmask else \
+                ipaddress.ip_network(f"0.0.0.0/{netmask}").prefixlen
+            self._net = ipaddress.ip_network(
+                f"{local_ip}/{prefixlen}", strict=False)
+        except ValueError as e:
+            raise ConfigError(
+                f"bad localnode ip/netmask {local_ip!r}/{netmask!r}: {e}"
+            ) from None
 
     def transform_addresses(self, addresses):
         out = set()

@@ -65,9 +65,7 @@ class ThriftClient(Service[ThriftCall, Optional[bytes]]):
             _, _, mtype = parse_message_header(reply)
             # EXCEPTION means a plain server: fall back silently
             self._upgraded = mtype == REPLY
-        except (ConnectionResetError, BrokenPipeError,
-                asyncio.IncompleteReadError, asyncio.TimeoutError,
-                Exception) as e:
+        except Exception as e:  # noqa: BLE001
             # ANY failed probe leaves the connection desynced (its reply
             # may still be in flight and could be served to a later
             # caller) — never cache it

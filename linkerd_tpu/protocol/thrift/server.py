@@ -96,11 +96,20 @@ class ThriftServer:
                 except Exception as e:  # noqa: BLE001 - bad frame: drop conn
                     log.debug("bad thrift frame: %s", e)
                     return
-                if (self.ttwitter and not upgraded and mtype == 1
-                        and name == _CAN_TRACE):
-                    from linkerd_tpu.protocol.thrift import ttwitter as ttw
-                    upgraded = True
-                    write_framed(writer, ttw.encode_upgrade_reply(seqid))
+                if not upgraded and mtype == 1 and name == _CAN_TRACE:
+                    if self.ttwitter:
+                        from linkerd_tpu.protocol.thrift import (
+                            ttwitter as ttw,
+                        )
+                        upgraded = True
+                        write_framed(writer,
+                                     ttw.encode_upgrade_reply(seqid))
+                    else:
+                        # never forward the probe downstream: a REPLY from
+                        # there would desync BOTH hops. Answer like any
+                        # plain thrift server (unknown method).
+                        write_framed(writer, encode_exception(
+                            name, seqid, "Invalid method name"))
                     await writer.drain()
                     continue
                 call = ThriftCall(payload, name, seqid, mtype, ctx=ctx)

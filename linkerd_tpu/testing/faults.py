@@ -92,3 +92,19 @@ def auc(labels, scores) -> float:
                 rank_sum += avg_rank
         i = j
     return (rank_sum - n_pos * (n_pos + 1) / 2.0) / (n_pos * n_neg)
+
+
+class WindowLabeler(Filter[Request, Response]):
+    """Labels responses anomalous while a named window is open — used for
+    cascade/degradation scenarios where the anomaly is indirect (inherited
+    latency), so no injector touches the request itself. The label rides
+    the same response header FaultInjector uses."""
+
+    def __init__(self):
+        self.active = False
+
+    async def apply(self, req: Request, service: Service) -> Response:
+        rsp = await service(req)
+        rsp.headers.set(FaultInjector.LABEL_HEADER,
+                        "1" if self.active else "0")
+        return rsp
