@@ -106,8 +106,12 @@ print(json.dumps(asyncio.run(measure())))
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["JAX_PLATFORMS"] = "cpu"
-        env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count={n} "
-                            + env.get("XLA_FLAGS", ""))
+        # strip any pre-existing device-count flag (XLA takes the LAST
+        # occurrence, so appending ours after the env's copy wins)
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if not f.startswith("--xla_force_host_platform_device_count")]
+        flags.append(f"--xla_force_host_platform_device_count={n}")
+        env["XLA_FLAGS"] = " ".join(flags)
         proc = subprocess.run(
             [sys.executable, "-c", code], env=env, capture_output=True,
             text=True, timeout=600,

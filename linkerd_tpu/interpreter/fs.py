@@ -45,15 +45,20 @@ class FileDtab:
         try:
             with open(self.path, "r", encoding="utf-8") as f:
                 text = f.read()
+        except OSError as e:
+            # transient read failure (e.g. permissions): do NOT record the
+            # mtime, so the next poll retries even without an edit
+            log.warning("fs interpreter: cannot read %s: %s", self.path, e)
+            return
+        # a parse failure records the mtime: a persistently bad file
+        # warns once per EDIT, not once per poll tick
+        self._mtime = mtime
+        try:
             self.activity.update(Ok(Dtab.read(text)))
         except Exception as e:  # noqa: BLE001 — bad dtab: keep last good
             log.warning("fs interpreter: bad dtab in %s: %s", self.path, e)
             if not isinstance(self.activity.current, Ok):
                 self.activity.set_exception(e)
-        finally:
-            # record the mtime even when parsing failed: a persistently
-            # bad file warns once per EDIT, not once per poll tick
-            self._mtime = mtime
 
     def start(self) -> "FileDtab":
         if self._task is None or self._task.done():

@@ -328,7 +328,8 @@ struct Engine {
     std::unordered_map<int, Conn*> conns;
     std::vector<int> listeners;
     std::unordered_map<std::string, std::vector<Conn*>> parked;
-    uint64_t accepted = 0;
+    // written by the loop thread, read by fp_stats_json callers: atomic
+    std::atomic<uint64_t> accepted{0};
     uint64_t last_sweep_us = 0;
 };
 
@@ -937,7 +938,7 @@ void on_listener(Engine* e, int lfd) {
         c->kind = Conn::Kind::CLIENT;
         c->fd = fd;
         ep_add(e, c);
-        e->accepted++;
+        e->accepted.fetch_add(1, std::memory_order_relaxed);
     }
 }
 
@@ -1186,7 +1187,8 @@ long fp_stats_json(void* ep, char* buf, size_t cap) {
     char tail[128];
     snprintf(tail, sizeof(tail),
              "},\"accepted\":%llu,\"features_dropped\":%llu}",
-             (unsigned long long)e->accepted,
+             (unsigned long long)e->accepted.load(
+                 std::memory_order_relaxed),
              (unsigned long long)e->features_dropped);
     s += tail;
     if (s.size() + 1 > cap) return -2;

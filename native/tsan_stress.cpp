@@ -1,0 +1,182 @@
+// ThreadSanitizer stress driver for the fastpath engine.
+//
+// Exercises the cross-thread seams the Python control plane hits in
+// production (SURVEY.md §5 race-detection note): concurrent route
+// install/remove, live HTTP traffic through the proxy, stats snapshots,
+// miss draining, and feature draining — all while the engine's epoll
+// thread runs. Build + run via `python native/build.py --sanitize`;
+// a clean exit with no TSan report is the pass criterion.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* fp_create();
+int fp_start(void* ep);
+int fp_listen(void* ep, const char* ip, int port);
+int fp_set_route(void* ep, const char* host, const char* endpoints);
+int fp_remove_route(void* ep, const char* host);
+long fp_drain_misses(void* ep, char* buf, size_t cap);
+long fp_stats_json(void* ep, char* buf, size_t cap);
+long fp_drain_features(void* ep, float* buf, long cap_rows);
+void fp_shutdown(void* ep);
+}
+
+namespace {
+
+std::atomic<bool> stop{false};
+std::atomic<long> responses{0};
+std::atomic<long> errors{0};
+
+// Minimal blocking HTTP/1.1 backend: fixed 200 response per request.
+void backend_loop(int lfd) {
+    while (!stop.load()) {
+        int fd = accept(lfd, nullptr, nullptr);
+        if (fd < 0) return;
+        std::thread([fd] {
+            char buf[4096];
+            std::string acc;
+            const char rsp[] =
+                "HTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nok";
+            while (!stop.load()) {
+                ssize_t n = read(fd, buf, sizeof(buf));
+                if (n <= 0) break;
+                acc.append(buf, n);
+                // one response per request head seen
+                size_t pos;
+                while ((pos = acc.find("\r\n\r\n")) != std::string::npos) {
+                    acc.erase(0, pos + 4);
+                    if (write(fd, rsp, sizeof(rsp) - 1) < 0) {
+                        break;
+                    }
+                }
+            }
+            close(fd);
+        }).detach();
+    }
+}
+
+int listen_on(int* port_out) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = 0;
+    if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0) return -1;
+    socklen_t len = sizeof(addr);
+    getsockname(fd, (sockaddr*)&addr, &len);
+    *port_out = ntohs(addr.sin_port);
+    listen(fd, 64);
+    return fd;
+}
+
+// Client: keep-alive requests against the proxy with a Host header.
+void client_loop(int proxy_port, int idx) {
+    while (!stop.load()) {
+        int fd = socket(AF_INET, SOCK_STREAM, 0);
+        sockaddr_in addr{};
+        addr.sin_family = AF_INET;
+        addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+        addr.sin_port = htons(proxy_port);
+        if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+            close(fd);
+            errors.fetch_add(1);
+            usleep(1000);
+            continue;
+        }
+        char req[128];
+        int rn = snprintf(req, sizeof(req),
+                          "GET / HTTP/1.1\r\nHost: svc-%d\r\n\r\n",
+                          idx % 4);
+        char buf[2048];
+        for (int i = 0; i < 50 && !stop.load(); i++) {
+            if (write(fd, req, rn) < 0) { errors.fetch_add(1); break; }
+            ssize_t n = read(fd, buf, sizeof(buf));
+            if (n <= 0) { errors.fetch_add(1); break; }
+            responses.fetch_add(1);
+        }
+        close(fd);
+    }
+}
+
+}  // namespace
+
+int main() {
+    int backend_port = 0;
+    int lfd = listen_on(&backend_port);
+    if (lfd < 0) { perror("backend listen"); return 2; }
+    std::thread backend(backend_loop, lfd);
+
+    void* ep = fp_create();
+    int proxy_port = fp_listen(ep, "127.0.0.1", 0);
+    if (proxy_port <= 0) { fprintf(stderr, "fp_listen failed\n"); return 2; }
+    if (fp_start(ep) != 0) { fprintf(stderr, "fp_start failed\n"); return 2; }
+
+    char endpoints[64];
+    snprintf(endpoints, sizeof(endpoints), "127.0.0.1:%d", backend_port);
+    for (int i = 0; i < 4; i++) {
+        char host[32];
+        snprintf(host, sizeof(host), "svc-%d", i);
+        fp_set_route(ep, host, endpoints);
+    }
+
+    // control-plane churn thread: install/remove routes while traffic runs
+    std::thread churn([&] {
+        int gen = 0;
+        while (!stop.load()) {
+            char host[32];
+            snprintf(host, sizeof(host), "svc-%d", gen % 4);
+            fp_remove_route(ep, host);
+            usleep(500);
+            fp_set_route(ep, host, endpoints);
+            gen++;
+            usleep(1500);
+        }
+    });
+
+    // drain thread: misses + stats + features, like the Python controller
+    std::thread drain([&] {
+        std::vector<char> buf(1 << 16);
+        std::vector<float> feats(64 * 1024);
+        while (!stop.load()) {
+            fp_drain_misses(ep, buf.data(), buf.size());
+            fp_stats_json(ep, buf.data(), buf.size());
+            fp_drain_features(ep, feats.data(), 1024);
+            usleep(2000);
+        }
+    });
+
+    std::vector<std::thread> clients;
+    for (int i = 0; i < 4; i++) clients.emplace_back(client_loop, proxy_port, i);
+
+    sleep(5);
+    stop.store(true);
+    for (auto& t : clients) t.join();
+    churn.join();
+    drain.join();
+    fp_shutdown(ep);
+    shutdown(lfd, SHUT_RDWR);
+    close(lfd);
+    backend.detach();
+
+    fprintf(stderr, "tsan_stress: %ld responses, %ld errors\n",
+            responses.load(), errors.load());
+    if (responses.load() < 100) {
+        fprintf(stderr, "tsan_stress: too little traffic flowed\n");
+        return 1;
+    }
+    return 0;
+}
