@@ -27,22 +27,111 @@ from linkerd_tpu.namer.core import Namer
 log = logging.getLogger(__name__)
 
 
+def _b64url(data: bytes) -> str:
+    import base64
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode("ascii")
+
+
+class DcosAuthenticator:
+    """DC/OS service-account auth (ref: namer/marathon/.../
+    Authenticator.scala:109): sign ``{"uid": <uid>}`` as an RS256 JWT
+    with the account's private key, POST ``{"uid","token"}`` to the ACS
+    login endpoint, and cache the returned session token. A 401 from
+    Marathon invalidates the cache so the next request re-authenticates
+    (token expiry)."""
+
+    def __init__(self, login_endpoint: str, uid: str,
+                 private_key_pem: str):
+        from urllib.parse import urlparse
+        u = urlparse(login_endpoint)
+        self.host = u.hostname or "leader.mesos"
+        self.port = u.port or (443 if u.scheme == "https" else 80)
+        self.use_tls = u.scheme == "https"
+        self.path = u.path or "/acs/api/v1/auth/login"
+        self.uid = uid
+        self.private_key_pem = private_key_pem
+        self._token: Optional[str] = None
+        self._lock = asyncio.Lock()
+
+    def _jwt(self) -> str:
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import padding
+
+        key = serialization.load_pem_private_key(
+            self.private_key_pem.encode(), password=None)
+        header = _b64url(json.dumps(
+            {"alg": "RS256", "typ": "JWT"}).encode())
+        payload = _b64url(json.dumps({"uid": self.uid}).encode())
+        signing_input = f"{header}.{payload}".encode("ascii")
+        sig = key.sign(signing_input, padding.PKCS1v15(), hashes.SHA256())
+        return f"{header}.{payload}.{_b64url(sig)}"
+
+    def invalidate(self, observed: Optional[str] = None) -> None:
+        """Drop the cached token — but only if it's still the one the
+        caller saw fail, so concurrent pollers hitting expiry don't wipe
+        a freshly-acquired token (one login per expiry, not one per
+        poller)."""
+        if observed is None or self._token == observed:
+            self._token = None
+
+    async def token(self) -> str:
+        async with self._lock:
+            if self._token is not None:
+                return self._token
+            import ssl as ssl_mod
+            from linkerd_tpu.protocol.http.simple_client import request
+
+            body = json.dumps({"uid": self.uid,
+                               "token": self._jwt()}).encode()
+            ctx = ssl_mod.create_default_context() if self.use_tls else None
+            rsp = await request(
+                self.host, self.port, "POST", self.path, body=body,
+                headers={"Content-Type": "application/json"},
+                ssl=ctx, timeout=15.0)
+            if rsp.status != 200:
+                raise ConnectionError(
+                    f"dcos login failed: {rsp.status}")
+            token = (json.loads(rsp.body) or {}).get("token")
+            if not token:
+                raise ConnectionError("dcos login: no token in response")
+            self._token = token
+            return token
+
+
 class MarathonApi:
     """Minimal /v2 client (GET JSON over a per-call connection)."""
 
     def __init__(self, host: str, port: int = 8080,
-                 auth_token: Optional[str] = None):
+                 auth_token: Optional[str] = None,
+                 authenticator: Optional[DcosAuthenticator] = None):
         self.host = host
         self.port = port
         self.auth_token = auth_token
+        self.authenticator = authenticator
+
+    async def _auth(self):
+        """-> (headers, token-used)."""
+        if self.authenticator is not None:
+            tok = await self.authenticator.token()
+            return {"Authorization": f"token={tok}"}, tok
+        if self.auth_token:
+            return {"Authorization": f"token={self.auth_token}"}, None
+        return {}, None
 
     async def get_json(self, path: str):
         from linkerd_tpu.protocol.http.simple_client import get as http_get
-        headers = {}
-        if self.auth_token:
-            headers["Authorization"] = f"token={self.auth_token}"
+        headers, used = await self._auth()
         rsp = await http_get(self.host, self.port, path,
                              headers=headers, timeout=30.0)
+        if rsp.status == 401 and self.authenticator is not None:
+            # session token expired: re-auth once and reissue
+            # (ref: Authenticator.scala UnauthorizedResponse handling);
+            # invalidate only the token WE used, not a fresh one another
+            # poller already fetched
+            self.authenticator.invalidate(used)
+            headers, _ = await self._auth()
+            rsp = await http_get(self.host, self.port, path,
+                                 headers=headers, timeout=30.0)
         try:
             parsed = json.loads(rsp.body) if rsp.body else None
         except ValueError:
@@ -149,7 +238,48 @@ class MarathonNamerConfig:
     port: int = 8080
     ttlMs: int = 5000
     prefix: str = "/io.l5d.marathon"
+    # DC/OS service-account auth (ref: MarathonSecret / DCOS_SERVICE_
+    # ACCOUNT_CREDENTIAL): either the env var's JSON blob is picked up
+    # automatically, or the three fields are set explicitly
+    acsLoginEndpoint: str = ""
+    acsUid: str = ""
+    acsPrivateKey: str = ""
+
+    def _authenticator(self) -> Optional[DcosAuthenticator]:
+        import os
+
+        from linkerd_tpu.config import ConfigError
+
+        endpoint, uid, key = (self.acsLoginEndpoint, self.acsUid,
+                              self.acsPrivateKey)
+        if not (endpoint and uid and key):
+            blob = os.environ.get("DCOS_SERVICE_ACCOUNT_CREDENTIAL", "")
+            if not blob:
+                return None
+            # a PRESENT but unusable credential is a config error — the
+            # alternative is silently-unauthenticated discovery that 401s
+            # forever (ref: MarathonSecret strictness)
+            try:
+                cred = json.loads(blob)
+            except ValueError as e:
+                raise ConfigError(
+                    f"DCOS_SERVICE_ACCOUNT_CREDENTIAL is not JSON: {e}"
+                ) from None
+            if cred.get("scheme", "RS256") != "RS256":
+                raise ConfigError(
+                    "DCOS_SERVICE_ACCOUNT_CREDENTIAL: only RS256 is "
+                    f"supported, got {cred.get('scheme')!r}")
+            endpoint = cred.get("login_endpoint", "")
+            uid = cred.get("uid", "")
+            key = cred.get("private_key", "")
+            if not (endpoint and uid and key):
+                raise ConfigError(
+                    "DCOS_SERVICE_ACCOUNT_CREDENTIAL missing "
+                    "login_endpoint/uid/private_key")
+        return DcosAuthenticator(endpoint, uid, key)
 
     def mk(self) -> Namer:
-        return MarathonNamer(MarathonApi(self.host, self.port),
-                             ttl_s=self.ttlMs / 1e3)
+        return MarathonNamer(
+            MarathonApi(self.host, self.port,
+                        authenticator=self._authenticator()),
+            ttl_s=self.ttlMs / 1e3)

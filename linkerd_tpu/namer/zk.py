@@ -52,6 +52,20 @@ def shared_zk(hosts: str, session_timeout_ms: int = 10000) -> ZkClient:
     return client
 
 
+async def close_shared_zk(hosts: Optional[str] = None) -> None:
+    """Close (one or all) shared ZK sessions — the shutdown API for
+    short-lived consumers like dcos-bootstrap; long-lived processes keep
+    their sessions for the process lifetime."""
+    if hosts is not None:
+        client = _shared_clients.pop(hosts, None)
+        if client is not None:
+            await client.close()
+        return
+    for key in list(_shared_clients):
+        client = _shared_clients.pop(key)
+        await client.close()
+
+
 def parse_zk_addrs(zk_addrs, hosts: str = "") -> str:
     if hosts:
         return hosts

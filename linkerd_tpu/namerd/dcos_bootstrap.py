@@ -1,0 +1,66 @@
+"""DC/OS bootstrap: seed namerd's ZooKeeper store with the default dtab.
+
+Ref: namerd/dcos-bootstrap/.../DcosBootstrap.scala:54 — run once before
+namerd comes up on DC/OS; reads the namerd config, requires
+``storage: {kind: io.l5d.zk}``, and creates the ``default`` namespace
+with the marathon-routing dtab (app ids through the marathon namer, Host
+header domains rewritten by domainToPathPfx).
+
+Usage: python -m linkerd_tpu.namerd.dcos_bootstrap path/to/namerd.yaml
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+
+from linkerd_tpu.core import Dtab
+
+DEFAULT_NS = "default"
+DEFAULT_DTAB = Dtab.read("""
+/marathonId => /#/io.l5d.marathon ;
+/svc => /$/io.buoyant.http.domainToPathPfx/marathonId ;
+""")
+
+
+async def bootstrap(config_text: str) -> str:
+    from linkerd_tpu.config import instantiate, parse_config
+    from linkerd_tpu.namerd.store import DtabNamespaceAlreadyExists
+    from linkerd_tpu.namerd.stores import ZkDtabStore
+    import linkerd_tpu.namerd.config  # noqa: F401 — registers store kinds
+
+    spec = parse_config(config_text)
+    storage = spec.get("storage")
+    if not isinstance(storage, dict) or storage.get("kind") != "io.l5d.zk":
+        raise SystemExit(
+            f"config file does not specify zk storage: {storage!r}")
+    store = instantiate("dtabStore", storage, "storage").mk()
+    assert isinstance(store, ZkDtabStore)
+    try:
+        await store.create(DEFAULT_NS, DEFAULT_DTAB)
+        result = f"created dtab namespace {DEFAULT_NS!r}"
+    except DtabNamespaceAlreadyExists:
+        result = f"dtab namespace {DEFAULT_NS!r} already exists; left as-is"
+    finally:
+        store.close()
+        from linkerd_tpu.namer.zk import close_shared_zk
+        await close_shared_zk()
+    return result
+
+
+def main() -> int:
+    if len(sys.argv) != 2:
+        print("usage: python -m linkerd_tpu.namerd.dcos_bootstrap "
+              "path/to/namerd.yaml", file=sys.stderr)
+        return 2
+    if sys.argv[1] == "-":
+        text = sys.stdin.read()
+    else:
+        with open(sys.argv[1], "r", encoding="utf-8") as f:
+            text = f.read()
+    print(asyncio.run(bootstrap(text)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

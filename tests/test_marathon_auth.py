@@ -1,0 +1,131 @@
+"""Marathon DC/OS service-account auth + the dcos-bootstrap tool.
+
+Ref: namer/marathon/.../Authenticator.scala:109 (RS256 JWT login, token
+cache, 401 re-auth) and namerd/dcos-bootstrap/.../DcosBootstrap.scala:54.
+"""
+
+import asyncio
+import base64
+import json
+
+from linkerd_tpu.namer.marathon import DcosAuthenticator, MarathonApi
+from linkerd_tpu.protocol.http.message import Request, Response
+from linkerd_tpu.protocol.http.server import HttpServer
+from linkerd_tpu.router.service import FnService
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+def _gen_key_pem() -> str:
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption()).decode()
+
+
+class FakeDcos:
+    """ACS login + a token-guarded marathon endpoint; can expire tokens."""
+
+    def __init__(self, key_pem: str):
+        self.key_pem = key_pem
+        self.generation = 0
+        self.logins = 0
+
+    def _verify_jwt(self, jwt: str) -> dict:
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import padding
+
+        head, payload, sig = jwt.split(".")
+        pad = "=" * (-len(sig) % 4)
+        key = serialization.load_pem_private_key(
+            self.key_pem.encode(), password=None).public_key()
+        key.verify(base64.urlsafe_b64decode(sig + pad),
+                   f"{head}.{payload}".encode(),
+                   padding.PKCS1v15(), hashes.SHA256())
+        return json.loads(base64.urlsafe_b64decode(
+            payload + "=" * (-len(payload) % 4)))
+
+    def service(self):
+        async def handler(req: Request) -> Response:
+            if req.uri.startswith("/acs/api/v1/auth/login"):
+                body = json.loads(req.body)
+                claims = self._verify_jwt(body["token"])  # raises if bad
+                assert claims["uid"] == body["uid"]
+                self.logins += 1
+                return Response(status=200, body=json.dumps(
+                    {"token": f"session-{self.generation}"}).encode())
+            auth = req.headers.get("Authorization") or ""
+            if auth != f"token=session-{self.generation}":
+                return Response(status=401, body=b"{}")
+            return Response(status=200, body=json.dumps(
+                {"tasks": [{"host": "10.0.0.1", "ports": [31001]}]}
+            ).encode())
+        return FnService(handler)
+
+
+class TestDcosAuth:
+    def test_login_cache_and_reauth_on_expiry(self):
+        async def go():
+            key = _gen_key_pem()
+            dcos = FakeDcos(key)
+            server = await HttpServer(dcos.service()).start()
+            auth = DcosAuthenticator(
+                f"http://127.0.0.1:{server.bound_port}/acs/api/v1/auth/login",
+                "svc-acct", key)
+            api = MarathonApi("127.0.0.1", server.bound_port,
+                              authenticator=auth)
+            try:
+                status, data = await api.get_json("/v2/apps/web/tasks")
+                assert status == 200
+                assert data["tasks"][0]["ports"] == [31001]
+                # token cached: second call does not re-login
+                await api.get_json("/v2/apps/web/tasks")
+                assert dcos.logins == 1
+
+                # server expires the session: exactly one re-auth
+                dcos.generation += 1
+                status, data = await api.get_json("/v2/apps/web/tasks")
+                assert status == 200
+                assert dcos.logins == 2
+            finally:
+                await server.close()
+
+        run(go())
+
+
+class TestDcosBootstrap:
+    def test_seeds_default_dtab_into_zk(self):
+        async def go():
+            from linkerd_tpu.namerd.dcos_bootstrap import bootstrap
+            from linkerd_tpu.testing.zkserver import FakeZkServer
+            from linkerd_tpu.zk.client import ZkClient
+
+            server = await FakeZkServer().start()
+            cfg = f"""
+storage:
+  kind: io.l5d.zk
+  hosts: "{server.hosts}"
+  pathPrefix: /dtabs
+namers: []
+interfaces: []
+"""
+            msg = await bootstrap(cfg)
+            assert "created" in msg
+            zk = ZkClient(server.hosts).start()
+            data, _ = await zk.get_data("/dtabs/default")
+            assert b"io.l5d.marathon" in data
+            assert b"domainToPathPfx" in data
+            await zk.close()
+
+            # idempotent: second run leaves the dtab alone
+            msg2 = await bootstrap(cfg)
+            assert "already exists" in msg2
+            await server.close()
+
+        run(go())

@@ -304,10 +304,8 @@ class TestZkDtabStore:
                     await store.delete("stage")
             finally:
                 store.close()
-                from linkerd_tpu.namer.zk import _shared_clients
-                for c in _shared_clients.values():
-                    await c.close()
-                _shared_clients.clear()
+                from linkerd_tpu.namer.zk import close_shared_zk
+                await close_shared_zk()
                 await server.close()
 
         run(go())
@@ -340,10 +338,8 @@ class TestZkAnnouncerRoundTrip:
                     lambda: not hosts_of(bound.addr.sample()))
             finally:
                 namer.close()
-                from linkerd_tpu.namer.zk import _shared_clients
-                for c in _shared_clients.values():
-                    await c.close()
-                _shared_clients.clear()
+                from linkerd_tpu.namer.zk import close_shared_zk
+                await close_shared_zk()
                 await zk.close()
                 await server.close()
 
