@@ -377,3 +377,19 @@ class ConfiguredDtabNamer(NameInterpreter):
                     lambda tree: bind_leaves(
                         tree, lambda p: self._bind(dtab, p, depth + 1)))
         return Activity.value(NEG)
+
+
+class RewritingNamer(Namer):
+    """PathMatcher-driven path rewriter (ref: namer/core/.../
+    RewritingNamer.scala, kind ``io.l5d.rewrite``): a matched path is
+    rewritten by the template (captures substituted) and re-resolved."""
+
+    def __init__(self, matcher, pattern: str):
+        self.matcher = matcher
+        self.pattern = pattern
+
+    def lookup(self, path: Path) -> Activity[NameTree[Name]]:
+        rewritten = self.matcher.substitute(path, self.pattern)
+        if rewritten is None:
+            return Activity.value(NEG)
+        return Activity.value(Leaf(Path.read(rewritten)))

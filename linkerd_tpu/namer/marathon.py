@@ -252,6 +252,10 @@ class MarathonNamerConfig:
 
         endpoint, uid, key = (self.acsLoginEndpoint, self.acsUid,
                               self.acsPrivateKey)
+        if (endpoint or uid or key) and not (endpoint and uid and key):
+            raise ConfigError(
+                "io.l5d.marathon: acsLoginEndpoint, acsUid and "
+                "acsPrivateKey must be set together")
         if not (endpoint and uid and key):
             blob = os.environ.get("DCOS_SERVICE_ACCOUNT_CREDENTIAL", "")
             if not blob:

@@ -50,6 +50,10 @@ class AddressTransformer(abc.ABC):
 
     def transform_tree(self, tree: NameTree) -> NameTree:
         if isinstance(tree, Leaf):
+            if isinstance(tree.value, Path):
+                # un-bound Path leaf (e.g. emitted by io.l5d.const earlier
+                # in the chain): nothing address-level to rewrite
+                return tree
             return Leaf(self.transform_leaf(tree.value))
         if isinstance(tree, Alt):
             return Alt(*(self.transform_tree(t) for t in tree.trees))
@@ -380,3 +384,54 @@ class LocalNodeTransformerConfig:
         if not ip:
             raise ConfigError("io.l5d.k8s.localnode needs POD_IP")
         return SubnetLocalTransformer(ip, self.netmask)
+
+
+class ConstTransformer(AddressTransformer):
+    """Replace the whole bound tree with the binding of a fixed path
+    (ref: namer/core/.../ConstTransformer.scala, kind ``io.l5d.const`` —
+    force all traffic through e.g. a local proxy). The emitted Path leaf
+    re-enters dtab resolution in ConfiguredDtabNamer.bind_leaves."""
+
+    def __init__(self, path: Path):
+        super().__init__("io.l5d.const")
+        self._path = path
+
+    def transform_addresses(self, addresses):  # unused: tree-level
+        return addresses
+
+    def transform_tree(self, tree: NameTree) -> NameTree:
+        if isinstance(tree, (Leaf, Alt, Union)):
+            return Leaf(self._path)
+        return tree  # Neg/Fail/Empty stay
+
+
+@register("transformer", "io.l5d.const")
+@dataclass
+class ConstTransformerConfig:
+    path: str = ""
+
+    def mk(self) -> AddressTransformer:
+        if not self.path:
+            raise ConfigError("io.l5d.const transformer needs path")
+        return ConstTransformer(Path.read(self.path))
+
+
+@register("namer", "io.l5d.rewrite")
+@dataclass
+class RewritingNamerConfig:
+    """ref: RewritingNamerInitializer.scala — the namer mounts at
+    ``prefix`` (like every namer); the RESIDUAL after the prefix strip is
+    matched by ``pattern`` (a PathMatcher expression) and rewritten into
+    ``name`` (a template with {var} captures), then re-resolved."""
+
+    prefix: str = ""      # mount point under /#/ (required)
+    pattern: str = ""     # PathMatcher over the residual, e.g. /{env}/{svc}
+    name: str = ""        # rewrite template, e.g. /envs/{env}/{svc}
+
+    def mk(self) -> "Namer":
+        if not (self.prefix and self.pattern and self.name):
+            raise ConfigError(
+                "io.l5d.rewrite needs prefix, pattern and name")
+        from linkerd_tpu.core.pathmatcher import PathMatcher
+        from linkerd_tpu.namer.core import RewritingNamer
+        return RewritingNamer(PathMatcher(self.pattern), self.name)

@@ -187,3 +187,57 @@ class TestK8sTransformerKinds:
                            Address.mk("5.6.7.8", 80, nodeName="node-x")})
         out2 = t2.transform_addresses(pods2)
         assert {a.host for a in out2} == {"10.0.1.1"}
+
+
+class TestConstAndRewriteKinds:
+    def test_const_transformer_redirects_tree(self):
+        from linkerd_tpu.config import instantiate
+        from linkerd_tpu.core import Path
+        from linkerd_tpu.core.nametree import Leaf, NEG
+
+        t = instantiate("transformer", {
+            "kind": "io.l5d.const", "path": "/$/inet/127.0.0.1/9990"}).mk()
+        from linkerd_tpu.core import Var
+        from linkerd_tpu.core.addr import Bound, BoundName
+        tree = Leaf(BoundName(Path.read("/#/x/web"), Var(Bound(frozenset())),
+                              Path.read("/")))
+        out = t.transform_tree(tree)
+        assert isinstance(out, Leaf)
+        assert out.value == Path.read("/$/inet/127.0.0.1/9990")
+        # Neg passes through untouched
+        assert t.transform_tree(NEG) is NEG
+
+    def test_rewrite_namer_kind(self):
+        from linkerd_tpu.config import instantiate
+        from linkerd_tpu.core import Path
+        from linkerd_tpu.core.nametree import Leaf, Neg
+
+        n = instantiate("namer", {
+            "kind": "io.l5d.rewrite",
+            "prefix": "/rw",
+            "pattern": "/{env}/{svc}",
+            "name": "/envs/{env}/{svc}"}).mk()
+        act = n.lookup(Path.read("/prod/web"))
+        tree = act.sample()
+        assert isinstance(tree, Leaf)
+        assert tree.value == Path.read("/envs/prod/web")
+        assert isinstance(n.lookup(Path.read("/onlyone")).sample(), Neg)
+
+    def test_rewrite_namer_mounted_in_interpreter(self):
+        """The namer must work THROUGH its /#/ mount (config prefix is
+        the mount point, pattern applies to the residual)."""
+        from linkerd_tpu.config import instantiate
+        from linkerd_tpu.core import Dtab, Path
+        from linkerd_tpu.core.nametree import Leaf
+        from linkerd_tpu.namer.core import ConfiguredDtabNamer
+
+        cfg = instantiate("namer", {
+            "kind": "io.l5d.rewrite", "prefix": "/rw",
+            "pattern": "/{svc}", "name": "/$/inet/127.0.0.1/8080"})
+        interp = ConfiguredDtabNamer(
+            [(Path.read(cfg.prefix), cfg.mk())])
+        act = interp.bind(Dtab.read("/svc => /#/rw"),
+                          Path.read("/svc/web"))
+        tree = act.sample().simplified
+        assert isinstance(tree, Leaf)
+        assert "/inet/127.0.0.1/8080" in tree.value.id_.show
