@@ -1,0 +1,12 @@
+"""Standalone etcd v2 client library.
+
+Ref: the reference's ``etcd`` module (etcd/.../Etcd.scala:118 — client
+entry, version; Key.scala:281 — key ops + recursive watch; NodeOp.scala/
+Node.scala/ApiError.scala — the typed results). The dtab store
+(namerd/stores.py EtcdDtabStore) is one consumer; the lib is usable for
+any etcd v2 keyspace.
+"""
+
+from linkerd_tpu.etcd.client import (  # noqa: F401
+    ApiError, EtcdClient, Key, Node, NodeOp,
+)

@@ -1,0 +1,283 @@
+"""etcd v2 keys API: typed ops and resilient recursive watches.
+
+Ref: etcd/.../Etcd.scala:118 (client + /version), Key.scala:281 (get/
+set/create/delete with CAS params; ``watch`` = initial GET establishing
+X-Etcd-Index, then ``?wait=true&waitIndex=N`` long-polls applied
+incrementally, with outdated-index (400/401 "event index cleared")
+falling back to a fresh re-list), NodeOp.scala/Node.scala/ApiError.scala.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import random
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+from urllib.parse import quote
+
+log = logging.getLogger(__name__)
+
+
+class ApiError(Exception):
+    """An etcd error response (ref: ApiError.scala; errorCode 401 =
+    EventIndexCleared, 105 = NodeExist, 101 = CompareFailed...)."""
+
+    KEY_NOT_FOUND = 100
+    COMPARE_FAILED = 101
+    NODE_EXIST = 105
+    INDEX_CLEARED = 401
+
+    def __init__(self, status: int, code: int = 0, message: str = "",
+                 cause: str = "", index: int = 0):
+        super().__init__(f"etcd {status}: [{code}] {message} {cause}")
+        self.status = status
+        self.code = code
+        self.message = message
+        self.cause = cause
+        self.index = index
+
+    @classmethod
+    def parse(cls, status: int, body: bytes) -> "ApiError":
+        try:
+            data = json.loads(body)
+            return cls(status, int(data.get("errorCode", 0)),
+                       data.get("message", ""), data.get("cause", ""),
+                       int(data.get("index", 0)))
+        except (ValueError, TypeError):
+            return cls(status, message=body.decode("utf-8", "replace"))
+
+
+@dataclass(frozen=True)
+class Node:
+    """ref: Node.scala — Data (value) or Dir (nodes)."""
+
+    key: str
+    value: Optional[str] = None
+    dir: bool = False
+    created_index: int = 0
+    modified_index: int = 0
+    nodes: tuple = ()
+
+    @classmethod
+    def parse(cls, obj: dict) -> "Node":
+        return cls(
+            key=obj.get("key", "/"),
+            value=obj.get("value"),
+            dir=bool(obj.get("dir")),
+            created_index=int(obj.get("createdIndex", 0)),
+            modified_index=int(obj.get("modifiedIndex", 0)),
+            nodes=tuple(cls.parse(n) for n in obj.get("nodes") or ()),
+        )
+
+    def leaves(self) -> List["Node"]:
+        """Flatten to data nodes (recursive listing convenience)."""
+        if not self.dir:
+            return [self]
+        out: List[Node] = []
+        for n in self.nodes:
+            out.extend(n.leaves())
+        return out
+
+
+@dataclass(frozen=True)
+class NodeOp:
+    """ref: NodeOp.scala — action + node (+ prevNode) + etcd index."""
+
+    action: str
+    node: Node
+    etcd_index: int = 0
+    prev_node: Optional[Node] = None
+
+
+class Key:
+    """One key (or directory) in the keyspace."""
+
+    def __init__(self, client: "EtcdClient", path: str):
+        self._client = client
+        self.path = "/" + path.strip("/")
+
+    def _uri(self, params: dict) -> str:
+        q = "&".join(f"{k}={v}" for k, v in params.items() if v is not None)
+        quoted = quote(self.path, safe="/")
+        return f"/v2/keys{quoted}" + (f"?{q}" if q else "")
+
+    async def get(self, recursive: bool = False, wait: bool = False,
+                  wait_index: Optional[int] = None,
+                  quorum: bool = False,
+                  timeout: float = 10.0) -> NodeOp:
+        rsp = await self._client._call(
+            "GET", self._uri({
+                "recursive": "true" if recursive else None,
+                "wait": "true" if wait else None,
+                "waitIndex": wait_index,
+                "quorum": "true" if quorum else None,
+            }), timeout=timeout)
+        return self._node_op(rsp)
+
+    async def set(self, value: Optional[str] = None, dir: bool = False,
+                  prev_exist: Optional[bool] = None,
+                  prev_index: Optional[int] = None,
+                  prev_value: Optional[str] = None,
+                  ttl: Optional[int] = None) -> NodeOp:
+        form = []
+        if value is not None:
+            form.append(f"value={quote(value)}")
+        if dir:
+            form.append("dir=true")
+        if prev_exist is not None:
+            form.append(f"prevExist={'true' if prev_exist else 'false'}")
+        if prev_index is not None:
+            form.append(f"prevIndex={prev_index}")
+        if prev_value is not None:
+            form.append(f"prevValue={quote(prev_value)}")
+        if ttl is not None:
+            form.append(f"ttl={ttl}")
+        rsp = await self._client._call("PUT", self._uri({}),
+                                       body="&".join(form).encode())
+        return self._node_op(rsp)
+
+    async def create(self, value: str) -> NodeOp:
+        """POST: in-order (sequential) child key."""
+        rsp = await self._client._call(
+            "POST", self._uri({}), body=f"value={quote(value)}".encode())
+        return self._node_op(rsp)
+
+    async def delete(self, recursive: bool = False, dir: bool = False,
+                     prev_index: Optional[int] = None,
+                     prev_value: Optional[str] = None) -> NodeOp:
+        rsp = await self._client._call(
+            "DELETE", self._uri({
+                "recursive": "true" if recursive else None,
+                "dir": "true" if dir else None,
+                "prevIndex": prev_index,
+                "prevValue": quote(prev_value) if prev_value else None,
+            }))
+        return self._node_op(rsp)
+
+    @staticmethod
+    def _node_op(rsp) -> NodeOp:
+        if rsp.status not in (200, 201):
+            raise ApiError.parse(rsp.status, rsp.body)
+        data = json.loads(rsp.body)
+        etcd_index = int(rsp.headers.get("X-Etcd-Index") or 0)
+        prev = data.get("prevNode")
+        return NodeOp(
+            action=data.get("action", "get"),
+            node=Node.parse(data.get("node") or {}),
+            etcd_index=etcd_index,
+            prev_node=Node.parse(prev) if prev else None,
+        )
+
+    def watch(self, on_op: Callable[[NodeOp], None],
+              recursive: bool = True,
+              backoff_base: float = 0.1) -> "Watch":
+        """The resilient recursive watch (ref: Key.scala:281): the first
+        delivered NodeOp is the initial (re-)list (action ``get``);
+        subsequent ops are incremental changes. Outdated indexes re-list;
+        errors retry with jittered backoff."""
+        return Watch(self, on_op, recursive, backoff_base).start()
+
+
+class Watch:
+    def __init__(self, key: Key, on_op, recursive: bool,
+                 backoff_base: float):
+        self._key = key
+        self._on_op = on_op
+        self._recursive = recursive
+        self._base = backoff_base
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> "Watch":
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_event_loop().create_task(self._run())
+        return self
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _run(self) -> None:
+        attempt = 0
+        index: Optional[int] = None
+        while True:
+            try:
+                if index is None:
+                    op = await self._key.get(recursive=self._recursive)
+                    # watch from the store-wide index (covers deletes that
+                    # bumped it past every surviving node's modifiedIndex)
+                    top = max([op.etcd_index]
+                              + [n.modified_index
+                                 for n in op.node.leaves()])
+                    index = top + 1
+                    self._on_op(op)
+                    attempt = 0
+                    continue
+                try:
+                    op = await self._key.get(
+                        recursive=self._recursive, wait=True,
+                        wait_index=index, timeout=70.0)
+                except (asyncio.TimeoutError, EOFError):
+                    continue  # quiet window: re-issue the long-poll
+                index = max(index, op.node.modified_index) + 1
+                self._on_op(op)
+                attempt = 0
+            except asyncio.CancelledError:
+                raise
+            except ApiError as e:
+                if e.code == ApiError.INDEX_CLEARED:
+                    # history compacted: full re-list is REQUIRED. Still
+                    # backed off so a broken server can't induce a hot
+                    # re-list loop. (HTTP-status 400/401 without the etcd
+                    # errorCode is an auth/protocol problem, NOT
+                    # index-cleared — it falls to the generic backoff.)
+                    index = None
+                    attempt = min(attempt + 1, 6)
+                    await asyncio.sleep(self._base
+                                        * (0.7 + random.random() / 2))
+                    continue
+                if e.status == 404 and index is None:
+                    # key doesn't exist yet: deliver empty state, poll
+                    self._on_op(NodeOp("get", Node(self._key.path, dir=True),
+                                       etcd_index=e.index))
+                    await asyncio.sleep(self._base * 4)
+                    continue
+                attempt = min(attempt + 1, 6)
+                await asyncio.sleep(self._base * (2 ** attempt)
+                                    * (0.7 + random.random() / 2))
+            except Exception as e:  # noqa: BLE001 — retry forever
+                # transient transport error: keep the held index and
+                # resume the long-poll — a full recursive re-list is only
+                # needed when etcd says the index was compacted
+                log.debug("etcd watch %s: %r", self._key.path, e)
+                attempt = min(attempt + 1, 6)
+                await asyncio.sleep(self._base * (2 ** attempt)
+                                    * (0.7 + random.random() / 2))
+
+
+class EtcdClient:
+    """ref: Etcd.scala — the client entry point."""
+
+    def __init__(self, host: str, port: int = 2379):
+        self.host = host
+        self.port = port
+
+    def key(self, path: str) -> Key:
+        return Key(self, path)
+
+    async def version(self) -> dict:
+        rsp = await self._call("GET", "/version")
+        if rsp.status != 200:
+            raise ApiError.parse(rsp.status, rsp.body)
+        return json.loads(rsp.body)
+
+    async def _call(self, method: str, uri: str, body: bytes = b"",
+                    timeout: float = 10.0):
+        from linkerd_tpu.protocol.http.simple_client import request
+        return await request(
+            self.host, self.port, method, uri, body=body,
+            headers=({"Content-Type": "application/x-www-form-urlencoded"}
+                     if body else None),
+            timeout=timeout)

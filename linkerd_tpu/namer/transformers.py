@@ -434,4 +434,20 @@ class RewritingNamerConfig:
                 "io.l5d.rewrite needs prefix, pattern and name")
         from linkerd_tpu.core.pathmatcher import PathMatcher
         from linkerd_tpu.namer.core import RewritingNamer
-        return RewritingNamer(PathMatcher(self.pattern), self.name)
+        matcher = PathMatcher(self.pattern)
+        # load-time validation: a typo'd capture or unparseable template
+        # would otherwise silently bind EVERY path to Neg at runtime
+        dummy = {v: "x" for v in matcher.var_names}
+        rendered = PathMatcher.substitute_vars(dummy, self.name)
+        if rendered is None:
+            raise ConfigError(
+                f"io.l5d.rewrite name {self.name!r} references captures "
+                f"not in pattern {self.pattern!r} "
+                f"(available: {sorted(matcher.var_names)})")
+        try:
+            Path.read(rendered)
+        except ValueError as e:
+            raise ConfigError(
+                f"io.l5d.rewrite name {self.name!r} is not a valid "
+                f"path template: {e}") from None
+        return RewritingNamer(matcher, self.name)

@@ -134,144 +134,135 @@ class _WatchedRemoteStore(DtabStore):
 
 
 class EtcdDtabStore(_WatchedRemoteStore):
-    """etcd v2 keys API under ``/v2/keys/<root>/`` (kind io.l5d.etcd).
-
-    Watch semantics per the reference's Key.watch (etcd/.../Key.scala:281):
-    an initial recursive GET establishes state + X-Etcd-Index, then
-    ``?wait=true&waitIndex=N`` blocks until the next change, which is
-    applied incrementally; an outdated index (400/401, "event index
-    cleared") falls back to a fresh re-list."""
+    """Dtabs as etcd v2 keys under ``<root>/<ns>`` (kind io.l5d.etcd),
+    built on the standalone etcd client library (linkerd_tpu/etcd —
+    ref: etcd/.../{Etcd,Key,NodeOp}.scala): the lib's resilient recursive
+    watch feeds the namespace Activities; CAS rides prevIndex/prevExist."""
 
     def __init__(self, host: str, port: int, root: str = "/namerd/dtabs",
                  poll_interval: float = 1.0):
         super().__init__(poll_interval)
-        self.host = host
-        self.port = port
-        self.root = root.rstrip("/")
-        self._watch_index: Optional[int] = None
+        from linkerd_tpu.etcd import EtcdClient
 
-    def _key(self, ns: str) -> str:
-        return f"/v2/keys{self.root}/{quote(ns)}"
+        self.etcd = EtcdClient(host, port)
+        self.root = "/" + root.strip("/")
+        self._dir = self.etcd.key(self.root)
+        self._watch = None
+
+    # ── watch plumbing (lib-driven, replaces the base _run loop) ─────────
+    def _ensure_task(self) -> None:
+        if self._watch is None:
+            self._watch = self._dir.watch(self._on_op)
+
+    def _restart_watch(self) -> None:
+        if self._watch is not None:
+            self._watch.stop()
+            self._watch = None
+        self._ensure_task()
 
     @staticmethod
-    def _parse_nodes(data) -> Dict[str, VersionedDtab]:
-        out: Dict[str, VersionedDtab] = {}
-        for node in (data.get("node") or {}).get("nodes") or []:
-            ns = node["key"].rsplit("/", 1)[-1]
-            try:
-                dtab = Dtab.read(node.get("value") or "")
-            except ValueError:
-                continue
-            version = str(node.get("modifiedIndex", "")).encode()
-            out[ns] = VersionedDtab(dtab, version)
-        return out
+    def _node_to_entry(node):
+        ns = node.key.rsplit("/", 1)[-1]
+        try:
+            dtab = Dtab.read(node.value or "")
+        except ValueError:
+            return None
+        return ns, VersionedDtab(dtab, str(node.modified_index).encode())
 
-    async def _list_nodes(self):
-        """One recursive GET -> (state, response); shared by writes'
-        _fetch_all and the watch bootstrap so list semantics can't
-        diverge. (Named to avoid the base class's ``_list`` Var.)"""
-        rsp = await http_get(self.host, self.port,
-                             f"/v2/keys{self.root}/?recursive=true",
-                             timeout=10.0)
-        if rsp.status == 404:
-            return {}, rsp
-        if rsp.status != 200:
-            raise RuntimeError(f"etcd list: {rsp.status}")
-        return self._parse_nodes(json.loads(rsp.body)), rsp
-
-    async def _fetch_all(self) -> Dict[str, VersionedDtab]:
-        state, _ = await self._list_nodes()
+    def _state_from(self, root) -> Dict[str, VersionedDtab]:
+        state: Dict[str, VersionedDtab] = {}
+        for node in root.leaves():
+            kv = self._node_to_entry(node)
+            if kv is not None:
+                state[kv[0]] = kv[1]
         return state
 
-    async def _watch_once(self) -> None:
-        if self._watch_index is None:
-            # (re-)list and capture the index to watch from
-            state, rsp = await self._list_nodes()
-            max_mod = 0
-            if rsp.status == 200:
-                data = json.loads(rsp.body)
-                for node in (data.get("node") or {}).get("nodes") or []:
-                    max_mod = max(max_mod, int(node.get("modifiedIndex", 0)))
-            etcd_index = rsp.headers.get("X-Etcd-Index")
-            self._watch_index = (int(etcd_index) if etcd_index
-                                 else max_mod) + 1
-            self._publish(state)
+    def _on_op(self, op) -> None:
+        if op.action == "get":
+            # initial or recovery (re-)list
+            self._publish(self._state_from(op.node))
             return
-        try:
-            rsp = await http_get(
-                self.host, self.port,
-                f"/v2/keys{self.root}/?recursive=true&wait=true"
-                f"&waitIndex={self._watch_index}",
-                timeout=70.0)
-        except (asyncio.TimeoutError, EOFError):
-            return  # quiet window / server closed the watch: re-issue
-        if rsp.status in (400, 401):
-            # "The event in requested index is outdated and cleared"
-            self._watch_index = None
+        node = op.node
+        if node.dir or node.key.rstrip("/") == self.root:
+            # directory-level event (e.g. recursive delete of the root):
+            # not a single-namespace change — re-list from scratch
+            self._restart_watch()
             return
-        if rsp.status != 200:
-            raise RuntimeError(f"etcd watch: {rsp.status}")
-        data = json.loads(rsp.body)
-        action = data.get("action", "set")
-        node = data.get("node") or {}
-        key = node.get("key", "")
-        if node.get("dir") or key.rstrip("/") == self.root:
-            # a directory-level event (e.g. recursive delete of the
-            # root) isn't a single-namespace change: re-list from scratch
-            self._watch_index = None
-            return
-        ns = key.rsplit("/", 1)[-1]
-        mod = int(node.get("modifiedIndex", self._watch_index))
         state = dict(self._known)
-        if action in ("delete", "expire", "compareAndDelete"):
-            state.pop(ns, None)
+        if op.action in ("delete", "expire", "compareAndDelete"):
+            state.pop(node.key.rsplit("/", 1)[-1], None)
         else:
-            try:
-                state[ns] = VersionedDtab(
-                    Dtab.read(node.get("value") or ""), str(mod).encode())
-            except ValueError:
-                pass  # unparseable dtab value: ignore the key
-        self._watch_index = mod + 1
+            kv = self._node_to_entry(node)
+            if kv is None:
+                return
+            state[kv[0]] = kv[1]
         self._publish(state)
 
+    async def _fetch_all(self) -> Dict[str, VersionedDtab]:
+        from linkerd_tpu.etcd import ApiError
+
+        try:
+            op = await self._dir.get(recursive=True)
+        except ApiError as e:
+            if e.status == 404:
+                return {}
+            raise
+        return self._state_from(op.node)
+
+    # ── writes ───────────────────────────────────────────────────────────
+    def _ns_key(self, ns: str):
+        return self.etcd.key(f"{self.root}/{ns}")
+
     async def create(self, ns: str, dtab: Dtab) -> None:
-        body = f"value={quote(dtab.show)}&prevExist=false".encode()
-        rsp = await _http_call(self.host, self.port, "PUT",
-                               self._key(ns), body)
-        if rsp.status == 412:
-            raise DtabNamespaceAlreadyExists(ns)
-        if rsp.status not in (200, 201):
-            raise RuntimeError(f"etcd create: {rsp.status}")
+        from linkerd_tpu.etcd import ApiError
+
+        try:
+            await self._ns_key(ns).set(dtab.show, prev_exist=False)
+        except ApiError as e:
+            if e.status == 412 or e.code == ApiError.NODE_EXIST:
+                raise DtabNamespaceAlreadyExists(ns) from e
+            raise
         await self._refresh_now()
 
     async def update(self, ns: str, dtab: Dtab, version: bytes) -> None:
-        idx = version.decode()
-        body = f"value={quote(dtab.show)}&prevIndex={idx}".encode()
-        rsp = await _http_call(self.host, self.port, "PUT",
-                               self._key(ns), body)
-        if rsp.status == 412:
-            raise DtabVersionMismatch(ns)
-        if rsp.status == 404:
-            raise DtabNamespaceDoesNotExist(ns)
-        if rsp.status != 200:
-            raise RuntimeError(f"etcd update: {rsp.status}")
+        from linkerd_tpu.etcd import ApiError
+
+        try:
+            prev_index = int(version.decode())
+        except (ValueError, UnicodeDecodeError) as e:
+            # only a malformed version STAMP is a mismatch; parse errors
+            # from the etcd exchange itself must surface as real errors
+            raise DtabVersionMismatch(ns) from e
+        try:
+            await self._ns_key(ns).set(dtab.show, prev_index=prev_index)
+        except ApiError as e:
+            if e.status == 412 or e.code == ApiError.COMPARE_FAILED:
+                raise DtabVersionMismatch(ns) from e
+            if e.status == 404 or e.code == ApiError.KEY_NOT_FOUND:
+                raise DtabNamespaceDoesNotExist(ns) from e
+            raise
         await self._refresh_now()
 
     async def put(self, ns: str, dtab: Dtab) -> None:
-        body = f"value={quote(dtab.show)}".encode()
-        rsp = await _http_call(self.host, self.port, "PUT",
-                               self._key(ns), body)
-        if rsp.status not in (200, 201):
-            raise RuntimeError(f"etcd put: {rsp.status}")
+        await self._ns_key(ns).set(dtab.show)
         await self._refresh_now()
 
     async def delete(self, ns: str) -> None:
-        rsp = await _http_call(self.host, self.port, "DELETE", self._key(ns))
-        if rsp.status == 404:
-            raise DtabNamespaceDoesNotExist(ns)
-        if rsp.status != 200:
-            raise RuntimeError(f"etcd delete: {rsp.status}")
+        from linkerd_tpu.etcd import ApiError
+
+        try:
+            await self._ns_key(ns).delete()
+        except ApiError as e:
+            if e.status == 404 or e.code == ApiError.KEY_NOT_FOUND:
+                raise DtabNamespaceDoesNotExist(ns) from e
+            raise
         await self._refresh_now()
+
+    def close(self) -> None:
+        if self._watch is not None:
+            self._watch.stop()
+            self._watch = None
+        super().close()
 
 
 class ConsulDtabStore(_WatchedRemoteStore):

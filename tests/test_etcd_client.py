@@ -1,0 +1,92 @@
+"""The standalone etcd v2 client library against the scripted fake
+(ref: etcd/.../{Etcd,Key,NodeOp}.scala + EtcdFixture-style tests)."""
+
+import asyncio
+
+import pytest
+
+from linkerd_tpu.etcd import ApiError, EtcdClient, Node, NodeOp
+from linkerd_tpu.protocol.http.server import HttpServer
+from tests.test_remote_stores import FakeEtcd
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+class TestEtcdKeyOps:
+    def test_set_get_cas_delete(self):
+        async def go():
+            fake = FakeEtcd()
+            server = await HttpServer(fake.service()).start()
+            etcd = EtcdClient("127.0.0.1", server.bound_port)
+            try:
+                key = etcd.key("/apps/web")
+                op = await key.set("v1")
+                assert op.node.value == "v1"
+                idx = op.node.modified_index
+
+                got = await key.get()
+                assert got.node.value == "v1"
+                assert got.node.modified_index == idx
+
+                # CAS: stale prevIndex rejected with COMPARE_FAILED/412
+                with pytest.raises(ApiError):
+                    await key.set("v2", prev_index=idx - 5)
+                await key.set("v2", prev_index=idx)
+                assert (await key.get()).node.value == "v2"
+
+                # prevExist=false on an existing key rejected
+                with pytest.raises(ApiError):
+                    await key.set("v3", prev_exist=False)
+
+                # recursive dir listing flattens to leaves
+                await etcd.key("/apps/api").set("v9")
+                listing = await etcd.key("/apps").get(recursive=True)
+                leaves = {n.key: n.value for n in listing.node.leaves()}
+                assert leaves == {"/apps/web": "v2", "/apps/api": "v9"}
+
+                await key.delete()
+                with pytest.raises(ApiError) as ei:
+                    await key.get()
+                assert ei.value.status == 404
+            finally:
+                await server.close()
+
+        run(go())
+
+    def test_watch_initial_list_then_incremental(self):
+        async def go():
+            fake = FakeEtcd()
+            fake.nodes["/apps/web"] = ("v1", fake.index)
+            server = await HttpServer(fake.service()).start()
+            etcd = EtcdClient("127.0.0.1", server.bound_port)
+            ops = []
+            got_initial = asyncio.Event()
+            got_change = asyncio.Event()
+
+            def on_op(op: NodeOp):
+                ops.append(op)
+                if op.action == "get":
+                    got_initial.set()
+                else:
+                    got_change.set()
+
+            watch = etcd.key("/apps").watch(on_op)
+            try:
+                await asyncio.wait_for(got_initial.wait(), 5)
+                assert ops[0].node.leaves()[0].value == "v1"
+
+                # external write arrives incrementally through the watch
+                fake._record("set", "/apps/api", "v2")
+                fake.nodes["/apps/api"] = ("v2", fake.index)
+                await asyncio.wait_for(got_change.wait(), 5)
+                change = ops[-1]
+                assert change.action == "set"
+                assert change.node.key == "/apps/api"
+                assert change.node.value == "v2"
+            finally:
+                watch.stop()
+                await server.close()
+
+        run(go())

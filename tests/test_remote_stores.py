@@ -96,13 +96,25 @@ class FakeEtcd:
                         return Response(status=412, body=b"{}")
                 self._record("set", key, form["value"])
                 self.nodes[key] = (form["value"], self.index)
-                return Response(status=200, body=b"{}")
+                # real etcd echoes the resulting node
+                return Response(status=200, headers=self._hdrs(),
+                                body=json.dumps({
+                                    "action": "set",
+                                    "node": {"key": key,
+                                             "value": form["value"],
+                                             "modifiedIndex": self.index},
+                                }).encode())
             if req.method == "DELETE":
                 if key not in self.nodes:
                     return Response(status=404, body=b"{}")
                 del self.nodes[key]
                 self._record("delete", key, None)
-                return Response(status=200, body=b"{}")
+                return Response(status=200, headers=self._hdrs(),
+                                body=json.dumps({
+                                    "action": "delete",
+                                    "node": {"key": key,
+                                             "modifiedIndex": self.index},
+                                }).encode())
             return Response(status=405)
         return FnService(handler)
 
@@ -263,7 +275,7 @@ class TestEtcdWatch:
             store = EtcdDtabStore("127.0.0.1", server.bound_port)
             act = store.observe("ops")
             for _ in range(100):
-                if store._watch_index is not None:
+                if store._primed:  # initial list delivered by the watch
                     break
                 await asyncio.sleep(0.01)
             t0 = time.perf_counter()
