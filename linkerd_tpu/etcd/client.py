@@ -152,7 +152,8 @@ class Key:
                 "recursive": "true" if recursive else None,
                 "dir": "true" if dir else None,
                 "prevIndex": prev_index,
-                "prevValue": quote(prev_value) if prev_value else None,
+                "prevValue": (quote(prev_value)
+                              if prev_value is not None else None),
             }))
         return self._node_op(rsp)
 
@@ -202,6 +203,7 @@ class Watch:
     async def _run(self) -> None:
         attempt = 0
         index: Optional[int] = None
+        delivered_absent = False  # empty-state op delivered for a 404
         while True:
             try:
                 if index is None:
@@ -214,6 +216,7 @@ class Watch:
                     index = top + 1
                     self._on_op(op)
                     attempt = 0
+                    delivered_absent = False  # key exists again
                     continue
                 try:
                     op = await self._key.get(
@@ -229,19 +232,29 @@ class Watch:
             except ApiError as e:
                 if e.code == ApiError.INDEX_CLEARED:
                     # history compacted: full re-list is REQUIRED. Still
-                    # backed off so a broken server can't induce a hot
-                    # re-list loop. (HTTP-status 400/401 without the etcd
-                    # errorCode is an auth/protocol problem, NOT
-                    # index-cleared — it falls to the generic backoff.)
+                    # exponentially backed off so a persistently-behind
+                    # watcher can't hot-loop full listings. (HTTP-status
+                    # 400/401 without the etcd errorCode is an auth/
+                    # protocol problem, NOT index-cleared — it falls to
+                    # the generic backoff.)
                     index = None
                     attempt = min(attempt + 1, 6)
-                    await asyncio.sleep(self._base
+                    await asyncio.sleep(self._base * (2 ** attempt)
                                         * (0.7 + random.random() / 2))
                     continue
                 if e.status == 404 and index is None:
-                    # key doesn't exist yet: deliver empty state, poll
-                    self._on_op(NodeOp("get", Node(self._key.path, dir=True),
-                                       etcd_index=e.index))
+                    # key doesn't exist yet: deliver empty state ONCE,
+                    # then long-poll from the index etcd reported (v2
+                    # accepts wait=true on nonexistent keys) — creation
+                    # arrives as an event, not by re-listing
+                    if not delivered_absent:
+                        delivered_absent = True
+                        self._on_op(NodeOp(
+                            "get", Node(self._key.path, dir=True),
+                            etcd_index=e.index))
+                    if e.index:
+                        index = e.index + 1
+                        continue
                     await asyncio.sleep(self._base * 4)
                     continue
                 attempt = min(attempt + 1, 6)

@@ -152,7 +152,8 @@ class EtcdDtabStore(_WatchedRemoteStore):
     # ── watch plumbing (lib-driven, replaces the base _run loop) ─────────
     def _ensure_task(self) -> None:
         if self._watch is None:
-            self._watch = self._dir.watch(self._on_op)
+            self._watch = self._dir.watch(
+                self._on_op, backoff_base=self._backoff_base)
 
     def _restart_watch(self) -> None:
         if self._watch is not None:

@@ -361,10 +361,24 @@ class _DynamicTable:
         return None, name_only
 
 
+_CACHE_CAP = 512           # entry bound on the steady-state block caches
+_CACHE_MAX_BLOCK = 2048    # don't cache oversized (peer-controlled) blocks
+_CACHE_MAX_BYTES = 256 * 1024  # per-connection byte bound (decoder keys
+                               # are peer-supplied: bound memory, not just
+                               # entries)
+
+
 class Decoder:
     def __init__(self, max_table_size: int = 4096):
         self._table = _DynamicTable(max_table_size)
         self._settings_max = max_table_size
+        # steady-state fast path: an identical block decodes identically
+        # as long as the dynamic table hasn't changed. Blocks that mutate
+        # the table are never cached (and invalidate everything, since
+        # dynamic indices shift); on the repeated header sets of a live
+        # connection this skips parsing entirely.
+        self._cache: dict = {}
+        self._cache_bytes = 0
 
     def set_max_table_size(self, size: int) -> None:
         """Apply our SETTINGS_HEADER_TABLE_SIZE (the encoder must shrink
@@ -372,9 +386,14 @@ class Decoder:
         self._settings_max = size
         if size < self._table.max_size:
             self._table.resize(size)
+        self._cache.clear()
 
     def decode(self, data: bytes) -> List[Tuple[str, str]]:
+        cached = self._cache.get(data)
+        if cached is not None:
+            return list(cached)
         headers: List[Tuple[str, str]] = []
+        mutated = False
         pos = 0
         while pos < len(data):
             b = data[pos]
@@ -391,6 +410,7 @@ class Decoder:
                     name, pos = _decode_string(data, pos)
                 value, pos = _decode_string(data, pos)
                 self._table.add(name, value)
+                mutated = True
                 headers.append((name, value))
             elif b & 0x20:  # dynamic table size update
                 size, pos = decode_int(data, pos, 5)
@@ -399,6 +419,7 @@ class Decoder:
                         f"table size update {size} exceeds settings "
                         f"{self._settings_max}")
                 self._table.resize(size)
+                mutated = True
             else:  # literal without indexing (0x00) / never indexed (0x10)
                 idx, pos = decode_int(data, pos, 4)
                 name = self._table.get(idx)[0] if idx else None
@@ -406,6 +427,16 @@ class Decoder:
                     name, pos = _decode_string(data, pos)
                 value, pos = _decode_string(data, pos)
                 headers.append((name, value))
+        if mutated:
+            self._cache.clear()
+            self._cache_bytes = 0
+        elif len(data) <= _CACHE_MAX_BLOCK:
+            if (len(self._cache) >= _CACHE_CAP
+                    or self._cache_bytes >= _CACHE_MAX_BYTES):
+                self._cache.clear()
+                self._cache_bytes = 0
+            self._cache[bytes(data)] = tuple(headers)
+            self._cache_bytes += len(data)
         return headers
 
 
@@ -414,6 +445,10 @@ class Encoder:
         self._table = _DynamicTable(max_table_size)
         self.huffman = huffman
         self._pending_resize: Optional[int] = None
+        # steady-state fast path (mirror of Decoder._cache): a header
+        # list that encodes without inserting into the dynamic table
+        # yields the same block until the table next changes
+        self._cache: dict = {}
 
     def set_max_table_size(self, size: int) -> None:
         """Honor the peer's SETTINGS_HEADER_TABLE_SIZE: emit a size update
@@ -421,14 +456,22 @@ class Encoder:
         size = min(size, 4096)
         self._pending_resize = size
         self._table.resize(size)
+        self._cache.clear()
 
     _NEVER_INDEX = frozenset({"authorization", "cookie", "set-cookie"})
 
     def encode(self, headers: List[Tuple[str, str]]) -> bytes:
+        key = tuple(headers)
+        if self._pending_resize is None:
+            cached = self._cache.get(key)
+            if cached is not None:
+                return cached
         out = bytearray()
+        inserted = False
         if self._pending_resize is not None:
             out += encode_int(self._pending_resize, 5, 0x20)
             self._pending_resize = None
+            inserted = True  # the size-update prefix must not be cached
         for name, value in headers:
             name = name.lower()
             full, name_idx = self._table.find(name, value)
@@ -452,4 +495,13 @@ class Encoder:
                 out += _encode_string(name, self.huffman)
             out += _encode_string(value, self.huffman)
             self._table.add(name, value)
-        return bytes(out)
+            inserted = True
+        block = bytes(out)
+        if inserted:
+            # dynamic indices shifted: previously cached blocks are stale
+            self._cache.clear()
+        else:
+            if len(self._cache) >= _CACHE_CAP:
+                self._cache.clear()
+            self._cache[key] = block
+        return block
