@@ -69,6 +69,7 @@ import linkerd_tpu.namer.transformers  # noqa: F401
 import linkerd_tpu.protocol.h2.classifiers  # noqa: F401
 import linkerd_tpu.protocol.h2.identifiers  # noqa: F401
 import linkerd_tpu.protocol.http.identifiers  # noqa: F401
+import linkerd_tpu.protocol.http.loggers  # noqa: F401
 import linkerd_tpu.router.classifiers  # noqa: F401
 import linkerd_tpu.router.failure_accrual  # noqa: F401
 import linkerd_tpu.telemetry.anomaly  # noqa: F401
@@ -199,6 +200,10 @@ class RouterSpec:
     # trace ids + dtab overrides ride thrift hops
     # (ref: ThriftInitializer.scala attemptTTwitterUpgrade)
     attemptTTwitterUpgrade: bool = True
+    # http only: per-request logger plugin chain in the client stack
+    # (ref: HttpLoggerConfig.scala loggers param; kinds under
+    # protocol/http/loggers.py)
+    loggers: Optional[List[Any]] = None
     # http only: serve the data plane from the native C++ epoll engine
     # (native/fastpath.cpp); Python remains the control plane (naming,
     # route install, stats/feature drain). Requires a built native lib.
@@ -367,6 +372,7 @@ class Linker:
         self.routers: List[Router] = []
         self.telemeters: List[Any] = []
         self._access_listeners: List[Tuple[Any, Any]] = []
+        self._logger_filters: List[Any] = []
         self._build()
 
     # -- assembly ---------------------------------------------------------
@@ -909,6 +915,13 @@ class Linker:
             self._mk_client_validator(label))
         metrics = self.metrics
         mk_policy_factory = self._mk_policy_factory_fn(label)
+        # logger plugin chain: validated + materialized ONCE at router
+        # build (bad configs fail load, not the first request), shared by
+        # every client, and closed with the linker
+        logger_filters = [
+            cfg.mk() for cfg in instantiate_list(
+                "logger", rspec.loggers, f"{label}.loggers")]
+        self._logger_filters.extend(logger_filters)
 
         def client_factory(bound: BoundName) -> Service:
             code = _status_code_of(bound)
@@ -945,6 +958,10 @@ class Linker:
                 StatsFilter(metrics, "rt", label, "client", cid),
                 DstHeadersFilter(cid),
             ]
+            # per-router logger plugin chain, client-stack position
+            # (ref: HttpConfig.scala insertAfter DtabStatsFilter);
+            # materialized ONCE per router — see logger_filters below
+            filters.extend(logger_filters)
             if not isinstance(self.tracer, NullTracer):
                 filters.append(ClientTraceFilter(self.tracer, cid))
             metrics.scope("rt", label, "client", cid).gauge(
@@ -1099,6 +1116,11 @@ class Linker:
             listener.stop()
             fh.close()
         self._access_listeners.clear()
+        for lf in self._logger_filters:
+            closer = getattr(lf, "close", None)
+            if closer is not None:
+                closer()
+        self._logger_filters.clear()
 
 
 def load_linker(text: str) -> Linker:

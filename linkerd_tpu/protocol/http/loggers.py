@@ -1,0 +1,113 @@
+"""Per-router request-logger plugin chain (the ``logger`` SPI).
+
+Ref: linkerd/protocol/http/.../HttpLoggerConfig.scala — router configs
+carry ``loggers: [{kind: ...}, ...]``; each kind materializes a filter
+inserted into the client stack per request (the plugin point istio's
+mixer logger uses, IstioLogger.scala). Kinds here:
+
+- ``io.l5d.http.debug`` — logs one line per request/response pair at a
+  configurable level (method, uri, dst, status, latency).
+- ``io.l5d.http.file`` — appends JSON lines to a file off the event
+  loop (same QueueListener pattern as the access log).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from linkerd_tpu.config import ConfigError, register
+from linkerd_tpu.router.service import Filter, Service
+
+log = logging.getLogger("linkerd_tpu.reqlog")
+
+
+class DebugLogger(Filter):
+    def __init__(self, level: int, logger_name: str):
+        self._level = level
+        self._log = logging.getLogger(logger_name)
+
+    async def apply(self, req, service: Service):
+        t0 = time.monotonic()
+        status = "err"
+        try:
+            rsp = await service(req)
+            status = rsp.status
+            return rsp
+        finally:
+            if self._log.isEnabledFor(self._level):
+                dst = req.ctx.get("dst")
+                self._log.log(
+                    self._level, "%s %s dst=%s -> %s (%.1fms)",
+                    req.method, req.uri,
+                    dst.path.show if dst is not None else "-",
+                    status, (time.monotonic() - t0) * 1e3)
+
+
+@register("logger", "io.l5d.http.debug")
+@dataclass
+class DebugLoggerConfig:
+    level: str = "DEBUG"       # DEBUG | INFO | WARNING
+    logger: str = "linkerd_tpu.reqlog"
+
+    def mk(self) -> Filter:
+        level = logging.getLevelName(self.level.upper())
+        if not isinstance(level, int):
+            raise ConfigError(f"io.l5d.http.debug: bad level {self.level!r}")
+        return DebugLogger(level, self.logger)
+
+
+class FileLogger(Filter):
+    """JSON-lines request log, written off the event loop."""
+
+    def __init__(self, path: str):
+        import queue as _queue
+        from logging.handlers import QueueHandler, QueueListener
+
+        self._q: _queue.SimpleQueue = _queue.SimpleQueue()
+        # standalone, NOT registered with logging.getLogger: registry
+        # entries live forever and id()-reuse could attach two handlers
+        # to one logger (same pattern as the access log, linker.py)
+        self._logger = logging.Logger("linkerd_tpu.reqlog.file",
+                                      logging.INFO)
+        self._logger.addHandler(QueueHandler(self._q))
+        self._fh = logging.FileHandler(path)
+        self._fh.setFormatter(logging.Formatter("%(message)s"))
+        self._listener = QueueListener(self._q, self._fh)
+        self._listener.start()
+
+    def close(self) -> None:
+        self._listener.stop()
+        self._fh.close()
+
+    async def apply(self, req, service: Service):
+        t0 = time.monotonic()
+        status: Optional[int] = None
+        try:
+            rsp = await service(req)
+            status = rsp.status
+            return rsp
+        finally:
+            dst = req.ctx.get("dst")
+            self._logger.info(json.dumps({
+                "ts": round(time.time(), 3),
+                "method": req.method,
+                "uri": req.uri,
+                "dst": dst.path.show if dst is not None else None,
+                "status": status,
+                "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
+            }))
+
+
+@register("logger", "io.l5d.http.file")
+@dataclass
+class FileLoggerConfig:
+    path: str = ""
+
+    def mk(self) -> Filter:
+        if not self.path:
+            raise ConfigError("io.l5d.http.file logger needs path")
+        return FileLogger(self.path)

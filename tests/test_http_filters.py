@@ -157,3 +157,64 @@ namers:
                 await linker.close()
                 await d.close()
         run(go())
+
+
+class TestRequestLoggers:
+    def test_file_logger_through_full_linker(self, tmp_path):
+        """loggers: [{kind: io.l5d.http.file}] writes one JSON line per
+        proxied request from the client-stack position
+        (ref: HttpLoggerConfig.scala plugin chain)."""
+        import json as _json
+
+        from linkerd_tpu.linker import load_linker
+        from linkerd_tpu.protocol.http.client import HttpClient
+        from linkerd_tpu.protocol.http.server import serve
+        from linkerd_tpu.router.service import FnService
+
+        async def go():
+            disco = tmp_path / "disco"
+            disco.mkdir()
+            log_path = tmp_path / "req.log"
+
+            async def handler(req):
+                return Response(status=200, body=b"ok")
+            backend = await serve(FnService(handler))
+            (disco / "web").write_text(f"127.0.0.1 {backend.bound_port}\n")
+
+            linker = load_linker(f"""
+routers:
+- protocol: http
+  label: lg
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  loggers:
+  - kind: io.l5d.http.file
+    path: {log_path}
+  servers: [{{port: 0}}]
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+""")
+            await linker.start()
+            proxy = HttpClient("127.0.0.1",
+                               linker.routers[0].server_ports[0])
+            req = Request(uri="/things")
+            req.headers.set("Host", "web")
+            rsp = await proxy(req)
+            assert rsp.status == 200
+            await proxy.close()
+            await linker.close()
+            await backend.close()
+
+            for _ in range(100):
+                if log_path.exists() and log_path.read_text().strip():
+                    break
+                await asyncio.sleep(0.02)
+            line = _json.loads(log_path.read_text().strip().splitlines()[0])
+            assert line["method"] == "GET"
+            assert line["uri"] == "/things"
+            assert line["status"] == 200
+            assert line["dst"].startswith("/svc/web")
+            assert line["latency_ms"] >= 0
+
+        run(go())
