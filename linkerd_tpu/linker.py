@@ -371,9 +371,15 @@ class Linker:
         self._announcements: List[Any] = []
         self.routers: List[Router] = []
         self.telemeters: List[Any] = []
-        self._access_listeners: List[Tuple[Any, Any]] = []
+        self._file_sinks: List[Any] = []  # close() fns for file emitters
         self._logger_filters: List[Any] = []
-        self._build()
+        try:
+            self._build()
+        except BaseException:
+            # a config error mid-build must not leak the listener threads
+            # / FDs of sinks and loggers materialized before the failure
+            self._close_sinks()
+            raise
 
     # -- assembly ---------------------------------------------------------
     def _build(self) -> None:
@@ -901,6 +907,12 @@ class Linker:
 
     def _mk_http_router(self, rspec: RouterSpec, label: str) -> Router:
         if rspec.fastPath:
+            if rspec.loggers:
+                # the native engine has no Python per-request hook; an
+                # ignored audit log is worse than a load failure
+                raise ConfigError(
+                    f"{label}: loggers are not supported with "
+                    f"fastPath: true")
             return self._mk_fastpath_router(rspec, label)
         base_dtab = Dtab.read(rspec.dtab) if rspec.dtab else Dtab.empty()
         prefix = Path.read(rspec.dstPrefix)
@@ -1064,18 +1076,10 @@ class Linker:
         Linker.close()."""
         if target == "stdout":
             return print
-        import logging.handlers
-        import queue
-
-        q: queue.SimpleQueue = queue.SimpleQueue()
-        fh = logging.FileHandler(target)
-        fh.setFormatter(logging.Formatter("%(message)s"))
-        listener = logging.handlers.QueueListener(q, fh)
-        listener.start()
-        self._access_listeners.append((listener, fh))
-        alog = logging.Logger(f"access.{label}")  # standalone, not registered
-        alog.addHandler(logging.handlers.QueueHandler(q))
-        return alog.info
+        from linkerd_tpu.protocol.http.loggers import mk_file_emit
+        emit, close = mk_file_emit(target)
+        self._file_sinks.append(close)
+        return emit
 
     def _anomaly_board(self):
         """ScoreBoard of the configured jaxAnomaly telemeter (or a detached
@@ -1112,14 +1116,22 @@ class Linker:
             namer.close()
         for t in self.telemeters:
             t.close()
-        for listener, fh in self._access_listeners:
-            listener.stop()
-            fh.close()
-        self._access_listeners.clear()
+        self._close_sinks()
+
+    def _close_sinks(self) -> None:
+        for close in self._file_sinks:
+            try:
+                close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._file_sinks.clear()
         for lf in self._logger_filters:
             closer = getattr(lf, "close", None)
             if closer is not None:
-                closer()
+                try:
+                    closer()
+                except Exception:  # noqa: BLE001
+                    pass
         self._logger_filters.clear()
 
 

@@ -123,13 +123,24 @@ class H2Stream:
     # -- consumer ---------------------------------------------------------
     async def read(self):
         """Next frame; raises StreamReset after a reset."""
-        if self.at_end:
-            raise EOFError("stream already ended")
-        while not self._q:
+        while True:
+            item = self.read_nowait()
+            if item is not None:
+                return item
             if self._reset is not None:
                 raise self._reset
             self._waiter = asyncio.get_running_loop().create_future()
             await self._waiter
+
+    def read_nowait(self):
+        """Next frame if one is queued, else None (never suspends) —
+        lets consumers that would otherwise wrap read() in wait_for (a
+        task + timer per call) take the common already-buffered frames
+        synchronously."""
+        if self.at_end:
+            raise EOFError("stream already ended")
+        if not self._q:
+            return None
         item = self._q.popleft()
         if isinstance(item, StreamReset):
             self._q.append(item)  # keep terminal state observable

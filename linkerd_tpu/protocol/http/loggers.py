@@ -60,28 +60,38 @@ class DebugLoggerConfig:
         return DebugLogger(level, self.logger)
 
 
+def mk_file_emit(path: str):
+    """Off-event-loop line sink: (emit, close). One QueueListener thread
+    drains a SimpleQueue into a FileHandler; the logger is standalone
+    (NOT registered with logging.getLogger — registry entries live
+    forever and id()-reuse could attach two handlers to one logger).
+    Shared by the access log and the file request-logger."""
+    import queue as _queue
+    from logging.handlers import QueueHandler, QueueListener
+
+    q: _queue.SimpleQueue = _queue.SimpleQueue()
+    logger = logging.Logger("linkerd_tpu.filesink", logging.INFO)
+    logger.addHandler(QueueHandler(q))
+    fh = logging.FileHandler(path)
+    fh.setFormatter(logging.Formatter("%(message)s"))
+    listener = QueueListener(q, fh)
+    listener.start()
+
+    def close() -> None:
+        listener.stop()
+        fh.close()
+
+    return logger.info, close
+
+
 class FileLogger(Filter):
     """JSON-lines request log, written off the event loop."""
 
     def __init__(self, path: str):
-        import queue as _queue
-        from logging.handlers import QueueHandler, QueueListener
-
-        self._q: _queue.SimpleQueue = _queue.SimpleQueue()
-        # standalone, NOT registered with logging.getLogger: registry
-        # entries live forever and id()-reuse could attach two handlers
-        # to one logger (same pattern as the access log, linker.py)
-        self._logger = logging.Logger("linkerd_tpu.reqlog.file",
-                                      logging.INFO)
-        self._logger.addHandler(QueueHandler(self._q))
-        self._fh = logging.FileHandler(path)
-        self._fh.setFormatter(logging.Formatter("%(message)s"))
-        self._listener = QueueListener(self._q, self._fh)
-        self._listener.start()
+        self._emit, self._close = mk_file_emit(path)
 
     def close(self) -> None:
-        self._listener.stop()
-        self._fh.close()
+        self._close()
 
     async def apply(self, req, service: Service):
         t0 = time.monotonic()
@@ -92,7 +102,7 @@ class FileLogger(Filter):
             return rsp
         finally:
             dst = req.ctx.get("dst")
-            self._logger.info(json.dumps({
+            self._emit(json.dumps({
                 "ts": round(time.time(), 3),
                 "method": req.method,
                 "uri": req.uri,

@@ -192,15 +192,20 @@ async def _collect_response(stream, limit: int, hold_s: float
     total = 0
     trailers: Optional[Trailers] = None
     deadline = time.monotonic() + hold_s
+    read_nowait = getattr(stream, "read_nowait", None)  # wrappers: absent
     try:
         while not stream.at_end:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                return frames, None, True, None
-            try:
-                frame = await asyncio.wait_for(stream.read(), remaining)
-            except asyncio.TimeoutError:
-                return frames, None, True, None
+            # already-buffered frames (the common unary case) are taken
+            # synchronously — wait_for costs a task + timer per call
+            frame = read_nowait() if read_nowait is not None else None
+            if frame is None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return frames, None, True, None
+                try:
+                    frame = await asyncio.wait_for(stream.read(), remaining)
+                except asyncio.TimeoutError:
+                    return frames, None, True, None
             if isinstance(frame, Trailers):
                 trailers = frame
                 frames.append(frame)

@@ -310,3 +310,47 @@ class TestCurlInterop:
                 await server.close()
 
         run(go())
+
+
+class TestHpackCacheCorrectness:
+    def test_random_roundtrip_with_table_churn(self):
+        """Property check for the steady-state block caches: random
+        header lists (repeats, new entries, evictions, resizes) must
+        round-trip encoder->decoder identically to a cache-free pair."""
+        import random as _random
+
+        from linkerd_tpu.protocol.h2 import hpack
+
+        rng = _random.Random(42)
+        enc = hpack.Encoder()
+        dec = hpack.Decoder()
+        names = [f"x-h{i}" for i in range(40)] + [":path", ":authority"]
+        values = [f"v{i}" * rng.randint(1, 30) for i in range(60)]
+        seen_lists = []
+        for step in range(600):
+            if seen_lists and rng.random() < 0.5:
+                headers = rng.choice(seen_lists)  # repeat: cache hits
+            else:
+                headers = [(rng.choice(names), rng.choice(values))
+                           for _ in range(rng.randint(1, 8))]
+                seen_lists.append(headers)
+            if rng.random() < 0.02:
+                size = rng.choice([512, 1024, 4096])
+                dec.set_max_table_size(size)
+                enc.set_max_table_size(size)
+            block = enc.encode(headers)
+            got = dec.decode(block)
+            want = [(n.lower(), v) for n, v in headers]
+            assert got == want, (step, headers, got)
+
+    def test_decoder_cache_bounded(self):
+        from linkerd_tpu.protocol.h2 import hpack
+
+        dec = hpack.Decoder()
+        enc = hpack.Encoder()
+        # only literal-never-indexed fields -> non-mutating blocks
+        for i in range(hpack._CACHE_CAP + 50):
+            block = enc.encode([("authorization", f"token-{i}")])
+            dec.decode(block)
+        assert len(dec._cache) <= hpack._CACHE_CAP
+        assert dec._cache_bytes <= hpack._CACHE_MAX_BYTES
