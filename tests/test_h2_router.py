@@ -187,3 +187,36 @@ class TestGrpcThroughProxy:
                 await linker.close()
                 await d.close()
         run(go())
+
+
+class TestLargeStreamThroughProxy:
+    def test_8mb_body_exceeds_conn_window_through_router(self, disco):
+        """A body larger than BOTH flow-control windows (1MB stream / 4MB
+        conn) must flow through the full router path — proves the
+        deferred WINDOW_UPDATE credits recycle across both hops (ref:
+        router/h2 LargeStreamEndToEndTest + FlowControlEndToEndTest)."""
+        big = bytes(1024) * (8 * 1024)  # 8MB
+
+        async def echo_len(req: H2Request) -> H2Response:
+            body, _ = await req.stream.read_all(max_bytes=1 << 27)
+            return H2Response(status=200, body=body[::-1][:64]
+                              + str(len(body)).encode())
+
+        async def go():
+            backend = await H2Server(FnService(echo_len)).start()
+            (disco / "big").write_text(f"127.0.0.1 {backend.bound_port}\n")
+            linker = load_linker(mk_cfg(disco))
+            await linker.start()
+            client = H2Client("127.0.0.1",
+                              linker.routers[0].server_ports[0])
+            try:
+                rsp = await client(H2Request(
+                    method="POST", path="/up", authority="big", body=big))
+                body, _ = await rsp.stream.read_all(max_bytes=1 << 27)
+                assert body.endswith(str(len(big)).encode())
+            finally:
+                await client.close()
+                await linker.close()
+                await backend.close()
+
+        run(go())
