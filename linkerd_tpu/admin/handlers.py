@@ -97,6 +97,17 @@ async def logging_handler(req: Request) -> Response:
     return json_response(loggers)
 
 
+def mk_anomaly_handler(linker: "Linker"):
+    """``/anomaly.json`` — live per-dst anomaly scores from the
+    io.l5d.jaxAnomaly telemeter's score board (empty when the telemeter
+    isn't configured)."""
+    async def handler(req: Request) -> Response:
+        board = linker._anomaly_board()
+        return json_response({"scores": dict(board.scores.sample())})
+
+    return handler
+
+
 def linkerd_admin_handlers(linker: "Linker") -> List[Tuple[str, Any]]:
     """The standard linkerd admin surface (LinkerdAdmin.apply)."""
     from linkerd_tpu.admin.dashboard import dashboard_handler
@@ -104,5 +115,6 @@ def linkerd_admin_handlers(linker: "Linker") -> List[Tuple[str, Any]]:
         ("/", dashboard_handler),
         ("/delegator.json", mk_delegator_handler(linker)),
         ("/bound-names.json", mk_bound_names_handler(linker)),
+        ("/anomaly.json", mk_anomaly_handler(linker)),
         ("/logging.json", logging_handler),
     ]
