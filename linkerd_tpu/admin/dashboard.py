@@ -136,7 +136,7 @@ async function refresh(){
   // rows use the same lstrip('/')+'.'-join normalization — exact join
   const anomalyByService = {};
   for(const [k,v] of Object.entries(anomaly))
-   anomalyByService[k.replace(/^\//,'').replaceAll('/','.')] = v;
+   anomalyByService[(k.startsWith('/')?k.slice(1):k).replaceAll('/','.')] = v;
   document.querySelector('#routers tbody').innerHTML =
    Object.entries(routers).map(([r,s])=>{
     const pct = s.req ? (100*(s.ok||0)/s.req).toFixed(1) : '';
