@@ -354,3 +354,38 @@ class TestHpackCacheCorrectness:
             dec.decode(block)
         assert len(dec._cache) <= hpack._CACHE_CAP
         assert dec._cache_bytes <= hpack._CACHE_MAX_BYTES
+
+
+class TestClientReconnect:
+    def test_reconnects_after_server_goaway(self):
+        """The singleton-pool client must transparently re-establish after
+        the server GOAWAYs its connection (ref: H2.scala SingletonPool
+        re-establishment)."""
+        async def go():
+            server = await serve_h2(echo_service())
+            client = H2Client("127.0.0.1", server.bound_port)
+            try:
+                r1 = await client(H2Request(
+                    method="POST", path="/a", authority="t", body=b"one"))
+                b1, _ = await r1.stream.read_all()
+                assert b1.endswith(b"one")
+
+                # server closes every live connection (GOAWAY + FIN)
+                first_conn = client._conn
+                for conn in list(server._conns):
+                    await conn.close()
+                for _ in range(100):
+                    if first_conn.is_closed:
+                        break
+                    await asyncio.sleep(0.01)
+
+                r2 = await client(H2Request(
+                    method="POST", path="/b", authority="t", body=b"two"))
+                b2, _ = await r2.stream.read_all()
+                assert b2.endswith(b"two")
+                assert client._conn is not first_conn  # fresh connection
+            finally:
+                await client.close()
+                await server.close()
+
+        run(go())

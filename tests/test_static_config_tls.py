@@ -299,3 +299,44 @@ namers:
 
 async def _ok(body: bytes) -> Response:
     return Response(status=200, body=body)
+
+
+class TestH2OverTls:
+    def test_h2_alpn_end_to_end(self, certs):
+        """h2 over TLS with ALPN negotiation, client verifying the server
+        cert (ref: finagle/h2/src/e2e/.../TlsEndToEndTest.scala)."""
+        from linkerd_tpu.protocol.h2.client import H2Client
+        from linkerd_tpu.protocol.h2.messages import H2Request, H2Response
+        from linkerd_tpu.protocol.h2.server import H2Server
+
+        cert, key = certs
+
+        async def handler(req: H2Request) -> H2Response:
+            body, _ = await req.stream.read_all()
+            return H2Response(status=200, body=b"tls:" + body)
+
+        async def go():
+            sctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            sctx.load_cert_chain(cert, key)
+            server = await H2Server(FnService(handler),
+                                    ssl_context=sctx).start()
+
+            cctx = ssl.create_default_context(cafile=cert)
+            client = H2Client("127.0.0.1", server.bound_port,
+                              ssl_context=cctx, server_hostname="web")
+            try:
+                rsp = await client(H2Request(
+                    method="POST", path="/s", authority="web",
+                    body=b"hello"))
+                body, _ = await rsp.stream.read_all()
+                assert body == b"tls:hello"
+                # the negotiated protocol must actually be h2 (ALPN)
+                transport = client._conn._writer.transport
+                sslobj = transport.get_extra_info("ssl_object")
+                assert sslobj is not None
+                assert sslobj.selected_alpn_protocol() == "h2"
+            finally:
+                await client.close()
+                await server.close()
+
+        run(go())
