@@ -108,6 +108,46 @@ def mk_anomaly_handler(linker: "Linker"):
     return handler
 
 
+def mk_identifier_handler(linker: "Linker"):
+    """``/identifier.json`` — run each http router's identifier against a
+    synthetic request and show the resulting logical name (ref:
+    linkerd/admin/.../HttpIdentifierHandler.scala:48). Query params:
+    ``method``, ``host``, ``path``, plus optional ``router`` filter."""
+    async def handler(req: Request) -> Response:
+        q = _query(req)
+        if q.get("router") and not any(
+                r.label == q["router"] for r in linker.routers):
+            return json_response(
+                {"error": f"no router {q['router']!r}"}, status=404)
+        synthetic = Request(method=q.get("method", "GET"),
+                            uri=q.get("path", "/"))
+        if q.get("host"):
+            synthetic.headers.set("Host", q["host"])
+        out = {}
+        for r in linker.routers:
+            identifier = getattr(r, "identifier", None)  # fastPath: absent
+            if identifier is None:
+                continue
+            if q.get("router") and r.label != q["router"]:
+                continue
+            try:
+                dst = identifier(synthetic)
+                if hasattr(dst, "__await__"):
+                    dst = await dst
+                if isinstance(dst, Response):
+                    # identifiers may answer directly (istio redirects)
+                    out[r.label] = {"response": dst.status}
+                else:
+                    out[r.label] = {"path": dst.path.show,
+                                    "baseDtab": dst.base_dtab.show,
+                                    "localDtab": dst.local_dtab.show}
+            except Exception as e:  # noqa: BLE001 — per-router result
+                out[r.label] = {"error": str(e)}
+        return json_response(out)
+
+    return handler
+
+
 def linkerd_admin_handlers(linker: "Linker") -> List[Tuple[str, Any]]:
     """The standard linkerd admin surface (LinkerdAdmin.apply)."""
     from linkerd_tpu.admin.dashboard import dashboard_handler
@@ -116,5 +156,6 @@ def linkerd_admin_handlers(linker: "Linker") -> List[Tuple[str, Any]]:
         ("/delegator.json", mk_delegator_handler(linker)),
         ("/bound-names.json", mk_bound_names_handler(linker)),
         ("/anomaly.json", mk_anomaly_handler(linker)),
+        ("/identifier.json", mk_identifier_handler(linker)),
         ("/logging.json", logging_handler),
     ]

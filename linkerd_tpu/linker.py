@@ -307,13 +307,14 @@ class Router:
 
     def __init__(self, spec: RouterSpec, label: str, service: Service,
                  binding: DstBindingFactory, servers: List[HttpServer],
-                 interpreter=None):
+                 interpreter=None, identifier=None):
         self.spec = spec
         self.label = label
         self.service = service
         self.binding = binding
         self.servers = servers
         self.interpreter = interpreter
+        self.identifier = identifier  # admin /identifier.json debug
 
     @property
     def server_ports(self) -> List[int]:
@@ -1068,7 +1069,8 @@ class Linker:
             for s in (rspec.servers or [ServerSpec()])
         ]
         return Router(rspec, label, server_stack, binding, servers,
-                      interpreter=interpreter)
+                      interpreter=interpreter,
+                      identifier=identifier)
 
     def _mk_access_emit(self, label: str, target: str):
         """Access-log sink: off-event-loop disk writes via QueueListener;

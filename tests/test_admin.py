@@ -46,3 +46,43 @@ class TestAdmin:
                 await admin.close()
 
         run(go())
+
+
+def test_identifier_debug_endpoint(tmp_path):
+    """/identifier.json runs each http router's identifier on a synthetic
+    request (ref: HttpIdentifierHandler.scala:48)."""
+    import asyncio
+    import json as _json
+
+    from linkerd_tpu.admin.handlers import mk_identifier_handler
+    from linkerd_tpu.linker import load_linker
+    from linkerd_tpu.protocol.http.message import Request
+
+    disco = tmp_path / "disco"
+    disco.mkdir()
+    (disco / "web").write_text("127.0.0.1 1\n")
+
+    async def go():
+        linker = load_linker(f"""
+routers:
+- protocol: http
+  label: idr
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers: [{{port: 0}}]
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+""")
+        handler = mk_identifier_handler(linker)
+        rsp = await handler(Request(
+            uri="/identifier.json?method=GET&host=web&path=/x"))
+        out = _json.loads(rsp.body)
+        assert out["idr"]["path"] == "/svc/web"
+        # unidentifiable request reports the per-router error
+        rsp2 = await handler(Request(uri="/identifier.json?path=/x"))
+        out2 = _json.loads(rsp2.body)
+        assert "error" in out2["idr"]
+        await linker.close()
+
+    asyncio.run(asyncio.wait_for(go(), 30))
