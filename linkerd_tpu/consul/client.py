@@ -22,11 +22,16 @@ class ConsulApiError(Exception):
 
 class ConsulApi:
     def __init__(self, host: str = "127.0.0.1", port: int = 8500,
-                 token: Optional[str] = None, wait: str = "5m"):
+                 token: Optional[str] = None, wait: str = "5m",
+                 consistency: str = "default"):
         self.host = host
         self.port = port
         self.token = token
         self.wait = wait
+        if consistency not in ("default", "stale", "consistent"):
+            raise ValueError(f"bad consul consistency {consistency!r}")
+        # ref: BaseApi.scala ConsistencyMode — rides every blocking query
+        self.consistency = consistency
 
     async def get(self, path: str,
                   index: Optional[int] = None,
@@ -63,10 +68,15 @@ class ConsulApi:
             path += f"&dc={dc}"
         if tag:
             path += f"&tag={tag}"
+        if self.consistency != "default":
+            path += f"&{self.consistency}"
         return await self.get(path, index)
 
     async def catalog_datacenters(self):
-        data, _ = await self.get("/v1/catalog/datacenters")
+        path = "/v1/catalog/datacenters"
+        if self.consistency != "default":
+            path += f"?{self.consistency}"
+        data, _ = await self.get(path)
         return data or []
 
     async def catalog_services(self, dc: Optional[str] = None,
@@ -74,4 +84,6 @@ class ConsulApi:
         path = "/v1/catalog/services"
         if dc:
             path += f"?dc={dc}"
+        if self.consistency != "default":
+            path += ("&" if "?" in path else "?") + self.consistency
         return await self.get(path, index)

@@ -15,7 +15,7 @@ import random
 from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
-from linkerd_tpu.config import register
+from linkerd_tpu.config import ConfigError, register
 from linkerd_tpu.core import Activity, Path, Var
 from linkerd_tpu.core.activity import Ok, PENDING
 from linkerd_tpu.core.addr import (
@@ -98,12 +98,21 @@ class _SvcPoll:
 class ConsulNamer(Namer):
     def __init__(self, api: ConsulApi, id_prefix: str = "io.l5d.consul",
                  include_tag: bool = False,
-                 prefer_service_address: bool = True):
+                 prefer_service_address: bool = True,
+                 set_host: bool = False, domain: str = "consul"):
         self._api = api
         self._id_prefix = id_prefix
         self._include_tag = include_tag
         self._prefer = prefer_service_address
+        # ref: SvcAddr.mkMeta — authority metadata
+        # ({tag.}{svc}.service.{dc}.{domain}) for TLS/Host rewriting
+        self._set_host = set_host
+        self._domain = domain
         self._polls: Dict[Tuple[str, str, Optional[str]], _SvcPoll] = {}
+        # one derived authority Var per poll key (NOT per lookup: a
+        # per-lookup Var.map registers an observer that is never
+        # detached and would leak across binding-cache churn)
+        self._authority_vars: Dict[Tuple[str, str, Optional[str]], Var] = {}
 
     def _poll(self, dc: str, svc: str, tag: Optional[str]) -> _SvcPoll:
         key = (dc, svc, tag)
@@ -125,7 +134,23 @@ class ConsulNamer(Namer):
         residual = path.drop(need)
         poll = self._poll(dc, svc, tag)
         bid = Path.of("#", self._id_prefix).concat(path.take(need))
-        bound_leaf = Leaf(BoundName(bid, poll.addr, residual))
+        addr_var = poll.addr
+        if self._set_host:
+            key = (dc, svc, tag)
+            addr_var = self._authority_vars.get(key)
+            if addr_var is None:
+                authority = (f"{tag}.{svc}.service.{dc}.{self._domain}"
+                             if tag else f"{svc}.service.{dc}.{self._domain}")
+
+                def with_authority(a, _auth=authority):
+                    if isinstance(a, Bound):
+                        return Bound(a.addresses,
+                                     a.meta + (("authority", _auth),))
+                    return a
+
+                addr_var = poll.addr.map(with_authority)
+                self._authority_vars[key] = addr_var
+        bound_leaf = Leaf(BoundName(bid, addr_var, residual))
 
         def to_state(args):
             seen, addr = args
@@ -152,9 +177,17 @@ class ConsulNamerConfig:
     includeTag: bool = False
     useHealthCheck: bool = True   # parity flag; health endpoint is used
     preferServiceAddress: bool = True
+    setHost: bool = False         # authority metadata (SvcAddr.mkMeta)
+    domain: str = "consul"        # consul DNS domain in the authority
+    consistencyMode: str = "default"  # default | stale | consistent
     prefix: str = "/io.l5d.consul"
 
     def mk(self) -> Namer:
-        api = ConsulApi(self.host, self.port, token=self.token)
+        try:
+            api = ConsulApi(self.host, self.port, token=self.token,
+                            consistency=self.consistencyMode)
+        except ValueError as e:
+            raise ConfigError(str(e)) from None
         return ConsulNamer(api, include_tag=self.includeTag,
-                           prefer_service_address=self.preferServiceAddress)
+                           prefer_service_address=self.preferServiceAddress,
+                           set_host=self.setHost, domain=self.domain)

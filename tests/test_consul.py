@@ -175,3 +175,56 @@ class TestMarathonNamer:
             namer.close()
             await server.close()
         run(go())
+
+
+class TestConsulConfigParity:
+    def test_set_host_authority_metadata(self):
+        """setHost attaches the consul DNS authority to the bound address
+        set (ref: SvcAddr.mkMeta)."""
+        from linkerd_tpu.core import Var
+        from linkerd_tpu.core.addr import Address, Bound, BoundName
+        from linkerd_tpu.core.nametree import Leaf
+        from linkerd_tpu.consul.namer import ConsulNamer, _SvcPoll
+        from linkerd_tpu.consul.client import ConsulApi
+
+        async def go():
+            namer = ConsulNamer(ConsulApi("127.0.0.1", 1),
+                                set_host=True)
+            # seed the poll with a live address set (no real consul)
+            poll = namer._poll("dc1", "web", None)
+            poll.stop()
+            poll.addr.update(Bound(frozenset({Address.mk("10.0.0.1", 80)})))
+            poll.seen.update(True)
+            from linkerd_tpu.core import Path
+            act = namer.lookup(Path.read("/dc1/web/rest"))
+            tree = act.sample()
+            assert isinstance(tree, Leaf)
+            meta = dict(tree.value.addr.sample().meta)
+            assert meta["authority"] == "web.service.dc1.consul"
+            namer.close()
+
+        run(go())
+
+    def test_consistency_mode_rides_health_queries(self):
+        from linkerd_tpu.consul.client import ConsulApi
+
+        api = ConsulApi("127.0.0.1", 1, consistency="stale")
+        seen = {}
+
+        async def fake_get(path, index=None, **kw):
+            seen["path"] = path
+            return [], 1
+
+        api.get = fake_get
+
+        async def go():
+            await api.health_service("web", dc="dc1")
+            assert "&stale" in seen["path"]
+
+        run(go())
+
+        import pytest as _pytest
+        from linkerd_tpu.config import ConfigError, instantiate
+        with _pytest.raises(ConfigError):
+            instantiate("namer", {"kind": "io.l5d.consul",
+                                  "consistencyMode": "bogus"}).mk()
