@@ -58,10 +58,14 @@ def _endpoints_addrs(obj: dict, port_sel: str) -> Addr:
 class _SvcWatch:
     """One list+watch per (namespace, service); raw-object Var."""
 
-    def __init__(self, api: K8sApi, kind_path: str, ns: str, name: str):
+    def __init__(self, api: K8sApi, kind_path: str, ns: str, name: str,
+                 label_selector: Optional[str] = None):
         self.obj: Var[Optional[dict]] = Var(None)
         self._started = False
         path = f"/api/v1/namespaces/{ns}/{kind_path}/{name}"
+        if label_selector:
+            from urllib.parse import quote
+            path += f"?labelSelector={quote(label_selector)}"
 
         def on_list(obj: dict) -> None:
             # a single-object GET returns the object itself
@@ -89,34 +93,44 @@ class EndpointsNamer(Namer):
     """``/<namespace>/<port>/<service>[/residual]`` over Endpoints."""
 
     def __init__(self, api: K8sApi, id_prefix: str = "io.l5d.k8s",
-                 fixed_namespace: Optional[str] = None):
+                 fixed_namespace: Optional[str] = None,
+                 label_name: Optional[str] = None):
         self._api = api
         self._id_prefix = id_prefix
         self._fixed_ns = fixed_namespace
-        self._watches: Dict[Tuple[str, str], _SvcWatch] = {}
+        # ref: EndpointsNamer.scala labelSelector — when a label NAME is
+        # configured, paths carry one extra segment (the label VALUE) and
+        # the endpoints watch filters by `label=value`
+        self._label_name = label_name
+        self._watches: Dict[Tuple[str, str, Optional[str]], _SvcWatch] = {}
 
-    def _watch(self, ns: str, svc: str) -> _SvcWatch:
-        key = (ns, svc)
+    def _watch(self, ns: str, svc: str,
+               selector: Optional[str] = None) -> _SvcWatch:
+        key = (ns, svc, selector)
         w = self._watches.get(key)
         if w is None:
-            w = _SvcWatch(self._api, "endpoints", ns, svc)
+            w = _SvcWatch(self._api, "endpoints", ns, svc,
+                          label_selector=selector)
             self._watches[key] = w
         w.start()
         return w
 
     def lookup(self, path: Path) -> Activity[NameTree]:
+        extra = 1 if self._label_name else 0
         if self._fixed_ns is None:
-            if len(path) < 3:
+            if len(path) < 3 + extra:
                 return Activity.value(NEG)
             ns, port, svc = path[0], path[1], path[2]
-            consumed = 3
+            consumed = 3 + extra
         else:
-            if len(path) < 2:
+            if len(path) < 2 + extra:
                 return Activity.value(NEG)
             ns, (port, svc) = self._fixed_ns, (path[0], path[1])
-            consumed = 2
+            consumed = 2 + extra
+        selector = (f"{self._label_name}={path[consumed - 1]}"
+                    if self._label_name else None)
         residual = path.drop(consumed)
-        watch = self._watch(ns, svc)
+        watch = self._watch(ns, svc, selector)
         bid = Path.of("#", self._id_prefix).concat(path.take(consumed))
         addr_var = watch.obj.map(
             lambda obj: (ADDR_PENDING if obj is None
@@ -222,12 +236,16 @@ class K8sNamerConfig:
     useTls: bool = False
     caCertPath: Optional[str] = None
     insecureSkipVerify: bool = False
+    # label NAME: paths gain a trailing label-VALUE segment and the
+    # endpoints watch filters by `label=value` (ref: K8sConfig.labelSelector)
+    labelSelector: Optional[str] = None
     prefix: str = "/io.l5d.k8s"
 
     def mk(self) -> Namer:
-        return EndpointsNamer(_mk_api(
-            self.host, self.port, self.useTls,
-            self.caCertPath, self.insecureSkipVerify))
+        return EndpointsNamer(
+            _mk_api(self.host, self.port, self.useTls,
+                    self.caCertPath, self.insecureSkipVerify),
+            label_name=self.labelSelector)
 
 
 @register("namer", "io.l5d.k8s.ns")
@@ -239,13 +257,15 @@ class K8sNamespacedConfig:
     useTls: bool = False
     caCertPath: Optional[str] = None
     insecureSkipVerify: bool = False
+    labelSelector: Optional[str] = None
     prefix: str = "/io.l5d.k8s.ns"
 
     def mk(self) -> Namer:
         return EndpointsNamer(
             _mk_api(self.host, self.port, self.useTls,
                     self.caCertPath, self.insecureSkipVerify),
-            id_prefix="io.l5d.k8s.ns", fixed_namespace=self.namespace)
+            id_prefix="io.l5d.k8s.ns", fixed_namespace=self.namespace,
+            label_name=self.labelSelector)
 
 
 @register("namer", "io.l5d.k8s.external")

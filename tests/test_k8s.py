@@ -232,3 +232,50 @@ class TestMissingService:
             namer.close()
             await server.close()
         run(go())
+
+
+class TestLabelSelector:
+    def test_label_value_segment_filters_watch(self):
+        """With labelSelector configured, paths carry a trailing label
+        value and the endpoints watch filters by label=value
+        (ref: EndpointsNamer.scala labelSelector handling)."""
+        seen_paths = []
+
+        class SelectorFake(FakeK8sApi):
+            def service(self):
+                inner = super().service()
+
+                async def handler(req):
+                    seen_paths.append(req.uri)
+                    return await inner(req)
+                return FnService(handler)
+
+        async def go():
+            fake = SelectorFake()
+            server = await HttpServer(fake.service()).start()
+            api = K8sApi("127.0.0.1", server.bound_port, use_tls=False)
+            namer = EndpointsNamer(api, label_name="version")
+            try:
+                act = namer.lookup(Path.read("/prod/http/web/v1/rest"))
+                for _ in range(100):
+                    from linkerd_tpu.core.activity import Ok
+                    if isinstance(act.current, Ok):
+                        break
+                    await asyncio.sleep(0.02)
+                tree = act.sample()
+                assert isinstance(tree, Leaf)
+                bn = tree.value
+                assert bn.id_.show == "/#/io.l5d.k8s/prod/http/web/v1"
+                assert bn.residual.show == "/rest"
+                assert any("labelSelector=version%3Dv1" in p
+                           for p in seen_paths), seen_paths
+
+                # too-short path (no label value) -> Neg
+                from linkerd_tpu.core.nametree import Neg
+                act2 = namer.lookup(Path.read("/prod/http/web"))
+                assert isinstance(act2.sample(), Neg)
+            finally:
+                namer.close()
+                await server.close()
+
+        run(go())
