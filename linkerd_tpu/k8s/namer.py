@@ -63,18 +63,33 @@ class _SvcWatch:
         self.obj: Var[Optional[dict]] = Var(None)
         self._started = False
         path = f"/api/v1/namespaces/{ns}/{kind_path}/{name}"
+        want_label: Optional[Tuple[str, str]] = None
         if label_selector:
             from urllib.parse import quote
             path += f"?labelSelector={quote(label_selector)}"
+            # real API servers IGNORE labelSelector on single-object
+            # GETs, so the filter must also apply client-side
+            k, _, v = label_selector.partition("=")
+            want_label = (k, v)
+
+        def matches(obj: dict) -> bool:
+            if want_label is None:
+                return True
+            labels = (obj.get("metadata") or {}).get("labels") or {}
+            return labels.get(want_label[0]) == want_label[1]
 
         def on_list(obj: dict) -> None:
             # a single-object GET returns the object itself
-            self.obj.update(obj if obj.get("kind") != "Status" else {})
+            if obj.get("kind") == "Status" or not matches(obj):
+                self.obj.update({})
+            else:
+                self.obj.update(obj)
 
         def on_event(evt: dict) -> None:
             t = evt.get("type")
             if t in ("ADDED", "MODIFIED"):
-                self.obj.update(evt.get("object") or {})
+                obj = evt.get("object") or {}
+                self.obj.update(obj if matches(obj) else {})
             elif t == "DELETED":
                 self.obj.update({})
 

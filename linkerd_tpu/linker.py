@@ -193,6 +193,13 @@ class RouterSpec:
     sampleRate: float = 1.0               # trace sampling for new roots
     httpAccessLog: Optional[str] = None   # path or "stdout"
     addForwardedHeader: bool = False      # RFC 7239 (AddForwardedHeader)
+    # h2 only: advertised SETTINGS (ref: H2Config.scala
+    # initialStreamWindowBytes/maxFrameBytes/maxHeaderListBytes/
+    # maxConcurrentStreamsPerConnection)
+    initialStreamWindowBytes: Optional[int] = None
+    maxFrameBytes: Optional[int] = None
+    maxHeaderListBytes: Optional[int] = None
+    maxConcurrentStreamsPerConnection: Optional[int] = None
     # thrift only: method name as the dst path element instead of the
     # static "thrift" dst (ref: router/thrift Identifier.scala:34)
     thriftMethodInDst: bool = False
@@ -533,6 +540,33 @@ class Linker:
 
         base_dtab = Dtab.read(rspec.dtab) if rspec.dtab else Dtab.empty()
         prefix = Path.read(rspec.dstPrefix)
+        # advertised SETTINGS for both sides (ref: H2Config.scala params);
+        # validated here so a bad value fails config load, not every
+        # connection at its SETTINGS exchange
+        if rspec.maxFrameBytes is not None and not (
+                16384 <= rspec.maxFrameBytes <= (1 << 24) - 1):
+            raise ConfigError(
+                f"{label}.maxFrameBytes must be in 16384..16777215 "
+                f"(RFC 7540 §6.5.2), got {rspec.maxFrameBytes}")
+        if rspec.initialStreamWindowBytes is not None and not (
+                0 < rspec.initialStreamWindowBytes <= (1 << 31) - 1):
+            raise ConfigError(
+                f"{label}.initialStreamWindowBytes must be in 1..2^31-1, "
+                f"got {rspec.initialStreamWindowBytes}")
+        if (rspec.maxHeaderListBytes is not None
+                and rspec.maxHeaderListBytes <= 0):
+            raise ConfigError(f"{label}.maxHeaderListBytes must be > 0")
+        if (rspec.maxConcurrentStreamsPerConnection is not None
+                and rspec.maxConcurrentStreamsPerConnection < 1):
+            raise ConfigError(
+                f"{label}.maxConcurrentStreamsPerConnection must be >= 1")
+        h2_settings = {k: v for k, v in {
+            "initial_window": rspec.initialStreamWindowBytes,
+            "max_frame": rspec.maxFrameBytes,
+            "max_header_list": rspec.maxHeaderListBytes,
+            "max_concurrent_streams":
+                rspec.maxConcurrentStreamsPerConnection,
+        }.items() if v is not None}
         identifier = self._mk_identifier(
             rspec, label, "h2identifier", "io.l5d.header.token",
             prefix, base_dtab)
@@ -567,7 +601,8 @@ class Linker:
                 client: Service = H2Client(
                     addr.host, addr.port,
                     connect_timeout=cspec.connectTimeoutMs / 1e3,
-                    ssl_context=ssl_ctx, server_hostname=sni)
+                    ssl_context=ssl_ctx, server_hostname=sni,
+                    h2_settings=h2_settings)
                 return FailureAccrualService(client, mk_policy())
 
             bal_kind = (cspec.loadBalancer or BalancerSpec()).kind
@@ -637,7 +672,8 @@ class Linker:
         servers = [
             H2Server(per_server_stack(s), s.ip, s.port,
                      max_concurrency=s.maxConcurrentRequests,
-                     ssl_context=(s.tls.mk_context() if s.tls else None))
+                     ssl_context=(s.tls.mk_context() if s.tls else None),
+                     h2_settings=h2_settings)
             for s in (rspec.servers or [ServerSpec()])
         ]
         return Router(rspec, label, server_stack, binding, servers,

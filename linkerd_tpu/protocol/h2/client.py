@@ -20,7 +20,8 @@ class H2Client(Service[H2Request, H2Response]):
 
     def __init__(self, host: str, port: int,
                  connect_timeout: float = 3.0,
-                 ssl_context=None, server_hostname: Optional[str] = None):
+                 ssl_context=None, server_hostname: Optional[str] = None,
+                 h2_settings: Optional[dict] = None):
         self.host = host
         self.port = port
         self.connect_timeout = connect_timeout
@@ -28,6 +29,7 @@ class H2Client(Service[H2Request, H2Response]):
             ssl_context.set_alpn_protocols(["h2"])
         self.ssl_context = ssl_context
         self.server_hostname = server_hostname
+        self._h2_settings = dict(h2_settings or {})
         self._conn: Optional[H2Connection] = None
         self._connecting: Optional[asyncio.Future] = None
         self._closed = False
@@ -54,7 +56,8 @@ class H2Client(Service[H2Request, H2Response]):
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(self.host, self.port, **kw),
                 self.connect_timeout)
-            conn = H2Connection(reader, writer, is_client=True)
+            conn = H2Connection(reader, writer, is_client=True,
+                                **self._h2_settings)
             await conn.start()
             self._conn = conn
             self._connecting.set_result(conn)

@@ -72,11 +72,27 @@ class H2Connection:
                  writer: asyncio.StreamWriter, is_client: bool,
                  handler: Optional[Callable[[H2Request],
                                             Awaitable[H2Response]]] = None,
-                 huffman: bool = False):
+                 huffman: bool = False,
+                 initial_window: int = LOCAL_INITIAL_WINDOW,
+                 max_frame: int = DEFAULT_MAX_FRAME_SIZE,
+                 max_header_list: int = MAX_HEADER_LIST,
+                 max_concurrent_streams: Optional[int] = None):
         self._reader = reader
         self._writer = writer
         self.is_client = is_client
         self._handler = handler
+        # advertised SETTINGS (ref: finagle/h2 param.scala — configurable
+        # per router via initialStreamWindowBytes/maxFrameBytes/
+        # maxHeaderListBytes/maxConcurrentStreamsPerConnection)
+        self._local_initial_window = initial_window
+        self._local_max_frame = max_frame
+        self._max_header_list = max_header_list
+        self._max_concurrent = max_concurrent_streams
+        self._stream_credit_threshold = max(1, initial_window // 2)
+        # the connection window must dominate the stream window or a
+        # single long-haul stream stalls below its advertised window
+        self._local_conn_window = max(LOCAL_CONN_WINDOW, 4 * initial_window)
+        self._conn_credit_threshold = max(1, self._local_conn_window // 4)
         self._encoder = hpack.Encoder(huffman=huffman)
         self._decoder = hpack.Decoder()
         self._streams: Dict[int, _StreamState] = {}
@@ -92,6 +108,9 @@ class H2Connection:
         self._last_peer_stream = 0
         self._settings_acked = asyncio.Event()
         self._handler_tasks: set = set()
+        self._refused: set = set()  # recently REFUSED_STREAM ids
+        self._peer_max_concurrent: Optional[int] = None
+        self._slot_waiters: List[asyncio.Future] = []
         # contiguous header-block assembly state
         self._hdr_accum: Optional[Tuple[int, int, bytearray]] = None
         # write coalescing: frames written within one event-loop iteration
@@ -132,10 +151,14 @@ class H2Connection:
     async def start(self) -> "H2Connection":
         self._loop = asyncio.get_running_loop()
         settings = [
-            (frames.SETTINGS_INITIAL_WINDOW_SIZE, LOCAL_INITIAL_WINDOW),
-            (frames.SETTINGS_MAX_FRAME_SIZE, DEFAULT_MAX_FRAME_SIZE),
-            (frames.SETTINGS_MAX_HEADER_LIST_SIZE, MAX_HEADER_LIST),
+            (frames.SETTINGS_INITIAL_WINDOW_SIZE,
+             self._local_initial_window),
+            (frames.SETTINGS_MAX_FRAME_SIZE, self._local_max_frame),
+            (frames.SETTINGS_MAX_HEADER_LIST_SIZE, self._max_header_list),
         ]
+        if self._max_concurrent is not None:
+            settings.append((frames.SETTINGS_MAX_CONCURRENT_STREAMS,
+                             self._max_concurrent))
         if self.is_client:
             self._write(CONNECTION_PREFACE)
             settings.append((frames.SETTINGS_ENABLE_PUSH, 0))
@@ -145,8 +168,8 @@ class H2Connection:
                 raise H2ProtocolError(frames.PROTOCOL_ERROR, "bad preface")
         self._write(frames.pack_settings(settings))
         self._write(frames.pack_window_update(
-            0, LOCAL_CONN_WINDOW - DEFAULT_INITIAL_WINDOW))
-        self._recv_window = LOCAL_CONN_WINDOW
+            0, self._local_conn_window - DEFAULT_INITIAL_WINDOW))
+        self._recv_window = self._local_conn_window
         await self._drain()
         self._read_task = self._loop.create_task(self._read_loop())
         return self
@@ -195,6 +218,10 @@ class H2Connection:
             if st.pump_task is not None:
                 st.pump_task.cancel()
         self._streams.clear()
+        for w in self._slot_waiters:
+            if not w.done():
+                w.set_result(None)
+        self._slot_waiters.clear()
         # wake any senders blocked on flow-control so they observe closure
         loop = asyncio.get_event_loop()
         loop.create_task(self._notify_windows())
@@ -209,10 +236,19 @@ class H2Connection:
         assert self.is_client
         if self._closed or self.goaway_received:
             raise ConnectionError("h2 connection closed/goaway")
+        # honor the peer's advertised concurrent-stream limit: wait for a
+        # slot instead of provoking REFUSED_STREAM failures
+        while (self._peer_max_concurrent is not None
+               and len(self._streams) >= self._peer_max_concurrent):
+            waiter = asyncio.get_running_loop().create_future()
+            self._slot_waiters.append(waiter)
+            await waiter
+            if self._closed or self.goaway_received:
+                raise ConnectionError("h2 connection closed/goaway")
         sid = self._next_stream_id
         self._next_stream_id += 2
         st = _StreamState(sid, self._peer_initial_window,
-                          LOCAL_INITIAL_WINDOW)
+                          self._local_initial_window)
         st.response_fut = asyncio.get_running_loop().create_future()
         self._streams[sid] = st
 
@@ -341,6 +377,7 @@ class H2Connection:
 
     def _rst(self, st: _StreamState, code: int) -> None:
         st.reset_sent = True
+        self._wake_slot()
         if not self._closed:
             try:
                 self._write(frames.pack_rst(st.id, code))
@@ -358,7 +395,7 @@ class H2Connection:
         credit is pending (the stream-update twin lives in _on_data)."""
         self._recv_window += n
         self._pending_conn_credit += n
-        if self._pending_conn_credit >= CONN_CREDIT_THRESHOLD:
+        if self._pending_conn_credit >= self._conn_credit_threshold:
             self._write(frames.pack_window_update(
                 0, self._pending_conn_credit))
             self._pending_conn_credit = 0
@@ -383,7 +420,7 @@ class H2Connection:
                 n = len(buf)
                 while n - pos >= 9:
                     length = (buf[pos] << 16) | (buf[pos + 1] << 8) | buf[pos + 2]
-                    if length > DEFAULT_MAX_FRAME_SIZE + 1024:
+                    if length > self._local_max_frame + 1024:
                         raise H2ProtocolError(frames.FRAME_SIZE_ERROR,
                                               f"frame too large: {length}")
                     end = pos + 9 + length
@@ -450,7 +487,7 @@ class H2Connection:
                                       "unexpected CONTINUATION")
             sid, es_flag, buf = self._hdr_accum
             buf += payload
-            if len(buf) > MAX_HEADER_LIST * 2:
+            if len(buf) > self._max_header_list * 2:
                 raise H2ProtocolError(frames.ENHANCE_YOUR_CALM,
                                       "header block too large")
             if fh.flags & frames.FLAG_END_HEADERS:
@@ -536,7 +573,7 @@ class H2Connection:
                 if stt is not None and not stt.recv_closed:
                     stt.recv_window += n
                     stt.pending_credit += n
-                    if stt.pending_credit >= STREAM_CREDIT_THRESHOLD:
+                    if stt.pending_credit >= self._stream_credit_threshold:
                         self._write(frames.pack_window_update(
                             _sid, stt.pending_credit))
                         stt.pending_credit = 0
@@ -577,12 +614,22 @@ class H2Connection:
                 self._maybe_gc(st)
         else:
             if st is None:
+                if sid in self._refused:
+                    return  # trailing frames of a refused stream (§5.1)
                 if sid <= self._last_peer_stream or sid % 2 == 0:
                     raise H2ProtocolError(frames.PROTOCOL_ERROR,
                                           f"bad stream id {sid}")
                 self._last_peer_stream = sid
+                if (self._max_concurrent is not None
+                        and len(self._streams) >= self._max_concurrent):
+                    # over our advertised limit: refuse, not kill the conn
+                    self._write(frames.pack_rst(sid, frames.REFUSED_STREAM))
+                    if len(self._refused) > 64:
+                        self._refused.clear()
+                    self._refused.add(sid)
+                    return
                 st = _StreamState(sid, self._peer_initial_window,
-                                  LOCAL_INITIAL_WINDOW)
+                                  self._local_initial_window)
                 st.got_headers = True
                 self._streams[sid] = st
                 req = H2Request.from_header_list(headers)
@@ -652,6 +699,14 @@ class H2Connection:
     def _maybe_gc(self, st: _StreamState) -> None:
         if st.recv_closed and st.send_closed:
             self._streams.pop(st.id, None)
+            self._wake_slot()
+
+    def _wake_slot(self) -> None:
+        while self._slot_waiters:
+            w = self._slot_waiters.pop(0)
+            if not w.done():
+                w.set_result(None)
+                break
 
     def _apply_settings(self, settings: List[Tuple[int, int]]) -> None:
         for key, value in settings:
@@ -668,6 +723,8 @@ class H2Connection:
                     raise H2ProtocolError(frames.PROTOCOL_ERROR,
                                           "bad max frame size")
                 self._peer_max_frame = value
+            elif key == frames.SETTINGS_MAX_CONCURRENT_STREAMS:
+                self._peer_max_concurrent = value
             elif key == frames.SETTINGS_HEADER_TABLE_SIZE:
                 self._encoder.set_max_table_size(value)
         loop = asyncio.get_event_loop()

@@ -22,13 +22,15 @@ class H2Server:
     def __init__(self, service: Service[H2Request, H2Response],
                  host: str = "127.0.0.1", port: int = 0,
                  ssl_context=None,
-                 max_concurrency: Optional[int] = None):
+                 max_concurrency: Optional[int] = None,
+                 h2_settings: Optional[dict] = None):
         self.service = service
         self.host = host
         self.port = port
         if ssl_context is not None:
             ssl_context.set_alpn_protocols(["h2"])
         self.ssl_context = ssl_context
+        self._h2_settings = dict(h2_settings or {})
         self._server: Optional[asyncio.base_events.Server] = None
         self._conns: set = set()
         # admission control (ref: maxConcurrentRequests ->
@@ -60,6 +62,7 @@ class H2Server:
     async def _on_conn(self, reader: asyncio.StreamReader,
                        writer: asyncio.StreamWriter) -> None:
         conn = H2Connection(reader, writer, is_client=False,
+                            **self._h2_settings,
                             handler=self._dispatch)
         self._conns.add(conn)
         try:

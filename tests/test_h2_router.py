@@ -220,3 +220,60 @@ class TestLargeStreamThroughProxy:
                 await backend.close()
 
         run(go())
+
+
+class TestH2SettingsConfig:
+    def test_settings_advertised_and_refusal(self, disco):
+        """Router-level h2 SETTINGS reach the wire: a tiny
+        maxConcurrentStreamsPerConnection causes REFUSED_STREAM resets
+        when exceeded (ref: H2Config.scala settings params)."""
+        async def slow(req: H2Request) -> H2Response:
+            await asyncio.sleep(0.3)
+            return H2Response(status=200, body=b"ok")
+
+        async def go():
+            backend = await H2Server(FnService(slow)).start()
+            (disco / "slow").write_text(f"127.0.0.1 {backend.bound_port}\n")
+            cfg = f"""
+routers:
+- protocol: h2
+  label: h2cfg
+  maxConcurrentStreamsPerConnection: 1
+  initialStreamWindowBytes: 131072
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers:
+  - port: 0
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+            from linkerd_tpu.linker import load_linker
+            linker = load_linker(cfg)
+            await linker.start()
+            client = H2Client("127.0.0.1",
+                              linker.routers[0].server_ports[0])
+
+            async def one():
+                rsp = await client(H2Request(
+                    method="GET", path="/x", authority="slow"))
+                body, _ = await rsp.stream.read_all()
+                return rsp.status
+
+            try:
+                # two concurrent streams against a limit of 1: one served,
+                # the other refused (StreamReset) — never a dead conn
+                results = await asyncio.gather(one(), one(),
+                                               return_exceptions=True)
+                ok = [r for r in results if r == 200]
+                refused = [r for r in results if isinstance(r, Exception)]
+                assert len(ok) >= 1
+                assert len(ok) + len(refused) == 2
+                # after the burst, the connection still works
+                assert await one() == 200
+            finally:
+                await client.close()
+                await linker.close()
+                await backend.close()
+
+        run(go())

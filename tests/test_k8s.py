@@ -242,6 +242,11 @@ class TestLabelSelector:
         seen_paths = []
 
         class SelectorFake(FakeK8sApi):
+            def __init__(self):
+                super().__init__()
+                # the pods behind this service carry version=v1
+                self.obj["metadata"]["labels"] = {"version": "v1"}
+
             def service(self):
                 inner = super().service()
 
@@ -274,6 +279,16 @@ class TestLabelSelector:
                 from linkerd_tpu.core.nametree import Neg
                 act2 = namer.lookup(Path.read("/prod/http/web"))
                 assert isinstance(act2.sample(), Neg)
+
+                # non-matching label value filters CLIENT-side too (real
+                # API servers ignore labelSelector on single-object GETs)
+                act3 = namer.lookup(Path.read("/prod/http/web/v9"))
+                for _ in range(100):
+                    from linkerd_tpu.core.activity import Ok
+                    if isinstance(act3.current, Ok):
+                        break
+                    await asyncio.sleep(0.02)
+                assert isinstance(act3.sample(), Neg)
             finally:
                 namer.close()
                 await server.close()
