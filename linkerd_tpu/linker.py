@@ -211,9 +211,10 @@ class RouterSpec:
     # (ref: HttpLoggerConfig.scala loggers param; kinds under
     # protocol/http/loggers.py)
     loggers: Optional[List[Any]] = None
-    # http only: serve the data plane from the native C++ epoll engine
-    # (native/fastpath.cpp); Python remains the control plane (naming,
-    # route install, stats/feature drain). Requires a built native lib.
+    # http + h2: serve the data plane from the native C++ epoll engine
+    # (native/fastpath.cpp for http, native/h2_fastpath.cpp for h2);
+    # Python remains the control plane (naming, route install,
+    # stats/feature drain). Requires a built native lib.
     fastPath: bool = False
 
 
@@ -538,6 +539,20 @@ class Linker:
             H2ClassifiedRetries, H2ErrorResponder, H2StreamStatsFilter,
         )
 
+        if rspec.fastPath:
+            # the native engine speaks fixed SETTINGS (16384 frames, 4MB
+            # stream / 16MB conn windows); silently dropping configured
+            # values would be worse than refusing them (same stance as
+            # http fastPath vs loggers)
+            for knob in ("maxFrameBytes", "initialStreamWindowBytes",
+                         "maxHeaderListBytes",
+                         "maxConcurrentStreamsPerConnection"):
+                if getattr(rspec, knob) is not None:
+                    raise ConfigError(
+                        f"{label}: {knob} is not supported with "
+                        f"fastPath: true (the native h2 engine uses "
+                        f"fixed SETTINGS)")
+            return self._mk_fastpath_router(rspec, label)
         base_dtab = Dtab.read(rspec.dtab) if rspec.dtab else Dtab.empty()
         prefix = Path.read(rspec.dstPrefix)
         # advertised SETTINGS for both sides (ref: H2Config.scala params);
@@ -919,10 +934,13 @@ class Linker:
                       interpreter=interpreter)
 
     def _mk_fastpath_router(self, rspec: RouterSpec, label: str) -> Router:
-        """HTTP router served by the native engine (fastPath: true).
+        """http or h2 router served by the native engine (fastPath: true).
 
         The engine owns the listeners and the request hot loop; naming,
-        stats, and anomaly features flow through FastPathController."""
+        stats, and anomaly features flow through FastPathController. The
+        h2 engine (native/h2_fastpath.cpp) proxies h2c/gRPC frames with
+        HPACK + both flow-control levels; the http engine
+        (native/fastpath.cpp) proxies HTTP/1.1."""
         from linkerd_tpu import native
         from linkerd_tpu.router.fastpath import FastPathController
 
@@ -933,7 +951,8 @@ class Linker:
         base_dtab = Dtab.read(rspec.dtab) if rspec.dtab else Dtab.empty()
         prefix = Path.read(rspec.dstPrefix)
         interpreter = self._mk_interpreter(rspec, label)
-        engine = native.FastPathEngine()
+        engine = (native.H2FastPathEngine() if rspec.protocol == "h2"
+                  else native.FastPathEngine())
         specs = rspec.servers or [ServerSpec()]
         ports = [engine.listen(s.ip, s.port) for s in specs]
         ctl = FastPathController(

@@ -64,6 +64,7 @@ def lib() -> Optional[ctypes.CDLL]:
     try:
         cdll = ctypes.CDLL(_SO_PATH)
         _declare_fastpath(cdll)
+        _declare_h2_fastpath(cdll)
         cdll.l5d_huffman_decode.restype = ctypes.c_long
         cdll.l5d_huffman_decode.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t,
@@ -120,6 +121,33 @@ def huffman_encode(data: bytes) -> Optional[bytes]:
     return out.raw[:n]
 
 
+def _declare_h2_fastpath(cdll: ctypes.CDLL) -> None:
+    cdll.fph2_create.restype = ctypes.c_void_p
+    cdll.fph2_create.argtypes = []
+    cdll.fph2_start.restype = ctypes.c_int
+    cdll.fph2_start.argtypes = [ctypes.c_void_p]
+    cdll.fph2_listen.restype = ctypes.c_int
+    cdll.fph2_listen.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_int]
+    cdll.fph2_set_route.restype = ctypes.c_int
+    cdll.fph2_set_route.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_char_p]
+    cdll.fph2_remove_route.restype = ctypes.c_int
+    cdll.fph2_remove_route.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    cdll.fph2_drain_misses.restype = ctypes.c_long
+    cdll.fph2_drain_misses.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_size_t]
+    cdll.fph2_stats_json.restype = ctypes.c_long
+    cdll.fph2_stats_json.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_size_t]
+    cdll.fph2_drain_features.restype = ctypes.c_long
+    cdll.fph2_drain_features.argtypes = [ctypes.c_void_p,
+                                         ctypes.POINTER(ctypes.c_float),
+                                         ctypes.c_long]
+    cdll.fph2_shutdown.restype = None
+    cdll.fph2_shutdown.argtypes = [ctypes.c_void_p]
+
+
 def _declare_fastpath(cdll: ctypes.CDLL) -> None:
     cdll.fp_create.restype = ctypes.c_void_p
     cdll.fp_create.argtypes = []
@@ -157,6 +185,7 @@ class FastPathEngine:
     """
 
     FEATURE_DIM = 6  # route_id, latency_ms, status, req_b, rsp_b, ts_s
+    _PREFIX = "fp"  # C symbol prefix; the h2 engine overrides to "fph2"
 
     def __init__(self):
         cdll = lib()
@@ -164,7 +193,16 @@ class FastPathEngine:
             raise RuntimeError("native library unavailable; fastPath "
                                "requires a working toolchain")
         self._lib = cdll
-        self._e = cdll.fp_create()
+        p = self._PREFIX
+        self._fn_listen = getattr(cdll, p + "_listen")
+        self._fn_start = getattr(cdll, p + "_start")
+        self._fn_set_route = getattr(cdll, p + "_set_route")
+        self._fn_remove_route = getattr(cdll, p + "_remove_route")
+        self._fn_drain_misses = getattr(cdll, p + "_drain_misses")
+        self._fn_stats = getattr(cdll, p + "_stats_json")
+        self._fn_features = getattr(cdll, p + "_drain_features")
+        self._fn_shutdown = getattr(cdll, p + "_shutdown")
+        self._e = getattr(cdll, p + "_create")()
         self._started = False
         self._closed = False
         self._miss_buf = ctypes.create_string_buffer(64 * 1024)
@@ -176,14 +214,14 @@ class FastPathEngine:
     def listen(self, ip: str, port: int) -> int:
         """Bind a listener; returns the bound port. Call before start()."""
         assert not self._started
-        got = self._lib.fp_listen(self._e, ip.encode(), port)
+        got = self._fn_listen(self._e, ip.encode(), port)
         if got < 0:
             raise OSError(f"fastpath listen {ip}:{port} failed")
         return got
 
     def start(self) -> None:
         if not self._started:
-            if self._lib.fp_start(self._e) != 0:
+            if self._fn_start(self._e) != 0:
                 raise RuntimeError("fastpath thread start failed")
             self._started = True
 
@@ -195,14 +233,14 @@ class FastPathEngine:
 
     def set_route(self, host: str, endpoints: List[Tuple[str, int]]) -> None:
         eps = " ".join(f"{ip}:{port}" for ip, port in endpoints) + " "
-        self._lib.fp_set_route(self._e, self._key(host), eps.encode())
+        self._fn_set_route(self._e, self._key(host), eps.encode())
 
     def remove_route(self, host: str) -> None:
-        self._lib.fp_remove_route(self._e, self._key(host))
+        self._fn_remove_route(self._e, self._key(host))
 
     def drain_misses(self) -> List[str]:
-        n = self._lib.fp_drain_misses(self._e, self._miss_buf,
-                                      len(self._miss_buf))
+        n = self._fn_drain_misses(self._e, self._miss_buf,
+                                  len(self._miss_buf))
         if n <= 0:
             return []
         return self._miss_buf.value.decode("latin-1").split("\n")[:n]
@@ -210,8 +248,8 @@ class FastPathEngine:
     def stats(self) -> dict:
         import json
         for _ in range(6):
-            n = self._lib.fp_stats_json(self._e, self._stats_buf,
-                                        len(self._stats_buf))
+            n = self._fn_stats(self._e, self._stats_buf,
+                               len(self._stats_buf))
             if n == -2:  # buffer too small: grow (capped at 64MB)
                 if len(self._stats_buf) >= 64 << 20:
                     log.warning("fastpath stats exceed 64MB; dropping")
@@ -227,8 +265,7 @@ class FastPathEngine:
     def drain_features(self):
         """-> float32 ndarray [n, FEATURE_DIM] of per-request rows."""
         import numpy as np
-        n = self._lib.fp_drain_features(self._e, self._feat_buf,
-                                        self._feat_rows)
+        n = self._fn_features(self._e, self._feat_buf, self._feat_rows)
         if n <= 0:
             return np.zeros((0, self.FEATURE_DIM), dtype=np.float32)
         arr = np.ctypeslib.as_array(self._feat_buf)
@@ -238,7 +275,18 @@ class FastPathEngine:
     def close(self) -> None:
         if not self._closed:
             self._closed = True
-            self._lib.fp_shutdown(self._e)
+            self._fn_shutdown(self._e)
+
+
+class H2FastPathEngine(FastPathEngine):
+    """Handle on the native h2/gRPC proxy data plane
+    (native/h2_fastpath.cpp).
+
+    Same control surface as FastPathEngine — FastPathController drives
+    either interchangeably — but the engine speaks HTTP/2 (h2c prior
+    knowledge) on both sides and routes by ``:authority``."""
+
+    _PREFIX = "fph2"
 
 
 MAX_HEADERS = 1024

@@ -1,0 +1,476 @@
+// Shared HTTP/2 primitives for the native data plane: frame helpers and a
+// full HPACK codec (RFC 7541: static + dynamic tables, integer/string
+// primitives, Huffman decode from the generated Appendix-B table).
+//
+// Used by h2_fastpath.cpp (the h2/gRPC proxy engine) and h2bench.cpp (the
+// out-of-process echo server / load generator). The reference's analogue
+// is Netty's HPACK codec consumed by its patched frame codec
+// (finagle/h2/src/main/scala/.../netty4/H2FrameCodec.scala); this is an
+// independent implementation of the same RFCs, kept deliberately small:
+// the proxy re-encodes header lists with incremental indexing (dynamic
+// table) and no Huffman on output — legal per RFC 7541 and cheap, while
+// decode accepts everything a conforming peer may send.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <utility>
+#include <vector>
+
+#include "huffman_table.h"
+
+namespace h2 {
+
+// ---- frame constants (RFC 7540 §6) ----
+enum FrameType : uint8_t {
+    DATA = 0x0, HEADERS = 0x1, PRIORITY = 0x2, RST_STREAM = 0x3,
+    SETTINGS = 0x4, PUSH_PROMISE = 0x5, PING = 0x6, GOAWAY = 0x7,
+    WINDOW_UPDATE = 0x8, CONTINUATION = 0x9,
+};
+
+constexpr uint8_t FLAG_END_STREAM = 0x1;
+constexpr uint8_t FLAG_ACK = 0x1;
+constexpr uint8_t FLAG_END_HEADERS = 0x4;
+constexpr uint8_t FLAG_PADDED = 0x8;
+constexpr uint8_t FLAG_PRIORITY = 0x20;
+
+enum SettingsId : uint16_t {
+    S_HEADER_TABLE_SIZE = 0x1, S_ENABLE_PUSH = 0x2,
+    S_MAX_CONCURRENT_STREAMS = 0x3, S_INITIAL_WINDOW_SIZE = 0x4,
+    S_MAX_FRAME_SIZE = 0x5, S_MAX_HEADER_LIST_SIZE = 0x6,
+};
+
+enum ErrCode : uint32_t {
+    NO_ERROR = 0x0, PROTOCOL_ERROR = 0x1, INTERNAL_ERROR = 0x2,
+    FLOW_CONTROL_ERROR = 0x3, SETTINGS_TIMEOUT = 0x4, STREAM_CLOSED = 0x5,
+    FRAME_SIZE_ERROR = 0x6, REFUSED_STREAM = 0x7, CANCEL = 0x8,
+    COMPRESSION_ERROR = 0x9, CONNECT_ERROR = 0xA, ENHANCE_YOUR_CALM = 0xB,
+};
+
+constexpr uint32_t DEFAULT_MAX_FRAME = 16384;
+constexpr int64_t DEFAULT_WINDOW = 65535;
+constexpr const char* PREFACE = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+constexpr size_t PREFACE_LEN = 24;
+
+inline void put_u32(std::string* out, uint32_t v) {
+    char b[4] = {(char)(v >> 24), (char)(v >> 16), (char)(v >> 8), (char)v};
+    out->append(b, 4);
+}
+
+inline uint32_t get_u32(const uint8_t* p) {
+    return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+           ((uint32_t)p[2] << 8) | p[3];
+}
+
+// Append a 9-byte frame header (RFC 7540 §4.1).
+inline void frame_head(std::string* out, size_t len, uint8_t type,
+                       uint8_t flags, uint32_t stream_id) {
+    char b[9] = {(char)(len >> 16), (char)(len >> 8), (char)len,
+                 (char)type, (char)flags,
+                 (char)(stream_id >> 24), (char)(stream_id >> 16),
+                 (char)(stream_id >> 8), (char)stream_id};
+    out->append(b, 9);
+}
+
+inline void write_frame(std::string* out, uint8_t type, uint8_t flags,
+                        uint32_t stream_id, const char* payload,
+                        size_t len) {
+    frame_head(out, len, type, flags, stream_id);
+    if (len) out->append(payload, len);
+}
+
+inline void write_settings(std::string* out,
+                           const std::vector<std::pair<uint16_t, uint32_t>>&
+                               kv,
+                           bool ack) {
+    std::string payload;
+    for (auto& s : kv) {
+        char b[6] = {(char)(s.first >> 8), (char)s.first,
+                     (char)(s.second >> 24), (char)(s.second >> 16),
+                     (char)(s.second >> 8), (char)s.second};
+        payload.append(b, 6);
+    }
+    write_frame(out, SETTINGS, ack ? FLAG_ACK : 0, 0, payload.data(),
+                payload.size());
+}
+
+inline void write_window_update(std::string* out, uint32_t stream_id,
+                                uint32_t inc) {
+    frame_head(out, 4, WINDOW_UPDATE, 0, stream_id);
+    put_u32(out, inc);
+}
+
+inline void write_rst(std::string* out, uint32_t stream_id, uint32_t code) {
+    frame_head(out, 4, RST_STREAM, 0, stream_id);
+    put_u32(out, code);
+}
+
+inline void write_goaway(std::string* out, uint32_t last_stream,
+                         uint32_t code) {
+    frame_head(out, 8, GOAWAY, 0, 0);
+    put_u32(out, last_stream);
+    put_u32(out, code);
+}
+
+// ---- Huffman decode (RFC 7541 §5.2 + Appendix B) ----
+// Bit-trie over the canonical code; built once from the generated table
+// (native/build.py emits huffman_table.h from hpack.py, the single source
+// of truth).
+struct HuffTrie {
+    struct Node { int32_t child[2] = {-1, -1}; int16_t sym = -1; };
+    std::vector<Node> nodes;
+    HuffTrie() {
+        nodes.emplace_back();
+        for (int sym = 0; sym < 257; sym++) {
+            uint32_t code = HUFF_CODES[sym];
+            int bits = HUFF_BITS[sym];
+            int32_t n = 0;
+            for (int i = bits - 1; i >= 0; i--) {
+                int b = (code >> i) & 1;
+                if (i == 0) {
+                    // leaf
+                    if (nodes[(size_t)n].child[b] < 0) {
+                        nodes[(size_t)n].child[b] = (int32_t)nodes.size();
+                        nodes.emplace_back();
+                    }
+                    nodes[(size_t)nodes[(size_t)n].child[b]].sym =
+                        (int16_t)sym;
+                } else {
+                    if (nodes[(size_t)n].child[b] < 0) {
+                        nodes[(size_t)n].child[b] = (int32_t)nodes.size();
+                        nodes.emplace_back();
+                    }
+                    n = nodes[(size_t)n].child[b];
+                }
+            }
+        }
+    }
+};
+
+inline const HuffTrie& huff_trie() {
+    static HuffTrie t;
+    return t;
+}
+
+// false => malformed (COMPRESSION_ERROR).
+inline bool huff_decode(const uint8_t* p, size_t n, std::string* out) {
+    const HuffTrie& t = huff_trie();
+    int32_t node = 0;
+    int pad_bits = 0;
+    bool pad_ones = true;
+    for (size_t i = 0; i < n; i++) {
+        uint8_t byte = p[i];
+        for (int k = 7; k >= 0; k--) {
+            int b = (byte >> k) & 1;
+            pad_bits++;
+            pad_ones = pad_ones && b == 1;
+            node = t.nodes[(size_t)node].child[b];
+            if (node < 0) return false;
+            int16_t sym = t.nodes[(size_t)node].sym;
+            if (sym >= 0) {
+                if (sym == 256) return false;  // EOS in data
+                out->push_back((char)sym);
+                node = 0;
+                pad_bits = 0;
+                pad_ones = true;
+            }
+        }
+    }
+    return pad_bits < 8 && pad_ones;
+}
+
+// ---- HPACK (RFC 7541) ----
+using Hdr = std::pair<std::string, std::string>;
+
+// RFC 7541 Appendix A: 61-entry static table.
+inline const std::vector<Hdr>& hpack_static() {
+    static const std::vector<Hdr> t = {
+        {":authority", ""}, {":method", "GET"}, {":method", "POST"},
+        {":path", "/"}, {":path", "/index.html"}, {":scheme", "http"},
+        {":scheme", "https"}, {":status", "200"}, {":status", "204"},
+        {":status", "206"}, {":status", "304"}, {":status", "400"},
+        {":status", "404"}, {":status", "500"}, {"accept-charset", ""},
+        {"accept-encoding", "gzip, deflate"}, {"accept-language", ""},
+        {"accept-ranges", ""}, {"accept", ""},
+        {"access-control-allow-origin", ""}, {"age", ""}, {"allow", ""},
+        {"authorization", ""}, {"cache-control", ""},
+        {"content-disposition", ""}, {"content-encoding", ""},
+        {"content-language", ""}, {"content-length", ""},
+        {"content-location", ""}, {"content-range", ""},
+        {"content-type", ""}, {"cookie", ""}, {"date", ""}, {"etag", ""},
+        {"expect", ""}, {"expires", ""}, {"from", ""}, {"host", ""},
+        {"if-match", ""}, {"if-modified-since", ""}, {"if-none-match", ""},
+        {"if-range", ""}, {"if-unmodified-since", ""},
+        {"last-modified", ""}, {"link", ""}, {"location", ""},
+        {"max-forwards", ""}, {"proxy-authenticate", ""},
+        {"proxy-authorization", ""}, {"range", ""}, {"referer", ""},
+        {"refresh", ""}, {"retry-after", ""}, {"server", ""},
+        {"set-cookie", ""}, {"strict-transport-security", ""},
+        {"transfer-encoding", ""}, {"user-agent", ""}, {"vary", ""},
+        {"via", ""}, {"www-authenticate", ""},
+    };
+    return t;
+}
+
+inline size_t hpack_entry_size(const Hdr& h) {
+    return h.first.size() + h.second.size() + 32;
+}
+
+struct HpackTable {
+    // newest at front (index 62 in the combined address space)
+    std::vector<Hdr> entries;
+    size_t size = 0;
+    size_t max_size = 4096;
+
+    void add(Hdr h) {
+        size_t need = hpack_entry_size(h);
+        entries.insert(entries.begin(), std::move(h));
+        size += need;
+        evict();
+        if (need > max_size) {
+            entries.clear();
+            size = 0;
+        }
+    }
+    void resize(size_t m) {
+        max_size = m;
+        evict();
+    }
+    void evict() {
+        while (size > max_size && !entries.empty()) {
+            size -= hpack_entry_size(entries.back());
+            entries.pop_back();
+        }
+    }
+    // 1-based combined index; false => out of range
+    bool get(uint64_t idx, Hdr* out) const {
+        const auto& st = hpack_static();
+        if (idx >= 1 && idx <= st.size()) {
+            *out = st[idx - 1];
+            return true;
+        }
+        uint64_t d = idx - st.size() - 1;
+        if (d < entries.size()) {
+            *out = entries[(size_t)d];
+            return true;
+        }
+        return false;
+    }
+};
+
+struct HpackDecoder {
+    HpackTable table;
+    size_t settings_max = 4096;  // our advertised SETTINGS_HEADER_TABLE_SIZE
+
+    // false => COMPRESSION_ERROR
+    bool decode(const uint8_t* p, size_t n, std::vector<Hdr>* out) {
+        size_t pos = 0;
+        while (pos < n) {
+            uint8_t b = p[pos];
+            if (b & 0x80) {  // indexed
+                uint64_t idx;
+                if (!dec_int(p, n, &pos, 7, &idx) || idx == 0) return false;
+                Hdr h;
+                if (!table.get(idx, &h)) return false;
+                out->push_back(std::move(h));
+            } else if (b & 0x40) {  // literal w/ incremental indexing
+                uint64_t idx;
+                if (!dec_int(p, n, &pos, 6, &idx)) return false;
+                Hdr h;
+                if (!read_literal(p, n, &pos, idx, &h)) return false;
+                table.add(h);
+                out->push_back(std::move(h));
+            } else if (b & 0x20) {  // dynamic table size update
+                uint64_t sz;
+                if (!dec_int(p, n, &pos, 5, &sz)) return false;
+                if (sz > settings_max) return false;
+                table.resize((size_t)sz);
+            } else {  // literal w/o indexing (0x00) / never indexed (0x10)
+                uint64_t idx;
+                if (!dec_int(p, n, &pos, 4, &idx)) return false;
+                Hdr h;
+                if (!read_literal(p, n, &pos, idx, &h)) return false;
+                out->push_back(std::move(h));
+            }
+        }
+        return true;
+    }
+
+ private:
+    static bool dec_int(const uint8_t* p, size_t n, size_t* pos,
+                        int prefix, uint64_t* out) {
+        if (*pos >= n) return false;
+        uint64_t limit = (1u << prefix) - 1;
+        uint64_t v = p[(*pos)++] & limit;
+        if (v < limit) {
+            *out = v;
+            return true;
+        }
+        int shift = 0;
+        for (;;) {
+            if (*pos >= n || shift > 35) return false;
+            uint8_t b = p[(*pos)++];
+            v += (uint64_t)(b & 0x7F) << shift;
+            shift += 7;
+            if (!(b & 0x80)) {
+                *out = v;
+                return true;
+            }
+        }
+    }
+    bool read_str(const uint8_t* p, size_t n, size_t* pos,
+                  std::string* out) {
+        if (*pos >= n) return false;
+        bool huff = p[*pos] & 0x80;
+        uint64_t len;
+        if (!dec_int(p, n, pos, 7, &len)) return false;
+        if (*pos + len > n) return false;
+        if (huff) {
+            if (!huff_decode(p + *pos, (size_t)len, out)) return false;
+        } else {
+            out->append((const char*)(p + *pos), (size_t)len);
+        }
+        *pos += (size_t)len;
+        return true;
+    }
+    bool read_literal(const uint8_t* p, size_t n, size_t* pos,
+                      uint64_t name_idx, Hdr* out) {
+        if (name_idx) {
+            Hdr h;
+            if (!table.get(name_idx, &h)) return false;
+            out->first = std::move(h.first);
+        } else {
+            if (!read_str(p, n, pos, &out->first)) return false;
+        }
+        return read_str(p, n, pos, &out->second);
+    }
+};
+
+struct HpackEncoder {
+    HpackTable table;
+    int64_t pending_resize = -1;
+
+    // full static-table lookup maps, shared & immutable
+    static const std::unordered_map<std::string, int>& static_full() {
+        static const std::unordered_map<std::string, int> m = [] {
+            std::unordered_map<std::string, int> r;
+            const auto& st = hpack_static();
+            for (size_t i = 0; i < st.size(); i++) {
+                std::string k = st[i].first;
+                k.push_back('\0');
+                k += st[i].second;
+                r.emplace(std::move(k), (int)i + 1);
+            }
+            return r;
+        }();
+        return m;
+    }
+    static const std::unordered_map<std::string, int>& static_name() {
+        static const std::unordered_map<std::string, int> m = [] {
+            std::unordered_map<std::string, int> r;
+            const auto& st = hpack_static();
+            for (size_t i = 0; i < st.size(); i++)
+                r.emplace(st[i].first, (int)i + 1);
+            return r;
+        }();
+        return m;
+    }
+
+    // Honor peer SETTINGS_HEADER_TABLE_SIZE (emit a size update next block)
+    void set_max_table_size(size_t sz) {
+        if (sz > 4096) sz = 4096;
+        pending_resize = (int64_t)sz;
+        table.resize(sz);
+    }
+
+    void encode(const std::vector<Hdr>& headers, std::string* out) {
+        if (pending_resize >= 0) {
+            enc_int((uint64_t)pending_resize, 5, 0x20, out);
+            pending_resize = -1;
+        }
+        for (const auto& h : headers) {
+            int full = 0, name = 0;
+            {
+                std::string k = h.first;
+                k.push_back('\0');
+                k += h.second;
+                auto it = static_full().find(k);
+                if (it != static_full().end()) full = it->second;
+            }
+            if (!full) {
+                auto it = static_name().find(h.first);
+                if (it != static_name().end()) name = it->second;
+                const auto& st = hpack_static();
+                for (size_t i = 0; i < table.entries.size(); i++) {
+                    const Hdr& e = table.entries[i];
+                    if (e.first == h.first) {
+                        int idx = (int)(st.size() + i + 1);
+                        if (e.second == h.second) {
+                            full = idx;
+                            break;
+                        }
+                        if (!name) name = idx;
+                    }
+                }
+            }
+            if (full) {
+                enc_int((uint64_t)full, 7, 0x80, out);
+                continue;
+            }
+            // literal with incremental indexing, no Huffman
+            if (name) {
+                enc_int((uint64_t)name, 6, 0x40, out);
+            } else {
+                out->push_back(0x40);
+                enc_str(h.first, out);
+            }
+            enc_str(h.second, out);
+            table.add(h);  // oversized entries clear the table (RFC §4.4)
+        }
+    }
+
+ private:
+    static void enc_int(uint64_t v, int prefix, uint8_t flags,
+                        std::string* out) {
+        uint64_t limit = (1u << prefix) - 1;
+        if (v < limit) {
+            out->push_back((char)(flags | v));
+            return;
+        }
+        out->push_back((char)(flags | limit));
+        v -= limit;
+        while (v >= 128) {
+            out->push_back((char)((v & 0x7F) | 0x80));
+            v >>= 7;
+        }
+        out->push_back((char)v);
+    }
+    static void enc_str(const std::string& s, std::string* out) {
+        enc_int(s.size(), 7, 0x00, out);
+        out->append(s);
+    }
+};
+
+// ---- per-connection protocol state shared by proxy & bench ----
+struct Session {
+    HpackDecoder dec;
+    HpackEncoder enc;
+    // peer's advertised settings (apply to our sends)
+    uint32_t peer_max_frame = DEFAULT_MAX_FRAME;
+    int64_t peer_init_win = DEFAULT_WINDOW;
+    uint32_t peer_max_streams = 0x7FFFFFFF;
+    // connection-level flow control
+    int64_t send_win = DEFAULT_WINDOW;  // how much we may send
+    uint64_t recv_unacked = 0;          // received but not yet WINDOW_UPDATEd
+    bool preface_seen = false;          // server side: peer preface consumed
+    bool settings_acked = false;
+    // header-block accumulation (HEADERS..CONTINUATION)
+    bool in_headers = false;
+    uint32_t hb_stream = 0;
+    uint8_t hb_flags = 0;
+    std::string hb_buf;
+};
+
+}  // namespace h2

@@ -1,0 +1,1645 @@
+// h2 fastpath: native HTTP/2 (h2c prior-knowledge) proxy data-plane
+// engine for gRPC and generic h2 traffic.
+//
+// Same control/data split as the HTTP/1.1 engine (fastpath.cpp): the
+// per-frame hot loop (preface -> SETTINGS -> HPACK-decode HEADERS ->
+// route by :authority -> re-encode + forward frames with flow control)
+// runs on one C++ epoll thread; Python stays the control plane and
+// installs concrete routes via fph2_set_route, drains misses, stats and
+// per-request feature rows. Parity anchors: the reference's h2 data
+// plane (finagle/h2/.../netty4/Netty4StreamTransport.scala:1-690 stream
+// state machine, Netty4ClientDispatcher/Netty4ServerDispatcher stream-id
+// demux, H2.scala:29 SingletonPool — one multiplexed upstream connection
+// per endpoint), RoutingFactory.scala:154-187 (identify->bind->dispatch).
+//
+// Scope: h2c prior-knowledge both sides, full HPACK (h2_core.h), both
+// flow-control levels with bounded buffering, CONTINUATION, trailers,
+// PING, RST propagation, GOAWAY-reconnect (refused streams replay when
+// the request is still retained, mirroring BufferedStream.scala:29's
+// retry-buffer idea), MAX_CONCURRENT_STREAMS queueing toward upstreams.
+// TLS/ALPN and h1->h2c upgrade stay on the Python path.
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "h2_core.h"
+
+namespace {
+
+using h2::Hdr;
+
+constexpr int MAX_EVENTS = 256;
+constexpr int LAT_BUCKETS = 28;
+constexpr uint64_t ROUTE_WAIT_TIMEOUT_US = 2'000'000;
+// our advertised windows (we are a proxy: accept generously, gate grants
+// on how much we have buffered for the slower side)
+constexpr int64_t OUR_STREAM_WIN = 4 << 20;
+constexpr int64_t OUR_CONN_WIN = 16 << 20;
+constexpr uint64_t STREAM_GRANT = 256 * 1024;
+constexpr uint64_t CONN_GRANT = 1 << 20;
+constexpr size_t PEND_HIGH = 2 << 20;      // per-stream buffered cap
+constexpr size_t CONN_BUF_HIGH = 8 << 20;  // per-source-conn buffered cap
+constexpr size_t OUT_HIGH = 1 << 20;       // stop pumping into a fat out-buf
+constexpr size_t RETAIN_CAP = 64 * 1024;   // GOAWAY-replay request buffer
+constexpr size_t PARKED_PEND_CAP = 1 << 20;
+constexpr uint32_t MAX_FRAME_OK = 17000;   // tolerated frame size
+
+uint64_t now_us() {
+    timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (uint64_t)ts.tv_sec * 1'000'000 + ts.tv_nsec / 1000;
+}
+
+void set_nodelay(int fd) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+void lower(std::string& s) {
+    for (auto& c : s) if (c >= 'A' && c <= 'Z') c += 32;
+}
+
+struct RouteStats {
+    uint64_t requests = 0, success = 0, f4xx = 0, f5xx = 0, conn_fail = 0;
+    uint64_t lat_hist[LAT_BUCKETS] = {0};
+    void record(int status, uint64_t lat_us) {
+        requests++;
+        if (status >= 500) f5xx++;
+        else if (status >= 400) f4xx++;
+        else success++;
+        int b = 0;
+        uint64_t v = lat_us;
+        while (v > 1 && b < LAT_BUCKETS - 1) { v >>= 1; b++; }
+        lat_hist[b]++;
+    }
+};
+
+struct H2Conn;
+
+struct Endpoint {
+    uint32_t ip_be = 0;
+    uint16_t port = 0;
+    int inflight = 0;
+    H2Conn* conn = nullptr;  // one multiplexed conn (SingletonPool parity)
+};
+
+struct Route {
+    uint64_t id = 0;
+    std::vector<Endpoint> eps;
+    uint32_t next = 0;
+    RouteStats stats;
+};
+
+struct FeatureRow {
+    float route_id, latency_ms, status, req_bytes, rsp_bytes, ts_s;
+};
+
+struct PStream;
+
+struct Engine {
+    int epfd = -1;
+    int wakefd = -1;
+    std::atomic<bool> running{true};
+    pthread_t thread;
+    bool thread_started = false;
+
+    std::mutex mu;  // guards routes, misses, features
+    std::unordered_map<std::string, Route> routes;
+    uint64_t next_route_id = 1;
+    std::deque<std::string> misses;
+    std::vector<FeatureRow> features;
+    size_t features_cap = 65536;
+    uint64_t features_dropped = 0;
+
+    // loop-thread-only
+    std::unordered_map<int, H2Conn*> conns;
+    std::vector<int> listeners;
+    std::unordered_map<std::string, std::vector<PStream*>> parked;
+    // conns/streams closed mid-handler; freed at a safe point in the
+    // loop so pointers held across a frame-handler call stay valid
+    std::vector<H2Conn*> graveyard;
+    std::vector<PStream*> stream_graveyard;
+    std::atomic<uint64_t> accepted{0};
+    uint64_t last_sweep_us = 0;
+};
+
+struct H2Conn {
+    enum class Kind { CLIENT, UPSTREAM };
+    Kind kind = Kind::CLIENT;
+    int fd = -1;
+    std::string in;
+    std::string out;
+    bool want_write = false;
+    bool paused = false;
+    bool connecting = false;
+    bool closing = false;
+    bool dead = false;
+    h2::Session s;
+    std::unordered_map<uint32_t, PStream*> streams;  // by this side's id
+    uint64_t buffered = 0;   // bytes read from this conn, pending forward
+    uint32_t max_seen_id = 0;  // client conns: highest peer stream id
+
+    // upstream-only
+    std::string route_key;
+    uint64_t route_id = 0;
+    uint32_t ep_ip_be = 0;
+    uint16_t ep_port = 0;
+    uint32_t next_stream_id = 1;
+    uint32_t active_streams = 0;
+    bool draining = false;  // GOAWAY received: no new streams
+    std::deque<PStream*> pend_dispatch;
+};
+
+struct PStream {
+    H2Conn* cc = nullptr;
+    uint32_t cid = 0;
+    H2Conn* uc = nullptr;
+    uint32_t uid = 0;
+    std::string route_key;
+    uint64_t route_id = 0;
+    uint32_t ep_ip = 0;   // endpoint this stream's inflight count is on
+    uint16_t ep_pt = 0;
+    uint64_t t_start_us = 0;
+    uint64_t req_b = 0, rsp_b = 0;
+    int status = 0;
+
+    // request retention for GOAWAY replay (BufferedStream parity)
+    std::vector<Hdr> req_hdrs;
+    std::string req_retain;
+    bool retain_valid = true;
+    bool replayed = false;  // one replay attempt only
+
+    bool req_end_seen = false;   // END_STREAM from client observed
+    bool req_hdrs_sent = false;  // HEADERS written upstream
+    bool req_end_sent = false;   // END_STREAM written upstream
+    bool rsp_started = false;    // final response HEADERS forwarded
+    bool rsp_end_sent = false;   // END_STREAM written to client
+
+    // request direction pending (client -> upstream)
+    std::string u_pend;
+    bool u_pend_end = false;
+    std::vector<Hdr> u_trailers;
+    bool u_has_trailers = false;
+    int64_t u_swin = 0;
+    // response direction pending (upstream -> client)
+    std::string c_pend;
+    bool c_pend_end = false;
+    std::vector<Hdr> c_trailers;
+    bool c_has_trailers = false;
+    int64_t c_swin = 0;
+
+    uint64_t c_runacked = 0, u_runacked = 0;  // recv not yet granted back
+    bool parked = false;
+    uint64_t park_deadline_us = 0;
+    // finished: unlinked from both conns, awaiting graveyard free. Every
+    // code path that holds a PStream* across a call that can finish
+    // streams (flush_out -> conn_close chains) re-checks this flag; the
+    // memory stays valid until the loop's safe point.
+    bool closed = false;
+};
+
+void ep_mod(Engine* e, H2Conn* c) {
+    epoll_event ev{};
+    ev.events = (c->paused ? 0 : EPOLLIN)
+        | (c->want_write ? EPOLLOUT : 0) | EPOLLRDHUP;
+    ev.data.fd = c->fd;
+    epoll_ctl(e->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+void ep_add(Engine* e, H2Conn* c) {
+    epoll_event ev{};
+    ev.events = (c->paused ? 0 : EPOLLIN)
+        | (c->want_write ? EPOLLOUT : 0) | EPOLLRDHUP;
+    ev.data.fd = c->fd;
+    epoll_ctl(e->epfd, EPOLL_CTL_ADD, c->fd, &ev);
+    e->conns[c->fd] = c;
+}
+
+void conn_close(Engine* e, H2Conn* c);
+
+bool flush_out(Engine* e, H2Conn* c) {
+    if (c->dead) return false;
+    while (!c->out.empty()) {
+        ssize_t n = ::send(c->fd, c->out.data(), c->out.size(),
+                           MSG_NOSIGNAL);
+        if (n > 0) {
+            c->out.erase(0, (size_t)n);
+        } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+            break;
+        } else {
+            conn_close(e, c);
+            return false;
+        }
+    }
+    if (c->out.empty() && c->closing) {
+        conn_close(e, c);
+        return false;
+    }
+    bool ww = !c->out.empty();
+    if (ww != c->want_write) {
+        c->want_write = ww;
+        ep_mod(e, c);
+    }
+    return true;
+}
+
+void push_feature(Engine* e, uint64_t route_id, uint64_t lat_us, int status,
+                  uint64_t req_b, uint64_t rsp_b) {
+    std::lock_guard<std::mutex> g(e->mu);
+    if (e->features.size() >= e->features_cap) {
+        e->features_dropped++;
+        return;
+    }
+    FeatureRow r;
+    r.route_id = (float)route_id;
+    r.latency_ms = (float)lat_us / 1000.0f;
+    r.status = (float)status;
+    r.req_bytes = (float)req_b;
+    r.rsp_bytes = (float)rsp_b;
+    r.ts_s = (float)((double)now_us() / 1e6);
+    e->features.push_back(r);
+}
+
+// Encode + write a header block, splitting into HEADERS/CONTINUATION at
+// the peer's max frame size.
+void write_headers(H2Conn* c, uint32_t stream_id,
+                   const std::vector<Hdr>& headers, bool end_stream) {
+    std::string block;
+    c->s.enc.encode(headers, &block);
+    size_t maxf = c->s.peer_max_frame;
+    size_t off = 0;
+    bool first = true;
+    do {
+        size_t n = block.size() - off;
+        if (n > maxf) n = maxf;
+        bool last = off + n == block.size();
+        uint8_t type = first ? h2::HEADERS : h2::CONTINUATION;
+        uint8_t flags = 0;
+        if (first && end_stream) flags |= h2::FLAG_END_STREAM;
+        if (last) flags |= h2::FLAG_END_HEADERS;
+        h2::write_frame(&c->out, type, flags, stream_id, block.data() + off,
+                        n);
+        off += n;
+        first = false;
+    } while (off < block.size());
+}
+
+// Synthesized response to the client (no upstream involved).
+void synth_response(Engine* e, H2Conn* cc, uint32_t cid, int status,
+                    const char* errmsg) {
+    char st[8];
+    snprintf(st, sizeof(st), "%d", status);
+    std::vector<Hdr> hs = {{":status", st}};
+    if (errmsg) hs.push_back({"l5d-err", errmsg});
+    hs.push_back({"content-length", "0"});
+    write_headers(cc, cid, hs, true);
+    flush_out(e, cc);
+}
+
+void unregister_parked(Engine* e, PStream* st) {
+    auto it = e->parked.find(st->route_key);
+    if (it == e->parked.end()) return;
+    auto& v = it->second;
+    for (size_t i = 0; i < v.size(); i++)
+        if (v[i] == st) { v.erase(v.begin() + i); break; }
+    if (v.empty()) e->parked.erase(it);
+}
+
+void dispatch_from_queue(Engine* e, H2Conn* uc);
+
+// Unlink + retire a stream. record=true adds route stats + a feature
+// row. Idempotent; the PStream is freed later at the loop's safe point.
+void finish_stream(Engine* e, PStream* st, bool record) {
+    if (st->closed) return;
+    st->closed = true;
+    e->stream_graveyard.push_back(st);
+    if (st->parked) {
+        unregister_parked(e, st);
+        st->parked = false;
+    }
+    if (st->cc != nullptr) {
+        st->cc->buffered -= st->u_pend.size();
+        st->cc->streams.erase(st->cid);
+    }
+    H2Conn* uc = st->uc;
+    if (uc != nullptr) {
+        uc->buffered -= st->c_pend.size();
+        if (st->uid) {
+            uc->streams.erase(st->uid);
+            if (uc->active_streams > 0) uc->active_streams--;
+        } else {
+            // still queued for dispatch on this conn
+            for (size_t i = 0; i < uc->pend_dispatch.size(); i++)
+                if (uc->pend_dispatch[i] == st) {
+                    uc->pend_dispatch.erase(uc->pend_dispatch.begin()
+                                            + (long)i);
+                    break;
+                }
+        }
+    }
+    uint64_t lat = now_us() - st->t_start_us;
+    {
+        std::lock_guard<std::mutex> g(e->mu);
+        auto it = e->routes.find(st->route_key);
+        if (it != e->routes.end() && it->second.id == st->route_id) {
+            if (record) it->second.stats.record(st->status, lat);
+            if (st->ep_ip)
+                for (auto& ep : it->second.eps)
+                    if (ep.ip_be == st->ep_ip && ep.port == st->ep_pt &&
+                        ep.inflight > 0) {
+                        ep.inflight--;
+                        break;
+                    }
+        }
+    }
+    if (record)
+        push_feature(e, st->route_id, lat, st->status, st->req_b,
+                     st->rsp_b);
+    if (uc != nullptr && !uc->dead) dispatch_from_queue(e, uc);
+}
+
+// ---- flow-control grants (we only re-open our receive windows when the
+// slower side has drained what we buffered: bounded memory) ----
+
+void conn_grant(Engine* e, H2Conn* c) {
+    if (c->s.recv_unacked >= CONN_GRANT && c->buffered < CONN_BUF_HIGH) {
+        h2::write_window_update(&c->out, 0, (uint32_t)c->s.recv_unacked);
+        c->s.recv_unacked = 0;
+        flush_out(e, c);
+    }
+}
+
+// Grant stream-level window back to the producer conn for stream st.
+// from_client: data arrived on cc (buffered in u_pend), else on uc.
+void stream_grant(Engine* e, PStream* st, bool from_client) {
+    if (st->closed) return;
+    if (from_client) {
+        if (st->cc != nullptr && st->c_runacked >= STREAM_GRANT &&
+            st->u_pend.size() < PEND_HIGH && !st->req_end_seen) {
+            h2::write_window_update(&st->cc->out, st->cid,
+                                    (uint32_t)st->c_runacked);
+            st->c_runacked = 0;
+            flush_out(e, st->cc);
+        }
+    } else {
+        if (st->uc != nullptr && st->uid && st->u_runacked >= STREAM_GRANT
+            && st->c_pend.size() < PEND_HIGH) {
+            h2::write_window_update(&st->uc->out, st->uid,
+                                    (uint32_t)st->u_runacked);
+            st->u_runacked = 0;
+            flush_out(e, st->uc);
+        }
+    }
+}
+
+// ---- forwarding pumps ----
+
+// Send buffered request bytes upstream as windows allow.
+void pump_upstream(Engine* e, PStream* st) {
+    if (st->closed) return;
+    H2Conn* uc = st->uc;
+    if (uc == nullptr || !st->req_hdrs_sent || st->req_end_sent) return;
+    if (uc->out.size() > OUT_HIGH) return;  // re-pumped on flush drain
+    while (!st->u_pend.empty() && st->u_swin > 0 && uc->s.send_win > 0) {
+        size_t n = st->u_pend.size();
+        if ((int64_t)n > st->u_swin) n = (size_t)st->u_swin;
+        if ((int64_t)n > uc->s.send_win) n = (size_t)uc->s.send_win;
+        if (n > uc->s.peer_max_frame) n = uc->s.peer_max_frame;
+        bool end = st->u_pend_end && !st->u_has_trailers &&
+                   n == st->u_pend.size();
+        h2::write_frame(&uc->out, h2::DATA,
+                        end ? h2::FLAG_END_STREAM : 0, st->uid,
+                        st->u_pend.data(), n);
+        st->u_pend.erase(0, n);
+        st->u_swin -= (int64_t)n;
+        uc->s.send_win -= (int64_t)n;
+        if (st->cc != nullptr) st->cc->buffered -= n;
+        if (end) st->req_end_sent = true;
+        if (uc->out.size() > OUT_HIGH) break;
+    }
+    if (st->u_pend.empty() && !st->req_end_sent) {
+        if (st->u_has_trailers) {
+            write_headers(uc, st->uid, st->u_trailers, true);
+            st->req_end_sent = true;
+        } else if (st->u_pend_end) {
+            h2::write_frame(&uc->out, h2::DATA, h2::FLAG_END_STREAM,
+                            st->uid, nullptr, 0);
+            st->req_end_sent = true;
+        }
+    }
+    flush_out(e, uc);
+    // flush_out failure can conn_close(uc), which finishes/replays st
+    if (st->closed) return;
+    if (st->cc != nullptr) {
+        stream_grant(e, st, true);
+        conn_grant(e, st->cc);
+    }
+}
+
+// Send buffered response bytes to the client; finishes the stream when
+// END_STREAM has been forwarded.
+void pump_client(Engine* e, PStream* st) {
+    if (st->closed) return;
+    H2Conn* cc = st->cc;
+    if (cc == nullptr || st->rsp_end_sent) return;
+    if (cc->out.size() > OUT_HIGH) return;
+    while (!st->c_pend.empty() && st->c_swin > 0 && cc->s.send_win > 0) {
+        size_t n = st->c_pend.size();
+        if ((int64_t)n > st->c_swin) n = (size_t)st->c_swin;
+        if ((int64_t)n > cc->s.send_win) n = (size_t)cc->s.send_win;
+        if (n > cc->s.peer_max_frame) n = cc->s.peer_max_frame;
+        bool end = st->c_pend_end && !st->c_has_trailers &&
+                   n == st->c_pend.size();
+        h2::write_frame(&cc->out, h2::DATA,
+                        end ? h2::FLAG_END_STREAM : 0, st->cid,
+                        st->c_pend.data(), n);
+        st->c_pend.erase(0, n);
+        st->c_swin -= (int64_t)n;
+        cc->s.send_win -= (int64_t)n;
+        if (st->uc != nullptr) st->uc->buffered -= n;
+        if (end) st->rsp_end_sent = true;
+        if (cc->out.size() > OUT_HIGH) break;
+    }
+    if (st->c_pend.empty() && !st->rsp_end_sent) {
+        if (st->c_has_trailers) {
+            write_headers(cc, st->cid, st->c_trailers, true);
+            st->rsp_end_sent = true;
+        } else if (st->c_pend_end) {
+            h2::write_frame(&cc->out, h2::DATA, h2::FLAG_END_STREAM,
+                            st->cid, nullptr, 0);
+            st->rsp_end_sent = true;
+        }
+    }
+    flush_out(e, cc);
+    // flush_out failure can conn_close(cc), which finishes st
+    if (st->closed) return;
+    if (st->uc != nullptr) {
+        stream_grant(e, st, false);
+        conn_grant(e, st->uc);
+    }
+    if (st->rsp_end_sent) finish_stream(e, st, true);
+}
+
+// ---- upstream dispatch ----
+
+H2Conn* mk_upstream(Engine* e, const std::string& route_key,
+                    uint64_t route_id, uint32_t ip_be, uint16_t port) {
+    int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (fd < 0) return nullptr;
+    set_nodelay(fd);
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_addr.s_addr = ip_be;
+    sa.sin_port = htons(port);
+    int rc = ::connect(fd, (sockaddr*)&sa, sizeof(sa));
+    if (rc < 0 && errno != EINPROGRESS) {
+        ::close(fd);
+        return nullptr;
+    }
+    H2Conn* c = new H2Conn();
+    c->kind = H2Conn::Kind::UPSTREAM;
+    c->fd = fd;
+    c->connecting = (rc < 0);
+    c->want_write = c->connecting;
+    c->route_key = route_key;
+    c->route_id = route_id;
+    c->ep_ip_be = ip_be;
+    c->ep_port = port;
+    // client preface + our SETTINGS + a big connection window
+    c->out.append(h2::PREFACE, h2::PREFACE_LEN);
+    h2::write_settings(&c->out,
+                       {{h2::S_HEADER_TABLE_SIZE, 4096},
+                        {h2::S_INITIAL_WINDOW_SIZE,
+                         (uint32_t)OUR_STREAM_WIN},
+                        {h2::S_MAX_FRAME_SIZE, h2::DEFAULT_MAX_FRAME}},
+                       false);
+    h2::write_window_update(&c->out, 0,
+                            (uint32_t)(OUR_CONN_WIN - h2::DEFAULT_WINDOW));
+    ep_add(e, c);
+    if (!c->connecting) flush_out(e, c);
+    return c;
+}
+
+// Open the upstream side of st on conn uc: allocate a stream id, send the
+// (re-encoded) request headers, then pump any buffered body.
+void send_request_headers(Engine* e, PStream* st, H2Conn* uc) {
+    st->uc = uc;
+    st->uid = uc->next_stream_id;
+    uc->next_stream_id += 2;
+    uc->streams[st->uid] = st;
+    uc->active_streams++;
+    st->u_swin = uc->s.peer_init_win;
+    st->req_hdrs_sent = true;
+    bool end = st->req_end_seen && st->u_pend.empty() &&
+               !st->u_has_trailers;
+    write_headers(uc, st->uid, st->req_hdrs, end);
+    if (end) st->req_end_sent = true;
+    pump_upstream(e, st);
+}
+
+void dispatch_from_queue(Engine* e, H2Conn* uc) {
+    while (!uc->pend_dispatch.empty() && !uc->draining &&
+           uc->active_streams < uc->s.peer_max_streams) {
+        PStream* st = uc->pend_dispatch.front();
+        uc->pend_dispatch.pop_front();
+        send_request_headers(e, st, uc);
+    }
+}
+
+int pick_endpoint(Route& r) {
+    size_t n = r.eps.size();
+    if (n == 0) return -1;
+    if (n == 1) return 0;
+    size_t a = r.next++ % n;
+    size_t b = r.next % n;
+    return (int)(r.eps[a].inflight <= r.eps[b].inflight ? a : b);
+}
+
+// Route + attach st to an upstream conn. Returns false when no route /
+// endpoint exists (caller decides to park or fail).
+bool dispatch_stream(Engine* e, PStream* st) {
+    H2Conn* uc = nullptr;
+    uint64_t route_id = 0;
+    uint32_t ip_be = 0;
+    uint16_t port = 0;
+    bool found = false;
+    {
+        std::lock_guard<std::mutex> g(e->mu);
+        auto it = e->routes.find(st->route_key);
+        if (it != e->routes.end()) {
+            Route& r = it->second;
+            int idx = pick_endpoint(r);
+            if (idx >= 0) {
+                found = true;
+                Endpoint& ep = r.eps[(size_t)idx];
+                route_id = r.id;
+                ip_be = ep.ip_be;
+                port = ep.port;
+                ep.inflight++;
+                if (ep.conn != nullptr && !ep.conn->draining &&
+                    !ep.conn->closing && !ep.conn->dead)
+                    uc = ep.conn;
+            }
+        }
+    }
+    if (!found) return false;
+    st->route_id = route_id;
+    st->ep_ip = ip_be;
+    st->ep_pt = port;
+    if (uc == nullptr) {
+        uc = mk_upstream(e, st->route_key, route_id, ip_be, port);
+        if (uc == nullptr) {
+            std::lock_guard<std::mutex> g(e->mu);
+            auto it = e->routes.find(st->route_key);
+            if (it != e->routes.end()) {
+                it->second.stats.conn_fail++;
+                for (auto& ep : it->second.eps)
+                    if (ep.ip_be == ip_be && ep.port == port &&
+                        ep.inflight > 0)
+                        ep.inflight--;
+            }
+            st->status = 502;
+            st->ep_ip = 0;  // inflight already decremented above
+            if (st->cc != nullptr)
+                synth_response(e, st->cc, st->cid, 502, "connect");
+            finish_stream(e, st, true);
+            return true;  // handled (as a failure)
+        }
+        std::lock_guard<std::mutex> g(e->mu);
+        auto it = e->routes.find(st->route_key);
+        if (it != e->routes.end() && it->second.id == route_id)
+            for (auto& ep : it->second.eps)
+                if (ep.ip_be == ip_be && ep.port == port) {
+                    ep.conn = uc;
+                    break;
+                }
+    }
+    if (uc->active_streams >= uc->s.peer_max_streams) {
+        st->uc = uc;  // queued on this conn (uid stays 0)
+        uc->pend_dispatch.push_back(st);
+        return true;
+    }
+    send_request_headers(e, st, uc);
+    return true;
+}
+
+void unpark_route(Engine* e, const std::string& host) {
+    auto it = e->parked.find(host);
+    if (it == e->parked.end()) return;
+    std::vector<PStream*> waiters;
+    waiters.swap(it->second);
+    e->parked.erase(it);
+    for (PStream* st : waiters) {
+        if (st->closed) continue;
+        st->parked = false;
+        if (!dispatch_stream(e, st)) {
+            st->status = 400;
+            if (st->cc != nullptr)
+                synth_response(e, st->cc, st->cid, 400, "no route");
+            finish_stream(e, st, false);
+        }
+    }
+}
+
+// Detach an upstream conn from its endpoint slot (so new streams open a
+// fresh conn). Safe to call repeatedly.
+void clear_endpoint_slot(Engine* e, H2Conn* uc) {
+    std::lock_guard<std::mutex> g(e->mu);
+    auto it = e->routes.find(uc->route_key);
+    if (it == e->routes.end()) return;
+    for (auto& ep : it->second.eps)
+        if (ep.conn == uc) ep.conn = nullptr;
+}
+
+// Undo the endpoint inflight increment for a stream being re-routed.
+void release_inflight(Engine* e, PStream* st) {
+    if (!st->ep_ip) return;
+    std::lock_guard<std::mutex> g(e->mu);
+    auto it = e->routes.find(st->route_key);
+    if (it != e->routes.end() && it->second.id == st->route_id)
+        for (auto& ep : it->second.eps)
+            if (ep.ip_be == st->ep_ip && ep.port == st->ep_pt &&
+                ep.inflight > 0) {
+                ep.inflight--;
+                break;
+            }
+    st->ep_ip = 0;
+    st->ep_pt = 0;
+}
+
+// Reset a stream back to undispatched and retry it once (GOAWAY-refused
+// or upstream death with the request still fully retained).
+bool replay_stream(Engine* e, PStream* st) {
+    if (st->closed || !st->retain_valid || st->rsp_started ||
+        st->replayed || st->cc == nullptr)
+        return false;
+    st->replayed = true;
+    release_inflight(e, st);
+    st->uc = nullptr;
+    st->uid = 0;
+    st->req_hdrs_sent = false;
+    st->req_end_sent = false;
+    if (st->cc != nullptr) st->cc->buffered -= st->u_pend.size();
+    st->u_pend = st->req_retain;
+    if (st->cc != nullptr) st->cc->buffered += st->u_pend.size();
+    st->u_pend_end = st->req_end_seen && !st->u_has_trailers;
+    return dispatch_stream(e, st);
+}
+
+void conn_close(Engine* e, H2Conn* c) {
+    if (c->dead) return;
+    c->dead = true;
+    e->graveyard.push_back(c);
+    if (c->fd >= 0) {
+        epoll_ctl(e->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+        e->conns.erase(c->fd);
+        ::close(c->fd);
+        c->fd = -1;
+    }
+    // collect streams first: finish_stream mutates c->streams
+    std::vector<PStream*> sts;
+    sts.reserve(c->streams.size());
+    for (auto& kv : c->streams) sts.push_back(kv.second);
+    if (c->kind == H2Conn::Kind::CLIENT) {
+        for (PStream* st : sts) {
+            st->cc = nullptr;  // conn is gone
+            if (st->uc != nullptr && st->uid)
+                h2::write_rst(&st->uc->out, st->uid, h2::CANCEL);
+            H2Conn* uc = st->uc;
+            finish_stream(e, st, false);
+            if (uc != nullptr) flush_out(e, uc);
+        }
+    } else {
+        clear_endpoint_slot(e, c);
+        std::vector<PStream*> queued(c->pend_dispatch.begin(),
+                                     c->pend_dispatch.end());
+        c->pend_dispatch.clear();
+        for (PStream* st : queued) {
+            st->uc = nullptr;
+            release_inflight(e, st);
+            if (!dispatch_stream(e, st)) {
+                st->status = 502;
+                if (st->cc != nullptr)
+                    synth_response(e, st->cc, st->cid, 502, "upstream");
+                finish_stream(e, st, true);
+            }
+        }
+        for (PStream* st : sts) {
+            st->uc = nullptr;  // conn is gone; don't unlink via it
+            if (replay_stream(e, st)) continue;
+            st->status = 502;
+            if (st->cc != nullptr) {
+                if (st->rsp_started) {
+                    h2::write_rst(&st->cc->out, st->cid,
+                                  h2::INTERNAL_ERROR);
+                    flush_out(e, st->cc);
+                } else {
+                    synth_response(e, st->cc, st->cid, 502, "upstream");
+                }
+            }
+            finish_stream(e, st, true);
+        }
+    }
+    c->streams.clear();
+}
+
+void conn_error(Engine* e, H2Conn* c, uint32_t code) {
+    if (c->dead) return;
+    h2::write_goaway(&c->out, c->max_seen_id, code);
+    flush_out(e, c);
+    conn_close(e, c);
+}
+
+// ---- frame handlers ----
+
+const std::string* find_hdr(const std::vector<Hdr>& hs, const char* name) {
+    for (auto& h : hs)
+        if (h.first == name) return &h.second;
+    return nullptr;
+}
+
+void apply_settings(Engine* e, H2Conn* c, const uint8_t* p, size_t len) {
+    int64_t old_init = c->s.peer_init_win;
+    for (size_t off = 0; off + 6 <= len; off += 6) {
+        uint16_t id = (uint16_t)((p[off] << 8) | p[off + 1]);
+        uint32_t v = h2::get_u32(p + off + 2);
+        switch (id) {
+        case h2::S_HEADER_TABLE_SIZE:
+            c->s.enc.set_max_table_size(v);
+            break;
+        case h2::S_INITIAL_WINDOW_SIZE:
+            c->s.peer_init_win = (int64_t)v;
+            break;
+        case h2::S_MAX_FRAME_SIZE:
+            if (v >= 16384 && v <= (1u << 24) - 1) c->s.peer_max_frame = v;
+            break;
+        case h2::S_MAX_CONCURRENT_STREAMS:
+            c->s.peer_max_streams = v;
+            break;
+        default:
+            break;
+        }
+    }
+    // §6.9.2: a changed INITIAL_WINDOW_SIZE adjusts every open stream's
+    // remaining send window by the delta
+    int64_t delta = c->s.peer_init_win - old_init;
+    if (delta != 0) {
+        for (auto& kv : c->streams) {
+            if (c->kind == H2Conn::Kind::CLIENT)
+                kv.second->c_swin += delta;
+            else
+                kv.second->u_swin += delta;
+        }
+    }
+    h2::write_settings(&c->out, {}, true);  // ACK
+    if (!flush_out(e, c)) return;
+    if (delta > 0) {
+        std::vector<PStream*> sts;
+        for (auto& kv : c->streams) sts.push_back(kv.second);
+        for (PStream* st : sts) {
+            if (c->dead) return;
+            if (st->closed) continue;
+            if (c->kind == H2Conn::Kind::CLIENT) pump_client(e, st);
+            else pump_upstream(e, st);
+        }
+    }
+    if (c->kind == H2Conn::Kind::UPSTREAM) dispatch_from_queue(e, c);
+}
+
+// A complete (HEADERS..CONTINUATION) block arrived on a CLIENT conn.
+void client_headers_complete(Engine* e, H2Conn* c) {
+    uint32_t sid = c->s.hb_stream;
+    uint8_t flags = c->s.hb_flags;
+    std::vector<Hdr> hs;
+    if (!c->s.dec.decode((const uint8_t*)c->s.hb_buf.data(),
+                         c->s.hb_buf.size(), &hs)) {
+        conn_error(e, c, h2::COMPRESSION_ERROR);
+        return;
+    }
+    auto it = c->streams.find(sid);
+    if (it != c->streams.end()) {
+        // trailers from the client
+        PStream* st = it->second;
+        st->req_end_seen = true;
+        st->u_has_trailers = true;
+        st->u_trailers = std::move(hs);
+        st->retain_valid = false;  // trailers aren't retained for replay
+        pump_upstream(e, st);
+        return;
+    }
+    if ((sid & 1) == 0 || sid == 0) {
+        conn_error(e, c, h2::PROTOCOL_ERROR);
+        return;
+    }
+    if (sid <= c->max_seen_id) return;  // closed stream: block was decoded
+    c->max_seen_id = sid;
+    const std::string* auth = find_hdr(hs, ":authority");
+    if (auth == nullptr) auth = find_hdr(hs, "host");
+    std::string key = auth != nullptr ? *auth : "";
+    size_t colon = key.find(':');
+    if (colon != std::string::npos) key.resize(colon);
+    lower(key);
+    if (key.empty()) {
+        synth_response(e, c, sid, 400, "no authority");
+        return;
+    }
+    PStream* st = new PStream();
+    st->cc = c;
+    st->cid = sid;
+    st->route_key = key;
+    st->t_start_us = now_us();
+    st->c_swin = c->s.peer_init_win;
+    st->req_end_seen = (flags & h2::FLAG_END_STREAM) != 0;
+    st->u_pend_end = st->req_end_seen;
+    hs.push_back({"via", "1.1 linkerd-tpu"});
+    st->req_hdrs = std::move(hs);
+    for (auto& h : st->req_hdrs) st->req_b += h.first.size()
+                                     + h.second.size();
+    c->streams[sid] = st;
+    if (dispatch_stream(e, st)) return;
+    // no route yet: surface the miss and park (same dance as the h1
+    // engine's WAIT_ROUTE, fastpath.cpp)
+    st->parked = true;
+    st->park_deadline_us = now_us() + ROUTE_WAIT_TIMEOUT_US;
+    e->parked[key].push_back(st);
+    {
+        std::lock_guard<std::mutex> g(e->mu);
+        e->misses.push_back(key);
+    }
+}
+
+// A complete header block arrived on an UPSTREAM conn (response headers,
+// informational headers, or trailers).
+void upstream_headers_complete(Engine* e, H2Conn* c) {
+    uint32_t sid = c->s.hb_stream;
+    uint8_t flags = c->s.hb_flags;
+    std::vector<Hdr> hs;
+    if (!c->s.dec.decode((const uint8_t*)c->s.hb_buf.data(),
+                         c->s.hb_buf.size(), &hs)) {
+        conn_error(e, c, h2::COMPRESSION_ERROR);
+        return;
+    }
+    auto it = c->streams.find(sid);
+    if (it == c->streams.end()) return;
+    PStream* st = it->second;
+    bool end = (flags & h2::FLAG_END_STREAM) != 0;
+    if (!st->rsp_started) {
+        const std::string* status = find_hdr(hs, ":status");
+        int code = status != nullptr ? atoi(status->c_str()) : 0;
+        if (code >= 100 && code < 200) {
+            // informational: forward and keep waiting for the real one
+            if (st->cc != nullptr) {
+                write_headers(st->cc, st->cid, hs, false);
+                flush_out(e, st->cc);
+            }
+            return;
+        }
+        st->rsp_started = true;
+        st->status = code;
+        st->retain_valid = false;  // response begun: no more replay
+        for (auto& h : hs) st->rsp_b += h.first.size() + h.second.size();
+        if (st->cc != nullptr) {
+            write_headers(st->cc, st->cid, hs, end);
+            if (end) st->rsp_end_sent = true;
+            flush_out(e, st->cc);
+        } else {
+            st->rsp_end_sent = end;
+        }
+        if (end) finish_stream(e, st, true);
+        return;
+    }
+    // trailers (gRPC: grpc-status rides here)
+    for (auto& h : hs) st->rsp_b += h.first.size() + h.second.size();
+    st->c_has_trailers = true;
+    st->c_trailers = std::move(hs);
+    st->c_pend_end = true;  // trailers always end the stream
+    pump_client(e, st);
+}
+
+void handle_client_frame(Engine* e, H2Conn* c, uint8_t type, uint8_t flags,
+                         uint32_t sid, const uint8_t* p, size_t len) {
+    if (c->s.in_headers && type != h2::CONTINUATION) {
+        conn_error(e, c, h2::PROTOCOL_ERROR);
+        return;
+    }
+    switch (type) {
+    case h2::HEADERS: {
+        size_t off = 0, n = len;
+        if (flags & h2::FLAG_PADDED) {
+            if (!len) { conn_error(e, c, h2::PROTOCOL_ERROR); return; }
+            uint8_t pad = p[0];
+            if ((size_t)pad + 1 > len) {
+                conn_error(e, c, h2::PROTOCOL_ERROR);
+                return;
+            }
+            off = 1;
+            n = len - 1 - pad;
+        }
+        if (flags & h2::FLAG_PRIORITY) {
+            if (n < 5) { conn_error(e, c, h2::FRAME_SIZE_ERROR); return; }
+            off += 5;
+            n -= 5;
+        }
+        c->s.hb_buf.assign((const char*)(p + off), n);
+        c->s.hb_stream = sid;
+        c->s.hb_flags = flags;
+        if (flags & h2::FLAG_END_HEADERS) {
+            client_headers_complete(e, c);
+        } else {
+            c->s.in_headers = true;
+        }
+        break;
+    }
+    case h2::CONTINUATION: {
+        if (!c->s.in_headers || sid != c->s.hb_stream) {
+            conn_error(e, c, h2::PROTOCOL_ERROR);
+            return;
+        }
+        c->s.hb_buf.append((const char*)p, len);
+        if (c->s.hb_buf.size() > 256 * 1024) {
+            conn_error(e, c, h2::ENHANCE_YOUR_CALM);
+            return;
+        }
+        if (flags & h2::FLAG_END_HEADERS) {
+            c->s.in_headers = false;
+            client_headers_complete(e, c);
+        }
+        break;
+    }
+    case h2::DATA: {
+        c->s.recv_unacked += len;  // padding counts toward flow control
+        auto it = c->streams.find(sid);
+        if (it == c->streams.end()) {
+            conn_grant(e, c);  // closed stream: keep the conn window open
+            return;
+        }
+        PStream* st = it->second;
+        size_t off = 0, n = len;
+        if (flags & h2::FLAG_PADDED) {
+            if (!len) { conn_error(e, c, h2::PROTOCOL_ERROR); return; }
+            uint8_t pad = p[0];
+            if ((size_t)pad + 1 > len) {
+                conn_error(e, c, h2::PROTOCOL_ERROR);
+                return;
+            }
+            off = 1;
+            n = len - 1 - pad;
+        }
+        st->c_runacked += len;
+        st->req_b += n;
+        st->u_pend.append((const char*)(p + off), n);
+        c->buffered += n;
+        if (st->retain_valid) {
+            if (st->req_retain.size() + n > RETAIN_CAP) {
+                st->retain_valid = false;
+                st->req_retain.clear();
+            } else {
+                st->req_retain.append((const char*)(p + off), n);
+            }
+        }
+        if (flags & h2::FLAG_END_STREAM) {
+            st->req_end_seen = true;
+            st->u_pend_end = true;
+        }
+        if (st->parked && st->u_pend.size() > PARKED_PEND_CAP) {
+            h2::write_rst(&c->out, sid, h2::ENHANCE_YOUR_CALM);
+            flush_out(e, c);
+            finish_stream(e, st, false);
+            return;
+        }
+        pump_upstream(e, st);
+        if (!c->dead) {
+            stream_grant(e, st, true);
+            conn_grant(e, c);
+        }
+        break;
+    }
+    case h2::WINDOW_UPDATE: {
+        if (len < 4) { conn_error(e, c, h2::FRAME_SIZE_ERROR); return; }
+        uint32_t inc = h2::get_u32(p) & 0x7FFFFFFF;
+        if (sid == 0) {
+            c->s.send_win += inc;
+            std::vector<PStream*> sts;
+            for (auto& kv : c->streams)
+                if (!kv.second->c_pend.empty() || kv.second->c_pend_end)
+                    sts.push_back(kv.second);
+            for (PStream* st : sts) {
+                if (c->dead) return;
+                if (st->closed) continue;
+                pump_client(e, st);
+            }
+        } else {
+            auto it = c->streams.find(sid);
+            if (it != c->streams.end()) {
+                it->second->c_swin += inc;
+                pump_client(e, it->second);
+            }
+        }
+        break;
+    }
+    case h2::SETTINGS:
+        if (sid != 0 || len % 6) {
+            conn_error(e, c, h2::FRAME_SIZE_ERROR);
+            return;
+        }
+        if (!(flags & h2::FLAG_ACK)) apply_settings(e, c, p, len);
+        break;
+    case h2::PING:
+        if (len != 8) { conn_error(e, c, h2::FRAME_SIZE_ERROR); return; }
+        if (!(flags & h2::FLAG_ACK)) {
+            h2::write_frame(&c->out, h2::PING, h2::FLAG_ACK, 0,
+                            (const char*)p, 8);
+            flush_out(e, c);
+        }
+        break;
+    case h2::RST_STREAM: {
+        if (len < 4) { conn_error(e, c, h2::FRAME_SIZE_ERROR); return; }
+        auto it = c->streams.find(sid);
+        if (it != c->streams.end()) {
+            PStream* st = it->second;
+            if (st->uc != nullptr && st->uid) {
+                h2::write_rst(&st->uc->out, st->uid, h2::CANCEL);
+                flush_out(e, st->uc);
+            }
+            finish_stream(e, st, false);
+        }
+        break;
+    }
+    case h2::GOAWAY:
+        c->draining = true;
+        break;
+    case h2::PRIORITY:
+    default:
+        break;  // ignored
+    }
+}
+
+void handle_upstream_frame(Engine* e, H2Conn* c, uint8_t type,
+                           uint8_t flags, uint32_t sid, const uint8_t* p,
+                           size_t len) {
+    if (c->s.in_headers && type != h2::CONTINUATION) {
+        conn_error(e, c, h2::PROTOCOL_ERROR);
+        return;
+    }
+    switch (type) {
+    case h2::HEADERS: {
+        size_t off = 0, n = len;
+        if (flags & h2::FLAG_PADDED) {
+            if (!len) { conn_error(e, c, h2::PROTOCOL_ERROR); return; }
+            uint8_t pad = p[0];
+            if ((size_t)pad + 1 > len) {
+                conn_error(e, c, h2::PROTOCOL_ERROR);
+                return;
+            }
+            off = 1;
+            n = len - 1 - pad;
+        }
+        if (flags & h2::FLAG_PRIORITY) {
+            if (n < 5) { conn_error(e, c, h2::FRAME_SIZE_ERROR); return; }
+            off += 5;
+            n -= 5;
+        }
+        c->s.hb_buf.assign((const char*)(p + off), n);
+        c->s.hb_stream = sid;
+        c->s.hb_flags = flags;
+        if (flags & h2::FLAG_END_HEADERS) {
+            upstream_headers_complete(e, c);
+        } else {
+            c->s.in_headers = true;
+        }
+        break;
+    }
+    case h2::CONTINUATION:
+        if (!c->s.in_headers || sid != c->s.hb_stream) {
+            conn_error(e, c, h2::PROTOCOL_ERROR);
+            return;
+        }
+        c->s.hb_buf.append((const char*)p, len);
+        if (c->s.hb_buf.size() > 256 * 1024) {
+            conn_error(e, c, h2::ENHANCE_YOUR_CALM);
+            return;
+        }
+        if (flags & h2::FLAG_END_HEADERS) {
+            c->s.in_headers = false;
+            upstream_headers_complete(e, c);
+        }
+        break;
+    case h2::DATA: {
+        c->s.recv_unacked += len;
+        auto it = c->streams.find(sid);
+        if (it == c->streams.end()) {
+            conn_grant(e, c);
+            return;
+        }
+        PStream* st = it->second;
+        size_t off = 0, n = len;
+        if (flags & h2::FLAG_PADDED) {
+            if (!len) { conn_error(e, c, h2::PROTOCOL_ERROR); return; }
+            uint8_t pad = p[0];
+            if ((size_t)pad + 1 > len) {
+                conn_error(e, c, h2::PROTOCOL_ERROR);
+                return;
+            }
+            off = 1;
+            n = len - 1 - pad;
+        }
+        st->u_runacked += len;
+        st->rsp_b += n;
+        st->c_pend.append((const char*)(p + off), n);
+        c->buffered += n;
+        if (flags & h2::FLAG_END_STREAM) st->c_pend_end = true;
+        pump_client(e, st);
+        if (!c->dead) conn_grant(e, c);
+        break;
+    }
+    case h2::WINDOW_UPDATE: {
+        if (len < 4) { conn_error(e, c, h2::FRAME_SIZE_ERROR); return; }
+        uint32_t inc = h2::get_u32(p) & 0x7FFFFFFF;
+        if (sid == 0) {
+            c->s.send_win += inc;
+            std::vector<PStream*> sts;
+            for (auto& kv : c->streams)
+                if (!kv.second->u_pend.empty() || kv.second->u_pend_end ||
+                    kv.second->u_has_trailers)
+                    sts.push_back(kv.second);
+            for (PStream* st : sts) {
+                if (c->dead) return;
+                if (st->closed) continue;
+                pump_upstream(e, st);
+            }
+        } else {
+            auto it = c->streams.find(sid);
+            if (it != c->streams.end()) {
+                it->second->u_swin += inc;
+                pump_upstream(e, it->second);
+            }
+        }
+        break;
+    }
+    case h2::SETTINGS:
+        if (sid != 0 || len % 6) {
+            conn_error(e, c, h2::FRAME_SIZE_ERROR);
+            return;
+        }
+        if (!(flags & h2::FLAG_ACK)) apply_settings(e, c, p, len);
+        break;
+    case h2::PING:
+        if (len != 8) { conn_error(e, c, h2::FRAME_SIZE_ERROR); return; }
+        if (!(flags & h2::FLAG_ACK)) {
+            h2::write_frame(&c->out, h2::PING, h2::FLAG_ACK, 0,
+                            (const char*)p, 8);
+            flush_out(e, c);
+        }
+        break;
+    case h2::RST_STREAM: {
+        if (len < 4) { conn_error(e, c, h2::FRAME_SIZE_ERROR); return; }
+        uint32_t code = h2::get_u32(p);
+        auto it = c->streams.find(sid);
+        if (it != c->streams.end()) {
+            PStream* st = it->second;
+            st->status = 502;
+            if (st->cc != nullptr) {
+                if (st->rsp_started || st->rsp_end_sent) {
+                    h2::write_rst(&st->cc->out, st->cid, code);
+                    flush_out(e, st->cc);
+                } else {
+                    synth_response(e, st->cc, st->cid, 502, "upstream rst");
+                }
+            }
+            finish_stream(e, st, true);
+        }
+        break;
+    }
+    case h2::GOAWAY: {
+        // reconnect semantics: this conn takes no new streams; streams
+        // the server never processed (uid > last_id) replay on a fresh
+        // conn when the request is still retained, else the client gets
+        // REFUSED_STREAM (safely retryable per RFC 7540 §8.1.4)
+        if (len < 8) { conn_error(e, c, h2::FRAME_SIZE_ERROR); return; }
+        uint32_t last_id = h2::get_u32(p) & 0x7FFFFFFF;
+        c->draining = true;
+        clear_endpoint_slot(e, c);
+        std::vector<PStream*> refused;
+        for (auto& kv : c->streams)
+            if (kv.first > last_id) refused.push_back(kv.second);
+        for (PStream* st : refused) {
+            c->streams.erase(st->uid);
+            if (c->active_streams > 0) c->active_streams--;
+            st->uc = nullptr;
+            st->uid = 0;
+            if (replay_stream(e, st)) continue;
+            if (st->cc != nullptr) {
+                h2::write_rst(&st->cc->out, st->cid, h2::REFUSED_STREAM);
+                flush_out(e, st->cc);
+            }
+            finish_stream(e, st, false);
+        }
+        std::vector<PStream*> queued(c->pend_dispatch.begin(),
+                                     c->pend_dispatch.end());
+        c->pend_dispatch.clear();
+        for (PStream* st : queued) {
+            st->uc = nullptr;
+            release_inflight(e, st);
+            if (!dispatch_stream(e, st)) {
+                if (st->cc != nullptr)
+                    synth_response(e, st->cc, st->cid, 502, "upstream");
+                finish_stream(e, st, true);
+            }
+        }
+        if (c->streams.empty()) conn_close(e, c);
+        break;
+    }
+    case h2::PRIORITY:
+    default:
+        break;
+    }
+}
+
+void process_in(Engine* e, H2Conn* c) {
+    size_t pos = 0;
+    if (c->kind == H2Conn::Kind::CLIENT && !c->s.preface_seen) {
+        if (c->in.size() < h2::PREFACE_LEN) return;
+        if (memcmp(c->in.data(), h2::PREFACE, h2::PREFACE_LEN) != 0) {
+            conn_close(e, c);
+            return;
+        }
+        c->s.preface_seen = true;
+        pos = h2::PREFACE_LEN;
+    }
+    while (!c->dead && c->in.size() - pos >= 9) {
+        const uint8_t* h = (const uint8_t*)c->in.data() + pos;
+        uint32_t len = ((uint32_t)h[0] << 16) | ((uint32_t)h[1] << 8)
+            | h[2];
+        uint8_t type = h[3];
+        uint8_t flags = h[4];
+        uint32_t sid = h2::get_u32(h + 5) & 0x7FFFFFFF;
+        if (len > MAX_FRAME_OK) {
+            conn_error(e, c, h2::FRAME_SIZE_ERROR);
+            return;
+        }
+        if (c->in.size() - pos < 9 + (size_t)len) break;
+        if (c->kind == H2Conn::Kind::CLIENT)
+            handle_client_frame(e, c, type, flags, sid, h + 9, len);
+        else
+            handle_upstream_frame(e, c, type, flags, sid, h + 9, len);
+        if (c->dead) return;
+        pos += 9 + (size_t)len;
+    }
+    if (pos) c->in.erase(0, pos);
+}
+
+void on_readable(Engine* e, H2Conn* c) {
+    char buf[64 * 1024];
+    for (;;) {
+        if (c->dead) return;
+        ssize_t n = ::recv(c->fd, buf, sizeof(buf), 0);
+        if (n < 0) {
+            if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+            conn_close(e, c);
+            return;
+        }
+        if (n == 0) {
+            conn_close(e, c);
+            return;
+        }
+        c->in.append(buf, (size_t)n);
+        process_in(e, c);
+    }
+}
+
+void on_listener(Engine* e, int lfd) {
+    for (;;) {
+        int fd = ::accept4(lfd, nullptr, nullptr, SOCK_NONBLOCK);
+        if (fd < 0) return;
+        set_nodelay(fd);
+        H2Conn* c = new H2Conn();
+        c->kind = H2Conn::Kind::CLIENT;
+        c->fd = fd;
+        // server preface: SETTINGS + a big connection window
+        h2::write_settings(&c->out,
+                           {{h2::S_HEADER_TABLE_SIZE, 4096},
+                            {h2::S_MAX_CONCURRENT_STREAMS, 1024},
+                            {h2::S_INITIAL_WINDOW_SIZE,
+                             (uint32_t)OUR_STREAM_WIN},
+                            {h2::S_MAX_FRAME_SIZE, h2::DEFAULT_MAX_FRAME}},
+                           false);
+        h2::write_window_update(&c->out, 0, (uint32_t)(OUR_CONN_WIN
+                                                       - h2::DEFAULT_WINDOW));
+        ep_add(e, c);
+        flush_out(e, c);
+        e->accepted.fetch_add(1, std::memory_order_relaxed);
+    }
+}
+
+void sweep(Engine* e) {
+    uint64_t now = now_us();
+    if (now - e->last_sweep_us < 500'000) return;
+    e->last_sweep_us = now;
+    std::vector<PStream*> expired;
+    for (auto& kv : e->parked)
+        for (PStream* st : kv.second)
+            if (now > st->park_deadline_us) expired.push_back(st);
+    for (PStream* st : expired) {
+        if (st->closed) continue;
+        if (st->cc != nullptr)
+            synth_response(e, st->cc, st->cid, 400, "no route");
+        finish_stream(e, st, false);
+    }
+}
+
+void drain_graveyard(Engine* e) {
+    for (H2Conn* c : e->graveyard) delete c;
+    e->graveyard.clear();
+    for (PStream* st : e->stream_graveyard) delete st;
+    e->stream_graveyard.clear();
+}
+
+void* loop_main(void* arg) {
+    Engine* e = (Engine*)arg;
+    epoll_event evs[MAX_EVENTS];
+    while (e->running.load(std::memory_order_relaxed)) {
+        int n = epoll_wait(e->epfd, evs, MAX_EVENTS, 250);
+        for (int i = 0; i < n; i++) {
+            int fd = evs[i].data.fd;
+            uint32_t ev = evs[i].events;
+            if (fd == e->wakefd) {
+                uint64_t v;
+                ssize_t r = ::read(e->wakefd, &v, sizeof(v));
+                (void)r;
+                std::vector<std::string> hosts;
+                {
+                    std::lock_guard<std::mutex> g(e->mu);
+                    for (auto& kv : e->parked)
+                        if (e->routes.count(kv.first))
+                            hosts.push_back(kv.first);
+                }
+                for (auto& h : hosts) unpark_route(e, h);
+                continue;
+            }
+            bool is_listener = false;
+            for (int lfd : e->listeners)
+                if (lfd == fd) {
+                    is_listener = true;
+                    break;
+                }
+            if (is_listener) {
+                on_listener(e, fd);
+                continue;
+            }
+            auto it = e->conns.find(fd);
+            if (it == e->conns.end()) continue;
+            H2Conn* c = it->second;
+            if (ev & (EPOLLHUP | EPOLLERR)) {
+                conn_close(e, c);
+                continue;
+            }
+            if (ev & EPOLLOUT) {
+                if (c->kind == H2Conn::Kind::UPSTREAM && c->connecting) {
+                    int err = 0;
+                    socklen_t sl = sizeof(err);
+                    getsockopt(c->fd, SOL_SOCKET, SO_ERROR, &err, &sl);
+                    if (err != 0) {
+                        conn_close(e, c);
+                        continue;
+                    }
+                    c->connecting = false;
+                }
+                size_t before = c->out.size();
+                if (!flush_out(e, c)) continue;
+                if (c->out.size() < before) {
+                    // room freed: resume streams stalled on OUT_HIGH
+                    std::vector<PStream*> sts;
+                    for (auto& kv : c->streams) sts.push_back(kv.second);
+                    for (PStream* st : sts) {
+                        if (c->dead) break;
+                        if (st->closed) continue;
+                        if (c->kind == H2Conn::Kind::CLIENT)
+                            pump_client(e, st);
+                        else
+                            pump_upstream(e, st);
+                    }
+                }
+            }
+            if ((ev & (EPOLLIN | EPOLLRDHUP)) && !c->dead)
+                on_readable(e, c);
+        }
+        sweep(e);
+        drain_graveyard(e);
+    }
+    return nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* fph2_create() {
+    Engine* e = new Engine();
+    e->epfd = epoll_create1(0);
+    e->wakefd = eventfd(0, EFD_NONBLOCK);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = e->wakefd;
+    epoll_ctl(e->epfd, EPOLL_CTL_ADD, e->wakefd, &ev);
+    return e;
+}
+
+int fph2_start(void* ep) {
+    Engine* e = (Engine*)ep;
+    if (e->thread_started) return 0;
+    if (pthread_create(&e->thread, nullptr, loop_main, e) != 0) return -1;
+    e->thread_started = true;
+    return 0;
+}
+
+int fph2_listen(void* ep, const char* ip, int port) {
+    Engine* e = (Engine*)ep;
+    int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (fd < 0) return -1;
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, ip, &sa.sin_addr) != 1) {
+        ::close(fd);
+        return -1;
+    }
+    if (bind(fd, (sockaddr*)&sa, sizeof(sa)) < 0 || listen(fd, 1024) < 0) {
+        ::close(fd);
+        return -1;
+    }
+    socklen_t sl = sizeof(sa);
+    getsockname(fd, (sockaddr*)&sa, &sl);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = fd;
+    epoll_ctl(e->epfd, EPOLL_CTL_ADD, fd, &ev);
+    e->listeners.push_back(fd);
+    return (int)ntohs(sa.sin_port);
+}
+
+int fph2_set_route(void* ep, const char* host, const char* endpoints) {
+    Engine* e = (Engine*)ep;
+    std::vector<Endpoint> eps;
+    const char* p = endpoints;
+    while (p && *p) {
+        while (*p == ' ') p++;
+        if (!*p) break;
+        const char* colon = strchr(p, ':');
+        if (!colon) break;
+        std::string ip(p, (size_t)(colon - p));
+        int port = atoi(colon + 1);
+        Endpoint epnt{};
+        if (inet_pton(AF_INET, ip.c_str(), &epnt.ip_be) == 1 &&
+            port > 0 && port < 65536) {
+            epnt.port = (uint16_t)port;
+            eps.push_back(epnt);
+        }
+        const char* sp = strchr(colon, ' ');
+        if (!sp) break;
+        p = sp + 1;
+    }
+    std::string key(host);
+    lower(key);
+    {
+        std::lock_guard<std::mutex> g(e->mu);
+        auto it = e->routes.find(key);
+        if (it == e->routes.end()) {
+            Route r;
+            r.id = e->next_route_id++;
+            r.eps = std::move(eps);
+            e->routes.emplace(std::move(key), std::move(r));
+        } else {
+            Route& r = it->second;
+            for (auto& ne : eps)
+                for (auto& oe : r.eps)
+                    if (oe.ip_be == ne.ip_be && oe.port == ne.port) {
+                        ne.inflight = oe.inflight;
+                        ne.conn = oe.conn;
+                    }
+            r.eps = std::move(eps);
+        }
+    }
+    uint64_t v = 1;
+    ssize_t r = ::write(e->wakefd, &v, sizeof(v));
+    (void)r;
+    return 0;
+}
+
+int fph2_remove_route(void* ep, const char* host) {
+    Engine* e = (Engine*)ep;
+    std::string key(host);
+    lower(key);
+    std::lock_guard<std::mutex> g(e->mu);
+    return e->routes.erase(key) ? 0 : -1;
+}
+
+long fph2_drain_misses(void* ep, char* buf, size_t cap) {
+    Engine* e = (Engine*)ep;
+    std::lock_guard<std::mutex> g(e->mu);
+    size_t used = 0;
+    long count = 0;
+    while (!e->misses.empty()) {
+        const std::string& h = e->misses.front();
+        if (used + h.size() + 2 > cap) break;
+        memcpy(buf + used, h.data(), h.size());
+        used += h.size();
+        buf[used++] = '\n';
+        e->misses.pop_front();
+        count++;
+    }
+    buf[used] = 0;
+    return count;
+}
+
+long fph2_stats_json(void* ep, char* buf, size_t cap) {
+    Engine* e = (Engine*)ep;
+    std::string s = "{\"routes\":{";
+    std::lock_guard<std::mutex> g(e->mu);
+    bool first = true;
+    for (auto& kv : e->routes) {
+        RouteStats& st = kv.second.stats;
+        char tmp[256];
+        snprintf(tmp, sizeof(tmp),
+                 "%s\"%s\":{\"id\":%llu,\"requests\":%llu,\"success\":%llu,"
+                 "\"f4xx\":%llu,\"f5xx\":%llu,\"conn_fail\":%llu,"
+                 "\"hist\":[",
+                 first ? "" : ",", kv.first.c_str(),
+                 (unsigned long long)kv.second.id,
+                 (unsigned long long)st.requests,
+                 (unsigned long long)st.success,
+                 (unsigned long long)st.f4xx,
+                 (unsigned long long)st.f5xx,
+                 (unsigned long long)st.conn_fail);
+        s += tmp;
+        for (int i = 0; i < LAT_BUCKETS; i++) {
+            if (i) s += ",";
+            snprintf(tmp, sizeof(tmp), "%llu",
+                     (unsigned long long)st.lat_hist[i]);
+            s += tmp;
+        }
+        s += "]}";
+        first = false;
+    }
+    char tail[128];
+    snprintf(tail, sizeof(tail),
+             "},\"accepted\":%llu,\"features_dropped\":%llu}",
+             (unsigned long long)e->accepted.load(
+                 std::memory_order_relaxed),
+             (unsigned long long)e->features_dropped);
+    s += tail;
+    if (s.size() + 1 > cap) return -2;
+    memcpy(buf, s.data(), s.size());
+    buf[s.size()] = 0;
+    return (long)s.size();
+}
+
+long fph2_drain_features(void* ep, float* buf, long cap_rows) {
+    Engine* e = (Engine*)ep;
+    std::lock_guard<std::mutex> g(e->mu);
+    long n = (long)e->features.size();
+    if (n > cap_rows) n = cap_rows;
+    for (long i = 0; i < n; i++)
+        memcpy(buf + i * 6, &e->features[(size_t)i], sizeof(FeatureRow));
+    e->features.erase(e->features.begin(), e->features.begin() + n);
+    return n;
+}
+
+void fph2_shutdown(void* ep) {
+    Engine* e = (Engine*)ep;
+    e->running.store(false);
+    uint64_t v = 1;
+    ssize_t r = ::write(e->wakefd, &v, sizeof(v));
+    (void)r;
+    if (e->thread_started) pthread_join(e->thread, nullptr);
+    std::vector<H2Conn*> cs;
+    for (auto& kv : e->conns) cs.push_back(kv.second);
+    for (H2Conn* c : cs) conn_close(e, c);
+    drain_graveyard(e);
+    for (int lfd : e->listeners) ::close(lfd);
+    ::close(e->wakefd);
+    ::close(e->epfd);
+    delete e;
+}
+
+}  // extern "C"
