@@ -214,8 +214,19 @@ def main() -> None:
     try:
         g = grpc_bench()
         detail["grpc_req_s"] = g.get("grpc_req_s")
-        detail["grpc_p99_ms"] = (g.get("grpc_lat") or {}).get("p99_ms")
+        # headline p99 @rate comes from the external (subprocess) paced
+        # loadgen; the Python-client view stays in grpc_python_p99_ms.
+        # A paced run with zero successes is a failed measurement, not a
+        # 0ms p99 — fall back to the in-process number then.
+        ext = g.get("grpc_paced_ext") or {}
+        detail["grpc_p99_ms"] = (ext.get("p99_ms") if ext.get("reqs")
+                                 else (g.get("grpc_lat")
+                                       or {}).get("p99_ms"))
+        detail["grpc_python_p99_ms"] = (g.get("grpc_lat") or {}).get(
+            "p99_ms")
         detail["grpc_saturation_req_s"] = g.get("grpc_saturation_req_s")
+        detail["grpc_saturation_p99_ms"] = g.get("grpc_saturation_p99_ms")
+        detail["grpc_loadgen"] = g.get("loadgen")
         if "error" in g:
             detail["grpc_error"] = g["error"]
     except Exception as e:  # noqa: BLE001

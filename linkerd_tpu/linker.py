@@ -933,6 +933,27 @@ class Linker:
         return Router(rspec, label, server_stack, binding, servers,
                       interpreter=interpreter)
 
+    def _check_fastpath_spec(self, rspec: RouterSpec, label: str) -> None:
+        """Refuse config the native engine cannot honor — silently
+        dropping an operator's TLS or policy block would be worse than
+        failing the load (same stance as the SETTINGS-knob gate)."""
+        def has_tls(raw) -> bool:
+            if not isinstance(raw, dict):
+                return False
+            if raw.get("kind") == "io.l5d.static":
+                return any(isinstance(c, dict) and "tls" in c
+                           for c in (raw.get("configs") or []))
+            return "tls" in raw
+
+        if has_tls(rspec.client):
+            raise ConfigError(
+                f"{label}: client.tls is not supported with "
+                f"fastPath: true (the native engine dials cleartext)")
+        if rspec.service:
+            raise ConfigError(
+                f"{label}: service policy (classifier/retries/timeout) "
+                f"is not supported with fastPath: true")
+
     def _mk_fastpath_router(self, rspec: RouterSpec, label: str) -> Router:
         """http or h2 router served by the native engine (fastPath: true).
 
@@ -944,6 +965,7 @@ class Linker:
         from linkerd_tpu import native
         from linkerd_tpu.router.fastpath import FastPathController
 
+        self._check_fastpath_spec(rspec, label)
         if not native.ensure_built():
             raise ConfigError(
                 f"{label}: fastPath requires the native library "

@@ -118,6 +118,7 @@ struct PStream;
 struct Engine {
     int epfd = -1;
     int wakefd = -1;
+    bool shutting_down = false;  // teardown: no replays / new upstreams
     std::atomic<bool> running{true};
     pthread_t thread;
     bool thread_started = false;
@@ -577,6 +578,7 @@ int pick_endpoint(Route& r) {
 // Route + attach st to an upstream conn. Returns false when no route /
 // endpoint exists (caller decides to park or fail).
 bool dispatch_stream(Engine* e, PStream* st) {
+    if (e->shutting_down) return false;
     H2Conn* uc = nullptr;
     uint64_t route_id = 0;
     uint32_t ip_be = 0;
@@ -689,8 +691,8 @@ void release_inflight(Engine* e, PStream* st) {
 // Reset a stream back to undispatched and retry it once (GOAWAY-refused
 // or upstream death with the request still fully retained).
 bool replay_stream(Engine* e, PStream* st) {
-    if (st->closed || !st->retain_valid || st->rsp_started ||
-        st->replayed || st->cc == nullptr)
+    if (e->shutting_down || st->closed || !st->retain_valid ||
+        st->rsp_started || st->replayed || st->cc == nullptr)
         return false;
     st->replayed = true;
     release_inflight(e, st);
@@ -775,6 +777,28 @@ const std::string* find_hdr(const std::vector<Hdr>& hs, const char* name) {
     for (auto& h : hs)
         if (h.first == name) return &h.second;
     return nullptr;
+}
+
+// Strip PADDED (+PRIORITY for HEADERS) from a frame payload; false =>
+// malformed (PROTOCOL_ERROR). Shared by both directions so padding
+// validation can't drift between the client and upstream handlers.
+bool strip_payload(uint8_t flags, bool headers, const uint8_t* p,
+                   size_t len, size_t* off, size_t* n) {
+    *off = 0;
+    *n = len;
+    if (flags & h2::FLAG_PADDED) {
+        if (!len) return false;
+        uint8_t pad = p[0];
+        if ((size_t)pad + 1 > len) return false;
+        *off = 1;
+        *n = len - 1 - pad;
+    }
+    if (headers && (flags & h2::FLAG_PRIORITY)) {
+        if (*n < 5) return false;
+        *off += 5;
+        *n -= 5;
+    }
+    return true;
 }
 
 void apply_settings(Engine* e, H2Conn* c, const uint8_t* p, size_t len) {
@@ -943,21 +967,10 @@ void handle_client_frame(Engine* e, H2Conn* c, uint8_t type, uint8_t flags,
     }
     switch (type) {
     case h2::HEADERS: {
-        size_t off = 0, n = len;
-        if (flags & h2::FLAG_PADDED) {
-            if (!len) { conn_error(e, c, h2::PROTOCOL_ERROR); return; }
-            uint8_t pad = p[0];
-            if ((size_t)pad + 1 > len) {
-                conn_error(e, c, h2::PROTOCOL_ERROR);
-                return;
-            }
-            off = 1;
-            n = len - 1 - pad;
-        }
-        if (flags & h2::FLAG_PRIORITY) {
-            if (n < 5) { conn_error(e, c, h2::FRAME_SIZE_ERROR); return; }
-            off += 5;
-            n -= 5;
+        size_t off, n;
+        if (!strip_payload(flags, true, p, len, &off, &n)) {
+            conn_error(e, c, h2::PROTOCOL_ERROR);
+            return;
         }
         c->s.hb_buf.assign((const char*)(p + off), n);
         c->s.hb_stream = sid;
@@ -993,16 +1006,10 @@ void handle_client_frame(Engine* e, H2Conn* c, uint8_t type, uint8_t flags,
             return;
         }
         PStream* st = it->second;
-        size_t off = 0, n = len;
-        if (flags & h2::FLAG_PADDED) {
-            if (!len) { conn_error(e, c, h2::PROTOCOL_ERROR); return; }
-            uint8_t pad = p[0];
-            if ((size_t)pad + 1 > len) {
-                conn_error(e, c, h2::PROTOCOL_ERROR);
-                return;
-            }
-            off = 1;
-            n = len - 1 - pad;
+        size_t off, n;
+        if (!strip_payload(flags, false, p, len, &off, &n)) {
+            conn_error(e, c, h2::PROTOCOL_ERROR);
+            return;
         }
         st->c_runacked += len;
         st->req_b += n;
@@ -1102,21 +1109,10 @@ void handle_upstream_frame(Engine* e, H2Conn* c, uint8_t type,
     }
     switch (type) {
     case h2::HEADERS: {
-        size_t off = 0, n = len;
-        if (flags & h2::FLAG_PADDED) {
-            if (!len) { conn_error(e, c, h2::PROTOCOL_ERROR); return; }
-            uint8_t pad = p[0];
-            if ((size_t)pad + 1 > len) {
-                conn_error(e, c, h2::PROTOCOL_ERROR);
-                return;
-            }
-            off = 1;
-            n = len - 1 - pad;
-        }
-        if (flags & h2::FLAG_PRIORITY) {
-            if (n < 5) { conn_error(e, c, h2::FRAME_SIZE_ERROR); return; }
-            off += 5;
-            n -= 5;
+        size_t off, n;
+        if (!strip_payload(flags, true, p, len, &off, &n)) {
+            conn_error(e, c, h2::PROTOCOL_ERROR);
+            return;
         }
         c->s.hb_buf.assign((const char*)(p + off), n);
         c->s.hb_stream = sid;
@@ -1151,16 +1147,10 @@ void handle_upstream_frame(Engine* e, H2Conn* c, uint8_t type,
             return;
         }
         PStream* st = it->second;
-        size_t off = 0, n = len;
-        if (flags & h2::FLAG_PADDED) {
-            if (!len) { conn_error(e, c, h2::PROTOCOL_ERROR); return; }
-            uint8_t pad = p[0];
-            if ((size_t)pad + 1 > len) {
-                conn_error(e, c, h2::PROTOCOL_ERROR);
-                return;
-            }
-            off = 1;
-            n = len - 1 - pad;
+        size_t off, n;
+        if (!strip_payload(flags, false, p, len, &off, &n)) {
+            conn_error(e, c, h2::PROTOCOL_ERROR);
+            return;
         }
         st->u_runacked += len;
         st->rsp_b += n;
@@ -1632,6 +1622,10 @@ void fph2_shutdown(void* ep) {
     ssize_t r = ::write(e->wakefd, &v, sizeof(v));
     (void)r;
     if (e->thread_started) pthread_join(e->thread, nullptr);
+    // set only after the loop thread is joined (no concurrent reader):
+    // the conn_close cascade below must not replay streams onto fresh
+    // upstream conns that would then leak
+    e->shutting_down = true;
     std::vector<H2Conn*> cs;
     for (auto& kv : e->conns) cs.push_back(kv.second);
     for (H2Conn* c : cs) conn_close(e, c);

@@ -1,0 +1,636 @@
+// h2bench: out-of-process gRPC echo server and closed-loop load
+// generator for benchmarking the h2 data plane (BASELINE config 2).
+//
+// The wrk/nginx analog for gRPC: the router under test sits between
+// `h2bench serve` (echo backend) and `h2bench load` (fixed-concurrency
+// closed-loop client), so the bench measures the ROUTER's saturation,
+// not a Python client/server stack self-measured in-process (round-3
+// VERDICT weak #6). Reuses the proxy's frame + HPACK codec (h2_core.h).
+//
+// Usage:
+//   h2bench serve <port>
+//   h2bench load <ip> <port> <authority> <concurrency> <seconds> [paysz]
+// Both print one JSON line on exit (serve: on SIGTERM/SIGINT).
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "h2_core.h"
+
+namespace {
+
+using h2::Hdr;
+
+volatile sig_atomic_t g_stop = 0;
+void on_sig(int) { g_stop = 1; }
+
+uint64_t now_us() {
+    timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (uint64_t)ts.tv_sec * 1'000'000 + ts.tv_nsec / 1000;
+}
+
+void set_nodelay(int fd) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+constexpr int64_t BIG_WIN = 64 << 20;
+
+struct Conn {
+    int fd = -1;
+    std::string in, out;
+    h2::Session s;
+    bool want_write = false;
+    // serve: per-stream request byte accumulation
+    std::unordered_map<uint32_t, std::string> req_data;
+    // load: streams in flight + completion accounting
+    std::unordered_map<uint32_t, uint64_t> start_us;
+    uint32_t next_id = 1;
+    uint64_t recv_since_grant = 0;
+};
+
+bool flush_conn(int epfd, Conn* c) {
+    while (!c->out.empty()) {
+        ssize_t n = ::send(c->fd, c->out.data(), c->out.size(),
+                           MSG_NOSIGNAL);
+        if (n > 0) {
+            c->out.erase(0, (size_t)n);
+        } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+            break;
+        } else {
+            return false;
+        }
+    }
+    bool ww = !c->out.empty();
+    if (ww != c->want_write) {
+        c->want_write = ww;
+        epoll_event ev{};
+        ev.events = EPOLLIN | (ww ? EPOLLOUT : 0);
+        ev.data.fd = c->fd;
+        epoll_ctl(epfd, EPOLL_CTL_MOD, c->fd, &ev);
+    }
+    return true;
+}
+
+void conn_grant(Conn* c) {
+    if (c->recv_since_grant > (1 << 20)) {
+        h2::write_window_update(&c->out, 0,
+                                (uint32_t)c->recv_since_grant);
+        c->recv_since_grant = 0;
+    }
+}
+
+// ---------------- serve mode ----------------
+
+struct ServeStats {
+    uint64_t requests = 0, conns = 0;
+};
+
+// gRPC-shaped echo: 200 headers, DATA = the request bytes verbatim
+// (already a gRPC-framed message), then grpc-status 0 trailers.
+void serve_respond(Conn* c, uint32_t sid, const std::string& body) {
+    std::string block;
+    c->s.enc.encode({{":status", "200"},
+                     {"content-type", "application/grpc"}},
+                    &block);
+    h2::write_frame(&c->out, h2::HEADERS, h2::FLAG_END_HEADERS, sid,
+                    block.data(), block.size());
+    size_t off = 0;
+    do {
+        size_t n = std::min(body.size() - off,
+                            (size_t)c->s.peer_max_frame);
+        h2::write_frame(&c->out, h2::DATA, 0, sid, body.data() + off, n);
+        off += n;
+    } while (off < body.size());
+    block.clear();
+    c->s.enc.encode({{"grpc-status", "0"}}, &block);
+    h2::write_frame(&c->out, h2::HEADERS,
+                    h2::FLAG_END_HEADERS | h2::FLAG_END_STREAM, sid,
+                    block.data(), block.size());
+}
+
+void serve_handle_frame(Conn* c, uint8_t type, uint8_t flags, uint32_t sid,
+                        const uint8_t* p, size_t len, ServeStats* stats) {
+    switch (type) {
+    case h2::HEADERS: {
+        size_t off = 0, n = len;
+        if (flags & h2::FLAG_PADDED) {
+            if (!len || (size_t)p[0] + 1 > len) return;  // malformed
+            off = 1;
+            n = len - 1 - p[0];
+        }
+        if (flags & h2::FLAG_PRIORITY) {
+            if (n < 5) return;
+            off += 5;
+            n -= 5;
+        }
+        std::vector<Hdr> hs;
+        c->s.dec.decode(p + off, n, &hs);  // keep HPACK state in sync
+        c->req_data[sid];                  // open the stream
+        if (flags & h2::FLAG_END_STREAM) {
+            // no body: echo empty
+            stats->requests++;
+            serve_respond(c, sid, std::string());
+            c->req_data.erase(sid);
+        }
+        break;
+    }
+    case h2::DATA: {
+        c->s.recv_unacked += len;
+        c->recv_since_grant += len;
+        auto it = c->req_data.find(sid);
+        if (it != c->req_data.end())
+            it->second.append((const char*)p, len);
+        conn_grant(c);
+        if (flags & h2::FLAG_END_STREAM && it != c->req_data.end()) {
+            stats->requests++;
+            serve_respond(c, sid, it->second);
+            c->req_data.erase(it);
+        }
+        break;
+    }
+    case h2::SETTINGS:
+        if (!(flags & h2::FLAG_ACK)) {
+            for (size_t o = 0; o + 6 <= len; o += 6) {
+                uint16_t id = (uint16_t)((p[o] << 8) | p[o + 1]);
+                uint32_t v = h2::get_u32(p + o + 2);
+                if (id == h2::S_HEADER_TABLE_SIZE)
+                    c->s.enc.set_max_table_size(v);
+                else if (id == h2::S_MAX_FRAME_SIZE && v >= 16384)
+                    c->s.peer_max_frame = v;
+            }
+            h2::write_settings(&c->out, {}, true);
+        }
+        break;
+    case h2::PING:
+        if (!(flags & h2::FLAG_ACK) && len == 8)
+            h2::write_frame(&c->out, h2::PING, h2::FLAG_ACK, 0,
+                            (const char*)p, 8);
+        break;
+    case h2::RST_STREAM:
+        c->req_data.erase(sid);
+        break;
+    default:
+        break;  // WINDOW_UPDATE/GOAWAY/PRIORITY: windows are huge, ignore
+    }
+}
+
+int run_serve(int port) {
+    int lfd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    int one = 1;
+    setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    sa.sin_port = htons((uint16_t)port);
+    if (bind(lfd, (sockaddr*)&sa, sizeof(sa)) < 0 ||
+        listen(lfd, 1024) < 0) {
+        perror("bind");
+        return 1;
+    }
+    socklen_t sl = sizeof(sa);
+    getsockname(lfd, (sockaddr*)&sa, &sl);
+    printf("{\"listening\": %d}\n", ntohs(sa.sin_port));
+    fflush(stdout);
+
+    int epfd = epoll_create1(0);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = lfd;
+    epoll_ctl(epfd, EPOLL_CTL_ADD, lfd, &ev);
+    std::unordered_map<int, Conn*> conns;
+    std::unordered_map<int, bool> preface_done;
+    ServeStats stats;
+    epoll_event evs[128];
+    while (!g_stop) {
+        int n = epoll_wait(epfd, evs, 128, 200);
+        for (int i = 0; i < n; i++) {
+            int fd = evs[i].data.fd;
+            if (fd == lfd) {
+                for (;;) {
+                    int cfd = ::accept4(lfd, nullptr, nullptr,
+                                        SOCK_NONBLOCK);
+                    if (cfd < 0) break;
+                    set_nodelay(cfd);
+                    Conn* c = new Conn();
+                    c->fd = cfd;
+                    h2::write_settings(
+                        &c->out,
+                        {{h2::S_INITIAL_WINDOW_SIZE, (uint32_t)BIG_WIN},
+                         {h2::S_MAX_FRAME_SIZE, 16384}},
+                        false);
+                    h2::write_window_update(
+                        &c->out, 0,
+                        (uint32_t)(BIG_WIN - h2::DEFAULT_WINDOW));
+                    epoll_event e2{};
+                    e2.events = EPOLLIN;
+                    e2.data.fd = cfd;
+                    epoll_ctl(epfd, EPOLL_CTL_ADD, cfd, &e2);
+                    conns[cfd] = c;
+                    stats.conns++;
+                    flush_conn(epfd, c);
+                }
+                continue;
+            }
+            auto it = conns.find(fd);
+            if (it == conns.end()) continue;
+            Conn* c = it->second;
+            bool dead = false;
+            if (evs[i].events & (EPOLLHUP | EPOLLERR)) dead = true;
+            if (!dead && (evs[i].events & EPOLLOUT))
+                dead = !flush_conn(epfd, c);
+            if (!dead && (evs[i].events & EPOLLIN)) {
+                char buf[64 * 1024];
+                for (;;) {
+                    ssize_t r = ::recv(fd, buf, sizeof(buf), 0);
+                    if (r > 0) {
+                        c->in.append(buf, (size_t)r);
+                    } else if (r < 0 && (errno == EAGAIN ||
+                                         errno == EWOULDBLOCK)) {
+                        break;
+                    } else {
+                        dead = true;
+                        break;
+                    }
+                }
+                if (!dead) {
+                    size_t pos = 0;
+                    if (!c->s.preface_seen) {
+                        if (c->in.size() < h2::PREFACE_LEN) continue;
+                        if (memcmp(c->in.data(), h2::PREFACE,
+                                   h2::PREFACE_LEN) != 0) {
+                            dead = true;
+                        } else {
+                            c->s.preface_seen = true;
+                            pos = h2::PREFACE_LEN;
+                        }
+                    }
+                    while (!dead && c->in.size() - pos >= 9) {
+                        const uint8_t* h =
+                            (const uint8_t*)c->in.data() + pos;
+                        uint32_t len = ((uint32_t)h[0] << 16) |
+                                       ((uint32_t)h[1] << 8) | h[2];
+                        if (c->in.size() - pos < 9 + (size_t)len) break;
+                        serve_handle_frame(
+                            c, h[3], h[4],
+                            h2::get_u32(h + 5) & 0x7FFFFFFF, h + 9, len,
+                            &stats);
+                        pos += 9 + (size_t)len;
+                    }
+                    if (pos) c->in.erase(0, pos);
+                    if (!dead) dead = !flush_conn(epfd, c);
+                }
+            }
+            if (dead) {
+                epoll_ctl(epfd, EPOLL_CTL_DEL, fd, nullptr);
+                ::close(fd);
+                delete c;
+                conns.erase(it);
+            }
+        }
+    }
+    fprintf(stderr,
+            "{\"served\": %llu, \"conns\": %llu}\n",
+            (unsigned long long)stats.requests,
+            (unsigned long long)stats.conns);
+    for (auto& kv : conns) {
+        ::close(kv.first);
+        delete kv.second;
+    }
+    ::close(lfd);
+    ::close(epfd);
+    return 0;
+}
+
+// ---------------- load mode ----------------
+
+struct LoadState {
+    std::string req_block_tail;  // DATA payload (gRPC-framed message)
+    std::vector<Hdr> req_hdrs;
+    uint64_t done = 0, errors = 0;
+    std::vector<uint32_t> lat_us;
+    uint64_t deadline_us = 0;
+    int inflight_target = 0;
+    int inflight = 0;
+    // open-loop pacing (rps > 0): launch on the clock, not on completion
+    bool paced = false;
+    uint64_t interval_us = 0;
+    uint64_t next_due_us = 0;
+};
+
+void launch_one(Conn* c, LoadState* ls) {
+    uint32_t sid = c->next_id;
+    c->next_id += 2;
+    std::string block;
+    c->s.enc.encode(ls->req_hdrs, &block);
+    h2::write_frame(&c->out, h2::HEADERS, h2::FLAG_END_HEADERS, sid,
+                    block.data(), block.size());
+    h2::write_frame(&c->out, h2::DATA, h2::FLAG_END_STREAM, sid,
+                    ls->req_block_tail.data(),
+                    ls->req_block_tail.size());
+    c->start_us[sid] = now_us();
+    ls->inflight++;
+}
+
+void load_launch(Conn* c, LoadState* ls) {
+    if (ls->paced) return;  // paced mode launches on the clock instead
+    while (ls->inflight < ls->inflight_target &&
+           now_us() < ls->deadline_us)
+        launch_one(c, ls);
+}
+
+void load_handle_frame(Conn* c, LoadState* ls, uint8_t type, uint8_t flags,
+                       uint32_t sid, const uint8_t* p, size_t len) {
+    switch (type) {
+    case h2::HEADERS: {
+        size_t off = 0, n = len;
+        if (flags & h2::FLAG_PADDED) {
+            if (!len || (size_t)p[0] + 1 > len) return;  // malformed
+            off = 1;
+            n = len - 1 - p[0];
+        }
+        std::vector<Hdr> hs;
+        c->s.dec.decode(p + off, n, &hs);
+        if (flags & h2::FLAG_END_STREAM) {
+            auto it = c->start_us.find(sid);
+            if (it != c->start_us.end()) {
+                bool ok = true;
+                for (auto& h : hs)
+                    if (h.first == ":status" && h.second != "200")
+                        ok = false;
+                    else if (h.first == "grpc-status" && h.second != "0")
+                        ok = false;
+                if (ok) {
+                    ls->done++;
+                    if (ls->lat_us.size() < 2'000'000)
+                        ls->lat_us.push_back(
+                            (uint32_t)(now_us() - it->second));
+                } else {
+                    ls->errors++;
+                }
+                c->start_us.erase(it);
+                ls->inflight--;
+                load_launch(c, ls);
+            }
+        }
+        break;
+    }
+    case h2::DATA:
+        c->s.recv_unacked += len;
+        c->recv_since_grant += len;
+        conn_grant(c);
+        if (flags & h2::FLAG_END_STREAM) {
+            // stream ended on DATA (non-gRPC shape); count as done
+            auto it = c->start_us.find(sid);
+            if (it != c->start_us.end()) {
+                ls->done++;
+                c->start_us.erase(it);
+                ls->inflight--;
+                load_launch(c, ls);
+            }
+        }
+        break;
+    case h2::SETTINGS:
+        if (!(flags & h2::FLAG_ACK)) {
+            for (size_t o = 0; o + 6 <= len; o += 6) {
+                uint16_t id = (uint16_t)((p[o] << 8) | p[o + 1]);
+                uint32_t v = h2::get_u32(p + o + 2);
+                if (id == h2::S_HEADER_TABLE_SIZE)
+                    c->s.enc.set_max_table_size(v);
+                else if (id == h2::S_MAX_FRAME_SIZE && v >= 16384)
+                    c->s.peer_max_frame = v;
+            }
+            h2::write_settings(&c->out, {}, true);
+        }
+        break;
+    case h2::PING:
+        if (!(flags & h2::FLAG_ACK) && len == 8)
+            h2::write_frame(&c->out, h2::PING, h2::FLAG_ACK, 0,
+                            (const char*)p, 8);
+        break;
+    case h2::RST_STREAM: {
+        auto it = c->start_us.find(sid);
+        if (it != c->start_us.end()) {
+            ls->errors++;
+            c->start_us.erase(it);
+            ls->inflight--;
+            load_launch(c, ls);
+        }
+        break;
+    }
+    default:
+        break;
+    }
+}
+
+int run_load(const char* ip, int port, const char* authority, int conc,
+             double seconds, int paysz, double rate_rps) {
+    // gRPC-framed echo message: 5-byte prefix + protobuf bytes field
+    std::string msg;
+    msg.push_back(0x0A);  // field 1, wire type 2
+    // varint length
+    {
+        unsigned v = (unsigned)paysz;
+        while (v >= 128) {
+            msg.push_back((char)((v & 0x7F) | 0x80));
+            v >>= 7;
+        }
+        msg.push_back((char)v);
+    }
+    msg.append((size_t)paysz, 'x');
+    std::string framed;
+    framed.push_back(0);
+    h2::put_u32(&framed, (uint32_t)msg.size());
+    framed += msg;
+
+    int nconns = std::max(1, conc / 16);
+    int per_conn = std::max(1, conc / nconns);
+
+    int epfd = epoll_create1(0);
+    std::unordered_map<int, Conn*> conns;
+    std::vector<LoadState> states((size_t)nconns);
+    std::unordered_map<int, size_t> conn_state;
+    uint64_t deadline = now_us() + (uint64_t)(seconds * 1e6);
+
+    for (int i = 0; i < nconns; i++) {
+        int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+        sockaddr_in sa{};
+        sa.sin_family = AF_INET;
+        sa.sin_port = htons((uint16_t)port);
+        inet_pton(AF_INET, ip, &sa.sin_addr);
+        if (::connect(fd, (sockaddr*)&sa, sizeof(sa)) < 0) {
+            perror("connect");
+            return 1;
+        }
+        set_nodelay(fd);
+        // switch to nonblocking after connect
+        int fl = fcntl(fd, F_GETFL, 0);
+        fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+        Conn* c = new Conn();
+        c->fd = fd;
+        c->out.append(h2::PREFACE, h2::PREFACE_LEN);
+        h2::write_settings(&c->out,
+                           {{h2::S_INITIAL_WINDOW_SIZE, (uint32_t)BIG_WIN},
+                            {h2::S_MAX_FRAME_SIZE, 16384}},
+                           false);
+        h2::write_window_update(&c->out, 0,
+                                (uint32_t)(BIG_WIN - h2::DEFAULT_WINDOW));
+        LoadState& ls = states[(size_t)i];
+        ls.req_block_tail = framed;
+        ls.req_hdrs = {{":method", "POST"},
+                       {":scheme", "http"},
+                       {":path", "/bench.Echo/Echo"},
+                       {":authority", authority},
+                       {"content-type", "application/grpc"},
+                       {"te", "trailers"}};
+        ls.deadline_us = deadline;
+        ls.inflight_target = per_conn;
+        if (rate_rps > 0) {
+            ls.paced = true;
+            ls.interval_us =
+                (uint64_t)(1e6 * (double)nconns / rate_rps);
+            ls.next_due_us = now_us()
+                + (uint64_t)i * ls.interval_us / (uint64_t)nconns;
+        }
+        load_launch(c, &ls);
+        epoll_event ev{};
+        ev.events = EPOLLIN | EPOLLOUT;
+        ev.data.fd = fd;
+        c->want_write = true;
+        epoll_ctl(epfd, EPOLL_CTL_ADD, fd, &ev);
+        conns[fd] = c;
+        conn_state[fd] = (size_t)i;
+    }
+
+    epoll_event evs[128];
+    uint64_t t0 = now_us();
+    for (;;) {
+        uint64_t now = now_us();
+        if (rate_rps > 0) {
+            // paced launches ride the clock; stop launching at deadline
+            for (auto& kv : conns) {
+                Conn* c = kv.second;
+                LoadState* ls = &states[conn_state[kv.first]];
+                while (now < deadline && now >= ls->next_due_us) {
+                    if (ls->inflight < 4 * ls->inflight_target + 64)
+                        launch_one(c, ls);
+                    ls->next_due_us += ls->interval_us;
+                }
+                flush_conn(epfd, c);
+            }
+        }
+        if (now >= deadline) break;
+        bool any_inflight = false;
+        for (auto& ls : states)
+            if (ls.inflight > 0) any_inflight = true;
+        if (!any_inflight && rate_rps <= 0) break;
+        int n = epoll_wait(epfd, evs, 128, rate_rps > 0 ? 1 : 100);
+        for (int i = 0; i < n; i++) {
+            int fd = evs[i].data.fd;
+            auto it = conns.find(fd);
+            if (it == conns.end()) continue;
+            Conn* c = it->second;
+            LoadState* ls = &states[conn_state[fd]];
+            bool dead = false;
+            if (evs[i].events & (EPOLLHUP | EPOLLERR)) dead = true;
+            if (!dead && (evs[i].events & EPOLLOUT))
+                dead = !flush_conn(epfd, c);
+            if (!dead && (evs[i].events & EPOLLIN)) {
+                char buf[64 * 1024];
+                for (;;) {
+                    ssize_t r = ::recv(fd, buf, sizeof(buf), 0);
+                    if (r > 0) {
+                        c->in.append(buf, (size_t)r);
+                    } else if (r < 0 && (errno == EAGAIN ||
+                                         errno == EWOULDBLOCK)) {
+                        break;
+                    } else {
+                        dead = true;
+                        break;
+                    }
+                }
+                size_t pos = 0;
+                while (!dead && c->in.size() - pos >= 9) {
+                    const uint8_t* h = (const uint8_t*)c->in.data() + pos;
+                    uint32_t len = ((uint32_t)h[0] << 16) |
+                                   ((uint32_t)h[1] << 8) | h[2];
+                    if (c->in.size() - pos < 9 + (size_t)len) break;
+                    load_handle_frame(c, ls, h[3], h[4],
+                                      h2::get_u32(h + 5) & 0x7FFFFFFF,
+                                      h + 9, len);
+                    pos += 9 + (size_t)len;
+                }
+                if (pos) c->in.erase(0, pos);
+                if (!dead) dead = !flush_conn(epfd, c);
+            }
+            if (dead) {
+                ls->errors += (uint64_t)ls->inflight;
+                ls->inflight = 0;
+                epoll_ctl(epfd, EPOLL_CTL_DEL, fd, nullptr);
+                ::close(fd);
+                delete c;
+                conns.erase(it);
+            }
+        }
+        if (conns.empty()) break;
+    }
+    double dt = (double)(now_us() - t0) / 1e6;
+    uint64_t done = 0, errors = 0;
+    std::vector<uint32_t> lat;
+    for (auto& ls : states) {
+        done += ls.done;
+        errors += ls.errors;
+        lat.insert(lat.end(), ls.lat_us.begin(), ls.lat_us.end());
+    }
+    std::sort(lat.begin(), lat.end());
+    auto pct = [&](double q) -> double {
+        if (lat.empty()) return 0.0;
+        size_t i = (size_t)(q * (double)(lat.size() - 1));
+        return (double)lat[i] / 1000.0;
+    };
+    printf("{\"reqs\": %llu, \"errors\": %llu, \"secs\": %.3f, "
+           "\"rps\": %.1f, \"p50_ms\": %.3f, \"p99_ms\": %.3f}\n",
+           (unsigned long long)done, (unsigned long long)errors, dt,
+           dt > 0 ? (double)done / dt : 0.0, pct(0.5), pct(0.99));
+    for (auto& kv : conns) {
+        ::close(kv.first);
+        delete kv.second;
+    }
+    ::close(epfd);
+    return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+    signal(SIGINT, on_sig);
+    signal(SIGTERM, on_sig);
+    signal(SIGPIPE, SIG_IGN);
+    if (argc >= 3 && strcmp(argv[1], "serve") == 0)
+        return run_serve(atoi(argv[2]));
+    if (argc >= 7 && strcmp(argv[1], "load") == 0)
+        return run_load(argv[2], atoi(argv[3]), argv[4], atoi(argv[5]),
+                        atof(argv[6]), argc > 7 ? atoi(argv[7]) : 128,
+                        argc > 8 ? atof(argv[8]) : 0.0);
+    fprintf(stderr,
+            "usage: h2bench serve <port> | h2bench load <ip> <port> "
+            "<authority> <conc> <secs> [paysz] [rate_rps]\n");
+    return 2;
+}

@@ -301,6 +301,35 @@ class TestH2FastPathLinker:
         run(go())
 
 
+class TestFastPathConfigRefusals:
+    def test_unsupported_knobs_fail_load(self, disco):
+        """fastPath must refuse config the native engine cannot honor
+        rather than silently dropping it (TLS dials, service policy,
+        h2 SETTINGS)."""
+        from linkerd_tpu.config import ConfigError
+
+        base = f"""
+routers:
+- protocol: h2
+  label: bad
+  fastPath: true
+  {{extra}}
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers: [{{{{port: 0}}}}]
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+        for extra, msg in [
+            ("maxFrameBytes: 65536", "maxFrameBytes"),
+            ("client: {tls: {commonName: x}}", "client.tls"),
+            ("service: {totalTimeoutMs: 100}", "service policy"),
+        ]:
+            with pytest.raises(ConfigError, match=msg):
+                load_linker(base.format(extra=extra))
+
+
 class TestGrpcioInterop:
     def test_grpcio_client_through_native_proxy(self):
         """grpcio's nghttp2 stack (Huffman HPACK, its own SETTINGS) must
