@@ -75,22 +75,25 @@ async def bench(duration: float, rate: float) -> dict:
     h2bench = _build_h2bench()
     serve = subprocess.Popen([h2bench, "serve", "0"],
                              stdout=subprocess.PIPE)
-    serve_port = json.loads(serve.stdout.readline())["listening"]
-
-    tmp = tempfile.TemporaryDirectory(prefix="l5d-bench2-")
-    disco = os.path.join(tmp.name, "disco")
-    os.makedirs(disco)
-    with open(os.path.join(disco, "echo"), "w") as f:
-        f.write(f"127.0.0.1 {serve_port}\n")
-
-    linker = load_linker(CONFIG.format(disco=disco))
-    await linker.start()
-    proxy_port = linker.routers[0].server_ports[0]
-    h2 = H2Client("127.0.0.1", proxy_port)
-    client = ClientDispatcher(h2, authority="echo")
-
+    # everything after the Popen must unwind through the finally, or a
+    # failed setup (missing toolchain, ConfigError) orphans the serve
+    # subprocess and the temp dir
+    tmp = linker = h2 = None
     out: dict = {"config": 2, "fastpath": True, "loadgen": "subprocess"}
     try:
+        serve_port = json.loads(serve.stdout.readline())["listening"]
+
+        tmp = tempfile.TemporaryDirectory(prefix="l5d-bench2-")
+        disco = os.path.join(tmp.name, "disco")
+        os.makedirs(disco)
+        with open(os.path.join(disco, "echo"), "w") as f:
+            f.write(f"127.0.0.1 {serve_port}\n")
+
+        linker = load_linker(CONFIG.format(disco=disco))
+        await linker.start()
+        proxy_port = linker.routers[0].server_ports[0]
+        h2 = H2Client("127.0.0.1", proxy_port)
+        client = ClientDispatcher(h2, authority="echo")
         msg = Echo(payload=b"x" * 128)
         # warm the binding + h2 connection
         await client.unary(SVC, "Echo", msg)
@@ -121,18 +124,25 @@ async def bench(duration: float, rate: float) -> dict:
         out["grpc_lat"] = lat_stats(latencies)
         out["target_rate_rps"] = rate
 
-        async def run_loadgen(*extra: str, secs: float) -> dict:
+        async def run_loadgen(*extra: str, secs: float):
+            """-> parsed result dict, or None when the loadgen failed (a
+            failed external measurement must not discard the paced
+            Python-client numbers already collected)."""
             proc = await asyncio.create_subprocess_exec(
                 h2bench, "load", "127.0.0.1", str(proxy_port), "echo",
                 "64", str(secs), "128", *extra,
                 stdout=asyncio.subprocess.PIPE)
             try:
                 stdout, _ = await asyncio.wait_for(proc.communicate(),
-                                                   secs + 30)
+                                                   secs + 40)
             except asyncio.TimeoutError:
                 proc.kill()
                 await proc.communicate()
-                raise
+                out["loadgen_error"] = "timeout"
+                return None
+            if proc.returncode != 0 or not stdout.strip():
+                out["loadgen_error"] = f"rc={proc.returncode}"
+                return None
             return json.loads(stdout)
 
         # Paced @rate from the SUBPROCESS load generator: the proxy's
@@ -147,10 +157,11 @@ async def bench(duration: float, rate: float) -> dict:
         # load generator (native/h2bench.cpp) so the number isn't
         # self-measured inside this event loop.
         sat = await run_loadgen(secs=min(4.0, duration / 2))
-        out["grpc_saturation_req_s"] = sat["rps"]
-        out["grpc_saturation_p50_ms"] = sat["p50_ms"]
-        out["grpc_saturation_p99_ms"] = sat["p99_ms"]
-        out["grpc_saturation_errors"] = sat["errors"]
+        if sat is not None:
+            out["grpc_saturation_req_s"] = sat["rps"]
+            out["grpc_saturation_p50_ms"] = sat["p50_ms"]
+            out["grpc_saturation_p99_ms"] = sat["p99_ms"]
+            out["grpc_saturation_errors"] = sat["errors"]
 
         # prometheus telemeter must expose the router's stats (fastpath
         # stats flow through the controller on a 1s poll)
@@ -158,11 +169,14 @@ async def bench(duration: float, rate: float) -> dict:
         text = prometheus_text(linker.metrics)
         out["prometheus_ok"] = ("h2bench" in text)
     finally:
-        await h2.close()
-        await linker.close()
+        if h2 is not None:
+            await h2.close()
+        if linker is not None:
+            await linker.close()
         serve.terminate()
         serve.wait()
-        tmp.cleanup()
+        if tmp is not None:
+            tmp.cleanup()
     return out
 
 

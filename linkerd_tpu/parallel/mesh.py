@@ -33,20 +33,35 @@ from linkerd_tpu.models.anomaly import (
 )
 
 
+# Per-shard hidden width below which tensor parallelism is pure
+# all-gather overhead: at MLP scale (256-wide layers) the matmul per
+# shard is microseconds while the collective latency is not — the
+# scaling-book rule that the model axis only pays when each shard still
+# saturates the MXU (round-3 BENCH: dp4xtp2 was 1.8x SLOWER than one
+# device). SURVEY.md §2.4: "no TP/PP needed at MLP scale but the design
+# should allow shard_map sharding of wide layers".
+MIN_TP_SHARD_WIDTH = 2048
+
+
 def make_mesh(
     devices: Optional[list] = None,
     tp: Optional[int] = None,
     axis_names: Tuple[str, str] = ("data", "model"),
+    model_width: Optional[int] = None,
 ) -> Mesh:
     """Build a dp x tp mesh over ``devices`` (default: all local devices).
 
-    ``tp`` defaults to 2 when the device count is even and > 1, else 1 —
-    enough to exercise both axes; callers override for real topologies.
+    ``tp`` defaults to 1 (pure data parallelism) unless ``model_width``
+    is given and wide enough that each model shard stays above
+    ``MIN_TP_SHARD_WIDTH``; callers override ``tp`` for real topologies.
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if tp is None:
-        tp = 2 if n % 2 == 0 and n > 1 else 1
+        tp = 1
+        if (model_width is not None and n % 2 == 0 and n > 1
+                and model_width // 2 >= MIN_TP_SHARD_WIDTH):
+            tp = 2
     if n % tp != 0:
         raise ValueError(f"device count {n} not divisible by tp={tp}")
     arr = np.array(devices).reshape(n // tp, tp)

@@ -453,6 +453,32 @@ struct HpackEncoder {
     }
 };
 
+// Strip PADDED (+PRIORITY for HEADERS) from a frame payload. Returns 0
+// on success or the RFC 7540 error code to fail the connection with:
+// PROTOCOL_ERROR for bad padding (§6.1/6.2), FRAME_SIZE_ERROR when the
+// frame is too small for its mandatory PRIORITY section (§4.2). Shared
+// by the proxy (both directions) and the bench tool so padding
+// validation cannot drift between copies.
+inline uint32_t strip_payload(uint8_t flags, bool headers,
+                              const uint8_t* p, size_t len, size_t* off,
+                              size_t* n) {
+    *off = 0;
+    *n = len;
+    if (flags & FLAG_PADDED) {
+        if (!len) return PROTOCOL_ERROR;
+        uint8_t pad = p[0];
+        if ((size_t)pad + 1 > len) return PROTOCOL_ERROR;
+        *off = 1;
+        *n = len - 1 - pad;
+    }
+    if (headers && (flags & FLAG_PRIORITY)) {
+        if (*n < 5) return FRAME_SIZE_ERROR;
+        *off += 5;
+        *n -= 5;
+    }
+    return 0;
+}
+
 // ---- per-connection protocol state shared by proxy & bench ----
 struct Session {
     HpackDecoder dec;

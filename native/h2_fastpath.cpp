@@ -779,28 +779,6 @@ const std::string* find_hdr(const std::vector<Hdr>& hs, const char* name) {
     return nullptr;
 }
 
-// Strip PADDED (+PRIORITY for HEADERS) from a frame payload; false =>
-// malformed (PROTOCOL_ERROR). Shared by both directions so padding
-// validation can't drift between the client and upstream handlers.
-bool strip_payload(uint8_t flags, bool headers, const uint8_t* p,
-                   size_t len, size_t* off, size_t* n) {
-    *off = 0;
-    *n = len;
-    if (flags & h2::FLAG_PADDED) {
-        if (!len) return false;
-        uint8_t pad = p[0];
-        if ((size_t)pad + 1 > len) return false;
-        *off = 1;
-        *n = len - 1 - pad;
-    }
-    if (headers && (flags & h2::FLAG_PRIORITY)) {
-        if (*n < 5) return false;
-        *off += 5;
-        *n -= 5;
-    }
-    return true;
-}
-
 void apply_settings(Engine* e, H2Conn* c, const uint8_t* p, size_t len) {
     int64_t old_init = c->s.peer_init_win;
     for (size_t off = 0; off + 6 <= len; off += 6) {
@@ -968,8 +946,9 @@ void handle_client_frame(Engine* e, H2Conn* c, uint8_t type, uint8_t flags,
     switch (type) {
     case h2::HEADERS: {
         size_t off, n;
-        if (!strip_payload(flags, true, p, len, &off, &n)) {
-            conn_error(e, c, h2::PROTOCOL_ERROR);
+        if (uint32_t err = h2::strip_payload(flags, true, p, len, &off,
+                                             &n)) {
+            conn_error(e, c, err);
             return;
         }
         c->s.hb_buf.assign((const char*)(p + off), n);
@@ -1007,8 +986,9 @@ void handle_client_frame(Engine* e, H2Conn* c, uint8_t type, uint8_t flags,
         }
         PStream* st = it->second;
         size_t off, n;
-        if (!strip_payload(flags, false, p, len, &off, &n)) {
-            conn_error(e, c, h2::PROTOCOL_ERROR);
+        if (uint32_t err = h2::strip_payload(flags, false, p, len, &off,
+                                             &n)) {
+            conn_error(e, c, err);
             return;
         }
         st->c_runacked += len;
@@ -1110,8 +1090,9 @@ void handle_upstream_frame(Engine* e, H2Conn* c, uint8_t type,
     switch (type) {
     case h2::HEADERS: {
         size_t off, n;
-        if (!strip_payload(flags, true, p, len, &off, &n)) {
-            conn_error(e, c, h2::PROTOCOL_ERROR);
+        if (uint32_t err = h2::strip_payload(flags, true, p, len, &off,
+                                             &n)) {
+            conn_error(e, c, err);
             return;
         }
         c->s.hb_buf.assign((const char*)(p + off), n);
@@ -1148,8 +1129,9 @@ void handle_upstream_frame(Engine* e, H2Conn* c, uint8_t type,
         }
         PStream* st = it->second;
         size_t off, n;
-        if (!strip_payload(flags, false, p, len, &off, &n)) {
-            conn_error(e, c, h2::PROTOCOL_ERROR);
+        if (uint32_t err = h2::strip_payload(flags, false, p, len, &off,
+                                             &n)) {
+            conn_error(e, c, err);
             return;
         }
         st->u_runacked += len;

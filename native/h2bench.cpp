@@ -130,17 +130,8 @@ void serve_handle_frame(Conn* c, uint8_t type, uint8_t flags, uint32_t sid,
                         const uint8_t* p, size_t len, ServeStats* stats) {
     switch (type) {
     case h2::HEADERS: {
-        size_t off = 0, n = len;
-        if (flags & h2::FLAG_PADDED) {
-            if (!len || (size_t)p[0] + 1 > len) return;  // malformed
-            off = 1;
-            n = len - 1 - p[0];
-        }
-        if (flags & h2::FLAG_PRIORITY) {
-            if (n < 5) return;
-            off += 5;
-            n -= 5;
-        }
+        size_t off, n;
+        if (h2::strip_payload(flags, true, p, len, &off, &n)) return;
         std::vector<Hdr> hs;
         c->s.dec.decode(p + off, n, &hs);  // keep HPACK state in sync
         c->req_data[sid];                  // open the stream
@@ -216,7 +207,6 @@ int run_serve(int port) {
     ev.data.fd = lfd;
     epoll_ctl(epfd, EPOLL_CTL_ADD, lfd, &ev);
     std::unordered_map<int, Conn*> conns;
-    std::unordered_map<int, bool> preface_done;
     ServeStats stats;
     epoll_event evs[128];
     while (!g_stop) {
@@ -360,12 +350,8 @@ void load_handle_frame(Conn* c, LoadState* ls, uint8_t type, uint8_t flags,
                        uint32_t sid, const uint8_t* p, size_t len) {
     switch (type) {
     case h2::HEADERS: {
-        size_t off = 0, n = len;
-        if (flags & h2::FLAG_PADDED) {
-            if (!len || (size_t)p[0] + 1 > len) return;  // malformed
-            off = 1;
-            n = len - 1 - p[0];
-        }
+        size_t off, n;
+        if (h2::strip_payload(flags, true, p, len, &off, &n)) return;
         std::vector<Hdr> hs;
         c->s.dec.decode(p + off, n, &hs);
         if (flags & h2::FLAG_END_STREAM) {
@@ -536,11 +522,17 @@ int run_load(const char* ip, int port, const char* authority, int conc,
                 flush_conn(epfd, c);
             }
         }
-        if (now >= deadline) break;
         bool any_inflight = false;
         for (auto& ls : states)
             if (ls.inflight > 0) any_inflight = true;
-        if (!any_inflight && rate_rps <= 0) break;
+        // past the deadline: stop launching but DRAIN in-flight requests
+        // (up to a 5s grace) so the tail isn't silently dropped from the
+        // latency/error accounting — the tail IS the p99
+        if (now >= deadline) {
+            if (!any_inflight || now >= deadline + 5'000'000) break;
+        } else if (!any_inflight && rate_rps <= 0) {
+            break;
+        }
         int n = epoll_wait(epfd, evs, 128, rate_rps > 0 ? 1 : 100);
         for (int i = 0; i < n; i++) {
             int fd = evs[i].data.fd;
@@ -596,7 +588,9 @@ int run_load(const char* ip, int port, const char* authority, int conc,
     std::vector<uint32_t> lat;
     for (auto& ls : states) {
         done += ls.done;
-        errors += ls.errors;
+        // requests still in flight after the drain grace are failures,
+        // not omissions
+        errors += ls.errors + (uint64_t)ls.inflight;
         lat.insert(lat.end(), ls.lat_us.begin(), ls.lat_us.end());
     }
     std::sort(lat.begin(), lat.end());

@@ -74,9 +74,12 @@ class TestModel:
 
     def test_training_reduces_loss(self):
         """A few steps of the real sharded train step reduce loss on a
-        fixed batch (8 virtual devices, dp=4 x tp=2)."""
-        mesh = make_mesh()
+        fixed batch (8 virtual devices; tp=2 forced to keep the
+        model-axis path covered now that make_mesh defaults pure-data
+        at this width)."""
+        mesh = make_mesh(tp=2)
         assert mesh.devices.size == 8
+        assert dict(mesh.shape) == {"data": 4, "model": 2}
         opt = optax.adam(1e-3)
         params, opt_state = init_sharded(mesh, jax.random.key(0), opt, CFG)
         step = make_train_step(mesh, opt, CFG)
@@ -91,15 +94,19 @@ class TestModel:
         assert losses[-1] < losses[0]
 
     def test_sharded_score_matches_single_device(self):
-        mesh = make_mesh()
-        params = init_params(jax.random.key(0), CFG)
-        x = jax.random.normal(jax.random.key(1), (32, FEATURE_DIM))
-        ref = anomaly_scores(params, x, CFG)
-        sharded = shard_params(mesh, params)
-        score = make_score_step(mesh, CFG)
-        got = score(sharded, x)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
-                                   atol=2e-2, rtol=2e-2)
+        # both mesh shapes: the pure-data default and forced tp=2
+        for tp in (None, 2):
+            mesh = make_mesh(tp=tp)
+            if tp is None:  # width heuristic: pure data at MLP scale
+                assert dict(mesh.shape) == {"data": 8, "model": 1}
+            params = init_params(jax.random.key(0), CFG)
+            x = jax.random.normal(jax.random.key(1), (32, FEATURE_DIM))
+            ref = anomaly_scores(params, x, CFG)
+            sharded = shard_params(mesh, params)
+            score = make_score_step(mesh, CFG)
+            got = score(sharded, x)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       atol=2e-2, rtol=2e-2)
 
     def test_trained_ae_separates_anomalies(self):
         """Autoencoder trained on 'normal' traffic scores shifted
