@@ -132,6 +132,8 @@ class FastPathController:
         self._last_stats: Dict[str, Dict[str, int]] = {}
         self._id_to_host: Dict[int, str] = {}
         self._scope = metrics.scope("rt", label, "fastpath")
+        from linkerd_tpu.models.features import DstTemporal
+        self._temporal = DstTemporal()
 
     async def start(self) -> None:
         self.engine.start()
@@ -205,9 +207,16 @@ class FastPathController:
         from linkerd_tpu.telemetry.anomaly import FeatureVector
         for row in rows:
             host = self._id_to_host.get(int(row[0]), f"fp-{int(row[0])}")
+            dst_path = f"{self.prefix.show}/{host}"
+            latency_ms = float(row[1])
+            status = int(row[2])
+            # row[5] is the engine-side timestamp: temporal deltas track
+            # when the request actually ran, not when it was drained
+            drift, err_rate, rate_delta, mesh_err = self._temporal.observe(
+                dst_path, latency_ms, status >= 500, float(row[5]))
             fv = FeatureVector(
-                latency_ms=float(row[1]),
-                status=int(row[2]),
+                latency_ms=latency_ms,
+                status=status,
                 retries=0,
                 request_bytes=int(row[3]),
                 response_bytes=int(row[4]),
@@ -215,8 +224,12 @@ class FastPathController:
                 queue_ms=0.0,
                 exception=False,
                 retryable=False,
-                dst_path=f"{self.prefix.show}/{host}",
+                dst_path=dst_path,
                 dst_rps=0.0,
+                lat_drift_ms=drift,
+                dst_err_rate=err_rate,
+                rate_delta=rate_delta,
+                mesh_err_rate=mesh_err,
             )
             for ring in rings:
                 ring.append((fv, None))

@@ -73,9 +73,11 @@ class FeatureRecorder(Filter[Request, Response]):
     best-effort, requests are never blocked)."""
 
     def __init__(self, ring: Deque, concurrency_gauge: Optional[Callable] = None):
+        from linkerd_tpu.models.features import DstTemporal
         self.ring = ring
         self._inflight = 0
         self._rps_window: Deque[float] = collections.deque(maxlen=512)
+        self._temporal = DstTemporal()
 
     async def apply(self, req: Request, service: Service) -> Response:
         t0 = time.monotonic()
@@ -96,9 +98,13 @@ class FeatureRecorder(Filter[Request, Response]):
             dst = req.ctx.get("dst")
             dst_path = dst.path.show if dst is not None else "/unidentified"
             rc = req.ctx.get("response_class")
+            status = rsp.status if rsp is not None else 0
+            is_err = exc is not None or status >= 500
+            drift, err_rate, rate_delta, mesh_err = self._temporal.observe(
+                dst_path, latency_ms, is_err, now)
             fv = FeatureVector(
                 latency_ms=latency_ms,
-                status=rsp.status if rsp is not None else 0,
+                status=status,
                 retries=int(req.ctx.get("retries", 0)),
                 # h2 messages carry streams, not bodies; size 0 there
                 request_bytes=len(getattr(req, "body", b"") or b""),
@@ -110,6 +116,10 @@ class FeatureRecorder(Filter[Request, Response]):
                 retryable=bool(getattr(rc, "is_retryable", False)),
                 dst_path=dst_path,
                 dst_rps=self._rps(now),
+                lat_drift_ms=drift,
+                dst_err_rate=err_rate,
+                rate_delta=rate_delta,
+                mesh_err_rate=mesh_err,
             )
             # label for fault-injection evaluation rides along when present:
             # from local ctx, or from the harness's response header
@@ -202,7 +212,14 @@ class InProcessScorer(Scorer):
         self._norm_initialized = False
 
     def _normalize(self, x: np.ndarray) -> np.ndarray:
-        return ((x - self._mu) / np.sqrt(self._var + 1e-6)).astype(np.float32)
+        # Variance floor 1e-2 (not 1e-6): a dim that was near-constant
+        # in training must register a real deviation as a LARGE z-score
+        # (novelty is signal — k8s-restart 5xx one-hots ride on this),
+        # but not a 1e3-sigma blowup that swamps every other dim. Hard
+        # clipping at +/-8 sigma was tried instead and cost ~0.15 AUC on
+        # the restart benchmark; the soft floor keeps the ordering.
+        z = (x - self._mu) / np.sqrt(self._var + 1e-2)
+        return z.astype(np.float32)
 
     def _update_norm(self, x: np.ndarray, labels: np.ndarray,
                      mask: np.ndarray) -> None:

@@ -331,6 +331,10 @@ struct Engine {
     // written by the loop thread, read by fp_stats_json callers: atomic
     std::atomic<uint64_t> accepted{0};
     uint64_t last_sweep_us = 0;
+    // feature timestamps are relative to engine creation:
+    // float32 seconds-since-boot quantizes to >60ms after
+    // ~12 days of uptime, breaking inter-arrival math
+    uint64_t t0_us = now_us();
 };
 
 struct Conn {
@@ -409,7 +413,7 @@ void push_feature(Engine* e, uint64_t route_id, uint64_t lat_us, int status,
     r.status = (float)status;
     r.req_bytes = (float)req_b;
     r.rsp_bytes = (float)rsp_b;
-    r.ts_s = (float)((double)now_us() / 1e6);
+    r.ts_s = (float)((double)(now_us() - e->t0_us) / 1e6);
     e->features.push_back(r);
 }
 

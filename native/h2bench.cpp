@@ -583,7 +583,12 @@ int run_load(const char* ip, int port, const char* authority, int conc,
         }
         if (conns.empty()) break;
     }
-    double dt = (double)(now_us() - t0) / 1e6;
+    // rate denominator is the offered window: the post-deadline drain
+    // adds completions (the tail) but no offered load, and must not
+    // deflate rps
+    uint64_t end = now_us();
+    if (end > deadline) end = deadline;
+    double dt = (double)(end - t0) / 1e6;
     uint64_t done = 0, errors = 0;
     std::vector<uint32_t> lat;
     for (auto& ls : states) {

@@ -8,6 +8,7 @@ import numpy as np
 import pytest
 
 from linkerd_tpu.linker import load_linker
+from linkerd_tpu.models.features import FEATURE_DIM
 from linkerd_tpu.protocol.http import Request, Response
 from linkerd_tpu.protocol.http.client import HttpClient
 from linkerd_tpu.protocol.http.server import serve
@@ -187,7 +188,7 @@ class TestGrpcSidecar:
         )
 
         # codec roundtrip
-        x = np.random.default_rng(0).standard_normal((5, 32)).astype(np.float32)
+        x = np.random.default_rng(0).standard_normal((5, FEATURE_DIM)).astype(np.float32)
         assert (decode_matrix(encode_matrix(x)) == x).all()
         labels = np.ones(5, np.float32)
         mask = np.zeros(5, np.float32)
