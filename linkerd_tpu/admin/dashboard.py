@@ -46,6 +46,7 @@ _PAGE = """<!DOCTYPE html>
  <a href="/config.json">config</a>
  <a href="/admin/metrics.json">metrics</a>
  <a href="/admin/metrics/prometheus">prometheus</a>
+ <a href="/admin/pprof/profile?seconds=3">profile</a>
 </header>
 <main>
  <div class="tiles" id="tiles"></div>

@@ -148,6 +148,93 @@ def mk_identifier_handler(linker: "Linker"):
     return handler
 
 
+# one capture of each kind at a time: cProfile refuses a second
+# concurrent enable() and tracemalloc.stop() under an active window
+# would break the other request's snapshot
+_profile_active = False
+_heap_active = False
+
+
+async def pprof_profile_handler(req: Request) -> Response:
+    """``/admin/pprof/profile?seconds=N`` — cProfile the live event-loop
+    thread for N seconds (default 3, max 60) and return the pstats text
+    sorted by cumulative time.
+
+    Ref: twitter-server's /admin/pprof/profile (inherited by the
+    reference via project/Deps.scala:10). The native engines run on
+    their own pthreads and are outside this profile — attach ``perf
+    record -t <tid>`` for those.
+    """
+    import asyncio
+    import cProfile
+    import io
+    import pstats
+
+    global _profile_active
+    q = _query(req)
+    try:
+        seconds = min(max(float(q.get("seconds", 3.0)), 0.1), 60.0)
+    except ValueError:
+        return json_response({"error": "bad seconds"}, status=400)
+    if _profile_active:
+        return json_response({"error": "a profile capture is already "
+                                       "running"}, status=409)
+    _profile_active = True
+    prof = cProfile.Profile()
+    try:
+        prof.enable()
+        try:
+            await asyncio.sleep(seconds)
+        finally:
+            prof.disable()
+    finally:
+        _profile_active = False
+    buf = io.StringIO()
+    stats = pstats.Stats(prof, stream=buf)
+    stats.sort_stats("cumulative").print_stats(80)
+    rsp = Response(status=200, body=buf.getvalue().encode())
+    rsp.headers.set("Content-Type", "text/plain; charset=utf-8")
+    return rsp
+
+
+async def pprof_heap_handler(req: Request) -> Response:
+    """``/admin/pprof/heap?seconds=N`` — tracemalloc snapshot of
+    allocations made during an N-second window (default 3, max 60),
+    top allocation sites by size."""
+    import asyncio
+    import io
+    import tracemalloc
+
+    global _heap_active
+    q = _query(req)
+    try:
+        seconds = min(max(float(q.get("seconds", 3.0)), 0.1), 60.0)
+    except ValueError:
+        return json_response({"error": "bad seconds"}, status=400)
+    if _heap_active:
+        return json_response({"error": "a heap capture is already "
+                                       "running"}, status=409)
+    _heap_active = True
+    try:
+        was_tracing = tracemalloc.is_tracing()
+        if not was_tracing:
+            tracemalloc.start()
+        try:
+            await asyncio.sleep(seconds)
+            snap = tracemalloc.take_snapshot()
+        finally:
+            if not was_tracing:
+                tracemalloc.stop()
+    finally:
+        _heap_active = False
+    buf = io.StringIO()
+    for stat in snap.statistics("lineno")[:60]:
+        buf.write(f"{stat}\n")
+    rsp = Response(status=200, body=buf.getvalue().encode())
+    rsp.headers.set("Content-Type", "text/plain; charset=utf-8")
+    return rsp
+
+
 def linkerd_admin_handlers(linker: "Linker") -> List[Tuple[str, Any]]:
     """The standard linkerd admin surface (LinkerdAdmin.apply)."""
     from linkerd_tpu.admin.dashboard import dashboard_handler
@@ -158,4 +245,6 @@ def linkerd_admin_handlers(linker: "Linker") -> List[Tuple[str, Any]]:
         ("/anomaly.json", mk_anomaly_handler(linker)),
         ("/identifier.json", mk_identifier_handler(linker)),
         ("/logging.json", logging_handler),
+        ("/admin/pprof/profile", pprof_profile_handler),
+        ("/admin/pprof/heap", pprof_heap_handler),
     ]

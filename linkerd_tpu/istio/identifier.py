@@ -185,6 +185,169 @@ class IstioIdentifierConfig:
         return identify
 
 
+class IstioIngressLogic:
+    """Istio traffic routed through a k8s Ingress resource: the fusion of
+    the ingress-rule match (annotation class ``istio``) with the istio
+    route-rule machinery (ref IstioIngressIdentifier.scala:1-128 and its
+    h2 twin).
+
+    Flow: ingress (host, path) match -> backend svc/namespace/port ->
+    cluster name ``<svc>.<ns>.svc.cluster.local``; a NUMERIC ingress port
+    resolves to its istio port NAME via the cluster cache (RDS domains
+    carry ``cluster:portNumber``); route rules for the cluster then
+    redirect / rewrite+route / fall through to the label-less dest path
+    exactly like the plain istio identifier."""
+
+    def __init__(self, ingress, cluster_cache: ClusterCache,
+                 route_cache: RouteCache, prefix: Path, base_dtab: Dtab):
+        self.ingress = ingress
+        self.clusters = cluster_cache
+        self.routes = route_cache
+        self.prefix = prefix
+        self.base_dtab = base_dtab
+
+    async def identify(self, meta: RequestMeta, local_dtab: Dtab,
+                       apply_rewrite: Callable[[str, Optional[str]], None],
+                       mk_redirect: Callable[[str, str], object]):
+        import asyncio
+        host = meta.authority.split(":", 1)[0].lower() or None
+        uri = meta.uri.split("?", 1)[0]
+        m = await asyncio.wait_for(self.ingress.match_path(host, uri), 30.0)
+        if m is None:
+            raise IdentificationError(
+                f"no ingress rule matches {meta.authority}:{meta.uri}")
+        cluster = f"{m.svc}.{m.namespace}.svc.cluster.local"
+        port = str(m.port)
+        if port.isdigit():
+            # numeric ingress port -> istio port name via RDS domains
+            c = await self.clusters.get(f"{cluster}:{port}")
+            if c is None:
+                raise IdentificationError(
+                    f"ingress path {m.svc}:{m.port} does not match any "
+                    f"istio vhosts")
+            port = c.port
+        rules = await self.routes.get_rules()
+        best = max_precedence(filter_rules(rules, cluster, meta))
+        if best is None:
+            # no matching rule: forward to an empty label selector
+            path = self.prefix + Path.of("dest", cluster, "::", port)
+            return DstPath(path, self.base_dtab, local_dtab)
+        name, rule = best
+        if rule.is_redirect:
+            return mk_redirect(rule.redirect_uri or meta.uri,
+                               rule.redirect_authority or meta.authority)
+        new_uri, authority = http_rewrite(rule, meta)
+        apply_rewrite(new_uri, authority)
+        path = self.prefix + Path.of("route", name, port)
+        return DstPath(path, self.base_dtab, local_dtab)
+
+
+@dataclass
+class _IstioIngressBase:
+    """Shared config/assembly for the http + h2 istio-ingress kinds."""
+
+    # k8s apiserver (ingress watch)
+    host: str = "localhost"
+    port: int = 8001
+    namespace: Optional[str] = None
+    apiPrefix: str = "/apis/extensions/v1beta1"
+    useTls: bool = False
+    caCertPath: Optional[str] = None
+    insecureSkipVerify: bool = False
+    # istio pilot (route rules + RDS discovery)
+    apiserverHost: str = "istio-pilot"
+    apiserverPort: int = 8081
+    discoveryHost: Optional[str] = None  # default: apiserverHost
+    discoveryPort: int = 8080
+    pollIntervalMs: int = 5000
+
+    def _mk_logic(self, prefix: Path, base_dtab: Dtab) -> IstioIngressLogic:
+        from linkerd_tpu.k8s.ingress import IngressCache
+        from linkerd_tpu.k8s.namer import _mk_api
+
+        ingress = IngressCache(
+            _mk_api(self.host, self.port, self.useTls, self.caCertPath,
+                    self.insecureSkipVerify),
+            self.namespace, annotation_class="istio",
+            api_prefix=self.apiPrefix).start()
+        interval = self.pollIntervalMs / 1e3
+        discovery = DiscoveryClient(self.discoveryHost or self.apiserverHost,
+                                    self.discoveryPort, interval=interval)
+        apiserver = ApiserverClient(self.apiserverHost, self.apiserverPort,
+                                    interval=interval)
+        return IstioIngressLogic(ingress, ClusterCache(discovery),
+                                 RouteCache(apiserver), prefix, base_dtab)
+
+
+@register("identifier", "io.l5d.k8s.istio-ingress")
+@dataclass
+class IstioIngressIdentifierConfig(_IstioIngressBase):
+    """HTTP istio-ingress identifier (kind ``io.l5d.k8s.istio-ingress``,
+    ref IstioIngressIdentifier.scala)."""
+
+    def mk(self, prefix: Path, base_dtab: Dtab):
+        from linkerd_tpu.protocol.http.message import Request, Response
+
+        logic = self._mk_logic(prefix, base_dtab)
+
+        async def identify(req: Request):
+            meta = RequestMeta(
+                uri=req.uri, scheme="http", method=req.method,
+                authority=req.host or "", get_header=req.headers.get)
+
+            def apply_rewrite(uri: str, authority: Optional[str]) -> None:
+                req.uri = uri
+                if authority is not None:
+                    req.headers.set("Host", authority)
+
+            def mk_redirect(uri: str, authority: str) -> Response:
+                rsp = Response(status=302)
+                rsp.headers.set("Location", f"http://{authority}{uri}")
+                return rsp
+
+            return await logic.identify(
+                meta, parse_local_dtab(req), apply_rewrite, mk_redirect)
+
+        return identify
+
+
+@register("h2identifier", "io.l5d.k8s.istio-ingress")
+@dataclass
+class IstioIngressH2IdentifierConfig(_IstioIngressBase):
+    """h2 istio-ingress identifier (ref the h2 IstioIngressIdentifier
+    twin)."""
+
+    def mk(self, prefix: Path, base_dtab: Dtab):
+        from linkerd_tpu.protocol.h2.messages import H2Request, H2Response
+
+        logic = self._mk_logic(prefix, base_dtab)
+
+        async def identify(req: H2Request):
+            meta = RequestMeta(
+                uri=req.path, scheme=req.scheme or "http",
+                method=req.method, authority=req.authority or "",
+                get_header=req.headers.get)
+
+            def apply_rewrite(uri: str, authority: Optional[str]) -> None:
+                req.path = uri
+                if authority is not None:
+                    req.authority = authority
+
+            def mk_redirect(uri: str, authority: str) -> H2Response:
+                rsp = H2Response(status=302)
+                rsp.headers.set("location", f"http://{authority}{uri}")
+                return rsp
+
+            local = Dtab.empty()
+            raw = req.headers.get_all("l5d-dtab")
+            if raw:
+                local = Dtab.read(";".join(raw))
+            return await logic.identify(
+                meta, local, apply_rewrite, mk_redirect)
+
+        return identify
+
+
 @register("h2identifier", "io.l5d.k8s.istio")
 @dataclass
 class IstioH2IdentifierConfig:

@@ -207,6 +207,10 @@ class RouterSpec:
     # trace ids + dtab overrides ride thrift hops
     # (ref: ThriftInitializer.scala attemptTTwitterUpgrade)
     attemptTTwitterUpgrade: bool = True
+    # thrift only: transport framing + protocol
+    # (ref: ThriftInitializer.scala:47,68-72 thriftProtocol/thriftFramed)
+    thriftFramed: bool = True
+    thriftProtocol: str = "binary"  # binary | compact
     # http only: per-request logger plugin chain in the client stack
     # (ref: HttpLoggerConfig.scala loggers param; kinds under
     # protocol/http/loggers.py)
@@ -749,6 +753,19 @@ class Linker:
 
         MuxStatsFilter = BasicStatsFilter
 
+        class _MuxEncodeResidual(Filter):
+            """The downstream Tdispatch carries the BOUND residual path
+            as its dest — not the client-sent logical dest — and no dtab
+            (the local dtab was consumed during binding; re-sending it
+            would double-apply). Ref: MuxEncodeResidual.scala:1-18."""
+
+            def __init__(self, residual: Path):
+                self._dest = residual.show if len(residual) else "/"
+
+            async def apply(self, td: Tdispatch, service: Service):
+                return await service(Tdispatch(
+                    td.tag, td.contexts, self._dest, [], td.payload))
+
         def client_factory(bound: BoundName) -> Service:
             if _status_code_of(bound) is not None:
                 raise ConfigError(
@@ -774,6 +791,14 @@ class Linker:
                         metrics.scope("rt", label, "client", cid))], bal),
                 metrics, ("rt", label, "client", cid))
 
+        def bound_filters(bound: BoundName, svc: Service) -> Service:
+            # the BOUND layer is keyed by (id, residual) — the client
+            # layer below is shared across residuals, so the rewrite
+            # must happen here (ref: Router.scala boundStack placement)
+            if thrift_semantics:
+                return svc
+            return _MuxEncodeResidual(bound.residual).and_then(svc)
+
         svc_lookup = per_prefix_lookup(
             rspec.service, SvcSpec, f"{label}.service")
 
@@ -789,6 +814,7 @@ class Linker:
         cache_cfg = rspec.bindingCache or {}
         binding = DstBindingFactory(
             interpreter, client_factory, path_filters=path_filters,
+            bound_filters=bound_filters,
             capacity=int(cache_cfg.get("capacity", 1000)),
             idle_ttl=float(cache_cfg.get("idleTtlSecs", 600.0)),
             bind_timeout=rspec.bindingTimeoutMs / 1e3)
@@ -824,6 +850,16 @@ class Linker:
                     f"{label}.servers[{i}].clearContext: "
                     f"not supported for thrift servers")
 
+        if rspec.thriftProtocol not in ("binary", "compact"):
+            raise ConfigError(
+                f"{label}.thriftProtocol must be binary or compact, "
+                f"got {rspec.thriftProtocol!r}")
+        if not rspec.thriftFramed and rspec.thriftProtocol != "binary":
+            raise ConfigError(
+                f"{label}: thriftFramed: false requires "
+                f"thriftProtocol: binary (the buffered transport scans "
+                f"binary-protocol message boundaries)")
+
         base_dtab = Dtab.read(rspec.dtab) if rspec.dtab else Dtab.empty()
         prefix = Path.read(rspec.dstPrefix)
         method_in_dst = rspec.thriftMethodInDst
@@ -844,15 +880,14 @@ class Linker:
 
         def thrift_classifier(req, rsp, exc):
             from linkerd_tpu.router.classifiers import ResponseClass
-            from linkerd_tpu.protocol.thrift.codec import (
-                parse_message_header,
-            )
+            from linkerd_tpu.protocol.thrift.codec import parse_header
             if exc is not None:
                 return ResponseClass.RETRYABLE_FAILURE \
                     if isinstance(exc, ConnectionError) \
                     else ResponseClass.FAILURE
             try:
-                _, _, mtype = parse_message_header(rsp or b"")
+                _, _, mtype = parse_header(rsp or b"",
+                                           rspec.thriftProtocol)
                 if mtype == EXCEPTION:
                     return ResponseClass.FAILURE
             except Exception:  # noqa: BLE001 - unparseable: assume ok
@@ -880,7 +915,9 @@ class Linker:
                     addr.host, addr.port,
                     connect_timeout=cspec.connectTimeoutMs / 1e3,
                     attempt_ttwitter=rspec.attemptTTwitterUpgrade,
-                    dest=bound.id_.show, client_id=label)
+                    dest=bound.id_.show, client_id=label,
+                    framed=rspec.thriftFramed,
+                    protocol=rspec.thriftProtocol)
                 return FailureAccrualService(client, mk_policy())
 
             bal_kind = (cspec.loadBalancer or BalancerSpec()).kind
@@ -927,7 +964,9 @@ class Linker:
             routing)
         servers = [
             ThriftServer(server_stack, s.ip, s.port,
-                         ttwitter=rspec.attemptTTwitterUpgrade)
+                         ttwitter=rspec.attemptTTwitterUpgrade,
+                         framed=rspec.thriftFramed,
+                         protocol=rspec.thriftProtocol)
             for s in (rspec.servers or [ServerSpec()])
         ]
         return Router(rspec, label, server_stack, binding, servers,

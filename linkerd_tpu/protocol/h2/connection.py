@@ -76,11 +76,19 @@ class H2Connection:
                  initial_window: int = LOCAL_INITIAL_WINDOW,
                  max_frame: int = DEFAULT_MAX_FRAME_SIZE,
                  max_header_list: int = MAX_HEADER_LIST,
-                 max_concurrent_streams: Optional[int] = None):
+                 max_concurrent_streams: Optional[int] = None,
+                 preface_consumed: bool = False,
+                 initial_data: bytes = b""):
         self._reader = reader
         self._writer = writer
         self.is_client = is_client
         self._handler = handler
+        # server side: the listener already consumed the client preface
+        # while sniffing prior-knowledge h2c vs an h1 Upgrade
+        # (ref: ServerUpgradeHandler.scala channelRead); bytes it
+        # over-read past the preface seed the frame loop
+        self._preface_consumed = preface_consumed
+        self._initial_data = initial_data
         # advertised SETTINGS (ref: finagle/h2 param.scala — configurable
         # per router via initialStreamWindowBytes/maxFrameBytes/
         # maxHeaderListBytes/maxConcurrentStreamsPerConnection)
@@ -162,7 +170,7 @@ class H2Connection:
         if self.is_client:
             self._write(CONNECTION_PREFACE)
             settings.append((frames.SETTINGS_ENABLE_PUSH, 0))
-        else:
+        elif not self._preface_consumed:
             preface = await self._reader.readexactly(len(CONNECTION_PREFACE))
             if preface != CONNECTION_PREFACE:
                 raise H2ProtocolError(frames.PROTOCOL_ERROR, "bad preface")
@@ -407,15 +415,23 @@ class H2Connection:
         # the buffer — two readexactly() awaits per frame becomes one
         # read() per TCP burst.
         read = self._reader.read
-        buf = bytearray()
+        buf = bytearray(self._initial_data)
+        self._initial_data = b""
+        # seeded bytes must be processed BEFORE the first read: waiting
+        # for more transport data while the peer's SETTINGS already sit
+        # in the buffer would deadlock the handshake
+        skip_read = bool(buf)
         FrameHeader = frames.FrameHeader
         CONTINUATION = frames.CONTINUATION
         try:
             while not self._closed:
-                chunk = await read(READ_CHUNK)
-                if not chunk:
-                    raise EOFError("connection closed by peer")
-                buf += chunk
+                if skip_read:
+                    skip_read = False
+                else:
+                    chunk = await read(READ_CHUNK)
+                    if not chunk:
+                        raise EOFError("connection closed by peer")
+                    buf += chunk
                 pos = 0
                 n = len(buf)
                 while n - pos >= 9:
@@ -707,6 +723,32 @@ class H2Connection:
             if not w.done():
                 w.set_result(None)
                 break
+
+    def adopt_upgraded_request(self, req: H2Request,
+                               body: bytes = b"") -> None:
+        """RFC 7540 §3.2: after a 101 Switching Protocols, the HTTP/1.1
+        request that carried ``Upgrade: h2c`` becomes stream 1,
+        half-closed (remote); its response goes out as h2 frames on
+        stream 1 (ref: Netty's Http2FrameCodec server upgrade path wired
+        by ServerUpgradeHandler.scala:38-41)."""
+        st = _StreamState(1, self._peer_initial_window,
+                          self._local_initial_window)
+        st.got_headers = True
+        self._streams[1] = st
+        self._last_peer_stream = max(self._last_peer_stream, 1)
+        req.stream = st.recv_stream
+        st.recv_stream.offer(DataFrame(body, eos=True))
+        st.recv_closed = True
+        task = asyncio.get_running_loop().create_task(
+            self._serve_stream(st, req))
+        self._handler_tasks.add(task)
+        task.add_done_callback(self._handler_tasks.discard)
+
+    def apply_upgrade_settings(self, payload: bytes) -> None:
+        """Apply the decoded HTTP2-Settings header payload (the client's
+        SETTINGS, carried in the h1 upgrade request) before any h2 frame
+        arrives (RFC 7540 §3.2.1)."""
+        self._apply_settings(frames.unpack_settings(payload))
 
     def _apply_settings(self, settings: List[Tuple[int, int]]) -> None:
         for key, value in settings:

@@ -20,16 +20,22 @@ log = logging.getLogger(__name__)
 class ThriftClient(Service[ThriftCall, Optional[bytes]]):
     def __init__(self, host: str, port: int, connect_timeout: float = 3.0,
                  attempt_ttwitter: bool = False, dest: str = "",
-                 client_id: str = ""):
+                 client_id: str = "", framed: bool = True,
+                 protocol: str = "binary"):
         self.host = host
         self.port = port
         self.connect_timeout = connect_timeout
         # Negotiate the TTwitter upgrade on connect; on success every
         # request carries a RequestHeader with trace + dtab context
-        # (ref: TTwitterClientFilter, attemptTTwitterUpgrade)
-        self.attempt_ttwitter = attempt_ttwitter
+        # (ref: TTwitterClientFilter, attemptTTwitterUpgrade). The
+        # upgrade protocol is framed-only.
+        self.framed = framed
+        self.protocol = protocol
+        self.attempt_ttwitter = (attempt_ttwitter and framed
+                                 and protocol == "binary")
         self.dest = dest
         self.client_id = client_id
+        self._unframed_reader = None  # lazy UnframedReader (buffered)
         self._upgraded = False
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
@@ -47,6 +53,9 @@ class ThriftClient(Service[ThriftCall, Optional[bytes]]):
             self._reader, self._writer = await asyncio.wait_for(
                 asyncio.open_connection(self.host, self.port),
                 self.connect_timeout)
+            if not self.framed:
+                from linkerd_tpu.protocol.thrift.codec import UnframedReader
+                self._unframed_reader = UnframedReader(self._reader)
             if self.attempt_ttwitter:
                 await self._try_upgrade()
 
@@ -91,11 +100,16 @@ class ThriftClient(Service[ThriftCall, Optional[bytes]]):
                 payload = (self._wrap_request(call) if self._upgraded
                            else call.payload)
                 try:
-                    write_framed(self._writer, payload)
+                    if self.framed:
+                        write_framed(self._writer, payload)
+                    else:
+                        self._writer.write(payload)
                     await self._writer.drain()
                     if call.oneway:
                         return None
-                    reply = await read_framed(self._reader)
+                    reply = (await read_framed(self._reader)
+                             if self.framed else
+                             await self._unframed_reader.read_message())
                 except (ConnectionResetError, BrokenPipeError,
                         asyncio.IncompleteReadError) as e:
                     self._teardown()
@@ -122,9 +136,9 @@ class ThriftClient(Service[ThriftCall, Optional[bytes]]):
                 # caller A's payload to caller B).
                 try:
                     from linkerd_tpu.protocol.thrift.codec import (
-                        parse_message_header,
+                        parse_header,
                     )
-                    _, seqid, _ = parse_message_header(reply)
+                    _, seqid, _ = parse_header(reply, self.protocol)
                 except Exception:  # noqa: BLE001 - unparseable reply
                     self._teardown()
                     raise ConnectionError("unparseable thrift reply")
@@ -143,7 +157,7 @@ class ThriftClient(Service[ThriftCall, Optional[bytes]]):
                 self._writer.close()
             except Exception:  # noqa: BLE001
                 pass
-        self._reader = self._writer = None
+        self._reader = self._writer = self._unframed_reader = None
 
     async def close(self) -> None:
         self._closed = True

@@ -1,8 +1,12 @@
-"""Framed-thrift server: per-connection sequential dispatch.
+"""Thrift server: framed or buffered transport, binary or compact
+protocol, pipelined per-connection dispatch.
 
-Ref: finagle-thrift server semantics as used by router/thrift — one
-request at a time per connection (thrift framed transport is not
-multiplexed), responses matched by seqid.
+Ref: finagle-thrift server semantics as used by router/thrift —
+requests on one connection dispatch CONCURRENTLY (finagle pipelines
+thrift), with responses written back in request order so plain Apache
+clients (which match replies positionally, not by seqid) stay correct.
+Transport/protocol knobs per ThriftInitializer.scala:47,68-72
+(``thriftProtocol``, ``thriftFramed``).
 """
 
 from __future__ import annotations
@@ -12,8 +16,8 @@ import logging
 from typing import Optional
 
 from linkerd_tpu.protocol.thrift.codec import (
-    ThriftCall, encode_exception, parse_message_header, read_framed,
-    write_framed,
+    ThriftCall, UnframedReader, encode_exception, encode_exception_for,
+    parse_header, read_framed, write_framed,
 )
 from linkerd_tpu.router.service import Service
 
@@ -27,13 +31,23 @@ log = logging.getLogger(__name__)
 class ThriftServer:
     def __init__(self, service: Service[ThriftCall, Optional[bytes]],
                  host: str = "127.0.0.1", port: int = 0,
-                 ttwitter: bool = True):
+                 ttwitter: bool = True, framed: bool = True,
+                 protocol: str = "binary", max_pipelined: int = 32):
         self.service = service
         self.host = host
         self.port = port
         # answer TTwitter upgrade requests; upgraded connections carry
-        # RequestHeader/ResponseHeader framing (ref: TTwitterServerFilter)
-        self.ttwitter = ttwitter
+        # RequestHeader/ResponseHeader framing (ref: TTwitterServerFilter).
+        # The upgrade protocol itself is framed-binary only.
+        self.ttwitter = ttwitter and framed and protocol == "binary"
+        self.framed = framed
+        self.protocol = protocol
+        if protocol not in ("binary", "compact"):
+            raise ValueError(f"unknown thrift protocol {protocol!r}")
+        if not framed and protocol != "binary":
+            raise ValueError("buffered transport requires the binary "
+                             "protocol (message-boundary scan)")
+        self.max_pipelined = max_pipelined
         self._server: Optional[asyncio.base_events.Server] = None
         self._conns: set = set()
         self._conn_tasks: set = set()
@@ -69,9 +83,60 @@ class ThriftServer:
             self._conn_tasks.add(task)
             task.add_done_callback(self._conn_tasks.discard)
         upgraded = False  # per-connection TTwitter state
+        unframed = (UnframedReader(reader) if not self.framed else None)
+        # pipelining: requests dispatch concurrently (bounded); replies
+        # are written in REQUEST order via an ordered queue of futures so
+        # positional (non-seqid) clients stay correct
+        sem = asyncio.Semaphore(self.max_pipelined)
+        reply_q: asyncio.Queue = asyncio.Queue()
+        pending_tasks: set = set()
+
+        def send(reply: bytes) -> None:
+            if self.framed:
+                write_framed(writer, reply)
+            else:
+                writer.write(reply)
+
+        async def write_loop() -> None:
+            try:
+                while True:
+                    fut = await reply_q.get()
+                    if fut is None:
+                        return
+                    reply = await fut
+                    if reply is not None:
+                        send(reply)
+                        await writer.drain()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — write side gone: kill the
+                # conn so the read loop unwinds instead of stalling
+                try:
+                    writer.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        async def run_one(call: ThriftCall, was_upgraded: bool) -> Optional[bytes]:
+            async with sem:
+                try:
+                    reply = await self.service(call)
+                except Exception as e:  # noqa: BLE001 -> thrift exception
+                    # encode in the CONNECTION's protocol: a binary-
+                    # encoded exception desyncs compact clients
+                    reply = encode_exception_for(
+                        self.protocol, call.name, call.seqid, repr(e))
+                if call.oneway or reply is None:
+                    return None
+                if was_upgraded:
+                    from linkerd_tpu.protocol.thrift import ttwitter as ttw
+                    reply = ttw.prepend_struct(ttw.TResponseHeader(), reply)
+                return reply
+
+        writer_task = asyncio.get_running_loop().create_task(write_loop())
         try:
             while True:
-                payload = await read_framed(reader)
+                payload = (await read_framed(reader) if self.framed
+                           else await unframed.read_message())
                 if payload is None:
                     return
                 ctx: dict = {}
@@ -92,40 +157,44 @@ class ThriftServer:
                     if header.client_id is not None:
                         ctx["clientId"] = header.client_id.name
                 try:
-                    name, seqid, mtype = parse_message_header(payload)
+                    name, seqid, mtype = parse_header(payload,
+                                                      self.protocol)
                 except Exception as e:  # noqa: BLE001 - bad frame: drop conn
                     log.debug("bad thrift frame: %s", e)
                     return
-                if not upgraded and mtype == 1 and name == _CAN_TRACE:
+                if not upgraded and mtype == 1 and name == _CAN_TRACE \
+                        and self.framed and self.protocol == "binary":
                     if self.ttwitter:
                         from linkerd_tpu.protocol.thrift import (
                             ttwitter as ttw,
                         )
                         upgraded = True
-                        write_framed(writer,
-                                     ttw.encode_upgrade_reply(seqid))
+                        probe_reply = ttw.encode_upgrade_reply(seqid)
                     else:
                         # never forward the probe downstream: a REPLY from
                         # there would desync BOTH hops. Answer like any
                         # plain thrift server (unknown method).
-                        write_framed(writer, encode_exception(
-                            name, seqid, "Invalid method name"))
-                    await writer.drain()
+                        probe_reply = encode_exception(
+                            name, seqid, "Invalid method name")
+                    # ride the ordered reply queue: a direct write would
+                    # overtake replies still pending for earlier
+                    # pipelined requests (positional clients pair
+                    # replies by order, not seqid)
+                    fut = asyncio.get_running_loop().create_future()
+                    fut.set_result(probe_reply)
+                    reply_q.put_nowait(fut)
                     continue
                 call = ThriftCall(payload, name, seqid, mtype, ctx=ctx)
-                try:
-                    reply = await self.service(call)
-                except Exception as e:  # noqa: BLE001 -> thrift exception
-                    reply = encode_exception(name, seqid, repr(e))
-                if not call.oneway and reply is not None:
-                    if upgraded:
-                        from linkerd_tpu.protocol.thrift import (
-                            ttwitter as ttw,
-                        )
-                        reply = ttw.prepend_struct(
-                            ttw.TResponseHeader(), reply)
-                    write_framed(writer, reply)
-                    await writer.drain()
+                task = asyncio.get_running_loop().create_task(
+                    run_one(call, upgraded))
+                pending_tasks.add(task)
+                task.add_done_callback(pending_tasks.discard)
+                if not call.oneway:
+                    reply_q.put_nowait(task)
+                # backpressure: don't read unboundedly ahead of dispatch
+                if sem.locked():
+                    async with sem:
+                        pass
         except (ConnectionResetError, BrokenPipeError,
                 asyncio.IncompleteReadError):
             pass
@@ -134,6 +203,14 @@ class ThriftServer:
         except Exception:  # noqa: BLE001
             log.exception("thrift connection handler error")
         finally:
+            # drain in-flight replies (bounded), then stop the writer
+            try:
+                reply_q.put_nowait(None)
+                await asyncio.wait_for(writer_task, 5.0)
+            except (asyncio.TimeoutError, Exception):  # noqa: BLE001
+                writer_task.cancel()
+            for t in list(pending_tasks):
+                t.cancel()
             self._conns.discard(writer)
             try:
                 writer.close()

@@ -86,3 +86,82 @@ namers:
         await linker.close()
 
     asyncio.run(asyncio.wait_for(go(), 30))
+
+
+class TestPprofHandlers:
+    def test_profile_and_heap_capture(self):
+        """/admin/pprof/profile + /heap return text captures of the live
+        process (ref: twitter-server's /admin/pprof via Deps.scala:10)."""
+        from linkerd_tpu.admin.handlers import (
+            pprof_heap_handler, pprof_profile_handler,
+        )
+
+        async def go():
+            async def busywork():
+                # something for the profiler to see
+                for _ in range(50):
+                    json.dumps({"x": list(range(100))})
+                    await asyncio.sleep(0)
+
+            task = asyncio.ensure_future(busywork())
+            rsp = await pprof_profile_handler(
+                Request(uri="/admin/pprof/profile?seconds=0.2"))
+            await task
+            assert rsp.status == 200
+            text = rsp.body.decode()
+            assert "cumulative" in text  # pstats table header
+            assert "sleep" in text or "json" in text
+
+            rsp = await pprof_heap_handler(
+                Request(uri="/admin/pprof/heap?seconds=0.1"))
+            assert rsp.status == 200
+
+            bad = await pprof_profile_handler(
+                Request(uri="/admin/pprof/profile?seconds=nope"))
+            assert bad.status == 400
+
+        run(go())
+
+    def test_linked_from_admin_surface(self, tmp_path):
+        """The handlers are wired into the assembled admin server."""
+        from linkerd_tpu.linker import load_linker
+
+        async def go():
+            disco = tmp_path / "disco"
+            disco.mkdir()
+            (disco / "x").write_text("127.0.0.1 1\n")
+            cfg = f"""
+admin: {{port: 0}}
+routers:
+- protocol: http
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers: [{{port: 0}}]
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+            from linkerd_tpu.admin.handlers import linkerd_admin_handlers
+            from linkerd_tpu.admin.server import AdminServer
+
+            linker = load_linker(cfg)
+            await linker.start()
+            # assemble the admin surface the way __main__ does
+            admin = AdminServer(linker.metrics, {}, port=0)
+            admin.add_handlers(linkerd_admin_handlers(linker))
+            await admin.start()
+            client = HttpClient("127.0.0.1", admin.bound_port)
+            try:
+                rsp = await client(Request(
+                    uri="/admin/pprof/profile?seconds=0.1"))
+                assert rsp.status == 200
+                assert b"function calls" in rsp.body
+                # dashboard nav links to it
+                dash = await client(Request(uri="/"))
+                assert b"/admin/pprof/profile" in dash.body
+            finally:
+                await client.close()
+                await admin.close()
+                await linker.close()
+
+        run(go())

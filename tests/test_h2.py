@@ -389,3 +389,206 @@ class TestClientReconnect:
                 await server.close()
 
         run(go())
+
+
+class TestH1ToH2cUpgrade:
+    """RFC 7540 §3.2 server-side upgrade: an HTTP/1.1 client sending
+    ``Upgrade: h2c`` + HTTP2-Settings on the h2 port gets 101 and its
+    request served as h2 stream 1 (ref ServerUpgradeHandler.scala:1-70)."""
+
+    @staticmethod
+    async def _h1_upgrade_exchange(port: int, host_hdr: str):
+        """Raw curl-style client: upgrade, then read the h2 response for
+        stream 1. -> (status, body, trailers_or_None)."""
+        from linkerd_tpu.protocol.h2 import frames
+
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        try:
+            import base64
+            settings = base64.urlsafe_b64encode(
+                b"").decode()  # empty SETTINGS payload is legal
+            writer.write(
+                (f"GET /up HTTP/1.1\r\nHost: {host_hdr}\r\n"
+                 f"Connection: Upgrade, HTTP2-Settings\r\n"
+                 f"Upgrade: h2c\r\nHTTP2-Settings: {settings}\r\n"
+                 f"\r\n").encode())
+            await writer.drain()
+            status_line = await reader.readline()
+            assert b"101" in status_line, status_line
+            while (await reader.readline()) not in (b"\r\n", b""):
+                pass
+            # now h2: client preface + SETTINGS
+            writer.write(frames.CONNECTION_PREFACE)
+            writer.write(frames.pack_settings([]))
+            await writer.drain()
+
+            dec = hpack.Decoder()
+            status = None
+            body = b""
+            trailers = None
+            while True:
+                head = await reader.readexactly(9)
+                fh = frames.unpack_header(head)
+                payload = (await reader.readexactly(fh.length)
+                           if fh.length else b"")
+                if fh.type == frames.SETTINGS:
+                    if not (fh.flags & frames.FLAG_ACK):
+                        writer.write(frames.pack_settings([], ack=True))
+                        await writer.drain()
+                elif fh.type == frames.HEADERS:
+                    hdrs = dec.decode(frames.strip_padding(fh.flags,
+                                                           payload))
+                    if status is None:
+                        status = int(next(v for n, v in hdrs
+                                          if n == ":status"))
+                    else:
+                        trailers = hdrs
+                    if fh.flags & frames.FLAG_END_STREAM:
+                        return status, body, trailers
+                elif fh.type == frames.DATA:
+                    body += frames.strip_padding(fh.flags, payload)
+                    if fh.flags & frames.FLAG_END_STREAM:
+                        return status, body, trailers
+                elif fh.type == frames.GOAWAY:
+                    raise AssertionError(f"goaway: {payload!r}")
+        finally:
+            writer.close()
+
+    def test_upgrade_direct_server(self):
+        async def go():
+            async def handler(req: H2Request) -> H2Response:
+                body, _ = await req.stream.read_all()
+                return H2Response(
+                    status=200,
+                    body=f"{req.method} {req.path} a={req.authority}"
+                         .encode())
+
+            server = await serve_h2(FnService(handler))
+            try:
+                status, body, _ = await self._h1_upgrade_exchange(
+                    server.bound_port, "up.test")
+                assert status == 200
+                assert body == b"GET /up a=up.test"
+            finally:
+                await server.close()
+
+        run(go())
+
+    def test_upgrade_with_coalesced_preface_and_body(self):
+        """An eager client coalesces the upgrade request (WITH a body)
+        and its h2 preface+SETTINGS into one write before reading the
+        101 — the server must split body / preface / frames correctly."""
+        from linkerd_tpu.protocol.h2 import frames
+
+        async def go():
+            async def handler(req: H2Request) -> H2Response:
+                body, _ = await req.stream.read_all()
+                return H2Response(status=200, body=b"got:" + body)
+
+            server = await serve_h2(FnService(handler))
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", server.bound_port)
+            try:
+                body = b"PAYLOAD"
+                writer.write(
+                    (f"POST /up HTTP/1.1\r\nHost: t\r\n"
+                     f"Connection: Upgrade, HTTP2-Settings\r\n"
+                     f"Upgrade: h2c\r\nHTTP2-Settings: \r\n"
+                     f"Content-Length: {len(body)}\r\n\r\n").encode()
+                    + body
+                    + frames.CONNECTION_PREFACE
+                    + frames.pack_settings([]))
+                await writer.drain()
+                status_line = await reader.readline()
+                assert b"101" in status_line
+                while (await reader.readline()) not in (b"\r\n", b""):
+                    pass
+                dec = hpack.Decoder()
+                status = rsp_body = None
+                got_body = b""
+                while True:
+                    head = await asyncio.wait_for(reader.readexactly(9), 5)
+                    fh = frames.unpack_header(head)
+                    payload = (await reader.readexactly(fh.length)
+                               if fh.length else b"")
+                    if fh.type == frames.SETTINGS and not (
+                            fh.flags & frames.FLAG_ACK):
+                        writer.write(frames.pack_settings([], ack=True))
+                        await writer.drain()
+                    elif fh.type == frames.HEADERS:
+                        hdrs = dec.decode(frames.strip_padding(
+                            fh.flags, payload))
+                        status = next(v for n, v in hdrs
+                                      if n == ":status")
+                    elif fh.type == frames.DATA:
+                        got_body += frames.strip_padding(fh.flags, payload)
+                        if fh.flags & frames.FLAG_END_STREAM:
+                            break
+                    elif fh.type == frames.GOAWAY:
+                        raise AssertionError(f"goaway: {payload!r}")
+                assert status == "200"
+                assert got_body == b"got:PAYLOAD"
+            finally:
+                writer.close()
+                await server.close()
+
+        run(go())
+
+    def test_non_upgrade_h1_gets_426(self):
+        async def go():
+            async def handler(req: H2Request) -> H2Response:
+                return H2Response(status=200)
+
+            server = await serve_h2(FnService(handler))
+            try:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", server.bound_port)
+                writer.write(b"GET / HTTP/1.1\r\nHost: x\r\n\r\n")
+                await writer.drain()
+                line = await reader.readline()
+                assert b"426" in line
+                writer.close()
+            finally:
+                await server.close()
+
+        run(go())
+
+    def test_upgrade_routed_through_linker(self, tmp_path):
+        """curl-style h1 client upgrades on the h2 ROUTER port and its
+        request routes through identify->bind->dispatch to an h2
+        backend."""
+        from linkerd_tpu.linker import load_linker
+
+        async def go():
+            async def handler(req: H2Request) -> H2Response:
+                body, _ = await req.stream.read_all()
+                return H2Response(status=200, body=b"routed-upgrade")
+
+            backend = await serve_h2(FnService(handler))
+            disco = tmp_path / "disco"
+            disco.mkdir()
+            (disco / "upsvc").write_text(
+                f"127.0.0.1 {backend.bound_port}\n")
+            cfg = f"""
+routers:
+- protocol: h2
+  label: h2up
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers:
+  - port: 0
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+            linker = load_linker(cfg)
+            await linker.start()
+            try:
+                status, body, _ = await self._h1_upgrade_exchange(
+                    linker.routers[0].server_ports[0], "upsvc")
+                assert (status, body) == (200, b"routed-upgrade")
+            finally:
+                await linker.close()
+                await backend.close()
+
+        run(go())

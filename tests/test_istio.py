@@ -372,3 +372,154 @@ class TestIstioInterpreter:
                 await server.close()
 
         run(go())
+
+
+class TestIstioIngressIdentifier:
+    """The fused kind io.l5d.k8s.istio-ingress: istio traffic routed
+    through a k8s Ingress resource (ref IstioIngressIdentifier.scala:1-128
+    + the h2 twin)."""
+
+    def _pilot(self):
+        pilot = FakePilot()
+        pilot.virtual_hosts = [
+            {"name": "reviews.default.svc.cluster.local|http",
+             "domains": ["reviews",
+                         "reviews.default.svc.cluster.local:9080"]}]
+        pilot.route_rules = RULES
+        return pilot
+
+    def _ingress_items(self):
+        from test_k8s_ingress import ingress_obj
+        return [ingress_obj(
+            name="shop", ns="default", host="shop.example.com",
+            path="/api/.*", svc="reviews", port="9080",
+            annotations={"kubernetes.io/ingress.class": "istio"})]
+
+    def test_linker_routes_istio_request_by_ingress_rule(self, tmp_path):
+        """e2e: ingress (host,path) match -> numeric port resolved to the
+        istio port name via RDS -> route rule rewrite -> fs-bound
+        backend; non-matching host is unidentified (400)."""
+        from test_k8s_ingress import FakeIngressApi
+
+        async def go():
+            from linkerd_tpu.linker import load_linker
+            from linkerd_tpu.protocol.http.client import HttpClient
+            from linkerd_tpu.protocol.http.server import serve
+
+            pilot = self._pilot()
+            pilot_srv = await HttpServer(pilot.service()).start()
+            fake = FakeIngressApi(items=self._ingress_items())
+            k8s_srv = await HttpServer(fake.service()).start()
+
+            async def backend_handler(req: Request) -> Response:
+                return Response(status=200,
+                                body=f"echo:{req.uri}".encode())
+            backend = await serve(FnService(backend_handler))
+
+            disco = tmp_path / "disco"
+            disco.mkdir()
+            (disco / "reviews-v1").write_text(
+                f"127.0.0.1 {backend.bound_port}\n")
+
+            cfg = f"""
+routers:
+- protocol: http
+  label: istio-ing
+  identifier:
+    kind: io.l5d.k8s.istio-ingress
+    host: 127.0.0.1
+    port: {k8s_srv.bound_port}
+    apiserverHost: 127.0.0.1
+    apiserverPort: {pilot_srv.bound_port}
+    discoveryPort: {pilot_srv.bound_port}
+    pollIntervalMs: 100
+  dtab: |
+    /svc/route/to-v1/http => /#/io.l5d.fs/reviews-v1 ;
+  servers:
+  - port: 0
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+            linker = load_linker(cfg)
+            await linker.start()
+            proxy = HttpClient("127.0.0.1",
+                               linker.routers[0].server_ports[0])
+            try:
+                # the to-v1 rule matches uri prefix /api/ and rewrites it
+                # to /v1/ before routing to /svc/route/to-v1/http
+                req = Request(uri="/api/users")
+                req.headers.set("Host", "shop.example.com")
+                rsp = await proxy(req)
+                assert (rsp.status, rsp.body) == (200, b"echo:/v1/users")
+
+                # no ingress rule for this host -> unidentified -> 400
+                bad = Request(uri="/api/users")
+                bad.headers.set("Host", "other.example.com")
+                rsp2 = await proxy(bad)
+                assert rsp2.status == 400
+            finally:
+                await proxy.close()
+                await linker.close()
+                await backend.close()
+                await k8s_srv.close()
+                await pilot_srv.close()
+
+        run(go())
+
+    def test_h2_twin_redirect_and_dest_fallthrough(self, tmp_path):
+        """The h2 kind registers + identifies: route rewrite, redirect
+        rules answering 302 directly, and the empty-label dest
+        fall-through when no rule matches."""
+        from test_k8s_ingress import FakeIngressApi, ingress_obj
+        from linkerd_tpu.config import lookup
+        from linkerd_tpu.core import Dtab, Path
+        from linkerd_tpu.protocol.h2.messages import H2Request, H2Response
+        from linkerd_tpu.router.binding import DstPath
+
+        async def go():
+            pilot = self._pilot()
+            pilot_srv = await HttpServer(pilot.service()).start()
+            # catch-all ingress path so every uri reaches the route rules
+            fake = FakeIngressApi(items=[ingress_obj(
+                name="shop", ns="default", host="shop.example.com",
+                path="/.*", svc="reviews", port="9080",
+                annotations={"kubernetes.io/ingress.class": "istio"})])
+            k8s_srv = await HttpServer(fake.service()).start()
+            try:
+                cls = lookup("h2identifier", "io.l5d.k8s.istio-ingress")
+                cfg = cls(host="127.0.0.1", port=k8s_srv.bound_port,
+                          apiserverHost="127.0.0.1",
+                          apiserverPort=pilot_srv.bound_port,
+                          discoveryPort=pilot_srv.bound_port,
+                          pollIntervalMs=100)
+                identify = cfg.mk(Path.read("/svc"), Dtab.empty())
+
+                # to-v1 rule: uri prefix /api/ -> rewrite + route path
+                req = H2Request(method="GET", path="/api/x",
+                                authority="shop.example.com")
+                got = await identify(req)
+                assert isinstance(got, DstPath)
+                assert got.path.show == "/svc/route/to-v1/http"
+                assert req.path == "/v1/x"  # rewrite applied in place
+
+                # redirect-old rule (exact /old, precedence 5) -> 302
+                rsp = await identify(H2Request(
+                    method="GET", path="/old",
+                    authority="shop.example.com"))
+                assert isinstance(rsp, H2Response)
+                assert rsp.status == 302
+                assert rsp.headers.get("location") == "http://reviews/new"
+
+                # uri matching no rule -> empty-label dest fall-through
+                got2 = await identify(H2Request(
+                    method="GET", path="/plain",
+                    authority="shop.example.com"))
+                assert isinstance(got2, DstPath)
+                assert got2.path.show == (
+                    "/svc/dest/reviews.default.svc.cluster.local/::/http")
+            finally:
+                await k8s_srv.close()
+                await pilot_srv.close()
+
+        run(go())

@@ -73,7 +73,10 @@ class TestMuxRouter:
         disco.mkdir()
 
         async def go():
+            seen_dests = []
+
             async def backend(td: Tdispatch) -> bytes:
+                seen_dests.append((td.dest, list(td.dtab)))
                 return b"be:" + td.payload
             be = await MuxServer(FnService(backend)).start()
             (disco / "users").write_text(f"127.0.0.1 {be.bound_port}\n")
@@ -97,6 +100,16 @@ namers:
             # (ref: Mux.scala:36 prefix ++ destination)
             rsp = await client(Tdispatch(0, [], "/users", [], b"hi"))
             assert rsp == b"be:hi"
+            # the downstream Tdispatch dest is the bound RESIDUAL path,
+            # not the logical dest, and the local dtab is consumed (ref:
+            # MuxEncodeResidual.scala:1-18). /svc/users binds fully ->
+            # empty residual -> "/".
+            assert seen_dests[-1] == ("/", [])
+
+            # a deeper dest leaves /extra unbound past the fs file
+            rsp = await client(Tdispatch(0, [], "/users/extra", [], b"r"))
+            assert rsp == b"be:r"
+            assert seen_dests[-1] == ("/extra", [])
 
             # per-request dtab override (mux carries dtabs natively)
             (disco / "other").write_text(f"127.0.0.1 {be.bound_port}\n")
@@ -104,9 +117,10 @@ namers:
                 0, [], "/nothere",
                 [("/svc/nothere", "/#/io.l5d.fs/other")], b"x"))
             assert rsp == b"be:x"
+            assert seen_dests[-1] == ("/", [])
 
             flat = linker.metrics.flatten()
-            assert flat["rt/mx/server/requests"] == 2
+            assert flat["rt/mx/server/requests"] == 3
             await client.close()
             await linker.close()
             await be.close()

@@ -228,3 +228,209 @@ namers:
             await backend.close()
 
         run(go())
+
+
+def mk_compact_call(name: str, seqid: int) -> bytes:
+    """A TCompactProtocol CALL with an empty-struct body."""
+    def varint(v: int) -> bytes:
+        out = b""
+        while v >= 0x80:
+            out += bytes([v & 0x7F | 0x80])
+            v >>= 7
+        return out + bytes([v])
+    nb = name.encode()
+    return (bytes([0x82, (CALL << 5) | 1]) + varint(seqid)
+            + varint(len(nb)) + nb + b"\x00")
+
+
+def mk_compact_reply(name: str, seqid: int) -> bytes:
+    def varint(v: int) -> bytes:
+        out = b""
+        while v >= 0x80:
+            out += bytes([v & 0x7F | 0x80])
+            v >>= 7
+        return out + bytes([v])
+    nb = name.encode()
+    return (bytes([0x82, (2 << 5) | 1]) + varint(seqid)
+            + varint(len(nb)) + nb + b"\x00")
+
+
+class TestUnframedTransport:
+    """thriftFramed: false — buffered transport, message boundaries from
+    the binary-protocol struct scan (ref ThriftInitializer.scala:68-72)."""
+
+    def test_message_length_boundary_scan(self):
+        from linkerd_tpu.protocol.thrift.codec import message_length
+
+        msg = mk_call("getUser", 42, args=(
+            b"\x0b" + struct.pack(">hI", 1, 3) + b"abc"  # string field
+            + b"\x08" + struct.pack(">hi", 2, 7)          # i32 field
+            + b"\x00"))                                   # stop
+        assert message_length(msg) == len(msg)
+        assert message_length(msg + b"extra") == len(msg)
+        for cut in (2, 6, 10, len(msg) - 1):
+            assert message_length(msg[:cut]) is None
+
+    def test_unframed_e2e_through_router(self, tmp_path):
+        from linkerd_tpu.protocol.thrift.codec import ThriftCall
+        from linkerd_tpu.protocol.thrift.server import ThriftServer
+        from linkerd_tpu.router.service import FnService
+
+        disco = tmp_path / "disco"
+        disco.mkdir()
+
+        async def go():
+            async def handler(call: ThriftCall):
+                return mk_reply(call.name, call.seqid, b"\x00")
+
+            backend = await ThriftServer(FnService(handler),
+                                         framed=False).start()
+            (disco / "thrift").write_text(
+                f"127.0.0.1 {backend.bound_port}\n")
+            cfg = f"""
+routers:
+- protocol: thrift
+  label: tun
+  thriftFramed: false
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers: [{{port: 0}}]
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+            linker = load_linker(cfg)
+            await linker.start()
+            rport = linker.routers[0].server_ports[0]
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", rport)
+            # raw unframed messages, two back-to-back in one write
+            writer.write(mk_call("ping", 11) + mk_call("ping", 12))
+            await writer.drain()
+            from linkerd_tpu.protocol.thrift.codec import UnframedReader
+            ur = UnframedReader(reader)
+            r1 = await ur.read_message()
+            r2 = await ur.read_message()
+            assert parse_message_header(r1)[:2] == ("ping", 11)
+            assert parse_message_header(r2)[:2] == ("ping", 12)
+            writer.close()
+            await linker.close()
+            await backend.close()
+
+        run(go())
+
+    def test_compact_unframed_rejected_at_load(self, tmp_path):
+        from linkerd_tpu.config import ConfigError
+        cfg = """
+routers:
+- protocol: thrift
+  label: bad
+  thriftFramed: false
+  thriftProtocol: compact
+  servers: [{port: 0}]
+"""
+        with pytest.raises(ConfigError, match="thriftProtocol: binary"):
+            load_linker(cfg)
+
+
+class TestCompactProtocol:
+    def test_compact_header_parse(self):
+        from linkerd_tpu.protocol.thrift.codec import parse_compact_header
+
+        msg = mk_compact_call("getThing", 300)
+        assert parse_compact_header(msg) == ("getThing", 300, CALL)
+
+    def test_compact_e2e_through_router(self, tmp_path):
+        from linkerd_tpu.protocol.thrift.codec import ThriftCall
+        from linkerd_tpu.protocol.thrift.server import ThriftServer
+        from linkerd_tpu.router.service import FnService
+
+        disco = tmp_path / "disco"
+        disco.mkdir()
+
+        async def go():
+            async def handler(call: ThriftCall):
+                return mk_compact_reply(call.name, call.seqid)
+
+            backend = await ThriftServer(FnService(handler),
+                                         protocol="compact",
+                                         ttwitter=False).start()
+            (disco / "thrift").write_text(
+                f"127.0.0.1 {backend.bound_port}\n")
+            cfg = f"""
+routers:
+- protocol: thrift
+  label: tc
+  thriftProtocol: compact
+  attemptTTwitterUpgrade: false
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers: [{{port: 0}}]
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+            linker = load_linker(cfg)
+            await linker.start()
+            rport = linker.routers[0].server_ports[0]
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", rport)
+            write_framed(writer, mk_compact_call("ping", 9))
+            await writer.drain()
+            reply = await read_framed(reader)
+            from linkerd_tpu.protocol.thrift.codec import (
+                parse_compact_header,
+            )
+            assert parse_compact_header(reply) == ("ping", 9, 2)
+            writer.close()
+            await linker.close()
+            await backend.close()
+
+        run(go())
+
+
+class TestPipelinedDispatch:
+    def test_two_in_flight_on_one_connection(self, tmp_path):
+        """Pipelining: a second request on the same connection dispatches
+        while the first is still in the handler (finagle pipelines
+        thrift); replies come back in request order."""
+        from linkerd_tpu.protocol.thrift.codec import ThriftCall
+        from linkerd_tpu.protocol.thrift.server import ThriftServer
+        from linkerd_tpu.router.service import FnService
+
+        async def go():
+            inflight = 0
+            max_inflight = 0
+            first_gate = asyncio.Event()
+
+            async def handler(call: ThriftCall):
+                nonlocal inflight, max_inflight
+                inflight += 1
+                max_inflight = max(max_inflight, inflight)
+                try:
+                    if call.seqid == 1:
+                        # block until the second request has arrived
+                        await asyncio.wait_for(first_gate.wait(), 5)
+                    else:
+                        first_gate.set()
+                    return mk_reply(call.name, call.seqid, b"\x00")
+                finally:
+                    inflight -= 1
+
+            server = await ThriftServer(FnService(handler),
+                                        ttwitter=False).start()
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", server.bound_port)
+            write_framed(writer, mk_call("a", 1))
+            write_framed(writer, mk_call("b", 2))
+            await writer.drain()
+            r1 = await asyncio.wait_for(read_framed(reader), 5)
+            r2 = await asyncio.wait_for(read_framed(reader), 5)
+            # in-order replies, both requests were in flight TOGETHER
+            assert parse_message_header(r1)[1] == 1
+            assert parse_message_header(r2)[1] == 2
+            assert max_inflight >= 2
+            writer.close()
+            await server.close()
+
+        run(go())
