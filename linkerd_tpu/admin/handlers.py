@@ -171,11 +171,16 @@ async def pprof_profile_handler(req: Request) -> Response:
     import pstats
 
     global _profile_active
+    import math
+
     q = _query(req)
     try:
-        seconds = min(max(float(q.get("seconds", 3.0)), 0.1), 60.0)
+        seconds = float(q.get("seconds", 3.0))
     except ValueError:
         return json_response({"error": "bad seconds"}, status=400)
+    if not math.isfinite(seconds):  # nan survives min/max clamping
+        return json_response({"error": "bad seconds"}, status=400)
+    seconds = min(max(seconds, 0.1), 60.0)
     if _profile_active:
         return json_response({"error": "a profile capture is already "
                                        "running"}, status=409)
@@ -206,11 +211,16 @@ async def pprof_heap_handler(req: Request) -> Response:
     import tracemalloc
 
     global _heap_active
+    import math
+
     q = _query(req)
     try:
-        seconds = min(max(float(q.get("seconds", 3.0)), 0.1), 60.0)
+        seconds = float(q.get("seconds", 3.0))
     except ValueError:
         return json_response({"error": "bad seconds"}, status=400)
+    if not math.isfinite(seconds):  # nan survives min/max clamping
+        return json_response({"error": "bad seconds"}, status=400)
+    seconds = min(max(seconds, 0.1), 60.0)
     if _heap_active:
         return json_response({"error": "a heap capture is already "
                                        "running"}, status=409)

@@ -112,6 +112,28 @@ class IstioIdentifierLogic:
         self.prefix = prefix
         self.base_dtab = base_dtab
 
+    async def apply_route_rules(self, dest: str, port: str,
+                                meta: RequestMeta, local_dtab: Dtab,
+                                apply_rewrite, mk_redirect):
+        """The shared route-rule tail: max-precedence rule for ``dest``
+        redirects / rewrites+routes / falls through to the empty-label
+        dest path. Used by the plain istio identifier AND the
+        istio-ingress fusion — one copy of the precedence/redirect/
+        rewrite semantics."""
+        rules = await self.routes.get_rules()
+        best = max_precedence(filter_rules(rules, dest, meta))
+        if best is None:
+            path = self.prefix + Path.of("dest", dest, "::", port)
+            return DstPath(path, self.base_dtab, local_dtab)
+        name, rule = best
+        if rule.is_redirect:
+            return mk_redirect(rule.redirect_uri or meta.uri,
+                               rule.redirect_authority or meta.authority)
+        uri, authority = http_rewrite(rule, meta)
+        apply_rewrite(uri, authority)
+        path = self.prefix + Path.of("route", name, port)
+        return DstPath(path, self.base_dtab, local_dtab)
+
     async def identify(self, meta: RequestMeta, local_dtab: Dtab,
                        apply_rewrite: Callable[[str, Optional[str]], None],
                        mk_redirect: Callable[[str, str], object]):
@@ -120,20 +142,9 @@ class IstioIdentifierLogic:
         if cluster is None:
             path = external_path(self.prefix, meta.authority)
             return DstPath(path, self.base_dtab, local_dtab)
-        rules = await self.routes.get_rules()
-        best = max_precedence(filter_rules(rules, cluster.dest, meta))
-        if best is None:
-            path = self.prefix + Path.of(
-                "dest", cluster.dest, "::", cluster.port)
-            return DstPath(path, self.base_dtab, local_dtab)
-        name, rule = best
-        if rule.is_redirect:
-            return mk_redirect(rule.redirect_uri or meta.uri,
-                               rule.redirect_authority or meta.authority)
-        uri, authority = http_rewrite(rule, meta)
-        apply_rewrite(uri, authority)
-        path = self.prefix + Path.of("route", name, cluster.port)
-        return DstPath(path, self.base_dtab, local_dtab)
+        return await self.apply_route_rules(
+            cluster.dest, cluster.port, meta, local_dtab, apply_rewrite,
+            mk_redirect)
 
 
 def _mk_caches(host: str, port: int, discovery_port: int,
@@ -201,10 +212,9 @@ class IstioIngressLogic:
     def __init__(self, ingress, cluster_cache: ClusterCache,
                  route_cache: RouteCache, prefix: Path, base_dtab: Dtab):
         self.ingress = ingress
+        self._logic = IstioIdentifierLogic(cluster_cache, route_cache,
+                                           prefix, base_dtab)
         self.clusters = cluster_cache
-        self.routes = route_cache
-        self.prefix = prefix
-        self.base_dtab = base_dtab
 
     async def identify(self, meta: RequestMeta, local_dtab: Dtab,
                        apply_rewrite: Callable[[str, Optional[str]], None],
@@ -226,20 +236,8 @@ class IstioIngressLogic:
                     f"ingress path {m.svc}:{m.port} does not match any "
                     f"istio vhosts")
             port = c.port
-        rules = await self.routes.get_rules()
-        best = max_precedence(filter_rules(rules, cluster, meta))
-        if best is None:
-            # no matching rule: forward to an empty label selector
-            path = self.prefix + Path.of("dest", cluster, "::", port)
-            return DstPath(path, self.base_dtab, local_dtab)
-        name, rule = best
-        if rule.is_redirect:
-            return mk_redirect(rule.redirect_uri or meta.uri,
-                               rule.redirect_authority or meta.authority)
-        new_uri, authority = http_rewrite(rule, meta)
-        apply_rewrite(new_uri, authority)
-        path = self.prefix + Path.of("route", name, port)
-        return DstPath(path, self.base_dtab, local_dtab)
+        return await self._logic.apply_route_rules(
+            cluster, port, meta, local_dtab, apply_rewrite, mk_redirect)
 
 
 @dataclass

@@ -203,11 +203,17 @@ class ThriftServer:
         except Exception:  # noqa: BLE001
             log.exception("thrift connection handler error")
         finally:
-            # drain in-flight replies (bounded), then stop the writer
+            # drain in-flight replies (bounded), then stop the writer.
+            # CancelledError (BaseException) must not skip the cleanup
+            # below — re-raise it after the conn is fully torn down.
+            cancelled: Optional[BaseException] = None
             try:
                 reply_q.put_nowait(None)
                 await asyncio.wait_for(writer_task, 5.0)
-            except (asyncio.TimeoutError, Exception):  # noqa: BLE001
+            except asyncio.CancelledError as e:
+                writer_task.cancel()
+                cancelled = e
+            except Exception:  # noqa: BLE001
                 writer_task.cancel()
             for t in list(pending_tasks):
                 t.cancel()
@@ -216,6 +222,8 @@ class ThriftServer:
                 writer.close()
             except Exception:  # noqa: BLE001
                 pass
+            if cancelled is not None:
+                raise cancelled
 
 
 async def serve_thrift(service: Service, host: str = "127.0.0.1",

@@ -263,9 +263,49 @@ struct HpackTable {
 struct HpackDecoder {
     HpackTable table;
     size_t settings_max = 4096;  // our advertised SETTINGS_HEADER_TABLE_SIZE
+    // steady-state fast path (mirrors hpack.py Decoder._cache): an
+    // identical block decodes identically while the dynamic table is
+    // unchanged; blocks that mutate the table invalidate everything
+    std::unordered_map<std::string, std::vector<Hdr>> cache;
+    size_t cache_bytes = 0;
+    static constexpr size_t CACHE_CAP = 256;
+    static constexpr size_t CACHE_MAX_BLOCK = 2048;
+    static constexpr size_t CACHE_MAX_BYTES = 128 * 1024;
+
+    bool decode(const uint8_t* p, size_t n, std::vector<Hdr>* out) {
+        std::string key;
+        if (n <= CACHE_MAX_BLOCK) {
+            key.assign((const char*)p, n);
+            auto it = cache.find(key);
+            if (it != cache.end()) {
+                out->insert(out->end(), it->second.begin(),
+                            it->second.end());
+                return true;
+            }
+        }
+        size_t base = out->size();
+        bool mutated = false;
+        if (!decode_uncached(p, n, out, &mutated)) return false;
+        if (mutated) {
+            cache.clear();
+            cache_bytes = 0;
+        } else if (!key.empty()) {
+            if (cache.size() >= CACHE_CAP ||
+                cache_bytes >= CACHE_MAX_BYTES) {
+                cache.clear();
+                cache_bytes = 0;
+            }
+            cache.emplace(std::move(key),
+                          std::vector<Hdr>(out->begin() + (long)base,
+                                           out->end()));
+            cache_bytes += n;
+        }
+        return true;
+    }
 
     // false => COMPRESSION_ERROR
-    bool decode(const uint8_t* p, size_t n, std::vector<Hdr>* out) {
+    bool decode_uncached(const uint8_t* p, size_t n, std::vector<Hdr>* out,
+                         bool* mutated) {
         size_t pos = 0;
         while (pos < n) {
             uint8_t b = p[pos];
@@ -281,12 +321,14 @@ struct HpackDecoder {
                 Hdr h;
                 if (!read_literal(p, n, &pos, idx, &h)) return false;
                 table.add(h);
+                *mutated = true;
                 out->push_back(std::move(h));
             } else if (b & 0x20) {  // dynamic table size update
                 uint64_t sz;
                 if (!dec_int(p, n, &pos, 5, &sz)) return false;
                 if (sz > settings_max) return false;
                 table.resize((size_t)sz);
+                *mutated = true;
             } else {  // literal w/o indexing (0x00) / never indexed (0x10)
                 uint64_t idx;
                 if (!dec_int(p, n, &pos, 4, &idx)) return false;
@@ -378,17 +420,44 @@ struct HpackEncoder {
         return m;
     }
 
+    // steady-state cache (mirrors hpack.py Encoder._cache): a header
+    // list that encodes without inserting into the dynamic table yields
+    // the same block until the table next changes
+    std::unordered_map<std::string, std::string> cache;
+    static constexpr size_t CACHE_CAP = 256;
+
     // Honor peer SETTINGS_HEADER_TABLE_SIZE (emit a size update next block)
     void set_max_table_size(size_t sz) {
         if (sz > 4096) sz = 4096;
         pending_resize = (int64_t)sz;
         table.resize(sz);
+        cache.clear();
     }
 
     void encode(const std::vector<Hdr>& headers, std::string* out) {
+        // collision-free key: length-prefixed fields (header values may
+        // contain ANY octet, so separator bytes alone would collide)
+        std::string key;
+        key.reserve(64);
+        for (const auto& h : headers) {
+            put_u32(&key, (uint32_t)h.first.size());
+            key += h.first;
+            put_u32(&key, (uint32_t)h.second.size());
+            key += h.second;
+        }
+        if (pending_resize < 0) {
+            auto it = cache.find(key);
+            if (it != cache.end()) {
+                out->append(it->second);
+                return;
+            }
+        }
+        size_t base = out->size();
+        bool inserted = false;
         if (pending_resize >= 0) {
             enc_int((uint64_t)pending_resize, 5, 0x20, out);
             pending_resize = -1;
+            inserted = true;  // the size-update prefix must not be cached
         }
         for (const auto& h : headers) {
             int full = 0, name = 0;
@@ -428,6 +497,15 @@ struct HpackEncoder {
             }
             enc_str(h.second, out);
             table.add(h);  // oversized entries clear the table (RFC §4.4)
+            inserted = true;
+        }
+        if (inserted) {
+            // dynamic indices shifted: cached blocks are stale
+            cache.clear();
+        } else {
+            if (cache.size() >= CACHE_CAP) cache.clear();
+            cache.emplace(std::move(key),
+                          out->substr(base));
         }
     }
 

@@ -27,18 +27,19 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <atomic>
 #include <string>
 #include <unordered_map>
 #include <vector>
 
 #include "h2_core.h"
 
-namespace {
+namespace h2bench {
 
 using h2::Hdr;
 
-volatile sig_atomic_t g_stop = 0;
-void on_sig(int) { g_stop = 1; }
+std::atomic<int> g_stop{0};
+void on_sig(int) { g_stop.store(1, std::memory_order_relaxed); }
 
 uint64_t now_us() {
     timespec ts;
@@ -183,7 +184,7 @@ void serve_handle_frame(Conn* c, uint8_t type, uint8_t flags, uint32_t sid,
     }
 }
 
-int run_serve(int port) {
+int run_serve(int port, std::atomic<int>* bound_out) {
     int lfd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
     int one = 1;
     setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
@@ -198,6 +199,8 @@ int run_serve(int port) {
     }
     socklen_t sl = sizeof(sa);
     getsockname(lfd, (sockaddr*)&sa, &sl);
+    if (bound_out != nullptr)
+        bound_out->store((int)ntohs(sa.sin_port));
     printf("{\"listening\": %d}\n", ntohs(sa.sin_port));
     fflush(stdout);
 
@@ -209,7 +212,7 @@ int run_serve(int port) {
     std::unordered_map<int, Conn*> conns;
     ServeStats stats;
     epoll_event evs[128];
-    while (!g_stop) {
+    while (!g_stop.load(std::memory_order_relaxed)) {
         int n = epoll_wait(epfd, evs, 128, 200);
         for (int i = 0; i < n; i++) {
             int fd = evs[i].data.fd;
@@ -427,7 +430,8 @@ void load_handle_frame(Conn* c, LoadState* ls, uint8_t type, uint8_t flags,
 }
 
 int run_load(const char* ip, int port, const char* authority, int conc,
-             double seconds, int paysz, double rate_rps) {
+             double seconds, int paysz, double rate_rps,
+             uint64_t* done_out) {
     // gRPC-framed echo message: 5-byte prefix + protobuf bytes field
     std::string msg;
     msg.push_back(0x0A);  // field 1, wire type 2
@@ -604,6 +608,7 @@ int run_load(const char* ip, int port, const char* authority, int conc,
         size_t i = (size_t)(q * (double)(lat.size() - 1));
         return (double)lat[i] / 1000.0;
     };
+    if (done_out != nullptr) *done_out = done;
     printf("{\"reqs\": %llu, \"errors\": %llu, \"secs\": %.3f, "
            "\"rps\": %.1f, \"p50_ms\": %.3f, \"p99_ms\": %.3f}\n",
            (unsigned long long)done, (unsigned long long)errors, dt,
@@ -616,20 +621,23 @@ int run_load(const char* ip, int port, const char* authority, int conc,
     return 0;
 }
 
-}  // namespace
+}  // namespace h2bench
 
+#ifndef H2BENCH_NO_MAIN
 int main(int argc, char** argv) {
-    signal(SIGINT, on_sig);
-    signal(SIGTERM, on_sig);
+    signal(SIGINT, h2bench::on_sig);
+    signal(SIGTERM, h2bench::on_sig);
     signal(SIGPIPE, SIG_IGN);
     if (argc >= 3 && strcmp(argv[1], "serve") == 0)
-        return run_serve(atoi(argv[2]));
+        return h2bench::run_serve(atoi(argv[2]), nullptr);
     if (argc >= 7 && strcmp(argv[1], "load") == 0)
-        return run_load(argv[2], atoi(argv[3]), argv[4], atoi(argv[5]),
-                        atof(argv[6]), argc > 7 ? atoi(argv[7]) : 128,
-                        argc > 8 ? atof(argv[8]) : 0.0);
+        return h2bench::run_load(argv[2], atoi(argv[3]), argv[4],
+                                 atoi(argv[5]), atof(argv[6]),
+                                 argc > 7 ? atoi(argv[7]) : 128,
+                                 argc > 8 ? atof(argv[8]) : 0.0, nullptr);
     fprintf(stderr,
             "usage: h2bench serve <port> | h2bench load <ip> <port> "
             "<authority> <conc> <secs> [paysz] [rate_rps]\n");
     return 2;
 }
+#endif  // H2BENCH_NO_MAIN

@@ -1,0 +1,150 @@
+// Sanitizer stress driver for the h2 fastpath engine (h2_fastpath.cpp).
+//
+// Same purpose as tsan_stress.cpp for the h1 engine (SURVEY.md §5: the
+// C++ pieces must be TSan-cleanable): real gRPC-shaped traffic flows
+// through the engine while a second thread hammers every cross-thread
+// entry point (set_route/remove_route/stats/misses/features) — the
+// exact surface the Python control plane exercises concurrently with
+// the epoll loop thread. Build + run via
+// `python native/build.py --sanitize thread` (and `address`).
+//
+// In-process topology: h2bench's echo server and closed-loop load
+// generator run on their own pthreads (each is a self-contained epoll
+// loop), the engine under test proxies between them, and the churn
+// thread plays the FastPathController.
+
+#define H2BENCH_NO_MAIN
+#include "h2bench.cpp"  // serve/load harness (namespace h2bench)
+
+#include <atomic>
+#include <pthread.h>
+
+extern "C" {
+void* fph2_create();
+int fph2_start(void* e);
+int fph2_listen(void* e, const char* ip, int port);
+int fph2_set_route(void* e, const char* host, const char* endpoints);
+int fph2_remove_route(void* e, const char* host);
+long fph2_drain_misses(void* e, char* buf, size_t cap);
+long fph2_stats_json(void* e, char* buf, size_t cap);
+long fph2_drain_features(void* e, float* buf, long cap_rows);
+void fph2_shutdown(void* e);
+}
+
+namespace {
+
+struct ServeArgs {
+    std::atomic<int> bound_port{0};
+};
+
+void* serve_main(void* arg) {
+    ServeArgs* a = (ServeArgs*)arg;
+    h2bench::run_serve(0, &a->bound_port);
+    return nullptr;
+}
+
+struct LoadArgs {
+    int port = 0;
+    uint64_t done = 0;
+};
+
+void* load_main(void* arg) {
+    LoadArgs* a = (LoadArgs*)arg;
+    h2bench::run_load("127.0.0.1", a->port, "echoext", 16, 3.0, 128, 0.0,
+                      &a->done);
+    return nullptr;
+}
+
+struct ChurnArgs {
+    void* engine = nullptr;
+    int serve_port = 0;
+    std::atomic<int> stop{0};
+};
+
+void* churn_main(void* arg) {
+    ChurnArgs* a = (ChurnArgs*)arg;
+    char ep[64];
+    snprintf(ep, sizeof(ep), "127.0.0.1:%d ", a->serve_port);
+    char* stats = new char[1 << 20];
+    char* misses = new char[64 * 1024];
+    float* feats = new float[4096 * 6];
+    int i = 0;
+    while (!a->stop.load(std::memory_order_relaxed)) {
+        // the whole Python-facing control surface, hammered
+        fph2_set_route(a->engine, "echoext", ep);
+        if (i % 7 == 0) {
+            fph2_set_route(a->engine, "ghost", "127.0.0.1:1 ");
+            fph2_remove_route(a->engine, "ghost");
+        }
+        fph2_stats_json(a->engine, stats, 1 << 20);
+        fph2_drain_misses(a->engine, misses, 64 * 1024);
+        fph2_drain_features(a->engine, feats, 4096);
+        usleep(500);
+        i++;
+    }
+    delete[] stats;
+    delete[] misses;
+    delete[] feats;
+    return nullptr;
+}
+
+}  // namespace
+
+int main() {
+    signal(SIGPIPE, SIG_IGN);
+
+    ServeArgs sa;
+    pthread_t serve_t;
+    pthread_create(&serve_t, nullptr, serve_main, &sa);
+    for (int i = 0; i < 200 && sa.bound_port.load() == 0; i++)
+        usleep(10'000);
+    if (sa.bound_port.load() == 0) {
+        fprintf(stderr, "echo server never bound\n");
+        return 2;
+    }
+
+    void* eng = fph2_create();
+    int lport = fph2_listen(eng, "127.0.0.1", 0);
+    if (lport <= 0) {
+        fprintf(stderr, "engine listen failed\n");
+        return 2;
+    }
+    fph2_start(eng);
+
+    ChurnArgs ca;
+    ca.engine = eng;
+    ca.serve_port = sa.bound_port.load();
+    // install the route up-front (the churn thread keeps re-installing)
+    char ep[64];
+    snprintf(ep, sizeof(ep), "127.0.0.1:%d ", sa.bound_port.load());
+    fph2_set_route(eng, "echoext", ep);
+    pthread_t churn_t;
+    pthread_create(&churn_t, nullptr, churn_main, &ca);
+
+    LoadArgs la[2];
+    pthread_t load_t[2];
+    for (int i = 0; i < 2; i++) {
+        la[i].port = lport;
+        pthread_create(&load_t[i], nullptr, load_main, &la[i]);
+    }
+    uint64_t total = 0;
+    for (int i = 0; i < 2; i++) {
+        pthread_join(load_t[i], nullptr);
+        total += la[i].done;
+    }
+
+    ca.stop.store(1);
+    pthread_join(churn_t, nullptr);
+    fph2_shutdown(eng);
+    h2bench::g_stop.store(1);
+    pthread_join(serve_t, nullptr);
+
+    fprintf(stderr, "h2 stress: %llu requests proxied\n",
+            (unsigned long long)total);
+    if (total < 500) {
+        fprintf(stderr, "too little traffic flowed (%llu)\n",
+                (unsigned long long)total);
+        return 3;
+    }
+    return 0;
+}
