@@ -320,3 +320,14 @@ class Proc:
             except subprocess.TimeoutExpired:
                 self.proc.kill()
                 self.proc.wait(5)
+
+
+def build_h2bench() -> str:
+    """Build (if stale) and return the out-of-process C++ load generator
+    / echo binary (native/h2bench.cpp), shared by configs 1 and 2."""
+    import importlib.util as u
+    spec = u.spec_from_file_location(
+        "nbuild", os.path.join(REPO, "native", "build.py"))
+    mod = u.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.build_h2bench()

@@ -50,13 +50,7 @@ namers:
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _build_h2bench() -> str:
-    import importlib.util as u
-    spec = u.spec_from_file_location(
-        "nbuild", os.path.join(REPO, "native", "build.py"))
-    mod = u.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod.build_h2bench()
+from benchmarks.common import build_h2bench as _build_h2bench  # noqa: E402
 
 
 async def bench(duration: float, rate: float) -> dict:

@@ -27,6 +27,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <deque>
 #include <atomic>
 #include <string>
 #include <unordered_map>
@@ -621,6 +622,176 @@ int run_load(const char* ip, int port, const char* authority, int conc,
     return 0;
 }
 
+// ---------------- h1 load mode (config 1's wrk analog) ----------------
+
+struct H1Conn {
+    int fd = -1;
+    std::string in, out;
+    bool want_write = false;
+    std::deque<uint64_t> sent_at;  // FIFO: pipelined responses in order
+    size_t scan = 0;               // resume offset for head scanning
+    long body_left = -1;           // -1: parsing head
+};
+
+int run_h1_load(const char* ip, int port, const char* host, int conc,
+                double seconds, uint64_t* done_out) {
+    char reqbuf[256];
+    int reqlen = snprintf(reqbuf, sizeof(reqbuf),
+                          "GET /bench HTTP/1.1\r\nHost: %s\r\n\r\n", host);
+    int nconns = std::max(1, conc / 16);
+    int window = std::max(1, conc / nconns);
+
+    int epfd = epoll_create1(0);
+    std::unordered_map<int, H1Conn*> conns;
+    uint64_t done = 0, errors = 0;
+    std::vector<uint32_t> lat;
+    uint64_t deadline = now_us() + (uint64_t)(seconds * 1e6);
+
+    for (int i = 0; i < nconns; i++) {
+        int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+        sockaddr_in sa{};
+        sa.sin_family = AF_INET;
+        sa.sin_port = htons((uint16_t)port);
+        inet_pton(AF_INET, ip, &sa.sin_addr);
+        if (::connect(fd, (sockaddr*)&sa, sizeof(sa)) < 0) {
+            perror("connect");
+            return 1;
+        }
+        set_nodelay(fd);
+        int fl = fcntl(fd, F_GETFL, 0);
+        fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+        H1Conn* c = new H1Conn();
+        c->fd = fd;
+        for (int w = 0; w < window; w++) {
+            c->out.append(reqbuf, (size_t)reqlen);
+            c->sent_at.push_back(now_us());
+        }
+        epoll_event ev{};
+        ev.events = EPOLLIN | EPOLLOUT;
+        ev.data.fd = fd;
+        c->want_write = true;
+        epoll_ctl(epfd, EPOLL_CTL_ADD, fd, &ev);
+        conns[fd] = c;
+    }
+
+    auto flush_h1 = [&](H1Conn* c) -> bool {
+        while (!c->out.empty()) {
+            ssize_t n = ::send(c->fd, c->out.data(), c->out.size(),
+                               MSG_NOSIGNAL);
+            if (n > 0) c->out.erase(0, (size_t)n);
+            else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK))
+                break;
+            else
+                return false;
+        }
+        bool ww = !c->out.empty();
+        if (ww != c->want_write) {
+            c->want_write = ww;
+            epoll_event ev{};
+            ev.events = EPOLLIN | (ww ? EPOLLOUT : 0);
+            ev.data.fd = c->fd;
+            epoll_ctl(epfd, EPOLL_CTL_MOD, c->fd, &ev);
+        }
+        return true;
+    };
+
+    epoll_event evs[128];
+    uint64_t t0 = now_us();
+    while (!conns.empty()) {
+        uint64_t now = now_us();
+        if (now >= deadline) {
+            bool any = false;
+            for (auto& kv : conns)
+                if (!kv.second->sent_at.empty()) any = true;
+            if (!any || now >= deadline + 5'000'000) break;
+        }
+        int n = epoll_wait(epfd, evs, 128, 100);
+        for (int i = 0; i < n; i++) {
+            auto it = conns.find(evs[i].data.fd);
+            if (it == conns.end()) continue;
+            H1Conn* c = it->second;
+            bool dead = (evs[i].events & (EPOLLHUP | EPOLLERR)) != 0;
+            if (!dead && (evs[i].events & EPOLLOUT))
+                dead = !flush_h1(c);
+            if (!dead && (evs[i].events & EPOLLIN)) {
+                char buf[64 * 1024];
+                for (;;) {
+                    ssize_t r = ::recv(c->fd, buf, sizeof(buf), 0);
+                    if (r > 0) c->in.append(buf, (size_t)r);
+                    else if (r < 0 && (errno == EAGAIN ||
+                                       errno == EWOULDBLOCK))
+                        break;
+                    else { dead = true; break; }
+                }
+                // consume complete responses
+                while (!dead) {
+                    if (c->body_left < 0) {
+                        size_t hs = c->in.find("\r\n\r\n", c->scan);
+                        if (hs == std::string::npos) {
+                            c->scan = c->in.size() > 3
+                                ? c->in.size() - 3 : 0;
+                            break;
+                        }
+                        long cl = 0;
+                        // case-insensitive content-length scan in head
+                        for (size_t p2 = 0; p2 + 16 < hs; p2++) {
+                            if (strncasecmp(c->in.data() + p2,
+                                            "content-length:", 15) == 0) {
+                                cl = atol(c->in.data() + p2 + 15);
+                                break;
+                            }
+                        }
+                        c->in.erase(0, hs + 4);
+                        c->scan = 0;
+                        c->body_left = cl;
+                    }
+                    if ((long)c->in.size() < c->body_left) break;
+                    c->in.erase(0, (size_t)c->body_left);
+                    c->body_left = -1;
+                    if (!c->sent_at.empty()) {
+                        uint64_t t = c->sent_at.front();
+                        c->sent_at.pop_front();
+                        done++;
+                        if (lat.size() < 2'000'000)
+                            lat.push_back((uint32_t)(now_us() - t));
+                    }
+                    if (now_us() < deadline) {
+                        c->out.append(reqbuf, (size_t)reqlen);
+                        c->sent_at.push_back(now_us());
+                    }
+                }
+                if (!dead && !c->out.empty()) dead = !flush_h1(c);
+            }
+            if (dead) {
+                errors += c->sent_at.size();
+                epoll_ctl(epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+                ::close(c->fd);
+                delete c;
+                conns.erase(it);
+            }
+        }
+    }
+    uint64_t end = now_us();
+    if (end > deadline) end = deadline;
+    double dt = (double)(end - t0) / 1e6;
+    std::sort(lat.begin(), lat.end());
+    auto pct = [&](double q) -> double {
+        if (lat.empty()) return 0.0;
+        return (double)lat[(size_t)(q * (double)(lat.size() - 1))] / 1e3;
+    };
+    if (done_out != nullptr) *done_out = done;
+    printf("{\"reqs\": %llu, \"errors\": %llu, \"secs\": %.3f, "
+           "\"rps\": %.1f, \"p50_ms\": %.3f, \"p99_ms\": %.3f}\n",
+           (unsigned long long)done, (unsigned long long)errors, dt,
+           dt > 0 ? (double)done / dt : 0.0, pct(0.5), pct(0.99));
+    for (auto& kv : conns) {
+        ::close(kv.first);
+        delete kv.second;
+    }
+    ::close(epfd);
+    return 0;
+}
+
 }  // namespace h2bench
 
 #ifndef H2BENCH_NO_MAIN
@@ -630,13 +801,16 @@ int main(int argc, char** argv) {
     signal(SIGPIPE, SIG_IGN);
     if (argc >= 3 && strcmp(argv[1], "serve") == 0)
         return h2bench::run_serve(atoi(argv[2]), nullptr);
+    if (argc >= 7 && strcmp(argv[1], "h1load") == 0)
+        return h2bench::run_h1_load(argv[2], atoi(argv[3]), argv[4],
+                                    atoi(argv[5]), atof(argv[6]), nullptr);
     if (argc >= 7 && strcmp(argv[1], "load") == 0)
         return h2bench::run_load(argv[2], atoi(argv[3]), argv[4],
                                  atoi(argv[5]), atof(argv[6]),
                                  argc > 7 ? atoi(argv[7]) : 128,
                                  argc > 8 ? atof(argv[8]) : 0.0, nullptr);
     fprintf(stderr,
-            "usage: h2bench serve <port> | h2bench load <ip> <port> "
+            "usage: h2bench serve <port> | h1load <ip> <port> <host> <conc> <secs> | h2bench load <ip> <port> "
             "<authority> <conc> <secs> [paysz] [rate_rps]\n");
     return 2;
 }
