@@ -105,7 +105,13 @@ def main() -> dict:
                 ext_res = json.loads(ext.stdout)
                 out["proxy_ext"] = ext_res
                 out["loadgen"] = "subprocess"
-                if ext_res["rps"] > out["proxy_req_s"]:
+                # only adopt a CLEAN, full-length run as the headline: a
+                # burst that died early (errors / short secs) can show a
+                # higher instantaneous rate than an honest saturation
+                healthy = (ext_res.get("errors", 1) == 0
+                           and ext_res.get("secs", 0)
+                           >= 0.9 * min(4.0, args.duration))
+                if healthy and ext_res["rps"] > out["proxy_req_s"]:
                     # adopt the whole measurement, not just the rate —
                     # a C++-measured rps paired with Python-client
                     # latencies would mix two runs

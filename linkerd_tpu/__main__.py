@@ -32,6 +32,14 @@ async def amain(config_text: str) -> None:
         admin.add_handlers(t.admin_handlers())
     await admin.start()
 
+    identifier_server = None
+    if admin_spec is not None and admin_spec.httpIdentifierPort is not None:
+        from linkerd_tpu.admin.handlers import mk_identifier_server
+        identifier_server = await mk_identifier_server(
+            linker, admin_spec.httpIdentifierPort, host=admin_spec.ip)
+        log.info("identifier debug server on %s:%s", admin_spec.ip,
+                 identifier_server.bound_port)
+
     telemeter_tasks = [asyncio.create_task(t.run()) for t in linker.telemeters]
 
     # usage telemetry is opt-out (ref: Linker.scala:116-125 implicit
@@ -59,6 +67,8 @@ async def amain(config_text: str) -> None:
     log.info("shutting down")
     for task in telemeter_tasks:
         task.cancel()
+    if identifier_server is not None:
+        await identifier_server.close()
     await admin.close()
     await linker.close()
 

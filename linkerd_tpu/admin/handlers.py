@@ -245,6 +245,21 @@ async def pprof_heap_handler(req: Request) -> Response:
     return rsp
 
 
+async def mk_identifier_server(linker: "Linker", port: int,
+                               host: str = "127.0.0.1"):
+    """Standalone identification debug server (ref: HttpIdentifierHandler
+    wired by Main.initAdmin when ``admin.httpIdentifierPort`` is set):
+    every request to the port runs the routers' identifiers against the
+    query-described synthetic request."""
+    from linkerd_tpu.protocol.http.server import HttpServer
+    from linkerd_tpu.router.service import FnService
+
+    handler = mk_identifier_handler(linker)
+    server = HttpServer(FnService(handler), host=host, port=port)
+    await server.start()
+    return server
+
+
 def linkerd_admin_handlers(linker: "Linker") -> List[Tuple[str, Any]]:
     """The standard linkerd admin surface (LinkerdAdmin.apply)."""
     from linkerd_tpu.admin.dashboard import dashboard_handler

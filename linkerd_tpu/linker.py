@@ -226,6 +226,11 @@ class RouterSpec:
 class AdminSpec:
     port: int = DEFAULT_ADMIN_PORT
     ip: str = "127.0.0.1"
+    # standalone identification debug server: every request to this port
+    # answers with each router's identification of a synthetic request
+    # built from the query params (ref: Main.initAdmin wiring of
+    # HttpIdentifierHandler.scala:48 when httpIdentifierPort is set)
+    httpIdentifierPort: Optional[int] = None
 
 
 @dataclass

@@ -734,7 +734,7 @@ int run_h1_load(const char* ip, int port, const char* host, int conc,
                         }
                         long cl = 0;
                         // case-insensitive content-length scan in head
-                        for (size_t p2 = 0; p2 + 16 < hs; p2++) {
+                        for (size_t p2 = 0; p2 + 15 < hs; p2++) {
                             if (strncasecmp(c->in.data() + p2,
                                             "content-length:", 15) == 0) {
                                 cl = atol(c->in.data() + p2 + 15);

@@ -165,3 +165,47 @@ namers:
                 await linker.close()
 
         run(go())
+
+
+class TestHttpIdentifierServer:
+    def test_standalone_identifier_port(self, tmp_path):
+        """admin.httpIdentifierPort serves the identification debugger on
+        its own port (ref HttpIdentifierHandler.scala:48 + Main.initAdmin
+        wiring)."""
+        from linkerd_tpu.admin.handlers import mk_identifier_server
+        from linkerd_tpu.linker import load_linker
+
+        async def go():
+            disco = tmp_path / "disco"
+            disco.mkdir()
+            (disco / "web").write_text("127.0.0.1 1\n")
+            cfg = f"""
+admin: {{port: 0, httpIdentifierPort: 0}}
+routers:
+- protocol: http
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers: [{{port: 0}}]
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+            linker = load_linker(cfg)
+            assert linker.spec.admin.httpIdentifierPort == 0
+            await linker.start()
+            srv = await mk_identifier_server(
+                linker, linker.spec.admin.httpIdentifierPort)
+            client = HttpClient("127.0.0.1", srv.bound_port)
+            try:
+                rsp = await client(Request(
+                    uri="/?method=GET&host=web&path=/x"))
+                assert rsp.status == 200
+                got = json.loads(rsp.body)
+                label = linker.routers[0].label
+                assert got[label]["path"] == "/svc/web"
+            finally:
+                await client.close()
+                await srv.close()
+                await linker.close()
+
+        run(go())
