@@ -1192,6 +1192,23 @@ void handle_upstream_frame(Engine* e, H2Conn* c, uint8_t type,
         auto it = c->streams.find(sid);
         if (it != c->streams.end()) {
             PStream* st = it->second;
+            if (code == h2::REFUSED_STREAM) {
+                // RFC 7540 §8.1.4: REFUSED_STREAM guarantees no
+                // processing happened — safe to replay. The common
+                // cause is the race where we dispatched a burst before
+                // the server's MAX_CONCURRENT_STREAMS SETTINGS arrived;
+                // by now they have, so the retry queues on the slot.
+                c->streams.erase(st->uid);
+                if (c->active_streams > 0) c->active_streams--;
+                st->uc = nullptr;  // unlinked here; stays null on failure
+                st->uid = 0;
+                bool replayed = replay_stream(e, st);
+                // the freed slot must wake queued dispatches on THIS
+                // conn — the replay may have routed elsewhere, and
+                // finish_stream's wakeup sees uc == nullptr
+                dispatch_from_queue(e, c);
+                if (replayed) break;
+            }
             st->status = 502;
             if (st->cc != nullptr) {
                 if (st->rsp_started || st->rsp_end_sent) {

@@ -214,6 +214,43 @@ class TestH2FastPathEngine:
 
         run(go())
 
+    def test_upstream_max_concurrent_streams_queueing(self):
+        """A backend advertising MAX_CONCURRENT_STREAMS=1 forces the
+        engine to queue dispatches on its multiplexed upstream conn;
+        all requests must still complete (ref: pend_dispatch in
+        h2_fastpath.cpp, finagle's slot waiting)."""
+        disp = ServerDispatcher()
+
+        async def slow_echo(req: Echo) -> Echo:
+            await asyncio.sleep(0.02)
+            return Echo(payload=req.payload)
+
+        disp.register_all(ECHO_SVC, {"Echo": slow_echo})
+
+        async def go():
+            eng = native.H2FastPathEngine()
+            port = eng.listen("127.0.0.1", 0)
+            eng.start()
+            backend = await H2Server(
+                disp, h2_settings={"max_concurrent_streams": 1}).start()
+            eng.set_route("echo", [("127.0.0.1", backend.bound_port)])
+            h2c = H2Client("127.0.0.1", port)
+            client = ClientDispatcher(h2c, authority="echo")
+            try:
+                outs = await asyncio.wait_for(asyncio.gather(*[
+                    client.unary(ECHO_SVC, "Echo", Echo(payload=b"q%d" % i))
+                    for i in range(8)]), 30)
+                assert all(o.payload == b"q%d" % i
+                           for i, o in enumerate(outs))
+                stats = eng.stats()["routes"]["echo"]
+                assert stats["success"] == 8
+            finally:
+                await h2c.close()
+                eng.close()
+                await backend.close()
+
+        run(go())
+
     def test_grpc_error_status_trailer_passthrough(self):
         """grpc-status trailers (the gRPC error channel) must survive the
         proxy hop byte-for-byte (ref: GrpcClassifier.scala reads them)."""
