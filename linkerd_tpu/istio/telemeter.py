@@ -128,3 +128,39 @@ class IstioTelemeter(Telemeter):
                 asyncio.get_running_loop().create_task(h2.close())
             except RuntimeError:
                 pass
+
+
+class _IstioLoggerFilter(MixerReportFilter):
+    """MixerReportFilter owning a private telemeter (the logger plugin
+    shape: materialized per router, closed with the linker)."""
+
+    def close(self) -> None:
+        self.telemeter.close()
+
+
+@register("logger", "io.l5d.k8s.istio")
+@dataclass
+class IstioLoggerConfig:
+    """Request-logger plugin reporting each response to istio-mixer —
+    the reference's logger-plugin wiring of the same mixer machinery the
+    io.l5d.istio telemeter uses (ref IstioLogger.scala:15-35 + the h2
+    twin; kind io.l5d.k8s.istio under `loggers`)."""
+
+    mixerHost: str = "istio-mixer"
+    mixerPort: int = 9091
+    sourceApp: str = "linkerd"
+    targetVersion: str = ""
+
+    def mk(self, metrics=None) -> Filter:
+        # given the linker tree, the istio reports/report_failures
+        # counters surface in /admin/metrics.json like the telemeter's
+        if metrics is None:
+            from linkerd_tpu.telemetry.metrics import MetricsTree
+            metrics = MetricsTree()
+        tele = IstioTelemeter(
+            IstioTelemeterConfig(
+                mixerHost=self.mixerHost, mixerPort=self.mixerPort,
+                sourceApp=self.sourceApp,
+                targetVersion=self.targetVersion),
+            metrics)
+        return _IstioLoggerFilter(tele)

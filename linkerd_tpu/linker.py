@@ -602,6 +602,9 @@ class Linker:
             self._mk_client_validator(label))
         metrics = self.metrics
         mk_policy_factory = self._mk_policy_factory_fn(label)
+        # request-logger plugin chain, same client-stack position as the
+        # http router (ref: the h2 H2LoggerConfig plugin point)
+        logger_filters = self._mk_logger_filters(rspec, label)
 
         def client_factory(bound: BoundName) -> Service:
             code = _status_code_of(bound)
@@ -633,6 +636,7 @@ class Linker:
             bal = mk_balancer(bal_kind, bound.addr, endpoint_factory)
             filters: List[Any] = [
                 H2StreamStatsFilter(metrics, "rt", label, "client", cid)]
+            filters.extend(logger_filters)
             metrics.scope("rt", label, "client", cid).gauge(
                 "endpoints", fn=lambda b=bal: b.size)
             return _PruneOnClose(
@@ -997,6 +1001,29 @@ class Linker:
             raise ConfigError(
                 f"{label}: service policy (classifier/retries/timeout) "
                 f"is not supported with fastPath: true")
+        if rspec.loggers:
+            # the native engine has no Python per-request hook; an
+            # ignored audit log is worse than a load failure
+            raise ConfigError(
+                f"{label}: loggers are not supported with fastPath: true")
+
+    def _mk_logger_filters(self, rspec: RouterSpec, label: str) -> List[Any]:
+        """Per-router request-logger plugin chain (ref: HttpLoggerConfig /
+        H2LoggerConfig `loggers`): validated + materialized ONCE at
+        router build (bad configs fail load, not the first request),
+        shared by every client, closed with the linker. Kinds whose
+        ``mk`` accepts a ``metrics`` argument get the linker tree so
+        their counters surface in /admin/metrics.json."""
+        import inspect
+
+        filters: List[Any] = []
+        for cfg in instantiate_list("logger", rspec.loggers,
+                                    f"{label}.loggers"):
+            params = inspect.signature(cfg.mk).parameters
+            filters.append(cfg.mk(metrics=self.metrics)
+                           if "metrics" in params else cfg.mk())
+        self._logger_filters.extend(filters)
+        return filters
 
     def _mk_fastpath_router(self, rspec: RouterSpec, label: str) -> Router:
         """http or h2 router served by the native engine (fastPath: true).
@@ -1029,12 +1056,6 @@ class Linker:
 
     def _mk_http_router(self, rspec: RouterSpec, label: str) -> Router:
         if rspec.fastPath:
-            if rspec.loggers:
-                # the native engine has no Python per-request hook; an
-                # ignored audit log is worse than a load failure
-                raise ConfigError(
-                    f"{label}: loggers are not supported with "
-                    f"fastPath: true")
             return self._mk_fastpath_router(rspec, label)
         base_dtab = Dtab.read(rspec.dtab) if rspec.dtab else Dtab.empty()
         prefix = Path.read(rspec.dstPrefix)
@@ -1049,13 +1070,7 @@ class Linker:
             self._mk_client_validator(label))
         metrics = self.metrics
         mk_policy_factory = self._mk_policy_factory_fn(label)
-        # logger plugin chain: validated + materialized ONCE at router
-        # build (bad configs fail load, not the first request), shared by
-        # every client, and closed with the linker
-        logger_filters = [
-            cfg.mk() for cfg in instantiate_list(
-                "logger", rspec.loggers, f"{label}.loggers")]
-        self._logger_filters.extend(logger_filters)
+        logger_filters = self._mk_logger_filters(rspec, label)
 
         def client_factory(bound: BoundName) -> Service:
             code = _status_code_of(bound)

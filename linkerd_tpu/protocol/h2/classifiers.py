@@ -107,6 +107,28 @@ class H2RetryableIdempotent5XX:
         return _StatusClassifier(IDEMPOTENT_METHODS)
 
 
+class _AllSuccessfulClassifier(H2Classifier):
+    """Every response (any status) is a success; transport errors fail
+    NON-retryably, matching the http twin (router/classifiers.py
+    io.l5d.http.allSuccessful) — the request may have had side effects
+    before the transport died (ref: h2 AllSuccessfulInitializer)."""
+
+    def early(self, req, rsp):
+        return ResponseClass.SUCCESS if rsp is not None else None
+
+    def classify(self, req, rsp, trailers, exc):
+        if exc is not None:
+            return ResponseClass.FAILURE
+        return ResponseClass.SUCCESS
+
+
+@register("h2classifier", "io.l5d.h2.allSuccessful")
+@dataclass
+class H2AllSuccessful:
+    def mk(self) -> H2Classifier:
+        return _AllSuccessfulClassifier()
+
+
 class _GrpcClassifier(H2Classifier):
     """Success iff grpc-status == 0; retryability of failures per policy.
     Falls back to HTTP-status classification for non-gRPC responses."""

@@ -1200,6 +1200,11 @@ void handle_upstream_frame(Engine* e, H2Conn* c, uint8_t type,
                 // by now they have, so the retry queues on the slot.
                 c->streams.erase(st->uid);
                 if (c->active_streams > 0) c->active_streams--;
+                // reconcile the buffered counter now: with uc nulled,
+                // finish_stream's subtraction is unreachable and the
+                // leak would eventually pin the conn window shut
+                c->buffered -= st->c_pend.size();
+                st->c_pend.clear();
                 st->uc = nullptr;  // unlinked here; stays null on failure
                 st->uid = 0;
                 bool replayed = replay_stream(e, st);

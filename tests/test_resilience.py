@@ -298,3 +298,22 @@ class TestCacheEvictionUnderLoad:
             assert await asyncio.wait_for(task, 5) == "ok-r1"
 
         asyncio.run(asyncio.wait_for(go(), 15))
+
+
+class TestH2AllSuccessful:
+    def test_any_status_is_success_exc_retries(self):
+        """io.l5d.h2.allSuccessful: every response (incl. 5xx) succeeds;
+        only transport errors fail, retryably (ref h2
+        AllSuccessfulInitializer)."""
+        from linkerd_tpu.config import lookup
+        from linkerd_tpu.protocol.h2.messages import H2Request, H2Response
+
+        cls = lookup("h2classifier", "io.l5d.h2.allSuccessful")().mk()
+        req = H2Request(method="POST", path="/x")
+        assert cls.early(req, H2Response(status=500)) is ResponseClass.SUCCESS
+        assert cls.classify(req, H2Response(status=503), None,
+                            None) is ResponseClass.SUCCESS
+        # transport death is NON-retryable (side effects may have
+        # landed), matching the http allSuccessful twin
+        assert cls.classify(req, None, None, ConnectionError("boom")) \
+            is ResponseClass.FAILURE
