@@ -140,16 +140,12 @@ class _IstioLoggerFilter(MixerReportFilter):
 
 @register("logger", "io.l5d.k8s.istio")
 @dataclass
-class IstioLoggerConfig:
+class IstioLoggerConfig(IstioTelemeterConfig):
     """Request-logger plugin reporting each response to istio-mixer —
     the reference's logger-plugin wiring of the same mixer machinery the
     io.l5d.istio telemeter uses (ref IstioLogger.scala:15-35 + the h2
-    twin; kind io.l5d.k8s.istio under `loggers`)."""
-
-    mixerHost: str = "istio-mixer"
-    mixerPort: int = 9091
-    sourceApp: str = "linkerd"
-    targetVersion: str = ""
+    twin; kind io.l5d.k8s.istio under `loggers`). Inherits the
+    telemeter's mixer fields so the two kinds cannot drift."""
 
     def mk(self, metrics=None) -> Filter:
         # given the linker tree, the istio reports/report_failures
@@ -157,10 +153,4 @@ class IstioLoggerConfig:
         if metrics is None:
             from linkerd_tpu.telemetry.metrics import MetricsTree
             metrics = MetricsTree()
-        tele = IstioTelemeter(
-            IstioTelemeterConfig(
-                mixerHost=self.mixerHost, mixerPort=self.mixerPort,
-                sourceApp=self.sourceApp,
-                targetVersion=self.targetVersion),
-            metrics)
-        return _IstioLoggerFilter(tele)
+        return _IstioLoggerFilter(IstioTelemeter(self, metrics))

@@ -1016,6 +1016,15 @@ class Linker:
         their counters surface in /admin/metrics.json."""
         import inspect
 
+        # http-only kinds touch Request-shaped fields (req.uri) and
+        # would crash an h2 router's first request, not its load
+        if rspec.protocol != "http":
+            for raw in rspec.loggers or []:
+                kind = (raw or {}).get("kind", "")
+                if str(kind).startswith("io.l5d.http."):
+                    raise ConfigError(
+                        f"{label}.loggers: {kind} only supports http "
+                        f"routers")
         filters: List[Any] = []
         for cfg in instantiate_list("logger", rspec.loggers,
                                     f"{label}.loggers"):

@@ -146,6 +146,9 @@ def _declare_h2_fastpath(cdll: ctypes.CDLL) -> None:
                                          ctypes.c_long]
     cdll.fph2_shutdown.restype = None
     cdll.fph2_shutdown.argtypes = [ctypes.c_void_p]
+    cdll.fph2_set_response_timeout_ms.restype = None
+    cdll.fph2_set_response_timeout_ms.argtypes = [ctypes.c_void_p,
+                                                  ctypes.c_long]
 
 
 def _declare_fastpath(cdll: ctypes.CDLL) -> None:
@@ -287,6 +290,15 @@ class H2FastPathEngine(FastPathEngine):
     knowledge) on both sides and routes by ``:authority``."""
 
     _PREFIX = "fph2"
+
+    def set_response_timeout_ms(self, ms: int) -> None:
+        """Window within which a dispatched stream's backend must START
+        its response (504 otherwise); streaming bodies are unbounded.
+        Must be >= 1 (0 would time out everything immediately)."""
+        ms = int(ms)
+        if ms < 1:
+            raise ValueError("response timeout must be >= 1 ms")
+        self._lib.fph2_set_response_timeout_ms(self._e, ms)
 
 
 MAX_HEADERS = 1024

@@ -119,6 +119,10 @@ struct Engine {
     int epfd = -1;
     int wakefd = -1;
     bool shutting_down = false;  // teardown: no replays / new upstreams
+    // response HEADERS must start within this window once dispatched
+    // (the h1 engine's EXCHANGE_TIMEOUT analog); streaming bodies are
+    // unbounded. Atomic: set from the control thread.
+    std::atomic<uint64_t> response_start_timeout_us{30'000'000};
     std::atomic<bool> running{true};
     pthread_t thread;
     bool thread_started = false;
@@ -1242,6 +1246,10 @@ void handle_upstream_frame(Engine* e, H2Conn* c, uint8_t type,
         for (PStream* st : refused) {
             c->streams.erase(st->uid);
             if (c->active_streams > 0) c->active_streams--;
+            // reconcile buffered before nulling uc (same invariant as
+            // the REFUSED_STREAM path): finish_stream can't reach it
+            c->buffered -= st->c_pend.size();
+            st->c_pend.clear();
             st->uc = nullptr;
             st->uid = 0;
             if (replay_stream(e, st)) continue;
@@ -1361,6 +1369,34 @@ void sweep(Engine* e) {
         if (st->cc != nullptr)
             synth_response(e, st->cc, st->cid, 400, "no route");
         finish_stream(e, st, false);
+    }
+    // Response-START timeout (h1 engine's EXCHANGE_TIMEOUT analog): a
+    // dispatched stream whose backend hasn't produced response HEADERS
+    // within the window gets a 504. Gated on !rsp_started so long-lived
+    // streaming responses (gRPC watches) are untouched.
+    std::vector<PStream*> stalled;
+    for (auto& kv : e->conns) {
+        H2Conn* c = kv.second;
+        if (c->kind != H2Conn::Kind::CLIENT) continue;
+        for (auto& skv : c->streams) {
+            PStream* st = skv.second;
+            if (!st->parked && !st->rsp_started && st->t_start_us &&
+                now - st->t_start_us >
+                    e->response_start_timeout_us.load(
+                        std::memory_order_relaxed))
+                stalled.push_back(st);
+        }
+    }
+    for (PStream* st : stalled) {
+        if (st->closed) continue;
+        if (st->uc != nullptr && st->uid) {
+            h2::write_rst(&st->uc->out, st->uid, h2::CANCEL);
+            flush_out(e, st->uc);
+        }
+        st->status = 504;
+        if (st->cc != nullptr && !st->cc->dead)
+            synth_response(e, st->cc, st->cid, 504, "response timeout");
+        finish_stream(e, st, true);
     }
 }
 
@@ -1542,6 +1578,13 @@ int fph2_set_route(void* ep, const char* host, const char* endpoints) {
     ssize_t r = ::write(e->wakefd, &v, sizeof(v));
     (void)r;
     return 0;
+}
+
+void fph2_set_response_timeout_ms(void* ep, long ms) {
+    Engine* e = (Engine*)ep;
+    if (ms < 1) return;  // 0/negative would 504 everything / wrap
+    e->response_start_timeout_us.store((uint64_t)ms * 1000,
+                                       std::memory_order_relaxed);
 }
 
 int fph2_remove_route(void* ep, const char* host) {

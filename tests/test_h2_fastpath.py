@@ -403,3 +403,40 @@ class TestGrpcioInterop:
             server_box["eng"].close()
             loop.run_until_complete(server_box["backend"].close())
             loop.close()
+
+
+class TestResponseStartTimeout:
+    def test_hung_backend_gets_504(self):
+        """A dispatched stream whose backend never starts its response
+        times out with 504 (the h1 engine's exchange-timeout analog);
+        the upstream side is reset."""
+        from linkerd_tpu.protocol.h2.messages import H2Request
+
+        async def go():
+            hung = asyncio.Event()
+
+            async def never(req):
+                await hung.wait()  # never set
+
+            backend = await H2Server(FnService(never)).start()
+            eng = native.H2FastPathEngine()
+            port = eng.listen("127.0.0.1", 0)
+            eng.set_response_timeout_ms(300)
+            eng.start()
+            eng.set_route("hang", [("127.0.0.1", backend.bound_port)])
+            h2c = H2Client("127.0.0.1", port)
+            try:
+                rsp = await asyncio.wait_for(
+                    h2c(H2Request(method="GET", path="/x",
+                                  authority="hang")), 10)
+                assert rsp.status == 504
+                assert rsp.headers.get("l5d-err") is not None
+                stats = eng.stats()["routes"]["hang"]
+                assert stats["f5xx"] == 1
+            finally:
+                hung.set()
+                await h2c.close()
+                eng.close()
+                await backend.close()
+
+        run(go())
