@@ -192,7 +192,11 @@ class RouterSpec:
     bindingCache: Optional[Dict[str, Any]] = None
     sampleRate: float = 1.0               # trace sampling for new roots
     httpAccessLog: Optional[str] = None   # path or "stdout"
-    addForwardedHeader: bool = False      # RFC 7239 (AddForwardedHeader)
+    # RFC 7239 Forwarded header: false (off), true (reference defaults:
+    # obfuscated per-request labels), or {by: {kind: ...}, for: {...}}
+    # with kinds ip | ip:port | requestRandom | connectionRandom |
+    # router | static (ref: AddForwardedHeaderConfig.scala)
+    addForwardedHeader: Any = False
     # h2 only: advertised SETTINGS (ref: H2Config.scala
     # initialStreamWindowBytes/maxFrameBytes/maxHeaderListBytes/
     # maxConcurrentStreamsPerConnection)
@@ -1190,14 +1194,33 @@ class Linker:
         from linkerd_tpu.protocol.http.filters import (
             AddForwardedHeaderFilter, ClearContextFilter, FramingFilter,
             ProxyRewriteFilter, StripHopByHopHeadersFilter,
-            ViaHeaderAppenderFilter,
+            ViaHeaderAppenderFilter, mk_forwarded_labeler,
         )
         server_filters += [
             FramingFilter(), ProxyRewriteFilter(),
             StripHopByHopHeadersFilter(), ViaHeaderAppenderFilter(),
         ]
-        if rspec.addForwardedHeader:
-            server_filters.append(AddForwardedHeaderFilter())
+        # bool true -> reference defaults (obfuscated per-request random
+        # for both); a mapping (INCLUDING an empty one — presence
+        # enables, like the reference) configures by/for labelers
+        # (ref: AddForwardedHeaderConfig.scala kinds)
+        if rspec.addForwardedHeader or isinstance(
+                rspec.addForwardedHeader, dict):
+            fwd_cfg = (rspec.addForwardedHeader
+                       if isinstance(rspec.addForwardedHeader, dict)
+                       else {})
+            unknown = set(fwd_cfg) - {"by", "for"}
+            if unknown:
+                raise ConfigError(
+                    f"{label}.addForwardedHeader: unknown fields "
+                    f"{sorted(unknown)}")
+            try:
+                by = mk_forwarded_labeler(fwd_cfg.get("by"), label)
+                for_ = mk_forwarded_labeler(fwd_cfg.get("for"), label)
+            except ValueError as e:
+                raise ConfigError(
+                    f"{label}.addForwardedHeader: {e}") from None
+            server_filters.append(AddForwardedHeaderFilter(by, for_))
         server_filters.append(ErrorResponder())
         server_stack = filters_to_service(server_filters, routing)
 

@@ -89,24 +89,112 @@ class ViaHeaderAppenderFilter(Filter[Request, Response]):
         return rsp
 
 
+def _clear_ip(addr: Optional[tuple]) -> str:
+    if not addr:
+        return "unknown"
+    host = addr[0]
+    if ":" in host:  # IPv6 must be bracketed+quoted per RFC 7239
+        return f'"[{host}]"'
+    return host
+
+
+def _clear_ip_port(addr: Optional[tuple]) -> str:
+    if not addr:
+        return "unknown"
+    host, port = addr[0], addr[1]
+    if ":" in host:
+        return f'"[{host}]:{port}"'
+    return f'"{host}:{port}"'  # node with port must be quoted (§6)
+
+
+_OBFUSCATED_ALPHABET = "abcdefghijklmnopqrstuvwxyz0123456789"
+
+
+def _random_label(length: int = 6) -> str:
+    import random
+    return "_" + "".join(random.choice(_OBFUSCATED_ALPHABET)
+                         for _ in range(length))
+
+
+import re as _re
+
+_OBFUSCATED_LABEL_RE = _re.compile(r"^[A-Za-z0-9._-]+$")
+
+
+def mk_forwarded_labeler(cfg: Optional[dict], router_label: str):
+    """One RFC 7239 node labeler from config (ref:
+    AddForwardedHeaderConfig.scala:9-72 — kinds ip, ip:port,
+    requestRandom, connectionRandom, router, static; default
+    requestRandom, matching AddForwardedHeader.Labeler.By/For.default).
+
+    -> callable(addr_tuple, conn_key) -> str. ``addr_tuple`` is the node
+    being labeled; ``conn_key`` identifies the client CONNECTION (so a
+    connectionRandom ``by`` doesn't degenerate to one label for the
+    shared listener address). The per-connection cache keys on the
+    client peer (ip, port) — an approximation of the reference's
+    per-Channel labeling that can reuse a label across an ephemeral-port
+    reuse; bounded by FIFO eviction."""
+    if cfg is not None and not isinstance(cfg, dict):
+        raise ValueError(f"labeler config must be a mapping with 'kind', "
+                         f"got {cfg!r}")
+    kind = (cfg or {}).get("kind", "requestRandom")
+    if kind == "ip":
+        return lambda addr, conn_key: _clear_ip(addr)
+    if kind == "ip:port":
+        return lambda addr, conn_key: _clear_ip_port(addr)
+    if kind == "requestRandom":
+        return lambda addr, conn_key: _random_label()
+    if kind == "connectionRandom":
+        labels: dict = {}
+
+        def per_conn(addr: Optional[tuple], conn_key) -> str:
+            key = tuple(conn_key) if conn_key else None
+            got = labels.get(key)
+            if got is None:
+                while len(labels) > 4096:  # FIFO: evict oldest entries
+                    labels.pop(next(iter(labels)))
+                got = labels[key] = _random_label()
+            return got
+
+        return per_conn
+
+    def _checked(label: str, what: str) -> str:
+        # RFC 7239 §6.3 obfuscated identifier syntax: anything else
+        # (spaces, ';', ',') would corrupt or forge the header
+        if not _OBFUSCATED_LABEL_RE.match(label):
+            raise ValueError(
+                f"{what} {label!r} is not a valid Forwarded label "
+                f"(ALPHA / DIGIT / '.' / '_' / '-')")
+        return f"_{label}"
+
+    if kind == "router":
+        lbl = _checked(router_label, "router label")
+        return lambda addr, conn_key, _l=lbl: _l
+    if kind == "static":
+        label = (cfg or {}).get("label")
+        if not label:
+            raise ValueError("static labeler needs 'label'")
+        lbl = _checked(str(label), "static label")
+        return lambda addr, conn_key, _l=lbl: _l
+    raise ValueError(f"unknown Forwarded labeler kind {kind!r}")
+
+
 class AddForwardedHeaderFilter(Filter[Request, Response]):
     """RFC 7239 ``Forwarded: for=...;by=...`` (ref:
-    AddForwardedHeader.scala:185; config-gated, off by default since it
-    adds per-request allocation)."""
+    AddForwardedHeader.scala:185 + AddForwardedHeaderConfig.scala;
+    config-gated, off by default since it adds per-request allocation).
+    ``by``/``for`` labelers default to per-request obfuscated random
+    like the reference."""
 
-    @staticmethod
-    def _elem(addr: Optional[tuple]) -> str:
-        if not addr:
-            return "unknown"
-        host = addr[0]
-        if ":" in host:  # IPv6 must be bracketed+quoted per RFC 7239
-            return f'"[{host}]"'
-        return host
+    def __init__(self, by=None, for_=None):
+        self._by = by or (lambda addr, conn_key: _random_label())
+        self._for = for_ or (lambda addr, conn_key: _random_label())
 
     async def apply(self, req: Request, service: Service) -> Response:
         client = req.ctx.get("client_addr")
         server = req.ctx.get("server_addr")
-        elem = f"for={self._elem(client)};by={self._elem(server)}"
+        elem = (f"for={self._for(client, client)};"
+                f"by={self._by(server, client)}")
         existing = req.headers.get("forwarded")
         req.headers.set("Forwarded",
                         f"{existing}, {elem}" if existing else elem)

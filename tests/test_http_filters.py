@@ -73,16 +73,60 @@ class TestFilters:
         run(go())
 
     def test_forwarded_rfc7239(self):
+        from linkerd_tpu.protocol.http.filters import mk_forwarded_labeler
+
         async def go():
+            # explicit clear-ip labelers (kind: ip), the pre-round-4 wire
+            # format
             seen = []
             svc = filters_to_service(
-                [AddForwardedHeaderFilter()], echo_service(seen))
+                [AddForwardedHeaderFilter(
+                    by=mk_forwarded_labeler({"kind": "ip"}, "r"),
+                    for_=mk_forwarded_labeler({"kind": "ip"}, "r"))],
+                echo_service(seen))
             req = Request(uri="/")
             req.ctx["client_addr"] = ("10.0.0.9", 55555)
             req.ctx["server_addr"] = ("10.0.0.1", 4140)
             await svc(req)
             assert seen[0].headers.get("forwarded") == \
                 "for=10.0.0.9;by=10.0.0.1"
+
+            # default labelers obfuscate (ref By/For.default =
+            # ObfuscatedRandom.PerRequest): a fresh _label per request
+            seen2 = []
+            svc2 = filters_to_service(
+                [AddForwardedHeaderFilter()], echo_service(seen2))
+            req2 = Request(uri="/")
+            req2.ctx["client_addr"] = ("10.0.0.9", 55555)
+            await svc2(req2)
+            await svc2(Request(uri="/"))
+            h1 = seen2[0].headers.get("forwarded")
+            h2 = seen2[1].headers.get("forwarded")
+            assert h1.startswith("for=_") and ";by=_" in h1
+            assert h1 != h2  # per-request randomness
+
+            # kinds: ip:port quoting, router + static obfuscated labels
+            ipport = mk_forwarded_labeler({"kind": "ip:port"}, "r")
+            assert ipport(("10.0.0.9", 55555), None) == '"10.0.0.9:55555"'
+            router = mk_forwarded_labeler({"kind": "router"}, "myrt")
+            assert router(None, None) == "_myrt"
+            static = mk_forwarded_labeler(
+                {"kind": "static", "label": "dmz"}, "r")
+            assert static(None, None) == "_dmz"
+            # header-injection labels are refused (RFC 7239 §6.3 syntax)
+            import pytest as _pytest
+            with _pytest.raises(ValueError):
+                mk_forwarded_labeler(
+                    {"kind": "static", "label": "dmz; by=evil"}, "r")
+
+            # connectionRandom: keyed on the CONNECTION (so a `by`
+            # labeler doesn't collapse on the shared listener addr) —
+            # stable per conn_key, distinct across conn_keys
+            conn = mk_forwarded_labeler({"kind": "connectionRandom"}, "r")
+            listener = ("10.0.0.1", 4140)
+            a1 = conn(listener, ("1.1.1.1", 10))
+            assert a1 == conn(listener, ("1.1.1.1", 10))
+            assert a1 != conn(listener, ("1.1.1.1", 11))
         run(go())
 
     def test_proxy_rewrite_absolute_uri(self):
