@@ -31,7 +31,8 @@ from linkerd_tpu.router.balancer import mk_balancer
 from linkerd_tpu.router.binding import DstBindingFactory, DstPath
 from linkerd_tpu.router.failure_accrual import FailureAccrualService
 from linkerd_tpu.router.retries import (
-    ClassifiedRetries, RetryBudget, TotalTimeout, backoff_jittered,
+    ClassifiedRetries, RequeueFilter, RetryBudget, TotalTimeout,
+    backoff_jittered,
 )
 from linkerd_tpu.router.routing import (
     BasicStatsFilter, ErrorResponder, IdentificationError,
@@ -135,6 +136,13 @@ class ClientSpec:
     connectTimeoutMs: int = 3000
     failureAccrual: Optional[Dict[str, Any]] = None  # kind-discriminated
     tls: Optional[TlsClientConfig] = None
+    # ref ClientConfig.scala:23-35 — per-attempt timeout (each balancer
+    # pick, inside requeues/retries), connect-failure requeues against a
+    # budget, and fail-fast endpoint marking (off by default for
+    # routers, Router.scala:374)
+    requestAttemptTimeoutMs: Optional[int] = None
+    requeueBudget: Optional["BudgetSpec"] = None
+    failFast: bool = False
 
 
 @dataclass
@@ -601,6 +609,21 @@ class Linker:
         interpreter = self._mk_interpreter(rspec, label)
         validate_svc = self._mk_svc_validator(label, "h2classifier")
 
+        def _client_has(raw, name: str) -> bool:
+            if not isinstance(raw, dict):
+                return False
+            if raw.get("kind") == "io.l5d.static":
+                return any(isinstance(c, dict) and name in c
+                           for c in (raw.get("configs") or []))
+            return name in raw
+
+        if _client_has(rspec.client, "requeueBudget"):
+            # a requeued h2 request would replay an already-consumed
+            # one-shot stream; the buffered-replay machinery lives in
+            # service retries (H2ClassifiedRetries)
+            raise ConfigError(
+                f"{label}: client.requeueBudget is not supported on h2 "
+                f"routers; use service retries (buffered replay)")
         client_lookup = per_prefix_lookup(
             rspec.client, ClientSpec, f"{label}.client",
             self._mk_client_validator(label))
@@ -623,6 +646,8 @@ class Linker:
             cid = bound.id_.show.lstrip("/").replace("/", ".") or "client"
             cspec, cvars = client_lookup(bound.id_)
             mk_policy = mk_policy_factory(cspec)
+            ep_wrap, extra_filters = self._client_stack_extras(
+                cspec, label, cid)
             ssl_ctx = sni = None
             if cspec.tls is not None:
                 sni = cspec.tls.server_hostname(cvars)
@@ -634,12 +659,14 @@ class Linker:
                     connect_timeout=cspec.connectTimeoutMs / 1e3,
                     ssl_context=ssl_ctx, server_hostname=sni,
                     h2_settings=h2_settings)
-                return FailureAccrualService(client, mk_policy())
+                return FailureAccrualService(ep_wrap(client),
+                                             mk_policy())
 
             bal_kind = (cspec.loadBalancer or BalancerSpec()).kind
             bal = mk_balancer(bal_kind, bound.addr, endpoint_factory)
             filters: List[Any] = [
                 H2StreamStatsFilter(metrics, "rt", label, "client", cid)]
+            filters.extend(extra_filters)
             filters.extend(logger_filters)
             metrics.scope("rt", label, "client", cid).gauge(
                 "endpoints", fn=lambda b=bal: b.size)
@@ -787,12 +814,15 @@ class Linker:
             cid = bound.id_.show.lstrip("/").replace("/", ".") or "client"
             cspec, _cvars = client_lookup(bound.id_)
             mk_policy = mk_policy_factory(cspec)
+            ep_wrap, extra_filters = self._client_stack_extras(
+                cspec, label, cid)
 
             def endpoint_factory(addr: Address) -> Service:
                 client: Service = MuxClient(
                     addr.host, addr.port,
                     connect_timeout=cspec.connectTimeoutMs / 1e3)
-                return FailureAccrualService(client, mk_policy())
+                return FailureAccrualService(ep_wrap(client),
+                                             mk_policy())
 
             bal_kind = (cspec.loadBalancer or BalancerSpec()).kind
             bal = mk_balancer(bal_kind, bound.addr, endpoint_factory)
@@ -801,7 +831,8 @@ class Linker:
             return _PruneOnClose(
                 filters_to_service(
                     [MuxStatsFilter(
-                        metrics.scope("rt", label, "client", cid))], bal),
+                        metrics.scope("rt", label, "client", cid)),
+                     *extra_filters], bal),
                 metrics, ("rt", label, "client", cid))
 
         def bound_filters(bound: BoundName, svc: Service) -> Service:
@@ -922,6 +953,8 @@ class Linker:
             cid = bound.id_.show.lstrip("/").replace("/", ".") or "client"
             cspec, _cvars = client_lookup(bound.id_)
             mk_policy = mk_policy_factory(cspec)
+            ep_wrap, extra_filters = self._client_stack_extras(
+                cspec, label, cid)
 
             def endpoint_factory(addr: Address) -> Service:
                 client: Service = ThriftClient(
@@ -931,7 +964,8 @@ class Linker:
                     dest=bound.id_.show, client_id=label,
                     framed=rspec.thriftFramed,
                     protocol=rspec.thriftProtocol)
-                return FailureAccrualService(client, mk_policy())
+                return FailureAccrualService(ep_wrap(client),
+                                             mk_policy())
 
             bal_kind = (cspec.loadBalancer or BalancerSpec()).kind
             bal = mk_balancer(bal_kind, bound.addr, endpoint_factory)
@@ -940,7 +974,8 @@ class Linker:
             return _PruneOnClose(
                 filters_to_service(
                     [ThriftStatsFilter(
-                        metrics.scope("rt", label, "client", cid))], bal),
+                        metrics.scope("rt", label, "client", cid)),
+                     *extra_filters], bal),
                 metrics, ("rt", label, "client", cid))
 
         svc_lookup = per_prefix_lookup(
@@ -1010,6 +1045,28 @@ class Linker:
             # ignored audit log is worse than a load failure
             raise ConfigError(
                 f"{label}: loggers are not supported with fastPath: true")
+
+    def _client_stack_extras(self, cspec: "ClientSpec", label: str,
+                             cid: str):
+        """ClientConfig parity knobs shared by every protocol's client
+        stack: -> (endpoint_wrap, filters_above_balancer). Order in the
+        stack: requeue OUTSIDE the per-attempt timeout (each re-pick is
+        re-timed); failFast wraps the endpoint below accrual."""
+        from linkerd_tpu.router.failure_accrual import FailFastService
+
+        filters: List[Any] = []
+        if cspec.requeueBudget is not None:
+            b = cspec.requeueBudget
+            filters.append(RequeueFilter(
+                RetryBudget(b.ttlSecs, b.minRetriesPerSec,
+                            b.percentCanRetry),
+                metrics_scope=self.metrics.scope(
+                    "rt", label, "client", cid)))
+        if cspec.requestAttemptTimeoutMs is not None:
+            filters.append(TotalTimeout(
+                cspec.requestAttemptTimeoutMs / 1e3))
+        wrap = FailFastService if cspec.failFast else (lambda s: s)
+        return wrap, filters
 
     def _mk_logger_filters(self, rspec: RouterSpec, label: str) -> List[Any]:
         """Per-router request-logger plugin chain (ref: HttpLoggerConfig /
@@ -1097,6 +1154,8 @@ class Linker:
             cid = bound.id_.show.lstrip("/").replace("/", ".") or "client"
             cspec, cvars = client_lookup(bound.id_)
             mk_policy = mk_policy_factory(cspec)
+            ep_wrap, extra_filters = self._client_stack_extras(
+                cspec, label, cid)
 
             ssl_ctx = sni = None
             if cspec.tls is not None:
@@ -1111,7 +1170,8 @@ class Linker:
                     ssl_context=ssl_ctx, server_hostname=sni)
                 # per-endpoint accrual (ref: FailureAccrualFactory sits below
                 # the balancer in the client stack, Router.scala:318)
-                return FailureAccrualService(client, mk_policy())
+                return FailureAccrualService(ep_wrap(client),
+                                             mk_policy())
 
             bal_kind = (cspec.loadBalancer or BalancerSpec()).kind
             bal = mk_balancer(bal_kind, bound.addr, endpoint_factory)
@@ -1120,6 +1180,7 @@ class Linker:
                 StatsFilter(metrics, "rt", label, "client", cid),
                 DstHeadersFilter(cid),
             ]
+            filters.extend(extra_filters)
             # per-router logger plugin chain, client-stack position
             # (ref: HttpConfig.scala insertAfter DtabStatsFilter);
             # materialized ONCE per router — see logger_filters below
@@ -1204,6 +1265,10 @@ class Linker:
         # for both); a mapping (INCLUDING an empty one — presence
         # enables, like the reference) configures by/for labelers
         # (ref: AddForwardedHeaderConfig.scala kinds)
+        if not isinstance(rspec.addForwardedHeader, (bool, dict)):
+            raise ConfigError(
+                f"{label}.addForwardedHeader must be a bool or a "
+                f"mapping, got {rspec.addForwardedHeader!r}")
         if rspec.addForwardedHeader or isinstance(
                 rspec.addForwardedHeader, dict):
             fwd_cfg = (rspec.addForwardedHeader

@@ -14,6 +14,7 @@ interval (ref: FailureAccrualFactory's ProbeOpen/ProbeClosed states).
 from __future__ import annotations
 
 import abc
+import asyncio
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -252,3 +253,76 @@ class SuccessRateWindowedConfig:
 class NoneConfig:
     def mk(self) -> FailureAccrualPolicy:
         return NonePolicy()
+
+
+class FailFastService(Service):
+    """finagle-style fail-fast on CONNECT failures: a connection-level
+    failure marks this endpoint Busy with exponentially backed-off
+    probing (1s doubling to 30s), so the balancer steers around a down
+    host between probes (ref: FailFastFactory via ClientConfig.failFast;
+    disabled by default for routers, Router.scala:374).
+
+    Distinct from failure accrual, which reacts to RESPONSE outcomes —
+    this reacts only to ConnectionError (the request never made it out).
+    """
+
+    _MIN_BACKOFF_S = 1.0
+    _MAX_BACKOFF_S = 30.0
+
+    def __init__(self, underlying: Service):
+        self._svc = underlying
+        self._down_until: Optional[float] = None
+        self._backoff_s = self._MIN_BACKOFF_S
+        self._probing = False
+
+    @property
+    def status(self) -> Status:
+        if self._down_until is not None:
+            if time.monotonic() >= self._down_until and not self._probing:
+                return Status.OPEN  # one probe may go
+            return Status.BUSY
+        return self._svc.status
+
+    async def __call__(self, req):
+        probing = False
+        if self._down_until is not None:
+            if time.monotonic() >= self._down_until and not self._probing:
+                self._probing = True
+                probing = True
+        try:
+            rsp = await self._svc(req)
+        except ConnectionError:
+            now = time.monotonic()
+            if probing:
+                # a FAILED PROBE advances the backoff; concurrent
+                # in-flight failures from one outage event must not
+                # each double it
+                self._probing = False
+                self._backoff_s = min(self._backoff_s * 2,
+                                      self._MAX_BACKOFF_S)
+                self._down_until = now + self._backoff_s
+            elif self._down_until is None:
+                self._down_until = now + self._backoff_s
+            raise
+        except asyncio.CancelledError:
+            if probing:
+                # outcome unknown: release the probe slot (the expired
+                # deadline admits the next probe) without reviving
+                self._probing = False
+            raise
+        except Exception:
+            if probing:
+                self._probing = False
+                self._revive()
+            raise  # non-connect failure: the host is reachable
+        if probing or self._down_until is not None:
+            self._probing = False
+            self._revive()
+        return rsp
+
+    def _revive(self) -> None:
+        self._down_until = None
+        self._backoff_s = self._MIN_BACKOFF_S
+
+    async def close(self) -> None:
+        await self._svc.close()

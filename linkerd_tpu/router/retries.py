@@ -155,3 +155,35 @@ class TotalTimeout(Filter[Request, Response]):
         except asyncio.TimeoutError:
             raise TimeoutError(
                 f"total timeout of {self.timeout_s}s exceeded") from None
+
+
+class RequeueFilter(Filter[Request, Response]):
+    """Client-layer requeues: a request that failed BEFORE a response
+    began (connect refused, pool exhausted — surfaced as
+    ConnectionError) retries immediately against the balancer, budgeted
+    (ref: finagle Requeues via ClientConfig.requeueBudget). Sits ABOVE
+    the balancer so each attempt re-picks an endpoint; write-failures
+    after a response started are NOT requeued (the downstream may have
+    processed the request)."""
+
+    def __init__(self, budget: RetryBudget, max_requeues: int = 25,
+                 metrics_scope=None):
+        self._budget = budget
+        self._max = max_requeues
+        self._counter = (metrics_scope.counter("requeues")
+                         if metrics_scope is not None else None)
+
+    async def apply(self, req: Request, service: Service) -> Response:
+        # one deposit per EXTERNAL request (like ClassifiedRetries) —
+        # depositing per attempt would let requeues fund themselves
+        self._budget.deposit()
+        attempts = 0
+        while True:
+            try:
+                return await service(req)
+            except ConnectionError:
+                attempts += 1
+                if attempts > self._max or not self._budget.try_withdraw():
+                    raise
+                if self._counter is not None:
+                    self._counter.incr()
