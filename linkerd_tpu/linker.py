@@ -122,6 +122,9 @@ class ServerSpec:
     clearContext: bool = False
     # announce paths, e.g. ["/#/io.l5d.fs/web"] (ref: servers[].announce)
     announce: Optional[List[str]] = None
+    # per-server request timeout (ref: ServerConfig.timeoutMs ->
+    # TimeoutFilter, Server.scala:85,96)
+    timeoutMs: Optional[int] = None
 
 
 @dataclass
@@ -722,11 +725,9 @@ class Linker:
 
         from linkerd_tpu.router.h2_layer import H2ClearContextFilter
 
-        def per_server_stack(s: ServerSpec) -> Service:
-            if s.clearContext:
-                return filters_to_service(
-                    [H2ClearContextFilter()], server_stack)
-            return server_stack
+        per_server_stack = self._per_server_stack_fn(
+            label, server_filters, routing, server_stack,
+            clear_filter=H2ClearContextFilter)
 
         servers = [
             H2Server(per_server_stack(s), s.ip, s.port,
@@ -863,10 +864,13 @@ class Linker:
             idle_ttl=float(cache_cfg.get("idleTtlSecs", 600.0)),
             bind_timeout=rspec.bindingTimeoutMs / 1e3)
         routing = RoutingService(identifier, binding)
-        server_stack = filters_to_service(
-            [MuxStatsFilter(metrics.scope("rt", label, "server"))], routing)
+        server_filters: List[Any] = [
+            MuxStatsFilter(metrics.scope("rt", label, "server"))]
+        server_stack = filters_to_service(server_filters, routing)
+        per_server_stack = self._per_server_stack_fn(
+            label, server_filters, routing, server_stack)
         servers = [
-            MuxServer(server_stack, s.ip, s.port)
+            MuxServer(per_server_stack(s), s.ip, s.port)
             for s in (rspec.servers or [ServerSpec()])
         ]
         return Router(rspec, label, server_stack, binding, servers,
@@ -1007,11 +1011,13 @@ class Linker:
             idle_ttl=float(cache_cfg.get("idleTtlSecs", 600.0)),
             bind_timeout=rspec.bindingTimeoutMs / 1e3)
         routing = RoutingService(identifier, binding)
-        server_stack = filters_to_service(
-            [ThriftStatsFilter(metrics.scope("rt", label, "server"))],
-            routing)
+        server_filters: List[Any] = [
+            ThriftStatsFilter(metrics.scope("rt", label, "server"))]
+        server_stack = filters_to_service(server_filters, routing)
+        per_server_stack = self._per_server_stack_fn(
+            label, server_filters, routing, server_stack)
         servers = [
-            ThriftServer(server_stack, s.ip, s.port,
+            ThriftServer(per_server_stack(s), s.ip, s.port,
                          ttwitter=rspec.attemptTTwitterUpgrade,
                          framed=rspec.thriftFramed,
                          protocol=rspec.thriftProtocol)
@@ -1045,6 +1051,12 @@ class Linker:
             # ignored audit log is worse than a load failure
             raise ConfigError(
                 f"{label}: loggers are not supported with fastPath: true")
+        for i, srv in enumerate(rspec.servers or []):
+            if srv.timeoutMs is not None:
+                raise ConfigError(
+                    f"{label}.servers[{i}].timeoutMs is not supported "
+                    f"with fastPath: true (the engine applies its own "
+                    f"timeouts)")
 
     def _client_stack_extras(self, cspec: "ClientSpec", label: str,
                              cid: str):
@@ -1067,6 +1079,31 @@ class Linker:
                 cspec.requestAttemptTimeoutMs / 1e3))
         wrap = FailFastService if cspec.failFast else (lambda s: s)
         return wrap, filters
+
+    def _per_server_stack_fn(self, label: str, server_filters: List[Any],
+                             routing: Service, shared_stack: Service,
+                             clear_filter: Optional[Callable] = None):
+        """Shared per-server stack builder (all four protocols): the
+        optional per-server TimeoutFilter (ref ServerConfig.timeoutMs,
+        Server.scala:85,96) sits INNERMOST — below the responder and
+        stats/access-log filters, so the mapped 504 is observed by
+        metrics and logs like any other response — and clearContext
+        strips headers outermost."""
+        def per_server(s: ServerSpec) -> Service:
+            if s.timeoutMs is not None and s.timeoutMs <= 0:
+                raise ConfigError(
+                    f"{label}.servers[].timeoutMs must be > 0, "
+                    f"got {s.timeoutMs}")
+            if s.timeoutMs is None and not s.clearContext:
+                return shared_stack
+            chain = list(server_filters)
+            if s.timeoutMs is not None:
+                chain.append(TotalTimeout(s.timeoutMs / 1e3))
+            if s.clearContext and clear_filter is not None:
+                chain.insert(0, clear_filter())
+            return filters_to_service(chain, routing)
+
+        return per_server
 
     def _mk_logger_filters(self, rspec: RouterSpec, label: str) -> List[Any]:
         """Per-router request-logger plugin chain (ref: HttpLoggerConfig /
@@ -1289,11 +1326,9 @@ class Linker:
         server_filters.append(ErrorResponder())
         server_stack = filters_to_service(server_filters, routing)
 
-        def per_server_stack(s: ServerSpec) -> Service:
-            if s.clearContext:
-                return filters_to_service(
-                    [ClearContextFilter()], server_stack)
-            return server_stack
+        per_server_stack = self._per_server_stack_fn(
+            label, server_filters, routing, server_stack,
+            clear_filter=ClearContextFilter)
 
         servers = [
             HttpServer(per_server_stack(s), s.ip, s.port,

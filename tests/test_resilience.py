@@ -422,3 +422,53 @@ namers:
             assert svc._down_until is None
 
         run(go())
+
+
+class TestServerTimeout:
+    def test_server_timeoutMs_504s_slow_service(self, tmp_path):
+        """servers[].timeoutMs caps a request at the server edge (ref
+        ServerConfig.timeoutMs -> TimeoutFilter, Server.scala:85)."""
+        from linkerd_tpu.linker import load_linker
+        from linkerd_tpu.protocol.http import Request, Response
+        from linkerd_tpu.protocol.http.client import HttpClient
+        from linkerd_tpu.protocol.http.server import serve
+        from linkerd_tpu.router.service import FnService
+
+        async def go():
+            async def slow(req):
+                await asyncio.sleep(1.0)
+                return Response(status=200)
+            backend = await serve(FnService(slow))
+            disco = tmp_path / "disco"
+            disco.mkdir()
+            (disco / "web").write_text(f"127.0.0.1 {backend.bound_port}\n")
+            cfg = f"""
+routers:
+- protocol: http
+  label: st
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers: [{{port: 0, timeoutMs: 100}}]
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+            linker = load_linker(cfg)
+            await linker.start()
+            proxy = HttpClient("127.0.0.1",
+                               linker.routers[0].server_ports[0])
+            try:
+                req = Request(uri="/")
+                req.headers.set("Host", "web")
+                rsp = await asyncio.wait_for(proxy(req), 5)
+                assert rsp.status == 504  # TimeoutError -> ErrorResponder
+                # the timeout sits INSIDE the stats chain: the mapped
+                # 504 must be visible to server metrics
+                flat = linker.metrics.flatten()
+                assert flat.get("rt/st/server/status/504", 0) >= 1, flat
+            finally:
+                await proxy.close()
+                await linker.close()
+                await backend.close()
+
+        run(go())
