@@ -366,6 +366,10 @@ class JaxAnomalyConfig:
 class JaxAnomalyTelemeter(Telemeter):
     def __init__(self, cfg: JaxAnomalyConfig, metrics: MetricsTree,
                  scorer: Optional[Scorer] = None):
+        if cfg.maxBatchesPerWake < 1:
+            # 0 would silently disable draining (NOT a sentinel like
+            # trainEveryBatches' 0 = never)
+            raise ValueError("maxBatchesPerWake must be >= 1")
         self.cfg = cfg
         self.metrics = metrics
         self.ring: Deque = collections.deque(maxlen=cfg.ringCapacity)
@@ -402,17 +406,18 @@ class JaxAnomalyTelemeter(Telemeter):
         try:
             while not self._stop.is_set():
                 await asyncio.sleep(interval)
-                await self._drain_burst(
-                    scorer, max_batches=self.cfg.maxBatchesPerWake)
+                await self._drain_burst(scorer)
         except asyncio.CancelledError:
             pass
 
     async def _drain_burst(self, scorer: Scorer,
-                           max_batches: int = 8) -> int:
+                           max_batches: Optional[int] = None) -> int:
         """Catch-up drain: under backlog, score several micro-batches
         per wake instead of one per interval — one full batch per 50ms
         caps at ~20k rows/s, below the proxy's saturation, and the ring
-        would otherwise shed newest-first under sustained load."""
+        would otherwise shed its OLDEST rows under sustained load."""
+        if max_batches is None:
+            max_batches = self.cfg.maxBatchesPerWake
         total = 0
         for _ in range(max_batches):
             n = await self.drain_once(scorer)

@@ -42,6 +42,7 @@
 #include <mutex>
 #include <string>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -50,6 +51,9 @@ constexpr size_t MAX_HEAD = 72 * 1024;
 constexpr int MAX_EVENTS = 256;
 constexpr uint64_t EXCHANGE_TIMEOUT_US = 30'000'000;
 constexpr uint64_t ROUTE_WAIT_TIMEOUT_US = 2'000'000;
+// an IDLE pooled conn no endpoint references (route churn orphaned it)
+// is closed after this much idle time (same constant in h2_fastpath)
+constexpr uint64_t ORPHAN_IDLE_TIMEOUT_US = 60'000'000;
 constexpr int LAT_BUCKETS = 28;  // log2 us buckets
 // Backpressure water marks: when a conn's out-buffer exceeds HIGH, stop
 // reading from the peer that produces into it until it drains below LOW.
@@ -366,6 +370,7 @@ struct Conn {
     // upstream conns
     uint32_t ep_ip_be = 0;
     uint16_t ep_port = 0;
+    uint64_t idle_since_us = 0;  // when the conn entered IDLE (pool)
     bool connecting = false;
     bool rsp_head_parsed = false;
     bool rsp_eof_delim = false;
@@ -493,6 +498,7 @@ void release_upstream(Engine* e, Conn* up, bool reusable) {
                         up->st = Conn::St::IDLE;
                         up->in.clear();
                         up->deadline_us = 0;
+                        up->idle_since_us = now_us();
                         up->rsp_head_parsed = false;
                         if (up->paused) {
                             up->paused = false;
@@ -954,6 +960,39 @@ void sweep_timeouts(Engine* e) {
     for (auto& kv : e->conns)
         if (kv.second->deadline_us != 0 && now > kv.second->deadline_us)
             expired.push_back(kv.second);
+    // endpoint churn orphans pooled IDLE conns: a route update that
+    // drops an endpoint leaves its idle fds unreachable (no ep.idle
+    // list holds them), so they would leak until the peer closes
+    std::vector<Conn*> cands;
+    for (auto& kv : e->conns) {
+        Conn* c = kv.second;
+        if (c->st == Conn::St::IDLE && c->idle_since_us != 0 &&
+            now - c->idle_since_us >= ORPHAN_IDLE_TIMEOUT_US)
+            cands.push_back(c);
+    }
+    if (!cands.empty()) {
+        // one pass under the lock: an idle entry only counts when it
+        // still resolves to a live IDLE conn of THAT endpoint — raw fd
+        // equality would let a recycled fd number in a stale idle
+        // entry keep a true orphan alive (see the checkout loop's
+        // identical validation)
+        std::unordered_set<int> referenced;
+        {
+            std::lock_guard<std::mutex> g(e->mu);
+            for (auto& rkv : e->routes)
+                for (auto& ep : rkv.second.eps)
+                    for (int fd2 : ep.idle) {
+                        auto cit = e->conns.find(fd2);
+                        if (cit != e->conns.end() &&
+                            cit->second->st == Conn::St::IDLE &&
+                            cit->second->ep_ip_be == ep.ip_be &&
+                            cit->second->ep_port == ep.port)
+                            referenced.insert(fd2);
+                    }
+        }
+        for (Conn* c : cands)
+            if (!referenced.count(c->fd)) conn_close(e, c);
+    }
     for (Conn* c : expired) {
         if (c->st == Conn::St::WAIT_ROUTE) {
             unregister_parked(e, c);

@@ -50,6 +50,9 @@ using h2::Hdr;
 constexpr int MAX_EVENTS = 256;
 constexpr int LAT_BUCKETS = 28;
 constexpr uint64_t ROUTE_WAIT_TIMEOUT_US = 2'000'000;
+// an upstream conn no route references (endpoint churn orphaned it) is
+// closed after this much stream-less idle time
+constexpr uint64_t ORPHAN_IDLE_TIMEOUT_US = 60'000'000;
 // our advertised windows (we are a proxy: accept generously, gate grants
 // on how much we have buffered for the slower side)
 constexpr int64_t OUR_STREAM_WIN = 4 << 20;
@@ -176,6 +179,9 @@ struct H2Conn {
     uint32_t active_streams = 0;
     bool draining = false;  // GOAWAY received: no new streams
     std::deque<PStream*> pend_dispatch;
+    // sweep bookkeeping: when this (upstream) conn last had no streams;
+    // 0 while it has work
+    uint64_t idle_since_us = 0;
 };
 
 struct PStream {
@@ -1398,6 +1404,37 @@ void sweep(Engine* e) {
             synth_response(e, st->cc, st->cid, 504, "response timeout");
         finish_stream(e, st, true);
     }
+    // Endpoint churn orphans upstream conns: a route update that drops
+    // an endpoint clears nothing here, so a conn with no streams and no
+    // route slot referencing it would live until the peer closes.
+    // (Referenced idle conns are the warm SingletonPool — kept.)
+    std::vector<H2Conn*> orphans;
+    for (auto& kv : e->conns) {
+        H2Conn* c = kv.second;
+        if (c->kind != H2Conn::Kind::UPSTREAM || c->dead) continue;
+        if (!c->streams.empty() || !c->pend_dispatch.empty()) {
+            c->idle_since_us = 0;
+            continue;
+        }
+        if (c->idle_since_us == 0) {
+            c->idle_since_us = now;
+            continue;
+        }
+        if (now - c->idle_since_us < ORPHAN_IDLE_TIMEOUT_US) continue;
+        bool referenced = false;
+        {
+            std::lock_guard<std::mutex> g(e->mu);
+            auto it = e->routes.find(c->route_key);
+            if (it != e->routes.end())
+                for (auto& ep : it->second.eps)
+                    if (ep.conn == c) {
+                        referenced = true;
+                        break;
+                    }
+        }
+        if (!referenced) orphans.push_back(c);
+    }
+    for (H2Conn* c : orphans) conn_close(e, c);
 }
 
 void drain_graveyard(Engine* e) {
