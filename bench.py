@@ -168,11 +168,14 @@ def subtle_auc_bench() -> dict:
     out: dict = {}
     aucs = []
     labeled = 0
+    # config-5's AUC estimate straddles the 0.95 bar at small n (run
+    # band ~0.945-0.982 at 400); a larger labeled sample tightens it
     for mod, req in (("benchmarks.config4_k8s", "600"),
-                     ("benchmarks.config5_istio", "400")):
+                     ("benchmarks.config5_istio", "700")):
         proc = subprocess.run(
             [sys.executable, "-m", mod, "--requests", req],
-            capture_output=True, text=True, timeout=900,
+            capture_output=True, text=True,
+            timeout=900 + 2 * int(req),  # scale with sample size
             cwd=os.path.dirname(os.path.abspath(__file__)))
         key = mod.rsplit(".", 1)[1]
         if proc.returncode != 0:

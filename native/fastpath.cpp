@@ -990,8 +990,15 @@ void sweep_timeouts(Engine* e) {
                             referenced.insert(fd2);
                     }
         }
-        for (Conn* c : cands)
-            if (!referenced.count(c->fd)) conn_close(e, c);
+        for (Conn* c : cands) {
+            if (referenced.count(c->fd)) {
+                // still warm-pooled: re-stamp so the locked scan runs
+                // at most once per timeout window per conn
+                c->idle_since_us = now;
+            } else {
+                conn_close(e, c);
+            }
+        }
     }
     for (Conn* c : expired) {
         if (c->st == Conn::St::WAIT_ROUTE) {

@@ -1432,7 +1432,13 @@ void sweep(Engine* e) {
                         break;
                     }
         }
-        if (!referenced) orphans.push_back(c);
+        if (!referenced) {
+            orphans.push_back(c);
+        } else {
+            // still the endpoint's warm conn: re-stamp so the locked
+            // route lookup runs at most once per timeout window
+            c->idle_since_us = now;
+        }
     }
     for (H2Conn* c : orphans) conn_close(e, c);
 }
