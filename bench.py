@@ -220,6 +220,74 @@ def grpc_bench() -> dict:
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+def lifecycle_bench() -> dict:
+    """Fast, deterministic model-lifecycle scenario: train -> checkpoint
+    -> recreate -> restore -> verify bit-identical scores, plus a
+    poisoned-candidate gate rejection. Reports save/restore latency and
+    checkpoint size — the hot-swap stall budget for a serving fleet."""
+    import asyncio
+    import tempfile
+
+    import numpy as np
+
+    from linkerd_tpu.lifecycle import (
+        CheckpointStore, GatePolicy, ModelLifecycleManager, PromotionGate,
+        ReplayWindow,
+    )
+    from linkerd_tpu.telemetry.anomaly import InProcessScorer
+
+    async def drive() -> dict:
+        rng = np.random.default_rng(0)
+        dim = InProcessScorer().cfg.in_dim
+        x = rng.standard_normal((256, dim)).astype(np.float32)
+        labels = np.zeros(256, np.float32)
+        x[:64, : dim // 2] += 4.0
+        labels[:64] = 1.0
+        mask = np.ones(256, np.float32)
+
+        scorer = InProcessScorer(seed=0, learning_rate=5e-3)
+        for _ in range(6):
+            await scorer.fit(x, labels, mask)
+        before = np.asarray(await scorer.score(x))
+
+        with tempfile.TemporaryDirectory(prefix="l5d-ckpt-bench-") as d:
+            store = CheckpointStore(d)
+            t0 = time.perf_counter()
+            snap = scorer.snapshot()
+            version = store.save(snap, status="promoted")
+            save_ms = (time.perf_counter() - t0) * 1e3
+
+            fresh = InProcessScorer(seed=123, learning_rate=5e-3)
+            t0 = time.perf_counter()
+            _, loaded = store.load(version)
+            fresh.restore(loaded)
+            restore_ms = (time.perf_counter() - t0) * 1e3
+            after = np.asarray(await fresh.score(x))
+
+            replay = ReplayWindow(4096)
+            replay.add_batch(x, labels, mask)
+            mgr = ModelLifecycleManager(
+                store, PromotionGate(GatePolicy()), replay,
+                min_replay_rows=32)
+            mgr.serving_version = version
+            for _ in range(10):
+                await fresh.fit(x, 1.0 - labels, mask)  # poisoned labels
+            outcome = await mgr.run_cycle(fresh)
+            meta = store._entry(version)
+            return {
+                "restore_bitwise_identical":
+                    before.tobytes() == after.tobytes(),
+                "poisoned_candidate_rejected":
+                    outcome.get("action") == "rolled_back",
+                "checkpoint_save_ms": round(save_ms, 2),
+                "checkpoint_restore_ms": round(restore_ms, 2),
+                "checkpoint_bytes": meta.bytes,
+                "verify_issues": store.verify(),
+            }
+
+    return asyncio.run(drive())
+
+
 def fault_auc_bench() -> dict:
     """Config 3 in-process: reuses this process's (TPU) device for the
     scorer, matching the telemeter's real serving path."""
@@ -303,6 +371,11 @@ def main() -> None:
             sharded_cpu8_scorer()
     except Exception as e:  # noqa: BLE001
         detail["sharded_cpu8_error"] = repr(e)
+
+    try:
+        detail["lifecycle"] = lifecycle_bench()
+    except Exception as e:  # noqa: BLE001
+        detail["lifecycle_error"] = repr(e)
 
     baseline = 50_000.0  # north-star: >=50k req/s scored (BASELINE.md)
     print(json.dumps({

@@ -108,6 +108,21 @@ def mk_anomaly_handler(linker: "Linker"):
     return handler
 
 
+def mk_model_handler(linker: "Linker"):
+    """``/model.json`` — anomaly-model lifecycle state (version, step,
+    last promotion/rollback, drift gauges, checkpoint inventory) from the
+    io.l5d.jaxAnomaly telemeter; ``{"lifecycle_enabled": false}`` when no
+    lifecycle block is configured."""
+    async def handler(req: Request) -> Response:
+        tele = linker._anomaly_telemeter()
+        if tele is None:
+            return json_response({"lifecycle_enabled": False,
+                                  "telemeter": None})
+        return json_response(tele.model_state())
+
+    return handler
+
+
 def mk_identifier_handler(linker: "Linker"):
     """``/identifier.json`` — run each http router's identifier against a
     synthetic request and show the resulting logical name (ref:
@@ -268,6 +283,7 @@ def linkerd_admin_handlers(linker: "Linker") -> List[Tuple[str, Any]]:
         ("/delegator.json", mk_delegator_handler(linker)),
         ("/bound-names.json", mk_bound_names_handler(linker)),
         ("/anomaly.json", mk_anomaly_handler(linker)),
+        ("/model.json", mk_model_handler(linker)),
         ("/identifier.json", mk_identifier_handler(linker)),
         ("/logging.json", logging_handler),
         ("/admin/pprof/profile", pprof_profile_handler),

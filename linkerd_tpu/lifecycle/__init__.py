@@ -1,0 +1,75 @@
+"""Model lifecycle subsystem for the anomaly scorer.
+
+The model artifact is a first-class, versioned, gated object:
+
+    capture -> train -> checkpoint -> shadow-eval -> promote -> hot-swap
+                                          |
+                                          +-> reject -> rollback
+
+- ``store``   — atomic, CRC-checked, versioned snapshots with lineage
+  and retention (``CheckpointStore``, ``ModelSnapshot``).
+- ``promote`` — held-out replay window, shadow evaluation, promotion
+  gate, and the ``ModelLifecycleManager`` orchestrating the loop.
+- ``drift``   — population-stats shift vs. the serving checkpoint,
+  exported through the metrics registry and /model.json.
+
+Configured from YAML via the jaxAnomaly telemeter's ``lifecycle`` block
+(``LifecycleConfig``); the scorers' ``snapshot()``/``restore()``/
+``swap()`` hooks (in-process and gRPC sidecar) do the hot-swapping.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from linkerd_tpu.lifecycle.drift import DriftMonitor
+from linkerd_tpu.lifecycle.promote import (
+    Decision, EvalReport, GatePolicy, ModelLifecycleManager, PromotionGate,
+    ReplayWindow, evaluate_snapshot,
+)
+from linkerd_tpu.lifecycle.store import (
+    CheckpointCorruptError, CheckpointError, CheckpointStore, ModelSnapshot,
+    decode_snapshot, encode_snapshot,
+)
+
+
+@dataclass
+class LifecycleConfig:
+    """YAML ``lifecycle:`` block of the io.l5d.jaxAnomaly telemeter."""
+
+    directory: str                   # checkpoint store root (required)
+    checkpointEveryS: float = 300.0  # gating-cycle cadence; 0 = manual only
+    retain: int = 5                  # versions kept (serving never pruned)
+    aucTolerance: float = 0.02
+    lossTolerance: float = 0.10
+    minLabeled: int = 8
+    replayCapacity: int = 4096       # held-out window, rows
+    minReplayRows: int = 256         # gate only once the window is warm
+    # every Nth drained batch is diverted to the replay window and
+    # EXCLUDED from training — the shadow-eval set must be held out from
+    # the candidate, or a poisoned training stream would evaluate best
+    # on its own poison and sail through the gate
+    holdoutEveryBatches: int = 4
+    restoreOnStart: bool = True      # survive restarts from last-good
+
+    def mk_manager(self, metrics_node=None) -> ModelLifecycleManager:
+        store = CheckpointStore(self.directory, retain=self.retain)
+        gate = PromotionGate(GatePolicy(
+            aucTolerance=self.aucTolerance,
+            lossTolerance=self.lossTolerance,
+            minLabeled=self.minLabeled))
+        replay = ReplayWindow(self.replayCapacity)
+        drift = DriftMonitor(metrics_node)
+        return ModelLifecycleManager(
+            store, gate, replay, drift=drift,
+            min_replay_rows=self.minReplayRows)
+
+
+__all__ = [
+    "CheckpointCorruptError", "CheckpointError", "CheckpointStore",
+    "Decision", "DriftMonitor", "EvalReport", "GatePolicy",
+    "LifecycleConfig", "ModelLifecycleManager", "ModelSnapshot",
+    "PromotionGate", "ReplayWindow", "decode_snapshot", "encode_snapshot",
+    "evaluate_snapshot",
+]

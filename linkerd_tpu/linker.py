@@ -1351,14 +1351,22 @@ class Linker:
         self._file_sinks.append(close)
         return emit
 
+    def _anomaly_telemeter(self):
+        """The configured jaxAnomaly telemeter, or None. Owns the score
+        board and (when a ``lifecycle`` block is configured) the model
+        lifecycle manager surfaced at /model.json."""
+        from linkerd_tpu.telemetry.anomaly import JaxAnomalyTelemeter
+        for t in self.telemeters:
+            if isinstance(t, JaxAnomalyTelemeter):
+                return t
+        return None
+
     def _anomaly_board(self):
         """ScoreBoard of the configured jaxAnomaly telemeter (or a detached
         one so anomaly-aware policies degrade to their base behavior)."""
-        from linkerd_tpu.telemetry.anomaly import JaxAnomalyTelemeter, ScoreBoard
-        for t in self.telemeters:
-            if isinstance(t, JaxAnomalyTelemeter):
-                return t.board
-        return ScoreBoard()
+        from linkerd_tpu.telemetry.anomaly import ScoreBoard
+        tele = self._anomaly_telemeter()
+        return tele.board if tele is not None else ScoreBoard()
 
     # -- lifecycle --------------------------------------------------------
     async def start(self) -> "Linker":

@@ -170,3 +170,44 @@ def init_sharded(
     params = shard_params(mesh, init_params(key, cfg))
     opt_state = optimizer.init(params)
     return params, opt_state
+
+
+def place_snapshot(
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    params_host: Params,
+    opt_leaves: Optional[list] = None,
+):
+    """Re-place a restored (host/numpy) checkpoint onto ``mesh`` with the
+    same column/row specs as a fresh init, so a snapshot taken on any
+    topology (single chip, other mesh shape) hot-swaps into this one.
+
+    ``opt_leaves`` is the checkpoint's flattened optax state (tree_leaves
+    order); the state *structure* is rebuilt from ``optimizer.init`` on
+    the placed params — its leaf shardings are the authoritative
+    placement for the restored leaves. Returns ``(params, opt_state)``.
+    """
+    params = shard_params(mesh, params_host)
+    template = optimizer.init(params)
+    if opt_leaves is None:
+        return params, template
+    t_leaves, treedef = jax.tree_util.tree_flatten(template)
+    if len(opt_leaves) != len(t_leaves):
+        raise ValueError(
+            f"optimizer state mismatch: checkpoint has {len(opt_leaves)} "
+            f"leaves, optimizer expects {len(t_leaves)}")
+    placed = []
+    for leaf, t in zip(opt_leaves, t_leaves):
+        arr = np.asarray(leaf)
+        if tuple(arr.shape) != tuple(t.shape):
+            raise ValueError(
+                f"optimizer leaf shape mismatch: checkpoint {arr.shape} "
+                f"vs optimizer {tuple(t.shape)}")
+        # param-shaped moments inherit the param NamedShardings via
+        # zeros_like; fresh scalars (adam's count) come back with a
+        # single-device placement — committing them there would make the
+        # jitted train step see mixed device sets, so replicate instead
+        sharding = (t.sharding if isinstance(t.sharding, NamedSharding)
+                    else replicated(mesh))
+        placed.append(jax.device_put(arr.astype(t.dtype), sharding))
+    return params, jax.tree_util.tree_unflatten(treedef, placed)

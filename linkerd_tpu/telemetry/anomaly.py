@@ -32,6 +32,7 @@ import numpy as np
 
 from linkerd_tpu.config import register
 from linkerd_tpu.core import Var
+from linkerd_tpu.lifecycle import LifecycleConfig
 from linkerd_tpu.models.features import FEATURE_DIM, FeatureVector, featurize_batch
 from linkerd_tpu.protocol.http.message import Request, Response
 from linkerd_tpu.router.service import Filter, Service
@@ -143,13 +144,29 @@ class FeatureRecorder(Filter[Request, Response]):
 
 class Scorer:
     """Scoring + online-training backends. ``score`` takes float32[B, D]
-    and returns float32[B] anomaly scores in [0, 1]."""
+    and returns float32[B] anomaly scores in [0, 1].
+
+    Lifecycle hooks: ``snapshot``/``restore``/``swap`` capture and
+    hot-swap the full training state (params, optimizer, normalization
+    stats, step counter) without recreating the scorer. They may be sync
+    (in-process: device transfers happen off the event loop via
+    ``asyncio.to_thread``) or async (gRPC sidecar)."""
 
     async def score(self, x: np.ndarray) -> np.ndarray:
         raise NotImplementedError
 
     async def fit(self, x: np.ndarray, labels: np.ndarray,
                   mask: np.ndarray) -> float:
+        raise NotImplementedError
+
+    def snapshot(self):
+        raise NotImplementedError
+
+    def restore(self, snap) -> None:
+        raise NotImplementedError
+
+    def swap(self, snap):
+        """Restore ``snap`` and return the previous state's snapshot."""
         raise NotImplementedError
 
     def close(self) -> None:
@@ -202,6 +219,10 @@ class InProcessScorer(Scorer):
             self._scorer = best_scorer(self.cfg)
             self._train_step = self._mk_train_step()
         self.fit_steps = fit_steps
+        self._devices = devices
+        # cumulative train steps; checkpointed so a restored model resumes
+        # its lineage, not a fresh step count
+        self._step = 0
         # Running feature normalization (updated on non-anomalous training
         # rows): without it the autoencoder's reconstruction error is
         # dominated by raw feature scale and tanh() saturates for normal
@@ -271,20 +292,99 @@ class InProcessScorer(Scorer):
         widths = ((0, target - n),) + ((0, 0),) * (arr.ndim - 1)
         return np.pad(arr, widths)
 
+    # -- lifecycle: snapshot / restore / swap -----------------------------
+    def snapshot(self):
+        """Capture the full training state to host memory: params,
+        optimizer state, normalization stats, config, step counter. The
+        returned ModelSnapshot restores to bit-identical scores on the
+        same backend. Blocking (device->host transfer) — call off the
+        event loop (the lifecycle manager uses asyncio.to_thread)."""
+        import jax
+
+        from linkerd_tpu.lifecycle.store import ModelSnapshot
+
+        params = jax.device_get(self.params)
+        opt_leaves = [np.asarray(leaf) for leaf in
+                      jax.tree_util.tree_leaves(
+                          jax.device_get(self._opt_state))]
+        return ModelSnapshot(
+            params=params, opt_leaves=opt_leaves,
+            mu=self._mu.copy(), var=self._var.copy(),
+            norm_initialized=self._norm_initialized,
+            step=self._step, cfg=self.cfg)
+
+    def restore(self, snap) -> None:
+        """Hot-swap a snapshot in: params re-placed per the current
+        topology (the dp x tp mesh specs when sharded, the pinned device
+        otherwise), optimizer state rebuilt leaf-for-leaf. The already
+        compiled score/train steps keep working — shapes, dtypes, and
+        shardings are unchanged, so no recompilation."""
+        import jax
+
+        from linkerd_tpu.lifecycle.store import _cfg_to_dict
+
+        if _cfg_to_dict(snap.cfg) != _cfg_to_dict(self.cfg):
+            raise ValueError(
+                f"snapshot config {snap.cfg_dict()} does not match "
+                f"scorer config {_cfg_to_dict(self.cfg)}")
+        if self.mesh is not None:
+            from linkerd_tpu.parallel.mesh import place_snapshot
+            self.params, self._opt_state = place_snapshot(
+                self.mesh, self._opt, snap.params, snap.opt_leaves)
+        else:
+            params = jax.device_put(snap.params, self._devices[0])
+            template = self._opt.init(params)
+            t_leaves, treedef = jax.tree_util.tree_flatten(template)
+            if len(snap.opt_leaves) != len(t_leaves):
+                raise ValueError(
+                    f"optimizer state mismatch: snapshot has "
+                    f"{len(snap.opt_leaves)} leaves, optimizer expects "
+                    f"{len(t_leaves)}")
+            placed = []
+            for leaf, t in zip(snap.opt_leaves, t_leaves):
+                arr = np.asarray(leaf)
+                if tuple(arr.shape) != tuple(t.shape):
+                    raise ValueError(
+                        f"optimizer leaf shape mismatch: snapshot "
+                        f"{arr.shape} vs optimizer {tuple(t.shape)}")
+                placed.append(jax.device_put(arr.astype(t.dtype),
+                                             self._devices[0]))
+            self.params = params
+            self._opt_state = jax.tree_util.tree_unflatten(treedef, placed)
+        self._mu = np.asarray(snap.mu, np.float32).copy()
+        self._var = np.asarray(snap.var, np.float32).copy()
+        self._norm_initialized = bool(snap.norm_initialized)
+        self._step = int(snap.step)
+
+    def swap(self, snap):
+        """Restore ``snap``; returns the displaced state so a failed
+        promotion can be undone without a store round-trip."""
+        old = self.snapshot()
+        self.restore(snap)
+        return old
+
     async def warmup(self, rows: int = 4) -> None:
         """Trigger compilation of the score and fit paths without letting
-        the dummy rows contaminate normalization stats or parameters."""
+        the dummy rows contaminate normalization stats or parameters.
+        Also exercises the snapshot->restore->score hot-swap path (host
+        gather, re-placement, optimizer-state rebuild) so the first real
+        swap doesn't stall the event loop."""
         rows = max(rows, self._batch_multiple, 1)
         x = np.zeros((rows, self.cfg.in_dim), np.float32)
         params, opt_state = self.params, self._opt_state
         mu, var, init = self._mu, self._var, self._norm_initialized
+        step = self._step
         try:
             await self.score(x)
             await self.fit(x, np.zeros(rows, np.float32),
                            np.zeros(rows, np.float32))
+            snap = await asyncio.to_thread(self.snapshot)
+            await asyncio.to_thread(self.restore, snap)
+            await self.score(x)
         finally:
             self.params, self._opt_state = params, opt_state
             self._mu, self._var, self._norm_initialized = mu, var, init
+            self._step = step
 
     def _prep(self, x: np.ndarray) -> np.ndarray:
         """Normalize + pad + cast to the transfer dtype. Post-norm values
@@ -341,6 +441,7 @@ class InProcessScorer(Scorer):
                 self.params, self._opt_state, loss = self._train_step(
                     self.params, self._opt_state, xn, labels, mask,
                     row_mask)
+            self._step += self.fit_steps
             return float(loss)
 
         return await asyncio.to_thread(run)
@@ -358,6 +459,9 @@ class JaxAnomalyConfig:
     reconWeight: float = 0.7
     learningRate: float = 0.001
     sidecarAddress: Optional[str] = None  # host:port -> gRPC sidecar mode
+    # model lifecycle: checkpointing, shadow-eval promotion gating, drift
+    # detection, restart restore (see linkerd_tpu/lifecycle/)
+    lifecycle: Optional["LifecycleConfig"] = None
 
     def mk(self, metrics: MetricsTree) -> "JaxAnomalyTelemeter":
         return JaxAnomalyTelemeter(self, metrics)
@@ -383,6 +487,28 @@ class JaxAnomalyTelemeter(Telemeter):
         self._train_loss = self._node.gauge("train_loss")
         self._gauges: Dict[str, object] = {}
         self._batch_i = 0
+        # model lifecycle: checkpoint store + promotion gate + drift
+        # monitor; None when the config block is absent (zero overhead)
+        self._lifecycle = None
+        if cfg.lifecycle is not None:
+            if cfg.lifecycle.holdoutEveryBatches < 1:
+                raise ValueError("lifecycle.holdoutEveryBatches must be >= 1")
+            self._lifecycle = cfg.lifecycle.mk_manager(
+                self._node.scope("drift"))
+            model_node = self._node.scope("model")
+            model_node.gauge("version", fn=lambda: float(
+                self._lifecycle.serving_version or 0))
+            model_node.gauge("step", fn=lambda: float(
+                getattr(self._scorer, "_step", 0) or 0))
+            model_node.gauge("promotions",
+                             fn=lambda: float(self._lifecycle.promotions))
+            model_node.gauge("rollbacks",
+                             fn=lambda: float(self._lifecycle.rollbacks))
+
+    @property
+    def lifecycle(self):
+        """The ModelLifecycleManager (None unless configured)."""
+        return self._lifecycle
 
     # -- stack tap --------------------------------------------------------
     def recorder(self) -> FeatureRecorder:
@@ -403,12 +529,44 @@ class JaxAnomalyTelemeter(Telemeter):
     async def run(self) -> None:
         scorer = self._ensure_scorer()
         interval = self.cfg.intervalMs / 1e3
+        lc_cfg = self.cfg.lifecycle
+        if self._lifecycle is not None and lc_cfg.restoreOnStart:
+            # survive restarts: pull the last-good model before scoring
+            try:
+                restored = await self._lifecycle.bootstrap(scorer)
+                if restored is not None:
+                    log.info("anomaly model restored from checkpoint v%d",
+                             restored)
+            except Exception:  # noqa: BLE001 — a bad store must not
+                log.exception("checkpoint bootstrap failed; "
+                              "serving from fresh init")
+        last_cycle = time.monotonic()
         try:
             while not self._stop.is_set():
                 await asyncio.sleep(interval)
                 await self._drain_burst(scorer)
+                if (self._lifecycle is not None
+                        and lc_cfg.checkpointEveryS > 0
+                        and time.monotonic() - last_cycle
+                        >= lc_cfg.checkpointEveryS):
+                    last_cycle = time.monotonic()
+                    await self.lifecycle_cycle()
         except asyncio.CancelledError:
             pass
+
+    async def lifecycle_cycle(self) -> Optional[dict]:
+        """One checkpoint/shadow-eval/promote-or-rollback pass (the
+        namerd-style periodic maintenance task; also admin-invocable)."""
+        if self._lifecycle is None:
+            return None
+        try:
+            outcome = await self._lifecycle.run_cycle(self._ensure_scorer())
+            log.info("model lifecycle cycle: %s",
+                     outcome.get("action", "?"))
+            return outcome
+        except Exception:  # noqa: BLE001 — lifecycle failures must never
+            log.exception("model lifecycle cycle failed")  # stop scoring
+            return None
 
     async def _drain_burst(self, scorer: Scorer,
                            max_batches: Optional[int] = None) -> int:
@@ -444,10 +602,22 @@ class JaxAnomalyTelemeter(Telemeter):
         scores = await scorer.score(x)
         self._scored.incr(n)
         self._batches.incr()
+        holdout = False
+        if self._lifecycle is not None:
+            # drift sees every batch (read-only); the replay window only
+            # takes HOLDOUT batches, which are then excluded from
+            # training below — a shadow-eval set the candidate trained on
+            # (same rows AND same labels) could not catch a poisoned
+            # training stream, because the poisoned candidate evaluates
+            # best on its own poison
+            self._lifecycle.drift.observe(x, np.asarray(scores))
+            holdout = self._batch_i % self.cfg.lifecycle.holdoutEveryBatches == 0
+            if holdout:
+                self._lifecycle.replay.add_batch(x, labels, mask)
         self.board.update_batch([fv.dst_path for fv in fvs], scores)
         self._publish_gauges()
         self._batch_i += 1
-        if (self.cfg.trainEveryBatches
+        if (not holdout and self.cfg.trainEveryBatches
                 and self._batch_i % self.cfg.trainEveryBatches == 0):
             loss = await scorer.fit(x, labels, mask)
             self._train_loss.set(loss)
@@ -472,10 +642,41 @@ class JaxAnomalyTelemeter(Telemeter):
                 "ring_depth": len(self.ring),
             })
 
-        return [("/anomaly.json", anomaly_json)]
+        async def model_json(req: Request) -> Response:
+            return json_response(self.model_state())
+
+        return [("/anomaly.json", anomaly_json),
+                ("/model.json", model_json)]
+
+    def model_state(self) -> dict:
+        """Model-lifecycle state for /model.json: version, step, last
+        promotion/rollback, drift gauges, store inventory."""
+        out: dict = {
+            "lifecycle_enabled": self._lifecycle is not None,
+            "live_step": getattr(self._scorer, "_step", None),
+            "scorer": type(self._scorer).__name__
+            if self._scorer is not None else None,
+        }
+        if self._lifecycle is not None:
+            out.update(self._lifecycle.status())
+        return out
 
     def close(self) -> None:
         self._stop.set()
+        if self._lifecycle is not None and self._scorer is not None:
+            # best-effort shutdown snapshot (sync/in-process scorers
+            # only): a router restart must not silently reset the model
+            # to random init. Saved as a candidate — restart prefers the
+            # last PROMOTED version when one exists (latest_good()).
+            snap_fn = getattr(self._scorer, "snapshot", None)
+            if snap_fn is not None \
+                    and not asyncio.iscoroutinefunction(snap_fn):
+                try:
+                    self._lifecycle.store.save(
+                        snap_fn(), status="candidate",
+                        parent=self._lifecycle.serving_version)
+                except Exception:  # noqa: BLE001 — shutdown must proceed
+                    log.exception("shutdown checkpoint failed")
         if self._scorer is not None:
             self._scorer.close()
 

@@ -13,6 +13,14 @@ names). Methods (service ``io.l5d.anomaly.Scorer``):
              response = f32[n] scores
 - ``Fit``:   request  = u32 n | u32 d | f32[n*d] x | f32[n] labels | f32[n] mask
              response = f32[1] loss
+- ``Snapshot``: request = (empty)
+             response = serialized ModelSnapshot (lifecycle/store format)
+- ``Restore``:  request = serialized ModelSnapshot
+             response = u64 restored step counter
+
+Snapshot/Restore are the fleet hot-swap path: the lifecycle manager on
+one router promotes a model, and every router pulls it into its sidecar
+(or the shared sidecar restores once) without a restart.
 """
 
 from __future__ import annotations
@@ -38,26 +46,56 @@ def bucket_rows(n: int) -> int:
 
 
 def encode_matrix(x: np.ndarray) -> bytes:
+    # ascontiguousarray normalizes sliced/strided views (a telemeter may
+    # hand us arr[::2]) and zero-row windows alike; tobytes() on a 0xD
+    # array is a valid empty payload.
     x = np.ascontiguousarray(x, dtype=np.float32)
+    if x.ndim != 2:
+        raise ValueError(f"encode_matrix wants [n, d], got shape {x.shape}")
     n, d = x.shape
     return struct.pack("<II", n, d) + x.tobytes()
 
 
 def decode_matrix(data: bytes) -> np.ndarray:
+    if len(data) < 8:
+        raise ValueError(
+            f"truncated matrix payload: {len(data)} bytes, need >= 8")
     n, d = struct.unpack_from("<II", data)
+    need = 8 + 4 * n * d
+    if len(data) != need:
+        # a Score payload is exactly one matrix; short payloads would
+        # make np.frombuffer raise a generic message, and trailing bytes
+        # would silently mask a producer-side framing bug
+        raise ValueError(
+            f"bad matrix payload: {len(data)} bytes, "
+            f"need exactly {need} for {n}x{d} f32")
     arr = np.frombuffer(data, dtype=np.float32, offset=8, count=n * d)
     return arr.reshape(n, d)
 
 
 def encode_fit(x: np.ndarray, labels: np.ndarray, mask: np.ndarray) -> bytes:
+    labels = np.ascontiguousarray(labels, np.float32)
+    mask = np.ascontiguousarray(mask, np.float32)
     n = x.shape[0]
-    return (encode_matrix(x)
-            + np.ascontiguousarray(labels, np.float32).tobytes()
-            + np.ascontiguousarray(mask, np.float32).tobytes())
+    if labels.shape != (n,) or mask.shape != (n,):
+        raise ValueError(
+            f"encode_fit row mismatch: x has {n} rows, labels "
+            f"{labels.shape}, mask {mask.shape}")
+    return encode_matrix(x) + labels.tobytes() + mask.tobytes()
 
 
 def decode_fit(data: bytes) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    if len(data) < 8:
+        raise ValueError(
+            f"truncated fit payload: {len(data)} bytes, need >= 8")
     n, d = struct.unpack_from("<II", data)
+    need = 8 + 4 * (n * d + 2 * n)
+    if len(data) != need:
+        # a silent np.frombuffer misread here would train on shifted
+        # labels/mask — reject short AND long payloads outright
+        raise ValueError(
+            f"bad fit payload: {len(data)} bytes, need exactly {need} "
+            f"for {n}x{d} f32 + 2x{n} f32")
     off = 8
     x = np.frombuffer(data, np.float32, n * d, off).reshape(n, d)
     off += 4 * n * d
@@ -96,12 +134,32 @@ class ScorerSidecar:
             loss = await scorer.fit(x, labels, mask)
             return np.float32([loss]).tobytes()
 
+        async def snapshot(request: bytes, context) -> bytes:
+            # request payload is empty; response is the full serialized
+            # checkpoint (lifecycle/store wire format, CRC-tailed)
+            from linkerd_tpu.lifecycle.store import encode_snapshot
+            snap = await asyncio.to_thread(scorer.snapshot)
+            return encode_snapshot(snap)
+
+        async def restore(request: bytes, context) -> bytes:
+            from linkerd_tpu.lifecycle.store import decode_snapshot
+            snap = decode_snapshot(request)
+            await asyncio.to_thread(scorer.restore, snap)
+            # echo the restored step so callers can confirm the swap
+            return struct.pack("<Q", int(snap.step))
+
         handler = grpc.method_handlers_generic_handler(SERVICE, {
             "Score": grpc.unary_unary_rpc_method_handler(
                 score,
                 request_deserializer=None, response_serializer=None),
             "Fit": grpc.unary_unary_rpc_method_handler(
                 fit,
+                request_deserializer=None, response_serializer=None),
+            "Snapshot": grpc.unary_unary_rpc_method_handler(
+                snapshot,
+                request_deserializer=None, response_serializer=None),
+            "Restore": grpc.unary_unary_rpc_method_handler(
+                restore,
                 request_deserializer=None, response_serializer=None),
         })
         self._server = grpc.aio.server()
@@ -136,6 +194,8 @@ class GrpcScorerClient:
         self._channel = None
         self._score = None
         self._fit = None
+        self._snapshot = None
+        self._restore = None
 
     @staticmethod
     def _bucket(rpc: str, rows: int) -> tuple:
@@ -159,6 +219,29 @@ class GrpcScorerClient:
             self._fit = self._channel.unary_unary(
                 f"/{SERVICE}/Fit",
                 request_serializer=None, response_deserializer=None)
+            self._snapshot = self._channel.unary_unary(
+                f"/{SERVICE}/Snapshot",
+                request_serializer=None, response_deserializer=None)
+            self._restore = self._channel.unary_unary(
+                f"/{SERVICE}/Restore",
+                request_serializer=None, response_deserializer=None)
+
+    async def snapshot(self):
+        """Pull the sidecar's full model state as a ModelSnapshot — the
+        fleet-wide distribution path: one router checkpoints/promotes,
+        every other router pulls and restores without restarting."""
+        from linkerd_tpu.lifecycle.store import decode_snapshot
+        self._ensure()
+        rsp = await self._snapshot(b"", timeout=self.first_timeout_s)
+        return decode_snapshot(rsp)
+
+    async def restore(self, snap) -> int:
+        """Hot-swap ``snap`` into the sidecar; returns the restored step."""
+        from linkerd_tpu.lifecycle.store import encode_snapshot
+        self._ensure()
+        rsp = await self._restore(encode_snapshot(snap),
+                                  timeout=self.first_timeout_s)
+        return struct.unpack("<Q", rsp)[0]
 
     async def score(self, x: np.ndarray) -> np.ndarray:
         self._ensure()

@@ -218,6 +218,60 @@ class TestGrpcSidecar:
         run(go())
 
 
+class TestSidecarCodec:
+    """Length-prefixed codec edge cases: zero-row and non-contiguous
+    (sliced) arrays round-trip; truncated payloads raise ValueError
+    instead of np.frombuffer silently misreading."""
+
+    def test_zero_row_roundtrip(self):
+        from linkerd_tpu.telemetry.sidecar import (
+            decode_fit, decode_matrix, encode_fit, encode_matrix,
+        )
+        empty = np.zeros((0, FEATURE_DIM), np.float32)
+        out = decode_matrix(encode_matrix(empty))
+        assert out.shape == (0, FEATURE_DIM)
+        x, l, m = decode_fit(encode_fit(
+            empty, np.zeros(0, np.float32), np.zeros(0, np.float32)))
+        assert x.shape == (0, FEATURE_DIM) and len(l) == 0 and len(m) == 0
+
+    def test_non_contiguous_roundtrip(self):
+        from linkerd_tpu.telemetry.sidecar import (
+            decode_fit, decode_matrix, encode_fit, encode_matrix,
+        )
+        rng = np.random.default_rng(1)
+        base = rng.standard_normal((16, FEATURE_DIM)).astype(np.float32)
+        labels = np.arange(16, dtype=np.float32)
+        # every-other-row views are not C-contiguous
+        x, l, m = base[::2], labels[::2], labels[::2] * 0 + 1
+        assert not x.flags["C_CONTIGUOUS"]
+        assert (decode_matrix(encode_matrix(x)) == x).all()
+        x2, l2, m2 = decode_fit(encode_fit(x, l, m))
+        assert (x2 == x).all() and (l2 == l).all() and (m2 == m).all()
+
+    def test_truncated_and_malformed_payloads_raise(self):
+        from linkerd_tpu.telemetry.sidecar import (
+            decode_fit, decode_matrix, encode_fit, encode_matrix,
+        )
+        x = np.ones((4, FEATURE_DIM), np.float32)
+        good = encode_matrix(x)
+        with pytest.raises(ValueError):
+            decode_matrix(good[:-8])       # short payload
+        with pytest.raises(ValueError):
+            decode_matrix(good[:6])        # shorter than the header
+        with pytest.raises(ValueError):
+            decode_matrix(good + b"\x00" * 4)  # trailing garbage
+        fit = encode_fit(x, np.zeros(4, np.float32), np.ones(4, np.float32))
+        with pytest.raises(ValueError):
+            decode_fit(fit[:-4])           # truncated mask
+        with pytest.raises(ValueError):
+            decode_fit(fit + b"\x00" * 4)  # trailing garbage
+        with pytest.raises(ValueError):
+            encode_matrix(np.ones(8, np.float32))  # not [n, d]
+        with pytest.raises(ValueError):
+            # label/mask row mismatch must not encode shifted payloads
+            encode_fit(x, np.zeros(3, np.float32), np.ones(4, np.float32))
+
+
 class TestDrainBurst:
     def test_backlog_drains_multiple_batches_per_wake(self, tmp_path):
         """Under backlog the telemeter scores several micro-batches per

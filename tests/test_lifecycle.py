@@ -1,0 +1,416 @@
+"""Model lifecycle subsystem tests: checkpoint store round-trips (incl.
+sharded<->single-device), promotion gate accept/reject/rollback, drift
+gauges, sidecar Snapshot/Restore, and the end-to-end acceptance loop:
+train -> checkpoint -> kill/recreate -> restore -> bitwise-identical
+scores; poisoned candidate rejected while the serving version keeps
+scoring."""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from linkerd_tpu.lifecycle import (
+    CheckpointCorruptError, CheckpointStore, DriftMonitor, EvalReport,
+    GatePolicy, LifecycleConfig, ModelLifecycleManager, PromotionGate,
+    ReplayWindow, decode_snapshot, encode_snapshot, evaluate_snapshot,
+)
+from linkerd_tpu.telemetry.anomaly import InProcessScorer
+from linkerd_tpu.telemetry.metrics import MetricsTree
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 300))
+
+
+def one_device():
+    import jax
+    return [jax.devices()[0]]
+
+
+def mk_data(n=128, anom_frac=0.25, seed=0, dim=36):
+    """Synthetic labeled window: normal rows ~N(0,1), anomalous rows
+    shifted +4 sigma in half the dims."""
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, dim)).astype(np.float32)
+    labels = np.zeros(n, np.float32)
+    n_anom = int(n * anom_frac)
+    x[:n_anom, : dim // 2] += 4.0
+    labels[:n_anom] = 1.0
+    mask = np.ones(n, np.float32)
+    perm = rng.permutation(n)
+    return x[perm], labels[perm], mask[perm]
+
+
+async def train(scorer, x, labels, mask, rounds=4):
+    for _ in range(rounds):
+        await scorer.fit(x, labels, mask)
+
+
+class TestSnapshotCodec:
+    def test_roundtrip_is_exact(self):
+        scorer = InProcessScorer(seed=3, devices=one_device())
+        snap = scorer.snapshot()
+        back = decode_snapshot(encode_snapshot(snap))
+        import jax
+        for a, b in zip(jax.tree_util.tree_leaves(snap.params),
+                        jax.tree_util.tree_leaves(back.params)):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+        assert len(back.opt_leaves) == len(snap.opt_leaves)
+        assert (back.mu == snap.mu).all() and (back.var == snap.var).all()
+        assert back.step == snap.step
+        assert back.cfg_dict() == snap.cfg_dict()
+
+    def test_corruption_detected(self):
+        scorer = InProcessScorer(seed=3, devices=one_device())
+        data = bytearray(encode_snapshot(scorer.snapshot()))
+        data[len(data) // 2] ^= 0xFF
+        with pytest.raises(CheckpointCorruptError):
+            decode_snapshot(bytes(data))
+        with pytest.raises(CheckpointCorruptError):
+            decode_snapshot(b"NOTACKPT")
+
+
+class TestCheckpointStore:
+    def test_save_load_retention_and_verify(self, tmp_path):
+        scorer = InProcessScorer(seed=1, devices=one_device())
+        store = CheckpointStore(str(tmp_path), retain=3)
+        v1 = store.save(scorer.snapshot(), status="promoted")
+        assert store.latest_good() == v1
+        versions = [v1]
+        for _ in range(4):
+            versions.append(store.save(scorer.snapshot(), status="candidate",
+                                       parent=v1))
+        # retention kept 3, but never pruned the serving version
+        kept = [e.version for e in store.versions()]
+        assert len(kept) == 3 and v1 in kept
+        assert store.verify() == []
+        v, snap = store.load()
+        assert v == v1 and snap.step == 0
+
+        # a reopened store sees the same manifest
+        store2 = CheckpointStore(str(tmp_path), retain=3)
+        assert store2.latest_good() == v1
+
+    def test_verify_reports_corruption_orphans_and_missing(self, tmp_path):
+        scorer = InProcessScorer(seed=1, devices=one_device())
+        store = CheckpointStore(str(tmp_path), retain=5)
+        v1 = store.save(scorer.snapshot(), status="promoted")
+        v2 = store.save(scorer.snapshot(), status="candidate", parent=v1)
+        # corrupt v2's payload on disk
+        f2 = tmp_path / store._entry(v2).file
+        raw = bytearray(f2.read_bytes())
+        raw[100] ^= 0xFF
+        f2.write_bytes(bytes(raw))
+        # drop an orphan
+        (tmp_path / "v999999.ckpt").write_bytes(b"x")
+        issues = store.verify()
+        assert any("CRC" in i for i in issues), issues
+        assert any("orphaned" in i for i in issues), issues
+        # corrupted load refuses rather than restoring garbage
+        with pytest.raises(CheckpointCorruptError):
+            store.load(v2)
+        # missing file
+        os.unlink(str(f2))
+        assert any("missing" in i for i in store.verify())
+
+
+class TestRestoreRoundTrip:
+    def test_kill_recreate_restore_bitwise_identical(self, tmp_path):
+        """Acceptance: train in-process -> checkpoint -> kill/recreate
+        scorer -> restore -> identical scores (bitwise on CPU)."""
+        async def go():
+            x, labels, mask = mk_data(seed=5)
+            scorer = InProcessScorer(seed=0, devices=one_device())
+            await train(scorer, x, labels, mask)
+            before = np.asarray(await scorer.score(x))
+            store = CheckpointStore(str(tmp_path))
+            v = store.save(scorer.snapshot(), status="promoted")
+            del scorer  # "kill" the process's scorer
+
+            fresh = InProcessScorer(seed=1234, devices=one_device())
+            _, snap = store.load(v)
+            fresh.restore(snap)
+            after = np.asarray(await fresh.score(x))
+            assert before.tobytes() == after.tobytes()
+            # training resumes from the checkpointed optimizer state
+            assert fresh._step == snap.step
+            loss = await fresh.fit(x, labels, mask)
+            assert np.isfinite(loss)
+            assert fresh._step == snap.step + fresh.fit_steps
+
+        run(go())
+
+    def test_sharded_and_single_device_restores(self, tmp_path):
+        """Snapshot portability across topologies: dp-sharded -> single
+        device and back, re-placed per the parallel/mesh.py specs."""
+        async def go():
+            import jax
+            devs = jax.devices()
+            if len(devs) < 2:
+                pytest.skip("needs the virtual multi-device CPU mesh")
+            x, labels, mask = mk_data(seed=6, n=64)
+            sharded = InProcessScorer(seed=0)
+            assert sharded.mesh is not None
+            await train(sharded, x, labels, mask, rounds=2)
+            snap = sharded.snapshot()
+
+            single = InProcessScorer(seed=7, devices=one_device())
+            single.restore(snap)
+            a = np.asarray(await sharded.score(x))
+            b = np.asarray(await single.score(x))
+            np.testing.assert_allclose(a, b, atol=1e-5)
+
+            # single -> sharded, and training continues on the mesh
+            await train(single, x, labels, mask, rounds=1)
+            sharded.restore(single.snapshot())
+            c = np.asarray(await single.score(x))
+            d = np.asarray(await sharded.score(x))
+            np.testing.assert_allclose(c, d, atol=1e-5)
+            assert np.isfinite(await sharded.fit(x, labels, mask))
+
+        run(go())
+
+    def test_restore_rejects_mismatched_config(self):
+        from linkerd_tpu.models.anomaly import AnomalyModelConfig
+        scorer = InProcessScorer(seed=0, devices=one_device())
+        snap = scorer.snapshot()
+        other = InProcessScorer(seed=0, recon_weight=0.11,
+                                devices=one_device())
+        with pytest.raises(ValueError):
+            other.restore(snap)
+        assert AnomalyModelConfig().in_dim == snap.cfg.in_dim
+
+
+class TestPromotionGate:
+    def mk_report(self, loss, auc, n_labeled=64):
+        return EvalReport(loss=loss, auc=auc, score_mean=0.5,
+                          score_std=0.1, n_rows=256, n_labeled=n_labeled)
+
+    def test_decisions(self):
+        gate = PromotionGate(GatePolicy(aucTolerance=0.02,
+                                        lossTolerance=0.10))
+        serving = self.mk_report(1.0, 0.95)
+        assert gate.decide(self.mk_report(1.0, 0.95), None).accepted
+        assert gate.decide(self.mk_report(1.05, 0.95), serving).accepted
+        assert not gate.decide(self.mk_report(1.5, 0.95), serving).accepted
+        assert not gate.decide(self.mk_report(1.0, 0.80), serving).accepted
+        # too few labels: AUC ignored, loss rules
+        d = gate.decide(self.mk_report(1.0, 0.10, n_labeled=2), serving)
+        assert d.accepted
+        assert not gate.decide(
+            self.mk_report(float("nan"), 0.99), serving).accepted
+
+    def test_poisoned_candidate_rejected_and_rolled_back(self, tmp_path):
+        """Acceptance: a candidate degraded by training on poisoned
+        labels is rejected by the gate; the scorer hot-swaps back to the
+        last-good version and keeps scoring identically."""
+        async def go():
+            x, labels, mask = mk_data(n=192, seed=7)
+            scorer = InProcessScorer(seed=0, devices=one_device(),
+                                     learning_rate=5e-3)
+            await train(scorer, x, labels, mask, rounds=6)
+
+            store = CheckpointStore(str(tmp_path))
+            gate = PromotionGate(GatePolicy())
+            replay = ReplayWindow(4096)
+            replay.add_batch(x, labels, mask)
+            mgr = ModelLifecycleManager(store, gate, replay,
+                                        min_replay_rows=32)
+
+            # first cycle bootstraps: the trained model becomes serving
+            out1 = await mgr.run_cycle(scorer)
+            assert out1["action"] == "promoted"
+            serving_scores = np.asarray(await scorer.score(x))
+
+            # a little more good training -> promoted again
+            await train(scorer, x, labels, mask, rounds=1)
+            out2 = await mgr.run_cycle(scorer)
+            assert out2["action"] == "promoted"
+            assert mgr.serving_version == out2["version"]
+            serving_scores = np.asarray(await scorer.score(x))
+
+            # poison: train hard on flipped labels
+            await train(scorer, x, 1.0 - labels, mask, rounds=12)
+            out3 = await mgr.run_cycle(scorer)
+            assert out3["action"] == "rolled_back", out3
+            assert mgr.rollbacks == 1 and mgr.rejections == 1
+            # the serving version keeps scoring: post-rollback scores are
+            # bitwise the promoted version's scores
+            restored = np.asarray(await scorer.score(x))
+            assert restored.tobytes() == serving_scores.tobytes()
+            # the rejected candidate is retained for forensics
+            statuses = {e.version: e.status for e in store.versions()}
+            assert statuses[out3["rejected_version"]] == "rejected"
+            assert statuses[mgr.serving_version] == "promoted"
+
+        run(go())
+
+    def test_shadow_eval_separates_good_from_poisoned(self):
+        async def go():
+            x, labels, mask = mk_data(n=192, seed=8)
+            good = InProcessScorer(seed=0, devices=one_device(),
+                                   learning_rate=5e-3)
+            await train(good, x, labels, mask, rounds=6)
+            bad = InProcessScorer(seed=0, devices=one_device(),
+                                  learning_rate=5e-3)
+            await train(bad, x, 1.0 - labels, mask, rounds=6)
+            rg = evaluate_snapshot(good.snapshot(), x, labels, mask)
+            rb = evaluate_snapshot(bad.snapshot(), x, labels, mask)
+            assert rg.loss < rb.loss
+            assert rg.auc > rb.auc
+            assert rg.n_labeled == len(x)
+
+        run(go())
+
+
+class TestDrift:
+    def test_gauges_emitted_via_metrics_registry(self):
+        mt = MetricsTree()
+        mon = DriftMonitor(mt.scope("anomaly", "drift"), momentum=0.5)
+        rng = np.random.default_rng(0)
+        base = rng.standard_normal((256, 8)).astype(np.float32)
+        mon.observe(base, scores=np.full(256, 0.2, np.float32))
+        mon.set_reference(base.mean(axis=0), base.var(axis=0),
+                          version=1, step=10)
+        flat = mt.flatten()
+        assert flat["anomaly/drift/feature_shift"] == pytest.approx(0.0,
+                                                                    abs=0.2)
+        # shift the population: means move by +3, scores jump
+        for _ in range(8):
+            mon.observe(base + 3.0, scores=np.full(256, 0.9, np.float32))
+        flat = mt.flatten()
+        assert flat["anomaly/drift/feature_shift"] > 1.0
+        assert flat["anomaly/drift/score_shift"] > 1.0
+        snap = mon.snapshot()
+        assert snap["reference_version"] == 1
+        assert snap["batches_observed"] == 9
+
+
+class TestSidecarLifecycle:
+    def test_snapshot_restore_over_grpc(self):
+        pytest.importorskip("grpc")
+        from linkerd_tpu.telemetry.sidecar import (
+            GrpcScorerClient, ScorerSidecar,
+        )
+
+        async def go():
+            x, labels, mask = mk_data(n=64, seed=9)
+            backend = InProcessScorer(seed=0, devices=one_device())
+            sidecar = await ScorerSidecar(scorer=backend).start()
+            client = GrpcScorerClient(f"127.0.0.1:{sidecar.port}")
+            try:
+                for _ in range(3):
+                    await client.fit(x, labels, mask)
+                before = await client.score(x)
+                snap = await client.snapshot()
+                assert snap.step == backend._step
+                # keep training, then roll the sidecar back over the wire
+                await client.fit(x, 1.0 - labels, mask)
+                step = await client.restore(snap)
+                assert step == snap.step
+                after = await client.score(x)
+                assert before.tobytes() == after.tobytes()
+            finally:
+                client.close()
+                await sidecar.close()
+
+        run(go())
+
+
+class TestTelemeterLifecycle:
+    def mk_cfg(self, tmp_path, **kw):
+        from linkerd_tpu.telemetry.anomaly import JaxAnomalyConfig
+        lc = LifecycleConfig(directory=str(tmp_path / "ckpts"),
+                             checkpointEveryS=0, minReplayRows=16,
+                             **kw)
+        return JaxAnomalyConfig(maxBatch=64, trainEveryBatches=1,
+                                lifecycle=lc)
+
+    def feed(self, tele, n=48, seed=0, anomalous=False):
+        from linkerd_tpu.models.features import FeatureVector
+        rng = np.random.default_rng(seed)
+        for i in range(n):
+            fv = FeatureVector(
+                latency_ms=float(rng.gamma(2.0, 200.0 if anomalous else 5.0)),
+                status=500 if anomalous else 200,
+                dst_path="/svc/web")
+            tele.ring.append((fv, 1.0 if anomalous else 0.0))
+
+    def test_yaml_config_wires_lifecycle(self, tmp_path):
+        """The YAML lifecycle block flows through the config parser into
+        a live manager (linker startup path)."""
+        from linkerd_tpu.config.parser import instantiate
+        cfg = instantiate("telemeter", {
+            "kind": "io.l5d.jaxAnomaly",
+            "lifecycle": {"directory": str(tmp_path / "store"),
+                          "retain": 7, "aucTolerance": 0.05},
+        })
+        tele = cfg.mk(MetricsTree())
+        assert tele.lifecycle is not None
+        assert tele.lifecycle.store.retain == 7
+        assert tele.lifecycle.gate.policy.aucTolerance == 0.05
+        assert os.path.isdir(str(tmp_path / "store"))
+        tele.close()
+
+    def test_replay_window_is_held_out_from_training(self, tmp_path):
+        """Shadow-eval batches must be excluded from training: a window
+        the candidate trained on (same rows and labels) could not catch
+        a poisoned training stream."""
+        async def go():
+            tele = self.mk_cfg(tmp_path).mk(MetricsTree())
+            scorer = tele._ensure_scorer()
+            hk = tele.cfg.lifecycle.holdoutEveryBatches
+            for i in range(3 * hk):
+                self.feed(tele, 8, seed=i)
+                step_before = scorer._step
+                await tele.drain_once()
+                if (tele._batch_i - 1) % hk == 0:
+                    # holdout batch: replay grew, no training happened
+                    assert scorer._step == step_before
+                else:
+                    assert scorer._step > step_before
+            assert len(tele.lifecycle.replay) == 3 * 8
+            tele.close()
+
+        run(go())
+
+    def test_drain_cycle_model_json_and_restart_restore(self, tmp_path):
+        """Telemeter-integrated loop: drain feeds the replay window and
+        drift gauges; a cycle promotes; /model.json reports state; a NEW
+        telemeter (restart) restores the promoted model."""
+        async def go():
+            mt = MetricsTree()
+            tele = self.mk_cfg(tmp_path).mk(mt)
+            self.feed(tele, 48, seed=1)
+            await tele.drain_once()
+            assert len(tele.lifecycle.replay) == 48
+            out = await tele.lifecycle_cycle()
+            assert out["action"] == "promoted"
+            state = tele.model_state()
+            assert state["serving_version"] == out["version"]
+            assert state["lifecycle_enabled"] is True
+            assert state["drift"]["batches_observed"] == 1
+            handlers = dict(tele.admin_handlers())
+            assert "/model.json" in handlers
+            from linkerd_tpu.protocol.http.message import Request
+            rsp = await handlers["/model.json"](Request())
+            assert rsp.status == 200 and b"serving_version" in rsp.body
+            flat = mt.flatten()
+            assert flat["anomaly/model/version"] == out["version"]
+            x = np.random.default_rng(3).standard_normal(
+                (32, tele._scorer.cfg.in_dim)).astype(np.float32)
+            before = np.asarray(await tele._scorer.score(x))
+            tele.close()  # writes the shutdown candidate snapshot
+
+            # "restart": a fresh telemeter restores last-good on bootstrap
+            tele2 = self.mk_cfg(tmp_path).mk(MetricsTree())
+            scorer2 = tele2._ensure_scorer()
+            restored = await tele2.lifecycle.bootstrap(scorer2)
+            assert restored == out["version"]
+            after = np.asarray(await scorer2.score(x))
+            assert before.tobytes() == after.tobytes()
+            tele2.close()
+
+        run(go())

@@ -13,6 +13,11 @@ io.l5d.thriftNameInterpreter), and the chunked-HTTP interpreter
 protocols.
 
 Usage: python tools/validator.py [mesh|thrift|http ...]  (exit 0 = pass)
+
+Also validates model-checkpoint stores (the lifecycle subsystem's
+artifact integrity: CRCs, manifest/file agreement, lineage, orphans):
+
+    python tools/validator.py ckpt <store-dir> [<store-dir> ...]
 """
 
 from __future__ import annotations
@@ -214,8 +219,54 @@ admin:
         d_b.close()
 
 
+def validate_checkpoints(dirs) -> int:
+    """Verify each checkpoint store: per-file CRC + full decode, manifest
+    agreement, lineage (parents known or recorded as pruned), orphaned
+    files, and that the serving version actually loads. Exit 0 = healthy."""
+    from linkerd_tpu.lifecycle import CheckpointError, CheckpointStore
+
+    failed = 0
+    for d in dirs:
+        issues = []
+        serving = None
+        # a validator must never CREATE state: a mistyped path passing as
+        # an empty healthy store would hide the real (corrupt) one
+        if not os.path.isdir(d):
+            issues = [f"store directory does not exist: {d}"]
+        else:
+            try:
+                store = CheckpointStore(d)
+                issues = store.verify()
+                serving = store.latest_good()
+                if serving is not None and not any(
+                        "missing" in i or "CRC" in i for i in issues):
+                    store.load(serving)  # rollback target must restore
+            except CheckpointError as e:
+                issues.append(f"store unreadable: {e}")
+        if issues:
+            failed += 1
+            print(f"validator[ckpt]: {d} FAILED")
+            for issue in issues:
+                print(f"  - {issue}")
+        else:
+            n = len(store.versions())
+            print(f"validator[ckpt]: {d} ok "
+                  f"({n} versions, serving v{serving})")
+    if failed:
+        return 1
+    print(f"VALIDATOR PASS (ckpt x{len(dirs)})")
+    return 0
+
+
 async def main() -> int:
-    protocols = sys.argv[1:] or ["mesh", "thrift", "http"]
+    args = sys.argv[1:]
+    if args and args[0] == "ckpt":
+        if len(args) < 2:
+            print("usage: python tools/validator.py ckpt <store-dir>...",
+                  file=sys.stderr)
+            return 64
+        return validate_checkpoints(args[1:])
+    protocols = args or ["mesh", "thrift", "http"]
     for protocol in protocols:
         await validate(protocol)
     print(f"VALIDATOR PASS ({', '.join(protocols)})")
