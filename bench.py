@@ -82,7 +82,9 @@ def scorer_throughput() -> dict:
         "rows_per_s_pipelined": round(batch * n_iters / dt_pipe, 1),
         "score_batch_p50_ms": round(lats[len(lats) // 2], 3),
         "score_batch_p99_ms": round(lats[int(0.99 * (len(lats) - 1))], 3),
-        "transfer_dtype": "bfloat16",
+        # raw f32 ships; normalization is fused on-device (see
+        # InProcessScorer._prep)
+        "transfer_dtype": "float32",
         "batch": batch,
         "iters": n_iters,
         # the mesh path uses plain XLA sharding, never the fused kernel
@@ -288,6 +290,24 @@ def lifecycle_bench() -> dict:
     return asyncio.run(drive())
 
 
+def static_analysis_bench() -> dict:
+    """l5dlint wall time over the full tree — the suite gates tier-1
+    (tests/test_static_analysis.py), so it must stay interactive-fast;
+    this entry catches a checker regressing into an O(files^2) sweep."""
+    from tools.analysis import rule_ids, run_analysis
+
+    t0 = time.perf_counter()
+    findings = run_analysis(["linkerd_tpu"])
+    wall_s = time.perf_counter() - t0
+    unsuppressed = [f for f in findings if not f.suppressed]
+    return {
+        "wall_s": round(wall_s, 3),
+        "findings_unsuppressed": len(unsuppressed),
+        "findings_suppressed": len(findings) - len(unsuppressed),
+        "rules": len(rule_ids()),
+    }
+
+
 def fault_auc_bench() -> dict:
     """Config 3 in-process: reuses this process's (TPU) device for the
     scorer, matching the telemeter's real serving path."""
@@ -376,6 +396,11 @@ def main() -> None:
         detail["lifecycle"] = lifecycle_bench()
     except Exception as e:  # noqa: BLE001
         detail["lifecycle_error"] = repr(e)
+
+    try:
+        detail["static_analysis"] = static_analysis_bench()
+    except Exception as e:  # noqa: BLE001
+        detail["static_analysis_error"] = repr(e)
 
     baseline = 50_000.0  # north-star: >=50k req/s scored (BASELINE.md)
     print(json.dumps({

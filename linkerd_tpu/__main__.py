@@ -40,7 +40,11 @@ async def amain(config_text: str) -> None:
         log.info("identifier debug server on %s:%s", admin_spec.ip,
                  identifier_server.bound_port)
 
-    telemeter_tasks = [asyncio.create_task(t.run()) for t in linker.telemeters]
+    from linkerd_tpu.core.tasks import monitor
+    telemeter_tasks = [
+        monitor(asyncio.create_task(t.run()),
+                what=f"telemeter-{type(t).__name__}")
+        for t in linker.telemeters]
 
     # usage telemetry is opt-out (ref: Linker.scala:116-125 implicit
     # telemeters; disable with `usage: {enabled: false}`)
@@ -52,7 +56,8 @@ async def amain(config_text: str) -> None:
         log.info("anonymized usage telemetry enabled -> %s "
                  "(disable with `usage: {enabled: false}`)",
                  usage._host)
-        telemeter_tasks.append(asyncio.create_task(usage.run()))
+        telemeter_tasks.append(monitor(asyncio.create_task(usage.run()),
+                                       what="telemeter-usage"))
 
     for r in linker.routers:
         log.info("router %s serving on %s", r.label, r.server_ports)

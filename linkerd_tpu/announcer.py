@@ -174,6 +174,10 @@ class ZkAnnouncer(Announcer):
 @register("announcer", "io.l5d.serversets")
 @dataclass
 class ZkAnnouncerConfig:
+    """Announce server ports as ZooKeeper serversets under
+    ``pathPrefix`` (finagle-compatible member JSON), so serverset-aware
+    namers (io.l5d.serversets) resolve this router's listeners."""
+
     zkAddrs: list = None  # type: ignore[assignment]
     hosts: str = ""
     pathPrefix: str = "/discovery"

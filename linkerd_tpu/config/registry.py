@@ -1,10 +1,14 @@
 """Plugin registry: (category, kind) -> config class.
 
 Reference parity: LoadService/META-INF SPI discovery + the unique-kind
-enforcement in Parser.scala:68-90. Categories mirror the 10 SPI kinds the
-reference Linker loads (Linker.scala:64-75): protocol, namer, interpreter,
-transformer, identifier, classifier, telemeter, announcer, failureAccrual,
-logger — plus namerd's storage and iface.
+enforcement in Parser.scala:68-90. ``CATEGORIES`` is the authoritative
+inventory of every category actually registered in this tree (protocols
+are wired by the linker directly, not through the registry): the SPI
+kinds the reference Linker loads (Linker.scala:64-75) with h1/h2 split
+identifier/classifier categories, plus namerd's dtab storage and
+control-plane iface categories. The l5dlint ``config-registry`` rule
+cross-checks every ``@register`` call against this tuple, so a new
+category must be declared here before it can register kinds.
 """
 
 from __future__ import annotations
@@ -19,9 +23,11 @@ class ConfigError(Exception):
 _REGISTRY: Dict[str, Dict[str, type]] = {}
 
 CATEGORIES = (
-    "protocol", "namer", "interpreter", "transformer", "identifier",
-    "classifier", "telemeter", "announcer", "failureAccrual", "logger",
-    "storage", "iface",
+    "namer", "interpreter", "transformer",
+    "identifier", "h2identifier",      # h1 / h2 request identification
+    "classifier", "h2classifier",      # h1 / h2 response classification
+    "telemeter", "announcer", "failureAccrual", "logger",
+    "dtabStore", "namerdIface",        # namerd storage + control ifaces
 )
 
 

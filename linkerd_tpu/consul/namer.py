@@ -171,6 +171,10 @@ class ConsulNamer(Namer):
 @register("namer", "io.l5d.consul")
 @dataclass
 class ConsulNamerConfig:
+    """Name via consul catalog/health: ``/#/io.l5d.consul/<dc>/<svc>``
+    resolves through blocking-index long-polls; ``consistencyMode`` and
+    tag filtering mirror the reference's io.l5d.consul options."""
+
     host: str = "127.0.0.1"
     port: int = 8500
     token: Optional[str] = None

@@ -118,9 +118,14 @@ class DecodingStream(Generic[T]):
                 self._status = GrpcStatus.from_reset(rst)
                 continue
             if isinstance(frame, DataFrame):
-                self._ready.extend(self._framer.feed(frame.data))
-                eos = frame.eos
-                frame.release()
+                # release on the exception edge too: a malformed gRPC
+                # frame raising out of the re-framer must not strand the
+                # h2 flow credit this DATA frame holds
+                try:
+                    self._ready.extend(self._framer.feed(frame.data))
+                    eos = frame.eos
+                finally:
+                    frame.release()
                 if eos and self._status is None:
                     # end without trailers: OK iff no partial message
                     if self._framer.pending_bytes:
@@ -130,8 +135,10 @@ class DecodingStream(Generic[T]):
                     else:
                         self._status = GrpcStatus(OK)
             elif isinstance(frame, Trailers):
-                self._status = GrpcStatus.from_trailers(frame)
-                frame.release()
+                try:
+                    self._status = GrpcStatus.from_trailers(frame)
+                finally:
+                    frame.release()
             else:  # pragma: no cover - unknown frame kind
                 raise GrpcError.of(13, f"unexpected frame {frame!r}")
 

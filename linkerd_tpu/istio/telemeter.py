@@ -125,9 +125,14 @@ class IstioTelemeter(Telemeter):
         if self._h2 is not None:
             h2, self._h2 = self._h2, None
             try:
-                asyncio.get_running_loop().create_task(h2.close())
+                asyncio.get_running_loop()
             except RuntimeError:
-                pass
+                # no running loop (interpreter teardown): the transport
+                # dies with the process. Checked BEFORE h2.close() is
+                # called so no never-awaited coroutine is orphaned.
+                return
+            from linkerd_tpu.core.tasks import spawn
+            spawn(h2.close(), what="istio-mixer-h2-close")
 
 
 class _IstioLoggerFilter(MixerReportFilter):

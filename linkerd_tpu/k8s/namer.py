@@ -266,6 +266,10 @@ class K8sNamerConfig:
 @register("namer", "io.l5d.k8s.ns")
 @dataclass
 class K8sNamespacedConfig:
+    """io.l5d.k8s pinned to one namespace:
+    ``/#/io.l5d.k8s.ns/<port>/<svc>`` — the in-cluster shape where the
+    namespace comes from config, not the name path."""
+
     namespace: str = "default"
     host: str = "localhost"   # "" -> in-cluster service account
     port: int = 8001
@@ -286,6 +290,9 @@ class K8sNamespacedConfig:
 @register("namer", "io.l5d.k8s.external")
 @dataclass
 class K8sExternalConfig:
+    """Resolve services to their EXTERNAL addresses (LoadBalancer
+    ingress / NodePort), for routers running outside the cluster."""
+
     host: str = "localhost"   # "" -> in-cluster service account
     port: int = 8001
     useTls: bool = False

@@ -234,6 +234,11 @@ class MarathonNamer(Namer):
 @register("namer", "io.l5d.marathon")
 @dataclass
 class MarathonNamerConfig:
+    """Name via Marathon app ids:
+    ``/#/io.l5d.marathon/<app>`` polls the tasks API every ``ttlMs``;
+    DC/OS service-account JWT auth (ACS login, token refresh on 401)
+    engages when credentials are configured."""
+
     host: str = "marathon.mesos"
     port: int = 8080
     ttlMs: int = 5000

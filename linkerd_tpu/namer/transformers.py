@@ -176,6 +176,9 @@ class SubnetGatewayTransformer(AddressTransformer):
 @register("transformer", "io.l5d.port")
 @dataclass
 class PortTransformerConfig:
+    """Rewrite every bound address to ``port`` (route to a sidecar
+    proxy listening on a fixed port on each replica's host)."""
+
     port: int = 4140
 
     def mk(self) -> AddressTransformer:
@@ -185,6 +188,9 @@ class PortTransformerConfig:
 @register("transformer", "io.l5d.localhost")
 @dataclass
 class LocalhostTransformerConfig:
+    """Replace every bound host with 127.0.0.1, keeping ports — the
+    node-local sidecar shape (ref: io.l5d.localhost)."""
+
     def mk(self) -> AddressTransformer:
         return LocalhostTransformer()
 
@@ -192,6 +198,9 @@ class LocalhostTransformerConfig:
 @register("transformer", "io.l5d.specificHost")
 @dataclass
 class SpecificHostTransformerConfig:
+    """Replace every bound host with ``host``, keeping ports (pin all
+    traffic through one gateway address)."""
+
     host: str = "127.0.0.1"
 
     def mk(self) -> AddressTransformer:
@@ -408,6 +417,9 @@ class ConstTransformer(AddressTransformer):
 @register("transformer", "io.l5d.const")
 @dataclass
 class ConstTransformerConfig:
+    """Replace every binding with the tree bound at ``path`` — the
+    blunt "send everything here" override."""
+
     path: str = ""
 
     def mk(self) -> AddressTransformer:

@@ -313,6 +313,10 @@ class CuratorNamer(_ZkNamerBase):
 @register("namer", "io.l5d.serversets")
 @dataclass
 class ServersetsNamerConfig:
+    """Name via finagle serversets:
+    ``/#/io.l5d.serversets/<zk-path>[:endpoint]`` resolves member znode
+    JSON (serviceEndpoint + additionalEndpoints) with live watches."""
+
     zkAddrs: list = field(default_factory=list)
     hosts: str = ""           # alternative: "host:port,host:port"
     prefix: str = "/io.l5d.serversets"
@@ -328,6 +332,9 @@ class ServersetsNamerConfig:
 @register("namer", "io.l5d.zkLeader")
 @dataclass
 class ZkLeaderNamerConfig:
+    """Resolve to the current leader of a ZooKeeper leader-election
+    group (lowest sequence znode), failing over on leader change."""
+
     zkAddrs: list = field(default_factory=list)
     hosts: str = ""
     prefix: str = "/io.l5d.zkLeader"
@@ -343,6 +350,9 @@ class ZkLeaderNamerConfig:
 @register("namer", "io.l5d.curator")
 @dataclass
 class CuratorNamerConfig:
+    """Name via Apache Curator service discovery under ``basePath``:
+    ServiceInstance JSON (address/port/sslPort) with live watches."""
+
     zkAddrs: list = field(default_factory=list)
     hosts: str = ""
     basePath: str = "/discovery"

@@ -388,6 +388,9 @@ class ConsulDtabStore(_WatchedRemoteStore):
 @register("dtabStore", "io.l5d.etcd")
 @dataclass
 class EtcdStoreConfig:
+    """Dtabs as etcd keys under ``pathPrefix``; modifiedIndex is the
+    CAS token, recursive watches feed observers."""
+
     host: str = "127.0.0.1"
     port: int = 2379
     pathPrefix: str = "/namerd/dtabs"
@@ -399,6 +402,9 @@ class EtcdStoreConfig:
 @register("dtabStore", "io.l5d.consul")
 @dataclass
 class ConsulStoreConfig:
+    """Dtabs in consul KV under ``pathPrefix``; ModifyIndex is the CAS
+    token, blocking-index long-polls feed observers."""
+
     host: str = "127.0.0.1"
     port: int = 8500
     pathPrefix: str = "namerd/dtabs"
@@ -576,6 +582,9 @@ class ZkDtabStore(DtabStore):
 @register("dtabStore", "io.l5d.zk")
 @dataclass
 class ZkStoreConfig:
+    """Dtabs as znodes under ``pathPrefix``; the znode version is the
+    CAS token, native ZooKeeper watches feed observers."""
+
     zkAddrs: Optional[list] = None
     hosts: str = ""
     pathPrefix: str = "/dtabs"

@@ -21,7 +21,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from linkerd_tpu.models.anomaly import AnomalyModelConfig, Params, anomaly_scores
+from linkerd_tpu.models.anomaly import (
+    AnomalyModelConfig, Params, anomaly_scores, normalize_features,
+)
 
 
 def _flatten_layers(params: Params):
@@ -131,7 +133,19 @@ def fused_available(cfg: AnomalyModelConfig = AnomalyModelConfig()) -> bool:
 
 
 def best_scorer(cfg: AnomalyModelConfig = AnomalyModelConfig()):
-    """Return a jitted scorer: the fused kernel when available, else XLA."""
+    """Return a jitted scorer: the fused kernel when available, else XLA.
+
+    The returned fn is ``(params, x, mu=None, var=None) -> scores``:
+    with mu/var, ``normalize_features`` runs on device ahead of the
+    kernel (XLA fuses the z-score into the input tile load), so the
+    host ships raw f32 features and never touches the batch.
+    """
+
+    def _norm(v, mu, var):
+        return v if mu is None else normalize_features(v, mu, var)
+
     if fused_available(cfg):
-        return jax.jit(lambda p, v: fused_anomaly_scores(p, v, cfg))
-    return jax.jit(lambda p, v: anomaly_scores(p, v, cfg))
+        return jax.jit(lambda p, v, mu=None, var=None:
+                       fused_anomaly_scores(p, _norm(v, mu, var), cfg))
+    return jax.jit(lambda p, v, mu=None, var=None:
+                   anomaly_scores(p, _norm(v, mu, var), cfg))

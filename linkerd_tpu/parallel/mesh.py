@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from linkerd_tpu.models.anomaly import (
     AnomalyModelConfig, Params, init_params, anomaly_scores, loss_fn,
+    normalize_features,
 )
 
 
@@ -118,13 +119,22 @@ def shard_params(mesh: Mesh, params: Params) -> Params:
 
 def make_score_step(
     mesh: Mesh, cfg: AnomalyModelConfig = AnomalyModelConfig()
-) -> Callable[[Params, jax.Array], jax.Array]:
-    """Jitted scoring step: features [B, D] -> scores [B]."""
+) -> Callable[..., jax.Array]:
+    """Jitted scoring step: features [B, D] -> scores [B].
+
+    With ``mu``/``var`` (replicated device arrays), feature
+    normalization runs on device, fused ahead of the first matmul: each
+    data-axis shard z-scores its own rows, the host never touches the
+    batch (normalize_features' contract). Without them the step scores
+    raw features (pre-normalized or synthetic-test input).
+    """
     xs = batch_sharding(mesh)
 
     @jax.jit
-    def score(params: Params, x: jax.Array) -> jax.Array:
+    def score(params: Params, x: jax.Array, mu=None, var=None) -> jax.Array:
         x = jax.lax.with_sharding_constraint(x, xs)
+        if mu is not None:
+            x = normalize_features(x, mu, var)
         return anomaly_scores(params, x, cfg)
 
     return score
@@ -139,18 +149,23 @@ def make_train_step(
 
     Gradients are averaged over "data" and hidden-dim partial sums reduced
     over "model" by XLA-inserted collectives; we only annotate shardings.
+    ``mu``/``var`` (replicated) fold feature normalization into the step
+    the same way make_score_step does — train and serve see identical
+    normalized inputs.
     """
     xs = batch_sharding(mesh)
     vs = NamedSharding(mesh, P("data"))
 
     @jax.jit
     def train_step(params: Params, opt_state, x, labels, label_mask,
-                   row_mask=None):
+                   row_mask=None, mu=None, var=None):
         x = jax.lax.with_sharding_constraint(x, xs)
         labels = jax.lax.with_sharding_constraint(labels, vs)
         label_mask = jax.lax.with_sharding_constraint(label_mask, vs)
         if row_mask is not None:
             row_mask = jax.lax.with_sharding_constraint(row_mask, vs)
+        if mu is not None:
+            x = normalize_features(x, mu, var)
         loss, grads = jax.value_and_grad(loss_fn)(
             params, x, labels, label_mask, cfg, row_mask)
         updates, opt_state = optimizer.update(grads, opt_state, params)

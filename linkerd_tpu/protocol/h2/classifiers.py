@@ -89,6 +89,9 @@ class _StatusClassifier(H2Classifier):
 @register("h2classifier", "io.l5d.h2.nonRetryable5XX")
 @dataclass
 class H2NonRetryable5XX:
+    """5xx is failure, never retryable (h2 twin of
+    io.l5d.http.nonRetryable5XX)."""
+
     def mk(self) -> H2Classifier:
         return _StatusClassifier(frozenset())
 
@@ -96,6 +99,8 @@ class H2NonRetryable5XX:
 @register("h2classifier", "io.l5d.h2.retryableRead5XX")
 @dataclass
 class H2RetryableRead5XX:
+    """5xx on read methods (GET/HEAD/OPTIONS/TRACE) is retryable."""
+
     def mk(self) -> H2Classifier:
         return _StatusClassifier(READ_METHODS)
 
@@ -103,6 +108,8 @@ class H2RetryableRead5XX:
 @register("h2classifier", "io.l5d.h2.retryableIdempotent5XX")
 @dataclass
 class H2RetryableIdempotent5XX:
+    """5xx on idempotent methods (reads + PUT/DELETE) is retryable."""
+
     def mk(self) -> H2Classifier:
         return _StatusClassifier(IDEMPOTENT_METHODS)
 
@@ -125,6 +132,9 @@ class _AllSuccessfulClassifier(H2Classifier):
 @register("h2classifier", "io.l5d.h2.allSuccessful")
 @dataclass
 class H2AllSuccessful:
+    """Every response is a success; only transport errors fail (and
+    non-retryably — side effects may have happened)."""
+
     def mk(self) -> H2Classifier:
         return _AllSuccessfulClassifier()
 
@@ -164,6 +174,9 @@ class _GrpcClassifier(H2Classifier):
 @register("h2classifier", "io.l5d.h2.grpc.default")
 @dataclass
 class GrpcDefault:
+    """grpc-status 0 is success; the conventionally-safe codes
+    (UNAVAILABLE, ...) retry."""
+
     def mk(self) -> H2Classifier:
         return _GrpcClassifier(RETRYABLE_GRPC_CODES)
 
@@ -171,6 +184,8 @@ class GrpcDefault:
 @register("h2classifier", "io.l5d.h2.grpc.alwaysRetryable")
 @dataclass
 class GrpcAlwaysRetryable:
+    """Any non-zero grpc-status retries."""
+
     def mk(self) -> H2Classifier:
         return _GrpcClassifier(frozenset(), always=True)
 
@@ -178,6 +193,8 @@ class GrpcAlwaysRetryable:
 @register("h2classifier", "io.l5d.h2.grpc.neverRetryable")
 @dataclass
 class GrpcNeverRetryable:
+    """No grpc-status ever retries."""
+
     def mk(self) -> H2Classifier:
         return _GrpcClassifier(frozenset(), never=True)
 
@@ -185,6 +202,8 @@ class GrpcNeverRetryable:
 @register("h2classifier", "io.l5d.h2.grpc.retryableStatusCodes")
 @dataclass
 class GrpcRetryableStatusCodes:
+    """Exactly the listed grpc-status codes retry."""
+
     retryableStatusCodes: List[int] = field(default_factory=list)
 
     def mk(self) -> H2Classifier:

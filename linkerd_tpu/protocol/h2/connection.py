@@ -19,6 +19,7 @@ import asyncio
 import logging
 from typing import Awaitable, Callable, Dict, List, Optional, Tuple
 
+from linkerd_tpu.core.tasks import monitor, spawn
 from linkerd_tpu.protocol.h2 import frames, hpack
 from linkerd_tpu.protocol.h2.frames import (
     CONNECTION_PREFACE, DEFAULT_INITIAL_WINDOW, DEFAULT_MAX_FRAME_SIZE,
@@ -141,7 +142,7 @@ class H2Connection:
             data, self._wbuf = self._wbuf, bytearray()
             try:
                 self._writer.write(data)
-            except Exception:  # noqa: BLE001 — transport torn down
+            except (OSError, RuntimeError):  # transport torn down
                 pass
 
     async def _drain(self) -> None:
@@ -152,7 +153,7 @@ class H2Connection:
             if (self._writer.transport.get_write_buffer_size()
                     > WRITE_HIGH_WATER):
                 await self._writer.drain()
-        except Exception:  # noqa: BLE001
+        except (OSError, RuntimeError):  # transport torn down mid-drain
             pass
 
     # ── lifecycle ────────────────────────────────────────────────────────
@@ -179,7 +180,10 @@ class H2Connection:
             0, self._local_conn_window - DEFAULT_INITIAL_WINDOW))
         self._recv_window = self._local_conn_window
         await self._drain()
-        self._read_task = self._loop.create_task(self._read_loop())
+        # a crashed read loop must be loud: it looks exactly like a hung
+        # peer from the application side
+        self._read_task = monitor(
+            self._loop.create_task(self._read_loop()), what="h2-read-loop")
         return self
 
     @property
@@ -198,14 +202,16 @@ class H2Connection:
                 self._wbuf += frames.pack_goaway(self._last_peer_stream, code)
                 self._do_flush()
                 await self._drain()
-            except Exception:  # noqa: BLE001
+            except (OSError, RuntimeError):  # peer already gone
                 pass
         if self._read_task is not None and not self._read_task.done():
             self._read_task.cancel()
             try:
                 await self._read_task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            except asyncio.CancelledError:
                 pass
+            except Exception as e:  # noqa: BLE001 — already closing, but
+                log.debug("h2 read loop exit on close: %r", e)  # be loud-ish
         self._fail_all(StreamReset(frames.CANCEL, "connection closed"))
         for t in list(self._handler_tasks):
             t.cancel()
@@ -214,7 +220,7 @@ class H2Connection:
         # Server.wait_closed().
         try:
             self._writer.close()
-        except Exception:  # noqa: BLE001
+        except (OSError, RuntimeError):  # transport already detached
             pass
 
     def _fail_all(self, err: StreamReset) -> None:
@@ -231,8 +237,7 @@ class H2Connection:
                 w.set_result(None)
         self._slot_waiters.clear()
         # wake any senders blocked on flow-control so they observe closure
-        loop = asyncio.get_event_loop()
-        loop.create_task(self._notify_windows())
+        spawn(self._notify_windows(), what="h2-notify-windows-close")
 
     # ── client API ───────────────────────────────────────────────────────
     async def request(self, req: H2Request) -> H2Response:
@@ -389,7 +394,7 @@ class H2Connection:
         if not self._closed:
             try:
                 self._write(frames.pack_rst(st.id, code))
-            except Exception:  # noqa: BLE001
+            except (OSError, RuntimeError):  # transport torn down
                 pass
         st.recv_stream.reset(code)
         self._streams.pop(st.id, None)
@@ -462,7 +467,7 @@ class H2Connection:
             self._fail_all(StreamReset(frames.CANCEL, "connection lost"))
             try:
                 self._writer.close()
-            except Exception:  # noqa: BLE001
+            except (OSError, RuntimeError):  # transport already detached
                 pass
         except asyncio.CancelledError:
             raise
@@ -474,7 +479,7 @@ class H2Connection:
                     self._last_peer_stream, e.code))
                 await self._drain()
                 self._writer.close()
-            except Exception:  # noqa: BLE001
+            except (OSError, RuntimeError):  # peer already gone
                 pass
             self._fail_all(StreamReset(frames.PROTOCOL_ERROR, str(e)))
         except Exception:  # noqa: BLE001
@@ -593,8 +598,11 @@ class H2Connection:
                         self._write(frames.pack_window_update(
                             _sid, stt.pending_credit))
                         stt.pending_credit = 0
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001 — app-land release()
+                # must never throw into the consumer, but a failed
+                # credit return wedges the peer's send window: say so
+                log.debug("h2 credit return failed (stream %d): %r",
+                          _sid, e)
 
         st.recv_stream.offer(DataFrame(data, eos, release=credit))
         if eos:
@@ -677,7 +685,7 @@ class H2Connection:
                 st.send_closed = True
                 try:
                     await self._drain()
-                except Exception:  # noqa: BLE001
+                except (OSError, RuntimeError):  # peer already gone
                     pass
                 self._maybe_gc(st)
             return
@@ -769,8 +777,7 @@ class H2Connection:
                 self._peer_max_concurrent = value
             elif key == frames.SETTINGS_HEADER_TABLE_SIZE:
                 self._encoder.set_max_table_size(value)
-        loop = asyncio.get_event_loop()
-        loop.create_task(self._notify_windows())
+        spawn(self._notify_windows(), what="h2-notify-windows-settings")
 
 
 def _poll_const_body(stream: H2Stream):

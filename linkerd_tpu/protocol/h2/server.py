@@ -114,8 +114,10 @@ class H2Server:
                 conn.adopt_upgraded_request(req, body)
             # the connection lives as long as its read loop
             await asyncio.shield(conn._read_task)  # noqa: SLF001
-        except (asyncio.CancelledError, Exception):  # noqa: BLE001
+        except asyncio.CancelledError:
             pass
+        except Exception as e:  # noqa: BLE001 — read loop already logged
+            log.debug("h2 connection serve exit: %r", e)  # the details
         finally:
             self._conns.discard(conn)
             await conn.close()

@@ -33,7 +33,7 @@ class _Conn:
     def close(self) -> None:
         try:
             self.writer.close()
-        except Exception:  # noqa: BLE001
+        except (OSError, RuntimeError):  # transport already detached
             pass
 
 

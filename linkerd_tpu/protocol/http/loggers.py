@@ -50,6 +50,9 @@ class DebugLogger(Filter):
 @register("logger", "io.l5d.http.debug")
 @dataclass
 class DebugLoggerConfig:
+    """Log every request/response line to a python logger at
+    ``level`` — the zero-dependency debugging tap."""
+
     level: str = "DEBUG"       # DEBUG | INFO | WARNING
     logger: str = "linkerd_tpu.reqlog"
 
@@ -115,6 +118,8 @@ class FileLogger(Filter):
 @register("logger", "io.l5d.http.file")
 @dataclass
 class FileLoggerConfig:
+    """Apache-combined-format access log appended to ``path``."""
+
     path: str = ""
 
     def mk(self) -> Filter:

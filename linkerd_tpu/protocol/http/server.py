@@ -57,7 +57,7 @@ class HttpServer:
         for w in list(self._conns):
             try:
                 w.close()
-            except Exception:  # noqa: BLE001
+            except (OSError, RuntimeError):  # transport already detached
                 pass
         # cancel parked handlers (e.g. watch streams blocked on state
         # changes) — 3.12's wait_closed() waits for ALL handlers
@@ -120,8 +120,10 @@ class HttpServer:
                         if aclose is not None:
                             try:
                                 await aclose()
-                            except Exception:  # noqa: BLE001
-                                pass
+                            except Exception as e:  # noqa: BLE001 — a
+                                # failing generator finalizer must not
+                                # mask the response outcome, but say so
+                                log.debug("body stream aclose: %r", e)
                     return
                 codec.write_response(writer, rsp)
                 await writer.drain()
@@ -135,7 +137,7 @@ class HttpServer:
             self._conns.discard(writer)
             try:
                 writer.close()
-            except Exception:  # noqa: BLE001
+            except (OSError, RuntimeError):  # transport already detached
                 pass
 
     async def _dispatch(self, req: Request) -> Response:

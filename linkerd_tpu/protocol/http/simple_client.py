@@ -40,7 +40,7 @@ async def request(host: str, port: int, method: str, path: str,
         finally:
             try:
                 writer.close()
-            except Exception:  # noqa: BLE001
+            except (OSError, RuntimeError):  # transport already detached
                 pass
 
     return await asyncio.wait_for(go(), timeout)

@@ -53,8 +53,11 @@ class MuxClient(Service[Tdispatch, bytes]):
         pending: Dict[int, asyncio.Future] = {}
         self._writer = writer
         self._pending = pending
-        self._read_task = asyncio.get_running_loop().create_task(
-            self._read_loop(reader, writer, pending))
+        from linkerd_tpu.core.tasks import monitor
+        self._read_task = monitor(
+            asyncio.get_running_loop().create_task(
+                self._read_loop(reader, writer, pending)),
+            what="mux-client-read-loop")
 
     async def _read_loop(self, reader: asyncio.StreamReader,
                          writer: asyncio.StreamWriter,
@@ -103,7 +106,7 @@ class MuxClient(Service[Tdispatch, bytes]):
             pending.clear()
             try:
                 writer.close()
-            except Exception:  # noqa: BLE001
+            except (OSError, RuntimeError):  # transport already detached
                 pass
             if self._writer is writer:
                 self._writer = None
@@ -144,8 +147,8 @@ class MuxClient(Service[Tdispatch, bytes]):
                         write_mux_frame(
                             writer, TDISCARDED, 0,
                             tag.to_bytes(3, "big") + b"canceled")
-                    except Exception:  # noqa: BLE001 - best effort
-                        pass
+                    except (OSError, RuntimeError):
+                        pass  # best effort: peer is likely gone already
                 raise
         finally:
             self.pending -= 1
@@ -167,6 +170,6 @@ class MuxClient(Service[Tdispatch, bytes]):
         if self._writer is not None:
             try:
                 self._writer.close()
-            except Exception:  # noqa: BLE001
+            except (OSError, RuntimeError):  # transport already detached
                 pass
             self._writer = None

@@ -48,7 +48,7 @@ class MuxServer:
         for w in list(self._conns):
             try:
                 w.close()
-            except Exception:  # noqa: BLE001
+            except (OSError, RuntimeError):  # transport already detached
                 pass
         for t in list(self._conn_tasks):
             t.cancel()
@@ -80,8 +80,10 @@ class MuxServer:
             except Exception as e:  # noqa: BLE001 -> Rerr
                 try:
                     await reply(*encode_rerr(msg.tag, repr(e)))
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as e2:  # noqa: BLE001 — the Rerr is
+                    # best-effort, but a failed one means the peer never
+                    # learns the dispatch died: leave a trace
+                    log.debug("mux Rerr write failed: %r", e2)
             finally:
                 pending.pop(msg.tag, None)
 
@@ -131,7 +133,7 @@ class MuxServer:
             self._conns.discard(writer)
             try:
                 writer.close()
-            except Exception:  # noqa: BLE001
+            except (OSError, RuntimeError):  # transport already detached
                 pass
 
 

@@ -155,7 +155,7 @@ class ThriftClient(Service[ThriftCall, Optional[bytes]]):
         if self._writer is not None:
             try:
                 self._writer.close()
-            except Exception:  # noqa: BLE001
+            except (OSError, RuntimeError):  # transport already detached
                 pass
         self._reader = self._writer = self._unframed_reader = None
 

@@ -68,7 +68,7 @@ class ThriftServer:
         for w in list(self._conns):
             try:
                 w.close()
-            except Exception:  # noqa: BLE001
+            except (OSError, RuntimeError):  # transport already detached
                 pass
         for t in list(self._conn_tasks):
             t.cancel()
@@ -109,11 +109,13 @@ class ThriftServer:
                         await writer.drain()
             except asyncio.CancelledError:
                 raise
-            except Exception:  # noqa: BLE001 — write side gone: kill the
-                # conn so the read loop unwinds instead of stalling
+            except Exception as e:  # noqa: BLE001 — write side gone:
+                # kill the conn so the read loop unwinds instead of
+                # stalling, but leave a trace of WHY the writer died
+                log.debug("thrift write loop failed: %r", e)
                 try:
                     writer.close()
-                except Exception:  # noqa: BLE001
+                except (OSError, RuntimeError):
                     pass
 
         async def run_one(call: ThriftCall, was_upgraded: bool) -> Optional[bytes]:
@@ -220,7 +222,7 @@ class ThriftServer:
             self._conns.discard(writer)
             try:
                 writer.close()
-            except Exception:  # noqa: BLE001
+            except (OSError, RuntimeError):  # transport already detached
                 pass
             if cancelled is not None:
                 raise cancelled

@@ -17,6 +17,7 @@ their endpoint services. Load metrics:
 from __future__ import annotations
 
 import asyncio
+import logging
 import random
 import time
 from dataclasses import dataclass
@@ -27,6 +28,8 @@ from linkerd_tpu.core.addr import (
     Addr, Address, AddrFailed, AddrNeg, AddrPending, Bound,
 )
 from linkerd_tpu.router.service import Service, Status
+
+log = logging.getLogger(__name__)
 
 
 class NoBrokersAvailable(Exception):
@@ -98,8 +101,9 @@ class Balancer(Service):
         for svc in to_close:
             try:
                 await svc.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001 — reaping must visit
+                # every evicted endpoint; a failed close is worth a line
+                log.debug("endpoint close during reap failed: %r", e)
 
     def _usable(self) -> List[Endpoint]:
         eps = [e for e in self._endpoints.values()

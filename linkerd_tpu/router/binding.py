@@ -109,8 +109,9 @@ class ServiceCache(Generic[K]):
         for svc, _ in entries.values():
             try:
                 await svc.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001 — cache close must
+                # visit every entry; a failed close is worth a line
+                log.debug("bound service close failed: %r", e)
 
 
 def _log_close_error(t: "asyncio.Task") -> None:

@@ -222,6 +222,9 @@ class FailureAccrualService(Service):
 @register("failureAccrual", "io.l5d.consecutiveFailures")
 @dataclass
 class ConsecutiveFailuresConfig:
+    """Mark an endpoint dead after ``failures`` consecutive failures
+    (the reference default policy)."""
+
     failures: int = 5
 
     def mk(self) -> FailureAccrualPolicy:
@@ -231,6 +234,9 @@ class ConsecutiveFailuresConfig:
 @register("failureAccrual", "io.l5d.successRate")
 @dataclass
 class SuccessRateConfig:
+    """Mark dead when the EWMA success rate over the last
+    ``requests`` requests drops below ``successRate``."""
+
     successRate: float = 0.8
     requests: int = 30
 
@@ -241,6 +247,9 @@ class SuccessRateConfig:
 @register("failureAccrual", "io.l5d.successRateWindowed")
 @dataclass
 class SuccessRateWindowedConfig:
+    """Mark dead when the success rate over a ``window``-second
+    rolling window drops below ``successRate``."""
+
     successRate: float = 0.8
     window: int = 30
 

@@ -137,9 +137,14 @@ class FastPathController:
 
     async def start(self) -> None:
         self.engine.start()
+        from linkerd_tpu.core.tasks import monitor
         self._tasks = [
-            asyncio.create_task(self._miss_loop(), name=f"fp-miss-{self.label}"),
-            asyncio.create_task(self._stats_loop(), name=f"fp-stats-{self.label}"),
+            monitor(asyncio.create_task(self._miss_loop(),
+                                        name=f"fp-miss-{self.label}"),
+                    what=f"fp-miss-{self.label}"),
+            monitor(asyncio.create_task(self._stats_loop(),
+                                        name=f"fp-stats-{self.label}"),
+                    what=f"fp-stats-{self.label}"),
         ]
 
     def resolve(self, host: str) -> None:
@@ -240,8 +245,10 @@ class FastPathController:
         for t in self._tasks:
             try:
                 await t
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            except asyncio.CancelledError:
                 pass
+            except Exception as e:  # noqa: BLE001 — loop crashes were
+                log.debug("fastpath loop exit: %r", e)  # already logged
         self._tasks = []
         for r in self._routes.values():
             r.close()

@@ -226,21 +226,33 @@ class InProcessScorer(Scorer):
         # Running feature normalization (updated on non-anomalous training
         # rows): without it the autoencoder's reconstruction error is
         # dominated by raw feature scale and tanh() saturates for normal
-        # AND anomalous traffic alike.
+        # AND anomalous traffic alike. The host keeps the authoritative
+        # numpy stats (cheap EWMA over a few rows); device mirrors feed
+        # the jitted steps, which apply models.anomaly.normalize_features
+        # on device — the z-score with its 1e-2 soft variance floor (a
+        # near-constant training dim must register novelty as a LARGE
+        # z-score, not a 1e3-sigma blowup; hard clipping cost ~0.15 AUC
+        # on the k8s-restart benchmark).
         self._mu = np.zeros(self.cfg.in_dim, np.float32)
         self._var = np.ones(self.cfg.in_dim, np.float32)
         self._norm_momentum = 0.2
         self._norm_initialized = False
+        self._place_norm()
 
-    def _normalize(self, x: np.ndarray) -> np.ndarray:
-        # Variance floor 1e-2 (not 1e-6): a dim that was near-constant
-        # in training must register a real deviation as a LARGE z-score
-        # (novelty is signal — k8s-restart 5xx one-hots ride on this),
-        # but not a 1e3-sigma blowup that swamps every other dim. Hard
-        # clipping at +/-8 sigma was tried instead and cost ~0.15 AUC on
-        # the restart benchmark; the soft floor keeps the ordering.
-        z = (x - self._mu) / np.sqrt(self._var + 1e-2)
-        return z.astype(np.float32)
+    def _place_norm(self) -> None:
+        """Refresh the device mirrors of the normalization stats: tiny
+        replicated arrays the jitted score/train steps consume so the
+        whole normalize->score pipeline runs on device (each data-axis
+        shard z-scores its own rows; the host never touches the batch)."""
+        import jax
+
+        if self.mesh is not None:
+            from linkerd_tpu.parallel.mesh import replicated
+            placement = replicated(self.mesh)
+        else:
+            placement = self._devices[0]
+        self._mu_d = jax.device_put(self._mu, placement)
+        self._var_d = jax.device_put(self._var, placement)
 
     def _update_norm(self, x: np.ndarray, labels: np.ndarray,
                      mask: np.ndarray) -> None:
@@ -257,17 +269,23 @@ class InProcessScorer(Scorer):
             m = self._norm_momentum
             self._mu = (1 - m) * self._mu + m * mu
             self._var = (1 - m) * self._var + m * var
+        self._mu = np.asarray(self._mu, np.float32)
+        self._var = np.asarray(self._var, np.float32)
+        self._place_norm()
 
     def _mk_train_step(self):
         import jax
         import optax
-        from linkerd_tpu.models.anomaly import loss_fn
+        from linkerd_tpu.models.anomaly import loss_fn, normalize_features
 
         cfg = self.cfg
         opt = self._opt
 
         @jax.jit
-        def step(params, opt_state, x, labels, mask, row_mask=None):
+        def step(params, opt_state, x, labels, mask, row_mask=None,
+                 mu=None, var=None):
+            if mu is not None:
+                x = normalize_features(x, mu, var)
             loss, grads = jax.value_and_grad(loss_fn)(
                 params, x, labels, mask, cfg, row_mask)
             updates, opt_state = opt.update(grads, opt_state, params)
@@ -354,6 +372,7 @@ class InProcessScorer(Scorer):
         self._mu = np.asarray(snap.mu, np.float32).copy()
         self._var = np.asarray(snap.var, np.float32).copy()
         self._norm_initialized = bool(snap.norm_initialized)
+        self._place_norm()
         self._step = int(snap.step)
 
     def swap(self, snap):
@@ -384,23 +403,31 @@ class InProcessScorer(Scorer):
         finally:
             self.params, self._opt_state = params, opt_state
             self._mu, self._var, self._norm_initialized = mu, var, init
+            self._place_norm()
             self._step = step
 
     def _prep(self, x: np.ndarray) -> np.ndarray:
-        """Normalize + pad + cast to the transfer dtype. Post-norm values
-        are ~N(0,1), so bfloat16 is precision-safe — and it halves the
-        host->device bytes, which is the variable cost on a tunneled or
-        PCIe-contended device (the model computes in bf16 anyway)."""
-        import jax.numpy as jnp
-        return self._pad_rows(self._normalize(x)).astype(jnp.bfloat16)
+        """Pad + cast to the f32 transfer dtype. Raw features ship as-is:
+        normalization happens ON DEVICE inside the jitted step (mu/var
+        mirrors via _place_norm), fused into the first matmul's producer
+        — so f32 precision is kept through the z-score (raw latencies in
+        the thousands would lose mantissa bits if cast to bf16 before
+        subtracting mu) and the sharded path normalizes each batch shard
+        on its own device."""
+        return self._pad_rows(np.asarray(x, np.float32))
 
     async def score(self, x: np.ndarray) -> np.ndarray:
         n = len(x)
         xn = self._prep(x)
+        # capture the (mu, var) pair BEFORE dispatching to the worker
+        # thread: a concurrent fit() repoints both mirrors, and reading
+        # them from the thread could tear the pair (new mu, old var)
+        mu_d, var_d = self._mu_d, self._var_d
 
         def run() -> np.ndarray:
-            return np.asarray(self._scorer(self.params, xn),
-                              dtype=np.float32)[:n]
+            return np.asarray(
+                self._scorer(self.params, xn, mu_d, var_d),
+                dtype=np.float32)[:n]
 
         return await asyncio.to_thread(run)
 
@@ -413,9 +440,11 @@ class InProcessScorer(Scorer):
         path; per-batch latency keeps using score()."""
         import collections
         pend = collections.deque()
+        mu_d, var_d = self._mu_d, self._var_d  # consistent pair (see score)
         for x in batches:
             xn = self._prep(x)
-            pend.append((len(x), self._scorer(self.params, xn)))
+            pend.append((len(x), self._scorer(
+                self.params, xn, mu_d, var_d)))
             if len(pend) >= depth:
                 n0, r = pend.popleft()
                 yield np.asarray(r, dtype=np.float32)[:n0]
@@ -427,7 +456,7 @@ class InProcessScorer(Scorer):
                   mask: np.ndarray) -> float:
         n = len(x)
         self._update_norm(x, labels, mask)
-        xn = self._pad_rows(self._normalize(x))
+        xn = self._prep(x)
         labels = self._pad_rows(np.asarray(labels, np.float32))
         mask = self._pad_rows(np.asarray(mask, np.float32))
         # row_mask excludes the padding rows from BOTH loss terms so the
@@ -435,12 +464,14 @@ class InProcessScorer(Scorer):
         row_mask = (self._pad_rows(np.ones(n, np.float32))
                     if len(xn) != n else None)
 
+        mu_d, var_d = self._mu_d, self._var_d  # consistent pair (see score)
+
         def run() -> float:
             loss = float("nan")
             for _ in range(self.fit_steps):
                 self.params, self._opt_state, loss = self._train_step(
                     self.params, self._opt_state, xn, labels, mask,
-                    row_mask)
+                    row_mask, mu_d, var_d)
             self._step += self.fit_steps
             return float(loss)
 

@@ -115,6 +115,9 @@ def influxdb_line(metrics: MetricsTree, host: str = "localhost") -> str:
 @register("telemeter", "io.l5d.prometheus")
 @dataclass
 class PrometheusConfig:
+    """Expose the MetricsTree in Prometheus text format at ``path``
+    on the admin server."""
+
     path: str = "/admin/metrics/prometheus"
 
     def mk(self, metrics: MetricsTree) -> Telemeter:
@@ -138,6 +141,9 @@ class PrometheusTelemeter(Telemeter):
 @register("telemeter", "io.l5d.influxdb")
 @dataclass
 class InfluxDbConfig:
+    """Expose the MetricsTree as InfluxDB line protocol at ``path``
+    on the admin server (for Telegraf scrapes)."""
+
     path: str = "/admin/metrics/influxdb"
 
     def mk(self, metrics: MetricsTree) -> Telemeter:
@@ -161,6 +167,9 @@ class InfluxDbTelemeter(Telemeter):
 @register("telemeter", "io.l5d.statsd", experimental=True)
 @dataclass
 class StatsDConfig:
+    """Push counters/timings to a StatsD agent over UDP; gauges
+    flush every ``gaugeIntervalMs``."""
+
     host: str = "127.0.0.1"
     port: int = 8125
     prefix: str = "linkerd"
@@ -225,6 +234,9 @@ class StatsDTelemeter(Telemeter):
 @register("telemeter", "io.l5d.tracelog")
 @dataclass
 class TracelogConfig:
+    """Write sampled trace annotations to the python log at
+    ``level``."""
+
     sampleRate: float = 1.0
     level: str = "INFO"
 
@@ -266,6 +278,9 @@ class _FnTracer(Tracer):
 @register("telemeter", "io.l5d.recentRequests")
 @dataclass
 class RecentRequestsConfig:
+    """Keep an in-memory ring of the last ``capacity`` sampled
+    requests, served at /requests.json on the admin server."""
+
     sampleRate: float = 1.0
     capacity: int = 100
 
@@ -305,6 +320,9 @@ class RecentRequestsTelemeter(Telemeter):
 @register("telemeter", "io.l5d.zipkin")
 @dataclass
 class ZipkinConfig:
+    """Ship sampled spans to a Zipkin collector in batches every
+    ``batchIntervalMs``."""
+
     host: str = "127.0.0.1"
     port: int = 9411
     sampleRate: float = 0.001

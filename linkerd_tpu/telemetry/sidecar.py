@@ -271,7 +271,12 @@ class GrpcScorerClient:
         if self._channel is not None:
             ch, self._channel = self._channel, None
             try:
-                loop = asyncio.get_running_loop()
-                loop.create_task(ch.close())
+                asyncio.get_running_loop()
             except RuntimeError:
-                pass
+                # no running loop (interpreter teardown): nothing to
+                # drain the close on; the socket dies with the process.
+                # Checked BEFORE ch.close() is called so no never-awaited
+                # coroutine is orphaned.
+                return
+            from linkerd_tpu.core.tasks import spawn
+            spawn(ch.close(), what="sidecar-channel-close")

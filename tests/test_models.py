@@ -125,3 +125,64 @@ class TestModel:
         s_norm = anomaly_scores(params, normal[:64], cfg)
         s_anom = anomaly_scores(params, anomalous[:64], cfg)
         assert float(jnp.mean(s_anom)) > float(jnp.mean(s_norm))
+
+
+class TestDeviceNormalization:
+    """normalize_features folded into the jitted steps (ADVICE r5): the
+    device path with raw features + mu/var must match host-side z-score
+    then score, on both the sharded and fused/XLA single-chip paths."""
+
+    def _host_norm(self, x, mu, var):
+        return (np.asarray(x) - mu) / np.sqrt(var + 1e-2)
+
+    def test_sharded_score_normalizes_on_device(self):
+        mesh = make_mesh()
+        params = init_params(jax.random.key(0), CFG)
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal((32, FEATURE_DIM)).astype(np.float32) * 40 + 5
+        mu = x.mean(axis=0)
+        var = x.var(axis=0)
+        ref = anomaly_scores(params, jnp.asarray(
+            self._host_norm(x, mu, var), jnp.float32), CFG)
+        score = make_score_step(mesh, CFG)
+        got = score(shard_params(mesh, params), jnp.asarray(x),
+                    jnp.asarray(mu), jnp.asarray(var))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-2, rtol=2e-2)
+
+    def test_best_scorer_normalizes_on_device(self):
+        from linkerd_tpu.ops.scoring import best_scorer
+        params = init_params(jax.random.key(0), CFG)
+        rng = np.random.default_rng(8)
+        x = rng.standard_normal((64, FEATURE_DIM)).astype(np.float32) * 10
+        mu = x.mean(axis=0)
+        var = x.var(axis=0)
+        ref = anomaly_scores(params, jnp.asarray(
+            self._host_norm(x, mu, var), jnp.float32), CFG)
+        scorer = best_scorer(CFG)
+        got = scorer(params, jnp.asarray(x), jnp.asarray(mu),
+                     jnp.asarray(var))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-2, rtol=2e-2)
+
+    def test_train_step_normalizes_on_device(self):
+        """Training with raw x + mu/var must move loss the same way as
+        training on pre-normalized input (same objective)."""
+        mesh = make_mesh()
+        opt = optax.adam(1e-3)
+        rng = np.random.default_rng(9)
+        x = rng.standard_normal((64, FEATURE_DIM)).astype(np.float32) * 20
+        mu = x.mean(axis=0)
+        var = x.var(axis=0)
+        labels = np.zeros(64, np.float32)
+        step = make_train_step(mesh, opt, CFG)
+        params, opt_state = init_sharded(mesh, jax.random.key(0), opt, CFG)
+        _, _, loss_dev = step(params, opt_state, jnp.asarray(x),
+                              jnp.asarray(labels), jnp.asarray(labels),
+                              None, jnp.asarray(mu), jnp.asarray(var))
+        params2, opt_state2 = init_sharded(mesh, jax.random.key(0), opt, CFG)
+        _, _, loss_host = step(params2, opt_state2, jnp.asarray(
+            self._host_norm(x, mu, var), jnp.float32),
+            jnp.asarray(labels), jnp.asarray(labels))
+        np.testing.assert_allclose(float(loss_dev), float(loss_host),
+                                   rtol=2e-2)

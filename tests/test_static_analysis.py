@@ -1,0 +1,342 @@
+"""l5dlint self-tests: every rule fires on a positive fixture, stays
+quiet on the matching negative, suppressions require justification, and
+the real tree is clean (the tier-1 gate).
+
+Fixtures are tiny synthetic repos written under tmp_path with the same
+layout the scope filters expect (``linkerd_tpu/router/...`` etc.), so
+the checkers run exactly as they do against the real tree.
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from tools.analysis import run_analysis, rule_ids
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def mk_repo(tmp_path, files):
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return str(tmp_path)
+
+
+def findings_of(tmp_path, files, rule):
+    root = mk_repo(tmp_path, files)
+    out = run_analysis(["linkerd_tpu"], repo_root=root, rules=[rule])
+    return [f for f in out if f.rule == rule]
+
+
+class TestAsyncBlocking:
+    def test_direct_blocking_call_fires(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                import time
+                async def handle(req):
+                    time.sleep(0.1)
+                    return req
+            """}, "async-blocking")
+        assert len(got) == 1 and "time.sleep" in got[0].message
+        assert got[0].path == "linkerd_tpu/router/x.py"
+        assert got[0].line == 4
+
+    def test_reachable_through_sync_helper(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/protocol/x.py": """
+                import time
+                def helper():
+                    time.sleep(1)
+                async def handle(req):
+                    helper()
+            """}, "async-blocking")
+        assert len(got) == 1 and "helper" in got[0].message
+
+    def test_async_sleep_and_to_thread_are_clean(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                import asyncio, time
+                async def handle(req):
+                    await asyncio.sleep(0.1)
+                    await asyncio.to_thread(time.sleep, 1)
+            """}, "async-blocking")
+        assert got == []
+
+    def test_out_of_scope_package_is_ignored(self, tmp_path):
+        # startup/control-plane code may block; the rule is data-plane
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/namerd/x.py": """
+                import time
+                async def boot():
+                    time.sleep(1)
+            """}, "async-blocking")
+        assert got == []
+
+
+class TestTaskLeak:
+    def test_dropped_spawn_fires(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                import asyncio
+                def go(loop, coro):
+                    loop.create_task(coro)
+            """}, "task-leak")
+        assert len(got) == 1 and "dropped" in got[0].message
+
+    def test_held_or_chained_spawn_is_clean(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                import asyncio
+                def go(loop, coro, cb):
+                    t = loop.create_task(coro)
+                    loop.create_task(coro).add_done_callback(cb)
+                    return t
+            """}, "task-leak")
+        assert got == []
+
+
+class TestSwallowedException:
+    def test_broad_pass_fires(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/grpc/x.py": """
+                def f(x):
+                    try:
+                        return x()
+                    except Exception:
+                        pass
+            """}, "swallowed-exception")
+        assert len(got) == 1
+
+    def test_bare_except_fires(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/telemetry/x.py": """
+                def f(x):
+                    try:
+                        return x()
+                    except:
+                        pass
+            """}, "swallowed-exception")
+        assert len(got) == 1 and "bare" in got[0].message
+
+    def test_narrow_logged_or_reraised_are_clean(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/protocol/x.py": """
+                import logging
+                log = logging.getLogger(__name__)
+                def f(x):
+                    try:
+                        return x()
+                    except (OSError, RuntimeError):
+                        pass
+                def g(x):
+                    try:
+                        return x()
+                    except Exception as e:
+                        log.debug("boom: %r", e)
+                def h(x):
+                    try:
+                        return x()
+                    except Exception:
+                        raise
+            """}, "swallowed-exception")
+        assert got == []
+
+
+class TestStreamRelease:
+    def test_unreleased_frame_fires(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/grpc/x.py": """
+                async def recv(stream):
+                    frame = await stream.read()
+                    return bytes(frame.data)
+            """}, "stream-release")
+        assert len(got) == 1 and "frame" in got[0].message
+
+    def test_dropped_read_fires(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/protocol/h2/x.py": """
+                async def drain(stream):
+                    await stream.read()
+            """}, "stream-release")
+        assert len(got) == 1
+
+    def test_released_or_forwarded_is_clean(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/grpc/x.py": """
+                async def recv(stream):
+                    frame = await stream.read()
+                    try:
+                        return bytes(frame.data)
+                    finally:
+                        frame.release()
+                async def tee(stream, out):
+                    frame = await stream.read()
+                    out.offer(frame)
+                async def read_bytes(reader):
+                    data = await reader.read(4096)  # byte read, not a frame
+                    return data
+            """}, "stream-release")
+        assert got == []
+
+
+class TestJaxPurity:
+    def test_impure_jit_fires(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/models/x.py": """
+                import jax
+                import numpy as np
+                @jax.jit
+                def used_step(x):
+                    print("tracing")
+                    return np.asarray(x)
+            """,
+            "linkerd_tpu/models/user.py": "from linkerd_tpu.models.x "
+                                          "import used_step\n",
+        }, "jax-purity")
+        msgs = " ".join(f.message for f in got)
+        assert "print" in msgs and "np.asarray" in msgs
+
+    def test_captured_state_mutation_fires(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/parallel/x.py": """
+                import jax
+                class M:
+                    def mk(self):
+                        @jax.jit
+                        def step(x):
+                            self.count = self.count + 1
+                            return x
+                        return step
+            """}, "jax-purity")
+        assert any("self.count" in f.message for f in got)
+
+    def test_dead_helper_fires_and_wired_helper_is_clean(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/ops/x.py": """
+                def dead_helper(x):
+                    return x + 1
+                def live_helper(x):
+                    return x * 2
+            """,
+            "tests/test_x.py": "from linkerd_tpu.ops.x import live_helper\n",
+        }, "jax-purity")
+        assert len(got) == 1 and "dead_helper" in got[0].message
+
+    def test_pallas_kernel_via_partial_is_scanned(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/ops/x.py": """
+                import functools
+                from jax.experimental import pallas as pl
+                def my_kernel(ref, out):
+                    print("host io")
+                    out[...] = ref[...]
+                def run(x):
+                    kernel = functools.partial(my_kernel)
+                    return pl.pallas_call(kernel)(x)
+            """}, "jax-purity")
+        assert any("print" in f.message and "my_kernel" in f.message
+                   for f in got)
+
+
+class TestConfigRegistry:
+    FILES = {
+        "linkerd_tpu/cfg.py": """
+            from dataclasses import dataclass
+            from linkerd_tpu.config import register
+            @register("namer", "io.l5d.good")
+            @dataclass
+            class GoodConfig:
+                '''A documented kind.'''
+                port: int = 0
+            @register("namer", "io.l5d.bad")
+            class BadConfig:
+                pass
+        """,
+        "tests/test_cfg.py": "KIND = 'io.l5d.good'\n",
+        "README.md": "uses io.l5d.good\n",
+    }
+
+    def test_loose_undocumented_unexercised_fire(self, tmp_path):
+        got = findings_of(tmp_path, self.FILES, "config-registry")
+        bad = [f for f in got if "io.l5d.bad" in f.message]
+        msgs = " ".join(f.message for f in bad)
+        assert "not a @dataclass" in msgs
+        assert "undocumented" in msgs
+        assert "exercised by no test" in msgs
+
+    def test_documented_exercised_dataclass_is_clean(self, tmp_path):
+        got = findings_of(tmp_path, self.FILES, "config-registry")
+        assert not [f for f in got if "io.l5d.good" in f.message]
+
+
+class TestSuppressions:
+    LEAK = """
+        import asyncio
+        def go(loop, coro):
+            loop.create_task(coro)  {comment}
+    """
+
+    def test_justified_suppression_suppresses(self, tmp_path):
+        root = mk_repo(tmp_path, {"linkerd_tpu/x.py": self.LEAK.format(
+            comment="# l5d: ignore[task-leak] — daemon owns its lifetime")})
+        out = run_analysis(["linkerd_tpu"], repo_root=root)
+        leaks = [f for f in out if f.rule == "task-leak"]
+        assert len(leaks) == 1 and leaks[0].suppressed
+        assert "daemon" in leaks[0].justification
+        assert not [f for f in out if f.rule == "suppression"]
+
+    def test_suppression_requires_justification(self, tmp_path):
+        root = mk_repo(tmp_path, {"linkerd_tpu/x.py": self.LEAK.format(
+            comment="# l5d: ignore[task-leak]")})
+        out = run_analysis(["linkerd_tpu"], repo_root=root)
+        leaks = [f for f in out if f.rule == "task-leak"]
+        # the bare ignore does NOT silence the finding...
+        assert len(leaks) == 1 and not leaks[0].suppressed
+        # ...and is itself reported
+        sup = [f for f in out if f.rule == "suppression"]
+        assert len(sup) == 1 and "justification" in sup[0].message
+
+    def test_unknown_rule_in_suppression_is_reported(self, tmp_path):
+        root = mk_repo(tmp_path, {"linkerd_tpu/x.py": self.LEAK.format(
+            comment="# l5d: ignore[no-such-rule] — because")})
+        out = run_analysis(["linkerd_tpu"], repo_root=root)
+        sup = [f for f in out if f.rule == "suppression"]
+        assert len(sup) == 1 and "unknown rule" in sup[0].message
+
+    def test_comment_line_above_applies(self, tmp_path):
+        root = mk_repo(tmp_path, {"linkerd_tpu/x.py": textwrap.dedent("""
+            import asyncio
+            def go(loop, coro):
+                # l5d: ignore[task-leak] — fire-and-forget by design here
+                loop.create_task(coro)
+        """)})
+        out = run_analysis(["linkerd_tpu"], repo_root=root)
+        leaks = [f for f in out if f.rule == "task-leak"]
+        assert len(leaks) == 1 and leaks[0].suppressed
+
+
+class TestRepoGate:
+    """The tier-1 gate: the suite itself over the real tree."""
+
+    def test_rule_inventory(self):
+        assert sorted(rule_ids()) == [
+            "async-blocking", "config-registry", "jax-purity",
+            "stream-release", "swallowed-exception", "task-leak",
+        ]
+
+    def test_repo_has_zero_unsuppressed_findings(self):
+        out = run_analysis(["linkerd_tpu"], repo_root=REPO)
+        unsuppressed = [f for f in out if not f.suppressed]
+        assert unsuppressed == [], "\n" + "\n".join(
+            f.show() for f in unsuppressed)
+
+    def test_every_repo_suppression_is_justified(self):
+        # run_analysis already enforces this via the meta-rule; assert
+        # the invariant directly so the intent is explicit in the gate
+        out = run_analysis(["linkerd_tpu"], repo_root=REPO)
+        for f in out:
+            if f.suppressed:
+                assert f.justification.strip(), f.show()

@@ -1,0 +1,21 @@
+"""l5dlint — repo-native static analysis for the async data plane and
+the JAX scoring path.
+
+Rules (see tools/analysis/checkers/ and COMPONENTS.md §2.6):
+
+- ``async-blocking``      blocking calls reachable inside ``async def``
+- ``task-leak``           dropped create_task/ensure_future results
+- ``swallowed-exception`` broad except with no log/metric/re-raise
+- ``stream-release``      h2/gRPC frames that strand flow credit
+- ``jax-purity``          host side effects in jitted code; dead helpers
+- ``config-registry``     undocumented/untested/loose YAML kinds
+- ``suppression``         (meta) ignores must carry a justification
+
+Run: ``python -m tools.analysis [paths] [--rule r1,r2] [--json]``.
+Suppress inline with ``# l5d: ignore[rule] — why it is safe``.
+"""
+
+from tools.analysis.core import (  # noqa: F401
+    Checker, Finding, Project, SourceFile, all_checkers, rule_ids,
+    run_analysis,
+)

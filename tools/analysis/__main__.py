@@ -1,0 +1,88 @@
+"""CLI: ``python -m tools.analysis [paths] [--rule ...] [--json]``.
+
+Exit status: 0 = no unsuppressed findings, 1 = findings, 2 = usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# allow running from anywhere inside the repo
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.analysis import all_checkers, rule_ids, run_analysis  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="l5dlint: repo-native static analysis "
+                    "(async data plane + JAX scoring path)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="repo-relative paths to scan (default: linkerd_tpu)")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only these rules (repeatable or comma-"
+                         "separated)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit one JSON object with findings + timing")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print rule ids and exit")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed findings")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for c in sorted(all_checkers(), key=lambda c: c.rule):
+            print(f"{c.rule:20s} {c.description}")
+        print(f"{'suppression':20s} (meta) ignores must carry a "
+              f"justification")
+        return 0
+
+    rules = None
+    if args.rule:
+        rules = [r.strip() for chunk in args.rule for r in chunk.split(",")]
+        unknown = set(rules) - set(rule_ids()) - {"suppression"}
+        if unknown:
+            print(f"unknown rule(s): {sorted(unknown)}; "
+                  f"known: {rule_ids() + ['suppression']}", file=sys.stderr)
+            return 2
+
+    paths = args.paths or ["linkerd_tpu"]
+    t0 = time.perf_counter()
+    try:
+        findings = run_analysis(paths, repo_root=_REPO, rules=rules)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    wall_s = time.perf_counter() - t0
+    unsuppressed = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+
+    if args.as_json:
+        print(json.dumps({
+            "paths": paths,
+            "rules": rules or rule_ids() + ["suppression"],
+            "wall_s": round(wall_s, 3),
+            "unsuppressed": [f.to_dict() for f in unsuppressed],
+            "suppressed_count": len(suppressed),
+        }))
+    else:
+        for f in unsuppressed:
+            print(f.show())
+        if args.show_suppressed:
+            for f in suppressed:
+                print(f.show())
+        print(f"l5dlint: {len(unsuppressed)} finding(s), "
+              f"{len(suppressed)} suppressed, {wall_s:.2f}s")
+    return 1 if unsuppressed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

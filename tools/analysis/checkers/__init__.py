@@ -1,0 +1,10 @@
+"""Checker registration: importing this package registers every rule."""
+
+from tools.analysis.checkers import (  # noqa: F401 — registration imports
+    async_blocking,
+    config_registry,
+    jax_purity,
+    stream_release,
+    swallowed,
+    task_leak,
+)

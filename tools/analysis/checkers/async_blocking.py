@@ -1,0 +1,135 @@
+"""async-blocking — blocking calls reachable inside ``async def`` on the
+data plane.
+
+One ``time.sleep`` or sync HTTP call inside a coroutine stalls EVERY
+router sharing the event loop — the whole proxy's throughput gates on it
+(the asyncio analogue of blocking a finagle worker thread). The rule
+flags direct blocking calls inside ``async def`` bodies, plus calls to
+same-module sync helpers that (transitively, within the module) contain
+one — "reachable", not just "written inline".
+
+Passing a blocking *function reference* to ``asyncio.to_thread`` /
+``run_in_executor`` is the sanctioned escape hatch and never flagged
+(the reference is not a Call in the coroutine's frame).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.analysis.core import (
+    Checker, Finding, Project, SourceFile, body_calls, dotted_name,
+    register_checker, walk_functions,
+)
+
+# Dotted prefixes/names that block the calling thread.
+BLOCKING_CALLS = {
+    "time.sleep": "time.sleep() blocks the event loop; use "
+                  "'await asyncio.sleep()'",
+    "urllib.request.urlopen": "sync HTTP I/O on the event loop",
+    "socket.create_connection": "sync socket connect on the event loop",
+    "socket.getaddrinfo": "sync DNS resolution on the event loop",
+    "subprocess.run": "subprocess wait blocks the event loop",
+    "subprocess.call": "subprocess wait blocks the event loop",
+    "subprocess.check_call": "subprocess wait blocks the event loop",
+    "subprocess.check_output": "subprocess wait blocks the event loop",
+    "os.system": "subprocess wait blocks the event loop",
+    "os.waitpid": "subprocess wait blocks the event loop",
+    "select.select": "sync select() blocks the event loop",
+}
+BLOCKING_PREFIXES = {
+    "requests.": "requests is sync HTTP; use the repo's async clients",
+}
+
+
+def _blocking_reason(call: ast.Call) -> Optional[str]:
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    if name in BLOCKING_CALLS:
+        return BLOCKING_CALLS[name]
+    for pfx, why in BLOCKING_PREFIXES.items():
+        if name.startswith(pfx):
+            return why
+    return None
+
+
+def _local_callee(call: ast.Call) -> Optional[Tuple[Optional[str], str]]:
+    """(class_hint, func_name) for calls resolvable within the module:
+    ``foo()`` -> (None, 'foo'); ``self.foo()`` -> ('self', 'foo')."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return (None, f.id)
+    if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+            and f.value.id == "self"):
+        return ("self", f.attr)
+    return None
+
+
+@register_checker
+class AsyncBlockingChecker(Checker):
+    rule = "async-blocking"
+    description = ("blocking call (sleep / sync IO / subprocess) reachable "
+                   "inside async def in the data-plane packages")
+    scope = ("linkerd_tpu/router", "linkerd_tpu/protocol",
+             "linkerd_tpu/grpc", "linkerd_tpu/telemetry")
+
+    def check(self, src: SourceFile, project: Project) -> Iterator[Finding]:
+        funcs = list(walk_functions(src.tree))
+        # pass 1: which sync functions contain a blocking call directly?
+        direct: Dict[Tuple[Optional[str], str], str] = {}
+        calls_of: Dict[Tuple[Optional[str], str],
+                       Set[Tuple[Optional[str], str]]] = {}
+        for fn, cls in funcs:
+            key = (cls, fn.name)
+            callees: Set[Tuple[Optional[str], str]] = set()
+            for call in body_calls(fn):
+                reason = _blocking_reason(call)
+                if reason is not None and not isinstance(
+                        fn, ast.AsyncFunctionDef):
+                    direct.setdefault(key, reason)
+                local = _local_callee(call)
+                if local is not None:
+                    hint, name = local
+                    callees.add((cls if hint == "self" else None, name))
+            calls_of[key] = callees
+        # pass 2: propagate "contains blocking" through same-module sync
+        # call edges until fixpoint
+        blocking: Dict[Tuple[Optional[str], str], str] = dict(direct)
+        changed = True
+        while changed:
+            changed = False
+            for key, callees in calls_of.items():
+                if key in blocking:
+                    continue
+                for callee in callees:
+                    hit = blocking.get(callee) or blocking.get(
+                        (None, callee[1]))
+                    if hit:
+                        blocking[key] = f"calls {callee[1]}() → {hit}"
+                        changed = True
+                        break
+        # pass 3: report sites inside async defs
+        for fn, cls in funcs:
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for call in body_calls(fn):
+                reason = _blocking_reason(call)
+                if reason is not None:
+                    yield Finding(
+                        self.rule, src.rel, call.lineno, call.col_offset,
+                        f"blocking call {dotted_name(call.func)}() inside "
+                        f"'async def {fn.name}': {reason}")
+                    continue
+                local = _local_callee(call)
+                if local is None:
+                    continue
+                hint, name = local
+                key = (cls if hint == "self" else None, name)
+                hit = blocking.get(key) or blocking.get((None, name))
+                if hit:
+                    yield Finding(
+                        self.rule, src.rel, call.lineno, call.col_offset,
+                        f"'async def {fn.name}' calls sync helper "
+                        f"{name}() — blocking: {hit}")

@@ -1,0 +1,124 @@
+"""config-registry — every registered YAML ``kind`` is a real, strict,
+documented, exercised config surface.
+
+The registry is the proxy's public configuration API: a ``kind`` that
+parses loosely (not a dataclass → no strict-field rejection), appears in
+no docs, or is exercised by no test/validator is a config surface users
+can typo into silently. Sub-checks per ``@register(category, kind)``:
+
+- the decorated class is a ``@dataclass`` (the parser's strict
+  unknown-field rejection only applies to dataclasses);
+- the category is one the registry declares in ``CATEGORIES`` (a stale
+  inventory means the next SPI consumer iterates the wrong set);
+- the kind is documented: class docstring or a mention in
+  README/COMPONENTS;
+- the kind is exercised: the literal appears in tests/, tools/, or
+  benchmarks/ (instantiation through the strict parser, the validator's
+  YAML, or a bench config).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator, List, Optional, Tuple
+
+from tools.analysis.core import (
+    Checker, Finding, Project, SourceFile, dotted_name, register_checker,
+)
+
+
+def _registrations(tree: ast.AST) -> Iterator[Tuple[ast.ClassDef, str, str]]:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for dec in node.decorator_list:
+            if not (isinstance(dec, ast.Call)
+                    and (dotted_name(dec.func) or "").split(".")[-1]
+                    == "register"):
+                continue
+            if (len(dec.args) >= 2
+                    and isinstance(dec.args[0], ast.Constant)
+                    and isinstance(dec.args[1], ast.Constant)):
+                yield node, str(dec.args[0].value), str(dec.args[1].value)
+
+
+def _declared_categories(project: Project) -> Optional[List[str]]:
+    """CATEGORIES from config/registry.py, read statically (no import)."""
+    path = os.path.join(project.repo_root, "linkerd_tpu", "config",
+                        "registry.py")
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        try:
+            tree = ast.parse(fh.read())
+        except SyntaxError:
+            return None
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "CATEGORIES"
+                        for t in node.targets)
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            return [e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)]
+    return None
+
+
+def _is_dataclass(node: ast.ClassDef) -> bool:
+    for dec in node.decorator_list:
+        name = dotted_name(dec if not isinstance(dec, ast.Call)
+                           else dec.func)
+        if name and name.split(".")[-1] == "dataclass":
+            return True
+    return False
+
+
+@register_checker
+class ConfigRegistryChecker(Checker):
+    rule = "config-registry"
+    description = ("registered YAML kind lacks a strict dataclass, a "
+                   "declared category, docs, or test/validator coverage")
+    scope = ("linkerd_tpu",)
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        # repo-level context resolved ONCE per run, not per file with
+        # registrations (bench detail.static_analysis watches this)
+        self._categories = _declared_categories(project)
+        self._docs = project.doc_text()
+        self._exercise = project.exercise_corpus()
+        yield from super().run(project)
+
+    def check(self, src: SourceFile, project: Project) -> Iterator[Finding]:
+        regs = list(_registrations(src.tree))
+        if not regs:
+            return
+        categories = self._categories
+        docs = self._docs
+        exercise = self._exercise
+        for node, category, kind in regs:
+            where = (src.rel, node.lineno, node.col_offset)
+            if not _is_dataclass(node):
+                yield Finding(
+                    self.rule, *where,
+                    f"kind {kind!r}: config class {node.name} is not a "
+                    f"@dataclass — the strict unknown-field rejection in "
+                    f"config/parser.py only applies to dataclasses")
+            if categories is not None and category not in categories:
+                yield Finding(
+                    self.rule, *where,
+                    f"kind {kind!r} registered under category "
+                    f"{category!r} which registry.CATEGORIES does not "
+                    f"declare (declared: {categories})")
+            documented = (ast.get_docstring(node) is not None
+                          or kind in docs)
+            if not documented:
+                yield Finding(
+                    self.rule, *where,
+                    f"kind {kind!r} is undocumented: add a class "
+                    f"docstring or a README/COMPONENTS mention")
+            if not any(kind in text for _, text in exercise):
+                yield Finding(
+                    self.rule, *where,
+                    f"kind {kind!r} is exercised by no test, validator, "
+                    f"or bench (literal appears nowhere under tests/, "
+                    f"tools/, benchmarks/)")

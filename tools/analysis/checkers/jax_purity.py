@@ -1,0 +1,195 @@
+"""jax-purity — host side effects inside jitted/sharded device code.
+
+Functions handed to ``jax.jit`` / ``shard_map`` / ``pallas_call`` run
+ONCE as a trace and then as compiled XLA: a ``print``, host RNG draw,
+``np.asarray`` materialization, or mutation of captured state executes
+at trace time only (silently wrong on every later call) or forces a
+device->host sync that breaks the dp×tp sharded serve mid-batch.
+
+Two sub-rules:
+
+- *impure op in jitted code*: host I/O (print/open/logging), numpy
+  materialization (``np.*``, ``.item()``, ``.tolist()``), Python RNG
+  (``random.*`` — ``jax.random`` is fine), wall-clock reads
+  (``time.*``), or assignment to captured state (``self.x = ...``)
+  anywhere in a function that is jitted, shard_mapped, or a Pallas
+  kernel (including helpers defined inside it — they trace too).
+- *dead device helper*: a module-level function in the device-path
+  packages with zero references anywhere in the repo (code, tests,
+  tools, benches). Dead device code rots instantly — nothing compiles
+  it, so nothing notices when it stops being true (ADVICE r5 found
+  exactly this by hand).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set
+
+from tools.analysis.core import (
+    Checker, Finding, Project, SourceFile, dotted_name, register_checker,
+)
+
+JIT_WRAPPERS = {"jit", "shard_map", "pallas_call", "pmap"}
+
+IMPURE_EXACT = {
+    "print": "host I/O runs at trace time only",
+    "input": "host I/O inside device code",
+    "open": "host file I/O inside device code",
+    "breakpoint": "host debugger inside device code",
+}
+IMPURE_PREFIX = {
+    "np.": "numpy materializes the tracer on host",
+    "numpy.": "numpy materializes the tracer on host",
+    "random.": "Python RNG is host state; use jax.random with a key",
+    "time.": "wall clock is host state captured at trace time",
+    "log.": "logging runs at trace time only",
+    "logging.": "logging runs at trace time only",
+    "logger.": "logging runs at trace time only",
+}
+IMPURE_METHODS = {
+    "item": ".item() forces a device->host sync",
+    "tolist": ".tolist() forces a device->host sync",
+    "block_until_ready": "host sync inside jitted code is a trace-time no-op",
+}
+
+
+def _jitted_functions(tree: ast.AST) -> Dict[str, str]:
+    """{function_name: how} for functions that end up jitted/traced."""
+    out: Dict[str, str] = {}
+    partial_wraps: Dict[str, str] = {}  # alias -> wrapped fn name
+
+    def is_wrapper(call: ast.Call) -> Optional[str]:
+        name = dotted_name(call.func)
+        if name is None:
+            return None
+        leaf = name.split(".")[-1]
+        return leaf if leaf in JIT_WRAPPERS else None
+
+    for node in ast.walk(tree):
+        # f = functools.partial(kernel, ...) — remember the alias
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            cname = dotted_name(node.value.func)
+            if cname and cname.split(".")[-1] == "partial" and node.value.args:
+                first = node.value.args[0]
+                wrapped = dotted_name(first)
+                if wrapped:
+                    partial_wraps[node.targets[0].id] = wrapped.split(".")[-1]
+        if isinstance(node, ast.Call):
+            how = is_wrapper(node)
+            if how is None:
+                continue
+            for arg in node.args:
+                target = dotted_name(arg)
+                if target is not None:
+                    leaf = target.split(".")[-1]
+                    out[partial_wraps.get(leaf, leaf)] = how
+        # decorators: @jax.jit, @partial(jax.jit, ...)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                dname = dotted_name(dec)
+                if dname and dname.split(".")[-1] in JIT_WRAPPERS:
+                    out[node.name] = dname.split(".")[-1]
+                if isinstance(dec, ast.Call):
+                    cn = dotted_name(dec.func)
+                    if cn and cn.split(".")[-1] in JIT_WRAPPERS:
+                        out[node.name] = cn.split(".")[-1]
+                    if cn and cn.split(".")[-1] == "partial" and dec.args:
+                        inner = dotted_name(dec.args[0])
+                        if inner and inner.split(".")[-1] in JIT_WRAPPERS:
+                            out[node.name] = inner.split(".")[-1]
+    return out
+
+
+def _impure_reason(call: ast.Call) -> Optional[str]:
+    name = dotted_name(call.func)
+    if name is not None:
+        if name in IMPURE_EXACT:
+            return IMPURE_EXACT[name]
+        for pfx, why in IMPURE_PREFIX.items():
+            if name.startswith(pfx):
+                return why
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in IMPURE_METHODS:
+        return IMPURE_METHODS[f.attr]
+    return None
+
+
+@register_checker
+class JaxPurityChecker(Checker):
+    rule = "jax-purity"
+    description = ("host side effect inside jit/shard_map/pallas code, or "
+                   "dead device-path helper with zero call sites")
+    scope = ("linkerd_tpu/models", "linkerd_tpu/ops",
+             "linkerd_tpu/lifecycle", "linkerd_tpu/parallel")
+
+    def check(self, src: SourceFile, project: Project) -> Iterator[Finding]:
+        jitted = _jitted_functions(src.tree)
+        fns = {node.name: node for node in ast.walk(src.tree)
+               if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        for name, how in jitted.items():
+            fn = fns.get(name)
+            if fn is None:
+                continue  # jitted lambda or imported fn; lambdas below
+            yield from self._check_body(src, fn, name, how)
+        # lambdas passed straight to a wrapper call
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call):
+                wname = dotted_name(node.func)
+                if (wname and wname.split(".")[-1] in JIT_WRAPPERS):
+                    for arg in node.args:
+                        if isinstance(arg, ast.Lambda):
+                            yield from self._check_body(
+                                src, arg, "<lambda>",
+                                wname.split(".")[-1])
+        yield from self._dead_helpers(src, project)
+
+    def _check_body(self, src: SourceFile, fn: ast.AST, name: str,
+                    how: str) -> Iterator[Finding]:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                reason = _impure_reason(node)
+                if reason:
+                    yield Finding(
+                        self.rule, src.rel, node.lineno, node.col_offset,
+                        f"impure call {dotted_name(node.func) or '?'}() in "
+                        f"{how}-traced '{name}': {reason}")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        yield Finding(
+                            self.rule, src.rel, node.lineno,
+                            node.col_offset,
+                            f"mutation of captured state 'self.{t.attr}' "
+                            f"in {how}-traced '{name}': runs at trace "
+                            f"time only")
+
+    def _dead_helpers(self, src: SourceFile,
+                      project: Project) -> Iterator[Finding]:
+        assert isinstance(src.tree, ast.Module)
+        for node in src.tree.body:
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name.startswith("__"):
+                continue
+            pat = re.compile(r"\b%s\b" % re.escape(node.name))
+            refs = 0
+            for rel, text in project.reference_corpus():
+                hits = len(pat.findall(text))
+                if rel == src.rel:
+                    # discount the def line itself
+                    hits -= len(pat.findall(src.lines[node.lineno - 1]))
+                refs += hits
+            if refs == 0:
+                yield Finding(
+                    self.rule, src.rel, node.lineno, node.col_offset,
+                    f"dead device-path helper '{node.name}': zero call "
+                    f"sites in the repo (code, tests, tools, benches) — "
+                    f"wire it in or delete it")

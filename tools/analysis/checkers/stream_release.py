@@ -1,0 +1,95 @@
+"""stream-release — h2 / gRPC frames must return their flow credit.
+
+The h2 layer is pull-based with explicit ``release()`` flow control:
+every Data frame handed to the application holds window credit until
+released (stream.py's Stream.release() semantics). A frame read and
+then dropped — especially on an exception edge — strands credit; the
+peer's send window never refills and the stream wedges at exactly the
+moment things are already going wrong.
+
+The rule tracks variables bound from a zero-arg ``await <x>.read()``
+(the H2Stream/DecodingStream pull shape — ``reader.read(n)`` byte reads
+take arguments and are ignored) and requires each to be released or to
+escape the function (returned, yielded, offered onward, stored).
+A bare ``await x.read()`` whose result is dropped is always a leak.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set
+
+from tools.analysis.core import (
+    Checker, Finding, Project, SourceFile, register_checker,
+)
+
+
+def _is_frame_read(node: ast.AST) -> bool:
+    """``await <expr>.read()`` with no arguments."""
+    return (isinstance(node, ast.Await)
+            and isinstance(node.value, ast.Call)
+            and isinstance(node.value.func, ast.Attribute)
+            and node.value.func.attr == "read"
+            and not node.value.args and not node.value.keywords)
+
+
+@register_checker
+class StreamReleaseChecker(Checker):
+    rule = "stream-release"
+    description = ("frame pulled from an h2/gRPC stream is neither "
+                   "release()d nor passed onward on every path")
+    scope = ("linkerd_tpu/protocol/h2", "linkerd_tpu/grpc",
+             "linkerd_tpu/router")
+
+    def check(self, src: SourceFile, project: Project) -> Iterator[Finding]:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield from self._check_fn(src, node)
+
+    def _check_fn(self, src: SourceFile, fn: ast.AST) -> Iterator[Finding]:
+        reads: List[ast.Assign] = []
+        for node in ast.walk(fn):
+            # frame read and dropped outright
+            if isinstance(node, ast.Expr) and _is_frame_read(node.value):
+                yield Finding(
+                    self.rule, src.rel, node.lineno, node.col_offset,
+                    "frame read and dropped without release(): its flow "
+                    "credit is stranded")
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and _is_frame_read(node.value)):
+                reads.append(node)
+        if not reads:
+            return
+        released: Set[str] = set()
+        escaped: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (isinstance(f, ast.Attribute) and f.attr == "release"
+                        and isinstance(f.value, ast.Name)):
+                    released.add(f.value.id)
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    if isinstance(arg, ast.Name):
+                        escaped.add(arg.id)
+                # attribute access on the frame (frame.data, frame.eos)
+                # is consumption, not escape — only whole-frame handoff
+            elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                v = getattr(node, "value", None)
+                if isinstance(v, ast.Name):
+                    escaped.add(v.id)
+            elif isinstance(node, ast.Assign):
+                # frame stored on an attribute/subscript outlives the fn
+                if isinstance(node.value, ast.Name) and any(
+                        isinstance(t, (ast.Attribute, ast.Subscript))
+                        for t in node.targets):
+                    escaped.add(node.value.id)
+        for read in reads:
+            name = read.targets[0].id
+            if name in released or name in escaped:
+                continue
+            yield Finding(
+                self.rule, src.rel, read.lineno, read.col_offset,
+                f"'{name}' pulled from a stream but never release()d or "
+                f"passed onward in this function: stranded flow credit")

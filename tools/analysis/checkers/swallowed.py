@@ -1,0 +1,91 @@
+"""swallowed-exception — broad handlers that eat serving-path errors.
+
+A bare ``except:`` or ``except Exception: pass`` on the serving path
+turns real faults (codec bugs, half-closed transports, cancelled
+scoring) into silence: no log line, no metric, no re-raise — the exact
+failure class ADVICE rounds keep finding by hand. Narrow handlers
+(``except ConnectionResetError: pass``) are legitimate teardown idiom
+and are not flagged; neither is a broad handler that logs, counts, or
+re-raises.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.analysis.core import (
+    Checker, Finding, Project, SourceFile, dotted_name, register_checker,
+)
+
+BROAD = {"Exception", "BaseException"}
+LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+               "critical", "log"}
+METRIC_METHODS = {"incr", "decr", "mark", "set", "observe", "add", "record"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [dotted_name(e) for e in t.elts]
+    else:
+        names = [dotted_name(t)]
+    return any(n is not None and n.split(".")[-1] in BROAD for n in names)
+
+
+def _handles(handler: ast.ExceptHandler) -> bool:
+    """True if the body re-raises, logs, counts, or does real work."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name is None:
+                continue
+            parts = name.split(".")
+            if parts[-1] in LOG_METHODS | METRIC_METHODS:
+                return True
+            if parts[0] in ("log", "logger", "logging", "warnings"):
+                return True
+    # body that is only pass / ... / continue / break / bare return is a
+    # swallow; anything else (assignments, fallback calls) counts as
+    # deliberate handling
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Continue) or isinstance(stmt, ast.Break):
+            continue
+        if isinstance(stmt, ast.Return) and stmt.value is None:
+            continue
+        if (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)):
+            continue  # docstring / ellipsis
+        return True
+    return False
+
+
+@register_checker
+class SwallowedExceptionChecker(Checker):
+    rule = "swallowed-exception"
+    description = ("bare or Exception-broad handler on the serving path "
+                   "with no log, metric, or re-raise")
+    scope = ("linkerd_tpu/router", "linkerd_tpu/protocol",
+             "linkerd_tpu/grpc", "linkerd_tpu/telemetry")
+
+    def check(self, src: SourceFile, project: Project) -> Iterator[Finding]:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node):
+                continue
+            if _handles(node):
+                continue
+            what = ("bare 'except:'" if node.type is None
+                    else "broad 'except Exception'")
+            yield Finding(
+                self.rule, src.rel, node.lineno, node.col_offset,
+                f"{what} swallows serving-path errors silently: narrow "
+                f"the exception type, or log/count/re-raise")

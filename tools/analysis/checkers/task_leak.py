@@ -1,0 +1,53 @@
+"""task-leak — fire-and-forget asyncio tasks.
+
+``asyncio.create_task`` / ``ensure_future`` whose result is dropped on
+the floor has two failure modes the data plane cannot afford: the event
+loop holds only a weak reference, so the task can be garbage-collected
+mid-flight; and an exception inside it is only reported at GC time via
+the loop's exception handler — a silently-dead h2 window pump looks
+exactly like a hung peer. A spawned task must be (a) bound to a name or
+attribute, (b) chained with ``add_done_callback``, (c) awaited, or (d)
+routed through ``linkerd_tpu.core.tasks.spawn`` which holds the
+reference and logs failures.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.analysis.core import (
+    Checker, Finding, Project, SourceFile, register_checker,
+)
+
+SPAWNERS = {"create_task", "ensure_future"}
+
+
+def _is_spawn(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in SPAWNERS:
+        return True
+    if isinstance(f, ast.Name) and f.id in SPAWNERS:
+        return True
+    return False
+
+
+@register_checker
+class TaskLeakChecker(Checker):
+    rule = "task-leak"
+    description = ("create_task/ensure_future result dropped: no held "
+                   "reference, done-callback, or await")
+    scope = ("linkerd_tpu",)
+
+    def check(self, src: SourceFile, project: Project) -> Iterator[Finding]:
+        for node in ast.walk(src.tree):
+            # a *statement* that is nothing but the spawn call — the
+            # returned Task is unreachable the moment the statement ends
+            if (isinstance(node, ast.Expr)
+                    and isinstance(node.value, ast.Call)
+                    and _is_spawn(node.value)):
+                yield Finding(
+                    self.rule, src.rel, node.lineno, node.col_offset,
+                    "task spawned and dropped: hold the reference, attach "
+                    "a done-callback, or use core.tasks.spawn() so "
+                    "failures are logged and the task outlives GC")

@@ -1,0 +1,316 @@
+"""Shared infrastructure for the l5d static-analysis suite.
+
+The suite is AST-based (``ast`` stdlib — no third-party deps) and
+repo-native: every rule encodes an invariant this codebase actually
+relies on (event-loop non-blocking, task ownership, stream release,
+jit purity, config-registry hygiene) rather than generic style.
+
+Model:
+
+- ``SourceFile``  — one parsed module: text, lines, AST, suppressions.
+- ``Finding``     — one diagnostic with ``file:line``, rule id, severity.
+- ``Checker``     — a rule; ``run(project)`` yields findings. Checkers
+  declare a ``scope`` of repo-relative path prefixes so data-plane rules
+  never fire on control-plane startup code.
+- ``Project``     — the scanned tree plus repo-level context (docs,
+  tests) for cross-file rules like config-registry and dead-helper
+  detection.
+
+Suppressions are inline and MUST carry a justification::
+
+    ring.append(x)  # l5d: ignore[async-blocking] — O(1) deque append
+
+A suppression with no justification does not suppress anything and is
+itself reported under the ``suppression`` meta-rule: the whole point is
+that every deliberate exception to a rule documents *why*.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+SEVERITIES = ("error", "warning")
+
+# `# l5d: ignore[rule-a,rule-b] — why this is deliberate`
+_SUPPRESS_RE = re.compile(
+    r"#\s*l5d:\s*ignore\[([a-zA-Z0-9_,\- ]+)\]\s*(?:[—:-]+\s*(\S.*))?")
+
+
+@dataclass
+class Suppression:
+    line: int
+    rules: Tuple[str, ...]
+    justification: str
+
+    @property
+    def justified(self) -> bool:
+        return bool(self.justification.strip())
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative
+    line: int
+    col: int
+    message: str
+    severity: str = "error"
+    suppressed: bool = False
+    justification: str = ""
+
+    def show(self) -> str:
+        mark = " (suppressed)" if self.suppressed else ""
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.rule}] {self.message}{mark}")
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class SourceFile:
+    """One parsed python module plus its inline suppressions."""
+
+    def __init__(self, abspath: str, rel: str, text: str):
+        self.abspath = abspath
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(text, filename=rel)
+        except SyntaxError as e:  # surfaced as a finding by run()
+            self.parse_error = f"syntax error: {e.msg} (line {e.lineno})"
+        self.suppressions: Dict[int, Suppression] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                rules = tuple(r.strip() for r in m.group(1).split(",")
+                              if r.strip())
+                self.suppressions[i] = Suppression(
+                    i, rules, (m.group(2) or "").strip())
+
+    def suppression_for(self, rule: str, line: int) -> Optional[Suppression]:
+        """A suppression applies to findings on its own line or the line
+        directly below it (comment-only line above the flagged code)."""
+        for ln in (line, line - 1):
+            sup = self.suppressions.get(ln)
+            if sup and rule in sup.rules:
+                return sup
+        return None
+
+
+class Project:
+    """The scanned tree + repo context for cross-file rules."""
+
+    def __init__(self, repo_root: str, scan_paths: Sequence[str]):
+        self.repo_root = os.path.abspath(repo_root)
+        self.scan_paths = [os.path.normpath(p) for p in scan_paths]
+        self.sources: List[SourceFile] = []
+        for p in self.scan_paths:
+            absp = os.path.join(self.repo_root, p)
+            if not os.path.exists(absp):
+                # a typo'd path must not pass the gate as a clean empty
+                # tree — "0 findings over nothing" is not a clean bill
+                raise FileNotFoundError(f"scan path does not exist: {absp}")
+            for f in sorted(_walk_py(absp)):
+                rel = os.path.relpath(f, self.repo_root)
+                with open(f, "r", encoding="utf-8") as fh:
+                    self.sources.append(SourceFile(f, rel, fh.read()))
+        self._ref_corpus: Optional[List[Tuple[str, str]]] = None
+        self._doc_text: Optional[str] = None
+
+    def in_scope(self, scope: Tuple[str, ...]) -> Iterator[SourceFile]:
+        for src in self.sources:
+            rel = src.rel.replace(os.sep, "/")
+            if not scope or any(rel == s or rel.startswith(s + "/")
+                                for s in scope):
+                yield src
+
+    # -- repo-level context ----------------------------------------------
+    def reference_corpus(self) -> List[Tuple[str, str]]:
+        """(rel, text) for every python file in the repo (scanned or not):
+        tests, tools, benchmarks count as call sites for dead-code rules."""
+        if self._ref_corpus is None:
+            out: List[Tuple[str, str]] = []
+            skip_dirs = {".git", "__pycache__", ".claude", "node_modules"}
+            for base, dirs, files in os.walk(self.repo_root):
+                dirs[:] = [d for d in dirs if d not in skip_dirs]
+                for name in files:
+                    if name.endswith(".py"):
+                        f = os.path.join(base, name)
+                        rel = os.path.relpath(f, self.repo_root)
+                        try:
+                            with open(f, "r", encoding="utf-8") as fh:
+                                out.append((rel, fh.read()))
+                        except OSError:
+                            continue
+            self._ref_corpus = out
+        return self._ref_corpus
+
+    def doc_text(self) -> str:
+        """README + COMPONENTS, for 'documented' checks (cached)."""
+        if self._doc_text is None:
+            chunks = []
+            for name in ("README.md", "COMPONENTS.md"):
+                p = os.path.join(self.repo_root, name)
+                if os.path.exists(p):
+                    with open(p, "r", encoding="utf-8") as fh:
+                        chunks.append(fh.read())
+            self._doc_text = "\n".join(chunks)
+        return self._doc_text
+
+    def exercise_corpus(self) -> List[Tuple[str, str]]:
+        """Files that count as 'exercising' a config kind: the test
+        suite, the validator/tooling, and the benchmark drivers."""
+        return [(rel, text) for rel, text in self.reference_corpus()
+                if rel.split(os.sep)[0] in ("tests", "tools", "benchmarks")
+                or rel in ("bench.py", "__graft_entry__.py")]
+
+
+def _walk_py(path: str) -> Iterator[str]:
+    if os.path.isfile(path):
+        if path.endswith(".py"):
+            yield path
+        return
+    skip_dirs = {".git", "__pycache__"}
+    for base, dirs, files in os.walk(path):
+        dirs[:] = [d for d in dirs if d not in skip_dirs]
+        for name in files:
+            if name.endswith(".py"):
+                yield os.path.join(base, name)
+
+
+class Checker:
+    """Base class for one rule."""
+
+    rule: str = ""
+    description: str = ""
+    scope: Tuple[str, ...] = ()  # repo-relative prefixes; () = everything
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        for src in project.in_scope(self.scope):
+            if src.tree is None:
+                continue
+            yield from self.check(src, project)
+
+    def check(self, src: SourceFile,
+              project: Project) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def body_calls(node: ast.AST, *,
+               skip_nested: bool = True) -> Iterator[ast.Call]:
+    """Call nodes executed in ``node``'s own frame: nested function/lambda
+    bodies are skipped (they run later, in a different context)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        if skip_nested and isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def walk_functions(tree: ast.AST) -> Iterator[Tuple[ast.AST, Optional[str]]]:
+    """Yield (function_node, enclosing_class_name) for every def in the
+    module, including methods and nested defs."""
+    def visit(node: ast.AST, cls: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield (child, cls)
+                yield from visit(child, cls)
+            else:
+                yield from visit(child, cls)
+    yield from visit(tree, None)
+
+
+# -- registry + runner -------------------------------------------------------
+
+_CHECKERS: List[Checker] = []
+
+
+def register_checker(cls):
+    _CHECKERS.append(cls())
+    return cls
+
+
+def all_checkers() -> List[Checker]:
+    from tools.analysis import checkers  # noqa: F401 — registration import
+    return list(_CHECKERS)
+
+
+def rule_ids() -> List[str]:
+    return sorted(c.rule for c in all_checkers())
+
+
+def run_analysis(scan_paths: Sequence[str], repo_root: Optional[str] = None,
+                 rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the suite; returns ALL findings (suppressed ones flagged).
+
+    Bad suppressions (no justification) surface as ``suppression``
+    findings and do NOT silence the original diagnostic.
+    """
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    project = Project(repo_root, scan_paths)
+    selected = [c for c in all_checkers()
+                if rules is None or c.rule in rules]
+    findings: List[Finding] = []
+    by_rel = {src.rel: src for src in project.sources}
+    for src in project.sources:
+        if src.parse_error:
+            findings.append(Finding("parse", src.rel, 0, 0, src.parse_error))
+    for checker in selected:
+        for f in checker.run(project):
+            src = by_rel.get(f.path)
+            if src is not None:
+                sup = src.suppression_for(f.rule, f.line)
+                if sup is not None and sup.justified:
+                    f.suppressed = True
+                    f.justification = sup.justification
+            findings.append(f)
+    # meta-rule: every suppression carries a justification and actually
+    # names a real rule (stale ids rot silently otherwise)
+    if rules is None or "suppression" in rules:
+        known = set(rule_ids()) | {"parse"}
+        for src in project.sources:
+            for sup in src.suppressions.values():
+                if not sup.justified:
+                    findings.append(Finding(
+                        "suppression", src.rel, sup.line, 0,
+                        "suppression without justification: write "
+                        "'# l5d: ignore[rule] — why it is safe'"))
+                for r in sup.rules:
+                    if r not in known:
+                        findings.append(Finding(
+                            "suppression", src.rel, sup.line, 0,
+                            f"suppression names unknown rule {r!r} "
+                            f"(known: {sorted(known)})"))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -18,6 +18,11 @@ Also validates model-checkpoint stores (the lifecycle subsystem's
 artifact integrity: CRCs, manifest/file agreement, lineage, orphans):
 
     python tools/validator.py ckpt <store-dir> [<store-dir> ...]
+
+And runs the l5dlint static-analysis suite (tools/analysis) over the
+tree — non-zero exit on any unsuppressed finding:
+
+    python tools/validator.py lint [path ...]
 """
 
 from __future__ import annotations
@@ -258,8 +263,21 @@ def validate_checkpoints(dirs) -> int:
     return 0
 
 
+def validate_lint(paths) -> int:
+    """Run the static-analysis suite; exit 0 only when the tree is
+    clean (every finding fixed or carrying a justified suppression)."""
+    from tools.analysis.__main__ import main as lint_main
+
+    rc = lint_main(list(paths) or ["linkerd_tpu"])
+    if rc == 0:
+        print("VALIDATOR PASS (lint)")
+    return rc
+
+
 async def main() -> int:
     args = sys.argv[1:]
+    if args and args[0] == "lint":
+        return validate_lint(args[1:])
     if args and args[0] == "ckpt":
         if len(args) < 2:
             print("usage: python tools/validator.py ckpt <store-dir>...",
