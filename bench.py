@@ -316,21 +316,69 @@ def fault_auc_bench() -> dict:
     return asyncio.run(bench(80))
 
 
+def resilience_bench() -> dict:
+    """Chaos validation wall time (``tools/validator.py chaos``): the
+    assembled linker with a black-holed scorer sidecar must keep
+    serving, flip anomaly/degraded, and recover once a live sidecar
+    replaces the black hole. Reports the measured degrade/recover
+    windows plus total wall time."""
+    import subprocess
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")  # stub sidecar, no device
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "tools/validator.py", "chaos"],
+        capture_output=True, text=True, timeout=180, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    out: dict = {"wall_s": round(time.perf_counter() - t0, 2),
+                 "pass": proc.returncode == 0}
+    for line in proc.stdout.splitlines():
+        if line.startswith("CHAOS "):
+            out.update(json.loads(line[len("CHAOS "):]))
+    if proc.returncode != 0:
+        out["error"] = (proc.stderr or proc.stdout)[-300:]
+    return out
+
+
+# Global wall-clock budget: a mid-run stall (e.g. the TPU tunnel
+# wedging one phase) must not zero the whole round. The headline JSON
+# line re-prints after EVERY phase (last line wins), and once the
+# budget is spent the remaining phases are recorded as skipped instead
+# of running into the driver's hard kill.
+DEFAULT_BUDGET_S = 2400.0
+
+
 def main() -> None:
     detail: dict = {}
-    rows_per_s = None
-    try:
+    state = {"rows_per_s": None}
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", DEFAULT_BUDGET_S))
+    t_start = time.monotonic()
+
+    def emit() -> None:
+        rows_per_s = state["rows_per_s"]
+        baseline = 50_000.0  # north-star: >=50k req/s (BASELINE.md)
+        print(json.dumps({
+            "metric": "anomaly_scorer_throughput",
+            "value": (round(rows_per_s, 1)
+                      if rows_per_s is not None else None),
+            "unit": "req/s",
+            "vs_baseline": (round(rows_per_s / baseline, 3)
+                            if rows_per_s is not None else None),
+            "detail": detail,
+        }), flush=True)
+
+    def ph_scorer() -> None:
         # The axon tunnel's host<->device bandwidth swings ~10x on a
         # minutes timescale (shared fabric). Two runs, keep the better:
         # the workload is identical, the variance is environmental.
         scorer = scorer_throughput()
-        rows_per_s = scorer.pop("rows_per_s")
+        state["rows_per_s"] = scorer.pop("rows_per_s")
         try:
             second = scorer_throughput()
             r2 = second.pop("rows_per_s")
-            other = min(rows_per_s, r2)
-            if r2 > rows_per_s:
-                rows_per_s, scorer = r2, second
+            other = min(state["rows_per_s"], r2)
+            if r2 > state["rows_per_s"]:
+                state["rows_per_s"], scorer = r2, second
             scorer["runs"] = 2
             # keep the losing run's rate visible: the spread IS the
             # tunnel variance, and hiding it would overstate stability
@@ -338,10 +386,8 @@ def main() -> None:
         except Exception:  # noqa: BLE001 — first run stands alone
             scorer["runs"] = 1
         detail["scorer"] = scorer
-    except Exception as e:  # noqa: BLE001 — partial results still count
-        detail["scorer_error"] = repr(e)
 
-    try:
+    def ph_proxy() -> None:
         p = proxy_bench()
         detail["proxy_req_s"] = p.get("proxy_req_s")
         detail["added_p99_ms"] = p.get("added_p99_ms")
@@ -349,16 +395,14 @@ def main() -> None:
         detail["proxy_fastpath"] = p.get("fastpath")
         if "error" in p:
             detail["proxy_error"] = p["error"]
-    except Exception as e:  # noqa: BLE001 — partial results still count
-        detail["proxy_error"] = repr(e)
 
-    try:
+    def ph_grpc() -> None:
         g = grpc_bench()
         detail["grpc_req_s"] = g.get("grpc_req_s")
         # headline p99 @rate comes from the external (subprocess) paced
         # loadgen; the Python-client view stays in grpc_python_p99_ms.
-        # A paced run with zero successes is a failed measurement, not a
-        # 0ms p99 — fall back to the in-process number then.
+        # A paced run with zero successes is a failed measurement, not
+        # a 0ms p99 — fall back to the in-process number then.
         ext = g.get("grpc_paced_ext") or {}
         detail["grpc_p99_ms"] = (ext.get("p99_ms") if ext.get("reqs")
                                  else (g.get("grpc_lat")
@@ -370,47 +414,51 @@ def main() -> None:
         detail["grpc_loadgen"] = g.get("loadgen")
         if "error" in g:
             detail["grpc_error"] = g["error"]
-    except Exception as e:  # noqa: BLE001
-        detail["grpc_error"] = repr(e)
 
-    try:
-        a = fault_auc_bench()
-        detail["fault_auc"] = a.get("fault_auc")
-    except Exception as e:  # noqa: BLE001
-        detail["auc_error"] = repr(e)
+    def ph_auc() -> None:
+        detail["fault_auc"] = fault_auc_bench().get("fault_auc")
 
-    try:
+    def ph_subtle() -> None:
         s = subtle_auc_bench()
         detail["fault_auc_subtle"] = s.get("fault_auc_subtle")
         detail["subtle"] = s
-    except Exception as e:  # noqa: BLE001
-        detail["subtle_auc_error"] = repr(e)
 
-    try:
+    def ph_sharded() -> None:
         detail.setdefault("scorer", {})["sharded_cpu8"] = \
             sharded_cpu8_scorer()
-    except Exception as e:  # noqa: BLE001
-        detail["sharded_cpu8_error"] = repr(e)
 
-    try:
+    def ph_lifecycle() -> None:
         detail["lifecycle"] = lifecycle_bench()
-    except Exception as e:  # noqa: BLE001
-        detail["lifecycle_error"] = repr(e)
 
-    try:
+    def ph_static() -> None:
         detail["static_analysis"] = static_analysis_bench()
-    except Exception as e:  # noqa: BLE001
-        detail["static_analysis_error"] = repr(e)
 
-    baseline = 50_000.0  # north-star: >=50k req/s scored (BASELINE.md)
-    print(json.dumps({
-        "metric": "anomaly_scorer_throughput",
-        "value": round(rows_per_s, 1) if rows_per_s is not None else None,
-        "unit": "req/s",
-        "vs_baseline": (round(rows_per_s / baseline, 3)
-                        if rows_per_s is not None else None),
-        "detail": detail,
-    }))
+    def ph_resilience() -> None:
+        detail["resilience"] = resilience_bench()
+
+    phases = [
+        ("scorer", ph_scorer),
+        ("proxy", ph_proxy),
+        ("grpc", ph_grpc),
+        ("auc", ph_auc),
+        ("subtle_auc", ph_subtle),
+        ("sharded_cpu8", ph_sharded),
+        ("lifecycle", ph_lifecycle),
+        ("static_analysis", ph_static),
+        ("resilience", ph_resilience),
+    ]
+    for name, fn in phases:
+        spent = time.monotonic() - t_start
+        if spent > budget_s:
+            detail.setdefault("skipped_phases", []).append(name)
+            detail["budget_s"] = budget_s
+            emit()  # skipping still re-emits: the round never zeroes
+            continue
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 — partial results count
+            detail[f"{name}_error"] = repr(e)
+        emit()
 
 
 if __name__ == "__main__":

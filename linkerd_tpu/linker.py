@@ -27,8 +27,12 @@ from linkerd_tpu.protocol.http.client import HttpClient
 from linkerd_tpu.protocol.http.identifiers import compose_identifiers
 from linkerd_tpu.protocol.http.message import Request, Response
 from linkerd_tpu.protocol.http.server import HttpServer
+from linkerd_tpu.router.admission import AdmissionControlFilter
 from linkerd_tpu.router.balancer import mk_balancer
 from linkerd_tpu.router.binding import DstBindingFactory, DstPath
+from linkerd_tpu.router.deadline import (
+    ClientDeadlineFilter, DeadlineFilter, ServerDeadlineFilter,
+)
 from linkerd_tpu.router.failure_accrual import FailureAccrualService
 from linkerd_tpu.router.retries import (
     ClassifiedRetries, RequeueFilter, RetryBudget, TotalTimeout,
@@ -171,6 +175,17 @@ class RetriesSpec:
 
 
 @dataclass
+class AdmissionControlSpec:
+    """Per-router overload protection: at most ``maxConcurrency``
+    requests in flight with up to ``maxPending`` queued for a slot;
+    beyond that the router sheds with a retryable signal (http: 503 +
+    ``l5d-retryable: true``; h2: ``RST_STREAM REFUSED_STREAM``)."""
+
+    maxConcurrency: int = 1024
+    maxPending: int = 0
+
+
+@dataclass
 class SvcSpec:
     """Per-logical-name policy (ref: SvcConfig.scala — totalTimeout,
     retries, classification)."""
@@ -230,6 +245,10 @@ class RouterSpec:
     # (ref: HttpLoggerConfig.scala loggers param; kinds under
     # protocol/http/loggers.py)
     loggers: Optional[List[Any]] = None
+    # http + h2: per-router admission control (bounded concurrency +
+    # bounded pending queue); sheds are retryable by contract (see
+    # AdmissionControlSpec / router/admission.py)
+    admissionControl: Optional[AdmissionControlSpec] = None
     # http + h2: serve the data plane from the native C++ epoll engine
     # (native/fastpath.cpp for http, native/h2_fastpath.cpp for h2);
     # Python remains the control plane (naming, route install,
@@ -668,7 +687,8 @@ class Linker:
             bal_kind = (cspec.loadBalancer or BalancerSpec()).kind
             bal = mk_balancer(bal_kind, bound.addr, endpoint_factory)
             filters: List[Any] = [
-                H2StreamStatsFilter(metrics, "rt", label, "client", cid)]
+                H2StreamStatsFilter(metrics, "rt", label, "client", cid),
+                ClientDeadlineFilter()]
             filters.extend(extra_filters)
             filters.extend(logger_filters)
             metrics.scope("rt", label, "client", cid).gauge(
@@ -696,8 +716,10 @@ class Linker:
             name = dst.path.show.lstrip("/").replace("/", ".") or "root"
             filters: List[Any] = [
                 H2StreamStatsFilter(metrics, "rt", label, "service", name)]
-            if sspec.totalTimeoutMs is not None:
-                filters.append(TotalTimeout(sspec.totalTimeoutMs / 1e3))
+            # deadline-aware total timeout (see the http twin)
+            filters.append(DeadlineFilter(
+                sspec.totalTimeoutMs / 1e3
+                if sspec.totalTimeoutMs is not None else None))
             filters.append(H2ClassifiedRetries(
                 classifier, budget, mk_backoffs(sspec),
                 max_retries=(sspec.retries.maxRetries
@@ -721,6 +743,9 @@ class Linker:
             if hasattr(t, "recorder"):
                 server_filters.append(t.recorder())
         server_filters.append(H2ErrorResponder())
+        # INSIDE the responder: DeadlineExceeded -> 504/DEADLINE_EXCEEDED,
+        # OverloadShed -> RST_STREAM REFUSED_STREAM
+        server_filters.extend(self._edge_resilience_filters(rspec, label))
         server_stack = filters_to_service(server_filters, routing)
 
         from linkerd_tpu.router.h2_layer import H2ClearContextFilter
@@ -757,6 +782,10 @@ class Linker:
                     f"{label}.servers[{i}]: tls/clearContext/"
                     f"maxConcurrentRequests not supported for "
                     f"{rspec.protocol} servers")
+        if rspec.admissionControl is not None:
+            raise ConfigError(
+                f"{label}: admissionControl is only supported on "
+                f"http/h2 routers")
 
         base_dtab = Dtab.read(rspec.dtab) if rspec.dtab else Dtab.empty()
         prefix = Path.read(rspec.dstPrefix)
@@ -898,6 +927,10 @@ class Linker:
                     f"{label}.servers[{i}].clearContext: "
                     f"not supported for thrift servers")
 
+        if rspec.admissionControl is not None:
+            raise ConfigError(
+                f"{label}: admissionControl is only supported on "
+                f"http/h2 routers")
         if rspec.thriftProtocol not in ("binary", "compact"):
             raise ConfigError(
                 f"{label}.thriftProtocol must be binary or compact, "
@@ -1051,12 +1084,40 @@ class Linker:
             # ignored audit log is worse than a load failure
             raise ConfigError(
                 f"{label}: loggers are not supported with fastPath: true")
+        if rspec.admissionControl is not None:
+            raise ConfigError(
+                f"{label}: admissionControl is not supported with "
+                f"fastPath: true (the native engine has no Python "
+                f"per-request hook to enforce it)")
         for i, srv in enumerate(rspec.servers or []):
             if srv.timeoutMs is not None:
                 raise ConfigError(
                     f"{label}.servers[{i}].timeoutMs is not supported "
                     f"with fastPath: true (the engine applies its own "
                     f"timeouts)")
+
+    def _edge_resilience_filters(self, rspec: RouterSpec,
+                                 label: str) -> List[Any]:
+        """Server-edge resilience (http + h2): deadline decode/expired
+        shed + admission control. Both raise, so they sit INSIDE the
+        protocol's error responder (appended AFTER it in server_filters)
+        where DeadlineExceeded maps to 504/DEADLINE_EXCEEDED and
+        OverloadShed to 503-retryable/REFUSED_STREAM. Single instances,
+        shared across the router's servers — the concurrency bound is a
+        router property."""
+        filters: List[Any] = [ServerDeadlineFilter(
+            self.metrics.scope("rt", label, "server", "deadline"))]
+        ac = rspec.admissionControl
+        if ac is not None:
+            try:
+                filters.append(AdmissionControlFilter(
+                    ac.maxConcurrency, ac.maxPending,
+                    self.metrics.scope("rt", label, "server",
+                                       "admission")))
+            except ValueError as e:
+                raise ConfigError(
+                    f"{label}.admissionControl: {e}") from None
+        return filters
 
     def _client_stack_extras(self, cspec: "ClientSpec", label: str,
                              cid: str):
@@ -1216,6 +1277,8 @@ class Linker:
             filters: List[Any] = [
                 StatsFilter(metrics, "rt", label, "client", cid),
                 DstHeadersFilter(cid),
+                # re-encode the clamped deadline for the next hop
+                ClientDeadlineFilter(),
             ]
             filters.extend(extra_filters)
             # per-router logger plugin chain, client-stack position
@@ -1256,8 +1319,13 @@ class Linker:
             name = dst.path.show.lstrip("/").replace("/", ".") or "root"
             filters: List[Any] = [
                 StatsFilter(metrics, "rt", label, "service", name)]
-            if sspec.totalTimeoutMs is not None:
-                filters.append(TotalTimeout(sspec.totalTimeoutMs / 1e3))
+            # DeadlineFilter subsumes TotalTimeout: enforces
+            # min(l5d-ctx-deadline, now + totalTimeoutMs), rejects
+            # already-expired work before dispatch, and its clamped
+            # deadline bounds the retry loop below
+            filters.append(DeadlineFilter(
+                sspec.totalTimeoutMs / 1e3
+                if sspec.totalTimeoutMs is not None else None))
             filters.append(ClassifiedRetries(
                 classifier, budget, mk_backoffs(sspec),
                 max_retries=(sspec.retries.maxRetries if sspec.retries else 25),
@@ -1324,6 +1392,8 @@ class Linker:
                     f"{label}.addForwardedHeader: {e}") from None
             server_filters.append(AddForwardedHeaderFilter(by, for_))
         server_filters.append(ErrorResponder())
+        # INSIDE the responder: their raises must map to 504/503
+        server_filters.extend(self._edge_resilience_filters(rspec, label))
         server_stack = filters_to_service(server_filters, routing)
 
         per_server_stack = self._per_server_stack_fn(

@@ -20,7 +20,9 @@ from typing import List, Optional
 
 from linkerd_tpu.config import register
 from linkerd_tpu.protocol.h2.messages import H2Request, H2Response
-from linkerd_tpu.protocol.h2.stream import Trailers
+from linkerd_tpu.protocol.h2.stream import (
+    RST_REFUSED_STREAM, StreamReset, Trailers,
+)
 from linkerd_tpu.router.classifiers import (
     IDEMPOTENT_METHODS, READ_METHODS, ResponseClass,
 )
@@ -61,6 +63,14 @@ def _grpc_code(rsp: Optional[H2Response],
         return None
 
 
+def _refused(exc: Optional[BaseException]) -> bool:
+    """RST_STREAM REFUSED_STREAM: the peer never processed the stream
+    (RFC 7540 §8.1.4 explicitly blesses retrying it), so refusal is
+    retryable regardless of method idempotence."""
+    return (isinstance(exc, StreamReset)
+            and exc.error_code == RST_REFUSED_STREAM)
+
+
 class _StatusClassifier(H2Classifier):
     """HTTP-status based classification; retryability by method policy."""
 
@@ -78,6 +88,8 @@ class _StatusClassifier(H2Classifier):
 
     def classify(self, req, rsp, trailers, exc):
         if exc is not None:
+            if _refused(exc):
+                return ResponseClass.RETRYABLE_FAILURE
             return (ResponseClass.RETRYABLE_FAILURE
                     if req.method in self._retryable
                     else ResponseClass.FAILURE)
@@ -158,8 +170,11 @@ class _GrpcClassifier(H2Classifier):
 
     def classify(self, req, rsp, trailers, exc):
         if exc is not None:
-            return (ResponseClass.RETRYABLE_FAILURE if self._always
-                    else ResponseClass.FAILURE)
+            if self._never:
+                return ResponseClass.FAILURE
+            if self._always or _refused(exc):
+                return ResponseClass.RETRYABLE_FAILURE
+            return ResponseClass.FAILURE
         code = _grpc_code(rsp, trailers)
         if code is None:
             # not gRPC: treat like HTTP status

@@ -12,7 +12,9 @@ import logging
 from typing import Optional
 
 from linkerd_tpu.protocol.h2.connection import H2Connection
+from linkerd_tpu.protocol.h2.frames import REFUSED_STREAM
 from linkerd_tpu.protocol.h2.messages import H2Request, H2Response
+from linkerd_tpu.protocol.h2.stream import StreamReset
 from linkerd_tpu.router.service import Service
 
 log = logging.getLogger(__name__)
@@ -230,10 +232,19 @@ class H2Server:
         try:
             if self._sem is not None:
                 if self._sem.locked():
-                    return H2Response(status=503, body=b"too many requests")
+                    # shed with a RETRYABLE signal: RST_STREAM
+                    # REFUSED_STREAM tells the peer the stream was never
+                    # processed (not a synthesized 503 body the client
+                    # can't distinguish from an app error)
+                    raise StreamReset(REFUSED_STREAM,
+                                      "server concurrency limit")
                 async with self._sem:
                     return await self.service(req)
             return await self.service(req)
+        except StreamReset:
+            # surfaces as an RST_STREAM frame (_serve_stream), keeping
+            # the refusal's error code on the wire
+            raise
         except Exception as e:  # noqa: BLE001 — last-resort responder
             log.debug("h2 service error: %r", e)
             return H2Response(status=502, body=repr(e).encode())

@@ -1,0 +1,70 @@
+"""Admission control: bounded concurrency + bounded pending queue.
+
+Reference parity: finagle's RequestSemaphoreFilter as ServerConfig's
+``maxConcurrentRequests`` installs it (Server.scala:89-97), extended the
+way the reference deployments actually run it — with a small wait queue
+in front so short bursts absorb instead of shedding, and a RETRYABLE
+shed signal so edge routers re-dispatch safely: http sheds surface as
+503 + ``l5d-retryable: true`` (ErrorResponder), h2/gRPC sheds surface as
+``RST_STREAM REFUSED_STREAM`` (H2ErrorResponder), which clients treat as
+safe-to-retry because the request was never admitted.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from linkerd_tpu.router.service import Filter, Service
+
+
+class OverloadShed(Exception):
+    """The request was refused by admission control before any work
+    happened — safe to retry elsewhere."""
+
+
+class AdmissionControlFilter(Filter):
+    """At most ``max_concurrency`` requests dispatch concurrently; up to
+    ``max_pending`` more may queue for a slot; beyond that the request
+    is shed with OverloadShed. One instance per router (the bound is a
+    router property, shared across its servers)."""
+
+    def __init__(self, max_concurrency: int, max_pending: int = 0,
+                 metrics_node=None):
+        if max_concurrency < 1:
+            raise ValueError("max_concurrency must be >= 1")
+        if max_pending < 0:
+            raise ValueError("max_pending must be >= 0")
+        self.max_concurrency = max_concurrency
+        self.max_pending = max_pending
+        self._sem = asyncio.Semaphore(max_concurrency)
+        self._inflight = 0
+        self._pending = 0
+        if metrics_node is not None:
+            self._shed = metrics_node.counter("shed_total")
+            metrics_node.gauge("inflight", fn=lambda: float(self._inflight))
+            metrics_node.gauge("pending", fn=lambda: float(self._pending))
+        else:
+            self._shed = None
+
+    async def apply(self, req, service: Service):
+        if self._sem.locked():
+            if self._pending >= self.max_pending:
+                if self._shed is not None:
+                    self._shed.incr()
+                raise OverloadShed(
+                    f"admission control: {self.max_concurrency} in flight "
+                    f"+ {self.max_pending} pending; shedding")
+            self._pending += 1
+            try:
+                await self._sem.acquire()
+            finally:
+                self._pending -= 1
+        else:
+            await self._sem.acquire()
+        self._inflight += 1
+        try:
+            return await service(req)
+        finally:
+            self._inflight -= 1
+            self._sem.release()

@@ -16,11 +16,14 @@ from typing import Iterable, List, Optional, Tuple
 from linkerd_tpu.protocol.h2.classifiers import H2Classifier
 from linkerd_tpu.protocol.h2.messages import H2Request, H2Response, Headers
 from linkerd_tpu.protocol.h2.stream import (
-    BufferedStream, DataFrame, H2Stream, StreamReset, Trailers,
+    RST_REFUSED_STREAM, BufferedStream, DataFrame, H2Stream, StreamReset,
+    Trailers,
 )
+from linkerd_tpu.router.admission import OverloadShed
 from linkerd_tpu.router.balancer import NoBrokersAvailable
 from linkerd_tpu.router.binding import BindingFailed, UnboundError
 from linkerd_tpu.router.classifiers import ResponseClass
+from linkerd_tpu.router.deadline import deadline_of
 from linkerd_tpu.router.retries import RetryBudget
 from linkerd_tpu.router.routing import IdentificationError
 from linkerd_tpu.router.service import Filter, Service
@@ -252,6 +255,7 @@ class H2ClassifiedRetries(Filter[H2Request, H2Response]):
                 else MetricsTree().scope("retries"))
         self._retry_count = node.counter("total")
         self._budget_exhausted = node.counter("budget_exhausted")
+        self._deadline_skipped = node.counter("deadline_skipped")
 
     def _replayed(self, req: H2Request, stream) -> H2Request:
         clone = H2Request(method=req.method, path=req.path,
@@ -311,11 +315,17 @@ class H2ClassifiedRetries(Filter[H2Request, H2Response]):
             req.ctx["response_class"] = rc
             if not rc.is_retryable or not retry_possible:
                 break
+            pause = self._backoffs[attempt]
+            dl = deadline_of(req)
+            if dl is not None and pause >= dl.remaining_s():
+                # backoff would overrun the propagated deadline budget:
+                # forfeit the retry, serve the classified outcome
+                self._deadline_skipped.incr()
+                break
             if not self._budget.try_withdraw():
                 self._budget_exhausted.incr()
                 break
             buffered.unfork(fork)  # abandoned attempt
-            pause = self._backoffs[attempt]
             attempt += 1
             self._retry_count.incr()
             if pause > 0:
@@ -352,7 +362,15 @@ class H2ClearContextFilter(Filter[H2Request, H2Response]):
 
 class H2ErrorResponder(Filter[H2Request, H2Response]):
     """Maps routing/dispatch failures to h2 responses with ``l5d-err``
-    (ref: linkerd/protocol/h2 ErrorReseter + LinkerdHeaders err)."""
+    (ref: linkerd/protocol/h2 ErrorReseter + LinkerdHeaders err).
+
+    Routing and shed failures do NOT synthesize a 502 body: they raise
+    ``StreamReset(REFUSED_STREAM)``, which the h2 server turns into an
+    ``RST_STREAM REFUSED_STREAM`` frame (ref: ErrorReseter.scala:14-31)
+    — gRPC clients observe UNAVAILABLE and edge linkerds retry safely,
+    because a refused stream was never processed. Deadline expiry on a
+    gRPC request answers Trailers-Only ``grpc-status: 4``
+    (DEADLINE_EXCEEDED) instead of an opaque 504."""
 
     ERR_HEADER = "l5d-err"
 
@@ -364,15 +382,45 @@ class H2ErrorResponder(Filter[H2Request, H2Response]):
         except UnboundError as e:
             return self._err(400, f"no binding: {e}")
         except (BindingFailed, NoBrokersAvailable) as e:
-            return self._err(502, f"binding failed: {e}")
+            raise StreamReset(RST_REFUSED_STREAM,
+                              f"binding failed: {e}") from None
+        except OverloadShed as e:
+            raise StreamReset(RST_REFUSED_STREAM,
+                              f"overloaded: {e}") from None
         except StreamReset as e:
+            if e.error_code == RST_REFUSED_STREAM:
+                raise  # propagate refusal so the edge retries
             return self._err(502, f"stream reset: {e}")
         except ConnectionError as e:
             return self._err(502, f"connection failed: {e}")
         except TimeoutError as e:
+            if _is_grpc(req):
+                return _grpc_deadline_exceeded(req, e)
             return self._err(504, f"timeout: {e}")
 
     def _err(self, status: int, msg: str) -> H2Response:
         rsp = H2Response(status=status, body=msg.encode())
         rsp.headers.set(self.ERR_HEADER, msg.replace("\n", " ")[:512])
         return rsp
+
+
+def _is_grpc(req: H2Request) -> bool:
+    ct = req.headers.get("content-type") or ""
+    return ct.startswith("application/grpc")
+
+
+def _grpc_deadline_exceeded(req: H2Request, exc: BaseException) -> H2Response:
+    """Trailers-Only gRPC error: HTTP 200 + grpc-status in the initial
+    HEADERS with END_STREAM (the shape gRPC clients require; a plain 504
+    surfaces as the opaque UNKNOWN instead of DEADLINE_EXCEEDED)."""
+    from linkerd_tpu.grpc.status import DEADLINE_EXCEEDED, GrpcStatus
+
+    dl = deadline_of(req)
+    detail = (f"deadline expired {-dl.remaining_s() * 1e3:.0f}ms ago"
+              if dl is not None and dl.expired
+              else str(exc) or "request timed out")
+    rsp = H2Response(status=200, body=b"")
+    rsp.headers.set("content-type", "application/grpc")
+    for n, v in GrpcStatus(DEADLINE_EXCEEDED, detail).to_headers():
+        rsp.headers.set(n, v)
+    return rsp

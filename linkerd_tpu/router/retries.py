@@ -20,6 +20,7 @@ from typing import Iterable, Iterator, List, Optional
 
 from linkerd_tpu.protocol.http.message import Request, Response
 from linkerd_tpu.router.classifiers import Classifier, ResponseClass
+from linkerd_tpu.router.deadline import deadline_of
 from linkerd_tpu.router.service import Filter, Service
 from linkerd_tpu.telemetry.metrics import MetricsTree
 
@@ -110,6 +111,7 @@ class ClassifiedRetries(Filter[Request, Response]):
                 else MetricsTree().scope("retries"))
         self._retry_count = node.counter("total")
         self._budget_exhausted = node.counter("budget_exhausted")
+        self._deadline_skipped = node.counter("deadline_skipped")
 
     async def apply(self, req: Request, service: Service) -> Response:
         self._budget.deposit()
@@ -128,10 +130,17 @@ class ClassifiedRetries(Filter[Request, Response]):
             if not rc.is_retryable or attempt >= min(
                     self._max_retries, len(self._backoffs)):
                 break
+            pause = self._backoffs[attempt]
+            dl = deadline_of(req)
+            if dl is not None and pause >= dl.remaining_s():
+                # the backoff alone would overrun the propagated budget:
+                # serve the classified failure now instead of burning the
+                # caller's remaining time on a doomed attempt
+                self._deadline_skipped.incr()
+                break
             if not self._budget.try_withdraw():
                 self._budget_exhausted.incr()
                 break
-            pause = self._backoffs[attempt]
             attempt += 1
             self._retry_count.incr()
             if pause > 0:

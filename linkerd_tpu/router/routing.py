@@ -14,6 +14,7 @@ from typing import Awaitable, Callable, Optional
 
 from linkerd_tpu.core import Dtab, Path
 from linkerd_tpu.protocol.http.message import Request, Response
+from linkerd_tpu.router.admission import OverloadShed
 from linkerd_tpu.router.balancer import NoBrokersAvailable
 from linkerd_tpu.router.binding import (
     BindingFailed, DstBindingFactory, DstPath, UnboundError,
@@ -82,6 +83,12 @@ class ErrorResponder(Filter[Request, Response]):
             return self._err(400, f"no binding: {e}")
         except (BindingFailed, NoBrokersAvailable) as e:
             return self._err(502, f"binding failed: {e}")
+        except OverloadShed as e:
+            # retryable by contract: the request was never admitted, so
+            # an edge router may safely re-dispatch it elsewhere
+            rsp = self._err(503, f"overloaded: {e}")
+            rsp.headers.set("l5d-retryable", "true")
+            return rsp
         except ConnectionError as e:
             return self._err(502, f"connection failed: {e}")
         except TimeoutError as e:

@@ -43,17 +43,29 @@ log = logging.getLogger(__name__)
 
 
 class ScoreBoard:
-    """Per-dst anomaly scores: EWMA-smoothed, observable.
+    """Per-dst anomaly scores: EWMA-smoothed, observable, with a
+    staleness TTL.
 
     The Var publishes {dst_path: score}; failure-accrual policies and the
-    admin handler read it. Scores decay toward 0 when traffic stops.
+    admin handler read it. Scores decay toward 0 when traffic stops, and
+    — independently — go STALE when the scorer stops updating them (a
+    degraded scorer path must not pin accrual policies to an old anomaly
+    verdict): within ``ttl_s`` of the last update a score reads at full
+    strength, then decays linearly to neutral (0) over one further
+    ``ttl_s`` window. ``degraded`` is set by the telemeter while the
+    scorer breaker is open; anomaly-aware policies treat it as
+    "no signal" and fall back to their reference behavior.
     """
 
-    def __init__(self, alpha: float = 0.3):
+    def __init__(self, alpha: float = 0.3, ttl_s: Optional[float] = 30.0):
         self.alpha = alpha
+        self.ttl_s = ttl_s
         self.scores: Var[dict] = Var({})
+        self.degraded = False
+        self._updated: Dict[str, float] = {}
 
     def update_batch(self, dsts: List[str], scores: np.ndarray) -> None:
+        now = time.monotonic()
         cur = dict(self.scores.sample())
         per_dst: Dict[str, List[float]] = {}
         for dst, s in zip(dsts, scores):
@@ -62,10 +74,36 @@ class ScoreBoard:
             mean = sum(vals) / len(vals)
             prev = cur.get(dst, mean)
             cur[dst] = prev + self.alpha * (mean - prev)
+            self._updated[dst] = now
         self.scores.update(cur)
 
+    def _staleness_factor(self, dst: str, now: float) -> float:
+        if self.ttl_s is None:
+            return 1.0
+        updated = self._updated.get(dst)
+        if updated is None:
+            return 1.0  # pre-TTL boards (tests seed Var directly)
+        age = now - updated
+        if age <= self.ttl_s:
+            return 1.0
+        return max(0.0, 1.0 - (age - self.ttl_s) / self.ttl_s)
+
     def score_of(self, dst: str) -> float:
-        return self.scores.sample().get(dst, 0.0)
+        raw = self.scores.sample().get(dst, 0.0)
+        return raw * self._staleness_factor(dst, time.monotonic())
+
+    def effective_scores(self) -> Dict[str, float]:
+        """{dst: staleness-decayed score} — the policy-facing view."""
+        now = time.monotonic()
+        return {dst: s * self._staleness_factor(dst, now)
+                for dst, s in self.scores.sample().items()}
+
+    def anomaly_level(self) -> float:
+        """Mesh-wide anomaly level: max effective score, 0 while the
+        scorer path is degraded (no signal beats a stale signal)."""
+        if self.degraded:
+            return 0.0
+        return max(self.effective_scores().values(), default=0.0)
 
 
 class FeatureRecorder(Filter[Request, Response]):
@@ -490,6 +528,14 @@ class JaxAnomalyConfig:
     reconWeight: float = 0.7
     learningRate: float = 0.001
     sidecarAddress: Optional[str] = None  # host:port -> gRPC sidecar mode
+    # scorer-path resilience (sidecar mode): per-call deadline, breaker
+    # thresholds/probe backoffs, and the ScoreBoard staleness TTL (stale
+    # scores decay to neutral so a dead scorer can't pin accrual policy)
+    scoreTimeoutMs: int = 2000
+    breakerFailures: int = 3
+    breakerMinBackoffMs: int = 500
+    breakerMaxBackoffMs: int = 30000
+    scoreTtlSecs: float = 30.0
     # model lifecycle: checkpointing, shadow-eval promotion gating, drift
     # detection, restart restore (see linkerd_tpu/lifecycle/)
     lifecycle: Optional["LifecycleConfig"] = None
@@ -508,7 +554,7 @@ class JaxAnomalyTelemeter(Telemeter):
         self.cfg = cfg
         self.metrics = metrics
         self.ring: Deque = collections.deque(maxlen=cfg.ringCapacity)
-        self.board = ScoreBoard()
+        self.board = ScoreBoard(ttl_s=cfg.scoreTtlSecs)
         self._scorer = scorer
         self._stop = asyncio.Event()
         self._node = metrics.scope("anomaly")
@@ -516,6 +562,13 @@ class JaxAnomalyTelemeter(Telemeter):
         self._dropped = self._node.gauge("ring_depth", fn=lambda: len(self.ring))
         self._batches = self._node.counter("batches")
         self._train_loss = self._node.gauge("train_loss")
+        # degraded mode: 1 while the scorer path is failing (breaker
+        # open / calls erroring); the data plane keeps serving, scoring
+        # pauses, anomaly-aware policies fall back to reference behavior
+        self._degraded = self._node.gauge("degraded")
+        self._degraded.set(0.0)
+        self._score_failures = self._node.counter("score_failures")
+        self._dropped_batches = self._node.counter("dropped_batches")
         self._gauges: Dict[str, object] = {}
         self._batch_i = 0
         # model lifecycle: checkpoint store + promotion gate + drift
@@ -549,13 +602,29 @@ class JaxAnomalyTelemeter(Telemeter):
     def _ensure_scorer(self) -> Scorer:
         if self._scorer is None:
             if self.cfg.sidecarAddress:
+                from linkerd_tpu.telemetry.resilience import (
+                    CircuitBreaker, ResilientScorer,
+                )
                 from linkerd_tpu.telemetry.sidecar import GrpcScorerClient
-                self._scorer = GrpcScorerClient(self.cfg.sidecarAddress)
+                # the breaker + per-call deadline wrap OUTSIDE the
+                # client's own (compile-aware) gRPC deadlines: a hung
+                # sidecar costs one bounded call, then fails fast
+                self._scorer = ResilientScorer(
+                    GrpcScorerClient(self.cfg.sidecarAddress),
+                    call_timeout_s=self.cfg.scoreTimeoutMs / 1e3,
+                    breaker=CircuitBreaker(
+                        failures=self.cfg.breakerFailures,
+                        min_backoff_s=self.cfg.breakerMinBackoffMs / 1e3,
+                        max_backoff_s=self.cfg.breakerMaxBackoffMs / 1e3))
             else:
                 self._scorer = InProcessScorer(
                     learning_rate=self.cfg.learningRate,
                     recon_weight=self.cfg.reconWeight)
         return self._scorer
+
+    def _set_degraded(self, degraded: bool) -> None:
+        self._degraded.set(1.0 if degraded else 0.0)
+        self.board.degraded = degraded
 
     async def run(self) -> None:
         scorer = self._ensure_scorer()
@@ -575,7 +644,14 @@ class JaxAnomalyTelemeter(Telemeter):
         try:
             while not self._stop.is_set():
                 await asyncio.sleep(interval)
-                await self._drain_burst(scorer)
+                try:
+                    await self._drain_burst(scorer)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 — the drain loop must
+                    # outlive any scoring failure; drain_once already
+                    # downgraded scorer faults, so this is a last resort
+                    log.exception("anomaly drain failed; continuing")
                 if (self._lifecycle is not None
                         and lc_cfg.checkpointEveryS > 0
                         and time.monotonic() - last_cycle
@@ -630,7 +706,23 @@ class JaxAnomalyTelemeter(Telemeter):
             [0.0 if lab is None else 1.0 for _, lab in items],
             dtype=np.float32)
         x = featurize_batch(fvs)
-        scores = await scorer.score(x)
+        try:
+            scores = await scorer.score(x)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — graceful degradation:
+            # scoring is best-effort; a dead/hung scorer drops the batch
+            # (requests were never blocked on it) and flips degraded mode
+            self._score_failures.incr()
+            self._dropped_batches.incr()
+            if not self.board.degraded:
+                log.warning("anomaly scorer degraded "
+                            "(scoring paused, data plane unaffected): %r", e)
+            self._set_degraded(True)
+            return 0
+        if self.board.degraded:
+            log.info("anomaly scorer recovered; scoring resumed")
+        self._set_degraded(False)
         self._scored.incr(n)
         self._batches.incr()
         holdout = False
@@ -650,8 +742,17 @@ class JaxAnomalyTelemeter(Telemeter):
         self._batch_i += 1
         if (not holdout and self.cfg.trainEveryBatches
                 and self._batch_i % self.cfg.trainEveryBatches == 0):
-            loss = await scorer.fit(x, labels, mask)
-            self._train_loss.set(loss)
+            try:
+                loss = await scorer.fit(x, labels, mask)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — training is optional;
+                # a fit failure (it still feeds the shared breaker) must
+                # not take down scoring
+                self._score_failures.incr()
+                log.debug("online fit skipped (scorer failure): %r", e)
+            else:
+                self._train_loss.set(loss)
         return n
 
     def _publish_gauges(self) -> None:
@@ -687,7 +788,14 @@ class JaxAnomalyTelemeter(Telemeter):
             "live_step": getattr(self._scorer, "_step", None),
             "scorer": type(self._scorer).__name__
             if self._scorer is not None else None,
+            "degraded": bool(self.board.degraded),
         }
+        breaker = getattr(self._scorer, "breaker", None)
+        if breaker is not None:
+            out["breaker"] = {
+                "state": breaker.state,
+                "next_probe_in_s": round(breaker.next_probe_in_s(), 3),
+            }
         if self._lifecycle is not None:
             out.update(self._lifecycle.status())
         return out
@@ -753,8 +861,10 @@ class AnomalyFailureAccrualPolicy:
         self._backoffs = self._mk_backoffs()
 
     def _anomaly_level(self) -> float:
-        scores = self.board.scores.sample()
-        return max(scores.values(), default=0.0)
+        # staleness-decayed and degraded-aware: while the scorer path is
+        # down or its scores are stale, this reads 0 and the policy
+        # degrades to its reference `failures` threshold
+        return self.board.anomaly_level()
 
     def record_success(self) -> None:
         self._consecutive = 0

@@ -28,6 +28,12 @@ class FaultSpec:
     error_status: int = 503
     latency_ms: float = 0.0       # added latency
     latency_jitter_ms: float = 0.0
+    # chaos kinds (inactive at their zero values):
+    hang_s: float = 0.0            # hold the request this long before
+    #                                forwarding (a near-black-hole hop —
+    #                                upstream deadlines must fire first)
+    connection_reset: bool = False  # abort mid-request with a RST
+    trickle_bytes_per_s: float = 0.0  # slow-loris the response body
 
 
 class FaultInjector(Filter[Request, Response]):
@@ -57,6 +63,17 @@ class FaultInjector(Filter[Request, Response]):
         if not self.active:
             return self._label(await service(req), 0.0)
         spec = self.spec
+        if spec.connection_reset:
+            self.injected += 1
+            raise ConnectionResetError("injected fault: connection reset")
+        if spec.hang_s > 0:
+            self.injected += 1
+            await asyncio.sleep(spec.hang_s)
+            return self._label(await service(req), 1.0)
+        if spec.trickle_bytes_per_s > 0:
+            self.injected += 1
+            rsp = await service(req)
+            return self._label(self._trickled(rsp), 1.0)
         injected = False
         if spec.latency_ms > 0:
             delay = spec.latency_ms + self._rng.uniform(
@@ -70,6 +87,21 @@ class FaultInjector(Filter[Request, Response]):
         if injected:
             self.injected += 1
         return self._label(await service(req), 1.0 if injected else 0.0)
+
+    def _trickled(self, rsp: Response) -> Response:
+        """Re-body the response as a drip-fed chunked stream."""
+        body = rsp.body or b""
+        rate = self.spec.trickle_bytes_per_s
+        chunk = max(1, int(rate / 10) or 1)
+
+        async def drip():
+            for i in range(0, len(body), chunk):
+                yield body[i:i + chunk]
+                await asyncio.sleep(chunk / rate)
+
+        rsp.body = b""
+        rsp.body_stream = drip()
+        return rsp
 
 
 def auc(labels, scores) -> float:
@@ -92,6 +124,89 @@ def auc(labels, scores) -> float:
                 rank_sum += avg_rank
         i = j
     return (rank_sum - n_pos * (n_pos + 1) / 2.0) / (n_pos * n_neg)
+
+
+class BlackholeServer:
+    """Transport-level black hole: accepts TCP connections, reads and
+    discards forever, never writes a byte. The shape of a hung sidecar
+    or a partitioned downstream — connects succeed, requests vanish,
+    and only the caller's own deadline gets it unstuck. Chaos tests
+    point gRPC/HTTP clients here to prove those deadlines exist."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._server = None
+        self._writers: set = set()
+        self.connections = 0
+
+    @property
+    def bound_port(self) -> int:
+        assert self._server is not None, "server not started"
+        return self._server.sockets[0].getsockname()[1]
+
+    async def start(self) -> "BlackholeServer":
+        self._server = await asyncio.start_server(
+            self._on_conn, self.host, self.port)
+        return self
+
+    async def _on_conn(self, reader, writer) -> None:
+        self.connections += 1
+        self._writers.add(writer)
+        try:
+            while await reader.read(65536):
+                pass  # swallow and never answer
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self._writers.discard(writer)
+            writer.close()
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        for w in list(self._writers):
+            w.close()
+        if self._server is not None:
+            await self._server.wait_closed()
+
+
+class FaultScorer:
+    """Scorer wrapper driven by a mutable fault ``mode`` — the
+    in-process twin of a blackholed/crashing scorer sidecar:
+
+    - ``None``: pass through to the wrapped scorer
+    - ``"hang"``: never completes (a black-holed sidecar; the caller's
+      per-call deadline must fire)
+    - ``"error"``: immediate ConnectionError (a reset/refused sidecar)
+
+    Lifecycle hooks delegate untouched so the wrapper can stand in for
+    the real scorer anywhere in the telemeter."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.mode: Optional[str] = None
+        self.calls = 0
+
+    async def _gate(self, what: str) -> None:
+        self.calls += 1
+        if self.mode == "hang":
+            await asyncio.Event().wait()  # forever; cancellable
+        if self.mode == "error":
+            raise ConnectionError(f"injected scorer fault ({what})")
+
+    async def score(self, x):
+        await self._gate("score")
+        return await self.inner.score(x)
+
+    async def fit(self, x, labels, mask):
+        await self._gate("fit")
+        return await self.inner.fit(x, labels, mask)
+
+    def __getattr__(self, name):
+        if name == "inner":
+            raise AttributeError(name)
+        return getattr(self.inner, name)
 
 
 class WindowLabeler(Filter[Request, Response]):

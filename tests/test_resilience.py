@@ -424,6 +424,143 @@ namers:
         run(go())
 
 
+class TestFailFastProbeEdges:
+    """Probe-slot edge cases (ref FailFastFactory): a cancelled probe
+    must release the slot WITHOUT reviving, and concurrent failures
+    from one outage must not compound the backoff."""
+
+    def test_cancelled_probe_releases_slot_without_reviving(self):
+        from linkerd_tpu.router.failure_accrual import FailFastService
+        from linkerd_tpu.router.service import FnService, Status
+
+        async def go():
+            gate = asyncio.Event()
+            state = {"fail": True}
+
+            async def ep(req):
+                if state["fail"]:
+                    raise ConnectionError("refused")
+                await gate.wait()
+                return "ok"
+
+            svc = FailFastService(FnService(ep))
+            with pytest.raises(ConnectionError):
+                await svc("a")
+            assert svc.status is Status.BUSY
+            state["fail"] = False
+            svc._down_until = 0.0  # force-expire the backoff
+            probe = asyncio.ensure_future(svc("probe"))
+            await asyncio.sleep(0.01)
+            assert svc._probing  # the slot is held
+            probe.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await probe
+            # slot released, NOT revived: the endpoint is still marked
+            # down, and the (expired) deadline admits the next probe
+            assert not svc._probing
+            assert svc._down_until is not None
+            assert svc.status is Status.OPEN  # next probe may go
+            gate.set()  # let the next probe complete
+            assert await svc("b") == "ok"  # successful probe revives
+            assert svc._down_until is None
+
+        run(go())
+
+    def test_concurrent_failures_do_not_double_backoff(self):
+        from linkerd_tpu.router.failure_accrual import FailFastService
+        from linkerd_tpu.router.service import FnService
+
+        async def go():
+            gate = asyncio.Event()
+
+            async def ep(req):
+                await gate.wait()
+                raise ConnectionError("refused")
+
+            svc = FailFastService(FnService(ep))
+            t1 = asyncio.ensure_future(svc("a"))
+            t2 = asyncio.ensure_future(svc("b"))
+            await asyncio.sleep(0.01)
+            gate.set()  # one outage event fails both in-flight calls
+            for t in (t1, t2):
+                with pytest.raises(ConnectionError):
+                    await t
+            # both failures land, but the backoff stays at MIN: only a
+            # failed PROBE advances the schedule
+            assert svc._backoff_s == FailFastService._MIN_BACKOFF_S
+            assert svc._down_until is not None
+
+        run(go())
+
+    def test_failed_probe_advances_backoff_once(self):
+        from linkerd_tpu.router.failure_accrual import FailFastService
+        from linkerd_tpu.router.service import FnService
+
+        async def go():
+            async def ep(req):
+                raise ConnectionError("refused")
+
+            svc = FailFastService(FnService(ep))
+            with pytest.raises(ConnectionError):
+                await svc("a")  # down @ min backoff
+            svc._down_until = 0.0
+            with pytest.raises(ConnectionError):
+                await svc("probe")  # failed probe: doubles
+            assert svc._backoff_s == 2 * FailFastService._MIN_BACKOFF_S
+            down_until = svc._down_until
+            with pytest.raises(ConnectionError):
+                await svc("straggler")  # non-probe: no further advance
+            assert svc._backoff_s == 2 * FailFastService._MIN_BACKOFF_S
+            assert svc._down_until == down_until
+
+        run(go())
+
+
+class TestRequeueBudgetExhaustion:
+    def test_exhausted_budget_raises_and_counts(self):
+        from linkerd_tpu.router.retries import RequeueFilter
+        from linkerd_tpu.telemetry.metrics import MetricsTree
+
+        async def go():
+            calls = []
+
+            async def dead(req):
+                calls.append(1)
+                raise ConnectionError("refused")
+
+            metrics = MetricsTree()
+            node = metrics.scope("client")
+            budget = RetryBudget(ttl_s=1, min_retries_per_s=2,
+                                 percent_can_retry=0.0)
+            f = RequeueFilter(budget, metrics_scope=node)
+            with pytest.raises(ConnectionError):
+                await f.apply(Request(), FnService(dead))
+            # 1 initial + 2 budgeted requeues, then the budget is dry
+            assert len(calls) == 3
+            assert metrics.flatten()["client/requeues"] == 2
+
+        run(go())
+
+    def test_max_requeues_caps_before_budget(self):
+        from linkerd_tpu.router.retries import RequeueFilter
+
+        async def go():
+            calls = []
+
+            async def dead(req):
+                calls.append(1)
+                raise ConnectionError("refused")
+
+            budget = RetryBudget(ttl_s=10, min_retries_per_s=100,
+                                 percent_can_retry=0.0)
+            f = RequeueFilter(budget, max_requeues=3)
+            with pytest.raises(ConnectionError):
+                await f.apply(Request(), FnService(dead))
+            assert len(calls) == 4  # 1 initial + 3 requeues (cap)
+
+        run(go())
+
+
 class TestServerTimeout:
     def test_server_timeoutMs_504s_slow_service(self, tmp_path):
         """servers[].timeoutMs caps a request at the server edge (ref

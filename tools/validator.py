@@ -23,6 +23,14 @@ And runs the l5dlint static-analysis suite (tools/analysis) over the
 tree — non-zero exit on any unsuppressed finding:
 
     python tools/validator.py lint [path ...]
+
+And the chaos validation: boot the assembled linker with its anomaly
+scorer sidecar black-holed, assert the data plane keeps serving within
+its deadline budget, the ``anomaly/degraded`` gauge flips to 1, and —
+after swapping the black hole for a live sidecar — scoring recovers
+(gauge back to 0) within a breaker-probe interval:
+
+    python tools/validator.py chaos
 """
 
 from __future__ import annotations
@@ -50,6 +58,8 @@ PORTS = {
                "admin": 25990, "a": 25801, "b": 25802},
     "http":   {"http": 26180, "iface": 26180, "linkerd": 26140,
                "admin": 26990, "a": 26801, "b": 26802},
+    "chaos":  {"linkerd": 27140, "admin": 27990, "a": 27801,
+               "sidecar": 27321},
 }
 
 IFACE_YAML = {
@@ -224,6 +234,130 @@ admin:
         d_b.close()
 
 
+async def validate_chaos() -> None:
+    """Boot the REAL linkerd binary with its anomaly sidecar
+    black-holed, prove degradation is graceful and recovery automatic.
+    Prints one ``CHAOS {json}`` line with the measured windows (bench.py
+    folds it into detail.resilience)."""
+    import numpy as np
+
+    from linkerd_tpu.telemetry.sidecar import ScorerSidecar
+    from linkerd_tpu.testing.faults import BlackholeServer
+
+    ports = PORTS["chaos"]
+    work = tempfile.mkdtemp(prefix="l5d-validate-chaos-")
+    disco = os.path.join(work, "disco")
+    os.makedirs(disco)
+    d_a = await downstream("A", ports["a"])
+    with open(os.path.join(disco, "web"), "w") as f:
+        f.write(f"127.0.0.1 {ports['a']}\n")
+
+    hole = await BlackholeServer(port=ports["sidecar"]).start()
+
+    linkerd_yaml = os.path.join(work, "linkerd.yaml")
+    with open(linkerd_yaml, "w") as f:
+        f.write(f"""
+routers:
+- protocol: http
+  label: chaos
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  service:
+    totalTimeoutMs: 1000
+  admissionControl: {{maxConcurrency: 512, maxPending: 64}}
+  servers:
+  - port: {ports['linkerd']}
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+telemetry:
+- kind: io.l5d.jaxAnomaly
+  sidecarAddress: 127.0.0.1:{ports['sidecar']}
+  intervalMs: 20
+  trainEveryBatches: 0
+  scoreTimeoutMs: 200
+  breakerFailures: 1
+  breakerMinBackoffMs: 200
+  breakerMaxBackoffMs: 400
+  scoreTtlSecs: 2
+admin:
+  port: {ports['admin']}
+""")
+
+    def degraded() -> float:
+        _, _, body = http(
+            "GET", f"http://127.0.0.1:{ports['admin']}"
+                   f"/admin/metrics.json?q=anomaly")
+        return float(json.loads(body).get("anomaly/degraded", -1.0))
+
+    def route_ok() -> bool:
+        t0 = time.time()
+        st, _, body = http(
+            "GET", f"http://127.0.0.1:{ports['linkerd']}/",
+            headers={"Host": "web"})
+        took = time.time() - t0
+        assert took < 1.0, f"request took {took:.2f}s (> deadline budget)"
+        return st == 200 and body == b"A"
+
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    linkerd = None
+    sidecar = None
+    try:
+        linkerd = subprocess.Popen(
+            [sys.executable, "-m", "linkerd_tpu", linkerd_yaml],
+            env=env, cwd=work)
+        await wait_for(route_ok, 20, "chaos route to A")
+        print("validator[chaos]: data plane up (sidecar black-holed)")
+
+        # the drain loop hits the black hole; the degraded gauge must
+        # flip while traffic keeps succeeding inside its budget
+        t0 = time.time()
+        await wait_for(lambda: route_ok() and degraded() == 1.0,
+                       20, "anomaly/degraded flip")
+        degrade_s = time.time() - t0
+        for _ in range(10):
+            assert await asyncio.to_thread(route_ok)
+        print(f"validator[chaos]: degraded in {degrade_s:.2f}s, "
+              f"traffic still flows")
+
+        # fault clears: a live sidecar (stub scorer, no device) takes
+        # over the SAME port; a breaker probe must close the loop
+        await hole.close()
+
+        class _Stub:
+            async def score(self, x):
+                return np.zeros(len(x), np.float32)
+
+            async def fit(self, x, labels, mask):
+                return 0.0
+
+            def close(self):
+                pass
+
+        sidecar = await ScorerSidecar(
+            _Stub(), port=ports["sidecar"]).start()
+        t0 = time.time()
+        await wait_for(lambda: route_ok() and degraded() == 0.0,
+                       20, "anomaly recovery")
+        recover_s = time.time() - t0
+        print(f"validator[chaos]: recovered in {recover_s:.2f}s")
+        print("CHAOS " + json.dumps({
+            "degrade_s": round(degrade_s, 2),
+            "recover_s": round(recover_s, 2),
+        }))
+    finally:
+        if linkerd is not None:
+            linkerd.send_signal(signal.SIGTERM)
+            try:
+                linkerd.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                linkerd.kill()
+        if sidecar is not None:
+            await sidecar.close()
+        await hole.close()
+        d_a.close()
+
+
 def validate_checkpoints(dirs) -> int:
     """Verify each checkpoint store: per-file CRC + full decode, manifest
     agreement, lineage (parents known or recorded as pruned), orphaned
@@ -284,6 +418,10 @@ async def main() -> int:
                   file=sys.stderr)
             return 64
         return validate_checkpoints(args[1:])
+    if args and args[0] == "chaos":
+        await validate_chaos()
+        print("VALIDATOR PASS (chaos)")
+        return 0
     protocols = args or ["mesh", "thrift", "http"]
     for protocol in protocols:
         await validate(protocol)
