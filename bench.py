@@ -308,6 +308,28 @@ def static_analysis_bench() -> dict:
     }
 
 
+def semantic_check_bench() -> dict:
+    """l5dcheck wall time over every in-repo YAML fixture (via
+    ``tools/validator.py config``) — the semantic gate runs in tier-1,
+    so analyzer cost is tracked across rounds like l5dlint's."""
+    import subprocess
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")  # imports the linker, no device
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "tools/validator.py", "config"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    out: dict = {"wall_s": round(time.perf_counter() - t0, 2),
+                 "pass": proc.returncode == 0}
+    for line in proc.stdout.splitlines():
+        if line.startswith("CONFIGCHECK "):
+            out.update(json.loads(line[len("CONFIGCHECK "):]))
+    if proc.returncode != 0:
+        out["error"] = (proc.stderr or proc.stdout)[-300:]
+    return out
+
+
 def fault_auc_bench() -> dict:
     """Config 3 in-process: reuses this process's (TPU) device for the
     scorer, matching the telemeter's real serving path."""
@@ -433,6 +455,9 @@ def main() -> None:
     def ph_static() -> None:
         detail["static_analysis"] = static_analysis_bench()
 
+    def ph_semantic() -> None:
+        detail["semantic_check"] = semantic_check_bench()
+
     def ph_resilience() -> None:
         detail["resilience"] = resilience_bench()
 
@@ -445,6 +470,7 @@ def main() -> None:
         ("sharded_cpu8", ph_sharded),
         ("lifecycle", ph_lifecycle),
         ("static_analysis", ph_static),
+        ("semantic_check", ph_semantic),
         ("resilience", ph_resilience),
     ]
     for name, fn in phases:

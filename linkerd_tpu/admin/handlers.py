@@ -123,6 +123,47 @@ def mk_model_handler(linker: "Linker"):
     return handler
 
 
+def mk_config_check_handler(linker: "Linker"):
+    """``/config-check.json`` — l5dcheck semantic verification of the
+    live linker's parsed config (the same rules as ``python -m
+    tools.analysis check``, run against what this process actually
+    loaded). Findings are returned, never enforced: the linker is
+    already serving this config."""
+    async def handler(req: Request) -> Response:
+        import asyncio
+
+        def run():
+            # tools/ lives next to the linkerd_tpu package, not inside
+            # it — resolvable even when the process cwd is elsewhere
+            import os
+            import sys
+
+            import linkerd_tpu
+            root = os.path.dirname(os.path.dirname(
+                os.path.abspath(linkerd_tpu.__file__)))
+            if root not in sys.path:
+                sys.path.insert(0, root)
+            from tools.analysis.semantic import check_data, check_text
+            if linker.config_text is not None:
+                return check_text(linker.config_text, "<live-config>")
+            return check_data(linker.config_dict, "<live-config>")
+
+        try:
+            # symbolic delegation over a big dtab is CPU work; keep the
+            # event loop serving while it runs
+            findings = await asyncio.to_thread(run)
+        except Exception as e:  # noqa: BLE001 — analyzer bug != outage
+            return json_response({"error": repr(e)}, status=500)
+        unsuppressed = [f for f in findings if not f.suppressed]
+        return json_response({
+            "clean": not unsuppressed,
+            "findings": [f.to_dict() for f in unsuppressed],
+            "suppressed": [f.to_dict() for f in findings if f.suppressed],
+        })
+
+    return handler
+
+
 def mk_identifier_handler(linker: "Linker"):
     """``/identifier.json`` — run each http router's identifier against a
     synthetic request and show the resulting logical name (ref:
@@ -284,6 +325,7 @@ def linkerd_admin_handlers(linker: "Linker") -> List[Tuple[str, Any]]:
         ("/bound-names.json", mk_bound_names_handler(linker)),
         ("/anomaly.json", mk_anomaly_handler(linker)),
         ("/model.json", mk_model_handler(linker)),
+        ("/config-check.json", mk_config_check_handler(linker)),
         ("/identifier.json", mk_identifier_handler(linker)),
         ("/logging.json", logging_handler),
         ("/admin/pprof/profile", pprof_profile_handler),

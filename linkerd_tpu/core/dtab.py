@@ -17,6 +17,7 @@ Text syntax::
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass
 from typing import Iterable, List, Tuple
 
@@ -25,6 +26,13 @@ from linkerd_tpu.core.nametree import Alt, Leaf, NameTree, NEG, parse as parse_t
 
 
 WILDCARD = "*"
+
+# ``#`` at line start or after whitespace opens a to-end-of-line comment
+# (so l5dcheck suppressions and operator notes survive inside YAML block
+# scalars and fs dtab files); ``#`` directly after ``/`` is the
+# configured-namer path segment (``/#/io.l5d.fs``) and is never a
+# comment, nor is ``#/`` (a comment can't shadow a path continuation).
+_COMMENT_RE = re.compile(r"(?:^|(?<=\s))#(?!/).*")
 
 
 @dataclass(frozen=True)
@@ -79,7 +87,10 @@ class Dtab(Tuple[Dentry, ...]):
 
     @staticmethod
     def read(s: str) -> "Dtab":
-        """Parse ``;``-separated dentries (trailing ``;`` allowed)."""
+        """Parse ``;``-separated dentries (trailing ``;`` allowed).
+        ``#``-to-end-of-line comments are stripped first (see
+        ``_COMMENT_RE``)."""
+        s = "\n".join(_COMMENT_RE.sub("", line) for line in s.splitlines())
         dentries = []
         for part in s.split(";"):
             part = part.strip()

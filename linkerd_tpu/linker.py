@@ -414,9 +414,13 @@ class _FastPathRouter(Router):
 
 
 class Linker:
-    def __init__(self, spec: LinkerSpec, config_dict: Any = None):
+    def __init__(self, spec: LinkerSpec, config_dict: Any = None,
+                 config_text: Optional[str] = None):
         self.spec = spec
         self.config_dict = config_dict
+        # raw YAML when loaded from text: /config-check.json re-analyzes
+        # it with comment suppressions intact (the parsed dict loses them)
+        self.config_text = config_text
         self.metrics = MetricsTree()
         self.namers: List[Tuple[Path, Namer]] = []
         self.announcers: List[Tuple[Path, Any]] = []
@@ -1485,4 +1489,5 @@ class Linker:
 
 def load_linker(text: str) -> Linker:
     """Parse a YAML/JSON config into an (unstarted) Linker."""
-    return Linker(parse_linker_spec(text), parse_config(text))
+    return Linker(parse_linker_spec(text), parse_config(text),
+                  config_text=text)

@@ -55,6 +55,12 @@ class DException(DelegateTree):
 
 
 @dataclass(frozen=True)
+class DTooDeep(DException):
+    """Delegation exceeded MAX_DEPTH — a typed marker so consumers
+    (l5dcheck's cycle detection) never couple to the message wording."""
+
+
+@dataclass(frozen=True)
 class DLeaf(DelegateTree):
     bound: Optional[BoundName] = None
 
@@ -129,8 +135,8 @@ class Delegator:
     def _step(self, dtab: Dtab, path: Path, dentry: Optional[Dentry],
               depth: int) -> DelegateTree:
         if depth > MAX_DEPTH:
-            return DException(path, dentry,
-                              message=f"delegation deeper than {MAX_DEPTH}")
+            return DTooDeep(path, dentry,
+                            message=f"delegation deeper than {MAX_DEPTH}")
         if len(path) > 0 and path[0] == UTILITY_PREFIX:
             tree = utility_lookup(path)
             return self._graft(dtab, path, dentry, tree, depth)
@@ -161,12 +167,15 @@ class Delegator:
             return DDelegate(path, dentry,
                              child=self._step(dtab, nxt, None, depth + 1))
         if isinstance(tree, Alt):
+            # nested branches keep the originating dentry: every step of
+            # an Alt/Union produced by one rule must attribute to it
+            # (the delegator UI and l5dcheck walk terminals by dentry)
             return DAlt(path, dentry, children=tuple(
-                self._graft(dtab, path, None, t, depth)
+                self._graft(dtab, path, dentry, t, depth)
                 for t in tree.trees))
         if isinstance(tree, Union):
             return DUnion(path, dentry, weighted=tuple(
-                (w.weight, self._graft(dtab, path, None, w.tree, depth))
+                (w.weight, self._graft(dtab, path, dentry, w.tree, depth))
                 for w in tree.weighted))
         if isinstance(tree, Fail):
             return DFail(path, dentry)

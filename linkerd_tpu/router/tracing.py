@@ -89,7 +89,10 @@ class ServerTraceFilter(Filter[Request, Response]):
                 sampled = _rng.random() < self.sample_rate
             span = TraceId.mk_root(sampled)
         req.ctx["trace"] = span
-        t0 = time.time()
+        # wall clock for the reported span instant, monotonic for the
+        # measured duration (NTP steps must not produce negative spans)
+        ts_us = int(time.time() * 1e6)
+        t0 = time.monotonic()
         status = None
         try:
             rsp = await service(req)
@@ -105,8 +108,8 @@ class ServerTraceFilter(Filter[Request, Response]):
                                  if span.parent_id else None),
                     "kind": "SERVER",
                     "name": f"{req.method} {req.path}",
-                    "timestamp": int(t0 * 1e6),
-                    "duration": int((time.time() - t0) * 1e6),
+                    "timestamp": ts_us,
+                    "duration": int((time.monotonic() - t0) * 1e6),
                     "localEndpoint": {"serviceName": self.router_label},
                     "tags": {
                         "router.label": self.router_label,
@@ -132,7 +135,8 @@ class ClientTraceFilter(Filter[Request, Response]):
             return await service(req)
         child = span.child()
         req.headers.set(CTX_TRACE, child.encode())
-        t0 = time.time()
+        ts_us = int(time.time() * 1e6)
+        t0 = time.monotonic()
         status = None
         try:
             rsp = await service(req)
@@ -146,8 +150,8 @@ class ClientTraceFilter(Filter[Request, Response]):
                     "parentId": f"{child.parent_id:016x}",
                     "kind": "CLIENT",
                     "name": f"{req.method} {req.path}",
-                    "timestamp": int(t0 * 1e6),
-                    "duration": int((time.time() - t0) * 1e6),
+                    "timestamp": ts_us,
+                    "duration": int((time.monotonic() - t0) * 1e6),
                     "localEndpoint": {"serviceName": self.client_id},
                     "tags": {
                         "client.id": self.client_id,

@@ -25,9 +25,12 @@ INTERVAL_S = 3600.0
 
 
 def build_report(spec: Any, orgId: str, instance_id: str,
-                 start_time: float) -> Dict[str, Any]:
+                 start_time: float,
+                 uptime_s: float = 0.0) -> Dict[str, Any]:
     """Anonymized shape only: kinds and counts, never user values
-    (ref: UsageMessage fields in usage.proto)."""
+    (ref: UsageMessage fields in usage.proto). ``start_time`` is the
+    reported wall-clock instant; ``uptime_s`` is measured by the caller
+    on the monotonic clock (an NTP step must not skew it)."""
     routers = []
     for r in getattr(spec, "routers", []) or []:
         ids = r.identifier
@@ -48,7 +51,7 @@ def build_report(spec: Any, orgId: str, instance_id: str,
         "orgId": orgId,
         "linkerd_version": "tpu-0.1",
         "start_time": int(start_time),
-        "uptime_s": int(time.time() - start_time),
+        "uptime_s": int(uptime_s),
         "routers": routers,
         "namers": namers,
         "telemeters": telemeters,
@@ -67,7 +70,8 @@ class UsageDataTelemeter:
         self._port = port
         self._interval = interval_s
         self._instance_id = str(uuid.uuid4())
-        self._start = time.time()
+        self._start = time.time()        # reported instant (wall clock)
+        self._start_mono = time.monotonic()  # uptime measurement
         self.tracer = None
 
     def admin_handlers(self):
@@ -75,7 +79,8 @@ class UsageDataTelemeter:
 
     async def _post(self) -> None:
         body = json.dumps(build_report(
-            self._spec, self._orgId, self._instance_id, self._start)
+            self._spec, self._orgId, self._instance_id, self._start,
+            uptime_s=time.monotonic() - self._start_mono)
         ).encode()
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(

@@ -88,6 +88,59 @@ namers:
     asyncio.run(asyncio.wait_for(go(), 30))
 
 
+def test_config_check_endpoint(tmp_path):
+    """/config-check.json runs l5dcheck over the live linker's own
+    config — findings (here: a dentry to an unconfigured namer) come
+    back as JSON, clean flips to false."""
+    import asyncio
+    import json as _json
+
+    from linkerd_tpu.admin.handlers import mk_config_check_handler
+    from linkerd_tpu.linker import load_linker
+    from linkerd_tpu.protocol.http.message import Request
+
+    disco = tmp_path / "disco"
+    disco.mkdir()
+
+    async def go():
+        linker = load_linker(f"""
+routers:
+- protocol: http
+  label: checked
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+    /svc/ghost => /#/io.l5d.nothere ;
+  servers: [{{port: 0}}]
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+""")
+        handler = mk_config_check_handler(linker)
+        out = _json.loads((await handler(
+            Request(uri="/config-check.json"))).body)
+        assert out["clean"] is False
+        rules = {f["rule"] for f in out["findings"]}
+        assert "dtab-unbound" in rules
+        await linker.close()
+
+        clean = load_linker(f"""
+routers:
+- protocol: http
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers: [{{port: 0}}]
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+""")
+        out = _json.loads((await mk_config_check_handler(clean)(
+            Request(uri="/config-check.json"))).body)
+        assert out["clean"] is True and out["findings"] == []
+        await clean.close()
+
+    asyncio.run(asyncio.wait_for(go(), 30))
+
+
 class TestPprofHandlers:
     def test_profile_and_heap_capture(self):
         """/admin/pprof/profile + /heap return text captures of the live

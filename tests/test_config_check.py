@@ -1,0 +1,565 @@
+"""l5dcheck self-tests: every semantic rule fires on a planted defect
+and stays quiet on the matching clean config; YAML suppressions require
+justification; the CLI speaks exit codes + --format json; and the
+tier-1 gate — every YAML fixture the repo ships is clean.
+
+Defective configs are inline strings (they must never live as .yml
+files, or the gate itself would trip over them); the clean fixtures are
+the real files under tests/configs/ and examples/.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools.analysis.semantic import (
+    check_data, check_file, check_text, semantic_rule_ids,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NAMERS = """
+namers:
+- kind: io.l5d.fs
+  rootDir: disco
+"""
+
+
+def rules_of(findings, rule):
+    return [f for f in findings if f.rule == rule and not f.suppressed]
+
+
+def linker(dtab="", extra="", servers="  servers: [{port: 0}]"):
+    dtab_block = ""
+    if dtab:
+        indented = "\n".join(f"    {line}" for line in dtab.splitlines())
+        dtab_block = f"  dtab: |\n{indented}\n"
+    return (f"routers:\n- protocol: http\n{dtab_block}{servers}\n"
+            f"{extra}{NAMERS}")
+
+
+class TestDtabRules:
+    def test_shadowed_dentry_fires(self):
+        got = check_text(linker(
+            "/svc/web => /#/io.l5d.fs/web-v1 ;\n"
+            "/svc => /#/io.l5d.fs ;"))
+        (f,) = rules_of(got, "dtab-shadowed")
+        assert "/svc/web" in f.message and "shadowed" in f.message
+        # anchored on the shadowed dentry's own line
+        assert f.line == 4
+
+    def test_specific_after_general_is_not_shadowed(self):
+        got = check_text(linker(
+            "/svc => /#/io.l5d.fs ;\n"
+            "/svc/web => /#/io.l5d.fs/web-v1 ;"))
+        assert rules_of(got, "dtab-shadowed") == []
+
+    def test_later_entry_that_can_neg_does_not_shadow(self):
+        # the later general rule delegates to /nowhere (Neg), so the
+        # earlier specific rule still catches the fallthrough
+        got = check_text(linker(
+            "/svc/web => /#/io.l5d.fs/web ;\n"
+            "/svc => /nowhere ;"))
+        assert rules_of(got, "dtab-shadowed") == []
+
+    def test_delegation_cycle_fires(self):
+        got = check_text(linker("/svc => /svc ;"))
+        (f,) = rules_of(got, "dtab-cycle")
+        assert "MAX_DEPTH" in f.message
+
+    def test_two_dentry_cycle_fires(self):
+        got = check_text(linker(
+            "/a => /b ;\n/b => /a ;\n/svc => /#/io.l5d.fs ;"))
+        assert len(rules_of(got, "dtab-cycle")) == 2
+
+    def test_unbound_namer_prefix_fires(self):
+        got = check_text(linker(
+            "/svc => /#/io.l5d.zookeeper ;"))
+        (f,) = rules_of(got, "dtab-unbound")
+        assert "io.l5d.zookeeper" in f.message
+        assert "io.l5d.fs" in f.message  # names the configured prefixes
+
+    def test_unknown_utility_fires(self):
+        got = check_text(linker("/svc => /$/io.l5d.noSuchUtility ;"))
+        (f,) = rules_of(got, "dtab-unbound")
+        assert "utility" in f.message
+
+    def test_bound_namer_and_utilities_are_clean(self):
+        got = check_text(linker(
+            "/srv => /#/io.l5d.fs ;\n"
+            "/svc => /srv ;\n"
+            "/svc/die => /$/fail ;"))
+        for rule in ("dtab-unbound", "dtab-neg-only", "dtab-cycle",
+                     "dtab-shadowed"):
+            assert rules_of(got, rule) == [], rule
+
+    def test_neg_only_dentry_fires(self):
+        got = check_text(linker(
+            "/orphan => /nowhere/bound ;\n/svc => /#/io.l5d.fs ;"))
+        (f,) = rules_of(got, "dtab-neg-only")
+        assert "/orphan" in f.message
+
+    def test_weight_zero_union_branch_fires(self):
+        got = check_text(linker(
+            "/svc => 0.0 * /#/io.l5d.fs/a & 1.0 * /#/io.l5d.fs/b ;"))
+        (f,) = rules_of(got, "dtab-dead-branch")
+        assert "weight-zero" in f.message
+
+    def test_alt_after_fail_fires(self):
+        got = check_text(linker(
+            "/svc => ! | /#/io.l5d.fs ;"))
+        (f,) = rules_of(got, "dtab-dead-branch")
+        assert "unreachable" in f.message
+
+    def test_dtab_syntax_error_fires(self):
+        got = check_text(linker("/svc /#/io.l5d.fs ;"))
+        assert rules_of(got, "dtab-syntax")
+
+    def test_finding_anchors_to_exact_dentry_line(self):
+        # '/svc' must anchor to ITS line, not the earlier '/svc/web'
+        # line that merely contains '/svc' as a substring — and a waiver
+        # trailing the unrelated dentry must not suppress it
+        got = check_text(linker(
+            "/svc/web => /#/io.l5d.fs/web ;"
+            "  # l5d: ignore[dtab-unbound] — wrong dentry on purpose\n"
+            "/svc => /#/io.l5d.nowhere ;"))
+        (f,) = rules_of(got, "dtab-unbound")
+        assert f.line == 5 and not f.suppressed
+
+    def test_same_prefix_dentries_anchor_to_distinct_lines(self):
+        # two '/svc => ...' dentries: a waiver trailing the FIRST must
+        # not cover the second's (still-real) finding
+        got = check_text(linker(
+            "/svc => /#/io.l5d.missing ;"
+            "  # l5d: ignore[dtab-unbound] — first dentry only\n"
+            "/svc => /#/io.l5d.alsomissing ;"))
+        unbound = [f for f in got if f.rule == "dtab-unbound"]
+        assert len(unbound) == 2
+        by_sup = {f.suppressed for f in unbound}
+        assert by_sup == {True, False}
+        live = next(f for f in unbound if not f.suppressed)
+        assert "alsomissing" in live.message and live.line == 5
+
+    def test_subpath_only_dtab_covers_dst_prefix(self):
+        # routing only specific subpaths (no '/svc' catch-all) is a
+        # legitimate linkerd pattern: /svc/web requests bind fine
+        got = check_text(linker("/svc/web => /#/io.l5d.fs/web ;"))
+        assert rules_of(got, "router-dst-uncovered") == []
+
+
+class TestRouterRules:
+    def test_port_conflict_fires(self):
+        cfg = f"""
+routers:
+- protocol: http
+  label: a
+  dtab: "/svc => /#/io.l5d.fs;"
+  servers: [{{port: 4140}}]
+- protocol: http
+  label: b
+  dtab: "/svc => /#/io.l5d.fs;"
+  servers: [{{port: 4140}}]
+{NAMERS}"""
+        got = check_text(cfg)
+        (f,) = rules_of(got, "router-port-conflict")
+        assert "4140" in f.message and "already taken" in f.message
+
+    def test_admin_port_conflict_fires(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;",
+                     servers="  servers: [{port: 9990}]",
+                     extra="admin:\n  port: 9990\n")
+        got = check_text(cfg)
+        assert rules_of(got, "router-port-conflict")
+
+    def test_wildcard_ip_conflicts_with_loopback(self):
+        # 0.0.0.0 claims every interface: same port on 127.0.0.1 is
+        # EADDRINUSE at startup even though the ip strings differ
+        cfg = f"""
+routers:
+- protocol: http
+  dtab: "/svc => /#/io.l5d.fs;"
+  servers: [{{ip: 0.0.0.0, port: 9990}}]
+{NAMERS}admin:
+  port: 9990
+"""
+        (f,) = rules_of(check_text(cfg), "router-port-conflict")
+        assert "9990" in f.message
+
+    def test_distinct_ports_are_clean(self):
+        cfg = f"""
+routers:
+- protocol: http
+  dtab: "/svc => /#/io.l5d.fs;"
+  servers: [{{port: 4140}}, {{port: 4141}}]
+{NAMERS}"""
+        assert rules_of(check_text(cfg), "router-port-conflict") == []
+
+    def test_per_try_above_total_fires(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  service:\n    totalTimeoutMs: 500\n"
+            "  client:\n    requestAttemptTimeoutMs: 900\n"))
+        got = check_text(cfg)
+        (f,) = rules_of(got, "timeout-inversion")
+        assert "900" in f.message and "500" in f.message
+
+    def test_per_try_below_total_is_clean(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  service:\n    totalTimeoutMs: 2000\n"
+            "  client:\n    requestAttemptTimeoutMs: 500\n"))
+        assert rules_of(check_text(cfg), "timeout-inversion") == []
+
+    def test_dst_prefix_uncovered_fires(self):
+        got = check_text(linker("/other => /#/io.l5d.fs ;"))
+        (f,) = rules_of(got, "router-dst-uncovered")
+        assert "/svc" in f.message
+
+    def test_remote_interpreter_skips_coverage(self):
+        cfg = f"""
+routers:
+- protocol: http
+  interpreter:
+    kind: io.l5d.namerd
+    dst: /$/inet/127.0.0.1/4100
+    namespace: default
+  servers: [{{port: 0}}]
+{NAMERS}"""
+        assert rules_of(check_text(cfg), "router-dst-uncovered") == []
+
+    def test_starved_retry_budget_fires(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  service:\n    retries:\n      budget:\n"
+            "        percentCanRetry: 0\n        minRetriesPerSec: 0\n"))
+        (f,) = rules_of(check_text(cfg), "retry-starved")
+        assert "never earns a token" in f.message
+
+    def test_zero_max_retries_fires(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  service:\n    retries:\n      maxRetries: 0\n"))
+        assert rules_of(check_text(cfg), "retry-starved")
+
+    def test_findings_anchor_within_their_router_block(self):
+        # routers[1]'s bad retries must not anchor onto routers[0]'s
+        # healthy 'retries' line (suppressions would misbind)
+        cfg = f"""
+routers:
+- protocol: http
+  dtab: "/svc => /#/io.l5d.fs;"
+  servers: [{{port: 0}}]
+  service:
+    retries:
+      budget: {{percentCanRetry: 0.2}}
+- protocol: http
+  dtab: "/svc => /#/io.l5d.fs;"
+  servers: [{{port: 0}}]
+  service:
+    retries:
+      maxRetries: 0
+{NAMERS}"""
+        (f,) = rules_of(check_text(cfg), "retry-starved")
+        assert f.line == 13  # the SECOND router's retries line
+
+    def test_admission_bounds_fire(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  admissionControl:\n    maxConcurrency: 0\n"))
+        (f,) = rules_of(check_text(cfg), "admission-deadline")
+        assert "maxConcurrency" in f.message
+
+    def test_deep_queue_vs_deadline_warns(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  service:\n    totalTimeoutMs: 200\n"
+            "  admissionControl:\n"
+            "    maxConcurrency: 2\n    maxPending: 1000\n"))
+        (f,) = rules_of(check_text(cfg), "admission-deadline")
+        assert f.severity == "warning" and "deadline budget" in f.message
+
+    def test_missing_tls_certs_fire(self, tmp_path):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  client:\n    tls:\n      commonName: svc.example.com\n"
+            "      trustCerts: [no-such-ca.pem]\n"))
+        got = check_text(cfg, base_dir=str(tmp_path))
+        (f,) = rules_of(got, "tls-missing-cert")
+        assert "no-such-ca.pem" in f.message
+
+    def test_existing_tls_certs_are_clean(self, tmp_path):
+        (tmp_path / "ca.pem").write_text("x")
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  client:\n    tls:\n      commonName: svc.example.com\n"
+            "      trustCerts: [ca.pem]\n"))
+        got = check_text(cfg, base_dir=str(tmp_path))
+        assert rules_of(got, "tls-missing-cert") == []
+
+
+class TestRegistryCrossCheck:
+    def test_unknown_kind_fires_with_known_list(self):
+        cfg = """
+routers:
+- protocol: http
+  dtab: "/svc => /#/io.l5d.fs;"
+  servers: [{port: 0}]
+namers:
+- kind: io.l5d.nope
+  rootDir: disco
+"""
+        (f,) = rules_of(check_text(cfg), "config-kind")
+        assert "io.l5d.nope" in f.message and "io.l5d.fs" in f.message
+
+    def test_unknown_field_fires(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "telemetry:\n- kind: io.l5d.prometheus\n  bogus: 1\n"))
+        (f,) = rules_of(check_text(cfg), "config-kind")
+        assert "bogus" in f.message
+
+    def test_identifier_on_thrift_router_warns(self):
+        cfg = f"""
+routers:
+- protocol: thrift
+  dtab: "/svc => /#/io.l5d.fs;"
+  identifier: {{kind: io.l5d.header.token}}
+  servers: [{{port: 0}}]
+{NAMERS}"""
+        (f,) = rules_of(check_text(cfg), "config-kind")
+        assert "ignored" in f.message and f.severity == "warning"
+
+
+class TestScorerRules:
+    def test_ring_below_batch_fires(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "telemetry:\n- kind: io.l5d.jaxAnomaly\n"
+            "  maxBatch: 100\n  ringCapacity: 10\n"))
+        (f,) = rules_of(check_text(cfg), "scorer-config")
+        assert "ringCapacity" in f.message
+
+    def test_gate_threshold_ranges_fire(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "telemetry:\n- kind: io.l5d.jaxAnomaly\n"
+            "  lifecycle:\n    directory: var/ckpt\n"
+            "    aucTolerance: 1.5\n"
+            "    minReplayRows: 5000\n    replayCapacity: 100\n"))
+        got = rules_of(check_text(cfg), "scorer-config")
+        msgs = " ".join(f.message for f in got)
+        assert "aucTolerance" in msgs and "minReplayRows" in msgs
+
+    def test_breaker_backoff_inversion_fires(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "telemetry:\n- kind: io.l5d.jaxAnomaly\n"
+            "  breakerMinBackoffMs: 5000\n  breakerMaxBackoffMs: 100\n"))
+        (f,) = rules_of(check_text(cfg), "scorer-config")
+        assert "backoff range is empty" in f.message
+
+    def test_valid_scorer_block_is_clean(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "telemetry:\n- kind: io.l5d.jaxAnomaly\n"
+            "  maxBatch: 256\n  ringCapacity: 4096\n"))
+        assert rules_of(check_text(cfg), "scorer-config") == []
+
+    @pytest.mark.slow
+    def test_checkpoint_width_mismatch_fires(self, tmp_path):
+        import numpy as np
+
+        from linkerd_tpu.lifecycle import CheckpointStore, ModelSnapshot
+        from linkerd_tpu.models.anomaly import AnomalyModelConfig
+
+        cfg7 = AnomalyModelConfig(in_dim=7)
+        snap = ModelSnapshot(
+            params={"w": np.zeros((7, 2), np.float32)}, opt_leaves=[],
+            mu=np.zeros(7, np.float32), var=np.ones(7, np.float32),
+            norm_initialized=True, step=1, cfg=cfg7)
+        CheckpointStore(str(tmp_path / "ckpt")).save(snap,
+                                                     status="promoted")
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "telemetry:\n- kind: io.l5d.jaxAnomaly\n"
+            "  lifecycle:\n    directory: ckpt\n"))
+        got = check_text(cfg, base_dir=str(tmp_path))
+        (f,) = rules_of(got, "scorer-width")
+        assert "in_dim=7" in f.message and "FEATURE_DIM=36" in f.message
+
+
+class TestNamerdConfigs:
+    def test_namespace_dtab_is_analyzed(self):
+        cfg = """
+storage:
+  kind: io.l5d.inMemory
+  namespaces:
+    default: "/svc => /#/io.l5d.ghost;"
+interfaces:
+- kind: io.l5d.httpController
+  port: 4180
+namers:
+- kind: io.l5d.fs
+  rootDir: disco
+"""
+        (f,) = rules_of(check_text(cfg), "dtab-unbound")
+        assert "storage.namespaces[default]" in f.message
+
+    def test_iface_port_conflict_fires(self):
+        cfg = """
+storage: {kind: io.l5d.inMemory}
+interfaces:
+- kind: io.l5d.httpController
+  port: 4180
+- kind: io.l5d.mesh
+  port: 4180
+"""
+        assert rules_of(check_text(cfg), "router-port-conflict")
+
+
+class TestSuppressions:
+    BAD_DTAB = ("/svc/web => /#/io.l5d.fs/v1 ;{comment}\n"
+                "/svc => /#/io.l5d.fs ;")
+
+    def test_justified_suppression_suppresses(self):
+        got = check_text(linker(self.BAD_DTAB.format(
+            comment="  # l5d: ignore[dtab-shadowed] — canary, re-enabled"
+                    " via header dtab")))
+        shadows = [f for f in got if f.rule == "dtab-shadowed"]
+        assert len(shadows) == 1 and shadows[0].suppressed
+        assert "canary" in shadows[0].justification
+        assert not [f for f in got if f.rule == "suppression"]
+
+    def test_suppression_requires_justification(self):
+        got = check_text(linker(self.BAD_DTAB.format(
+            comment="  # l5d: ignore[dtab-shadowed]")))
+        shadows = [f for f in got if f.rule == "dtab-shadowed"]
+        assert len(shadows) == 1 and not shadows[0].suppressed
+        sup = [f for f in got if f.rule == "suppression"]
+        assert len(sup) == 1 and "justification" in sup[0].message
+
+    def test_trailing_suppression_does_not_leak_to_next_line(self):
+        # a waiver trailing one dentry must not silence the NEXT dentry
+        got = check_text(linker(
+            "/a => /#/io.l5d.fs ;"
+            "  # l5d: ignore[dtab-unbound] — wrong line on purpose\n"
+            "/ghost => /#/io.l5d.nowhere ;\n"
+            "/svc => /#/io.l5d.fs ;"))
+        unbound = [f for f in got if f.rule == "dtab-unbound"]
+        assert len(unbound) == 1 and not unbound[0].suppressed
+
+    def test_unknown_semantic_rule_is_reported(self):
+        got = check_text(linker(
+            "/svc => /#/io.l5d.fs ;"
+            "  # l5d: ignore[no-such-rule] — because"))
+        sup = [f for f in got if f.rule == "suppression"]
+        assert len(sup) == 1 and "unknown semantic rule" in sup[0].message
+
+
+class TestCheckData:
+    def test_parsed_dict_path_works(self):
+        # the admin /config-check.json path: no text, no suppressions
+        data = {"routers": [{"protocol": "http",
+                             "dtab": "/svc => /#/io.l5d.nope;",
+                             "servers": [{"port": 0}]}],
+                "namers": [{"kind": "io.l5d.fs", "rootDir": "d"}]}
+        got = check_data(data, "<live>")
+        assert rules_of(got, "dtab-unbound")
+
+
+class TestCli:
+    def run_cli(self, *args, cwd=REPO):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+        return subprocess.run(
+            [sys.executable, "-m", "tools.analysis", *args],
+            capture_output=True, text=True, timeout=120, env=env, cwd=cwd)
+
+    def test_check_clean_config_exits_zero(self):
+        p = self.run_cli("check", "tests/configs/linker-http.yml")
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert "l5dcheck: 0 finding(s)" in p.stdout
+
+    def test_check_bad_config_exits_one(self, tmp_path):
+        bad = tmp_path / "bad.yml"
+        bad.write_text(
+            "routers:\n- protocol: http\n"
+            "  dtab: '/svc => /#/io.l5d.ghost;'\n"
+            "  servers: [{port: 0}]\n"
+            "namers:\n- kind: io.l5d.fs\n  rootDir: d\n")
+        p = self.run_cli("check", str(bad))
+        assert p.returncode == 1
+        assert "dtab-unbound" in p.stdout
+
+    def test_check_missing_file_exits_two(self):
+        p = self.run_cli("check", "no/such/file.yml")
+        assert p.returncode == 2
+
+    def test_check_no_args_exits_two(self):
+        p = self.run_cli("check")
+        assert p.returncode == 2
+
+    def test_check_format_json(self, tmp_path):
+        bad = tmp_path / "bad.yml"
+        bad.write_text(
+            "routers:\n- protocol: http\n"
+            "  dtab: '/svc => /#/io.l5d.ghost;'\n"
+            "  servers: [{port: 0}]\n"
+            "namers:\n- kind: io.l5d.fs\n  rootDir: d\n")
+        p = self.run_cli("check", str(bad), "--format", "json")
+        assert p.returncode == 1
+        out = json.loads(p.stdout)
+        assert out["mode"] == "check"
+        assert out["suppressed_count"] == 0
+        (f,) = [x for x in out["unsuppressed"]
+                if x["rule"] == "dtab-unbound"]
+        assert f["line"] == 3 and f["severity"] == "error"
+        assert "dtab-unbound" in out["rules"]
+
+    def test_lint_format_json_still_works(self):
+        p = self.run_cli("lint", "tools/analysis/semantic",
+                         "--format", "json")
+        # no python files under scan fail; shape is the contract here
+        out = json.loads(p.stdout)
+        assert out["mode"] == "lint" and "wall_s" in out
+
+    def test_list_rules_covers_semantic_suite(self):
+        p = self.run_cli("check", "--list-rules")
+        assert p.returncode == 0
+        for rule in ("dtab-shadowed", "dtab-cycle", "scorer-width"):
+            assert rule in p.stdout
+
+
+class TestRepoGate:
+    """Tier-1: every YAML config the repo ships passes l5dcheck."""
+
+    def fixtures(self):
+        out = []
+        for pattern in ("tests/configs/*.yml", "tests/configs/*.yaml",
+                        "examples/*.yml", "examples/*.yaml"):
+            out.extend(sorted(glob.glob(os.path.join(REPO, pattern))))
+        return out
+
+    def test_fixture_inventory(self):
+        # the gate must never silently pass over an empty set
+        assert len(self.fixtures()) >= 7
+
+    def test_rule_inventory(self):
+        assert "dtab-shadowed" in semantic_rule_ids()
+        assert len(semantic_rule_ids()) >= 15
+
+    def test_all_repo_fixtures_are_clean(self):
+        bad = []
+        for path in self.fixtures():
+            for f in check_file(path, repo_root=REPO):
+                if not f.suppressed:
+                    bad.append(f.show())
+        assert bad == [], "\n" + "\n".join(bad)
+
+    def test_suppressed_fixture_findings_are_justified(self):
+        for path in self.fixtures():
+            for f in check_file(path, repo_root=REPO):
+                if f.suppressed:
+                    assert f.justification.strip(), f.show()
+
+    def test_fixtures_load_through_the_real_parsers(self):
+        # l5dcheck passing a config the linker/namerd would refuse to
+        # parse is worthless — fixtures go through the strict parsers
+        from linkerd_tpu.linker import parse_linker_spec
+        from linkerd_tpu.namerd.config import parse_namerd_spec
+        for path in self.fixtures():
+            with open(path) as fh:
+                text = fh.read()
+            if "routers:" in text:
+                parse_linker_spec(text)
+            else:
+                parse_namerd_spec(text)

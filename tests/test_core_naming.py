@@ -160,6 +160,16 @@ class TestDtab:
         assert t.trees[1] == Leaf(Path.read("/old/a"))
         assert t.eval() == frozenset([Path.read("/new/a")])
 
+    def test_comments_are_stripped(self):
+        # '#' at line start or after whitespace opens a comment (so
+        # l5dcheck suppressions ride in dtab blocks); '/#/' segments
+        # and paths are untouched
+        d = Dtab.read(
+            "# full-line comment\n"
+            "/svc => /#/io.l5d.fs ;  # trailing note\n"
+            "/a => /b ;")
+        assert d.show == "/svc => /#/io.l5d.fs;/a => /b"
+
     def test_wildcard_prefix(self):
         d = Dtab.read("/svc/*/users => /users-cluster")
         t = d.lookup(Path.read("/svc/east/users/extra"))

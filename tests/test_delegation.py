@@ -66,6 +66,31 @@ class TestDelegator:
         assert isinstance(tree, DDelegate)
         assert isinstance(tree.child, DNeg)
 
+    def test_alt_union_branches_keep_originating_dentry(self, interp):
+        # regression: nested Alt/Union nodes produced by ONE dentry's dst
+        # tree used to drop that dentry — every step must attribute the
+        # rule that produced it (delegator UI + l5dcheck terminals)
+        from linkerd_tpu.namer.delegate import DUnion
+
+        dtab = Dtab.read(
+            "/svc => /#/io.l5d.fs/web | /#/io.l5d.fs/web-v0 ;")
+        tree = Delegator(interp).delegate(dtab, Path.read("/svc/x"))
+        assert isinstance(tree, DAlt)
+        assert tree.dentry is not None
+        for child in tree.children:
+            assert child.dentry is not None
+            assert child.dentry.prefix.show == "/svc"
+        j = delegate_json(tree)
+        assert all("dentry" in c for c in j["alt"])
+
+        dtab = Dtab.read(
+            "/svc => 0.9 * /#/io.l5d.fs/web & 0.1 * /#/io.l5d.fs/web-v0 ;")
+        tree = Delegator(interp).delegate(dtab, Path.read("/svc/x"))
+        assert isinstance(tree, DUnion)
+        for _w, child in tree.weighted:
+            assert child.dentry is not None
+            assert child.dentry.prefix.show == "/svc"
+
 
 class TestAdminDelegator:
     def test_delegator_and_bound_names_handlers(self, tmp_path):

@@ -241,6 +241,89 @@ class TestJaxPurity:
                    for f in got)
 
 
+class TestFloatTime:
+    def test_direct_duration_subtraction_fires(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                import time
+                def measure(fn):
+                    t0 = time.time()
+                    fn()
+                    return time.time() - t0
+            """}, "float-time")
+        assert len(got) == 1 and got[0].line == 6
+
+    def test_variable_flow_flags_the_assignment(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                import time
+                def deadline_of(timeout_s, now_mono):
+                    wall = time.time()
+                    return now_mono < wall + timeout_s
+            """}, "float-time")
+        assert len(got) == 1
+        assert got[0].line == 4 and "assigned here" in got[0].message
+
+    def test_deadline_comparison_fires(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/telemetry/x.py": """
+                import time
+                def expired(deadline):
+                    return time.time() > deadline
+            """}, "float-time")
+        assert len(got) == 1
+
+    def test_method_bodies_are_scanned(self, tmp_path):
+        # regression: walk_functions used to skip class methods entirely
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                import time
+                class Filter:
+                    async def apply(self, req, service):
+                        t0 = time.time()
+                        rsp = await service(req)
+                        self.latency = time.time() - t0
+                        return rsp
+            """}, "float-time")
+        assert len(got) >= 1
+
+    def test_rebound_variable_clears_wall_clock_taint(self, tmp_path):
+        # t0 first holds a reported wall timestamp, then is rebound to
+        # monotonic before the arithmetic — no bug, no finding
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                import time
+                def span():
+                    t0 = time.time()
+                    stamp = int(t0 * 1e6)
+                    t0 = time.monotonic()
+                    return stamp, time.monotonic() - t0
+            """}, "float-time")
+        assert got == []
+
+    def test_timestamps_and_unit_conversion_are_clean(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                import time
+                def span_fields():
+                    ts_us = int(time.time() * 1e6)  # unit conversion
+                    t0 = time.monotonic()
+                    return {"ts": round(time.time(), 3),  # reported stamp
+                            "elapsed": time.monotonic() - t0,
+                            "timestamp": ts_us}
+            """}, "float-time")
+        assert got == []
+
+    def test_out_of_scope_control_plane_is_ignored(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/namerd/x.py": """
+                import time
+                def uptime(start):
+                    return time.time() - start
+            """}, "float-time")
+        assert got == []
+
+
 class TestConfigRegistry:
     FILES = {
         "linkerd_tpu/cfg.py": """
@@ -306,6 +389,17 @@ class TestSuppressions:
         sup = [f for f in out if f.rule == "suppression"]
         assert len(sup) == 1 and "unknown rule" in sup[0].message
 
+    def test_trailing_suppression_binds_to_its_line_only(self, tmp_path):
+        root = mk_repo(tmp_path, {"linkerd_tpu/x.py": textwrap.dedent("""
+            import asyncio
+            def go(loop, coro):
+                x = 1  # l5d: ignore[task-leak] — wrong line on purpose
+                loop.create_task(coro)
+        """)})
+        out = run_analysis(["linkerd_tpu"], repo_root=root)
+        leaks = [f for f in out if f.rule == "task-leak"]
+        assert len(leaks) == 1 and not leaks[0].suppressed
+
     def test_comment_line_above_applies(self, tmp_path):
         root = mk_repo(tmp_path, {"linkerd_tpu/x.py": textwrap.dedent("""
             import asyncio
@@ -323,8 +417,9 @@ class TestRepoGate:
 
     def test_rule_inventory(self):
         assert sorted(rule_ids()) == [
-            "async-blocking", "config-registry", "jax-purity",
-            "stream-release", "swallowed-exception", "task-leak",
+            "async-blocking", "config-registry", "float-time",
+            "jax-purity", "stream-release", "swallowed-exception",
+            "task-leak",
         ]
 
     def test_repo_has_zero_unsuppressed_findings(self):

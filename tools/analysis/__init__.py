@@ -9,9 +9,13 @@ Rules (see tools/analysis/checkers/ and COMPONENTS.md §2.6):
 - ``stream-release``      h2/gRPC frames that strand flow credit
 - ``jax-purity``          host side effects in jitted code; dead helpers
 - ``config-registry``     undocumented/untested/loose YAML kinds
+- ``float-time``          wall-clock time.time() in duration/deadline math
 - ``suppression``         (meta) ignores must carry a justification
 
-Run: ``python -m tools.analysis [paths] [--rule r1,r2] [--json]``.
+Run: ``python -m tools.analysis [paths] [--rule r1,r2] [--format json]``.
+Semantic verification of linker/namerd YAML (l5dcheck, see
+``tools/analysis/semantic`` and COMPONENTS.md §2.8):
+``python -m tools.analysis check <config.yml...>``.
 Suppress inline with ``# l5d: ignore[rule] — why it is safe``.
 """
 

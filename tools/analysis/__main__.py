@@ -1,6 +1,15 @@
-"""CLI: ``python -m tools.analysis [paths] [--rule ...] [--json]``.
+"""CLI for the static-analysis suite.
 
-Exit status: 0 = no unsuppressed findings, 1 = findings, 2 = usage.
+Two modes::
+
+    python -m tools.analysis [lint] [paths] [--rule ...] [--format json]
+    python -m tools.analysis check <config.yml...>      [--format json]
+
+``lint`` (the default) runs the l5dlint AST rules over python sources;
+``check`` runs l5dcheck semantic verification over linker/namerd YAML.
+
+Exit status (both modes): 0 = no unsuppressed findings, 1 = findings,
+2 = usage/IO error.
 """
 
 from __future__ import annotations
@@ -20,31 +29,52 @@ if _REPO not in sys.path:
 from tools.analysis import all_checkers, rule_ids, run_analysis  # noqa: E402
 
 
-def main(argv=None) -> int:
+def _mk_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="python -m tools.analysis",
-        description="l5dlint: repo-native static analysis "
-                    "(async data plane + JAX scoring path)")
+        description="l5dlint (code) + l5dcheck (configs): repo-native "
+                    "static analysis")
     ap.add_argument("paths", nargs="*", default=None,
-                    help="repo-relative paths to scan (default: linkerd_tpu)")
+                    help="lint: repo-relative source paths (default: "
+                         "linkerd_tpu); check: config YAML files")
     ap.add_argument("--rule", action="append", default=None,
                     help="run only these rules (repeatable or comma-"
                          "separated)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="emit one JSON object with findings + timing")
+                    help="alias for --format json")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="output format (json: one machine-readable "
+                         "object with findings + timing, for CI)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print rule ids and exit")
     ap.add_argument("--show-suppressed", action="store_true",
                     help="also print suppressed findings")
-    args = ap.parse_args(argv)
+    return ap
 
-    if args.list_rules:
-        for c in sorted(all_checkers(), key=lambda c: c.rule):
-            print(f"{c.rule:20s} {c.description}")
-        print(f"{'suppression':20s} (meta) ignores must carry a "
-              f"justification")
-        return 0
 
+def _report(findings, wall_s: float, as_json: bool, show_suppressed: bool,
+            header: dict, label: str) -> int:
+    unsuppressed = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+    if as_json:
+        print(json.dumps({
+            **header,
+            "wall_s": round(wall_s, 3),
+            "unsuppressed": [f.to_dict() for f in unsuppressed],
+            "suppressed_count": len(suppressed),
+        }))
+    else:
+        for f in unsuppressed:
+            print(f.show())
+        if show_suppressed:
+            for f in suppressed:
+                print(f.show())
+        print(f"{label}: {len(unsuppressed)} finding(s), "
+              f"{len(suppressed)} suppressed, {wall_s:.2f}s")
+    return 1 if unsuppressed else 0
+
+
+def _lint(args) -> int:
     rules = None
     if args.rule:
         rules = [r.strip() for chunk in args.rule for r in chunk.split(",")]
@@ -61,27 +91,62 @@ def main(argv=None) -> int:
     except FileNotFoundError as e:
         print(str(e), file=sys.stderr)
         return 2
-    wall_s = time.perf_counter() - t0
-    unsuppressed = [f for f in findings if not f.suppressed]
-    suppressed = [f for f in findings if f.suppressed]
+    return _report(
+        findings, time.perf_counter() - t0, args.as_json,
+        args.show_suppressed,
+        {"mode": "lint", "paths": paths,
+         "rules": rules or rule_ids() + ["suppression"]},
+        "l5dlint")
 
-    if args.as_json:
-        print(json.dumps({
-            "paths": paths,
-            "rules": rules or rule_ids() + ["suppression"],
-            "wall_s": round(wall_s, 3),
-            "unsuppressed": [f.to_dict() for f in unsuppressed],
-            "suppressed_count": len(suppressed),
-        }))
-    else:
-        for f in unsuppressed:
-            print(f.show())
-        if args.show_suppressed:
-            for f in suppressed:
-                print(f.show())
-        print(f"l5dlint: {len(unsuppressed)} finding(s), "
-              f"{len(suppressed)} suppressed, {wall_s:.2f}s")
-    return 1 if unsuppressed else 0
+
+def _check(args) -> int:
+    from tools.analysis.semantic import check_file, semantic_rule_ids
+
+    if args.rule:
+        print("check mode runs every semantic rule; use inline "
+              "suppressions to waive specific findings", file=sys.stderr)
+        return 2
+    if not args.paths:
+        print("usage: python -m tools.analysis check <config.yml...>",
+              file=sys.stderr)
+        return 2
+    t0 = time.perf_counter()
+    findings = []
+    for p in args.paths:
+        if not os.path.exists(p):
+            print(f"no such config file: {p}", file=sys.stderr)
+            return 2
+        findings.extend(check_file(p, repo_root=os.getcwd()))
+    return _report(
+        findings, time.perf_counter() - t0, args.as_json,
+        args.show_suppressed,
+        {"mode": "check", "paths": list(args.paths),
+         "rules": semantic_rule_ids() + ["suppression"]},
+        "l5dcheck")
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    mode = "lint"
+    if argv and argv[0] in ("lint", "check"):
+        mode = argv.pop(0)
+    args = _mk_parser().parse_args(argv)
+    if args.as_json or args.format == "json":
+        args.as_json = True
+
+    if args.list_rules:
+        if mode == "check":
+            from tools.analysis.semantic import semantic_rule_ids
+            for r in semantic_rule_ids():
+                print(r)
+        else:
+            for c in sorted(all_checkers(), key=lambda c: c.rule):
+                print(f"{c.rule:20s} {c.description}")
+        print(f"{'suppression':20s} (meta) ignores must carry a "
+              f"justification")
+        return 0
+
+    return _check(args) if mode == "check" else _lint(args)
 
 
 if __name__ == "__main__":

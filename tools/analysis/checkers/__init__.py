@@ -3,6 +3,7 @@
 from tools.analysis.checkers import (  # noqa: F401 — registration imports
     async_blocking,
     config_registry,
+    float_time,
     jax_purity,
     stream_release,
     swallowed,

@@ -1,0 +1,107 @@
+"""float-time — wall-clock ``time.time()`` used for durations/deadlines.
+
+``time.time()`` jumps under NTP slew/step and leap-second smearing. A
+duration measured across a step can go negative (a negative latency
+poisons EWMA stats and the anomaly feature pipeline) and a deadline
+computed against a stepped clock sheds live traffic or never fires.
+The data plane must measure with ``time.monotonic()`` (or
+``perf_counter``); wall time is only for *reporting* absolute instants
+(span timestamps, log lines), where no arithmetic happens.
+
+The rule flags ``time.time()`` whose result flows into arithmetic or a
+comparison — direct (``time.time() - t0``) or through a local variable
+later used that way. A bare ``time.time()`` stored or formatted as a
+timestamp is fine.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from tools.analysis.core import (
+    Checker, Finding, Project, SourceFile, dotted_name, register_checker,
+    walk_functions,
+)
+
+_MSG = ("wall-clock time.time() used in duration/deadline arithmetic"
+        "{via}: an NTP step makes intervals negative or deadlines wrong; "
+        "use time.monotonic() for measuring and keep time.time() only "
+        "for reported timestamps")
+
+
+def _is_wall_clock(call: ast.Call) -> bool:
+    return dotted_name(call.func) in ("time.time",)
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+@register_checker
+class FloatTimeChecker(Checker):
+    rule = "float-time"
+    description = ("time.time() used for durations/deadlines in the data "
+                   "plane (monotonic-clock bug)")
+    # the data plane + its support layers; control-plane startup and
+    # test scaffolding may report wall time freely
+    scope = ("linkerd_tpu/router", "linkerd_tpu/protocol",
+             "linkerd_tpu/telemetry", "linkerd_tpu/core",
+             "linkerd_tpu/grpc")
+
+    def check(self, src: SourceFile, project: Project) -> Iterator[Finding]:
+        # module body + every function get an independent dataflow pass
+        yield from self._check_frame(src, src.tree)
+        for fn, _cls in walk_functions(src.tree):
+            yield from self._check_frame(src, fn)
+
+    def _check_frame(self, src: SourceFile,
+                     frame: ast.AST) -> Iterator[Finding]:
+        wall_vars: dict = {}  # name -> assignment node
+        flagged: Set[int] = set()
+
+        def walk(node: ast.AST):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue  # separate frame: its own pass
+                yield child
+                yield from walk(child)
+
+        for node in walk(frame):
+            if isinstance(node, ast.Assign):
+                wall = (isinstance(node.value, ast.Call)
+                        and _is_wall_clock(node.value))
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        if wall:
+                            wall_vars[tgt.id] = node
+                        else:
+                            # rebound to something else (e.g. monotonic):
+                            # the wall-clock taint must not stick
+                            wall_vars.pop(tgt.id, None)
+            # duration/deadline math is add/sub/compare; multiplying or
+            # dividing a timestamp is unit conversion (ts * 1e6), fine
+            if isinstance(node, (ast.BinOp, ast.AugAssign)):
+                if not isinstance(node.op, (ast.Add, ast.Sub)):
+                    continue
+            elif not isinstance(node, ast.Compare):
+                continue
+            # direct: time.time() inside the arithmetic expression
+            direct = any(
+                isinstance(c, ast.Call) and _is_wall_clock(c)
+                for c in ast.walk(node))
+            if direct and node.lineno not in flagged:
+                flagged.add(node.lineno)
+                yield Finding(self.rule, src.rel, node.lineno,
+                              node.col_offset, _MSG.format(via=""))
+                continue
+            # through a variable assigned from time.time() in this frame
+            for name in _names_in(node):
+                assign = wall_vars.get(name)
+                if assign is not None and assign.lineno not in flagged:
+                    flagged.add(assign.lineno)
+                    yield Finding(
+                        self.rule, src.rel, assign.lineno,
+                        assign.col_offset,
+                        _MSG.format(via=f" (via {name!r}, assigned here)"))

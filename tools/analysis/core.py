@@ -52,6 +52,25 @@ class Suppression:
         return bool(self.justification.strip())
 
 
+def suppression_at(suppressions: Dict[int, "Suppression"],
+                   lines: Sequence[str], rule: str,
+                   line: int) -> Optional["Suppression"]:
+    """The one definition of suppression placement (python sources AND
+    l5dcheck YAML share it): a suppression applies to findings on its
+    own line, or — when it is a comment-ONLY line — to the line
+    directly below it. A suppression trailing code binds to that code
+    alone (it must not leak onto the next statement/dentry)."""
+    for ln in (line, line - 1):
+        sup = suppressions.get(ln)
+        if sup and rule in sup.rules:
+            if ln == line - 1:
+                above = lines[ln - 1].strip() if 1 <= ln <= len(lines) else ""
+                if not above.startswith("#"):
+                    continue
+            return sup
+    return None
+
+
 @dataclass
 class Finding:
     rule: str
@@ -96,13 +115,7 @@ class SourceFile:
                     i, rules, (m.group(2) or "").strip())
 
     def suppression_for(self, rule: str, line: int) -> Optional[Suppression]:
-        """A suppression applies to findings on its own line or the line
-        directly below it (comment-only line above the flagged code)."""
-        for ln in (line, line - 1):
-            sup = self.suppressions.get(ln)
-            if sup and rule in sup.rules:
-                return sup
-        return None
+        return suppression_at(self.suppressions, self.lines, rule, line)
 
 
 class Project:
@@ -240,7 +253,7 @@ def walk_functions(tree: ast.AST) -> Iterator[Tuple[ast.AST, Optional[str]]]:
     def visit(node: ast.AST, cls: Optional[str]):
         for child in ast.iter_child_nodes(node):
             if isinstance(child, ast.ClassDef):
-                visit(child, child.name)
+                yield from visit(child, child.name)
             elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 yield (child, cls)
                 yield from visit(child, cls)

@@ -1,0 +1,20 @@
+"""l5dcheck — semantic static verification of linker/namerd configs.
+
+Where l5dlint (``tools/analysis/checkers``) verifies the *code*,
+l5dcheck verifies the *configs* that steer it: dtabs are evaluated by
+symbolic delegation over the real ``DelegateTree``/``ConfiguredDtabNamer``
+machinery (shadowed/unreachable dentries, delegation cycles, unbound
+namer prefixes, dead branches), router wiring is cross-checked
+(port conflicts, timeout inversions, starved retry budgets, admission
+bounds vs deadline budgets, missing TLS material), and the jaxAnomaly
+scorer block is validated against the model/lifecycle contracts.
+
+Run: ``python -m tools.analysis check <config.yml...>``.
+Suppress inline with ``# l5d: ignore[rule] — why`` in YAML comments.
+See COMPONENTS.md §2.8.
+"""
+
+from tools.analysis.semantic.engine import (  # noqa: F401
+    check_data, check_file, check_text, semantic_rule_ids,
+)
+from tools.analysis.semantic.loader import ConfigSource  # noqa: F401

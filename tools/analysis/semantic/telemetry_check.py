@@ -1,0 +1,182 @@
+"""Telemetry / anomaly-scorer wiring checks.
+
+The jaxAnomaly telemeter is configured entirely from YAML but its knobs
+interlock: a ring smaller than one batch never fills a batch, a breaker
+whose min backoff exceeds its max has an empty probe range, lifecycle
+gate tolerances outside their ranges make the promotion gate either
+vacuous or unpassable. The runtime validates a few of these at telemeter
+construction (and crashes the linker); l5dcheck reports all of them
+pre-deploy.
+
+- ``scorer-config``  invalid/contradictory jaxAnomaly + lifecycle knobs
+- ``scorer-width``   an on-disk checkpoint whose model width disagrees
+  with the feature pipeline's FEATURE_DIM (restore would fail or score
+  garbage)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator
+
+from linkerd_tpu.config import ConfigError
+from linkerd_tpu.config.parser import instantiate
+from linkerd_tpu.linker import LinkerSpec
+from tools.analysis.core import Finding
+from tools.analysis.semantic.loader import ConfigSource, resolve_path
+
+
+def check_telemetry(source: ConfigSource, spec: LinkerSpec
+                    ) -> Iterator[Finding]:
+    for i, raw in enumerate(spec.telemetry or []):
+        if not isinstance(raw, dict):
+            continue
+        if raw.get("kind") != "io.l5d.jaxAnomaly":
+            continue
+        where = f"telemetry[{i}]"
+        try:
+            cfg = instantiate("telemeter", raw, where)
+        except ConfigError:
+            continue  # the registry cross-check already reported it
+        yield from _check_anomaly_cfg(source, cfg, where)
+        if cfg.lifecycle is not None:
+            yield from _check_lifecycle_cfg(source, cfg.lifecycle,
+                                            f"{where}.lifecycle")
+            yield from _check_checkpoint_width(source, cfg.lifecycle,
+                                              f"{where}.lifecycle")
+
+
+def _bad(source: ConfigSource, rule: str, where: str, message: str,
+         needle: str, severity: str = "error") -> Finding:
+    return source.finding(rule, f"{where}: {message}",
+                          line=source.line_of(needle), severity=severity)
+
+
+def _check_anomaly_cfg(source: ConfigSource, cfg, where: str
+                       ) -> Iterator[Finding]:
+    if cfg.intervalMs <= 0:
+        yield _bad(source, "scorer-config", where,
+                   f"intervalMs must be > 0 (got {cfg.intervalMs})",
+                   "intervalMs")
+    if cfg.maxBatch < 1:
+        yield _bad(source, "scorer-config", where,
+                   f"maxBatch must be >= 1 (got {cfg.maxBatch})",
+                   "maxBatch")
+    elif cfg.ringCapacity < cfg.maxBatch:
+        yield _bad(source, "scorer-config", where,
+                   f"ringCapacity ({cfg.ringCapacity}) is below maxBatch "
+                   f"({cfg.maxBatch}) — the feature ring can never hold "
+                   f"a full scoring batch",
+                   "ringCapacity")
+    if cfg.maxBatchesPerWake < 1:
+        yield _bad(source, "scorer-config", where,
+                   f"maxBatchesPerWake must be >= 1 (got "
+                   f"{cfg.maxBatchesPerWake}) — 0 silently disables "
+                   f"draining (the telemeter refuses it at startup)",
+                   "maxBatchesPerWake")
+    if not (0.0 <= cfg.scoreThreshold <= 1.0):
+        yield _bad(source, "scorer-config", where,
+                   f"scoreThreshold must be in [0, 1] (got "
+                   f"{cfg.scoreThreshold}) — scores are sigmoid outputs",
+                   "scoreThreshold")
+    if cfg.trainEveryBatches < 0:
+        yield _bad(source, "scorer-config", where,
+                   f"trainEveryBatches must be >= 0 (0 = never train, "
+                   f"got {cfg.trainEveryBatches})",
+                   "trainEveryBatches")
+    if cfg.scoreTimeoutMs <= 0:
+        yield _bad(source, "scorer-config", where,
+                   f"scoreTimeoutMs must be > 0 (got {cfg.scoreTimeoutMs})",
+                   "scoreTimeoutMs")
+    if cfg.scoreTtlSecs <= 0:
+        yield _bad(source, "scorer-config", where,
+                   f"scoreTtlSecs must be > 0 (got {cfg.scoreTtlSecs}) — "
+                   f"every score would be stale on arrival and decay to "
+                   f"neutral immediately",
+                   "scoreTtlSecs")
+    if cfg.breakerMinBackoffMs > cfg.breakerMaxBackoffMs:
+        yield _bad(source, "scorer-config", where,
+                   f"breakerMinBackoffMs ({cfg.breakerMinBackoffMs}) "
+                   f"exceeds breakerMaxBackoffMs "
+                   f"({cfg.breakerMaxBackoffMs}) — the probe backoff "
+                   f"range is empty",
+                   "breakerMinBackoffMs")
+    if cfg.breakerFailures < 1:
+        yield _bad(source, "scorer-config", where,
+                   f"breakerFailures must be >= 1 (got "
+                   f"{cfg.breakerFailures})",
+                   "breakerFailures")
+
+
+def _check_lifecycle_cfg(source: ConfigSource, lc, where: str
+                         ) -> Iterator[Finding]:
+    if not (0.0 <= lc.aucTolerance <= 1.0):
+        yield _bad(source, "scorer-config", where,
+                   f"aucTolerance must be in [0, 1] (got "
+                   f"{lc.aucTolerance}) — AUC itself lives in [0, 1]",
+                   "aucTolerance")
+    if lc.lossTolerance < 0:
+        yield _bad(source, "scorer-config", where,
+                   f"lossTolerance must be >= 0 (got {lc.lossTolerance})",
+                   "lossTolerance")
+    if lc.retain < 1:
+        yield _bad(source, "scorer-config", where,
+                   f"retain must be >= 1 (got {lc.retain}) — retention "
+                   f"would prune the serving checkpoint",
+                   "retain")
+    if lc.holdoutEveryBatches < 1:
+        yield _bad(source, "scorer-config", where,
+                   f"holdoutEveryBatches must be >= 1 (got "
+                   f"{lc.holdoutEveryBatches}) — the telemeter refuses "
+                   f"it at startup",
+                   "holdoutEveryBatches")
+    if lc.minReplayRows > lc.replayCapacity:
+        yield _bad(source, "scorer-config", where,
+                   f"minReplayRows ({lc.minReplayRows}) exceeds "
+                   f"replayCapacity ({lc.replayCapacity}) — the "
+                   f"promotion gate can never warm up and no candidate "
+                   f"is ever promoted",
+                   "minReplayRows")
+    if lc.checkpointEveryS < 0:
+        yield _bad(source, "scorer-config", where,
+                   f"checkpointEveryS must be >= 0 (got "
+                   f"{lc.checkpointEveryS})",
+                   "checkpointEveryS")
+    if lc.minLabeled < 0:
+        yield _bad(source, "scorer-config", where,
+                   f"minLabeled must be >= 0 (got {lc.minLabeled})",
+                   "minLabeled")
+
+
+def _check_checkpoint_width(source: ConfigSource, lc, where: str
+                            ) -> Iterator[Finding]:
+    """Restore-time contract: the checkpoint this config would restore
+    on startup must have been trained at the feature pipeline's width."""
+    from linkerd_tpu.models.features import FEATURE_DIM
+
+    directory = resolve_path(source, lc.directory)
+    if not os.path.isdir(directory):
+        return  # fresh store: created on first checkpoint
+    try:
+        from linkerd_tpu.lifecycle import CheckpointStore
+        store = CheckpointStore(directory)
+        serving = store.latest_good()
+        if serving is None:
+            return
+        _, snap = store.load(serving)
+    except Exception as e:  # noqa: BLE001 — corrupt store: point at ckpt
+        yield _bad(source, "scorer-width", where,
+                   f"checkpoint store {lc.directory!r} is unreadable "
+                   f"({e}); run `python tools/validator.py ckpt` for the "
+                   f"full integrity report",
+                   "directory", severity="warning")
+        return
+    in_dim = getattr(snap.cfg, "in_dim", None)
+    if in_dim is not None and in_dim != FEATURE_DIM:
+        yield _bad(source, "scorer-width", where,
+                   f"serving checkpoint v{serving} in {lc.directory!r} "
+                   f"was trained with in_dim={in_dim} but the feature "
+                   f"pipeline emits FEATURE_DIM={FEATURE_DIM}-wide "
+                   f"vectors — restoreOnStart would crash or score "
+                   f"garbage",
+                   "directory")

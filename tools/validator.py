@@ -24,6 +24,12 @@ tree — non-zero exit on any unsuppressed finding:
 
     python tools/validator.py lint [path ...]
 
+And the l5dcheck semantic config verification (tools/analysis/semantic)
+over linker/namerd YAML — defaults to every fixture under tests/configs/
+and examples/ when no files are given:
+
+    python tools/validator.py config [config.yml ...]
+
 And the chaos validation: boot the assembled linker with its anomaly
 scorer sidecar black-holed, assert the data plane keeps serving within
 its deadline budget, the ``anomaly/degraded`` gauge flips to 1, and —
@@ -397,6 +403,39 @@ def validate_checkpoints(dirs) -> int:
     return 0
 
 
+def default_config_fixtures() -> list:
+    """Every YAML config the repo ships: test fixtures + examples."""
+    import glob
+    out = []
+    for pattern in ("tests/configs/*.yml", "tests/configs/*.yaml",
+                    "examples/*.yml", "examples/*.yaml"):
+        out.extend(sorted(glob.glob(os.path.join(REPO, pattern))))
+    return out
+
+
+def validate_config(paths) -> int:
+    """Run l5dcheck over linker/namerd YAML; exit 0 only when every
+    config is clean (each finding fixed or justify-suppressed). Prints
+    one ``CONFIGCHECK {json}`` line (bench.py folds it into
+    detail.semantic_check)."""
+    from tools.analysis.__main__ import main as analysis_main
+
+    files = list(paths) or default_config_fixtures()
+    if not files:
+        print("validator[config]: no config fixtures found", file=sys.stderr)
+        return 64
+    t0 = time.perf_counter()
+    rc = analysis_main(["check", *files])
+    print("CONFIGCHECK " + json.dumps({
+        "files": len(files),
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "clean": rc == 0,
+    }))
+    if rc == 0:
+        print(f"VALIDATOR PASS (config x{len(files)})")
+    return rc
+
+
 def validate_lint(paths) -> int:
     """Run the static-analysis suite; exit 0 only when the tree is
     clean (every finding fixed or carrying a justified suppression)."""
@@ -412,6 +451,8 @@ async def main() -> int:
     args = sys.argv[1:]
     if args and args[0] == "lint":
         return validate_lint(args[1:])
+    if args and args[0] == "config":
+        return validate_config(args[1:])
     if args and args[0] == "ckpt":
         if len(args) < 2:
             print("usage: python tools/validator.py ckpt <store-dir>...",
