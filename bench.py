@@ -308,6 +308,25 @@ def static_analysis_bench() -> dict:
     }
 
 
+def race_analysis_bench() -> dict:
+    """l5drace wall time + finding counts over the data-plane scope —
+    gated in tier-1 (tests/test_race_analysis.py) like l5dlint, so its
+    cost is tracked the same way across rounds."""
+    from tools.analysis import race_rule_ids
+    from tools.analysis.race import run_race_analysis
+
+    t0 = time.perf_counter()
+    findings = run_race_analysis()
+    wall_s = time.perf_counter() - t0
+    unsuppressed = [f for f in findings if not f.suppressed]
+    return {
+        "wall_s": round(wall_s, 3),
+        "findings_unsuppressed": len(unsuppressed),
+        "findings_suppressed": len(findings) - len(unsuppressed),
+        "rules": len(race_rule_ids()),
+    }
+
+
 def semantic_check_bench() -> dict:
     """l5dcheck wall time over every in-repo YAML fixture (via
     ``tools/validator.py config``) — the semantic gate runs in tier-1,
@@ -455,6 +474,9 @@ def main() -> None:
     def ph_static() -> None:
         detail["static_analysis"] = static_analysis_bench()
 
+    def ph_race() -> None:
+        detail["race_analysis"] = race_analysis_bench()
+
     def ph_semantic() -> None:
         detail["semantic_check"] = semantic_check_bench()
 
@@ -470,6 +492,7 @@ def main() -> None:
         ("sharded_cpu8", ph_sharded),
         ("lifecycle", ph_lifecycle),
         ("static_analysis", ph_static),
+        ("race_analysis", ph_race),
         ("semantic_check", ph_semantic),
         ("resilience", ph_resilience),
     ]

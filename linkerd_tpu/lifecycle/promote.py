@@ -220,23 +220,33 @@ class ModelLifecycleManager:
     async def bootstrap(self, scorer) -> Optional[int]:
         """Restore the last-good checkpoint into the scorer, surviving a
         router/sidecar restart (the seed motivation: params must not
-        silently reset to random init). No-op on an empty store."""
-        version = self.store.latest_good()
-        if version is None:
-            return None
-        v, snap = self.store.load(version)
-        await _call_scorer(scorer.restore, snap)
-        self.serving_version = v
-        if self.drift is not None:
-            self.drift.set_reference(snap.mu, snap.var, version=v,
-                                     step=snap.step)
-        return v
+        silently reset to random init). No-op on an empty store.
+
+        Holds the cycle lock: a gate cycle promoting v(N+1) while the
+        restore await is in flight would otherwise be clobbered — the
+        scorer would serve vN with serving_version rolled back under a
+        store whose latest promotion is newer."""
+        async with self._lock:
+            version = self.store.latest_good()
+            if version is None:
+                return None
+            v, snap = self.store.load(version)
+            await _call_scorer(scorer.restore, snap)
+            self.serving_version = v
+            if self.drift is not None:
+                self.drift.set_reference(snap.mu, snap.var, version=v,
+                                         step=snap.step)
+            return v
 
     # -- the gating cycle -------------------------------------------------
     async def checkpoint(self, scorer, status: str = "candidate") -> int:
-        snap = await _call_scorer(scorer.snapshot)
-        return self.store.save(snap, status=status,
-                               parent=self.serving_version)
+        # locked so the parent lineage is the serving version at SAVE
+        # time: a promotion completing during the snapshot await would
+        # otherwise leave this checkpoint claiming a stale parent
+        async with self._lock:
+            snap = await _call_scorer(scorer.snapshot)
+            return self.store.save(snap, status=status,
+                                   parent=self.serving_version)
 
     async def run_cycle(self, scorer) -> Dict[str, Any]:
         """One checkpoint/shadow-eval/promote-or-rollback pass over the

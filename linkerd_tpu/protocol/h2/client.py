@@ -41,7 +41,7 @@ class H2Client(Service[H2Request, H2Response]):
 
     async def _get_conn(self) -> H2Connection:
         if self._conn is not None and not self._conn.is_closed \
-                and not self._conn.goaway_received:
+                and not self._conn.goaway_received:  # l5d: ignore[await-atomicity] — singleton dedup: concurrent connects serialize on _connecting, and the _closed re-check below covers the only concurrent writer (close)
             return self._conn
         if self._connecting is not None:
             return await asyncio.shield(self._connecting)
@@ -59,7 +59,16 @@ class H2Client(Service[H2Request, H2Response]):
             conn = H2Connection(reader, writer, is_client=True,
                                 **self._h2_settings)
             await conn.start()
-            self._conn = conn
+            if self._closed:
+                # close() ran during the handshake: the entry guard is
+                # stale, and the fresh connection (socket + read loop)
+                # must not outlive its client
+                await conn.close()
+                raise ConnectionError(
+                    f"h2 client {self.host}:{self.port} closed")
+            # singleton reconnect: concurrent callers dedup through
+            # _connecting; close-vs-connect is handled by the re-check
+            self._conn = conn  # l5d: ignore[await-atomicity] — only this path (serialized by _connecting) assigns a live conn; close() was just re-checked above
             self._connecting.set_result(conn)
             return conn
         except BaseException as e:
@@ -80,6 +89,11 @@ class H2Client(Service[H2Request, H2Response]):
             # reject requests without it); default to the endpoint
             req.authority = f"{self.host}:{self.port}"
         conn = await self._get_conn()
+        if self._closed:
+            # close() ran while we were connecting: the entry guard is
+            # stale and the request must not ride a dead client
+            raise ConnectionError(
+                f"h2 client {self.host}:{self.port} closed")
         self.pending += 1
         try:
             return await conn.request(req)
@@ -88,6 +102,9 @@ class H2Client(Service[H2Request, H2Response]):
 
     async def close(self) -> None:
         self._closed = True
-        if self._conn is not None:
-            await self._conn.close()
-            self._conn = None
+        # detach before awaiting: a connect finishing during the await
+        # must find _conn already cleared (it re-checks _closed and
+        # closes its own socket), not re-cache over our teardown
+        conn, self._conn = self._conn, None
+        if conn is not None:
+            await conn.close()

@@ -484,7 +484,7 @@ class H2Connection:
             self._fail_all(StreamReset(frames.PROTOCOL_ERROR, str(e)))
         except Exception:  # noqa: BLE001
             log.exception("h2 read loop crashed")
-            self._closed = True
+            self._closed = True  # l5d: ignore[await-atomicity] — monotonic teardown flag in an exclusive except arm; the loop test re-reads it every iteration and close() is idempotent
             self._fail_all(StreamReset(frames.INTERNAL_ERROR, "read loop"))
 
     async def _dispatch(self, fh: frames.FrameHeader, payload: bytes) -> None:

@@ -111,6 +111,13 @@ class HttpClient(Service[Request, Response]):
         if req.headers.get("host") is None:
             req.headers.set("Host", f"{self.host}:{self.port}")
         conn = await self._checkout()
+        if self._closed:
+            # close() ran while we were checking out/connecting: the
+            # entry guard above is stale. Surrender the connection
+            # instead of dispatching on a closed client (the fresh
+            # socket would otherwise outlive close() forever).
+            self._checkin(conn, reusable=False)
+            raise ConnectionError(f"client {self.host}:{self.port} closed")
         self.pending += 1
         try:
             codec.write_request(conn.writer, req)

@@ -41,11 +41,26 @@ class MuxClient(Service[Tdispatch, bytes]):
         return Status.CLOSED if self._closed else Status.OPEN
 
     async def _ensure_conn(self) -> None:
+        if self._closed:
+            # close() may have run while this dispatch queued on _lock;
+            # reconnecting now would leak a socket + read loop past it
+            raise ConnectionError(
+                f"mux client {self.host}:{self.port} closed")
         if self._writer is not None and not self._writer.is_closing():
             return
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(self.host, self.port),
             self.connect_timeout)
+        if self._closed:
+            # close() ran during the connect: abandon before installing
+            # the generation — dispatching on a closed client would
+            # wedge close() behind the lock
+            try:
+                writer.close()
+            except (OSError, RuntimeError):
+                pass
+            raise ConnectionError(
+                f"mux client {self.host}:{self.port} closed")
         # fresh pending map per connection generation: the read loop
         # tears down ONLY its own generation's state, so a stale loop's
         # cleanup can never close a freshly reconnected writer or fail
@@ -108,8 +123,11 @@ class MuxClient(Service[Tdispatch, bytes]):
                 writer.close()
             except (OSError, RuntimeError):  # transport already detached
                 pass
-            if self._writer is writer:
-                self._writer = None
+            # generation-guarded: this loop only clears ITS OWN writer;
+            # losing the race to a reconnect leaves the newer generation
+            # untouched (the identity check makes the write idempotent)
+            if self._writer is writer:  # l5d: ignore[lock-guard] — generation identity check; stale loop can only null its own dead writer
+                self._writer = None  # l5d: ignore[lock-guard] — see identity check above: newer generations are never clobbered
 
     def _alloc_tag(self) -> int:
         for _ in range(MAX_TAG):
@@ -164,12 +182,30 @@ class MuxClient(Service[Tdispatch, bytes]):
         await fut
 
     async def close(self) -> None:
-        self._closed = True
-        if self._read_task is not None:
-            self._read_task.cancel()
-        if self._writer is not None:
+        # the flag is published BEFORE taking the lock so dispatches
+        # already queued on it observe closure in _ensure_conn instead
+        # of reconnecting after our teardown
+        self._closed = True  # l5d: ignore[lock-guard] — monotonic flag set-before-lock: queued dispatches must see it when they win the lock
+        # break any wedged in-flight dispatch BEFORE waiting for the
+        # lock (a peer that stopped reading parks drain() forever, and
+        # the lock with it): read-only pokes, the owning paths clean up
+        task, w = self._read_task, self._writer
+        if task is not None:
+            task.cancel()
+        if w is not None:
             try:
-                self._writer.close()
-            except (OSError, RuntimeError):  # transport already detached
+                w.close()
+            except (OSError, RuntimeError):  # transport detached
                 pass
-            self._writer = None
+        async with self._lock:
+            # serialize the final teardown with a dispatch that was
+            # mid-connect when the flag published: its fresh generation
+            # must not outlive close
+            if self._read_task is not None:
+                self._read_task.cancel()
+            if self._writer is not None:
+                try:
+                    self._writer.close()
+                except (OSError, RuntimeError):  # transport detached
+                    pass
+                self._writer = None

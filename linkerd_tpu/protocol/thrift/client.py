@@ -48,11 +48,27 @@ class ThriftClient(Service[ThriftCall, Optional[bytes]]):
         return Status.CLOSED if self._closed else Status.OPEN
 
     async def _ensure_conn(self) -> None:
+        if self._closed:
+            # close() may have run while this exchange queued on _lock;
+            # reconnecting now would leak a socket past it
+            raise ConnectionError(
+                f"thrift client {self.host}:{self.port} closed")
         if self._writer is None or self._writer.is_closing():
             self._upgraded = False
-            self._reader, self._writer = await asyncio.wait_for(
+            reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(self.host, self.port),
                 self.connect_timeout)
+            if self._closed:
+                # close() ran during the connect: abandon before
+                # installing, or this exchange would dispatch on a
+                # closed client and wedge close() behind the lock
+                try:
+                    writer.close()
+                except (OSError, RuntimeError):
+                    pass
+                raise ConnectionError(
+                    f"thrift client {self.host}:{self.port} closed")
+            self._reader, self._writer = reader, writer
             if not self.framed:
                 from linkerd_tpu.protocol.thrift.codec import UnframedReader
                 self._unframed_reader = UnframedReader(self._reader)
@@ -160,5 +176,21 @@ class ThriftClient(Service[ThriftCall, Optional[bytes]]):
         self._reader = self._writer = self._unframed_reader = None
 
     async def close(self) -> None:
-        self._closed = True
-        self._teardown()
+        # flag first (outside the lock) so exchanges already queued on
+        # it observe closure in _ensure_conn instead of reconnecting
+        self._closed = True  # l5d: ignore[lock-guard] — monotonic flag set-before-lock: queued exchanges must see it when they win the lock
+        # break any wedged in-flight exchange BEFORE waiting for the
+        # lock: a peer that blackholes the reply would otherwise hold
+        # the lock (and this close) forever. Closing the transport is a
+        # read-only poke — the exchange's own error path runs teardown.
+        w = self._writer
+        if w is not None:
+            try:
+                w.close()
+            except (OSError, RuntimeError):  # transport already detached
+                pass
+        async with self._lock:
+            # serialize the final teardown with a dispatch that was
+            # mid-connect when the flag published (its fresh writer
+            # must not outlive close)
+            self._teardown()  # l5d: ignore[await-atomicity] — the pre-lock read is a fail-fast alias only; this locked teardown re-nulls whatever generation is current

@@ -187,6 +187,7 @@ class FailureAccrualService(Service):
         try:
             rsp = await self._svc(req)
         except Exception:
+            # l5d: ignore[await-atomicity] — advisory probe state machine: only the task that WON the pre-await probe slot mutates the backoff schedule; concurrent stampede writes of _dead_until are deliberate (see class docstring)
             self._on_failure(probing)
             raise
         status = getattr(rsp, "status", 200)
@@ -306,10 +307,10 @@ class FailFastService(Service):
                 # a FAILED PROBE advances the backoff; concurrent
                 # in-flight failures from one outage event must not
                 # each double it
-                self._probing = False
+                self._probing = False  # l5d: ignore[await-atomicity] — only the task that won the pre-await probe slot (probing=True, claimed atomically) releases it
                 self._backoff_s = min(self._backoff_s * 2,
                                       self._MAX_BACKOFF_S)
-                self._down_until = now + self._backoff_s
+                self._down_until = now + self._backoff_s  # l5d: ignore[await-atomicity] — probe-slot holder owns the backoff schedule; non-probe stampede writes take the elif arm by design
             elif self._down_until is None:
                 self._down_until = now + self._backoff_s
             raise

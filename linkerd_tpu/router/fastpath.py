@@ -240,16 +240,19 @@ class FastPathController:
                 ring.append((fv, None))
 
     async def close(self) -> None:
-        for t in self._tasks:
+        # detach the task list BEFORE awaiting: a start() interleaving
+        # with the cancel waits would otherwise have its fresh loops
+        # clobbered by the assignment below and leak, still running
+        tasks, self._tasks = self._tasks, []
+        for t in tasks:
             t.cancel()
-        for t in self._tasks:
+        for t in tasks:
             try:
                 await t
             except asyncio.CancelledError:
                 pass
             except Exception as e:  # noqa: BLE001 — loop crashes were
                 log.debug("fastpath loop exit: %r", e)  # already logged
-        self._tasks = []
         for r in self._routes.values():
             r.close()
         self._routes.clear()

@@ -115,7 +115,7 @@ class _ReplayStream:
             raise self._terminal_reset
         if self._tail is not None:
             frame = await self._tail.read()
-            self.at_end = self._tail.at_end
+            self.at_end = self._tail.at_end  # l5d: ignore[await-atomicity] — streams are single-consumer by contract (one pump per stream); at_end mirrors the tail we just read from
             return frame
         raise EOFError("stream already ended")
 

@@ -439,10 +439,12 @@ class InProcessScorer(Scorer):
             await asyncio.to_thread(self.restore, snap)
             await self.score(x)
         finally:
-            self.params, self._opt_state = params, opt_state
-            self._mu, self._var, self._norm_initialized = mu, var, init
+            # startup-sequenced: warmup runs before the telemeter's drain
+            # loop starts, so no concurrent fit/score exists to clobber
+            self.params, self._opt_state = params, opt_state  # l5d: ignore[await-atomicity] — warmup is startup-sequenced; no concurrent mutator yet
+            self._mu, self._var, self._norm_initialized = mu, var, init  # l5d: ignore[await-atomicity] — warmup is startup-sequenced; no concurrent mutator yet
             self._place_norm()
-            self._step = step
+            self._step = step  # l5d: ignore[await-atomicity] — warmup is startup-sequenced; no concurrent mutator yet
 
     def _prep(self, x: np.ndarray) -> np.ndarray:
         """Pad + cast to the f32 transfer dtype. Raw features ship as-is:

@@ -1,0 +1,287 @@
+"""Deterministic interleaving scheduler + write-tracking sanitizer.
+
+The dynamic half of l5drace (tools/analysis/race): every static finding
+gets a *reproducing or refuting* test by driving the implicated code
+through adversarial interleavings — deterministically, so a failure is
+a seed, not a flake.
+
+Model: tests tag the await points they want to control. Production code
+is driven through its REAL awaits by injecting gated dependencies (a
+fake connect, a fake scorer, a gated downstream service) whose awaits
+call ``await sched.point("tag")``. The scheduler parks every point and
+releases them one at a time — in an explicit order (``order=[...]``)
+when reproducing a known interleaving, or seeded-randomly when
+exploring. ``explore()`` sweeps seeds and reports the first schedule
+that violates an invariant, printing the release history needed to
+replay it.
+
+The sanitizer half (``track``/``lost_updates``) swaps an object's class
+for a recording subclass so every attribute read/write is logged with
+the owning task; ``lost_updates`` then reports the torn
+read-modify-write shape (task A reads, task B writes, task A writes —
+A's write was computed from a stale value), which is exactly what the
+static ``await-atomicity`` rule predicts.
+
+Example::
+
+    sched = DeterministicScheduler(order=["connect", "close"])
+
+    async def caller():
+        await client(req)            # its fake connect parks at "connect"
+
+    async def closer():
+        await sched.point("close")   # sequenced by the scheduler
+        await client.close()
+
+    results = sched.run_sync(caller(), closer())
+"""
+
+from __future__ import annotations
+
+import asyncio
+import fnmatch
+import itertools
+import random
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "DeterministicScheduler", "ScheduleDeadlock", "explore",
+    "track", "access_log", "lost_updates", "clear_log",
+]
+
+
+class ScheduleDeadlock(RuntimeError):
+    """No parked points, tasks not finishing: the schedule wedged (or
+    the code under test awaits something the test never resolves)."""
+
+
+class DeterministicScheduler:
+    """Releases tagged await points one at a time in a deterministic
+    order.
+
+    - ``order``: explicit release sequence (fnmatch patterns matched
+      against tags, consumed front to back). Use it to pin a known-bad
+      interleaving in a regression test.
+    - ``seed``: once ``order`` is exhausted (or absent), remaining
+      releases are chosen by this seeded RNG — reproducible exploration.
+
+    ``history`` records the tags actually released, in order: paste it
+    into ``order=[...]`` to replay a failing run exactly.
+    """
+
+    def __init__(self, seed: int = 0,
+                 order: Optional[Sequence[str]] = None):
+        self._rng = random.Random(seed)
+        self._order: List[str] = list(order or [])
+        self._parked: "Dict[int, Tuple[str, asyncio.Future]]" = {}
+        self._seq = itertools.count()
+        self._open = False  # True once run() finishes: points pass through
+        self.history: List[str] = []
+
+    # -- tagged await points ---------------------------------------------
+    async def point(self, tag: str) -> None:
+        """Park here until the scheduler releases this point."""
+        if self._open:
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._parked[next(self._seq)] = (tag, fut)
+        await fut
+
+    def gated(self, tag: str, fn: Callable) -> Callable:
+        """Wrap an async callable so every invocation parks at ``tag``
+        first — the injection seam for fakes (connects, scorers, ...)."""
+        async def wrapped(*a, **kw):
+            await self.point(tag)
+            return await fn(*a, **kw)
+        return wrapped
+
+    # -- release policy ---------------------------------------------------
+    def _release_one(self) -> bool:
+        if not self._parked:
+            return False
+        keys = sorted(self._parked)
+        choice = None
+        while self._order and choice is None:
+            pattern = self._order[0]
+            for k in keys:
+                if fnmatch.fnmatch(self._parked[k][0], pattern):
+                    choice = k
+                    break
+            if choice is None:
+                # pattern matches nothing parked yet: wait for it (do
+                # not skip — explicit orders are exact reproductions)
+                return False
+            self._order.pop(0)
+        if choice is None:
+            choice = self._rng.choice(keys)
+        tag, fut = self._parked.pop(choice)
+        self.history.append(tag)
+        if not fut.done():
+            fut.set_result(None)
+        return True
+
+    # -- driving ----------------------------------------------------------
+    async def run(self, *aws, timeout: float = 5.0,
+                  max_steps: int = 10_000) -> List[Any]:
+        """Drive the given coroutines to completion, one point release
+        at a time. Returns results in order (exceptions as values)."""
+        tasks = [asyncio.ensure_future(a) for a in aws]
+        try:
+            steps = 0
+            while not all(t.done() for t in tasks):
+                steps += 1
+                if steps > max_steps:
+                    raise ScheduleDeadlock(
+                        f"no convergence after {max_steps} steps; "
+                        f"history={self.history}")
+                # let every runnable task advance to its next await
+                for _ in range(20):
+                    if all(t.done() for t in tasks):
+                        break
+                    await asyncio.sleep(0)
+                if all(t.done() for t in tasks):
+                    break
+                if self._release_one():
+                    continue
+                # tasks blocked on non-scheduler awaits (locks held by a
+                # parked task resolve once we release it; real timers /
+                # IO get a bounded grace)
+                before = sum(t.done() for t in tasks)
+                await asyncio.wait(
+                    [t for t in tasks if not t.done()], timeout=timeout,
+                    return_when=asyncio.FIRST_COMPLETED)
+                if sum(t.done() for t in tasks) == before:
+                    raise ScheduleDeadlock(
+                        f"no release possible and no task progress "
+                        f"(pending order={self._order!r}, parked="
+                        f"{[t for t, _ in self._parked.values()]}); "
+                        f"history={self.history}")
+        except BaseException:
+            # a wedged schedule must not strand live SUT tasks: cancel
+            # them so asyncio.run() doesn't destroy them mid-flight
+            # ("Task was destroyed but it is pending") and their cleanup
+            # paths actually run
+            for t in tasks:
+                t.cancel()
+            raise
+        finally:
+            # open the gates so cleanup paths (cancellation, context
+            # managers) never hang on an unreleased point — then retire
+            # every task before control leaves this frame
+            self._open = True
+            for _tag, fut in self._parked.values():
+                if not fut.done():
+                    fut.set_result(None)
+            self._parked.clear()
+            await asyncio.gather(*tasks, return_exceptions=True)
+        return await asyncio.gather(*tasks, return_exceptions=True)
+
+    def run_sync(self, *aws, timeout: float = 5.0) -> List[Any]:
+        """asyncio.run wrapper for plain (non-async) tests."""
+        return asyncio.run(self.run(*aws, timeout=timeout))
+
+
+def explore(mk: Callable[["DeterministicScheduler"], Sequence],
+            invariant: Callable[[List[Any]], None],
+            seeds: Sequence[int] = range(32),
+            timeout: float = 5.0) -> Optional[Tuple[int, List[str], str]]:
+    """Sweep seeds; returns (seed, release history, failure repr) for the
+    first schedule whose results violate ``invariant`` (which raises
+    AssertionError to object), or None when every schedule holds.
+
+    ``mk(sched)`` builds a FRESH system under test per seed and returns
+    the coroutines to drive. The sanitizer log is cleared per seed:
+    stale events from a previous seed's (possibly id-reused) objects
+    must never pair into phantom lost updates.
+    """
+    for seed in seeds:
+        clear_log()
+        sched = DeterministicScheduler(seed=seed)
+        results = sched.run_sync(*mk(sched), timeout=timeout)
+        try:
+            invariant(results)
+        except AssertionError as e:
+            return seed, list(sched.history), repr(e)
+    return None
+
+
+# -- write-tracking sanitizer -------------------------------------------------
+
+# (task_name, op, attr, id(obj)) in global program order. One module-level
+# log keeps multi-object scenarios ordered against each other.
+_LOG: List[Tuple[str, str, str, int]] = []
+
+
+def _task_name() -> str:
+    try:
+        t = asyncio.current_task()
+    except RuntimeError:
+        t = None
+    return t.get_name() if t is not None else "<no-task>"
+
+
+def clear_log() -> None:
+    del _LOG[:]
+
+
+def access_log(attr: Optional[str] = None) -> List[Tuple[str, str, str, int]]:
+    return [e for e in _LOG if attr is None or e[2] == attr]
+
+
+_TRACKED_CLASSES: Dict[Tuple[type, frozenset], type] = {}
+
+
+def track(obj, attrs: Sequence[str]):
+    """Swap ``obj``'s class for a recording subclass: every read/write
+    of the named attributes is appended to the module log with the
+    current task's name. Returns ``obj`` (mutated in place)."""
+    watched = frozenset(attrs)
+    key = (type(obj), watched)
+    cls = _TRACKED_CLASSES.get(key)
+    if cls is None:
+        base = type(obj)
+
+        def __getattribute__(self, name):  # noqa: N807
+            if name in watched:
+                _LOG.append((_task_name(), "r", name, id(self)))
+            return base.__getattribute__(self, name)
+
+        def __setattr__(self, name, value):  # noqa: N807
+            if name in watched:
+                _LOG.append((_task_name(), "w", name, id(self)))
+            base.__setattr__(self, name, value)
+
+        cls = type(f"Tracked{base.__name__}", (base,), {
+            "__slots__": (),  # keep layout compatible with slotted bases
+            "__getattribute__": __getattribute__,
+            "__setattr__": __setattr__,
+        })
+        _TRACKED_CLASSES[key] = cls
+    obj.__class__ = cls
+    return obj
+
+
+def lost_updates(attr: str) -> List[Tuple[str, str]]:
+    """Torn read-modify-write detector: (victim_task, clobbering_task)
+    pairs where victim read ``attr``, another task wrote it, then victim
+    wrote — the victim's write was computed from a stale value. This is
+    the dynamic confirmation of the static ``await-atomicity`` rule."""
+    out: List[Tuple[str, str]] = []
+    events = access_log(attr)
+    last_read_idx: Dict[Tuple[str, int], int] = {}
+    for i, (task, op, _a, oid) in enumerate(events):
+        if op == "r":
+            last_read_idx[(task, oid)] = i
+        else:
+            start = last_read_idx.get((task, oid))
+            if start is None:
+                continue
+            for j in range(start + 1, i):
+                other_task, other_op, _oa, other_oid = events[j]
+                if (other_oid == oid and other_op == "w"
+                        and other_task != task):
+                    out.append((task, other_task))
+                    break
+            # this write refreshes the task's view
+            last_read_idx.pop((task, oid), None)
+    return out

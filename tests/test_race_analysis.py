@@ -1,0 +1,1049 @@
+"""l5drace self-tests + deterministic-interleaving regression tests.
+
+Three layers, mirroring tests/test_static_analysis.py:
+
+1. every race rule fires on a positive fixture and stays quiet on the
+   matching negative (tiny synthetic repos under tmp_path);
+2. the real tree is clean — zero unsuppressed findings over the race
+   scope, every suppression justified (the tier-1 gate);
+3. every race the analyzer found and we FIXED has a deterministic
+   interleaving test here: the schedule that breaks the pre-fix code is
+   replayed against the fixed code (linkerd_tpu/testing/schedules), so
+   a regression turns the exact race back into a red test, not a flake.
+"""
+
+import asyncio
+import os
+import textwrap
+
+import pytest
+
+from linkerd_tpu.testing.schedules import (
+    DeterministicScheduler, ScheduleDeadlock, access_log, clear_log,
+    explore, lost_updates, track,
+)
+from tools.analysis import race_rule_ids
+from tools.analysis.race import DEFAULT_SCOPE, run_race_analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def mk_repo(tmp_path, files):
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return str(tmp_path)
+
+
+def findings_of(tmp_path, files, rule):
+    root = mk_repo(tmp_path, files)
+    out = run_race_analysis(["linkerd_tpu"], repo_root=root, rules=[rule])
+    return [f for f in out if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# 1. rule fixtures
+# ---------------------------------------------------------------------------
+
+
+class TestAwaitAtomicity:
+    def test_torn_rmw_fires(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                class Gauge:
+                    def __init__(self):
+                        self.count = 0
+                    async def bump(self, svc):
+                        v = self.count
+                        await svc()
+                        self.count = v + 1
+                    def reset(self):
+                        self.count = 0
+            """}, "await-atomicity")
+        assert len(got) == 1 and "self.count" in got[0].message
+        assert "straddle" in got[0].message
+
+    def test_lock_spanning_window_is_clean(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                import asyncio
+                class Gauge:
+                    def __init__(self):
+                        self.count = 0
+                        self._lock = asyncio.Lock()
+                    async def bump(self, svc):
+                        async with self._lock:
+                            v = self.count
+                            await svc()
+                            self.count = v + 1
+                    async def read(self):
+                        async with self._lock:
+                            return self.count
+            """}, "await-atomicity")
+        assert got == []
+
+    def test_atomic_augassign_counters_are_clean(self, tmp_path):
+        # the admission-filter idiom: each += / -= is atomic in asyncio
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                class F(Filter):
+                    def __init__(self):
+                        self.pending = 0
+                    async def apply(self, req, service):
+                        self.pending += 1
+                        try:
+                            return await service(req)
+                        finally:
+                            self.pending -= 1
+            """}, "await-atomicity")
+        assert got == []
+
+    def test_reread_after_await_is_clean(self, tmp_path):
+        # the sanctioned fix idiom the rule message recommends
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                class Gauge:
+                    def __init__(self):
+                        self.count = 0
+                    async def bump(self, svc):
+                        v = self.count
+                        await svc()
+                        v = self.count
+                        self.count = v + 1
+                    def reset(self):
+                        self.count = 0
+            """}, "await-atomicity")
+        assert got == []
+
+    def test_while_test_read_is_not_stale(self, tmp_path):
+        # `while not self.closed:` re-evaluates after every await in the
+        # loop — pairing it with a teardown write is a false positive
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/protocol/x.py": """
+                class Loop:
+                    def __init__(self):
+                        self.closed = False
+                    async def run(self, step):
+                        while not self.closed:
+                            await step()
+                    async def close(self):
+                        self.closed = True
+            """}, "await-atomicity")
+        assert got == []
+
+    def test_stale_entry_guard_fires(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/protocol/x.py": """
+                class Client(Service):
+                    def __init__(self):
+                        self.closed = False
+                        self.pending = 0
+                    async def call(self, req, connect):
+                        if self.closed:
+                            raise ConnectionError("closed")
+                        conn = await connect()
+                        self.pending += 1
+                        return conn
+                    async def close(self):
+                        self.closed = True
+            """}, "await-atomicity")
+        assert len(got) == 1 and "guard on self.closed" in got[0].message
+        assert "never re-checked" in got[0].message
+
+    def test_rechecked_guard_is_clean(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/protocol/x.py": """
+                class Client(Service):
+                    def __init__(self):
+                        self.closed = False
+                        self.pending = 0
+                    async def call(self, req, connect):
+                        if self.closed:
+                            raise ConnectionError("closed")
+                        conn = await connect()
+                        if self.closed:
+                            raise ConnectionError("closed during connect")
+                        self.pending += 1
+                        return conn
+                    async def close(self):
+                        self.closed = True
+            """}, "await-atomicity")
+        assert got == []
+
+    def test_out_of_scope_package_is_ignored(self, tmp_path):
+        # control-plane startup code is single-task; not race scope
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/namerd/x.py": """
+                class Gauge:
+                    def __init__(self):
+                        self.count = 0
+                    async def bump(self, svc):
+                        v = self.count
+                        await svc()
+                        self.count = v + 1
+                    def reset(self):
+                        self.count = 0
+            """}, "await-atomicity")
+        assert got == []
+
+
+class TestLockGuard:
+    FILES = {
+        "linkerd_tpu/protocol/x.py": """
+            import asyncio
+            class Conn:
+                def __init__(self):
+                    self._lock = asyncio.Lock()
+                    self.writer = None
+                async def dispatch(self, connect):
+                    async with self._lock:
+                        if self.writer is None:
+                            self.writer = await connect()
+                        return self.writer
+                async def close(self):
+                    self.writer = None
+        """}
+
+    def test_unguarded_write_fires(self, tmp_path):
+        got = findings_of(tmp_path, self.FILES, "lock-guard")
+        assert len(got) == 1
+        assert "close" in got[0].message and "_lock" in got[0].message
+
+    def test_write_under_lock_is_clean(self, tmp_path):
+        files = {"linkerd_tpu/protocol/x.py":
+                 self.FILES["linkerd_tpu/protocol/x.py"].replace(
+                     "async def close(self):\n                    "
+                     "self.writer = None",
+                     "async def close(self):\n                    "
+                     "async with self._lock:\n                        "
+                     "self.writer = None")}
+        got = findings_of(tmp_path, files, "lock-guard")
+        assert got == []
+
+    def test_helper_called_only_under_lock_is_inferred_held(self, tmp_path):
+        # the _ensure_conn idiom: every call site holds the lock, so the
+        # helper's writes are lock-held even without a lexical region
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/protocol/x.py": """
+                import asyncio
+                class Conn:
+                    def __init__(self):
+                        self._lock = asyncio.Lock()
+                        self.writer = None
+                    async def _ensure(self, connect):
+                        if self.writer is None:
+                            self.writer = await connect()
+                    async def dispatch(self, connect):
+                        async with self._lock:
+                            await self._ensure(connect)
+                            return self.writer
+                    async def ping(self, connect):
+                        async with self._lock:
+                            await self._ensure(connect)
+            """}, "lock-guard")
+        assert got == []
+
+    def test_sync_helper_inlined_into_async_caller(self, tmp_path):
+        # close() tearing down through a sync helper is still an
+        # unguarded write (the ThriftClient._teardown shape)
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/protocol/x.py": """
+                import asyncio
+                class Conn:
+                    def __init__(self):
+                        self._lock = asyncio.Lock()
+                        self.writer = None
+                    def _teardown(self):
+                        self.writer = None
+                    async def dispatch(self, connect):
+                        async with self._lock:
+                            if self.writer is None:
+                                self.writer = await connect()
+                            return self.writer
+                    async def close(self):
+                        self._teardown()
+            """}, "lock-guard")
+        assert len(got) == 1 and "via _teardown()" in got[0].message
+
+
+class TestLockOrder:
+    def test_ordering_cycle_fires(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                import asyncio
+                class Pair:
+                    def __init__(self):
+                        self._alock = asyncio.Lock()
+                        self._block = asyncio.Lock()
+                    async def ab(self):
+                        async with self._alock:
+                            async with self._block:
+                                return 1
+                    async def ba(self):
+                        async with self._block:
+                            async with self._alock:
+                                return 2
+            """}, "lock-order")
+        assert len(got) == 1 and "deadlock" in got[0].message
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                import asyncio
+                class Pair:
+                    def __init__(self):
+                        self._alock = asyncio.Lock()
+                        self._block = asyncio.Lock()
+                    async def ab(self):
+                        async with self._alock:
+                            async with self._block:
+                                return 1
+                    async def ab2(self):
+                        async with self._alock:
+                            async with self._block:
+                                return 2
+            """}, "lock-order")
+        assert got == []
+
+
+class TestLockRelease:
+    def test_acquire_without_release_fires(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                import asyncio
+                class Q:
+                    def __init__(self):
+                        self._sem = asyncio.Semaphore(1)
+                    async def take(self):
+                        await self._sem.acquire()
+                        return 1
+            """}, "lock-release")
+        assert len(got) == 1 and "acquire()" in got[0].message
+
+    def test_finally_release_is_clean(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                import asyncio
+                class Q:
+                    def __init__(self):
+                        self._sem = asyncio.Semaphore(1)
+                    async def take(self, fn):
+                        await self._sem.acquire()
+                        try:
+                            return await fn()
+                        finally:
+                            self._sem.release()
+            """}, "lock-release")
+        assert got == []
+
+    def test_cross_method_release_is_trusted(self, tmp_path):
+        # the connection-pool checkout/checkin shape
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/protocol/x.py": """
+                import asyncio
+                class Pool:
+                    def __init__(self):
+                        self._sem = asyncio.Semaphore(4)
+                    async def checkout(self):
+                        await self._sem.acquire()
+                        return object()
+                    def checkin(self, conn):
+                        self._sem.release()
+            """}, "lock-release")
+        assert got == []
+
+
+class TestRaceSuppressions:
+    RACY = """
+        class Gauge:
+            def __init__(self):
+                self.count = 0
+            async def bump(self, svc):
+                v = self.count
+                await svc()
+                self.count = v + 1  {comment}
+            def reset(self):
+                self.count = 0
+    """
+
+    def test_justified_suppression_suppresses(self, tmp_path):
+        root = mk_repo(tmp_path, {"linkerd_tpu/router/x.py":
+                                  self.RACY.format(
+            comment="# l5d: ignore[await-atomicity] — single-task by "
+                    "construction here")})
+        out = run_race_analysis(["linkerd_tpu"], repo_root=root)
+        hits = [f for f in out if f.rule == "await-atomicity"]
+        assert len(hits) == 1 and hits[0].suppressed
+        assert "single-task" in hits[0].justification
+
+    def test_unjustified_suppression_does_not_suppress(self, tmp_path):
+        # ...and the lint suite's meta-rule reports the bare ignore
+        from tools.analysis import run_analysis
+        root = mk_repo(tmp_path, {"linkerd_tpu/router/x.py":
+                                  self.RACY.format(
+            comment="# l5d: ignore[await-atomicity]")})
+        out = run_race_analysis(["linkerd_tpu"], repo_root=root)
+        hits = [f for f in out if f.rule == "await-atomicity"]
+        assert len(hits) == 1 and not hits[0].suppressed
+        lint = run_analysis(["linkerd_tpu"], repo_root=root)
+        sup = [f for f in lint if f.rule == "suppression"]
+        assert len(sup) == 1 and "justification" in sup[0].message
+
+    def test_race_rule_names_are_known_to_lint_meta_rule(self, tmp_path):
+        # race suppressions live in the same .py files lint scans; their
+        # rule ids must not be reported as unknown
+        from tools.analysis import run_analysis
+        root = mk_repo(tmp_path, {"linkerd_tpu/router/x.py":
+                                  self.RACY.format(
+            comment="# l5d: ignore[await-atomicity] — justified")})
+        lint = run_analysis(["linkerd_tpu"], repo_root=root)
+        assert [f for f in lint if f.rule == "suppression"] == []
+
+
+class TestRaceCLI:
+    def test_rule_inventory(self):
+        assert race_rule_ids() == [
+            "await-atomicity", "lock-guard", "lock-order", "lock-release",
+        ]
+
+    def test_cli_clean_tree_exits_zero(self, capsys):
+        from tools.analysis.__main__ import main
+        assert main(["race"]) == 0
+        assert "l5drace" in capsys.readouterr().out
+
+    def test_cli_json_format(self, capsys):
+        import json
+        from tools.analysis.__main__ import main
+        assert main(["race", "--format", "json"]) == 0
+        blob = json.loads(capsys.readouterr().out)
+        assert blob["mode"] == "race"
+        assert blob["unsuppressed"] == []
+        assert blob["suppressed_count"] >= 1
+
+    def test_cli_unknown_rule_is_usage_error(self):
+        from tools.analysis.__main__ import main
+        assert main(["race", "--rule", "no-such-rule"]) == 2
+
+
+class TestRepoGate:
+    """The tier-1 gate: the race suite over the real tree."""
+
+    def test_repo_has_zero_unsuppressed_findings(self):
+        out = run_race_analysis(list(DEFAULT_SCOPE), repo_root=REPO)
+        unsuppressed = [f for f in out if not f.suppressed]
+        assert unsuppressed == [], "\n" + "\n".join(
+            f.show() for f in unsuppressed)
+
+    def test_every_race_suppression_is_justified(self):
+        out = run_race_analysis(list(DEFAULT_SCOPE), repo_root=REPO)
+        suppressed = [f for f in out if f.suppressed]
+        assert suppressed, "expected the documented benign findings"
+        for f in suppressed:
+            assert f.justification.strip(), f.show()
+
+
+# ---------------------------------------------------------------------------
+# 2. the deterministic scheduler + sanitizer themselves
+# ---------------------------------------------------------------------------
+
+
+class Counter:
+    def __init__(self):
+        self.value = 0
+
+
+class TestScheduler:
+    def test_reproduces_torn_rmw_and_sanitizer_flags_it(self):
+        def mk(sched):
+            c = Counter()
+            clear_log()
+            track(c, ["value"])
+
+            async def bump(tag):
+                v = c.value
+                await sched.point(tag)
+                c.value = v + 1
+            return c, [bump("a"), bump("b")]
+
+        # every schedule loses one update: both tasks read before either
+        # writes (they park between read and write)
+        sched = DeterministicScheduler(order=["a", "b"])
+        c, coros = mk(sched)
+        sched.run_sync(*coros)
+        assert c.value == 1  # not 2: the lost update, deterministically
+        assert sched.history == ["a", "b"]
+        assert lost_updates("value"), "sanitizer missed the torn RMW"
+
+    def test_explicit_order_replays_exactly(self):
+        seen = []
+
+        async def step(sched, tag):
+            await sched.point(tag)
+            seen.append(tag)
+
+        sched = DeterministicScheduler(order=["c", "a", "b"])
+        sched.run_sync(step(sched, "a"), step(sched, "b"),
+                       step(sched, "c"))
+        assert seen == ["c", "a", "b"]
+
+    def test_seeded_runs_are_reproducible(self):
+        def run(seed):
+            sched = DeterministicScheduler(seed=seed)
+
+            async def step(tag):
+                await sched.point(tag)
+            sched.run_sync(step("a"), step("b"), step("c"))
+            return sched.history
+
+        assert run(7) == run(7)
+
+    def test_deadlock_is_reported_not_hung(self):
+        async def wedged():
+            await asyncio.get_running_loop().create_future()
+
+        sched = DeterministicScheduler()
+        with pytest.raises(ScheduleDeadlock):
+            sched.run_sync(wedged(), timeout=0.1)
+
+    def test_atomic_counters_show_no_lost_updates(self):
+        # negative control for the sanitizer: += with no await between
+        # read and write never tears, under any schedule
+        def mk(sched):
+            c = Counter()
+            clear_log()
+            track(c, ["value"])
+
+            async def bump(tag):
+                await sched.point(tag)
+                c.value += 1
+            return [bump("a"), bump("b")]
+
+        def invariant(_results):
+            assert lost_updates("value") == []
+
+        assert explore(mk, invariant, seeds=range(8)) is None
+
+
+# ---------------------------------------------------------------------------
+# 3. interleaving regressions for the fixed races
+# ---------------------------------------------------------------------------
+
+
+class FakeTransport:
+    def get_write_buffer_size(self):
+        return 0
+
+
+class FakeWriter:
+    def __init__(self):
+        self.closed = False
+        self.transport = FakeTransport()
+        self.reader = None        # EOF'd on close, like a real transport
+        self.drain_forever = False  # simulate a peer that stopped reading
+        self._drain_fut = None
+
+    def is_closing(self):
+        return self.closed
+
+    def close(self):
+        self.closed = True
+        if self.reader is not None and not self.reader.at_eof():
+            self.reader.feed_eof()
+        if self._drain_fut is not None and not self._drain_fut.done():
+            # closing the transport aborts parked drain() waiters
+            self._drain_fut.set_exception(
+                ConnectionResetError("transport closed"))
+
+    def write(self, data):
+        pass
+
+    async def drain(self):
+        if self.drain_forever and not self.closed:
+            self._drain_fut = asyncio.get_running_loop().create_future()
+            await self._drain_fut
+
+
+class GatedConnect:
+    """Monkeypatches asyncio.open_connection with a scheduler-gated fake.
+    Closing a writer feeds EOF to its reader (as a real transport
+    teardown does), so reads wedged on a dead connection fail over."""
+
+    def __init__(self, sched, reader_bytes=b"", wedge_drain=False):
+        self.sched = sched
+        self.reader_bytes = reader_bytes
+        self.wedge_drain = wedge_drain
+        self.writers = []
+        self._orig = None
+
+    async def _open(self, host, port, **kw):
+        await self.sched.point("connect")
+        reader = asyncio.StreamReader()
+        if self.reader_bytes:
+            reader.feed_data(self.reader_bytes)
+        writer = FakeWriter()
+        writer.reader = reader
+        if self.wedge_drain:
+            writer.drain_forever = True
+        self.writers.append(writer)
+        await self.sched.point("connect-done")
+        return reader, writer
+
+    def __enter__(self):
+        self._orig = asyncio.open_connection
+        asyncio.open_connection = self._open
+        return self
+
+    def __exit__(self, *exc):
+        asyncio.open_connection = self._orig
+
+
+class TestHttpClientCloseRace:
+    """await-atomicity @ protocol/http/client.py __call__: close() lands
+    between the entry guard and the checkout — pre-fix, the request
+    dispatched on the closed client and the fresh socket leaked."""
+
+    def test_close_between_guard_and_checkout(self):
+        from linkerd_tpu.protocol.http.client import HttpClient
+        from linkerd_tpu.protocol.http.message import Request
+
+        async def main():
+            sched = DeterministicScheduler(
+                order=["connect", "close", "connect-done"])
+            with GatedConnect(
+                    sched,
+                    reader_bytes=b"HTTP/1.1 200 OK\r\n"
+                                 b"content-length: 0\r\n\r\n") as gc:
+                client = HttpClient("127.0.0.1", 1)
+
+                async def caller():
+                    try:
+                        await client(Request(method="GET", uri="/"))
+                    except ConnectionError:
+                        return "refused"
+                    return "dispatched"
+
+                async def closer():
+                    await sched.point("close")
+                    await client.close()
+
+                results = await sched.run(caller(), closer(), timeout=1.0)
+                assert results[0] == "refused", (
+                    f"request rode a closed client: {results[0]}")
+                assert gc.writers and gc.writers[0].closed, (
+                    "connection leaked past close()")
+
+        asyncio.run(main())
+
+
+class TestH2ClientCloseRace:
+    """await-atomicity @ protocol/h2/client.py _get_conn/__call__: the
+    singleton connect finishing after close() cached a live connection
+    (read loop and all) on a dead client — pre-fix it leaked forever."""
+
+    def test_close_during_handshake(self):
+        from linkerd_tpu.protocol.h2.client import H2Client
+        from linkerd_tpu.protocol.h2.messages import H2Request
+
+        async def main():
+            sched = DeterministicScheduler(
+                order=["connect", "close", "connect-done"])
+            with GatedConnect(sched) as gc:
+                client = H2Client("127.0.0.1", 1)
+
+                async def caller():
+                    try:
+                        await client(H2Request(method="GET", path="/",
+                                               authority="t"))
+                    except ConnectionError:
+                        return "refused"
+                    return "dispatched"
+
+                async def closer():
+                    await sched.point("close")
+                    await client.close()
+
+                results = await sched.run(caller(), closer(), timeout=1.0)
+                assert results[0] == "refused", (
+                    f"request rode a closed h2 client: {results[0]}")
+                assert client._conn is None, "dead client cached a conn"
+                assert gc.writers and gc.writers[0].closed, (
+                    "h2 connection (and its read loop) leaked past close()")
+
+        asyncio.run(main())
+
+
+class TestMuxClientCloseRace:
+    """lock-guard @ protocol/mux/client.py close(): teardown ran outside
+    _lock, so a dispatch parked in _ensure_conn reconnected AFTER the
+    teardown — a leaked socket + read loop on a closed client."""
+
+    def test_close_during_connect(self):
+        from linkerd_tpu.protocol.mux.client import MuxClient
+
+        async def main():
+            sched = DeterministicScheduler(
+                order=["close", "connect", "connect-done"])
+            with GatedConnect(sched) as gc:
+                client = MuxClient("127.0.0.1", 1)
+
+                async def caller():
+                    try:
+                        await client.ping()
+                    except ConnectionError:
+                        return "refused"
+                    return "ok"
+
+                async def closer():
+                    await sched.point("close")
+                    await client.close()
+
+                results = await sched.run(caller(), closer(), timeout=1.0)
+                assert isinstance(results[0], str), results[0]
+                assert client._writer is None, (
+                    "reconnect leaked a writer past close()")
+                assert all(w.closed for w in gc.writers), (
+                    "mux socket leaked past close()")
+
+        asyncio.run(main())
+
+
+class TestThriftClientCloseRace:
+    """lock-guard @ protocol/thrift/client.py close(): same shape as mux
+    — teardown outside the exchange lock let a queued exchange
+    reconnect after close()."""
+
+    def test_close_during_connect(self):
+        from linkerd_tpu.protocol.thrift.client import ThriftClient
+        from linkerd_tpu.protocol.thrift.codec import ONEWAY, ThriftCall
+
+        async def main():
+            sched = DeterministicScheduler(
+                order=["close", "connect", "connect-done"])
+            with GatedConnect(sched) as gc:
+                client = ThriftClient("127.0.0.1", 1)
+                call = ThriftCall(payload=b"x", name="m", seqid=1,
+                                  type=ONEWAY)
+
+                async def caller():
+                    try:
+                        await client(call)
+                    except ConnectionError:
+                        return "refused"
+                    return "ok"
+
+                async def closer():
+                    await sched.point("close")
+                    await client.close()
+
+                results = await sched.run(caller(), closer(), timeout=1.0)
+                assert isinstance(results[0], str), results[0]
+                assert client._writer is None, (
+                    "reconnect leaked a writer past close()")
+                assert all(w.closed for w in gc.writers), (
+                    "thrift socket leaked past close()")
+                # and once closed, no silent reconnect ever again
+                with pytest.raises(ConnectionError):
+                    await client(call)
+
+        asyncio.run(main())
+
+
+class TestCloseNeverHangs:
+    """The lock-based close fixes must not trade the reconnect race for
+    a close-that-hangs: a wedged in-flight exchange (blackholed reply,
+    peer that stopped reading) holds the exchange lock indefinitely, so
+    close() pokes the transport BEFORE waiting for the lock."""
+
+    def test_thrift_close_breaks_a_blackholed_exchange(self):
+        from linkerd_tpu.protocol.thrift.client import ThriftClient
+        from linkerd_tpu.protocol.thrift.codec import CALL, ThriftCall
+
+        async def main():
+            sched = DeterministicScheduler(
+                order=["connect", "connect-done", "close"])
+            with GatedConnect(sched) as gc:  # reply never arrives
+                client = ThriftClient("127.0.0.1", 1)
+                call = ThriftCall(payload=b"x", name="m", seqid=1,
+                                  type=CALL)
+
+                async def caller():
+                    try:
+                        await client(call)
+                    except ConnectionError:
+                        return "failed-fast"
+                    return "ok"
+
+                async def closer():
+                    await sched.point("close")
+                    await client.close()
+                    return "closed"
+
+                results = await sched.run(caller(), closer(), timeout=1.0)
+                assert results[1] == "closed", (
+                    f"close() hung behind the wedged exchange: "
+                    f"{results[1]!r}")
+                assert results[0] == "failed-fast", results[0]
+                assert all(w.closed for w in gc.writers)
+
+        asyncio.run(main())
+
+    def test_mux_close_breaks_a_wedged_drain(self):
+        from linkerd_tpu.protocol.mux.client import MuxClient
+
+        async def main():
+            sched = DeterministicScheduler(
+                order=["connect", "connect-done", "close"])
+            with GatedConnect(sched, wedge_drain=True) as gc:
+                client = MuxClient("127.0.0.1", 1)
+
+                async def caller():
+                    try:
+                        await client.ping()
+                    except (ConnectionError, ConnectionResetError):
+                        return "failed-fast"
+                    return "ok"
+
+                async def closer():
+                    await sched.point("close")
+                    await client.close()
+                    return "closed"
+
+                results = await sched.run(caller(), closer(), timeout=1.0)
+                assert results[1] == "closed", (
+                    f"close() hung behind the wedged drain: "
+                    f"{results[1]!r}")
+                assert results[0] == "failed-fast", results[0]
+                assert all(w.closed for w in gc.writers)
+
+        asyncio.run(main())
+
+
+    def test_thrift_close_mid_connect_never_wedges(self):
+        # close lands BETWEEN connect start and finish: the exchange
+        # must abandon its fresh socket instead of dispatching on the
+        # closed client (which would wedge close() behind the lock)
+        from linkerd_tpu.protocol.thrift.client import ThriftClient
+        from linkerd_tpu.protocol.thrift.codec import CALL, ThriftCall
+
+        async def main():
+            sched = DeterministicScheduler(
+                order=["connect", "close", "connect-done"])
+            with GatedConnect(sched) as gc:  # reply would never arrive
+                client = ThriftClient("127.0.0.1", 1)
+                call = ThriftCall(payload=b"x", name="m", seqid=1,
+                                  type=CALL)
+
+                async def caller():
+                    try:
+                        await client(call)
+                    except ConnectionError:
+                        return "refused"
+                    return "ok"
+
+                async def closer():
+                    await sched.point("close")
+                    await client.close()
+                    return "closed"
+
+                results = await sched.run(caller(), closer(), timeout=1.0)
+                assert results == ["refused", "closed"], results
+                assert all(w.closed for w in gc.writers)
+                assert client._writer is None
+
+        asyncio.run(main())
+
+    def test_mux_close_mid_connect_never_wedges(self):
+        from linkerd_tpu.protocol.mux.client import MuxClient
+
+        async def main():
+            sched = DeterministicScheduler(
+                order=["connect", "close", "connect-done"])
+            with GatedConnect(sched, wedge_drain=True) as gc:
+                client = MuxClient("127.0.0.1", 1)
+
+                async def caller():
+                    try:
+                        await client.ping()
+                    except (ConnectionError, ConnectionResetError):
+                        return "refused"
+                    return "ok"
+
+                async def closer():
+                    await sched.point("close")
+                    await client.close()
+                    return "closed"
+
+                results = await sched.run(caller(), closer(), timeout=1.0)
+                assert results == ["refused", "closed"], results
+                assert all(w.closed for w in gc.writers)
+                assert client._writer is None
+
+        asyncio.run(main())
+
+
+class TestLifecycleLockRaces:
+    """lock-guard @ lifecycle/promote.py bootstrap()/checkpoint(): both
+    ran outside the cycle lock. Pre-fix, a checkpoint taken while a
+    bootstrap restore was in flight recorded the STALE serving version
+    as its parent — corrupted lineage in the store."""
+
+    @staticmethod
+    def _mk_snap(step):
+        import numpy as np
+        from linkerd_tpu.lifecycle.store import ModelSnapshot
+        from linkerd_tpu.models.anomaly import AnomalyModelConfig
+        return ModelSnapshot(
+            params={"w": np.zeros((2, 2), np.float32)},
+            opt_leaves=[np.zeros(2, np.float32)],
+            mu=np.zeros(4, np.float32), var=np.ones(4, np.float32),
+            norm_initialized=False, step=step,
+            cfg=AnomalyModelConfig())
+
+    def test_checkpoint_parent_is_never_stale(self, tmp_path):
+        from linkerd_tpu.lifecycle.promote import (
+            ModelLifecycleManager, PromotionGate, ReplayWindow,
+        )
+        from linkerd_tpu.lifecycle.store import CheckpointStore
+
+        mk_snap = self._mk_snap
+        import itertools
+        store_ids = itertools.count()  # id(sched) is reusable after GC
+
+        def mk(sched):
+            store = CheckpointStore(str(tmp_path / f"s{next(store_ids)}"))
+            v1 = store.save(mk_snap(1), status="promoted")
+            mgr = ModelLifecycleManager(store, PromotionGate(),
+                                        ReplayWindow())
+            assert mgr.serving_version == v1
+            # a peer promotes v2 out from under this manager (the
+            # fleet-distribution path): latest_good moves past serving
+            v2 = store.save(mk_snap(2), status="promoted", parent=v1)
+
+            class GatedScorer:
+                async def snapshot(self):
+                    await sched.point("snapshot")
+                    return mk_snap(7)
+
+                async def restore(self, snap):
+                    await sched.point("restore")
+                    self.restored = snap.step
+
+            scorer = GatedScorer()
+
+            async def check_invariant():
+                await sched.run(mgr.bootstrap(scorer),
+                                mgr.checkpoint(scorer))
+                assert mgr.serving_version == v2
+                cand = [e for e in store.versions()
+                        if e.status == "candidate"]
+                assert len(cand) == 1
+                assert cand[0].parent == v2, (
+                    f"stale lineage: candidate parent {cand[0].parent} "
+                    f"but serving was {v2} at save time")
+            return [check_invariant()]
+
+        def invariant(results):
+            for r in results:
+                if isinstance(r, BaseException):
+                    raise AssertionError(repr(r))
+
+        hit = explore(mk, invariant, seeds=range(12))
+        assert hit is None, f"lineage race reproduced: {hit}"
+
+
+class TestReplayWindowInterleaving:
+    """Regression pin: ReplayWindow.sample() snapshots stay internally
+    consistent (equal column lengths, row accounting exact) while
+    add_batch churns between awaits — under every schedule."""
+
+    def test_append_vs_snapshot(self):
+        import numpy as np
+        from linkerd_tpu.lifecycle.promote import ReplayWindow
+
+        def mk(sched):
+            win = ReplayWindow(capacity_rows=64)
+            win.add_batch(np.zeros((4, 3), np.float32),
+                          np.zeros(4), np.zeros(4))
+
+            async def writer(tag):
+                for i in range(4):
+                    await sched.point(f"{tag}-{i}")
+                    win.add_batch(np.full((8, 3), i, np.float32),
+                                  np.zeros(8), np.ones(8))
+
+            async def sampler():
+                views = []
+                for i in range(3):
+                    await sched.point(f"sample-{i}")
+                    x, labels, mask = win.sample()
+                    views.append((len(x), len(labels), len(mask)))
+                return views
+
+            async def check():
+                results = await sched.run(writer("w1"), writer("w2"),
+                                          sampler())
+                for r in results:
+                    if isinstance(r, BaseException):
+                        raise r
+                for nx, nl, nm in results[2]:
+                    assert nx == nl == nm, "torn sample"
+                total = sum(len(b[0]) for b in win._batches)
+                assert len(win) == total, "row accounting drifted"
+                assert len(win) <= win.capacity_rows + 8
+            return [check()]
+
+        def invariant(results):
+            for r in results:
+                if isinstance(r, BaseException):
+                    raise AssertionError(repr(r))
+
+        assert explore(mk, invariant, seeds=range(10)) is None
+
+
+class TestAdmissionInterleaving:
+    """Regression pin: the admission pending/inflight counters stay
+    exact under concurrent shed/admit — each RMW is awaitless (atomic),
+    which is exactly why l5drace does NOT flag them. The sanitizer
+    confirms: no lost updates on either counter, any schedule."""
+
+    def test_counters_under_concurrent_shed_admit(self):
+        from linkerd_tpu.router.admission import (
+            AdmissionControlFilter, OverloadShed,
+        )
+
+        def mk(sched):
+            f = AdmissionControlFilter(max_concurrency=2, max_pending=1)
+            clear_log()
+            track(f, ["_pending", "_inflight"])
+            peak = {"inflight": 0, "pending": 0}
+
+            async def service(req):
+                peak["inflight"] = max(peak["inflight"], f._inflight)
+                await sched.point(f"svc-{req}")
+                return "ok"
+
+            async def caller(i):
+                try:
+                    return await f.apply(i, service)
+                except OverloadShed:
+                    return "shed"
+
+            async def check():
+                results = await sched.run(*[caller(i) for i in range(5)])
+                outcomes = sorted(str(r) for r in results)
+                # 2 dispatch + 1 queued admit + 2 sheds, every schedule
+                assert outcomes == ["ok", "ok", "ok", "shed", "shed"], (
+                    outcomes)
+                assert f._pending == 0 and f._inflight == 0
+                assert peak["inflight"] <= 2, "concurrency bound broken"
+                assert lost_updates("_pending") == []
+                assert lost_updates("_inflight") == []
+            return [check()]
+
+        def invariant(results):
+            for r in results:
+                if isinstance(r, BaseException):
+                    raise AssertionError(repr(r))
+
+        assert explore(mk, invariant, seeds=range(10)) is None

@@ -75,6 +75,42 @@ class TestAsyncBlocking:
             """}, "async-blocking")
         assert got == []
 
+    def test_blocking_call_in_lambda_inside_async_def_fires(self, tmp_path):
+        # regression: lambda bodies are frames body_calls skips, so a
+        # blocking call hidden in one passed silently
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                import asyncio, time
+                async def handle(req, loop):
+                    loop.call_soon(lambda: time.sleep(1))
+                    return req
+            """}, "async-blocking")
+        assert len(got) == 1 and "lambda" in got[0].message
+
+    def test_offloaded_lambda_is_clean(self, tmp_path):
+        # to_thread/run_in_executor run the lambda in a worker thread —
+        # blocking there is the sanctioned escape hatch
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                import asyncio, time
+                async def handle(req, loop):
+                    await asyncio.to_thread(lambda: time.sleep(1))
+                    await loop.run_in_executor(None, lambda: time.sleep(1))
+                    return req
+            """}, "async-blocking")
+        assert got == []
+
+    def test_lambda_in_nested_async_def_reported_once(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                import asyncio, time
+                async def outer(loop):
+                    async def inner():
+                        loop.call_soon(lambda: time.sleep(1))
+                    await inner()
+            """}, "async-blocking")
+        assert len(got) == 1 and "inner" in got[0].message
+
 
 class TestTaskLeak:
     def test_dropped_spawn_fires(self, tmp_path):
@@ -93,6 +129,29 @@ class TestTaskLeak:
                 def go(loop, coro, cb):
                     t = loop.create_task(coro)
                     loop.create_task(coro).add_done_callback(cb)
+                    return t
+            """}, "task-leak")
+        assert got == []
+
+    def test_spawn_inside_callback_lambda_fires(self, tmp_path):
+        # regression: call_soon discards its callback's return value, so
+        # a lambda-body spawn drops the Task — this passed silently
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                import asyncio
+                def go(loop, mk):
+                    loop.call_soon(lambda: loop.create_task(mk()))
+            """}, "task-leak")
+        assert len(got) == 1 and "lambda" in got[0].message
+
+    def test_spawning_lambda_used_as_factory_is_clean(self, tmp_path):
+        # the lambda's return value is consumed — not a leak
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                import asyncio
+                def go(loop, mk):
+                    factory = lambda: loop.create_task(mk())
+                    t = factory()
                     return t
             """}, "task-leak")
         assert got == []
@@ -286,6 +345,17 @@ class TestFloatTime:
                         return rsp
             """}, "float-time")
         assert len(got) >= 1
+
+    def test_lambda_bodies_are_scanned(self, tmp_path):
+        # regression: lambdas are frames the per-frame walk skips, so a
+        # wall-clock duration inside one passed silently
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                import time
+                def mk_age_fn(t0):
+                    return lambda: time.time() - t0
+            """}, "float-time")
+        assert len(got) == 1
 
     def test_rebound_variable_clears_wall_clock_taint(self, tmp_path):
         # t0 first holds a reported wall timestamp, then is rebound to

@@ -16,10 +16,16 @@ Run: ``python -m tools.analysis [paths] [--rule r1,r2] [--format json]``.
 Semantic verification of linker/namerd YAML (l5dcheck, see
 ``tools/analysis/semantic`` and COMPONENTS.md §2.8):
 ``python -m tools.analysis check <config.yml...>``.
+Await-atomicity race analysis of the asyncio data plane (l5drace, see
+``tools/analysis/race`` and COMPONENTS.md §2.9):
+``python -m tools.analysis race [paths...]``.
+All three modes take ``--changed`` (analyze only files differing from
+``git merge-base HEAD main`` — the pre-commit hook mode, see
+``tools/hooks/``).
 Suppress inline with ``# l5d: ignore[rule] — why it is safe``.
 """
 
 from tools.analysis.core import (  # noqa: F401
-    Checker, Finding, Project, SourceFile, all_checkers, rule_ids,
-    run_analysis,
+    Checker, Finding, Project, SourceFile, all_checkers, race_checkers,
+    race_rule_ids, rule_ids, run_analysis,
 )

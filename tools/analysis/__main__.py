@@ -1,14 +1,22 @@
 """CLI for the static-analysis suite.
 
-Two modes::
+Three modes::
 
     python -m tools.analysis [lint] [paths] [--rule ...] [--format json]
     python -m tools.analysis check <config.yml...>      [--format json]
+    python -m tools.analysis race  [paths]              [--format json]
 
 ``lint`` (the default) runs the l5dlint AST rules over python sources;
-``check`` runs l5dcheck semantic verification over linker/namerd YAML.
+``check`` runs l5dcheck semantic verification over linker/namerd YAML;
+``race`` runs l5drace await-atomicity/lock-discipline analysis over the
+asyncio data plane.
 
-Exit status (both modes): 0 = no unsuppressed findings, 1 = findings,
+``--changed`` (any mode) restricts the run to files that differ from
+``git merge-base HEAD main`` (plus untracked files) — fast enough for
+the pre-commit hook shipped under ``tools/hooks/``. With no relevant
+changed files the mode is a clean no-op (exit 0).
+
+Exit status (all modes): 0 = no unsuppressed findings, 1 = findings,
 2 = usage/IO error.
 """
 
@@ -17,6 +25,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -26,17 +35,67 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
-from tools.analysis import all_checkers, rule_ids, run_analysis  # noqa: E402
+from tools.analysis import (  # noqa: E402
+    all_checkers, race_rule_ids, rule_ids, run_analysis,
+)
+
+
+def changed_files(repo_root: str = _REPO) -> "list[str] | None":
+    """Repo-relative files differing from ``git merge-base HEAD main``
+    plus untracked files; None when git/merge-base is unavailable (the
+    caller should fall back to a full run rather than silently skip)."""
+    def git(*args: str) -> str:
+        return subprocess.run(
+            ["git", *args], cwd=repo_root, check=True,
+            capture_output=True, text=True).stdout
+
+    try:
+        base = None
+        for ref in ("main", "origin/main"):
+            try:
+                base = git("merge-base", "HEAD", ref).strip()
+                break
+            except subprocess.CalledProcessError:
+                continue
+        if base is None:
+            return None
+        out = git("diff", "--name-only", "--diff-filter=d", base)
+        untracked = git("ls-files", "--others", "--exclude-standard")
+        files = [f for f in (out + untracked).splitlines() if f.strip()]
+        return sorted({f for f in files
+                       if os.path.exists(os.path.join(repo_root, f))})
+    except (OSError, subprocess.CalledProcessError):
+        return None
+
+
+def _restrict_to_changed(paths: "list[str]", suffixes: tuple,
+                         label: str) -> "list[str] | None":
+    """Intersect the requested scan paths with the changed set. Returns
+    None for "nothing to do" (clean no-op), or the narrowed file list."""
+    changed = changed_files()
+    if changed is None:
+        print(f"{label}: --changed: git merge-base unavailable; "
+              f"analyzing everything", file=sys.stderr)
+        return paths
+    norm = [os.path.normpath(p) for p in paths]
+    picked = []
+    for f in changed:
+        if not f.endswith(suffixes):
+            continue
+        if any(f == p or f.startswith(p + os.sep)
+               or f.startswith(p + "/") for p in norm):
+            picked.append(f)
+    return picked or None
 
 
 def _mk_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="python -m tools.analysis",
-        description="l5dlint (code) + l5dcheck (configs): repo-native "
-                    "static analysis")
+        description="l5dlint (code) + l5dcheck (configs) + l5drace "
+                    "(concurrency): repo-native static analysis")
     ap.add_argument("paths", nargs="*", default=None,
-                    help="lint: repo-relative source paths (default: "
-                         "linkerd_tpu); check: config YAML files")
+                    help="lint/race: repo-relative source paths; "
+                         "check: config YAML files")
     ap.add_argument("--rule", action="append", default=None,
                     help="run only these rules (repeatable or comma-"
                          "separated)")
@@ -49,6 +108,9 @@ def _mk_parser() -> argparse.ArgumentParser:
                     help="print rule ids and exit")
     ap.add_argument("--show-suppressed", action="store_true",
                     help="also print suppressed findings")
+    ap.add_argument("--changed", action="store_true",
+                    help="analyze only files differing from "
+                         "'git merge-base HEAD main' (pre-commit mode)")
     return ap
 
 
@@ -74,17 +136,39 @@ def _report(findings, wall_s: float, as_json: bool, show_suppressed: bool,
     return 1 if unsuppressed else 0
 
 
-def _lint(args) -> int:
-    rules = None
-    if args.rule:
-        rules = [r.strip() for chunk in args.rule for r in chunk.split(",")]
-        unknown = set(rules) - set(rule_ids()) - {"suppression"}
-        if unknown:
-            print(f"unknown rule(s): {sorted(unknown)}; "
-                  f"known: {rule_ids() + ['suppression']}", file=sys.stderr)
-            return 2
+def _noop(label: str, as_json: bool, header: dict) -> int:
+    if as_json:
+        print(json.dumps({**header, "wall_s": 0.0, "unsuppressed": [],
+                          "suppressed_count": 0, "changed_noop": True}))
+    else:
+        print(f"{label}: no relevant changed files, nothing to analyze")
+    return 0
 
+
+def _parse_rules(args, known: "list[str]") -> "tuple[int, list | None]":
+    if not args.rule:
+        return 0, None
+    rules = [r.strip() for chunk in args.rule for r in chunk.split(",")]
+    unknown = set(rules) - set(known) - {"suppression"}
+    if unknown:
+        print(f"unknown rule(s): {sorted(unknown)}; "
+              f"known: {known + ['suppression']}", file=sys.stderr)
+        return 2, None
+    return 0, rules
+
+
+def _lint(args) -> int:
+    rc, rules = _parse_rules(args, rule_ids())
+    if rc:
+        return rc
     paths = args.paths or ["linkerd_tpu"]
+    header = {"mode": "lint", "paths": paths,
+              "rules": rules or rule_ids() + ["suppression"]}
+    if args.changed:
+        paths = _restrict_to_changed(paths, (".py",), "l5dlint")
+        if paths is None:
+            return _noop("l5dlint", args.as_json, header)
+        header["paths"] = paths
     t0 = time.perf_counter()
     try:
         findings = run_analysis(paths, repo_root=_REPO, rules=rules)
@@ -93,10 +177,32 @@ def _lint(args) -> int:
         return 2
     return _report(
         findings, time.perf_counter() - t0, args.as_json,
-        args.show_suppressed,
-        {"mode": "lint", "paths": paths,
-         "rules": rules or rule_ids() + ["suppression"]},
-        "l5dlint")
+        args.show_suppressed, header, "l5dlint")
+
+
+def _race(args) -> int:
+    from tools.analysis.race import DEFAULT_SCOPE, run_race_analysis
+
+    rc, rules = _parse_rules(args, race_rule_ids())
+    if rc:
+        return rc
+    paths = args.paths or list(DEFAULT_SCOPE)
+    header = {"mode": "race", "paths": paths,
+              "rules": rules or race_rule_ids()}
+    if args.changed:
+        paths = _restrict_to_changed(paths, (".py",), "l5drace")
+        if paths is None:
+            return _noop("l5drace", args.as_json, header)
+        header["paths"] = paths
+    t0 = time.perf_counter()
+    try:
+        findings = run_race_analysis(paths, repo_root=_REPO, rules=rules)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    return _report(
+        findings, time.perf_counter() - t0, args.as_json,
+        args.show_suppressed, header, "l5drace")
 
 
 def _check(args) -> int:
@@ -106,29 +212,57 @@ def _check(args) -> int:
         print("check mode runs every semantic rule; use inline "
               "suppressions to waive specific findings", file=sys.stderr)
         return 2
-    if not args.paths:
+    paths = list(args.paths or [])
+    header = {"mode": "check", "paths": paths,
+              "rules": semantic_rule_ids() + ["suppression"]}
+    if args.changed:
+        scan = paths or ["tests/configs", "examples"]
+        picked = _restrict_to_changed(scan, (".yml", ".yaml"), "l5dcheck")
+        if picked is None:
+            return _noop("l5dcheck", args.as_json, header)
+        paths = [os.path.join(_REPO, p) if not os.path.isabs(p)
+                 and not os.path.exists(p) else p for p in picked]
+        header["paths"] = picked
+    if not paths:
         print("usage: python -m tools.analysis check <config.yml...>",
+              file=sys.stderr)
+        return 2
+    # directories (CLI convenience + the --changed git-unavailable
+    # fallback) expand to their YAML files
+    import glob as _glob
+    expanded = []
+    for p in paths:
+        if os.path.isdir(p):
+            for pattern in ("*.yml", "*.yaml"):
+                expanded.extend(sorted(_glob.glob(
+                    os.path.join(p, "**", pattern), recursive=True)))
+        else:
+            expanded.append(p)
+    paths = expanded
+    if not paths:
+        if args.changed:
+            return _noop("l5dcheck", args.as_json, header)
+        # an explicitly-given directory with no YAML must not pass as
+        # clean — "0 findings over nothing" is not a clean bill
+        print("no YAML files found under the given path(s)",
               file=sys.stderr)
         return 2
     t0 = time.perf_counter()
     findings = []
-    for p in args.paths:
+    for p in paths:
         if not os.path.exists(p):
             print(f"no such config file: {p}", file=sys.stderr)
             return 2
         findings.extend(check_file(p, repo_root=os.getcwd()))
     return _report(
         findings, time.perf_counter() - t0, args.as_json,
-        args.show_suppressed,
-        {"mode": "check", "paths": list(args.paths),
-         "rules": semantic_rule_ids() + ["suppression"]},
-        "l5dcheck")
+        args.show_suppressed, header, "l5dcheck")
 
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     mode = "lint"
-    if argv and argv[0] in ("lint", "check"):
+    if argv and argv[0] in ("lint", "check", "race"):
         mode = argv.pop(0)
     args = _mk_parser().parse_args(argv)
     if args.as_json or args.format == "json":
@@ -139,6 +273,10 @@ def main(argv=None) -> int:
             from tools.analysis.semantic import semantic_rule_ids
             for r in semantic_rule_ids():
                 print(r)
+        elif mode == "race":
+            from tools.analysis import race_checkers
+            for c in sorted(race_checkers(), key=lambda c: c.rule):
+                print(f"{c.rule:20s} {c.description}")
         else:
             for c in sorted(all_checkers(), key=lambda c: c.rule):
                 print(f"{c.rule:20s} {c.description}")
@@ -146,7 +284,11 @@ def main(argv=None) -> int:
               f"justification")
         return 0
 
-    return _check(args) if mode == "check" else _lint(args)
+    if mode == "check":
+        return _check(args)
+    if mode == "race":
+        return _race(args)
+    return _lint(args)
 
 
 if __name__ == "__main__":

@@ -19,8 +19,8 @@ import ast
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 from tools.analysis.core import (
-    Checker, Finding, Project, SourceFile, body_calls, dotted_name,
-    register_checker, walk_functions,
+    Checker, Finding, Project, SourceFile, body_calls, callee_name,
+    dotted_name, register_checker, walk_functions,
 )
 
 # Dotted prefixes/names that block the calling thread.
@@ -114,6 +114,44 @@ class AsyncBlockingChecker(Checker):
         for fn, cls in funcs:
             if not isinstance(fn, ast.AsyncFunctionDef):
                 continue
+            # lambdas defined inside the coroutine run on the event loop
+            # too (call_soon callbacks, default args, sort keys) but are
+            # their own frames — body_calls skips them, so visit each
+            # lambda body explicitly (the historical silent gap). Only
+            # lambdas OWNED by this coroutine (not ones inside nested
+            # defs, which get their own walk_functions visit) and not
+            # handed to the thread-offload escape hatches.
+            offloaded = set()
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                if callee_name(call) in ("to_thread", "run_in_executor"):
+                    for arg in call.args:
+                        for sub in ast.walk(arg):
+                            if isinstance(sub, ast.Lambda):
+                                offloaded.add(id(sub))
+            stack = list(ast.iter_child_nodes(fn))
+            owned_lambdas = []
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue  # nested def: its own walk_functions visit
+                if isinstance(node, ast.Lambda):
+                    owned_lambdas.append(node)
+                stack.extend(ast.iter_child_nodes(node))
+            for node in owned_lambdas:
+                if id(node) in offloaded:
+                    continue  # runs in a worker thread, not the loop
+                for call in body_calls(node):
+                    reason = _blocking_reason(call)
+                    if reason is not None:
+                        yield Finding(
+                            self.rule, src.rel, call.lineno,
+                            call.col_offset,
+                            f"blocking call {dotted_name(call.func)}() in "
+                            f"a lambda inside 'async def {fn.name}': "
+                            f"{reason}")
             for call in body_calls(fn):
                 reason = _blocking_reason(call)
                 if reason is not None:

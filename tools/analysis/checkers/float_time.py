@@ -50,9 +50,11 @@ class FloatTimeChecker(Checker):
              "linkerd_tpu/grpc")
 
     def check(self, src: SourceFile, project: Project) -> Iterator[Finding]:
-        # module body + every function get an independent dataflow pass
+        # module body + every function (lambdas included: their bodies
+        # are frames the per-frame walk below deliberately skips) get an
+        # independent dataflow pass
         yield from self._check_frame(src, src.tree)
-        for fn, _cls in walk_functions(src.tree):
+        for fn, _cls in walk_functions(src.tree, include_lambdas=True):
             yield from self._check_frame(src, fn)
 
     def _check_frame(self, src: SourceFile,

@@ -17,19 +17,21 @@ import ast
 from typing import Iterator
 
 from tools.analysis.core import (
-    Checker, Finding, Project, SourceFile, register_checker,
+    Checker, Finding, Project, SourceFile, callee_name, register_checker,
 )
 
 SPAWNERS = {"create_task", "ensure_future"}
 
+# Callback registration points that DISCARD their callback's return
+# value: a lambda whose body is a spawn, handed to one of these, drops
+# the Task reference exactly like a bare-statement spawn.
+CALLBACK_SINKS = {"call_soon", "call_later", "call_at",
+                  "call_soon_threadsafe", "add_done_callback",
+                  "add_callback"}
+
 
 def _is_spawn(call: ast.Call) -> bool:
-    f = call.func
-    if isinstance(f, ast.Attribute) and f.attr in SPAWNERS:
-        return True
-    if isinstance(f, ast.Name) and f.id in SPAWNERS:
-        return True
-    return False
+    return callee_name(call) in SPAWNERS
 
 
 @register_checker
@@ -51,3 +53,21 @@ class TaskLeakChecker(Checker):
                     "task spawned and dropped: hold the reference, attach "
                     "a done-callback, or use core.tasks.spawn() so "
                     "failures are logged and the task outlives GC")
+            # a lambda whose body is the spawn, registered as a callback
+            # (call_soon / add_done_callback / ...): the sink discards
+            # the lambda's return value, so the Task is dropped the
+            # instant it is created (historical gap: this passed silently)
+            if (isinstance(node, ast.Call)
+                    and callee_name(node) in CALLBACK_SINKS):
+                for arg in node.args:
+                    if (isinstance(arg, ast.Lambda)
+                            and isinstance(arg.body, ast.Call)
+                            and _is_spawn(arg.body)):
+                        yield Finding(
+                            self.rule, src.rel, arg.lineno,
+                            arg.col_offset,
+                            f"task spawned inside a lambda passed to "
+                            f"{callee_name(node)}(): the sink discards "
+                            f"the lambda's return value, dropping the "
+                            f"Task; use core.tasks.spawn() in the "
+                            f"callback instead")

@@ -220,6 +220,17 @@ class Checker:
 # -- shared AST helpers ------------------------------------------------------
 
 
+def callee_name(call: ast.Call) -> Optional[str]:
+    """The bare callee name of a Call: ``loop.create_task(...)`` ->
+    'create_task', ``spawn(...)`` -> 'spawn', else None."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
 def dotted_name(node: ast.AST) -> Optional[str]:
     """'a.b.c' for Name/Attribute chains, else None."""
     parts: List[str] = []
@@ -247,9 +258,15 @@ def body_calls(node: ast.AST, *,
         stack.extend(ast.iter_child_nodes(n))
 
 
-def walk_functions(tree: ast.AST) -> Iterator[Tuple[ast.AST, Optional[str]]]:
+def walk_functions(tree: ast.AST, include_lambdas: bool = False
+                   ) -> Iterator[Tuple[ast.AST, Optional[str]]]:
     """Yield (function_node, enclosing_class_name) for every def in the
-    module, including methods and nested defs."""
+    module, including methods and nested defs (async or not, however
+    deeply closed over). With ``include_lambdas``, Lambda nodes are
+    yielded too — they are frames like any other, and a checker that
+    skips nested frames during body analysis otherwise never sees a
+    lambda body at all (the historical gap: a blocking call or
+    wall-clock subtraction inside ``lambda: ...`` passed silently)."""
     def visit(node: ast.AST, cls: Optional[str]):
         for child in ast.iter_child_nodes(node):
             if isinstance(child, ast.ClassDef):
@@ -258,6 +275,8 @@ def walk_functions(tree: ast.AST) -> Iterator[Tuple[ast.AST, Optional[str]]]:
                 yield (child, cls)
                 yield from visit(child, cls)
             else:
+                if include_lambdas and isinstance(child, ast.Lambda):
+                    yield (child, cls)
                 yield from visit(child, cls)
     yield from visit(tree, None)
 
@@ -265,10 +284,19 @@ def walk_functions(tree: ast.AST) -> Iterator[Tuple[ast.AST, Optional[str]]]:
 # -- registry + runner -------------------------------------------------------
 
 _CHECKERS: List[Checker] = []
+_RACE_CHECKERS: List[Checker] = []
 
 
 def register_checker(cls):
     _CHECKERS.append(cls())
+    return cls
+
+
+def register_race_checker(cls):
+    """Race rules register separately: ``python -m tools.analysis race``
+    runs them; plain lint does not (the race suite has its own scope and
+    cost profile)."""
+    _RACE_CHECKERS.append(cls())
     return cls
 
 
@@ -277,8 +305,17 @@ def all_checkers() -> List[Checker]:
     return list(_CHECKERS)
 
 
+def race_checkers() -> List[Checker]:
+    from tools.analysis.race import rules  # noqa: F401 — registration
+    return list(_RACE_CHECKERS)
+
+
 def rule_ids() -> List[str]:
     return sorted(c.rule for c in all_checkers())
+
+
+def race_rule_ids() -> List[str]:
+    return sorted(c.rule for c in race_checkers())
 
 
 def run_analysis(scan_paths: Sequence[str], repo_root: Optional[str] = None,
@@ -309,9 +346,11 @@ def run_analysis(scan_paths: Sequence[str], repo_root: Optional[str] = None,
                     f.justification = sup.justification
             findings.append(f)
     # meta-rule: every suppression carries a justification and actually
-    # names a real rule (stale ids rot silently otherwise)
+    # names a real rule (stale ids rot silently otherwise). Race-rule
+    # suppressions live in the same .py files, so they are "known" here
+    # even though the race suite runs as its own mode.
     if rules is None or "suppression" in rules:
-        known = set(rule_ids()) | {"parse"}
+        known = set(rule_ids()) | set(race_rule_ids()) | {"parse"}
         for src in project.sources:
             for sup in src.suppressions.values():
                 if not sup.justified:

@@ -1,0 +1,66 @@
+"""l5drace — await-atomicity race analysis for the async data plane.
+
+Static half of the repo's concurrency tooling: an interprocedural
+(shallow, same-class) analysis that models shared mutable state per
+class and flags interleaving windows — read/await/write sequences,
+stale entry guards, inconsistently-held locks, ordering cycles, and
+leaked acquires. The dynamic half (``linkerd_tpu/testing/schedules``)
+drives the flagged code through adversarial interleavings so every
+static finding gets a reproducing or refuting test.
+
+Run it::
+
+    python -m tools.analysis race [paths...] [--format json] [--changed]
+
+Suppressions reuse the l5dlint syntax and MUST carry a justification::
+
+    self._conn = conn  # l5d: ignore[await-atomicity] — dedup via future
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+from tools.analysis.core import (  # noqa: F401 — re-exports
+    Finding, Project, race_checkers, race_rule_ids,
+)
+
+# The packages the race suite gates (the asyncio data plane). Control
+# plane / startup code may block and single-task freely.
+DEFAULT_SCOPE = ("linkerd_tpu/router", "linkerd_tpu/protocol",
+                 "linkerd_tpu/telemetry", "linkerd_tpu/lifecycle")
+
+
+def run_race_analysis(scan_paths: Optional[Sequence[str]] = None,
+                      repo_root: Optional[str] = None,
+                      rules: Optional[Sequence[str]] = None
+                      ) -> List[Finding]:
+    """Run the race suite; returns ALL findings (suppressed ones
+    flagged). Suppression *justification* is enforced by the lint
+    suite's meta-rule, which owns every ``# l5d: ignore`` comment."""
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+    if scan_paths is None:
+        scan_paths = [p for p in DEFAULT_SCOPE
+                      if os.path.exists(os.path.join(repo_root, p))]
+    project = Project(repo_root, scan_paths)
+    selected = [c for c in race_checkers()
+                if rules is None or c.rule in rules]
+    findings: List[Finding] = []
+    by_rel = {src.rel: src for src in project.sources}
+    for src in project.sources:
+        if src.parse_error:
+            findings.append(Finding("parse", src.rel, 0, 0, src.parse_error))
+    for checker in selected:
+        for f in checker.run(project):
+            src = by_rel.get(f.path)
+            if src is not None:
+                sup = src.suppression_for(f.rule, f.line)
+                if sup is not None and sup.justified:
+                    f.suppressed = True
+                    f.justification = sup.justification
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -1,0 +1,581 @@
+"""Shared-state model for the l5drace analyzer.
+
+The data plane is a single-process asyncio program: every ``await`` is a
+potential interleaving point, and every instance attribute reached from
+more than one coroutine (a second method, a task-spawn site, a
+request-concurrent Filter/Service instance) is shared mutable state. The
+model extracted here feeds the rules in ``tools/analysis/race/rules``:
+
+- ``Access``      — one attribute read/write or await point, annotated
+  with its line, the locks lexically (or inferred) held, its innermost
+  enclosing loop, and whether it sits in a loop test or an entry guard.
+- ``MethodModel`` — one method's ordered access stream plus its lock
+  regions, acquire/release sites, and same-class sync calls.
+- ``ClassModel``  — per-class aggregation: known lock attributes, the
+  shared-mutable attribute set, and lock-held inference.
+
+Interprocedural treatment (deliberately shallow — one level, same
+class):
+
+- sync helper methods are *inlined* into their async callers: their
+  attribute events surface at the call-site line under the call-site's
+  lock context (``close()`` calling ``self._teardown()`` is a write to
+  everything ``_teardown`` writes);
+- a method whose same-class call sites ALL sit inside ``async with
+  self.lock`` regions is treated as lock-held throughout (the
+  ``_ensure_conn`` idiom), propagated to fixpoint.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+# Constructors that make an instance attribute a "lock" for lock-region
+# tracking; name heuristic catches locks built elsewhere.
+_LOCK_CTORS = {"Lock", "Condition", "Semaphore", "BoundedSemaphore"}
+_LOCK_NAME_RE = re.compile(r"lock|mutex|cond(ition)?$|sem(aphore)?$", re.I)
+
+# Construction-time methods: single-task by definition, never concurrent.
+_SETUP_METHODS = {"__init__", "__post_init__", "__new__", "__init_subclass__"}
+
+# Base-class names whose instances serve concurrent requests: one async
+# method is concurrent WITH ITSELF (a Filter's apply runs once per
+# in-flight request on the same instance).
+_MULTI_ENTRANT_BASES = {"Filter", "Service", "Telemeter", "Scorer",
+                        "Balancer", "Namer", "NameInterpreter"}
+
+# Spawn wrappers: a method handed to one of these runs as its own task.
+_SPAWNERS = {"create_task", "ensure_future", "spawn", "monitor"}
+
+
+@dataclass
+class Access:
+    kind: str              # "r" | "w" | "a" (await point)
+    attr: Optional[str]    # None for awaits
+    line: int
+    col: int
+    aug: bool = False      # part of an AugAssign (atomic RMW, no await)
+    loops: Tuple[int, ...] = ()  # enclosing loop ids, outermost first
+    loop_test: bool = False  # read in a while-loop test (re-evaluates)
+    guard: bool = False    # read in an early-exit if test (raise/return)
+    locks: Tuple[str, ...] = ()  # lock attrs lexically held here
+    inlined_from: Optional[str] = None  # sync helper the event came from
+    terminal: bool = False  # await inside return/raise: control leaves
+
+    @property
+    def loop(self) -> int:
+        """Innermost enclosing loop id (0 = not in a loop)."""
+        return self.loops[-1] if self.loops else 0
+
+
+@dataclass
+class LockRegion:
+    lock: str
+    start: int
+    end: int
+    line: int  # the with-statement line
+
+
+@dataclass
+class AcquireSite:
+    lock: str
+    line: int
+    col: int
+    awaited: bool
+    released_in_finally: bool  # a later finally in this fn releases it
+
+
+@dataclass
+class MethodModel:
+    name: str
+    is_async: bool
+    lineno: int
+    accesses: List[Access] = field(default_factory=list)
+    lock_regions: List[LockRegion] = field(default_factory=list)
+    acquires: List[AcquireSite] = field(default_factory=list)
+    releases: List[Tuple[str, int]] = field(default_factory=list)
+    # same-class method calls: (callee, line, locks-held-at-call-site)
+    calls: List[Tuple[str, int, Tuple[str, ...]]] = field(
+        default_factory=list)
+    inferred_locks: Tuple[str, ...] = ()  # all-call-sites-under-lock
+
+    @property
+    def awaits(self) -> List[Access]:
+        return [a for a in self.accesses if a.kind == "a"]
+
+    def effective(self) -> List[Access]:
+        """Accesses with inferred locks merged in (see ClassModel.infer)."""
+        if not self.inferred_locks:
+            return self.accesses
+        out = []
+        for a in self.accesses:
+            locks = tuple(sorted(set(a.locks) | set(self.inferred_locks)))
+            out.append(Access(a.kind, a.attr, a.line, a.col, a.aug,
+                              a.loops, a.loop_test, a.guard, locks,
+                              a.inlined_from, a.terminal))
+        return out
+
+
+@dataclass
+class ClassModel:
+    name: str
+    lineno: int
+    bases: Tuple[str, ...]
+    methods: Dict[str, MethodModel] = field(default_factory=dict)
+    lock_attrs: Set[str] = field(default_factory=set)
+
+    # -- concurrency classification --------------------------------------
+    @property
+    def multi_entrant(self) -> bool:
+        return bool(set(self.bases) & _MULTI_ENTRANT_BASES)
+
+    def shared_attrs(self) -> Set[str]:
+        """Instance attributes that are (a) mutated outside construction
+        and (b) reachable from more than one coroutine: touched by >= 2
+        methods, or touched across an await in a request-concurrent
+        class (one async method concurrent with itself)."""
+        touched_by: Dict[str, Set[str]] = {}
+        written: Set[str] = set()
+        async_awaiting_toucher: Dict[str, bool] = {}
+        for m in self.methods.values():
+            if m.name in _SETUP_METHODS:
+                continue
+            has_await = bool(m.awaits)
+            for a in m.accesses:
+                if a.attr is None or a.attr in self.lock_attrs:
+                    continue
+                touched_by.setdefault(a.attr, set()).add(m.name)
+                if a.kind == "w":
+                    written.add(a.attr)
+                if m.is_async and has_await:
+                    async_awaiting_toucher[a.attr] = True
+        out = set()
+        for attr, methods in touched_by.items():
+            if attr not in written:
+                continue
+            if len(methods) >= 2:
+                out.add(attr)
+            elif self.multi_entrant and async_awaiting_toucher.get(attr):
+                out.add(attr)
+        return out
+
+    def writers_of(self, attr: str) -> Set[str]:
+        return {m.name for m in self.methods.values()
+                if m.name not in _SETUP_METHODS
+                and any(a.kind == "w" and a.attr == attr
+                        for a in m.accesses)}
+
+    # -- interprocedural lock inference ----------------------------------
+    def infer_lock_held(self) -> None:
+        """A method whose same-class call sites ALL hold lock L is
+        treated as holding L throughout (fixpoint over the call graph;
+        methods with no in-class call sites stay unannotated)."""
+        for _ in range(4):  # shallow graphs converge immediately
+            changed = False
+            for name, m in self.methods.items():
+                sites: List[Tuple[str, ...]] = []
+                for caller in self.methods.values():
+                    if caller.name == name:
+                        continue
+                    for callee, _line, locks in caller.calls:
+                        if callee != name:
+                            continue
+                        held = set(locks) | set(caller.inferred_locks)
+                        sites.append(tuple(sorted(held)))
+                if not sites:
+                    continue
+                common = set(sites[0])
+                for s in sites[1:]:
+                    common &= set(s)
+                common -= set(m.inferred_locks)
+                if common:
+                    m.inferred_locks = tuple(
+                        sorted(set(m.inferred_locks) | common))
+                    changed = True
+            if not changed:
+                break
+
+    def inline_sync_helpers(self) -> None:
+        """Surface sync helpers' attribute events at their async call
+        sites (one level): the caller's lock context applies, and the
+        events collapse onto the call-site line (ordering within the
+        helper is invisible — good enough for cross-await reasoning)."""
+        for m in list(self.methods.values()):
+            if not m.is_async:
+                continue
+            merged: List[Access] = []
+            for callee, line, locks in m.calls:
+                h = self.methods.get(callee)
+                if h is None or h.is_async or callee in _SETUP_METHODS:
+                    continue
+                # locate the call-site access context (loop chain) by
+                # the nearest access on the same line, else defaults
+                loops: Tuple[int, ...] = ()
+                for a in m.accesses:
+                    if a.line == line:
+                        loops = a.loops
+                        break
+                for ev in h.accesses:
+                    if ev.kind == "a" or ev.attr is None:
+                        continue
+                    held = tuple(sorted(set(locks) | set(ev.locks)))
+                    merged.append(Access(
+                        ev.kind, ev.attr, line, 0, ev.aug, loops,
+                        False, False, held, inlined_from=callee))
+            if merged:
+                m.accesses = sorted(m.accesses + merged,
+                                    key=lambda a: (a.line, a.col))
+
+
+# ---------------------------------------------------------------------------
+
+
+def _self_attr_chain(node: ast.AST) -> Optional[str]:
+    """The OUTERMOST attribute name for an access rooted at ``self``:
+    ``self.x`` -> x, ``self.x.y`` -> x (mutating/reading through x),
+    ``self.x[k]`` -> x."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        inner = node.value
+        if (isinstance(node, ast.Attribute)
+                and isinstance(inner, ast.Name) and inner.id == "self"):
+            return node.attr
+        node = inner
+    return None
+
+
+def _is_lockish(attr: str, init_lock_attrs: Set[str]) -> bool:
+    return attr in init_lock_attrs or bool(_LOCK_NAME_RE.search(attr))
+
+
+class _MethodExtractor:
+    """Walks one function body in source order collecting accesses."""
+
+    def __init__(self, fn: ast.AST, lock_attrs: Set[str]):
+        self.fn = fn
+        self.lock_attrs = lock_attrs
+        self.accesses: List[Access] = []
+        self.lock_regions: List[LockRegion] = []
+        self.acquires: List[AcquireSite] = []
+        self.releases: List[Tuple[str, int]] = []
+        self.calls: List[Tuple[str, int, Tuple[str, ...]]] = []
+        self._spawned_calls: Set[Tuple[str, int]] = set()
+        self._loop_ids = 0
+        self._loop_stack: List[int] = []
+        self._lock_stack: List[str] = []
+        self._finally_release_lines: List[Tuple[str, int]] = []
+        self._collect_finally_releases(fn)
+
+    # -- helpers ----------------------------------------------------------
+    def _locks(self) -> Tuple[str, ...]:
+        return tuple(self._lock_stack)
+
+    def _loop(self) -> int:
+        return self._loop_stack[-1] if self._loop_stack else 0
+
+    def _collect_finally_releases(self, fn: ast.AST) -> None:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Try) and node.finalbody:
+                for sub in node.finalbody:
+                    for call in ast.walk(sub):
+                        if (isinstance(call, ast.Call)
+                                and isinstance(call.func, ast.Attribute)
+                                and call.func.attr == "release"):
+                            attr = _self_attr_chain(call.func.value)
+                            if attr is not None:
+                                self._finally_release_lines.append(
+                                    (attr, call.lineno))
+
+    # -- expression-level events -----------------------------------------
+    def _expr_events(self, node: ast.AST, *, loop_test: bool = False,
+                     guard: bool = False, terminal: bool = False) -> None:
+        """Record reads/awaits inside an expression, skipping nested
+        function/lambda frames (they run later, elsewhere)."""
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if isinstance(n, ast.Await):
+                self.accesses.append(Access(
+                    "a", None, n.lineno, n.col_offset,
+                    loops=tuple(self._loop_stack), locks=self._locks(),
+                    terminal=terminal))
+                self._await_calls(n.value)
+            if isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load):
+                attr = _self_attr_chain(n)
+                if attr is not None and isinstance(n.value, ast.Name):
+                    # only the rooted self.X access itself (outermost
+                    # chains are handled when their root is visited)
+                    self.accesses.append(Access(
+                        "r", attr, n.lineno, n.col_offset,
+                        loops=tuple(self._loop_stack), loop_test=loop_test,
+                        guard=guard, locks=self._locks()))
+            if isinstance(n, ast.Call):
+                self._call_events(n)
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _await_calls(self, value: ast.AST) -> None:
+        """acquire() under an await: ``await self.lock.acquire()``."""
+        if (isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Attribute)
+                and value.func.attr == "acquire"):
+            attr = _self_attr_chain(value.func.value)
+            if attr is not None and _is_lockish(attr, self.lock_attrs):
+                self.acquires.append(AcquireSite(
+                    attr, value.lineno, value.col_offset, awaited=True,
+                    released_in_finally=self._released_later(
+                        attr, value.lineno)))
+
+    def _released_later(self, attr: str, line: int) -> bool:
+        return any(a == attr and ln >= line
+                   for a, ln in self._finally_release_lines)
+
+    def _call_events(self, call: ast.Call) -> None:
+        from tools.analysis.core import callee_name
+        f = call.func
+        if callee_name(call) in _SPAWNERS:
+            # self.m() inside create_task/spawn/monitor(...) is NOT a
+            # call in this frame: it runs as its own task, outside any
+            # lock held here — exclude it from the call graph so lock
+            # inference can't claim the spawned body is lock-held
+            for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                for sub in ast.walk(arg):
+                    if (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and isinstance(sub.func.value, ast.Name)
+                            and sub.func.value.id == "self"):
+                        self._spawned_calls.add(
+                            (sub.func.attr, sub.lineno))
+        if isinstance(f, ast.Attribute):
+            attr_of_self = (isinstance(f.value, ast.Name)
+                            and f.value.id == "self")
+            if attr_of_self:
+                self.calls.append((f.attr, call.lineno, self._locks()))
+            if f.attr == "acquire":
+                lock = _self_attr_chain(f.value)
+                if lock is not None and _is_lockish(lock, self.lock_attrs):
+                    # non-awaited acquires recorded here; awaited ones in
+                    # _await_calls (both feed lock-release)
+                    self.acquires.append(AcquireSite(
+                        lock, call.lineno, call.col_offset, awaited=False,
+                        released_in_finally=self._released_later(
+                            lock, call.lineno)))
+            if f.attr == "release":
+                lock = _self_attr_chain(f.value)
+                if lock is not None and _is_lockish(lock, self.lock_attrs):
+                    self.releases.append((lock, call.lineno))
+
+    # -- statement walk ---------------------------------------------------
+    def run(self) -> None:
+        for stmt in self.fn.body:
+            self._stmt(stmt, top=True)
+        if self._spawned_calls:
+            self.calls = [c for c in self.calls
+                          if (c[0], c[1]) not in self._spawned_calls]
+        # an awaited acquire is seen by both the Await and the Call
+        # visitors: collapse to one site (awaited wins)
+        by_site: Dict[Tuple[str, int, int], AcquireSite] = {}
+        for acq in self.acquires:
+            key = (acq.lock, acq.line, acq.col)
+            prev = by_site.get(key)
+            if prev is None or (acq.awaited and not prev.awaited):
+                by_site[key] = acq
+        self.acquires = [by_site[k] for k in sorted(by_site)]
+
+    def _write_target(self, target: ast.AST, aug: bool) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._write_target(el, aug)
+            return
+        attr = _self_attr_chain(target)
+        if attr is not None:
+            self.accesses.append(Access(
+                "w", attr, target.lineno, target.col_offset, aug=aug,
+                loops=tuple(self._loop_stack), locks=self._locks()))
+        # subscripts/attribute chains also READ their root object
+        if isinstance(target, (ast.Attribute, ast.Subscript)):
+            self._expr_events(target.value)
+
+    def _stmt(self, stmt: ast.AST, top: bool = False) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested frame
+        if isinstance(stmt, ast.Assign):
+            self._expr_events(stmt.value)
+            for t in stmt.targets:
+                self._write_target(t, aug=False)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._expr_events(stmt.value)
+                self._write_target(stmt.target, aug=False)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._expr_events(stmt.value)
+            attr = _self_attr_chain(stmt.target)
+            if attr is not None:
+                self.accesses.append(Access(
+                    "r", attr, stmt.lineno, stmt.col_offset, aug=True,
+                    loops=tuple(self._loop_stack), locks=self._locks()))
+                self.accesses.append(Access(
+                    "w", attr, stmt.lineno, stmt.col_offset, aug=True,
+                    loops=tuple(self._loop_stack), locks=self._locks()))
+            if isinstance(stmt.target, (ast.Attribute, ast.Subscript)):
+                self._expr_events(stmt.target.value)
+            return
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                self._write_target(t, aug=False)
+            return
+        if isinstance(stmt, ast.If):
+            is_guard = top and all(
+                isinstance(s, (ast.Raise, ast.Return)) for s in stmt.body)
+            self._expr_events(stmt.test, guard=is_guard)
+            for s in stmt.body:
+                self._stmt(s)
+            for s in stmt.orelse:
+                self._stmt(s, top=top)
+            return
+        if isinstance(stmt, ast.While):
+            self._loop_ids += 1
+            self._loop_stack.append(self._loop_ids)
+            self._expr_events(stmt.test, loop_test=True)
+            for s in stmt.body:
+                self._stmt(s)
+            self._loop_stack.pop()
+            for s in stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            # the iterable is evaluated ONCE, before the loop
+            self._expr_events(stmt.iter)
+            if isinstance(stmt, ast.AsyncFor):
+                self.accesses.append(Access(
+                    "a", None, stmt.lineno, stmt.col_offset,
+                    loops=tuple(self._loop_stack), locks=self._locks()))
+            self._loop_ids += 1
+            self._loop_stack.append(self._loop_ids)
+            self._write_target(stmt.target, aug=False)
+            for s in stmt.body:
+                self._stmt(s)
+            self._loop_stack.pop()
+            for s in stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            entered: List[str] = []
+            for item in stmt.items:
+                self._expr_events(item.context_expr)
+                lock = None
+                ctx = item.context_expr
+                if isinstance(ctx, ast.Call):
+                    ctx = ctx.func  # e.g. self._lock() styles
+                attr = _self_attr_chain(ctx)
+                if attr is not None and _is_lockish(attr, self.lock_attrs):
+                    lock = attr
+                if isinstance(stmt, ast.AsyncWith):
+                    self.accesses.append(Access(
+                        "a", None, stmt.lineno, stmt.col_offset,
+                        loops=tuple(self._loop_stack), locks=self._locks()))
+                if lock is not None:
+                    entered.append(lock)
+                    self._lock_stack.append(lock)
+                    end = max((n.lineno for n in ast.walk(stmt)
+                               if hasattr(n, "lineno")), default=stmt.lineno)
+                    self.lock_regions.append(LockRegion(
+                        lock, stmt.lineno, end, stmt.lineno))
+            for s in stmt.body:
+                self._stmt(s)
+            for _ in entered:
+                self._lock_stack.pop()
+            return
+        if isinstance(stmt, ast.Try):
+            for s in stmt.body:
+                self._stmt(s, top=top)
+            for h in stmt.handlers:
+                for s in h.body:
+                    self._stmt(s)
+            for s in stmt.orelse:
+                self._stmt(s)
+            for s in stmt.finalbody:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            # an await in `return await f()` cannot straddle anything:
+            # no code of this function runs after it on this path
+            self._expr_events(stmt.value, terminal=True)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._expr_events(stmt.value)
+            return
+        if isinstance(stmt, (ast.Raise, ast.Assert)):
+            for child in ast.iter_child_nodes(stmt):
+                self._expr_events(child, terminal=isinstance(
+                    stmt, ast.Raise))
+            return
+        # anything else (pass, break, continue, global, import...)
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._expr_events(child)
+
+
+def _init_lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Attributes assigned a Lock/Condition/Semaphore in __init__."""
+    out: Set[str] = set()
+    for node in cls.body:
+        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == "__init__"):
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                v = sub.value
+                ctor = None
+                if isinstance(v, ast.Call):
+                    f = v.func
+                    name = f.attr if isinstance(f, ast.Attribute) else (
+                        f.id if isinstance(f, ast.Name) else None)
+                    ctor = name
+                if ctor not in _LOCK_CTORS:
+                    continue
+                for t in sub.targets:
+                    attr = _self_attr_chain(t)
+                    if attr is not None:
+                        out.add(attr)
+    return out
+
+
+def extract_classes(tree: ast.AST) -> Iterator[ClassModel]:
+    """Build a ClassModel for every class in a module (top level and
+    nested)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        def base_name(b: ast.AST) -> str:
+            if isinstance(b, ast.Subscript):  # Service[Req, Rsp]
+                b = b.value
+            if isinstance(b, ast.Name):
+                return b.id
+            if isinstance(b, ast.Attribute):
+                return b.attr
+            return ""
+
+        bases = tuple(base_name(b) for b in node.bases)
+        lock_attrs = _init_lock_attrs(node)
+        cm = ClassModel(node.name, node.lineno, bases, lock_attrs=lock_attrs)
+        for item in node.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            ex = _MethodExtractor(item, lock_attrs)
+            ex.run()
+            mm = MethodModel(
+                item.name, isinstance(item, ast.AsyncFunctionDef),
+                item.lineno, ex.accesses, ex.lock_regions, ex.acquires,
+                ex.releases, ex.calls)
+            cm.methods[item.name] = mm
+        cm.infer_lock_held()
+        cm.inline_sync_helpers()
+        yield cm

@@ -1,0 +1,309 @@
+"""l5drace rules over the shared-state model.
+
+Four rules, all rooted in the same fact: an ``await`` is the only place
+an asyncio task can lose the CPU, so any read-...-await-...-write
+sequence on shared state is a real interleaving window, and any lock
+that doesn't span the window doesn't help.
+
+- ``await-atomicity`` — (a) read -> await -> write of the same shared
+  attribute with no single lock spanning all three (a torn
+  read-modify-write: the value written was computed from a stale read);
+  (b) an entry guard (``if self._closed: raise``) on a shared attribute
+  checked before the first await and never re-checked after one, in a
+  method that then mutates shared state (check-then-act: a concurrent
+  writer invalidates the guard mid-flight).
+- ``lock-guard``    — an attribute accessed under ``async with self.L``
+  on some paths is written (or read after an await) WITHOUT the lock on
+  another async path: the lock guards nothing it doesn't cover.
+- ``lock-order``    — acquiring lock B while holding lock A in one
+  method and A while holding B in another: two tasks deadlock.
+- ``lock-release``  — a lock ``.acquire()`` with no ``.release()``
+  reachable in a later ``finally`` of the same function and none
+  anywhere else in the class: one exception leaks the lock forever.
+
+Every rule anchors its finding on the line that must change (the write,
+the acquire) so ``# l5d: ignore[rule] — why`` suppressions sit on the
+code they waive.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.analysis.core import (
+    Checker, Finding, Project, SourceFile, register_race_checker,
+)
+from tools.analysis.race.model import (
+    Access, ClassModel, MethodModel, extract_classes,
+)
+
+
+class RaceChecker(Checker):
+    """Base for race rules: iterates class models per source file."""
+
+    scope = ("linkerd_tpu/router", "linkerd_tpu/protocol",
+             "linkerd_tpu/telemetry", "linkerd_tpu/lifecycle")
+
+    def check(self, src: SourceFile, project: Project) -> Iterator[Finding]:
+        for cm in extract_classes(src.tree):
+            yield from self.check_class(src, cm)
+
+    def check_class(self, src: SourceFile,
+                    cm: ClassModel) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+def _spanning_lock(r: Access, a: Access, w: Access) -> Optional[str]:
+    common = set(r.locks) & set(a.locks) & set(w.locks)
+    return sorted(common)[0] if common else None
+
+
+@register_race_checker
+class AwaitAtomicityChecker(RaceChecker):
+    rule = "await-atomicity"
+    description = ("read -> await -> write (or unchecked entry guard) on "
+                   "a shared attribute with no lock spanning the window")
+
+    def check_class(self, src: SourceFile,
+                    cm: ClassModel) -> Iterator[Finding]:
+        shared = cm.shared_attrs()
+        if not shared:
+            return
+        for m in cm.methods.values():
+            if not m.is_async or m.name in ("__init__",):
+                continue
+            acc = m.effective()
+            awaits = [a for a in acc if a.kind == "a"]
+            if not awaits:
+                continue
+            yield from self._torn_rmw(src, cm, m, acc, awaits, shared)
+            yield from self._stale_guard(src, cm, m, acc, awaits, shared)
+
+    # -- (a) read -> await -> write --------------------------------------
+    def _torn_rmw(self, src, cm, m, acc, awaits, shared):
+        reported: Set[str] = set()
+        for attr in shared:
+            if attr in reported:
+                continue
+            # inlined reads stay internal to their helper (the value
+            # cannot flow into a later caller-side write at this
+            # resolution), and an AugAssign write is an atomic RMW that
+            # does not consume the earlier read's value
+            reads = [x for x in acc
+                     if x.kind == "r" and x.attr == attr and not x.aug
+                     and x.inlined_from is None]
+            writes = [x for x in acc
+                      if x.kind == "w" and x.attr == attr and not x.aug]
+            hit = None
+            for r in reads:
+                if hit:
+                    break
+                for w in writes:
+                    if w.line <= r.line:
+                        continue
+                    for a in awaits:
+                        if a.terminal:
+                            continue  # return/raise await: no code after
+                        if not (r.line < a.line < w.line):
+                            continue
+                        # a while-test read re-evaluates after every
+                        # await anywhere inside its own loop — not stale
+                        if r.loop_test and r.loop in a.loops:
+                            continue
+                        # all three inside one shared loop: the linear
+                        # order is cyclic, nothing to conclude
+                        if set(r.loops) & set(a.loops) & set(w.loops):
+                            continue
+                        if _spanning_lock(r, a, w):
+                            continue
+                        # the sanctioned fix idiom: a fresh read between
+                        # the await and the write means the stale value
+                        # was discarded (a later await after THAT read
+                        # forms its own triple and still fires)
+                        if any(r2.line > a.line and r2.line <= w.line
+                               for r2 in reads if r2 is not r):
+                            continue
+                        hit = (r, a, w)
+                        break
+                    if hit:
+                        break
+            if hit:
+                r, a, w = hit
+                reported.add(attr)
+                yield Finding(
+                    self.rule, src.rel, w.line, w.col,
+                    f"{cm.name}.{m.name}: self.{attr} read at line "
+                    f"{r.line} and written at line {w.line} straddle the "
+                    f"await at line {a.line} — a concurrent task can "
+                    f"interleave and the write lands a stale value; span "
+                    f"both with one 'async with' lock or re-read after "
+                    f"the await")
+
+    # -- (b) stale entry guard -------------------------------------------
+    def _stale_guard(self, src, cm, m, acc, awaits, shared):
+        first_await = min(a.line for a in awaits)
+        # the guarded method must go on to mutate shared state — a pure
+        # read path can tolerate a stale check
+        mutates_after = any(
+            x.kind == "w" and x.attr in shared and x.line > first_await
+            for x in acc)
+        if not mutates_after:
+            return
+        reported: Set[str] = set()
+        for g in acc:
+            if not (g.kind == "r" and g.guard and g.attr in shared
+                    and g.loop == 0 and g.line < first_await
+                    and g.attr not in reported):
+                continue
+            attr = g.attr
+            # re-checked after an await (incl. loop-carried re-reads)?
+            # Reads inlined from sync helpers don't count: the helper's
+            # internal check cannot guard the caller's act.
+            rechecked = any(
+                x.kind == "r" and x.attr == attr and x is not g
+                and x.inlined_from is None
+                and (x.line > first_await
+                     or (x.loop and any(x.loop in a.loops
+                                        for a in awaits)))
+                for x in acc)
+            if rechecked:
+                continue
+            # a concurrent writer must exist for the guard to go stale
+            writers = cm.writers_of(attr) - {m.name}
+            if not writers:
+                continue
+            if g.locks and any(set(g.locks) <= set(a.locks)
+                               for a in awaits):
+                continue  # guard + awaits under one lock: serialized
+            reported.add(attr)
+            yield Finding(
+                self.rule, src.rel, g.line, g.col,
+                f"{cm.name}.{m.name}: guard on self.{attr} (written by "
+                f"{', '.join(sorted(writers))}) is checked before the "
+                f"first await (line {first_await}) but never re-checked "
+                f"after one — a concurrent writer can invalidate it "
+                f"mid-flight; re-check after the await or hold a lock "
+                f"across the window")
+
+
+@register_race_checker
+class LockGuardChecker(RaceChecker):
+    rule = "lock-guard"
+    description = ("attribute guarded by 'async with self.<lock>' on some "
+                   "paths is mutated (or read after an await) without it "
+                   "on others")
+
+    def check_class(self, src: SourceFile,
+                    cm: ClassModel) -> Iterator[Finding]:
+        if not cm.lock_attrs and not any(
+                m.lock_regions for m in cm.methods.values()):
+            return
+        # which attrs are ever accessed under which lock?
+        guarded_by: Dict[str, Set[str]] = {}
+        for m in cm.methods.values():
+            for a in m.effective():
+                if a.attr is None or a.attr in cm.lock_attrs:
+                    continue
+                for lock in a.locks:
+                    guarded_by.setdefault(a.attr, set()).add(lock)
+        if not guarded_by:
+            return
+        shared = cm.shared_attrs()
+        for m in cm.methods.values():
+            if not m.is_async or m.name in ("__init__",):
+                continue
+            acc = m.effective()
+            awaits = [a for a in acc if a.kind == "a"]
+            first_await = min((a.line for a in awaits), default=None)
+            seen: Set[Tuple[str, str]] = set()
+            for a in acc:
+                if a.attr not in guarded_by or a.attr not in shared:
+                    continue
+                locks = guarded_by[a.attr]
+                if set(a.locks) & locks:
+                    continue
+                kind = None
+                if a.kind == "w":
+                    kind = "written"
+                elif (a.kind == "r" and not a.aug
+                      and first_await is not None and a.line > first_await
+                      and not a.loop_test):
+                    kind = "read after an await"
+                if kind is None or (a.attr, kind) in seen:
+                    continue
+                seen.add((a.attr, kind))
+                via = (f" (via {a.inlined_from}())"
+                       if a.inlined_from else "")
+                yield Finding(
+                    self.rule, src.rel, a.line, a.col,
+                    f"{cm.name}.{m.name}: self.{a.attr} is {kind} without "
+                    f"holding {' / '.join(sorted(locks))}{via}, but other "
+                    f"paths access it under that lock — the lock guards "
+                    f"nothing it does not cover; take it here too")
+
+
+@register_race_checker
+class LockOrderChecker(RaceChecker):
+    rule = "lock-order"
+    description = ("lock A taken while holding B in one method and B "
+                   "while holding A in another: ordering cycle "
+                   "(deadlock)")
+
+    def check_class(self, src: SourceFile,
+                    cm: ClassModel) -> Iterator[Finding]:
+        # edges: (outer, inner) with an example site
+        edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        for m in cm.methods.values():
+            for reg in m.lock_regions:
+                for inner in m.lock_regions:
+                    if (inner is not reg
+                            and reg.start <= inner.line <= reg.end
+                            and inner.lock != reg.lock):
+                        edges.setdefault((reg.lock, inner.lock),
+                                         (m.name, inner.line))
+                for acq in m.acquires:
+                    if (reg.start <= acq.line <= reg.end
+                            and acq.lock != reg.lock):
+                        edges.setdefault((reg.lock, acq.lock),
+                                         (m.name, acq.line))
+        reported: Set[frozenset] = set()
+        for (a, b), (meth, line) in edges.items():
+            if (b, a) in edges and frozenset((a, b)) not in reported:
+                reported.add(frozenset((a, b)))
+                other_meth, other_line = edges[(b, a)]
+                yield Finding(
+                    self.rule, src.rel, line, 0,
+                    f"{cm.name}: {meth} takes self.{b} while holding "
+                    f"self.{a} (line {line}) but {other_meth} takes "
+                    f"self.{a} while holding self.{b} (line "
+                    f"{other_line}) — two tasks deadlock; pick one "
+                    f"order")
+
+
+@register_race_checker
+class LockReleaseChecker(RaceChecker):
+    rule = "lock-release"
+    description = ("bare .acquire() with no .release() in a later "
+                   "finally (and none anywhere else in the class)")
+
+    def check_class(self, src: SourceFile,
+                    cm: ClassModel) -> Iterator[Finding]:
+        class_releases: Set[str] = set()
+        for m in cm.methods.values():
+            for lock, _line in m.releases:
+                class_releases.add(lock)
+        for m in cm.methods.values():
+            for acq in m.acquires:
+                if acq.released_in_finally:
+                    continue
+                if acq.lock in class_releases:
+                    # released on another path (pool checkout/checkin
+                    # style) — structured enough to trust
+                    continue
+                yield Finding(
+                    self.rule, src.rel, acq.line, acq.col,
+                    f"{cm.name}.{m.name}: self.{acq.lock}.acquire() with "
+                    f"no release() in a later finally and none anywhere "
+                    f"in the class — one exception and the lock is held "
+                    f"forever; use 'async with self.{acq.lock}' or a "
+                    f"try/finally release")

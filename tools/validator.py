@@ -24,6 +24,11 @@ tree — non-zero exit on any unsuppressed finding:
 
     python tools/validator.py lint [path ...]
 
+And the l5drace await-atomicity/lock-discipline analysis
+(tools/analysis/race) over the asyncio data plane:
+
+    python tools/validator.py race [path ...]
+
 And the l5dcheck semantic config verification (tools/analysis/semantic)
 over linker/namerd YAML — defaults to every fixture under tests/configs/
 and examples/ when no files are given:
@@ -447,10 +452,23 @@ def validate_lint(paths) -> int:
     return rc
 
 
+def validate_race(paths) -> int:
+    """Run the race suite; exit 0 only when the data plane carries zero
+    unsuppressed await-atomicity / lock-discipline findings."""
+    from tools.analysis.__main__ import main as analysis_main
+
+    rc = analysis_main(["race", *paths])
+    if rc == 0:
+        print("VALIDATOR PASS (race)")
+    return rc
+
+
 async def main() -> int:
     args = sys.argv[1:]
     if args and args[0] == "lint":
         return validate_lint(args[1:])
+    if args and args[0] == "race":
+        return validate_race(args[1:])
     if args and args[0] == "config":
         return validate_config(args[1:])
     if args and args[0] == "ckpt":
