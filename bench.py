@@ -48,6 +48,13 @@ def scorer_throughput() -> dict:
 
     async def drive() -> tuple:
         await scorer.score(host_batches[0])  # warm / compile
+        # seam measurement phase: phase-split timing ON for 20 batches
+        # (transfer_GBps / device_step_ms), then OFF so the headline
+        # latency/throughput loops keep the fused dispatch path
+        scorer.timing_enabled = True
+        for i in range(20):
+            await scorer.score(host_batches[i % len(host_batches)])
+        scorer.timing_enabled = False
         # per-batch e2e latency: sequential score() calls, the shape a
         # single accrual-policy consumer sees (VERDICT r3 item 4)
         lats = []
@@ -68,6 +75,20 @@ def scorer_throughput() -> dict:
         return time.perf_counter() - t0, lats
 
     dt, lats = asyncio.run(drive())
+    # seam efficiency (ROADMAP item 3): host<->device transfer bandwidth
+    # and pure device-step time, from the scorer's own timing hooks —
+    # the same decomposition the scorer-path trace spans annotate
+    tt = dict(scorer.timing_totals)
+    seam = {}
+    if tt.get("calls"):
+        transfer_s = tt["transfer_ms"] / 1e3
+        seam["transfer_GBps"] = (
+            round(tt["bytes"] / transfer_s / 1e9, 3)
+            if transfer_s > 0 else None)
+        seam["device_step_ms"] = round(tt["device_ms"] / tt["calls"], 3)
+        seam["transfer_ms_avg"] = round(tt["transfer_ms"] / tt["calls"], 3)
+        seam["dispatch_queue_ms_avg"] = round(
+            tt["queue_ms"] / tt["calls"], 3)
     # pipelined generator path (double-buffered transfer; score_batches)
     gen_batches = (host_batches[i % len(host_batches)]
                    for i in range(n_iters))
@@ -76,6 +97,7 @@ def scorer_throughput() -> dict:
         pass
     dt_pipe = time.perf_counter() - t0
     return {
+        **seam,
         "rows_per_s": max(batch * n_iters / dt,
                           batch * n_iters / dt_pipe),
         "rows_per_s_async4": round(batch * n_iters / dt, 1),
@@ -220,6 +242,92 @@ def grpc_bench() -> dict:
     if proc.returncode != 0:
         return {"error": proc.stderr[-500:]}
     return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def observability_bench() -> dict:
+    """The observability layer under load, in-process: a traced router
+    (zipkin exporter -> stub collector) serving paced requests. Reports
+    per-stage latency decomposition (rt/<router>/stage/*), span export
+    counts, the exporter's buffer/drop stats, and throughput with the
+    full tracing+stage pipeline enabled — the cost of being able to ask
+    "where did my millisecond go"."""
+    import asyncio
+
+    async def drive() -> dict:
+        import tempfile
+
+        from linkerd_tpu.linker import load_linker
+        from linkerd_tpu.protocol.http import Request, Response
+        from linkerd_tpu.protocol.http.client import HttpClient
+        from linkerd_tpu.protocol.http.server import serve
+        from linkerd_tpu.router.service import FnService
+        from linkerd_tpu.telemetry.exporters import ZipkinTelemeter
+
+        received = []
+
+        async def collector(req: Request) -> Response:
+            received.append(json.loads(req.body))
+            return Response(status=202)
+
+        async def backend(req: Request) -> Response:
+            return Response(status=200, body=b"ok")
+
+        coll = await serve(FnService(collector))
+        down = await serve(FnService(backend))
+        disco = tempfile.mkdtemp(prefix="l5d-obs-bench-")
+        with open(os.path.join(disco, "web"), "w") as f:
+            f.write(f"127.0.0.1 {down.bound_port}\n")
+        cfg = f"""
+routers:
+- protocol: http
+  label: obs
+  sampleRate: 1.0
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers: [{{port: 0}}]
+telemetry:
+- kind: io.l5d.zipkin
+  port: {coll.bound_port}
+  batchIntervalMs: 100
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+        linker = load_linker(cfg)
+        await linker.start()
+        proxy = HttpClient("127.0.0.1", linker.routers[0].server_ports[0])
+        n = 400
+        try:
+            req0 = Request(uri="/")
+            req0.headers.set("Host", "web")
+            await proxy(req0)  # warm the binding path out of the timing
+            t0 = time.perf_counter()
+            for _ in range(n):
+                req = Request(uri="/")
+                req.headers.set("Host", "web")
+                await proxy(req)
+            wall = time.perf_counter() - t0
+            zipkin = next(t for t in linker.telemeters
+                          if isinstance(t, ZipkinTelemeter))
+            await zipkin.flush()
+            flat = linker.metrics.flatten()
+            stages = {
+                k.rsplit("/", 2)[1].replace("_ms", ""): round(v, 3)
+                for k, v in flat.items()
+                if k.startswith("rt/obs/stage/") and k.endswith("/p50")}
+            return {
+                "traced_req_s": round(n / wall, 1),
+                "stage_p50_ms": stages,
+                "spans_exported": sum(len(b) for b in received),
+                "tracer": zipkin.stats(),
+            }
+        finally:
+            await proxy.close()
+            await linker.close()
+            await down.close()
+            await coll.close()
+
+    return asyncio.run(drive())
 
 
 def lifecycle_bench() -> dict:
@@ -471,6 +579,9 @@ def main() -> None:
     def ph_lifecycle() -> None:
         detail["lifecycle"] = lifecycle_bench()
 
+    def ph_observability() -> None:
+        detail["observability"] = observability_bench()
+
     def ph_static() -> None:
         detail["static_analysis"] = static_analysis_bench()
 
@@ -491,6 +602,7 @@ def main() -> None:
         ("subtle_auc", ph_subtle),
         ("sharded_cpu8", ph_sharded),
         ("lifecycle", ph_lifecycle),
+        ("observability", ph_observability),
         ("static_analysis", ph_static),
         ("race_analysis", ph_race),
         ("semantic_check", ph_semantic),

@@ -34,13 +34,22 @@ class AdminServer:
         self.host = host
         self.port = port
         self._handlers: Dict[str, Handler] = {}
+        self._prefix_handlers: List[Tuple[str, Handler]] = []
         self._server: Optional[HttpServer] = None
         self.add_handler("/ping", self._ping)
         self.add_handler("/config.json", self._config)
         self.add_handler("/admin/metrics.json", self._metrics_json)
+        # short alias (namerd's documented surface; same tree)
+        self.add_handler("/metrics.json", self._metrics_json)
 
     def add_handler(self, path: str, handler: Handler) -> None:
         self._handlers[path] = handler
+
+    def add_prefix_handler(self, prefix: str, handler: Handler) -> None:
+        """Route every path under ``prefix`` to ``handler`` (exact
+        matches win; longest prefix wins among prefixes)."""
+        self._prefix_handlers.append((prefix, handler))
+        self._prefix_handlers.sort(key=lambda ph: -len(ph[0]))
 
     def add_handlers(self, handlers: List[Tuple[str, Handler]]) -> None:
         for path, h in handlers:
@@ -63,6 +72,11 @@ class AdminServer:
     # -- routing ----------------------------------------------------------
     async def _route(self, req: Request) -> Response:
         handler = self._handlers.get(req.path)
+        if handler is None:
+            for prefix, h in self._prefix_handlers:
+                if req.path.startswith(prefix):
+                    handler = h
+                    break
         if handler is None:
             return json_response(
                 {"error": "not found", "handlers": sorted(self._handlers)},

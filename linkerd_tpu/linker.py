@@ -46,8 +46,10 @@ from linkerd_tpu.router.routing import (
 from linkerd_tpu.router.service import (
     Filter, FnService, Service, filters_to_service,
 )
+from linkerd_tpu.router.stages import StageTimerFilter
 from linkerd_tpu.router.tracing import (
-    AccessLogger, ClientTraceFilter, ServerTraceFilter,
+    AccessLogger, ClientTraceFilter, MuxClientTraceFilter,
+    MuxServerTraceFilter, ServerTraceFilter,
 )
 from linkerd_tpu.telemetry.metrics import MetricsTree
 from linkerd_tpu.telemetry.telemeter import BroadcastTracer, NullTracer
@@ -474,6 +476,13 @@ class Linker:
         # broadcast tracer over all telemeter tracers (ref: Linker.scala:152-157)
         tracers = [t.tracer for t in self.telemeters if t.tracer is not None]
         self.tracer = BroadcastTracer(tracers) if tracers else NullTracer()
+        if tracers:
+            # span-PRODUCING telemeters (the anomaly micro-batcher emits
+            # scorer spans) get the assembled sink; with no tracer
+            # configured they stay silent
+            for t in self.telemeters:
+                if hasattr(t, "set_tracer"):
+                    t.set_tracer(self.tracer)
 
         labels_seen: Dict[str, int] = {}
         for rspec in self.spec.routers:
@@ -695,6 +704,9 @@ class Linker:
                 ClientDeadlineFilter()]
             filters.extend(extra_filters)
             filters.extend(logger_filters)
+            if not isinstance(self.tracer, NullTracer):
+                # h2 carries l5d-ctx-trace as a plain header like http
+                filters.append(ClientTraceFilter(self.tracer, cid))
             metrics.scope("rt", label, "client", cid).gauge(
                 "endpoints", fn=lambda b=bal: b.size)
             return _PruneOnClose(
@@ -741,8 +753,12 @@ class Linker:
 
         routing = RoutingService(identifier, binding)
         server_filters: List[Any] = [
+            StageTimerFilter(metrics, "rt", label),
             H2StreamStatsFilter(metrics, "rt", label, "server"),
         ]
+        if not isinstance(self.tracer, NullTracer):
+            server_filters.insert(
+                0, ServerTraceFilter(self.tracer, label, rspec.sampleRate))
         for t in self.telemeters:
             if hasattr(t, "recorder"):
                 server_filters.append(t.recorder())
@@ -837,8 +853,11 @@ class Linker:
                 self._dest = residual.show if len(residual) else "/"
 
             async def apply(self, td: Tdispatch, service: Service):
+                # ctx rides along: the client trace filter below this
+                # layer reads td.ctx["trace"] to propagate the span
                 return await service(Tdispatch(
-                    td.tag, td.contexts, self._dest, [], td.payload))
+                    td.tag, td.contexts, self._dest, [], td.payload,
+                    td.ctx))
 
         def client_factory(bound: BoundName) -> Service:
             if _status_code_of(bound) is not None:
@@ -862,11 +881,16 @@ class Linker:
             bal = mk_balancer(bal_kind, bound.addr, endpoint_factory)
             metrics.scope("rt", label, "client", cid).gauge(
                 "endpoints", fn=lambda b=bal: b.size)
+            client_filters: List[Any] = [
+                MuxStatsFilter(metrics.scope("rt", label, "client", cid)),
+                *extra_filters]
+            if not isinstance(self.tracer, NullTracer):
+                # propagate l5d-ctx-trace in the Tdispatch context
+                # section (the mux analogue of the http header)
+                client_filters.append(
+                    MuxClientTraceFilter(self.tracer, cid))
             return _PruneOnClose(
-                filters_to_service(
-                    [MuxStatsFilter(
-                        metrics.scope("rt", label, "client", cid)),
-                     *extra_filters], bal),
+                filters_to_service(client_filters, bal),
                 metrics, ("rt", label, "client", cid))
 
         def bound_filters(bound: BoundName, svc: Service) -> Service:
@@ -898,7 +922,11 @@ class Linker:
             bind_timeout=rspec.bindingTimeoutMs / 1e3)
         routing = RoutingService(identifier, binding)
         server_filters: List[Any] = [
+            StageTimerFilter(metrics, "rt", label),
             MuxStatsFilter(metrics.scope("rt", label, "server"))]
+        if not isinstance(self.tracer, NullTracer):
+            server_filters.insert(0, MuxServerTraceFilter(
+                self.tracer, label, rspec.sampleRate))
         server_stack = filters_to_service(server_filters, routing)
         per_server_stack = self._per_server_stack_fn(
             label, server_filters, routing, server_stack)
@@ -1049,6 +1077,7 @@ class Linker:
             bind_timeout=rspec.bindingTimeoutMs / 1e3)
         routing = RoutingService(identifier, binding)
         server_filters: List[Any] = [
+            StageTimerFilter(metrics, "rt", label),
             ThriftStatsFilter(metrics.scope("rt", label, "server"))]
         server_stack = filters_to_service(server_filters, routing)
         per_server_stack = self._per_server_stack_fn(
@@ -1345,8 +1374,11 @@ class Linker:
 
         routing = RoutingService(identifier, binding)
         # Stats outermost so they observe ErrorResponder's mapped statuses;
-        # anomaly feature recorders tap the same final view.
+        # anomaly feature recorders tap the same final view. The stage
+        # timer sits just inside the trace filter so span tags see the
+        # completed per-stage totals.
         server_filters: List[Any] = [
+            StageTimerFilter(metrics, "rt", label),
             StatsFilter(metrics, "rt", label, "server"),
             StatusCodeStatsFilter(metrics, "rt", label, "server"),
         ]

@@ -137,7 +137,11 @@ class NamerdProcess:
         for ncfg in instantiate_list("namer", spec.namers, "namers"):
             prefix = Path.read(getattr(ncfg, "prefix", f"/{ncfg.kind}"))
             namers.append((prefix, ncfg.mk()))
-        self.namerd = Namerd(store, namers)
+        # one MetricsTree behind all three interfaces + the store,
+        # exported by the admin server at /metrics.json
+        from linkerd_tpu.telemetry.metrics import MetricsTree
+        self.metrics = MetricsTree()
+        self.namerd = Namerd(store, namers, metrics=self.metrics)
         self._iface_cfgs = instantiate_list(
             "namerdIface", spec.interfaces, "interfaces")
         self.servers: List[Any] = []
@@ -150,10 +154,14 @@ class NamerdProcess:
             self.servers.append(server)
         if self.spec.admin is not None:
             from linkerd_tpu.admin.server import AdminServer
-            from linkerd_tpu.telemetry.metrics import MetricsTree
+            from linkerd_tpu.namerd.admin_pages import namerd_admin_handlers
             self.admin_server = AdminServer(
-                MetricsTree(), config_dict=self.config_dict,
+                self.metrics, config_dict=self.config_dict,
                 port=int(self.spec.admin.get("port", 9991)))
+            exact, prefix = namerd_admin_handlers(self.namerd)
+            self.admin_server.add_handlers(exact)
+            for p, h in prefix:
+                self.admin_server.add_prefix_handler(p, h)
             await self.admin_server.start()
         return self
 

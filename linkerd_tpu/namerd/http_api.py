@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import time
 from typing import Any, AsyncIterator, Callable, Dict, Optional
 from urllib.parse import parse_qsl, unquote, urlsplit
 
@@ -127,36 +128,74 @@ async def _watch_var(var: Var, to_json: Callable[[Any], Any]
 
 class HttpControlService(Service[Request, Response]):
     """The control API as a plain HTTP service (mount standalone or on
-    the admin server)."""
+    the admin server). Per-endpoint request/latency/failure stats land
+    under ``namerd/http/<endpoint>/*`` in the namerd MetricsTree."""
 
     def __init__(self, namerd: Namerd):
         self._namerd = namerd
+        self._metrics = namerd.metrics.scope("namerd", "http")
+        # live watch streams (chunked NDJSON responses still open)
+        self._watches = 0
+        self._metrics.gauge("watches", fn=lambda: float(self._watches))
+
+    def _observe(self, endpoint: str, t0: float, status: int) -> None:
+        node = self._metrics.scope(endpoint)
+        node.counter("requests").incr()
+        node.stat("latency_ms").add((time.monotonic() - t0) * 1e3)
+        node.counter("status", f"{status // 100}XX").incr()
+        if status >= 500:
+            node.counter("failures").incr()
+
+    def _track_watch(self, gen: AsyncIterator[bytes]) -> AsyncIterator[bytes]:
+        async def tracked():
+            self._watches += 1
+            try:
+                async for line in gen:
+                    yield line
+            finally:
+                self._watches -= 1
+        return tracked()
 
     async def __call__(self, req: Request) -> Response:
         parts = urlsplit(req.uri)
         segs = [unquote(s) for s in parts.path.split("/") if s]
         q = dict(parse_qsl(parts.query))
         watch = q.get("watch", "").lower() == "true"
+        # bounded metric cardinality: only the fixed route set may name
+        # a scope — an unmatched path (scanner sweep) must not mint a
+        # permanent tree node per unique segment
+        endpoint = (segs[2] if len(segs) >= 3 and segs[2] in (
+            "dtabs", "bind", "addr", "resolve", "delegate") else "unknown")
+        t0 = time.monotonic()
         try:
+            rsp: Optional[Response] = None
             if segs[:3] == ["api", "1", "dtabs"]:
-                return await self._dtabs(req, segs[3:], q, watch)
-            if segs[:3] == ["api", "1", "bind"] and len(segs) == 4:
-                return await self._bind(segs[3], q, watch)
-            if segs[:3] == ["api", "1", "addr"] and len(segs) == 4:
-                return await self._addr(segs[3], q, watch)
-            if segs[:3] == ["api", "1", "resolve"] and len(segs) == 4:
-                return await self._resolve(segs[3], q, watch)
-            if segs[:3] == ["api", "1", "delegate"] and len(segs) == 4:
-                return await self._delegate(segs[3], q)
+                rsp = await self._dtabs(req, segs[3:], q, watch)
+            elif segs[:3] == ["api", "1", "bind"] and len(segs) == 4:
+                rsp = await self._bind(segs[3], q, watch)
+            elif segs[:3] == ["api", "1", "addr"] and len(segs) == 4:
+                rsp = await self._addr(segs[3], q, watch)
+            elif segs[:3] == ["api", "1", "resolve"] and len(segs) == 4:
+                rsp = await self._resolve(segs[3], q, watch)
+            elif segs[:3] == ["api", "1", "delegate"] and len(segs) == 4:
+                rsp = await self._delegate(segs[3], q)
         except DtabNamespaceDoesNotExist as e:
-            return _err(404, str(e))
+            rsp = _err(404, str(e))
         except DtabNamespaceAlreadyExists as e:
-            return _err(409, str(e))
+            rsp = _err(409, str(e))
         except DtabVersionMismatch as e:
-            return _err(412, str(e))
+            rsp = _err(412, str(e))
         except (ValueError, KeyError) as e:
-            return _err(400, f"bad request: {e}")
-        return _err(404, f"no such endpoint {parts.path}")
+            rsp = _err(400, f"bad request: {e}")
+        except BaseException:
+            self._observe(endpoint, t0, 500)
+            raise
+        if rsp is None:
+            rsp = _err(404, f"no such endpoint {parts.path}")
+        if rsp.body_stream is not None:
+            rsp.body_stream = self._track_watch(rsp.body_stream)
+        self._observe(endpoint, t0, rsp.status)
+        return rsp
 
     # ---- /api/1/dtabs ------------------------------------------------------
 

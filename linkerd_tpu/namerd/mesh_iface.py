@@ -10,6 +10,7 @@ default port 4321 (MeshIfaceInitializer.scala:60).
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import AsyncIterator, Optional
 
 from linkerd_tpu.core import Activity, Dtab, Path
@@ -22,6 +23,7 @@ from linkerd_tpu.mesh import (
     DELEGATOR_SVC, INTERPRETER_SVC, RESOLVER_SVC, converters, messages as m,
 )
 from linkerd_tpu.namerd.core import Namerd
+from linkerd_tpu.telemetry.metrics import observed
 
 DEFAULT_MESH_PORT = 4321
 
@@ -53,23 +55,78 @@ def _first_leaf(tree: NameTree) -> Optional[BoundName]:
 
 
 class MeshIface:
-    """Registers the three mesh services on a ServerDispatcher."""
+    """Registers the three mesh services on a ServerDispatcher.
+    Per-method request/latency/failure stats plus a live-stream gauge
+    land under ``namerd/mesh/*`` in the namerd MetricsTree."""
 
     def __init__(self, namerd: Namerd):
         self._namerd = namerd
+        self._metrics = namerd.metrics.scope("namerd", "mesh")
+        self._streams = 0
+        self._metrics.gauge("streams", fn=lambda: float(self._streams))
         self.dispatcher = ServerDispatcher()
         self.dispatcher.register_all(INTERPRETER_SVC, {
-            "GetBoundTree": self.get_bound_tree,
-            "StreamBoundTree": self.stream_bound_tree,
+            "GetBoundTree": self._unary("GetBoundTree",
+                                        self.get_bound_tree),
+            "StreamBoundTree": self._streaming("StreamBoundTree",
+                                               self.stream_bound_tree),
         })
         self.dispatcher.register_all(RESOLVER_SVC, {
-            "GetReplicas": self.get_replicas,
-            "StreamReplicas": self.stream_replicas,
+            "GetReplicas": self._unary("GetReplicas", self.get_replicas),
+            "StreamReplicas": self._streaming("StreamReplicas",
+                                              self.stream_replicas),
         })
         self.dispatcher.register_all(DELEGATOR_SVC, {
-            "GetDtab": self.get_dtab,
-            "StreamDtab": self.stream_dtab,
+            "GetDtab": self._unary("GetDtab", self.get_dtab),
+            "StreamDtab": self._streaming("StreamDtab", self.stream_dtab),
         })
+
+    # ---- instrumentation ---------------------------------------------------
+
+    def _unary(self, name: str, fn):
+        node = self._metrics.scope(name)
+
+        async def wrapped(req):
+            with observed(node):
+                return await fn(req)
+        return wrapped
+
+    def _streaming(self, name: str, fn):
+        """Stream methods: count the open, gauge live streams, count
+        per-update fan-out, and record time-to-first-response (the
+        latency a linkerd waits before its first routable state)."""
+        node = self._metrics.scope(name)
+        requests = node.counter("requests")
+        failures = node.counter("failures")
+        updates = node.counter("updates")
+        first_rsp = node.stat("first_response_ms")
+
+        async def wrapped(req):
+            requests.incr()
+            t0 = time.monotonic()
+            try:
+                gen = await fn(req)
+            except BaseException:
+                failures.incr()
+                raise
+
+            async def counted():
+                self._streams += 1
+                first = True
+                try:
+                    async for rsp in gen:
+                        if first:
+                            first = False
+                            first_rsp.add((time.monotonic() - t0) * 1e3)
+                        updates.incr()
+                        yield rsp
+                except GrpcError:
+                    failures.incr()
+                    raise
+                finally:
+                    self._streams -= 1
+            return counted()
+        return wrapped
 
     # ---- Interpreter -------------------------------------------------------
 

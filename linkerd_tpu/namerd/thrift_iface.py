@@ -75,8 +75,10 @@ class Observer:
     current stamp differs, else parks until the next publish
     (ref Observer :85-124)."""
 
-    def __init__(self, stamper: _Stamper):
+    def __init__(self, stamper: _Stamper,
+                 on_publish: Optional[Callable[[], None]] = None):
         self._stamper = stamper
+        self._on_publish = on_publish
         self.stamp: Optional[bytes] = None
         self.value = None
         self.error: Optional[Exception] = None
@@ -88,12 +90,16 @@ class Observer:
         self.value = value
         self.error = None
         self.stamp = self._stamper()
+        if self._on_publish is not None:
+            self._on_publish()
         self._event.set()
         self._event = asyncio.Event()
 
     def publish_error(self, exc: Exception) -> None:
         self.error = exc
         self.stamp = self._stamper()
+        if self._on_publish is not None:
+            self._on_publish()
         self._event.set()
         self._event = asyncio.Event()
 
@@ -291,6 +297,19 @@ class ThriftNamerIface:
         self._bindings = ObserverCache(binding_cache, self._mk_binding)
         self._addrs = ObserverCache(addr_cache, self._mk_addr)
         self._dtabs = ObserverCache(64, self._mk_dtab)
+        # interface stats: per-op requests/latency/failures under
+        # namerd/thrift/<op>/*, watch-stream gauges (live observations
+        # per cache), and the publish fan-out counter (every stamped
+        # update pushed to parked long-polls)
+        self._metrics = namerd.metrics.scope("namerd", "thrift")
+        self._updates = self._metrics.counter("updates_total")
+        watches = self._metrics.scope("watches")
+        watches.gauge(
+            "bindings", fn=lambda: float(len(self._bindings._entries)))
+        watches.gauge(
+            "addrs", fn=lambda: float(len(self._addrs._entries)))
+        watches.gauge(
+            "dtabs", fn=lambda: float(len(self._dtabs._entries)))
 
     async def start(self) -> "ThriftNamerIface":
         await self._server.start()
@@ -310,7 +329,7 @@ class ThriftNamerIface:
 
     def _mk_binding(self, key) -> Observer:
         ns, dtab_str, path_show = key
-        obs = Observer(self._stamper)
+        obs = Observer(self._stamper, on_publish=self._updates.incr)
         interp = self.namerd.interpreter(ns)
         activity = interp.bind(Dtab.read(dtab_str) if dtab_str
                                else Dtab.empty(), Path.read(path_show))
@@ -347,7 +366,7 @@ class ThriftNamerIface:
             self._addr_vars.popitem(last=False)
 
     def _mk_addr(self, key: Path) -> Observer:
-        obs = Observer(self._stamper)
+        obs = Observer(self._stamper, on_publish=self._updates.incr)
         var = self._addr_vars.get(key)
         if var is None:
             obs.dead = True
@@ -365,7 +384,7 @@ class ThriftNamerIface:
         return obs
 
     def _mk_dtab(self, ns: str) -> Observer:
-        obs = Observer(self._stamper)
+        obs = Observer(self._stamper, on_publish=self._updates.incr)
         activity = self.namerd.store.observe(ns)
 
         def on_state(st) -> None:
@@ -388,6 +407,7 @@ class ThriftNamerIface:
     # -- dispatch ---------------------------------------------------------
 
     async def _dispatch(self, call: ThriftCall) -> Optional[bytes]:
+        import time
         handler = {
             "bind": self._handle_bind,
             "addr": self._handle_addr,
@@ -395,17 +415,27 @@ class ThriftNamerIface:
             "dtab": self._handle_dtab,
         }.get(call.name)
         if handler is None:
+            self._metrics.scope("unknown").counter("requests").incr()
             return encode_exception(call.name, call.seqid,
                                     f"unknown method {call.name!r}")
         # args struct begins after the message header
         hdr_len = self._header_len(call.payload)
+        node = self._metrics.scope(call.name)
+        node.counter("requests").incr()
+        t0 = time.monotonic()
         try:
+            # NOTE: latency includes long-poll park time — for a stamped
+            # long-poll interface, time-to-next-update IS the op's shape
             return await handler(call, call.payload, hdr_len)
         except ThriftApplicationError as e:
+            node.counter("failures").incr()
             return self._reply(call, e.payload, field_id=1)
         except Exception as e:  # noqa: BLE001
+            node.counter("failures").incr()
             log.exception("thrift iface %s failed", call.name)
             return encode_exception(call.name, call.seqid, repr(e))
+        finally:
+            node.stat("latency_ms").add((time.monotonic() - t0) * 1e3)
 
     @staticmethod
     def _header_len(payload: bytes) -> int:

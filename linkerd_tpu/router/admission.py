@@ -16,6 +16,7 @@ import asyncio
 from typing import Optional
 
 from linkerd_tpu.router.service import Filter, Service
+from linkerd_tpu.router.stages import staged
 
 
 class OverloadShed(Exception):
@@ -57,7 +58,8 @@ class AdmissionControlFilter(Filter):
                     f"+ {self.max_pending} pending; shedding")
             self._pending += 1
             try:
-                await self._sem.acquire()
+                with staged(req, "queue"):
+                    await self._sem.acquire()
             finally:
                 self._pending -= 1
         else:

@@ -141,12 +141,18 @@ class Balancer(Service):
     async def _await_nonpending(self) -> None:
         if self._endpoints or not isinstance(self._addr.sample(), AddrPending):
             return
+
+        async def _wait() -> None:
+            async for a in self._addr.changes():
+                if not isinstance(a, AddrPending):
+                    return
+
         try:
-            async with asyncio.timeout(self.PENDING_TIMEOUT):
-                async for a in self._addr.changes():
-                    if not isinstance(a, AddrPending):
-                        return
-        except TimeoutError:
+            # wait_for, not asyncio.timeout: the latter is 3.11+ and
+            # this path must run on 3.10 (first dispatch through a
+            # freshly-opened resolver watch lands here)
+            await asyncio.wait_for(_wait(), self.PENDING_TIMEOUT)
+        except (TimeoutError, asyncio.TimeoutError):
             return  # _check_addr reports the empty set
 
     async def __call__(self, req):

@@ -35,6 +35,7 @@ from linkerd_tpu.core.nametree import (
 )
 from linkerd_tpu.namer.core import NameInterpreter
 from linkerd_tpu.router.service import Service, Status
+from linkerd_tpu.router.stages import staged
 
 log = logging.getLogger(__name__)
 
@@ -225,18 +226,21 @@ class DynBoundService(Service):
         self.bind_timeout = bind_timeout
 
     async def __call__(self, req):
-        st = self._activity.current
-        if isinstance(st, Pending):
-            try:
-                await asyncio.wait_for(self._activity.to_future(),
-                                       self.bind_timeout)
-            except asyncio.TimeoutError:
-                raise BindingFailed("name binding timed out") from None
+        with staged(req, "binding"):
             st = self._activity.current
-        if isinstance(st, Failed):
-            raise BindingFailed(f"name binding failed: {st.exc!r}")
-        tree = st.value.simplified
-        return await self._tree_for(tree)(req)
+            if isinstance(st, Pending):
+                try:
+                    await asyncio.wait_for(self._activity.to_future(),
+                                           self.bind_timeout)
+                except asyncio.TimeoutError:
+                    raise BindingFailed("name binding timed out") from None
+                st = self._activity.current
+            if isinstance(st, Failed):
+                raise BindingFailed(f"name binding failed: {st.exc!r}")
+            tree = st.value.simplified
+            svc = self._tree_for(tree)
+        with staged(req, "service"):
+            return await svc(req)
 
     async def close(self) -> None:
         self._activity.close()

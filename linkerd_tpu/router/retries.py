@@ -22,6 +22,7 @@ from linkerd_tpu.protocol.http.message import Request, Response
 from linkerd_tpu.router.classifiers import Classifier, ResponseClass
 from linkerd_tpu.router.deadline import deadline_of
 from linkerd_tpu.router.service import Filter, Service
+from linkerd_tpu.router.stages import staged
 from linkerd_tpu.telemetry.metrics import MetricsTree
 
 
@@ -144,7 +145,8 @@ class ClassifiedRetries(Filter[Request, Response]):
             attempt += 1
             self._retry_count.incr()
             if pause > 0:
-                await asyncio.sleep(pause)
+                with staged(req, "retry"):
+                    await asyncio.sleep(pause)
         if exc is not None:
             raise exc
         assert rsp is not None

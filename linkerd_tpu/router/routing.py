@@ -20,6 +20,7 @@ from linkerd_tpu.router.binding import (
     BindingFailed, DstBindingFactory, DstPath, UnboundError,
 )
 from linkerd_tpu.router.service import Filter, Service
+from linkerd_tpu.router.stages import staged
 from linkerd_tpu.telemetry.metrics import MetricsTree
 
 Identifier = Callable[[Request], DstPath]
@@ -40,15 +41,18 @@ class RoutingService(Service[Request, Response]):
         self._binding = binding
 
     async def __call__(self, req: Request) -> Response:
-        dst = self._identifier(req)  # raises IdentificationError
-        if hasattr(dst, "__await__"):
-            # async identifiers (e.g. istio: cluster + route-rule lookups)
-            dst = await dst
+        with staged(req, "identification"):
+            dst = self._identifier(req)  # raises IdentificationError
+            if hasattr(dst, "__await__"):
+                # async identifiers (istio: cluster + route-rule lookups)
+                dst = await dst
         if not isinstance(dst, DstPath):
             # identifier answered directly (istio redirect responses —
             # ref IstioIdentifierBase.redirectRequest)
             return dst
         req.ctx["dst"] = dst
+        # binding + service stages are attributed inside DynBoundService
+        # (the pending-bind wait and the dispatch through the bound tree)
         svc = self._binding.path_service(dst)
         return await svc(req)
 

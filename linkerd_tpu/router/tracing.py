@@ -25,6 +25,13 @@ from linkerd_tpu.telemetry.telemeter import Tracer
 CTX_TRACE = "l5d-ctx-trace"
 SAMPLE_HEADER = "l5d-sample"
 
+# mux/thriftmux carry the SAME wire encodings in Tdispatch context
+# sections (the finagle analogue: Trace context rides mux contexts, not
+# headers) — one codec, two transports, so a trace crosses protocol
+# boundaries without re-encoding
+MUX_CTX_TRACE = CTX_TRACE.encode("ascii")
+MUX_CTX_SAMPLE = SAMPLE_HEADER.encode("ascii")
+
 _rng = random.Random()
 
 
@@ -101,6 +108,19 @@ class ServerTraceFilter(Filter[Request, Response]):
         finally:
             if span.sampled:
                 dst = req.ctx.get("dst")
+                tags = {
+                    "router.label": self.router_label,
+                    "dst.path": dst.path.show if dst else "",
+                    "http.status_code": str(status) if status else "error",
+                    "response.class": str(
+                        getattr(req.ctx.get("response_class"), "value", "")),
+                }
+                # per-stage decomposition rides the span so one trace
+                # answers "where did my millisecond go" for this hop
+                timer = req.ctx.get("stages")
+                if timer is not None:
+                    for stage, ms in timer.totals.items():
+                        tags[f"stage.{stage}_ms"] = f"{ms:.3f}"
                 self.tracer.record({
                     "traceId": f"{span.trace_id:032x}",
                     "id": f"{span.span_id:016x}",
@@ -111,13 +131,7 @@ class ServerTraceFilter(Filter[Request, Response]):
                     "timestamp": ts_us,
                     "duration": int((time.monotonic() - t0) * 1e6),
                     "localEndpoint": {"serviceName": self.router_label},
-                    "tags": {
-                        "router.label": self.router_label,
-                        "dst.path": dst.path.show if dst else "",
-                        "http.status_code": str(status) if status else "error",
-                        "response.class": str(
-                            getattr(req.ctx.get("response_class"), "value", "")),
-                    },
+                    "tags": tags,
                 })
 
 
@@ -156,6 +170,122 @@ class ClientTraceFilter(Filter[Request, Response]):
                     "tags": {
                         "client.id": self.client_id,
                         "http.status_code": str(status) if status else "error",
+                    },
+                })
+
+
+def mux_ctx_get(contexts, key: bytes) -> Optional[bytes]:
+    """First value for ``key`` in a Tdispatch context section."""
+    for k, v in contexts:
+        if k == key:
+            return v
+    return None
+
+
+def mux_ctx_set(contexts, key: bytes, value: bytes):
+    """Context section with ``key`` replaced (appended if absent)."""
+    out = [(k, v) for k, v in contexts if k != key]
+    out.append((key, value))
+    return out
+
+
+class MuxServerTraceFilter(Filter):
+    """mux/thriftmux server-side trace init: join the caller's trace
+    from the ``l5d-ctx-trace`` Tdispatch context entry (same wire
+    encoding as the http header) or start a new root; record the server
+    span. The mux twin of ServerTraceFilter."""
+
+    def __init__(self, tracer: Tracer, router_label: str,
+                 sample_rate: float = 1.0):
+        self.tracer = tracer
+        self.router_label = router_label
+        self.sample_rate = sample_rate
+
+    async def apply(self, td, service: Service):
+        raw = mux_ctx_get(td.contexts, MUX_CTX_TRACE)
+        parent = (TraceId.decode(raw.decode("ascii", "replace"))
+                  if raw else None)
+        if parent is not None:
+            span = parent.child()
+        else:
+            sample_raw = mux_ctx_get(td.contexts, MUX_CTX_SAMPLE)
+            if sample_raw is not None:
+                try:
+                    sampled = _rng.random() < float(sample_raw)
+                except ValueError:
+                    sampled = _rng.random() < self.sample_rate
+            else:
+                sampled = _rng.random() < self.sample_rate
+            span = TraceId.mk_root(sampled)
+        td.ctx["trace"] = span
+        ts_us = int(time.time() * 1e6)
+        t0 = time.monotonic()
+        ok = False
+        try:
+            rsp = await service(td)
+            ok = True
+            return rsp
+        finally:
+            if span.sampled:
+                dst = td.ctx.get("dst")
+                self.tracer.record({
+                    "traceId": f"{span.trace_id:032x}",
+                    "id": f"{span.span_id:016x}",
+                    "parentId": (f"{span.parent_id:016x}"
+                                 if span.parent_id else None),
+                    "kind": "SERVER",
+                    "name": f"mux {td.dest or '/'}",
+                    "timestamp": ts_us,
+                    "duration": int((time.monotonic() - t0) * 1e6),
+                    "localEndpoint": {"serviceName": self.router_label},
+                    "tags": {
+                        "router.label": self.router_label,
+                        "dst.path": dst.path.show if dst else "",
+                        "mux.ok": str(ok).lower(),
+                    },
+                })
+
+
+class MuxClientTraceFilter(Filter):
+    """mux/thriftmux client-side: propagate the child trace downstream
+    in the Tdispatch context section and record the client span."""
+
+    def __init__(self, tracer: Tracer, client_id: str):
+        self.tracer = tracer
+        self.client_id = client_id
+
+    async def apply(self, td, service: Service):
+        span: Optional[TraceId] = td.ctx.get("trace")
+        if span is None:
+            return await service(td)
+        child = span.child()
+        from linkerd_tpu.protocol.mux.codec import Tdispatch
+        out = Tdispatch(
+            td.tag,
+            mux_ctx_set(td.contexts, MUX_CTX_TRACE,
+                        child.encode().encode("ascii")),
+            td.dest, td.dtab, td.payload, td.ctx)
+        ts_us = int(time.time() * 1e6)
+        t0 = time.monotonic()
+        ok = False
+        try:
+            rsp = await service(out)
+            ok = True
+            return rsp
+        finally:
+            if child.sampled:
+                self.tracer.record({
+                    "traceId": f"{child.trace_id:032x}",
+                    "id": f"{child.span_id:016x}",
+                    "parentId": f"{child.parent_id:016x}",
+                    "kind": "CLIENT",
+                    "name": f"mux {td.dest or '/'}",
+                    "timestamp": ts_us,
+                    "duration": int((time.monotonic() - t0) * 1e6),
+                    "localEndpoint": {"serviceName": self.client_id},
+                    "tags": {
+                        "client.id": self.client_id,
+                        "mux.ok": str(ok).lower(),
                     },
                 })
 

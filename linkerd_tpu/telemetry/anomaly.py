@@ -170,7 +170,10 @@ class FeatureRecorder(Filter[Request, Response]):
                         label = float(hdr)
                     except ValueError:
                         label = None  # untrusted header; never fail a request
-            self.ring.append((fv, label))
+            # the request's trace context + enqueue instant ride along so
+            # the micro-batcher can emit scorer spans as children of the
+            # originating request (ring wait = the span's queue annotation)
+            self.ring.append((fv, label, req.ctx.get("trace"), now))
 
     def _rps(self, now: float) -> float:
         w = self._rps_window
@@ -188,7 +191,15 @@ class Scorer:
     hot-swap the full training state (params, optimizer, normalization
     stats, step counter) without recreating the scorer. They may be sync
     (in-process: device transfers happen off the event loop via
-    ``asyncio.to_thread``) or async (gRPC sidecar)."""
+    ``asyncio.to_thread``) or async (gRPC sidecar).
+
+    ``last_timing``: per-call decomposition of the most recent score()
+    ({queue_ms, transfer_ms, device_ms, bytes} in-process; {rpc_ms} for
+    the sidecar) — the source for scorer-span annotations and the
+    bench's transfer_GBps / device_step_ms seam metrics. None until the
+    first scored batch; backends without instrumentation leave it None."""
+
+    last_timing: Optional[dict] = None
 
     async def score(self, x: np.ndarray) -> np.ndarray:
         raise NotImplementedError
@@ -275,6 +286,16 @@ class InProcessScorer(Scorer):
         self._var = np.ones(self.cfg.in_dim, np.float32)
         self._norm_momentum = 0.2
         self._norm_initialized = False
+        # score-path timing decomposition (worker-thread writes are
+        # GIL-atomic dict swaps; readers snapshot last_timing whole).
+        # OFF by default: the phase-split adds two device barriers per
+        # batch, forfeiting transfer/compute overlap — only pay it when
+        # a consumer exists (span sink installed, or bench seam metrics)
+        self.timing_enabled = False
+        self.last_timing: Optional[dict] = None
+        self.timing_totals = {"calls": 0, "queue_ms": 0.0,
+                              "transfer_ms": 0.0, "device_ms": 0.0,
+                              "bytes": 0}
         self._place_norm()
 
     def _place_norm(self) -> None:
@@ -456,8 +477,29 @@ class InProcessScorer(Scorer):
         on its own device."""
         return self._pad_rows(np.asarray(x, np.float32))
 
+    def _batch_placement(self):
+        """Device placement for an input batch: the data-axis sharding
+        when meshed, the pinned device otherwise."""
+        if self.mesh is not None:
+            from linkerd_tpu.parallel.mesh import batch_sharding
+            return batch_sharding(self.mesh)
+        return self._devices[0]
+
+    def _note_timing(self, queue_ms: float, transfer_ms: float,
+                     device_ms: float, nbytes: int) -> None:
+        self.last_timing = {"queue_ms": queue_ms,
+                            "transfer_ms": transfer_ms,
+                            "device_ms": device_ms, "bytes": nbytes}
+        t = self.timing_totals
+        t["calls"] += 1
+        t["queue_ms"] += queue_ms
+        t["transfer_ms"] += transfer_ms
+        t["device_ms"] += device_ms
+        t["bytes"] += nbytes
+
     async def score(self, x: np.ndarray) -> np.ndarray:
         n = len(x)
+        t_submit = time.monotonic()
         xn = self._prep(x)
         # capture the (mu, var) pair BEFORE dispatching to the worker
         # thread: a concurrent fit() repoints both mirrors, and reading
@@ -465,9 +507,31 @@ class InProcessScorer(Scorer):
         mu_d, var_d = self._mu_d, self._var_d
 
         def run() -> np.ndarray:
-            return np.asarray(
-                self._scorer(self.params, xn, mu_d, var_d),
-                dtype=np.float32)[:n]
+            if not self.timing_enabled:
+                # fused dispatch: hand the host array straight to the
+                # jitted step so XLA overlaps transfer with compute
+                return np.asarray(
+                    self._scorer(self.params, xn, mu_d, var_d),
+                    dtype=np.float32)[:n]
+            import jax
+            # explicit transfer/step/readback phases so the seam cost is
+            # measurable (ROADMAP item 3: transfer_GBps, device-step-ms)
+            # and scorer spans can split queue/device/transfer out
+            t0 = time.monotonic()
+            xd = jax.block_until_ready(
+                jax.device_put(xn, self._batch_placement()))
+            t1 = time.monotonic()
+            r = jax.block_until_ready(
+                self._scorer(self.params, xd, mu_d, var_d))
+            t2 = time.monotonic()
+            out = np.asarray(r, dtype=np.float32)[:n]
+            t3 = time.monotonic()
+            self._note_timing(
+                queue_ms=(t0 - t_submit) * 1e3,
+                transfer_ms=(t1 - t0 + t3 - t2) * 1e3,
+                device_ms=(t2 - t1) * 1e3,
+                nbytes=xn.nbytes + out.nbytes)
+            return out
 
         return await asyncio.to_thread(run)
 
@@ -573,6 +637,11 @@ class JaxAnomalyTelemeter(Telemeter):
         self._dropped_batches = self._node.counter("dropped_batches")
         self._gauges: Dict[str, object] = {}
         self._batch_i = 0
+        # span sink (the linker's BroadcastTracer): scorer-path spans —
+        # per-request children of the originating trace plus one batch
+        # span linking its constituents — flow to every tracer telemeter
+        self._span_sink = None
+        self._spans_recorded = self._node.counter("spans_recorded")
         # model lifecycle: checkpoint store + promotion gate + drift
         # monitor; None when the config block is absent (zero overhead)
         self._lifecycle = None
@@ -600,6 +669,16 @@ class JaxAnomalyTelemeter(Telemeter):
     def recorder(self) -> FeatureRecorder:
         return FeatureRecorder(self.ring)
 
+    def set_tracer(self, tracer) -> None:
+        """Install the linker's span sink (called after telemeter
+        assembly — the broadcast tracer is built FROM telemeters, so it
+        cannot exist when this one is constructed). With a sink in
+        place the scorer's phase-split timing pays for itself, so it is
+        switched on."""
+        self._span_sink = tracer
+        if self._scorer is not None and tracer is not None:
+            self._scorer.timing_enabled = True
+
     # -- Telemeter --------------------------------------------------------
     def _ensure_scorer(self) -> Scorer:
         if self._scorer is None:
@@ -622,6 +701,10 @@ class JaxAnomalyTelemeter(Telemeter):
                 self._scorer = InProcessScorer(
                     learning_rate=self.cfg.learningRate,
                     recon_weight=self.cfg.reconWeight)
+            if self._span_sink is not None:
+                # spans consume the decomposition: turn on phase-split
+                # timing (a no-op attribute on backends without it)
+                self._scorer.timing_enabled = True
         return self._scorer
 
     def _set_degraded(self, degraded: bool) -> None:
@@ -699,15 +782,20 @@ class JaxAnomalyTelemeter(Telemeter):
         n = min(len(self.ring), self.cfg.maxBatch)
         if n == 0:
             return 0
-        items = [self.ring.popleft() for _ in range(n)]
-        fvs = [fv for fv, _ in items]
+        # ring items are (fv, label[, trace, enqueued_at]) — external
+        # producers (benchmarks, fault harnesses) still append 2-tuples
+        items = [(it + (None, None, None))[:4]
+                 for it in (self.ring.popleft() for _ in range(n))]
+        fvs = [it[0] for it in items]
         labels = np.array(
-            [0.0 if lab is None else float(lab) for _, lab in items],
+            [0.0 if it[1] is None else float(it[1]) for it in items],
             dtype=np.float32)
         mask = np.array(
-            [0.0 if lab is None else 1.0 for _, lab in items],
+            [0.0 if it[1] is None else 1.0 for it in items],
             dtype=np.float32)
         x = featurize_batch(fvs)
+        t_drain = time.monotonic()
+        ts_us = int(time.time() * 1e6)
         try:
             scores = await scorer.score(x)
         except asyncio.CancelledError:
@@ -727,6 +815,10 @@ class JaxAnomalyTelemeter(Telemeter):
         self._set_degraded(False)
         self._scored.incr(n)
         self._batches.incr()
+        if self._span_sink is not None:
+            self._record_scorer_spans(
+                items, t_drain, ts_us,
+                int((time.monotonic() - t_drain) * 1e6), scorer)
         holdout = False
         if self._lifecycle is not None:
             # drift sees every batch (read-only); the replay window only
@@ -756,6 +848,66 @@ class JaxAnomalyTelemeter(Telemeter):
             else:
                 self._train_loss.set(loss)
         return n
+
+    # at most this many per-request scorer spans per drained batch: a
+    # 1024-row batch must not turn into 1024 span records per 50ms
+    MAX_SPANS_PER_BATCH = 128
+
+    def _record_scorer_spans(self, items, t_drain: float, ts_us: int,
+                             dur_us: int, scorer) -> None:
+        """Scorer-path spans for one drained micro-batch: a batch span
+        (own trace) that links its constituent request traces via
+        annotations, plus one child span per SAMPLED originating request
+        carrying the queue/device/transfer decomposition."""
+        from linkerd_tpu.router.tracing import TraceId
+
+        timing = getattr(scorer, "last_timing", None) or {}
+        timing_tags = {f"scorer.{k}": (f"{v:.3f}" if isinstance(v, float)
+                                       else str(v))
+                       for k, v in timing.items()}
+        traced = [(it[2], it[3]) for it in items
+                  if it[2] is not None and it[2].sampled]
+        batch = TraceId.mk_root(True)
+        batch_tags = dict(timing_tags)
+        batch_tags["scorer.batch_size"] = str(len(items))
+        batch_tags["scorer.linked"] = str(len(traced))
+        self._span_sink.record({
+            "traceId": f"{batch.trace_id:032x}",
+            "id": f"{batch.span_id:016x}",
+            "parentId": None,
+            "kind": "CONSUMER",
+            "name": "scorer.batch",
+            "timestamp": ts_us,
+            "duration": dur_us,
+            "localEndpoint": {"serviceName": "scorer"},
+            # constituent request spans, linked (zipkin has no otel-style
+            # span links; annotations are the v2-JSON-native equivalent)
+            "annotations": [
+                {"timestamp": ts_us,
+                 "value": f"link:{t.trace_id:032x}:{t.span_id:016x}"}
+                for t, _ in traced[:self.MAX_SPANS_PER_BATCH]],
+            "tags": batch_tags,
+        })
+        self._spans_recorded.incr()
+        for trace, enq in traced[:self.MAX_SPANS_PER_BATCH]:
+            child = trace.child()
+            tags = dict(timing_tags)
+            tags["scorer.batch_span"] = f"{batch.span_id:016x}"
+            if enq is not None:
+                # ring wait: enqueue (request completion) -> drain start
+                tags["scorer.queue_ms"] = f"{(t_drain - enq) * 1e3:.3f}"
+            self._span_sink.record({
+                "traceId": f"{child.trace_id:032x}",
+                "id": f"{child.span_id:016x}",
+                "parentId": f"{child.parent_id:016x}",
+                "kind": "CONSUMER",
+                "name": "scorer",
+                "timestamp": ts_us,
+                "duration": dur_us,
+                "localEndpoint": {"serviceName": "scorer"},
+                "tags": tags,
+            })
+            self._spans_recorded.incr()
 
     def _publish_gauges(self) -> None:
         for dst, score in self.board.scores.sample().items():

@@ -320,13 +320,22 @@ class RecentRequestsTelemeter(Telemeter):
 @register("telemeter", "io.l5d.zipkin")
 @dataclass
 class ZipkinConfig:
-    """Ship sampled spans to a Zipkin collector in batches every
-    ``batchIntervalMs``."""
+    """Ship sampled spans to a Zipkin collector (v2 JSON API) in
+    batches every ``batchIntervalMs``; bounded buffering, exponential
+    backoff on collector failure, stats at ``/tracer.json``."""
 
     host: str = "127.0.0.1"
     port: int = 9411
     sampleRate: float = 0.001
     batchIntervalMs: int = 1000
+    # bounded buffering: spans beyond this are dropped (and counted) —
+    # a dead collector must cost memory-bounded, never unbounded
+    maxBufferedSpans: int = 10000
+    # spans per POST (zipkin collectors reject oversized bodies)
+    maxBatch: int = 500
+    # backoff bounds after a failed POST
+    backoffMinMs: int = 1000
+    backoffMaxMs: int = 30000
 
     def mk(self, metrics: MetricsTree) -> Telemeter:
         return ZipkinTelemeter(self)
@@ -337,15 +346,32 @@ class ZipkinTelemeter(Telemeter):
 
     The reference ships scribe-thrift (ZipkinInitializer.scala:27-60, a
     2017-era protocol); the v2 HTTP API is the modern equivalent of the
-    same component. Spans batch on an interval; send failures drop the
-    batch (telemetry must never block the data plane).
+    same component. Sampling is decided at trace creation (the
+    ``l5d-sample`` header / router sampleRate drive span.sampled, and
+    the trace filters only record sampled spans), so everything handed
+    to this tracer ships — unless a span explicitly carries
+    ``sampled: false``, which is dropped here and counted.
+
+    Failure posture: telemetry must never block or destabilize the data
+    plane. The buffer is bounded (overflow drops the NEWEST span and
+    counts it), a failed POST re-buffers its batch and backs off
+    exponentially, and all of it is observable at ``/tracer.json``.
     """
 
     def __init__(self, cfg: ZipkinConfig):
         self.cfg = cfg
-        self._buf: List[dict] = []
-        self._tracer = _FnTracer(self._buf.append)
+        self._buf: Deque[dict] = collections.deque()
+        self._tracer = _FnTracer(self._record)
         self._stop = asyncio.Event()
+        self._client = None
+        # stats surfaced at /tracer.json
+        self.sent_spans = 0
+        self.dropped_spans = 0
+        self.sampled_out = 0
+        self.failed_posts = 0
+        self.posts = 0
+        self._backoff_s = 0.0
+        self._next_send_after = 0.0  # monotonic gate while backing off
 
     @property
     def tracer(self) -> Tracer:
@@ -355,33 +381,106 @@ class ZipkinTelemeter(Telemeter):
     def sample_rate(self) -> float:
         return self.cfg.sampleRate
 
-    async def run(self) -> None:
-        from linkerd_tpu.protocol.http.client import HttpClient
+    @property
+    def buffer_depth(self) -> int:
+        return len(self._buf)
 
-        client = HttpClient(self.cfg.host, self.cfg.port, max_connections=2)
+    def _record(self, span: dict) -> None:
+        if span.get("sampled") is False:
+            self.sampled_out += 1
+            return
+        if len(self._buf) >= self.cfg.maxBufferedSpans:
+            self.dropped_spans += 1
+            return
+        self._buf.append(span)
+
+    def _ensure_client(self):
+        if self._client is None:
+            from linkerd_tpu.protocol.http.client import HttpClient
+            self._client = HttpClient(self.cfg.host, self.cfg.port,
+                                      max_connections=2)
+        return self._client
+
+    async def run(self) -> None:
         try:
             while not self._stop.is_set():
                 await asyncio.sleep(self.cfg.batchIntervalMs / 1e3)
-                await self.flush(client)
+                if time.monotonic() < self._next_send_after:
+                    continue  # backing off after a failed POST
+                await self.flush()
         except asyncio.CancelledError:
             pass
         finally:
-            await client.close()
+            # detach before awaiting: a flush() racing this teardown
+            # sees None and builds a fresh client instead of a closed one
+            client, self._client = self._client, None
+            if client is not None:
+                await client.close()
 
-    async def flush(self, client) -> None:
-        if not self._buf:
-            return
-        batch, self._buf = self._buf, []
-        req = Request(method="POST", uri="/api/v2/spans",
-                      body=json.dumps(batch).encode())
-        req.headers.set("Content-Type", "application/json")
-        req.headers.set("Host", f"{self.cfg.host}:{self.cfg.port}")
-        try:
-            rsp = await client(req)
-            if rsp.status >= 300:
-                log.warning("zipkin rejected spans: %s", rsp.status)
-        except Exception as e:  # noqa: BLE001 — drop batch, keep serving
-            log.debug("zipkin send failed: %r", e)
+    async def flush(self, client=None) -> int:
+        """POST buffered spans in bounded batches; returns spans sent.
+        On failure the batch is re-buffered (oldest-first, dropped if
+        the buffer refilled meanwhile) and the backoff window opens."""
+        sent = 0
+        client = client or self._ensure_client()
+        while self._buf:
+            batch = [self._buf.popleft()
+                     for _ in range(min(len(self._buf), self.cfg.maxBatch))]
+            req = Request(method="POST", uri="/api/v2/spans",
+                          body=json.dumps(batch).encode())
+            req.headers.set("Content-Type", "application/json")
+            req.headers.set("Host", f"{self.cfg.host}:{self.cfg.port}")
+            self.posts += 1
+            try:
+                rsp = await client(req)
+                if rsp.status >= 300:
+                    raise ConnectionError(
+                        f"zipkin rejected spans: {rsp.status}")
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — re-buffer + back off
+                self.failed_posts += 1
+                self._backoff_s = min(
+                    max(self._backoff_s * 2, self.cfg.backoffMinMs / 1e3),
+                    self.cfg.backoffMaxMs / 1e3)
+                self._next_send_after = time.monotonic() + self._backoff_s
+                for i, span in enumerate(reversed(batch)):
+                    if len(self._buf) >= self.cfg.maxBufferedSpans:
+                        # everything not re-buffered is lost — count all
+                        # of it, not just the span that hit the wall
+                        self.dropped_spans += len(batch) - i
+                        break
+                    self._buf.appendleft(span)
+                log.debug("zipkin send failed (backoff %.1fs): %r",
+                          self._backoff_s, e)
+                return sent
+            sent += len(batch)
+            self.sent_spans += len(batch)
+        self._backoff_s = 0.0
+        self._next_send_after = 0.0
+        return sent
+
+    def stats(self) -> dict:
+        return {
+            "collector": f"{self.cfg.host}:{self.cfg.port}",
+            "buffer_depth": len(self._buf),
+            "buffer_capacity": self.cfg.maxBufferedSpans,
+            "sent_spans": self.sent_spans,
+            "dropped_spans": self.dropped_spans,
+            "sampled_out": self.sampled_out,
+            "posts": self.posts,
+            "failed_posts": self.failed_posts,
+            "backoff_s": round(self._backoff_s, 3),
+            "sample_rate": self.cfg.sampleRate,
+        }
+
+    def admin_handlers(self):
+        from linkerd_tpu.admin.server import json_response
+
+        async def tracer_json(req: Request) -> Response:
+            return json_response(self.stats())
+
+        return [("/tracer.json", tracer_json)]
 
     def close(self) -> None:
         self._stop.set()

@@ -19,6 +19,8 @@ from __future__ import annotations
 import bisect
 import math
 import threading
+import time
+from contextlib import contextmanager
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
 
 
@@ -132,6 +134,24 @@ class Stat:
 
 
 Metric = Union[Counter, Gauge, Stat]
+
+
+@contextmanager
+def observed(node: "MetricsTree"):
+    """The standard op-instrumentation triple around a block:
+    ``requests`` counter on entry, ``failures`` counter when the block
+    raises, ``latency_ms`` stat always. One definition so every
+    instrumented surface (namerd store ops, iface methods) exports the
+    same family shape."""
+    node.counter("requests").incr()
+    t0 = time.monotonic()
+    try:
+        yield
+    except BaseException:
+        node.counter("failures").incr()
+        raise
+    finally:
+        node.stat("latency_ms").add((time.monotonic() - t0) * 1e3)
 
 
 class MetricsTree:

@@ -191,6 +191,10 @@ class GrpcScorerClient:
         self.timeout_s = timeout_s
         self.first_timeout_s = first_timeout_s
         self._warm: set = set()
+        # most recent Score call decomposition ({rpc_ms, bytes}): the
+        # sidecar analogue of InProcessScorer.last_timing — scorer spans
+        # annotate the gRPC hop cost instead of device phases
+        self.last_timing = None
         self._channel = None
         self._score = None
         self._fit = None
@@ -244,10 +248,16 @@ class GrpcScorerClient:
         return struct.unpack("<Q", rsp)[0]
 
     async def score(self, x: np.ndarray) -> np.ndarray:
+        import time
         self._ensure()
         key = self._bucket("score", len(x))
-        rsp = await self._score(encode_matrix(x),
-                                timeout=self._deadline(key))
+        payload = encode_matrix(x)
+        t0 = time.monotonic()
+        rsp = await self._score(payload, timeout=self._deadline(key))
+        self.last_timing = {
+            "rpc_ms": (time.monotonic() - t0) * 1e3,
+            "bytes": len(payload) + len(rsp),
+        }
         self._warm.add(key)
         return np.frombuffer(rsp, np.float32)
 

@@ -128,10 +128,12 @@ namers:
                 anomalous = tele.board.score_of("/svc/web")
                 assert anomalous > baseline  # score rose under faults
 
-                # AUC over the individually labeled window
+                # AUC over the individually labeled window (ring items
+                # are (fv, label, trace, enqueued_at) since the scorer
+                # spans landed)
                 from linkerd_tpu.models.features import featurize_batch
-                fvs = [fv for fv, _ in items]
-                labels = [lab for _, lab in items]
+                fvs = [it[0] for it in items]
+                labels = [it[1] for it in items]
                 x = featurize_batch(fvs)
                 scorer = tele._ensure_scorer()
                 scores = await scorer.score(x)

@@ -1,0 +1,646 @@
+"""Mesh-wide observability: scorer-path tracing, Zipkin export,
+per-stage latency decomposition, mux/thriftmux trace propagation, and
+namerd interface metrics.
+
+The acceptance scenario (ISSUE 6): one request through a two-router
+chain with scoring enabled yields ONE trace whose Zipkin-v2 export
+contains edge server/client spans, the inner server span, and a scorer
+span with queue/device/transfer annotations; namerd's /metrics.json
+shows non-zero request stats for all three interfaces.
+"""
+
+import asyncio
+import json
+import socket
+
+import numpy as np
+import pytest
+
+from linkerd_tpu.linker import load_linker
+from linkerd_tpu.protocol.http import Request, Response
+from linkerd_tpu.protocol.http.client import HttpClient
+from linkerd_tpu.protocol.http.server import serve
+from linkerd_tpu.router.service import FnService
+from linkerd_tpu.router.tracing import (
+    CTX_TRACE, MUX_CTX_TRACE, TraceId, mux_ctx_get, mux_ctx_set,
+)
+from linkerd_tpu.telemetry.exporters import ZipkinConfig, ZipkinTelemeter
+from linkerd_tpu.telemetry.metrics import MetricsTree
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class StubScorer:
+    """In-process scorer stand-in: zero scores + a fixed timing
+    decomposition, so the span pipeline runs without JAX."""
+
+    def __init__(self):
+        self.last_timing = {"queue_ms": 0.5, "device_ms": 1.25,
+                            "transfer_ms": 0.75, "bytes": 4096}
+
+    async def score(self, x):
+        return np.zeros(len(x), np.float32)
+
+    async def fit(self, x, labels, mask):
+        return 0.0
+
+    def close(self):
+        pass
+
+
+def mk_collector():
+    """Stub zipkin collector service; returns (handler, batches)."""
+    batches = []
+
+    async def collector(req: Request) -> Response:
+        batches.append(json.loads(req.body))
+        return Response(status=202)
+
+    return FnService(collector), batches
+
+
+class TestTwoRouterChainWithScorer:
+    def test_single_trace_covers_chain_and_scorer_span(self, tmp_path):
+        disco = tmp_path / "disco"
+        disco.mkdir()
+
+        async def go():
+            coll_svc, batches = mk_collector()
+            coll = await serve(coll_svc)
+            down = await serve(FnService(
+                lambda req: _respond(b"ok")(req)))
+            (disco / "web").write_text(f"127.0.0.1 {down.bound_port}\n")
+            inner_port = free_port()
+            cfg = f"""
+routers:
+- protocol: http
+  label: edge
+  sampleRate: 1.0
+  dtab: |
+    /svc => /$/inet/127.0.0.1/{inner_port} ;
+  servers: [{{port: 0}}]
+- protocol: http
+  label: inner
+  sampleRate: 1.0
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers: [{{port: {inner_port}}}]
+telemetry:
+- kind: io.l5d.zipkin
+  port: {coll.bound_port}
+  batchIntervalMs: 60000
+- kind: io.l5d.jaxAnomaly
+  trainEveryBatches: 0
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+            linker = load_linker(cfg)
+            tele = linker._anomaly_telemeter()
+            tele._scorer = StubScorer()  # no JAX in this test
+            await linker.start()
+            edge_port = linker.routers[0].server_ports[0]
+            proxy = HttpClient("127.0.0.1", edge_port)
+            try:
+                root = TraceId.mk_root(sampled=True)
+                req = Request(uri="/")
+                req.headers.set("Host", "web")
+                req.headers.set(CTX_TRACE, root.encode())
+                rsp = await proxy(req)
+                assert (rsp.status, rsp.body) == (200, b"ok")
+
+                # the micro-batcher drains both routers' recorded rows
+                assert len(tele.ring) == 2
+                scored = await tele.drain_once()
+                assert scored == 2
+
+                zipkin = next(t for t in linker.telemeters
+                              if isinstance(t, ZipkinTelemeter))
+                await zipkin.flush()
+                spans = [s for b in batches for s in b]
+
+                by_svc = {}
+                for s in spans:
+                    key = (s["localEndpoint"]["serviceName"], s["kind"])
+                    by_svc.setdefault(key, []).append(s)
+                edge_srv = by_svc[("edge", "SERVER")][0]
+                inner_srv = by_svc[("inner", "SERVER")][0]
+                scorers = by_svc[("scorer", "CONSUMER")]
+                clients = [s for s in spans if s["kind"] == "CLIENT"]
+                assert clients, "no client spans exported"
+
+                # ONE trace id covers edge server, edge client, inner
+                # server, and the scorer spans
+                tid = f"{root.trace_id:032x}"
+                assert edge_srv["traceId"] == tid
+                assert inner_srv["traceId"] == tid
+                edge_client = next(
+                    c for c in clients if c["traceId"] == tid
+                    and c["parentId"] == edge_srv["id"])
+                assert inner_srv["parentId"] == edge_client["id"]
+                request_scorers = [
+                    s for s in scorers if s["traceId"] == tid]
+                assert len(request_scorers) == 2  # edge + inner rows
+                server_ids = {edge_srv["id"], inner_srv["id"]}
+                assert {s["parentId"] for s in request_scorers} \
+                    == server_ids
+
+                # scorer spans carry queue/device/transfer annotations
+                for s in request_scorers:
+                    tags = s["tags"]
+                    assert float(tags["scorer.queue_ms"]) >= 0.0
+                    assert tags["scorer.device_ms"] == "1.250"
+                    assert tags["scorer.transfer_ms"] == "0.750"
+
+                # the batch span links its constituents via annotations
+                batch_spans = [s for s in scorers
+                               if s.get("annotations")]
+                assert batch_spans, "no batch span with link annotations"
+                links = {a["value"]
+                         for s in batch_spans for a in s["annotations"]}
+                assert any(tid in link for link in links)
+
+                # server spans carry the stage decomposition tags
+                assert any(k.startswith("stage.")
+                           for k in edge_srv["tags"])
+            finally:
+                await proxy.close()
+                await linker.close()
+                await down.close()
+                await coll.close()
+
+        run(go())
+
+
+def _respond(body: bytes):
+    async def handler(req: Request) -> Response:
+        return Response(status=200, body=body)
+    return handler
+
+
+class TestStageDecomposition:
+    def test_stage_histograms_under_rt_scope(self, tmp_path):
+        disco = tmp_path / "disco"
+        disco.mkdir()
+
+        async def go():
+            down = await serve(FnService(_respond(b"ok")))
+            (disco / "web").write_text(f"127.0.0.1 {down.bound_port}\n")
+            cfg = f"""
+routers:
+- protocol: http
+  label: st
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers: [{{port: 0}}]
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+            linker = load_linker(cfg)
+            await linker.start()
+            proxy = HttpClient("127.0.0.1", linker.routers[0].server_ports[0])
+            try:
+                for _ in range(3):
+                    req = Request(uri="/")
+                    req.headers.set("Host", "web")
+                    await proxy(req)
+                flat = linker.metrics.flatten()
+                for stage in ("identification", "binding", "service",
+                              "total"):
+                    key = f"rt/st/stage/{stage}_ms/count"
+                    assert flat.get(key) == 3, (key, flat.get(key))
+                # attribution sanity: stages sum to <= total
+                total = flat["rt/st/stage/total_ms/sum"]
+                parts = sum(flat[f"rt/st/stage/{s}_ms/sum"]
+                            for s in ("identification", "binding",
+                                      "service"))
+                assert parts <= total * 1.05
+            finally:
+                await proxy.close()
+                await linker.close()
+                await down.close()
+
+        run(go())
+
+    def test_retry_stage_records_backoff(self):
+        from linkerd_tpu.router.retries import ClassifiedRetries, RetryBudget
+        from linkerd_tpu.router.classifiers import ResponseClass
+        from linkerd_tpu.router.stages import CTX_KEY, StageTimer
+
+        async def go():
+            calls = {"n": 0}
+
+            async def flaky(req):
+                calls["n"] += 1
+                return Response(status=503 if calls["n"] == 1 else 200)
+
+            def classify(req, rsp, exc):
+                return (ResponseClass.RETRYABLE_FAILURE
+                        if rsp is not None and rsp.status == 503
+                        else ResponseClass.SUCCESS)
+
+            mt = MetricsTree()
+            filt = ClassifiedRetries(classify, RetryBudget(),
+                                     backoffs=[0.01] * 3)
+            req = Request(uri="/")
+            timer = StageTimer(mt.scope("rt", "r", "stage"))
+            req.ctx[CTX_KEY] = timer
+            rsp = await filt.apply(req, FnService(flaky))
+            assert rsp.status == 200
+            assert timer.totals["retry"] >= 10.0 * 0.9  # ~10ms backoff
+            assert mt.flatten()["rt/r/stage/retry_ms/count"] == 1
+
+        run(go())
+
+    def test_queue_stage_from_admission_wait(self):
+        from linkerd_tpu.router.admission import AdmissionControlFilter
+        from linkerd_tpu.router.stages import CTX_KEY, StageTimer
+
+        async def go():
+            filt = AdmissionControlFilter(1, max_pending=4)
+            release = asyncio.Event()
+
+            async def slow(req):
+                await release.wait()
+                return Response(200)
+
+            svc = filt.and_then(FnService(slow))
+
+            async def first():
+                return await svc(Request(uri="/"))
+
+            q_req = Request(uri="/")
+            timer = StageTimer(None)
+            q_req.ctx[CTX_KEY] = timer
+            t1 = asyncio.ensure_future(first())
+            await asyncio.sleep(0.02)  # t1 holds the slot
+            t2 = asyncio.ensure_future(svc(q_req))
+            await asyncio.sleep(0.03)  # t2 queues on the semaphore
+            release.set()
+            await asyncio.gather(t1, t2)
+            assert timer.totals["queue"] >= 20.0  # waited ~30ms
+
+        run(go())
+
+
+class TestZipkinExporter:
+    def test_buffer_overflow_drops_and_counts(self):
+        tele = ZipkinConfig(maxBufferedSpans=3).mk(MetricsTree())
+        for i in range(5):
+            tele.tracer.record({"traceId": f"{i:032x}", "id": "01"})
+        assert tele.buffer_depth == 3
+        assert tele.dropped_spans == 2
+
+    def test_explicitly_unsampled_span_dropped(self):
+        tele = ZipkinConfig().mk(MetricsTree())
+        tele.tracer.record({"traceId": "ab", "id": "01",
+                            "sampled": False})
+        assert tele.buffer_depth == 0
+        assert tele.sampled_out == 1
+
+    def test_failed_post_rebuffers_and_backs_off(self):
+        async def go():
+            tele = ZipkinConfig(backoffMinMs=500).mk(MetricsTree())
+            tele.tracer.record({"traceId": "ab", "id": "01"})
+
+            async def failing(req):
+                raise ConnectionError("collector down")
+
+            sent = await tele.flush(FnService(failing))
+            assert sent == 0
+            assert tele.failed_posts == 1
+            assert tele.buffer_depth == 1  # re-buffered, not lost
+            stats = tele.stats()
+            assert stats["backoff_s"] == 0.5
+
+            # second failure doubles the backoff
+            await tele.flush(FnService(failing))
+            assert tele.stats()["backoff_s"] == 1.0
+
+            # recovery: spans ship, backoff resets
+            posted = []
+
+            async def ok(req):
+                posted.append(json.loads(req.body))
+                return Response(status=202)
+
+            sent = await tele.flush(FnService(ok))
+            assert sent == 1 and posted[0][0]["traceId"] == "ab"
+            assert tele.buffer_depth == 0
+            assert tele.stats()["backoff_s"] == 0.0
+
+        run(go())
+
+    def test_rebuffer_overflow_counts_every_lost_span(self):
+        """A failed POST whose batch can't re-buffer (the buffer
+        refilled meanwhile) must count ALL lost spans, not one."""
+        async def go():
+            tele = ZipkinConfig(maxBufferedSpans=2,
+                                maxBatch=2).mk(MetricsTree())
+            tele.tracer.record({"traceId": "aa", "id": "01"})
+            tele.tracer.record({"traceId": "bb", "id": "02"})
+
+            async def failing(req):
+                # new spans land while the POST is in flight, filling
+                # the buffer before the failed batch tries to return
+                tele.tracer.record({"traceId": "cc", "id": "03"})
+                tele.tracer.record({"traceId": "dd", "id": "04"})
+                raise ConnectionError("collector down")
+
+            await tele.flush(FnService(failing))
+            assert tele.buffer_depth == 2  # the in-flight arrivals
+            assert tele.dropped_spans == 2  # whole failed batch counted
+
+        run(go())
+
+    def test_rejected_status_counts_as_failure(self):
+        async def go():
+            tele = ZipkinConfig().mk(MetricsTree())
+            tele.tracer.record({"traceId": "ab", "id": "01"})
+
+            async def reject(req):
+                return Response(status=500)
+
+            await tele.flush(FnService(reject))
+            assert tele.failed_posts == 1
+            assert tele.buffer_depth == 1
+
+        run(go())
+
+    def test_batches_bounded_by_max_batch(self):
+        async def go():
+            tele = ZipkinConfig(maxBatch=2).mk(MetricsTree())
+            for i in range(5):
+                tele.tracer.record({"traceId": f"{i:032x}", "id": "01"})
+            sizes = []
+
+            async def ok(req):
+                sizes.append(len(json.loads(req.body)))
+                return Response(status=202)
+
+            sent = await tele.flush(FnService(ok))
+            assert sent == 5
+            assert sizes == [2, 2, 1]
+
+        run(go())
+
+    def test_tracer_json_admin_endpoint(self):
+        async def go():
+            tele = ZipkinConfig().mk(MetricsTree())
+            tele.tracer.record({"traceId": "ab", "id": "01"})
+            handlers = dict(tele.admin_handlers())
+            rsp = await handlers["/tracer.json"](Request())
+            data = json.loads(rsp.body)
+            assert data["buffer_depth"] == 1
+            assert data["dropped_spans"] == 0
+            assert "collector" in data
+
+        run(go())
+
+    def test_l5d_sample_zero_suppresses_export_e2e(self, tmp_path):
+        """The sampling decision from l5d-sample: 0 reaches the
+        exporter as silence — no span is ever recorded."""
+        disco = tmp_path / "disco"
+        disco.mkdir()
+
+        async def go():
+            down = await serve(FnService(_respond(b"ok")))
+            (disco / "web").write_text(f"127.0.0.1 {down.bound_port}\n")
+            cfg = f"""
+routers:
+- protocol: http
+  label: s
+  sampleRate: 1.0
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers: [{{port: 0}}]
+telemetry:
+- kind: io.l5d.zipkin
+  port: 1
+  batchIntervalMs: 60000
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+"""
+            linker = load_linker(cfg)
+            await linker.start()
+            proxy = HttpClient("127.0.0.1", linker.routers[0].server_ports[0])
+            zipkin = next(t for t in linker.telemeters
+                          if isinstance(t, ZipkinTelemeter))
+            try:
+                req = Request(uri="/")
+                req.headers.set("Host", "web")
+                req.headers.set("l5d-sample", "0.0")
+                await proxy(req)
+                assert zipkin.buffer_depth == 0
+
+                req2 = Request(uri="/")
+                req2.headers.set("Host", "web")
+                req2.headers.set("l5d-sample", "1.0")
+                await proxy(req2)
+                assert zipkin.buffer_depth == 2  # server + client span
+            finally:
+                await proxy.close()
+                await linker.close()
+                await down.close()
+
+        run(go())
+
+
+class TestMuxTracePropagation:
+    def test_context_codec_matches_http_header_codec(self):
+        """Cross-protocol continuity: the value an http hop writes into
+        l5d-ctx-trace parses identically from a mux context section."""
+        root = TraceId.mk_root()
+        header_value = root.encode()  # what http/h2 put on the wire
+        contexts = mux_ctx_set([], MUX_CTX_TRACE,
+                               header_value.encode("ascii"))
+        raw = mux_ctx_get(contexts, MUX_CTX_TRACE)
+        assert TraceId.decode(raw.decode("ascii")) == root
+
+    @pytest.mark.parametrize("protocol", ["mux", "thriftmux"])
+    def test_router_propagates_trace_in_context_section(self, protocol):
+        from linkerd_tpu.protocol.mux.client import MuxClient
+        from linkerd_tpu.protocol.mux.codec import Tdispatch
+        from linkerd_tpu.protocol.mux.server import serve_mux
+
+        async def go():
+            seen = []
+
+            async def backend(td):
+                seen.append(td.contexts)
+                return b"pong"
+
+            down = await serve_mux(FnService(backend))
+            cfg = f"""
+routers:
+- protocol: {protocol}
+  label: m
+  sampleRate: 1.0
+  dtab: |
+    /svc => /$/inet/127.0.0.1/{down.bound_port} ;
+  servers: [{{port: 0}}]
+telemetry:
+- kind: io.l5d.zipkin
+  port: 1
+  batchIntervalMs: 60000
+"""
+            linker = load_linker(cfg)
+            await linker.start()
+            client = MuxClient("127.0.0.1",
+                               linker.routers[0].server_ports[0])
+            zipkin = next(t for t in linker.telemeters
+                          if isinstance(t, ZipkinTelemeter))
+            try:
+                root = TraceId.mk_root(sampled=True)
+                td = Tdispatch(
+                    0,
+                    mux_ctx_set([], MUX_CTX_TRACE,
+                                root.encode().encode("ascii")),
+                    "/web", [], b"payload")
+                rsp = await client(td)
+                assert rsp == b"pong"
+
+                # downstream received a descendant of the caller's trace
+                raw = mux_ctx_get(seen[0], MUX_CTX_TRACE)
+                assert raw is not None, "l5d-ctx-trace context missing"
+                got = TraceId.decode(raw.decode("ascii"))
+                assert got.trace_id == root.trace_id
+                assert got.span_id != root.span_id
+
+                # server + client spans recorded under the same trace
+                spans = list(zipkin._buf)
+                tid = f"{root.trace_id:032x}"
+                kinds = {s["kind"] for s in spans
+                         if s["traceId"] == tid}
+                assert kinds == {"SERVER", "CLIENT"}
+            finally:
+                await client.close()
+                await linker.close()
+                await down.close()
+
+        run(go())
+
+
+class TestNamerdObservability:
+    def _drive_and_metrics(self, disco):
+        from linkerd_tpu.core import Dtab, Path
+        from linkerd_tpu.interpreter.namerd_thrift import (
+            ThriftNamerInterpreter,
+        )
+        from linkerd_tpu.interpreter.mesh import MeshClientInterpreter
+        from linkerd_tpu.namerd.config import serve_namerd
+
+        async def go():
+            nd = await serve_namerd(f"""
+storage:
+  kind: io.l5d.inMemory
+  namespaces:
+    default: "/svc => /#/io.l5d.fs;"
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+interfaces:
+- kind: io.l5d.mesh
+  port: 0
+- kind: io.l5d.thriftNameInterpreter
+  port: 0
+- kind: io.l5d.httpController
+  port: 0
+admin:
+  port: 0
+""")
+            mesh_port, thrift_port, http_port = nd.bound_ports
+            try:
+                # 1. http controller
+                hc = HttpClient("127.0.0.1", http_port)
+                rsp = await hc(Request(uri="/api/1/dtabs"))
+                assert rsp.status == 200
+                rsp = await hc(Request(uri="/api/1/bind/default"
+                                           "?path=/svc/web"))
+                assert rsp.status == 200
+                await hc.close()
+
+                # 2. thrift long-poll interpreter
+                ti = ThriftNamerInterpreter("127.0.0.1", thrift_port)
+                act = ti.bind(Dtab.empty(), Path.read("/svc/web"))
+                await asyncio.wait_for(act.to_future(), 10)
+                act.close()
+                ti.close()
+
+                # 3. gRPC mesh interpreter
+                mi = MeshClientInterpreter("127.0.0.1", mesh_port,
+                                           root="/default")
+                act = mi.bind(Dtab.empty(), Path.read("/svc/web"))
+                await asyncio.wait_for(act.to_future(), 10)
+                act.close()
+                await mi.aclose()
+
+                # all three interfaces report through /metrics.json
+                admin = HttpClient("127.0.0.1",
+                                   nd.admin_server.bound_port)
+                rsp = await admin(Request(uri="/metrics.json"))
+                flat = json.loads(rsp.body)
+
+                dtabs_page = await admin(Request(uri="/dtabs"))
+                detail_page = await admin(
+                    Request(uri="/dtabs/default"))
+                detail_json = await admin(
+                    Request(uri="/dtabs/default?format=json"))
+                missing_page = await admin(Request(uri="/dtabs/nope"))
+                await admin.close()
+                return (flat, dtabs_page, detail_page, detail_json,
+                        missing_page)
+            finally:
+                await nd.close()
+
+        return run(go())
+
+    def test_all_three_interfaces_and_store_report_stats(self, tmp_path):
+        disco = tmp_path / "disco"
+        disco.mkdir()
+        (disco / "web").write_text("127.0.0.1 8080\n")
+        flat, *_ = self._drive_and_metrics(disco)
+
+        assert flat["namerd/http/dtabs/requests"] >= 1
+        assert flat["namerd/http/bind/requests"] >= 1
+        assert flat["namerd/http/bind/latency_ms/count"] >= 1
+        assert flat["namerd/thrift/bind/requests"] >= 1
+        assert flat["namerd/thrift/updates_total"] >= 1
+        mesh_reqs = [v for k, v in flat.items()
+                     if k.startswith("namerd/mesh/")
+                     and k.endswith("/requests")]
+        assert mesh_reqs and sum(mesh_reqs) >= 1
+        assert flat["namerd/store/observe/requests"] >= 1
+        # watch gauges registered (live counts may have drained to 0)
+        assert "namerd/thrift/watches/bindings" in flat
+        assert "namerd/mesh/streams" in flat
+
+    def test_dtab_admin_pages(self, tmp_path):
+        disco = tmp_path / "disco"
+        disco.mkdir()
+        (disco / "web").write_text("127.0.0.1 8080\n")
+        (_, index, detail, detail_json, missing) = \
+            self._drive_and_metrics(disco)
+
+        assert index.status == 200
+        assert b"/dtabs/default" in index.body  # namespace link
+        assert detail.status == 200
+        assert b"/svc" in detail.body and b"io.l5d.fs" in detail.body
+        data = json.loads(detail_json.body)
+        assert data["namespace"] == "default"
+        assert data["dentries"] == [
+            {"prefix": "/svc", "dst": "/#/io.l5d.fs"}]
+        assert data["version"]
+        assert missing.status == 404

@@ -482,14 +482,50 @@ class TestSuppressions:
         assert len(leaks) == 1 and leaks[0].suppressed
 
 
+class TestMetricsScope:
+    def test_slashed_name_fires(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                def install(metrics):
+                    metrics.counter("rt/out/server/requests").incr()
+            """}, "metrics-scope")
+        assert len(got) == 1 and "rt/out/server/requests" in got[0].message
+
+    def test_slashed_scope_component_fires(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/telemetry/x.py": """
+                def install(metrics):
+                    node = metrics.scope("namerd/http")
+                    node.stat("latency_ms")
+            """}, "metrics-scope")
+        assert len(got) == 1 and "namerd/http" in got[0].message
+
+    def test_component_args_and_sanitized_dynamic_are_clean(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                def install(metrics, path):
+                    metrics.scope("rt", "out", "server").counter("requests")
+                    metrics.gauge(path.replace("/", "."))
+            """}, "metrics-scope")
+        assert got == []
+
+    def test_justified_suppression_suppresses(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/router/x.py": """
+                def install(metrics):
+                    metrics.counter("a/b")  # l5d: ignore[metrics-scope] — wire-format key, not a scope
+            """}, "metrics-scope")
+        assert len(got) == 1 and got[0].suppressed
+
+
 class TestRepoGate:
     """The tier-1 gate: the suite itself over the real tree."""
 
     def test_rule_inventory(self):
         assert sorted(rule_ids()) == [
             "async-blocking", "config-registry", "float-time",
-            "jax-purity", "stream-release", "swallowed-exception",
-            "task-leak",
+            "jax-purity", "metrics-scope", "stream-release",
+            "swallowed-exception", "task-leak",
         ]
 
     def test_repo_has_zero_unsuppressed_findings(self):

@@ -10,6 +10,7 @@ Rules (see tools/analysis/checkers/ and COMPONENTS.md §2.6):
 - ``jax-purity``          host side effects in jitted code; dead helpers
 - ``config-registry``     undocumented/untested/loose YAML kinds
 - ``float-time``          wall-clock time.time() in duration/deadline math
+- ``metrics-scope``       slashed metric names bypassing MetricsTree.scope
 - ``suppression``         (meta) ignores must carry a justification
 
 Run: ``python -m tools.analysis [paths] [--rule r1,r2] [--format json]``.

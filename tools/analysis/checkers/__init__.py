@@ -5,6 +5,7 @@ from tools.analysis.checkers import (  # noqa: F401 — registration imports
     config_registry,
     float_time,
     jax_purity,
+    metrics_scope,
     stream_release,
     swallowed,
     task_leak,

@@ -1,0 +1,57 @@
+"""metrics-scope — ad-hoc metric names that bypass MetricsTree.scope.
+
+The MetricsTree contract is that scope components are SEPARATE
+arguments (``metrics.scope("rt", label, "server").counter("requests")``)
+— the Prometheus exporter's label rewriting, ``prune()`` on client
+eviction, and the ``?q=`` subtree filter all walk the tree by component.
+A slash baked into one name string (``metrics.counter("rt/x/requests")``)
+creates a SINGLE tree node whose name merely looks like a path: it
+never prunes with its client, exports with a sanitized underscore name
+instead of labels, and silently diverges from every properly scoped
+sibling.
+
+The rule flags string literals containing ``/`` passed to the four
+registration methods (``scope``/``counter``/``gauge``/``stat``) on any
+receiver — the tree is the only thing in the codebase exposing that
+quartet. Dynamic names are out of scope: the convention for those is to
+sanitize (``path.replace("/", ".")``), which the anomaly telemeter and
+stats filters already follow.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.analysis.core import (
+    Checker, Finding, Project, SourceFile, register_checker,
+)
+
+_METHODS = {"scope", "counter", "gauge", "stat"}
+
+
+@register_checker
+class MetricsScopeChecker(Checker):
+    rule = "metrics-scope"
+    description = ("metric registered under a slashed name string "
+                   "instead of separate scope components")
+    scope = ("linkerd_tpu",)
+
+    def check(self, src: SourceFile, project: Project) -> Iterator[Finding]:
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METHODS):
+                continue
+            for arg in node.args:
+                if (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)
+                        and "/" in arg.value):
+                    yield Finding(
+                        self.rule, src.rel, arg.lineno, arg.col_offset,
+                        f"metric name {arg.value!r} bakes a path into one "
+                        f"component: pass scope segments as separate "
+                        f"arguments (.{node.func.attr}("
+                        f"{', '.join(repr(s) for s in arg.value.split('/') if s)}"
+                        f")) so pruning, labels, and subtree queries "
+                        f"keep working")

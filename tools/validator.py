@@ -42,6 +42,14 @@ after swapping the black hole for a live sidecar — scoring recovers
 (gauge back to 0) within a breaker-probe interval:
 
     python tools/validator.py chaos
+
+And the trace validation: boot the REAL linkerd binary with a
+two-router chain (edge -> inner over loopback) and a zipkin exporter
+pointed at a stub collector, drive one request, and assert the
+exported spans form a single connected tree under one trace id (edge
+server -> edge client -> inner server -> inner client):
+
+    python tools/validator.py trace
 """
 
 from __future__ import annotations
@@ -71,6 +79,8 @@ PORTS = {
                "admin": 26990, "a": 26801, "b": 26802},
     "chaos":  {"linkerd": 27140, "admin": 27990, "a": 27801,
                "sidecar": 27321},
+    "trace":  {"edge": 28140, "inner": 28141, "admin": 28990,
+               "a": 28801, "collector": 28411},
 }
 
 IFACE_YAML = {
@@ -369,6 +379,124 @@ admin:
         d_a.close()
 
 
+async def validate_trace() -> None:
+    """Boot the REAL linkerd binary as a two-router chain with a zipkin
+    exporter, drive one traced request, assert the exported spans form
+    one connected tree. Prints one ``TRACE {json}`` line."""
+    ports = PORTS["trace"]
+    work = tempfile.mkdtemp(prefix="l5d-validate-trace-")
+    disco = os.path.join(work, "disco")
+    os.makedirs(disco)
+    d_a = await downstream("A", ports["a"])
+    with open(os.path.join(disco, "web"), "w") as f:
+        f.write(f"127.0.0.1 {ports['a']}\n")
+
+    # stub zipkin collector: accept POST /api/v2/spans, remember spans
+    spans = []
+
+    async def on_conn(reader, writer):
+        try:
+            while True:
+                head = await reader.readuntil(b"\r\n\r\n")
+                clen = 0
+                for line in head.split(b"\r\n"):
+                    if line.lower().startswith(b"content-length:"):
+                        clen = int(line.split(b":", 1)[1])
+                body = await reader.readexactly(clen) if clen else b""
+                if body:
+                    spans.extend(json.loads(body))
+                writer.write(b"HTTP/1.1 202 Accepted\r\n"
+                             b"Content-Length: 0\r\n\r\n")
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    collector = await asyncio.start_server(
+        on_conn, "127.0.0.1", ports["collector"])
+
+    linkerd_yaml = os.path.join(work, "linkerd.yaml")
+    with open(linkerd_yaml, "w") as f:
+        f.write(f"""
+routers:
+- protocol: http
+  label: edge
+  sampleRate: 1.0
+  dtab: |
+    /svc => /$/inet/127.0.0.1/{ports['inner']} ;
+  servers:
+  - port: {ports['edge']}
+- protocol: http
+  label: inner
+  sampleRate: 1.0
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers:
+  - port: {ports['inner']}
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+telemetry:
+- kind: io.l5d.zipkin
+  port: {ports['collector']}
+  batchIntervalMs: 200
+admin:
+  port: {ports['admin']}
+""")
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    linkerd = None
+    try:
+        linkerd = subprocess.Popen(
+            [sys.executable, "-m", "linkerd_tpu", linkerd_yaml],
+            env=env, cwd=work)
+        await wait_for(lambda: http(
+            "GET", f"http://127.0.0.1:{ports['edge']}/",
+            headers={"Host": "web"})[2] == b"A", 20, "trace chain route")
+        await wait_for(lambda: len(spans) >= 4, 10, "span export")
+
+        # connected-tree assertion: one trace id; every parentId either
+        # absent (the root) or another exported span's id
+        trace_ids = {s["traceId"] for s in spans}
+        assert len(trace_ids) == 1, f"expected 1 trace, got {trace_ids}"
+        ids = {s["id"] for s in spans}
+        roots = [s for s in spans if not s.get("parentId")]
+        dangling = [s["id"] for s in spans
+                    if s.get("parentId") and s["parentId"] not in ids]
+        assert len(roots) == 1, f"expected 1 root span, got {len(roots)}"
+        assert not dangling, f"spans with unexported parents: {dangling}"
+        kinds = sorted((s.get("kind"),
+                        s.get("localEndpoint", {}).get("serviceName"))
+                       for s in spans)
+        expected = sorted([
+            ("SERVER", "edge"),
+            ("CLIENT", f"$.inet.127.0.0.1.{ports['inner']}"),
+            ("SERVER", "inner"),
+            ("CLIENT", "#.io.l5d.fs.web"),
+        ])
+        assert kinds == expected, f"span set {kinds} != {expected}"
+        # the edge server span carries the stage decomposition
+        edge_srv = next(s for s in spans
+                        if s["localEndpoint"]["serviceName"] == "edge")
+        stage_tags = [k for k in edge_srv.get("tags", {})
+                      if k.startswith("stage.")]
+        assert stage_tags, "edge server span missing stage.* tags"
+        print("TRACE " + json.dumps({
+            "spans": len(spans),
+            "connected_tree": True,
+            "stage_tags": sorted(stage_tags),
+        }))
+    finally:
+        if linkerd is not None:
+            linkerd.send_signal(signal.SIGTERM)
+            try:
+                linkerd.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                linkerd.kill()
+        collector.close()
+        d_a.close()
+
+
 def validate_checkpoints(dirs) -> int:
     """Verify each checkpoint store: per-file CRC + full decode, manifest
     agreement, lineage (parents known or recorded as pruned), orphaned
@@ -480,6 +608,10 @@ async def main() -> int:
     if args and args[0] == "chaos":
         await validate_chaos()
         print("VALIDATOR PASS (chaos)")
+        return 0
+    if args and args[0] == "trace":
+        await validate_trace()
+        print("VALIDATOR PASS (trace)")
         return 0
     protocols = args or ["mesh", "thrift", "http"]
     for protocol in protocols:
