@@ -144,8 +144,11 @@ async def bench(n_requests: int) -> dict:
         await tele.drain_once()
         anomalous = tele.board.score_of("/svc/svc-a")
 
-        fvs = [fv for fv, _ in items]
-        labels = [lab for _, lab in items]
+        # ring items are (fv, label, trace, enqueued_at) since the
+        # scorer spans landed; external producers may still append
+        # 2-tuples, so index instead of unpacking
+        fvs = [it[0] for it in items]
+        labels = [it[1] for it in items]
         x = featurize_batch(fvs)
         scorer = tele._ensure_scorer()
         scores = await scorer.score(x)

@@ -243,8 +243,10 @@ async def bench(n_requests: int) -> dict:
         tele.cfg.trainEveryBatches = 0  # score-only
         items = list(tele.ring)
         await tele.drain_once()
-        fvs = [fv for fv, _ in items]
-        labels = [lab for _, lab in items]
+        # ring items are (fv, label, trace, enqueued_at) since the
+        # scorer spans landed; index instead of unpacking
+        fvs = [it[0] for it in items]
+        labels = [it[1] for it in items]
         x = featurize_batch(fvs)
         scorer = tele._ensure_scorer()
         scores = await scorer.score(x)

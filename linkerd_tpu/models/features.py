@@ -58,8 +58,27 @@ from typing import Deque, Dict, Optional, Sequence, Tuple
 import numpy as np
 
 FEATURE_DIM = 36
+STATUS_ONEHOT_OFF = 1   # [1:6] status-class one-hot
 _PATH_HASH_OFF = 14
 _PATH_HASH_DIM = 16
+
+# path -> (hash column, sign) cache shared by every encoder (per-row,
+# batch, and the native block featurizer): paths repeat heavily (one
+# per dst), so the crc is paid once per distinct path
+_PATH_HASH_CACHE: Dict[str, Tuple[int, float]] = {}
+
+
+def path_hash_cols(path: str) -> Tuple[int, float]:
+    """The ONE definition of the signed dst-path feature hash:
+    -> (feature column, ±1.0 sign)."""
+    got = _PATH_HASH_CACHE.get(path)
+    if got is None:
+        h = zlib.crc32(path.encode())
+        got = (_PATH_HASH_OFF + h % _PATH_HASH_DIM,
+               1.0 if (h >> 16) & 1 else -1.0)
+        if len(_PATH_HASH_CACHE) < 65536:
+            _PATH_HASH_CACHE[path] = got
+    return got
 
 # Debug/ablation knob: comma-separated dim indices to zero after
 # encoding (e.g. L5D_FEATURE_ABLATE="32,34"). Parsed once at import;
@@ -97,10 +116,8 @@ class FeatureVector:
 
 def _hash_path(path: str, out: np.ndarray) -> None:
     """Signed feature hashing of the dst path into 16 buckets."""
-    h = zlib.crc32(path.encode())
-    bucket = h % _PATH_HASH_DIM
-    sign = 1.0 if (h >> 16) & 1 else -1.0
-    out[_PATH_HASH_OFF + bucket] += sign
+    col, sign = path_hash_cols(path)
+    out[col] += sign
 
 
 def featurize(fv: FeatureVector, out: Optional[np.ndarray] = None) -> np.ndarray:
@@ -109,7 +126,7 @@ def featurize(fv: FeatureVector, out: Optional[np.ndarray] = None) -> np.ndarray
     x[0] = np.log1p(max(fv.latency_ms, 0.0))
     sc = fv.status // 100
     if 1 <= sc <= 5:
-        x[1 + sc - 1] = 1.0
+        x[STATUS_ONEHOT_OFF + sc - 1] = 1.0
     x[6] = 1.0 if fv.retryable else 0.0
     x[7] = float(fv.retries)
     x[8] = np.log1p(max(fv.request_bytes, 0))
@@ -230,8 +247,43 @@ class DstTemporal:
 
 
 def featurize_batch(fvs: Sequence[FeatureVector]) -> np.ndarray:
-    """Encode a micro-batch: float32[len(fvs), FEATURE_DIM]."""
-    out = np.zeros((len(fvs), FEATURE_DIM), dtype=np.float32)
+    """Encode a micro-batch: float32[len(fvs), FEATURE_DIM].
+
+    Vectorized column-wise (one numpy pass per feature, not one Python
+    ``featurize`` per row): the drain path encodes thousands of rows
+    per wake, and per-row encoding was the line-rate batcher's
+    bottleneck. Bit-identical to stacking ``featurize`` per row
+    (pinned by tests/test_models.py)."""
+    n = len(fvs)
+    out = np.zeros((n, FEATURE_DIM), dtype=np.float32)
+    if n == 0:
+        return out
+    out[:, 0] = np.log1p(np.maximum(
+        [fv.latency_ms for fv in fvs], 0.0))
+    sc = np.array([fv.status for fv in fvs], np.int64) // 100
+    ok = (sc >= 1) & (sc <= 5)
+    out[np.flatnonzero(ok), STATUS_ONEHOT_OFF + sc[ok] - 1] = 1.0
+    out[:, 6] = [1.0 if fv.retryable else 0.0 for fv in fvs]
+    out[:, 7] = [float(fv.retries) for fv in fvs]
+    out[:, 8] = np.log1p(np.maximum(
+        [fv.request_bytes for fv in fvs], 0))
+    out[:, 9] = np.log1p(np.maximum(
+        [fv.response_bytes for fv in fvs], 0))
+    out[:, 10] = np.log1p(np.maximum(
+        [fv.concurrency for fv in fvs], 0))
+    out[:, 11] = np.log1p(np.maximum(
+        [fv.ewma_ms for fv in fvs], 0.0))
+    out[:, 12] = np.log1p(np.maximum(
+        [fv.queue_ms for fv in fvs], 0.0))
+    out[:, 13] = [1.0 if fv.exception else 0.0 for fv in fvs]
     for i, fv in enumerate(fvs):
-        featurize(fv, out[i])
+        col, sign = path_hash_cols(fv.dst_path)
+        out[i, col] += sign
+    out[:, 30] = np.log1p(np.maximum(
+        [fv.dst_rps for fv in fvs], 0.0))
+    out[:, 31] = 1.0
+    d = np.array([fv.lat_drift_ms for fv in fvs], np.float64)
+    out[:, 32] = np.sign(d) * np.log1p(np.abs(d))
+    for dim in _ABLATE:
+        out[:, dim] = 0.0
     return out

@@ -275,6 +275,27 @@ class FastPathEngine:
         return arr[:n * self.FEATURE_DIM].reshape(
             n, self.FEATURE_DIM).copy()
 
+    def drain_features_into(self, out) -> int:
+        """Drain up to ``len(out)`` feature rows directly into ``out``
+        (a C-contiguous float32 [rows, FEATURE_DIM] ndarray — in
+        practice a writable view of the telemeter's NativeFeatureRing):
+        the engine memcpys rows straight into ring memory, no
+        intermediate buffer and no per-row Python objects. Returns the
+        number of rows written."""
+        import numpy as np
+        if len(out) == 0:
+            return 0
+        if out.dtype != np.float32:
+            raise ValueError(f"want float32 rows, got {out.dtype}")
+        if out.ndim != 2 or out.shape[1] != self.FEATURE_DIM \
+                or not out.flags["C_CONTIGUOUS"]:
+            raise ValueError(
+                f"want C-contiguous [n, {self.FEATURE_DIM}] f32, got "
+                f"shape {out.shape}")
+        ptr = out.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        n = self._fn_features(self._e, ptr, len(out))
+        return max(int(n), 0)
+
     def close(self) -> None:
         if not self._closed:
             self._closed = True

@@ -78,6 +78,26 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("data", None))
 
 
+def shard_batch(mesh: Mesh, x: np.ndarray) -> jax.Array:
+    """Per-device shard feed: place each device's OWN batch shard and
+    assemble the global array with
+    ``jax.make_array_from_single_device_arrays``.
+
+    The old path handed the full host batch to one ``jax.device_put``
+    with a NamedSharding, which on weak-scaled meshes serializes the
+    whole transfer through a single host-side staging pass (BENCH_r04:
+    cpu8 weak-scaled LOST to cpu1). Here each device receives exactly
+    its slice — transfers are per-shard and the assembly is metadata
+    only. The batch dim must divide evenly over the data axis (callers
+    pad via ``_pad_rows``; ``bucket_rows`` already rounds to a multiple
+    of the mesh's data size).
+    """
+    sh = batch_sharding(mesh)
+    idx_map = sh.addressable_devices_indices_map(x.shape)
+    shards = [jax.device_put(x[idx], d) for d, idx in idx_map.items()]
+    return jax.make_array_from_single_device_arrays(x.shape, sh, shards)
+
+
 def _layer_specs(n_layers: int, first_col: bool = True):
     """Alternating column-/row-parallel specs for a dense chain.
 
@@ -118,7 +138,8 @@ def shard_params(mesh: Mesh, params: Params) -> Params:
 
 
 def make_score_step(
-    mesh: Mesh, cfg: AnomalyModelConfig = AnomalyModelConfig()
+    mesh: Mesh, cfg: AnomalyModelConfig = AnomalyModelConfig(),
+    donate: bool = False,
 ) -> Callable[..., jax.Array]:
     """Jitted scoring step: features [B, D] -> scores [B].
 
@@ -127,17 +148,25 @@ def make_score_step(
     data-axis shard z-scores its own rows, the host never touches the
     batch (normalize_features' contract). Without them the step scores
     raw features (pre-normalized or synthetic-test input).
+
+    With ``donate``, the input batch buffer is donated to the step
+    (``donate_argnums``): the line-rate dispatcher hands the step a
+    device array assembled by ``shard_batch`` and never touches it
+    again, so XLA reuses the buffer instead of allocating per batch.
+    Donated inputs must not be re-read after dispatch — JAX raises on
+    reuse of a deleted buffer.
     """
     xs = batch_sharding(mesh)
 
-    @jax.jit
     def score(params: Params, x: jax.Array, mu=None, var=None) -> jax.Array:
         x = jax.lax.with_sharding_constraint(x, xs)
         if mu is not None:
             x = normalize_features(x, mu, var)
         return anomaly_scores(params, x, cfg)
 
-    return score
+    if donate:
+        return jax.jit(score, donate_argnums=(1,))
+    return jax.jit(score)
 
 
 def make_train_step(

@@ -134,6 +134,12 @@ class FastPathController:
         self._scope = metrics.scope("rt", label, "fastpath")
         from linkerd_tpu.models.features import DstTemporal
         self._temporal = DstTemporal()
+        # native line-rate feed state: telemeters whose ring resolver is
+        # installed, plus the overflow scratch block (drop-and-count
+        # when the ring is full — the engine must not grow unbounded)
+        self._native_sinks: set = set()
+        import numpy as np
+        self._scratch = np.zeros((1024, 6), np.float32)
 
     async def start(self) -> None:
         self.engine.start()
@@ -200,44 +206,136 @@ class FastPathController:
                 k: int(s.get(k, 0))
                 for k in ("requests", "success", "f4xx", "f5xx", "conn_fail")}
 
+    def _route_dst(self, route_id: int) -> Optional[str]:
+        """route_id -> dst path for feature attribution, or None while
+        the id is not yet in the stats-loop mapping (the featurizer
+        then uses an UNCACHED placeholder, so attribution self-corrects
+        on the next 1s stats tick instead of pinning a stale name)."""
+        host = self._id_to_host.get(int(route_id))
+        if host is None:
+            return None
+        return f"{self.prefix.show}/{host}"
+
     def _forward_features(self) -> None:
-        rings = []
+        """Forward per-request engine rows to the anomaly telemeters.
+
+        Line-rate path: rows are drained by the engine DIRECTLY into
+        the telemeter's preallocated NativeFeatureRing
+        (``drain_features_into`` memcpys C → ring memory) and consumed
+        zero-copy by the micro-batcher — no per-row Python objects on
+        the C++→Python seam. Telemeters without a native ring keep the
+        legacy FeatureVector-per-row feed."""
+        sinks = []
+        legacy_rings = []
         for t in self.telemeters:
-            ring = getattr(t, "ring", None)
-            if ring is not None and hasattr(t, "board"):
-                rings.append(ring)
-        rows = self.engine.drain_features()
-        if not len(rows) or not rings:
+            if getattr(t, "native_ring", None) is not None \
+                    and hasattr(t, "native_committed"):
+                sinks.append(t)
+            elif getattr(t, "ring", None) is not None \
+                    and hasattr(t, "board"):
+                legacy_rings.append(t.ring)
+        if not sinks:
+            # no native consumer: the legacy per-row path drains the
+            # engine itself
+            for row in self.engine.drain_features():
+                fv = self._legacy_fv(row)
+                for ring in legacy_rings:
+                    ring.append((fv, None))
             return
+        primary, extras = sinks[0], sinks[1:]
+        for t in sinks:
+            if t not in self._native_sinks:
+                t.set_native_route_resolver(self._route_dst)
+                self._native_sinks.add(t)
+        ring = primary.native_ring
+        total = 0
+        drained_views = []  # row views, for fan-out to other consumers
+        while True:
+            wrote = 0
+            for view in ring.produce_views():
+                n = self.engine.drain_features_into(view)
+                ring.commit(n)
+                if n:
+                    drained_views.append(view[:n])
+                wrote += n
+                if n < len(view):
+                    break
+            total += wrote
+            if wrote == 0:
+                break
+        # ring full but the engine may still hold rows: shed them into
+        # a scratch buffer so neither side grows unbounded. Shed rows
+        # still COUNT toward requests_total (they entered the scoring
+        # path and were dropped — under backpressure the scored
+        # fraction must report < 1.0, not lie)
+        dropped = 0
+        if ring.free == 0:
+            while True:
+                n = self.engine.drain_features_into(self._scratch)
+                if n <= 0:
+                    break
+                ring.drop(n)
+                dropped += n
+                if n < len(self._scratch):
+                    break
+        if total or dropped:
+            primary.native_committed(total, dropped=dropped)
+        # fan out: additional native sinks get a copy of the drained
+        # block (the zero-copy path is inherently per-ring; a second
+        # telemeter is a second consumer)
+        for t in extras:
+            copied = 0
+            for block in drained_views:
+                off = 0
+                for view in t.native_ring.produce_views(len(block)):
+                    k = len(view)
+                    view[:] = block[off:off + k]
+                    off += k
+                t.native_ring.commit(off)
+                copied += off
+            short = (total - copied) + dropped
+            if short > 0:
+                t.native_ring.drop(short)
+            if copied or short:
+                t.native_committed(copied, dropped=short)
+        # legacy telemeters consume the SAME drained block (the engine
+        # was already emptied above)
+        if legacy_rings:
+            for block in drained_views:
+                for row in block:
+                    fv = self._legacy_fv(row)
+                    for r in legacy_rings:
+                        r.append((fv, None))
+
+    def _legacy_fv(self, row):
+        """One engine row -> FeatureVector (the per-row Python path for
+        telemeters without a native ring)."""
         from linkerd_tpu.telemetry.anomaly import FeatureVector
-        for row in rows:
-            host = self._id_to_host.get(int(row[0]), f"fp-{int(row[0])}")
-            dst_path = f"{self.prefix.show}/{host}"
-            latency_ms = float(row[1])
-            status = int(row[2])
-            # row[5] is the engine-side timestamp: temporal deltas track
-            # when the request actually ran, not when it was drained
-            drift, err_rate, rate_delta, mesh_err = self._temporal.observe(
-                dst_path, latency_ms, status >= 500, float(row[5]))
-            fv = FeatureVector(
-                latency_ms=latency_ms,
-                status=status,
-                retries=0,
-                request_bytes=int(row[3]),
-                response_bytes=int(row[4]),
-                concurrency=1,
-                queue_ms=0.0,
-                exception=False,
-                retryable=False,
-                dst_path=dst_path,
-                dst_rps=0.0,
-                lat_drift_ms=drift,
-                dst_err_rate=err_rate,
-                rate_delta=rate_delta,
-                mesh_err_rate=mesh_err,
-            )
-            for ring in rings:
-                ring.append((fv, None))
+        rid = int(row[0])
+        dst_path = self._route_dst(rid) or f"{self.prefix.show}/fp-{rid}"
+        latency_ms = float(row[1])
+        status = int(row[2])
+        # row[5] is the engine-side timestamp: temporal deltas track
+        # when the request actually ran, not when it was drained
+        drift, err_rate, rate_delta, mesh_err = self._temporal.observe(
+            dst_path, latency_ms, status >= 500, float(row[5]))
+        return FeatureVector(
+            latency_ms=latency_ms,
+            status=status,
+            retries=0,
+            request_bytes=int(row[3]),
+            response_bytes=int(row[4]),
+            concurrency=1,
+            queue_ms=0.0,
+            exception=False,
+            retryable=False,
+            dst_path=dst_path,
+            dst_rps=0.0,
+            lat_drift_ms=drift,
+            dst_err_rate=err_rate,
+            rate_delta=rate_delta,
+            mesh_err_rate=mesh_err,
+        )
 
     async def close(self) -> None:
         # detach the task list BEFORE awaiting: a start() interleaving

@@ -109,11 +109,15 @@ class ScoreBoard:
 class FeatureRecorder(Filter[Request, Response]):
     """Tap the request path: record one FeatureVector per request into the
     ring. O(1) appends; the deque drops oldest under overload (scoring is
-    best-effort, requests are never blocked)."""
+    best-effort, requests are never blocked). ``on_record`` (the
+    telemeter's enqueue hook) counts the request toward the scored
+    fraction and wakes the line-rate micro-batcher."""
 
-    def __init__(self, ring: Deque, concurrency_gauge: Optional[Callable] = None):
+    def __init__(self, ring: Deque,
+                 on_record: Optional[Callable[[], None]] = None):
         from linkerd_tpu.models.features import DstTemporal
         self.ring = ring
+        self._on_record = on_record
         self._inflight = 0
         self._rps_window: Deque[float] = collections.deque(maxlen=512)
         self._temporal = DstTemporal()
@@ -174,6 +178,8 @@ class FeatureRecorder(Filter[Request, Response]):
             # the micro-batcher can emit scorer spans as children of the
             # originating request (ring wait = the span's queue annotation)
             self.ring.append((fv, label, req.ctx.get("trace"), now))
+            if self._on_record is not None:
+                self._on_record()
 
     def _rps(self, now: float) -> float:
         w = self._rps_window
@@ -223,15 +229,25 @@ class Scorer:
 
 
 class InProcessScorer(Scorer):
-    """Runs the JAX model in-process. Device work is dispatched from a
-    worker thread so the event loop never blocks on compilation or
-    transfers.
+    """Runs the JAX model in-process, dispatched at line rate.
+
+    The score path has NO per-call thread hop and NO fresh full-batch
+    ``device_put``: batches land in persistent double-buffered staging
+    buffers (one pair per padded batch bucket), the jitted score step
+    takes the device copy with ``donate_argnums`` (XLA reuses the
+    buffer instead of allocating per batch), and dispatch rides JAX
+    async dispatch — a single background drainer thread does the
+    blocking readback, so host→device transfer of batch N overlaps
+    device compute of batch N-1 and the event loop never blocks on the
+    device (see telemetry/linerate.RingDispatcher).
 
     With more than one device the SAME serving path runs sharded: a
     dp x tp mesh from parallel/mesh.py, params placed per the Megatron
-    column/row specs, micro-batches sharded over ``data`` — XLA inserts
-    the ICI collectives. Single-chip keeps the fused-Pallas kernel
-    (ops/scoring.best_scorer)."""
+    column/row specs, micro-batches fed per-device via
+    ``parallel.mesh.shard_batch`` (each device receives exactly its
+    shard; no single host-side device_put of the full batch) — XLA
+    inserts the ICI collectives. Single-chip keeps the fused-Pallas
+    kernel (ops/scoring.best_scorer)."""
 
     def __init__(self, seed: int = 0, learning_rate: float = 1e-3,
                  recon_weight: float = 0.7, fit_steps: int = 4,
@@ -256,7 +272,12 @@ class InProcessScorer(Scorer):
                                   model_width=max(self.cfg.enc_dims))
             self.params, self._opt_state = init_sharded(
                 self.mesh, jax.random.key(seed), self._opt, self.cfg)
-            self._scorer = make_score_step(self.mesh, self.cfg)
+            # the one jitted score step DONATES its input batch: every
+            # caller hands it a buffer it never re-reads (the dispatch
+            # ring's staging copy, or a fresh per-call device_put on
+            # the instrumented path)
+            self._scorer = make_score_step(self.mesh, self.cfg,
+                                           donate=True)
             self._train_step = make_train_step(self.mesh, self._opt, self.cfg)
             self._batch_multiple = self.mesh.shape["data"]
         else:
@@ -265,7 +286,7 @@ class InProcessScorer(Scorer):
             # chip); jit follows the committed placement of the params
             self.params = jax.device_put(params, devices[0])
             self._opt_state = self._opt.init(self.params)
-            self._scorer = best_scorer(self.cfg)
+            self._scorer = best_scorer(self.cfg, donate=True)
             self._train_step = self._mk_train_step()
         self.fit_steps = fit_steps
         self._devices = devices
@@ -292,10 +313,21 @@ class InProcessScorer(Scorer):
         # batch, forfeiting transfer/compute overlap — only pay it when
         # a consumer exists (span sink installed, or bench seam metrics)
         self.timing_enabled = False
+        # with timing on, only every Nth batch pays the instrumented
+        # (two-barrier, thread-hop) path; the rest ride the line-rate
+        # ring and span tags reuse the last sampled decomposition.
+        # 1 = time every batch (the bench's seam phase sets this).
+        self.timing_sample_every = 1
+        self._timing_i = 0
         self.last_timing: Optional[dict] = None
         self.timing_totals = {"calls": 0, "queue_ms": 0.0,
                               "transfer_ms": 0.0, "device_ms": 0.0,
                               "bytes": 0}
+        # persistent double-buffered staging ring (the line-rate
+        # dispatch path; see class docstring)
+        from linkerd_tpu.telemetry.linerate import RingDispatcher
+        self._dispatcher = RingDispatcher(self.cfg.in_dim,
+                                          self._bucket_target)
         self._place_norm()
 
     def _place_norm(self) -> None:
@@ -353,17 +385,23 @@ class InProcessScorer(Scorer):
 
         return step
 
-    def _pad_rows(self, arr: np.ndarray) -> np.ndarray:
-        """Pad the batch dim up to the next power of two (and a multiple of
-        the data-axis size: sharded arrays must divide evenly over the
-        mesh). Bucketing batch shapes bounds the number of distinct XLA
-        compilations to ~log2(maxBatch) instead of one per batch size."""
+    def _bucket_target(self, n: int) -> int:
+        """Padded batch size for ``n`` rows: next power of two, rounded
+        up to a multiple of the data-axis size (sharded arrays must
+        divide evenly over the mesh). Bucketing batch shapes bounds the
+        number of distinct XLA compilations to ~log2(maxBatch) instead
+        of one per batch size — and bounds the dispatch ring to one
+        staging pair per bucket."""
         from linkerd_tpu.telemetry.sidecar import bucket_rows
-        n = len(arr)
         target = bucket_rows(n)
         m = self._batch_multiple
         if m > 1 and target % m:
             target += m - target % m
+        return target
+
+    def _pad_rows(self, arr: np.ndarray) -> np.ndarray:
+        n = len(arr)
+        target = self._bucket_target(n)
         if target == n:
             return arr
         widths = ((0, target - n),) + ((0, 0),) * (arr.ndim - 1)
@@ -475,7 +513,7 @@ class InProcessScorer(Scorer):
         the thousands would lose mantissa bits if cast to bf16 before
         subtracting mu) and the sharded path normalizes each batch shard
         on its own device."""
-        return self._pad_rows(np.asarray(x, np.float32))
+        return self._pad_rows(np.asarray(x, np.float32))  # l5d: ignore[jax-hotpath] — host-side dtype cast of the input batch, not a device readback
 
     def _batch_placement(self):
         """Device placement for an input batch: the data-axis sharding
@@ -498,6 +536,46 @@ class InProcessScorer(Scorer):
         t["bytes"] += nbytes
 
     async def score(self, x: np.ndarray) -> np.ndarray:
+        """Score [n, D] -> [n] through the donated staging ring. The
+        event loop only pays one host memcpy into the staging slot plus
+        JAX async dispatch; readback happens on the drainer thread.
+        Hot-swap safety: ``params``/``mu``/``var`` are captured HERE —
+        a concurrent ``restore``/``fit`` repoints the attributes but
+        never mutates the captured (immutable) device arrays, so an
+        in-flight donated batch always completes against a consistent
+        model."""
+        if self.timing_enabled:
+            self._timing_i += 1
+            if self.timing_sample_every <= 1 \
+                    or self._timing_i % self.timing_sample_every == 1:
+                return await self._score_timed(x)
+        xf = np.asarray(x, np.float32)  # l5d: ignore[jax-hotpath] — host-side dtype cast of the input batch, not a device readback
+        params = self.params
+        mu_d, var_d = self._mu_d, self._var_d
+        if self.mesh is not None:
+            from linkerd_tpu.parallel.mesh import shard_batch
+            mesh = self.mesh
+
+            def step(staging: np.ndarray):
+                # per-device shard feed; the assembled array is donated
+                xd = shard_batch(mesh, staging)  # l5d: ignore[jax-hotpath] — per-shard async placement of the persistent staging buffer, not a per-call full-batch copy
+                return self._scorer(params, xd, mu_d, var_d)
+        else:
+            dev = self._devices[0]
+
+            def step(staging: np.ndarray):
+                import jax
+                xd = jax.device_put(staging, dev)  # l5d: ignore[jax-hotpath] — async placement of the persistent staging buffer; donated to the step, never re-read
+                return self._scorer(params, xd, mu_d, var_d)
+
+        return await self._dispatcher.dispatch(xf, step)
+
+    async def _score_timed(self, x: np.ndarray) -> np.ndarray:
+        """Instrumented scoring: explicit transfer/step/readback phases
+        so the seam cost is measurable (transfer_GBps, device-step-ms)
+        and scorer spans can split queue/device/transfer out. Pays two
+        device barriers per batch — opt-in via ``timing_enabled`` only;
+        the line-rate path is ``score`` above."""
         n = len(x)
         t_submit = time.monotonic()
         xn = self._prep(x)
@@ -505,26 +583,28 @@ class InProcessScorer(Scorer):
         # thread: a concurrent fit() repoints both mirrors, and reading
         # them from the thread could tear the pair (new mu, old var)
         mu_d, var_d = self._mu_d, self._var_d
+        params = self.params
 
         def run() -> np.ndarray:
-            if not self.timing_enabled:
-                # fused dispatch: hand the host array straight to the
-                # jitted step so XLA overlaps transfer with compute
-                return np.asarray(
-                    self._scorer(self.params, xn, mu_d, var_d),
-                    dtype=np.float32)[:n]
             import jax
-            # explicit transfer/step/readback phases so the seam cost is
-            # measurable (ROADMAP item 3: transfer_GBps, device-step-ms)
-            # and scorer spans can split queue/device/transfer out
             t0 = time.monotonic()
-            xd = jax.block_until_ready(
-                jax.device_put(xn, self._batch_placement()))
+            xd = jax.block_until_ready(  # l5d: ignore[jax-hotpath] — instrumented path: the barriers ARE the measurement
+                jax.device_put(xn, self._batch_placement()))  # l5d: ignore[jax-hotpath] — instrumented path: fresh per-call transfer, measured deliberately
             t1 = time.monotonic()
-            r = jax.block_until_ready(
-                self._scorer(self.params, xd, mu_d, var_d))
+            import warnings
+
+            from linkerd_tpu.telemetry.linerate import (
+                _DONATION_DECLINED_MSG,
+            )
+            with warnings.catch_warnings():
+                # first-compile of a bucket may happen here instead of
+                # on the ring path; same expected donation-decline note
+                warnings.filterwarnings(
+                    "ignore", message=_DONATION_DECLINED_MSG)
+                r = jax.block_until_ready(  # l5d: ignore[jax-hotpath] — instrumented path: device-step barrier, measured deliberately
+                    self._scorer(params, xd, mu_d, var_d))
             t2 = time.monotonic()
-            out = np.asarray(r, dtype=np.float32)[:n]
+            out = np.asarray(r, dtype=np.float32)[:n]  # l5d: ignore[jax-hotpath] — instrumented path: host readback timed deliberately
             t3 = time.monotonic()
             self._note_timing(
                 queue_ms=(t0 - t_submit) * 1e3,
@@ -533,28 +613,7 @@ class InProcessScorer(Scorer):
                 nbytes=xn.nbytes + out.nbytes)
             return out
 
-        return await asyncio.to_thread(run)
-
-    def score_batches_sync(self, batches, depth: int = 2):
-        """Pipelined scoring: keep up to ``depth`` batches in flight so
-        the host->device transfer of batch i+1 overlaps device compute
-        of batch i (double-buffering; JAX dispatch is async, only the
-        np.asarray readback blocks). Yields one f32 score array per
-        input batch, in order. This is the throughput-shaped serving
-        path; per-batch latency keeps using score()."""
-        import collections
-        pend = collections.deque()
-        mu_d, var_d = self._mu_d, self._var_d  # consistent pair (see score)
-        for x in batches:
-            xn = self._prep(x)
-            pend.append((len(x), self._scorer(
-                self.params, xn, mu_d, var_d)))
-            if len(pend) >= depth:
-                n0, r = pend.popleft()
-                yield np.asarray(r, dtype=np.float32)[:n0]
-        while pend:
-            n0, r = pend.popleft()
-            yield np.asarray(r, dtype=np.float32)[:n0]
+        return await asyncio.to_thread(run)  # l5d: ignore[jax-hotpath] — opt-in instrumented path only; the serving path is the donated ring dispatch
 
     async def fit(self, x: np.ndarray, labels: np.ndarray,
                   mask: np.ndarray) -> float:
@@ -581,6 +640,9 @@ class InProcessScorer(Scorer):
 
         return await asyncio.to_thread(run)
 
+    def close(self) -> None:
+        self._dispatcher.close()
+
 
 @register("telemeter", "io.l5d.jaxAnomaly")
 @dataclass
@@ -593,7 +655,21 @@ class JaxAnomalyConfig:
     trainEveryBatches: int = 8      # online-fit cadence (0 = never train)
     reconWeight: float = 0.7
     learningRate: float = 0.001
+    # line-rate micro-batcher (the default): drain is size- and
+    # deadline-triggered — a batch dispatches when maxBatch rows are
+    # pending OR the oldest pending row has lingered maxLingerMs,
+    # whichever first — so 100% of requests are scored with bounded
+    # added queue latency. lineRate: false falls back to the legacy
+    # intervalMs polling loop (sampled-batch behavior).
+    lineRate: bool = True
+    maxLingerMs: float = 2.0
+    scoreConcurrency: int = 2  # batches in flight (double-buffer depth)
     sidecarAddress: Optional[str] = None  # host:port -> gRPC sidecar mode
+    # sidecar tiering: "fallback" (default) serves every batch from the
+    # in-process line-rate scorer and demotes the sidecar to a fallback
+    # tier behind its breaker; "primary" keeps the sidecar as the one
+    # scorer (the pre-line-rate wiring, used by the chaos harnesses)
+    sidecarTier: str = "fallback"
     # scorer-path resilience (sidecar mode): per-call deadline, breaker
     # thresholds/probe backoffs, and the ScoreBoard staleness TTL (stale
     # scores decay to neutral so a dead scorer can't pin accrual policy)
@@ -617,15 +693,39 @@ class JaxAnomalyTelemeter(Telemeter):
             # 0 would silently disable draining (NOT a sentinel like
             # trainEveryBatches' 0 = never)
             raise ValueError("maxBatchesPerWake must be >= 1")
+        if cfg.sidecarTier not in ("primary", "fallback"):
+            raise ValueError("sidecarTier must be 'primary' or 'fallback'")
+        if cfg.maxLingerMs < 0:
+            raise ValueError("maxLingerMs must be >= 0")
+        if cfg.scoreConcurrency < 1:
+            raise ValueError("scoreConcurrency must be >= 1")
+        from linkerd_tpu.telemetry.linerate import (
+            NativeFeatureRing, NativeFeaturizer,
+        )
         self.cfg = cfg
         self.metrics = metrics
         self.ring: Deque = collections.deque(maxlen=cfg.ringCapacity)
+        # raw native-engine rows, drained C -> ring memory by the
+        # FastPathController and consumed zero-copy by the batcher
+        self.native_ring = NativeFeatureRing(cfg.ringCapacity)
+        self._native_featurizer = NativeFeaturizer()
         self.board = ScoreBoard(ttl_s=cfg.scoreTtlSecs)
         self._scorer = scorer
         self._stop = asyncio.Event()
+        self._wake = asyncio.Event()  # batcher wake: rows pending
+        self._fit_lock = asyncio.Lock()
         self._node = metrics.scope("anomaly")
         self._scored = self._node.counter("scored_total")
+        # every request that ENTERS the scoring path (recorder append or
+        # native-ring row): scored_total / requests_total is the scored
+        # fraction — "100% scored" is measured, not asserted
+        self._requests = self._node.counter("requests_total")
+        self._node.gauge("scored_fraction", fn=self._scored_fraction)
         self._dropped = self._node.gauge("ring_depth", fn=lambda: len(self.ring))
+        self._node.gauge("native_ring_depth",
+                         fn=lambda: float(len(self.native_ring)))
+        self._node.gauge("native_ring_dropped",
+                         fn=lambda: float(self.native_ring.dropped))
         self._batches = self._node.counter("batches")
         self._train_loss = self._node.gauge("train_loss")
         # degraded mode: 1 while the scorer path is failing (breaker
@@ -665,24 +765,69 @@ class JaxAnomalyTelemeter(Telemeter):
         """The ModelLifecycleManager (None unless configured)."""
         return self._lifecycle
 
+    def _scored_fraction(self) -> float:
+        req = self._requests.value
+        if req <= 0:
+            return 1.0
+        return min(1.0, self._scored.value / req)
+
     # -- stack tap --------------------------------------------------------
     def recorder(self) -> FeatureRecorder:
-        return FeatureRecorder(self.ring)
+        return FeatureRecorder(self.ring, on_record=self._note_request)
+
+    def _note_request(self) -> None:
+        self._requests.incr()
+        self._wake.set()
+
+    # -- native fastpath feed ---------------------------------------------
+    def set_native_route_resolver(self, fn: Callable[[int], str]) -> None:
+        """Install the FastPathController's route_id -> dst-path mapping
+        (consulted once per unique route, cached)."""
+        self._native_featurizer.resolver = fn
+
+    def native_committed(self, rows: int, dropped: int = 0) -> None:
+        """The controller drained ``rows`` engine rows into
+        ``native_ring`` and shed ``dropped`` more under backpressure:
+        BOTH count toward requests_total (a shed row entered the
+        scoring path and was not scored — the scored fraction must
+        report < 1.0 under overload, not hide the shed), then wake the
+        batcher."""
+        if rows > 0 or dropped > 0:
+            self._requests.incr(rows + dropped)
+        if rows > 0:
+            self._wake.set()
+
+    # with a span sink installed, 1-in-N batches pay the instrumented
+    # two-barrier timing path; the other N-1 keep the line-rate ring
+    # and span tags reuse the last sampled decomposition
+    TIMING_SAMPLE_EVERY = 16
 
     def set_tracer(self, tracer) -> None:
         """Install the linker's span sink (called after telemeter
         assembly — the broadcast tracer is built FROM telemeters, so it
         cannot exist when this one is constructed). With a sink in
         place the scorer's phase-split timing pays for itself, so it is
-        switched on."""
+        switched on — SAMPLED, so the serving path stays on the
+        donated ring."""
         self._span_sink = tracer
         if self._scorer is not None and tracer is not None:
-            self._scorer.timing_enabled = True
+            self._enable_sampled_timing(self._scorer)
+
+    def _enable_sampled_timing(self, scorer) -> None:
+        scorer.timing_enabled = True
+        if hasattr(scorer, "timing_sample_every"):
+            scorer.timing_sample_every = self.TIMING_SAMPLE_EVERY
 
     # -- Telemeter --------------------------------------------------------
+    def _mk_inprocess(self) -> "InProcessScorer":
+        return InProcessScorer(
+            learning_rate=self.cfg.learningRate,
+            recon_weight=self.cfg.reconWeight)
+
     def _ensure_scorer(self) -> Scorer:
         if self._scorer is None:
             if self.cfg.sidecarAddress:
+                from linkerd_tpu.telemetry.linerate import TieredScorer
                 from linkerd_tpu.telemetry.resilience import (
                     CircuitBreaker, ResilientScorer,
                 )
@@ -690,21 +835,34 @@ class JaxAnomalyTelemeter(Telemeter):
                 # the breaker + per-call deadline wrap OUTSIDE the
                 # client's own (compile-aware) gRPC deadlines: a hung
                 # sidecar costs one bounded call, then fails fast
-                self._scorer = ResilientScorer(
+                resilient = ResilientScorer(
                     GrpcScorerClient(self.cfg.sidecarAddress),
                     call_timeout_s=self.cfg.scoreTimeoutMs / 1e3,
                     breaker=CircuitBreaker(
                         failures=self.cfg.breakerFailures,
                         min_backoff_s=self.cfg.breakerMinBackoffMs / 1e3,
                         max_backoff_s=self.cfg.breakerMaxBackoffMs / 1e3))
+                if self.cfg.sidecarTier == "primary":
+                    self._scorer = resilient
+                else:
+                    # line-rate default: in-process primary, sidecar
+                    # DEMOTED to the fallback tier behind the breaker
+                    try:
+                        primary = self._mk_inprocess()
+                    except Exception as e:  # noqa: BLE001 — no local
+                        # device/toolchain: the sidecar carries the load
+                        log.warning("in-process scorer unavailable (%r); "
+                                    "sidecar serves as the only tier", e)
+                        self._scorer = resilient
+                    else:
+                        self._scorer = TieredScorer(primary, resilient)
             else:
-                self._scorer = InProcessScorer(
-                    learning_rate=self.cfg.learningRate,
-                    recon_weight=self.cfg.reconWeight)
+                self._scorer = self._mk_inprocess()
             if self._span_sink is not None:
                 # spans consume the decomposition: turn on phase-split
-                # timing (a no-op attribute on backends without it)
-                self._scorer.timing_enabled = True
+                # timing (a no-op attribute on backends without it),
+                # sampled so the line-rate path keeps the ring
+                self._enable_sampled_timing(self._scorer)
         return self._scorer
 
     def _set_degraded(self, degraded: bool) -> None:
@@ -713,7 +871,6 @@ class JaxAnomalyTelemeter(Telemeter):
 
     async def run(self) -> None:
         scorer = self._ensure_scorer()
-        interval = self.cfg.intervalMs / 1e3
         lc_cfg = self.cfg.lifecycle
         if self._lifecycle is not None and lc_cfg.restoreOnStart:
             # survive restarts: pull the last-good model before scoring
@@ -725,26 +882,99 @@ class JaxAnomalyTelemeter(Telemeter):
             except Exception:  # noqa: BLE001 — a bad store must not
                 log.exception("checkpoint bootstrap failed; "
                               "serving from fresh init")
+        try:
+            if self.cfg.lineRate:
+                await self._line_rate_loop(scorer)
+            else:
+                await self._interval_loop(scorer)
+        except asyncio.CancelledError:
+            pass
+
+    async def _maybe_lifecycle(self, last_cycle: float) -> float:
+        lc_cfg = self.cfg.lifecycle
+        if (self._lifecycle is not None and lc_cfg.checkpointEveryS > 0
+                and time.monotonic() - last_cycle
+                >= lc_cfg.checkpointEveryS):
+            last_cycle = time.monotonic()
+            await self.lifecycle_cycle()
+        return last_cycle
+
+    async def _interval_loop(self, scorer: Scorer) -> None:
+        """Legacy polling drain (lineRate: false): one burst per
+        intervalMs tick; rows arriving between ticks wait a full
+        interval."""
+        interval = self.cfg.intervalMs / 1e3
+        last_cycle = time.monotonic()
+        while not self._stop.is_set():
+            await asyncio.sleep(interval)
+            try:
+                await self._drain_burst(scorer)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — the drain loop must
+                # outlive any scoring failure; drain_once already
+                # downgraded scorer faults, so this is a last resort
+                log.exception("anomaly drain failed; continuing")
+            last_cycle = await self._maybe_lifecycle(last_cycle)
+
+    async def _line_rate_loop(self, scorer: Scorer) -> None:
+        """Adaptive micro-batcher (the default): dispatch when maxBatch
+        rows are pending OR the oldest pending row has lingered
+        ``maxLingerMs``. Up to ``scoreConcurrency`` batches stay in
+        flight so the staging ring double-buffers — host→device of
+        batch N overlaps device compute of batch N-1 — while the
+        recorder path stays O(1) (it only sets the wake event)."""
+        from linkerd_tpu.core.tasks import monitor
+        linger = max(self.cfg.maxLingerMs, 0.0) / 1e3
+        tick = max(linger / 4, 2e-4)
+        sem = asyncio.Semaphore(self.cfg.scoreConcurrency)
+        inflight: set = set()
         last_cycle = time.monotonic()
         try:
             while not self._stop.is_set():
-                await asyncio.sleep(interval)
-                try:
-                    await self._drain_burst(scorer)
-                except asyncio.CancelledError:
-                    raise
-                except Exception:  # noqa: BLE001 — the drain loop must
-                    # outlive any scoring failure; drain_once already
-                    # downgraded scorer faults, so this is a last resort
-                    log.exception("anomaly drain failed; continuing")
-                if (self._lifecycle is not None
-                        and lc_cfg.checkpointEveryS > 0
-                        and time.monotonic() - last_cycle
-                        >= lc_cfg.checkpointEveryS):
-                    last_cycle = time.monotonic()
-                    await self.lifecycle_cycle()
-        except asyncio.CancelledError:
-            pass
+                if not self._pending_rows():
+                    self._wake.clear()
+                    if not self._pending_rows():  # recheck: append raced
+                        # asyncio.wait, NOT wait_for: 3.10's wait_for
+                        # swallows a cancel() that lands on the same
+                        # tick the wake future completes, which would
+                        # leave this loop running forever after the
+                        # owner cancelled it
+                        waiter = asyncio.ensure_future(self._wake.wait())
+                        try:
+                            await asyncio.wait((waiter,), timeout=0.05)
+                        finally:
+                            waiter.cancel()
+                        if not self._pending_rows():
+                            last_cycle = await self._maybe_lifecycle(
+                                last_cycle)
+                            continue
+                # linger: give the batch up to maxLingerMs to fill
+                t0 = time.monotonic()
+                while (self._pending_rows() < self.cfg.maxBatch
+                       and time.monotonic() - t0 < linger
+                       and not self._stop.is_set()):
+                    await asyncio.sleep(tick)
+                batch = self._take_batch()
+                if batch is None:
+                    continue
+                await sem.acquire()
+                task = asyncio.create_task(
+                    self._score_and_publish(scorer, batch),
+                    name="anomaly-score-batch")
+                task.add_done_callback(lambda _t: sem.release())
+                inflight.add(task)
+                task.add_done_callback(inflight.discard)
+                monitor(task, what="anomaly-score-batch")
+                last_cycle = await self._maybe_lifecycle(last_cycle)
+        finally:
+            for t in list(inflight):
+                t.cancel()
+            if inflight:
+                await asyncio.gather(*inflight, return_exceptions=True)
+
+    def _pending_rows(self) -> int:
+        return len(self.ring) + len(self.native_ring)
 
     async def lifecycle_cycle(self) -> Optional[dict]:
         """One checkpoint/shadow-eval/promote-or-rollback pass (the
@@ -779,21 +1009,51 @@ class JaxAnomalyTelemeter(Telemeter):
     async def drain_once(self, scorer: Optional[Scorer] = None) -> int:
         """Drain one micro-batch through the scorer; returns rows scored."""
         scorer = scorer or self._ensure_scorer()
-        n = min(len(self.ring), self.cfg.maxBatch)
-        if n == 0:
+        batch = self._take_batch()
+        if batch is None:
             return 0
+        return await self._score_and_publish(scorer, batch)
+
+    def _take_batch(self) -> Optional[dict]:
+        """Assemble one micro-batch: Python-path ring items plus a
+        zero-copy block of native engine rows. Featurization happens
+        HERE, synchronously — the native block is a view into ring
+        memory that is only valid until the caller's next await."""
+        n_py = min(len(self.ring), self.cfg.maxBatch)
         # ring items are (fv, label[, trace, enqueued_at]) — external
         # producers (benchmarks, fault harnesses) still append 2-tuples
         items = [(it + (None, None, None))[:4]
-                 for it in (self.ring.popleft() for _ in range(n))]
+                 for it in (self.ring.popleft() for _ in range(n_py))]
+        nat_block = self.native_ring.consume(self.cfg.maxBatch - n_py)
+        k = len(nat_block)
+        if not items and k == 0:
+            return None
         fvs = [it[0] for it in items]
         labels = np.array(
-            [0.0 if it[1] is None else float(it[1]) for it in items],
-            dtype=np.float32)
+            [0.0 if it[1] is None else float(it[1]) for it in items]
+            + [0.0] * k, dtype=np.float32)
         mask = np.array(
-            [0.0 if it[1] is None else 1.0 for it in items],
-            dtype=np.float32)
-        x = featurize_batch(fvs)
+            [0.0 if it[1] is None else 1.0 for it in items]
+            + [0.0] * k, dtype=np.float32)
+        x_py = featurize_batch(fvs)
+        nat_inv: Optional[np.ndarray] = None
+        nat_dsts: List[str] = []
+        if k:
+            x_nat, nat_inv, nat_dsts = \
+                self._native_featurizer.encode_block(nat_block)
+            x = np.concatenate([x_py, x_nat]) if n_py else x_nat
+        else:
+            x = x_py
+        return {"items": items, "fvs": fvs, "x": x, "labels": labels,
+                "mask": mask, "n_py": n_py, "nat_inv": nat_inv,
+                "nat_dsts": nat_dsts}
+
+    async def _score_and_publish(self, scorer: Scorer, b: dict) -> int:
+        """Score one assembled batch and publish every downstream
+        effect: degraded-mode accounting, scorer spans, lifecycle
+        drift/holdout, per-dst board updates, training cadence."""
+        x, items, n_py = b["x"], b["items"], b["n_py"]
+        n = len(x)
         t_drain = time.monotonic()
         ts_us = int(time.time() * 1e6)
         try:
@@ -810,6 +1070,7 @@ class JaxAnomalyTelemeter(Telemeter):
                             "(scoring paused, data plane unaffected): %r", e)
             self._set_degraded(True)
             return 0
+        scores = np.asarray(scores)  # l5d: ignore[jax-hotpath] — scorers return host arrays (the drainer already did readback); this is a no-op view
         if self.board.degraded:
             log.info("anomaly scorer recovered; scoring resumed")
         self._set_degraded(False)
@@ -827,17 +1088,30 @@ class JaxAnomalyTelemeter(Telemeter):
             # (same rows AND same labels) could not catch a poisoned
             # training stream, because the poisoned candidate evaluates
             # best on its own poison
-            self._lifecycle.drift.observe(x, np.asarray(scores))
+            self._lifecycle.drift.observe(x, scores)
             holdout = self._batch_i % self.cfg.lifecycle.holdoutEveryBatches == 0
             if holdout:
-                self._lifecycle.replay.add_batch(x, labels, mask)
-        self.board.update_batch([fv.dst_path for fv in fvs], scores)
+                self._lifecycle.replay.add_batch(x, b["labels"], b["mask"])
+        self.board.update_batch([fv.dst_path for fv in b["fvs"]],
+                                scores[:n_py])
+        if b["nat_inv"] is not None and b["nat_dsts"]:
+            # native rows: per-ROUTE means, vectorized (update_batch
+            # averages per dst anyway, so feeding group means is
+            # equivalent to feeding every row)
+            inv = b["nat_inv"]
+            m = len(b["nat_dsts"])
+            sums = np.bincount(inv, weights=scores[n_py:], minlength=m)
+            counts = np.maximum(np.bincount(inv, minlength=m), 1)
+            self.board.update_batch(b["nat_dsts"], sums / counts)
         self._publish_gauges()
         self._batch_i += 1
         if (not holdout and self.cfg.trainEveryBatches
                 and self._batch_i % self.cfg.trainEveryBatches == 0):
             try:
-                loss = await scorer.fit(x, labels, mask)
+                # serialized: concurrent line-rate batches must not
+                # interleave their fit steps
+                async with self._fit_lock:
+                    loss = await scorer.fit(x, b["labels"], b["mask"])
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # noqa: BLE001 — training is optional;
@@ -943,6 +1217,11 @@ class JaxAnomalyTelemeter(Telemeter):
             "scorer": type(self._scorer).__name__
             if self._scorer is not None else None,
             "degraded": bool(self.board.degraded),
+            # "100% scored" is measured, not asserted
+            "requests_total": self._requests.value,
+            "scored_total": self._scored.value,
+            "scored_fraction": round(self._scored_fraction(), 6),
+            "line_rate": bool(self.cfg.lineRate),
         }
         breaker = getattr(self._scorer, "breaker", None)
         if breaker is not None:
@@ -950,6 +1229,9 @@ class JaxAnomalyTelemeter(Telemeter):
                 "state": breaker.state,
                 "next_probe_in_s": round(breaker.next_probe_in_s(), 3),
             }
+        tier_fn = getattr(self._scorer, "tier_state", None)
+        if tier_fn is not None:
+            out["tiers"] = tier_fn()
         if self._lifecycle is not None:
             out.update(self._lifecycle.status())
         return out

@@ -177,6 +177,9 @@ class ScorerSidecar:
     async def close(self) -> None:
         if self._server is not None:
             await self._server.stop(grace=0.5)
+        closer = getattr(self.scorer, "close", None)
+        if closer is not None:
+            closer()  # release the scorer's dispatch ring + drainer
 
 
 class GrpcScorerClient:

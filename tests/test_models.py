@@ -45,6 +45,31 @@ class TestFeatures:
         xs = featurize_batch([FeatureVector(), FeatureVector(status=500)])
         assert xs.shape == (2, FEATURE_DIM)
 
+    def test_batch_bit_identical_to_per_row(self):
+        """The vectorized batch encoder is an optimization of
+        ``featurize``, not a second schema: it must agree bit-for-bit
+        on every column, including edge values (negative sizes,
+        out-of-range statuses, signed drift)."""
+        rng = np.random.default_rng(7)
+        fvs = [FeatureVector(
+            latency_ms=float(rng.uniform(-5, 5000)),
+            status=int(rng.integers(0, 700)),
+            retries=int(rng.integers(0, 4)),
+            request_bytes=int(rng.integers(-10, 10**6)),
+            response_bytes=int(rng.integers(0, 10**6)),
+            concurrency=int(rng.integers(0, 100)),
+            ewma_ms=float(rng.uniform(0, 100)),
+            queue_ms=float(rng.uniform(-1, 10)),
+            exception=bool(rng.integers(0, 2)),
+            retryable=bool(rng.integers(0, 2)),
+            dst_path=f"/svc/s{int(rng.integers(0, 20))}",
+            dst_rps=float(rng.uniform(0, 10**4)),
+            lat_drift_ms=float(rng.uniform(-500, 500)),
+        ) for _ in range(256)]
+        batch = featurize_batch(fvs)
+        ref = np.stack([featurize(fv) for fv in fvs])
+        assert (batch == ref).all()
+
 
 class TestModel:
     def test_forward_shapes(self):

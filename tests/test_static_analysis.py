@@ -518,14 +518,105 @@ class TestMetricsScope:
         assert len(got) == 1 and got[0].suppressed
 
 
+class TestJaxHotpath:
+    """Per-call device seams reachable from the score dispatch path:
+    device_put / to_thread / asarray readback must not creep back into
+    the line-rate path (the 39.95 ms regression shape of BENCH_r04)."""
+
+    def test_device_put_and_to_thread_in_score_fire(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/telemetry/x.py": """
+                import asyncio
+                import jax
+
+                class Scorer:
+                    async def score(self, x):
+                        xd = jax.device_put(x, self.dev)
+                        return await asyncio.to_thread(self._run, xd)
+            """}, "jax-hotpath")
+        assert len(got) == 2
+        assert any("device_put" in f.message for f in got)
+        assert any("to_thread" in f.message for f in got)
+
+    def test_reachable_through_helper_fires(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/telemetry/x.py": """
+                import numpy as np
+
+                class Scorer:
+                    async def score(self, x):
+                        return self._readback(x)
+
+                    def _readback(self, r):
+                        return np.asarray(r)
+            """}, "jax-hotpath")
+        assert len(got) == 1 and "asarray" in got[0].message
+
+    def test_nested_step_closure_fires(self, tmp_path):
+        # closures handed to the dispatcher execute on the path
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/telemetry/x.py": """
+                import jax
+
+                class Scorer:
+                    async def score(self, x):
+                        def step(staging):
+                            return jax.device_put(staging, self.dev)
+                        return await self.dispatcher.dispatch(x, step)
+            """}, "jax-hotpath")
+        assert len(got) == 1 and "device_put" in got[0].message
+
+    def test_off_path_device_put_is_clean(self, tmp_path):
+        # placement during init/restore is not the dispatch path
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/telemetry/x.py": """
+                import jax
+
+                class Scorer:
+                    def restore(self, snap):
+                        self.params = jax.device_put(snap.params, self.dev)
+
+                    def _place_norm(self):
+                        self.mu_d = jax.device_put(self.mu, self.dev)
+            """}, "jax-hotpath")
+        assert got == []
+
+    def test_out_of_scope_package_is_ignored(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/lifecycle/x.py": """
+                import jax
+                async def score(x):
+                    return jax.device_put(x, None)
+            """}, "jax-hotpath")
+        assert got == []
+
+    def test_justified_suppression_suppresses(self, tmp_path):
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/telemetry/x.py": """
+                import numpy as np
+                async def score(x):
+                    return np.asarray(x, np.float32)  # l5d: ignore[jax-hotpath] — host dtype cast, not a readback
+            """}, "jax-hotpath")
+        assert len(got) == 1 and got[0].suppressed
+
+    def test_real_tree_dispatch_path_is_clean(self):
+        # the contract the rule exists to keep: the shipped score
+        # dispatch path has no unsuppressed per-call seams
+        out = run_analysis(["linkerd_tpu"], repo_root=REPO,
+                           rules=["jax-hotpath"])
+        unsuppressed = [f for f in out if not f.suppressed]
+        assert unsuppressed == [], "\n" + "\n".join(
+            f.show() for f in unsuppressed)
+
+
 class TestRepoGate:
     """The tier-1 gate: the suite itself over the real tree."""
 
     def test_rule_inventory(self):
         assert sorted(rule_ids()) == [
             "async-blocking", "config-registry", "float-time",
-            "jax-purity", "metrics-scope", "stream-release",
-            "swallowed-exception", "task-leak",
+            "jax-hotpath", "jax-purity", "metrics-scope",
+            "stream-release", "swallowed-exception", "task-leak",
         ]
 
     def test_repo_has_zero_unsuppressed_findings(self):

@@ -4,6 +4,7 @@ from tools.analysis.checkers import (  # noqa: F401 — registration imports
     async_blocking,
     config_registry,
     float_time,
+    jax_hotpath,
     jax_purity,
     metrics_scope,
     stream_release,

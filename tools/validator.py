@@ -43,6 +43,13 @@ after swapping the black hole for a live sidecar — scoring recovers
 
     python tools/validator.py chaos
 
+And the scorer-latency validation: boot the REAL linkerd binary with
+the line-rate in-process scorer, drive paced traffic, and assert the
+added p99 and the scored fraction (scored_total == requests_total)
+from the live metrics tree:
+
+    python tools/validator.py scorer-latency
+
 And the trace validation: boot the REAL linkerd binary with a
 two-router chain (edge -> inner over loopback) and a zipkin exporter
 pointed at a stub collector, drive one request, and assert the
@@ -81,6 +88,7 @@ PORTS = {
                "sidecar": 27321},
     "trace":  {"edge": 28140, "inner": 28141, "admin": 28990,
                "a": 28801, "collector": 28411},
+    "scorer": {"linkerd": 29140, "admin": 29990, "a": 29801},
 }
 
 IFACE_YAML = {
@@ -294,6 +302,7 @@ namers:
 telemetry:
 - kind: io.l5d.jaxAnomaly
   sidecarAddress: 127.0.0.1:{ports['sidecar']}
+  sidecarTier: primary  # the chaos scenario exercises the sidecar path
   intervalMs: 20
   trainEveryBatches: 0
   scoreTimeoutMs: 200
@@ -376,6 +385,127 @@ admin:
         if sidecar is not None:
             await sidecar.close()
         await hole.close()
+        d_a.close()
+
+
+async def validate_scorer_latency() -> None:
+    """Boot the REAL linkerd binary with the line-rate in-process
+    scorer, drive paced traffic, and assert from the LIVE metrics tree
+    that (a) 100% of requests are scored (scored fraction 1.0 once the
+    linger window drains) and (b) the proxy's added p99 stays bounded
+    with scoring inline. Prints one ``SCORER-LATENCY {json}`` line."""
+    ports = PORTS["scorer"]
+    work = tempfile.mkdtemp(prefix="l5d-validate-scorer-")
+    disco = os.path.join(work, "disco")
+    os.makedirs(disco)
+    d_a = await downstream("A", ports["a"])
+    with open(os.path.join(disco, "web"), "w") as f:
+        f.write(f"127.0.0.1 {ports['a']}\n")
+
+    linkerd_yaml = os.path.join(work, "linkerd.yaml")
+    with open(linkerd_yaml, "w") as f:
+        f.write(f"""
+routers:
+- protocol: http
+  label: scorer
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers:
+  - port: {ports['linkerd']}
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+telemetry:
+- kind: io.l5d.jaxAnomaly
+  maxBatch: 256
+  trainEveryBatches: 0
+admin:
+  port: {ports['admin']}
+""")
+
+    def anomaly_metrics() -> dict:
+        _, _, body = http(
+            "GET", f"http://127.0.0.1:{ports['admin']}"
+                   f"/admin/metrics.json?q=anomaly")
+        return json.loads(body)
+
+    def route_ok() -> bool:
+        st, _, body = http(
+            "GET", f"http://127.0.0.1:{ports['linkerd']}/",
+            headers={"Host": "web"})
+        return st == 200 and body == b"A"
+
+    def one_timed() -> float:
+        t0 = time.perf_counter()
+        st, _, _ = http(
+            "GET", f"http://127.0.0.1:{ports['linkerd']}/",
+            headers={"Host": "web"})
+        assert st == 200
+        return (time.perf_counter() - t0) * 1e3
+
+    def direct_timed() -> float:
+        t0 = time.perf_counter()
+        st, _, _ = http("GET", f"http://127.0.0.1:{ports['a']}/",
+                        headers={"Host": "web"})
+        assert st == 200
+        return (time.perf_counter() - t0) * 1e3
+
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    linkerd = None
+    try:
+        linkerd = subprocess.Popen(
+            [sys.executable, "-m", "linkerd_tpu", linkerd_yaml],
+            env=env, cwd=work)
+        await wait_for(route_ok, 30, "scorer-latency route up")
+        # warm: let the first batches compile off the measured window
+        for _ in range(30):
+            await asyncio.to_thread(one_timed)
+        await wait_for(
+            lambda: anomaly_metrics().get("anomaly/scored_total", 0) > 0,
+            30, "first scored batch")
+
+        n = 300
+        pace_s = 0.002  # ~500 rps paced
+        lats, direct = [], []
+        for i in range(n):
+            lats.append(await asyncio.to_thread(one_timed))
+            if i % 3 == 0:
+                direct.append(await asyncio.to_thread(direct_timed))
+            await asyncio.sleep(pace_s)
+        lats.sort()
+        direct.sort()
+        p99 = lats[int(0.99 * (len(lats) - 1))]
+        added_p99 = p99 - direct[len(direct) // 2]
+
+        # the linger window is ms-scale: every recorded request must be
+        # scored almost immediately after the pacing stops
+        await wait_for(
+            lambda: (lambda m: m.get("anomaly/requests_total", 0) > 0
+                     and m.get("anomaly/scored_total", 0)
+                     == m.get("anomaly/requests_total", -1))(
+                         anomaly_metrics()),
+            15, "scored fraction settling to 1.0")
+        m = anomaly_metrics()
+        frac = m["anomaly/scored_total"] / m["anomaly/requests_total"]
+        assert frac == 1.0, f"scored fraction {frac}"
+        assert added_p99 < 100.0, \
+            f"added p99 {added_p99:.1f}ms with inline scoring"
+        print("SCORER-LATENCY " + json.dumps({
+            "requests": int(m["anomaly/requests_total"]),
+            "scored": int(m["anomaly/scored_total"]),
+            "scored_fraction": frac,
+            "proxy_p50_ms": round(lats[len(lats) // 2], 3),
+            "proxy_p99_ms": round(p99, 3),
+            "added_p99_ms": round(added_p99, 3),
+            "paced_rps": round(1.0 / pace_s, 1),
+        }))
+    finally:
+        if linkerd is not None:
+            linkerd.send_signal(signal.SIGTERM)
+            try:
+                linkerd.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                linkerd.kill()
         d_a.close()
 
 
@@ -612,6 +742,10 @@ async def main() -> int:
     if args and args[0] == "trace":
         await validate_trace()
         print("VALIDATOR PASS (trace)")
+        return 0
+    if args and args[0] == "scorer-latency":
+        await validate_scorer_latency()
+        print("VALIDATOR PASS (scorer-latency)")
         return 0
     protocols = args or ["mesh", "thrift", "http"]
     for protocol in protocols:
