@@ -541,6 +541,150 @@ def fault_auc_bench() -> dict:
     return asyncio.run(bench(80))
 
 
+def control_loop_bench() -> dict:
+    """Reactive-control-loop actuation latency, in-process: a linker
+    bound through a real namerd (HTTP control API + watches) with the
+    jaxAnomaly ``control:`` block, scores driven by a stub scorer.
+    Reports anomaly-onset -> override-publish and -> first-SHIFTED-
+    request (the number that matters: how long a sick cluster keeps
+    receiving fleet traffic), plus revert latency after recovery."""
+    import asyncio
+    import tempfile
+
+    import numpy as np
+
+    from linkerd_tpu.core import Dtab, Path
+    from linkerd_tpu.linker import load_linker
+    from linkerd_tpu.namer.fs import FsNamer
+    from linkerd_tpu.namerd import InMemoryDtabStore, Namerd
+    from linkerd_tpu.namerd.http_api import HttpControlService
+    from linkerd_tpu.protocol.http import Request, Response
+    from linkerd_tpu.protocol.http.client import HttpClient
+    from linkerd_tpu.protocol.http.server import HttpServer, serve
+    from linkerd_tpu.router.service import FnService
+
+    class _LevelScorer:
+        def __init__(self):
+            self.level = 0.0
+
+        async def score(self, x):
+            return np.full(len(x), self.level, np.float32)
+
+        async def fit(self, x, labels, mask):
+            return 0.0
+
+        def close(self):
+            pass
+
+    async def drive() -> dict:
+        async def body_of(name):
+            async def h(req):
+                return Response(200, body=name)
+            return h
+
+        back_a = await serve(FnService(await body_of(b"a")))
+        back_b = await serve(FnService(await body_of(b"b")))
+        work = tempfile.mkdtemp(prefix="l5d-bench-control-")
+        with open(os.path.join(work, "web"), "w") as f:
+            f.write(f"127.0.0.1 {back_a.bound_port}\n")
+        with open(os.path.join(work, "web-b"), "w") as f:
+            f.write(f"127.0.0.1 {back_b.bound_port}\n")
+        namerd = Namerd(
+            InMemoryDtabStore(
+                {"default": Dtab.read("/svc => /#/io.l5d.fs ;")}),
+            namers=[(Path.read("/io.l5d.fs"), FsNamer(work))])
+        ctl_srv = await HttpServer(HttpControlService(namerd)).start()
+        edge = load_linker(f"""
+routers:
+- protocol: http
+  label: bench-ctl
+  servers: [{{port: 0}}]
+  interpreter:
+    kind: io.l5d.namerd.http
+    dst: /$/inet/127.0.0.1/{ctl_srv.bound_port}
+    namespace: default
+telemetry:
+- kind: io.l5d.jaxAnomaly
+  maxLingerMs: 1
+  trainEveryBatches: 0
+  scoreTtlSecs: 10
+  control:
+    intervalMs: 10
+    warmupBatches: 1
+    enterThreshold: 0.6
+    exitThreshold: 0.2
+    quorum: 2
+    cooldownS: 0.05
+    namespace: default
+    namerdAddress: 127.0.0.1:{ctl_srv.bound_port}
+    failover:
+      /svc/web: /svc/web-b
+""")
+        tele = edge.telemeters[0]
+        scorer = _LevelScorer()
+        tele._scorer = scorer
+        await edge.start()
+        drain = asyncio.ensure_future(tele.run())
+        proxy = HttpClient("127.0.0.1", edge.routers[0].server_ports[0])
+        flat = edge.metrics.flatten
+
+        async def one() -> bytes:
+            req = Request(uri="/")
+            req.headers.set("Host", "web")
+            return (await proxy(req)).body
+
+        async def until(pred, what, timeout=30.0):
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < timeout:
+                if await pred():
+                    return (time.perf_counter() - t0) * 1e3
+                await asyncio.sleep(0.005)
+            raise AssertionError(f"timed out: {what}")
+
+        try:
+            for _ in range(20):
+                assert await one() == b"a"
+            scorer.level = 0.9
+
+            async def published():
+                await one()
+                return flat().get(
+                    "control/reactor/overrides_published", 0) >= 1
+
+            publish_ms = await until(published, "override publish")
+
+            async def shifted():
+                return await one() == b"b"
+
+            shift_ms = publish_ms + await until(shifted, "traffic shift")
+            scorer.level = 0.0
+
+            async def reverted():
+                await one()
+                return flat().get(
+                    "control/reactor/overrides_reverted", 0) >= 1
+
+            revert_ms = await until(reverted, "override revert")
+            return {
+                "override_publish_ms": round(publish_ms, 1),
+                "anomaly_to_first_shifted_request_ms": round(shift_ms, 1),
+                "recovery_to_revert_ms": round(revert_ms, 1),
+                "flaps": int(flat().get(
+                    "control/reactor/overrides_published", 0)) - 1,
+            }
+        finally:
+            drain.cancel()
+            await asyncio.gather(drain, return_exceptions=True)
+            await proxy.close()
+            await edge.close()
+            await ctl_srv.close()
+            await namerd.close()
+            await back_a.close()
+            await back_b.close()
+
+    return asyncio.run(asyncio.wait_for(drive(), 120))
+
+
 def resilience_bench() -> dict:
     """Chaos validation wall time (``tools/validator.py chaos``): the
     assembled linker with a black-holed scorer sidecar must keep
@@ -678,6 +822,9 @@ def main() -> None:
     def ph_resilience() -> None:
         detail["resilience"] = resilience_bench()
 
+    def ph_control() -> None:
+        detail["control_loop"] = control_loop_bench()
+
     phases = [
         # fastest first: the headline line must exist on disk before
         # any phase that can wedge on the device tunnel gets a chance
@@ -693,6 +840,7 @@ def main() -> None:
         ("lifecycle", ph_lifecycle),
         ("observability", ph_observability),
         ("semantic_check", ph_semantic),
+        ("control_loop", ph_control),
         ("resilience", ph_resilience),
     ]
     emit()  # a hard kill mid-phase-1 must still leave a parsed line

@@ -1,0 +1,106 @@
+"""Adaptive admission control: shed earlier when trouble is coming.
+
+The static ``admissionControl`` bound (router/admission.py) protects the
+router from overload that has already arrived. The control loop narrows
+that bound *preemptively* when the anomaly signal says the mesh is
+degrading — the mesh-wide score level and the drift monitor's
+score-distribution shift both feed it — so the router sheds (with its
+retryable signal) before queues build behind a sick downstream, and
+widens back to the configured ceiling as the signal clears.
+
+The factor moves through an EWMA (never a step function) and the limit
+never drops below ``floor`` x the configured concurrency, so adaptive
+shedding can slow a router down but never wedge it shut.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+log = logging.getLogger(__name__)
+
+
+class AdaptiveAdmission:
+    """Modulates registered AdmissionControlFilters' effective
+    concurrency from the anomaly level and drift-monitor score shift.
+
+    ``step()`` is called by the ControlLoop each tick; it is pure
+    computation + ``set_limit`` calls (no awaits)."""
+
+    # score_shift is in reference-score sigmas; 3 sigma reads as a
+    # fully-drifted model (signal 1.0)
+    DRIFT_FULL_SIGMAS = 3.0
+
+    def __init__(self, board, drift=None, threshold: float = 0.5,
+                 floor: float = 0.25, alpha: float = 0.3,
+                 metrics_node=None):
+        if not 0.0 < floor <= 1.0:
+            raise ValueError("floor must be in (0, 1]")
+        if not 0.0 < threshold < 1.0:
+            raise ValueError("threshold must be in (0, 1)")
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError("alpha must be in (0, 1]")
+        self._board = board
+        self._drift = drift
+        self.threshold = threshold
+        self.floor = floor
+        self.alpha = alpha
+        self.factor = 1.0
+        self._filters: List = []
+        if metrics_node is not None:
+            self._factor_g = metrics_node.gauge("admission_factor")
+            self._factor_g.set(1.0)
+            metrics_node.gauge(
+                "admission_limit",
+                fn=lambda: float(sum(f.effective_concurrency
+                                     for f in self._filters)))
+        else:
+            self._factor_g = None
+
+    def register(self, admission_filter) -> None:
+        """Adopt a router's AdmissionControlFilter (the Linker calls
+        this during router assembly)."""
+        self._filters.append(admission_filter)
+        admission_filter.set_limit(
+            round(admission_filter.max_concurrency * self.factor))
+
+    def signal(self) -> float:
+        """The combined trouble signal in [0, 1]: max of the mesh-wide
+        anomaly level (staleness/degraded-aware) and the normalized
+        drift score shift."""
+        level = float(self._board.anomaly_level())
+        drift_sig = 0.0
+        if self._drift is not None:
+            drift_sig = min(
+                1.0, self._drift.score_shift() / self.DRIFT_FULL_SIGMAS)
+        return max(level, drift_sig)
+
+    def step(self) -> float:
+        sig = self.signal()
+        if sig <= self.threshold:
+            target = 1.0
+        else:
+            span = max(1e-6, 1.0 - self.threshold)
+            target = max(
+                self.floor,
+                1.0 - (1.0 - self.floor) * (sig - self.threshold) / span)
+        self.factor += self.alpha * (target - self.factor)
+        for f in self._filters:
+            f.set_limit(round(f.max_concurrency * self.factor))
+        if self._factor_g is not None:
+            self._factor_g.set(self.factor)
+        return self.factor
+
+    def status(self) -> dict:
+        return {
+            "signal": round(self.signal(), 4),
+            "factor": round(self.factor, 4),
+            "threshold": self.threshold,
+            "floor": self.floor,
+            "limits": [
+                {"max": f.max_concurrency,
+                 "effective": f.effective_concurrency}
+                for f in self._filters
+            ],
+        }

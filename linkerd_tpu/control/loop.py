@@ -1,0 +1,243 @@
+"""ControlConfig (the jaxAnomaly ``control:`` YAML block) + ControlLoop.
+
+One periodic driver owns all three actuators so their cadence, tracer,
+metrics subtree, and admin surface stay coherent:
+
+    telemetry:
+    - kind: io.l5d.jaxAnomaly
+      control:
+        intervalMs: 100
+        weightThreshold: 0.3        # balancer down-weighting ramp start
+        weightFloor: 0.05           # sick replicas keep a probe trickle
+        adaptiveAdmission: true
+        admissionThreshold: 0.5
+        admissionFloor: 0.25
+        namespace: default          # namerd ns the reactor shifts
+        namerdAddress: 127.0.0.1:4180   # its HTTP control API
+        failover:                   # sick cluster -> where to shift
+          /svc/web: /svc/web-b
+        enterThreshold: 0.7
+        exitThreshold: 0.3
+        quorum: 3
+        cooldownS: 2.0
+
+Omitting ``failover``/``namespace`` disables the reactor; setting
+``balancerWeighting``/``adaptiveAdmission`` false disables those
+actuators — each is independent, all share the metrics subtree
+(``control/*``) and ``/control.json``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class ControlConfig:
+    """YAML ``control:`` block of the io.l5d.jaxAnomaly telemeter."""
+
+    intervalMs: int = 100
+    # score-weighted balancing
+    balancerWeighting: bool = True
+    weightThreshold: float = 0.3
+    weightFloor: float = 0.05
+    # adaptive admission control
+    adaptiveAdmission: bool = True
+    admissionThreshold: float = 0.5
+    admissionFloor: float = 0.25
+    admissionAlpha: float = 0.3
+    # mesh reactor (anomaly-triggered dtab overrides); requires
+    # namespace + failover, and namerdAddress unless a store client is
+    # injected programmatically (embedded namerd, tests, bench)
+    namespace: Optional[str] = None
+    namerdAddress: Optional[str] = None
+    failover: Optional[Dict[str, str]] = None
+    enterThreshold: float = 0.7
+    exitThreshold: float = 0.3
+    quorum: int = 3
+    cooldownS: float = 2.0
+    verifyOverrides: bool = True
+    # bound on every reactor<->namerd store round-trip: a hung namerd
+    # costs one timed-out step, never a wedged control loop
+    storeTimeoutMs: int = 3000
+    # cold-start guard: a fresh linker's UNTRAINED scorer reads most
+    # traffic as anomalous (reconstruction error against random
+    # weights); no actuator may fire until this many batches have been
+    # scored (and, with online training on, learned from)
+    warmupBatches: int = 50
+
+    def mk(self, board, metrics, drift=None, namer_prefixes=None,
+           ready_fn=None) -> "ControlLoop":
+        return ControlLoop(self, board, metrics, drift=drift,
+                           namer_prefixes=namer_prefixes,
+                           ready_fn=ready_fn)
+
+
+class ControlLoop:
+    """Owns the actuators and drives them at ``intervalMs``. Built by
+    the jaxAnomaly telemeter at assembly; the Linker registers balancers
+    and admission filters into it while building routers; its ``run()``
+    task rides alongside the telemeter's drain loop."""
+
+    def __init__(self, cfg: ControlConfig, board, metrics, drift=None,
+                 namer_prefixes=None, ready_fn=None):
+        if cfg.intervalMs <= 0:
+            raise ValueError("control.intervalMs must be > 0")
+        if not 0.0 < cfg.weightFloor <= 1.0:
+            raise ValueError("control.weightFloor must be in (0, 1]")
+        if not 0.0 < cfg.weightThreshold < 1.0:
+            raise ValueError("control.weightThreshold must be in (0, 1)")
+        self.cfg = cfg
+        self.board = board
+        self.node = metrics.scope("control")
+        self._stop = asyncio.Event()
+        self._steps = self.node.counter("steps")
+        # cold-start guard (see ControlConfig.warmupBatches); no gate
+        # when unset (unit tests, boards fed out-of-band) or 0 batches
+        self._ready_fn = ready_fn
+        self._warmed = ready_fn is None or cfg.warmupBatches <= 0
+        self.node.gauge("warmed_up",
+                        fn=lambda: 1.0 if self._warmed else 0.0)
+        self.weigher = None
+        if cfg.balancerWeighting:
+            from linkerd_tpu.control.balancer import mk_weigher
+            base_weigher = mk_weigher(board, cfg.weightThreshold,
+                                      cfg.weightFloor)
+            # warmup-gated: untrained scores must not skew picks either
+            self.weigher = (lambda hostport:
+                            base_weigher(hostport) if self._warmed
+                            else 1.0)
+        self.admission = None
+        if cfg.adaptiveAdmission:
+            from linkerd_tpu.control.admission import AdaptiveAdmission
+            self.admission = AdaptiveAdmission(
+                board, drift=drift, threshold=cfg.admissionThreshold,
+                floor=cfg.admissionFloor, alpha=cfg.admissionAlpha,
+                metrics_node=self.node.scope("admission"))
+        self.reactor = None
+        self._reactor_prefixes = (list(namer_prefixes)
+                                  if namer_prefixes is not None else None)
+        if cfg.failover:
+            if not cfg.namespace:
+                raise ValueError(
+                    "control.failover requires control.namespace")
+            if cfg.namerdAddress:
+                from linkerd_tpu.control.reactor import (
+                    NamerdHttpStoreClient,
+                )
+                self._mk_reactor(NamerdHttpStoreClient(cfg.namerdAddress))
+            else:
+                # embedded namerd / tests must inject a store via
+                # set_store_client; until then the failover map is INERT
+                # — loud, or an operator typo silently disables shifting
+                log.warning(
+                    "control.failover configured without namerdAddress: "
+                    "the mesh reactor is DISABLED until a store client "
+                    "is injected (set_store_client)")
+        self._balancers: list = []
+
+    def _mk_reactor(self, client) -> None:
+        from linkerd_tpu.control.reactor import MeshReactor
+        from linkerd_tpu.control.state import HysteresisGovernor
+        cfg = self.cfg
+        self.reactor = MeshReactor(
+            self.board, client, cfg.namespace, cfg.failover or {},
+            governor=HysteresisGovernor(
+                enter=cfg.enterThreshold, exit=cfg.exitThreshold,
+                quorum=cfg.quorum, dwell_s=cfg.cooldownS),
+            metrics_node=self.node.scope("reactor"),
+            namer_prefixes=self._reactor_prefixes,
+            verify=cfg.verifyOverrides,
+            store_timeout_s=cfg.storeTimeoutMs / 1e3)
+
+    # -- assembly hooks (Linker) ------------------------------------------
+    def set_store_client(self, client) -> None:
+        """Install a reactor store client (embedded namerd / tests);
+        the YAML path builds one from ``namerdAddress`` instead."""
+        self._mk_reactor(client)
+
+    def set_namer_prefixes(self, prefixes) -> None:
+        """Configured-namer prefixes for override verification (the
+        Linker knows them only after building namers); None = unknown
+        (remote namerd owns the namers)."""
+        self._reactor_prefixes = (list(prefixes) if prefixes is not None
+                                  else None)
+        if self.reactor is not None:
+            self.reactor._namer_prefixes = self._reactor_prefixes
+
+    def register_admission(self, admission_filter) -> None:
+        if self.admission is not None:
+            self.admission.register(admission_filter)
+
+    def register_balancer(self, bal) -> None:
+        """Track a ScoreWeightedBalancer for /control.json weights."""
+        self._balancers.append(bal)
+
+    def set_tracer(self, tracer) -> None:
+        if self.reactor is not None:
+            self.reactor.set_tracer(tracer)
+
+    # -- the loop ----------------------------------------------------------
+    async def run(self) -> None:
+        interval = self.cfg.intervalMs / 1e3
+        try:
+            while not self._stop.is_set():
+                await self.step()
+                await asyncio.sleep(interval)
+        except asyncio.CancelledError:
+            pass
+
+    async def step(self) -> None:
+        """One control tick (also driven directly by tests/bench).
+        Until the scorer has warmed up, NO actuator fires — an
+        untrained model's scores are noise, and noise must not shift
+        fleet traffic."""
+        self._steps.incr()
+        if not self._warmed:
+            if not self._ready_fn():
+                return
+            self._warmed = True
+            log.info("control loop warmed up; actuators live")
+        if self.admission is not None:
+            self.admission.step()
+        if self.reactor is not None:
+            await self.reactor.step()
+
+    # -- observability -----------------------------------------------------
+    def status(self) -> dict:
+        out: dict = {
+            "interval_ms": self.cfg.intervalMs,
+            "steps": self._steps.value,
+            "warmed_up": self._warmed,
+            "actuators": {
+                "balancer_weighting": self.weigher is not None,
+                "adaptive_admission": self.admission is not None,
+                "mesh_reactor": self.reactor is not None,
+            },
+        }
+        if self.weigher is not None:
+            out["endpoint_scores"] = {
+                ep: round(s, 4) for ep, s in
+                self.board.effective_endpoint_scores().items()}
+            weights: Dict[str, float] = {}
+            for bal in self._balancers:
+                weights.update(bal.weights())
+            out["endpoint_weights"] = weights
+        if self.admission is not None:
+            out["admission"] = self.admission.status()
+        if self.reactor is not None:
+            out["reactor"] = self.reactor.status()
+        return out
+
+    def close(self) -> None:
+        self._stop.set()
+
+    async def aclose(self) -> None:
+        self.close()
+        if self.reactor is not None:
+            await self.reactor.aclose()

@@ -1,0 +1,350 @@
+"""MeshReactor: anomaly-triggered dtab overrides through namerd.
+
+When a whole cluster trends anomalous, per-replica down-weighting inside
+one linkerd is not enough — the *fleet* must shift. The reactor watches
+cluster-level score aggregates; past the hysteresis governor's guarded
+threshold it appends a traffic-shifting dentry (``/svc/web =>
+/svc/web-b``) to the namespace dtab and publishes it through the namerd
+store with compare-and-swap, so every linkerd watching that namespace
+re-binds away from the sick cluster. When scores recover (and the dwell
+has elapsed), the exact dentry is removed again.
+
+Safety properties:
+
+- **verified before published** — the candidate override runs through
+  l5dcheck's symbolic delegation (``override-unsafe``: cycles, unbound
+  or neg-only destinations, collateral shadowing of unrelated rules);
+  a bad override is rejected and counted, never published;
+- **CAS, never clobber** — publishes and reverts are version-checked
+  writes; a concurrent operator edit wins and the reactor retries
+  against the new version on its next step;
+- **flap-free** — all threshold logic lives in the shared
+  ``HysteresisGovernor`` (split thresholds + quorum + dwell);
+- **observable** — every actuation is a counter + a span
+  (``control.override`` with cluster/action/verify tags) and shows in
+  ``/control.json`` with its reason.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from linkerd_tpu.core import Dtab, Path
+from linkerd_tpu.core.dtab import Dentry
+from linkerd_tpu.namerd.store import (
+    DtabStore, DtabVersionMismatch, VersionedDtab,
+)
+from linkerd_tpu.control.state import SICK, HysteresisGovernor
+
+log = logging.getLogger(__name__)
+
+
+class OverrideRejected(Exception):
+    """The generated override failed l5dcheck verification; it was NOT
+    published."""
+
+
+class LocalStoreClient:
+    """Reactor store client over an in-process DtabStore (embedded
+    namerd, tests, bench)."""
+
+    def __init__(self, store: DtabStore):
+        self._store = store
+
+    async def fetch(self, ns: str) -> Optional[VersionedDtab]:
+        from linkerd_tpu.core.activity import Ok
+        act = self._store.observe(ns)
+        st = act.current
+        if isinstance(st, Ok):
+            return st.value
+        return await act.to_future()
+
+    async def cas(self, ns: str, dtab: Dtab, version: bytes) -> None:
+        await self._store.update(ns, dtab, version)
+
+    async def aclose(self) -> None:
+        return
+
+
+class NamerdHttpStoreClient:
+    """Reactor store client over namerd's HTTP control API
+    (``/api/1/dtabs/<ns>`` with ETag/If-Match CAS), for linkers whose
+    control plane is a remote namerd."""
+
+    def __init__(self, address: str):
+        host, _, port = address.partition(":")
+        self._host = host
+        self._port = int(port or 4180)
+        self._client = None
+
+    def _ensure_client(self):
+        if self._client is None:
+            from linkerd_tpu.protocol.http.client import HttpClient
+            self._client = HttpClient(self._host, self._port)
+        return self._client
+
+    async def fetch(self, ns: str) -> Optional[VersionedDtab]:
+        from linkerd_tpu.protocol.http.message import Request
+        rsp = await self._ensure_client()(
+            Request(method="GET", uri=f"/api/1/dtabs/{ns}"))
+        if rsp.status == 404:
+            return None
+        if rsp.status != 200:
+            raise RuntimeError(
+                f"namerd GET dtabs/{ns} failed: {rsp.status}")
+        etag = rsp.headers.get("etag")
+        if not etag:
+            # no version means no CAS: refusing is the only option that
+            # preserves the reactor's never-clobber guarantee
+            raise RuntimeError(
+                f"namerd GET dtabs/{ns} returned no ETag; refusing to "
+                f"write without compare-and-swap")
+        body = rsp.body or b""
+        import json
+        dentries = json.loads(body.decode())
+        dtab = Dtab.read(";".join(
+            f"{d['prefix']} => {d['dst']}" for d in dentries))
+        return VersionedDtab(dtab, bytes.fromhex(etag))
+
+    async def cas(self, ns: str, dtab: Dtab, version: bytes) -> None:
+        from linkerd_tpu.protocol.http.message import Request
+        req = Request(method="PUT", uri=f"/api/1/dtabs/{ns}",
+                      body=dtab.show.encode())
+        req.headers.set("Content-Type", "application/dtab")
+        req.headers.set("If-Match", version.hex())
+        rsp = await self._ensure_client()(req)
+        if rsp.status == 412:
+            raise DtabVersionMismatch(ns)
+        if rsp.status not in (200, 204):
+            raise RuntimeError(
+                f"namerd PUT dtabs/{ns} failed: {rsp.status}")
+
+    async def aclose(self) -> None:
+        if self._client is not None:
+            await self._client.close()
+
+
+def verify_override(base: Dtab, override: Dtab,
+                    namer_prefixes: Optional[Sequence[Path]]) -> List[str]:
+    """Run the l5dcheck ``override-unsafe`` analysis; returns the
+    messages of unsuppressed findings (empty = safe to publish)."""
+    from tools.analysis.semantic.dtab_check import check_override
+    return [f.message for f in
+            check_override(base, override, namer_prefixes)
+            if not f.suppressed]
+
+
+class MeshReactor:
+    """See module docstring. Drive with periodic ``step()`` calls (the
+    ControlLoop does); every step is serialized under one lock so an
+    actuate can never interleave with a revert of the same cluster."""
+
+    def __init__(self, board, client, namespace: str,
+                 failover: Dict[str, str],
+                 governor: Optional[HysteresisGovernor] = None,
+                 metrics_node=None,
+                 namer_prefixes: Optional[Sequence[Path]] = None,
+                 verify: bool = True,
+                 verifier: Optional[Callable] = None,
+                 store_timeout_s: float = 3.0):
+        for cluster, target in failover.items():
+            Path.read(cluster)  # raises on bad config up front
+            Path.read(target)
+        self._board = board
+        self._client = client
+        self._ns = namespace
+        self._failover = dict(failover)
+        self._governor = governor or HysteresisGovernor()
+        # None = unknown (remote namerd): verification skips
+        # namer-reachability, keeps cycle/shadow analysis
+        self._namer_prefixes = (list(namer_prefixes)
+                                if namer_prefixes is not None else None)
+        self._verify = verify
+        self._verifier = verifier or verify_override
+        # every store round-trip is bounded: a hung namerd must cost one
+        # timed-out step, not wedge the whole control loop (admission
+        # modulation shares the same driver) behind this lock forever
+        self._store_timeout_s = store_timeout_s
+        self._lock = asyncio.Lock()
+        self._tracer = None
+        # cluster -> the exact dentry this reactor appended (removed
+        # verbatim on revert; an operator's own edits are never touched)
+        self.active: Dict[str, Dentry] = {}
+        self.rejected: Dict[str, str] = {}  # cluster -> last reject reason
+        node = metrics_node
+        if node is not None:
+            self._published = node.counter("overrides_published")
+            self._reverted = node.counter("overrides_reverted")
+            self._rejected_c = node.counter("overrides_rejected")
+            self._adopted = node.counter("overrides_adopted")
+            self._conflicts = node.counter("cas_conflicts")
+            self._errors = node.counter("errors")
+            node.gauge("active_overrides",
+                       fn=lambda: float(len(self.active)))
+        else:
+            self._published = self._reverted = self._rejected_c = None
+            self._adopted = self._conflicts = self._errors = None
+
+    def set_tracer(self, tracer) -> None:
+        self._tracer = tracer
+
+    # -- level aggregation -------------------------------------------------
+    def cluster_levels(self) -> Dict[str, float]:
+        """Per-watched-cluster anomaly level: the max effective score of
+        the cluster path itself and anything under it. Degraded scorer
+        path reads 0 everywhere — no signal beats a stale signal, and
+        the governor's dwell keeps an active override from snapping
+        back the instant the scorer dies."""
+        if getattr(self._board, "degraded", False):
+            return {c: 0.0 for c in self._failover}
+        eff = self._board.effective_scores()
+        levels: Dict[str, float] = {}
+        for cluster in self._failover:
+            prefix = cluster.rstrip("/") + "/"
+            levels[cluster] = max(
+                (s for d, s in eff.items()
+                 if d == cluster or d.startswith(prefix)),
+                default=0.0)
+        return levels
+
+    # -- the loop body -----------------------------------------------------
+    async def step(self, now: Optional[float] = None) -> None:
+        """One evaluation pass: fold current levels into the governor
+        and reconcile the published overrides with its verdicts."""
+        async with self._lock:
+            levels = self.cluster_levels()
+            for cluster, target in self._failover.items():
+                state = self._governor.observe(
+                    cluster, levels.get(cluster, 0.0), now)
+                try:
+                    if state == SICK and cluster not in self.active:
+                        await self._actuate(cluster, target,
+                                            levels.get(cluster, 0.0))
+                    elif state != SICK and cluster in self.active:
+                        await self._revert(cluster,
+                                           levels.get(cluster, 0.0))
+                except DtabVersionMismatch:
+                    # a concurrent write won the CAS; re-fetch and retry
+                    # on the next step rather than looping hot here
+                    if self._conflicts is not None:
+                        self._conflicts.incr()
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — one cluster's
+                    # store trouble must not starve the others; the
+                    # governor state persists so the next step retries
+                    if self._errors is not None:
+                        self._errors.incr()
+                    log.warning("control reactor step failed for %s: %r",
+                                cluster, e)
+
+    async def _fetch(self) -> Optional[VersionedDtab]:
+        return await asyncio.wait_for(self._client.fetch(self._ns),
+                                      self._store_timeout_s)
+
+    async def _cas(self, dtab: Dtab, version: bytes) -> None:
+        await asyncio.wait_for(self._client.cas(self._ns, dtab, version),
+                               self._store_timeout_s)
+
+    async def _actuate(self, cluster: str, target: str,
+                       level: float) -> None:
+        vd = await self._fetch()
+        if vd is None:
+            raise RuntimeError(
+                f"dtab namespace {self._ns!r} does not exist")
+        override = Dtab.read(f"{cluster} => {target} ;")
+        if override[0] in vd.dtab:
+            # a fleet peer's reactor (same failover config) already
+            # published this exact dentry: ADOPT it instead of stacking
+            # a duplicate — reverts stay idempotent and the namespace
+            # never accumulates N copies from N linkerds
+            self.active[cluster] = override[0]
+            self.rejected.pop(cluster, None)
+            if self._adopted is not None:
+                self._adopted.incr()
+            log.info("control override ADOPTED (already published by a "
+                     "peer): %s => %s (ns=%s)", cluster, target, self._ns)
+            return
+        if self._verify:
+            problems = self._verifier(vd.dtab, override,
+                                      self._namer_prefixes)
+            if problems:
+                reason = problems[0]
+                first_time = self.rejected.get(cluster) != reason
+                self.rejected[cluster] = reason
+                if self._rejected_c is not None:
+                    self._rejected_c.incr()
+                if first_time:
+                    log.warning(
+                        "control override for %s REJECTED by l5dcheck "
+                        "(not published): %s", cluster, reason)
+                self._span("reject", cluster, target, level)
+                return
+        await self._cas(vd.dtab + override, vd.version)
+        self.active[cluster] = override[0]
+        self.rejected.pop(cluster, None)
+        if self._published is not None:
+            self._published.incr()
+        log.warning("control override PUBLISHED: %s => %s "
+                    "(ns=%s, level=%.3f)", cluster, target, self._ns, level)
+        self._span("publish", cluster, target, level)
+
+    async def _revert(self, cluster: str, level: float) -> None:
+        vd = await self._fetch()
+        dentry = self.active[cluster]
+        if vd is not None and dentry in vd.dtab:
+            pruned = Dtab(d for d in vd.dtab if d != dentry)
+            await self._cas(pruned, vd.version)
+        # the dentry may already be gone (operator removed it); either
+        # way this reactor no longer owns an override for the cluster
+        del self.active[cluster]
+        if self._reverted is not None:
+            self._reverted.incr()
+        log.warning("control override REVERTED: %s (ns=%s, level=%.3f)",
+                    cluster, self._ns, level)
+        self._span("revert", cluster, self._failover.get(cluster, ""),
+                   level)
+
+    def _span(self, action: str, cluster: str, target: str,
+              level: float) -> None:
+        if self._tracer is None:
+            return
+        from linkerd_tpu.router.tracing import TraceId
+        tid = TraceId.mk_root(True)
+        self._tracer.record({
+            "traceId": f"{tid.trace_id:032x}",
+            "id": f"{tid.span_id:016x}",
+            "parentId": None,
+            "kind": "PRODUCER",
+            "name": "control.override",
+            "timestamp": int(time.time() * 1e6),
+            "duration": 1,
+            "localEndpoint": {"serviceName": "control"},
+            "tags": {
+                "control.action": action,
+                "control.cluster": cluster,
+                "control.target": target,
+                "control.namespace": self._ns,
+                "control.level": f"{level:.3f}",
+                "control.verified": str(self._verify).lower(),
+            },
+        })
+
+    # -- observability -----------------------------------------------------
+    def status(self) -> dict:
+        return {
+            "namespace": self._ns,
+            "failover": dict(self._failover),
+            "levels": {c: round(v, 4)
+                       for c, v in self.cluster_levels().items()},
+            "governor": self._governor.snapshot(),
+            "active_overrides": {c: d.show
+                                 for c, d in self.active.items()},
+            "rejected": dict(self.rejected),
+        }
+
+    async def aclose(self) -> None:
+        await self._client.aclose()

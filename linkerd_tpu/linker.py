@@ -29,6 +29,7 @@ from linkerd_tpu.protocol.http.message import Request, Response
 from linkerd_tpu.protocol.http.server import HttpServer
 from linkerd_tpu.router.admission import AdmissionControlFilter
 from linkerd_tpu.router.balancer import mk_balancer
+from linkerd_tpu.router.classifiers import ClassifierFilter
 from linkerd_tpu.router.binding import DstBindingFactory, DstPath
 from linkerd_tpu.router.deadline import (
     ClientDeadlineFilter, DeadlineFilter, ServerDeadlineFilter,
@@ -473,6 +474,13 @@ class Linker:
 
         for tcfg in instantiate_list("telemeter", self.spec.telemetry, "telemetry"):
             self.telemeters.append(tcfg.mk(self.metrics))
+        # the control loop's reactor verifies generated overrides by
+        # symbolic delegation over THESE namers' prefixes; a linker with
+        # no local namers (remote namerd interpreter) passes None =
+        # unknown, which keeps cycle/shadow checks but not reachability
+        ctl = self._anomaly_control()
+        if ctl is not None:
+            ctl.set_namer_prefixes([p for p, _ in self.namers] or None)
         # broadcast tracer over all telemeter tracers (ref: Linker.scala:152-157)
         tracers = [t.tracer for t in self.telemeters if t.tracer is not None]
         self.tracer = BroadcastTracer(tracers) if tracers else NullTracer()
@@ -592,7 +600,8 @@ class Linker:
         from linkerd_tpu.protocol.h2.client import H2Client
         from linkerd_tpu.protocol.h2.server import H2Server
         from linkerd_tpu.router.h2_layer import (
-            H2ClassifiedRetries, H2ErrorResponder, H2StreamStatsFilter,
+            H2ClassifiedRetries, H2ClassifierFilter, H2ErrorResponder,
+            H2StreamStatsFilter,
         )
 
         if rspec.fastPath:
@@ -698,7 +707,8 @@ class Linker:
                                              mk_policy())
 
             bal_kind = (cspec.loadBalancer or BalancerSpec()).kind
-            bal = mk_balancer(bal_kind, bound.addr, endpoint_factory)
+            bal = self._mk_balancer(bal_kind, bound.addr,
+                                    endpoint_factory)
             filters: List[Any] = [
                 H2StreamStatsFilter(metrics, "rt", label, "client", cid),
                 ClientDeadlineFilter()]
@@ -731,6 +741,9 @@ class Linker:
                 budget_spec.percentCanRetry)
             name = dst.path.show.lstrip("/").replace("/", ".") or "root"
             filters: List[Any] = [
+                # outermost: stamp l5d-success-class from the class the
+                # retries filter recorded for the returned stream
+                H2ClassifierFilter(),
                 H2StreamStatsFilter(metrics, "rt", label, "service", name)]
             # deadline-aware total timeout (see the http twin)
             filters.append(DeadlineFilter(
@@ -878,7 +891,8 @@ class Linker:
                                              mk_policy())
 
             bal_kind = (cspec.loadBalancer or BalancerSpec()).kind
-            bal = mk_balancer(bal_kind, bound.addr, endpoint_factory)
+            bal = self._mk_balancer(bal_kind, bound.addr,
+                                    endpoint_factory)
             metrics.scope("rt", label, "client", cid).gauge(
                 "endpoints", fn=lambda b=bal: b.size)
             client_filters: List[Any] = [
@@ -1037,7 +1051,8 @@ class Linker:
                                              mk_policy())
 
             bal_kind = (cspec.loadBalancer or BalancerSpec()).kind
-            bal = mk_balancer(bal_kind, bound.addr, endpoint_factory)
+            bal = self._mk_balancer(bal_kind, bound.addr,
+                                    endpoint_factory)
             metrics.scope("rt", label, "client", cid).gauge(
                 "endpoints", fn=lambda b=bal: b.size)
             return _PruneOnClose(
@@ -1143,13 +1158,19 @@ class Linker:
         ac = rspec.admissionControl
         if ac is not None:
             try:
-                filters.append(AdmissionControlFilter(
+                admission = AdmissionControlFilter(
                     ac.maxConcurrency, ac.maxPending,
                     self.metrics.scope("rt", label, "server",
-                                       "admission")))
+                                       "admission"))
             except ValueError as e:
                 raise ConfigError(
                     f"{label}.admissionControl: {e}") from None
+            # the control loop modulates this bound from score trends +
+            # the drift monitor (shed earlier when trouble is coming)
+            ctl = self._anomaly_control()
+            if ctl is not None:
+                ctl.register_admission(admission)
+            filters.append(admission)
         return filters
 
     def _client_stack_extras(self, cspec: "ClientSpec", label: str,
@@ -1305,11 +1326,17 @@ class Linker:
                                              mk_policy())
 
             bal_kind = (cspec.loadBalancer or BalancerSpec()).kind
-            bal = mk_balancer(bal_kind, bound.addr, endpoint_factory)
-            from linkerd_tpu.protocol.http.filters import DstHeadersFilter
+            bal = self._mk_balancer(bal_kind, bound.addr,
+                                    endpoint_factory)
+            from linkerd_tpu.protocol.http.filters import (
+                DstHeadersFilter, RewriteHostHeader,
+            )
             filters: List[Any] = [
                 StatsFilter(metrics, "rt", label, "client", cid),
                 DstHeadersFilter(cid),
+                # Host from bound `authority` metadata (consul setHost),
+                # Location/Refresh reverse-rewritten; no-op without meta
+                RewriteHostHeader(bound.addr),
                 # re-encode the clamped deadline for the next hop
                 ClientDeadlineFilter(),
             ]
@@ -1351,6 +1378,10 @@ class Linker:
                 budget_spec.percentCanRetry)
             name = dst.path.show.lstrip("/").replace("/", ".") or "root"
             filters: List[Any] = [
+                # outermost: stamp l5d-success-class with the verdict on
+                # the response actually returned (post-retries) so an
+                # upstream linkerd can trust this router's classification
+                ClassifierFilter(classifier),
                 StatsFilter(metrics, "rt", label, "service", name)]
             # DeadlineFilter subsumes TotalTimeout: enforces
             # min(l5d-ctx-deadline, now + totalTimeoutMs), rejects
@@ -1473,6 +1504,25 @@ class Linker:
         from linkerd_tpu.telemetry.anomaly import ScoreBoard
         tele = self._anomaly_telemeter()
         return tele.board if tele is not None else ScoreBoard()
+
+    def _anomaly_control(self):
+        """The jaxAnomaly telemeter's ControlLoop (None unless a
+        ``control:`` block is configured)."""
+        tele = self._anomaly_telemeter()
+        return getattr(tele, "control", None) if tele is not None else None
+
+    def _mk_balancer(self, kind: str, addr, endpoint_factory):
+        """mk_balancer + the control loop's score weighting when
+        configured: replicas trending anomalous are multiplicatively
+        down-weighted inside the kind's own pick path, deprioritizing
+        BEFORE failure accrual would eject (control/balancer.py)."""
+        bal = mk_balancer(kind, addr, endpoint_factory)
+        ctl = self._anomaly_control()
+        if ctl is not None and ctl.weigher is not None:
+            from linkerd_tpu.control.balancer import ScoreWeightedBalancer
+            bal = ScoreWeightedBalancer(bal, ctl.weigher)
+            ctl.register_balancer(bal)
+        return bal
 
     # -- lifecycle --------------------------------------------------------
     async def start(self) -> "Linker":

@@ -24,7 +24,7 @@ from linkerd_tpu.protocol.h2.stream import (
     RST_REFUSED_STREAM, StreamReset, Trailers,
 )
 from linkerd_tpu.router.classifiers import (
-    IDEMPOTENT_METHODS, READ_METHODS, ResponseClass,
+    IDEMPOTENT_METHODS, READ_METHODS, SUCCESS_CLASS_HEADER, ResponseClass,
 )
 
 GRPC_STATUS = "grpc-status"
@@ -149,6 +149,60 @@ class H2AllSuccessful:
 
     def mk(self) -> H2Classifier:
         return _AllSuccessfulClassifier()
+
+
+class _SuccessClassClassifier(H2Classifier):
+    """Trust the downstream router's ``l5d-success-class`` response
+    header (stamped by its H2ClassifierFilter); defer to the wrapped
+    classifier when absent/garbled. A failure verdict keeps the
+    fallback's retryability analysis (h2 twin of
+    io.l5d.http.successClass; ref router/h2/.../ClassifierFilter.scala:23)."""
+
+    def __init__(self, inner: H2Classifier):
+        self._inner = inner
+
+    def _header_success(self, rsp: Optional[H2Response]) -> Optional[bool]:
+        if rsp is None:
+            return None
+        hdr = rsp.headers.get(SUCCESS_CLASS_HEADER)
+        if hdr is None:
+            return None
+        try:
+            return float(hdr) >= 0.5
+        except ValueError:
+            return None
+
+    def early(self, req, rsp):
+        success = self._header_success(rsp)
+        if success:
+            return ResponseClass.SUCCESS
+        if success is None:
+            return self._inner.early(req, rsp)
+        # downstream says failed: let classify() decide retryability
+        return None
+
+    def classify(self, req, rsp, trailers, exc):
+        success = self._header_success(rsp)
+        if success:
+            return ResponseClass.SUCCESS
+        rc = self._inner.classify(req, rsp, trailers, exc)
+        if success is False and not rc.is_failure:
+            return ResponseClass.FAILURE
+        return rc
+
+
+@register("h2classifier", "io.l5d.h2.successClass")
+@dataclass
+class H2SuccessClass:
+    """Trust a downstream linkerd's l5d-success-class verdict; fall back
+    to the wrapped kind when the header is absent."""
+
+    fallback: str = "io.l5d.h2.nonRetryable5XX"
+
+    def mk(self) -> H2Classifier:
+        from linkerd_tpu.config import lookup
+        return _SuccessClassClassifier(
+            lookup("h2classifier", self.fallback)().mk())
 
 
 class _GrpcClassifier(H2Classifier):

@@ -230,6 +230,87 @@ class ClearContextFilter(Filter[Request, Response]):
         return await service(req)
 
 
+def _authority_of(addr_state) -> Optional[str]:
+    """``authority`` metadata of a replica set: from the Bound's own
+    meta, else the first address carrying one (consul's SvcAddr.mkMeta
+    stamps every address identically)."""
+    from linkerd_tpu.core.addr import Bound
+    if not isinstance(addr_state, Bound):
+        return None
+    for k, v in addr_state.meta:
+        if k == "authority" and v:
+            return str(v)
+    for a in addr_state.addresses:
+        for k, v in a.meta:
+            if k == "authority" and v:
+                return str(v)
+    return None
+
+
+def _swap_url_authority(url: str, frm: str, to: str) -> Optional[str]:
+    """``url`` with its authority replaced when it names ``frm``
+    (case-insensitive host compare); None = leave untouched."""
+    parts = urlsplit(url)
+    if not parts.netloc or parts.netloc.lower() != frm.lower():
+        return None
+    rebuilt = f"{parts.scheme}://{to}" if parts.scheme else f"//{to}"
+    rebuilt += parts.path or ""
+    if parts.query:
+        rebuilt += f"?{parts.query}"
+    if parts.fragment:
+        rebuilt += f"#{parts.fragment}"
+    return rebuilt
+
+
+class RewriteHostHeader(Filter[Request, Response]):
+    """Rewrite the request Host from the bound replica set's
+    ``authority`` metadata — what consul's ``setHost`` (SvcAddr.mkMeta)
+    produces — and reverse-rewrite ``Location``/``Refresh`` response
+    headers that name the rewritten authority back to the caller's
+    original Host, so redirects keep pointing at the virtual host the
+    caller used. Ref: linkerd/protocol/http/.../RewriteHostHeader.scala:8-40.
+
+    Installed in every http client stack; a bound name with no authority
+    metadata (fs, k8s, ...) is a per-request no-op. The authority is
+    derived once per replica-set update (cached on the sampled Addr's
+    identity — Bound states are immutable between Var updates), not by
+    scanning every address's metadata on every request."""
+
+    def __init__(self, addr_var):
+        self._addr = addr_var
+        self._cached_state: Optional[object] = None
+        self._cached_authority: Optional[str] = None
+
+    def _authority(self) -> Optional[str]:
+        state = self._addr.sample()
+        if state is not self._cached_state:
+            self._cached_authority = _authority_of(state)
+            self._cached_state = state
+        return self._cached_authority
+
+    async def apply(self, req: Request, service: Service) -> Response:
+        authority = self._authority()
+        if not authority:
+            return await service(req)
+        original = req.headers.get("host")
+        req.headers.set("Host", authority)
+        rsp = await service(req)
+        if original and original.lower() != authority.lower():
+            loc = rsp.headers.get("location")
+            if loc:
+                swapped = _swap_url_authority(loc, authority, original)
+                if swapped is not None:
+                    rsp.headers.set("Location", swapped)
+            refresh = rsp.headers.get("refresh")
+            if refresh and "url=" in refresh.lower():
+                head, _, url = refresh.partition("=")
+                swapped = _swap_url_authority(url.strip(), authority,
+                                              original)
+                if swapped is not None:
+                    rsp.headers.set("Refresh", f"{head}={swapped}")
+        return rsp
+
+
 class DstHeadersFilter(Filter[Request, Response]):
     """Client-side ``l5d-dst-*`` headers telling the next hop how this
     request was routed (ref: LinkerdHeaders.Dst, LinkerdHeaders.scala)."""

@@ -8,12 +8,20 @@ shed signal so edge routers re-dispatch safely: http sheds surface as
 503 + ``l5d-retryable: true`` (ErrorResponder), h2/gRPC sheds surface as
 ``RST_STREAM REFUSED_STREAM`` (H2ErrorResponder), which clients treat as
 safe-to-retry because the request was never admitted.
+
+The concurrency bound is dynamic: ``set_limit`` narrows the effective
+limit below the configured ceiling (and back), which is how the control
+loop's AdaptiveAdmission (control/admission.py) sheds preemptively when
+anomaly scores or model drift say trouble is coming. The queue is FIFO
+and admission is strict: while anyone waits, new arrivals queue behind
+them rather than stealing freed slots.
 """
 
 from __future__ import annotations
 
 import asyncio
-from typing import Optional
+import collections
+from typing import Deque, Optional
 
 from linkerd_tpu.router.service import Filter, Service
 from linkerd_tpu.router.stages import staged
@@ -25,7 +33,8 @@ class OverloadShed(Exception):
 
 
 class AdmissionControlFilter(Filter):
-    """At most ``max_concurrency`` requests dispatch concurrently; up to
+    """At most ``effective_concurrency`` requests dispatch concurrently
+    (<= the configured ``max_concurrency`` ceiling); up to
     ``max_pending`` more may queue for a slot; beyond that the request
     is shed with OverloadShed. One instance per router (the bound is a
     router property, shared across its servers)."""
@@ -38,35 +47,66 @@ class AdmissionControlFilter(Filter):
             raise ValueError("max_pending must be >= 0")
         self.max_concurrency = max_concurrency
         self.max_pending = max_pending
-        self._sem = asyncio.Semaphore(max_concurrency)
+        self._limit = max_concurrency
         self._inflight = 0
         self._pending = 0
+        self._waiters: Deque[asyncio.Future] = collections.deque()
         if metrics_node is not None:
             self._shed = metrics_node.counter("shed_total")
             metrics_node.gauge("inflight", fn=lambda: float(self._inflight))
             metrics_node.gauge("pending", fn=lambda: float(self._pending))
+            metrics_node.gauge("limit", fn=lambda: float(self._limit))
         else:
             self._shed = None
 
+    @property
+    def effective_concurrency(self) -> int:
+        return self._limit
+
+    def set_limit(self, limit: int) -> None:
+        """Narrow (or re-widen) the live concurrency bound, clamped to
+        [1, max_concurrency]. Widening admits queued waiters
+        immediately; narrowing never cancels in-flight work — the bound
+        tightens as requests complete."""
+        self._limit = max(1, min(int(limit), self.max_concurrency))
+        self._admit_waiters()
+
+    def _admit_waiters(self) -> None:
+        while self._waiters and self._inflight < self._limit:
+            fut = self._waiters.popleft()
+            if fut.done():
+                continue  # cancelled while queued
+            self._inflight += 1
+            fut.set_result(None)
+
     async def apply(self, req, service: Service):
-        if self._sem.locked():
-            if self._pending >= self.max_pending:
-                if self._shed is not None:
-                    self._shed.incr()
-                raise OverloadShed(
-                    f"admission control: {self.max_concurrency} in flight "
-                    f"+ {self.max_pending} pending; shedding")
+        if self._inflight < self._limit and not self._waiters:
+            self._inflight += 1
+        elif self._pending >= self.max_pending:
+            if self._shed is not None:
+                self._shed.incr()
+            raise OverloadShed(
+                f"admission control: {self._limit} in flight "
+                f"+ {self.max_pending} pending; shedding")
+        else:
+            loop = asyncio.get_running_loop()
+            fut = loop.create_future()
+            self._waiters.append(fut)
             self._pending += 1
             try:
                 with staged(req, "queue"):
-                    await self._sem.acquire()
+                    await fut
+            except asyncio.CancelledError:
+                if fut.done() and not fut.cancelled():
+                    # admitted on the same tick the caller cancelled:
+                    # hand the slot to the next waiter
+                    self._inflight -= 1
+                    self._admit_waiters()
+                raise
             finally:
                 self._pending -= 1
-        else:
-            await self._sem.acquire()
-        self._inflight += 1
         try:
             return await service(req)
         finally:
             self._inflight -= 1
-            self._sem.release()
+            self._admit_waiters()

@@ -39,7 +39,8 @@ class NoBrokersAvailable(Exception):
 class Endpoint:
     """One concrete replica: the endpoint service + load accounting."""
 
-    __slots__ = ("address", "service", "pending", "ewma_ms", "_decay")
+    __slots__ = ("address", "service", "pending", "ewma_ms", "_decay",
+                 "weight_factor")
 
     def __init__(self, address: Address, service: Service):
         self.address = address
@@ -47,10 +48,15 @@ class Endpoint:
         self.pending = 0
         self.ewma_ms = 0.0  # peak-EWMA latency estimate
         self._decay = 0.1
+        # multiplicative anomaly down-weight in (0, 1], refreshed from
+        # the control loop's weigher (control/balancer.py); 1.0 =
+        # healthy / no control loop configured
+        self.weight_factor = 1.0
 
     @property
     def weight(self) -> float:
-        return self.address.weight if self.address.weight > 0 else 1e-6
+        base = self.address.weight if self.address.weight > 0 else 1e-6
+        return base * self.weight_factor
 
     @property
     def load(self) -> float:
@@ -72,6 +78,11 @@ class Endpoint:
 class Balancer(Service):
     """Base: maintains the endpoint set from a Var[Addr]."""
 
+    # weigher refresh throttle / rejection-sampling redraw bound (see
+    # _score_pick)
+    WEIGHT_REFRESH_S = 0.05
+    SCORE_REPICKS = 3
+
     def __init__(self, addr: Var[Addr],
                  endpoint_factory: Callable[[Address], Service],
                  rng: Optional[random.Random] = None):
@@ -81,6 +92,10 @@ class Balancer(Service):
         self._rng = rng or random.Random()
         self._closed = False
         self._to_close: List[Service] = []
+        # score weigher hook: hostport -> factor in (0, 1], installed by
+        # control/balancer.ScoreWeightedBalancer; None = no weighting
+        self.weigher: Optional[Callable[[str], float]] = None
+        self._weights_at = 0.0
         self._obs = addr.observe(self._on_addr)
 
     # -- replica-set maintenance -----------------------------------------
@@ -133,6 +148,44 @@ class Balancer(Service):
     def pick(self) -> Endpoint:
         raise NotImplementedError
 
+    # -- score weighting (the control loop's balancer actuator) -----------
+    def refresh_weights(self, force: bool = False) -> None:
+        """Refresh every endpoint's anomaly weight factor from the
+        installed weigher, throttled so the per-dispatch cost is an
+        occasional dict walk, not a per-request one."""
+        if self.weigher is None:
+            return
+        now = time.monotonic()
+        if not force and now - self._weights_at < self.WEIGHT_REFRESH_S:
+            return
+        self._weights_at = now
+        for ep in self._endpoints.values():
+            ep.weight_factor = self.weigher(ep.address.hostport)
+
+    def _score_pick(self) -> Endpoint:
+        """The kind's own ``pick`` with anomaly rejection sampling
+        layered on: a picked endpoint is accepted with probability equal
+        to its weight factor, redrawn otherwise (bounded). Healthy
+        endpoints (factor 1.0) pass untouched; a sick one keeps a
+        ``floor``-sized trickle via the acceptance probability. The
+        factor ALSO scales ``Endpoint.weight``, so the load formulas
+        (pending/weight, peak-EWMA) steer loaded traffic the same way —
+        rejection sampling is what makes the shift visible at idle,
+        where every load formula ties at zero."""
+        if self.weigher is None:
+            return self.pick()
+        self.refresh_weights()
+        best: Optional[Endpoint] = None
+        best_f = -1.0
+        for _ in range(1 + self.SCORE_REPICKS):
+            ep = self.pick()
+            f = ep.weight_factor
+            if f >= 1.0 or self._rng.random() < f:
+                return ep
+            if f > best_f:
+                best, best_f = ep, f
+        return best if best is not None else self.pick()
+
     # How long a request queues while the replica set is still Pending
     # (finagle balancers queue on Addr.Pending rather than failing —
     # matters on first dispatch through a freshly-opened resolver watch).
@@ -160,7 +213,17 @@ class Balancer(Service):
             await self._reap()
         await self._await_nonpending()
         self._check_addr()
-        ep = self.pick()
+        ep = self._score_pick()
+        # the chosen replica rides the request ctx so the anomaly
+        # pipeline can score per-endpoint (FeatureRecorder reads it) —
+        # which is what feeds the weigher back. FIRST pick wins: a
+        # retry re-enters here after the first endpoint failed, and the
+        # request's degraded features (aggregate latency, retries>0)
+        # must blame the replica that caused them, not the healthy one
+        # that served the retry.
+        ctx = getattr(req, "ctx", None)
+        if ctx is not None and "endpoint" not in ctx:
+            ctx["endpoint"] = ep.address.hostport
         ep.pending += 1
         t0 = time.monotonic()
         try:

@@ -14,6 +14,7 @@ from typing import Callable, Optional
 
 from linkerd_tpu.config import register
 from linkerd_tpu.protocol.http.message import Request, Response
+from linkerd_tpu.router.service import Filter, Service
 
 
 class ResponseClass(enum.Enum):
@@ -38,6 +39,33 @@ IDEMPOTENT_METHODS = frozenset({"GET", "HEAD", "OPTIONS", "TRACE", "PUT", "DELET
 READ_METHODS = frozenset({"GET", "HEAD", "OPTIONS", "TRACE"})
 
 RETRYABLE_HEADER = "l5d-retryable"  # ref: HeaderRetryable / ClassifierFilter
+SUCCESS_CLASS_HEADER = "l5d-success-class"  # ref: ClassifierFilter.scala:31
+
+
+class ClassifierFilter(Filter[Request, Response]):
+    """Stamp this router's response classification onto the response as
+    ``l5d-success-class`` (1.0 success / 0.0 failure) so an UPSTREAM
+    linkerd can trust the verdict of the router closest to the server —
+    which sees app-level semantics (classifier config, grpc-status,
+    retry outcomes) the edge can't reconstruct from the status line.
+    Ref: router/http/.../ClassifierFilter.scala:33; the edge trusts it
+    via the ``io.l5d.http.successClass`` classifier kind.
+
+    Prefers the class recorded in ctx by ClassifiedRetries (the verdict
+    on the response actually returned, after retries); falls back to
+    classifying directly when no retry filter ran."""
+
+    def __init__(self, classifier: Classifier):
+        self._classifier = classifier
+
+    async def apply(self, req: Request, service: Service) -> Response:
+        rsp = await service(req)
+        rc = req.ctx.get("response_class")
+        if rc is None:
+            rc = self._classifier(req, rsp, None)
+        rsp.headers.set(SUCCESS_CLASS_HEADER,
+                        "0.0" if rc.is_failure else "1.0")
+        return rsp
 
 
 def _status_class(req: Request, rsp: Optional[Response],
@@ -104,6 +132,39 @@ class AllSuccessful:
             if exc is not None:
                 return ResponseClass.FAILURE
             return ResponseClass.SUCCESS
+
+        return classify
+
+
+@register("classifier", "io.l5d.http.successClass")
+@dataclass
+class SuccessClassHeader:
+    """Trust a downstream linkerd's ``l5d-success-class`` header
+    (stamped by its ClassifierFilter): >= 0.5 is success regardless of
+    status; < 0.5 is a failure whose retryability the fallback decides
+    (the status-based analysis still knows idempotency). Without the
+    header, the fallback classifies alone — a chain ending at a
+    non-linkerd backend degrades to reference behavior."""
+
+    fallback: str = "io.l5d.http.nonRetryable5XX"
+
+    def mk(self) -> Classifier:
+        from linkerd_tpu.config import lookup
+        inner = lookup("classifier", self.fallback)().mk()
+
+        def classify(req, rsp, exc):
+            if rsp is not None:
+                hdr = rsp.headers.get(SUCCESS_CLASS_HEADER)
+                if hdr is not None:
+                    try:
+                        success = float(hdr) >= 0.5
+                    except ValueError:
+                        return inner(req, rsp, exc)
+                    if success:
+                        return ResponseClass.SUCCESS
+                    rc = inner(req, rsp, exc)
+                    return rc if rc.is_failure else ResponseClass.FAILURE
+            return inner(req, rsp, exc)
 
         return classify
 

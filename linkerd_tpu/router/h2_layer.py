@@ -22,7 +22,9 @@ from linkerd_tpu.protocol.h2.stream import (
 from linkerd_tpu.router.admission import OverloadShed
 from linkerd_tpu.router.balancer import NoBrokersAvailable
 from linkerd_tpu.router.binding import BindingFailed, UnboundError
-from linkerd_tpu.router.classifiers import ResponseClass
+from linkerd_tpu.router.classifiers import (
+    SUCCESS_CLASS_HEADER, ResponseClass,
+)
 from linkerd_tpu.router.deadline import deadline_of
 from linkerd_tpu.router.retries import RetryBudget
 from linkerd_tpu.router.routing import IdentificationError
@@ -344,6 +346,29 @@ class H2ClassifiedRetries(Filter[H2Request, H2Response]):
             raise exc
         assert rsp is not None
         rsp.stream = replay
+        return rsp
+
+
+class H2ClassifierFilter(Filter[H2Request, H2Response]):
+    """Stamp this router's final response classification onto the
+    response headers as ``l5d-success-class`` (1.0/0.0) so an upstream
+    linkerd can trust it (via io.l5d.h2.successClass) instead of
+    re-deriving a weaker verdict from the status line — the h2 twin of
+    the http ClassifierFilter (ref: router/h2/.../ClassifierFilter.scala:23).
+
+    Sits OUTSIDE H2ClassifiedRetries in the path stack: by the time the
+    response passes here, the retries filter has recorded the verdict on
+    the stream it is actually returning in ``ctx['response_class']``
+    (early header-only classification, or the held final-frame one). A
+    stream whose classification forfeited (hold timeout) gets no stamp
+    — unknown must not masquerade as a verdict."""
+
+    async def apply(self, req: H2Request, service: Service) -> H2Response:
+        rsp = await service(req)
+        rc = req.ctx.get("response_class")
+        if rc is not None:
+            rsp.headers.set(SUCCESS_CLASS_HEADER,
+                            "0.0" if rc.is_failure else "1.0")
         return rsp
 
 

@@ -31,6 +31,7 @@ from typing import Callable, Deque, Dict, List, Optional, Tuple
 import numpy as np
 
 from linkerd_tpu.config import register
+from linkerd_tpu.control.loop import ControlConfig
 from linkerd_tpu.core import Var
 from linkerd_tpu.lifecycle import LifecycleConfig
 from linkerd_tpu.models.features import FEATURE_DIM, FeatureVector, featurize_batch
@@ -63,30 +64,58 @@ class ScoreBoard:
         self.scores: Var[dict] = Var({})
         self.degraded = False
         self._updated: Dict[str, float] = {}
+        # per-REPLICA scores keyed by endpoint hostport (the balancer
+        # stamps req.ctx["endpoint"] at pick time): the control loop's
+        # score-weighted balancer reads these; same EWMA + staleness
+        # machinery as the per-dst board
+        self._ep_scores: Dict[str, float] = {}
+        self._ep_updated: Dict[str, float] = {}
 
-    def update_batch(self, dsts: List[str], scores: np.ndarray) -> None:
+    def update_batch(self, dsts: List[str], scores: np.ndarray,
+                     endpoints: Optional[List[Optional[str]]] = None,
+                     ) -> None:
         now = time.monotonic()
         cur = dict(self.scores.sample())
         per_dst: Dict[str, List[float]] = {}
-        for dst, s in zip(dsts, scores):
+        per_ep: Dict[str, List[float]] = {}
+        for i, (dst, s) in enumerate(zip(dsts, scores)):
             per_dst.setdefault(dst, []).append(float(s))
+            if endpoints is not None and i < len(endpoints) \
+                    and endpoints[i]:
+                per_ep.setdefault(endpoints[i], []).append(float(s))
         for dst, vals in per_dst.items():
             mean = sum(vals) / len(vals)
             prev = cur.get(dst, mean)
             cur[dst] = prev + self.alpha * (mean - prev)
             self._updated[dst] = now
+        for ep, vals in per_ep.items():
+            mean = sum(vals) / len(vals)
+            prev = self._ep_scores.get(ep, mean)
+            self._ep_scores[ep] = prev + self.alpha * (mean - prev)
+            self._ep_updated[ep] = now
+        # endpoint keys churn with the replica set (hostports change on
+        # every deploy); fully-stale entries are dead replicas — prune,
+        # or the maps grow without bound on a long-running linker
+        if self.ttl_s is not None and per_ep:
+            dead = [ep for ep, upd in self._ep_updated.items()
+                    if now - upd > 2 * self.ttl_s]
+            for ep in dead:
+                self._ep_scores.pop(ep, None)
+                self._ep_updated.pop(ep, None)
         self.scores.update(cur)
 
-    def _staleness_factor(self, dst: str, now: float) -> float:
+    def _decay(self, updated: Optional[float], now: float) -> float:
         if self.ttl_s is None:
             return 1.0
-        updated = self._updated.get(dst)
         if updated is None:
             return 1.0  # pre-TTL boards (tests seed Var directly)
         age = now - updated
         if age <= self.ttl_s:
             return 1.0
         return max(0.0, 1.0 - (age - self.ttl_s) / self.ttl_s)
+
+    def _staleness_factor(self, dst: str, now: float) -> float:
+        return self._decay(self._updated.get(dst), now)
 
     def score_of(self, dst: str) -> float:
         raw = self.scores.sample().get(dst, 0.0)
@@ -97,6 +126,23 @@ class ScoreBoard:
         now = time.monotonic()
         return {dst: s * self._staleness_factor(dst, now)
                 for dst, s in self.scores.sample().items()}
+
+    def endpoint_score_of(self, hostport: str) -> float:
+        """Per-replica effective score: staleness-decayed, and neutral
+        while the scorer path is degraded (a dead scorer must not pin
+        a replica's down-weight)."""
+        if self.degraded:
+            return 0.0
+        raw = self._ep_scores.get(hostport, 0.0)
+        return raw * self._decay(self._ep_updated.get(hostport),
+                                 time.monotonic())
+
+    def effective_endpoint_scores(self) -> Dict[str, float]:
+        if self.degraded:
+            return {ep: 0.0 for ep in self._ep_scores}
+        now = time.monotonic()
+        return {ep: s * self._decay(self._ep_updated.get(ep), now)
+                for ep, s in self._ep_scores.items()}
 
     def anomaly_level(self) -> float:
         """Mesh-wide anomaly level: max effective score, 0 while the
@@ -176,8 +222,11 @@ class FeatureRecorder(Filter[Request, Response]):
                         label = None  # untrusted header; never fail a request
             # the request's trace context + enqueue instant ride along so
             # the micro-batcher can emit scorer spans as children of the
-            # originating request (ring wait = the span's queue annotation)
-            self.ring.append((fv, label, req.ctx.get("trace"), now))
+            # originating request (ring wait = the span's queue
+            # annotation); the balancer-picked endpoint rides too so the
+            # board can score per replica (the control loop's weigher)
+            self.ring.append((fv, label, req.ctx.get("trace"), now,
+                              req.ctx.get("endpoint")))
             if self._on_record is not None:
                 self._on_record()
 
@@ -681,6 +730,10 @@ class JaxAnomalyConfig:
     # model lifecycle: checkpointing, shadow-eval promotion gating, drift
     # detection, restart restore (see linkerd_tpu/lifecycle/)
     lifecycle: Optional["LifecycleConfig"] = None
+    # reactive control loop: score-weighted balancing, adaptive
+    # admission, anomaly-triggered namerd dtab overrides (see
+    # linkerd_tpu/control/)
+    control: Optional["ControlConfig"] = None
 
     def mk(self, metrics: MetricsTree) -> "JaxAnomalyTelemeter":
         return JaxAnomalyTelemeter(self, metrics)
@@ -759,6 +812,20 @@ class JaxAnomalyTelemeter(Telemeter):
                              fn=lambda: float(self._lifecycle.promotions))
             model_node.gauge("rollbacks",
                              fn=lambda: float(self._lifecycle.rollbacks))
+        # reactive control loop (score-weighted balancing / adaptive
+        # admission / mesh reactor); None when the block is absent. The
+        # Linker registers balancers + admission filters into it during
+        # router assembly and its run() task rides alongside ours.
+        self.control = None
+        if cfg.control is not None:
+            self.control = cfg.control.mk(
+                self.board, metrics,
+                drift=(self._lifecycle.drift
+                       if self._lifecycle is not None else None),
+                # cold-start guard: no actuation until the scorer has
+                # seen (and trained on) warmupBatches batches
+                ready_fn=lambda: (self._batches.value
+                                  >= self.cfg.control.warmupBatches))
 
     @property
     def lifecycle(self):
@@ -810,6 +877,8 @@ class JaxAnomalyTelemeter(Telemeter):
         switched on — SAMPLED, so the serving path stays on the
         donated ring."""
         self._span_sink = tracer
+        if self.control is not None and tracer is not None:
+            self.control.set_tracer(tracer)
         if self._scorer is not None and tracer is not None:
             self._enable_sampled_timing(self._scorer)
 
@@ -882,6 +951,12 @@ class JaxAnomalyTelemeter(Telemeter):
             except Exception:  # noqa: BLE001 — a bad store must not
                 log.exception("checkpoint bootstrap failed; "
                               "serving from fresh init")
+        control_task = None
+        if self.control is not None:
+            from linkerd_tpu.core.tasks import monitor
+            control_task = asyncio.create_task(
+                self.control.run(), name="control-loop")
+            monitor(control_task, what="control-loop")
         try:
             if self.cfg.lineRate:
                 await self._line_rate_loop(scorer)
@@ -889,6 +964,10 @@ class JaxAnomalyTelemeter(Telemeter):
                 await self._interval_loop(scorer)
         except asyncio.CancelledError:
             pass
+        finally:
+            if control_task is not None:
+                control_task.cancel()
+                await asyncio.gather(control_task, return_exceptions=True)
 
     async def _maybe_lifecycle(self, last_cycle: float) -> float:
         lc_cfg = self.cfg.lifecycle
@@ -1020,9 +1099,10 @@ class JaxAnomalyTelemeter(Telemeter):
         HERE, synchronously — the native block is a view into ring
         memory that is only valid until the caller's next await."""
         n_py = min(len(self.ring), self.cfg.maxBatch)
-        # ring items are (fv, label[, trace, enqueued_at]) — external
-        # producers (benchmarks, fault harnesses) still append 2-tuples
-        items = [(it + (None, None, None))[:4]
+        # ring items are (fv, label[, trace, enqueued_at, endpoint]) —
+        # external producers (benchmarks, fault harnesses) still append
+        # 2-tuples
+        items = [(it + (None, None, None, None))[:5]
                  for it in (self.ring.popleft() for _ in range(n_py))]
         nat_block = self.native_ring.consume(self.cfg.maxBatch - n_py)
         k = len(nat_block)
@@ -1093,7 +1173,8 @@ class JaxAnomalyTelemeter(Telemeter):
             if holdout:
                 self._lifecycle.replay.add_batch(x, b["labels"], b["mask"])
         self.board.update_batch([fv.dst_path for fv in b["fvs"]],
-                                scores[:n_py])
+                                scores[:n_py],
+                                endpoints=[it[4] for it in items])
         if b["nat_inv"] is not None and b["nat_dsts"]:
             # native rows: per-ROUTE means, vectorized (update_batch
             # averages per dst anyway, so feeding group means is
@@ -1205,8 +1286,14 @@ class JaxAnomalyTelemeter(Telemeter):
         async def model_json(req: Request) -> Response:
             return json_response(self.model_state())
 
-        return [("/anomaly.json", anomaly_json),
-                ("/model.json", model_json)]
+        handlers = [("/anomaly.json", anomaly_json),
+                    ("/model.json", model_json)]
+        if self.control is not None:
+            async def control_json(req: Request) -> Response:
+                return json_response(self.control.status())
+
+            handlers.append(("/control.json", control_json))
+        return handlers
 
     def model_state(self) -> dict:
         """Model-lifecycle state for /model.json: version, step, last
@@ -1238,6 +1325,8 @@ class JaxAnomalyTelemeter(Telemeter):
 
     def close(self) -> None:
         self._stop.set()
+        if self.control is not None:
+            self.control.close()
         if self._lifecycle is not None and self._scorer is not None:
             # best-effort shutdown snapshot (sync/in-process scorers
             # only): a router restart must not silently reset the model

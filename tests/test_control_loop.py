@@ -1,0 +1,1261 @@
+"""Reactive control loop: anomaly scores drive balancing, admission,
+and namerd traffic shifting (linkerd_tpu/control/).
+
+Chaos scenario matrix (ISSUE 8 acceptance):
+- sick-replica drain-before-ejection: a replica with degrading scores
+  receives measurably less traffic while still OPEN (no accrual kick);
+- sick-cluster shift + recovery revert: a two-router fleet + namerd —
+  the reactor publishes an l5dcheck-verified dtab override through the
+  namerd HTTP API, every router re-binds away, and the override is
+  reverted when scores recover;
+- retry-storm under shifted traffic: a burst through the shifted route
+  succeeds without flapping the override;
+- mixed-protocol fleet: the http and h2 routers share one control loop
+  and both shift;
+- flap-resistance: oscillating scores produce ZERO override flaps
+  (split thresholds + quorum + dwell);
+- a bad override (cycle / unbound / collateral shadowing) is REJECTED
+  by l5dcheck verification, never published.
+
+Plus: score-weighted pick distribution property test, adaptive
+admission, DeterministicScheduler interleavings for reactor
+actuate-vs-revert, and the parity-tail satellites (ClassifierFilter
+l5d-success-class trust across a two-linkerd chain; RewriteHostHeader
+consuming bound authority metadata).
+"""
+
+import asyncio
+import random
+import time
+
+import numpy as np
+import pytest
+
+from linkerd_tpu.control.admission import AdaptiveAdmission
+from linkerd_tpu.control.balancer import ScoreWeightedBalancer, mk_weigher
+from linkerd_tpu.control.reactor import LocalStoreClient, MeshReactor
+from linkerd_tpu.control.state import HEALTHY, SICK, HysteresisGovernor
+from linkerd_tpu.core import Dtab, Path, Var
+from linkerd_tpu.core.addr import Address, Bound
+from linkerd_tpu.linker import load_linker
+from linkerd_tpu.namer.fs import FsNamer
+from linkerd_tpu.namerd import InMemoryDtabStore, Namerd
+from linkerd_tpu.namerd.http_api import HttpControlService
+from linkerd_tpu.protocol.http import Request, Response
+from linkerd_tpu.protocol.http.client import HttpClient
+from linkerd_tpu.protocol.http.server import HttpServer, serve
+from linkerd_tpu.router.admission import AdmissionControlFilter
+from linkerd_tpu.router.balancer import P2CBalancer
+from linkerd_tpu.router.service import FnService
+from linkerd_tpu.telemetry.anomaly import ScoreBoard
+from linkerd_tpu.telemetry.metrics import MetricsTree
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 60))
+
+
+async def eventually(pred, timeout: float = 10.0, what: str = "",
+                     tick=None):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if tick is not None:
+            await tick()
+        if pred():
+            return
+        await asyncio.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+class _LevelScorer:
+    """Stub scorer: every row scores ``level`` (settable mid-test) —
+    lets the chaos tests drive the FULL pipeline (recorder -> batcher ->
+    scorer -> board -> reactor) without jax in the loop."""
+
+    def __init__(self, level: float = 0.0):
+        self.level = level
+        self.batches = 0
+
+    async def score(self, x):
+        self.batches += 1
+        return np.full(len(x), self.level, np.float32)
+
+    async def fit(self, x, labels, mask):
+        return 0.0
+
+    def close(self):
+        pass
+
+
+class _FakeBoard:
+    """Minimal board for reactor unit tests: one settable per-cluster
+    level."""
+
+    def __init__(self):
+        self.levels = {}
+        self.degraded = False
+
+    def effective_scores(self):
+        return dict(self.levels)
+
+    def anomaly_level(self):
+        return max(self.levels.values(), default=0.0)
+
+
+# ---- hysteresis ------------------------------------------------------------
+
+
+class TestHysteresisGovernor:
+    def test_oscillation_produces_zero_transitions(self):
+        g = HysteresisGovernor(enter=0.7, exit=0.3, quorum=3, dwell_s=0.0)
+        t = 0.0
+        for i in range(200):
+            # hop across BOTH thresholds every observation: no streak
+            # ever reaches quorum
+            level = 0.9 if i % 2 == 0 else 0.1
+            assert g.observe("k", level, now=t) == HEALTHY
+            t += 0.01
+        assert g.snapshot()["k"]["transitions"] == 0
+
+    def test_sustained_trip_and_clear_once_each(self):
+        g = HysteresisGovernor(enter=0.7, exit=0.3, quorum=2, dwell_s=1.0)
+        t = 10.0
+        assert g.observe("k", 0.9, now=t) == HEALTHY      # streak 1
+        assert g.observe("k", 0.9, now=t + 2.0) == SICK   # quorum + dwell
+        # mid-band levels change nothing in either state
+        assert g.observe("k", 0.5, now=t + 2.1) == SICK
+        # below exit but dwell not elapsed: stays SICK
+        assert g.observe("k", 0.1, now=t + 2.2) == SICK
+        assert g.observe("k", 0.1, now=t + 2.3) == SICK
+        # dwell elapsed + quorum met: clears exactly once
+        assert g.observe("k", 0.1, now=t + 3.3) == HEALTHY
+        assert g.observe("k", 0.1, now=t + 3.4) == HEALTHY
+        assert g.snapshot()["k"]["transitions"] == 2
+
+    def test_spike_resets_streak(self):
+        g = HysteresisGovernor(enter=0.7, exit=0.3, quorum=3, dwell_s=0.0)
+        t = 0.0
+        for level in (0.9, 0.9, 0.2, 0.9, 0.9):  # spike interrupted
+            state = g.observe("k", level, now=t)
+            t += 1.0
+        assert state == HEALTHY
+
+    def test_rejects_inverted_thresholds(self):
+        with pytest.raises(ValueError):
+            HysteresisGovernor(enter=0.3, exit=0.7)
+        with pytest.raises(ValueError):
+            HysteresisGovernor(quorum=0)
+
+
+# ---- score-weighted balancing ----------------------------------------------
+
+
+class TestScoreWeightedPick:
+    def _bal(self, weigher, n=3):
+        addrs = [Address.mk("127.0.0.1", 8000 + i) for i in range(n)]
+        bal = P2CBalancer(Var(Bound(frozenset(addrs))),
+                          lambda a: FnService(None),
+                          rng=random.Random(7))
+        return ScoreWeightedBalancer(bal, weigher), addrs
+
+    def test_weigher_ramp(self):
+        board = ScoreBoard(alpha=1.0, ttl_s=None)
+        board.update_batch(["/svc/web"] * 3,
+                           np.array([0.0, 0.5, 1.0], np.float32),
+                           endpoints=["a:1", "b:1", "c:1"])
+        w = mk_weigher(board, threshold=0.3, floor=0.05)
+        assert w("a:1") == 1.0           # healthy
+        assert 0.2 < w("b:1") < 0.9      # ramping
+        assert w("c:1") == pytest.approx(0.05)  # floor, never zero
+        assert w("unknown:1") == 1.0     # never-scored: neutral
+
+    def test_degraded_board_weighs_neutral(self):
+        board = ScoreBoard(alpha=1.0, ttl_s=None)
+        board.update_batch(["/svc/web"], np.array([0.95], np.float32),
+                           endpoints=["a:1"])
+        w = mk_weigher(board)
+        assert w("a:1") < 0.2
+        board.degraded = True  # scorer path died: weights go neutral
+        assert w("a:1") == 1.0
+
+    def test_pick_distribution_shifts_but_keeps_trickle(self):
+        """Property: with one sick replica of three, its pick share
+        drops well below fair (1/3) but stays nonzero (the probe
+        trickle), while the healthy pair splits the remainder evenly —
+        at ZERO load, where every load formula ties."""
+        sick = "127.0.0.1:8000"
+        factors = {sick: 0.05}
+        swb, addrs = self._bal(lambda hp: factors.get(hp, 1.0))
+        counts = {a.hostport: 0 for a in addrs}
+        swb._inner.refresh_weights(force=True)
+        for _ in range(3000):
+            counts[swb._inner._score_pick().address.hostport] += 1
+        total = sum(counts.values())
+        share = counts[sick] / total
+        assert 0.0 < share < 0.12, f"sick share {share:.3f}"
+        healthy = sorted(c for hp, c in counts.items() if hp != sick)
+        assert healthy[0] / healthy[1] > 0.7  # pair stays balanced
+
+    def test_weight_factor_scales_load_formula(self):
+        swb, addrs = self._bal(lambda hp: 0.1
+                               if hp == "127.0.0.1:8000" else 1.0)
+        swb._inner.refresh_weights(force=True)
+        ep = next(e for e in swb._inner._endpoints.values()
+                  if e.address.port == 8000)
+        assert ep.weight == pytest.approx(0.1)
+        assert swb.weights()["127.0.0.1:8000"] == pytest.approx(0.1)
+
+    def test_endpoint_scores_ride_staleness(self):
+        board = ScoreBoard(alpha=1.0, ttl_s=0.1)
+        board.update_batch(["/svc/web"], np.array([0.9], np.float32),
+                           endpoints=["a:1"])
+        assert board.endpoint_score_of("a:1") == pytest.approx(0.9)
+        board._ep_updated["a:1"] -= 0.5  # fully stale: neutral
+        assert board.endpoint_score_of("a:1") == 0.0
+
+    def test_dead_endpoint_entries_pruned(self):
+        """Replica churn (hostports change every deploy) must not grow
+        the endpoint maps forever: fully-stale entries are pruned on
+        the next update."""
+        board = ScoreBoard(alpha=1.0, ttl_s=0.1)
+        board.update_batch(["/svc/web"], np.array([0.9], np.float32),
+                           endpoints=["dead:1"])
+        board._ep_updated["dead:1"] -= 1.0  # > 2 * ttl old
+        board.update_batch(["/svc/web"], np.array([0.5], np.float32),
+                           endpoints=["live:1"])
+        assert "dead:1" not in board._ep_scores
+        assert "live:1" in board._ep_scores
+
+    def test_retry_blames_first_picked_endpoint(self):
+        """A retried request's degraded features must be attributed to
+        the FIRST picked (failing) replica, not the healthy one that
+        served the retry — first pick wins in req.ctx['endpoint']."""
+        async def go():
+            addrs = [Address.mk("127.0.0.1", 9001),
+                     Address.mk("127.0.0.1", 9002)]
+
+            class _Echo:
+                def __init__(self, addr):
+                    self.addr = addr
+
+                async def __call__(self, req):
+                    return Response(200)
+
+            bal = P2CBalancer(Var(Bound(frozenset(addrs))),
+                              lambda a: FnService(_Echo(a)),
+                              rng=random.Random(3))
+            req = Request(uri="/")
+            await bal(req)
+            first = req.ctx["endpoint"]
+            # a retry re-dispatches the same request object: the blame
+            # stamp must not be overwritten by the second pick
+            for _ in range(10):
+                await bal(req)
+            assert req.ctx["endpoint"] == first
+            await bal.close()
+
+        run(go())
+
+
+# ---- adaptive admission ----------------------------------------------------
+
+
+class TestAdaptiveAdmission:
+    def test_set_limit_narrows_and_rewidens(self):
+        async def go():
+            gate = asyncio.Event()
+
+            async def waiting(req):
+                await gate.wait()
+                return Response(200)
+
+            f = AdmissionControlFilter(4, max_pending=8)
+            svc = f.and_then(FnService(waiting))
+            f.set_limit(1)
+            assert f.effective_concurrency == 1
+            t1 = asyncio.ensure_future(svc(Request()))
+            await asyncio.sleep(0.02)
+            t2 = asyncio.ensure_future(svc(Request()))  # queues at limit 1
+            await asyncio.sleep(0.02)
+            assert f._inflight == 1 and f._pending == 1
+            f.set_limit(4)  # widening admits the queued waiter now
+            await asyncio.sleep(0.02)
+            assert f._inflight == 2 and f._pending == 0
+            gate.set()
+            for t in (t1, t2):
+                assert (await t).status == 200
+            # clamped to [1, max_concurrency]
+            f.set_limit(0)
+            assert f.effective_concurrency == 1
+            f.set_limit(99)
+            assert f.effective_concurrency == 4
+
+        run(go())
+
+    def test_factor_tracks_signal_with_floor(self):
+        board = _FakeBoard()
+        adm = AdaptiveAdmission(board, threshold=0.5, floor=0.25,
+                                alpha=1.0)
+        f = AdmissionControlFilter(100, max_pending=0)
+        adm.register(f)
+        board.levels["/svc/web"] = 0.4   # below threshold: full open
+        adm.step()
+        assert f.effective_concurrency == 100
+        board.levels["/svc/web"] = 1.0   # fully sick: floor, not zero
+        adm.step()
+        assert f.effective_concurrency == 25
+        board.levels["/svc/web"] = 0.0   # recovery re-widens
+        adm.step()
+        assert f.effective_concurrency == 100
+
+    def test_drift_shift_feeds_signal(self):
+        class _Drift:
+            def score_shift(self):
+                return 6.0  # sigmas >> DRIFT_FULL_SIGMAS
+
+        adm = AdaptiveAdmission(_FakeBoard(), drift=_Drift())
+        assert adm.signal() == 1.0
+
+
+# ---- override verification (l5dcheck override-unsafe) ----------------------
+
+
+class TestOverrideUnsafe:
+    PREFIXES = [Path.read("/io.l5d.fs")]
+    BASE = Dtab.read("/svc => /#/io.l5d.fs ;")
+
+    def _check(self, override, base=None, prefixes=PREFIXES):
+        from tools.analysis.semantic.dtab_check import check_override
+        return check_override(base if base is not None else self.BASE,
+                              Dtab.read(override), prefixes)
+
+    def test_good_override_is_clean(self):
+        assert self._check("/svc/web => /svc/web-b ;") == []
+
+    def test_self_shift_cycle_flagged(self):
+        out = self._check("/svc/web => /svc/web ;")
+        assert any("cycle" in f.message for f in out)
+
+    def test_unbound_target_flagged(self):
+        out = self._check("/svc/web => /#/io.l5d.nope/x ;")
+        assert any("unroutable" in f.message for f in out)
+
+    def test_wildcard_and_collateral_shadowing_flagged(self):
+        assert any("wildcard" in f.message
+                   for f in self._check("/svc/* => /svc/web-b ;"))
+        base = Dtab.read(
+            "/svc => /#/io.l5d.fs ; /svc/special => /#/io.l5d.fs/sp ;")
+        out = self._check("/svc => /svc/web-b ;", base=base)
+        assert any("shadows" in f.message for f in out)
+
+    def test_unknown_namers_keep_cycle_check_only(self):
+        # remote-namerd linker: /#/ targets assumed bindable...
+        assert self._check("/svc/web => /#/anything/x ;",
+                           prefixes=None) == []
+        # ...but cycles still cannot hide
+        out = self._check("/svc/web => /svc/web ;", prefixes=None)
+        assert any("cycle" in f.message for f in out)
+
+
+# ---- mesh reactor (unit) ---------------------------------------------------
+
+
+def _reactor(store, board, failover=None, quorum=1, dwell=0.0,
+             metrics=None, verify=True, prefixes=None):
+    node = (metrics or MetricsTree()).scope("control", "reactor")
+    return MeshReactor(
+        board, LocalStoreClient(store), "default",
+        failover or {"/svc/web": "/svc/web-b"},
+        governor=HysteresisGovernor(enter=0.6, exit=0.2, quorum=quorum,
+                                    dwell_s=dwell),
+        metrics_node=node,
+        namer_prefixes=(prefixes if prefixes is not None
+                        else [Path.read("/io.l5d.fs")]),
+        verify=verify)
+
+
+BASE_DTAB = "/svc => /#/io.l5d.fs ;"
+
+
+class TestMeshReactor:
+    def test_trip_publish_revert(self):
+        async def go():
+            store = InMemoryDtabStore({"default": Dtab.read(BASE_DTAB)})
+            board = _FakeBoard()
+            metrics = MetricsTree()
+            r = _reactor(store, board, metrics=metrics)
+            board.levels["/svc/web"] = 0.9
+            await r.step(now=1.0)
+            vd = await store.observe("default").to_future()
+            assert "/svc/web => /svc/web-b" in vd.dtab.show
+            assert "/svc/web" in r.active
+            # sick again: idempotent, no second publish
+            await r.step(now=2.0)
+            flat = metrics.flatten()
+            assert flat["control/reactor/overrides_published"] == 1
+            # recovery: the exact dentry is removed, base preserved
+            board.levels["/svc/web"] = 0.0
+            await r.step(now=3.0)
+            vd = await store.observe("default").to_future()
+            assert vd.dtab.show.strip() == Dtab.read(BASE_DTAB).show.strip()
+            assert r.active == {}
+            flat = metrics.flatten()
+            assert flat["control/reactor/overrides_reverted"] == 1
+
+        run(go())
+
+    def test_subcluster_scores_aggregate_to_cluster(self):
+        async def go():
+            store = InMemoryDtabStore({"default": Dtab.read(BASE_DTAB)})
+            board = _FakeBoard()
+            r = _reactor(store, board)
+            board.levels["/svc/web/v2"] = 0.95  # child path of the cluster
+            assert r.cluster_levels()["/svc/web"] == 0.95
+            board.levels = {"/svc/webstore": 0.95}  # NOT under /svc/web
+            assert r.cluster_levels()["/svc/web"] == 0.0
+
+        run(go())
+
+    def test_bad_override_rejected_not_published(self):
+        async def go():
+            store = InMemoryDtabStore({"default": Dtab.read(BASE_DTAB)})
+            board = _FakeBoard()
+            metrics = MetricsTree()
+            # failover target reaches no configured namer: l5dcheck
+            # must reject the generated override pre-publish
+            r = _reactor(store, board,
+                         failover={"/svc/web": "/#/io.l5d.nope/x"},
+                         metrics=metrics)
+            board.levels["/svc/web"] = 0.9
+            before = (await store.observe("default").to_future()).dtab.show
+            await r.step(now=1.0)
+            after = (await store.observe("default").to_future()).dtab.show
+            assert after == before, "rejected override was published!"
+            assert r.active == {}
+            assert "unroutable" in r.rejected["/svc/web"]
+            flat = metrics.flatten()
+            assert flat["control/reactor/overrides_rejected"] >= 1
+            assert "overrides_published" not in {
+                k: v for k, v in flat.items() if v} or \
+                flat["control/reactor/overrides_published"] == 0
+
+        run(go())
+
+    def test_oscillating_scores_zero_flaps(self):
+        async def go():
+            store = InMemoryDtabStore({"default": Dtab.read(BASE_DTAB)})
+            board = _FakeBoard()
+            metrics = MetricsTree()
+            r = _reactor(store, board, quorum=3, dwell=0.5,
+                         metrics=metrics)
+            t = 0.0
+            for i in range(100):
+                board.levels["/svc/web"] = 0.9 if i % 2 == 0 else 0.1
+                await r.step(now=t)
+                t += 0.05
+            flat = metrics.flatten()
+            assert flat["control/reactor/overrides_published"] == 0
+            assert flat["control/reactor/overrides_reverted"] == 0
+
+        run(go())
+
+    def test_concurrent_operator_write_wins_cas(self):
+        async def go():
+            store = InMemoryDtabStore({"default": Dtab.read(BASE_DTAB)})
+            board = _FakeBoard()
+
+            class _RacingClient(LocalStoreClient):
+                """An operator write lands between fetch and cas."""
+
+                def __init__(self, store):
+                    super().__init__(store)
+                    self.race_once = True
+
+                async def fetch(self, ns):
+                    vd = await super().fetch(ns)
+                    if self.race_once:
+                        self.race_once = False
+                        await store.put(ns, Dtab.read(
+                            BASE_DTAB + " /ops => /#/io.l5d.fs/ops ;"))
+                    return vd
+
+            metrics = MetricsTree()
+            r = MeshReactor(
+                board, _RacingClient(store), "default",
+                {"/svc/web": "/svc/web-b"},
+                governor=HysteresisGovernor(enter=0.6, exit=0.2,
+                                            quorum=1, dwell_s=0.0),
+                metrics_node=metrics.scope("control", "reactor"),
+                namer_prefixes=[Path.read("/io.l5d.fs")])
+            board.levels["/svc/web"] = 0.9
+            await r.step(now=1.0)   # CAS loses to the operator write
+            assert r.active == {}
+            assert metrics.flatten()["control/reactor/cas_conflicts"] == 1
+            await r.step(now=2.0)   # retried against the new version
+            vd = await store.observe("default").to_future()
+            assert "/svc/web => /svc/web-b" in vd.dtab.show
+            assert "/ops" in vd.dtab.show  # operator's dentry preserved
+
+        run(go())
+
+    def test_peer_published_override_is_adopted_not_duplicated(self):
+        """N fleet linkerds share one failover config: the second
+        reactor to trip must ADOPT the peer's identical dentry instead
+        of stacking a duplicate — and its revert stays idempotent."""
+        async def go():
+            store = InMemoryDtabStore({"default": Dtab.read(BASE_DTAB)})
+            board_a, board_b = _FakeBoard(), _FakeBoard()
+            metrics_b = MetricsTree()
+            r_a = _reactor(store, board_a)
+            r_b = _reactor(store, board_b, metrics=metrics_b)
+            board_a.levels["/svc/web"] = 0.9
+            board_b.levels["/svc/web"] = 0.9
+            await r_a.step(now=1.0)
+            await r_b.step(now=1.0)
+            vd = await store.observe("default").to_future()
+            assert vd.dtab.show.count("/svc/web => /svc/web-b") == 1
+            assert metrics_b.flatten()[
+                "control/reactor/overrides_adopted"] == 1
+            # either reactor reverting removes the single dentry
+            board_b.levels["/svc/web"] = 0.0
+            await r_b.step(now=2.0)
+            vd = await store.observe("default").to_future()
+            assert "web-b" not in vd.dtab.show
+
+        run(go())
+
+    def test_hung_store_costs_one_bounded_step(self):
+        """A blackholed namerd must cost one timed-out step (counted as
+        an error), never wedge the control loop behind the reactor's
+        lock — the adaptive-admission ticks share that driver."""
+        async def go():
+            board = _FakeBoard()
+
+            class _HungClient:
+                async def fetch(self, ns):
+                    await asyncio.Event().wait()  # forever; cancellable
+
+                async def cas(self, ns, dtab, version):
+                    pass
+
+                async def aclose(self):
+                    pass
+
+            metrics = MetricsTree()
+            r = MeshReactor(
+                board, _HungClient(), "default",
+                {"/svc/web": "/svc/web-b"},
+                governor=HysteresisGovernor(enter=0.6, exit=0.2,
+                                            quorum=1, dwell_s=0.0),
+                metrics_node=metrics.scope("control", "reactor"),
+                store_timeout_s=0.05)
+            board.levels["/svc/web"] = 0.9
+            t0 = time.monotonic()
+            await r.step(now=1.0)  # must return, not hang
+            assert time.monotonic() - t0 < 2.0
+            assert metrics.flatten()["control/reactor/errors"] == 1
+            assert r.active == {}
+
+        run(go())
+
+    def test_degraded_board_reads_zero_levels(self):
+        board = _FakeBoard()
+        board.levels["/svc/web"] = 0.95
+        board.degraded = True
+        store = InMemoryDtabStore({"default": Dtab.read(BASE_DTAB)})
+        r = _reactor(store, board)
+        assert r.cluster_levels() == {"/svc/web": 0.0}
+
+
+# ---- reactor interleavings (DeterministicScheduler) ------------------------
+
+
+class TestReactorInterleaving:
+    def test_actuate_vs_revert_schedules_stay_consistent(self):
+        """Concurrent reactor steps (the run() tick racing an admin- or
+        test-driven step) through every seeded interleaving of the store
+        client's fetch/cas awaits: the published dtab and the reactor's
+        `active` book-keeping must never disagree, and the base dtab
+        must never be corrupted."""
+        from linkerd_tpu.testing.schedules import explore
+
+        def mk(sched):
+            store = InMemoryDtabStore({"default": Dtab.read(BASE_DTAB)})
+            board = _FakeBoard()
+
+            class _Gated(LocalStoreClient):
+                async def fetch(self, ns):
+                    await sched.point("fetch")
+                    return await super().fetch(ns)
+
+                async def cas(self, ns, dtab, version):
+                    await sched.point("cas")
+                    await super().cas(ns, dtab, version)
+
+            r = _reactor(store, board)
+            r._client = _Gated(store)
+
+            async def sick_step():
+                board.levels["/svc/web"] = 0.9
+                await r.step(now=1.0)
+
+            async def recover_step():
+                await sched.point("flip-healthy")
+                board.levels["/svc/web"] = 0.0
+                await r.step(now=2.0)
+
+            async def check():
+                # runs last (scheduler drains): consistency invariant
+                await sched.point("check")
+                vd = await store.observe("default").to_future()
+                dentry_present = "/svc/web => /svc/web-b" in vd.dtab.show
+                assert dentry_present == ("/svc/web" in r.active), (
+                    f"store/active diverged: present={dentry_present} "
+                    f"active={list(r.active)}")
+                assert "/svc => /#/io.l5d.fs" in vd.dtab.show
+                return True
+
+            return [sick_step(), recover_step(), check()]
+
+        def invariant(results):
+            for res in results:
+                if isinstance(res, BaseException):
+                    raise AssertionError(repr(res))
+
+        failure = explore(mk, invariant, seeds=range(24), timeout=10.0)
+        assert failure is None, f"schedule violated invariant: {failure}"
+
+
+# ---- satellites: ClassifierFilter + RewriteHostHeader ----------------------
+
+
+class TestClassifierFilterChain:
+    def test_two_linkerd_chain_trusts_inner_verdict(self, tmp_path):
+        """The inner router (allSuccessful) stamps l5d-success-class:
+        1.0 on a backend 503; the edge (io.l5d.http.successClass over a
+        retrying fallback) TRUSTS it: no retry, classified success —
+        exactly how the reference's ClassifierFilter chains behave."""
+        calls = []
+
+        async def flaky(req):
+            calls.append(1)
+            return Response(503, body=b"nope")
+
+        async def go():
+            backend = await serve(FnService(flaky))
+            disco_b = tmp_path / "disco-b"
+            disco_b.mkdir()
+            (disco_b / "web").write_text(
+                f"127.0.0.1 {backend.bound_port}\n")
+            inner = load_linker(f"""
+routers:
+- protocol: http
+  label: inner
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  service:
+    responseClassifier: {{kind: io.l5d.http.allSuccessful}}
+  servers: [{{port: 0}}]
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco_b}
+""")
+            await inner.start()
+            disco_a = tmp_path / "disco-a"
+            disco_a.mkdir()
+            (disco_a / "web").write_text(
+                f"127.0.0.1 {inner.routers[0].server_ports[0]}\n")
+            edge = load_linker(f"""
+routers:
+- protocol: http
+  label: edge
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  service:
+    responseClassifier:
+      kind: io.l5d.http.successClass
+      fallback: io.l5d.http.retryableRead5XX
+    retries: {{backoff: {{kind: constant, ms: 5}}}}
+  servers: [{{port: 0}}]
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco_a}
+""")
+            await edge.start()
+            proxy = HttpClient("127.0.0.1",
+                               edge.routers[0].server_ports[0])
+            try:
+                req = Request(uri="/")
+                req.headers.set("Host", "web")
+                rsp = await proxy(req)
+                assert rsp.status == 503
+                # the inner router's verdict rode the wire...
+                assert rsp.headers.get("l5d-success-class") == "1.0"
+                # ...and the edge trusted it: no retry fired even though
+                # the fallback alone would have retried a GET 503
+                assert len(calls) == 1
+                flat = edge.metrics.flatten()
+                assert flat.get(
+                    "rt/edge/service/svc.web/retries/total", 0) == 0
+            finally:
+                await proxy.close()
+                await edge.close()
+                await inner.close()
+                await backend.close()
+
+        run(go())
+
+    def test_edge_retries_when_inner_says_failure(self, tmp_path):
+        """Inverse chain: the inner router classifies the 503 as a
+        failure (nonRetryable5XX -> stamp 0.0); the edge honors the
+        failure verdict and its fallback's retryability (GET + read5XX
+        -> retry)."""
+        calls = []
+        gate = {"fail": True}
+
+        async def recovering(req):
+            calls.append(1)
+            if gate["fail"]:
+                gate["fail"] = False
+                return Response(503, body=b"nope")
+            return Response(200, body=b"ok")
+
+        async def go():
+            backend = await serve(FnService(recovering))
+            disco_b = tmp_path / "db"
+            disco_b.mkdir()
+            (disco_b / "web").write_text(
+                f"127.0.0.1 {backend.bound_port}\n")
+            inner = load_linker(f"""
+routers:
+- protocol: http
+  label: inner
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers: [{{port: 0}}]
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco_b}
+""")
+            await inner.start()
+            disco_a = tmp_path / "da"
+            disco_a.mkdir()
+            (disco_a / "web").write_text(
+                f"127.0.0.1 {inner.routers[0].server_ports[0]}\n")
+            edge = load_linker(f"""
+routers:
+- protocol: http
+  label: edge
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  service:
+    responseClassifier:
+      kind: io.l5d.http.successClass
+      fallback: io.l5d.http.retryableRead5XX
+    retries: {{backoff: {{kind: constant, ms: 5}}}}
+  servers: [{{port: 0}}]
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco_a}
+""")
+            await edge.start()
+            proxy = HttpClient("127.0.0.1",
+                               edge.routers[0].server_ports[0])
+            try:
+                req = Request(uri="/")
+                req.headers.set("Host", "web")
+                rsp = await proxy(req)
+                assert rsp.status == 200
+                assert len(calls) == 2  # retried once, then succeeded
+            finally:
+                await proxy.close()
+                await edge.close()
+                await inner.close()
+                await backend.close()
+
+        run(go())
+
+    def test_h2_success_class_classifier(self):
+        from linkerd_tpu.config import lookup
+        from linkerd_tpu.protocol.h2.messages import H2Request, H2Response
+        from linkerd_tpu.router.classifiers import ResponseClass
+
+        cls = lookup("h2classifier", "io.l5d.h2.successClass")(
+            fallback="io.l5d.h2.retryableRead5XX").mk()
+        req = H2Request(method="GET", path="/x")
+        # downstream says success: a 503 classifies SUCCESS
+        rsp = H2Response(status=503)
+        rsp.headers.set("l5d-success-class", "1.0")
+        assert cls.early(req, rsp) is ResponseClass.SUCCESS
+        assert cls.classify(req, rsp, None, None) \
+            is ResponseClass.SUCCESS
+        # downstream says failure: a 200 classifies FAILURE
+        rsp = H2Response(status=200)
+        rsp.headers.set("l5d-success-class", "0.0")
+        assert cls.early(req, rsp) is None  # retryability needs final
+        assert cls.classify(req, rsp, None, None) \
+            is ResponseClass.FAILURE
+        # no header: fallback behavior (retryable read 5xx)
+        rsp = H2Response(status=503)
+        assert cls.classify(req, rsp, None, None) \
+            is ResponseClass.RETRYABLE_FAILURE
+
+    def test_h2_classifier_filter_stamps_ctx_verdict(self):
+        from linkerd_tpu.protocol.h2.messages import H2Request, H2Response
+        from linkerd_tpu.router.classifiers import ResponseClass
+        from linkerd_tpu.router.h2_layer import H2ClassifierFilter
+
+        async def go():
+            async def svc(req):
+                req.ctx["response_class"] = ResponseClass.FAILURE
+                return H2Response(status=200)
+
+            rsp = await H2ClassifierFilter().apply(
+                H2Request(method="GET", path="/x"), FnService(svc))
+            assert rsp.headers.get("l5d-success-class") == "0.0"
+
+        run(go())
+
+
+class TestRewriteHostHeader:
+    def _addr_var(self, authority=None):
+        meta = (("authority", authority),) if authority else ()
+        return Var(Bound(frozenset(
+            {Address("127.0.0.1", 80, 1.0, meta)})))
+
+    def test_rewrites_host_and_reverses_location(self):
+        from linkerd_tpu.protocol.http.filters import RewriteHostHeader
+
+        seen = {}
+
+        async def svc(req):
+            seen["host"] = req.headers.get("host")
+            rsp = Response(302)
+            rsp.headers.set(
+                "Location", "http://web.svc.dc1.consul/login?x=1")
+            rsp.headers.set("Refresh",
+                            "5; url=http://web.svc.dc1.consul/retry")
+            return rsp
+
+        async def go():
+            f = RewriteHostHeader(
+                self._addr_var("web.svc.dc1.consul"))
+            req = Request(uri="/login")
+            req.headers.set("Host", "web")
+            rsp = await f.apply(req, FnService(svc))
+            # consul setHost authority reached the backend...
+            assert seen["host"] == "web.svc.dc1.consul"
+            # ...and the redirect points back at the caller's vhost
+            assert rsp.headers.get("location") == \
+                "http://web/login?x=1"
+            assert rsp.headers.get("refresh") == \
+                "5; url=http://web/retry"
+
+        run(go())
+
+    def test_no_authority_meta_is_noop(self):
+        from linkerd_tpu.protocol.http.filters import RewriteHostHeader
+
+        seen = {}
+
+        async def svc(req):
+            seen["host"] = req.headers.get("host")
+            return Response(200)
+
+        async def go():
+            f = RewriteHostHeader(self._addr_var(None))
+            req = Request(uri="/")
+            req.headers.set("Host", "web")
+            await f.apply(req, FnService(svc))
+            assert seen["host"] == "web"
+
+        run(go())
+
+    def test_foreign_location_untouched(self):
+        from linkerd_tpu.protocol.http.filters import RewriteHostHeader
+
+        async def svc(req):
+            rsp = Response(302)
+            rsp.headers.set("Location", "http://elsewhere.example/x")
+            return rsp
+
+        async def go():
+            f = RewriteHostHeader(self._addr_var("web.svc.consul"))
+            req = Request(uri="/")
+            req.headers.set("Host", "web")
+            rsp = await f.apply(req, FnService(svc))
+            assert rsp.headers.get("location") == \
+                "http://elsewhere.example/x"
+
+        run(go())
+
+    def test_consul_namer_meta_shape_is_consumed(self):
+        """The filter reads exactly what consul's SvcAddr.mkMeta-style
+        with_authority mapping produces (per-Address authority meta)."""
+        from linkerd_tpu.protocol.http.filters import _authority_of
+
+        a = Address.mk("10.0.0.1", 8080,
+                       authority="web.service.dc1.consul")
+        assert _authority_of(Bound(frozenset({a}))) == \
+            "web.service.dc1.consul"
+
+
+# ---- chaos e2e: two-router fleet + namerd ----------------------------------
+
+
+class TestControlChaosE2E:
+    def test_sick_cluster_shifts_and_reverts(self, tmp_path):
+        """The acceptance scenario end-to-end, mixed-protocol: an http
+        and an h2 router on one linker, both bound through a REAL namerd
+        (HTTP control API + chunked watches). Scores rise -> the reactor
+        CAS-publishes verified overrides -> both protocols' traffic
+        shifts to the -b clusters; scores recover -> overrides revert ->
+        traffic returns; an oscillation phase afterwards produces zero
+        further actuations; a retry burst mid-shift all succeeds."""
+        from linkerd_tpu.protocol.h2.client import H2Client
+        from linkerd_tpu.protocol.h2.messages import H2Request, H2Response
+        from linkerd_tpu.protocol.h2.server import serve_h2
+
+        counts = {"a": 0, "b": 0, "a2": 0, "b2": 0}
+
+        def http_backend(name):
+            async def handler(req):
+                counts[name] += 1
+                return Response(200, body=name.encode())
+            return handler
+
+        def h2_backend(name):
+            async def handler(req):
+                counts[name] += 1
+                return H2Response(status=200, body=name.encode())
+            return handler
+
+        async def go():
+            back_a = await serve(FnService(http_backend("a")))
+            back_b = await serve(FnService(http_backend("b")))
+            back_a2 = await serve_h2(FnService(h2_backend("a2")))
+            back_b2 = await serve_h2(FnService(h2_backend("b2")))
+
+            disco = tmp_path / "disco"
+            disco.mkdir()
+            (disco / "web").write_text(f"127.0.0.1 {back_a.bound_port}\n")
+            (disco / "web-b").write_text(
+                f"127.0.0.1 {back_b.bound_port}\n")
+            (disco / "web2").write_text(
+                f"127.0.0.1 {back_a2.bound_port}\n")
+            (disco / "web2-b").write_text(
+                f"127.0.0.1 {back_b2.bound_port}\n")
+
+            namerd = Namerd(
+                InMemoryDtabStore(
+                    {"default": Dtab.read("/svc => /#/io.l5d.fs ;")}),
+                namers=[(Path.read("/io.l5d.fs"),
+                         FsNamer(str(disco)))])
+            ctl_srv = await HttpServer(HttpControlService(namerd)).start()
+            ctl_port = ctl_srv.bound_port
+
+            edge = load_linker(f"""
+routers:
+- protocol: http
+  label: edge
+  servers: [{{port: 0}}]
+  interpreter:
+    kind: io.l5d.namerd.http
+    dst: /$/inet/127.0.0.1/{ctl_port}
+    namespace: default
+  service:
+    responseClassifier: {{kind: io.l5d.http.retryableRead5XX}}
+    retries: {{backoff: {{kind: constant, ms: 10}}}}
+- protocol: h2
+  label: edge-h2
+  servers: [{{port: 0}}]
+  interpreter:
+    kind: io.l5d.namerd.http
+    dst: /$/inet/127.0.0.1/{ctl_port}
+    namespace: default
+telemetry:
+- kind: io.l5d.jaxAnomaly
+  maxBatch: 64
+  maxLingerMs: 1
+  trainEveryBatches: 0
+  scoreTtlSecs: 10
+  control:
+    intervalMs: 20
+    warmupBatches: 1
+    enterThreshold: 0.6
+    exitThreshold: 0.2
+    quorum: 2
+    cooldownS: 0.1
+    namespace: default
+    namerdAddress: 127.0.0.1:{ctl_port}
+    failover:
+      /svc/web: /svc/web-b
+      /svc/web2: /svc/web2-b
+""")
+            tele = edge.telemeters[0]
+            scorer = _LevelScorer(0.0)
+            tele._scorer = scorer
+            await edge.start()
+            drain = asyncio.ensure_future(tele.run())
+            http_port = edge.routers[0].server_ports[0]
+            h2_port = edge.routers[1].server_ports[0]
+            proxy = HttpClient("127.0.0.1", http_port)
+            h2c = H2Client("127.0.0.1", h2_port)
+            flat = edge.metrics.flatten
+
+            async def one_http():
+                req = Request(uri="/")
+                req.headers.set("Host", "web")
+                rsp = await proxy(req)
+                assert rsp.status == 200
+                return rsp.body
+
+            async def one_h2():
+                rsp = await h2c(H2Request(method="GET", path="/",
+                                          authority="web2"))
+                body, _trailers = await rsp.stream.read_all()
+                assert rsp.status == 200
+                return body
+
+            async def tick():
+                await one_http()
+                await one_h2()
+
+            try:
+                # healthy: traffic lands on the A clusters
+                for _ in range(5):
+                    await tick()
+                assert counts["a"] >= 5 and counts["a2"] >= 5
+                assert counts["b"] == 0 and counts["b2"] == 0
+
+                # ---- fault: every scored row reads anomalous ----
+                scorer.level = 0.9
+                await eventually(
+                    lambda: flat().get(
+                        "control/reactor/overrides_published", 0) >= 2,
+                    timeout=15.0, what="override publish", tick=tick)
+                vd = await namerd.store.observe("default").to_future()
+                assert "/svc/web => /svc/web-b" in vd.dtab.show
+                assert "/svc/web2 => /svc/web2-b" in vd.dtab.show
+
+                # both protocols shift to the -b clusters
+                await eventually(
+                    lambda: b"b" == counts.setdefault("_", b"")
+                    or counts["b"] > 0, timeout=10.0,
+                    what="http traffic shift", tick=one_http)
+                await eventually(
+                    lambda: counts["b2"] > 0, timeout=10.0,
+                    what="h2 traffic shift", tick=one_h2)
+                a_plateau, a2_plateau = counts["a"], counts["a2"]
+                for _ in range(5):
+                    await tick()
+                assert counts["a"] == a_plateau, "http still leaks to A"
+                assert counts["a2"] == a2_plateau, "h2 still leaks to A"
+
+                # retry-storm under shifted traffic: a concurrent burst
+                # through the override path all succeeds, and the
+                # override does not flap
+                bodies = await asyncio.gather(
+                    *[one_http() for _ in range(20)])
+                assert all(b == b"b" for b in bodies)
+                assert flat()[
+                    "control/reactor/overrides_published"] == 2
+
+                # ---- recovery: scores fall, override reverts ----
+                scorer.level = 0.0
+                await eventually(
+                    lambda: flat().get(
+                        "control/reactor/overrides_reverted", 0) >= 2,
+                    timeout=15.0, what="override revert", tick=tick)
+                vd = await namerd.store.observe("default").to_future()
+                assert "web-b" not in vd.dtab.show
+                await eventually(
+                    lambda: counts["a"] > a_plateau, timeout=10.0,
+                    what="http traffic return", tick=one_http)
+
+                # ---- oscillation: zero further flaps ----
+                published = flat()["control/reactor/overrides_published"]
+                reverted = flat()["control/reactor/overrides_reverted"]
+                for i in range(20):
+                    scorer.level = 0.9 if i % 2 == 0 else 0.0
+                    await tick()
+                    await asyncio.sleep(0.03)
+                scorer.level = 0.0
+                assert flat()[
+                    "control/reactor/overrides_published"] == published
+                assert flat()[
+                    "control/reactor/overrides_reverted"] == reverted
+
+                # the whole loop is observable
+                status = tele.control.status()
+                assert status["reactor"]["active_overrides"] == {}
+                assert status["actuators"]["mesh_reactor"] is True
+                assert flat()["control/steps"] > 0
+            finally:
+                drain.cancel()
+                await asyncio.gather(drain, return_exceptions=True)
+                await proxy.close()
+                await h2c.close()
+                await edge.close()
+                await ctl_srv.close()
+                await namerd.close()
+                for b in (back_a, back_b, back_a2, back_b2):
+                    await b.close()
+
+        run(go())
+
+    def test_sick_replica_drains_before_ejection(self, tmp_path):
+        """One cluster, two replicas: per-endpoint scores degrade for
+        replica A -> the score-weighted balancer shifts its share down
+        to a trickle while the endpoint stays OPEN (failure accrual
+        never fired — nothing failed)."""
+        counts = {"a": 0, "b": 0}
+
+        async def go():
+            async def mk_handler(name):
+                async def h(req):
+                    counts[name] += 1
+                    return Response(200, body=name.encode())
+                return h
+
+            back_a = await serve(FnService(await mk_handler("a")))
+            back_b = await serve(FnService(await mk_handler("b")))
+            disco = tmp_path / "disco"
+            disco.mkdir()
+            (disco / "web").write_text(
+                f"127.0.0.1 {back_a.bound_port}\n"
+                f"127.0.0.1 {back_b.bound_port}\n")
+            linker = load_linker(f"""
+routers:
+- protocol: http
+  label: drain
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers: [{{port: 0}}]
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+telemetry:
+- kind: io.l5d.jaxAnomaly
+  trainEveryBatches: 0
+  scoreTtlSecs: 30
+  control:
+    intervalMs: 20
+    warmupBatches: 0   # scores seeded out-of-band; no drain loop runs
+    weightThreshold: 0.3
+    weightFloor: 0.05
+""")
+            tele = linker.telemeters[0]
+            await linker.start()
+            proxy = HttpClient("127.0.0.1",
+                               linker.routers[0].server_ports[0])
+
+            async def one():
+                req = Request(uri="/")
+                req.headers.set("Host", "web")
+                rsp = await proxy(req)
+                assert rsp.status == 200
+
+            try:
+                # warmup: both replicas share traffic
+                for _ in range(40):
+                    await one()
+                assert counts["a"] > 5 and counts["b"] > 5
+
+                # replica A trends anomalous (per-endpoint scores)
+                sick_ep = f"127.0.0.1:{back_a.bound_port}"
+                for _ in range(10):
+                    tele.board.update_batch(
+                        ["/svc/web"], np.array([0.95], np.float32),
+                        endpoints=[sick_ep])
+                assert tele.board.endpoint_score_of(sick_ep) > 0.8
+
+                counts["a"] = counts["b"] = 0
+                for _ in range(300):
+                    await one()
+                total = counts["a"] + counts["b"]
+                share_a = counts["a"] / total
+                # measurably drained (fair share would be 0.5), NOT
+                # ejected: a trickle remains possible and the endpoint
+                # is still OPEN
+                assert share_a < 0.25, f"sick share {share_a:.2f}"
+                assert counts["b"] > 200
+                flat = linker.metrics.flatten()
+                # nothing failed, so accrual never removed anything
+                assert flat.get("rt/drain/server/failures", 0) == 0
+            finally:
+                await proxy.close()
+                await linker.close()
+                for b in (back_a, back_b):
+                    await b.close()
+
+        run(go())
+
+
+# ---- /control.json + config validation -------------------------------------
+
+
+class TestControlConfigSurface:
+    def test_control_json_admin_handler(self):
+        from linkerd_tpu.config.parser import instantiate
+
+        cfg = instantiate("telemeter", {
+            "kind": "io.l5d.jaxAnomaly",
+            "control": {"intervalMs": 50},
+        }, "t")
+        tele = cfg.mk(MetricsTree())
+        paths = [p for p, _ in tele.admin_handlers()]
+        assert "/control.json" in paths
+
+        async def go():
+            handler = dict(tele.admin_handlers())["/control.json"]
+            rsp = await handler(Request(uri="/control.json"))
+            assert rsp.status == 200
+            import json
+            data = json.loads(rsp.body)
+            assert data["actuators"]["balancer_weighting"] is True
+
+        run(go())
+
+    def test_l5dcheck_flags_bad_control_blocks(self):
+        from tools.analysis.semantic.engine import check_text
+
+        findings = check_text("""
+routers:
+- protocol: http
+  servers: [{port: 0}]
+telemetry:
+- kind: io.l5d.jaxAnomaly
+  control:
+    enterThreshold: 0.2
+    exitThreshold: 0.7
+    namespace: default
+    failover:
+      /svc/web: /svc/web
+""")
+        rules = {f.rule for f in findings if not f.suppressed}
+        assert "scorer-config" in rules      # inverted thresholds
+        assert "override-unsafe" in rules    # self-shift failover
+
+    def test_clean_control_block_passes(self):
+        from tools.analysis.semantic.engine import check_text
+
+        findings = check_text("""
+routers:
+- protocol: http
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers: [{port: 0}]
+namers:
+- kind: io.l5d.fs
+  rootDir: /tmp
+telemetry:
+- kind: io.l5d.jaxAnomaly
+  control:
+    namespace: default
+    namerdAddress: 127.0.0.1:4180
+    failover:
+      /svc/web: /svc/web-b
+""")
+        assert [f for f in findings if not f.suppressed
+                and f.rule in ("scorer-config", "override-unsafe")] == []

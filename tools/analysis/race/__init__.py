@@ -26,10 +26,12 @@ from tools.analysis.core import (  # noqa: F401 — re-exports
     Finding, Project, race_checkers, race_rule_ids,
 )
 
-# The packages the race suite gates (the asyncio data plane). Control
-# plane / startup code may block and single-task freely.
+# The packages the race suite gates (the asyncio data plane + the
+# reactive control loop, whose reactor steps race its own run() tick).
+# Startup/assembly code may block and single-task freely.
 DEFAULT_SCOPE = ("linkerd_tpu/router", "linkerd_tpu/protocol",
-                 "linkerd_tpu/telemetry", "linkerd_tpu/lifecycle")
+                 "linkerd_tpu/telemetry", "linkerd_tpu/lifecycle",
+                 "linkerd_tpu/control")
 
 
 def run_race_analysis(scan_paths: Optional[Sequence[str]] = None,

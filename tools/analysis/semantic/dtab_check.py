@@ -344,6 +344,85 @@ def check_dtab(source: ConfigSource, dtab_text: str,
     return findings
 
 
+def check_override(base: Dtab, override: Dtab,
+                   namer_prefixes: Optional[Sequence[Path]],
+                   where: str = "override") -> List[Finding]:
+    """``override-unsafe``: verify a control-plane-GENERATED override
+    dtab (the MeshReactor's traffic shift) before it is published.
+
+    An override is a dentry appended to the live namespace dtab, so it
+    takes precedence over everything before it. Unsafe shapes:
+
+    - **cycle** — the override's destination delegates back into a loop
+      (classic: failing over a cluster to itself, or to an alias that
+      resolves through it); the fleet would bind nothing.
+    - **unroutable** — the destination reaches no configured namer /
+      resolves only to Neg; "shift away from sick" must never mean
+      "shift into a wall".
+    - **collateral shadowing** — the override's prefix is broader than
+      an existing rule it would silently preempt (a wildcard, or a
+      prefix strictly subsuming a more specific base dentry): the shift
+      would hijack traffic the reactor was not told to move. Replacing
+      a dentry with the SAME prefix is the override's whole point and
+      is not flagged.
+
+    Symbolic delegation over the REAL Delegator (the same machinery as
+    every other dtab rule), so verification can't drift from what the
+    fleet's interpreters would do.
+
+    ``namer_prefixes=None`` means the caller does NOT know the fleet's
+    namers (a linker bound to a remote namerd): /#/ destinations are
+    then assumed bindable (a zero-length probe prefix matches every
+    configured-namer path) and only the namer-independent rules —
+    cycles, collateral shadowing — can fire."""
+    unknown_namers = namer_prefixes is None
+    prefixes = [Path()] if unknown_namers else list(namer_prefixes)
+    combined = base + override
+    text = "\n".join(f"{d.show} ;" for d in combined)
+    source = ConfigSource("<override>", text)
+    analysis = DtabAnalysis(source, combined, prefixes, where)
+    findings: List[Finding] = []
+    base_len = len(base)
+    for k, dentry in enumerate(override):
+        line = base_len + k + 1  # one dentry per line in `text`
+        if WILDCARD in dentry.prefix.segments:
+            findings.append(source.finding(
+                "override-unsafe",
+                f"{where}: override dentry '{dentry.show}' has a "
+                f"wildcard prefix — it would claim traffic for every "
+                f"matching service, not just the sick cluster",
+                line=line))
+        for b in base:
+            if b.prefix != dentry.prefix and prefix_subsumes(
+                    dentry.prefix, b.prefix):
+                findings.append(source.finding(
+                    "override-unsafe",
+                    f"{where}: override dentry '{dentry.show}' shadows "
+                    f"the more specific rule '{b.show}' — the shift "
+                    f"would hijack traffic beyond its target cluster",
+                    line=line))
+                break
+        outs = analysis.dentry_outcomes(dentry)
+        if any(isinstance(t, DTooDeep) for t in outs):
+            findings.append(source.finding(
+                "override-unsafe",
+                f"{where}: override dentry '{dentry.show}' delegates "
+                f"into a cycle — resolution would abort at MAX_DEPTH "
+                f"and the cluster would bind nothing",
+                line=line))
+        elif outs and all(isinstance(t, (DNeg, DException))
+                          for t in outs):
+            known = ("<unknown: remote namerd>" if unknown_namers
+                     else (sorted(p.show for p in prefixes) or ["<none>"]))
+            findings.append(source.finding(
+                "override-unsafe",
+                f"{where}: override dentry '{dentry.show}' is "
+                f"unroutable — its destination reaches no configured "
+                f"namer (prefixes: {known}) and resolves only to Neg",
+                line=line))
+    return findings
+
+
 def _claims_under(prefix: Prefix, dst: Path) -> bool:
     """Can ``prefix`` match some path under ``dst``? Segment-wise
     agreement over the common length ('*' covers anything): a dentry

@@ -32,6 +32,7 @@ SEMANTIC_RULES = (
     "timeout-inversion", "retry-starved", "admission-deadline",
     "tls-missing-cert",
     "scorer-config", "scorer-width",
+    "override-unsafe",    # reactor-generated dtab overrides (control/)
 )
 
 

@@ -39,6 +39,9 @@ def check_telemetry(source: ConfigSource, spec: LinkerSpec
         except ConfigError:
             continue  # the registry cross-check already reported it
         yield from _check_anomaly_cfg(source, cfg, where)
+        if cfg.control is not None:
+            yield from _check_control_cfg(source, cfg.control, spec,
+                                          f"{where}.control")
         if cfg.lifecycle is not None:
             yield from _check_lifecycle_cfg(source, cfg.lifecycle,
                                             f"{where}.lifecycle")
@@ -106,6 +109,76 @@ def _check_anomaly_cfg(source: ConfigSource, cfg, where: str
                    f"breakerFailures must be >= 1 (got "
                    f"{cfg.breakerFailures})",
                    "breakerFailures")
+
+
+def _check_control_cfg(source: ConfigSource, ctl, spec: LinkerSpec,
+                       where: str) -> Iterator[Finding]:
+    """Control-loop (reactive routing) knob interlocks + the statically
+    checkable half of ``override-unsafe``: a failover mapping that can
+    only ever generate a rejected override (self-shift cycle, wildcard
+    claims, unparseable paths) is a config bug, not a runtime event."""
+    from linkerd_tpu.core import Path as _Path
+    from linkerd_tpu.core.dtab import WILDCARD as _WILDCARD
+
+    if ctl.intervalMs <= 0:
+        yield _bad(source, "scorer-config", where,
+                   f"intervalMs must be > 0 (got {ctl.intervalMs})",
+                   "intervalMs")
+    if not (0.0 < ctl.exitThreshold < ctl.enterThreshold <= 1.0):
+        yield _bad(source, "scorer-config", where,
+                   f"thresholds must satisfy 0 < exitThreshold < "
+                   f"enterThreshold <= 1 (got enter="
+                   f"{ctl.enterThreshold}, exit={ctl.exitThreshold}) — "
+                   f"split thresholds are the anti-flap hysteresis",
+                   "enterThreshold")
+    if ctl.quorum < 1:
+        yield _bad(source, "scorer-config", where,
+                   f"quorum must be >= 1 (got {ctl.quorum})", "quorum")
+    if ctl.cooldownS < 0:
+        yield _bad(source, "scorer-config", where,
+                   f"cooldownS must be >= 0 (got {ctl.cooldownS})",
+                   "cooldownS")
+    for bad_range, name in (
+            (not 0.0 < ctl.weightFloor <= 1.0, "weightFloor"),
+            (not 0.0 < ctl.weightThreshold < 1.0, "weightThreshold"),
+            (not 0.0 < ctl.admissionFloor <= 1.0, "admissionFloor"),
+            (not 0.0 < ctl.admissionThreshold < 1.0,
+             "admissionThreshold")):
+        if bad_range:
+            yield _bad(source, "scorer-config", where,
+                       f"{name} out of range (got "
+                       f"{getattr(ctl, name)})", name)
+    if ctl.failover and not ctl.namespace:
+        yield _bad(source, "scorer-config", where,
+                   "failover requires namespace (the namerd dtab "
+                   "namespace the reactor shifts)", "failover")
+    if ctl.failover and ctl.namespace and not ctl.namerdAddress:
+        yield _bad(source, "scorer-config", where,
+                   "failover is configured but namerdAddress is not: "
+                   "the mesh reactor stays disabled unless a store "
+                   "client is injected programmatically "
+                   "(set_store_client) — a YAML-only deployment will "
+                   "never shift traffic", "failover",
+                   severity="warning")
+    for cluster, target in (ctl.failover or {}).items():
+        try:
+            c_path, t_path = _Path.read(cluster), _Path.read(str(target))
+        except ValueError as e:
+            yield _bad(source, "override-unsafe", where,
+                       f"failover entry {cluster!r} -> {target!r} does "
+                       f"not parse as paths: {e}", "failover")
+            continue
+        if cluster == str(target):
+            yield _bad(source, "override-unsafe", where,
+                       f"failover {cluster} -> {target} shifts a "
+                       f"cluster to itself — the generated override is "
+                       f"a guaranteed delegation cycle and would always "
+                       f"be rejected", "failover")
+        if _WILDCARD in tuple(c_path) or _WILDCARD in tuple(t_path):
+            yield _bad(source, "override-unsafe", where,
+                       f"failover {cluster} -> {target} uses a wildcard "
+                       f"segment — overrides must name one concrete "
+                       f"cluster", "failover")
 
 
 def _check_lifecycle_cfg(source: ConfigSource, lc, where: str

@@ -57,6 +57,15 @@ exported spans form a single connected tree under one trace id (edge
 server -> edge client -> inner server -> inner client):
 
     python tools/validator.py trace
+
+And the control-loop validation: boot the REAL linkerd and namerd
+binaries with the jaxAnomaly ``control:`` block and its ONLINE-TRAINED
+in-process scorer, warm it on normal traffic, then fault the primary
+cluster (errors + latency) and assert from live metrics that the
+reactor publishes an l5dcheck-verified dtab override (traffic shifts to
+the failover cluster), and reverts it after the fault clears:
+
+    python tools/validator.py control
 """
 
 from __future__ import annotations
@@ -89,6 +98,8 @@ PORTS = {
     "trace":  {"edge": 28140, "inner": 28141, "admin": 28990,
                "a": 28801, "collector": 28411},
     "scorer": {"linkerd": 29140, "admin": 29990, "a": 29801},
+    "control": {"linkerd": 30140, "admin": 30990, "namerd": 30180,
+                "a": 30801, "b": 30802},
 }
 
 IFACE_YAML = {
@@ -386,6 +397,199 @@ admin:
             await sidecar.close()
         await hole.close()
         d_a.close()
+
+
+async def faultable_downstream(name: str, port: int, fault: dict):
+    """Downstream that serves 200/<name> normally; while
+    ``fault['on']`` it answers 503 after ~150ms — the feature shape
+    (status + latency spike + error-rate drift) the anomaly scorer is
+    trained to flag."""
+    async def on_conn(reader, writer):
+        try:
+            while True:
+                head = await reader.readuntil(b"\r\n\r\n")
+                if not head:
+                    return
+                if fault["on"]:
+                    await asyncio.sleep(0.15)
+                    body = b"injected fault"
+                    writer.write(
+                        b"HTTP/1.1 503 Service Unavailable\r\n"
+                        b"l5d-fault-label: 1\r\nContent-Length: "
+                        + str(len(body)).encode() + b"\r\n\r\n" + body)
+                else:
+                    body = name.encode()
+                    writer.write(
+                        b"HTTP/1.1 200 OK\r\nl5d-fault-label: 0\r\n"
+                        b"Content-Length: "
+                        + str(len(body)).encode() + b"\r\n\r\n" + body)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+    return await asyncio.start_server(on_conn, "127.0.0.1", port)
+
+
+async def validate_control() -> None:
+    """Boot the REAL namerd + linkerd binaries with the reactive
+    control loop configured, fault the primary cluster, and assert the
+    whole loop closes: scores rise -> the reactor CAS-publishes an
+    l5dcheck-verified override through namerd -> traffic shifts to the
+    failover cluster -> the fault clears -> the override reverts and
+    traffic returns. Prints one ``CONTROL {json}`` line with the
+    measured actuation windows."""
+    ports = PORTS["control"]
+    work = tempfile.mkdtemp(prefix="l5d-validate-control-")
+    disco = os.path.join(work, "disco")
+    dtabs = os.path.join(work, "dtabs")
+    os.makedirs(disco)
+    fault = {"on": False}
+    d_a = await faultable_downstream("A", ports["a"], fault)
+    d_b = await faultable_downstream("B", ports["b"], {"on": False})
+    with open(os.path.join(disco, "web"), "w") as f:
+        f.write(f"127.0.0.1 {ports['a']}\n")
+    with open(os.path.join(disco, "web-b"), "w") as f:
+        f.write(f"127.0.0.1 {ports['b']}\n")
+
+    namerd_yaml = os.path.join(work, "namerd.yaml")
+    with open(namerd_yaml, "w") as f:
+        f.write(f"""
+storage:
+  kind: io.l5d.fs
+  directory: {dtabs}
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+interfaces:
+- kind: io.l5d.httpController
+  port: {ports['namerd']}
+""")
+    linkerd_yaml = os.path.join(work, "linkerd.yaml")
+    with open(linkerd_yaml, "w") as f:
+        f.write(f"""
+routers:
+- protocol: http
+  label: ctrl
+  interpreter:
+    kind: io.l5d.namerd.http
+    dst: /$/inet/127.0.0.1/{ports['namerd']}
+    namespace: default
+  servers:
+  - port: {ports['linkerd']}
+telemetry:
+- kind: io.l5d.jaxAnomaly
+  maxLingerMs: 2
+  scoreTtlSecs: 30
+  control:
+    intervalMs: 50
+    enterThreshold: 0.5
+    exitThreshold: 0.2
+    quorum: 4
+    cooldownS: 1.0
+    namespace: default
+    namerdAddress: 127.0.0.1:{ports['namerd']}
+    failover:
+      /svc/web: /svc/web-b
+admin:
+  port: {ports['admin']}
+""")
+
+    def route() -> bytes:
+        _, _, body = http(
+            "GET", f"http://127.0.0.1:{ports['linkerd']}/",
+            headers={"Host": "web"})
+        return body
+
+    def reactor_metric(name: str) -> float:
+        _, _, body = http(
+            "GET", f"http://127.0.0.1:{ports['admin']}"
+                   f"/admin/metrics.json?q=control")
+        return float(json.loads(body).get(
+            f"control/reactor/{name}", 0.0))
+
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    procs = []
+    try:
+        namerd = subprocess.Popen(
+            [sys.executable, "-m", "linkerd_tpu.namerd", namerd_yaml],
+            env=env, cwd=work)
+        procs.append(namerd)
+        await wait_for(lambda: http(
+            "GET", f"http://127.0.0.1:{ports['namerd']}/api/1/dtabs"
+        )[0] == 200, 15, "namerd http controller")
+        st, _, _ = await asyncio.to_thread(
+            http, "POST",
+            f"http://127.0.0.1:{ports['namerd']}/api/1/dtabs/default",
+            b"/svc => /#/io.l5d.fs;")
+        assert st == 204, f"dtab create: {st}"
+
+        linkerd = subprocess.Popen(
+            [sys.executable, "-m", "linkerd_tpu", linkerd_yaml],
+            env=env, cwd=work)
+        procs.append(linkerd)
+        await wait_for(lambda: route() == b"A", 30, "control route to A")
+        print("validator[control]: route -> A; warming the scorer "
+              "on normal traffic")
+        # warm: the in-process scorer online-trains on normal features
+        for _ in range(300):
+            assert await asyncio.to_thread(route) == b"A"
+            await asyncio.sleep(0.01)
+        assert reactor_metric("overrides_published") == 0
+
+        # fault the primary cluster: errors + latency. The predicates
+        # keep DRIVING traffic — scores only move while features flow.
+        fault["on"] = True
+        t0 = time.time()
+
+        def drive_then(metric: str, want: float):
+            def probe() -> bool:
+                try:
+                    route()
+                except Exception:  # noqa: BLE001 — faulted traffic may
+                    pass           # 503; the features still flowed
+                return reactor_metric(metric) >= want
+            return probe
+
+        await wait_for(
+            drive_then("overrides_published", 1),
+            60, "override publish (scores must cross the threshold)")
+        publish_s = time.time() - t0
+        await wait_for(lambda: route() == b"B", 10, "traffic shift to B")
+        shift_s = time.time() - t0
+        print(f"validator[control]: override published in "
+              f"{publish_s:.2f}s, traffic shifted in {shift_s:.2f}s")
+        _, _, body = http("GET", f"http://127.0.0.1:{ports['admin']}"
+                                 f"/control.json")
+        state = json.loads(body)
+        assert state["reactor"]["active_overrides"], state
+
+        # fault clears: healthy traffic through B drives scores down
+        fault["on"] = False
+        t0 = time.time()
+        await wait_for(
+            drive_then("overrides_reverted", 1), 60, "override revert")
+        await wait_for(lambda: route() == b"A", 10, "traffic return to A")
+        revert_s = time.time() - t0
+        print(f"validator[control]: reverted in {revert_s:.2f}s; "
+              f"zero flaps: "
+              f"{reactor_metric('overrides_published') == 1}")
+        assert reactor_metric("overrides_published") == 1, "flapped!"
+        print("CONTROL " + json.dumps({
+            "publish_s": round(publish_s, 2),
+            "shift_s": round(shift_s, 2),
+            "revert_s": round(revert_s, 2),
+        }))
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        d_a.close()
+        d_b.close()
 
 
 async def validate_scorer_latency() -> None:
@@ -738,6 +942,10 @@ async def main() -> int:
     if args and args[0] == "chaos":
         await validate_chaos()
         print("VALIDATOR PASS (chaos)")
+        return 0
+    if args and args[0] == "control":
+        await validate_control()
+        print("VALIDATOR PASS (control)")
         return 0
     if args and args[0] == "trace":
         await validate_trace()
