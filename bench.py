@@ -770,6 +770,13 @@ def main() -> None:
         detail["added_p99_ms"] = p.get("added_p99_ms")
         detail["paced_rate_rps"] = p.get("paced_rate_rps")
         detail["proxy_fastpath"] = p.get("fastpath")
+        # TLS rows ride the same subprocess run (native termination on
+        # the fastpath engine); absent — not zero — when the TLS leg
+        # failed, with the cause kept visible
+        detail["proxy_tls_req_s"] = p.get("proxy_tls_req_s")
+        detail["tls_added_p99_ms"] = p.get("tls_added_p99_ms")
+        if "tls_error" in p:
+            detail["proxy_tls_error"] = p["tls_error"]
         if "error" in p:
             detail["proxy_error"] = p["error"]
 
@@ -788,7 +795,13 @@ def main() -> None:
             "p99_ms")
         detail["grpc_saturation_req_s"] = g.get("grpc_saturation_req_s")
         detail["grpc_saturation_p99_ms"] = g.get("grpc_saturation_p99_ms")
+        detail["grpc_tls_saturation_req_s"] = g.get(
+            "grpc_tls_saturation_req_s")
+        detail["grpc_tls_saturation_p99_ms"] = g.get(
+            "grpc_tls_saturation_p99_ms")
         detail["grpc_loadgen"] = g.get("loadgen")
+        if "tls_error" in g:
+            detail["grpc_tls_error"] = g["tls_error"]
         if "error" in g:
             detail["grpc_error"] = g["error"]
 
@@ -828,12 +841,15 @@ def main() -> None:
     phases = [
         # fastest first: the headline line must exist on disk before
         # any phase that can wedge on the device tunnel gets a chance
-        # to (BENCH_r05 lost every number to exactly that)
+        # to (BENCH_r05 lost every number to exactly that). proxy/grpc
+        # — which carry the TLS rows — run BEFORE the scorer for the
+        # same reason: they never touch the device tunnel, and an
+        # rc:124 mid-scorer must not lose the TLS claim.
         ("static_analysis", ph_static),
         ("race_analysis", ph_race),
-        ("scorer", ph_scorer),
         ("proxy", ph_proxy),
         ("grpc", ph_grpc),
+        ("scorer", ph_scorer),
         ("auc", ph_auc),
         ("subtle_auc", ph_subtle),
         ("sharded_cpu8", ph_sharded),

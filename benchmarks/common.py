@@ -21,6 +21,23 @@ from typing import List, Optional, Tuple
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def gen_bench_cert(dirpath: str) -> Optional[Tuple[str, str]]:
+    """Self-signed cert/key for the TLS bench legs (openssl CLI; None —
+    TLS rows are skipped, cleartext rows stand — when unavailable)."""
+    cert = os.path.join(dirpath, "bench-cert.pem")
+    key = os.path.join(dirpath, "bench-key.pem")
+    try:
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+             "-keyout", key, "-out", cert, "-days", "2", "-nodes",
+             "-subj", "/CN=localhost",
+             "-addext", "subjectAltName=DNS:localhost,DNS:web,DNS:echo"],
+            check=True, capture_output=True, timeout=60)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return cert, key
+
+
 # ---------------------------------------------------------------- downstream
 
 class EchoProtocol(asyncio.Protocol):
@@ -173,6 +190,7 @@ async def run_load(host: str, port: int, duration_s: float,
 async def run_paced_load(host: str, port: int, duration_s: float,
                          rate_rps: float, connections: int = 16,
                          path: str = "/", host_header: str = "web",
+                         ssl_ctx=None,
                          ) -> Tuple[float, List[float], bool]:
     """Open-loop paced load at `rate_rps`: requests are issued on a clock
     over a pool of keep-alive connections (one outstanding request per
@@ -226,7 +244,9 @@ async def run_paced_load(host: str, port: int, duration_s: float,
 
     protos = []
     for _ in range(connections):
-        _, p = await loop.create_connection(lambda: _Paced(), host, port)
+        _, p = await loop.create_connection(
+            lambda: _Paced(), host, port, ssl=ssl_ctx,
+            server_hostname="localhost" if ssl_ctx else None)
         protos.append(p)
 
     interval = 1.0 / rate_rps

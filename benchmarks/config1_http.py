@@ -6,6 +6,9 @@ Measures:
   - direct_req_s         same load straight at the downstream (harness ceiling)
   - added_p99_ms         paced-rate p99(proxy) - p99(direct)
   - paced_rate_rps       the rate the added-latency run was paced at
+  - proxy_tls_req_s      saturation through the proxy's TLS server (native
+                         termination when --fastpath; h2bench h1loadtls)
+  - tls_added_p99_ms     paced-rate p99(TLS proxy) - p99(cleartext direct)
 
 Usage: python -m benchmarks.config1_http [--duration 10] [--rate 10000]
        [--fastpath]
@@ -23,7 +26,7 @@ import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from benchmarks.common import (  # noqa: E402
-    Proc, lat_stats, run_load, run_paced_load,
+    Proc, gen_bench_cert, lat_stats, run_load, run_paced_load,
 )
 
 CONFIG = """
@@ -42,7 +45,14 @@ routers:
   identifier: {{kind: io.l5d.methodAndHost}}
   servers:
   - port: 0
-{extra}
+{tls_server}{extra}
+"""
+
+TLS_SERVER = """\
+  - port: 0
+    tls:
+      certPath: {cert}
+      keyPath: {key}
 """
 
 
@@ -66,11 +76,19 @@ def main() -> dict:
         f.write(f"127.0.0.1 {echo_port}\n")
 
     extra = "  fastPath: true\n" if args.fastpath else ""
+    # second, TLS-terminating server on the same router (native
+    # termination under --fastpath); skipped when no cert can be minted
+    certs = gen_bench_cert(tmp.name)
+    tls_server = (TLS_SERVER.format(cert=certs[0], key=certs[1])
+                  if certs else "")
     cfg_path = os.path.join(tmp.name, "linker.yaml")
     with open(cfg_path, "w") as f:
-        f.write(CONFIG.format(disco=disco, extra=extra))
+        f.write(CONFIG.format(disco=disco, extra=extra,
+                              tls_server=tls_server))
     linker = Proc(["-m", "benchmarks.serve_linker", cfg_path])
-    proxy_port = linker.wait_ready()["ports"][0]
+    ports = linker.wait_ready()["ports"]
+    proxy_port = ports[0]
+    tls_port = ports[1] if certs and len(ports) > 1 else None
 
     out: dict = {"config": 1, "fastpath": args.fastpath}
     try:
@@ -136,6 +154,49 @@ def main() -> dict:
         out["paced_saturated"] = bool(dsat or psat)
         out["added_p99_ms"] = round(pstats["p99_ms"] - dstats["p99_ms"], 3)
         out["added_p50_ms"] = round(pstats["p50_ms"] - dstats["p50_ms"], 3)
+
+        # TLS legs: same saturation shape against the router's
+        # TLS-terminating server (native termination under --fastpath),
+        # and the same paced run for added latency over cleartext
+        # direct. A failed TLS leg must not discard the cleartext rows.
+        if tls_port is not None:
+            try:
+                from benchmarks.common import build_h2bench
+                h2bench = build_h2bench()
+                import subprocess as _sp
+                ext = _sp.run(
+                    [h2bench, "h1loadtls", "127.0.0.1", str(tls_port),
+                     "web", str(args.connections * args.window),
+                     str(min(4.0, args.duration))],
+                    capture_output=True, text=True, timeout=60)
+                if ext.returncode == 0 and ext.stdout.strip():
+                    tls_res = json.loads(ext.stdout)
+                    out["proxy_tls_ext"] = tls_res
+                    if (tls_res.get("errors", 1) == 0
+                            and tls_res.get("secs", 0)
+                            >= 0.9 * min(4.0, args.duration)):
+                        out["proxy_tls_req_s"] = tls_res["rps"]
+                        out["proxy_tls_lat"] = {
+                            "n": tls_res["reqs"],
+                            "p50_ms": tls_res["p50_ms"],
+                            "p99_ms": tls_res["p99_ms"]}
+                import ssl as _ssl
+                cctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_CLIENT)
+                cctx.load_verify_locations(certs[0])
+                ar3, tlats, tsat = asyncio.run(run_paced_load(
+                    "127.0.0.1", tls_port, min(5.0, args.duration),
+                    rate, ssl_ctx=cctx))
+                tstats = lat_stats(tlats)
+                out["paced_tls_proxy"] = tstats
+                out["paced_tls_saturated"] = bool(tsat)
+                out["tls_added_p99_ms"] = round(
+                    tstats["p99_ms"] - dstats["p99_ms"], 3)
+                out["tls_added_p50_ms"] = round(
+                    tstats["p50_ms"] - dstats["p50_ms"], 3)
+            except Exception as e:  # noqa: BLE001 — cleartext rows stand
+                out["tls_error"] = repr(e)
+        else:
+            out["tls_error"] = "no cert (openssl unavailable)"
     finally:
         linker.stop()
         echo.stop()

@@ -39,12 +39,20 @@ routers:
   fastPath: true
   dtab: |
     /svc => /#/io.l5d.fs ;
-  servers: [{{port: 0}}]
-telemetry:
+  servers:
+  - port: 0
+{tls_server}telemetry:
 - kind: io.l5d.prometheus
 namers:
 - kind: io.l5d.fs
   rootDir: {disco}
+"""
+
+TLS_SERVER = """\
+  - port: 0
+    tls:
+      certPath: {cert}
+      keyPath: {key}
 """
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -83,9 +91,16 @@ async def bench(duration: float, rate: float) -> dict:
         with open(os.path.join(disco, "echo"), "w") as f:
             f.write(f"127.0.0.1 {serve_port}\n")
 
-        linker = load_linker(CONFIG.format(disco=disco))
+        from benchmarks.common import gen_bench_cert
+        certs = gen_bench_cert(tmp.name)
+        tls_server = (TLS_SERVER.format(cert=certs[0], key=certs[1])
+                      if certs else "")
+        linker = load_linker(CONFIG.format(disco=disco,
+                                           tls_server=tls_server))
         await linker.start()
-        proxy_port = linker.routers[0].server_ports[0]
+        ports = linker.routers[0].server_ports
+        proxy_port = ports[0]
+        tls_port = ports[1] if certs and len(ports) > 1 else None
         h2 = H2Client("127.0.0.1", proxy_port)
         client = ClientDispatcher(h2, authority="echo")
         msg = Echo(payload=b"x" * 128)
@@ -118,13 +133,14 @@ async def bench(duration: float, rate: float) -> dict:
         out["grpc_lat"] = lat_stats(latencies)
         out["target_rate_rps"] = rate
 
-        async def run_loadgen(*extra: str, secs: float):
+        async def run_loadgen(*extra: str, secs: float,
+                              mode: str = "load", port: int = 0):
             """-> parsed result dict, or None when the loadgen failed (a
             failed external measurement must not discard the paced
             Python-client numbers already collected)."""
             proc = await asyncio.create_subprocess_exec(
-                h2bench, "load", "127.0.0.1", str(proxy_port), "echo",
-                "64", str(secs), "128", *extra,
+                h2bench, mode, "127.0.0.1", str(port or proxy_port),
+                "echo", "64", str(secs), "128", *extra,
                 stdout=asyncio.subprocess.PIPE)
             try:
                 stdout, _ = await asyncio.wait_for(proc.communicate(),
@@ -156,6 +172,19 @@ async def bench(duration: float, rate: float) -> dict:
             out["grpc_saturation_p50_ms"] = sat["p50_ms"]
             out["grpc_saturation_p99_ms"] = sat["p99_ms"]
             out["grpc_saturation_errors"] = sat["errors"]
+
+        # Same saturation shape against the NATIVE-TLS-terminating
+        # server (h2bench loadtls: ALPN h2, full encrypt both ways).
+        if tls_port is not None:
+            sat_tls = await run_loadgen(secs=min(4.0, duration / 2),
+                                        mode="loadtls", port=tls_port)
+            if sat_tls is not None:
+                out["grpc_tls_saturation_req_s"] = sat_tls["rps"]
+                out["grpc_tls_saturation_p50_ms"] = sat_tls["p50_ms"]
+                out["grpc_tls_saturation_p99_ms"] = sat_tls["p99_ms"]
+                out["grpc_tls_saturation_errors"] = sat_tls["errors"]
+        else:
+            out["tls_error"] = "no cert (openssl unavailable)"
 
         # prometheus telemeter must expose the router's stats (fastpath
         # stats flow through the controller on a 1s poll)

@@ -83,6 +83,8 @@ import linkerd_tpu.router.failure_accrual  # noqa: F401
 import linkerd_tpu.telemetry.anomaly  # noqa: F401
 import linkerd_tpu.telemetry.exporters  # noqa: F401
 
+log = logging.getLogger(__name__)
+
 DEFAULT_ADMIN_PORT = 9990  # ref: Linker.scala:37
 DEFAULT_HTTP_PORT = 4140   # ref: linkerd http router default
 
@@ -132,6 +134,11 @@ class ServerSpec:
     # per-server request timeout (ref: ServerConfig.timeoutMs ->
     # TimeoutFilter, Server.scala:85,96)
     timeoutMs: Optional[int] = None
+    # http only: gzip response compression (ref: HttpConfig.scala:202,248
+    # compressionLevel). -1 = automatic (compressible content types at
+    # the zlib default), 0 = off, 1..9 = always compress at that level
+    # when the client sends Accept-Encoding: gzip
+    compressionLevel: Optional[int] = None
 
 
 @dataclass
@@ -432,6 +439,8 @@ class Linker:
         self.telemeters: List[Any] = []
         self._file_sinks: List[Any] = []  # close() fns for file emitters
         self._logger_filters: List[Any] = []
+        # concatenated trustCerts bundles for native client TLS contexts
+        self._trust_bundles: List[str] = []
         try:
             self._build()
         except BaseException:
@@ -503,6 +512,17 @@ class Linker:
             labels_seen[label] = n + 1
             if n:
                 label = f"{label}-{n}"
+            for i, s in enumerate(rspec.servers or []):
+                if s.compressionLevel is None:
+                    continue
+                if not -1 <= s.compressionLevel <= 9:
+                    raise ConfigError(
+                        f"{label}.servers[{i}].compressionLevel must be "
+                        f"in -1..9, got {s.compressionLevel}")
+                if rspec.protocol != "http":
+                    raise ConfigError(
+                        f"{label}.servers[{i}].compressionLevel only "
+                        f"supports http routers")
             if rspec.protocol == "h2":
                 self.routers.append(self._mk_h2_router(rspec, label))
             elif rspec.protocol == "thrift":
@@ -617,7 +637,11 @@ class Linker:
                         f"{label}: {knob} is not supported with "
                         f"fastPath: true (the native h2 engine uses "
                         f"fixed SETTINGS)")
-            return self._mk_fastpath_router(rspec, label)
+            router = self._mk_fastpath_router(rspec, label)
+            if router is not None:
+                return router
+            # TLS requested but no native OpenSSL runtime: fall through
+            # to the Python data plane (graceful gate)
         base_dtab = Dtab.read(rspec.dtab) if rspec.dtab else Dtab.empty()
         prefix = Path.read(rspec.dstPrefix)
         # advertised SETTINGS for both sides (ref: H2Config.scala params);
@@ -1107,22 +1131,49 @@ class Linker:
         return Router(rspec, label, server_stack, binding, servers,
                       interpreter=interpreter)
 
+    def _fastpath_client_tls(self, rspec: RouterSpec,
+                             label: str) -> Optional[TlsClientConfig]:
+        """The router-wide client.tls block for a fastPath router, or
+        None. The native engine originates TLS per-endpoint with the
+        route authority as SNI/verified name, so only the router-wide
+        subset is honored: disableValidation + trustCerts. Per-prefix
+        (io.l5d.static) TLS, commonName templates, and clientAuth have
+        no native seam — refuse them rather than silently downgrade."""
+        raw = rspec.client
+        if not isinstance(raw, dict):
+            return None
+        if raw.get("kind") == "io.l5d.static":
+            if any(isinstance(c, dict) and "tls" in c
+                   for c in (raw.get("configs") or [])):
+                raise ConfigError(
+                    f"{label}: per-prefix client.tls (io.l5d.static) is "
+                    f"not supported with fastPath: true")
+            return None
+        if "tls" not in raw:
+            return None
+        spec = instantiate_as(TlsClientConfig, raw["tls"] or {},
+                              f"{label}.client.tls")
+        if spec.clientAuth is not None:
+            raise ConfigError(
+                f"{label}.client.tls: clientAuth is not supported with "
+                f"fastPath: true")
+        if spec.commonName is not None:
+            raise ConfigError(
+                f"{label}.client.tls: commonName is not supported with "
+                f"fastPath: true (the native engine verifies each "
+                f"endpoint against its route authority)")
+        return spec
+
     def _check_fastpath_spec(self, rspec: RouterSpec, label: str) -> None:
         """Refuse config the native engine cannot honor — silently
         dropping an operator's TLS or policy block would be worse than
         failing the load (same stance as the SETTINGS-knob gate)."""
-        def has_tls(raw) -> bool:
-            if not isinstance(raw, dict):
-                return False
-            if raw.get("kind") == "io.l5d.static":
-                return any(isinstance(c, dict) and "tls" in c
-                           for c in (raw.get("configs") or []))
-            return "tls" in raw
-
-        if has_tls(rspec.client):
-            raise ConfigError(
-                f"{label}: client.tls is not supported with "
-                f"fastPath: true (the native engine dials cleartext)")
+        self._fastpath_client_tls(rspec, label)  # raises on bad subsets
+        for i, srv in enumerate(rspec.servers or []):
+            if srv.tls is not None and srv.tls.caCertPath:
+                raise ConfigError(
+                    f"{label}.servers[{i}].tls: caCertPath (client-cert "
+                    f"verification) is not supported with fastPath: true")
         if rspec.service:
             raise ConfigError(
                 f"{label}: service policy (classifier/retries/timeout) "
@@ -1143,6 +1194,11 @@ class Linker:
                     f"{label}.servers[{i}].timeoutMs is not supported "
                     f"with fastPath: true (the engine applies its own "
                     f"timeouts)")
+            if srv.compressionLevel:
+                raise ConfigError(
+                    f"{label}.servers[{i}].compressionLevel is not "
+                    f"supported with fastPath: true (the native engine "
+                    f"proxies bodies byte-for-byte)")
 
     def _edge_resilience_filters(self, rspec: RouterSpec,
                                  label: str) -> List[Any]:
@@ -1247,14 +1303,23 @@ class Linker:
         self._logger_filters.extend(filters)
         return filters
 
-    def _mk_fastpath_router(self, rspec: RouterSpec, label: str) -> Router:
+    def _mk_fastpath_router(self, rspec: RouterSpec,
+                            label: str) -> Optional[Router]:
         """http or h2 router served by the native engine (fastPath: true).
 
         The engine owns the listeners and the request hot loop; naming,
         stats, and anomaly features flow through FastPathController. The
-        h2 engine (native/h2_fastpath.cpp) proxies h2c/gRPC frames with
+        h2 engine (native/h2_fastpath.cpp) proxies h2/gRPC frames with
         HPACK + both flow-control levels; the http engine
-        (native/fastpath.cpp) proxies HTTP/1.1."""
+        (native/fastpath.cpp) proxies HTTP/1.1. Both terminate and
+        originate TLS natively (tls_engine.h memory-BIO pump) when the
+        OpenSSL runtime is present; Python stays the control plane
+        (cert/key config, handshake-failure stats).
+
+        Returns None when the spec needs TLS but the OpenSSL runtime
+        could not be loaded — the caller then assembles the Python
+        router, which serves TLS on its own data plane (graceful gate,
+        not a load failure; mirrors the optional-native pattern)."""
         from linkerd_tpu import native
         from linkerd_tpu.router.fastpath import FastPathController
 
@@ -1263,22 +1328,88 @@ class Linker:
             raise ConfigError(
                 f"{label}: fastPath requires the native library "
                 "(no toolchain available to build it)")
+        engine_cls = (native.H2FastPathEngine if rspec.protocol == "h2"
+                      else native.FastPathEngine)
+        specs = rspec.servers or [ServerSpec()]
+        client_tls = self._fastpath_client_tls(rspec, label)
+        tls_servers = [s for s in specs if s.tls is not None]
+        if (tls_servers or client_tls is not None) \
+                and not engine_cls.tls_runtime_available():
+            log.warning(
+                "%s: fastPath TLS requested but the OpenSSL runtime is "
+                "unavailable natively; serving this router on the "
+                "Python data plane instead", label)
+            return None
+        # one accept-leg identity per engine: distinct cert pairs across
+        # a router's servers have no native seam
+        pairs = {(s.tls.certPath, s.tls.keyPath) for s in tls_servers}
+        if len(pairs) > 1:
+            raise ConfigError(
+                f"{label}: fastPath servers must share one TLS "
+                f"cert/key pair (got {len(pairs)} distinct pairs)")
         base_dtab = Dtab.read(rspec.dtab) if rspec.dtab else Dtab.empty()
         prefix = Path.read(rspec.dstPrefix)
         interpreter = self._mk_interpreter(rspec, label)
-        engine = (native.H2FastPathEngine() if rspec.protocol == "h2"
-                  else native.FastPathEngine())
-        specs = rspec.servers or [ServerSpec()]
-        ports = [engine.listen(s.ip, s.port) for s in specs]
+        engine = engine_cls()
+        if tls_servers:
+            tls = tls_servers[0].tls
+            if not tls.certPath or not tls.keyPath:
+                raise ConfigError(
+                    f"{label}.servers[].tls needs certPath and keyPath")
+            try:
+                engine.set_tls(tls.certPath, tls.keyPath)
+            except OSError as e:
+                raise ConfigError(f"{label}.servers[].tls: {e}") from None
+        if client_tls is not None:
+            ca = self._trust_bundle(client_tls.trustCerts, label)
+            try:
+                engine.set_client_tls(
+                    verify=not client_tls.disableValidation, ca_path=ca)
+            except OSError as e:
+                raise ConfigError(f"{label}.client.tls: {e}") from None
+        ports = [engine.listen_tls(s.ip, s.port) if s.tls is not None
+                 else engine.listen(s.ip, s.port) for s in specs]
         ctl = FastPathController(
             engine, interpreter, base_dtab, prefix, label, self.metrics,
             telemeters=self.telemeters)
         return _FastPathRouter(rspec, label, ctl, ports,
                                interpreter=interpreter)
 
+    def _trust_bundle(self, trust_certs: List[str],
+                      label: str) -> Optional[str]:
+        """trustCerts -> one CA file for the native client context (the
+        OpenSSL API takes a single location): pass-through for one path,
+        concatenated bundle (linker-owned tempfile) for several, None
+        (default roots) for none."""
+        if not trust_certs:
+            return None
+        if len(trust_certs) == 1:
+            return trust_certs[0]
+        import tempfile
+        # binary passthrough: distro bundles and `openssl -text` output
+        # carry non-ASCII preamble bytes OpenSSL happily skips
+        bundle = tempfile.NamedTemporaryFile(
+            mode="wb", suffix=".pem", prefix="l5d-trust-", delete=False)
+        try:
+            for path in trust_certs:
+                with open(path, "rb") as fh:
+                    bundle.write(fh.read())
+                    bundle.write(b"\n")
+        except OSError as e:
+            raise ConfigError(f"{label}.client.tls.trustCerts: {e}") \
+                from None
+        finally:
+            bundle.close()
+        self._trust_bundles.append(bundle.name)
+        return bundle.name
+
     def _mk_http_router(self, rspec: RouterSpec, label: str) -> Router:
         if rspec.fastPath:
-            return self._mk_fastpath_router(rspec, label)
+            router = self._mk_fastpath_router(rspec, label)
+            if router is not None:
+                return router
+            # TLS requested but no native OpenSSL runtime: fall through
+            # to the Python data plane (graceful gate)
         base_dtab = Dtab.read(rspec.dtab) if rspec.dtab else Dtab.empty()
         prefix = Path.read(rspec.dstPrefix)
         identifier = self._mk_identifier(
@@ -1470,7 +1601,8 @@ class Linker:
         servers = [
             HttpServer(per_server_stack(s), s.ip, s.port,
                        max_concurrency=s.maxConcurrentRequests,
-                       ssl_context=(s.tls.mk_context() if s.tls else None))
+                       ssl_context=(s.tls.mk_context() if s.tls else None),
+                       compression_level=s.compressionLevel)
             for s in (rspec.servers or [ServerSpec()])
         ]
         return Router(rspec, label, server_stack, binding, servers,
@@ -1553,6 +1685,13 @@ class Linker:
         self._close_sinks()
 
     def _close_sinks(self) -> None:
+        import os
+        for path in self._trust_bundles:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        self._trust_bundles.clear()
         for close in self._file_sinks:
             try:
                 close()

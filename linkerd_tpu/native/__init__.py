@@ -121,7 +121,26 @@ def huffman_encode(data: bytes) -> Optional[bytes]:
     return out.raw[:n]
 
 
+def _declare_tls(cdll: ctypes.CDLL, prefix: str) -> None:
+    """TLS exports shared by both engines (fp_* / fph2_*)."""
+    fn = getattr(cdll, prefix + "_tls_runtime_available")
+    fn.restype = ctypes.c_int
+    fn.argtypes = []
+    fn = getattr(cdll, prefix + "_set_tls")
+    fn.restype = ctypes.c_int
+    fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                   ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t]
+    fn = getattr(cdll, prefix + "_listen_tls")
+    fn.restype = ctypes.c_int
+    fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    fn = getattr(cdll, prefix + "_set_client_tls")
+    fn.restype = ctypes.c_int
+    fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+                   ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t]
+
+
 def _declare_h2_fastpath(cdll: ctypes.CDLL) -> None:
+    _declare_tls(cdll, "fph2")
     cdll.fph2_create.restype = ctypes.c_void_p
     cdll.fph2_create.argtypes = []
     cdll.fph2_start.restype = ctypes.c_int
@@ -152,6 +171,7 @@ def _declare_h2_fastpath(cdll: ctypes.CDLL) -> None:
 
 
 def _declare_fastpath(cdll: ctypes.CDLL) -> None:
+    _declare_tls(cdll, "fp")
     cdll.fp_create.restype = ctypes.c_void_p
     cdll.fp_create.argtypes = []
     cdll.fp_start.restype = ctypes.c_int
@@ -189,6 +209,8 @@ class FastPathEngine:
 
     FEATURE_DIM = 6  # route_id, latency_ms, status, req_b, rsp_b, ts_s
     _PREFIX = "fp"  # C symbol prefix; the h2 engine overrides to "fph2"
+    # ALPN preference list the engine's TLS contexts advertise/offer
+    _ALPN = "http/1.1"
 
     def __init__(self):
         cdll = lib()
@@ -221,6 +243,56 @@ class FastPathEngine:
         if got < 0:
             raise OSError(f"fastpath listen {ip}:{port} failed")
         return got
+
+    @classmethod
+    def tls_runtime_available(cls) -> bool:
+        """True when the engine could dlopen the OpenSSL runtime (TLS
+        termination/origination available natively)."""
+        cdll = lib()
+        if cdll is None:
+            return False
+        return bool(getattr(cdll, cls._PREFIX + "_tls_runtime_available")())
+
+    def set_tls(self, cert_path: str, key_path: str) -> None:
+        """Install the accept-leg TLS context (PEM cert chain + key).
+        Call before start(); listeners bound with listen_tls() then
+        terminate TLS with this identity (ALPN per engine protocol)."""
+        assert not self._started
+        err = ctypes.create_string_buffer(512)
+        rc = getattr(self._lib, self._PREFIX + "_set_tls")(
+            self._e, cert_path.encode(), key_path.encode(),
+            self._ALPN.encode(), err, len(err))
+        if rc != 0:
+            raise OSError(
+                f"fastpath TLS config failed: "
+                f"{err.value.decode('latin-1') or 'unknown error'}")
+
+    def listen_tls(self, ip: str, port: int) -> int:
+        """Bind a TLS-terminating listener (requires set_tls first);
+        returns the bound port. Call before start()."""
+        assert not self._started
+        got = getattr(self._lib, self._PREFIX + "_listen_tls")(
+            self._e, ip.encode(), port)
+        if got < 0:
+            raise OSError(f"fastpath TLS listen {ip}:{port} failed")
+        return got
+
+    def set_client_tls(self, verify: bool = True,
+                       ca_path: Optional[str] = None) -> None:
+        """Originate TLS to every upstream endpoint (router-wide
+        client.tls). The route authority is sent as SNI and, when
+        ``verify`` is set, pinned against the peer certificate;
+        ``ca_path`` replaces the default trust roots. Call before
+        start()."""
+        assert not self._started
+        err = ctypes.create_string_buffer(512)
+        rc = getattr(self._lib, self._PREFIX + "_set_client_tls")(
+            self._e, self._ALPN.encode(), 1 if verify else 0,
+            ca_path.encode() if ca_path else None, err, len(err))
+        if rc != 0:
+            raise OSError(
+                f"fastpath client TLS config failed: "
+                f"{err.value.decode('latin-1') or 'unknown error'}")
 
     def start(self) -> None:
         if not self._started:
@@ -311,6 +383,7 @@ class H2FastPathEngine(FastPathEngine):
     knowledge) on both sides and routes by ``:authority``."""
 
     _PREFIX = "fph2"
+    _ALPN = "h2"
 
     def set_response_timeout_ms(self, ms: int) -> None:
         """Window within which a dispatched stream's backend must START

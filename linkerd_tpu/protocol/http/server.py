@@ -19,18 +19,29 @@ from linkerd_tpu.router.service import Service
 log = logging.getLogger(__name__)
 
 
+#: content types worth compressing when compression_level is -1 (auto);
+#: binary media (images, archives, protobuf) is already entropy-coded
+_COMPRESSIBLE = ("text/", "application/json", "application/javascript",
+                 "application/xml", "+json", "+xml", "ecmascript")
+
+
 class HttpServer:
     def __init__(self, service: Service[Request, Response],
                  host: str = "127.0.0.1", port: int = 0,
                  max_body: int = codec.MAX_BODY,
                  max_concurrency: Optional[int] = None,
-                 ssl_context=None):
+                 ssl_context=None,
+                 compression_level: Optional[int] = None):
         self.service = service
         self.host = host
         self.port = port
         self.max_body = max_body
         # TLS termination (ref: TlsServerConfig.scala via ServerConfig tls)
         self.ssl_context = ssl_context
+        # gzip response compression (ref: HttpConfig.scala:202,248
+        # compressionLevel): None/0 = off, -1 = automatic (compressible
+        # content types at zlib default), 1..9 = always, at that level
+        self.compression_level = compression_level
         self._server: Optional[asyncio.base_events.Server] = None
         self._sem = (asyncio.Semaphore(max_concurrency)
                      if max_concurrency else None)
@@ -110,6 +121,8 @@ class HttpServer:
                 )
                 if conn_close:
                     rsp.headers.set("Connection", "close")
+                if self.compression_level:
+                    self._maybe_compress(req, rsp)
                 if rsp.body_stream is not None:
                     # watch-style chunked stream; terminal for this conn
                     # (the stream usually ends only when the client goes)
@@ -139,6 +152,35 @@ class HttpServer:
                 writer.close()
             except (OSError, RuntimeError):  # transport already detached
                 pass
+
+    def _maybe_compress(self, req: Request, rsp: Response) -> None:
+        """gzip the response in place when the configured level, the
+        client's Accept-Encoding, and the payload all warrant it. Bodies
+        that gzip would inflate (tiny or already-compressed) pass
+        through untouched."""
+        lvl = self.compression_level or 0
+        if (rsp.body_stream is not None or not rsp.body
+                or req.method == "HEAD"
+                or rsp.status in (204, 304) or rsp.status < 200
+                or rsp.headers.get("content-encoding") is not None):
+            return
+        accept = (req.headers.get("accept-encoding") or "").lower()
+        if "gzip" not in accept:
+            return
+        if lvl < 0:  # automatic: compressible content types only
+            ctype = (rsp.headers.get("content-type") or "").lower()
+            if not any(t in ctype for t in _COMPRESSIBLE):
+                return
+            lvl = 6  # zlib default
+        import gzip
+        body = gzip.compress(rsp.body, compresslevel=lvl)
+        if len(body) >= len(rsp.body):
+            return
+        rsp.body = body
+        rsp.headers.set("Content-Encoding", "gzip")
+        rsp.headers.remove("content-length")  # _ensure_length re-derives
+        if "accept-encoding" not in (rsp.headers.get("vary") or "").lower():
+            rsp.headers.add("Vary", "Accept-Encoding")
 
     async def _dispatch(self, req: Request) -> Response:
         try:

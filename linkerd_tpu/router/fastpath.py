@@ -130,6 +130,7 @@ class FastPathController:
         self._routes: Dict[str, _HostRoute] = {}
         self._tasks: List[asyncio.Task] = []
         self._last_stats: Dict[str, Dict[str, int]] = {}
+        self._last_tls: Dict[str, int] = {}
         self._id_to_host: Dict[int, str] = {}
         self._scope = metrics.scope("rt", label, "fastpath")
         from linkerd_tpu.models.features import DstTemporal
@@ -191,8 +192,22 @@ class FastPathController:
             except Exception:  # noqa: BLE001
                 log.exception("fastpath stats loop error")
 
+    _TLS_KEYS = ("handshakes", "failures", "resumed", "alpn_h2",
+                 "alpn_http1", "upstream_handshakes", "upstream_resumed",
+                 "upstream_failures")
+
     def _export_stats(self) -> None:
         snap = self.engine.stats()
+        tls = snap.get("tls")
+        if tls and (tls.get("enabled") or tls.get("client_enabled")):
+            scope = self._scope.scope("tls")
+            prev = self._last_tls
+            for key in self._TLS_KEYS:
+                delta = int(tls.get(key, 0)) - int(prev.get(key, 0))
+                if delta > 0:
+                    scope.counter(key).incr(delta)
+            self._last_tls = {k: int(tls.get(k, 0))
+                              for k in self._TLS_KEYS}
         for host, s in snap.get("routes", {}).items():
             if "id" in s:
                 self._id_to_host[int(s["id"])] = host

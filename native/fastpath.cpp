@@ -45,6 +45,8 @@
 #include <unordered_set>
 #include <vector>
 
+#include "tls_engine.h"
+
 namespace {
 
 constexpr size_t MAX_HEAD = 72 * 1024;
@@ -62,6 +64,10 @@ constexpr size_t OUT_LOW_WATER = 64 * 1024;
 // Bytes a client may buffer beyond the current request (pipelining /
 // parked-for-route). Beyond this the conn is abusive: close it.
 constexpr size_t MAX_BUFFERED_IN = 1 << 20;
+// Handshake budget: a TLS peer that hasn't completed its handshake in
+// this window is closed by the sweep (a slow handshaker must not pin
+// conn slots; the loop itself never blocks — everything is memory-BIO).
+constexpr uint64_t TLS_HS_TIMEOUT_US = 5'000'000;
 
 uint64_t now_us() {
     timespec ts;
@@ -332,6 +338,18 @@ struct Engine {
     std::unordered_map<int, Conn*> conns;
     std::vector<int> listeners;
     std::unordered_map<std::string, std::vector<Conn*>> parked;
+    // TLS: contexts are installed from Python BEFORE fp_start (the
+    // wrapper asserts), so the loop thread reads them without locking;
+    // TlsStats is written by the loop thread under mu (stats readers
+    // snapshot under the same mutex).
+    l5dtls::Ctx* tls_srv = nullptr;  // accept-leg termination
+    l5dtls::Ctx* tls_cli = nullptr;  // upstream-leg origination
+    bool tls_cli_verify = false;
+    std::unordered_set<int> tls_listeners;
+    l5dtls::TlsStats tls_stats;
+    // upstream session cache ("ip:port" -> last session) so fresh
+    // origination conns resume instead of full-handshaking (loop only)
+    std::unordered_map<std::string, l5dtls::SSL_SESSION*> tls_sessions;
     // written by the loop thread, read by fp_stats_json callers: atomic
     std::atomic<uint64_t> accepted{0};
     uint64_t last_sweep_us = 0;
@@ -375,7 +393,25 @@ struct Conn {
     bool rsp_head_parsed = false;
     bool rsp_eof_delim = false;
     int rsp_status = 0;
+
+    // TLS adapter (null = cleartext). `out` always holds wire bytes;
+    // app plaintext stages in tls->plain_out until flush encrypts it.
+    l5dtls::TlsIo* tls = nullptr;
+
+    ~Conn() { delete tls; }
 };
+
+// App-data write target: plaintext staging for TLS conns, the wire
+// buffer directly for cleartext ones.
+std::string* wbuf(Conn* c) {
+    return c->tls != nullptr ? &c->tls->plain_out : &c->out;
+}
+
+// Total un-sent bytes for watermark decisions (wire + staged plain).
+size_t outsz(const Conn* c) {
+    return c->out.size()
+        + (c->tls != nullptr ? c->tls->plain_out.size() : 0);
+}
 
 void ep_mod(Engine* e, Conn* c) {
     epoll_event ev{};
@@ -399,7 +435,7 @@ void ep_add(Engine* e, Conn* c) {
 void maybe_pause_producer(Engine* e, Conn* consumer) {
     Conn* producer = consumer->peer;
     if (producer != nullptr && !producer->paused &&
-        consumer->out.size() > OUT_HIGH_WATER) {
+        outsz(consumer) > OUT_HIGH_WATER) {
         producer->paused = true;
         ep_mod(e, producer);
     }
@@ -425,8 +461,32 @@ void push_feature(Engine* e, uint64_t route_id, uint64_t lat_us, int status,
 void conn_close(Engine* e, Conn* c);
 void process_client_buffer(Engine* e, Conn* c);
 
+// Record a handshake outcome in the engine's TLS stats (idempotent per
+// conn via TlsIo::accounted; mu guards against concurrent stats reads).
+void tls_account(Engine* e, Conn* c, bool failed) {
+    std::lock_guard<std::mutex> g(e->mu);
+    l5dtls::account_handshake(c->tls, &e->tls_stats,
+                              c->tls->sess->is_server, failed);
+}
+
 // flush c->out; returns false if the conn errored (and was freed)
 bool flush_out(Engine* e, Conn* c) {
+    if (c->tls != nullptr) {
+        bool was_hs = !c->tls->sess->hs_done;
+        if (!l5dtls::encrypt_pending(c->tls, &c->out)) {
+            tls_account(e, c, /*failed=*/was_hs);
+            // best effort: let the TLS alert reach the peer
+            if (!c->out.empty())
+                (void)::send(c->fd, c->out.data(), c->out.size(),
+                             MSG_NOSIGNAL);
+            conn_close(e, c);
+            return false;
+        }
+        if (was_hs && c->tls->sess->hs_done) {
+            c->tls->hs_deadline_us = 0;
+            tls_account(e, c, false);
+        }
+    }
     while (!c->out.empty()) {
         ssize_t n = ::send(c->fd, c->out.data(), c->out.size(),
                            MSG_NOSIGNAL);
@@ -439,7 +499,20 @@ bool flush_out(Engine* e, Conn* c) {
             return false;
         }
     }
-    if (c->out.empty() && c->close_when_flushed) {
+    if (c->out.empty() && c->close_when_flushed &&
+        (c->tls == nullptr || c->tls->plain_out.empty())) {
+        if (c->tls != nullptr && c->tls->sess->hs_done &&
+            !c->tls->shutdown_sent) {
+            // graceful TLS close so EOF-delimited bodies end cleanly
+            c->tls->shutdown_sent = true;
+            l5dtls::shutdown(c->tls->sess, &c->out);
+            while (!c->out.empty()) {
+                ssize_t n = ::send(c->fd, c->out.data(), c->out.size(),
+                                   MSG_NOSIGNAL);
+                if (n <= 0) break;
+                c->out.erase(0, (size_t)n);
+            }
+        }
         conn_close(e, c);
         return false;
     }
@@ -449,7 +522,7 @@ bool flush_out(Engine* e, Conn* c) {
         ep_mod(e, c);
     }
     // resume a paused producer once this buffer drains
-    if (c->out.size() < OUT_LOW_WATER && c->peer != nullptr &&
+    if (outsz(c) < OUT_LOW_WATER && c->peer != nullptr &&
         c->peer->paused) {
         c->peer->paused = false;
         ep_mod(e, c->peer);
@@ -467,10 +540,35 @@ bool send_simple(Engine* e, Conn* c, int status, const char* reason,
                      status, reason, extra_hdr,
                      close_conn ? "Connection: close\r\n" : "",
                      body.size());
-    c->out.append(head, (size_t)n);
-    c->out.append(body);
+    wbuf(c)->append(head, (size_t)n);
+    wbuf(c)->append(body);
     if (close_conn) c->close_when_flushed = true;
     return flush_out(e, c);
+}
+
+void stash_upstream_session(Engine* e, Conn* up) {
+    if (up->tls == nullptr || up->kind != Conn::Kind::UPSTREAM) return;
+    l5dtls::stash_session(
+        &e->tls_sessions,
+        l5dtls::session_key(up->ep_ip_be, up->ep_port, up->tls->sni),
+        up->tls->sess);
+}
+
+// Wrap a fresh origination socket in TLS when the engine has a client
+// context (SNI/verify name = the route host; cached session offered).
+void tls_wrap_upstream(Engine* e, Conn* up, const std::string& host) {
+    if (e->tls_cli == nullptr) return;
+    l5dtls::SSL_SESSION* resume = nullptr;
+    auto it = e->tls_sessions.find(
+        l5dtls::session_key(up->ep_ip_be, up->ep_port, host));
+    if (it != e->tls_sessions.end()) resume = it->second;
+    l5dtls::Sess* s = l5dtls::new_session(
+        e->tls_cli, host.c_str(), e->tls_cli_verify, resume);
+    if (s == nullptr) return;  // shim gone mid-flight: dial cleartext
+    up->tls = new l5dtls::TlsIo();
+    up->tls->sess = s;
+    up->tls->sni = host;
+    up->tls->hs_deadline_us = now_us() + TLS_HS_TIMEOUT_US;
 }
 
 void unregister_parked(Engine* e, Conn* c) {
@@ -515,6 +613,7 @@ void release_upstream(Engine* e, Conn* up, bool reusable) {
     }
     if (pooled) return;
     if (up->fd >= 0) {
+        stash_upstream_session(e, up);
         epoll_ctl(e->epfd, EPOLL_CTL_DEL, up->fd, nullptr);
         e->conns.erase(up->fd);
         ::close(up->fd);
@@ -527,6 +626,7 @@ void conn_close(Engine* e, Conn* c) {
     bool was_wait_route = (c->st == Conn::St::WAIT_ROUTE);
     c->st = Conn::St::CLOSED;
     if (c->fd >= 0) {
+        stash_upstream_session(e, c);
         epoll_ctl(e->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
         e->conns.erase(c->fd);
         ::close(c->fd);
@@ -580,7 +680,7 @@ void attach_upstream(Engine* e, Conn* client, Conn* up) {
     client->st = client->req_body.done()
         ? Conn::St::READ_RSP : Conn::St::FORWARD_BODY;
     client->deadline_us = 0;
-    up->out.append(client->req_stash);
+    wbuf(up)->append(client->req_stash);
     client->req_stash.clear();
     flush_out(e, up);
 }
@@ -658,6 +758,7 @@ int dispatch(Engine* e, Conn* client) {
                 up->fd = fd;
                 up->connecting = (rc < 0);
                 up->want_write = up->connecting;
+                tls_wrap_upstream(e, up, client->route_key);
                 ep_add(e, up);
             }
         }
@@ -748,6 +849,12 @@ bool try_start_request(Engine* e, Conn* client) {
         return send_simple(e, client, 400, "Bad Request",
                            "l5d-err: no host\r\n", "missing Host", false);
     }
+    if (!l5dtls::valid_authority(key)) {
+        // reject before the key can reach routing/parked maps, feature
+        // attribution, or the stats JSON (Host is untrusted input)
+        return send_simple(e, client, 400, "Bad Request",
+                           "l5d-err: bad host\r\n", "invalid Host", false);
+    }
 
     client->req_stash = std::move(staged);
     bool have_route;
@@ -820,6 +927,25 @@ void finish_exchange(Engine* e, Conn* up, bool upstream_reusable) {
     process_client_buffer(e, client);
 }
 
+// TCP EOF (or TLS close-notify) from an upstream: completes an
+// EOF-delimited response, otherwise tears the exchange down. On a TLS
+// conn only an authenticated close-notify may complete an
+// EOF-delimited body — a bare FIN is indistinguishable from an
+// attacker-injected truncation (RFC 8446 §6.1).
+void handle_upstream_eof(Engine* e, Conn* up) {
+    Conn* client = up->peer;
+    bool clean_eof = up->tls == nullptr || up->tls->close_notify;
+    if (clean_eof && client != nullptr && up->rsp_head_parsed &&
+        up->rsp_eof_delim) {
+        // EOF completes the response; client can't be kept alive.
+        // finish_exchange(reusable=false) fully disposes `up`.
+        client->close_after = true;
+        finish_exchange(e, up, false);
+    } else {
+        conn_close(e, up);
+    }
+}
+
 void on_upstream_readable(Engine* e, Conn* up) {
     char buf[64 * 1024];
     for (;;) {
@@ -830,24 +956,36 @@ void on_upstream_readable(Engine* e, Conn* up) {
             return;
         }
         if (n == 0) {
-            Conn* client = up->peer;
-            if (client != nullptr && up->rsp_head_parsed &&
-                up->rsp_eof_delim) {
-                // EOF completes the response; client can't be kept alive.
-                // finish_exchange(reusable=false) fully disposes `up`.
-                client->close_after = true;
-                finish_exchange(e, up, false);
-            } else {
-                conn_close(e, up);
-            }
+            handle_upstream_eof(e, up);
             return;
+        }
+        int tls_rc = 0;
+        if (up->tls != nullptr) {
+            bool was_hs = !up->tls->sess->hs_done;
+            tls_rc = l5dtls::ingest(up->tls, buf, (size_t)n, &up->in,
+                                    &up->out);
+            if (tls_rc < 0) {
+                tls_account(e, up, was_hs);
+                conn_close(e, up);
+                return;
+            }
+            if (was_hs && up->tls->sess->hs_done) {
+                up->tls->hs_deadline_us = 0;
+                tls_account(e, up, false);
+            }
+            // handshake records / staged request plaintext
+            if (!flush_out(e, up)) return;
         }
         Conn* client = up->peer;
         if (client == nullptr) {
+            // TLS-layer records (tickets) carry no plaintext and are
+            // fine on an idle pooled conn; app bytes are not
+            if (up->tls != nullptr && up->in.empty() && tls_rc == 0)
+                continue;
             conn_close(e, up);  // bytes on an unpaired conn: drop
             return;
         }
-        up->in.append(buf, (size_t)n);
+        if (up->tls == nullptr) up->in.append(buf, (size_t)n);
         while (!up->rsp_head_parsed) {
             if (up->in.find("\r\n\r\n") == std::string::npos) {
                 if (up->in.size() > MAX_HEAD) {
@@ -866,7 +1004,7 @@ void on_upstream_readable(Engine* e, Conn* up) {
                 conn_close(e, up);
                 return;
             }
-            client->out.append(up->in.data(), h.head_len);
+            wbuf(client)->append(up->in.data(), h.head_len);
             client->rsp_bytes += h.head_len;
             up->in.erase(0, h.head_len);
             if (h.status >= 100 && h.status < 200 && h.status != 101) {
@@ -884,7 +1022,7 @@ void on_upstream_readable(Engine* e, Conn* up) {
                 conn_close(e, up);
                 return;
             }
-            client->out.append(up->in.data(), (size_t)take);
+            wbuf(client)->append(up->in.data(), (size_t)take);
             client->rsp_bytes += (uint64_t)take;
             up->in.erase(0, (size_t)take);
         }
@@ -896,6 +1034,10 @@ void on_upstream_readable(Engine* e, Conn* up) {
         }
         maybe_pause_producer(e, client);  // up produces into client->out
     more:;
+        if (tls_rc == 1) {  // close-notify: buffered plaintext consumed
+            handle_upstream_eof(e, up);
+            return;
+        }
     }
 }
 
@@ -912,14 +1054,35 @@ void on_client_readable(Engine* e, Conn* c) {
             conn_close(e, c);
             return;
         }
-        c->in.append(buf, (size_t)n);
+        int tls_rc = 0;
+        if (c->tls != nullptr) {
+            bool was_hs = !c->tls->sess->hs_done;
+            tls_rc = l5dtls::ingest(c->tls, buf, (size_t)n, &c->in,
+                                    &c->out);
+            if (tls_rc < 0) {
+                tls_account(e, c, was_hs);
+                if (!c->out.empty())  // let the TLS alert out
+                    (void)::send(c->fd, c->out.data(), c->out.size(),
+                                 MSG_NOSIGNAL);
+                conn_close(e, c);
+                return;
+            }
+            if (was_hs && c->tls->sess->hs_done) {
+                c->tls->hs_deadline_us = 0;
+                tls_account(e, c, false);
+            }
+            // handshake records / resumption tickets
+            if (!flush_out(e, c)) return;
+        } else {
+            c->in.append(buf, (size_t)n);
+        }
         if (c->st == Conn::St::FORWARD_BODY && c->peer != nullptr) {
             long take = c->req_body.feed(c->in.data(), c->in.size());
             if (take < 0) {
                 conn_close(e, c);
                 return;
             }
-            c->peer->out.append(c->in.data(), (size_t)take);
+            wbuf(c->peer)->append(c->in.data(), (size_t)take);
             c->req_bytes += (uint64_t)take;
             c->in.erase(0, (size_t)take);
             if (!flush_out(e, c->peer)) return;
@@ -936,10 +1099,15 @@ void on_client_readable(Engine* e, Conn* c) {
             conn_close(e, c);
             return;
         }
+        if (tls_rc == 1) {  // clean TLS shutdown from the client
+            conn_close(e, c);
+            return;
+        }
     }
 }
 
 void on_listener(Engine* e, int lfd) {
+    bool tls = e->tls_srv != nullptr && e->tls_listeners.count(lfd) > 0;
     for (;;) {
         int fd = ::accept4(lfd, nullptr, nullptr, SOCK_NONBLOCK);
         if (fd < 0) return;
@@ -947,6 +1115,18 @@ void on_listener(Engine* e, int lfd) {
         Conn* c = new Conn();
         c->kind = Conn::Kind::CLIENT;
         c->fd = fd;
+        if (tls) {
+            l5dtls::Sess* s = l5dtls::new_session(e->tls_srv, nullptr,
+                                                  false, nullptr);
+            if (s == nullptr) {
+                ::close(fd);
+                delete c;
+                continue;
+            }
+            c->tls = new l5dtls::TlsIo();
+            c->tls->sess = s;
+            c->tls->hs_deadline_us = now_us() + TLS_HS_TIMEOUT_US;
+        }
         ep_add(e, c);
         e->accepted.fetch_add(1, std::memory_order_relaxed);
     }
@@ -957,9 +1137,19 @@ void sweep_timeouts(Engine* e) {
     if (now - e->last_sweep_us < 500'000) return;
     e->last_sweep_us = now;
     std::vector<Conn*> expired;
-    for (auto& kv : e->conns)
-        if (kv.second->deadline_us != 0 && now > kv.second->deadline_us)
-            expired.push_back(kv.second);
+    for (auto& kv : e->conns) {
+        Conn* c = kv.second;
+        // handshake budget: a TLS peer still mid-handshake past its
+        // window is a handshake failure (one list — a conn must not be
+        // collected twice, conn_close frees it immediately)
+        if (c->tls != nullptr && c->tls->hs_deadline_us != 0 &&
+            now > c->tls->hs_deadline_us) {
+            tls_account(e, c, /*failed=*/true);
+            expired.push_back(c);
+        } else if (c->deadline_us != 0 && now > c->deadline_us) {
+            expired.push_back(c);
+        }
+    }
     // endpoint churn orphans pooled IDLE conns: a route update that
     // drops an endpoint leaves its idle fds unreachable (no ep.idle
     // list holds them), so they would leak until the peer closes
@@ -1130,6 +1320,60 @@ int fp_listen(void* ep, const char* ip, int port) {
     return (int)ntohs(sa.sin_port);
 }
 
+// 1 when the OpenSSL runtime could be dlopen'd (TLS termination /
+// origination available), else 0.
+int fp_tls_runtime_available() { return l5dtls::available() ? 1 : 0; }
+
+// Install the accept-leg TLS context (cert/key PEM + ALPN preference
+// CSV, e.g. "http/1.1"). Call BEFORE fp_start. Returns 0, or -1 with
+// the OpenSSL error text in err.
+int fp_set_tls(void* ep, const char* cert, const char* key,
+               const char* alpn, char* err, size_t errcap) {
+    Engine* e = (Engine*)ep;
+    std::string why;
+    l5dtls::Ctx* c = l5dtls::server_ctx(cert, key, alpn, &why);
+    if (c == nullptr) {
+        if (err != nullptr && errcap > 0) {
+            snprintf(err, errcap, "%s", why.c_str());
+        }
+        return -1;
+    }
+    l5dtls::free_ctx(e->tls_srv);
+    e->tls_srv = c;
+    return 0;
+}
+
+// Like fp_listen, but connections accepted on this listener terminate
+// TLS (requires fp_set_tls first).
+int fp_listen_tls(void* ep, const char* ip, int port) {
+    Engine* e = (Engine*)ep;
+    if (e->tls_srv == nullptr) return -1;
+    int got = fp_listen(ep, ip, port);
+    if (got >= 0) e->tls_listeners.insert(e->listeners.back());
+    return got;
+}
+
+// Originate TLS to every upstream endpoint (the router-wide client.tls
+// block). verify=0 skips chain/hostname validation
+// (tls.disableValidation parity); ca_path, when set, replaces the
+// default trust roots. Call BEFORE fp_start.
+int fp_set_client_tls(void* ep, const char* alpn, int verify,
+                      const char* ca_path, char* err, size_t errcap) {
+    Engine* e = (Engine*)ep;
+    std::string why;
+    l5dtls::Ctx* c = l5dtls::client_ctx(alpn, verify != 0, ca_path, &why);
+    if (c == nullptr) {
+        if (err != nullptr && errcap > 0) {
+            snprintf(err, errcap, "%s", why.c_str());
+        }
+        return -1;
+    }
+    l5dtls::free_ctx(e->tls_cli);
+    e->tls_cli = c;
+    e->tls_cli_verify = verify != 0;
+    return 0;
+}
+
 // endpoints: space-separated "ip:port" entries (trailing space ok).
 int fp_set_route(void* ep, const char* host, const char* endpoints) {
     Engine* e = (Engine*)ep;
@@ -1213,11 +1457,12 @@ long fp_stats_json(void* ep, char* buf, size_t cap) {
     for (auto& kv : e->routes) {
         RouteStats& st = kv.second.stats;
         char tmp[256];
+        s += first ? "\"" : ",\"";
+        l5dtls::json_escape(kv.first, &s);  // keys came off the wire
         snprintf(tmp, sizeof(tmp),
-                 "%s\"%s\":{\"id\":%llu,\"requests\":%llu,\"success\":%llu,"
+                 "\":{\"id\":%llu,\"requests\":%llu,\"success\":%llu,"
                  "\"f4xx\":%llu,\"f5xx\":%llu,\"conn_fail\":%llu,"
                  "\"hist\":[",
-                 first ? "" : ",", kv.first.c_str(),
                  (unsigned long long)kv.second.id,
                  (unsigned long long)st.requests,
                  (unsigned long long)st.success,
@@ -1234,12 +1479,28 @@ long fp_stats_json(void* ep, char* buf, size_t cap) {
         s += "]}";
         first = false;
     }
-    char tail[128];
+    char tail[512];
+    l5dtls::TlsStats& t = e->tls_stats;
     snprintf(tail, sizeof(tail),
-             "},\"accepted\":%llu,\"features_dropped\":%llu}",
+             "},\"accepted\":%llu,\"features_dropped\":%llu,"
+             "\"tls\":{\"handshakes\":%llu,\"failures\":%llu,"
+             "\"resumed\":%llu,\"alpn_h2\":%llu,\"alpn_http1\":%llu,"
+             "\"upstream_handshakes\":%llu,\"upstream_resumed\":%llu,"
+             "\"upstream_failures\":%llu,\"enabled\":%s,"
+             "\"client_enabled\":%s}}",
              (unsigned long long)e->accepted.load(
                  std::memory_order_relaxed),
-             (unsigned long long)e->features_dropped);
+             (unsigned long long)e->features_dropped,
+             (unsigned long long)t.handshakes,
+             (unsigned long long)t.failures,
+             (unsigned long long)t.resumed,
+             (unsigned long long)t.alpn_h2,
+             (unsigned long long)t.alpn_http1,
+             (unsigned long long)t.up_handshakes,
+             (unsigned long long)t.up_resumed,
+             (unsigned long long)t.up_failures,
+             e->tls_srv != nullptr ? "true" : "false",
+             e->tls_cli != nullptr ? "true" : "false");
     s += tail;
     if (s.size() + 1 > cap) return -2;
     memcpy(buf, s.data(), s.size());
@@ -1271,6 +1532,9 @@ void fp_shutdown(void* ep) {
         delete kv.second;
     }
     for (int lfd : e->listeners) ::close(lfd);
+    for (auto& kv : e->tls_sessions) l5dtls::free_ssl_session(kv.second);
+    l5dtls::free_ctx(e->tls_srv);
+    l5dtls::free_ctx(e->tls_cli);
     ::close(e->wakefd);
     ::close(e->epfd);
     delete e;

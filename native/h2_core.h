@@ -568,6 +568,10 @@ struct Session {
     // connection-level flow control
     int64_t send_win = DEFAULT_WINDOW;  // how much we may send
     uint64_t recv_unacked = 0;          // received but not yet WINDOW_UPDATEd
+    // how much the peer may still send us (our advertised window minus
+    // consumed DATA): receive-side enforcement — going negative is a
+    // FLOW_CONTROL_ERROR on the peer (RFC 7540 §6.9)
+    int64_t recv_win = DEFAULT_WINDOW;
     bool preface_seen = false;          // server side: peer preface consumed
     bool settings_acked = false;
     // header-block accumulation (HEADERS..CONTINUATION)

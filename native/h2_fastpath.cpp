@@ -12,12 +12,18 @@
 // demux, H2.scala:29 SingletonPool — one multiplexed upstream connection
 // per endpoint), RoutingFactory.scala:154-187 (identify->bind->dispatch).
 //
-// Scope: h2c prior-knowledge both sides, full HPACK (h2_core.h), both
-// flow-control levels with bounded buffering, CONTINUATION, trailers,
+// Scope: h2 over TLS (ALPN "h2") and h2c prior-knowledge on both
+// sides, full HPACK (h2_core.h), both flow-control levels with bounded
+// buffering AND receive-side enforcement, CONTINUATION, trailers,
 // PING, RST propagation, GOAWAY-reconnect (refused streams replay when
 // the request is still retained, mirroring BufferedStream.scala:29's
 // retry-buffer idea), MAX_CONCURRENT_STREAMS queueing toward upstreams.
-// TLS/ALPN and h1->h2c upgrade stay on the Python path.
+// TLS rides tls_engine.h/tls_shim.h (non-blocking memory BIOs: the
+// loop owns the sockets, OpenSSL never sees an fd); h1->h2c upgrade
+// stays on the Python path. Writes are coalesced per socket wakeup —
+// frame producers mark a conn dirty and the loop flushes each dirty
+// conn once per epoll round (one send() per burst, one TLS record
+// batch per burst).
 
 #include <arpa/inet.h>
 #include <errno.h>
@@ -39,9 +45,11 @@
 #include <mutex>
 #include <string>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include "h2_core.h"
+#include "tls_engine.h"
 
 namespace {
 
@@ -65,6 +73,9 @@ constexpr size_t OUT_HIGH = 1 << 20;       // stop pumping into a fat out-buf
 constexpr size_t RETAIN_CAP = 64 * 1024;   // GOAWAY-replay request buffer
 constexpr size_t PARKED_PEND_CAP = 1 << 20;
 constexpr uint32_t MAX_FRAME_OK = 17000;   // tolerated frame size
+// TLS handshake budget (see fastpath.cpp): mid-handshake past this
+// window -> closed by the sweep, counted as a handshake failure
+constexpr uint64_t TLS_HS_TIMEOUT_US = 5'000'000;
 
 uint64_t now_us() {
     timespec ts;
@@ -142,6 +153,18 @@ struct Engine {
     std::unordered_map<int, H2Conn*> conns;
     std::vector<int> listeners;
     std::unordered_map<std::string, std::vector<PStream*>> parked;
+    // write coalescing: conns with pending frames, flushed once per
+    // epoll round (true only while the loop thread runs — outside it,
+    // queue_flush degrades to an immediate flush)
+    std::vector<H2Conn*> dirty;
+    bool defer_ok = false;
+    // TLS (installed from Python BEFORE fph2_start; loop-thread reads)
+    l5dtls::Ctx* tls_srv = nullptr;
+    l5dtls::Ctx* tls_cli = nullptr;
+    bool tls_cli_verify = false;
+    std::unordered_set<int> tls_listeners;
+    l5dtls::TlsStats tls_stats;  // written by the loop thread under mu
+    std::unordered_map<std::string, l5dtls::SSL_SESSION*> tls_sessions;
     // conns/streams closed mid-handler; freed at a safe point in the
     // loop so pointers held across a frame-handler call stay valid
     std::vector<H2Conn*> graveyard;
@@ -182,7 +205,23 @@ struct H2Conn {
     // sweep bookkeeping: when this (upstream) conn last had no streams;
     // 0 while it has work
     uint64_t idle_since_us = 0;
+
+    // TLS adapter (null = cleartext); `out` always holds wire bytes,
+    // app plaintext stages in tls->plain_out until flush encrypts it
+    l5dtls::TlsIo* tls = nullptr;
+    bool flush_queued = false;  // on the engine's dirty list
+
+    ~H2Conn() { delete tls; }
 };
+
+std::string* wbuf(H2Conn* c) {
+    return c->tls != nullptr ? &c->tls->plain_out : &c->out;
+}
+
+size_t outsz(const H2Conn* c) {
+    return c->out.size()
+        + (c->tls != nullptr ? c->tls->plain_out.size() : 0);
+}
 
 struct PStream {
     H2Conn* cc = nullptr;
@@ -223,6 +262,10 @@ struct PStream {
     int64_t c_swin = 0;
 
     uint64_t c_runacked = 0, u_runacked = 0;  // recv not yet granted back
+    // receive-side enforcement: how much each peer may still send on
+    // this stream (our advertised initial window + grants − DATA seen);
+    // negative = the peer overran our window -> FLOW_CONTROL_ERROR
+    int64_t c_recv_win = 0, u_recv_win = 0;
     bool parked = false;
     uint64_t park_deadline_us = 0;
     // finished: unlinked from both conns, awaiting graveyard free. Every
@@ -251,8 +294,29 @@ void ep_add(Engine* e, H2Conn* c) {
 
 void conn_close(Engine* e, H2Conn* c);
 
+void tls_account(Engine* e, H2Conn* c, bool failed) {
+    std::lock_guard<std::mutex> g(e->mu);
+    l5dtls::account_handshake(c->tls, &e->tls_stats,
+                              c->tls->sess->is_server, failed);
+}
+
 bool flush_out(Engine* e, H2Conn* c) {
     if (c->dead) return false;
+    if (c->tls != nullptr) {
+        bool was_hs = !c->tls->sess->hs_done;
+        if (!l5dtls::encrypt_pending(c->tls, &c->out)) {
+            tls_account(e, c, /*failed=*/was_hs);
+            if (!c->out.empty())  // best effort: alert out
+                (void)::send(c->fd, c->out.data(), c->out.size(),
+                             MSG_NOSIGNAL);
+            conn_close(e, c);
+            return false;
+        }
+        if (was_hs && c->tls->sess->hs_done) {
+            c->tls->hs_deadline_us = 0;
+            tls_account(e, c, false);
+        }
+    }
     while (!c->out.empty()) {
         ssize_t n = ::send(c->fd, c->out.data(), c->out.size(),
                            MSG_NOSIGNAL);
@@ -265,7 +329,19 @@ bool flush_out(Engine* e, H2Conn* c) {
             return false;
         }
     }
-    if (c->out.empty() && c->closing) {
+    if (c->out.empty() && c->closing &&
+        (c->tls == nullptr || c->tls->plain_out.empty())) {
+        if (c->tls != nullptr && c->tls->sess->hs_done &&
+            !c->tls->shutdown_sent) {
+            c->tls->shutdown_sent = true;
+            l5dtls::shutdown(c->tls->sess, &c->out);
+            while (!c->out.empty()) {
+                ssize_t n = ::send(c->fd, c->out.data(), c->out.size(),
+                                   MSG_NOSIGNAL);
+                if (n <= 0) break;
+                c->out.erase(0, (size_t)n);
+            }
+        }
         conn_close(e, c);
         return false;
     }
@@ -275,6 +351,64 @@ bool flush_out(Engine* e, H2Conn* c) {
         ep_mod(e, c);
     }
     return true;
+}
+
+// Mark a conn for the end-of-wakeup flush pass. Frame producers call
+// this instead of flushing inline, so a burst of frames (a whole read's
+// worth of requests, grants, PING acks) leaves in ONE send() — and for
+// TLS conns, one SSL_write batch — per socket wakeup. Outside the loop
+// thread's run window (startup/shutdown) it degrades to an immediate
+// flush so teardown writes still reach the wire.
+void queue_flush(Engine* e, H2Conn* c) {
+    if (c->dead) return;
+    if (!e->defer_ok) {
+        flush_out(e, c);
+        return;
+    }
+    if (!c->flush_queued) {
+        c->flush_queued = true;
+        e->dirty.push_back(c);
+    }
+}
+
+void pump_upstream(Engine* e, PStream* st);
+void pump_client(Engine* e, PStream* st);
+
+// Flush every dirty conn; when a flush frees room below the pump gate,
+// resume the conn's streams (they stalled on OUT_HIGH) — which may mark
+// more conns dirty, hence the bounded rounds + plain-flush tail.
+void drain_dirty(Engine* e) {
+    for (int round = 0; round < 8 && !e->dirty.empty(); round++) {
+        std::vector<H2Conn*> batch;
+        batch.swap(e->dirty);
+        for (H2Conn* c : batch) {
+            c->flush_queued = false;
+            if (c->dead) continue;
+            size_t before = outsz(c);
+            if (!flush_out(e, c)) continue;
+            if (before > OUT_HIGH && outsz(c) < OUT_HIGH) {
+                std::vector<PStream*> sts;
+                sts.reserve(c->streams.size());
+                for (auto& kv : c->streams) sts.push_back(kv.second);
+                for (PStream* st : sts) {
+                    if (c->dead) break;
+                    if (st->closed) continue;
+                    if (c->kind == H2Conn::Kind::CLIENT)
+                        pump_client(e, st);
+                    else
+                        pump_upstream(e, st);
+                }
+            }
+        }
+    }
+    while (!e->dirty.empty()) {  // close cascades only: flush, no pump
+        std::vector<H2Conn*> batch;
+        batch.swap(e->dirty);
+        for (H2Conn* c : batch) {
+            c->flush_queued = false;
+            if (!c->dead) flush_out(e, c);
+        }
+    }
 }
 
 void push_feature(Engine* e, uint64_t route_id, uint64_t lat_us, int status,
@@ -303,6 +437,7 @@ void write_headers(H2Conn* c, uint32_t stream_id,
     size_t maxf = c->s.peer_max_frame;
     size_t off = 0;
     bool first = true;
+    std::string* out = wbuf(c);
     do {
         size_t n = block.size() - off;
         if (n > maxf) n = maxf;
@@ -311,7 +446,7 @@ void write_headers(H2Conn* c, uint32_t stream_id,
         uint8_t flags = 0;
         if (first && end_stream) flags |= h2::FLAG_END_STREAM;
         if (last) flags |= h2::FLAG_END_HEADERS;
-        h2::write_frame(&c->out, type, flags, stream_id, block.data() + off,
+        h2::write_frame(out, type, flags, stream_id, block.data() + off,
                         n);
         off += n;
         first = false;
@@ -327,7 +462,7 @@ void synth_response(Engine* e, H2Conn* cc, uint32_t cid, int status,
     if (errmsg) hs.push_back({"l5d-err", errmsg});
     hs.push_back({"content-length", "0"});
     write_headers(cc, cid, hs, true);
-    flush_out(e, cc);
+    queue_flush(e, cc);
 }
 
 void unregister_parked(Engine* e, PStream* st) {
@@ -397,9 +532,10 @@ void finish_stream(Engine* e, PStream* st, bool record) {
 
 void conn_grant(Engine* e, H2Conn* c) {
     if (c->s.recv_unacked >= CONN_GRANT && c->buffered < CONN_BUF_HIGH) {
-        h2::write_window_update(&c->out, 0, (uint32_t)c->s.recv_unacked);
+        h2::write_window_update(wbuf(c), 0, (uint32_t)c->s.recv_unacked);
+        c->s.recv_win += (int64_t)c->s.recv_unacked;
         c->s.recv_unacked = 0;
-        flush_out(e, c);
+        queue_flush(e, c);
     }
 }
 
@@ -410,18 +546,20 @@ void stream_grant(Engine* e, PStream* st, bool from_client) {
     if (from_client) {
         if (st->cc != nullptr && st->c_runacked >= STREAM_GRANT &&
             st->u_pend.size() < PEND_HIGH && !st->req_end_seen) {
-            h2::write_window_update(&st->cc->out, st->cid,
+            h2::write_window_update(wbuf(st->cc), st->cid,
                                     (uint32_t)st->c_runacked);
+            st->c_recv_win += (int64_t)st->c_runacked;
             st->c_runacked = 0;
-            flush_out(e, st->cc);
+            queue_flush(e, st->cc);
         }
     } else {
         if (st->uc != nullptr && st->uid && st->u_runacked >= STREAM_GRANT
             && st->c_pend.size() < PEND_HIGH) {
-            h2::write_window_update(&st->uc->out, st->uid,
+            h2::write_window_update(wbuf(st->uc), st->uid,
                                     (uint32_t)st->u_runacked);
+            st->u_recv_win += (int64_t)st->u_runacked;
             st->u_runacked = 0;
-            flush_out(e, st->uc);
+            queue_flush(e, st->uc);
         }
     }
 }
@@ -433,7 +571,7 @@ void pump_upstream(Engine* e, PStream* st) {
     if (st->closed) return;
     H2Conn* uc = st->uc;
     if (uc == nullptr || !st->req_hdrs_sent || st->req_end_sent) return;
-    if (uc->out.size() > OUT_HIGH) return;  // re-pumped on flush drain
+    if (outsz(uc) > OUT_HIGH) return;  // re-pumped on flush drain
     while (!st->u_pend.empty() && st->u_swin > 0 && uc->s.send_win > 0) {
         size_t n = st->u_pend.size();
         if ((int64_t)n > st->u_swin) n = (size_t)st->u_swin;
@@ -441,7 +579,7 @@ void pump_upstream(Engine* e, PStream* st) {
         if (n > uc->s.peer_max_frame) n = uc->s.peer_max_frame;
         bool end = st->u_pend_end && !st->u_has_trailers &&
                    n == st->u_pend.size();
-        h2::write_frame(&uc->out, h2::DATA,
+        h2::write_frame(wbuf(uc), h2::DATA,
                         end ? h2::FLAG_END_STREAM : 0, st->uid,
                         st->u_pend.data(), n);
         st->u_pend.erase(0, n);
@@ -449,20 +587,20 @@ void pump_upstream(Engine* e, PStream* st) {
         uc->s.send_win -= (int64_t)n;
         if (st->cc != nullptr) st->cc->buffered -= n;
         if (end) st->req_end_sent = true;
-        if (uc->out.size() > OUT_HIGH) break;
+        if (outsz(uc) > OUT_HIGH) break;
     }
     if (st->u_pend.empty() && !st->req_end_sent) {
         if (st->u_has_trailers) {
             write_headers(uc, st->uid, st->u_trailers, true);
             st->req_end_sent = true;
         } else if (st->u_pend_end) {
-            h2::write_frame(&uc->out, h2::DATA, h2::FLAG_END_STREAM,
+            h2::write_frame(wbuf(uc), h2::DATA, h2::FLAG_END_STREAM,
                             st->uid, nullptr, 0);
             st->req_end_sent = true;
         }
     }
-    flush_out(e, uc);
-    // flush_out failure can conn_close(uc), which finishes/replays st
+    queue_flush(e, uc);
+    // a degraded (immediate) flush can conn_close(uc) -> finish/replay
     if (st->closed) return;
     if (st->cc != nullptr) {
         stream_grant(e, st, true);
@@ -476,7 +614,7 @@ void pump_client(Engine* e, PStream* st) {
     if (st->closed) return;
     H2Conn* cc = st->cc;
     if (cc == nullptr || st->rsp_end_sent) return;
-    if (cc->out.size() > OUT_HIGH) return;
+    if (outsz(cc) > OUT_HIGH) return;
     while (!st->c_pend.empty() && st->c_swin > 0 && cc->s.send_win > 0) {
         size_t n = st->c_pend.size();
         if ((int64_t)n > st->c_swin) n = (size_t)st->c_swin;
@@ -484,7 +622,7 @@ void pump_client(Engine* e, PStream* st) {
         if (n > cc->s.peer_max_frame) n = cc->s.peer_max_frame;
         bool end = st->c_pend_end && !st->c_has_trailers &&
                    n == st->c_pend.size();
-        h2::write_frame(&cc->out, h2::DATA,
+        h2::write_frame(wbuf(cc), h2::DATA,
                         end ? h2::FLAG_END_STREAM : 0, st->cid,
                         st->c_pend.data(), n);
         st->c_pend.erase(0, n);
@@ -492,20 +630,20 @@ void pump_client(Engine* e, PStream* st) {
         cc->s.send_win -= (int64_t)n;
         if (st->uc != nullptr) st->uc->buffered -= n;
         if (end) st->rsp_end_sent = true;
-        if (cc->out.size() > OUT_HIGH) break;
+        if (outsz(cc) > OUT_HIGH) break;
     }
     if (st->c_pend.empty() && !st->rsp_end_sent) {
         if (st->c_has_trailers) {
             write_headers(cc, st->cid, st->c_trailers, true);
             st->rsp_end_sent = true;
         } else if (st->c_pend_end) {
-            h2::write_frame(&cc->out, h2::DATA, h2::FLAG_END_STREAM,
+            h2::write_frame(wbuf(cc), h2::DATA, h2::FLAG_END_STREAM,
                             st->cid, nullptr, 0);
             st->rsp_end_sent = true;
         }
     }
-    flush_out(e, cc);
-    // flush_out failure can conn_close(cc), which finishes st
+    queue_flush(e, cc);
+    // a degraded (immediate) flush can conn_close(cc) -> finish st
     if (st->closed) return;
     if (st->uc != nullptr) {
         stream_grant(e, st, false);
@@ -515,6 +653,14 @@ void pump_client(Engine* e, PStream* st) {
 }
 
 // ---- upstream dispatch ----
+
+void stash_upstream_session(Engine* e, H2Conn* up) {
+    if (up->tls == nullptr || up->kind != H2Conn::Kind::UPSTREAM) return;
+    l5dtls::stash_session(
+        &e->tls_sessions,
+        l5dtls::session_key(up->ep_ip_be, up->ep_port, up->tls->sni),
+        up->tls->sess);
+}
 
 H2Conn* mk_upstream(Engine* e, const std::string& route_key,
                     uint64_t route_id, uint32_t ip_be, uint16_t port) {
@@ -539,18 +685,35 @@ H2Conn* mk_upstream(Engine* e, const std::string& route_key,
     c->route_id = route_id;
     c->ep_ip_be = ip_be;
     c->ep_port = port;
+    if (e->tls_cli != nullptr) {
+        // originate TLS (SNI/verify name = the route authority), with
+        // the endpoint's cached session offered for resumption
+        l5dtls::SSL_SESSION* resume = nullptr;
+        auto it = e->tls_sessions.find(
+            l5dtls::session_key(ip_be, port, route_key));
+        if (it != e->tls_sessions.end()) resume = it->second;
+        l5dtls::Sess* s = l5dtls::new_session(
+            e->tls_cli, route_key.c_str(), e->tls_cli_verify, resume);
+        if (s != nullptr) {
+            c->tls = new l5dtls::TlsIo();
+            c->tls->sess = s;
+            c->tls->sni = route_key;
+            c->tls->hs_deadline_us = now_us() + TLS_HS_TIMEOUT_US;
+        }
+    }
     // client preface + our SETTINGS + a big connection window
-    c->out.append(h2::PREFACE, h2::PREFACE_LEN);
-    h2::write_settings(&c->out,
+    wbuf(c)->append(h2::PREFACE, h2::PREFACE_LEN);
+    h2::write_settings(wbuf(c),
                        {{h2::S_HEADER_TABLE_SIZE, 4096},
                         {h2::S_INITIAL_WINDOW_SIZE,
                          (uint32_t)OUR_STREAM_WIN},
                         {h2::S_MAX_FRAME_SIZE, h2::DEFAULT_MAX_FRAME}},
                        false);
-    h2::write_window_update(&c->out, 0,
+    h2::write_window_update(wbuf(c), 0,
                             (uint32_t)(OUR_CONN_WIN - h2::DEFAULT_WINDOW));
+    c->s.recv_win = OUR_CONN_WIN;
     ep_add(e, c);
-    if (!c->connecting) flush_out(e, c);
+    if (!c->connecting) queue_flush(e, c);
     return c;
 }
 
@@ -563,11 +726,18 @@ void send_request_headers(Engine* e, PStream* st, H2Conn* uc) {
     uc->streams[st->uid] = st;
     uc->active_streams++;
     st->u_swin = uc->s.peer_init_win;
+    st->u_recv_win = OUR_STREAM_WIN;  // what we advertised upstream
     st->req_hdrs_sent = true;
     bool end = st->req_end_seen && st->u_pend.empty() &&
                !st->u_has_trailers;
     write_headers(uc, st->uid, st->req_hdrs, end);
     if (end) st->req_end_sent = true;
+    // queue the flush HERE, not just in pump_upstream: for an empty-body
+    // request pump_upstream early-returns on req_end_sent and the
+    // HEADERS would otherwise sit in wbuf until some other frame flushes
+    // this conn
+    queue_flush(e, uc);
+    if (st->closed) return;  // a degraded flush can close uc underneath
     pump_upstream(e, st);
 }
 
@@ -726,6 +896,7 @@ void conn_close(Engine* e, H2Conn* c) {
     c->dead = true;
     e->graveyard.push_back(c);
     if (c->fd >= 0) {
+        stash_upstream_session(e, c);
         epoll_ctl(e->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
         e->conns.erase(c->fd);
         ::close(c->fd);
@@ -739,10 +910,10 @@ void conn_close(Engine* e, H2Conn* c) {
         for (PStream* st : sts) {
             st->cc = nullptr;  // conn is gone
             if (st->uc != nullptr && st->uid)
-                h2::write_rst(&st->uc->out, st->uid, h2::CANCEL);
+                h2::write_rst(wbuf(st->uc), st->uid, h2::CANCEL);
             H2Conn* uc = st->uc;
             finish_stream(e, st, false);
-            if (uc != nullptr) flush_out(e, uc);
+            if (uc != nullptr) queue_flush(e, uc);
         }
     } else {
         clear_endpoint_slot(e, c);
@@ -765,9 +936,9 @@ void conn_close(Engine* e, H2Conn* c) {
             st->status = 502;
             if (st->cc != nullptr) {
                 if (st->rsp_started) {
-                    h2::write_rst(&st->cc->out, st->cid,
+                    h2::write_rst(wbuf(st->cc), st->cid,
                                   h2::INTERNAL_ERROR);
-                    flush_out(e, st->cc);
+                    queue_flush(e, st->cc);
                 } else {
                     synth_response(e, st->cc, st->cid, 502, "upstream");
                 }
@@ -780,8 +951,8 @@ void conn_close(Engine* e, H2Conn* c) {
 
 void conn_error(Engine* e, H2Conn* c, uint32_t code) {
     if (c->dead) return;
-    h2::write_goaway(&c->out, c->max_seen_id, code);
-    flush_out(e, c);
+    h2::write_goaway(wbuf(c), c->max_seen_id, code);
+    flush_out(e, c);  // immediate: the conn closes right below
     conn_close(e, c);
 }
 
@@ -803,6 +974,12 @@ void apply_settings(Engine* e, H2Conn* c, const uint8_t* p, size_t len) {
             c->s.enc.set_max_table_size(v);
             break;
         case h2::S_INITIAL_WINDOW_SIZE:
+            if (v > 0x7FFFFFFFu) {
+                // RFC 7540 §6.5.2: values above 2^31-1 MUST be treated
+                // as a connection error of type FLOW_CONTROL_ERROR
+                conn_error(e, c, h2::FLOW_CONTROL_ERROR);
+                return;
+            }
             c->s.peer_init_win = (int64_t)v;
             break;
         case h2::S_MAX_FRAME_SIZE:
@@ -826,8 +1003,9 @@ void apply_settings(Engine* e, H2Conn* c, const uint8_t* p, size_t len) {
                 kv.second->u_swin += delta;
         }
     }
-    h2::write_settings(&c->out, {}, true);  // ACK
-    if (!flush_out(e, c)) return;
+    h2::write_settings(wbuf(c), {}, true);  // ACK
+    queue_flush(e, c);
+    if (c->dead) return;
     if (delta > 0) {
         std::vector<PStream*> sts;
         for (auto& kv : c->streams) sts.push_back(kv.second);
@@ -866,7 +1044,15 @@ void client_headers_complete(Engine* e, H2Conn* c) {
         conn_error(e, c, h2::PROTOCOL_ERROR);
         return;
     }
-    if (sid <= c->max_seen_id) return;  // closed stream: block was decoded
+    if (sid <= c->max_seen_id) {
+        // §5.1.1: a client stream id never goes backwards — this id was
+        // either closed here or implicitly closed idle, so reuse is
+        // illegal. RST it (the block was decoded above, HPACK state is
+        // intact) rather than killing every other stream on the conn.
+        h2::write_rst(wbuf(c), sid, h2::STREAM_CLOSED);
+        queue_flush(e, c);
+        return;
+    }
     c->max_seen_id = sid;
     const std::string* auth = find_hdr(hs, ":authority");
     if (auth == nullptr) auth = find_hdr(hs, "host");
@@ -878,12 +1064,19 @@ void client_headers_complete(Engine* e, H2Conn* c) {
         synth_response(e, c, sid, 400, "no authority");
         return;
     }
+    if (!l5dtls::valid_authority(key)) {
+        // reject before the authority reaches routing, parked maps, or
+        // the stats JSON — it is untrusted wire input
+        synth_response(e, c, sid, 400, "bad authority");
+        return;
+    }
     PStream* st = new PStream();
     st->cc = c;
     st->cid = sid;
     st->route_key = key;
     st->t_start_us = now_us();
     st->c_swin = c->s.peer_init_win;
+    st->c_recv_win = OUR_STREAM_WIN;  // what our SETTINGS advertised
     st->req_end_seen = (flags & h2::FLAG_END_STREAM) != 0;
     st->u_pend_end = st->req_end_seen;
     hs.push_back({"via", "1.1 linkerd-tpu"});
@@ -925,7 +1118,7 @@ void upstream_headers_complete(Engine* e, H2Conn* c) {
             // informational: forward and keep waiting for the real one
             if (st->cc != nullptr) {
                 write_headers(st->cc, st->cid, hs, false);
-                flush_out(e, st->cc);
+                queue_flush(e, st->cc);
             }
             return;
         }
@@ -936,7 +1129,7 @@ void upstream_headers_complete(Engine* e, H2Conn* c) {
         if (st->cc != nullptr) {
             write_headers(st->cc, st->cid, hs, end);
             if (end) st->rsp_end_sent = true;
-            flush_out(e, st->cc);
+            queue_flush(e, st->cc);
         } else {
             st->rsp_end_sent = end;
         }
@@ -992,6 +1185,14 @@ void handle_client_frame(Engine* e, H2Conn* c, uint8_t type, uint8_t flags,
         break;
     }
     case h2::DATA: {
+        // receive-side enforcement first: the whole payload (padding
+        // included) consumes our advertised windows, and overrunning
+        // them is a FLOW_CONTROL_ERROR (RFC 7540 §6.9)
+        c->s.recv_win -= (int64_t)len;
+        if (c->s.recv_win < 0) {
+            conn_error(e, c, h2::FLOW_CONTROL_ERROR);
+            return;
+        }
         c->s.recv_unacked += len;  // padding counts toward flow control
         auto it = c->streams.find(sid);
         if (it == c->streams.end()) {
@@ -999,6 +1200,18 @@ void handle_client_frame(Engine* e, H2Conn* c, uint8_t type, uint8_t flags,
             return;
         }
         PStream* st = it->second;
+        st->c_recv_win -= (int64_t)len;
+        if (st->c_recv_win < 0) {
+            // stream-level overrun: RST this stream, spare the conn
+            h2::write_rst(wbuf(c), sid, h2::FLOW_CONTROL_ERROR);
+            queue_flush(e, c);
+            if (st->uc != nullptr && st->uid) {
+                h2::write_rst(wbuf(st->uc), st->uid, h2::CANCEL);
+                queue_flush(e, st->uc);
+            }
+            finish_stream(e, st, false);
+            return;
+        }
         size_t off, n;
         if (uint32_t err = h2::strip_payload(flags, false, p, len, &off,
                                              &n)) {
@@ -1022,8 +1235,8 @@ void handle_client_frame(Engine* e, H2Conn* c, uint8_t type, uint8_t flags,
             st->u_pend_end = true;
         }
         if (st->parked && st->u_pend.size() > PARKED_PEND_CAP) {
-            h2::write_rst(&c->out, sid, h2::ENHANCE_YOUR_CALM);
-            flush_out(e, c);
+            h2::write_rst(wbuf(c), sid, h2::ENHANCE_YOUR_CALM);
+            queue_flush(e, c);
             finish_stream(e, st, false);
             return;
         }
@@ -1067,9 +1280,9 @@ void handle_client_frame(Engine* e, H2Conn* c, uint8_t type, uint8_t flags,
     case h2::PING:
         if (len != 8) { conn_error(e, c, h2::FRAME_SIZE_ERROR); return; }
         if (!(flags & h2::FLAG_ACK)) {
-            h2::write_frame(&c->out, h2::PING, h2::FLAG_ACK, 0,
+            h2::write_frame(wbuf(c), h2::PING, h2::FLAG_ACK, 0,
                             (const char*)p, 8);
-            flush_out(e, c);
+            queue_flush(e, c);
         }
         break;
     case h2::RST_STREAM: {
@@ -1078,8 +1291,8 @@ void handle_client_frame(Engine* e, H2Conn* c, uint8_t type, uint8_t flags,
         if (it != c->streams.end()) {
             PStream* st = it->second;
             if (st->uc != nullptr && st->uid) {
-                h2::write_rst(&st->uc->out, st->uid, h2::CANCEL);
-                flush_out(e, st->uc);
+                h2::write_rst(wbuf(st->uc), st->uid, h2::CANCEL);
+                queue_flush(e, st->uc);
             }
             finish_stream(e, st, false);
         }
@@ -1135,6 +1348,11 @@ void handle_upstream_frame(Engine* e, H2Conn* c, uint8_t type,
         }
         break;
     case h2::DATA: {
+        c->s.recv_win -= (int64_t)len;
+        if (c->s.recv_win < 0) {
+            conn_error(e, c, h2::FLOW_CONTROL_ERROR);
+            return;
+        }
         c->s.recv_unacked += len;
         auto it = c->streams.find(sid);
         if (it == c->streams.end()) {
@@ -1142,6 +1360,24 @@ void handle_upstream_frame(Engine* e, H2Conn* c, uint8_t type,
             return;
         }
         PStream* st = it->second;
+        st->u_recv_win -= (int64_t)len;
+        if (st->u_recv_win < 0) {
+            h2::write_rst(wbuf(c), sid, h2::FLOW_CONTROL_ERROR);
+            queue_flush(e, c);
+            st->status = 502;
+            if (st->cc != nullptr) {
+                if (st->rsp_started) {
+                    h2::write_rst(wbuf(st->cc), st->cid,
+                                  h2::INTERNAL_ERROR);
+                    queue_flush(e, st->cc);
+                } else {
+                    synth_response(e, st->cc, st->cid, 502,
+                                   "upstream flow");
+                }
+            }
+            finish_stream(e, st, true);
+            return;
+        }
         size_t off, n;
         if (uint32_t err = h2::strip_payload(flags, false, p, len, &off,
                                              &n)) {
@@ -1191,9 +1427,9 @@ void handle_upstream_frame(Engine* e, H2Conn* c, uint8_t type,
     case h2::PING:
         if (len != 8) { conn_error(e, c, h2::FRAME_SIZE_ERROR); return; }
         if (!(flags & h2::FLAG_ACK)) {
-            h2::write_frame(&c->out, h2::PING, h2::FLAG_ACK, 0,
+            h2::write_frame(wbuf(c), h2::PING, h2::FLAG_ACK, 0,
                             (const char*)p, 8);
-            flush_out(e, c);
+            queue_flush(e, c);
         }
         break;
     case h2::RST_STREAM: {
@@ -1227,8 +1463,8 @@ void handle_upstream_frame(Engine* e, H2Conn* c, uint8_t type,
             st->status = 502;
             if (st->cc != nullptr) {
                 if (st->rsp_started || st->rsp_end_sent) {
-                    h2::write_rst(&st->cc->out, st->cid, code);
-                    flush_out(e, st->cc);
+                    h2::write_rst(wbuf(st->cc), st->cid, code);
+                    queue_flush(e, st->cc);
                 } else {
                     synth_response(e, st->cc, st->cid, 502, "upstream rst");
                 }
@@ -1260,8 +1496,8 @@ void handle_upstream_frame(Engine* e, H2Conn* c, uint8_t type,
             st->uid = 0;
             if (replay_stream(e, st)) continue;
             if (st->cc != nullptr) {
-                h2::write_rst(&st->cc->out, st->cid, h2::REFUSED_STREAM);
-                flush_out(e, st->cc);
+                h2::write_rst(wbuf(st->cc), st->cid, h2::REFUSED_STREAM);
+                queue_flush(e, st->cc);
             }
             finish_stream(e, st, false);
         }
@@ -1333,12 +1569,37 @@ void on_readable(Engine* e, H2Conn* c) {
             conn_close(e, c);
             return;
         }
-        c->in.append(buf, (size_t)n);
+        int tls_rc = 0;
+        if (c->tls != nullptr) {
+            bool was_hs = !c->tls->sess->hs_done;
+            tls_rc = l5dtls::ingest(c->tls, buf, (size_t)n, &c->in,
+                                    &c->out);
+            if (tls_rc < 0) {
+                tls_account(e, c, was_hs);
+                if (!c->out.empty())  // let the TLS alert out
+                    (void)::send(c->fd, c->out.data(), c->out.size(),
+                                 MSG_NOSIGNAL);
+                conn_close(e, c);
+                return;
+            }
+            if (was_hs && c->tls->sess->hs_done) {
+                c->tls->hs_deadline_us = 0;
+                tls_account(e, c, false);
+            }
+            queue_flush(e, c);  // handshake records / tickets / staged
+        } else {
+            c->in.append(buf, (size_t)n);
+        }
         process_in(e, c);
+        if (tls_rc == 1 && !c->dead) {  // clean TLS shutdown
+            conn_close(e, c);
+            return;
+        }
     }
 }
 
 void on_listener(Engine* e, int lfd) {
+    bool tls = e->tls_srv != nullptr && e->tls_listeners.count(lfd) > 0;
     for (;;) {
         int fd = ::accept4(lfd, nullptr, nullptr, SOCK_NONBLOCK);
         if (fd < 0) return;
@@ -1346,18 +1607,32 @@ void on_listener(Engine* e, int lfd) {
         H2Conn* c = new H2Conn();
         c->kind = H2Conn::Kind::CLIENT;
         c->fd = fd;
-        // server preface: SETTINGS + a big connection window
-        h2::write_settings(&c->out,
+        if (tls) {
+            l5dtls::Sess* s = l5dtls::new_session(e->tls_srv, nullptr,
+                                                  false, nullptr);
+            if (s == nullptr) {
+                ::close(fd);
+                delete c;
+                continue;
+            }
+            c->tls = new l5dtls::TlsIo();
+            c->tls->sess = s;
+            c->tls->hs_deadline_us = now_us() + TLS_HS_TIMEOUT_US;
+        }
+        // server preface: SETTINGS + a big connection window (staged as
+        // plaintext on TLS conns; write_plain holds it until hs_done)
+        h2::write_settings(wbuf(c),
                            {{h2::S_HEADER_TABLE_SIZE, 4096},
                             {h2::S_MAX_CONCURRENT_STREAMS, 1024},
                             {h2::S_INITIAL_WINDOW_SIZE,
                              (uint32_t)OUR_STREAM_WIN},
                             {h2::S_MAX_FRAME_SIZE, h2::DEFAULT_MAX_FRAME}},
                            false);
-        h2::write_window_update(&c->out, 0, (uint32_t)(OUR_CONN_WIN
+        h2::write_window_update(wbuf(c), 0, (uint32_t)(OUR_CONN_WIN
                                                        - h2::DEFAULT_WINDOW));
+        c->s.recv_win = OUR_CONN_WIN;
         ep_add(e, c);
-        flush_out(e, c);
+        queue_flush(e, c);
         e->accepted.fetch_add(1, std::memory_order_relaxed);
     }
 }
@@ -1366,6 +1641,20 @@ void sweep(Engine* e) {
     uint64_t now = now_us();
     if (now - e->last_sweep_us < 500'000) return;
     e->last_sweep_us = now;
+    // TLS handshake budget: a peer still mid-handshake past its window
+    // is a handshake failure and must not pin a conn slot (the loop
+    // never blocks on TLS, so only the sweep can reclaim these)
+    std::vector<H2Conn*> hs_expired;
+    for (auto& kv : e->conns) {
+        H2Conn* c = kv.second;
+        if (c->tls != nullptr && c->tls->hs_deadline_us != 0 &&
+            now > c->tls->hs_deadline_us)
+            hs_expired.push_back(c);
+    }
+    for (H2Conn* c : hs_expired) {
+        tls_account(e, c, /*failed=*/true);
+        conn_close(e, c);
+    }
     std::vector<PStream*> expired;
     for (auto& kv : e->parked)
         for (PStream* st : kv.second)
@@ -1396,8 +1685,8 @@ void sweep(Engine* e) {
     for (PStream* st : stalled) {
         if (st->closed) continue;
         if (st->uc != nullptr && st->uid) {
-            h2::write_rst(&st->uc->out, st->uid, h2::CANCEL);
-            flush_out(e, st->uc);
+            h2::write_rst(wbuf(st->uc), st->uid, h2::CANCEL);
+            queue_flush(e, st->uc);
         }
         st->status = 504;
         if (st->cc != nullptr && !st->cc->dead)
@@ -1453,6 +1742,7 @@ void drain_graveyard(Engine* e) {
 void* loop_main(void* arg) {
     Engine* e = (Engine*)arg;
     epoll_event evs[MAX_EVENTS];
+    e->defer_ok = true;  // frame producers may now coalesce writes
     while (e->running.load(std::memory_order_relaxed)) {
         int n = epoll_wait(e->epfd, evs, MAX_EVENTS, 250);
         for (int i = 0; i < n; i++) {
@@ -1500,9 +1790,9 @@ void* loop_main(void* arg) {
                     }
                     c->connecting = false;
                 }
-                size_t before = c->out.size();
+                size_t before = outsz(c);
                 if (!flush_out(e, c)) continue;
-                if (c->out.size() < before) {
+                if (outsz(c) < before) {
                     // room freed: resume streams stalled on OUT_HIGH
                     std::vector<PStream*> sts;
                     for (auto& kv : c->streams) sts.push_back(kv.second);
@@ -1520,8 +1810,14 @@ void* loop_main(void* arg) {
                 on_readable(e, c);
         }
         sweep(e);
+        // ONE coalesced flush per wakeup: every frame produced this
+        // round (requests, grants, PING acks, synth responses) leaves
+        // in a single send()/TLS-record batch per conn
+        drain_dirty(e);
         drain_graveyard(e);
     }
+    drain_dirty(e);          // teardown frames (GOAWAYs) still flush
+    e->defer_ok = false;     // shutdown-path writes go straight out
     return nullptr;
 }
 
@@ -1573,6 +1869,60 @@ int fph2_listen(void* ep, const char* ip, int port) {
     epoll_ctl(e->epfd, EPOLL_CTL_ADD, fd, &ev);
     e->listeners.push_back(fd);
     return (int)ntohs(sa.sin_port);
+}
+
+// 1 when the OpenSSL runtime could be dlopen'd (TLS termination /
+// origination available), else 0.
+int fph2_tls_runtime_available() { return l5dtls::available() ? 1 : 0; }
+
+// Install the accept-leg TLS context (cert/key PEM + ALPN preference
+// CSV, e.g. "h2"). Call BEFORE fph2_start. Returns 0, or -1 with the
+// OpenSSL error text in err.
+int fph2_set_tls(void* ep, const char* cert, const char* key,
+                 const char* alpn, char* err, size_t errcap) {
+    Engine* e = (Engine*)ep;
+    std::string why;
+    l5dtls::Ctx* c = l5dtls::server_ctx(cert, key, alpn, &why);
+    if (c == nullptr) {
+        if (err != nullptr && errcap > 0) {
+            snprintf(err, errcap, "%s", why.c_str());
+        }
+        return -1;
+    }
+    l5dtls::free_ctx(e->tls_srv);
+    e->tls_srv = c;
+    return 0;
+}
+
+// Like fph2_listen, but connections accepted on this listener terminate
+// TLS (requires fph2_set_tls first).
+int fph2_listen_tls(void* ep, const char* ip, int port) {
+    Engine* e = (Engine*)ep;
+    if (e->tls_srv == nullptr) return -1;
+    int got = fph2_listen(ep, ip, port);
+    if (got >= 0) e->tls_listeners.insert(e->listeners.back());
+    return got;
+}
+
+// Originate TLS to every upstream endpoint (the router-wide client.tls
+// block). verify=0 skips chain/hostname validation
+// (tls.disableValidation parity); ca_path, when set, replaces the
+// default trust roots. Call BEFORE fph2_start.
+int fph2_set_client_tls(void* ep, const char* alpn, int verify,
+                        const char* ca_path, char* err, size_t errcap) {
+    Engine* e = (Engine*)ep;
+    std::string why;
+    l5dtls::Ctx* c = l5dtls::client_ctx(alpn, verify != 0, ca_path, &why);
+    if (c == nullptr) {
+        if (err != nullptr && errcap > 0) {
+            snprintf(err, errcap, "%s", why.c_str());
+        }
+        return -1;
+    }
+    l5dtls::free_ctx(e->tls_cli);
+    e->tls_cli = c;
+    e->tls_cli_verify = verify != 0;
+    return 0;
 }
 
 int fph2_set_route(void* ep, const char* host, const char* endpoints) {
@@ -1664,11 +2014,12 @@ long fph2_stats_json(void* ep, char* buf, size_t cap) {
     for (auto& kv : e->routes) {
         RouteStats& st = kv.second.stats;
         char tmp[256];
+        s += first ? "\"" : ",\"";
+        l5dtls::json_escape(kv.first, &s);  // keys came off the wire
         snprintf(tmp, sizeof(tmp),
-                 "%s\"%s\":{\"id\":%llu,\"requests\":%llu,\"success\":%llu,"
+                 "\":{\"id\":%llu,\"requests\":%llu,\"success\":%llu,"
                  "\"f4xx\":%llu,\"f5xx\":%llu,\"conn_fail\":%llu,"
                  "\"hist\":[",
-                 first ? "" : ",", kv.first.c_str(),
                  (unsigned long long)kv.second.id,
                  (unsigned long long)st.requests,
                  (unsigned long long)st.success,
@@ -1685,12 +2036,28 @@ long fph2_stats_json(void* ep, char* buf, size_t cap) {
         s += "]}";
         first = false;
     }
-    char tail[128];
+    char tail[512];
+    l5dtls::TlsStats& t = e->tls_stats;
     snprintf(tail, sizeof(tail),
-             "},\"accepted\":%llu,\"features_dropped\":%llu}",
+             "},\"accepted\":%llu,\"features_dropped\":%llu,"
+             "\"tls\":{\"handshakes\":%llu,\"failures\":%llu,"
+             "\"resumed\":%llu,\"alpn_h2\":%llu,\"alpn_http1\":%llu,"
+             "\"upstream_handshakes\":%llu,\"upstream_resumed\":%llu,"
+             "\"upstream_failures\":%llu,\"enabled\":%s,"
+             "\"client_enabled\":%s}}",
              (unsigned long long)e->accepted.load(
                  std::memory_order_relaxed),
-             (unsigned long long)e->features_dropped);
+             (unsigned long long)e->features_dropped,
+             (unsigned long long)t.handshakes,
+             (unsigned long long)t.failures,
+             (unsigned long long)t.resumed,
+             (unsigned long long)t.alpn_h2,
+             (unsigned long long)t.alpn_http1,
+             (unsigned long long)t.up_handshakes,
+             (unsigned long long)t.up_resumed,
+             (unsigned long long)t.up_failures,
+             e->tls_srv != nullptr ? "true" : "false",
+             e->tls_cli != nullptr ? "true" : "false");
     s += tail;
     if (s.size() + 1 > cap) return -2;
     memcpy(buf, s.data(), s.size());
@@ -1725,6 +2092,9 @@ void fph2_shutdown(void* ep) {
     for (H2Conn* c : cs) conn_close(e, c);
     drain_graveyard(e);
     for (int lfd : e->listeners) ::close(lfd);
+    for (auto& kv : e->tls_sessions) l5dtls::free_ssl_session(kv.second);
+    l5dtls::free_ctx(e->tls_srv);
+    l5dtls::free_ctx(e->tls_cli);
     ::close(e->wakefd);
     ::close(e->epfd);
     delete e;

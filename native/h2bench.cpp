@@ -34,6 +34,7 @@
 #include <vector>
 
 #include "h2_core.h"
+#include "tls_shim.h"
 
 namespace h2bench {
 
@@ -60,6 +61,10 @@ struct Conn {
     std::string in, out;
     h2::Session s;
     bool want_write = false;
+    // TLS client leg (h1loadtls / loadtls): c->out holds plaintext,
+    // cipher_out is what actually hits the socket
+    l5dtls::Sess* tls = nullptr;
+    std::string cipher_out;
     // serve: per-stream request byte accumulation
     std::unordered_map<uint32_t, std::string> req_data;
     // load: streams in flight + completion accounting
@@ -68,7 +73,66 @@ struct Conn {
     uint64_t recv_since_grant = 0;
 };
 
+// Shared TLS client context for the load modes. Validation is off: the
+// bench measures throughput against a self-signed fixture, and the
+// router under test never requests a client cert.
+l5dtls::Ctx* g_tls_client = nullptr;
+
+bool tls_client_init(const char* alpn_csv) {
+    if (!l5dtls::available()) {
+        fprintf(stderr, "h2bench: TLS runtime unavailable: %s\n",
+                l5dtls::load_error());
+        return false;
+    }
+    std::string err;
+    g_tls_client = l5dtls::client_ctx(alpn_csv, /*verify=*/false,
+                                      nullptr, &err);
+    if (g_tls_client == nullptr) {
+        fprintf(stderr, "h2bench: client ctx: %s\n", err.c_str());
+        return false;
+    }
+    return true;
+}
+
+// Encrypt whatever plaintext is queued (a no-op while the handshake is
+// in flight — write_plain drives it) and push ciphertext to the socket.
+// Returns false on a dead connection.
+bool tls_flush_bytes(int fd, l5dtls::Sess* t, std::string* plain_out,
+                     std::string* cipher_out) {
+    if (!plain_out->empty()) {
+        long n = l5dtls::write_plain(t, plain_out->data(),
+                                     plain_out->size(), cipher_out);
+        if (n < 0) return false;
+        if (n > 0) plain_out->erase(0, (size_t)n);
+    }
+    while (!cipher_out->empty()) {
+        ssize_t n = ::send(fd, cipher_out->data(), cipher_out->size(),
+                           MSG_NOSIGNAL);
+        if (n > 0) cipher_out->erase(0, (size_t)n);
+        else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK))
+            break;
+        else
+            return false;
+    }
+    return true;
+}
+
 bool flush_conn(int epfd, Conn* c) {
+    if (c->tls != nullptr) {
+        if (!tls_flush_bytes(c->fd, c->tls, &c->out, &c->cipher_out))
+            return false;
+        // EPOLLOUT only while ciphertext is stuck in the socket buffer;
+        // plaintext blocked on the handshake drains via EPOLLIN pumps
+        bool ww = !c->cipher_out.empty();
+        if (ww != c->want_write) {
+            c->want_write = ww;
+            epoll_event ev{};
+            ev.events = EPOLLIN | (ww ? EPOLLOUT : 0);
+            ev.data.fd = c->fd;
+            epoll_ctl(epfd, EPOLL_CTL_MOD, c->fd, &ev);
+        }
+        return true;
+    }
     while (!c->out.empty()) {
         ssize_t n = ::send(c->fd, c->out.data(), c->out.size(),
                            MSG_NOSIGNAL);
@@ -432,7 +496,9 @@ void load_handle_frame(Conn* c, LoadState* ls, uint8_t type, uint8_t flags,
 
 int run_load(const char* ip, int port, const char* authority, int conc,
              double seconds, int paysz, double rate_rps,
-             uint64_t* done_out) {
+             uint64_t* done_out, bool tls = false) {
+    if (tls && g_tls_client == nullptr && !tls_client_init("h2"))
+        return 1;
     // gRPC-framed echo message: 5-byte prefix + protobuf bytes field
     std::string msg;
     msg.push_back(0x0A);  // field 1, wire type 2
@@ -476,6 +542,14 @@ int run_load(const char* ip, int port, const char* authority, int conc,
         fcntl(fd, F_SETFL, fl | O_NONBLOCK);
         Conn* c = new Conn();
         c->fd = fd;
+        if (tls) {
+            c->tls = l5dtls::new_session(g_tls_client, authority,
+                                         /*verify=*/false, nullptr);
+            if (c->tls == nullptr) {
+                fprintf(stderr, "h2bench: TLS session alloc failed\n");
+                return 1;
+            }
+        }
         c->out.append(h2::PREFACE, h2::PREFACE_LEN);
         h2::write_settings(&c->out,
                            {{h2::S_INITIAL_WINDOW_SIZE, (uint32_t)BIG_WIN},
@@ -486,7 +560,7 @@ int run_load(const char* ip, int port, const char* authority, int conc,
         LoadState& ls = states[(size_t)i];
         ls.req_block_tail = framed;
         ls.req_hdrs = {{":method", "POST"},
-                       {":scheme", "http"},
+                       {":scheme", tls ? "https" : "http"},
                        {":path", "/bench.Echo/Echo"},
                        {":authority", authority},
                        {"content-type", "application/grpc"},
@@ -551,10 +625,18 @@ int run_load(const char* ip, int port, const char* authority, int conc,
                 dead = !flush_conn(epfd, c);
             if (!dead && (evs[i].events & EPOLLIN)) {
                 char buf[64 * 1024];
+                bool tls_eof = false;
                 for (;;) {
                     ssize_t r = ::recv(fd, buf, sizeof(buf), 0);
                     if (r > 0) {
-                        c->in.append(buf, (size_t)r);
+                        if (c->tls != nullptr) {
+                            if (!l5dtls::feed(c->tls, buf, (size_t)r)) {
+                                dead = true;
+                                break;
+                            }
+                        } else {
+                            c->in.append(buf, (size_t)r);
+                        }
                     } else if (r < 0 && (errno == EAGAIN ||
                                          errno == EWOULDBLOCK)) {
                         break;
@@ -562,6 +644,11 @@ int run_load(const char* ip, int port, const char* authority, int conc,
                         dead = true;
                         break;
                     }
+                }
+                if (!dead && c->tls != nullptr) {
+                    int rc = l5dtls::pump(c->tls, &c->in, &c->cipher_out);
+                    if (rc < 0) dead = true;
+                    else if (rc > 0) tls_eof = true;  // after the parse
                 }
                 size_t pos = 0;
                 while (!dead && c->in.size() - pos >= 9) {
@@ -575,6 +662,7 @@ int run_load(const char* ip, int port, const char* authority, int conc,
                     pos += 9 + (size_t)len;
                 }
                 if (pos) c->in.erase(0, pos);
+                if (!dead && tls_eof) dead = true;
                 if (!dead) dead = !flush_conn(epfd, c);
             }
             if (dead) {
@@ -582,6 +670,7 @@ int run_load(const char* ip, int port, const char* authority, int conc,
                 ls->inflight = 0;
                 epoll_ctl(epfd, EPOLL_CTL_DEL, fd, nullptr);
                 ::close(fd);
+                l5dtls::free_session(c->tls);
                 delete c;
                 conns.erase(it);
             }
@@ -616,6 +705,7 @@ int run_load(const char* ip, int port, const char* authority, int conc,
            dt > 0 ? (double)done / dt : 0.0, pct(0.5), pct(0.99));
     for (auto& kv : conns) {
         ::close(kv.first);
+        l5dtls::free_session(kv.second->tls);
         delete kv.second;
     }
     ::close(epfd);
@@ -628,13 +718,17 @@ struct H1Conn {
     int fd = -1;
     std::string in, out;
     bool want_write = false;
+    l5dtls::Sess* tls = nullptr;   // TLS leg (h1loadtls)
+    std::string cipher_out;
     std::deque<uint64_t> sent_at;  // FIFO: pipelined responses in order
     size_t scan = 0;               // resume offset for head scanning
     long body_left = -1;           // -1: parsing head
 };
 
 int run_h1_load(const char* ip, int port, const char* host, int conc,
-                double seconds, uint64_t* done_out) {
+                double seconds, uint64_t* done_out, bool tls = false) {
+    if (tls && g_tls_client == nullptr && !tls_client_init("http/1.1"))
+        return 1;
     char reqbuf[256];
     int reqlen = snprintf(reqbuf, sizeof(reqbuf),
                           "GET /bench HTTP/1.1\r\nHost: %s\r\n\r\n", host);
@@ -662,6 +756,14 @@ int run_h1_load(const char* ip, int port, const char* host, int conc,
         fcntl(fd, F_SETFL, fl | O_NONBLOCK);
         H1Conn* c = new H1Conn();
         c->fd = fd;
+        if (tls) {
+            c->tls = l5dtls::new_session(g_tls_client, host,
+                                         /*verify=*/false, nullptr);
+            if (c->tls == nullptr) {
+                fprintf(stderr, "h2bench: TLS session alloc failed\n");
+                return 1;
+            }
+        }
         for (int w = 0; w < window; w++) {
             c->out.append(reqbuf, (size_t)reqlen);
             c->sent_at.push_back(now_us());
@@ -675,6 +777,19 @@ int run_h1_load(const char* ip, int port, const char* host, int conc,
     }
 
     auto flush_h1 = [&](H1Conn* c) -> bool {
+        if (c->tls != nullptr) {
+            if (!tls_flush_bytes(c->fd, c->tls, &c->out, &c->cipher_out))
+                return false;
+            bool tww = !c->cipher_out.empty();
+            if (tww != c->want_write) {
+                c->want_write = tww;
+                epoll_event ev{};
+                ev.events = EPOLLIN | (tww ? EPOLLOUT : 0);
+                ev.data.fd = c->fd;
+                epoll_ctl(epfd, EPOLL_CTL_MOD, c->fd, &ev);
+            }
+            return true;
+        }
         while (!c->out.empty()) {
             ssize_t n = ::send(c->fd, c->out.data(), c->out.size(),
                                MSG_NOSIGNAL);
@@ -715,13 +830,27 @@ int run_h1_load(const char* ip, int port, const char* host, int conc,
                 dead = !flush_h1(c);
             if (!dead && (evs[i].events & EPOLLIN)) {
                 char buf[64 * 1024];
+                bool tls_eof = false;
                 for (;;) {
                     ssize_t r = ::recv(c->fd, buf, sizeof(buf), 0);
-                    if (r > 0) c->in.append(buf, (size_t)r);
-                    else if (r < 0 && (errno == EAGAIN ||
-                                       errno == EWOULDBLOCK))
+                    if (r > 0) {
+                        if (c->tls != nullptr) {
+                            if (!l5dtls::feed(c->tls, buf, (size_t)r)) {
+                                dead = true;
+                                break;
+                            }
+                        } else {
+                            c->in.append(buf, (size_t)r);
+                        }
+                    } else if (r < 0 && (errno == EAGAIN ||
+                                         errno == EWOULDBLOCK)) {
                         break;
-                    else { dead = true; break; }
+                    } else { dead = true; break; }
+                }
+                if (!dead && c->tls != nullptr) {
+                    int rc = l5dtls::pump(c->tls, &c->in, &c->cipher_out);
+                    if (rc < 0) dead = true;
+                    else if (rc > 0) tls_eof = true;  // after the parse
                 }
                 // consume complete responses
                 while (!dead) {
@@ -760,12 +889,15 @@ int run_h1_load(const char* ip, int port, const char* host, int conc,
                         c->sent_at.push_back(now_us());
                     }
                 }
-                if (!dead && !c->out.empty()) dead = !flush_h1(c);
+                if (!dead && tls_eof) dead = true;
+                if (!dead && (!c->out.empty() || c->tls != nullptr))
+                    dead = !flush_h1(c);
             }
             if (dead) {
                 errors += c->sent_at.size();
                 epoll_ctl(epfd, EPOLL_CTL_DEL, c->fd, nullptr);
                 ::close(c->fd);
+                l5dtls::free_session(c->tls);
                 delete c;
                 conns.erase(it);
             }
@@ -786,6 +918,7 @@ int run_h1_load(const char* ip, int port, const char* host, int conc,
            dt > 0 ? (double)done / dt : 0.0, pct(0.5), pct(0.99));
     for (auto& kv : conns) {
         ::close(kv.first);
+        l5dtls::free_session(kv.second->tls);
         delete kv.second;
     }
     ::close(epfd);
@@ -801,17 +934,21 @@ int main(int argc, char** argv) {
     signal(SIGPIPE, SIG_IGN);
     if (argc >= 3 && strcmp(argv[1], "serve") == 0)
         return h2bench::run_serve(atoi(argv[2]), nullptr);
-    if (argc >= 7 && strcmp(argv[1], "h1load") == 0)
+    if (argc >= 7 && (strcmp(argv[1], "h1load") == 0 ||
+                      strcmp(argv[1], "h1loadtls") == 0))
         return h2bench::run_h1_load(argv[2], atoi(argv[3]), argv[4],
-                                    atoi(argv[5]), atof(argv[6]), nullptr);
-    if (argc >= 7 && strcmp(argv[1], "load") == 0)
+                                    atoi(argv[5]), atof(argv[6]), nullptr,
+                                    strcmp(argv[1], "h1loadtls") == 0);
+    if (argc >= 7 && (strcmp(argv[1], "load") == 0 ||
+                      strcmp(argv[1], "loadtls") == 0))
         return h2bench::run_load(argv[2], atoi(argv[3]), argv[4],
                                  atoi(argv[5]), atof(argv[6]),
                                  argc > 7 ? atoi(argv[7]) : 128,
-                                 argc > 8 ? atof(argv[8]) : 0.0, nullptr);
+                                 argc > 8 ? atof(argv[8]) : 0.0, nullptr,
+                                 strcmp(argv[1], "loadtls") == 0);
     fprintf(stderr,
-            "usage: h2bench serve <port> | h1load <ip> <port> <host> <conc> <secs> | h2bench load <ip> <port> "
-            "<authority> <conc> <secs> [paysz] [rate_rps]\n");
+            "usage: h2bench serve <port> | h1load|h1loadtls <ip> <port> <host> <conc> <secs> | h2bench "
+            "load|loadtls <ip> <port> <authority> <conc> <secs> [paysz] [rate_rps]\n");
     return 2;
 }
 #endif  // H2BENCH_NO_MAIN

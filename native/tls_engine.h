@@ -1,0 +1,158 @@
+// Per-connection TLS adapter shared by both native proxy engines
+// (fastpath.cpp, h2_fastpath.cpp) and the h2bench load generator.
+//
+// The engines keep their single-threaded epoll shape: a connection's
+// `out` string always holds WIRE bytes (ciphertext once TLS is up), and
+// a TLS connection stages application plaintext in `plain_out` until
+// flush time, when write_plain() moves it through the memory-BIO pump.
+// Reads go the other way: recv'd ciphertext is fed to the session and
+// the decrypted plaintext lands in the connection's normal `in` buffer,
+// so none of the protocol logic above this layer knows TLS exists.
+//
+// Lifecycle: an accepted/connected socket gets a TlsIo when its engine
+// has a server/client l5dtls::Ctx configured; the handshake rides the
+// first reads/writes; `hs_deadline_us` bounds how long a peer may take
+// (a slow or stalled handshaker is closed by the engine's sweep — the
+// epoll loop itself never blocks on TLS, everything is memory-BIO).
+#pragma once
+
+#include <string>
+#include <unordered_map>
+
+#include "tls_shim.h"
+
+namespace l5dtls {
+
+struct TlsIo {
+    Sess* sess = nullptr;
+    std::string plain_out;       // app plaintext staged until hs_done
+    std::string sni;             // verify/SNI name (client sessions)
+    uint64_t hs_deadline_us = 0; // 0 once the handshake completed
+    bool accounted = false;      // handshake counted in engine stats
+    bool close_notify = false;   // peer sent a clean TLS shutdown
+    bool shutdown_sent = false;  // we queued our close-notify
+
+    ~TlsIo() { free_session(sess); }
+};
+
+// Resumption-cache key: endpoint AND the SNI/verify name the session
+// was handshaken under. Resumption skips the Certificate exchange, so
+// a session verified against one authority must never be offered for a
+// connection that would pin a different one (two routes sharing an
+// ip:port would otherwise bypass hostname verification).
+inline std::string session_key(uint32_t ip_be, uint16_t port,
+                               const std::string& sni) {
+    char buf[32];
+    snprintf(buf, sizeof(buf), "%u:%u|", ip_be, port);
+    return buf + sni;
+}
+
+// Harvest the latest session of a dying origination conn into the
+// engine's cache (tickets arrive post-handshake, so harvesting at
+// close catches them). Frees the displaced session.
+inline void stash_session(
+    std::unordered_map<std::string, SSL_SESSION*>* cache,
+    const std::string& key, Sess* sess) {
+    if (sess == nullptr || !sess->hs_done) return;
+    SSL_SESSION* s = get1_session(sess);
+    if (s == nullptr) return;
+    SSL_SESSION*& slot = (*cache)[key];
+    free_ssl_session(slot);
+    slot = s;
+}
+
+// Counters an engine exports under stats_json "tls": {...}. Written by
+// the loop thread; snapshotted under the engine's stats mutex by the
+// exporters, so plain integers suffice.
+struct TlsStats {
+    uint64_t handshakes = 0, failures = 0, resumed = 0;
+    uint64_t alpn_h2 = 0, alpn_http1 = 0;
+    uint64_t up_handshakes = 0, up_resumed = 0, up_failures = 0;
+};
+
+inline void count_alpn(TlsStats* st, const std::string& proto) {
+    if (proto == "h2") st->alpn_h2++;
+    else if (proto == "http/1.1") st->alpn_http1++;
+}
+
+// Account a finished (or failed) handshake exactly once.
+inline void account_handshake(TlsIo* t, TlsStats* st, bool is_server,
+                              bool failed) {
+    if (t->accounted) return;
+    t->accounted = true;
+    if (failed) {
+        (is_server ? st->failures : st->up_failures)++;
+        return;
+    }
+    if (is_server) {
+        st->handshakes++;
+        if (resumed(t->sess)) st->resumed++;
+        count_alpn(st, t->sess->alpn);
+    } else {
+        st->up_handshakes++;
+        if (resumed(t->sess)) st->up_resumed++;
+    }
+}
+
+// Move staged plaintext into the wire buffer. Returns false on a fatal
+// TLS error (caller closes the conn; ciphertext already in *out should
+// still be flushed so the peer sees the alert).
+inline bool encrypt_pending(TlsIo* t, std::string* out) {
+    // write_plain with an empty buffer still pumps the handshake, which
+    // is what emits the connect-side ClientHello on first flush
+    long n = write_plain(t->sess, t->plain_out.data(),
+                         t->plain_out.size(), out);
+    if (n < 0) return false;
+    if (n > 0) t->plain_out.erase(0, (size_t)n);
+    return !t->sess->fatal;
+}
+
+// Feed ciphertext from the socket; decrypted plaintext is appended to
+// *plain_in and any TLS-layer output (handshake records, tickets,
+// close-notify acks) to *out. Returns 0 = ok, 1 = clean TLS shutdown
+// from the peer (process plain_in, then close), -1 = fatal.
+inline int ingest(TlsIo* t, const char* data, size_t n,
+                  std::string* plain_in, std::string* out) {
+    if (!feed(t->sess, data, n)) return -1;
+    int rc = pump(t->sess, plain_in, out);
+    if (rc == 1) t->close_notify = true;
+    return rc;
+}
+
+// JSON string escaping for engine stats (route keys are attacker-ish
+// input on the h1 side: the Host header). Minimal but complete for the
+// JSON grammar: quotes, backslashes, and control bytes.
+inline void json_escape(const std::string& s, std::string* out) {
+    for (char ch : s) {
+        unsigned char c = (unsigned char)ch;
+        if (c == '"' || c == '\\') {
+            out->push_back('\\');
+            out->push_back((char)c);
+        } else if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", c);
+            out->append(buf);
+        } else {
+            out->push_back((char)c);
+        }
+    }
+}
+
+// Authority / Host validation before routing (RFC 3986 reg-name +
+// optional port, plus IPv6 literals). Rejects userinfo ('@'), path
+// separators, spaces and control bytes — the characters that would let
+// a crafted :authority smuggle through routing, logs, or stats JSON.
+inline bool valid_authority(const std::string& a) {
+    if (a.empty() || a.size() > 255) return false;
+    for (char ch : a) {
+        unsigned char c = (unsigned char)ch;
+        bool ok = (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+                  (c >= '0' && c <= '9') || c == '.' || c == '-' ||
+                  c == '_' || c == ':' || c == '[' || c == ']' ||
+                  c == '%' || c == '~';
+        if (!ok) return false;
+    }
+    return true;
+}
+
+}  // namespace l5dtls

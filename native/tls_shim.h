@@ -1,0 +1,558 @@
+// TLS for the native data plane, without build-time OpenSSL headers.
+//
+// The reference terminates TLS inside its fast path via netty-tcnative
+// boringssl (project/Deps.scala:24). The analogous move here must work
+// in containers that ship only the OpenSSL *runtime* (libssl.so.1.1 —
+// no /usr/include/openssl), so this shim declares the small stable
+// slice of the OpenSSL 1.1 ABI it needs and resolves it with
+// dlopen/dlsym at first use. Everything is opaque-pointer based, which
+// is exactly how the 1.1 API is designed to be consumed; when the
+// runtime is missing the engines report TLS unavailable and Python
+// keeps serving TLS on its own data plane (graceful gate, not a build
+// failure).
+//
+// The I/O model is non-blocking memory BIOs: the epoll loop owns the
+// sockets and moves ciphertext in/out of the BIO pair; OpenSSL never
+// sees a file descriptor and can never block the loop. Handshake,
+// ALPN selection and session resumption (tickets) all ride the same
+// pump:
+//
+//   socket readable --> feed(ciphertext) --> pump() --> plaintext in
+//   plaintext out   --> write_plain()    --> cipher_out --> socket
+//
+// Used by fastpath.cpp / h2_fastpath.cpp (both proxy legs), the
+// h2bench load generator's TLS mode, and the TSan/ASan stress drivers.
+#pragma once
+
+#include <dlfcn.h>
+#include <pthread.h>
+#include <stddef.h>
+#include <stdio.h>
+#include <string.h>
+
+#include <string>
+
+namespace l5dtls {
+
+// ---- the OpenSSL 1.1 ABI slice (opaque types + constants) ----
+
+typedef struct ssl_ctx_st SSL_CTX;
+typedef struct ssl_st SSL;
+typedef struct bio_st BIO;
+typedef struct bio_method_st BIO_METHOD;
+typedef struct ssl_method_st SSL_METHOD;
+typedef struct ssl_session_st SSL_SESSION;
+typedef struct x509_vp_st X509_VERIFY_PARAM;
+
+constexpr int SSL_FILETYPE_PEM = 1;
+constexpr int SSL_ERROR_NONE = 0;
+constexpr int SSL_ERROR_WANT_READ = 2;
+constexpr int SSL_ERROR_WANT_WRITE = 3;
+constexpr int SSL_ERROR_ZERO_RETURN = 6;
+constexpr long SSL_CTRL_MODE = 33;
+constexpr long SSL_CTRL_SET_SESS_CACHE_MODE = 44;
+constexpr long SSL_MODE_ENABLE_PARTIAL_WRITE = 0x1;
+constexpr long SSL_MODE_ACCEPT_MOVING_WRITE_BUFFER = 0x2;
+constexpr long SSL_MODE_RELEASE_BUFFERS = 0x10;
+constexpr long SSL_SESS_CACHE_CLIENT = 0x1;
+constexpr long SSL_SESS_CACHE_SERVER = 0x2;
+constexpr int SSL_VERIFY_NONE = 0;
+constexpr int SSL_VERIFY_PEER = 1;
+constexpr int SSL_SENT_SHUTDOWN = 1;
+constexpr int SSL_RECEIVED_SHUTDOWN = 2;
+constexpr int SSL_TLSEXT_ERR_OK = 0;
+constexpr int SSL_TLSEXT_ERR_NOACK = 3;
+constexpr long BIO_CTRL_PENDING = 10;
+
+struct Api {
+    void* h_ssl = nullptr;
+    void* h_crypto = nullptr;
+    bool ok = false;
+    std::string err;
+
+    const SSL_METHOD* (*TLS_method)();
+    SSL_CTX* (*SSL_CTX_new)(const SSL_METHOD*);
+    void (*SSL_CTX_free)(SSL_CTX*);
+    int (*SSL_CTX_use_certificate_chain_file)(SSL_CTX*, const char*);
+    int (*SSL_CTX_use_PrivateKey_file)(SSL_CTX*, const char*, int);
+    int (*SSL_CTX_check_private_key)(const SSL_CTX*);
+    long (*SSL_CTX_ctrl)(SSL_CTX*, int, long, void*);
+    void (*SSL_CTX_set_verify)(SSL_CTX*, int,
+                               int (*)(int, void*));
+    int (*SSL_CTX_load_verify_locations)(SSL_CTX*, const char*,
+                                         const char*);
+    void (*SSL_CTX_set_alpn_select_cb)(
+        SSL_CTX*,
+        int (*)(SSL*, const unsigned char**, unsigned char*,
+                const unsigned char*, unsigned, void*),
+        void*);
+    int (*SSL_set_alpn_protos)(SSL*, const unsigned char*, unsigned);
+    void (*SSL_get0_alpn_selected)(const SSL*, const unsigned char**,
+                                   unsigned*);
+    SSL* (*SSL_new)(SSL_CTX*);
+    void (*SSL_free)(SSL*);
+    void (*SSL_set_accept_state)(SSL*);
+    void (*SSL_set_connect_state)(SSL*);
+    void (*SSL_set_bio)(SSL*, BIO*, BIO*);
+    int (*SSL_do_handshake)(SSL*);
+    int (*SSL_read)(SSL*, void*, int);
+    int (*SSL_write)(SSL*, const void*, int);
+    int (*SSL_get_error)(const SSL*, int);
+    long (*SSL_ctrl)(SSL*, int, long, void*);
+    int (*SSL_session_reused)(SSL*);
+    SSL_SESSION* (*SSL_get1_session)(SSL*);
+    int (*SSL_set_session)(SSL*, SSL_SESSION*);
+    void (*SSL_SESSION_free)(SSL_SESSION*);
+    X509_VERIFY_PARAM* (*SSL_get0_param)(SSL*);
+    int (*X509_VERIFY_PARAM_set1_host)(X509_VERIFY_PARAM*, const char*,
+                                       size_t);
+    int (*SSL_shutdown)(SSL*);
+    void (*SSL_set_shutdown)(SSL*, int);
+    BIO* (*BIO_new)(const BIO_METHOD*);
+    const BIO_METHOD* (*BIO_s_mem)();
+    int (*BIO_write)(BIO*, const void*, int);
+    int (*BIO_read)(BIO*, void*, int);
+    long (*BIO_ctrl)(BIO*, int, long, void*);
+    unsigned long (*ERR_get_error)();
+    void (*ERR_error_string_n)(unsigned long, char*, size_t);
+    void (*ERR_clear_error)();
+};
+
+inline Api& api() {
+    static Api a;
+    static pthread_once_t once = PTHREAD_ONCE_INIT;
+    static auto init = [] {
+        // try the sonames this container family actually ships; the
+        // 1.1 names first (what this image has), then 3.x (the set1_host
+        // / options signatures are register-compatible on LP64)
+        const char* ssl_names[] = {"libssl.so.1.1", "libssl.so.3",
+                                   "libssl.so"};
+        const char* crypto_names[] = {"libcrypto.so.1.1", "libcrypto.so.3",
+                                      "libcrypto.so"};
+        for (const char* n : crypto_names) {
+            a.h_crypto = dlopen(n, RTLD_NOW | RTLD_GLOBAL);
+            if (a.h_crypto) break;
+        }
+        for (const char* n : ssl_names) {
+            a.h_ssl = dlopen(n, RTLD_NOW | RTLD_GLOBAL);
+            if (a.h_ssl) break;
+        }
+        if (!a.h_ssl || !a.h_crypto) {
+            a.err = "libssl/libcrypto runtime not found";
+            return;
+        }
+        bool all = true;
+        auto want = [&](const char* name) -> void* {
+            void* p = dlsym(a.h_ssl, name);
+            if (!p) p = dlsym(a.h_crypto, name);
+            if (!p) {
+                all = false;
+                if (a.err.empty())
+                    a.err = std::string("missing symbol ") + name;
+            }
+            return p;
+        };
+#define L5D_SYM(n) a.n = (decltype(a.n))want(#n)
+        L5D_SYM(TLS_method);
+        L5D_SYM(SSL_CTX_new);
+        L5D_SYM(SSL_CTX_free);
+        L5D_SYM(SSL_CTX_use_certificate_chain_file);
+        L5D_SYM(SSL_CTX_use_PrivateKey_file);
+        L5D_SYM(SSL_CTX_check_private_key);
+        L5D_SYM(SSL_CTX_ctrl);
+        L5D_SYM(SSL_CTX_set_verify);
+        L5D_SYM(SSL_CTX_load_verify_locations);
+        L5D_SYM(SSL_CTX_set_alpn_select_cb);
+        L5D_SYM(SSL_set_alpn_protos);
+        L5D_SYM(SSL_get0_alpn_selected);
+        L5D_SYM(SSL_new);
+        L5D_SYM(SSL_free);
+        L5D_SYM(SSL_set_accept_state);
+        L5D_SYM(SSL_set_connect_state);
+        L5D_SYM(SSL_set_bio);
+        L5D_SYM(SSL_do_handshake);
+        L5D_SYM(SSL_read);
+        L5D_SYM(SSL_write);
+        L5D_SYM(SSL_get_error);
+        L5D_SYM(SSL_ctrl);
+        L5D_SYM(SSL_session_reused);
+        L5D_SYM(SSL_get1_session);
+        L5D_SYM(SSL_set_session);
+        L5D_SYM(SSL_SESSION_free);
+        L5D_SYM(SSL_get0_param);
+        L5D_SYM(X509_VERIFY_PARAM_set1_host);
+        L5D_SYM(SSL_shutdown);
+        L5D_SYM(SSL_set_shutdown);
+        L5D_SYM(BIO_new);
+        L5D_SYM(BIO_s_mem);
+        L5D_SYM(BIO_write);
+        L5D_SYM(BIO_read);
+        L5D_SYM(BIO_ctrl);
+        L5D_SYM(ERR_get_error);
+        L5D_SYM(ERR_error_string_n);
+        L5D_SYM(ERR_clear_error);
+#undef L5D_SYM
+        a.ok = all;
+    };
+    pthread_once(&once, [] { init(); });
+    return a;
+}
+
+inline bool available() { return api().ok; }
+inline const char* load_error() { return api().err.c_str(); }
+
+inline std::string ossl_errors() {
+    Api& a = api();
+    std::string out;
+    char buf[256];
+    for (int i = 0; i < 4; i++) {
+        unsigned long e = a.ERR_get_error();
+        if (!e) break;
+        a.ERR_error_string_n(e, buf, sizeof(buf));
+        if (!out.empty()) out += "; ";
+        out += buf;
+    }
+    return out.empty() ? "unknown TLS error" : out;
+}
+
+// ---- contexts ----
+
+// ALPN preference list in wire format: len-prefixed protocol names.
+inline std::string alpn_wire(const char* csv) {
+    std::string out;
+    if (csv == nullptr) return out;
+    const char* p = csv;
+    while (*p) {
+        const char* c = strchr(p, ',');
+        size_t n = c ? (size_t)(c - p) : strlen(p);
+        if (n > 0 && n < 256) {
+            out.push_back((char)n);
+            out.append(p, n);
+        }
+        p = c ? c + 1 : p + n;
+    }
+    return out;
+}
+
+struct Ctx {
+    SSL_CTX* ctx = nullptr;
+    std::string alpn;  // wire-format preference list (ours)
+    bool is_server = false;
+};
+
+// Server-preference ALPN select: first of OUR protocols the client
+// offered; no overlap -> NOACK (proceed without ALPN, prior-knowledge
+// clients still work).
+inline int alpn_select_cb(SSL*, const unsigned char** out,
+                          unsigned char* outlen, const unsigned char* in,
+                          unsigned inlen, void* arg) {
+    Ctx* c = (Ctx*)arg;
+    const unsigned char* pref = (const unsigned char*)c->alpn.data();
+    size_t pn = c->alpn.size();
+    for (size_t i = 0; i < pn;) {
+        unsigned char plen = pref[i];
+        for (unsigned j = 0; j < inlen;) {
+            unsigned char clen = in[j];
+            if (clen == plen && j + 1 + clen <= inlen &&
+                memcmp(pref + i + 1, in + j + 1, clen) == 0) {
+                *out = in + j + 1;
+                *outlen = clen;
+                return SSL_TLSEXT_ERR_OK;
+            }
+            j += 1 + clen;
+        }
+        i += 1 + plen;
+    }
+    return SSL_TLSEXT_ERR_NOACK;
+}
+
+// Server context: cert/key PEM + ALPN preference list ("h2,http/1.1").
+// nullptr + *err on failure.
+inline Ctx* server_ctx(const char* cert_path, const char* key_path,
+                       const char* alpn_csv, std::string* err) {
+    Api& a = api();
+    if (!a.ok) {
+        if (err) *err = a.err;
+        return nullptr;
+    }
+    a.ERR_clear_error();
+    SSL_CTX* sc = a.SSL_CTX_new(a.TLS_method());
+    if (!sc) {
+        if (err) *err = ossl_errors();
+        return nullptr;
+    }
+    a.SSL_CTX_ctrl(sc, SSL_CTRL_MODE,
+                   SSL_MODE_ENABLE_PARTIAL_WRITE |
+                       SSL_MODE_ACCEPT_MOVING_WRITE_BUFFER |
+                       SSL_MODE_RELEASE_BUFFERS,
+                   nullptr);
+    // session tickets are on by default; keep a server-side cache too so
+    // ticketless clients can still resume
+    a.SSL_CTX_ctrl(sc, SSL_CTRL_SET_SESS_CACHE_MODE, SSL_SESS_CACHE_SERVER,
+                   nullptr);
+    if (a.SSL_CTX_use_certificate_chain_file(sc, cert_path) != 1 ||
+        a.SSL_CTX_use_PrivateKey_file(sc, key_path, SSL_FILETYPE_PEM) != 1 ||
+        a.SSL_CTX_check_private_key(sc) != 1) {
+        if (err) *err = ossl_errors();
+        a.SSL_CTX_free(sc);
+        return nullptr;
+    }
+    Ctx* c = new Ctx();
+    c->ctx = sc;
+    c->is_server = true;
+    c->alpn = alpn_wire(alpn_csv);
+    if (!c->alpn.empty())
+        a.SSL_CTX_set_alpn_select_cb(sc, alpn_select_cb, c);
+    return c;
+}
+
+// Client context. verify=false skips chain+hostname validation
+// (tls.disableValidation parity); ca_path, when set, replaces the
+// default trust roots.
+inline Ctx* client_ctx(const char* alpn_csv, bool verify,
+                       const char* ca_path, std::string* err) {
+    Api& a = api();
+    if (!a.ok) {
+        if (err) *err = a.err;
+        return nullptr;
+    }
+    a.ERR_clear_error();
+    SSL_CTX* sc = a.SSL_CTX_new(a.TLS_method());
+    if (!sc) {
+        if (err) *err = ossl_errors();
+        return nullptr;
+    }
+    a.SSL_CTX_ctrl(sc, SSL_CTRL_MODE,
+                   SSL_MODE_ENABLE_PARTIAL_WRITE |
+                       SSL_MODE_ACCEPT_MOVING_WRITE_BUFFER |
+                       SSL_MODE_RELEASE_BUFFERS,
+                   nullptr);
+    a.SSL_CTX_ctrl(sc, SSL_CTRL_SET_SESS_CACHE_MODE, SSL_SESS_CACHE_CLIENT,
+                   nullptr);
+    if (verify) {
+        if (ca_path != nullptr && *ca_path) {
+            if (a.SSL_CTX_load_verify_locations(sc, ca_path, nullptr) != 1) {
+                if (err) *err = ossl_errors();
+                a.SSL_CTX_free(sc);
+                return nullptr;
+            }
+        }
+        a.SSL_CTX_set_verify(sc, SSL_VERIFY_PEER, nullptr);
+    } else {
+        a.SSL_CTX_set_verify(sc, SSL_VERIFY_NONE, nullptr);
+    }
+    Ctx* c = new Ctx();
+    c->ctx = sc;
+    c->is_server = false;
+    c->alpn = alpn_wire(alpn_csv);
+    return c;
+}
+
+inline void free_ctx(Ctx* c) {
+    if (!c) return;
+    if (c->ctx) api().SSL_CTX_free(c->ctx);
+    delete c;
+}
+
+// ---- per-connection session (the memory-BIO pump) ----
+
+struct Sess {
+    SSL* ssl = nullptr;
+    BIO* rbio = nullptr;  // ciphertext from the peer (we BIO_write)
+    BIO* wbio = nullptr;  // ciphertext to the peer (we BIO_read)
+    bool is_server = false;
+    bool hs_done = false;
+    bool fatal = false;
+    std::string alpn;       // negotiated protocol ("" = none)
+    std::string last_err;
+};
+
+// verify_name: hostname pinned against the peer cert (client side with
+// verification); also sent as SNI. resume: cached SSL_SESSION* to offer
+// (client side), or nullptr.
+inline Sess* new_session(Ctx* c, const char* verify_name, bool verify,
+                         SSL_SESSION* resume) {
+    Api& a = api();
+    if (!a.ok || c == nullptr || c->ctx == nullptr) return nullptr;
+    a.ERR_clear_error();
+    SSL* ssl = a.SSL_new(c->ctx);
+    if (!ssl) return nullptr;
+    BIO* rbio = a.BIO_new(a.BIO_s_mem());
+    BIO* wbio = a.BIO_new(a.BIO_s_mem());
+    if (!rbio || !wbio) {
+        a.SSL_free(ssl);
+        return nullptr;
+    }
+    a.SSL_set_bio(ssl, rbio, wbio);  // SSL owns the BIOs now
+    Sess* s = new Sess();
+    s->ssl = ssl;
+    s->rbio = rbio;
+    s->wbio = wbio;
+    s->is_server = c->is_server;
+    if (c->is_server) {
+        a.SSL_set_accept_state(ssl);
+    } else {
+        if (!c->alpn.empty())
+            a.SSL_set_alpn_protos(ssl,
+                                  (const unsigned char*)c->alpn.data(),
+                                  (unsigned)c->alpn.size());
+        if (verify_name != nullptr && *verify_name) {
+            // SNI (SSL_ctrl SSL_CTRL_SET_TLSEXT_HOSTNAME=55, type=0)
+            a.SSL_ctrl(ssl, 55, 0, (void*)verify_name);
+            if (verify)
+                a.X509_VERIFY_PARAM_set1_host(a.SSL_get0_param(ssl),
+                                              verify_name, 0);
+        }
+        if (resume != nullptr) a.SSL_set_session(ssl, resume);
+        a.SSL_set_connect_state(ssl);
+    }
+    return s;
+}
+
+inline void free_session(Sess* s) {
+    if (!s) return;
+    if (s->ssl) {
+        // Mark the connection cleanly shut down even when the close was
+        // abortive: SSL_free on an un-shutdown SSL invalidates its
+        // session (ssl_clear_bad_session marks it not_resumable), which
+        // would silently defeat resumption for any stashed session ref.
+        api().SSL_set_shutdown(s->ssl,
+                               SSL_SENT_SHUTDOWN | SSL_RECEIVED_SHUTDOWN);
+        api().SSL_free(s->ssl);  // frees the BIO pair too
+    }
+    delete s;
+}
+
+// Ciphertext read from the socket. Memory BIOs grow as needed; callers
+// feed at most one socket read (<=64KB) per call, so growth is bounded
+// by the read loop.
+inline bool feed(Sess* s, const char* data, size_t n) {
+    Api& a = api();
+    size_t off = 0;
+    while (off < n) {
+        int w = a.BIO_write(s->rbio, data + off, (int)(n - off));
+        if (w <= 0) {
+            s->fatal = true;
+            s->last_err = "BIO_write failed";
+            return false;
+        }
+        off += (size_t)w;
+    }
+    return true;
+}
+
+inline void drain_wbio(Sess* s, std::string* cipher_out) {
+    Api& a = api();
+    char buf[16 * 1024];
+    while (a.BIO_ctrl(s->wbio, BIO_CTRL_PENDING, 0, nullptr) > 0) {
+        int r = a.BIO_read(s->wbio, buf, sizeof(buf));
+        if (r <= 0) break;
+        cipher_out->append(buf, (size_t)r);
+    }
+}
+
+// Advance the state machine: handshake if pending, then decrypt all
+// available plaintext into *plain_in; outgoing ciphertext (handshake
+// records, session tickets, close-notify responses) is appended to
+// *cipher_out. Returns 0 = ok, -1 = fatal (flush cipher_out, close),
+// 1 = clean TLS shutdown from the peer.
+inline int pump(Sess* s, std::string* plain_in, std::string* cipher_out) {
+    Api& a = api();
+    if (s->fatal) return -1;
+    a.ERR_clear_error();
+    if (!s->hs_done) {
+        int r = a.SSL_do_handshake(s->ssl);
+        drain_wbio(s, cipher_out);
+        if (r == 1) {
+            s->hs_done = true;
+            const unsigned char* proto = nullptr;
+            unsigned plen = 0;
+            a.SSL_get0_alpn_selected(s->ssl, &proto, &plen);
+            if (proto != nullptr && plen > 0)
+                s->alpn.assign((const char*)proto, plen);
+        } else {
+            int e = a.SSL_get_error(s->ssl, r);
+            if (e != SSL_ERROR_WANT_READ && e != SSL_ERROR_WANT_WRITE) {
+                s->fatal = true;
+                s->last_err = ossl_errors();
+                return -1;
+            }
+            return 0;  // need more ciphertext from the peer
+        }
+    }
+    char buf[16 * 1024];
+    for (;;) {
+        int r = a.SSL_read(s->ssl, buf, sizeof(buf));
+        if (r > 0) {
+            plain_in->append(buf, (size_t)r);
+            continue;
+        }
+        int e = a.SSL_get_error(s->ssl, r);
+        drain_wbio(s, cipher_out);
+        if (e == SSL_ERROR_WANT_READ || e == SSL_ERROR_WANT_WRITE)
+            return 0;
+        if (e == SSL_ERROR_ZERO_RETURN) return 1;  // close-notify
+        s->fatal = true;
+        s->last_err = ossl_errors();
+        return -1;
+    }
+}
+
+// Encrypt plaintext; ciphertext lands in *cipher_out. Returns bytes of
+// plaintext consumed (0 while the handshake is still in flight, which
+// is not an error), or -1 on fatal error.
+inline long write_plain(Sess* s, const char* data, size_t n,
+                        std::string* cipher_out) {
+    Api& a = api();
+    if (s->fatal) return -1;
+    if (!s->hs_done) {
+        // drive the handshake opportunistically so connect-side sessions
+        // emit their ClientHello without waiting for socket readability
+        std::string scratch;
+        if (pump(s, &scratch, cipher_out) < 0) return -1;
+        // (scratch stays empty pre-handshake)
+        if (!s->hs_done) return 0;
+    }
+    a.ERR_clear_error();
+    size_t off = 0;
+    while (off < n) {
+        int w = a.SSL_write(s->ssl, data + off, (int)(n - off));
+        if (w > 0) {
+            off += (size_t)w;
+            continue;
+        }
+        int e = a.SSL_get_error(s->ssl, w);
+        if (e == SSL_ERROR_WANT_READ || e == SSL_ERROR_WANT_WRITE) break;
+        s->fatal = true;
+        s->last_err = ossl_errors();
+        drain_wbio(s, cipher_out);
+        return -1;
+    }
+    drain_wbio(s, cipher_out);
+    return (long)off;
+}
+
+inline bool resumed(Sess* s) {
+    return s->ssl != nullptr && api().SSL_session_reused(s->ssl) == 1;
+}
+
+// Client-side resumption: take a ref on the current session (caller
+// frees with free_ssl_session; TLS1.3 tickets arrive post-handshake so
+// call this after traffic has flowed).
+inline SSL_SESSION* get1_session(Sess* s) {
+    return api().SSL_get1_session(s->ssl);
+}
+
+inline void free_ssl_session(SSL_SESSION* sess) {
+    if (sess != nullptr) api().SSL_SESSION_free(sess);
+}
+
+// Append a close-notify record to cipher_out (best-effort graceful
+// shutdown; safe to skip on abortive closes).
+inline void shutdown(Sess* s, std::string* cipher_out) {
+    if (s->fatal || !s->hs_done) return;
+    api().SSL_shutdown(s->ssl);
+    drain_wbio(s, cipher_out);
+}
+
+}  // namespace l5dtls

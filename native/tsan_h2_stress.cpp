@@ -29,6 +29,12 @@ long fph2_drain_misses(void* e, char* buf, size_t cap);
 long fph2_stats_json(void* e, char* buf, size_t cap);
 long fph2_drain_features(void* e, float* buf, long cap_rows);
 void fph2_shutdown(void* e);
+int fph2_tls_runtime_available();
+int fph2_set_tls(void* e, const char* cert, const char* key,
+                 const char* alpn, char* err, size_t errcap);
+int fph2_listen_tls(void* e, const char* ip, int port);
+int fph2_set_client_tls(void* e, const char* alpn, int verify,
+                        const char* ca_path, char* err, size_t errcap);
 }
 
 namespace {
@@ -109,6 +115,45 @@ int main() {
         fprintf(stderr, "engine listen failed\n");
         return 2;
     }
+    // TLS leg (cert provided by the runner + OpenSSL runtime loads):
+    // h2c load -> front engine (TLS ORIGINATION, ALPN h2) -> this
+    // engine's TLS listener (TERMINATION) -> echo server. Exercises the
+    // memory-BIO pump on both legs under the sanitizer.
+    const char* cert = getenv("L5D_STRESS_CERT");
+    const char* key = getenv("L5D_STRESS_KEY");
+    bool tls_leg = cert && key && fph2_tls_runtime_available();
+    void* front = nullptr;
+    int front_port = 0;
+    if (tls_leg) {
+        char err[256];
+        if (fph2_set_tls(eng, cert, key, "h2", err, sizeof(err)) != 0) {
+            fprintf(stderr, "fph2_set_tls: %s\n", err);
+            return 2;
+        }
+        int tls_port = fph2_listen_tls(eng, "127.0.0.1", 0);
+        if (tls_port <= 0) {
+            fprintf(stderr, "tls listen failed\n");
+            return 2;
+        }
+        front = fph2_create();
+        if (fph2_set_client_tls(front, "h2", 0, nullptr, err,
+                                sizeof(err)) != 0) {
+            fprintf(stderr, "fph2_set_client_tls: %s\n", err);
+            return 2;
+        }
+        front_port = fph2_listen(front, "127.0.0.1", 0);
+        if (front_port <= 0) {
+            fprintf(stderr, "front listen failed\n");
+            return 2;
+        }
+        char tls_ep[64];
+        snprintf(tls_ep, sizeof(tls_ep), "127.0.0.1:%d ", tls_port);
+        fph2_set_route(front, "echoext", tls_ep);
+        fph2_start(front);
+    } else {
+        fprintf(stderr, "h2 stress: TLS leg skipped (%s)\n",
+                cert && key ? "no OpenSSL runtime" : "no cert in env");
+    }
     fph2_start(eng);
 
     ChurnArgs ca;
@@ -121,29 +166,38 @@ int main() {
     pthread_t churn_t;
     pthread_create(&churn_t, nullptr, churn_main, &ca);
 
-    LoadArgs la[2];
-    pthread_t load_t[2];
-    for (int i = 0; i < 2; i++) {
-        la[i].port = lport;
+    int nload = tls_leg ? 3 : 2;
+    LoadArgs la[3];
+    pthread_t load_t[3];
+    for (int i = 0; i < nload; i++) {
+        // the last loader drives the TLS chain through the front engine
+        la[i].port = (tls_leg && i == nload - 1) ? front_port : lport;
         pthread_create(&load_t[i], nullptr, load_main, &la[i]);
     }
-    uint64_t total = 0;
-    for (int i = 0; i < 2; i++) {
+    uint64_t total = 0, tls_total = 0;
+    for (int i = 0; i < nload; i++) {
         pthread_join(load_t[i], nullptr);
         total += la[i].done;
+        if (tls_leg && i == nload - 1) tls_total = la[i].done;
     }
 
     ca.stop.store(1);
     pthread_join(churn_t, nullptr);
+    if (front != nullptr) fph2_shutdown(front);
     fph2_shutdown(eng);
     h2bench::g_stop.store(1);
     pthread_join(serve_t, nullptr);
 
-    fprintf(stderr, "h2 stress: %llu requests proxied\n",
-            (unsigned long long)total);
+    fprintf(stderr, "h2 stress: %llu requests proxied (%llu via TLS)\n",
+            (unsigned long long)total, (unsigned long long)tls_total);
     if (total < 500) {
         fprintf(stderr, "too little traffic flowed (%llu)\n",
                 (unsigned long long)total);
+        return 3;
+    }
+    if (tls_leg && tls_total < 100) {
+        fprintf(stderr, "too little TLS traffic flowed (%llu)\n",
+                (unsigned long long)tls_total);
         return 3;
     }
     return 0;

@@ -31,12 +31,19 @@ long fp_drain_misses(void* ep, char* buf, size_t cap);
 long fp_stats_json(void* ep, char* buf, size_t cap);
 long fp_drain_features(void* ep, float* buf, long cap_rows);
 void fp_shutdown(void* ep);
+int fp_tls_runtime_available();
+int fp_set_tls(void* ep, const char* cert, const char* key,
+               const char* alpn, char* err, size_t errcap);
+int fp_listen_tls(void* ep, const char* ip, int port);
+int fp_set_client_tls(void* ep, const char* alpn, int verify,
+                      const char* ca_path, char* err, size_t errcap);
 }
 
 namespace {
 
 std::atomic<bool> stop{false};
 std::atomic<long> responses{0};
+std::atomic<long> tls_responses{0};  // via the front-engine TLS chain
 std::atomic<long> errors{0};
 
 // Minimal blocking HTTP/1.1 backend: fixed 200 response per request.
@@ -84,7 +91,7 @@ int listen_on(int* port_out) {
 }
 
 // Client: keep-alive requests against the proxy with a Host header.
-void client_loop(int proxy_port, int idx) {
+void client_loop(int proxy_port, int idx, std::atomic<long>* counter) {
     while (!stop.load()) {
         int fd = socket(AF_INET, SOCK_STREAM, 0);
         sockaddr_in addr{};
@@ -106,7 +113,7 @@ void client_loop(int proxy_port, int idx) {
             if (write(fd, req, rn) < 0) { errors.fetch_add(1); break; }
             ssize_t n = read(fd, buf, sizeof(buf));
             if (n <= 0) { errors.fetch_add(1); break; }
-            responses.fetch_add(1);
+            counter->fetch_add(1);
         }
         close(fd);
     }
@@ -120,9 +127,43 @@ int main() {
     if (lfd < 0) { perror("backend listen"); return 2; }
     std::thread backend(backend_loop, lfd);
 
+    // TLS leg (when the runner provides a cert + the OpenSSL runtime
+    // loads): cleartext clients -> front engine (TLS ORIGINATION) ->
+    // main engine's TLS listener (TERMINATION) -> backend. Both sides
+    // of the memory-BIO pump run under the sanitizer; no TLS client
+    // code needed. TLS contexts/listeners are installed BEFORE start()
+    // (the wrapper's contract: the loop thread reads them unlocked).
     void* ep = fp_create();
+    void* front = nullptr;
+    const char* cert = getenv("L5D_STRESS_CERT");
+    const char* key = getenv("L5D_STRESS_KEY");
+    bool tls_leg = cert && key && fp_tls_runtime_available();
     int proxy_port = fp_listen(ep, "127.0.0.1", 0);
     if (proxy_port <= 0) { fprintf(stderr, "fp_listen failed\n"); return 2; }
+    int tls_port = 0, front_port = 0;
+    if (tls_leg) {
+        char err[256];
+        if (fp_set_tls(ep, cert, key, "http/1.1", err, sizeof(err)) != 0) {
+            fprintf(stderr, "fp_set_tls: %s\n", err);
+            return 2;
+        }
+        tls_port = fp_listen_tls(ep, "127.0.0.1", 0);
+        if (tls_port <= 0) { fprintf(stderr, "tls listen failed\n"); return 2; }
+        front = fp_create();
+        if (fp_set_client_tls(front, "http/1.1", 0, nullptr, err,
+                              sizeof(err)) != 0) {
+            fprintf(stderr, "fp_set_client_tls: %s\n", err);
+            return 2;
+        }
+        front_port = fp_listen(front, "127.0.0.1", 0);
+        if (front_port <= 0) {
+            fprintf(stderr, "front listen failed\n");
+            return 2;
+        }
+    } else {
+        fprintf(stderr, "tsan_stress: TLS leg skipped (%s)\n",
+                cert && key ? "no OpenSSL runtime" : "no cert in env");
+    }
     if (fp_start(ep) != 0) { fprintf(stderr, "fp_start failed\n"); return 2; }
 
     char endpoints[64];
@@ -131,6 +172,19 @@ int main() {
         char host[32];
         snprintf(host, sizeof(host), "svc-%d", i);
         fp_set_route(ep, host, endpoints);
+    }
+    if (front != nullptr) {
+        if (fp_start(front) != 0) {
+            fprintf(stderr, "front start failed\n");
+            return 2;
+        }
+        char tls_ep[64];
+        snprintf(tls_ep, sizeof(tls_ep), "127.0.0.1:%d", tls_port);
+        for (int i = 0; i < 4; i++) {
+            char host[32];
+            snprintf(host, sizeof(host), "svc-%d", i);
+            fp_set_route(front, host, tls_ep);
+        }
     }
 
     // control-plane churn thread: install/remove routes while traffic runs
@@ -155,27 +209,43 @@ int main() {
             fp_drain_misses(ep, buf.data(), buf.size());
             fp_stats_json(ep, buf.data(), buf.size());
             fp_drain_features(ep, feats.data(), 1024);
+            if (front != nullptr) {
+                fp_drain_misses(front, buf.data(), buf.size());
+                fp_stats_json(front, buf.data(), buf.size());
+                fp_drain_features(front, feats.data(), 1024);
+            }
             usleep(2000);
         }
     });
 
     std::vector<std::thread> clients;
-    for (int i = 0; i < 4; i++) clients.emplace_back(client_loop, proxy_port, i);
+    for (int i = 0; i < 4; i++)
+        clients.emplace_back(client_loop, proxy_port, i, &responses);
+    if (tls_leg)  // the TLS chain: front (originate) -> ep (terminate)
+        for (int i = 0; i < 2; i++)
+            clients.emplace_back(client_loop, front_port, i,
+                                 &tls_responses);
 
     sleep(5);
     stop.store(true);
     for (auto& t : clients) t.join();
     churn.join();
     drain.join();
+    if (front != nullptr) fp_shutdown(front);
     fp_shutdown(ep);
     shutdown(lfd, SHUT_RDWR);
     close(lfd);
     backend.detach();
 
-    fprintf(stderr, "tsan_stress: %ld responses, %ld errors\n",
-            responses.load(), errors.load());
+    fprintf(stderr, "tsan_stress: %ld responses (%ld via TLS), "
+            "%ld errors\n", responses.load(), tls_responses.load(),
+            errors.load());
     if (responses.load() < 100) {
         fprintf(stderr, "tsan_stress: too little traffic flowed\n");
+        return 1;
+    }
+    if (tls_leg && tls_responses.load() < 50) {
+        fprintf(stderr, "tsan_stress: too little TLS traffic flowed\n");
         return 1;
     }
     return 0;

@@ -1,0 +1,289 @@
+"""h2 conformance/safety on the native fastpath engine (advisor findings).
+
+Each test pins one of the RFC 7540 guards in native/h2_fastpath.cpp with
+a raw-socket client that violates the protocol on purpose:
+
+- receive-side flow control is enforced: a stream overrunning our
+  advertised window is RST with FLOW_CONTROL_ERROR, a connection
+  overrunning the conn-level window gets GOAWAY(FLOW_CONTROL_ERROR)
+  (§6.9);
+- SETTINGS_INITIAL_WINDOW_SIZE above 2^31-1 is a connection error of
+  type FLOW_CONTROL_ERROR (§6.5.2);
+- an ``:authority`` with characters outside the host grammar is
+  rejected with a synthesized 400 before it can reach routing, parked
+  maps, or the stats JSON (wire input is untrusted);
+- a client stream id that goes backwards (or reuses a closed id) is
+  RST with STREAM_CLOSED instead of poisoning the connection (§5.1.1).
+"""
+
+import socket
+import struct
+import threading
+
+import pytest
+
+from linkerd_tpu import native
+from linkerd_tpu.protocol.h2.hpack import Decoder
+from linkerd_tpu.protocol.h2.messages import H2Response
+from linkerd_tpu.protocol.h2.server import H2Server
+from linkerd_tpu.router.service import FnService
+
+pytestmark = pytest.mark.skipif(
+    not native.ensure_built(), reason="native toolchain unavailable")
+
+PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+HEADERS, RST_STREAM, SETTINGS, GOAWAY, WINDOW_UPDATE = 0x1, 0x3, 0x4, 0x7, 0x8
+DATA = 0x0
+END_STREAM, END_HEADERS = 0x1, 0x4
+FLOW_CONTROL_ERROR, STREAM_CLOSED = 0x3, 0x5
+
+
+def frame(ftype: int, flags: int, sid: int, payload: bytes = b"") -> bytes:
+    return (len(payload).to_bytes(3, "big") + bytes([ftype, flags])
+            + sid.to_bytes(4, "big") + payload)
+
+
+def hpack_literal(headers) -> bytes:
+    """Literal Header Field without Indexing — New Name (RFC 7541 §6.2.2),
+    no Huffman: decodable by any conformant decoder, touches no dynamic
+    table state."""
+    out = b""
+    for n, v in headers:
+        nb, vb = n.encode(), v.encode()
+        assert len(nb) < 127 and len(vb) < 127
+        out += b"\x00" + bytes([len(nb)]) + nb + bytes([len(vb)]) + vb
+    return out
+
+
+def req_headers(authority: str, sid: int, end_stream: bool) -> bytes:
+    block = hpack_literal([(":method", "POST"), (":scheme", "http"),
+                           (":authority", authority), (":path", "/")])
+    flags = END_HEADERS | (END_STREAM if end_stream else 0)
+    return frame(HEADERS, flags, sid, block)
+
+
+class FrameReader:
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.buf = b""
+
+    def next(self):
+        """(type, flags, sid, payload) or None on EOF."""
+        while len(self.buf) < 9:
+            d = self.sock.recv(65536)
+            if not d:
+                return None
+            self.buf += d
+        n = int.from_bytes(self.buf[:3], "big")
+        ftype, flags = self.buf[3], self.buf[4]
+        sid = int.from_bytes(self.buf[5:9], "big") & 0x7FFFFFFF
+        while len(self.buf) < 9 + n:
+            d = self.sock.recv(65536)
+            if not d:
+                return None
+            self.buf += d
+        payload = self.buf[9:9 + n]
+        self.buf = self.buf[9 + n:]
+        return ftype, flags, sid, payload
+
+    def wait_for(self, ftype: int, sid=None):
+        """Skip frames until one matches; None if the peer closed first."""
+        while True:
+            fr = self.next()
+            if fr is None:
+                return None
+            if fr[0] == ftype and (sid is None or fr[2] == sid):
+                return fr
+
+
+def h2_connect(port: int) -> "tuple[socket.socket, FrameReader]":
+    s = socket.create_connection(("127.0.0.1", port))
+    s.settimeout(30)
+    s.sendall(PREFACE + frame(SETTINGS, 0, 0))
+    return s, FrameReader(s)
+
+
+@pytest.fixture
+def sink_backend():
+    """Accepts TCP but never speaks h2 back: the engine's upstream leg
+    gets no SETTINGS and no window grants, so client-side buffering (and
+    the grant gates) are fully deterministic."""
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(16)
+    held = []
+
+    def serve():
+        while True:
+            try:
+                c, _ = lsock.accept()
+            except OSError:
+                return
+            held.append(c)  # keep open, read nothing
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    yield lsock.getsockname()[1]
+    lsock.close()
+    for c in held:
+        c.close()
+
+
+@pytest.fixture
+def engine():
+    eng = native.H2FastPathEngine()
+    yield eng
+    eng.close()
+
+
+class TestFlowControlEnforcement:
+    def test_stream_overrun_rst_flow_control_error(self, engine,
+                                                   sink_backend):
+        """10MB of DATA on one stream: far past the 4MB advertised
+        stream window plus every grant the engine can legally have made
+        (grants stop once the per-stream pend cap is hit) -> RST with
+        FLOW_CONTROL_ERROR on that stream, connection survives."""
+        port = engine.listen("127.0.0.1", 0)
+        engine.start()
+        engine.set_route("sink", [("127.0.0.1", sink_backend)])
+        s, rd = h2_connect(port)
+        try:
+            s.sendall(req_headers("sink", 1, end_stream=False))
+            chunk = frame(DATA, 0, 1, b"\x00" * 16384)
+            for _ in range(10 * 1024 * 1024 // 16384):
+                s.sendall(chunk)
+            fr = rd.wait_for(RST_STREAM, sid=1)
+            assert fr is not None, "engine closed the conn instead of RST"
+            assert struct.unpack("!I", fr[3])[0] == FLOW_CONTROL_ERROR
+            # the connection is still alive: a PING comes back
+            s.sendall(frame(0x6, 0, 0, b"12345678"))
+            pong = rd.wait_for(0x6)
+            assert pong is not None and pong[3] == b"12345678"
+        finally:
+            s.close()
+
+    def test_conn_overrun_goaway_flow_control_error(self, engine,
+                                                    sink_backend):
+        """Eight streams each under their own stream window but 31MB in
+        total: past the 16MB conn window plus the conn grants the
+        engine's buffered-cap gate allows -> GOAWAY(FLOW_CONTROL_ERROR)
+        and the connection closes."""
+        port = engine.listen("127.0.0.1", 0)
+        engine.start()
+        engine.set_route("sink", [("127.0.0.1", sink_backend)])
+        s, rd = h2_connect(port)
+        goaway = []
+
+        def read_all():
+            while True:
+                fr = rd.next()
+                if fr is None:
+                    return
+                if fr[0] == GOAWAY:
+                    goaway.append(fr)
+
+        t = threading.Thread(target=read_all, daemon=True)
+        t.start()
+        try:
+            sids = [1 + 2 * i for i in range(8)]
+            for sid in sids:
+                s.sendall(req_headers("sink", sid, end_stream=False))
+            payload = b"\x00" * 16384
+            try:
+                # ~3.9MB per stream (< its 4MB window), 31MB total
+                for _ in range(250):
+                    for sid in sids:
+                        s.sendall(frame(DATA, 0, sid, payload))
+            except OSError:
+                pass  # engine already closed on us — that's the point
+            t.join(timeout=30)
+            assert goaway, "no GOAWAY before close"
+            last_sid, err = struct.unpack("!II", goaway[-1][3][:8])
+            assert err == FLOW_CONTROL_ERROR
+        finally:
+            s.close()
+
+
+class TestSettingsValidation:
+    def test_initial_window_size_over_2_31_is_conn_error(self, engine):
+        """SETTINGS_INITIAL_WINDOW_SIZE = 2^31 MUST be a connection
+        error of type FLOW_CONTROL_ERROR (RFC 7540 §6.5.2)."""
+        port = engine.listen("127.0.0.1", 0)
+        engine.start()
+        s = socket.create_connection(("127.0.0.1", port))
+        s.settimeout(30)
+        try:
+            bad = struct.pack("!HI", 0x4, 1 << 31)  # INITIAL_WINDOW_SIZE
+            s.sendall(PREFACE + frame(SETTINGS, 0, 0, bad))
+            rd = FrameReader(s)
+            fr = rd.wait_for(GOAWAY)
+            assert fr is not None
+            _, err = struct.unpack("!II", fr[3][:8])
+            assert err == FLOW_CONTROL_ERROR
+            assert rd.next() is None  # engine closed the connection
+        finally:
+            s.close()
+
+
+class TestAuthorityValidation:
+    def test_bad_authority_rejected_400(self, engine, sink_backend):
+        """An :authority outside the host grammar is answered with a
+        synthesized 400 — it must never reach routing (no route-miss is
+        recorded for it)."""
+        port = engine.listen("127.0.0.1", 0)
+        engine.start()
+        engine.set_route("sink", [("127.0.0.1", sink_backend)])
+        s, rd = h2_connect(port)
+        try:
+            # CR/LF + quote smuggling attempt in the authority
+            s.sendall(req_headers('evil"\r\nx: y', 1, end_stream=True))
+            fr = rd.wait_for(HEADERS, sid=1)
+            assert fr is not None
+            hdrs = dict(Decoder().decode(fr[3]))
+            assert hdrs[":status"] == "400"
+            assert hdrs.get("l5d-err") == "bad authority"
+            assert engine.drain_misses() == []
+        finally:
+            s.close()
+
+
+class TestStreamIdReuse:
+    def test_backwards_and_reused_stream_ids_rst(self, engine):
+        """After stream 5 completes, HEADERS on 3 (backwards) and on 5
+        (reuse of a closed id) are each RST with STREAM_CLOSED; stream 7
+        still works, proving the connection was spared."""
+        import asyncio
+
+        async def go():
+            async def echo(req):
+                body, _ = await req.stream.read_all(max_bytes=1 << 20)
+                return H2Response(status=200, body=body)
+
+            backend = await H2Server(FnService(echo)).start()
+            port = engine.listen("127.0.0.1", 0)
+            engine.start()
+            engine.set_route("echo", [("127.0.0.1", backend.bound_port)])
+
+            def drive():
+                s, rd = h2_connect(port)
+                try:
+                    s.sendall(req_headers("echo", 5, end_stream=True))
+                    assert rd.wait_for(HEADERS, sid=5) is not None
+                    for bad_sid in (3, 5):
+                        s.sendall(req_headers("echo", bad_sid,
+                                              end_stream=True))
+                        fr = rd.wait_for(RST_STREAM, sid=bad_sid)
+                        assert fr is not None, f"no RST for sid {bad_sid}"
+                        code = struct.unpack("!I", fr[3])[0]
+                        assert code == STREAM_CLOSED
+                    s.sendall(req_headers("echo", 7, end_stream=True))
+                    assert rd.wait_for(HEADERS, sid=7) is not None
+                finally:
+                    s.close()
+
+            try:
+                await asyncio.wait_for(asyncio.to_thread(drive), 30)
+            finally:
+                await backend.close()
+
+        asyncio.run(go())
