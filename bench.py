@@ -293,6 +293,127 @@ def subtle_auc_bench() -> dict:
     return out
 
 
+def native_score_bench() -> dict:
+    """In-data-plane scoring cost, measured on the REAL h1 engine with
+    paced loopback traffic — an A/B of the same paced run with and
+    without a published weight blob:
+
+    - ``native_score_p99_us``: per-row in-engine scoring cost from the
+      engine's ns histogram (featurize + dense forward on the epoll
+      thread);
+    - ``scored_added_p99_ms``: client-observed p99 delta between the
+      scored and unscored runs (the ISSUE bar: < 1.0 ms added for 100%
+      of requests);
+    - ``native_scored_fraction``: scored/(scored+unscored) on the
+      scored run — must be 1.0 (every request scored in-engine, not a
+      sampled batch).
+
+    Uses the C-side deterministic test blob, so this phase never
+    touches JAX or the device tunnel."""
+    import asyncio
+
+    import numpy as np
+
+    from linkerd_tpu import native
+
+    if not native.available():
+        return {"error": "native lib unavailable"}
+
+    async def drive() -> dict:
+        async def handle(r, w):
+            try:
+                while True:
+                    await r.readuntil(b"\r\n\r\n")
+                    w.write(b"HTTP/1.1 200 OK\r\n"
+                            b"Content-Length: 2\r\n\r\nok")
+                    await w.drain()
+            except Exception:  # noqa: BLE001 — client went away
+                pass
+
+        srv = await asyncio.start_server(handle, "127.0.0.1", 0)
+        bport = srv.sockets[0].getsockname()[1]
+        eng = native.FastPathEngine()
+        port = eng.listen("127.0.0.1", 0)
+        eng.start()
+        eng.set_route("svc", [("127.0.0.1", bport)])
+        eng.set_route_feature("svc", 14, 1.0)
+        rsp_len = len(b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nok")
+        req = b"GET / HTTP/1.1\r\nHost: svc\r\n\r\n"
+
+        async def paced_run(n: int, gap_s: float) -> np.ndarray:
+            """n paced requests on one keep-alive conn; per-request
+            client-observed latency (seconds)."""
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            lats = np.zeros(n)
+            try:
+                for i in range(n):
+                    t0 = time.perf_counter()
+                    w.write(req)
+                    await w.drain()
+                    await r.readexactly(rsp_len)
+                    lats[i] = time.perf_counter() - t0
+                    await asyncio.sleep(gap_s)
+            finally:
+                w.close()
+                try:
+                    await w.wait_closed()
+                except Exception:  # noqa: BLE001
+                    pass
+            return lats
+
+        try:
+            n, gap = 600, 0.001
+            await paced_run(50, 0)  # warm the route + upstream conn
+            eng.drain_features()
+            off = await paced_run(n, gap)
+            st_off = eng.stats().get("native_scorer", {})
+            eng.drain_features()
+            # publish + re-run the IDENTICAL paced load, now scored
+            eng.publish_weights(native.score_test_blob(version=1, seed=7))
+            on = await paced_run(n, gap)
+            rows = eng.drain_features()
+            st_on = eng.stats().get("native_scorer", {})
+            scored = int(st_on.get("scored", 0)) - int(
+                st_off.get("scored", 0))
+            unscored = int(st_on.get("unscored", 0)) - int(
+                st_off.get("unscored", 0))
+            hist = st_on.get("score_ns_hist", [])
+            total = sum(hist)
+            p99_ns = None
+            if total:
+                acc = 0
+                for b, c in enumerate(hist):
+                    acc += c
+                    if acc >= 0.99 * total:
+                        p99_ns = 2 ** (b + 1)  # bucket upper bound
+                        break
+            p99_on = float(np.percentile(on, 99))
+            p99_off = float(np.percentile(off, 99))
+            return {
+                "native_score_p99_us": (round(p99_ns / 1e3, 2)
+                                        if p99_ns is not None else None),
+                "scored_added_p99_ms": round(
+                    max(0.0, (p99_on - p99_off)) * 1e3, 3),
+                "native_scored_fraction": (
+                    round(scored / max(scored + max(unscored, 0), 1), 4)),
+                "scored_rows": scored,
+                "prescored_in_drain": int(
+                    (rows[:, 7] > 0.5).sum()) if len(rows) else 0,
+                "p99_scored_ms": round(p99_on * 1e3, 3),
+                "p99_unscored_ms": round(p99_off * 1e3, 3),
+                "paced_rate_rps": round(1.0 / gap, 1),
+            }
+        finally:
+            eng.close()
+            srv.close()
+            await srv.wait_closed()
+
+    # hard cap on the in-process phase: the engine awaits above have no
+    # individual timeouts, and a wedged exchange must cost THIS phase,
+    # not the whole round (the budget check only runs between phases)
+    return asyncio.run(asyncio.wait_for(drive(), 240))
+
+
 def proxy_bench() -> dict:
     """Config 1 through the fastpath engine, as subprocesses."""
     import subprocess
@@ -719,14 +840,92 @@ def resilience_bench() -> dict:
 # the driver's kill window while the first phase wedged on the tunnel.
 DEFAULT_BUDGET_S = 1200.0
 
+# Device-touching phases run as `bench.py --phase <name>` SUBPROCESSES
+# under their own timeout: BENCH_r05's failure mode was a hung axon
+# platform init wedging the whole bench process — the budget check only
+# runs between phases, so an in-process hang ate the entire round. A
+# child that hangs is killed at its timeout and costs exactly one
+# phase; every other number survives.
+DEVICE_PHASES = {"scorer", "auc", "subtle_auc", "sharded_cpu8",
+                 "lifecycle", "observability", "control_loop"}
+DEFAULT_PHASE_TIMEOUT_S = 420.0
+_PHASE_MARK = "BENCH_PHASE_DETAIL "
+
+
+def _last_phase_fragment(stdout) -> "dict | None":
+    """Newest parseable ``BENCH_PHASE_DETAIL`` fragment in a child's
+    stdout, or None. Children emit a fragment after every sub-step, so
+    a kill mid-phase (timeout, segfault mid-print leaving a torn final
+    line) still surrenders everything measured before it."""
+    if isinstance(stdout, bytes):
+        stdout = stdout.decode("utf-8", "replace")
+    for line in reversed((stdout or "").splitlines()):
+        if line.startswith(_PHASE_MARK):
+            try:
+                return json.loads(line[len(_PHASE_MARK):])
+            except ValueError:
+                continue  # torn line from a mid-print kill
+    return None
+
+
+def _run_phase_subprocess(name: str, timeout_s: float) -> dict:
+    """Run one phase isolated in a child; returns its detail fragment
+    (plus ``rows_per_s`` under the reserved ``_rows_per_s`` key), or an
+    {"<name>_error": ...} fragment on timeout/crash — merged with any
+    partial fragment the child managed to emit first."""
+    import subprocess
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--phase", name],
+            capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired as e:
+        frag = _last_phase_fragment(e.stdout) or {}
+        frag[f"{name}_error"] = (
+            f"phase timeout after {timeout_s:.0f}s (subprocess killed; "
+            "round continues"
+            + ("; partial results kept)" if frag else ")"))
+        return frag
+    frag = _last_phase_fragment(proc.stdout)
+    if frag is not None:
+        return frag
+    return {f"{name}_error":
+            f"phase subprocess rc={proc.returncode} with no detail: "
+            + (proc.stderr or proc.stdout)[-300:]}
+
+
+def _merge_detail(detail: dict, frag: dict) -> None:
+    """One-level-deep merge so e.g. a sharded_cpu8 fragment lands
+    INSIDE the scorer block another phase created."""
+    for k, v in frag.items():
+        if isinstance(v, dict) and isinstance(detail.get(k), dict):
+            detail[k].update(v)
+        else:
+            detail[k] = v
+
 
 def main() -> None:
+    only_phase = None
+    if "--phase" in sys.argv:
+        only_phase = sys.argv[sys.argv.index("--phase") + 1]
     detail: dict = {}
     state = {"rows_per_s": None}
     budget_s = float(os.environ.get("BENCH_BUDGET_S", DEFAULT_BUDGET_S))
+    phase_timeout_s = float(os.environ.get("BENCH_PHASE_TIMEOUT_S",
+                                           DEFAULT_PHASE_TIMEOUT_S))
     t_start = time.monotonic()
 
     def emit() -> None:
+        if only_phase is not None:
+            # child mode: every emit prints a full fragment, so a kill
+            # at the phase timeout still surrenders everything measured
+            # so far (e.g. scorer throughput stands even if a later
+            # probe in the same phase wedges)
+            frag = dict(detail)
+            if state["rows_per_s"] is not None:
+                frag["_rows_per_s"] = state["rows_per_s"]
+            print(_PHASE_MARK + json.dumps(frag), flush=True)
+            return
         rows_per_s = state["rows_per_s"]
         baseline = 50_000.0  # north-star: >=50k req/s (BASELINE.md)
         print(json.dumps({
@@ -838,6 +1037,16 @@ def main() -> None:
     def ph_control() -> None:
         detail["control_loop"] = control_loop_bench()
 
+    def ph_native_score() -> None:
+        ns = native_score_bench()
+        # headline rows at the top level (the acceptance bar reads
+        # them); the full A/B stays under detail.native_score
+        detail["native_score_p99_us"] = ns.get("native_score_p99_us")
+        detail["scored_added_p99_ms"] = ns.get("scored_added_p99_ms")
+        detail["native_scored_fraction"] = ns.get(
+            "native_scored_fraction")
+        detail["native_score"] = ns
+
     phases = [
         # fastest first: the headline line must exist on disk before
         # any phase that can wedge on the device tunnel gets a chance
@@ -847,6 +1056,7 @@ def main() -> None:
         # rc:124 mid-scorer must not lose the TLS claim.
         ("static_analysis", ph_static),
         ("race_analysis", ph_race),
+        ("native_score", ph_native_score),
         ("proxy", ph_proxy),
         ("grpc", ph_grpc),
         ("scorer", ph_scorer),
@@ -859,6 +1069,17 @@ def main() -> None:
         ("control_loop", ph_control),
         ("resilience", ph_resilience),
     ]
+    if only_phase is not None:
+        # child mode: run exactly one phase, print its detail fragment
+        # for the parent to merge (rows_per_s rides the fragment too;
+        # mid-phase emit()s printed earlier fragments already)
+        fn = dict(phases)[only_phase]
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 — partial results count
+            detail[f"{only_phase}_error"] = repr(e)
+        emit()
+        return
     emit()  # a hard kill mid-phase-1 must still leave a parsed line
     for name, fn in phases:
         spent = time.monotonic() - t_start
@@ -867,10 +1088,22 @@ def main() -> None:
             detail["budget_s"] = budget_s
             emit()  # skipping still re-emits: the round never zeroes
             continue
-        try:
-            fn()
-        except Exception as e:  # noqa: BLE001 — partial results count
-            detail[f"{name}_error"] = repr(e)
+        if name in DEVICE_PHASES:
+            try:
+                frag = _run_phase_subprocess(
+                    name, min(phase_timeout_s,
+                              max(30.0, budget_s - spent)))
+            except Exception as e:  # noqa: BLE001 — a child-handling
+                # bug must cost one phase, never the round
+                frag = {f"{name}_error": repr(e)}
+            state["rows_per_s"] = frag.pop("_rows_per_s",
+                                           state["rows_per_s"])
+            _merge_detail(detail, frag)
+        else:
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — partial results
+                detail[f"{name}_error"] = repr(e)
         emit()
 
 

@@ -24,6 +24,9 @@ from dataclasses import dataclass
 from typing import Optional
 
 from linkerd_tpu.lifecycle.drift import DriftMonitor
+from linkerd_tpu.lifecycle.export import (
+    WEIGHT_MAGIC, blob_meta, export_weight_blob,
+)
 from linkerd_tpu.lifecycle.promote import (
     Decision, EvalReport, GatePolicy, ModelLifecycleManager, PromotionGate,
     ReplayWindow, evaluate_snapshot,
@@ -70,6 +73,7 @@ __all__ = [
     "CheckpointCorruptError", "CheckpointError", "CheckpointStore",
     "Decision", "DriftMonitor", "EvalReport", "GatePolicy",
     "LifecycleConfig", "ModelLifecycleManager", "ModelSnapshot",
-    "PromotionGate", "ReplayWindow", "decode_snapshot", "encode_snapshot",
-    "evaluate_snapshot",
+    "PromotionGate", "ReplayWindow", "WEIGHT_MAGIC", "blob_meta",
+    "decode_snapshot", "encode_snapshot", "evaluate_snapshot",
+    "export_weight_blob",
 ]

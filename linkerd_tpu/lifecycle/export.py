@@ -1,0 +1,125 @@
+"""Native weight-blob export: the trained scorer, flattened for C++.
+
+``export_weight_blob`` turns a ``ModelSnapshot`` (the same host-side
+capture the CheckpointStore persists) into the versioned flat blob the
+native engines evaluate in-data-plane (``native/scorer.h``). The format
+is the seam between the JAX training tier and the C++ serving tier —
+keep it in lockstep with ``l5dscore::parse_blob``:
+
+    magic "L5DWTS01" | u32 version | u32 quant (0=f32, 1=int8)
+    | u32 in_dim | u32 n_enc | u32 n_dec | u32 n_cls | f32 recon_weight
+    | f32 mu[in_dim] | f32 var[in_dim]
+    | per layer (enc..., dec..., cls...):
+        u32 rows | u32 cols | f32 b[cols]
+        | quant 0: f32 w[rows*cols]   (row-major: w[i][j] = in i -> out j)
+        | quant 1: f32 scale[cols] | i8 w[rows*cols]
+    | u32 crc32 (zlib, over everything before it)
+
+int8 quantization is symmetric per OUTPUT column — scale[j] =
+max|w[:, j]| / 127 — with f32 biases and f32 accumulation on the C++
+side, so the error stays a per-weight rounding effect. The trailing
+CRC mirrors the CheckpointStore's integrity posture: a flipped bit is a
+rejected publish, never silently-wrong scores.
+
+Everything here is host-side numpy on an already-gathered snapshot: the
+export path must never touch the device (it runs at promote/hot-swap
+time next to the serving loop) — the l5dlint ``jax-hotpath`` rule roots
+``export_weight_blob`` to keep it that way.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+WEIGHT_MAGIC = b"L5DWTS01"
+QUANT_F32 = 0
+QUANT_INT8 = 1
+_QUANTS = {"f32": QUANT_F32, "int8": QUANT_INT8}
+
+
+def _f32(arr) -> np.ndarray:
+    return np.ascontiguousarray(arr, dtype=np.float32)
+
+
+def _layer_chunks(layer: Dict[str, Any], quant: int) -> List[bytes]:
+    w = _f32(layer["w"])
+    b = _f32(layer["b"])
+    if w.ndim != 2 or b.ndim != 1 or w.shape[1] != b.shape[0]:
+        raise ValueError(
+            f"layer shapes do not form a dense layer: w {w.shape}, "
+            f"b {b.shape}")
+    rows, cols = w.shape
+    out = [struct.pack("<II", rows, cols), b.tobytes()]
+    if quant == QUANT_F32:
+        out.append(w.tobytes())
+    else:
+        scale = np.abs(w).max(axis=0) / 127.0
+        scale = np.where(scale > 0, scale, 1.0).astype(np.float32)
+        wq = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+        out.append(_f32(scale).tobytes())
+        out.append(np.ascontiguousarray(wq).tobytes())
+    return out
+
+
+def export_weight_blob(snap, version: int, quant: str = "f32") -> bytes:
+    """``ModelSnapshot`` -> native weight blob (bytes, CRC'd).
+
+    ``version`` stamps the blob (the checkpoint version on a lifecycle
+    publish, the train step otherwise) so /model.json and the engine
+    stats can prove WHICH model the data plane is serving.
+    """
+    if quant not in _QUANTS:
+        raise ValueError(f"quant must be one of {sorted(_QUANTS)}, "
+                         f"got {quant!r}")
+    q = _QUANTS[quant]
+    params = snap.params
+    enc = list(params["enc"])
+    dec = list(params["dec"])
+    cls = list(params["cls"])
+    if not enc or not dec or not cls:
+        raise ValueError("snapshot params missing enc/dec/cls layers")
+    mu = _f32(snap.mu)
+    var = _f32(snap.var)
+    in_dim = int(np.asarray(params["enc"][0]["w"]).shape[0])  # l5d: ignore[jax-hotpath] — snapshot params are host numpy already; shape probe, not a readback
+    if mu.shape != (in_dim,) or var.shape != (in_dim,):
+        raise ValueError(
+            f"normalization stats ({mu.shape}/{var.shape}) do not match "
+            f"in_dim {in_dim}")
+    chunks = [
+        WEIGHT_MAGIC,
+        struct.pack("<IIIIII", int(version), q, in_dim,
+                    len(enc), len(dec), len(cls)),
+        struct.pack("<f", float(snap.cfg.recon_weight)),
+        mu.tobytes(),
+        var.tobytes(),
+    ]
+    for layer in enc + dec + cls:
+        chunks.extend(_layer_chunks(layer, q))
+    body = b"".join(chunks)
+    return body + struct.pack("<I", zlib.crc32(body))
+
+
+def blob_meta(blob: bytes) -> Optional[Dict[str, Any]]:
+    """Header + CRC of an exported blob, without the native lib (the
+    telemeter records this for /model.json). None on a malformed blob.
+    """
+    if len(blob) < len(WEIGHT_MAGIC) + 28 + 4 \
+            or not blob.startswith(WEIGHT_MAGIC):
+        return None
+    body, (crc,) = blob[:-4], struct.unpack("<I", blob[-4:])
+    if zlib.crc32(body) != crc:
+        return None
+    version, q, in_dim, n_enc, n_dec, n_cls = struct.unpack_from(
+        "<IIIIII", blob, len(WEIGHT_MAGIC))
+    return {
+        "version": int(version),
+        "crc": int(crc),
+        "quant": "int8" if q == QUANT_INT8 else "f32",
+        "in_dim": int(in_dim),
+        "layers": int(n_enc + n_dec + n_cls),
+        "bytes": len(blob),
+    }

@@ -217,6 +217,10 @@ class CheckpointMeta:
     status: str            # candidate | promoted | rejected | rolled_back
     created_at: float
     bytes: int
+    # native weight-blob lineage (set when this version was exported to
+    # the in-data-plane scorer): {crc, quant, bytes, ...} from
+    # lifecycle.export.blob_meta — proves WHICH bits the engines served
+    native_blob: Optional[Dict[str, Any]] = None
 
 
 class CheckpointStore:
@@ -295,6 +299,19 @@ class CheckpointStore:
         self._apply_retention()
         self._write_manifest()
         return version
+
+    def record_native_blob(self, version: int,
+                           meta: Optional[Dict[str, Any]]) -> None:
+        """Annotate a checkpoint's manifest entry with the native
+        weight blob exported from it (lifecycle.export.blob_meta): the
+        manifest then carries the full lineage from training state to
+        the exact CRC'd bits the data-plane engines serve."""
+        for e in self._manifest["versions"]:
+            if e["version"] == version:
+                e["native_blob"] = meta
+                self._write_manifest()
+                return
+        raise CheckpointError(f"unknown checkpoint version {version}")
 
     def mark(self, version: int, status: str) -> None:
         for e in self._manifest["versions"]:

@@ -65,6 +65,7 @@ def lib() -> Optional[ctypes.CDLL]:
         cdll = ctypes.CDLL(_SO_PATH)
         _declare_fastpath(cdll)
         _declare_h2_fastpath(cdll)
+        _declare_scorer(cdll)
         cdll.l5d_huffman_decode.restype = ctypes.c_long
         cdll.l5d_huffman_decode.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t,
@@ -119,6 +120,57 @@ def huffman_encode(data: bytes) -> Optional[bytes]:
     if n < 0:
         return None
     return out.raw[:n]
+
+
+def _declare_scorer(cdll: ctypes.CDLL) -> None:
+    """Engine-independent in-data-plane scorer exports (l5d_score_* /
+    l5d_slab_*) plus the per-engine publish/feature hooks."""
+    cdll.l5d_score_feature_dim.restype = ctypes.c_int
+    cdll.l5d_score_feature_dim.argtypes = []
+    cdll.l5d_score_blob_info.restype = ctypes.c_long
+    cdll.l5d_score_blob_info.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+        ctypes.c_size_t]
+    cdll.l5d_score_eval.restype = ctypes.c_long
+    cdll.l5d_score_eval.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_long, ctypes.c_long,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_char_p, ctypes.c_size_t]
+    cdll.l5d_score_eval_raw.restype = ctypes.c_long
+    cdll.l5d_score_eval_raw.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_long,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float), ctypes.c_char_p, ctypes.c_size_t]
+    cdll.l5d_slab_create.restype = ctypes.c_void_p
+    cdll.l5d_slab_create.argtypes = []
+    cdll.l5d_slab_publish.restype = ctypes.c_int
+    cdll.l5d_slab_publish.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_char_p, ctypes.c_size_t]
+    cdll.l5d_slab_score.restype = ctypes.c_long
+    cdll.l5d_slab_score.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_long,
+        ctypes.POINTER(ctypes.c_float)]
+    cdll.l5d_slab_stats.restype = ctypes.c_long
+    cdll.l5d_slab_stats.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+    cdll.l5d_slab_free.restype = None
+    cdll.l5d_slab_free.argtypes = [ctypes.c_void_p]
+    cdll.l5d_score_test_blob.restype = ctypes.c_long
+    cdll.l5d_score_test_blob.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32, ctypes.c_int,
+        ctypes.c_uint32]
+    for prefix in ("fp", "fph2"):
+        fn = getattr(cdll, prefix + "_publish_weights")
+        fn.restype = ctypes.c_int
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+                       ctypes.c_char_p, ctypes.c_size_t]
+        fn = getattr(cdll, prefix + "_set_route_feature")
+        fn.restype = ctypes.c_int
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+                       ctypes.c_float]
 
 
 def _declare_tls(cdll: ctypes.CDLL, prefix: str) -> None:
@@ -207,7 +259,10 @@ class FastPathEngine:
     misses, stats, and per-request feature rows.
     """
 
-    FEATURE_DIM = 6  # route_id, latency_ms, status, req_b, rsp_b, ts_s
+    # engine feature-row width: route_id, latency_ms, status, req_b,
+    # rsp_b, ts_s, score, scored (the last two are the in-data-plane
+    # scorer's output; scored == 0.0 rows fall back to the JAX tier)
+    FEATURE_DIM = 8
     _PREFIX = "fp"  # C symbol prefix; the h2 engine overrides to "fph2"
     # ALPN preference list the engine's TLS contexts advertise/offer
     _ALPN = "http/1.1"
@@ -227,6 +282,8 @@ class FastPathEngine:
         self._fn_stats = getattr(cdll, p + "_stats_json")
         self._fn_features = getattr(cdll, p + "_drain_features")
         self._fn_shutdown = getattr(cdll, p + "_shutdown")
+        self._fn_publish = getattr(cdll, p + "_publish_weights")
+        self._fn_route_feat = getattr(cdll, p + "_set_route_feature")
         self._e = getattr(cdll, p + "_create")()
         self._started = False
         self._closed = False
@@ -309,6 +366,29 @@ class FastPathEngine:
     def set_route(self, host: str, endpoints: List[Tuple[str, int]]) -> None:
         eps = " ".join(f"{ip}:{port}" for ip, port in endpoints) + " "
         self._fn_set_route(self._e, self._key(host), eps.encode())
+
+    def set_route_feature(self, host: str, col: int, sign: float) -> bool:
+        """Install the dst-path feature-hash (column, sign) for a route
+        so the in-engine scorer can featurize its rows; call after
+        set_route. Returns False while the route does not exist."""
+        return self._fn_route_feat(self._e, self._key(host), int(col),
+                                   float(sign)) == 0
+
+    def publish_weights(self, blob: bytes) -> None:
+        """Hot-swap the in-engine scorer's weights from a versioned
+        blob (lifecycle/export.export_weight_blob). Raises ValueError
+        on a rejected blob (bad magic/CRC/geometry); the data plane
+        never pauses — scoring flips to the new weights per-row."""
+        if self._closed:
+            # a stale sink calling into a freed C++ engine would be a
+            # native use-after-free, not a catchable Python error
+            raise RuntimeError("engine is closed")
+        err = ctypes.create_string_buffer(256)
+        rc = self._fn_publish(self._e, blob, len(blob), err, len(err))
+        if rc != 0:
+            raise ValueError(
+                f"weight blob rejected: "
+                f"{err.value.decode('latin-1') or 'unknown error'}")
 
     def remove_route(self, host: str) -> None:
         self._fn_remove_route(self._e, self._key(host))
@@ -421,3 +501,157 @@ def parse_http1_head(head: bytes
         val = head[spans[o + 2]:spans[o + 2] + spans[o + 3]].decode("latin-1")
         headers.append((name, val))
     return method, uri, version, headers
+
+
+# -- in-data-plane scorer (engine-independent surface) ------------------------
+
+
+def _as_f32_ptr(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def score_feature_dim() -> Optional[int]:
+    """The C featurizer's FEATURE_DIM (None = native unavailable)."""
+    cdll = lib()
+    return None if cdll is None else int(cdll.l5d_score_feature_dim())
+
+
+def score_blob_info(blob: bytes) -> Optional[dict]:
+    """Parse+validate a weight blob. Returns its header dict, or raises
+    ValueError with the parser's reason; None = native unavailable."""
+    import json
+    cdll = lib()
+    if cdll is None:
+        return None
+    out = ctypes.create_string_buffer(512)
+    n = cdll.l5d_score_blob_info(blob, len(blob), out, len(out))
+    if n < 0:
+        raise ValueError(out.value.decode("latin-1"))
+    return json.loads(out.value.decode("latin-1"))
+
+
+def score_eval(blob: bytes, x) -> Optional["object"]:
+    """Score featurized rows (f32 [n, in_dim]) with the C evaluator.
+    Returns f32 [n] scores; ValueError on a rejected blob; None when
+    the native lib is unavailable."""
+    import numpy as np
+    cdll = lib()
+    if cdll is None:
+        return None
+    x = np.ascontiguousarray(x, np.float32)
+    out = np.zeros(len(x), np.float32)
+    err = ctypes.create_string_buffer(256)
+    n = cdll.l5d_score_eval(blob, len(blob), _as_f32_ptr(x), len(x),
+                            x.shape[1], _as_f32_ptr(out), err, len(err))
+    if n < 0:
+        raise ValueError(err.value.decode("latin-1"))
+    return out
+
+
+def score_eval_raw(blob: bytes, rows, cols, signs, drifts,
+                   return_features: bool = False):
+    """Score RAW engine rows (f32 [n, 8] FeatureRow layout) through the
+    in-engine featurizer, with per-row dst-hash (cols/signs) and
+    pre-update drift supplied by the caller — the parity surface for the
+    C featurizer. Returns scores [n] (and features [n, FEATURE_DIM]
+    when requested); None = native unavailable."""
+    import numpy as np
+    cdll = lib()
+    if cdll is None:
+        return None
+    rows = np.ascontiguousarray(rows, np.float32)
+    n = len(rows)
+    cols = np.ascontiguousarray(cols, np.int32)
+    signs = np.ascontiguousarray(signs, np.float32)
+    drifts = np.ascontiguousarray(drifts, np.float32)
+    scores = np.zeros(n, np.float32)
+    dim = score_feature_dim()
+    feats = np.zeros((n, dim), np.float32) if return_features else None
+    err = ctypes.create_string_buffer(256)
+    rc = cdll.l5d_score_eval_raw(
+        blob, len(blob), _as_f32_ptr(rows), n,
+        cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        _as_f32_ptr(signs), _as_f32_ptr(drifts), _as_f32_ptr(scores),
+        _as_f32_ptr(feats) if feats is not None else None, err, len(err))
+    if rc < 0:
+        raise ValueError(err.value.decode("latin-1"))
+    return (scores, feats) if return_features else scores
+
+
+def score_test_blob(version: int = 1, quant: str = "f32",
+                    seed: int = 0) -> Optional[bytes]:
+    """Deterministic valid weight blob from the C-side generator (the
+    stress drivers' model) — lets tests exercise publish/score without
+    a JAX snapshot. None = native unavailable."""
+    cdll = lib()
+    if cdll is None:
+        return None
+    buf = ctypes.create_string_buffer(1 << 20)
+    n = cdll.l5d_score_test_blob(buf, len(buf), int(version),
+                                 1 if quant == "int8" else 0, int(seed))
+    if n < 0:
+        raise ValueError("test blob generation failed")
+    return buf.raw[:n]
+
+
+class ScoreSlab:
+    """Standalone handle on the double-buffered weight slab — the same
+    hot-swap machinery the engines embed, without an engine. Used by the
+    torn-weights concurrency tests and the bench's evaluator probe."""
+
+    def __init__(self):
+        cdll = lib()
+        if cdll is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = cdll
+        self._s = cdll.l5d_slab_create()
+
+    def _handle(self):
+        if self._s is None:
+            raise RuntimeError("slab is closed")
+        return self._s
+
+    def publish(self, blob: bytes) -> None:
+        # the C side rejects a valid blob whose in_dim disagrees with
+        # the featurizer width (l5d_slab_score strides by FEATURE_DIM)
+        s = self._handle()
+        err = ctypes.create_string_buffer(256)
+        if self._lib.l5d_slab_publish(s, blob, len(blob), err,
+                                      len(err)) != 0:
+            raise ValueError(
+                f"weight blob rejected: "
+                f"{err.value.decode('latin-1') or 'unknown error'}")
+
+    def score(self, x) -> Optional["object"]:
+        """Score featurized f32 [n, FEATURE_DIM] rows; None while no
+        weights are published. Rejects wrong-width input up front — the
+        C side strides by FEATURE_DIM unchecked (an engine-row-shaped
+        [n, 8] array would read out of bounds)."""
+        import numpy as np
+        s = self._handle()
+        x = np.ascontiguousarray(x, np.float32)
+        dim = int(self._lib.l5d_score_feature_dim())
+        if x.ndim != 2 or x.shape[1] != dim:
+            raise ValueError(
+                f"expected [n, {dim}] featurized rows, got {x.shape}")
+        out = np.zeros(len(x), np.float32)
+        n = self._lib.l5d_slab_score(s, _as_f32_ptr(x), len(x),
+                                     _as_f32_ptr(out))
+        return None if n < 0 else out
+
+    def stats(self) -> dict:
+        import json
+        out = ctypes.create_string_buffer(256)
+        self._lib.l5d_slab_stats(self._handle(), out, len(out))
+        return json.loads(out.value.decode("latin-1"))
+
+    def close(self) -> None:
+        if self._s is not None:
+            self._lib.l5d_slab_free(self._s)
+            self._s = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass

@@ -96,6 +96,10 @@ class _HostRoute:
                     eps.append((a.host, a.port))
         if eps:
             self.ctl.engine.set_route(self.host, sorted(set(eps)))
+            # the in-engine scorer needs the dst-path feature hash
+            # before it can featurize this route's rows; must follow
+            # set_route (the engine rejects unknown routes)
+            self.ctl.push_route_feature(self.host)
         else:
             # Neg everywhere / no replicas: drop the route so the engine
             # answers 400 (parity with UnboundError -> 4xx)
@@ -131,6 +135,8 @@ class FastPathController:
         self._tasks: List[asyncio.Task] = []
         self._last_stats: Dict[str, Dict[str, int]] = {}
         self._last_tls: Dict[str, int] = {}
+        self._last_scorer: Dict[str, int] = {}
+        self._weight_sink_regs: List[tuple] = []
         self._id_to_host: Dict[int, str] = {}
         self._scope = metrics.scope("rt", label, "fastpath")
         from linkerd_tpu.models.features import DstTemporal
@@ -140,10 +146,22 @@ class FastPathController:
         # when the ring is full — the engine must not grow unbounded)
         self._native_sinks: set = set()
         import numpy as np
-        self._scratch = np.zeros((1024, 6), np.float32)
+        from linkerd_tpu.telemetry.linerate import NATIVE_ROW_WIDTH
+        self._scratch = np.zeros((1024, NATIVE_ROW_WIDTH), np.float32)
 
     async def start(self) -> None:
         self.engine.start()
+        # in-data-plane scoring: hand the engine's weight-slab publish
+        # to every telemeter that exports native weight blobs — the
+        # telemeter replays its last blob immediately, so an engine
+        # that starts after the initial export still gets weights
+        if hasattr(self.engine, "publish_weights"):
+            sink = self.engine.publish_weights  # ONE bound method: the
+            for t in self.telemeters:           # unregister must remove
+                reg = getattr(t, "register_weight_sink", None)  # it
+                if reg is not None:
+                    reg(sink)
+                    self._weight_sink_regs.append((t, sink))
         from linkerd_tpu.core.tasks import monitor
         self._tasks = [
             monitor(asyncio.create_task(self._miss_loop(),
@@ -153,6 +171,23 @@ class FastPathController:
                                         name=f"fp-stats-{self.label}"),
                     what=f"fp-stats-{self.label}"),
         ]
+
+    def push_route_feature(self, host: str) -> None:
+        """Install the dst-path feature hash (column, sign) for a route
+        in the engine's in-data-plane scorer. The hash is computed over
+        the SAME ``{prefix}/{host}`` dst path the Python featurizer
+        resolves for this route (``_route_dst``), so engine-side and
+        Python-side features for one route land in the same column —
+        the native and JAX tiers score the same point."""
+        fn = getattr(self.engine, "set_route_feature", None)
+        if fn is None:
+            return  # stub engine (tests) or pre-scorer native lib
+        from linkerd_tpu.models.features import path_hash_cols
+        col, sign = path_hash_cols(f"{self.prefix.show}/{host}")
+        try:
+            fn(host, col, sign)
+        except Exception:  # noqa: BLE001 — a rejecting engine must not
+            log.exception("route feature push failed for %r", host)
 
     def resolve(self, host: str) -> None:
         """Begin (or refresh) resolution for a host."""
@@ -208,6 +243,22 @@ class FastPathController:
                     scope.counter(key).incr(delta)
             self._last_tls = {k: int(tls.get(k, 0))
                               for k in self._TLS_KEYS}
+        ns = snap.get("native_scorer")
+        if ns and (ns.get("weights") or ns.get("unscored")):
+            # in-data-plane scorer accounting under
+            # rt/<label>/fastpath/scorer/*: the live proof of WHICH
+            # tier scored (validator native-score mode reads these)
+            scope = self._scope.scope("scorer")
+            prev = self._last_scorer
+            for key in ("scored", "unscored", "swaps", "retries"):
+                delta = int(ns.get(key, 0)) - int(prev.get(key, 0))
+                if delta > 0:
+                    scope.counter(key).incr(delta)
+            self._last_scorer = {k: int(ns.get(k, 0)) for k in
+                                 ("scored", "unscored", "swaps",
+                                  "retries")}
+            scope.gauge("weights").set(1.0 if ns.get("weights") else 0.0)
+            scope.gauge("version").set(float(ns.get("version", 0)))
         for host, s in snap.get("routes", {}).items():
             if "id" in s:
                 self._id_to_host[int(s["id"])] = host
@@ -366,6 +417,15 @@ class FastPathController:
                 pass
             except Exception as e:  # noqa: BLE001 — loop crashes were
                 log.debug("fastpath loop exit: %r", e)  # already logged
+        # detach from weight publication BEFORE freeing the engine: a
+        # lifecycle promote after close() must not call into freed
+        # native memory (the engine guard raises, but the sink should
+        # simply be gone)
+        regs, self._weight_sink_regs = self._weight_sink_regs, []
+        for t, sink in regs:
+            unreg = getattr(t, "unregister_weight_sink", None)
+            if unreg is not None:
+                unreg(sink)
         for r in self._routes.values():
             r.close()
         self._routes.clear()

@@ -719,6 +719,22 @@ class JaxAnomalyConfig:
     # tier behind its breaker; "primary" keeps the sidecar as the one
     # scorer (the pre-line-rate wiring, used by the chaos harnesses)
     sidecarTier: str = "fallback"
+    # in-data-plane scoring (the native tier): "primary" exports the
+    # serving model as a versioned, CRC'd weight blob — published into
+    # the fastpath engines' double-buffered weight slab at startup and
+    # on every lifecycle promote/hot-swap — so engine rows arrive
+    # PRE-SCORED (featurized and evaluated inside the epoll thread,
+    # sub-ms added latency) and the JAX path only trains and serves
+    # rows the engine could not score; "off" keeps every row on the
+    # JAX tier. Python-path (non-fastPath) rows always score on JAX.
+    nativeTier: str = "primary"
+    nativeQuant: str = "f32"  # native blob weight encoding: f32 | int8
+    # without a lifecycle: block there are no promote/rollback events
+    # to chase, so the ONLINE-trained model is re-exported to the
+    # engines on this cadence (seconds; 0 disables) — the native tier
+    # must track training, not serve the startup init blob forever.
+    # With a lifecycle, promotes republish and bound the staleness.
+    nativeRefreshS: float = 30.0
     # scorer-path resilience (sidecar mode): per-call deadline, breaker
     # thresholds/probe backoffs, and the ScoreBoard staleness TTL (stale
     # scores decay to neutral so a dead scorer can't pin accrual policy)
@@ -748,6 +764,12 @@ class JaxAnomalyTelemeter(Telemeter):
             raise ValueError("maxBatchesPerWake must be >= 1")
         if cfg.sidecarTier not in ("primary", "fallback"):
             raise ValueError("sidecarTier must be 'primary' or 'fallback'")
+        if cfg.nativeTier not in ("primary", "off"):
+            raise ValueError("nativeTier must be 'primary' or 'off'")
+        if cfg.nativeQuant not in ("f32", "int8"):
+            raise ValueError("nativeQuant must be 'f32' or 'int8'")
+        if cfg.nativeRefreshS < 0:
+            raise ValueError("nativeRefreshS must be >= 0")
         if cfg.maxLingerMs < 0:
             raise ValueError("maxLingerMs must be >= 0")
         if cfg.scoreConcurrency < 1:
@@ -769,6 +791,12 @@ class JaxAnomalyTelemeter(Telemeter):
         self._fit_lock = asyncio.Lock()
         self._node = metrics.scope("anomaly")
         self._scored = self._node.counter("scored_total")
+        # rows scored IN the native engines (in-data-plane tier); the
+        # scored_total counter includes them — native_scored_fraction
+        # is the native-vs-JAX tier split
+        self._native_scored = self._node.counter("native_scored_total")
+        self._node.gauge("native_scored_fraction",
+                         fn=self._native_fraction)
         # every request that ENTERS the scoring path (recorder append or
         # native-ring row): scored_total / requests_total is the scored
         # fraction — "100% scored" is measured, not asserted
@@ -790,6 +818,16 @@ class JaxAnomalyTelemeter(Telemeter):
         self._dropped_batches = self._node.counter("dropped_batches")
         self._gauges: Dict[str, object] = {}
         self._batch_i = 0
+        # native weight publication: the FastPath controllers register
+        # their engines as sinks; the serving model is exported as a
+        # CRC'd blob at startup and on every lifecycle promote/rollback
+        # hot-swap, and the last blob is replayed to late registrations
+        self._weight_sinks: List[Callable[[bytes], None]] = []
+        self._last_blob: Optional[bytes] = None
+        self._native_blob_meta: Optional[dict] = None
+        self._native_publishes = 0
+        self._last_native_pub = 0.0   # monotonic; periodic re-export
+        self._native_refreshing = False
         # span sink (the linker's BroadcastTracer): scorer-path spans —
         # per-request children of the originating trace plus one batch
         # span linking its constituents — flow to every tracer telemeter
@@ -837,6 +875,136 @@ class JaxAnomalyTelemeter(Telemeter):
         if req <= 0:
             return 1.0
         return min(1.0, self._scored.value / req)
+
+    def _native_fraction(self) -> float:
+        scored = self._scored.value
+        if scored <= 0:
+            return 0.0
+        return min(1.0, self._native_scored.value / scored)
+
+    # -- native tier: weight export + publication -------------------------
+    def register_weight_sink(self, sink: Callable[[bytes], None]) -> None:
+        """Install a native-engine publish callback (the FastPath
+        controller registers ``engine.publish_weights`` here). The last
+        exported blob is replayed immediately, so registration order
+        against the startup publish does not matter."""
+        self._weight_sinks.append(sink)
+        if self._last_blob is not None:
+            self._publish_blob_to(sink, self._last_blob)
+
+    def unregister_weight_sink(self, sink: Callable[[bytes], None]) -> None:
+        """Remove an engine's publish callback (the controller calls
+        this from close(): a later promote must not call into a freed
+        native engine)."""
+        try:
+            self._weight_sinks.remove(sink)
+        except ValueError:
+            pass
+
+    def _publish_blob_to(self, sink, blob: bytes) -> None:
+        try:
+            sink(blob)
+        except Exception:  # noqa: BLE001 — a rejecting engine must not
+            # take down the telemeter; the JAX tier keeps scoring
+            log.exception("native weight publish failed")
+
+    async def refresh_native_weights(self, scorer: Optional[Scorer] = None,
+                                     version: Optional[int] = None) -> bool:
+        """Export the serving model as a native weight blob and publish
+        it to every registered engine (double-buffered hot-swap in the
+        slab — the data plane never pauses). Called at startup and after
+        every lifecycle promote/rollback; also admin-invocable via the
+        lifecycle cycle. Returns True when a blob went out."""
+        if self.cfg.nativeTier != "primary":
+            return False
+        scorer = scorer or self._ensure_scorer()
+        snap_fn = getattr(scorer, "snapshot", None)
+        if snap_fn is None or asyncio.iscoroutinefunction(snap_fn):
+            # no host-side snapshot surface (stub scorer, sidecar-primary
+            # wiring): the native tier stays off, rows fall back to JAX
+            return False
+        from linkerd_tpu.lifecycle.export import (
+            blob_meta, export_weight_blob,
+        )
+        try:
+            snap = await asyncio.to_thread(snap_fn)  # l5d: ignore[jax-hotpath] — weight export is a fire-and-forget task on the nativeRefreshS (>=30s) cadence, never a per-batch hop; the device readback must NOT run on the event loop
+            if version is None:
+                version = (self._lifecycle.serving_version
+                           if self._lifecycle is not None else None)
+            if version is None:
+                version = int(getattr(scorer, "_step", 0) or 0)
+            blob = await asyncio.to_thread(  # l5d: ignore[jax-hotpath] — same cadence-bounded export task: flattening a few-thousand-param snapshot off-loop, not a dispatch-path hop
+                export_weight_blob, snap, int(version),
+                self.cfg.nativeQuant)
+        except Exception:  # noqa: BLE001 — export failures must never
+            # stop scoring; the JAX tier serves everything meanwhile
+            log.exception("native weight export failed")
+            return False
+        self._last_blob = blob
+        self._native_blob_meta = blob_meta(blob)
+        self._native_publishes += 1
+        self._last_native_pub = time.monotonic()
+        if (self._lifecycle is not None
+                and version == self._lifecycle.serving_version):
+            # the blob rides the checkpoint manifest: the serving
+            # version's entry records exactly which CRC'd bits went to
+            # the engines (lineage from training state to data plane)
+            try:
+                self._lifecycle.store.record_native_blob(
+                    int(version), self._native_blob_meta)
+            except Exception:  # noqa: BLE001 — lineage annotation must
+                log.exception("native blob manifest record failed")
+        for sink in list(self._weight_sinks):
+            self._publish_blob_to(sink, blob)
+        return True
+
+    def _maybe_refresh_native_weights(self, scorer: Scorer) -> None:
+        """Periodic re-export of the ONLINE-trained model to the
+        engines when no lifecycle manages promotes — without this the
+        native tier would serve the startup init blob forever while
+        training improves only the JAX model. Fire-and-forget with a
+        reentrancy guard; with a lifecycle configured, promote/rollback
+        republishes bound the staleness instead (and keep the manifest
+        lineage exact)."""
+        if (self.cfg.nativeTier != "primary"
+                or self._lifecycle is not None
+                or not self.cfg.nativeRefreshS
+                or not self._weight_sinks
+                or self._native_refreshing
+                or time.monotonic() - self._last_native_pub
+                < self.cfg.nativeRefreshS):
+            return
+        self._native_refreshing = True
+
+        async def go() -> None:
+            try:
+                await self.refresh_native_weights(scorer)
+            finally:
+                # rate-limit retries on export failure too
+                self._last_native_pub = time.monotonic()
+                self._native_refreshing = False
+
+        from linkerd_tpu.core.tasks import monitor
+        monitor(asyncio.create_task(go(), name="native-weight-refresh"),
+                what="native-weight-refresh")
+
+    def native_tier_state(self) -> dict:
+        """The /model.json + /control.json native-tier block: what blob
+        the engines serve (version/CRC), how often it swapped, and the
+        native-vs-JAX scored split."""
+        scored = self._scored.value
+        nat = self._native_scored.value
+        return {
+            "mode": self.cfg.nativeTier,
+            "quant": self.cfg.nativeQuant,
+            "blob": self._native_blob_meta,
+            "publishes": self._native_publishes,
+            "engines": len(self._weight_sinks),
+            "native_scored_total": nat,
+            "jax_scored_total": scored - nat,
+            "native_scored_fraction": (round(nat / scored, 6)
+                                       if scored else 0.0),
+        }
 
     # -- stack tap --------------------------------------------------------
     def recorder(self) -> FeatureRecorder:
@@ -951,6 +1119,10 @@ class JaxAnomalyTelemeter(Telemeter):
             except Exception:  # noqa: BLE001 — a bad store must not
                 log.exception("checkpoint bootstrap failed; "
                               "serving from fresh init")
+        # initial native publish: the engines score in-data-plane from
+        # the first request (fresh-init weights if nothing restored;
+        # promotions republish as the model improves)
+        await self.refresh_native_weights(scorer)
         control_task = None
         if self.control is not None:
             from linkerd_tpu.core.tasks import monitor
@@ -1064,6 +1236,11 @@ class JaxAnomalyTelemeter(Telemeter):
             outcome = await self._lifecycle.run_cycle(self._ensure_scorer())
             log.info("model lifecycle cycle: %s",
                      outcome.get("action", "?"))
+            if outcome.get("action") in ("promoted", "rolled_back"):
+                # the serving model changed (hot-swap): the native tier
+                # must follow, or the engines keep scoring the old one
+                await self.refresh_native_weights(
+                    version=self._lifecycle.serving_version)
             return outcome
         except Exception:  # noqa: BLE001 — lifecycle failures must never
             log.exception("model lifecycle cycle failed")  # stop scoring
@@ -1097,7 +1274,16 @@ class JaxAnomalyTelemeter(Telemeter):
         """Assemble one micro-batch: Python-path ring items plus a
         zero-copy block of native engine rows. Featurization happens
         HERE, synchronously — the native block is a view into ring
-        memory that is only valid until the caller's next await."""
+        memory that is only valid until the caller's next await.
+
+        Engine rows that arrived PRE-SCORED (the in-data-plane native
+        tier; scored flag set) are split out of the JAX dispatch: their
+        features still feed training/drift/holdout, but the device
+        never re-scores them. ``x`` holds only the rows that NEED a
+        JAX score (Python-path + unscored native rows)."""
+        from linkerd_tpu.telemetry.linerate import (
+            NATIVE_COL_SCORE, NATIVE_COL_SCORED,
+        )
         n_py = min(len(self.ring), self.cfg.maxBatch)
         # ring items are (fv, label[, trace, enqueued_at, endpoint]) —
         # external producers (benchmarks, fault harnesses) still append
@@ -1109,90 +1295,164 @@ class JaxAnomalyTelemeter(Telemeter):
         if not items and k == 0:
             return None
         fvs = [it[0] for it in items]
-        labels = np.array(
-            [0.0 if it[1] is None else float(it[1]) for it in items]
-            + [0.0] * k, dtype=np.float32)
-        mask = np.array(
-            [0.0 if it[1] is None else 1.0 for it in items]
-            + [0.0] * k, dtype=np.float32)
         x_py = featurize_batch(fvs)
         nat_inv: Optional[np.ndarray] = None
         nat_dsts: List[str] = []
+        nat_scored: Optional[dict] = None
+        x_nat: Optional[np.ndarray] = None
         if k:
-            x_nat, nat_inv, nat_dsts = \
+            # encode the WHOLE block in one pass — the featurizer's
+            # per-route drift EWMA must advance exactly once per drain,
+            # in arrival order (two subset passes would double-step the
+            # baseline and compute the later subset's drift against an
+            # already-advanced EWMA) — then split the ENCODED rows by
+            # tier. Boolean fancy indexing copies, safe across awaits.
+            x_enc, inv_all, dsts = \
                 self._native_featurizer.encode_block(nat_block)
+            is_scored = nat_block[:, NATIVE_COL_SCORED] > 0.5
+            if is_scored.any():
+                all_sc = bool(is_scored.all())
+                nat_scored = {
+                    "x": x_enc if all_sc else x_enc[is_scored],
+                    "scores": np.ascontiguousarray(
+                        nat_block[is_scored, NATIVE_COL_SCORE],
+                        np.float32),
+                    "inv": inv_all if all_sc else inv_all[is_scored],
+                    "dsts": dsts,
+                }
+            un = ~is_scored
+            if un.any():
+                all_un = bool(un.all())
+                x_nat = x_enc if all_un else x_enc[un]
+                nat_inv = inv_all if all_un else inv_all[un]
+                nat_dsts = dsts
+        k_un = 0 if x_nat is None else len(x_nat)
+        labels = np.array(
+            [0.0 if it[1] is None else float(it[1]) for it in items]
+            + [0.0] * k_un, dtype=np.float32)
+        mask = np.array(
+            [0.0 if it[1] is None else 1.0 for it in items]
+            + [0.0] * k_un, dtype=np.float32)
+        if x_nat is not None:
             x = np.concatenate([x_py, x_nat]) if n_py else x_nat
         else:
             x = x_py
         return {"items": items, "fvs": fvs, "x": x, "labels": labels,
                 "mask": mask, "n_py": n_py, "nat_inv": nat_inv,
-                "nat_dsts": nat_dsts}
+                "nat_dsts": nat_dsts, "nat_scored": nat_scored}
 
     async def _score_and_publish(self, scorer: Scorer, b: dict) -> int:
         """Score one assembled batch and publish every downstream
         effect: degraded-mode accounting, scorer spans, lifecycle
-        drift/holdout, per-dst board updates, training cadence."""
+        drift/holdout, per-dst board updates, training cadence.
+
+        Rows the engines already scored in-data-plane (``nat_scored``)
+        skip the JAX dispatch entirely: their scores publish straight
+        to the board, their features still feed drift/holdout/training
+        — the RingDispatcher stays the training and fallback tier."""
         x, items, n_py = b["x"], b["items"], b["n_py"]
-        n = len(x)
+        ns = b.get("nat_scored")
+        k_ns = 0 if ns is None else len(ns["x"])
+        n_jax = len(x)
         t_drain = time.monotonic()
         ts_us = int(time.time() * 1e6)
-        try:
-            scores = await scorer.score(x)
-        except asyncio.CancelledError:
-            raise
-        except Exception as e:  # noqa: BLE001 — graceful degradation:
-            # scoring is best-effort; a dead/hung scorer drops the batch
-            # (requests were never blocked on it) and flips degraded mode
-            self._score_failures.incr()
-            self._dropped_batches.incr()
-            if not self.board.degraded:
-                log.warning("anomaly scorer degraded "
-                            "(scoring paused, data plane unaffected): %r", e)
-            self._set_degraded(True)
-            return 0
-        scores = np.asarray(scores)  # l5d: ignore[jax-hotpath] — scorers return host arrays (the drainer already did readback); this is a no-op view
-        if self.board.degraded:
-            log.info("anomaly scorer recovered; scoring resumed")
-        self._set_degraded(False)
-        self._scored.incr(n)
-        self._batches.incr()
-        if self._span_sink is not None:
-            self._record_scorer_spans(
-                items, t_drain, ts_us,
-                int((time.monotonic() - t_drain) * 1e6), scorer)
+        scores: Optional[np.ndarray] = None
+        jax_failed = False
+        if n_jax:
+            try:
+                scores = await scorer.score(x)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — graceful degradation:
+                # scoring is best-effort; a dead/hung scorer drops the
+                # JAX half of the batch (requests were never blocked on
+                # it) and flips degraded mode — engine-scored rows still
+                # publish below: the native tier does not depend on the
+                # device being healthy
+                self._score_failures.incr()
+                self._dropped_batches.incr()
+                jax_failed = True
+                if not self.board.degraded:
+                    log.warning(
+                        "anomaly scorer degraded (scoring paused, data "
+                        "plane unaffected): %r", e)
+                self._set_degraded(True)
+                if k_ns == 0:
+                    return 0
+            else:
+                scores = np.asarray(scores)  # l5d: ignore[jax-hotpath] — scorers return host arrays (the drainer already did readback); this is a no-op view
+                if self.board.degraded:
+                    log.info("anomaly scorer recovered; scoring resumed")
+                self._set_degraded(False)
+        n_scored = (n_jax if scores is not None else 0) + k_ns
+        self._scored.incr(n_scored)
+        if k_ns:
+            self._native_scored.incr(k_ns)
+        if not jax_failed:
+            # a failed JAX dispatch was already counted dropped; the
+            # native half still publishes below but the batch must not
+            # ALSO count completed, nor export scorer spans for the
+            # Python items whose scoring was just dropped
+            self._batches.incr()
+            if self._span_sink is not None:
+                self._record_scorer_spans(
+                    items, t_drain, ts_us,
+                    int((time.monotonic() - t_drain) * 1e6), scorer)
+        # every row with a score — JAX-scored and engine-scored alike —
+        # feeds drift/holdout; labels/mask for the native rows are all
+        # zeros (engine rows are never fault-labeled)
+        x_all, labels_all, mask_all, scores_all = x, b["labels"], \
+            b["mask"], scores
+        if k_ns:
+            if scores is not None and n_jax:
+                x_all = np.concatenate([x, ns["x"]])
+                scores_all = np.concatenate(
+                    [scores, ns["scores"]])
+                labels_all = np.concatenate(
+                    [b["labels"], np.zeros(k_ns, np.float32)])
+                mask_all = np.concatenate(
+                    [b["mask"], np.zeros(k_ns, np.float32)])
+            else:
+                x_all, scores_all = ns["x"], ns["scores"]
+                labels_all = np.zeros(k_ns, np.float32)
+                mask_all = np.zeros(k_ns, np.float32)
         holdout = False
-        if self._lifecycle is not None:
+        if self._lifecycle is not None and scores_all is not None:
             # drift sees every batch (read-only); the replay window only
             # takes HOLDOUT batches, which are then excluded from
             # training below — a shadow-eval set the candidate trained on
             # (same rows AND same labels) could not catch a poisoned
             # training stream, because the poisoned candidate evaluates
             # best on its own poison
-            self._lifecycle.drift.observe(x, scores)
+            self._lifecycle.drift.observe(x_all, scores_all)
             holdout = self._batch_i % self.cfg.lifecycle.holdoutEveryBatches == 0
             if holdout:
-                self._lifecycle.replay.add_batch(x, b["labels"], b["mask"])
-        self.board.update_batch([fv.dst_path for fv in b["fvs"]],
-                                scores[:n_py],
-                                endpoints=[it[4] for it in items])
-        if b["nat_inv"] is not None and b["nat_dsts"]:
-            # native rows: per-ROUTE means, vectorized (update_batch
-            # averages per dst anyway, so feeding group means is
-            # equivalent to feeding every row)
-            inv = b["nat_inv"]
-            m = len(b["nat_dsts"])
-            sums = np.bincount(inv, weights=scores[n_py:], minlength=m)
-            counts = np.maximum(np.bincount(inv, minlength=m), 1)
-            self.board.update_batch(b["nat_dsts"], sums / counts)
+                self._lifecycle.replay.add_batch(x_all, labels_all,
+                                                 mask_all)
+        if scores is not None:
+            self.board.update_batch([fv.dst_path for fv in b["fvs"]],
+                                    scores[:n_py],
+                                    endpoints=[it[4] for it in items])
+            if b["nat_inv"] is not None and b["nat_dsts"]:
+                # native rows: per-ROUTE means, vectorized (update_batch
+                # averages per dst anyway, so feeding group means is
+                # equivalent to feeding every row)
+                self._publish_route_means(
+                    b["nat_dsts"], b["nat_inv"], scores[n_py:])
+        self._publish_native_batch(ns)
         self._publish_gauges()
         self._batch_i += 1
         if (not holdout and self.cfg.trainEveryBatches
+                and not jax_failed
                 and self._batch_i % self.cfg.trainEveryBatches == 0):
             try:
                 # serialized: concurrent line-rate batches must not
-                # interleave their fit steps
+                # interleave their fit steps. Engine-scored rows train
+                # too — the JAX model is the training tier for ALL
+                # traffic, or it would drift away from the distribution
+                # the native tier actually serves
                 async with self._fit_lock:
-                    loss = await scorer.fit(x, b["labels"], b["mask"])
+                    loss = await scorer.fit(x_all, labels_all, mask_all)
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # noqa: BLE001 — training is optional;
@@ -1202,7 +1462,37 @@ class JaxAnomalyTelemeter(Telemeter):
                 log.debug("online fit skipped (scorer failure): %r", e)
             else:
                 self._train_loss.set(loss)
-        return n
+                self._maybe_refresh_native_weights(scorer)
+        return n_scored
+
+    def _publish_native_batch(self, ns: Optional[dict]) -> None:
+        """Publish engine-scored rows to the board: per-route score
+        means, no device work — the scores were computed in-data-plane
+        and this hop is pure host arithmetic (a jax-hotpath root: a
+        device seam creeping in here would put the old per-batch
+        latency right back on the native tier's publish path)."""
+        if ns is None or not ns["dsts"]:
+            return
+        self._publish_route_means(ns["dsts"], ns["inv"], ns["scores"])
+
+    def _publish_route_means(self, dsts: List[str], inv: np.ndarray,
+                             scores: np.ndarray) -> None:
+        """Per-route score means onto the board. ``dsts`` is the FULL
+        block's route list while ``inv`` may index only one tier's
+        subset of its rows — routes with no rows here are skipped, not
+        published as a spurious 0.0."""
+        m = len(dsts)
+        sums = np.bincount(inv, weights=scores, minlength=m)
+        counts = np.bincount(inv, minlength=m)
+        nz = counts > 0
+        if not nz.any():
+            return
+        if nz.all():
+            self.board.update_batch(dsts, sums / counts)
+        else:
+            self.board.update_batch(
+                [d for d, keep in zip(dsts, nz) if keep],
+                sums[nz] / counts[nz])
 
     # at most this many per-request scorer spans per drained batch: a
     # 1024-row batch must not turn into 1024 span records per 50ms
@@ -1290,7 +1580,12 @@ class JaxAnomalyTelemeter(Telemeter):
                     ("/model.json", model_json)]
         if self.control is not None:
             async def control_json(req: Request) -> Response:
-                return json_response(self.control.status())
+                st = self.control.status()
+                # the control loop actuates on scores; surface WHICH
+                # tier produced them (and which model version/CRC the
+                # engines are serving) next to the actuation state
+                st["native_tier"] = self.native_tier_state()
+                return json_response(st)
 
             handlers.append(("/control.json", control_json))
         return handlers
@@ -1309,6 +1604,9 @@ class JaxAnomalyTelemeter(Telemeter):
             "scored_total": self._scored.value,
             "scored_fraction": round(self._scored_fraction(), 6),
             "line_rate": bool(self.cfg.lineRate),
+            # in-data-plane tier: blob version/CRC, publish (swap)
+            # count, native-vs-JAX scored split
+            "native_tier": self.native_tier_state(),
         }
         breaker = getattr(self._scorer, "breaker", None)
         if breaker is not None:

@@ -278,13 +278,21 @@ class RingDispatcher:
 # -- native feature ring ------------------------------------------------------
 
 
-NATIVE_ROW_WIDTH = 6  # engine row: route_id, lat_ms, status, req_b, rsp_b, ts
+# engine row: route_id, lat_ms, status, req_b, rsp_b, ts, score, scored.
+# The last two are the in-data-plane scorer's output (native/scorer.h):
+# scored == 1.0 rows arrive pre-scored from the engine; 0.0 rows (no
+# weight blob published, route hash not pushed yet, nativeTier: off)
+# fall back to the JAX tier in the micro-batcher.
+NATIVE_ROW_WIDTH = 8
+NATIVE_COL_SCORE = 6
+NATIVE_COL_SCORED = 7
 
 
 class NativeFeatureRing:
     """Preallocated single-producer single-consumer ring of raw native
-    feature rows (float32 [capacity, 6], the engines' FeatureRow
-    layout). Both sides run on the event loop thread; views are valid
+    feature rows (float32 [capacity, NATIVE_ROW_WIDTH], the engines'
+    FeatureRow layout incl. the in-data-plane score/scored columns).
+    Both sides run on the event loop thread; views are valid
     until the holder's next await (no interleaved producer).
 
     The producer (FastPathController) drains engine rows straight into

@@ -45,6 +45,7 @@
 #include <unordered_set>
 #include <vector>
 
+#include "scorer.h"
 #include "tls_engine.h"
 
 namespace {
@@ -107,10 +108,17 @@ struct Route {
     std::vector<Endpoint> eps;
     uint32_t next = 0;
     RouteStats stats;
+    // in-data-plane scorer state: dst-path hash column (pushed from
+    // Python via fp_set_route_feature) + the robust latency-drift EWMA
+    l5dscore::RouteFeat feat;
 };
 
 struct FeatureRow {
     float route_id, latency_ms, status, req_bytes, rsp_bytes, ts_s;
+    // in-data-plane scoring result: `scored` 1.0 when the engine
+    // evaluated the native model for this row (score then holds the
+    // anomaly score); 0.0 rows fall back to the JAX tier in Python
+    float score, scored;
 };
 
 enum class BodyKind { NONE, LENGTH, CHUNKED, EOF_DELIM };
@@ -333,6 +341,10 @@ struct Engine {
     std::vector<FeatureRow> features;
     size_t features_cap = 65536;
     uint64_t features_dropped = 0;
+    // in-data-plane scorer: weight slab has its own (lock-free reader)
+    // sync; score_stats is guarded by mu like the feature buffer
+    l5dscore::Slab scorer_slab;
+    l5dscore::ScoreStats score_stats;
 
     // loop-thread-only state
     std::unordered_map<int, Conn*> conns;
@@ -442,8 +454,13 @@ void maybe_pause_producer(Engine* e, Conn* consumer) {
 }
 
 void push_feature(Engine* e, uint64_t route_id, uint64_t lat_us, int status,
-                  uint64_t req_b, uint64_t rsp_b) {
+                  uint64_t req_b, uint64_t rsp_b, float score, int scored,
+                  uint64_t score_ns) {
     std::lock_guard<std::mutex> g(e->mu);
+    if (scored)
+        e->score_stats.record(score_ns);
+    else
+        e->score_stats.unscored++;
     if (e->features.size() >= e->features_cap) {
         e->features_dropped++;
         return;
@@ -455,6 +472,8 @@ void push_feature(Engine* e, uint64_t route_id, uint64_t lat_us, int status,
     r.req_bytes = (float)req_b;
     r.rsp_bytes = (float)rsp_b;
     r.ts_s = (float)((double)(now_us() - e->t0_us) / 1e6);
+    r.score = score;
+    r.scored = scored ? 1.0f : 0.0f;
     e->features.push_back(r);
 }
 
@@ -903,17 +922,47 @@ void finish_exchange(Engine* e, Conn* up, bool upstream_reusable) {
         return;
     }
     uint64_t lat = now_us() - client->t_start_us;
+    // in-data-plane scoring: feature prep (hash col + drift EWMA)
+    // rides the SAME mu hold and route scan as the stats record; the
+    // dense forward runs OUTSIDE mu against the slab's own reader
+    // protocol, so a weight publish never contends with request work
+    float feats[l5dscore::FEATURE_DIM];
+    bool have_feats = false;
     {
         std::lock_guard<std::mutex> g(e->mu);
         for (auto& kv : e->routes) {
             if (kv.second.id == up->route_id) {
                 kv.second.stats.record(up->rsp_status, lat);
+                l5dscore::RouteFeat& rf = kv.second.feat;
+                const float lat_ms = (float)lat / 1000.0f;
+                const float drift =
+                    l5dscore::feat_drift_update(&rf, lat_ms);
+                if (rf.col >= 0 &&
+                    l5dscore::slab_has_weights(&e->scorer_slab)) {
+                    l5dscore::featurize(
+                        lat_ms, up->rsp_status,
+                        (float)client->req_bytes,
+                        (float)client->rsp_bytes, rf.col, rf.sign,
+                        drift, feats);
+                    have_feats = true;
+                }
                 break;
             }
         }
     }
+    float score = 0.0f;
+    int scored = 0;
+    uint64_t score_ns = 0;
+    if (have_feats) {
+        const uint64_t t0 = l5dscore::now_ns();
+        if (l5dscore::slab_score(&e->scorer_slab, feats, &score)) {
+            scored = 1;
+            score_ns = l5dscore::now_ns() - t0;
+        }
+    }
     push_feature(e, up->route_id, lat, up->rsp_status,
-                 client->req_bytes, client->rsp_bytes);
+                 client->req_bytes, client->rsp_bytes,
+                 score, scored, score_ns);
     client->peer = nullptr;
     up->peer = nullptr;
     release_upstream(e, up, upstream_reusable);
@@ -1487,7 +1536,7 @@ long fp_stats_json(void* ep, char* buf, size_t cap) {
              "\"resumed\":%llu,\"alpn_h2\":%llu,\"alpn_http1\":%llu,"
              "\"upstream_handshakes\":%llu,\"upstream_resumed\":%llu,"
              "\"upstream_failures\":%llu,\"enabled\":%s,"
-             "\"client_enabled\":%s}}",
+             "\"client_enabled\":%s},",
              (unsigned long long)e->accepted.load(
                  std::memory_order_relaxed),
              (unsigned long long)e->features_dropped,
@@ -1502,22 +1551,60 @@ long fp_stats_json(void* ep, char* buf, size_t cap) {
              e->tls_srv != nullptr ? "true" : "false",
              e->tls_cli != nullptr ? "true" : "false");
     s += tail;
+    l5dscore::stats_json(e->scorer_slab, e->score_stats, &s);
+    s += "}";
     if (s.size() + 1 > cap) return -2;
     memcpy(buf, s.data(), s.size());
     buf[s.size()] = 0;
     return (long)s.size();
 }
 
-// Each row: [route_id, latency_ms, status, req_bytes, rsp_bytes, ts_s]
+// Each row: [route_id, latency_ms, status, req_bytes, rsp_bytes, ts_s,
+// score, scored]
 long fp_drain_features(void* ep, float* buf, long cap_rows) {
     Engine* e = (Engine*)ep;
     std::lock_guard<std::mutex> g(e->mu);
     long n = (long)e->features.size();
     if (n > cap_rows) n = cap_rows;
     for (long i = 0; i < n; i++)
-        memcpy(buf + i * 6, &e->features[(size_t)i], sizeof(FeatureRow));
+        memcpy(buf + i * 8, &e->features[(size_t)i], sizeof(FeatureRow));
     e->features.erase(e->features.begin(), e->features.begin() + n);
     return n;
+}
+
+// Install the dst-path feature-hash column/sign for a route (the
+// Python controller computes path_hash_cols over the bound dst path —
+// the engine only knows the Host key). Scoring stays off until this
+// lands: the model was trained with the hash column set.
+int fp_set_route_feature(void* ep, const char* host, int col,
+                         float sign) {
+    Engine* e = (Engine*)ep;
+    std::string key(host);
+    lower(key);
+    std::lock_guard<std::mutex> g(e->mu);
+    auto it = e->routes.find(key);
+    if (it == e->routes.end()) return -1;
+    it->second.feat.col = col;
+    it->second.feat.sign = sign;
+    return 0;
+}
+
+// Publish a weight blob into the double-buffered slab (hot-swap; the
+// data plane never pauses). Rejects blobs whose in_dim disagrees with
+// the engine featurizer's FEATURE_DIM.
+int fp_publish_weights(void* ep, const uint8_t* blob, size_t len,
+                       char* err, size_t errcap) {
+    Engine* e = (Engine*)ep;
+    l5dscore::Model m;
+    if (!l5dscore::parse_blob(blob, len, &m, err, errcap)) return -1;
+    if (m.in_dim != l5dscore::FEATURE_DIM) {
+        l5dscore::fail(err, errcap,
+                       "weight blob in_dim does not match engine "
+                       "FEATURE_DIM");
+        return -1;
+    }
+    l5dscore::slab_install(&e->scorer_slab, std::move(m));
+    return 0;
 }
 
 void fp_shutdown(void* ep) {

@@ -49,6 +49,7 @@
 #include <vector>
 
 #include "h2_core.h"
+#include "scorer.h"
 #include "tls_engine.h"
 
 namespace {
@@ -121,10 +122,15 @@ struct Route {
     std::vector<Endpoint> eps;
     uint32_t next = 0;
     RouteStats stats;
+    // in-data-plane scorer state (see fastpath.cpp / scorer.h)
+    l5dscore::RouteFeat feat;
 };
 
 struct FeatureRow {
     float route_id, latency_ms, status, req_bytes, rsp_bytes, ts_s;
+    // in-data-plane scoring result (scored 1.0 = engine evaluated the
+    // native model; 0.0 rows fall back to the JAX tier in Python)
+    float score, scored;
 };
 
 struct PStream;
@@ -148,6 +154,10 @@ struct Engine {
     std::vector<FeatureRow> features;
     size_t features_cap = 65536;
     uint64_t features_dropped = 0;
+    // in-data-plane scorer: weight slab has its own (lock-free reader)
+    // sync; score_stats is guarded by mu like the feature buffer
+    l5dscore::Slab scorer_slab;
+    l5dscore::ScoreStats score_stats;
 
     // loop-thread-only
     std::unordered_map<int, H2Conn*> conns;
@@ -412,8 +422,13 @@ void drain_dirty(Engine* e) {
 }
 
 void push_feature(Engine* e, uint64_t route_id, uint64_t lat_us, int status,
-                  uint64_t req_b, uint64_t rsp_b) {
+                  uint64_t req_b, uint64_t rsp_b, float score, int scored,
+                  uint64_t score_ns) {
     std::lock_guard<std::mutex> g(e->mu);
+    if (scored)
+        e->score_stats.record(score_ns);
+    else
+        e->score_stats.unscored++;
     if (e->features.size() >= e->features_cap) {
         e->features_dropped++;
         return;
@@ -425,6 +440,8 @@ void push_feature(Engine* e, uint64_t route_id, uint64_t lat_us, int status,
     r.req_bytes = (float)req_b;
     r.rsp_bytes = (float)rsp_b;
     r.ts_s = (float)((double)(now_us() - e->t0_us) / 1e6);
+    r.score = score;
+    r.scored = scored ? 1.0f : 0.0f;
     e->features.push_back(r);
 }
 
@@ -507,11 +524,30 @@ void finish_stream(Engine* e, PStream* st, bool record) {
         }
     }
     uint64_t lat = now_us() - st->t_start_us;
+    // in-data-plane scoring: feature prep (hash col + drift EWMA) rides
+    // the same mu hold as the route stats; the dense forward runs
+    // OUTSIDE mu against the slab's own reader protocol
+    float feats[l5dscore::FEATURE_DIM];
+    bool have_feats = false;
     {
         std::lock_guard<std::mutex> g(e->mu);
         auto it = e->routes.find(st->route_key);
         if (it != e->routes.end() && it->second.id == st->route_id) {
             if (record) it->second.stats.record(st->status, lat);
+            if (record) {
+                l5dscore::RouteFeat& rf = it->second.feat;
+                const float lat_ms = (float)lat / 1000.0f;
+                const float drift =
+                    l5dscore::feat_drift_update(&rf, lat_ms);
+                if (rf.col >= 0 &&
+                    l5dscore::slab_has_weights(&e->scorer_slab)) {
+                    l5dscore::featurize(lat_ms, st->status,
+                                        (float)st->req_b,
+                                        (float)st->rsp_b, rf.col,
+                                        rf.sign, drift, feats);
+                    have_feats = true;
+                }
+            }
             if (st->ep_ip)
                 for (auto& ep : it->second.eps)
                     if (ep.ip_be == st->ep_ip && ep.port == st->ep_pt &&
@@ -521,9 +557,20 @@ void finish_stream(Engine* e, PStream* st, bool record) {
                     }
         }
     }
-    if (record)
+    if (record) {
+        float score = 0.0f;
+        int scored = 0;
+        uint64_t score_ns = 0;
+        if (have_feats) {
+            const uint64_t t0 = l5dscore::now_ns();
+            if (l5dscore::slab_score(&e->scorer_slab, feats, &score)) {
+                scored = 1;
+                score_ns = l5dscore::now_ns() - t0;
+            }
+        }
         push_feature(e, st->route_id, lat, st->status, st->req_b,
-                     st->rsp_b);
+                     st->rsp_b, score, scored, score_ns);
+    }
     if (uc != nullptr && !uc->dead) dispatch_from_queue(e, uc);
 }
 
@@ -2044,7 +2091,7 @@ long fph2_stats_json(void* ep, char* buf, size_t cap) {
              "\"resumed\":%llu,\"alpn_h2\":%llu,\"alpn_http1\":%llu,"
              "\"upstream_handshakes\":%llu,\"upstream_resumed\":%llu,"
              "\"upstream_failures\":%llu,\"enabled\":%s,"
-             "\"client_enabled\":%s}}",
+             "\"client_enabled\":%s},",
              (unsigned long long)e->accepted.load(
                  std::memory_order_relaxed),
              (unsigned long long)e->features_dropped,
@@ -2059,6 +2106,8 @@ long fph2_stats_json(void* ep, char* buf, size_t cap) {
              e->tls_srv != nullptr ? "true" : "false",
              e->tls_cli != nullptr ? "true" : "false");
     s += tail;
+    l5dscore::stats_json(e->scorer_slab, e->score_stats, &s);
+    s += "}";
     if (s.size() + 1 > cap) return -2;
     memcpy(buf, s.data(), s.size());
     buf[s.size()] = 0;
@@ -2071,9 +2120,39 @@ long fph2_drain_features(void* ep, float* buf, long cap_rows) {
     long n = (long)e->features.size();
     if (n > cap_rows) n = cap_rows;
     for (long i = 0; i < n; i++)
-        memcpy(buf + i * 6, &e->features[(size_t)i], sizeof(FeatureRow));
+        memcpy(buf + i * 8, &e->features[(size_t)i], sizeof(FeatureRow));
     e->features.erase(e->features.begin(), e->features.begin() + n);
     return n;
+}
+
+// See fp_set_route_feature / fp_publish_weights (fastpath.cpp) for the
+// contract; this is the h2 engine's identical control surface.
+int fph2_set_route_feature(void* ep, const char* host, int col,
+                           float sign) {
+    Engine* e = (Engine*)ep;
+    std::string key(host);
+    lower(key);
+    std::lock_guard<std::mutex> g(e->mu);
+    auto it = e->routes.find(key);
+    if (it == e->routes.end()) return -1;
+    it->second.feat.col = col;
+    it->second.feat.sign = sign;
+    return 0;
+}
+
+int fph2_publish_weights(void* ep, const uint8_t* blob, size_t len,
+                         char* err, size_t errcap) {
+    Engine* e = (Engine*)ep;
+    l5dscore::Model m;
+    if (!l5dscore::parse_blob(blob, len, &m, err, errcap)) return -1;
+    if (m.in_dim != l5dscore::FEATURE_DIM) {
+        l5dscore::fail(err, errcap,
+                       "weight blob in_dim does not match engine "
+                       "FEATURE_DIM");
+        return -1;
+    }
+    l5dscore::slab_install(&e->scorer_slab, std::move(m));
+    return 0;
 }
 
 void fph2_shutdown(void* ep) {

@@ -12,7 +12,10 @@
 #include <cstring>
 #include <cstddef>
 
+#include <vector>
+
 #include "huffman_table.h"  // generated from hpack.py: HUFF_CODES/HUFF_BITS
+#include "scorer.h"         // in-data-plane anomaly scorer (l5dscore::)
 
 namespace {
 
@@ -205,6 +208,138 @@ long l5d_parse_http1_head(const char* buf, size_t len,
         pos = (size_t)(line_end - buf) + 1;
     }
     return (long)n;
+}
+
+// ---- in-data-plane scorer: engine-independent eval + slab handles ----------
+// The engines embed their own slabs (fp_publish_weights /
+// fph2_publish_weights); these entry points exist for the parity tests,
+// the hot-swap concurrency tests, and the bench's standalone evaluator
+// measurements — same code paths (scorer.h), no engine required.
+
+// The C featurizer's feature width (must equal models.features.FEATURE_DIM;
+// pinned by tests/test_native_scorer.py).
+int l5d_score_feature_dim() { return l5dscore::FEATURE_DIM; }
+
+// Parse + validate a weight blob; writes a small JSON description.
+// Returns JSON length, or -1 invalid (err text in the buffer).
+long l5d_score_blob_info(const uint8_t* blob, size_t len, char* out,
+                         size_t cap) {
+    l5dscore::Model m;
+    char err[256];
+    if (!l5dscore::parse_blob(blob, len, &m, err, sizeof(err))) {
+        snprintf(out, cap, "%s", err);
+        return -1;
+    }
+    int n = snprintf(out, cap,
+                     "{\"version\":%u,\"crc\":%u,\"quant\":%u,"
+                     "\"in_dim\":%d,\"n_enc\":%d,\"n_dec\":%d,"
+                     "\"n_cls\":%d,\"recon_weight\":%.6f}",
+                     m.version, m.crc, m.quant, m.in_dim, m.n_enc,
+                     m.n_dec, m.n_cls, (double)m.recon_weight);
+    return (long)n;
+}
+
+// Score n already-featurized rows (x: [n, dim] f32, dim must equal the
+// blob's in_dim). Returns n, or -1 on a bad blob / dim mismatch.
+long l5d_score_eval(const uint8_t* blob, size_t len, const float* x,
+                    long n, long dim, float* out, char* err,
+                    size_t errcap) {
+    l5dscore::Model m;
+    if (!l5dscore::parse_blob(blob, len, &m, err, errcap)) return -1;
+    if (dim != m.in_dim) {
+        l5dscore::fail(err, errcap, "feature dim != blob in_dim");
+        return -1;
+    }
+    for (long i = 0; i < n; i++)
+        out[i] = l5dscore::eval_model(m, x + (size_t)i * m.in_dim);
+    return n;
+}
+
+// Score n RAW engine rows ([n, 8] f32 FeatureRow layout; only columns
+// 1..4 are read) through the in-engine featurizer: per-row dst-hash
+// (cols/signs) and pre-update drift come from the caller, so tests can
+// drive the exact per-route state the engines hold. feat_out (nullable,
+// [n, FEATURE_DIM]) receives the encoded features for parity checks.
+long l5d_score_eval_raw(const uint8_t* blob, size_t len,
+                        const float* rows, long n, const int32_t* cols,
+                        const float* signs, const float* drifts,
+                        float* scores_out, float* feat_out, char* err,
+                        size_t errcap) {
+    l5dscore::Model m;
+    if (!l5dscore::parse_blob(blob, len, &m, err, errcap)) return -1;
+    if (m.in_dim != l5dscore::FEATURE_DIM) {
+        l5dscore::fail(err, errcap, "blob in_dim != FEATURE_DIM");
+        return -1;
+    }
+    float feats[l5dscore::FEATURE_DIM];
+    for (long i = 0; i < n; i++) {
+        const float* r = rows + (size_t)i * 8;
+        l5dscore::featurize(r[1], (int)r[2], r[3], r[4], cols[i],
+                            signs[i], drifts[i], feats);
+        if (feat_out != nullptr)
+            memcpy(feat_out + (size_t)i * l5dscore::FEATURE_DIM, feats,
+                   sizeof(feats));
+        scores_out[i] = l5dscore::eval_model(m, feats);
+    }
+    return n;
+}
+
+// Standalone slab handle: the hot-swap machinery without an engine.
+void* l5d_slab_create() { return new l5dscore::Slab(); }
+
+int l5d_slab_publish(void* slab, const uint8_t* blob, size_t len,
+                     char* err, size_t errcap) {
+    l5dscore::Model m;
+    if (!l5dscore::parse_blob(blob, len, &m, err, errcap)) return -1;
+    // l5d_slab_score strides rows by FEATURE_DIM, so (like the
+    // engines' publish) a valid blob with any other in_dim must be
+    // rejected here — not read out of bounds at eval time
+    if (m.in_dim != l5dscore::FEATURE_DIM) {
+        l5dscore::fail(err, errcap,
+                       "weight blob in_dim does not match featurizer "
+                       "FEATURE_DIM");
+        return -1;
+    }
+    l5dscore::slab_install((l5dscore::Slab*)slab, std::move(m));
+    return 0;
+}
+
+// Score n featurized rows via the slab; -1 = no weights published.
+long l5d_slab_score(void* slab, const float* x, long n, float* out) {
+    l5dscore::Slab* s = (l5dscore::Slab*)slab;
+    for (long i = 0; i < n; i++) {
+        if (!l5dscore::slab_score(
+                s, x + (size_t)i * l5dscore::FEATURE_DIM, out + i))
+            return -1;
+    }
+    return n;
+}
+
+long l5d_slab_stats(void* slab, char* out, size_t cap) {
+    l5dscore::Slab* s = (l5dscore::Slab*)slab;
+    int n = snprintf(out, cap,
+                     "{\"version\":%u,\"crc\":%u,\"swaps\":%llu,"
+                     "\"retries\":%llu}",
+                     s->version.load(std::memory_order_relaxed),
+                     s->crc.load(std::memory_order_relaxed),
+                     (unsigned long long)s->swaps.load(
+                         std::memory_order_relaxed),
+                     (unsigned long long)s->retries.load(
+                         std::memory_order_relaxed));
+    return (long)n;
+}
+
+void l5d_slab_free(void* slab) { delete (l5dscore::Slab*)slab; }
+
+// Deterministic valid test blob (the stress drivers' generator, exposed
+// so tests can exercise publish/score without a JAX-side export).
+long l5d_score_test_blob(uint8_t* out, size_t cap, uint32_t version,
+                         int quant, uint32_t seed) {
+    std::vector<uint8_t> v;
+    l5dscore::build_test_blob(&v, version, quant, seed);
+    if (v.size() > cap) return -2;
+    memcpy(out, v.data(), v.size());
+    return (long)v.size();
 }
 
 }  // extern "C"

@@ -1,0 +1,530 @@
+// scorer.h — in-data-plane anomaly scoring for the native engines.
+//
+// A dependency-free evaluator for the distilled anomaly model (the
+// autoencoder + classifier dense stack of models/anomaly.py): a request
+// retired by an epoll engine is featurized AND scored without leaving
+// the engine thread — the Taurus/FENIX move of evaluating a small model
+// inside the forwarding element itself. Weights arrive from Python as a
+// versioned, CRC'd flat blob (lifecycle/export.py emits it on model
+// promote/hot-swap) into a double-buffered, seqlock-style slab: readers
+// never block on a publish, a publish never pauses the data plane, and
+// a reader that raced a buffer flip retries instead of evaluating torn
+// weights (slab_score's recheck; `retries` counts them).
+//
+// Layout contract (must mirror lifecycle/export.py exactly):
+//
+//   magic "L5DWTS01" | u32 version | u32 quant (0=f32, 1=int8)
+//   | u32 in_dim | u32 n_enc | u32 n_dec | u32 n_cls | f32 recon_weight
+//   | f32 mu[in_dim] | f32 var[in_dim]
+//   | per layer (enc..., dec..., cls...):
+//       u32 rows | u32 cols | f32 b[cols]
+//       | quant 0: f32 w[rows*cols]        (row-major, w[i][j] = in i -> out j)
+//       | quant 1: f32 scale[cols] | i8 w[rows*cols]
+//   | u32 crc32 (zlib polynomial, over everything before it)
+//
+// All fields little-endian. int8 weights dequantize per OUTPUT column
+// (w_f32 ≈ scale[j] * w_i8) and accumulate in f32 — the "int8 weights,
+// f32 accumulate" scheme, so quantization error stays a weight-rounding
+// effect and never compounds through the accumulation.
+
+#pragma once
+
+#include <math.h>
+#include <sched.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+#include <time.h>
+
+#include <atomic>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace l5dscore {
+
+// Feature schema (models/features.py FEATURE_DIM + column layout); the
+// featurizer below mirrors telemetry/linerate.NativeFeaturizer, which
+// is the one Python-side encoder for engine rows.
+constexpr int FEATURE_DIM = 36;
+constexpr int STATUS_ONEHOT_OFF = 1;
+constexpr int MAX_WIDTH = 1024;   // widest layer a blob may carry
+constexpr int MAX_LAYERS = 16;    // per group (enc/dec/cls)
+constexpr int SCORE_HIST_BUCKETS = 32;  // log2(ns) buckets
+
+// ---- crc32 (zlib polynomial; must match Python zlib.crc32) -----------------
+
+struct Crc32Table {
+    uint32_t t[256];
+    Crc32Table() {
+        for (uint32_t i = 0; i < 256; i++) {
+            uint32_t c = i;
+            for (int k = 0; k < 8; k++)
+                c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : (c >> 1);
+            t[i] = c;
+        }
+    }
+};
+
+inline uint32_t crc32_of(const uint8_t* p, size_t n) {
+    static Crc32Table tbl;  // C++11 magic static: thread-safe init
+    uint32_t c = 0xFFFFFFFFu;
+    for (size_t i = 0; i < n; i++)
+        c = tbl.t[(c ^ p[i]) & 0xFFu] ^ (c >> 8);
+    return c ^ 0xFFFFFFFFu;
+}
+
+// ---- model -----------------------------------------------------------------
+
+struct Layer {
+    int rows = 0, cols = 0;
+    std::vector<float> w;       // f32 weights (quant 0)
+    std::vector<int8_t> wq;     // int8 weights (quant 1)
+    std::vector<float> scale;   // per-output-column dequant (quant 1)
+    std::vector<float> b;
+};
+
+struct Model {
+    uint32_t version = 0;
+    uint32_t crc = 0;       // the blob's own trailing crc32
+    uint32_t quant = 0;     // 0 = f32, 1 = int8
+    int in_dim = 0;
+    int n_enc = 0, n_dec = 0, n_cls = 0;
+    float recon_weight = 0.5f;
+    std::vector<float> mu;
+    std::vector<float> inv_std;  // precomputed 1/sqrt(var + 1e-2)
+    std::vector<Layer> layers;   // enc..., dec..., cls...
+};
+
+// bounds-checked little-endian reader
+struct Cursor {
+    const uint8_t* p;
+    size_t len, off = 0;
+    bool ok = true;
+    Cursor(const uint8_t* d, size_t n) : p(d), len(n) {}
+    bool take(void* out, size_t n) {
+        if (!ok || off + n > len) { ok = false; return false; }
+        memcpy(out, p + off, n);
+        off += n;
+        return true;
+    }
+    uint32_t u32() { uint32_t v = 0; take(&v, 4); return v; }
+    float f32() { float v = 0; take(&v, 4); return v; }
+    bool floats(std::vector<float>* out, size_t n) {
+        if (!ok || off + n * 4 > len) { ok = false; return false; }
+        out->resize(n);
+        memcpy(out->data(), p + off, n * 4);
+        off += n * 4;
+        return true;
+    }
+    bool bytes(std::vector<int8_t>* out, size_t n) {
+        if (!ok || off + n > len) { ok = false; return false; }
+        out->resize(n);
+        memcpy(out->data(), p + off, n);
+        off += n;
+        return true;
+    }
+};
+
+inline bool fail(char* err, size_t errcap, const char* msg) {
+    if (err != nullptr && errcap > 0) {
+        strncpy(err, msg, errcap - 1);
+        err[errcap - 1] = 0;
+    }
+    return false;
+}
+
+// Parse + fully validate a weight blob. Geometry is checked end to end
+// (layer chain, bottleneck consistency, classifier output width 1) so a
+// published blob can never index out of bounds at eval time.
+inline bool parse_blob(const uint8_t* data, size_t len, Model* out,
+                       char* err, size_t errcap) {
+    if (len < 8 + 4 * 6 + 4 + 4)
+        return fail(err, errcap, "weight blob truncated");
+    if (memcmp(data, "L5DWTS01", 8) != 0)
+        return fail(err, errcap, "bad weight blob magic");
+    uint32_t crc_stored;
+    memcpy(&crc_stored, data + len - 4, 4);
+    if (crc32_of(data, len - 4) != crc_stored)
+        return fail(err, errcap, "weight blob crc mismatch");
+    Cursor c(data + 8, len - 8 - 4);
+    Model m;
+    m.crc = crc_stored;
+    m.version = c.u32();
+    m.quant = c.u32();
+    uint32_t in_dim = c.u32();
+    uint32_t n_enc = c.u32(), n_dec = c.u32(), n_cls = c.u32();
+    m.recon_weight = c.f32();
+    if (!c.ok) return fail(err, errcap, "weight blob header truncated");
+    if (m.quant > 1)
+        return fail(err, errcap, "unknown weight quantization");
+    if (in_dim < 1 || in_dim > MAX_WIDTH)
+        return fail(err, errcap, "weight blob in_dim out of range");
+    if (n_enc < 1 || n_dec < 1 || n_cls < 1 || n_enc > MAX_LAYERS ||
+        n_dec > MAX_LAYERS || n_cls > MAX_LAYERS)
+        return fail(err, errcap, "weight blob layer counts out of range");
+    if (!(m.recon_weight >= 0.0f && m.recon_weight <= 1.0f))
+        return fail(err, errcap, "recon_weight out of [0, 1]");
+    m.in_dim = (int)in_dim;
+    m.n_enc = (int)n_enc;
+    m.n_dec = (int)n_dec;
+    m.n_cls = (int)n_cls;
+    if (!c.floats(&m.mu, in_dim))
+        return fail(err, errcap, "weight blob mu truncated");
+    std::vector<float> var;
+    if (!c.floats(&var, in_dim))
+        return fail(err, errcap, "weight blob var truncated");
+    m.inv_std.resize(in_dim);
+    for (uint32_t i = 0; i < in_dim; i++) {
+        // soft variance floor, matching models.anomaly.normalize_features
+        m.inv_std[i] = 1.0f / sqrtf(var[i] + 1e-2f);
+        if (!(m.inv_std[i] == m.inv_std[i]))  // NaN guard
+            return fail(err, errcap, "weight blob var not finite");
+    }
+    int total = m.n_enc + m.n_dec + m.n_cls;
+    m.layers.resize(total);
+    for (int k = 0; k < total; k++) {
+        Layer& L = m.layers[k];
+        L.rows = (int)c.u32();
+        L.cols = (int)c.u32();
+        if (!c.ok || L.rows < 1 || L.cols < 1 || L.rows > MAX_WIDTH ||
+            L.cols > MAX_WIDTH)
+            return fail(err, errcap, "weight blob layer dims out of range");
+        if (!c.floats(&L.b, L.cols))
+            return fail(err, errcap, "weight blob bias truncated");
+        size_t n = (size_t)L.rows * L.cols;
+        if (m.quant == 0) {
+            if (!c.floats(&L.w, n))
+                return fail(err, errcap, "weight blob weights truncated");
+        } else {
+            if (!c.floats(&L.scale, L.cols))
+                return fail(err, errcap, "weight blob scales truncated");
+            if (!c.bytes(&L.wq, n))
+                return fail(err, errcap, "weight blob weights truncated");
+        }
+    }
+    if (c.off != c.len)
+        return fail(err, errcap, "weight blob has trailing bytes");
+    // geometry: enc chain from in_dim to the bottleneck, dec mirrors it
+    // back to in_dim, cls maps the bottleneck to one logit
+    int w = m.in_dim;
+    for (int k = 0; k < m.n_enc; k++) {
+        if (m.layers[k].rows != w)
+            return fail(err, errcap, "encoder layer chain mismatch");
+        w = m.layers[k].cols;
+    }
+    int bottleneck = w;
+    for (int k = 0; k < m.n_dec; k++) {
+        if (m.layers[m.n_enc + k].rows != w)
+            return fail(err, errcap, "decoder layer chain mismatch");
+        w = m.layers[m.n_enc + k].cols;
+    }
+    if (w != m.in_dim)
+        return fail(err, errcap, "decoder does not reconstruct in_dim");
+    w = bottleneck;
+    for (int k = 0; k < m.n_cls; k++) {
+        if (m.layers[m.n_enc + m.n_dec + k].rows != w)
+            return fail(err, errcap, "classifier layer chain mismatch");
+        w = m.layers[m.n_enc + m.n_dec + k].cols;
+    }
+    if (w != 1)
+        return fail(err, errcap, "classifier head must end at width 1");
+    *out = std::move(m);
+    return true;
+}
+
+// ---- forward pass ----------------------------------------------------------
+
+// out[j] = act(b[j] + sum_i in[i] * w[i][j]); f32 weights or int8 with
+// f32 accumulation. `in` and `out` must not alias.
+inline void dense(const Layer& L, const float* in, float* out, bool relu) {
+    for (int j = 0; j < L.cols; j++) out[j] = 0.0f;
+    if (!L.w.empty()) {
+        for (int i = 0; i < L.rows; i++) {
+            const float v = in[i];
+            const float* wr = &L.w[(size_t)i * L.cols];
+            for (int j = 0; j < L.cols; j++) out[j] += v * wr[j];
+        }
+        for (int j = 0; j < L.cols; j++) out[j] += L.b[j];
+    } else {
+        for (int i = 0; i < L.rows; i++) {
+            const float v = in[i];
+            const int8_t* wr = &L.wq[(size_t)i * L.cols];
+            for (int j = 0; j < L.cols; j++) out[j] += v * (float)wr[j];
+        }
+        for (int j = 0; j < L.cols; j++)
+            out[j] = out[j] * L.scale[j] + L.b[j];
+    }
+    if (relu)
+        for (int j = 0; j < L.cols; j++)
+            if (out[j] < 0.0f) out[j] = 0.0f;
+}
+
+// One row through normalize -> autoencoder -> classifier -> blended
+// score, mirroring ops/scoring._score_kernel (reconstruction error is
+// measured against the NORMALIZED input, which is what the jitted step
+// scores after folding normalize_features in).
+inline float eval_model(const Model& m, const float* x) {
+    float b0[MAX_WIDTH], b1[MAX_WIDTH], zb[MAX_WIDTH], xn[MAX_WIDTH];
+    for (int i = 0; i < m.in_dim; i++)
+        xn[i] = (x[i] - m.mu[i]) * m.inv_std[i];
+    // encoder: relu on every layer (final_act=true in _mlp)
+    const float* cur = xn;
+    float* dst = b0;
+    for (int k = 0; k < m.n_enc; k++) {
+        dense(m.layers[k], cur, dst, true);
+        cur = dst;
+        dst = (dst == b0) ? b1 : b0;
+    }
+    const int zw = m.layers[m.n_enc - 1].cols;
+    memcpy(zb, cur, (size_t)zw * sizeof(float));
+    // decoder: relu except the last layer
+    cur = zb;
+    dst = b0;
+    for (int k = 0; k < m.n_dec; k++) {
+        dense(m.layers[m.n_enc + k], cur, dst, k < m.n_dec - 1);
+        cur = dst;
+        dst = (dst == b0) ? b1 : b0;
+    }
+    float err = 0.0f;
+    for (int i = 0; i < m.in_dim; i++) {
+        const float d = cur[i] - xn[i];
+        err += d * d;
+    }
+    err /= (float)m.in_dim;
+    // classifier head from the bottleneck: relu except the last layer
+    cur = zb;
+    dst = b0;
+    for (int k = 0; k < m.n_cls; k++) {
+        dense(m.layers[m.n_enc + m.n_dec + k], cur, dst, k < m.n_cls - 1);
+        cur = dst;
+        dst = (dst == b0) ? b1 : b0;
+    }
+    const float logit = cur[0];
+    const float recon_score = tanhf(err);
+    const float cls_score = 1.0f / (1.0f + expf(-logit));
+    return m.recon_weight * recon_score
+        + (1.0f - m.recon_weight) * cls_score;
+}
+
+// ---- double-buffered weight slab -------------------------------------------
+
+// Publishes go to the inactive buffer; the flip is one release-store of
+// `active`. Readers take a per-buffer refcount and RE-CHECK `active`
+// before touching weights — a reader that raced a flip backs off and
+// retries (counted in `retries`), so it can never evaluate a buffer a
+// concurrent publish is rewriting. The publisher in turn drains the
+// target buffer's refcount before writing, so it never rewrites under
+// a reader that already passed its recheck. No reader ever blocks on a
+// lock; the (rare) publisher spin is bounded by one in-flight eval.
+struct Slab {
+    std::mutex write_mu;  // serializes publishers only
+    Model bufs[2];
+    std::atomic<int> active{-1};  // -1 = nothing published yet
+    std::atomic<uint32_t> readers[2] = {{0}, {0}};
+    std::atomic<uint64_t> swaps{0};
+    std::atomic<uint64_t> retries{0};
+    std::atomic<uint32_t> version{0};
+    std::atomic<uint32_t> crc{0};
+};
+
+inline bool slab_has_weights(const Slab* s) {
+    return s->active.load(std::memory_order_acquire) >= 0;
+}
+
+inline bool slab_score(Slab* s, const float* x, float* out) {
+    for (;;) {
+        const int idx = s->active.load(std::memory_order_acquire);
+        if (idx < 0) return false;
+        s->readers[idx].fetch_add(1, std::memory_order_acq_rel);
+        if (s->active.load(std::memory_order_acquire) != idx) {
+            // a publish flipped (or is flipping) this buffer under us:
+            // back off WITHOUT reading any weight bytes and retry
+            s->readers[idx].fetch_sub(1, std::memory_order_acq_rel);
+            s->retries.fetch_add(1, std::memory_order_relaxed);
+            continue;
+        }
+        const float score = eval_model(s->bufs[idx], x);
+        s->readers[idx].fetch_sub(1, std::memory_order_release);
+        *out = score;
+        return true;
+    }
+}
+
+inline void slab_install(Slab* s, Model&& m) {
+    std::lock_guard<std::mutex> g(s->write_mu);
+    const int cur = s->active.load(std::memory_order_acquire);
+    const int target = cur < 0 ? 0 : 1 - cur;
+    // drain stragglers still evaluating the target buffer (bounded:
+    // one row eval is microseconds)
+    while (s->readers[target].load(std::memory_order_acquire) != 0)
+        sched_yield();
+    s->bufs[target] = std::move(m);
+    s->version.store(s->bufs[target].version, std::memory_order_relaxed);
+    s->crc.store(s->bufs[target].crc, std::memory_order_relaxed);
+    s->active.store(target, std::memory_order_release);
+    s->swaps.fetch_add(1, std::memory_order_relaxed);
+}
+
+// ---- featurizer ------------------------------------------------------------
+
+// Per-route featurizer state. The dst-path hash column/sign is pushed
+// from Python (fp_set_route_feature: the controller knows the dst path,
+// the engine does not); the latency EWMA is the robust drift baseline
+// of models.features.DstTemporal, updated per retired request. Guarded
+// by the engine's `mu` like the rest of the Route.
+struct RouteFeat {
+    int col = -1;        // dst-path hash column (-1: not pushed yet)
+    float sign = 0.0f;
+    bool ewma_init = false;
+    float ewma = 0.0f;
+    float dev = 0.25f;
+};
+
+// Returns the drift (lat - EWMA before update) and applies the robust
+// update: increments winsorized at 3 deviation-scales so anomalies
+// barely drag the baseline toward themselves (DstTemporal's lat_alpha
+// 0.05 / dev_clip 3.0 / dev_alpha 0.05).
+inline float feat_drift_update(RouteFeat* rf, float lat_ms) {
+    if (!rf->ewma_init) {
+        rf->ewma_init = true;
+        rf->ewma = lat_ms;
+        rf->dev = fmaxf(fabsf(lat_ms) * 0.1f, 0.25f);
+        return 0.0f;
+    }
+    const float drift = lat_ms - rf->ewma;
+    const float dev = rf->dev;
+    const float lim = 3.0f * fmaxf(dev, 0.25f);
+    float inc = drift;
+    if (inc > lim) inc = lim;
+    if (inc < -lim) inc = -lim;
+    rf->ewma += 0.05f * inc;
+    const float ad = fminf(fabsf(drift), lim);
+    rf->dev = dev + 0.05f * (ad - dev);
+    return drift;
+}
+
+// One engine row -> FEATURE_DIM model features; must stay bit-for-bit
+// in step with telemetry/linerate.NativeFeaturizer.encode_block (the
+// Python encoder for the same raw rows — pinned by the parity test).
+inline void featurize(float lat_ms, int status, float req_b, float rsp_b,
+                      int col, float sign, float drift, float* x) {
+    memset(x, 0, FEATURE_DIM * sizeof(float));
+    x[0] = log1pf(fmaxf(lat_ms, 0.0f));
+    const int sc = status / 100;
+    if (sc >= 1 && sc <= 5) x[STATUS_ONEHOT_OFF + sc - 1] = 1.0f;
+    x[8] = log1pf(fmaxf(req_b, 0.0f));
+    x[9] = log1pf(fmaxf(rsp_b, 0.0f));
+    x[10] = log1pf(1.0f);  // engine rows carry no concurrency
+    if (col >= 0 && col < FEATURE_DIM) x[col] += sign;
+    x[31] = 1.0f;
+    const float ad = fabsf(drift);
+    const float s = drift > 0.0f ? 1.0f : (drift < 0.0f ? -1.0f : 0.0f);
+    x[32] = s * log1pf(ad);
+}
+
+// ---- per-engine accounting -------------------------------------------------
+
+struct ScoreStats {  // guarded by the engine's mu
+    uint64_t scored = 0;    // rows scored in-engine
+    uint64_t unscored = 0;  // rows passed through (no weights / no feat)
+    uint64_t ns_hist[SCORE_HIST_BUCKETS] = {0};
+    void record(uint64_t ns) {
+        int b = 0;
+        uint64_t v = ns;
+        while (v > 1 && b < SCORE_HIST_BUCKETS - 1) { v >>= 1; b++; }
+        ns_hist[b]++;
+        scored++;
+    }
+};
+
+inline uint64_t now_ns() {
+    timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (uint64_t)ts.tv_sec * 1'000'000'000ull + (uint64_t)ts.tv_nsec;
+}
+
+// Append the engine's "native_scorer" stats block (caller holds the
+// engine mu for the ScoreStats half; slab fields are atomics).
+inline void stats_json(const Slab& slab, const ScoreStats& st,
+                       std::string* s) {
+    char tmp[256];
+    snprintf(tmp, sizeof(tmp),
+             "\"native_scorer\":{\"weights\":%s,\"version\":%u,"
+             "\"crc\":%u,\"swaps\":%llu,\"retries\":%llu,"
+             "\"scored\":%llu,\"unscored\":%llu,\"score_ns_hist\":[",
+             slab.active.load(std::memory_order_acquire) >= 0
+                 ? "true" : "false",
+             slab.version.load(std::memory_order_relaxed),
+             slab.crc.load(std::memory_order_relaxed),
+             (unsigned long long)slab.swaps.load(std::memory_order_relaxed),
+             (unsigned long long)slab.retries.load(
+                 std::memory_order_relaxed),
+             (unsigned long long)st.scored,
+             (unsigned long long)st.unscored);
+    *s += tmp;
+    for (int i = 0; i < SCORE_HIST_BUCKETS; i++) {
+        if (i) *s += ",";
+        snprintf(tmp, sizeof(tmp), "%llu",
+                 (unsigned long long)st.ns_hist[i]);
+        *s += tmp;
+    }
+    *s += "]}";
+}
+
+// ---- deterministic test blob (stress drivers + C-level tests) --------------
+
+inline void put_u32(std::vector<uint8_t>* v, uint32_t x) {
+    const uint8_t* p = (const uint8_t*)&x;
+    v->insert(v->end(), p, p + 4);
+}
+
+inline void put_f32(std::vector<uint8_t>* v, float f) {
+    const uint8_t* p = (const uint8_t*)&f;
+    v->insert(v->end(), p, p + 4);
+}
+
+// A small, valid blob with seeded pseudo-random weights; the stress
+// drivers publish alternating seeds while traffic scores concurrently.
+inline void build_test_blob(std::vector<uint8_t>* out, uint32_t version,
+                            int quant, uint32_t seed) {
+    out->clear();
+    const char magic[8] = {'L', '5', 'D', 'W', 'T', 'S', '0', '1'};
+    out->insert(out->end(), magic, magic + 8);
+    const int in_dim = FEATURE_DIM;
+    const int dims_enc[] = {in_dim, 32, 8};    // two enc layers
+    const int dims_dec[] = {8, 32, in_dim};    // mirrored back
+    const int dims_cls[] = {8, 16, 1};
+    put_u32(out, version);
+    put_u32(out, (uint32_t)quant);
+    put_u32(out, (uint32_t)in_dim);
+    put_u32(out, 2);
+    put_u32(out, 2);
+    put_u32(out, 2);
+    put_f32(out, 0.5f);
+    uint32_t st = seed * 2654435761u + 1u;
+    auto rnd = [&st]() {
+        st = st * 1664525u + 1013904223u;
+        return ((float)(st >> 8) / (float)(1u << 24) - 0.5f) * 0.2f;
+    };
+    for (int i = 0; i < in_dim; i++) put_f32(out, rnd());        // mu
+    for (int i = 0; i < in_dim; i++) put_f32(out, 1.0f);         // var
+    auto layer = [&](int rows, int cols) {
+        put_u32(out, (uint32_t)rows);
+        put_u32(out, (uint32_t)cols);
+        for (int j = 0; j < cols; j++) put_f32(out, rnd());      // bias
+        if (quant == 0) {
+            for (int i = 0; i < rows * cols; i++) put_f32(out, rnd());
+        } else {
+            for (int j = 0; j < cols; j++) put_f32(out, 0.01f);  // scale
+            for (int i = 0; i < rows * cols; i++)
+                out->push_back((uint8_t)(int8_t)(int)(rnd() * 600.0f));
+        }
+    };
+    for (int k = 0; k < 2; k++) layer(dims_enc[k], dims_enc[k + 1]);
+    for (int k = 0; k < 2; k++) layer(dims_dec[k], dims_dec[k + 1]);
+    for (int k = 0; k < 2; k++) layer(dims_cls[k], dims_cls[k + 1]);
+    put_u32(out, crc32_of(out->data(), out->size()));
+}
+
+}  // namespace l5dscore

@@ -19,6 +19,8 @@
 #include <atomic>
 #include <pthread.h>
 
+#include "scorer.h"  // build_test_blob: the scoring leg's weight source
+
 extern "C" {
 void* fph2_create();
 int fph2_start(void* e);
@@ -35,6 +37,9 @@ int fph2_set_tls(void* e, const char* cert, const char* key,
 int fph2_listen_tls(void* e, const char* ip, int port);
 int fph2_set_client_tls(void* e, const char* alpn, int verify,
                         const char* ca_path, char* err, size_t errcap);
+int fph2_publish_weights(void* e, const unsigned char* blob, size_t len,
+                         char* err, size_t errcap);
+int fph2_set_route_feature(void* e, const char* host, int col, float sign);
 }
 
 namespace {
@@ -65,6 +70,8 @@ struct ChurnArgs {
     void* engine = nullptr;
     int serve_port = 0;
     std::atomic<int> stop{0};
+    std::atomic<long> scored{0};    // drained rows the engine pre-scored
+    std::atomic<long> swaps{0};     // weight publishes that landed
 };
 
 void* churn_main(void* arg) {
@@ -73,18 +80,34 @@ void* churn_main(void* arg) {
     snprintf(ep, sizeof(ep), "127.0.0.1:%d ", a->serve_port);
     char* stats = new char[1 << 20];
     char* misses = new char[64 * 1024];
-    float* feats = new float[4096 * 6];
+    float* feats = new float[4096 * 8];  // FeatureRow is 8 floats wide
+    std::vector<uint8_t> blob;
+    char err[256];
     int i = 0;
     while (!a->stop.load(std::memory_order_relaxed)) {
         // the whole Python-facing control surface, hammered
         fph2_set_route(a->engine, "echoext", ep);
+        // scoring leg: the route-feature push rides every re-install
+        // (the Python controller's _push does the same), and weight
+        // blobs hot-swap mid-traffic — concurrent score + swap + drain
+        // is exactly the slab's seqlock contract under test
+        fph2_set_route_feature(a->engine, "echoext", 14, 1.0f);
+        if (i % 4 == 0) {
+            l5dscore::build_test_blob(&blob, (uint32_t)i, i % 2,
+                                      (uint32_t)i);
+            if (fph2_publish_weights(a->engine, blob.data(), blob.size(),
+                                     err, sizeof(err)) == 0)
+                a->swaps.fetch_add(1);
+        }
         if (i % 7 == 0) {
             fph2_set_route(a->engine, "ghost", "127.0.0.1:1 ");
             fph2_remove_route(a->engine, "ghost");
         }
         fph2_stats_json(a->engine, stats, 1 << 20);
         fph2_drain_misses(a->engine, misses, 64 * 1024);
-        fph2_drain_features(a->engine, feats, 4096);
+        long n = fph2_drain_features(a->engine, feats, 4096);
+        for (long r = 0; r < n; r++)
+            if (feats[r * 8 + 7] > 0.5f) a->scored.fetch_add(1);
         usleep(500);
         i++;
     }
@@ -188,8 +211,11 @@ int main() {
     h2bench::g_stop.store(1);
     pthread_join(serve_t, nullptr);
 
-    fprintf(stderr, "h2 stress: %llu requests proxied (%llu via TLS)\n",
-            (unsigned long long)total, (unsigned long long)tls_total);
+    fprintf(stderr,
+            "h2 stress: %llu requests proxied (%llu via TLS), "
+            "%ld rows scored in-engine across %ld weight swaps\n",
+            (unsigned long long)total, (unsigned long long)tls_total,
+            ca.scored.load(), ca.swaps.load());
     if (total < 500) {
         fprintf(stderr, "too little traffic flowed (%llu)\n",
                 (unsigned long long)total);
@@ -198,6 +224,11 @@ int main() {
     if (tls_leg && tls_total < 100) {
         fprintf(stderr, "too little TLS traffic flowed (%llu)\n",
                 (unsigned long long)tls_total);
+        return 3;
+    }
+    if (ca.scored.load() < 50 || ca.swaps.load() < 10) {
+        fprintf(stderr, "scoring leg starved (scored=%ld swaps=%ld)\n",
+                ca.scored.load(), ca.swaps.load());
         return 3;
     }
     return 0;

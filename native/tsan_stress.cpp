@@ -21,6 +21,8 @@
 #include <thread>
 #include <vector>
 
+#include "scorer.h"  // build_test_blob: the scoring leg's weight source
+
 extern "C" {
 void* fp_create();
 int fp_start(void* ep);
@@ -37,6 +39,9 @@ int fp_set_tls(void* ep, const char* cert, const char* key,
 int fp_listen_tls(void* ep, const char* ip, int port);
 int fp_set_client_tls(void* ep, const char* alpn, int verify,
                       const char* ca_path, char* err, size_t errcap);
+int fp_publish_weights(void* ep, const unsigned char* blob, size_t len,
+                       char* err, size_t errcap);
+int fp_set_route_feature(void* ep, const char* host, int col, float sign);
 }
 
 namespace {
@@ -45,6 +50,8 @@ std::atomic<bool> stop{false};
 std::atomic<long> responses{0};
 std::atomic<long> tls_responses{0};  // via the front-engine TLS chain
 std::atomic<long> errors{0};
+std::atomic<long> scored_rows{0};    // drained rows the engine pre-scored
+std::atomic<long> weight_swaps{0};   // weight publishes that landed
 
 // Minimal blocking HTTP/1.1 backend: fixed 200 response per request.
 void backend_loop(int lfd) {
@@ -172,6 +179,9 @@ int main() {
         char host[32];
         snprintf(host, sizeof(host), "svc-%d", i);
         fp_set_route(ep, host, endpoints);
+        // scoring leg: push each route's dst-hash feature column so
+        // the in-engine scorer featurizes its rows
+        fp_set_route_feature(ep, host, 14 + i, i % 2 ? -1.0f : 1.0f);
     }
     if (front != nullptr) {
         if (fp_start(front) != 0) {
@@ -187,17 +197,37 @@ int main() {
         }
     }
 
-    // control-plane churn thread: install/remove routes while traffic runs
+    // control-plane churn thread: install/remove ONE route while
+    // traffic runs (svc-0..2 stay stable so their rows keep scoring
+    // in-engine; svc-3 exercises the remove/re-add + feature-re-push
+    // path the Python controller's _push performs on every update)
     std::thread churn([&] {
         int gen = 0;
         while (!stop.load()) {
-            char host[32];
-            snprintf(host, sizeof(host), "svc-%d", gen % 4);
-            fp_remove_route(ep, host);
+            fp_remove_route(ep, "svc-3");
             usleep(500);
-            fp_set_route(ep, host, endpoints);
+            fp_set_route(ep, "svc-3", endpoints);
+            fp_set_route_feature(ep, "svc-3", 17,
+                                 gen % 2 ? -1.0f : 1.0f);
             gen++;
             usleep(1500);
+        }
+    });
+
+    // weight-swap thread: alternating f32/int8 blobs hot-swap into
+    // the slab while the epoll thread scores concurrently — the
+    // double-buffer + reader-recheck protocol under sanitizer fire
+    std::thread swapper([&] {
+        std::vector<uint8_t> blob;
+        char err[256];
+        uint32_t gen = 0;
+        while (!stop.load()) {
+            l5dscore::build_test_blob(&blob, gen, (int)(gen % 2), gen);
+            if (fp_publish_weights(ep, blob.data(), blob.size(), err,
+                                   sizeof(err)) == 0)
+                weight_swaps.fetch_add(1);
+            gen++;
+            usleep(1000);
         }
     });
 
@@ -208,7 +238,9 @@ int main() {
         while (!stop.load()) {
             fp_drain_misses(ep, buf.data(), buf.size());
             fp_stats_json(ep, buf.data(), buf.size());
-            fp_drain_features(ep, feats.data(), 1024);
+            long n = fp_drain_features(ep, feats.data(), 1024);
+            for (long r = 0; r < n; r++)
+                if (feats[r * 8 + 7] > 0.5f) scored_rows.fetch_add(1);
             if (front != nullptr) {
                 fp_drain_misses(front, buf.data(), buf.size());
                 fp_stats_json(front, buf.data(), buf.size());
@@ -230,6 +262,7 @@ int main() {
     stop.store(true);
     for (auto& t : clients) t.join();
     churn.join();
+    swapper.join();
     drain.join();
     if (front != nullptr) fp_shutdown(front);
     fp_shutdown(ep);
@@ -238,14 +271,21 @@ int main() {
     backend.detach();
 
     fprintf(stderr, "tsan_stress: %ld responses (%ld via TLS), "
-            "%ld errors\n", responses.load(), tls_responses.load(),
-            errors.load());
+            "%ld errors, %ld rows scored in-engine across %ld weight "
+            "swaps\n", responses.load(), tls_responses.load(),
+            errors.load(), scored_rows.load(), weight_swaps.load());
     if (responses.load() < 100) {
         fprintf(stderr, "tsan_stress: too little traffic flowed\n");
         return 1;
     }
     if (tls_leg && tls_responses.load() < 50) {
         fprintf(stderr, "tsan_stress: too little TLS traffic flowed\n");
+        return 1;
+    }
+    if (scored_rows.load() < 50 || weight_swaps.load() < 100) {
+        fprintf(stderr, "tsan_stress: scoring leg starved "
+                "(scored=%ld swaps=%ld)\n", scored_rows.load(),
+                weight_swaps.load());
         return 1;
     }
     return 0;

@@ -20,7 +20,8 @@ from linkerd_tpu.telemetry.anomaly import (
     InProcessScorer, JaxAnomalyConfig, JaxAnomalyTelemeter,
 )
 from linkerd_tpu.telemetry.linerate import (
-    NativeFeatureRing, NativeFeaturizer, RingDispatcher, TieredScorer,
+    NATIVE_ROW_WIDTH, NativeFeatureRing, NativeFeaturizer, RingDispatcher,
+    TieredScorer,
 )
 from linkerd_tpu.telemetry.metrics import MetricsTree
 
@@ -256,11 +257,12 @@ class TestNativeFeatureRing:
         views = ring.produce_views(3)
         assert sum(len(v) for v in views) == 3
         views[0][:] = np.arange(
-            3 * 6, dtype=np.float32).reshape(3, 6)
+            3 * NATIVE_ROW_WIDTH, dtype=np.float32).reshape(
+                3, NATIVE_ROW_WIDTH)
         ring.commit(3)
         got = ring.consume(8)
-        assert got.shape == (3, 6)
-        assert (got.ravel() == np.arange(18)).all()
+        assert got.shape == (3, NATIVE_ROW_WIDTH)
+        assert (got.ravel() == np.arange(3 * NATIVE_ROW_WIDTH)).all()
         assert len(ring) == 0
 
     def test_wraparound_preserves_row_integrity(self):
@@ -415,10 +417,10 @@ class TestLineRateBatcher:
             tele.set_native_route_resolver(lambda rid: f"/fp/route-{rid}")
             views = tele.native_ring.produce_views(4)
             views[0][:] = np.array([
-                [1, 50.0, 200, 0, 0, 1.0],
-                [1, 60.0, 200, 0, 0, 1.1],
-                [2, 900.0, 500, 0, 0, 1.2],
-                [2, 950.0, 500, 0, 0, 1.3],
+                [1, 50.0, 200, 0, 0, 1.0, 0, 0],
+                [1, 60.0, 200, 0, 0, 1.1, 0, 0],
+                [2, 900.0, 500, 0, 0, 1.2, 0, 0],
+                [2, 950.0, 500, 0, 0, 1.3, 0, 0],
             ], np.float32)
             tele.native_ring.commit(4)
             tele.native_committed(4)
@@ -452,8 +454,9 @@ class TestLineRateBatcher:
             tele.ring.append((FeatureVector(dst_path="/svc/py"), None))
             tele.set_native_route_resolver(lambda rid: "/fp/nat")
             v = tele.native_ring.produce_views(2)
-            v[0][:] = np.array([[9, 1.0, 200, 0, 0, 1.0],
-                                [9, 2.0, 200, 0, 0, 1.1]], np.float32)
+            v[0][:] = np.array(
+                [[9, 1.0, 200, 0, 0, 1.0, 0, 0],
+                 [9, 2.0, 200, 0, 0, 1.1, 0, 0]], np.float32)
             tele.native_ring.commit(2)
             n = await tele.drain_once()
             assert n == 3
@@ -603,7 +606,7 @@ class TestFastpathNativeFeed:
             return n
 
         def drain_features(self):
-            return np.zeros((0, 6), np.float32)
+            return np.zeros((0, NATIVE_ROW_WIDTH), np.float32)
 
     class _StubScorer:
         async def score(self, x):
@@ -630,8 +633,8 @@ class TestFastpathNativeFeed:
                 JaxAnomalyConfig(trainEveryBatches=0), mt,
                 scorer=self._StubScorer())
             eng = self._StubEngine(
-                [[5, 12.0, 200, 10, 20, 1.0],
-                 [5, 14.0, 500, 10, 20, 1.1]])
+                [[5, 12.0, 200, 10, 20, 1.0, 0.0, 0.0],
+                 [5, 14.0, 500, 10, 20, 1.1, 0.0, 0.0]])
             ctl = self._mk_controller(eng, tele)
             ctl._id_to_host[5] = "web"
             ctl._forward_features()
@@ -652,7 +655,8 @@ class TestFastpathNativeFeed:
             tele = JaxAnomalyTelemeter(
                 JaxAnomalyConfig(trainEveryBatches=0, ringCapacity=4),
                 mt, scorer=self._StubScorer())
-            rows = [[1, float(i), 200, 0, 0, 1.0] for i in range(10)]
+            rows = [[1, float(i), 200, 0, 0, 1.0, 0.0, 0.0]
+                    for i in range(10)]
             ctl = self._mk_controller(self._StubEngine(rows), tele)
             ctl._forward_features()
             assert len(tele.native_ring) == 4  # capacity
@@ -679,7 +683,8 @@ class TestFastpathNativeFeed:
             teles = [JaxAnomalyTelemeter(
                 JaxAnomalyConfig(trainEveryBatches=0), m,
                 scorer=self._StubScorer()) for m in mts]
-            rows = [[3, float(i), 200, 0, 0, 1.0] for i in range(6)]
+            rows = [[3, float(i), 200, 0, 0, 1.0, 0.0, 0.0]
+                    for i in range(6)]
             eng = self._StubEngine(rows)
             from linkerd_tpu.core import Dtab, Path
             from linkerd_tpu.router.fastpath import FastPathController
@@ -712,10 +717,10 @@ class TestFastpathNativeFeed:
             assert eng.drain_features_into(views[0]) == 0
             with pytest.raises(ValueError):
                 eng.drain_features_into(
-                    np.zeros((4, 6), np.float64))
+                    np.zeros((4, NATIVE_ROW_WIDTH), np.float64))
             with pytest.raises(ValueError):
                 eng.drain_features_into(
-                    np.zeros((4, 12), np.float32)[:, ::2])
+                    np.zeros((4, 2 * NATIVE_ROW_WIDTH), np.float32)[:, ::2])
         finally:
             eng.close()
 

@@ -583,12 +583,41 @@ class TestJaxHotpath:
 
     def test_out_of_scope_package_is_ignored(self, tmp_path):
         got = findings_of(tmp_path, {
-            "linkerd_tpu/lifecycle/x.py": """
+            "linkerd_tpu/router/x.py": """
                 import jax
                 async def score(x):
                     return jax.device_put(x, None)
             """}, "jax-hotpath")
         assert got == []
+
+    def test_weight_export_root_fires_in_lifecycle(self, tmp_path):
+        # the native weight export must stay host-side numpy on an
+        # already-gathered snapshot: a readback inside it (or a helper
+        # it calls) fires
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/lifecycle/x.py": """
+                import numpy as np
+
+                def export_weight_blob(snap, version):
+                    return _pack(snap.params)
+
+                def _pack(params):
+                    return np.asarray(params["w"]).tobytes()
+            """}, "jax-hotpath")
+        assert len(got) == 1 and "asarray" in got[0].message
+
+    def test_native_publish_root_fires(self, tmp_path):
+        # the in-data-plane tier's per-batch board publish is a root: a
+        # device barrier there would put the old per-batch latency back
+        got = findings_of(tmp_path, {
+            "linkerd_tpu/telemetry/x.py": """
+                import jax
+
+                class Tele:
+                    def _publish_native_batch(self, ns):
+                        jax.block_until_ready(ns["scores"])
+            """}, "jax-hotpath")
+        assert len(got) == 1 and "block_until_ready" in got[0].message
 
     def test_justified_suppression_suppresses(self, tmp_path):
         got = findings_of(tmp_path, {

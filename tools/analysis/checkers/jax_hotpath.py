@@ -36,8 +36,14 @@ from tools.analysis.core import (
 )
 
 # dispatch-path entry points: a function with one of these names (or a
-# name starting with "dispatch") anchors reachability
-ROOT_NAMES = {"score", "drain_once", "_score_and_publish"}
+# name starting with "dispatch") anchors reachability.
+# _publish_native_batch is the in-data-plane tier's board publish (runs
+# per drained batch — a device seam there would put the per-batch
+# latency right back on the native path); export_weight_blob is the
+# promote-time weight export, which must stay host-side numpy on an
+# already-gathered snapshot (it runs next to the serving loop).
+ROOT_NAMES = {"score", "drain_once", "_score_and_publish",
+              "_publish_native_batch", "export_weight_blob"}
 
 FLAGGED_CALLS = {
     "jax.device_put": "per-call device_put on the score dispatch path; "
@@ -93,7 +99,7 @@ class JaxHotpathChecker(Checker):
     description = ("per-call device_put / to_thread / host asarray "
                    "readback reachable from the score dispatch path")
     scope = ("linkerd_tpu/telemetry", "linkerd_tpu/parallel",
-             "linkerd_tpu/ops")
+             "linkerd_tpu/ops", "linkerd_tpu/lifecycle")
 
     def check(self, src: SourceFile, project: Project) -> Iterator[Finding]:
         funcs = [(fn, cls) for fn, cls in walk_functions(src.tree)

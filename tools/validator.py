@@ -77,6 +77,16 @@ C++ epoll loop owns the bytes) and that every TLS'd request was still
 scored (scored fraction 1.0):
 
     python tools/validator.py tls
+
+And the native-score validation: boot the REAL linkerd binary with a
+``fastPath: true`` router and the jaxAnomaly telemeter's in-data-plane
+tier (``nativeTier: primary``, the default), drive paced traffic, and
+assert from live metrics that the NATIVE tier — not the JAX fallback —
+scored 100% of the measured window (the ``rt/*/fastpath/scorer/*``
+counters only exist when the C++ epoll loop evaluated the model), with
+the client-observed added p99 reported alongside:
+
+    python tools/validator.py native-score
 """
 
 from __future__ import annotations
@@ -113,6 +123,7 @@ PORTS = {
     "control": {"linkerd": 30140, "admin": 30990, "namerd": 30180,
                 "a": 30801, "b": 30802},
     "tls":    {"linkerd": 31140, "admin": 31990, "a": 31801},
+    "native-score": {"linkerd": 32140, "admin": 32990, "a": 32801},
 }
 
 IFACE_YAML = {
@@ -903,6 +914,187 @@ admin:
         d_a.close()
 
 
+async def validate_native_score() -> None:
+    """Boot the REAL linkerd binary with a fastPath router and the
+    in-data-plane scoring tier (``nativeTier: primary``), drive paced
+    traffic, and assert from the LIVE metrics tree that the NATIVE tier
+    — not the JAX fallback — scored 100% of the measured window:
+
+    - ``rt/*/fastpath/scorer/scored`` (incremented only by the C++
+      epoll loop's per-request eval) grew by exactly the measured
+      request count, with zero ``unscored`` growth — the engine, not a
+      silent Python fallback, evaluated the model;
+    - ``anomaly/native_scored_total`` grew in lockstep with
+      ``anomaly/scored_total`` — every published score came from the
+      engine, the JAX tier only trained;
+    - the weight-slab gauges report a published blob (version + CRC
+      matching /model.json's native_tier block).
+
+    The client-observed added p99 (proxy vs direct) rides the report.
+    Prints one ``NATIVE-SCORE {json}`` line."""
+    from linkerd_tpu import native
+    if not native.ensure_built():
+        raise AssertionError(
+            "native toolchain unavailable — the native-score validation "
+            "proves the C++ engine scored in-data-plane, so a missing "
+            "toolchain is a failure here, not a skip")
+
+    ports = PORTS["native-score"]
+    work = tempfile.mkdtemp(prefix="l5d-validate-nscore-")
+    disco = os.path.join(work, "disco")
+    os.makedirs(disco)
+    d_a = await downstream("A", ports["a"])
+    with open(os.path.join(disco, "web"), "w") as f:
+        f.write(f"127.0.0.1 {ports['a']}\n")
+
+    linkerd_yaml = os.path.join(work, "linkerd.yaml")
+    with open(linkerd_yaml, "w") as f:
+        f.write(f"""
+routers:
+- protocol: http
+  label: native
+  fastPath: true
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers:
+  - port: {ports['linkerd']}
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+telemetry:
+- kind: io.l5d.jaxAnomaly
+  maxBatch: 256
+  trainEveryBatches: 0
+admin:
+  port: {ports['admin']}
+""")
+
+    def metrics(q: str) -> dict:
+        _, _, body = http(
+            "GET", f"http://127.0.0.1:{ports['admin']}"
+                   f"/admin/metrics.json?q={q}")
+        return json.loads(body)
+
+    def scorer_metrics() -> dict:
+        m = metrics("rt/native/fastpath/scorer")
+        m.update(metrics("anomaly"))
+        return m
+
+    def route_ok() -> bool:
+        st, _, body = http(
+            "GET", f"http://127.0.0.1:{ports['linkerd']}/",
+            headers={"Host": "web"})
+        return st == 200 and body == b"A"
+
+    def one_timed() -> float:
+        t0 = time.perf_counter()
+        st, _, _ = http(
+            "GET", f"http://127.0.0.1:{ports['linkerd']}/",
+            headers={"Host": "web"})
+        assert st == 200
+        return (time.perf_counter() - t0) * 1e3
+
+    def direct_timed() -> float:
+        t0 = time.perf_counter()
+        st, _, _ = http("GET", f"http://127.0.0.1:{ports['a']}/",
+                        headers={"Host": "web"})
+        assert st == 200
+        return (time.perf_counter() - t0) * 1e3
+
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    linkerd = None
+    try:
+        linkerd = subprocess.Popen(
+            [sys.executable, "-m", "linkerd_tpu", linkerd_yaml],
+            env=env, cwd=work)
+        await wait_for(route_ok, 30, "native-score route up")
+        # warm until the weight blob has landed in the engine slab AND
+        # rows started scoring in-engine (the startup export, the route
+        # resolution, and the feature-hash push all have to complete;
+        # warmup rows before that fall back to JAX by design)
+        for _ in range(20):
+            await asyncio.to_thread(one_timed)
+        await wait_for(
+            lambda: (lambda m: m.get(
+                "rt/native/fastpath/scorer/weights", 0) == 1
+                and m.get("rt/native/fastpath/scorer/scored", 0) > 0)(
+                    scorer_metrics()),
+            30, "weight blob published + first in-engine score")
+
+        # settle the warmup, then snapshot — the measured window's
+        # deltas are the proof (warmup rows that raced the publish fell
+        # back to JAX legitimately and must not pollute the fraction)
+        await asyncio.sleep(1.0)
+        m0 = scorer_metrics()
+
+        n = 300
+        pace_s = 0.002  # ~500 rps paced
+        lats, direct = [], []
+        for i in range(n):
+            lats.append(await asyncio.to_thread(one_timed))
+            if i % 3 == 0:
+                direct.append(await asyncio.to_thread(direct_timed))
+            await asyncio.sleep(pace_s)
+        lats.sort()
+        direct.sort()
+        p99 = lats[int(0.99 * (len(lats) - 1))]
+        added_p99 = p99 - direct[len(direct) // 2]
+
+        def d(m, key):
+            return m.get(key, 0) - m0.get(key, 0)
+
+        def settled() -> bool:
+            m = scorer_metrics()
+            return (d(m, "rt/native/fastpath/scorer/scored") >= n
+                    and d(m, "anomaly/scored_total") >= n
+                    and d(m, "anomaly/scored_total")
+                    == d(m, "anomaly/requests_total"))
+        await wait_for(settled, 20, "measured window drained + scored")
+
+        m1 = scorer_metrics()
+        eng_scored = d(m1, "rt/native/fastpath/scorer/scored")
+        eng_unscored = d(m1, "rt/native/fastpath/scorer/unscored")
+        nat = d(m1, "anomaly/native_scored_total")
+        tot = d(m1, "anomaly/scored_total")
+        assert eng_unscored == 0, \
+            f"{eng_unscored} rows fell back to the JAX tier mid-window"
+        assert eng_scored >= n, \
+            f"engine scored {eng_scored} < {n} measured requests"
+        frac = nat / tot if tot else 0.0
+        assert frac == 1.0, \
+            f"native tier scored fraction {frac} (native {nat}/{tot})"
+        # the serving blob is versioned + CRC'd end to end: the engine
+        # gauges agree with what /model.json says was exported
+        _, _, body = http("GET", f"http://127.0.0.1:{ports['admin']}"
+                                 f"/model.json")
+        tier = json.loads(body)["native_tier"]
+        assert tier["mode"] == "primary" and tier["blob"], tier
+        assert m1.get("rt/native/fastpath/scorer/version") \
+            == tier["blob"]["version"], (m1, tier)
+        assert added_p99 < 50.0, \
+            f"added p99 {added_p99:.1f}ms with in-engine scoring"
+        print("NATIVE-SCORE " + json.dumps({
+            "requests": n,
+            "engine_scored": eng_scored,
+            "engine_unscored": eng_unscored,
+            "native_scored_fraction": frac,
+            "blob_version": tier["blob"]["version"],
+            "blob_crc": tier["blob"]["crc"],
+            "proxy_p50_ms": round(lats[len(lats) // 2], 3),
+            "proxy_p99_ms": round(p99, 3),
+            "added_p99_ms": round(added_p99, 3),
+            "paced_rps": round(1.0 / pace_s, 1),
+        }))
+    finally:
+        if linkerd is not None:
+            linkerd.send_signal(signal.SIGTERM)
+            try:
+                linkerd.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                linkerd.kill()
+        d_a.close()
+
+
 async def validate_trace() -> None:
     """Boot the REAL linkerd binary as a two-router chain with a zipkin
     exporter, drive one traced request, assert the exported spans form
@@ -1148,6 +1340,10 @@ async def main() -> int:
     if args and args[0] == "tls":
         await validate_tls()
         print("VALIDATOR PASS (tls)")
+        return 0
+    if args and args[0] == "native-score":
+        await validate_native_score()
+        print("VALIDATOR PASS (native-score)")
         return 0
     protocols = args or ["mesh", "thrift", "http"]
     for protocol in protocols:
