@@ -414,6 +414,143 @@ def native_score_bench() -> dict:
     return asyncio.run(asyncio.wait_for(drive(), 240))
 
 
+def tenant_isolation_bench() -> dict:
+    """Tenant isolation on the REAL h1 engine, device-free: a paced
+    two-tenant run (one attacker retry-storming at its floor quota, one
+    paced victim) plus a TLS connection-churn leg.
+
+    - ``victim_p99_ms_under_attack``: the victim tenant's p99 while the
+      attacker floods and is shed in the data plane;
+    - ``attacker_shed_fraction``: shed/(ok+shed+errors) for the
+      attacker under its floor quota;
+    - ``churn_conn_s``: short-lived TLS connections per second through
+      the accept leg (the session-resumption cache under churn);
+      falls back to cleartext churn when no TLS runtime/cert.
+    """
+    import asyncio
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    from linkerd_tpu import native
+    from linkerd_tpu.router.tenancy import tenant_hash
+    from linkerd_tpu.testing.faults import (
+        PacedTenantClient, TenantRetryStorm,
+    )
+
+    if not native.available():
+        return {"error": "native lib unavailable"}
+
+    async def drive(cert: str, key: str) -> dict:
+        async def handle(r, w):
+            try:
+                while True:
+                    await r.readuntil(b"\r\n\r\n")
+                    w.write(b"HTTP/1.1 200 OK\r\n"
+                            b"Content-Length: 2\r\n\r\nok")
+                    await w.drain()
+            except Exception:  # noqa: BLE001 — client went away
+                pass
+
+        srv = await asyncio.start_server(handle, "127.0.0.1", 0)
+        bport = srv.sockets[0].getsockname()[1]
+        eng = native.FastPathEngine()
+        eng.set_tenant("header", "l5d-tenant")
+        tls_ok = bool(cert) and eng.tls_runtime_available()
+        if tls_ok:
+            eng.set_tls(cert, key)
+        port = eng.listen("127.0.0.1", 0)
+        tls_port = eng.listen_tls("127.0.0.1", 0) if tls_ok else 0
+        eng.start()
+        eng.set_route("svc", [("127.0.0.1", bport)])
+        out: dict = {}
+        try:
+            # -- two-tenant leg: attacker at its floor quota
+            eng.set_tenant_quota(tenant_hash("attacker"), 1)
+            storm = TenantRetryStorm(port, "svc", "attacker",
+                                     concurrency=8,
+                                     retry_delay_s=0.002).start()
+            vic = PacedTenantClient(port, "svc", "victim",
+                                    rate_per_s=200)
+            await vic.run(400)
+            await storm.stop()
+            out["victim_p99_ms_under_attack"] = round(vic.p99_ms(), 3)
+            out["victim_success_rate"] = round(vic.success_rate, 4)
+            out["attacker_shed_fraction"] = round(
+                storm.shed_fraction, 4)
+            out["attacker_total"] = storm.total
+
+            # -- churn leg: short-lived (TLS) conns at rate. Sync
+            # sockets in worker threads, each reusing its last session
+            # so the churn drives the PR 9 resumption path, not just
+            # full handshakes.
+            churn_port = tls_port if tls_ok else port
+            import socket
+            import ssl
+
+            stop_at = time.monotonic() + 2.0
+
+            def churn_sync() -> int:
+                opened = 0
+                sctx = None
+                if tls_ok:
+                    sctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+                    sctx.check_hostname = False
+                    sctx.verify_mode = ssl.CERT_NONE
+                sess = None
+                while time.monotonic() < stop_at:
+                    try:
+                        raw = socket.create_connection(
+                            ("127.0.0.1", churn_port), timeout=5)
+                        if sctx is not None:
+                            s = sctx.wrap_socket(raw, session=sess)
+                            # one tiny read gives TLS1.3 tickets time
+                            # to land so the next conn can resume
+                            s.settimeout(0.005)
+                            try:
+                                s.recv(1)
+                            except (TimeoutError, OSError):
+                                pass
+                            sess = s.session
+                            s.close()
+                        else:
+                            raw.close()
+                        opened += 1
+                    except OSError:
+                        pass
+                return opened
+
+            t0 = time.monotonic()
+            counts = await asyncio.gather(
+                *[asyncio.to_thread(churn_sync) for _ in range(16)])
+            took = time.monotonic() - t0
+            out["churn_conn_s"] = round(sum(counts) / max(took, 1e-6), 1)
+            out["churn_tls"] = tls_ok
+            if tls_ok:
+                tls = eng.stats().get("tls", {})
+                out["churn_resumed"] = int(tls.get("resumed", 0))
+                out["churn_handshakes"] = int(tls.get("handshakes", 0))
+        finally:
+            eng.close()
+            srv.close()
+            await srv.wait_closed()
+        return out
+
+    with tempfile.TemporaryDirectory(prefix="l5d-tenant-bench-") as td:
+        cert = os.path.join(td, "cert.pem")
+        key = os.path.join(td, "key.pem")
+        try:
+            subprocess.run(
+                ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+                 "-keyout", key, "-out", cert, "-days", "2", "-nodes",
+                 "-subj", "/CN=localhost"],
+                check=True, capture_output=True, timeout=60)
+        except (OSError, subprocess.SubprocessError):
+            cert = key = ""
+        return asyncio.run(asyncio.wait_for(drive(cert, key), 240))
+
+
 def proxy_bench() -> dict:
     """Config 1 through the fastpath engine, as subprocesses."""
     import subprocess
@@ -1037,6 +1174,17 @@ def main() -> None:
     def ph_control() -> None:
         detail["control_loop"] = control_loop_bench()
 
+    def ph_tenant_isolation() -> None:
+        ti = tenant_isolation_bench()
+        # headline rows at the top level (the acceptance bar reads
+        # them); the full run stays under detail.tenant_isolation
+        detail["victim_p99_ms_under_attack"] = ti.get(
+            "victim_p99_ms_under_attack")
+        detail["attacker_shed_fraction"] = ti.get(
+            "attacker_shed_fraction")
+        detail["churn_conn_s"] = ti.get("churn_conn_s")
+        detail["tenant_isolation"] = ti
+
     def ph_native_score() -> None:
         ns = native_score_bench()
         # headline rows at the top level (the acceptance bar reads
@@ -1056,6 +1204,7 @@ def main() -> None:
         # rc:124 mid-scorer must not lose the TLS claim.
         ("static_analysis", ph_static),
         ("race_analysis", ph_race),
+        ("tenant_isolation", ph_tenant_isolation),
         ("native_score", ph_native_score),
         ("proxy", ph_proxy),
         ("grpc", ph_grpc),

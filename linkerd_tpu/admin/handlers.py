@@ -123,6 +123,44 @@ def mk_model_handler(linker: "Linker"):
     return handler
 
 
+def mk_tenants_handler(linker: "Linker"):
+    """``/tenants.json`` — per-router tenant-isolation state: each
+    tenant's aggregates and anomaly level (TenantBoard), the quota
+    governor's verdicts (sick set, transitions, hysteresis snapshot),
+    and — for fastPath routers — the native engine's own per-tenant
+    stats and connection-guard counters, read live so the admin view
+    and ``rt/*/fastpath/tenant/*`` can be cross-checked."""
+
+    async def handler(req: Request) -> Response:
+        out = {}
+        views = {label: (board, adm)
+                 for label, board, adm in linker.tenant_views}
+        for r in linker.routers:
+            view = views.get(r.label)
+            if view is None:
+                continue
+            board, adm = view
+            entry: dict = {
+                "tenants": board.snapshot(),
+                "evicted": board.evicted,
+            }
+            if adm is not None:
+                quotas = adm.status()
+                quotas.pop("tenants", None)  # already above
+                entry["quotas"] = quotas
+            ctl = getattr(r, "controller", None)
+            if ctl is not None:
+                snap = ctl.engine.stats()
+                entry["engine"] = {
+                    "tenants": snap.get("tenants"),
+                    "guard": snap.get("guard"),
+                }
+            out[r.label] = entry
+        return json_response(out)
+
+    return handler
+
+
 def mk_config_check_handler(linker: "Linker"):
     """``/config-check.json`` — l5dcheck semantic verification of the
     live linker's parsed config (the same rules as ``python -m
@@ -325,6 +363,7 @@ def linkerd_admin_handlers(linker: "Linker") -> List[Tuple[str, Any]]:
         ("/bound-names.json", mk_bound_names_handler(linker)),
         ("/anomaly.json", mk_anomaly_handler(linker)),
         ("/model.json", mk_model_handler(linker)),
+        ("/tenants.json", mk_tenants_handler(linker)),
         ("/config-check.json", mk_config_check_handler(linker)),
         ("/identifier.json", mk_identifier_handler(linker)),
         ("/logging.json", logging_handler),

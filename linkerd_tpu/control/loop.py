@@ -140,6 +140,7 @@ class ControlLoop:
                     "the mesh reactor is DISABLED until a store client "
                     "is injected (set_store_client)")
         self._balancers: list = []
+        self._tenant_admissions: list = []
 
     def _mk_reactor(self, client) -> None:
         from linkerd_tpu.control.reactor import MeshReactor
@@ -174,6 +175,13 @@ class ControlLoop:
         if self.admission is not None:
             self.admission.register(admission_filter)
 
+    def register_tenant_admission(self, tenant_admission) -> None:
+        """Adopt a router's TenantAdmission: its per-tenant quota
+        governor rides this loop's tick (it also steps
+        opportunistically on its own — registration here just gives it
+        a steady cadence)."""
+        self._tenant_admissions.append(tenant_admission)
+
     def register_balancer(self, bal) -> None:
         """Track a ScoreWeightedBalancer for /control.json weights."""
         self._balancers.append(bal)
@@ -205,6 +213,8 @@ class ControlLoop:
             log.info("control loop warmed up; actuators live")
         if self.admission is not None:
             self.admission.step()
+        for ta in self._tenant_admissions:
+            ta.step()
         if self.reactor is not None:
             await self.reactor.step()
 
@@ -230,6 +240,9 @@ class ControlLoop:
             out["endpoint_weights"] = weights
         if self.admission is not None:
             out["admission"] = self.admission.status()
+        if self._tenant_admissions:
+            out["tenants"] = [ta.status() for ta in
+                              self._tenant_admissions]
         if self.reactor is not None:
             out["reactor"] = self.reactor.status()
         return out

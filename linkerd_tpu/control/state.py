@@ -87,6 +87,17 @@ class HysteresisGovernor:
         ks = self._keys.get(key)
         return ks.state if ks is not None else HEALTHY
 
+    def forget(self, key: str) -> None:
+        """Drop a key's state entirely (it re-enters HEALTHY with a
+        fresh streak if observed again). Callers with unbounded key
+        spaces — per-tenant governors under hostile tenant-id churn —
+        MUST forget keys their own bounded stores evicted, or the
+        governor grows without bound."""
+        self._keys.pop(key, None)
+
+    def keys(self):
+        return list(self._keys)
+
     def snapshot(self) -> Dict[str, dict]:
         """{key: {state, level, streak, transitions}} for /control.json."""
         return {

@@ -196,6 +196,71 @@ class AdmissionControlSpec:
 
 
 @dataclass
+class TenantsSpec:
+    """Per-tenant score-driven quotas (the ``tenants:`` router block;
+    requires a ``tenantIdentifier``): each tenant's anomaly level
+    (error EWMA, in-plane score EWMA, traffic dominance) feeds a
+    flap-proof HysteresisGovernor; a SICK tenant's quota shrinks to
+    ``floor`` × the router's concurrency (Python path) / ``floor`` ×
+    ``engineBase`` (pushed into the native engines), and clears on
+    recovery — every other tenant's budget is untouched."""
+
+    floor: float = 0.1
+    enterThreshold: float = 0.7
+    exitThreshold: float = 0.3
+    quorum: int = 3
+    cooldownS: float = 2.0
+    maxTenants: int = 1024
+    engineBase: int = 64
+
+    def validate(self, where: str) -> None:
+        if not 0.0 < self.floor <= 1.0:
+            raise ConfigError(f"{where}.floor must be in (0, 1]")
+        if not 0.0 < self.exitThreshold < self.enterThreshold <= 1.0:
+            raise ConfigError(
+                f"{where}: thresholds must satisfy 0 < exitThreshold "
+                f"< enterThreshold <= 1")
+        if self.quorum < 1:
+            raise ConfigError(f"{where}.quorum must be >= 1")
+        if self.cooldownS < 0:
+            raise ConfigError(f"{where}.cooldownS must be >= 0")
+        if self.maxTenants < 1:
+            raise ConfigError(f"{where}.maxTenants must be >= 1")
+        if self.engineBase < 1:
+            raise ConfigError(f"{where}.engineBase must be >= 1")
+
+
+@dataclass
+class ConnectionGuardSpec:
+    """Native connection-plane defenses (fastPath routers only): the
+    slowloris header/body budgets, per-source accept throttle, TLS
+    handshake-churn backpressure, and (h2) control-frame flood caps.
+    0 disables an individual defense."""
+
+    headerBudgetMs: int = 10_000
+    bodyStallMs: int = 30_000
+    acceptBurst: int = 0
+    acceptWindowMs: int = 1000
+    maxHandshakesInflight: int = 0
+    # h2 only
+    maxStreamsPerConnection: int = 512
+    rstBurst: int = 200
+    pingBurst: int = 256
+    settingsBurst: int = 64
+    floodWindowMs: int = 1000
+
+    def validate(self, where: str) -> None:
+        for name in ("headerBudgetMs", "bodyStallMs", "acceptBurst",
+                     "maxHandshakesInflight", "maxStreamsPerConnection",
+                     "rstBurst", "pingBurst", "settingsBurst"):
+            if getattr(self, name) < 0:
+                raise ConfigError(f"{where}.{name} must be >= 0")
+        if self.acceptWindowMs < 1 or self.floodWindowMs < 1:
+            raise ConfigError(
+                f"{where}: window sizes must be >= 1 ms")
+
+
+@dataclass
 class SvcSpec:
     """Per-logical-name policy (ref: SvcConfig.scala — totalTimeout,
     retries, classification)."""
@@ -264,6 +329,18 @@ class RouterSpec:
     # Python remains the control plane (naming, route install,
     # stats/feature drain). Requires a built native lib.
     fastPath: bool = False
+    # http + h2: tenant identity extraction (header / pathSegment /
+    # sni; router/tenancy.py, mirrored in C by both engines) — stamps
+    # ctx["tenant"]/["tenant_hash"] and feeds per-tenant accounting
+    tenantIdentifier: Optional[Dict[str, Any]] = None
+    # http + h2: per-tenant score-driven quotas on top of admission
+    # control (Python path) / in-engine quota maps (fastPath); needs a
+    # tenantIdentifier to key by
+    tenants: Optional[TenantsSpec] = None
+    # fastPath only: native connection-plane defenses (slowloris
+    # budgets, accept throttle, handshake-churn backpressure, h2
+    # flood caps)
+    connectionGuard: Optional[ConnectionGuardSpec] = None
 
 
 @dataclass
@@ -441,6 +518,9 @@ class Linker:
         self._logger_filters: List[Any] = []
         # concatenated trustCerts bundles for native client TLS contexts
         self._trust_bundles: List[str] = []
+        # per-router tenant state for /tenants.json:
+        # [(label, TenantBoard, Optional[TenantAdmission])]
+        self.tenant_views: List[Tuple[str, Any, Any]] = []
         try:
             self._build()
         except BaseException:
@@ -843,6 +923,11 @@ class Linker:
             raise ConfigError(
                 f"{label}: admissionControl is only supported on "
                 f"http/h2 routers")
+        if rspec.tenantIdentifier is not None or rspec.tenants is not None \
+                or rspec.connectionGuard is not None:
+            raise ConfigError(
+                f"{label}: tenantIdentifier/tenants/connectionGuard are "
+                f"only supported on http/h2 routers")
 
         base_dtab = Dtab.read(rspec.dtab) if rspec.dtab else Dtab.empty()
         prefix = Path.read(rspec.dstPrefix)
@@ -1001,6 +1086,11 @@ class Linker:
             raise ConfigError(
                 f"{label}: admissionControl is only supported on "
                 f"http/h2 routers")
+        if rspec.tenantIdentifier is not None or rspec.tenants is not None \
+                or rspec.connectionGuard is not None:
+            raise ConfigError(
+                f"{label}: tenantIdentifier/tenants/connectionGuard are "
+                f"only supported on http/h2 routers")
         if rspec.thriftProtocol not in ("binary", "compact"):
             raise ConfigError(
                 f"{label}.thriftProtocol must be binary or compact, "
@@ -1200,17 +1290,94 @@ class Linker:
                     f"supported with fastPath: true (the native engine "
                     f"proxies bodies byte-for-byte)")
 
+    def _mk_tenant_identifier(self, rspec: RouterSpec, label: str):
+        """Parse + validate the ``tenantIdentifier`` block into a
+        TenantIdentifierSpec (None when absent)."""
+        raw = rspec.tenantIdentifier
+        if raw is None:
+            return None
+        from linkerd_tpu.router.tenancy import TenantIdentifierSpec
+        spec = instantiate_as(TenantIdentifierSpec, raw,
+                              f"{label}.tenantIdentifier")
+        try:
+            spec.validate(f"{label}.tenantIdentifier")
+        except ValueError as e:
+            raise ConfigError(str(e)) from None
+        return spec
+
+    def _mk_tenant_isolation(self, rspec: RouterSpec, label: str,
+                             tid_spec) -> Tuple[Any, Any]:
+        """Build the router's TenantBoard (+ TenantAdmission when a
+        ``tenants:`` quota block is configured) and register both for
+        /tenants.json. Returns (board, admission_or_None)."""
+        from linkerd_tpu.router.tenancy import TenantBoard
+        ts = rspec.tenants
+        board = TenantBoard(
+            max_tenants=ts.maxTenants if ts is not None else 1024)
+        admission = None
+        if ts is not None and tid_spec is not None:
+            ts.validate(f"{label}.tenants")
+            from linkerd_tpu.control.admission import TenantAdmission
+            from linkerd_tpu.control.state import HysteresisGovernor
+            admission = TenantAdmission(
+                board,
+                governor=HysteresisGovernor(
+                    enter=ts.enterThreshold, exit=ts.exitThreshold,
+                    quorum=ts.quorum, dwell_s=ts.cooldownS),
+                floor=ts.floor, engine_base=ts.engineBase,
+                metrics_node=self.metrics.scope(
+                    "rt", label, "server", "tenants"))
+            ctl = self._anomaly_control()
+            if ctl is not None:
+                ctl.register_tenant_admission(admission)
+        if tid_spec is not None:
+            self.tenant_views.append((label, board, admission))
+        return board, admission
+
     def _edge_resilience_filters(self, rspec: RouterSpec,
                                  label: str) -> List[Any]:
         """Server-edge resilience (http + h2): deadline decode/expired
-        shed + admission control. Both raise, so they sit INSIDE the
-        protocol's error responder (appended AFTER it in server_filters)
-        where DeadlineExceeded maps to 504/DEADLINE_EXCEEDED and
-        OverloadShed to 503-retryable/REFUSED_STREAM. Single instances,
-        shared across the router's servers — the concurrency bound is a
-        router property."""
+        shed + tenant tagging + admission control. The raisers sit
+        INSIDE the protocol's error responder (appended AFTER it in
+        server_filters) where DeadlineExceeded maps to
+        504/DEADLINE_EXCEEDED and OverloadShed to
+        503-retryable/REFUSED_STREAM. Single instances, shared across
+        the router's servers — the concurrency bound is a router
+        property. TenantTagFilter runs BEFORE admission so per-tenant
+        sub-limits see ``ctx["tenant_hash"]``."""
+        if rspec.connectionGuard is not None:
+            raise ConfigError(
+                f"{label}: connectionGuard requires fastPath: true "
+                f"(the defenses live in the native engines)")
         filters: List[Any] = [ServerDeadlineFilter(
             self.metrics.scope("rt", label, "server", "deadline"))]
+        tid_spec = self._mk_tenant_identifier(rspec, label)
+        tenant_admission = None
+        if tid_spec is None and rspec.tenants is not None:
+            # l5dcheck warns on this too: quotas without an identity
+            # axis are inert, which an operator should notice — but a
+            # mis-keyed block must not take the whole linker down
+            log.warning(
+                "%s: tenants: quotas configured without a "
+                "tenantIdentifier — per-tenant isolation is DISABLED "
+                "until one is added", label)
+        if tid_spec is not None:
+            from linkerd_tpu.router.tenancy import TenantTagFilter
+            board, tenant_admission = self._mk_tenant_isolation(
+                rspec, label, tid_spec)
+            if rspec.tenants is not None \
+                    and rspec.admissionControl is None:
+                log.warning(
+                    "%s: tenants: quotas on the Python data plane "
+                    "enforce through admissionControl — without one, "
+                    "tenant levels are tracked but nothing sheds",
+                    label)
+            # the tag filter drives the quota governor opportunistically
+            # (interval-gated) so isolation works without a control loop
+            filters.append(TenantTagFilter(
+                tid_spec, board,
+                stepper=(tenant_admission.maybe_step
+                         if tenant_admission is not None else None)))
         ac = rspec.admissionControl
         if ac is not None:
             try:
@@ -1226,6 +1393,8 @@ class Linker:
             ctl = self._anomaly_control()
             if ctl is not None:
                 ctl.register_admission(admission)
+            if tenant_admission is not None:
+                tenant_admission.register(admission)
             filters.append(admission)
         return filters
 
@@ -1367,11 +1536,59 @@ class Linker:
                     verify=not client_tls.disableValidation, ca_path=ca)
             except OSError as e:
                 raise ConfigError(f"{label}.client.tls: {e}") from None
+        # tenant identity + isolation: extraction mirrored in C (the
+        # engine stamps tenant hashes into stats + feature rows and
+        # enforces pushed quotas in the data plane), guard knobs for
+        # the native connection-plane defenses
+        tid_spec = self._mk_tenant_identifier(rspec, label)
+        if tid_spec is not None:
+            if tid_spec.kind == "sni" and not tls_servers:
+                raise ConfigError(
+                    f"{label}.tenantIdentifier: sni extraction needs a "
+                    f"TLS server")
+            engine.set_tenant(tid_spec.kind, tid_spec.header,
+                              tid_spec.segment)
+        guard = rspec.connectionGuard
+        tenant_cap = (rspec.tenants.maxTenants
+                      if rspec.tenants is not None else 1024)
+        if guard is not None:
+            guard.validate(f"{label}.connectionGuard")
+            engine.set_guard(
+                header_budget_ms=guard.headerBudgetMs,
+                body_stall_ms=guard.bodyStallMs,
+                accept_burst=guard.acceptBurst,
+                accept_window_ms=guard.acceptWindowMs,
+                max_hs_inflight=guard.maxHandshakesInflight,
+                tenant_cap=tenant_cap)
+            if rspec.protocol == "h2":
+                engine.set_flood_guard(
+                    max_streams=guard.maxStreamsPerConnection,
+                    rst_burst=guard.rstBurst,
+                    ping_burst=guard.pingBurst,
+                    settings_burst=guard.settingsBurst,
+                    window_ms=guard.floodWindowMs)
+        elif rspec.tenants is not None:
+            # no guard block, but the operator DID bound tenant
+            # cardinality: the engine table must honor it (defaults
+            # for everything else)
+            engine.set_guard(tenant_cap=tenant_cap)
+        tenant_board = tenant_admission = None
+        if tid_spec is None and rspec.tenants is not None:
+            log.warning(
+                "%s: tenants: quotas configured without a "
+                "tenantIdentifier — per-tenant isolation is DISABLED "
+                "until one is added", label)
+        if tid_spec is not None:
+            tenant_board, tenant_admission = self._mk_tenant_isolation(
+                rspec, label, tid_spec)
+            if tenant_admission is not None:
+                tenant_admission.register_engine(engine)
         ports = [engine.listen_tls(s.ip, s.port) if s.tls is not None
                  else engine.listen(s.ip, s.port) for s in specs]
         ctl = FastPathController(
             engine, interpreter, base_dtab, prefix, label, self.metrics,
-            telemeters=self.telemeters)
+            telemeters=self.telemeters, tenant_board=tenant_board,
+            tenant_admission=tenant_admission)
         return _FastPathRouter(rspec, label, ctl, ports,
                                interpreter=interpreter)
 

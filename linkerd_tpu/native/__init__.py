@@ -171,6 +171,21 @@ def _declare_scorer(cdll: ctypes.CDLL) -> None:
         fn.restype = ctypes.c_int
         fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
                        ctypes.c_float]
+        fn = getattr(cdll, prefix + "_set_tenant")
+        fn.restype = ctypes.c_int
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
+                       ctypes.c_int]
+        fn = getattr(cdll, prefix + "_set_tenant_quota")
+        fn.restype = ctypes.c_int
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int]
+        fn = getattr(cdll, prefix + "_set_guard")
+        fn.restype = ctypes.c_int
+        fn.argtypes = [ctypes.c_void_p] + [ctypes.c_long] * 6
+    cdll.fph2_set_flood_guard.restype = ctypes.c_int
+    cdll.fph2_set_flood_guard.argtypes = \
+        [ctypes.c_void_p] + [ctypes.c_long] * 5
+    cdll.l5d_tenant_hash.restype = ctypes.c_uint32
+    cdll.l5d_tenant_hash.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
 
 
 def _declare_tls(cdll: ctypes.CDLL, prefix: str) -> None:
@@ -260,9 +275,10 @@ class FastPathEngine:
     """
 
     # engine feature-row width: route_id, latency_ms, status, req_b,
-    # rsp_b, ts_s, score, scored (the last two are the in-data-plane
-    # scorer's output; scored == 0.0 rows fall back to the JAX tier)
-    FEATURE_DIM = 8
+    # rsp_b, ts_s, score, scored, tenant (score/scored are the
+    # in-data-plane scorer's output; scored == 0.0 rows fall back to
+    # the JAX tier; tenant is the 24-bit-folded tenant hash, 0 = none)
+    FEATURE_DIM = 9
     _PREFIX = "fp"  # C symbol prefix; the h2 engine overrides to "fph2"
     # ALPN preference list the engine's TLS contexts advertise/offer
     _ALPN = "http/1.1"
@@ -367,6 +383,57 @@ class FastPathEngine:
         eps = " ".join(f"{ip}:{port}" for ip, port in endpoints) + " "
         self._fn_set_route(self._e, self._key(host), eps.encode())
 
+    TENANT_KINDS = {"off": 0, "header": 1, "pathSegment": 2, "sni": 3}
+
+    def set_tenant(self, kind: str, header: str = "l5d-tenant",
+                   segment: int = 0) -> None:
+        """Install the tenant-extraction mode (call before start()):
+        ``header`` hashes the named request header's value,
+        ``pathSegment`` the ``segment``th path element, ``sni`` the TLS
+        server name. The engine stamps the FNV-1a hash into per-request
+        feature rows and the per-tenant stats table."""
+        assert not self._started
+        k = self.TENANT_KINDS.get(kind)
+        if k is None:
+            raise ValueError(f"unknown tenant extraction kind {kind!r}")
+        rc = getattr(self._lib, self._PREFIX + "_set_tenant")(
+            self._e, k, header.encode("latin-1", "replace"),
+            int(segment))
+        if rc != 0:
+            raise ValueError("tenant extraction config rejected")
+
+    def set_tenant_quota(self, tenant_hash: int,
+                         limit: Optional[int]) -> None:
+        """Push (or clear, with ``limit=None``) a per-tenant
+        concurrency quota, keyed by the tenant's 32-bit hash. The
+        engine sheds over-quota requests retryably in the data plane
+        (h1: 503 + l5d-retryable, h2: RST REFUSED_STREAM). Safe at any
+        time; raises when the native quota map is full."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        rc = getattr(self._lib, self._PREFIX + "_set_tenant_quota")(
+            self._e, int(tenant_hash) & 0xFFFFFFFF,
+            -1 if limit is None else max(0, int(limit)))
+        if rc != 0:
+            raise ValueError("native tenant quota map is full")
+
+    def set_guard(self, header_budget_ms: int = 10_000,
+                  body_stall_ms: int = 30_000, accept_burst: int = 0,
+                  accept_window_ms: int = 1000,
+                  max_hs_inflight: int = 0,
+                  tenant_cap: int = 1024) -> None:
+        """Connection-plane defense knobs (call before start()): the
+        slowloris header/body budgets, the per-source accept throttle,
+        TLS handshake-churn backpressure, and the tenant-stats LRU
+        bound. 0 disables an individual defense."""
+        assert not self._started
+        rc = getattr(self._lib, self._PREFIX + "_set_guard")(
+            self._e, int(header_budget_ms), int(body_stall_ms),
+            int(accept_burst), int(accept_window_ms),
+            int(max_hs_inflight), int(tenant_cap))
+        if rc != 0:
+            raise ValueError("guard config rejected")
+
     def set_route_feature(self, host: str, col: int, sign: float) -> bool:
         """Install the dst-path feature-hash (column, sign) for a route
         so the in-engine scorer can featurize its rows; call after
@@ -465,6 +532,21 @@ class H2FastPathEngine(FastPathEngine):
     _PREFIX = "fph2"
     _ALPN = "h2"
 
+    def set_flood_guard(self, max_streams: int = 512,
+                        rst_burst: int = 200, ping_burst: int = 256,
+                        settings_burst: int = 64,
+                        window_ms: int = 1000) -> None:
+        """h2 control-frame flood caps, per client conn per window:
+        stream-concurrency bound, RST (rapid-reset, CVE-2023-44487),
+        PING and SETTINGS bursts. 0 disables one cap. Call before
+        start()."""
+        assert not self._started
+        rc = self._lib.fph2_set_flood_guard(
+            self._e, int(max_streams), int(rst_burst), int(ping_burst),
+            int(settings_burst), int(window_ms))
+        if rc != 0:
+            raise ValueError("flood guard config rejected")
+
     def set_response_timeout_ms(self, ms: int) -> None:
         """Window within which a dispatched stream's backend must START
         its response (504 otherwise); streaming bodies are unbounded.
@@ -501,6 +583,15 @@ def parse_http1_head(head: bytes
         val = head[spans[o + 2]:spans[o + 2] + spans[o + 3]].decode("latin-1")
         headers.append((name, val))
     return method, uri, version, headers
+
+
+def tenant_hash_native(tenant_id: bytes) -> Optional[int]:
+    """The C engines' FNV-1a tenant hash (parity surface for
+    router.tenancy.tenant_hash); None = native unavailable."""
+    cdll = lib()
+    if cdll is None:
+        return None
+    return int(cdll.l5d_tenant_hash(tenant_id, len(tenant_id)))
 
 
 # -- in-data-plane scorer (engine-independent surface) ------------------------
@@ -550,7 +641,7 @@ def score_eval(blob: bytes, x) -> Optional["object"]:
 
 def score_eval_raw(blob: bytes, rows, cols, signs, drifts,
                    return_features: bool = False):
-    """Score RAW engine rows (f32 [n, 8] FeatureRow layout) through the
+    """Score RAW engine rows (f32 [n, 9] FeatureRow layout) through the
     in-engine featurizer, with per-row dst-hash (cols/signs) and
     pre-update drift supplied by the caller — the parity surface for the
     C featurizer. Returns scores [n] (and features [n, FEATURE_DIM]
@@ -626,7 +717,7 @@ class ScoreSlab:
         """Score featurized f32 [n, FEATURE_DIM] rows; None while no
         weights are published. Rejects wrong-width input up front — the
         C side strides by FEATURE_DIM unchecked (an engine-row-shaped
-        [n, 8] array would read out of bounds)."""
+        [n, 9] array would read out of bounds)."""
         import numpy as np
         s = self._handle()
         x = np.ascontiguousarray(x, np.float32)

@@ -37,7 +37,15 @@ class AdmissionControlFilter(Filter):
     (<= the configured ``max_concurrency`` ceiling); up to
     ``max_pending`` more may queue for a slot; beyond that the request
     is shed with OverloadShed. One instance per router (the bound is a
-    router property, shared across its servers)."""
+    router property, shared across its servers).
+
+    The top-level gate is shared fairly (FIFO); per-TENANT sub-limits
+    (``set_tenant_limit``, keyed by the hash TenantTagFilter stamps
+    into ``ctx["tenant_hash"]``) bound any single tenant's share of it
+    on top: a tenant at its sub-limit is shed retryably up front — no
+    queue slot, no global slot — while every other tenant's budget is
+    untouched. The TenantAdmission governor shrinks a sick tenant's
+    sub-limit toward its floor and clears it on recovery."""
 
     def __init__(self, max_concurrency: int, max_pending: int = 0,
                  metrics_node=None):
@@ -51,13 +59,33 @@ class AdmissionControlFilter(Filter):
         self._inflight = 0
         self._pending = 0
         self._waiters: Deque[asyncio.Future] = collections.deque()
+        # per-tenant sub-limits + inflight, keyed by tenant hash
+        self._tenant_limits: dict = {}
+        self._tenant_inflight: dict = {}
         if metrics_node is not None:
             self._shed = metrics_node.counter("shed_total")
+            self._tenant_shed = metrics_node.counter("tenant_shed_total")
             metrics_node.gauge("inflight", fn=lambda: float(self._inflight))
             metrics_node.gauge("pending", fn=lambda: float(self._pending))
             metrics_node.gauge("limit", fn=lambda: float(self._limit))
+            metrics_node.gauge(
+                "tenant_limits",
+                fn=lambda: float(len(self._tenant_limits)))
         else:
             self._shed = None
+            self._tenant_shed = None
+
+    def set_tenant_limit(self, tenant_hash: int,
+                         limit: Optional[int]) -> None:
+        """Install (or clear, with ``None``) one tenant's concurrency
+        sub-limit. Narrowing never cancels in-flight work."""
+        if limit is None:
+            self._tenant_limits.pop(tenant_hash, None)
+        else:
+            self._tenant_limits[tenant_hash] = max(0, int(limit))
+
+    def tenant_limit_of(self, tenant_hash: int) -> Optional[int]:
+        return self._tenant_limits.get(tenant_hash)
 
     @property
     def effective_concurrency(self) -> int:
@@ -80,6 +108,35 @@ class AdmissionControlFilter(Filter):
             fut.set_result(None)
 
     async def apply(self, req, service: Service):
+        # per-tenant sub-limit first: an over-limit tenant is refused
+        # before it can take a queue slot or a global slot (the shed is
+        # retryable by the same contract as the global gate's)
+        th = req.ctx.get("tenant_hash") if hasattr(req, "ctx") else None
+        if th is not None:
+            tl = self._tenant_limits.get(th)
+            if tl is not None \
+                    and self._tenant_inflight.get(th, 0) >= tl:
+                if self._tenant_shed is not None:
+                    self._tenant_shed.incr()
+                raise OverloadShed(
+                    f"admission control: tenant over its sub-limit "
+                    f"({tl}); shedding")
+            # the tenant slot is taken NOW (not after the queue wait)
+            # so queued same-tenant arrivals count against the
+            # sub-limit instead of slipping past it
+            self._tenant_inflight[th] = \
+                self._tenant_inflight.get(th, 0) + 1
+        try:
+            return await self._admit_and_serve(req, service)
+        finally:
+            if th is not None:
+                left = self._tenant_inflight.get(th, 0) - 1
+                if left <= 0:
+                    self._tenant_inflight.pop(th, None)
+                else:
+                    self._tenant_inflight[th] = left
+
+    async def _admit_and_serve(self, req, service: Service):
         if self._inflight < self._limit and not self._waiters:
             self._inflight += 1
         elif self._pending >= self.max_pending:

@@ -120,7 +120,8 @@ class FastPathController:
     def __init__(self, engine, interpreter, base_dtab: Dtab, prefix: Path,
                  label: str, metrics, telemeters=(),
                  miss_poll_s: float = 0.01, stats_poll_s: float = 1.0,
-                 max_hosts: int = 10_000):
+                 max_hosts: int = 10_000, tenant_board=None,
+                 tenant_admission=None):
         self.engine = engine
         self.interpreter = interpreter
         self.dtab = base_dtab
@@ -139,6 +140,21 @@ class FastPathController:
         self._weight_sink_regs: List[tuple] = []
         self._id_to_host: Dict[int, str] = {}
         self._scope = metrics.scope("rt", label, "fastpath")
+        # tenant isolation: engine per-tenant aggregates feed the board
+        # (level inputs for the quota governor) each stats tick, and
+        # the governor steps on the same cadence — the engines are the
+        # data plane, this loop is their control plane
+        self.tenant_board = tenant_board
+        self.tenant_admission = tenant_admission
+        self._last_tenants: Dict[str, Dict[str, float]] = {}
+        self._last_guard: Dict[str, int] = {}
+        # metrics-tree cardinality bound: the engine's tenant table is
+        # LRU-bounded, but the metrics tree never forgets a scope —
+        # under tenant-id churn each stats tick would otherwise mint
+        # fresh rt/*/fastpath/tenant/<hash>/* counters forever. Past
+        # this many distinct hashes, deltas roll up under .../other/*.
+        self._tenant_metric_keys: set = set()
+        self._tenant_metric_cap = 256
         from linkerd_tpu.models.features import DstTemporal
         self._temporal = DstTemporal()
         # native line-rate feed state: telemeters whose ring resolver is
@@ -230,9 +246,66 @@ class FastPathController:
     _TLS_KEYS = ("handshakes", "failures", "resumed", "alpn_h2",
                  "alpn_http1", "upstream_handshakes", "upstream_resumed",
                  "upstream_failures")
+    _GUARD_KEYS = ("slowloris_closed", "body_stall_closed",
+                   "accept_throttled", "hs_churn_shed",
+                   "rapid_reset_closed", "flood_closed", "tenant_shed")
+    _TENANT_KEYS = ("requests", "shed", "errors", "scored")
+
+    def _export_tenants(self, snap: dict) -> None:
+        """Engine per-tenant aggregates → rt/*/fastpath/tenant/* and
+        (as deltas) into the TenantBoard; guard counters →
+        rt/*/fastpath/guard/*. The quota governor steps on this same
+        1 s cadence — sick tenants get their in-engine quota within
+        one stats tick of their level crossing the governor's
+        threshold."""
+        guard = snap.get("guard")
+        if guard:
+            scope = self._scope.scope("guard")
+            prev = self._last_guard
+            for key in self._GUARD_KEYS:
+                delta = int(guard.get(key, 0)) - int(prev.get(key, 0))
+                if delta > 0:
+                    scope.counter(key).incr(delta)
+            self._last_guard = {k: int(guard.get(k, 0))
+                                for k in self._GUARD_KEYS}
+        tn = snap.get("tenants")
+        if not tn:
+            return
+        scope = self._scope.scope("tenant")
+        scope.gauge("count").set(float(tn.get("count", 0)))
+        scope.gauge("evicted").set(float(tn.get("evicted", 0)))
+        by = tn.get("by_tenant") or {}
+        cur: Dict[str, Dict[str, float]] = {}
+        for thash, t in by.items():
+            prev = self._last_tenants.get(thash, {})
+            if (thash in self._tenant_metric_keys
+                    or len(self._tenant_metric_keys)
+                    < self._tenant_metric_cap):
+                self._tenant_metric_keys.add(thash)
+                tscope = scope.scope(thash)
+            else:
+                tscope = scope.scope("other")
+            deltas = {}
+            for key in self._TENANT_KEYS:
+                d = int(t.get(key, 0)) - int(prev.get(key, 0))
+                deltas[key] = max(0, d)
+                if d > 0:
+                    tscope.counter(key).incr(d)
+            cur[thash] = {k: int(t.get(k, 0))
+                          for k in self._TENANT_KEYS}
+            if self.tenant_board is not None and (
+                    deltas["requests"] or deltas["shed"]):
+                self.tenant_board.ingest_native(
+                    int(thash), deltas["requests"], deltas["errors"],
+                    deltas["shed"], t.get("score_ewma"),
+                    deltas["scored"])
+        self._last_tenants = cur
+        if self.tenant_admission is not None:
+            self.tenant_admission.step()
 
     def _export_stats(self) -> None:
         snap = self.engine.stats()
+        self._export_tenants(snap)
         tls = snap.get("tls")
         if tls and (tls.get("enabled") or tls.get("client_enabled")):
             scope = self._scope.scope("tls")

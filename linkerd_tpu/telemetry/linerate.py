@@ -278,14 +278,17 @@ class RingDispatcher:
 # -- native feature ring ------------------------------------------------------
 
 
-# engine row: route_id, lat_ms, status, req_b, rsp_b, ts, score, scored.
-# The last two are the in-data-plane scorer's output (native/scorer.h):
-# scored == 1.0 rows arrive pre-scored from the engine; 0.0 rows (no
-# weight blob published, route hash not pushed yet, nativeTier: off)
-# fall back to the JAX tier in the micro-batcher.
-NATIVE_ROW_WIDTH = 8
+# engine row: route_id, lat_ms, status, req_b, rsp_b, ts, score,
+# scored, tenant. score/scored are the in-data-plane scorer's output
+# (native/scorer.h): scored == 1.0 rows arrive pre-scored from the
+# engine; 0.0 rows (no weight blob published, route hash not pushed
+# yet, nativeTier: off) fall back to the JAX tier in the micro-batcher.
+# tenant is the 24-bit-folded FNV-1a tenant hash (0 = no tenant) the
+# engine extracted per its tenantIdentifier config.
+NATIVE_ROW_WIDTH = 9
 NATIVE_COL_SCORE = 6
 NATIVE_COL_SCORED = 7
+NATIVE_COL_TENANT = 8
 
 
 class NativeFeatureRing:

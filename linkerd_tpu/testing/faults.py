@@ -209,6 +209,286 @@ class FaultScorer:
         return getattr(self.inner, name)
 
 
+class TenantRetryStorm:
+    """Tenant-shaped attacker: a closed-loop flood of concurrent
+    requests stamped with one tenant id, hammering as fast as the
+    router answers — the shape of a retry storm (every shed/error is
+    immediately re-sent). Counts outcomes so the chaos matrix can
+    assert the attacker was shed while the victim held."""
+
+    def __init__(self, port: int, host: str, tenant: str,
+                 concurrency: int = 16,
+                 tenant_header: str = "l5d-tenant", uri: str = "/",
+                 retry_delay_s: float = 0.0):
+        self.port = port
+        self.host = host
+        self.tenant = tenant
+        self.concurrency = concurrency
+        self.tenant_header = tenant_header
+        self.uri = uri
+        # pause after a non-200 (a real storm's retry backoff); also
+        # keeps an in-process attacker from starving the shared event
+        # loop the victim runs on
+        self.retry_delay_s = retry_delay_s
+        self.ok = 0
+        self.shed = 0       # 503 + l5d-retryable (or REFUSED)
+        self.errors = 0
+        self._stop = asyncio.Event()
+        self._tasks: list = []
+
+    async def _worker(self) -> None:
+        req = (f"GET {self.uri} HTTP/1.1\r\nHost: {self.host}\r\n"
+               f"{self.tenant_header}: {self.tenant}\r\n\r\n").encode()
+        while not self._stop.is_set():
+            try:
+                r, w = await asyncio.open_connection("127.0.0.1",
+                                                     self.port)
+            except OSError:
+                self.errors += 1
+                await asyncio.sleep(0.01)
+                continue
+            try:
+                while not self._stop.is_set():
+                    w.write(req)
+                    await w.drain()
+                    line = await asyncio.wait_for(r.readline(), 10)
+                    if not line:
+                        break
+                    status = int(line.split()[1])
+                    clen = 0
+                    while True:
+                        h = await r.readline()
+                        if h in (b"\r\n", b""):
+                            break
+                        if h.lower().startswith(b"content-length:"):
+                            clen = int(h.split(b":")[1])
+                    if clen:
+                        await r.readexactly(clen)
+                    if status == 200:
+                        self.ok += 1
+                    elif status == 503:
+                        self.shed += 1
+                    else:
+                        self.errors += 1
+                    if status != 200 and self.retry_delay_s > 0:
+                        await asyncio.sleep(self.retry_delay_s)
+            except (OSError, asyncio.IncompleteReadError,
+                    asyncio.TimeoutError, ValueError, IndexError):
+                self.errors += 1
+            finally:
+                w.close()
+
+    def start(self) -> "TenantRetryStorm":
+        self._tasks = [asyncio.ensure_future(self._worker())
+                       for _ in range(self.concurrency)]
+        return self
+
+    async def stop(self) -> None:
+        self._stop.set()
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+
+    @property
+    def total(self) -> int:
+        return self.ok + self.shed + self.errors
+
+    @property
+    def shed_fraction(self) -> float:
+        return self.shed / self.total if self.total else 0.0
+
+
+class SlowlorisAttack:
+    """Connection-plane attacker: opens ``conns`` sockets, sends a
+    PARTIAL request head (h1) or half a client preface (h2), then
+    drips one byte every ``drip_s`` — classic slowloris. Tracks how
+    many of its conns the target closed (the defense's kill count)."""
+
+    H1_PARTIAL = b"GET / HTTP/1.1\r\nHost: victim\r\nX-Drip: "
+    H2_PARTIAL = b"PRI * HTTP/2.0\r\n"
+
+    def __init__(self, port: int, conns: int = 32, drip_s: float = 5.0,
+                 h2: bool = False):
+        self.port = port
+        self.conns = conns
+        self.drip_s = drip_s
+        self.partial = self.H2_PARTIAL if h2 else self.H1_PARTIAL
+        self.closed_by_target = 0
+        self.opened = 0
+        self._stop = asyncio.Event()
+        self._tasks: list = []
+
+    async def _worker(self) -> None:
+        while not self._stop.is_set():
+            try:
+                r, w = await asyncio.open_connection("127.0.0.1",
+                                                     self.port)
+            except OSError:
+                await asyncio.sleep(0.05)
+                continue
+            self.opened += 1
+            try:
+                w.write(self.partial)
+                await w.drain()
+                while not self._stop.is_set():
+                    # a closed conn surfaces as EOF on read
+                    try:
+                        data = await asyncio.wait_for(
+                            r.read(256), self.drip_s)
+                    except asyncio.TimeoutError:
+                        w.write(b"x")  # the drip
+                        await w.drain()
+                        continue
+                    if not data:
+                        self.closed_by_target += 1
+                        break
+            except OSError:
+                self.closed_by_target += 1
+            finally:
+                w.close()
+
+    def start(self) -> "SlowlorisAttack":
+        self._tasks = [asyncio.ensure_future(self._worker())
+                       for _ in range(self.conns)]
+        return self
+
+    async def stop(self) -> None:
+        self._stop.set()
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+
+
+class ConnectionChurnAttack:
+    """Connection-plane attacker: opens and immediately abandons
+    connections at rate — the TCP/TLS churn flood that thrashes accept
+    queues and handshake state. ``tls_context`` upgrades each conn to
+    a full TLS handshake (the expensive variant the handshake-churn
+    backpressure exists for)."""
+
+    def __init__(self, port: int, rate_per_s: float = 500.0,
+                 workers: int = 8, tls_context=None):
+        self.port = port
+        self.rate_per_s = rate_per_s
+        self.workers = workers
+        self.tls_context = tls_context
+        self.opened = 0
+        self.refused = 0  # connect/handshake rejected by the target
+        self._stop = asyncio.Event()
+        self._tasks: list = []
+
+    async def _worker(self) -> None:
+        delay = self.workers / max(1.0, self.rate_per_s)
+        while not self._stop.is_set():
+            try:
+                r, w = await asyncio.wait_for(
+                    asyncio.open_connection(
+                        "127.0.0.1", self.port, ssl=self.tls_context,
+                        server_hostname=("localhost"
+                                         if self.tls_context else None)),
+                    5)
+                self.opened += 1
+                w.close()
+            except (OSError, asyncio.TimeoutError, ConnectionError):
+                self.refused += 1
+            await asyncio.sleep(delay)
+
+    def start(self) -> "ConnectionChurnAttack":
+        self._tasks = [asyncio.ensure_future(self._worker())
+                       for _ in range(self.workers)]
+        return self
+
+    async def stop(self) -> None:
+        self._stop.set()
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+
+
+class PacedTenantClient:
+    """The victim tenant: paced (open-loop) requests with its own
+    tenant id, recording per-request latency + outcome so the chaos
+    matrix can assert its p99 and success rate held while the attacker
+    was shed."""
+
+    def __init__(self, port: int, host: str, tenant: str,
+                 rate_per_s: float = 50.0,
+                 tenant_header: str = "l5d-tenant"):
+        self.port = port
+        self.host = host
+        self.tenant = tenant
+        self.rate_per_s = rate_per_s
+        self.tenant_header = tenant_header
+        self.latencies_ms: list = []
+        self.ok = 0
+        self.failed = 0
+
+    async def run(self, n: int) -> None:
+        req = (f"GET / HTTP/1.1\r\nHost: {self.host}\r\n"
+               f"{self.tenant_header}: {self.tenant}\r\n\r\n").encode()
+        delay = 1.0 / self.rate_per_s
+        r = w = None
+        for _ in range(n):
+            t0 = time.monotonic()
+            try:
+                if w is None:
+                    r, w = await asyncio.open_connection("127.0.0.1",
+                                                         self.port)
+                w.write(req)
+                await w.drain()
+                line = await asyncio.wait_for(r.readline(), 10)
+                status = int(line.split()[1])
+                clen = 0
+                while True:
+                    h = await r.readline()
+                    if h in (b"\r\n", b""):
+                        break
+                    if h.lower().startswith(b"content-length:"):
+                        clen = int(h.split(b":")[1])
+                if clen:
+                    await r.readexactly(clen)
+                if status == 200:
+                    self.ok += 1
+                    self.latencies_ms.append(
+                        (time.monotonic() - t0) * 1e3)
+                else:
+                    self.failed += 1
+            except (OSError, asyncio.IncompleteReadError,
+                    asyncio.TimeoutError, ValueError, IndexError):
+                self.failed += 1
+                if w is not None:
+                    w.close()
+                r = w = None
+            took = time.monotonic() - t0
+            if took < delay:
+                await asyncio.sleep(delay - took)
+        if w is not None:
+            w.close()
+
+    @property
+    def success_rate(self) -> float:
+        total = self.ok + self.failed
+        return self.ok / total if total else 0.0
+
+    def p99_ms(self) -> float:
+        if not self.latencies_ms:
+            return float("inf")
+        xs = sorted(self.latencies_ms)
+        return xs[min(len(xs) - 1, int(0.99 * len(xs)))]
+
+
 class WindowLabeler(Filter[Request, Response]):
     """Labels responses anomalous while a named window is open — used for
     cascade/degradation scenarios where the anomaly is indirect (inherited

@@ -46,6 +46,7 @@
 #include <vector>
 
 #include "scorer.h"
+#include "tenant_guard.h"
 #include "tls_engine.h"
 
 namespace {
@@ -119,6 +120,8 @@ struct FeatureRow {
     // evaluated the native model for this row (score then holds the
     // anomaly score); 0.0 rows fall back to the JAX tier in Python
     float score, scored;
+    // tenant hash folded to 24 bits (f32-integer-exact); 0 = no tenant
+    float tenant;
 };
 
 enum class BodyKind { NONE, LENGTH, CHUNKED, EOF_DELIM };
@@ -345,6 +348,14 @@ struct Engine {
     // sync; score_stats is guarded by mu like the feature buffer
     l5dscore::Slab scorer_slab;
     l5dscore::ScoreStats score_stats;
+    // tenant accounting + per-tenant quotas (guarded by mu); the
+    // extraction mode and guard knobs are installed BEFORE fp_start
+    // (wrapper-asserted), so the loop thread reads them unlocked
+    l5dtg::TenantTable tenants;
+    l5dtg::QuotaMap quotas;
+    l5dtg::TenantExtract tenant_ex;
+    l5dtg::GuardCfg guard_cfg;
+    l5dtg::GuardStats guard;
 
     // loop-thread-only state
     std::unordered_map<int, Conn*> conns;
@@ -365,6 +376,9 @@ struct Engine {
     // written by the loop thread, read by fp_stats_json callers: atomic
     std::atomic<uint64_t> accepted{0};
     uint64_t last_sweep_us = 0;
+    // loop-thread-only defense state
+    l5dtg::SourceTable sources;
+    uint32_t hs_inflight = 0;  // accept-leg TLS handshakes in flight
     // feature timestamps are relative to engine creation:
     // float32 seconds-since-boot quantizes to >60ms after
     // ~12 days of uptime, breaking inter-arrival math
@@ -396,6 +410,16 @@ struct Conn {
     std::string req_method;
     uint64_t t_start_us = 0;
     uint64_t req_bytes = 0, rsp_bytes = 0;
+    // tenant isolation (client conns): current request's tenant hash,
+    // whether it holds a per-tenant inflight slot, and the slowloris
+    // budgets the sweep enforces (hdr_start: a partial head has been
+    // accumulating since then; body_progress: last request-body byte)
+    uint32_t tenant = 0;
+    bool tenant_counted = false;
+    bool served_one = false;  // completed >=1 head: keep-alive may idle
+    uint64_t hdr_start_us = 0;
+    uint64_t body_progress_us = 0;
+    bool hs_pending = false;  // counted in Engine::hs_inflight
 
     // upstream conns
     uint32_t ep_ip_be = 0;
@@ -455,12 +479,15 @@ void maybe_pause_producer(Engine* e, Conn* consumer) {
 
 void push_feature(Engine* e, uint64_t route_id, uint64_t lat_us, int status,
                   uint64_t req_b, uint64_t rsp_b, float score, int scored,
-                  uint64_t score_ns) {
+                  uint64_t score_ns, uint32_t tenant) {
     std::lock_guard<std::mutex> g(e->mu);
     if (scored)
         e->score_stats.record(score_ns);
     else
         e->score_stats.unscored++;
+    // per-tenant aggregates ride the same mu hold as the feature push
+    if (tenant)
+        e->tenants.observe(tenant, status, score, scored != 0, now_us());
     if (e->features.size() >= e->features_cap) {
         e->features_dropped++;
         return;
@@ -474,7 +501,32 @@ void push_feature(Engine* e, uint64_t route_id, uint64_t lat_us, int status,
     r.ts_s = (float)((double)(now_us() - e->t0_us) / 1e6);
     r.score = score;
     r.scored = scored ? 1.0f : 0.0f;
+    r.tenant = l5dtg::tenant_feature(tenant);
     e->features.push_back(r);
+}
+
+// Release the client's per-tenant inflight slot (idempotent via the
+// tenant_counted flag; finish_exchange and conn_close both call it).
+void tenant_release(Engine* e, Conn* c) {
+    if (!c->tenant_counted) return;
+    c->tenant_counted = false;
+    std::lock_guard<std::mutex> g(e->mu);
+    l5dtg::TenantStats* ts = e->tenants.peek(c->tenant);
+    if (ts != nullptr && ts->inflight > 0) ts->inflight--;
+}
+
+// A TLS handshake finished (either way): clear its sweep deadline and
+// release its slot in the accept-leg churn-backpressure counter.
+void hs_complete(Engine* e, Conn* c) {
+    c->tls->hs_deadline_us = 0;
+    if (c->hs_pending) {
+        c->hs_pending = false;
+        if (e->hs_inflight > 0) e->hs_inflight--;
+        // the header budget starts now that the handshake is done
+        if (e->guard_cfg.header_budget_us != 0 && !c->served_one &&
+            c->hdr_start_us == 0)
+            c->hdr_start_us = now_us();
+    }
 }
 
 void conn_close(Engine* e, Conn* c);
@@ -502,7 +554,7 @@ bool flush_out(Engine* e, Conn* c) {
             return false;
         }
         if (was_hs && c->tls->sess->hs_done) {
-            c->tls->hs_deadline_us = 0;
+            hs_complete(e, c);
             tls_account(e, c, false);
         }
     }
@@ -644,6 +696,11 @@ void conn_close(Engine* e, Conn* c) {
     if (c->st == Conn::St::CLOSED) return;
     bool was_wait_route = (c->st == Conn::St::WAIT_ROUTE);
     c->st = Conn::St::CLOSED;
+    tenant_release(e, c);
+    if (c->hs_pending) {
+        c->hs_pending = false;
+        if (e->hs_inflight > 0) e->hs_inflight--;
+    }
     if (c->fd >= 0) {
         stash_upstream_session(e, c);
         epoll_ctl(e->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
@@ -661,6 +718,7 @@ void conn_close(Engine* e, Conn* c) {
         } else {
             // upstream died mid-exchange
             if (p->st == Conn::St::READ_RSP && p->rsp_bytes == 0) {
+                tenant_release(e, p);  // exchange over: free the slot
                 if (send_simple(e, p, 502, "Bad Gateway",
                                 "l5d-err: upstream\r\n",
                                 "upstream connection failed", false)) {
@@ -698,6 +756,9 @@ void attach_upstream(Engine* e, Conn* client, Conn* up) {
     up->deadline_us = now_us() + EXCHANGE_TIMEOUT_US;
     client->st = client->req_body.done()
         ? Conn::St::READ_RSP : Conn::St::FORWARD_BODY;
+    // zero-progress-body budget starts when we begin waiting for body
+    client->body_progress_us =
+        client->st == Conn::St::FORWARD_BODY ? now_us() : 0;
     client->deadline_us = 0;
     wbuf(up)->append(client->req_stash);
     client->req_stash.clear();
@@ -750,6 +811,7 @@ int dispatch(Engine* e, Conn* client) {
         }
     }
     if (!found) {
+        tenant_release(e, client);  // no exchange will finish this
         client->req_stash.clear();
         if (send_simple(e, client, 400, "Bad Request",
                         "l5d-err: no route\r\n",
@@ -794,6 +856,7 @@ int dispatch(Engine* e, Conn* client) {
                 }
             }
             delete up;
+            tenant_release(e, client);
             client->req_stash.clear();
             send_simple(e, client, 502, "Bad Gateway",
                         "l5d-err: connect\r\n", "connect failed", true);
@@ -821,6 +884,10 @@ bool try_start_request(Engine* e, Conn* client) {
                     true);
         return false;
     }
+    // a complete head arrived: the slowloris header budget is met, and
+    // the conn has proven itself a real client (keep-alive may idle)
+    client->served_one = true;
+    client->hdr_start_us = 0;
     BodyTracker bt;
     if (!request_body(h, &bt)) {
         send_simple(e, client, 400, "Bad Request", "", "bad body framing",
@@ -873,6 +940,59 @@ bool try_start_request(Engine* e, Conn* client) {
         // attribution, or the stats JSON (Host is untrusted input)
         return send_simple(e, client, 400, "Bad Request",
                            "l5d-err: bad host\r\n", "invalid Host", false);
+    }
+
+    // tenant identity: stamp the request's tenant hash, then enforce
+    // the tenant's pushed quota HERE — the isolation decision runs in
+    // the data plane, before any upstream work. Sheds are retry-safe
+    // (503 + l5d-retryable: the request was never admitted).
+    client->tenant = 0;
+    switch (e->tenant_ex.kind) {
+    case 1: {
+        const std::string* tv = get_header(h, e->tenant_ex.header.c_str());
+        if (tv != nullptr && !tv->empty())
+            client->tenant = l5dtg::tenant_hash(tv->data(), tv->size());
+        break;
+    }
+    case 2:
+        client->tenant = l5dtg::hash_path_segment(h.uri,
+                                                  e->tenant_ex.segment);
+        break;
+    case 3:
+        if (client->tls != nullptr) {
+            std::string sni = l5dtls::server_sni(client->tls->sess);
+            if (!sni.empty())
+                client->tenant = l5dtg::tenant_hash(sni.data(),
+                                                    sni.size());
+        }
+        break;
+    default:
+        break;
+    }
+    if (client->tenant) {
+        bool over = false;
+        {
+            std::lock_guard<std::mutex> g(e->mu);
+            l5dtg::TenantStats* ts =
+                e->tenants.get(client->tenant, client->t_start_us);
+            int q = e->quotas.limit_of(client->tenant);
+            if (q >= 0 && ts->inflight >= q) {
+                ts->shed++;
+                over = true;
+            } else {
+                ts->inflight++;
+                client->tenant_counted = true;
+            }
+        }
+        if (over) {
+            e->guard.tenant_shed.fetch_add(1, std::memory_order_relaxed);
+            // a shed mid-body can't resync the framing: close after
+            return send_simple(e, client, 503, "Service Unavailable",
+                               "l5d-retryable: true\r\n"
+                               "l5d-err: tenant quota\r\n",
+                               "tenant over quota",
+                               !client->req_body.done());
+        }
     }
 
     client->req_stash = std::move(staged);
@@ -962,7 +1082,8 @@ void finish_exchange(Engine* e, Conn* up, bool upstream_reusable) {
     }
     push_feature(e, up->route_id, lat, up->rsp_status,
                  client->req_bytes, client->rsp_bytes,
-                 score, scored, score_ns);
+                 score, scored, score_ns, client->tenant);
+    tenant_release(e, client);
     client->peer = nullptr;
     up->peer = nullptr;
     release_upstream(e, up, upstream_reusable);
@@ -1019,7 +1140,7 @@ void on_upstream_readable(Engine* e, Conn* up) {
                 return;
             }
             if (was_hs && up->tls->sess->hs_done) {
-                up->tls->hs_deadline_us = 0;
+                hs_complete(e, up);
                 tls_account(e, up, false);
             }
             // handshake records / staged request plaintext
@@ -1117,7 +1238,7 @@ void on_client_readable(Engine* e, Conn* c) {
                 return;
             }
             if (was_hs && c->tls->sess->hs_done) {
-                c->tls->hs_deadline_us = 0;
+                hs_complete(e, c);
                 tls_account(e, c, false);
             }
             // handshake records / resumption tickets
@@ -1134,12 +1255,26 @@ void on_client_readable(Engine* e, Conn* c) {
             wbuf(c->peer)->append(c->in.data(), (size_t)take);
             c->req_bytes += (uint64_t)take;
             c->in.erase(0, (size_t)take);
+            if (take > 0) c->body_progress_us = now_us();
             if (!flush_out(e, c->peer)) return;
             maybe_pause_producer(e, c->peer);  // c produces into peer->out
-            if (c->req_body.done()) c->st = Conn::St::READ_RSP;
+            if (c->req_body.done()) {
+                c->st = Conn::St::READ_RSP;
+                c->body_progress_us = 0;
+            }
         } else if (c->st == Conn::St::READ_HEAD) {
             process_client_buffer(e, c);
             if (c->st == Conn::St::CLOSED) return;
+        }
+        // slowloris header budget: a partial head (or a fresh conn
+        // that has sent nothing) keeps its deadline; an idle keep-alive
+        // conn that has completed at least one request may idle freely
+        if (c->st == Conn::St::READ_HEAD &&
+            e->guard_cfg.header_budget_us != 0) {
+            if (c->in.empty() && c->served_one)
+                c->hdr_start_us = 0;
+            else if (c->hdr_start_us == 0)
+                c->hdr_start_us = now_us();
         }
         // WAIT_ROUTE / READ_RSP: extra bytes buffer in c->in (pipelining),
         // bounded — a client shoveling data while parked is abusive
@@ -1158,12 +1293,42 @@ void on_client_readable(Engine* e, Conn* c) {
 void on_listener(Engine* e, int lfd) {
     bool tls = e->tls_srv != nullptr && e->tls_listeners.count(lfd) > 0;
     for (;;) {
-        int fd = ::accept4(lfd, nullptr, nullptr, SOCK_NONBLOCK);
+        sockaddr_in peer{};
+        socklen_t plen = sizeof(peer);
+        int fd = ::accept4(lfd, (sockaddr*)&peer, &plen, SOCK_NONBLOCK);
         if (fd < 0) return;
+        uint64_t now = now_us();
+        // per-source accept throttle: a churn-flooding source is shed
+        // at accept, before it can consume a handshake or conn slot
+        if (peer.sin_family == AF_INET &&
+            !e->sources.allow(peer.sin_addr.s_addr, e->guard_cfg, now)) {
+            e->guard.accept_throttled.fetch_add(
+                1, std::memory_order_relaxed);
+            ::close(fd);
+            continue;
+        }
+        // handshake-churn backpressure: shed new TLS conns while too
+        // many handshakes are in flight — full handshakes are the
+        // expensive path, and letting a flood queue them would thrash
+        // the resumption cache for well-behaved peers
+        if (tls && e->guard_cfg.max_hs_inflight != 0 &&
+            e->hs_inflight >= e->guard_cfg.max_hs_inflight) {
+            e->guard.hs_churn_shed.fetch_add(
+                1, std::memory_order_relaxed);
+            ::close(fd);
+            continue;
+        }
         set_nodelay(fd);
         Conn* c = new Conn();
         c->kind = Conn::Kind::CLIENT;
         c->fd = fd;
+        // slowloris: a fresh conn must produce a complete request head
+        // within the header budget (enforced by the sweep). TLS conns
+        // arm it on handshake COMPLETION (hs_complete) instead — the
+        // handshake has its own budget, and a tight header budget must
+        // not misread a slow handshake as a slowloris
+        if (e->guard_cfg.header_budget_us != 0 && !tls)
+            c->hdr_start_us = now;
         if (tls) {
             l5dtls::Sess* s = l5dtls::new_session(e->tls_srv, nullptr,
                                                   false, nullptr);
@@ -1174,7 +1339,9 @@ void on_listener(Engine* e, int lfd) {
             }
             c->tls = new l5dtls::TlsIo();
             c->tls->sess = s;
-            c->tls->hs_deadline_us = now_us() + TLS_HS_TIMEOUT_US;
+            c->tls->hs_deadline_us = now + TLS_HS_TIMEOUT_US;
+            c->hs_pending = true;
+            e->hs_inflight++;
         }
         ep_add(e, c);
         e->accepted.fetch_add(1, std::memory_order_relaxed);
@@ -1196,6 +1363,26 @@ void sweep_timeouts(Engine* e) {
             tls_account(e, c, /*failed=*/true);
             expired.push_back(c);
         } else if (c->deadline_us != 0 && now > c->deadline_us) {
+            expired.push_back(c);
+        } else if (c->kind == Conn::Kind::CLIENT &&
+                   e->guard_cfg.header_budget_us != 0 &&
+                   c->hdr_start_us != 0 &&
+                   now - c->hdr_start_us >
+                       e->guard_cfg.header_budget_us) {
+            // slowloris: head still incomplete past the budget
+            e->guard.slowloris_closed.fetch_add(
+                1, std::memory_order_relaxed);
+            expired.push_back(c);
+        } else if (c->kind == Conn::Kind::CLIENT &&
+                   c->st == Conn::St::FORWARD_BODY &&
+                   e->guard_cfg.body_stall_budget_us != 0 &&
+                   c->body_progress_us != 0 &&
+                   now - c->body_progress_us >
+                       e->guard_cfg.body_stall_budget_us) {
+            // zero-progress request body: a trickling attacker must
+            // not pin an upstream slot indefinitely
+            e->guard.body_stall_closed.fetch_add(
+                1, std::memory_order_relaxed);
             expired.push_back(c);
         }
     }
@@ -1242,6 +1429,7 @@ void sweep_timeouts(Engine* e) {
     for (Conn* c : expired) {
         if (c->st == Conn::St::WAIT_ROUTE) {
             unregister_parked(e, c);
+            tenant_release(e, c);  // the exchange will never finish
             c->req_stash.clear();
             if (send_simple(e, c, 400, "Bad Request",
                             "l5d-err: no route\r\n",
@@ -1551,6 +1739,10 @@ long fp_stats_json(void* ep, char* buf, size_t cap) {
              e->tls_srv != nullptr ? "true" : "false",
              e->tls_cli != nullptr ? "true" : "false");
     s += tail;
+    l5dtg::tenants_json(e->tenants, e->quotas, &s);
+    s += ",";
+    l5dtg::guard_json(e->guard, &s);
+    s += ",";
     l5dscore::stats_json(e->scorer_slab, e->score_stats, &s);
     s += "}";
     if (s.size() + 1 > cap) return -2;
@@ -1560,16 +1752,58 @@ long fp_stats_json(void* ep, char* buf, size_t cap) {
 }
 
 // Each row: [route_id, latency_ms, status, req_bytes, rsp_bytes, ts_s,
-// score, scored]
+// score, scored, tenant]
 long fp_drain_features(void* ep, float* buf, long cap_rows) {
     Engine* e = (Engine*)ep;
     std::lock_guard<std::mutex> g(e->mu);
     long n = (long)e->features.size();
     if (n > cap_rows) n = cap_rows;
     for (long i = 0; i < n; i++)
-        memcpy(buf + i * 8, &e->features[(size_t)i], sizeof(FeatureRow));
+        memcpy(buf + i * 9, &e->features[(size_t)i], sizeof(FeatureRow));
     e->features.erase(e->features.begin(), e->features.begin() + n);
     return n;
+}
+
+// Install the tenant-extraction mode (call BEFORE fp_start). kind:
+// 0 = off, 1 = header (name, matched case-insensitively), 2 = path
+// segment (`segment`th element of the request path), 3 = SNI (TLS
+// listeners; requires a runtime with SSL_get_servername).
+int fp_set_tenant(void* ep, int kind, const char* header, int segment) {
+    Engine* e = (Engine*)ep;
+    if (kind < 0 || kind > 3) return -1;
+    e->tenant_ex.kind = kind;
+    e->tenant_ex.header = header != nullptr ? header : "";
+    lower(e->tenant_ex.header);
+    e->tenant_ex.segment = segment;
+    return 0;
+}
+
+// Push / clear (limit < 0) a per-tenant concurrency quota, keyed by
+// the tenant's 32-bit hash. Safe at any time: the data plane reads
+// quotas under the engine mu per request head.
+int fp_set_tenant_quota(void* ep, unsigned int hash, int limit) {
+    Engine* e = (Engine*)ep;
+    std::lock_guard<std::mutex> g(e->mu);
+    return e->quotas.set(hash, limit);
+}
+
+// Connection-plane guard knobs (call BEFORE fp_start); 0 disables the
+// individual defense. tenant_cap bounds the tenant-stats LRU.
+int fp_set_guard(void* ep, long header_budget_ms, long body_stall_ms,
+                 long accept_burst, long accept_window_ms,
+                 long max_hs_inflight, long tenant_cap) {
+    Engine* e = (Engine*)ep;
+    if (header_budget_ms < 0 || body_stall_ms < 0 || accept_burst < 0 ||
+        accept_window_ms < 1 || max_hs_inflight < 0 || tenant_cap < 1)
+        return -1;
+    e->guard_cfg.header_budget_us = (uint64_t)header_budget_ms * 1000;
+    e->guard_cfg.body_stall_budget_us = (uint64_t)body_stall_ms * 1000;
+    e->guard_cfg.accept_burst = (uint32_t)accept_burst;
+    e->guard_cfg.accept_window_us = (uint64_t)accept_window_ms * 1000;
+    e->guard_cfg.max_hs_inflight = (uint32_t)max_hs_inflight;
+    std::lock_guard<std::mutex> g(e->mu);
+    e->tenants.cap = (size_t)tenant_cap;
+    return 0;
 }
 
 // Install the dst-path feature-hash column/sign for a route (the

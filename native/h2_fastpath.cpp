@@ -50,6 +50,7 @@
 
 #include "h2_core.h"
 #include "scorer.h"
+#include "tenant_guard.h"
 #include "tls_engine.h"
 
 namespace {
@@ -131,6 +132,8 @@ struct FeatureRow {
     // in-data-plane scoring result (scored 1.0 = engine evaluated the
     // native model; 0.0 rows fall back to the JAX tier in Python)
     float score, scored;
+    // tenant hash folded to 24 bits (f32-integer-exact); 0 = no tenant
+    float tenant;
 };
 
 struct PStream;
@@ -158,6 +161,14 @@ struct Engine {
     // sync; score_stats is guarded by mu like the feature buffer
     l5dscore::Slab scorer_slab;
     l5dscore::ScoreStats score_stats;
+    // tenant accounting + per-tenant quotas (guarded by mu); the
+    // extraction mode and guard knobs are installed BEFORE fph2_start
+    // (wrapper-asserted), so the loop thread reads them unlocked
+    l5dtg::TenantTable tenants;
+    l5dtg::QuotaMap quotas;
+    l5dtg::TenantExtract tenant_ex;
+    l5dtg::GuardCfg guard_cfg;
+    l5dtg::GuardStats guard;
 
     // loop-thread-only
     std::unordered_map<int, H2Conn*> conns;
@@ -181,6 +192,9 @@ struct Engine {
     std::vector<PStream*> stream_graveyard;
     std::atomic<uint64_t> accepted{0};
     uint64_t last_sweep_us = 0;
+    // loop-thread-only defense state
+    l5dtg::SourceTable sources;
+    uint32_t hs_inflight = 0;  // accept-leg TLS handshakes in flight
     // feature timestamps are relative to engine creation:
     // float32 seconds-since-boot quantizes to >60ms after
     // ~12 days of uptime, breaking inter-arrival math
@@ -202,6 +216,15 @@ struct H2Conn {
     std::unordered_map<uint32_t, PStream*> streams;  // by this side's id
     uint64_t buffered = 0;   // bytes read from this conn, pending forward
     uint32_t max_seen_id = 0;  // client conns: highest peer stream id
+    // connection-plane defenses (client conns): control-frame flood
+    // window (SETTINGS/PING/RST rapid-reset caps), header-block stall
+    // budget (hb_start: CONTINUATION sequence open since then), and a
+    // preface deadline for fresh conns that never speak
+    uint64_t flood_window_start_us = 0;
+    uint32_t rst_count = 0, ping_count = 0, settings_count = 0;
+    uint64_t hb_start_us = 0;
+    uint64_t preface_deadline_us = 0;
+    bool hs_pending = false;  // counted in Engine::hs_inflight
 
     // upstream-only
     std::string route_key;
@@ -245,6 +268,12 @@ struct PStream {
     uint64_t t_start_us = 0;
     uint64_t req_b = 0, rsp_b = 0;
     int status = 0;
+    // tenant isolation: the stream's tenant hash, whether it holds a
+    // per-tenant inflight slot, and the zero-progress-body budget the
+    // sweep enforces (0 = request already ended / not yet dispatched)
+    uint32_t tenant = 0;
+    bool tenant_counted = false;
+    uint64_t body_progress_us = 0;
 
     // request retention for GOAWAY replay (BufferedStream parity)
     std::vector<Hdr> req_hdrs;
@@ -310,6 +339,16 @@ void tls_account(Engine* e, H2Conn* c, bool failed) {
                               c->tls->sess->is_server, failed);
 }
 
+// A TLS handshake finished (either way): clear its sweep deadline and
+// release its slot in the accept-leg churn-backpressure counter.
+void hs_complete(Engine* e, H2Conn* c) {
+    c->tls->hs_deadline_us = 0;
+    if (c->hs_pending) {
+        c->hs_pending = false;
+        if (e->hs_inflight > 0) e->hs_inflight--;
+    }
+}
+
 bool flush_out(Engine* e, H2Conn* c) {
     if (c->dead) return false;
     if (c->tls != nullptr) {
@@ -323,7 +362,7 @@ bool flush_out(Engine* e, H2Conn* c) {
             return false;
         }
         if (was_hs && c->tls->sess->hs_done) {
-            c->tls->hs_deadline_us = 0;
+            hs_complete(e, c);
             tls_account(e, c, false);
         }
     }
@@ -423,12 +462,15 @@ void drain_dirty(Engine* e) {
 
 void push_feature(Engine* e, uint64_t route_id, uint64_t lat_us, int status,
                   uint64_t req_b, uint64_t rsp_b, float score, int scored,
-                  uint64_t score_ns) {
+                  uint64_t score_ns, uint32_t tenant) {
     std::lock_guard<std::mutex> g(e->mu);
     if (scored)
         e->score_stats.record(score_ns);
     else
         e->score_stats.unscored++;
+    // per-tenant aggregates ride the same mu hold as the feature push
+    if (tenant)
+        e->tenants.observe(tenant, status, score, scored != 0, now_us());
     if (e->features.size() >= e->features_cap) {
         e->features_dropped++;
         return;
@@ -442,6 +484,7 @@ void push_feature(Engine* e, uint64_t route_id, uint64_t lat_us, int status,
     r.ts_s = (float)((double)(now_us() - e->t0_us) / 1e6);
     r.score = score;
     r.scored = scored ? 1.0f : 0.0f;
+    r.tenant = l5dtg::tenant_feature(tenant);
     e->features.push_back(r);
 }
 
@@ -531,6 +574,11 @@ void finish_stream(Engine* e, PStream* st, bool record) {
     bool have_feats = false;
     {
         std::lock_guard<std::mutex> g(e->mu);
+        if (st->tenant_counted) {
+            st->tenant_counted = false;
+            l5dtg::TenantStats* ts = e->tenants.peek(st->tenant);
+            if (ts != nullptr && ts->inflight > 0) ts->inflight--;
+        }
         auto it = e->routes.find(st->route_key);
         if (it != e->routes.end() && it->second.id == st->route_id) {
             if (record) it->second.stats.record(st->status, lat);
@@ -569,7 +617,7 @@ void finish_stream(Engine* e, PStream* st, bool record) {
             }
         }
         push_feature(e, st->route_id, lat, st->status, st->req_b,
-                     st->rsp_b, score, scored, score_ns);
+                     st->rsp_b, score, scored, score_ns, st->tenant);
     }
     if (uc != nullptr && !uc->dead) dispatch_from_queue(e, uc);
 }
@@ -941,6 +989,10 @@ bool replay_stream(Engine* e, PStream* st) {
 void conn_close(Engine* e, H2Conn* c) {
     if (c->dead) return;
     c->dead = true;
+    if (c->hs_pending) {
+        c->hs_pending = false;
+        if (e->hs_inflight > 0) e->hs_inflight--;
+    }
     e->graveyard.push_back(c);
     if (c->fd >= 0) {
         stash_upstream_session(e, c);
@@ -1001,6 +1053,26 @@ void conn_error(Engine* e, H2Conn* c, uint32_t code) {
     h2::write_goaway(wbuf(c), c->max_seen_id, code);
     flush_out(e, c);  // immediate: the conn closes right below
     conn_close(e, c);
+}
+
+// Control-frame flood cap (per client conn per guard window). Returns
+// true while within budget; over budget the conn is killed with
+// ENHANCE_YOUR_CALM (GOAWAY) — the CVE-2023-44487 rapid-reset defense
+// when the counter is the RST one.
+bool flood_ok(Engine* e, H2Conn* c, uint32_t* counter, uint32_t cap,
+              bool rapid_reset) {
+    if (cap == 0) return true;
+    uint64_t now = now_us();
+    if (now - c->flood_window_start_us > e->guard_cfg.flood_window_us) {
+        c->flood_window_start_us = now;
+        c->rst_count = c->ping_count = c->settings_count = 0;
+    }
+    (*counter)++;
+    if (*counter <= cap) return true;
+    (rapid_reset ? e->guard.rapid_reset_closed : e->guard.flood_closed)
+        .fetch_add(1, std::memory_order_relaxed);
+    conn_error(e, c, h2::ENHANCE_YOUR_CALM);
+    return false;
 }
 
 // ---- frame handlers ----
@@ -1101,6 +1173,15 @@ void client_headers_complete(Engine* e, H2Conn* c) {
         return;
     }
     c->max_seen_id = sid;
+    // stream-concurrency cap: we advertised MAX_CONCURRENT_STREAMS in
+    // our SETTINGS; a peer opening beyond the guard cap is refused
+    // (REFUSED_STREAM: retry-safe, nothing was processed)
+    if (e->guard_cfg.max_streams_per_conn != 0 &&
+        c->streams.size() >= e->guard_cfg.max_streams_per_conn) {
+        h2::write_rst(wbuf(c), sid, h2::REFUSED_STREAM);
+        queue_flush(e, c);
+        return;
+    }
     const std::string* auth = find_hdr(hs, ":authority");
     if (auth == nullptr) auth = find_hdr(hs, "host");
     std::string key = auth != nullptr ? *auth : "";
@@ -1117,11 +1198,67 @@ void client_headers_complete(Engine* e, H2Conn* c) {
         synth_response(e, c, sid, 400, "bad authority");
         return;
     }
+    // tenant identity + in-data-plane quota enforcement (h2 names are
+    // lowercase on the wire; sheds are RST_STREAM REFUSED_STREAM —
+    // retry-safe, the stream was never admitted)
+    uint32_t tenant = 0;
+    switch (e->tenant_ex.kind) {
+    case 1: {
+        const std::string* tv = find_hdr(hs, e->tenant_ex.header.c_str());
+        if (tv != nullptr && !tv->empty())
+            tenant = l5dtg::tenant_hash(tv->data(), tv->size());
+        break;
+    }
+    case 2: {
+        const std::string* pv = find_hdr(hs, ":path");
+        if (pv != nullptr)
+            tenant = l5dtg::hash_path_segment(*pv, e->tenant_ex.segment);
+        break;
+    }
+    case 3:
+        if (c->tls != nullptr) {
+            std::string sni = l5dtls::server_sni(c->tls->sess);
+            if (!sni.empty())
+                tenant = l5dtg::tenant_hash(sni.data(), sni.size());
+        }
+        break;
+    default:
+        break;
+    }
+    bool tenant_counted = false;
+    if (tenant) {
+        bool over = false;
+        {
+            std::lock_guard<std::mutex> g(e->mu);
+            l5dtg::TenantStats* ts = e->tenants.get(tenant, now_us());
+            int q = e->quotas.limit_of(tenant);
+            if (q >= 0 && ts->inflight >= q) {
+                ts->shed++;
+                over = true;
+            } else {
+                ts->inflight++;
+                tenant_counted = true;
+            }
+        }
+        if (over) {
+            e->guard.tenant_shed.fetch_add(1, std::memory_order_relaxed);
+            h2::write_rst(wbuf(c), sid, h2::REFUSED_STREAM);
+            queue_flush(e, c);
+            return;
+        }
+    }
     PStream* st = new PStream();
     st->cc = c;
     st->cid = sid;
     st->route_key = key;
+    st->tenant = tenant;
+    st->tenant_counted = tenant_counted;
     st->t_start_us = now_us();
+    // zero-progress-body budget: armed only while the request body is
+    // still open (cleared when END_STREAM is seen)
+    if (!(flags & h2::FLAG_END_STREAM) &&
+        e->guard_cfg.body_stall_budget_us != 0)
+        st->body_progress_us = st->t_start_us;
     st->c_swin = c->s.peer_init_win;
     st->c_recv_win = OUR_STREAM_WIN;  // what our SETTINGS advertised
     st->req_end_seen = (flags & h2::FLAG_END_STREAM) != 0;
@@ -1212,6 +1349,8 @@ void handle_client_frame(Engine* e, H2Conn* c, uint8_t type, uint8_t flags,
             client_headers_complete(e, c);
         } else {
             c->s.in_headers = true;
+            // slowloris: an open CONTINUATION sequence has a budget
+            c->hb_start_us = now_us();
         }
         break;
     }
@@ -1227,6 +1366,7 @@ void handle_client_frame(Engine* e, H2Conn* c, uint8_t type, uint8_t flags,
         }
         if (flags & h2::FLAG_END_HEADERS) {
             c->s.in_headers = false;
+            c->hb_start_us = 0;
             client_headers_complete(e, c);
         }
         break;
@@ -1267,6 +1407,8 @@ void handle_client_frame(Engine* e, H2Conn* c, uint8_t type, uint8_t flags,
         }
         st->c_runacked += len;
         st->req_b += n;
+        if (st->body_progress_us != 0 && n > 0)
+            st->body_progress_us = now_us();
         st->u_pend.append((const char*)(p + off), n);
         c->buffered += n;
         if (st->retain_valid) {
@@ -1280,6 +1422,7 @@ void handle_client_frame(Engine* e, H2Conn* c, uint8_t type, uint8_t flags,
         if (flags & h2::FLAG_END_STREAM) {
             st->req_end_seen = true;
             st->u_pend_end = true;
+            st->body_progress_us = 0;  // body complete: budget disarmed
         }
         if (st->parked && st->u_pend.size() > PARKED_PEND_CAP) {
             h2::write_rst(wbuf(c), sid, h2::ENHANCE_YOUR_CALM);
@@ -1322,10 +1465,16 @@ void handle_client_frame(Engine* e, H2Conn* c, uint8_t type, uint8_t flags,
             conn_error(e, c, h2::FRAME_SIZE_ERROR);
             return;
         }
+        if (!flood_ok(e, c, &c->settings_count,
+                      e->guard_cfg.settings_burst, false))
+            return;
         if (!(flags & h2::FLAG_ACK)) apply_settings(e, c, p, len);
         break;
     case h2::PING:
         if (len != 8) { conn_error(e, c, h2::FRAME_SIZE_ERROR); return; }
+        if (!flood_ok(e, c, &c->ping_count, e->guard_cfg.ping_burst,
+                      false))
+            return;
         if (!(flags & h2::FLAG_ACK)) {
             h2::write_frame(wbuf(c), h2::PING, h2::FLAG_ACK, 0,
                             (const char*)p, 8);
@@ -1334,6 +1483,12 @@ void handle_client_frame(Engine* e, H2Conn* c, uint8_t type, uint8_t flags,
         break;
     case h2::RST_STREAM: {
         if (len < 4) { conn_error(e, c, h2::FRAME_SIZE_ERROR); return; }
+        // rapid-reset cap (CVE-2023-44487): a client opening streams
+        // and immediately cancelling them burns header-decode + routing
+        // + upstream work per stream while keeping its own concurrency
+        // at zero — cap client RSTs per window, then GOAWAY the conn
+        if (!flood_ok(e, c, &c->rst_count, e->guard_cfg.rst_burst, true))
+            return;
         auto it = c->streams.find(sid);
         if (it != c->streams.end()) {
             PStream* st = it->second;
@@ -1578,6 +1733,7 @@ void process_in(Engine* e, H2Conn* c) {
             return;
         }
         c->s.preface_seen = true;
+        c->preface_deadline_us = 0;
         pos = h2::PREFACE_LEN;
     }
     while (!c->dead && c->in.size() - pos >= 9) {
@@ -1630,7 +1786,7 @@ void on_readable(Engine* e, H2Conn* c) {
                 return;
             }
             if (was_hs && c->tls->sess->hs_done) {
-                c->tls->hs_deadline_us = 0;
+                hs_complete(e, c);
                 tls_account(e, c, false);
             }
             queue_flush(e, c);  // handshake records / tickets / staged
@@ -1648,12 +1804,39 @@ void on_readable(Engine* e, H2Conn* c) {
 void on_listener(Engine* e, int lfd) {
     bool tls = e->tls_srv != nullptr && e->tls_listeners.count(lfd) > 0;
     for (;;) {
-        int fd = ::accept4(lfd, nullptr, nullptr, SOCK_NONBLOCK);
+        sockaddr_in peer{};
+        socklen_t plen = sizeof(peer);
+        int fd = ::accept4(lfd, (sockaddr*)&peer, &plen, SOCK_NONBLOCK);
         if (fd < 0) return;
+        uint64_t now = now_us();
+        // per-source accept throttle: churn floods are shed at accept
+        if (peer.sin_family == AF_INET &&
+            !e->sources.allow(peer.sin_addr.s_addr, e->guard_cfg, now)) {
+            e->guard.accept_throttled.fetch_add(
+                1, std::memory_order_relaxed);
+            ::close(fd);
+            continue;
+        }
+        // handshake-churn backpressure: shed new TLS conns while too
+        // many handshakes are in flight (see fastpath.cpp)
+        if (tls && e->guard_cfg.max_hs_inflight != 0 &&
+            e->hs_inflight >= e->guard_cfg.max_hs_inflight) {
+            e->guard.hs_churn_shed.fetch_add(
+                1, std::memory_order_relaxed);
+            ::close(fd);
+            continue;
+        }
         set_nodelay(fd);
         H2Conn* c = new H2Conn();
         c->kind = H2Conn::Kind::CLIENT;
         c->fd = fd;
+        // slowloris: a fresh conn must complete its client preface
+        // within the header budget (TLS conns get the handshake budget
+        // on top — the sweep enforces both independently)
+        if (e->guard_cfg.header_budget_us != 0)
+            c->preface_deadline_us =
+                now + e->guard_cfg.header_budget_us
+                + (tls ? TLS_HS_TIMEOUT_US : 0);
         if (tls) {
             l5dtls::Sess* s = l5dtls::new_session(e->tls_srv, nullptr,
                                                   false, nullptr);
@@ -1664,7 +1847,9 @@ void on_listener(Engine* e, int lfd) {
             }
             c->tls = new l5dtls::TlsIo();
             c->tls->sess = s;
-            c->tls->hs_deadline_us = now_us() + TLS_HS_TIMEOUT_US;
+            c->tls->hs_deadline_us = now + TLS_HS_TIMEOUT_US;
+            c->hs_pending = true;
+            e->hs_inflight++;
         }
         // server preface: SETTINGS + a big connection window (staged as
         // plaintext on TLS conns; write_plain holds it until hs_done)
@@ -1701,6 +1886,58 @@ void sweep(Engine* e) {
     for (H2Conn* c : hs_expired) {
         tls_account(e, c, /*failed=*/true);
         conn_close(e, c);
+    }
+    // slowloris sweeps: (a) fresh conns that never completed the
+    // client preface, (b) conns stalled mid header block
+    // (CONTINUATION started, END_HEADERS never arrived)
+    std::vector<H2Conn*> loris;
+    for (auto& kv : e->conns) {
+        H2Conn* c = kv.second;
+        if (c->kind != H2Conn::Kind::CLIENT || c->dead) continue;
+        if (c->preface_deadline_us != 0 && now > c->preface_deadline_us) {
+            loris.push_back(c);
+        } else if (e->guard_cfg.header_budget_us != 0 &&
+                   c->s.in_headers && c->hb_start_us != 0 &&
+                   now - c->hb_start_us >
+                       e->guard_cfg.header_budget_us) {
+            loris.push_back(c);
+        }
+    }
+    for (H2Conn* c : loris) {
+        e->guard.slowloris_closed.fetch_add(1, std::memory_order_relaxed);
+        conn_close(e, c);
+    }
+    // zero-progress request bodies: RST the stalled stream (both
+    // sides), spare the conn — a trickling uploader must not pin an
+    // upstream stream slot indefinitely
+    if (e->guard_cfg.body_stall_budget_us != 0) {
+        std::vector<PStream*> stalls;
+        for (auto& kv : e->conns) {
+            H2Conn* c = kv.second;
+            if (c->kind != H2Conn::Kind::CLIENT || c->dead) continue;
+            for (auto& skv : c->streams) {
+                PStream* st = skv.second;
+                if (st->body_progress_us != 0 && !st->req_end_seen &&
+                    now - st->body_progress_us >
+                        e->guard_cfg.body_stall_budget_us)
+                    stalls.push_back(st);
+            }
+        }
+        for (PStream* st : stalls) {
+            if (st->closed) continue;
+            e->guard.body_stall_closed.fetch_add(
+                1, std::memory_order_relaxed);
+            if (st->cc != nullptr && !st->cc->dead) {
+                h2::write_rst(wbuf(st->cc), st->cid,
+                              h2::ENHANCE_YOUR_CALM);
+                queue_flush(e, st->cc);
+            }
+            if (st->uc != nullptr && st->uid && !st->uc->dead) {
+                h2::write_rst(wbuf(st->uc), st->uid, h2::CANCEL);
+                queue_flush(e, st->uc);
+            }
+            finish_stream(e, st, false);
+        }
     }
     std::vector<PStream*> expired;
     for (auto& kv : e->parked)
@@ -2106,6 +2343,10 @@ long fph2_stats_json(void* ep, char* buf, size_t cap) {
              e->tls_srv != nullptr ? "true" : "false",
              e->tls_cli != nullptr ? "true" : "false");
     s += tail;
+    l5dtg::tenants_json(e->tenants, e->quotas, &s);
+    s += ",";
+    l5dtg::guard_json(e->guard, &s);
+    s += ",";
     l5dscore::stats_json(e->scorer_slab, e->score_stats, &s);
     s += "}";
     if (s.size() + 1 > cap) return -2;
@@ -2120,7 +2361,7 @@ long fph2_drain_features(void* ep, float* buf, long cap_rows) {
     long n = (long)e->features.size();
     if (n > cap_rows) n = cap_rows;
     for (long i = 0; i < n; i++)
-        memcpy(buf + i * 8, &e->features[(size_t)i], sizeof(FeatureRow));
+        memcpy(buf + i * 9, &e->features[(size_t)i], sizeof(FeatureRow));
     e->features.erase(e->features.begin(), e->features.begin() + n);
     return n;
 }
@@ -2152,6 +2393,58 @@ int fph2_publish_weights(void* ep, const uint8_t* blob, size_t len,
         return -1;
     }
     l5dscore::slab_install(&e->scorer_slab, std::move(m));
+    return 0;
+}
+
+// Tenant extraction / quotas / guard knobs: the h2 engine's identical
+// control surface (see fp_set_tenant / fp_set_tenant_quota /
+// fp_set_guard in fastpath.cpp for the contract).
+int fph2_set_tenant(void* ep, int kind, const char* header, int segment) {
+    Engine* e = (Engine*)ep;
+    if (kind < 0 || kind > 3) return -1;
+    e->tenant_ex.kind = kind;
+    e->tenant_ex.header = header != nullptr ? header : "";
+    lower(e->tenant_ex.header);
+    e->tenant_ex.segment = segment;
+    return 0;
+}
+
+int fph2_set_tenant_quota(void* ep, unsigned int hash, int limit) {
+    Engine* e = (Engine*)ep;
+    std::lock_guard<std::mutex> g(e->mu);
+    return e->quotas.set(hash, limit);
+}
+
+int fph2_set_guard(void* ep, long header_budget_ms, long body_stall_ms,
+                   long accept_burst, long accept_window_ms,
+                   long max_hs_inflight, long tenant_cap) {
+    Engine* e = (Engine*)ep;
+    if (header_budget_ms < 0 || body_stall_ms < 0 || accept_burst < 0 ||
+        accept_window_ms < 1 || max_hs_inflight < 0 || tenant_cap < 1)
+        return -1;
+    e->guard_cfg.header_budget_us = (uint64_t)header_budget_ms * 1000;
+    e->guard_cfg.body_stall_budget_us = (uint64_t)body_stall_ms * 1000;
+    e->guard_cfg.accept_burst = (uint32_t)accept_burst;
+    e->guard_cfg.accept_window_us = (uint64_t)accept_window_ms * 1000;
+    e->guard_cfg.max_hs_inflight = (uint32_t)max_hs_inflight;
+    std::lock_guard<std::mutex> g(e->mu);
+    e->tenants.cap = (size_t)tenant_cap;
+    return 0;
+}
+
+// h2-only flood caps (per client conn per window); 0 disables one cap.
+int fph2_set_flood_guard(void* ep, long max_streams, long rst_burst,
+                         long ping_burst, long settings_burst,
+                         long window_ms) {
+    Engine* e = (Engine*)ep;
+    if (max_streams < 0 || rst_burst < 0 || ping_burst < 0 ||
+        settings_burst < 0 || window_ms < 1)
+        return -1;
+    e->guard_cfg.max_streams_per_conn = (uint32_t)max_streams;
+    e->guard_cfg.rst_burst = (uint32_t)rst_burst;
+    e->guard_cfg.ping_burst = (uint32_t)ping_burst;
+    e->guard_cfg.settings_burst = (uint32_t)settings_burst;
+    e->guard_cfg.flood_window_us = (uint64_t)window_ms * 1000;
     return 0;
 }
 

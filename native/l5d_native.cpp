@@ -16,6 +16,7 @@
 
 #include "huffman_table.h"  // generated from hpack.py: HUFF_CODES/HUFF_BITS
 #include "scorer.h"         // in-data-plane anomaly scorer (l5dscore::)
+#include "tenant_guard.h"   // tenant hashing (l5dtg::)
 
 namespace {
 
@@ -210,6 +211,15 @@ long l5d_parse_http1_head(const char* buf, size_t len,
     return (long)n;
 }
 
+// ---- tenant identity -------------------------------------------------------
+
+// FNV-1a 32-bit tenant hash — the exact function both engines apply to
+// extracted tenant ids (parity surface for
+// linkerd_tpu.router.tenancy.tenant_hash; pinned by the parity test).
+unsigned int l5d_tenant_hash(const char* s, size_t n) {
+    return l5dtg::tenant_hash(s, n);
+}
+
 // ---- in-data-plane scorer: engine-independent eval + slab handles ----------
 // The engines embed their own slabs (fp_publish_weights /
 // fph2_publish_weights); these entry points exist for the parity tests,
@@ -255,7 +265,7 @@ long l5d_score_eval(const uint8_t* blob, size_t len, const float* x,
     return n;
 }
 
-// Score n RAW engine rows ([n, 8] f32 FeatureRow layout; only columns
+// Score n RAW engine rows ([n, 9] f32 FeatureRow layout; only columns
 // 1..4 are read) through the in-engine featurizer: per-row dst-hash
 // (cols/signs) and pre-update drift come from the caller, so tests can
 // drive the exact per-route state the engines hold. feat_out (nullable,
@@ -273,7 +283,7 @@ long l5d_score_eval_raw(const uint8_t* blob, size_t len,
     }
     float feats[l5dscore::FEATURE_DIM];
     for (long i = 0; i < n; i++) {
-        const float* r = rows + (size_t)i * 8;
+        const float* r = rows + (size_t)i * 9;
         l5dscore::featurize(r[1], (int)r[2], r[3], r[4], cols[i],
                             signs[i], drifts[i], feats);
         if (feat_out != nullptr)

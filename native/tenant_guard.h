@@ -1,0 +1,333 @@
+// tenant_guard.h — tenant accounting + connection-plane defenses for
+// the native engines.
+//
+// Both epoll engines (fastpath.cpp, h2_fastpath.cpp) embed the same
+// two pieces:
+//
+// - TenantTable: per-tenant request/shed/error/score-EWMA aggregates
+//   keyed by a 32-bit FNV-1a hash of the extracted tenant id. The table
+//   is bounded-cardinality with amortized-LRU eviction, so hostile
+//   tenant-id churn (a new id per request) costs eviction work, never
+//   unbounded memory. Quotas live in a separate, pusher-bounded map so
+//   a sick tenant's quota survives stats eviction.
+//
+// - Guard: connection-plane defense state — per-source accept
+//   throttling (SourceTable), slowloris budgets (header-read and
+//   zero-progress-body, enforced by the engines' sweeps), TLS
+//   handshake-churn backpressure, and (h2) SETTINGS/PING/RST flood +
+//   rapid-reset caps. All knobs arrive from Python before start();
+//   counters are atomics (loop thread writes, stats readers read).
+//
+// The isolation DECISION is evaluated where the score is computed: the
+// engine sheds an over-quota tenant's request itself (503 +
+// l5d-retryable on h1, RST_STREAM REFUSED_STREAM on h2 — retry-safe by
+// contract, the request was never admitted), per the Taurus/INSIGHT
+// in-network-policy argument (PAPERS.md).
+
+#pragma once
+
+#include <stdint.h>
+#include <string.h>
+
+#include <algorithm>
+#include <atomic>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace l5dtg {
+
+// FNV-1a 32-bit over the raw tenant-id bytes; mirrored bit-identically
+// by linkerd_tpu.router.tenancy.tenant_hash (pinned by the parity
+// test). 0 is reserved for "no tenant" — a real id hashing to 0 is
+// folded to 1 so absence stays unambiguous.
+inline uint32_t tenant_hash(const char* s, size_t n) {
+    uint32_t h = 2166136261u;
+    for (size_t i = 0; i < n; i++) {
+        h ^= (uint8_t)s[i];
+        h *= 16777619u;
+    }
+    return h == 0 ? 1u : h;
+}
+
+// Feature rows carry the hash folded to 24 bits so the value stays
+// exact in float32 (2^24 is f32's integer-exact ceiling).
+inline float tenant_feature(uint32_t h) {
+    return (float)(h & 0xFFFFFFu);
+}
+
+struct TenantStats {
+    uint64_t requests = 0;
+    uint64_t shed = 0;       // refused by the per-tenant quota
+    uint64_t errors = 0;     // 5xx outcomes
+    uint64_t scored = 0;     // rows the in-plane scorer evaluated
+    double score_ewma = 0.0; // EWMA of in-plane anomaly scores
+    int inflight = 0;        // live exchanges/streams
+    uint64_t last_seen_us = 0;
+};
+
+// Bounded-cardinality tenant aggregates. Callers hold the engine mu.
+// When the table overflows its cap, the oldest ~quarter (by last_seen)
+// is evicted in one pass — amortized O(1) per insert, so an attacker
+// minting a fresh tenant id per request buys eviction churn, not
+// memory. Entries with live inflight are never evicted (their
+// decrement must find them).
+struct TenantTable {
+    std::unordered_map<uint32_t, TenantStats> map;
+    size_t cap = 1024;
+    uint64_t evicted = 0;
+
+    TenantStats* get(uint32_t h, uint64_t now_us) {
+        auto it = map.find(h);
+        if (it != map.end()) {
+            it->second.last_seen_us = now_us;
+            return &it->second;
+        }
+        if (map.size() >= cap) evict(now_us);
+        TenantStats& ts = map[h];
+        ts.last_seen_us = now_us;
+        return &ts;
+    }
+
+    // Look up without inserting (inflight decrements on finish paths).
+    TenantStats* peek(uint32_t h) {
+        auto it = map.find(h);
+        return it == map.end() ? nullptr : &it->second;
+    }
+
+    void observe(uint32_t h, int status, float score, bool scored,
+                 uint64_t now_us) {
+        TenantStats* ts = get(h, now_us);
+        ts->requests++;
+        if (status >= 500) ts->errors++;
+        if (scored) {
+            ts->scored++;
+            ts->score_ewma += 0.1 * ((double)score - ts->score_ewma);
+        }
+    }
+
+    void evict(uint64_t now_us) {
+        // drop the stalest quarter in one pass (skip live entries)
+        std::vector<std::pair<uint64_t, uint32_t>> ages;
+        ages.reserve(map.size());
+        for (auto& kv : map)
+            if (kv.second.inflight <= 0)
+                ages.push_back({kv.second.last_seen_us, kv.first});
+        if (ages.empty()) return;
+        size_t k = ages.size() / 4;
+        if (k == 0) k = 1;
+        std::nth_element(ages.begin(), ages.begin() + (long)(k - 1),
+                         ages.end());
+        uint64_t cutoff = ages[k - 1].first;
+        size_t dropped = 0;
+        for (auto it = map.begin(); it != map.end() && dropped < k;) {
+            if (it->second.inflight <= 0 &&
+                it->second.last_seen_us <= cutoff) {
+                it = map.erase(it);
+                dropped++;
+            } else {
+                ++it;
+            }
+        }
+        evicted += dropped;
+        (void)now_us;
+    }
+};
+
+// Per-tenant concurrency quotas pushed from the control plane (the
+// TenantAdmission governor). Separate from the stats LRU: quotas are
+// few (one per SICK tenant) and must survive stats eviction. Bounded
+// by refusing pushes past cap — the pusher clamps long before that.
+struct QuotaMap {
+    std::unordered_map<uint32_t, int> map;
+    size_t cap = 4096;
+
+    // limit < 0 clears. Returns 0, or -1 when full.
+    int set(uint32_t h, int limit) {
+        if (limit < 0) {
+            map.erase(h);
+            return 0;
+        }
+        if (map.find(h) == map.end() && map.size() >= cap) return -1;
+        map[h] = limit;
+        return 0;
+    }
+
+    // -1 = no quota for this tenant
+    int limit_of(uint32_t h) const {
+        auto it = map.find(h);
+        return it == map.end() ? -1 : it->second;
+    }
+};
+
+// ---- connection-plane guard ------------------------------------------------
+
+struct GuardCfg {
+    // slowloris: a fresh conn (or a conn with a partial request head)
+    // must complete its head within this budget; 0 disables.
+    uint64_t header_budget_us = 10'000'000;
+    // zero-progress body: a request body that advances no bytes for
+    // this long is a stalled attacker; 0 disables.
+    uint64_t body_stall_budget_us = 30'000'000;
+    // per-source accept throttle: more than `accept_burst` accepts from
+    // one source ip within `accept_window_us` are closed on arrival;
+    // 0 disables.
+    uint32_t accept_burst = 0;
+    uint64_t accept_window_us = 1'000'000;
+    // handshake-churn backpressure: new TLS conns are shed while this
+    // many handshakes are already in flight (the resumption cache must
+    // not thrash); 0 disables.
+    uint32_t max_hs_inflight = 0;
+    // h2 flood caps (per client conn per flood_window_us); 0 disables
+    // the individual cap.
+    uint32_t max_streams_per_conn = 512;
+    uint32_t rst_burst = 200;      // CVE-2023-44487 rapid reset
+    uint32_t ping_burst = 256;
+    uint32_t settings_burst = 64;
+    uint64_t flood_window_us = 1'000'000;
+};
+
+struct GuardStats {
+    std::atomic<uint64_t> slowloris_closed{0};
+    std::atomic<uint64_t> body_stall_closed{0};
+    std::atomic<uint64_t> accept_throttled{0};
+    std::atomic<uint64_t> hs_churn_shed{0};
+    std::atomic<uint64_t> rapid_reset_closed{0};
+    std::atomic<uint64_t> flood_closed{0};
+    std::atomic<uint64_t> tenant_shed{0};  // quota refusals, all tenants
+};
+
+// Per-source accept-rate tracking (loop thread only). Bounded the same
+// amortized way as TenantTable: source-ip churn cannot grow it.
+struct SourceTable {
+    struct Slot {
+        uint64_t window_start_us = 0;
+        uint32_t count = 0;
+    };
+    std::unordered_map<uint32_t, Slot> map;
+    size_t cap = 4096;
+
+    // True when this accept is within budget.
+    bool allow(uint32_t ip_be, const GuardCfg& cfg, uint64_t now_us) {
+        if (cfg.accept_burst == 0) return true;
+        if (map.size() >= cap && map.find(ip_be) == map.end()) {
+            // stalest-quarter eviction keyed by window start
+            std::vector<std::pair<uint64_t, uint32_t>> ages;
+            ages.reserve(map.size());
+            for (auto& kv : map)
+                ages.push_back({kv.second.window_start_us, kv.first});
+            size_t k = ages.size() / 4;
+            if (k == 0) k = 1;
+            std::nth_element(ages.begin(), ages.begin() + (long)(k - 1),
+                             ages.end());
+            uint64_t cutoff = ages[k - 1].first;
+            size_t dropped = 0;
+            for (auto it = map.begin(); it != map.end() && dropped < k;) {
+                if (it->second.window_start_us <= cutoff) {
+                    it = map.erase(it);
+                    dropped++;
+                } else {
+                    ++it;
+                }
+            }
+        }
+        Slot& s = map[ip_be];
+        if (now_us - s.window_start_us > cfg.accept_window_us) {
+            s.window_start_us = now_us;
+            s.count = 0;
+        }
+        s.count++;
+        return s.count <= cfg.accept_burst;
+    }
+};
+
+// ---- stats JSON ------------------------------------------------------------
+
+// Append `"tenants":{...}` (caller holds the engine mu for the table).
+inline void tenants_json(const TenantTable& t, const QuotaMap& q,
+                         std::string* s) {
+    char tmp[320];
+    snprintf(tmp, sizeof(tmp),
+             "\"tenants\":{\"count\":%zu,\"evicted\":%llu,\"by_tenant\":{",
+             t.map.size(), (unsigned long long)t.evicted);
+    *s += tmp;
+    bool first = true;
+    for (auto& kv : t.map) {
+        snprintf(tmp, sizeof(tmp),
+                 "%s\"%u\":{\"requests\":%llu,\"shed\":%llu,"
+                 "\"errors\":%llu,\"scored\":%llu,\"score_ewma\":%.6f,"
+                 "\"inflight\":%d,\"quota\":%d}",
+                 first ? "" : ",", kv.first,
+                 (unsigned long long)kv.second.requests,
+                 (unsigned long long)kv.second.shed,
+                 (unsigned long long)kv.second.errors,
+                 (unsigned long long)kv.second.scored,
+                 kv.second.score_ewma, kv.second.inflight,
+                 q.limit_of(kv.first));
+        *s += tmp;
+        first = false;
+    }
+    *s += "}}";
+}
+
+// Append `"guard":{...}`.
+inline void guard_json(const GuardStats& g, std::string* s) {
+    char tmp[448];
+    snprintf(tmp, sizeof(tmp),
+             "\"guard\":{\"slowloris_closed\":%llu,"
+             "\"body_stall_closed\":%llu,\"accept_throttled\":%llu,"
+             "\"hs_churn_shed\":%llu,\"rapid_reset_closed\":%llu,"
+             "\"flood_closed\":%llu,\"tenant_shed\":%llu}",
+             (unsigned long long)g.slowloris_closed.load(
+                 std::memory_order_relaxed),
+             (unsigned long long)g.body_stall_closed.load(
+                 std::memory_order_relaxed),
+             (unsigned long long)g.accept_throttled.load(
+                 std::memory_order_relaxed),
+             (unsigned long long)g.hs_churn_shed.load(
+                 std::memory_order_relaxed),
+             (unsigned long long)g.rapid_reset_closed.load(
+                 std::memory_order_relaxed),
+             (unsigned long long)g.flood_closed.load(
+                 std::memory_order_relaxed),
+             (unsigned long long)g.tenant_shed.load(
+                 std::memory_order_relaxed));
+    *s += tmp;
+}
+
+// ---- tenant extraction -----------------------------------------------------
+
+// Extraction mode, pushed from Python before start(). kind: 0 = off,
+// 1 = header (name in `header`, lowercase), 2 = path segment
+// (`segment`th slash-separated element of the request path), 3 = SNI
+// (TLS server name; TLS listeners only).
+struct TenantExtract {
+    int kind = 0;
+    std::string header;
+    int segment = 0;
+};
+
+// Path-segment extraction: "/a/b/c" segment 0 -> "a". Query strings are
+// cut first. Empty result -> no tenant.
+inline uint32_t hash_path_segment(const std::string& path, int segment) {
+    size_t end = path.find('?');
+    if (end == std::string::npos) end = path.size();
+    size_t pos = 0;
+    int idx = -1;
+    while (pos < end) {
+        if (path[pos] == '/') {
+            pos++;
+            continue;
+        }
+        size_t seg_end = pos;
+        while (seg_end < end && path[seg_end] != '/') seg_end++;
+        idx++;
+        if (idx == segment) {
+            return tenant_hash(path.data() + pos, seg_end - pos);
+        }
+        pos = seg_end;
+    }
+    return 0;
+}
+
+}  // namespace l5dtg

@@ -108,6 +108,9 @@ struct Api {
                                        size_t);
     int (*SSL_shutdown)(SSL*);
     void (*SSL_set_shutdown)(SSL*, int);
+    // optional (present in 1.1 and 3.x): server-side SNI retrieval for
+    // tenant extraction; nullptr when the runtime lacks it
+    const char* (*SSL_get_servername)(const SSL*, int);
     BIO* (*BIO_new)(const BIO_METHOD*);
     const BIO_METHOD* (*BIO_s_mem)();
     int (*BIO_write)(BIO*, const void*, int);
@@ -183,6 +186,9 @@ inline Api& api() {
         L5D_SYM(X509_VERIFY_PARAM_set1_host);
         L5D_SYM(SSL_shutdown);
         L5D_SYM(SSL_set_shutdown);
+        // optional: load without failing the slice when absent
+        a.SSL_get_servername = (decltype(a.SSL_get_servername))
+            dlsym(a.h_ssl, "SSL_get_servername");
         L5D_SYM(BIO_new);
         L5D_SYM(BIO_s_mem);
         L5D_SYM(BIO_write);
@@ -534,6 +540,18 @@ inline long write_plain(Sess* s, const char* data, size_t n,
 
 inline bool resumed(Sess* s) {
     return s->ssl != nullptr && api().SSL_session_reused(s->ssl) == 1;
+}
+
+// Server-side SNI the client sent (TLSEXT_NAMETYPE_host_name = 0), or
+// "" when none / the runtime lacks SSL_get_servername. Valid once the
+// ClientHello has been processed (post-handshake is always safe).
+inline std::string server_sni(Sess* s) {
+    Api& a = api();
+    if (s == nullptr || s->ssl == nullptr ||
+        a.SSL_get_servername == nullptr)
+        return "";
+    const char* name = a.SSL_get_servername(s->ssl, 0);
+    return name != nullptr ? std::string(name) : "";
 }
 
 // Client-side resumption: take a ref on the current session (caller

@@ -19,7 +19,8 @@
 #include <atomic>
 #include <pthread.h>
 
-#include "scorer.h"  // build_test_blob: the scoring leg's weight source
+#include "scorer.h"        // build_test_blob: the scoring leg's weight source
+#include "tenant_guard.h"  // tenant_hash: the quota-push leg's key
 
 extern "C" {
 void* fph2_create();
@@ -40,6 +41,14 @@ int fph2_set_client_tls(void* e, const char* alpn, int verify,
 int fph2_publish_weights(void* e, const unsigned char* blob, size_t len,
                          char* err, size_t errcap);
 int fph2_set_route_feature(void* e, const char* host, int col, float sign);
+int fph2_set_tenant(void* e, int kind, const char* header, int segment);
+int fph2_set_tenant_quota(void* e, unsigned int hash, int limit);
+int fph2_set_guard(void* e, long header_budget_ms, long body_stall_ms,
+                   long accept_burst, long accept_window_ms,
+                   long max_hs_inflight, long tenant_cap);
+int fph2_set_flood_guard(void* e, long max_streams, long rst_burst,
+                         long ping_burst, long settings_burst,
+                         long window_ms);
 }
 
 namespace {
@@ -80,7 +89,7 @@ void* churn_main(void* arg) {
     snprintf(ep, sizeof(ep), "127.0.0.1:%d ", a->serve_port);
     char* stats = new char[1 << 20];
     char* misses = new char[64 * 1024];
-    float* feats = new float[4096 * 8];  // FeatureRow is 8 floats wide
+    float* feats = new float[4096 * 9];  // FeatureRow is 9 floats wide
     std::vector<uint8_t> blob;
     char err[256];
     int i = 0;
@@ -103,17 +112,65 @@ void* churn_main(void* arg) {
             fph2_set_route(a->engine, "ghost", "127.0.0.1:1 ");
             fph2_remove_route(a->engine, "ghost");
         }
+        // per-tenant quota push/clear races the data plane's quota
+        // reads in client_headers_complete
+        fph2_set_tenant_quota(a->engine,
+                              l5dtg::tenant_hash("echoext", 7),
+                              i % 2 ? 1024 : -1);
         fph2_stats_json(a->engine, stats, 1 << 20);
         fph2_drain_misses(a->engine, misses, 64 * 1024);
         long n = fph2_drain_features(a->engine, feats, 4096);
         for (long r = 0; r < n; r++)
-            if (feats[r * 8 + 7] > 0.5f) a->scored.fetch_add(1);
+            if (feats[r * 9 + 7] > 0.5f) a->scored.fetch_add(1);
         usleep(500);
         i++;
     }
     delete[] stats;
     delete[] misses;
     delete[] feats;
+    return nullptr;
+}
+
+std::atomic<int> g_attack_stop{0};
+
+// Slowloris: connect, send a PARTIAL client preface, stall until the
+// engine's preface budget reaps us.
+void* h2_slowloris_main(void* arg) {
+    int port = *(int*)arg;
+    while (!g_attack_stop.load(std::memory_order_relaxed)) {
+        int fd = socket(AF_INET, SOCK_STREAM, 0);
+        sockaddr_in addr{};
+        addr.sin_family = AF_INET;
+        addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+        addr.sin_port = htons((uint16_t)port);
+        if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+            close(fd);
+            usleep(2000);
+            continue;
+        }
+        (void)write(fd, "PRI * HTTP/2.0\r\n", 16);  // half a preface
+        char buf[256];
+        struct timeval tv{2, 0};
+        setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+        while (read(fd, buf, sizeof(buf)) > 0) {}
+        close(fd);
+    }
+    return nullptr;
+}
+
+// Connection churn: connect + close at rate.
+void* h2_churn_main(void* arg) {
+    int port = *(int*)arg;
+    while (!g_attack_stop.load(std::memory_order_relaxed)) {
+        int fd = socket(AF_INET, SOCK_STREAM, 0);
+        sockaddr_in addr{};
+        addr.sin_family = AF_INET;
+        addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+        addr.sin_port = htons((uint16_t)port);
+        if (connect(fd, (sockaddr*)&addr, sizeof(addr)) == 0) close(fd);
+        else close(fd);
+        usleep(200);
+    }
     return nullptr;
 }
 
@@ -177,6 +234,18 @@ int main() {
         fprintf(stderr, "h2 stress: TLS leg skipped (%s)\n",
                 cert && key ? "no OpenSSL runtime" : "no cert in env");
     }
+    // tenant + guard legs: path-segment extraction (h2bench's :path
+    // feeds the tenant table without touching the load generator),
+    // tight preface budget for the slowloris thread, generous accept
+    // throttle, small tenant LRU, and flood caps high enough that the
+    // legit load never trips them
+    fph2_set_tenant(eng, 2, nullptr, 0);
+    fph2_set_guard(eng, /*header_ms=*/400, /*body_ms=*/400,
+                   /*accept_burst=*/100000, /*accept_window_ms=*/1000,
+                   /*max_hs_inflight=*/64, /*tenant_cap=*/16);
+    fph2_set_flood_guard(eng, /*max_streams=*/512, /*rst=*/100000,
+                         /*ping=*/100000, /*settings=*/100000,
+                         /*window_ms=*/1000);
     fph2_start(eng);
 
     ChurnArgs ca;
@@ -188,6 +257,11 @@ int main() {
     fph2_set_route(eng, "echoext", ep);
     pthread_t churn_t;
     pthread_create(&churn_t, nullptr, churn_main, &ca);
+
+    pthread_t loris_t, churnflood_t;
+    int attack_port = lport;
+    pthread_create(&loris_t, nullptr, h2_slowloris_main, &attack_port);
+    pthread_create(&churnflood_t, nullptr, h2_churn_main, &attack_port);
 
     int nload = tls_leg ? 3 : 2;
     LoadArgs la[3];
@@ -204,6 +278,9 @@ int main() {
         if (tls_leg && i == nload - 1) tls_total = la[i].done;
     }
 
+    g_attack_stop.store(1);
+    pthread_join(loris_t, nullptr);
+    pthread_join(churnflood_t, nullptr);
     ca.stop.store(1);
     pthread_join(churn_t, nullptr);
     if (front != nullptr) fph2_shutdown(front);

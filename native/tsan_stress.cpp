@@ -21,7 +21,8 @@
 #include <thread>
 #include <vector>
 
-#include "scorer.h"  // build_test_blob: the scoring leg's weight source
+#include "scorer.h"        // build_test_blob: the scoring leg's weight source
+#include "tenant_guard.h"  // tenant_hash: the quota-push leg's key
 
 extern "C" {
 void* fp_create();
@@ -42,6 +43,11 @@ int fp_set_client_tls(void* ep, const char* alpn, int verify,
 int fp_publish_weights(void* ep, const unsigned char* blob, size_t len,
                        char* err, size_t errcap);
 int fp_set_route_feature(void* ep, const char* host, int col, float sign);
+int fp_set_tenant(void* ep, int kind, const char* header, int segment);
+int fp_set_tenant_quota(void* ep, unsigned int hash, int limit);
+int fp_set_guard(void* ep, long header_budget_ms, long body_stall_ms,
+                 long accept_burst, long accept_window_ms,
+                 long max_hs_inflight, long tenant_cap);
 }
 
 namespace {
@@ -111,10 +117,15 @@ void client_loop(int proxy_port, int idx, std::atomic<long>* counter) {
             usleep(1000);
             continue;
         }
-        char req[128];
+        static std::atomic<long> conn_seq{0};
+        long seq = conn_seq.fetch_add(1);
+        char req[160];
+        // rotating tenant ids churn the engine's bounded tenant LRU
+        // while stats/features drain concurrently
         int rn = snprintf(req, sizeof(req),
-                          "GET / HTTP/1.1\r\nHost: svc-%d\r\n\r\n",
-                          idx % 4);
+                          "GET / HTTP/1.1\r\nHost: svc-%d\r\n"
+                          "l5d-tenant: t-%ld\r\n\r\n",
+                          idx % 4, seq % 37);
         char buf[2048];
         for (int i = 0; i < 50 && !stop.load(); i++) {
             if (write(fd, req, rn) < 0) { errors.fetch_add(1); break; }
@@ -123,6 +134,48 @@ void client_loop(int proxy_port, int idx, std::atomic<long>* counter) {
             counter->fetch_add(1);
         }
         close(fd);
+    }
+}
+
+// Slowloris attacker: partial request heads, then stall until the
+// engine's header budget closes us (the sweep leg under fire).
+void slowloris_loop(int proxy_port) {
+    while (!stop.load()) {
+        int fd = socket(AF_INET, SOCK_STREAM, 0);
+        sockaddr_in addr{};
+        addr.sin_family = AF_INET;
+        addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+        addr.sin_port = htons(proxy_port);
+        if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+            close(fd);
+            usleep(2000);
+            continue;
+        }
+        const char partial[] = "GET / HTTP/1.1\r\nHost: sv";
+        (void)write(fd, partial, sizeof(partial) - 1);
+        // wait for the engine to close us (or give up after 2s)
+        char buf[256];
+        struct timeval tv{2, 0};
+        setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+        while (read(fd, buf, sizeof(buf)) > 0) {}
+        close(fd);
+    }
+}
+
+// Connection-churn attacker: connect + immediately close, at rate —
+// the accept-throttle and fresh-conn bookkeeping under fire.
+void churn_flood_loop(int proxy_port) {
+    while (!stop.load()) {
+        int fd = socket(AF_INET, SOCK_STREAM, 0);
+        sockaddr_in addr{};
+        addr.sin_family = AF_INET;
+        addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+        addr.sin_port = htons(proxy_port);
+        if (connect(fd, (sockaddr*)&addr, sizeof(addr)) == 0)
+            close(fd);
+        else
+            close(fd);
+        usleep(200);
     }
 }
 
@@ -171,6 +224,15 @@ int main() {
         fprintf(stderr, "tsan_stress: TLS leg skipped (%s)\n",
                 cert && key ? "no OpenSSL runtime" : "no cert in env");
     }
+    // tenant + guard legs: header extraction on, tight slowloris
+    // budgets (the sweep must reap the attacker threads below), a
+    // generous accept throttle (the legit clients must keep flowing),
+    // and a small tenant LRU so the rotating-tenant clients force
+    // evictions under concurrent stats/feature drains
+    fp_set_tenant(ep, 1, "l5d-tenant", 0);
+    fp_set_guard(ep, /*header_ms=*/400, /*body_ms=*/400,
+                 /*accept_burst=*/100000, /*accept_window_ms=*/1000,
+                 /*max_hs_inflight=*/64, /*tenant_cap=*/16);
     if (fp_start(ep) != 0) { fprintf(stderr, "fp_start failed\n"); return 2; }
 
     char endpoints[64];
@@ -209,6 +271,10 @@ int main() {
             fp_set_route(ep, "svc-3", endpoints);
             fp_set_route_feature(ep, "svc-3", 17,
                                  gen % 2 ? -1.0f : 1.0f);
+            // per-tenant quota push/clear races the data plane's
+            // quota reads (the TenantAdmission actuation path)
+            unsigned int th = l5dtg::tenant_hash("t-3", 3);
+            fp_set_tenant_quota(ep, th, gen % 2 ? 1 : -1);
             gen++;
             usleep(1500);
         }
@@ -240,7 +306,7 @@ int main() {
             fp_stats_json(ep, buf.data(), buf.size());
             long n = fp_drain_features(ep, feats.data(), 1024);
             for (long r = 0; r < n; r++)
-                if (feats[r * 8 + 7] > 0.5f) scored_rows.fetch_add(1);
+                if (feats[r * 9 + 7] > 0.5f) scored_rows.fetch_add(1);
             if (front != nullptr) {
                 fp_drain_misses(front, buf.data(), buf.size());
                 fp_stats_json(front, buf.data(), buf.size());
@@ -253,6 +319,8 @@ int main() {
     std::vector<std::thread> clients;
     for (int i = 0; i < 4; i++)
         clients.emplace_back(client_loop, proxy_port, i, &responses);
+    clients.emplace_back(slowloris_loop, proxy_port);
+    clients.emplace_back(churn_flood_loop, proxy_port);
     if (tls_leg)  // the TLS chain: front (originate) -> ep (terminate)
         for (int i = 0; i < 2; i++)
             clients.emplace_back(client_loop, front_port, i,

@@ -293,6 +293,67 @@ routers:
         assert rules_of(got, "tls-missing-cert") == []
 
 
+class TestTenantConfigRules:
+    def test_bad_extraction_kind_fires(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  tenantIdentifier: {kind: cookie}\n"))
+        (f,) = rules_of(check_text(cfg), "tenant-config")
+        assert "kind" in f.message
+
+    def test_quotas_without_identifier_warn(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  admissionControl: {maxConcurrency: 64}\n"
+            "  tenants: {floor: 0.1}\n"))
+        (f,) = rules_of(check_text(cfg), "tenant-config")
+        assert f.severity == "warning"
+        assert "without a tenantIdentifier" in f.message
+
+    def test_floor_covering_whole_gate_fires(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  tenantIdentifier: {kind: header}\n"
+            "  admissionControl: {maxConcurrency: 2}\n"
+            "  tenants: {floor: 0.9}\n"))
+        (f,) = rules_of(check_text(cfg), "tenant-config")
+        assert "isolates nothing" in f.message
+
+    def test_quotas_without_admission_on_python_path_warn(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  tenantIdentifier: {kind: header}\n"
+            "  tenants: {floor: 0.1}\n"))
+        (f,) = rules_of(check_text(cfg), "tenant-config")
+        assert f.severity == "warning"
+        assert "admissionControl" in f.message
+
+    def test_sni_without_tls_server_fires(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  tenantIdentifier: {kind: sni}\n"
+            "  admissionControl: {maxConcurrency: 64}\n"
+            "  tenants: {floor: 0.1}\n"))
+        (f,) = rules_of(check_text(cfg), "tenant-config")
+        assert "TLS server" in f.message
+
+    def test_connection_guard_without_fastpath_fires(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  connectionGuard: {headerBudgetMs: 5000}\n"))
+        (f,) = rules_of(check_text(cfg), "tenant-config")
+        assert "fastPath" in f.message
+
+    def test_bad_tenants_thresholds_fire(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  tenantIdentifier: {kind: header}\n"
+            "  admissionControl: {maxConcurrency: 64}\n"
+            "  tenants: {enterThreshold: 0.2, exitThreshold: 0.5}\n"))
+        (f,) = rules_of(check_text(cfg), "tenant-config")
+        assert "exitThreshold" in f.message
+
+    def test_healthy_tenant_block_is_clean(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  tenantIdentifier: {kind: header, header: l5d-tenant}\n"
+            "  admissionControl: {maxConcurrency: 64}\n"
+            "  tenants: {floor: 0.1}\n"))
+        assert rules_of(check_text(cfg), "tenant-config") == []
+
+
 class TestRegistryCrossCheck:
     def test_unknown_kind_fires_with_known_list(self):
         cfg = """

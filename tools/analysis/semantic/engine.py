@@ -31,6 +31,7 @@ SEMANTIC_RULES = (
     "router-port-conflict", "router-dst-uncovered",
     "timeout-inversion", "retry-starved", "admission-deadline",
     "tls-missing-cert",
+    "tenant-config",      # tenantIdentifier/tenants/connectionGuard wiring
     "scorer-config", "scorer-width",
     "override-unsafe",    # reactor-generated dtab overrides (control/)
 )

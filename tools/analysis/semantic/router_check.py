@@ -136,6 +136,7 @@ class RouterChecks:
             yield from self.check_router_dtab(rspec, where)
             yield from self.check_timeouts_retries(rspec, where)
             yield from self.check_admission(rspec, where)
+            yield from self.check_tenants(rspec, where)
             yield from self.check_tls(rspec, where)
 
     def _router_spans(self) -> List[Tuple[int, int]]:
@@ -312,6 +313,101 @@ class RouterChecks:
                 f"a slot and are shed as 504s instead of fast 503s; "
                 f"shrink the queue so sheds happen up front",
                 line=line, severity="warning")
+
+    # -- tenant isolation --------------------------------------------------
+    def check_tenants(self, rspec: RouterSpec, where: str
+                      ) -> Iterator[Finding]:
+        """``tenantIdentifier`` / ``tenants:`` / ``connectionGuard``
+        wiring: extraction-source sanity, floor-vs-limit coherence, and
+        the inert-config traps (quotas without an identity axis; quotas
+        on the Python path without an admission gate to enforce them;
+        sni extraction where no TLS listener will ever see a server
+        name)."""
+        tid = None
+        if rspec.tenantIdentifier is not None:
+            from linkerd_tpu.router.tenancy import TenantIdentifierSpec
+            line = self._anchor("tenantIdentifier")
+            try:
+                tid = instantiate_as(TenantIdentifierSpec,
+                                     rspec.tenantIdentifier,
+                                     f"{where}.tenantIdentifier")
+                tid.validate(f"{where}.tenantIdentifier")
+            except (ConfigError, ValueError) as e:
+                yield self.source.finding("tenant-config", str(e),
+                                          line=line)
+                tid = None
+            if tid is not None and tid.kind == "sni":
+                has_tls_server = any(s.tls is not None
+                                     for s in rspec.servers or [])
+                if not has_tls_server:
+                    yield self.source.finding(
+                        "tenant-config",
+                        f"{where}.tenantIdentifier: kind sni needs a "
+                        f"TLS server — no listener here terminates "
+                        f"TLS, so no request ever carries a server "
+                        f"name and every request is tenantless",
+                        line=line)
+                elif not rspec.fastPath:
+                    yield self.source.finding(
+                        "tenant-config",
+                        f"{where}.tenantIdentifier: kind sni is only "
+                        f"extracted on fastPath TLS listeners — the "
+                        f"Python data plane does not surface the "
+                        f"server name",
+                        line=line, severity="warning")
+        ts = rspec.tenants
+        if ts is not None:
+            line = self._anchor("tenants")
+            try:
+                ts.validate(f"{where}.tenants")
+            except ConfigError as e:
+                yield self.source.finding("tenant-config", str(e),
+                                          line=line)
+                return
+            if rspec.tenantIdentifier is None:
+                yield self.source.finding(
+                    "tenant-config",
+                    f"{where}.tenants: per-tenant quotas are configured "
+                    f"without a tenantIdentifier — no request gets a "
+                    f"tenant, so the quotas never apply",
+                    line=line, severity="warning")
+            ac = rspec.admissionControl
+            if ac is not None:
+                # the floor quota must stay below the router's own
+                # concurrency limit, or a "sick" tenant still owns the
+                # whole gate
+                floor_limit = max(1, round(ts.floor * ac.maxConcurrency))
+                if floor_limit >= ac.maxConcurrency:
+                    yield self.source.finding(
+                        "tenant-config",
+                        f"{where}.tenants: floor ({ts.floor}) x "
+                        f"admissionControl.maxConcurrency "
+                        f"({ac.maxConcurrency}) rounds to "
+                        f"{floor_limit} — a sick tenant's \"floor\" "
+                        f"still covers the whole gate, so shrinking "
+                        f"its quota isolates nothing",
+                        line=line)
+            elif not rspec.fastPath:
+                yield self.source.finding(
+                    "tenant-config",
+                    f"{where}.tenants: quotas on the Python data plane "
+                    f"enforce through admissionControl — without one, "
+                    f"tenant levels are tracked but nothing sheds",
+                    line=line, severity="warning")
+        if rspec.connectionGuard is not None and not rspec.fastPath:
+            yield self.source.finding(
+                "tenant-config",
+                f"{where}.connectionGuard requires fastPath: true (the "
+                f"defenses live in the native engines) — the linker "
+                f"refuses this config at load",
+                line=self._anchor("connectionGuard"))
+        elif rspec.connectionGuard is not None:
+            try:
+                rspec.connectionGuard.validate(f"{where}.connectionGuard")
+            except ConfigError as e:
+                yield self.source.finding(
+                    "tenant-config", str(e),
+                    line=self._anchor("connectionGuard"))
 
     # -- TLS ---------------------------------------------------------------
     def check_tls(self, rspec: RouterSpec, where: str) -> Iterator[Finding]:

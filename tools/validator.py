@@ -87,6 +87,15 @@ counters only exist when the C++ epoll loop evaluated the model), with
 the client-observed added p99 reported alongside:
 
     python tools/validator.py native-score
+
+And the tenant-isolation validation: boot the REAL linkerd binary with
+a ``fastPath: true`` router carrying the tenant stack (tenantIdentifier
++ tenants quota governor + connectionGuard), launch attacker + victim
+tenant traffic, and assert from live state that the attacker was shed
+at the NATIVE tier, the victim's success rate stayed >= 0.99, and the
+``rt/*/fastpath/tenant/*`` metrics agree with admin ``/tenants.json``:
+
+    python tools/validator.py tenant
 """
 
 from __future__ import annotations
@@ -124,6 +133,8 @@ PORTS = {
                 "a": 30801, "b": 30802},
     "tls":    {"linkerd": 31140, "admin": 31990, "a": 31801},
     "native-score": {"linkerd": 32140, "admin": 32990, "a": 32801},
+    "tenant": {"linkerd": 33140, "admin": 33990, "a": 33801,
+               "b": 33802},
 }
 
 IFACE_YAML = {
@@ -1095,6 +1106,184 @@ admin:
         d_a.close()
 
 
+async def validate_tenant() -> None:
+    """Boot the REAL linkerd binary with a fastPath router carrying
+    the full tenant-isolation stack (tenantIdentifier + tenants quota
+    governor + connectionGuard), launch attacker + victim tenant
+    traffic, and assert from LIVE state that:
+
+    - the attacker was shed at the NATIVE tier (the engine's
+      ``guard.tenant_shed`` / per-tenant shed counters only move when
+      the C++ epoll loop refused the request itself);
+    - the victim tenant's success rate stayed >= 0.99 throughout;
+    - ``rt/*/fastpath/tenant/*`` metrics agree with the admin
+      ``/tenants.json`` view of the same engine table.
+
+    Prints one ``TENANT {json}`` line."""
+    from linkerd_tpu import native
+    from linkerd_tpu.router.tenancy import tenant_hash
+    from linkerd_tpu.testing.faults import (
+        PacedTenantClient, TenantRetryStorm,
+    )
+    if not native.ensure_built():
+        raise AssertionError(
+            "native toolchain unavailable — the tenant validation "
+            "proves the NATIVE tier sheds, so a missing lib is a "
+            "failure here, not a skip")
+
+    ports = PORTS["tenant"]
+    work = tempfile.mkdtemp(prefix="l5d-validate-tenant-")
+    disco = os.path.join(work, "disco")
+    os.makedirs(disco)
+    d_good = await downstream("G", ports["a"])
+
+    async def boom_conn(reader, writer):
+        try:
+            while True:
+                await reader.readuntil(b"\r\n\r\n")
+                writer.write(b"HTTP/1.1 500 Boom\r\n"
+                             b"Content-Length: 4\r\n\r\nboom")
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    d_boom = await asyncio.start_server(boom_conn, "127.0.0.1",
+                                        ports["b"])
+    with open(os.path.join(disco, "good"), "w") as f:
+        f.write(f"127.0.0.1 {ports['a']}\n")
+    with open(os.path.join(disco, "boom"), "w") as f:
+        f.write(f"127.0.0.1 {ports['b']}\n")
+
+    linkerd_yaml = os.path.join(work, "linkerd.yaml")
+    with open(linkerd_yaml, "w") as f:
+        f.write(f"""
+routers:
+- protocol: http
+  label: tnt
+  fastPath: true
+  tenantIdentifier: {{kind: header, header: l5d-tenant}}
+  tenants:
+    floor: 0.05
+    engineBase: 20
+    enterThreshold: 0.45
+    exitThreshold: 0.15
+    quorum: 2
+    cooldownS: 0.5
+  connectionGuard:
+    headerBudgetMs: 5000
+    bodyStallMs: 10000
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers:
+  - port: {ports['linkerd']}
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+admin:
+  port: {ports['admin']}
+""")
+
+    def metrics(q: str) -> dict:
+        _, _, body = http(
+            "GET", f"http://127.0.0.1:{ports['admin']}"
+                   f"/admin/metrics.json?q={q}")
+        return json.loads(body)
+
+    def tenants_json() -> dict:
+        _, _, body = http(
+            "GET", f"http://127.0.0.1:{ports['admin']}/tenants.json")
+        return json.loads(body)
+
+    def get_ok() -> bool:
+        st, _, body = http(
+            "GET", f"http://127.0.0.1:{ports['linkerd']}/",
+            headers={"Host": "good", "l5d-tenant": "victim"})
+        return st == 200 and body == b"G"
+
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    linkerd = None
+    try:
+        linkerd = subprocess.Popen(
+            [sys.executable, "-m", "linkerd_tpu", linkerd_yaml],
+            env=env, cwd=work)
+        await wait_for(get_ok, 30, "fastpath route to good")
+
+        # warm the boom route too (the storm needs it installed); in a
+        # worker thread — the boom downstream serves on THIS loop
+        def boom_ok() -> bool:
+            st, _, _ = http(
+                "GET", f"http://127.0.0.1:{ports['linkerd']}/",
+                headers={"Host": "boom", "l5d-tenant": "attacker"})
+            return st == 500
+
+        await wait_for(boom_ok, 30, "fastpath route to boom")
+
+        # attacker retry-storms the failing route; its engine-side
+        # error EWMA (ingested by the fastpath stats loop each second)
+        # trips the quota governor, which pushes a floor quota INTO
+        # the engine — sheds then happen in the data plane
+        storm = TenantRetryStorm(
+            ports["linkerd"], "boom", "attacker", concurrency=8,
+            retry_delay_s=0.005).start()
+
+        def attacker_shed_natively() -> bool:
+            tj = tenants_json().get("tnt", {})
+            eng = (tj.get("engine") or {}).get("tenants") or {}
+            by = eng.get("by_tenant") or {}
+            atk = by.get(str(tenant_hash("attacker")), {})
+            return int(atk.get("shed", 0)) > 0
+
+        await wait_for(attacker_shed_natively, 45,
+                       "native per-tenant shed (governor -> engine)")
+
+        # victim rides through the live attack
+        vic = PacedTenantClient(ports["linkerd"], "good", "victim",
+                                rate_per_s=50)
+        await vic.run(150)
+        await storm.stop()
+        assert vic.success_rate >= 0.99, \
+            f"victim success {vic.success_rate}"
+
+        # stats agreement: the metrics tree's per-tenant counters are
+        # deltas of the same engine table /tenants.json snapshots
+        await asyncio.sleep(2.5)  # two stats ticks settle the export
+        tj = tenants_json()["tnt"]
+        eng_by = tj["engine"]["tenants"]["by_tenant"]
+        fp = metrics("rt/tnt/fastpath/tenant")
+        vh = tenant_hash("victim")
+        eng_vic = int(eng_by[str(vh)]["requests"])
+        tree_vic = int(fp.get(
+            f"rt/tnt/fastpath/tenant/{vh}/requests", 0))
+        assert eng_vic > 0 and abs(tree_vic - eng_vic) <= 2, \
+            f"tenant stats disagree: tree={tree_vic} engine={eng_vic}"
+        guard = metrics("rt/tnt/fastpath/guard")
+        shed_native = int(guard.get(
+            "rt/tnt/fastpath/guard/tenant_shed", 0))
+        assert shed_native > 0, "no native tenant sheds in metrics"
+        quotas = tj.get("quotas") or {}
+        assert quotas.get("sick"), "governor never marked the attacker"
+        print("TENANT " + json.dumps({
+            "attacker_shed_native": shed_native,
+            "attacker_shed_fraction": round(storm.shed_fraction, 4),
+            "victim_success_rate": round(vic.success_rate, 4),
+            "victim_p99_ms": round(vic.p99_ms(), 2),
+            "sick": quotas.get("sick"),
+            "transitions": quotas.get("transitions"),
+            "tenant_stats_agree": True,
+        }))
+    finally:
+        if linkerd is not None:
+            linkerd.send_signal(signal.SIGTERM)
+            try:
+                linkerd.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                linkerd.kill()
+        d_good.close()
+        d_boom.close()
+
+
 async def validate_trace() -> None:
     """Boot the REAL linkerd binary as a two-router chain with a zipkin
     exporter, drive one traced request, assert the exported spans form
@@ -1344,6 +1533,10 @@ async def main() -> int:
     if args and args[0] == "native-score":
         await validate_native_score()
         print("VALIDATOR PASS (native-score)")
+        return 0
+    if args and args[0] == "tenant":
+        await validate_tenant()
+        print("VALIDATOR PASS (tenant)")
         return 0
     protocols = args or ["mesh", "thrift", "http"]
     for protocol in protocols:
