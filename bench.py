@@ -414,6 +414,140 @@ def native_score_bench() -> dict:
     return asyncio.run(asyncio.wait_for(drive(), 240))
 
 
+def core_scaling_bench() -> dict:
+    """Multi-core data-plane scaling, device-free: both native engines
+    (h1 proxy + h2/gRPC) driven to closed-loop saturation at workers =
+    1 / 2 / min(4, hw cores), everything else held constant — the same
+    backend fleet (sized for the max shard count), the same two
+    out-of-process h2bench load generators with a
+    ``--conns-per-worker`` spread so the kernel's per-connection
+    SO_REUSEPORT balancing can reach every worker.
+
+    Emits ``proxy_req_s`` / ``grpc_saturation_req_s`` per worker count
+    and ``core_scaling_eff`` = throughput(w_max) / (throughput(1) x
+    w_max) — 1.0 is ideal linear scaling. The acceptance bar reads
+    ``proxy_x2`` (workers=2 vs workers=1; target >= 1.6)."""
+    import subprocess
+
+    from linkerd_tpu import native
+
+    if not native.ensure_built():
+        return {"error": "native lib unavailable"}
+    from benchmarks.common import Proc, build_h2bench
+
+    ncpu = os.cpu_count() or 1
+    wmax = min(4, ncpu)
+    workers_list = sorted({1, min(2, wmax), wmax})
+    h2b = build_h2bench()
+    secs = 3.0
+    out: dict = {"hw_cores": ncpu, "worker_counts": workers_list,
+                 "loadgen": f"h2bench subprocess (2x h1, "
+                            f"{max(2, wmax)}x grpc)"}
+
+    def run_loadgens(mode, port, authority, conc, extra,
+                     n_gen=2, duration=secs):
+        """n_gen parallel h2bench loadgen subprocesses; -> (sum rps,
+        sum errors). The gen count and conn spread stay CONSTANT
+        across worker counts so the only variable is the shard
+        count."""
+        cmd_tail = ["--conns-per-worker", "8", "--workers", str(wmax)]
+        procs = [subprocess.Popen(
+            [h2b, mode, "127.0.0.1", str(port), authority, str(conc),
+             str(duration), *extra, *cmd_tail],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+            for _ in range(n_gen)]
+        total = 0.0
+        errors = 0
+        failed_gens = 0
+        for p in procs:
+            sout, _ = p.communicate(timeout=duration + 60)
+            line = (sout or "").strip().splitlines()
+            if p.returncode == 0 and line:
+                r = json.loads(line[-1])
+                total += float(r.get("rps", 0.0))
+                errors += int(r.get("errors", 0))
+            else:
+                # a crashed generator must not silently deflate the
+                # scaling ratio — count it as errors so the sweep
+                # records the run as degraded, not as a real rate
+                failed_gens += 1
+                errors += 1
+        return total, errors, failed_gens
+
+    def sweep(engine_cls, authority, eps, mode, conc, extra,
+              n_gen=2) -> dict:
+        res: dict = {}
+        for w in workers_list:
+            eng = engine_cls(workers=w)
+            port = eng.listen("127.0.0.1", 0)
+            eng.start()
+            eng.set_route(authority, eps)
+            try:
+                # short warm fills every worker's upstream pools
+                run_loadgens(mode, port, authority, conc, extra,
+                             n_gen=1, duration=0.8)
+                rps, errs, failed = run_loadgens(
+                    mode, port, authority, conc, extra, n_gen=n_gen)
+                res[f"w{w}"] = round(rps, 1)
+                if errs:
+                    res[f"w{w}_errors"] = errs
+                if failed:
+                    res[f"w{w}_loadgen_failures"] = failed
+            finally:
+                eng.close()
+        return res
+
+    # -- h1 leg: engine proxies to a fleet of echo subprocesses (the
+    # backend fleet is sized for w_max and CONSTANT across runs)
+    echoes = [Proc(["-m", "benchmarks.serve_echo"]) for _ in range(wmax)]
+    try:
+        eps = [("127.0.0.1", e.wait_ready()["port"]) for e in echoes]
+        out["proxy_req_s"] = sweep(native.FastPathEngine, "svc", eps,
+                                   "h1load", 256, [])
+    finally:
+        for e in echoes:
+            e.stop()
+
+    # -- h2/gRPC leg: same sweep through the h2 engine against
+    # h2bench's own epoll echo servers
+    serves = [subprocess.Popen([h2b, "serve", "0"],
+                               stdout=subprocess.PIPE, text=True)
+              for _ in range(wmax)]
+    try:
+        ports = [json.loads(p.stdout.readline())["listening"]
+                 for p in serves]
+        # the h2 engine multiplexes streams, so one single-threaded
+        # loadgen saturates well below the engine: use w_max generators
+        # (still constant across worker counts)
+        out["grpc_saturation_req_s"] = sweep(
+            native.H2FastPathEngine, "echo",
+            [("127.0.0.1", p) for p in ports], "load", 256, ["128", "0"],
+            n_gen=max(2, wmax))
+    finally:
+        for p in serves:
+            p.terminate()
+        for p in serves:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    def eff(d: dict):
+        w1, wm = d.get("w1"), d.get(f"w{wmax}")
+        return (round(wm / (w1 * wmax), 3) if w1 and wm else None)
+
+    def x2(d: dict):
+        w1, w2 = d.get("w1"), d.get("w2")
+        return round(w2 / w1, 3) if w1 and w2 else None
+
+    out["core_scaling_eff"] = {"proxy": eff(out["proxy_req_s"]),
+                               "grpc": eff(out["grpc_saturation_req_s"]),
+                               "ideal": 1.0, "w_max": wmax}
+    out["proxy_x2"] = x2(out["proxy_req_s"])
+    out["grpc_x2"] = x2(out["grpc_saturation_req_s"])
+    return out
+
+
 def tenant_isolation_bench() -> dict:
     """Tenant isolation on the REAL h1 engine, device-free: a paced
     two-tenant run (one attacker retry-storming at its floor quota, one
@@ -1185,6 +1319,13 @@ def main() -> None:
         detail["churn_conn_s"] = ti.get("churn_conn_s")
         detail["tenant_isolation"] = ti
 
+    def ph_core_scaling() -> None:
+        cs = core_scaling_bench()
+        # headline rows at the top level (the acceptance bar reads
+        # proxy_x2); the full sweep stays under detail.core_scaling
+        detail["core_scaling"] = cs
+        detail["core_scaling_eff"] = cs.get("core_scaling_eff")
+
     def ph_native_score() -> None:
         ns = native_score_bench()
         # headline rows at the top level (the acceptance bar reads
@@ -1206,6 +1347,7 @@ def main() -> None:
         ("race_analysis", ph_race),
         ("tenant_isolation", ph_tenant_isolation),
         ("native_score", ph_native_score),
+        ("core_scaling", ph_core_scaling),
         ("proxy", ph_proxy),
         ("grpc", ph_grpc),
         ("scorer", ph_scorer),

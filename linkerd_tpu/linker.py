@@ -341,6 +341,12 @@ class RouterSpec:
     # budgets, accept throttle, handshake-churn backpressure, h2
     # flood caps)
     connectionGuard: Optional[ConnectionGuardSpec] = None
+    # fastPath only: shard the native engine N-way — N per-core epoll
+    # workers sharing the router's ports via SO_REUSEPORT, per-core
+    # stats/tenant/guard slabs merged at scrape time, one shared
+    # read-only scorer weight slab. None/1 = today's single engine
+    # (bit-compatible); 0 = auto-size to min(4, hw cores).
+    workers: Optional[int] = None
 
 
 @dataclass
@@ -1290,6 +1296,25 @@ class Linker:
                     f"supported with fastPath: true (the native engine "
                     f"proxies bodies byte-for-byte)")
 
+    @staticmethod
+    def _resolve_workers(rspec: RouterSpec, label: str) -> int:
+        """The ``workers`` knob -> a concrete shard count: None -> 1
+        (bit-compatible single engine), 0 -> auto = min(4, hw cores),
+        N -> N (validated). l5dcheck's ``fastpath-workers`` rule warns
+        statically when N exceeds the hardware."""
+        raw = rspec.workers
+        if raw is None:
+            return 1
+        from linkerd_tpu import native
+        n = int(raw)
+        if n == 0:
+            n = native.auto_workers()
+        if not 1 <= n <= native.FastPathEngine.MAX_WORKERS:
+            raise ConfigError(
+                f"{label}.workers must be 0 (auto) or in "
+                f"1..{native.FastPathEngine.MAX_WORKERS}, got {raw}")
+        return n
+
     def _mk_tenant_identifier(self, rspec: RouterSpec, label: str):
         """Parse + validate the ``tenantIdentifier`` block into a
         TenantIdentifierSpec (None when absent)."""
@@ -1349,6 +1374,18 @@ class Linker:
             raise ConfigError(
                 f"{label}: connectionGuard requires fastPath: true "
                 f"(the defenses live in the native engines)")
+        if rspec.workers is not None:
+            if not rspec.fastPath:
+                raise ConfigError(
+                    f"{label}: workers requires fastPath: true (the "
+                    f"sharded epoll workers are the native engines; "
+                    f"the asyncio data plane is single-loop)")
+            # fastPath requested but the router fell back to the Python
+            # data plane (no native TLS runtime): the knob is inert
+            # there, which the operator should see but not die on
+            log.warning(
+                "%s: workers is ignored on the Python data-plane "
+                "fallback (no native TLS runtime)", label)
         filters: List[Any] = [ServerDeadlineFilter(
             self.metrics.scope("rt", label, "server", "deadline"))]
         tid_spec = self._mk_tenant_identifier(rspec, label)
@@ -1499,6 +1536,7 @@ class Linker:
                 "(no toolchain available to build it)")
         engine_cls = (native.H2FastPathEngine if rspec.protocol == "h2"
                       else native.FastPathEngine)
+        workers = self._resolve_workers(rspec, label)
         specs = rspec.servers or [ServerSpec()]
         client_tls = self._fastpath_client_tls(rspec, label)
         tls_servers = [s for s in specs if s.tls is not None]
@@ -1519,7 +1557,7 @@ class Linker:
         base_dtab = Dtab.read(rspec.dtab) if rspec.dtab else Dtab.empty()
         prefix = Path.read(rspec.dstPrefix)
         interpreter = self._mk_interpreter(rspec, label)
-        engine = engine_cls()
+        engine = engine_cls(workers=workers)
         if tls_servers:
             tls = tls_servers[0].tls
             if not tls.certPath or not tls.keyPath:

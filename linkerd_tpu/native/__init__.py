@@ -215,6 +215,14 @@ def _declare_h2_fastpath(cdll: ctypes.CDLL) -> None:
     cdll.fph2_listen.restype = ctypes.c_int
     cdll.fph2_listen.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                  ctypes.c_int]
+    cdll.fph2_listen_shared.restype = ctypes.c_int
+    cdll.fph2_listen_shared.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_int]
+    cdll.fph2_listen_tls_shared.restype = ctypes.c_int
+    cdll.fph2_listen_tls_shared.argtypes = [ctypes.c_void_p,
+                                            ctypes.c_char_p, ctypes.c_int]
+    cdll.fph2_attach_slab.restype = ctypes.c_int
+    cdll.fph2_attach_slab.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
     cdll.fph2_set_route.restype = ctypes.c_int
     cdll.fph2_set_route.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                     ctypes.c_char_p]
@@ -246,6 +254,14 @@ def _declare_fastpath(cdll: ctypes.CDLL) -> None:
     cdll.fp_listen.restype = ctypes.c_int
     cdll.fp_listen.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                ctypes.c_int]
+    cdll.fp_listen_shared.restype = ctypes.c_int
+    cdll.fp_listen_shared.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_int]
+    cdll.fp_listen_tls_shared.restype = ctypes.c_int
+    cdll.fp_listen_tls_shared.argtypes = [ctypes.c_void_p,
+                                          ctypes.c_char_p, ctypes.c_int]
+    cdll.fp_attach_slab.restype = ctypes.c_int
+    cdll.fp_attach_slab.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
     cdll.fp_set_route.restype = ctypes.c_int
     cdll.fp_set_route.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                   ctypes.c_char_p]
@@ -265,6 +281,116 @@ def _declare_fastpath(cdll: ctypes.CDLL) -> None:
     cdll.fp_shutdown.argtypes = [ctypes.c_void_p]
 
 
+def auto_workers() -> int:
+    """The ``workers: 0`` auto-size rule — one definition shared by
+    the linker's knob resolution and l5dcheck's ``fastpath-workers``
+    rule: min(4, hw cores)."""
+    return min(4, os.cpu_count() or 1)
+
+
+def _sum_hists(a, b):
+    if not a:
+        return list(b)
+    if not b:
+        return list(a)
+    return [int(x) + int(y) for x, y in zip(a, b)]
+
+
+def _merge_worker_stats(snaps: List[dict], n_workers: int) -> dict:
+    """Merge N per-worker engine stats snapshots into one router-level
+    view — the merge-at-scrape rule: the hot path never shares a
+    counter; the control plane adds the per-core slabs up here, once a
+    second. Counters and histograms sum; shared-slab scorer fields
+    (weights/version/crc/swaps/retries live in the ONE process-wide
+    slab) are taken from the first worker; per-tenant score EWMAs
+    average weighted by each worker's scored count."""
+    if not snaps:
+        return {}
+    out: dict = {"routes": {}}
+    for key in ("accepted", "features_dropped"):
+        out[key] = sum(int(s.get(key, 0)) for s in snaps)
+    for s in snaps:
+        for host, r in (s.get("routes") or {}).items():
+            m = out["routes"].get(host)
+            if m is None:
+                out["routes"][host] = dict(r)
+                continue
+            for k in ("requests", "success", "f4xx", "f5xx",
+                      "conn_fail"):
+                m[k] = int(m.get(k, 0)) + int(r.get(k, 0))
+            m["hist"] = _sum_hists(m.get("hist") or [],
+                                   r.get("hist") or [])
+    tls_snaps = [s["tls"] for s in snaps if s.get("tls")]
+    if tls_snaps:
+        tls = {k: sum(int(t.get(k, 0)) for t in tls_snaps)
+               for k in ("handshakes", "failures", "resumed", "alpn_h2",
+                         "alpn_http1", "upstream_handshakes",
+                         "upstream_resumed", "upstream_failures")}
+        tls["enabled"] = any(t.get("enabled") for t in tls_snaps)
+        tls["client_enabled"] = any(t.get("client_enabled")
+                                    for t in tls_snaps)
+        out["tls"] = tls
+    guard_snaps = [s["guard"] for s in snaps if s.get("guard")]
+    if guard_snaps:
+        keys = set()
+        for g in guard_snaps:
+            keys.update(g)
+        out["guard"] = {k: sum(int(g.get(k, 0)) for g in guard_snaps)
+                        for k in keys}
+    tn_snaps = [s["tenants"] for s in snaps if s.get("tenants")]
+    if tn_snaps:
+        by: dict = {}
+        for tn in tn_snaps:
+            for thash, t in (tn.get("by_tenant") or {}).items():
+                m = by.get(thash)
+                if m is None:
+                    by[thash] = dict(t)
+                    continue
+                # score_ewma: scored-weighted mean across workers
+                w_old, w_new = int(m.get("scored", 0)), int(
+                    t.get("scored", 0))
+                if w_old + w_new > 0:
+                    m["score_ewma"] = (
+                        float(m.get("score_ewma", 0.0)) * w_old
+                        + float(t.get("score_ewma", 0.0)) * w_new
+                    ) / (w_old + w_new)
+                for k in ("requests", "shed", "errors", "scored",
+                          "inflight"):
+                    m[k] = int(m.get(k, 0)) + int(t.get(k, 0))
+                # per-worker quota splits are equal; fold to the max
+                # here (-1 = unlimited wins), scaled back to the
+                # global cap below
+                qa, qb = int(m.get("quota", -1)), int(t.get("quota", -1))
+                m["quota"] = -1 if (qa < 0 or qb < 0) else max(qa, qb)
+        # quota: report the GLOBAL cap, per-worker split x the TRUE
+        # worker count (not a sum over the workers whose bounded-LRU
+        # stats table still happens to hold the tenant, nor over the
+        # scrapes that succeeded this tick — quota maps survive stats
+        # eviction, so every worker enforces the same split even when
+        # only some reported the tenant)
+        for t in by.values():
+            q = int(t.get("quota", -1))
+            if q >= 0:
+                t["quota"] = q * n_workers
+        out["tenants"] = {
+            "count": len(by),
+            "evicted": sum(int(t.get("evicted", 0)) for t in tn_snaps),
+            "by_tenant": by,
+        }
+    ns_snaps = [s["native_scorer"] for s in snaps
+                if s.get("native_scorer")]
+    if ns_snaps:
+        ns = dict(ns_snaps[0])  # slab fields: shared, identical
+        ns["scored"] = sum(int(x.get("scored", 0)) for x in ns_snaps)
+        ns["unscored"] = sum(int(x.get("unscored", 0)) for x in ns_snaps)
+        hist = ns_snaps[0].get("score_ns_hist") or []
+        for x in ns_snaps[1:]:
+            hist = _sum_hists(hist, x.get("score_ns_hist") or [])
+        ns["score_ns_hist"] = hist
+        out["native_scorer"] = ns
+    return out
+
+
 class FastPathEngine:
     """Handle on the native epoll proxy data plane (native/fastpath.cpp).
 
@@ -272,7 +398,21 @@ class FastPathEngine:
     installs/updates concrete routes (host -> [(ip, port), ...]) as the
     naming system publishes address changes, and periodically drains route
     misses, stats, and per-request feature rows.
-    """
+
+    Multi-core sharding (``workers`` > 1): N per-core C++ engine
+    instances, each with its own epoll loop, upstream pools, and
+    stats/tenant/guard slabs; ``listen()`` binds every worker to the
+    SAME port via SO_REUSEPORT so the kernel distributes connections —
+    no shared counters on the hot path, no cache-line ping-pong.
+    Control-plane calls (routes, quotas, TLS, guards) broadcast to all
+    workers; drains fan in; ``stats()`` merges the per-worker slabs at
+    scrape time and carries the raw per-worker snapshots under
+    ``workers``. The scorer's double-buffered weight slab becomes ONE
+    process-wide slab shared read-only across workers (attached before
+    start), so a single ``publish_weights`` flips every core to the new
+    blob atomically. ``workers=1`` is byte-for-byte today's single
+    engine: the legacy (non-REUSEPORT) bind, the embedded slab, and the
+    unmerged stats shape."""
 
     # engine feature-row width: route_id, latency_ms, status, req_b,
     # rsp_b, ts_s, score, scored, tenant (score/scored are the
@@ -283,14 +423,23 @@ class FastPathEngine:
     # ALPN preference list the engine's TLS contexts advertise/offer
     _ALPN = "http/1.1"
 
-    def __init__(self):
+    MAX_WORKERS = 64
+
+    def __init__(self, workers: int = 1):
         cdll = lib()
         if cdll is None:
             raise RuntimeError("native library unavailable; fastPath "
                                "requires a working toolchain")
+        workers = int(workers)
+        if not 1 <= workers <= self.MAX_WORKERS:
+            raise ValueError(
+                f"workers must be in 1..{self.MAX_WORKERS}, got {workers}")
         self._lib = cdll
         p = self._PREFIX
         self._fn_listen = getattr(cdll, p + "_listen")
+        self._fn_listen_shared = getattr(cdll, p + "_listen_shared")
+        self._fn_listen_tls_shared = getattr(cdll,
+                                             p + "_listen_tls_shared")
         self._fn_start = getattr(cdll, p + "_start")
         self._fn_set_route = getattr(cdll, p + "_set_route")
         self._fn_remove_route = getattr(cdll, p + "_remove_route")
@@ -300,7 +449,20 @@ class FastPathEngine:
         self._fn_shutdown = getattr(cdll, p + "_shutdown")
         self._fn_publish = getattr(cdll, p + "_publish_weights")
         self._fn_route_feat = getattr(cdll, p + "_set_route_feature")
-        self._e = getattr(cdll, p + "_create")()
+        self.workers = workers
+        self._es = [getattr(cdll, p + "_create")()
+                    for _ in range(workers)]
+        self._e = self._es[0]  # single-worker compat handle
+        # multi-worker: ONE process-wide weight slab, shared read-only
+        # by every worker's epoll thread — one publish fans out to all
+        # cores atomically (freed in close(), after every worker's loop
+        # thread has joined)
+        self._slab = None
+        if workers > 1:
+            self._slab = cdll.l5d_slab_create()
+            attach = getattr(cdll, p + "_attach_slab")
+            for h in self._es:
+                attach(h, self._slab)
         self._started = False
         self._closed = False
         self._miss_buf = ctypes.create_string_buffer(64 * 1024)
@@ -310,11 +472,26 @@ class FastPathEngine:
                           * (self._feat_rows * self.FEATURE_DIM))()
 
     def listen(self, ip: str, port: int) -> int:
-        """Bind a listener; returns the bound port. Call before start()."""
+        """Bind a listener; returns the bound port. Call before start().
+        With ``workers`` > 1 every worker binds the same port via
+        SO_REUSEPORT (the first worker resolves port 0 to a concrete
+        port; the rest join it)."""
         assert not self._started
-        got = self._fn_listen(self._e, ip.encode(), port)
+        if self.workers == 1:
+            got = self._fn_listen(self._e, ip.encode(), port)
+            if got < 0:
+                raise OSError(f"fastpath listen {ip}:{port} failed")
+            return got
+        return self._listen_all(self._fn_listen_shared, ip, port)
+
+    def _listen_all(self, fn, ip: str, port: int) -> int:
+        got = fn(self._es[0], ip.encode(), port)
         if got < 0:
             raise OSError(f"fastpath listen {ip}:{port} failed")
+        for h in self._es[1:]:
+            if fn(h, ip.encode(), got) < 0:
+                raise OSError(
+                    f"fastpath shared listen {ip}:{got} failed")
         return got
 
     @classmethod
@@ -332,23 +509,27 @@ class FastPathEngine:
         terminate TLS with this identity (ALPN per engine protocol)."""
         assert not self._started
         err = ctypes.create_string_buffer(512)
-        rc = getattr(self._lib, self._PREFIX + "_set_tls")(
-            self._e, cert_path.encode(), key_path.encode(),
-            self._ALPN.encode(), err, len(err))
-        if rc != 0:
-            raise OSError(
-                f"fastpath TLS config failed: "
-                f"{err.value.decode('latin-1') or 'unknown error'}")
+        fn = getattr(self._lib, self._PREFIX + "_set_tls")
+        for h in self._es:
+            rc = fn(h, cert_path.encode(), key_path.encode(),
+                    self._ALPN.encode(), err, len(err))
+            if rc != 0:
+                raise OSError(
+                    f"fastpath TLS config failed: "
+                    f"{err.value.decode('latin-1') or 'unknown error'}")
 
     def listen_tls(self, ip: str, port: int) -> int:
         """Bind a TLS-terminating listener (requires set_tls first);
-        returns the bound port. Call before start()."""
+        returns the bound port. Call before start(). Multi-worker
+        engines share the port via SO_REUSEPORT like listen()."""
         assert not self._started
-        got = getattr(self._lib, self._PREFIX + "_listen_tls")(
-            self._e, ip.encode(), port)
-        if got < 0:
-            raise OSError(f"fastpath TLS listen {ip}:{port} failed")
-        return got
+        if self.workers == 1:
+            got = getattr(self._lib, self._PREFIX + "_listen_tls")(
+                self._e, ip.encode(), port)
+            if got < 0:
+                raise OSError(f"fastpath TLS listen {ip}:{port} failed")
+            return got
+        return self._listen_all(self._fn_listen_tls_shared, ip, port)
 
     def set_client_tls(self, verify: bool = True,
                        ca_path: Optional[str] = None) -> None:
@@ -359,18 +540,20 @@ class FastPathEngine:
         start()."""
         assert not self._started
         err = ctypes.create_string_buffer(512)
-        rc = getattr(self._lib, self._PREFIX + "_set_client_tls")(
-            self._e, self._ALPN.encode(), 1 if verify else 0,
-            ca_path.encode() if ca_path else None, err, len(err))
-        if rc != 0:
-            raise OSError(
-                f"fastpath client TLS config failed: "
-                f"{err.value.decode('latin-1') or 'unknown error'}")
+        fn = getattr(self._lib, self._PREFIX + "_set_client_tls")
+        for h in self._es:
+            rc = fn(h, self._ALPN.encode(), 1 if verify else 0,
+                    ca_path.encode() if ca_path else None, err, len(err))
+            if rc != 0:
+                raise OSError(
+                    f"fastpath client TLS config failed: "
+                    f"{err.value.decode('latin-1') or 'unknown error'}")
 
     def start(self) -> None:
         if not self._started:
-            if self._fn_start(self._e) != 0:
-                raise RuntimeError("fastpath thread start failed")
+            for h in self._es:
+                if self._fn_start(h) != 0:
+                    raise RuntimeError("fastpath thread start failed")
             self._started = True
 
     @staticmethod
@@ -380,8 +563,13 @@ class FastPathEngine:
         return host.encode("latin-1", "replace").lower()
 
     def set_route(self, host: str, endpoints: List[Tuple[str, int]]) -> None:
+        # Broadcast in WORKER ORDER, always: each worker assigns route
+        # ids by install order, so identical broadcast order keeps ids
+        # in lockstep across workers — feature rows drained from any
+        # worker then attribute to the same dst path.
         eps = " ".join(f"{ip}:{port}" for ip, port in endpoints) + " "
-        self._fn_set_route(self._e, self._key(host), eps.encode())
+        for h in self._es:
+            self._fn_set_route(h, self._key(host), eps.encode())
 
     TENANT_KINDS = {"off": 0, "header": 1, "pathSegment": 2, "sni": 3}
 
@@ -396,11 +584,11 @@ class FastPathEngine:
         k = self.TENANT_KINDS.get(kind)
         if k is None:
             raise ValueError(f"unknown tenant extraction kind {kind!r}")
-        rc = getattr(self._lib, self._PREFIX + "_set_tenant")(
-            self._e, k, header.encode("latin-1", "replace"),
-            int(segment))
-        if rc != 0:
-            raise ValueError("tenant extraction config rejected")
+        fn = getattr(self._lib, self._PREFIX + "_set_tenant")
+        for h in self._es:
+            if fn(h, k, header.encode("latin-1", "replace"),
+                  int(segment)) != 0:
+                raise ValueError("tenant extraction config rejected")
 
     def set_tenant_quota(self, tenant_hash: int,
                          limit: Optional[int]) -> None:
@@ -408,14 +596,26 @@ class FastPathEngine:
         concurrency quota, keyed by the tenant's 32-bit hash. The
         engine sheds over-quota requests retryably in the data plane
         (h1: 503 + l5d-retryable, h2: RST REFUSED_STREAM). Safe at any
-        time; raises when the native quota map is full."""
+        time; raises when the native quota map is full.
+
+        Multi-worker engines split the limit N ways (floor division:
+        per-worker tables are independent, so the global cap is never
+        exceeded). A limit below ``workers`` rounds to a per-worker
+        quota of ZERO — every worker sheds that tenant entirely; the
+        l5dcheck ``fastpath-workers`` rule flags floor quotas that
+        round to zero at config load."""
         if self._closed:
             raise RuntimeError("engine is closed")
-        rc = getattr(self._lib, self._PREFIX + "_set_tenant_quota")(
-            self._e, int(tenant_hash) & 0xFFFFFFFF,
-            -1 if limit is None else max(0, int(limit)))
-        if rc != 0:
-            raise ValueError("native tenant quota map is full")
+        if limit is None:
+            per_worker = -1
+        else:
+            per_worker = max(0, int(limit))
+            if self.workers > 1:
+                per_worker //= self.workers
+        fn = getattr(self._lib, self._PREFIX + "_set_tenant_quota")
+        for h in self._es:
+            if fn(h, int(tenant_hash) & 0xFFFFFFFF, per_worker) != 0:
+                raise ValueError("native tenant quota map is full")
 
     def set_guard(self, header_budget_ms: int = 10_000,
                   body_stall_ms: int = 30_000, accept_burst: int = 0,
@@ -427,50 +627,81 @@ class FastPathEngine:
         TLS handshake-churn backpressure, and the tenant-stats LRU
         bound. 0 disables an individual defense."""
         assert not self._started
-        rc = getattr(self._lib, self._PREFIX + "_set_guard")(
-            self._e, int(header_budget_ms), int(body_stall_ms),
-            int(accept_burst), int(accept_window_ms),
-            int(max_hs_inflight), int(tenant_cap))
-        if rc != 0:
-            raise ValueError("guard config rejected")
+        fn = getattr(self._lib, self._PREFIX + "_set_guard")
+        for h in self._es:
+            rc = fn(h, int(header_budget_ms), int(body_stall_ms),
+                    int(accept_burst), int(accept_window_ms),
+                    int(max_hs_inflight), int(tenant_cap))
+            if rc != 0:
+                raise ValueError("guard config rejected")
 
     def set_route_feature(self, host: str, col: int, sign: float) -> bool:
         """Install the dst-path feature-hash (column, sign) for a route
         so the in-engine scorer can featurize its rows; call after
-        set_route. Returns False while the route does not exist."""
-        return self._fn_route_feat(self._e, self._key(host), int(col),
-                                   float(sign)) == 0
+        set_route. Returns False while the route does not exist (on
+        any worker — set_route broadcasts, so all workers agree)."""
+        ok = True
+        for h in self._es:
+            if self._fn_route_feat(h, self._key(host), int(col),
+                                   float(sign)) != 0:
+                ok = False
+        return ok
 
     def publish_weights(self, blob: bytes) -> None:
         """Hot-swap the in-engine scorer's weights from a versioned
         blob (lifecycle/export.export_weight_blob). Raises ValueError
         on a rejected blob (bad magic/CRC/geometry); the data plane
-        never pauses — scoring flips to the new weights per-row."""
+        never pauses — scoring flips to the new weights per-row. With
+        ``workers`` > 1 the publish goes ONCE into the shared slab and
+        every worker observes the new blob atomically."""
         if self._closed:
             # a stale sink calling into a freed C++ engine would be a
             # native use-after-free, not a catchable Python error
             raise RuntimeError("engine is closed")
         err = ctypes.create_string_buffer(256)
-        rc = self._fn_publish(self._e, blob, len(blob), err, len(err))
+        if self._slab is not None:
+            rc = self._lib.l5d_slab_publish(self._slab, blob, len(blob),
+                                            err, len(err))
+        else:
+            rc = self._fn_publish(self._e, blob, len(blob), err,
+                                  len(err))
         if rc != 0:
             raise ValueError(
                 f"weight blob rejected: "
                 f"{err.value.decode('latin-1') or 'unknown error'}")
 
     def remove_route(self, host: str) -> None:
-        self._fn_remove_route(self._e, self._key(host))
+        for h in self._es:
+            self._fn_remove_route(h, self._key(host))
 
     def drain_misses(self) -> List[str]:
-        n = self._fn_drain_misses(self._e, self._miss_buf,
-                                  len(self._miss_buf))
-        if n <= 0:
-            return []
-        return self._miss_buf.value.decode("latin-1").split("\n")[:n]
+        if self.workers == 1:
+            n = self._fn_drain_misses(self._e, self._miss_buf,
+                                      len(self._miss_buf))
+            if n <= 0:
+                return []
+            return self._miss_buf.value.decode("latin-1").split("\n")[:n]
+        # fan-in: the same host typically misses on several workers at
+        # once (the kernel spread its first connections); one entry is
+        # enough — set_route broadcasts the resolution to all of them
+        out: List[str] = []
+        seen = set()
+        for h in self._es:
+            n = self._fn_drain_misses(h, self._miss_buf,
+                                      len(self._miss_buf))
+            if n <= 0:
+                continue
+            for host in self._miss_buf.value.decode(
+                    "latin-1").split("\n")[:n]:
+                if host not in seen:
+                    seen.add(host)
+                    out.append(host)
+        return out
 
-    def stats(self) -> dict:
+    def _stats_one(self, handle) -> dict:
         import json
         for _ in range(6):
-            n = self._fn_stats(self._e, self._stats_buf,
+            n = self._fn_stats(handle, self._stats_buf,
                                len(self._stats_buf))
             if n == -2:  # buffer too small: grow (capped at 64MB)
                 if len(self._stats_buf) >= 64 << 20:
@@ -484,15 +715,41 @@ class FastPathEngine:
             return json.loads(self._stats_buf.value.decode("latin-1"))
         return {}
 
+    def stats(self) -> dict:
+        """Engine stats snapshot. ``workers == 1``: the single engine's
+        snapshot, unchanged. ``workers > 1``: per-worker slabs merged at
+        scrape time (counters summed, histograms added element-wise,
+        shared-slab fields taken once), with the raw per-worker
+        snapshots under ``workers`` for ``worker/<i>/*`` breakdowns."""
+        if self.workers == 1:
+            return self._stats_one(self._e)
+        snaps = [self._stats_one(h) for h in self._es]
+        if any(not s for s in snaps):
+            # a PARTIAL merge would report totals below the
+            # controller's delta baselines, and the next full scrape
+            # would then re-count the missing worker's whole history
+            # as one giant delta — skip this scrape entirely instead
+            # (an empty snapshot is the established failure shape:
+            # every consumer skips it and keeps its baselines)
+            return {}
+        merged = _merge_worker_stats(snaps, self.workers)
+        merged["workers"] = snaps
+        return merged
+
     def drain_features(self):
-        """-> float32 ndarray [n, FEATURE_DIM] of per-request rows."""
+        """-> float32 ndarray [n, FEATURE_DIM] of per-request rows
+        (fan-in over every worker's ring segment)."""
         import numpy as np
-        n = self._fn_features(self._e, self._feat_buf, self._feat_rows)
-        if n <= 0:
+        blocks = []
+        for h in self._es:
+            n = self._fn_features(h, self._feat_buf, self._feat_rows)
+            if n > 0:
+                arr = np.ctypeslib.as_array(self._feat_buf)
+                blocks.append(arr[:n * self.FEATURE_DIM].reshape(
+                    n, self.FEATURE_DIM).copy())
+        if not blocks:
             return np.zeros((0, self.FEATURE_DIM), dtype=np.float32)
-        arr = np.ctypeslib.as_array(self._feat_buf)
-        return arr[:n * self.FEATURE_DIM].reshape(
-            n, self.FEATURE_DIM).copy()
+        return blocks[0] if len(blocks) == 1 else np.concatenate(blocks)
 
     def drain_features_into(self, out) -> int:
         """Drain up to ``len(out)`` feature rows directly into ``out``
@@ -511,14 +768,32 @@ class FastPathEngine:
             raise ValueError(
                 f"want C-contiguous [n, {self.FEATURE_DIM}] f32, got "
                 f"shape {out.shape}")
-        ptr = out.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
-        n = self._fn_features(self._e, ptr, len(out))
-        return max(int(n), 0)
+        # fan-in: fill `out` from each worker's per-core ring segment in
+        # turn until it is full (each drain memcpys straight into ring
+        # memory at the right row offset — still zero-copy per worker)
+        total = 0
+        row_bytes = self.FEATURE_DIM * 4
+        base = out.ctypes.data
+        for h in self._es:
+            if total >= len(out):
+                break
+            ptr = ctypes.cast(base + total * row_bytes,
+                              ctypes.POINTER(ctypes.c_float))
+            n = self._fn_features(h, ptr, len(out) - total)
+            if n > 0:
+                total += int(n)
+        return total
 
     def close(self) -> None:
         if not self._closed:
             self._closed = True
-            self._fn_shutdown(self._e)
+            # every worker's epoll thread joins before the shared slab
+            # is freed: no core can be mid-eval on freed weights
+            for h in self._es:
+                self._fn_shutdown(h)
+            if self._slab is not None:
+                self._lib.l5d_slab_free(self._slab)
+                self._slab = None
 
 
 class H2FastPathEngine(FastPathEngine):
@@ -541,11 +816,12 @@ class H2FastPathEngine(FastPathEngine):
         PING and SETTINGS bursts. 0 disables one cap. Call before
         start()."""
         assert not self._started
-        rc = self._lib.fph2_set_flood_guard(
-            self._e, int(max_streams), int(rst_burst), int(ping_burst),
-            int(settings_burst), int(window_ms))
-        if rc != 0:
-            raise ValueError("flood guard config rejected")
+        for h in self._es:
+            rc = self._lib.fph2_set_flood_guard(
+                h, int(max_streams), int(rst_burst), int(ping_burst),
+                int(settings_burst), int(window_ms))
+            if rc != 0:
+                raise ValueError("flood guard config rejected")
 
     def set_response_timeout_ms(self, ms: int) -> None:
         """Window within which a dispatched stream's backend must START
@@ -554,7 +830,8 @@ class H2FastPathEngine(FastPathEngine):
         ms = int(ms)
         if ms < 1:
             raise ValueError("response timeout must be >= 1 ms")
-        self._lib.fph2_set_response_timeout_ms(self._e, ms)
+        for h in self._es:
+            self._lib.fph2_set_response_timeout_ms(h, ms)
 
 
 MAX_HEADERS = 1024

@@ -8,6 +8,7 @@ engine dispatching streams into the Service.
 from __future__ import annotations
 
 import asyncio
+import functools
 import logging
 from typing import Optional
 
@@ -15,6 +16,7 @@ from linkerd_tpu.protocol.h2.connection import H2Connection
 from linkerd_tpu.protocol.h2.frames import REFUSED_STREAM
 from linkerd_tpu.protocol.h2.messages import H2Request, H2Response
 from linkerd_tpu.protocol.h2.stream import StreamReset
+from linkerd_tpu.protocol.tls import sni_of
 from linkerd_tpu.router.service import Service
 
 log = logging.getLogger(__name__)
@@ -101,9 +103,16 @@ class H2Server:
         except (ConnectionError, asyncio.IncompleteReadError):
             writer.close()
             return
+        # SNI is a per-connection fact: read it once, stamp it on every
+        # stream's request (tenantIdentifier: sni on the Python data
+        # plane; the native h2 engine surfaces the same name natively)
+        sni = sni_of(writer)
+        handler = self._dispatch
+        if sni is not None:
+            handler = functools.partial(self._dispatch, sni=sni)
         conn = H2Connection(reader, writer, is_client=False,
                             **self._h2_settings,
-                            handler=self._dispatch,
+                            handler=handler,
                             preface_consumed=True,
                             initial_data=surplus)
         self._conns.add(conn)
@@ -228,7 +237,10 @@ class H2Server:
         return H2Request.from_header_list(h2_headers), body, \
             settings_payload, leftover
 
-    async def _dispatch(self, req: H2Request) -> H2Response:
+    async def _dispatch(self, req: H2Request,
+                        sni: Optional[str] = None) -> H2Response:
+        if sni is not None:
+            req.ctx["sni"] = sni
         try:
             if self._sem is not None:
                 if self._sem.locked():

@@ -14,6 +14,7 @@ from typing import Optional, Tuple
 
 from linkerd_tpu.protocol.http import codec
 from linkerd_tpu.protocol.http.message import Request, Response
+from linkerd_tpu.protocol.tls import sni_of
 from linkerd_tpu.router.service import Service
 
 log = logging.getLogger(__name__)
@@ -84,6 +85,10 @@ class HttpServer:
         if task is not None:
             self._conn_tasks.add(task)
             task.add_done_callback(self._conn_tasks.discard)
+        # SNI is a per-connection fact: read it once, stamp it on every
+        # request of the conn (tenantIdentifier: sni on the Python data
+        # plane; the native engines surface the same name natively)
+        sni = sni_of(writer)
         try:
             while True:
                 try:
@@ -102,6 +107,8 @@ class HttpServer:
 
                 req.ctx["client_addr"] = writer.get_extra_info("peername")
                 req.ctx["server_addr"] = writer.get_extra_info("sockname")
+                if sni is not None:
+                    req.ctx["sni"] = sni
                 if self._sem is not None:
                     # Admission control (ref: maxConcurrentRequests ->
                     # RequestSemaphoreFilter, Server.scala:89-97)

@@ -92,6 +92,29 @@ class TlsClientConfig:
         return sub
 
 
+def _record_sni(sslobj, server_name, _ctx) -> None:
+    """sni_callback installed on every server context: stash the
+    client's requested server name on the SSLObject so the asyncio
+    servers can surface it into ``req.ctx["sni"]`` (the Python
+    data plane's half of ``tenantIdentifier: sni`` — the native
+    engines read it via SSL_get_servername). Returning None proceeds
+    with the handshake unchanged."""
+    sslobj._l5d_sni = server_name  # noqa: SLF001 — our own marker attr
+
+
+def sni_of(transport_or_writer) -> Optional[str]:
+    """The SNI a TLS peer sent on this server-side connection, or None
+    (cleartext conn, no SNI extension, or a context built outside
+    TlsServerConfig.mk_context)."""
+    get = getattr(transport_or_writer, "get_extra_info", None)
+    if get is None:
+        return None
+    sslobj = get("ssl_object")
+    if sslobj is None:
+        return None
+    return getattr(sslobj, "_l5d_sni", None) or None
+
+
 @dataclass
 class TlsServerConfig:
     """Server-side TLS termination (ref: TlsServerConfig.scala)."""
@@ -108,4 +131,7 @@ class TlsServerConfig:
         if self.caCertPath:
             ctx.load_verify_locations(cafile=self.caCertPath)
             ctx.verify_mode = ssl.CERT_REQUIRED
+        # surface SNI to the data plane (tenantIdentifier: sni parity
+        # with the native engines)
+        ctx.sni_callback = _record_sni
         return ctx

@@ -137,6 +137,10 @@ class FastPathController:
         self._last_stats: Dict[str, Dict[str, int]] = {}
         self._last_tls: Dict[str, int] = {}
         self._last_scorer: Dict[str, int] = {}
+        # multi-worker sharding: per-worker counter baselines for the
+        # rt/*/fastpath/worker/<i>/* breakdown (merged totals ride the
+        # normal scopes above)
+        self._last_workers: List[Dict[str, int]] = []
         self._weight_sink_regs: List[tuple] = []
         self._id_to_host: Dict[int, str] = {}
         self._scope = metrics.scope("rt", label, "fastpath")
@@ -303,9 +307,48 @@ class FastPathController:
         if self.tenant_admission is not None:
             self.tenant_admission.step()
 
+    _WORKER_KEYS = ("requests", "accepted", "scored", "unscored",
+                    "features_dropped")
+
+    def _export_workers(self, snap: dict) -> None:
+        """Per-worker breakdown under rt/*/fastpath/worker/<i>/* when
+        the engine is sharded (stats() carries the raw per-worker
+        snapshots under ``workers``): the live proof that the kernel's
+        SO_REUSEPORT spread is actually using every core, and the
+        denominator for merged-equals-sum checks (validator cores
+        mode)."""
+        workers = snap.get("workers")
+        if not workers:
+            return
+        while len(self._last_workers) < len(workers):
+            self._last_workers.append({})
+        for i, ws in enumerate(workers):
+            if not ws:
+                # a failed scrape (oversized/errored stats JSON) must
+                # not reset this worker's baseline to zero — the next
+                # good scrape would re-count its whole history
+                continue
+            ns = ws.get("native_scorer") or {}
+            cur = {
+                "requests": sum(int(r.get("requests", 0)) for r in
+                                (ws.get("routes") or {}).values()),
+                "accepted": int(ws.get("accepted", 0)),
+                "scored": int(ns.get("scored", 0)),
+                "unscored": int(ns.get("unscored", 0)),
+                "features_dropped": int(ws.get("features_dropped", 0)),
+            }
+            prev = self._last_workers[i]
+            scope = self._scope.scope("worker", str(i))
+            for key in self._WORKER_KEYS:
+                delta = cur[key] - int(prev.get(key, 0))
+                if delta > 0:
+                    scope.counter(key).incr(delta)
+            self._last_workers[i] = cur
+
     def _export_stats(self) -> None:
         snap = self.engine.stats()
         self._export_tenants(snap)
+        self._export_workers(snap)
         tls = snap.get("tls")
         if tls and (tls.get("enabled") or tls.get("client_enabled")):
             scope = self._scope.scope("tls")

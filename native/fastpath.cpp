@@ -345,8 +345,15 @@ struct Engine {
     size_t features_cap = 65536;
     uint64_t features_dropped = 0;
     // in-data-plane scorer: weight slab has its own (lock-free reader)
-    // sync; score_stats is guarded by mu like the feature buffer
+    // sync; score_stats is guarded by mu like the feature buffer.
+    // `slab` is the slab this engine scores/publishes through — its own
+    // embedded one by default, or (multi-worker sharding) one external
+    // process-wide slab shared READ-ONLY by every worker's epoll thread
+    // (fp_attach_slab, called before fp_start): one publish flips the
+    // active buffer for all workers atomically, and the per-buffer
+    // reader refcounts aggregate every worker's in-flight evals.
     l5dscore::Slab scorer_slab;
+    l5dscore::Slab* slab = &scorer_slab;
     l5dscore::ScoreStats score_stats;
     // tenant accounting + per-tenant quotas (guarded by mu); the
     // extraction mode and guard knobs are installed BEFORE fp_start
@@ -1058,7 +1065,7 @@ void finish_exchange(Engine* e, Conn* up, bool upstream_reusable) {
                 const float drift =
                     l5dscore::feat_drift_update(&rf, lat_ms);
                 if (rf.col >= 0 &&
-                    l5dscore::slab_has_weights(&e->scorer_slab)) {
+                    l5dscore::slab_has_weights(e->slab)) {
                     l5dscore::featurize(
                         lat_ms, up->rsp_status,
                         (float)client->req_bytes,
@@ -1075,7 +1082,7 @@ void finish_exchange(Engine* e, Conn* up, bool upstream_reusable) {
     uint64_t score_ns = 0;
     if (have_feats) {
         const uint64_t t0 = l5dscore::now_ns();
-        if (l5dscore::slab_score(&e->scorer_slab, feats, &score)) {
+        if (l5dscore::slab_score(e->slab, feats, &score)) {
             scored = 1;
             score_ns = l5dscore::now_ns() - t0;
         }
@@ -1529,13 +1536,14 @@ int fp_start(void* ep) {
     return 0;
 }
 
-// Bind a listener; returns the bound port or -1. Call before fp_start.
-int fp_listen(void* ep, const char* ip, int port) {
-    Engine* e = (Engine*)ep;
+static int fp_listen_impl(Engine* e, const char* ip, int port,
+                          int reuseport) {
     int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
     if (fd < 0) return -1;
     int one = 1;
     setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    if (reuseport)
+        setsockopt(fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one));
     sockaddr_in sa{};
     sa.sin_family = AF_INET;
     sa.sin_port = htons((uint16_t)port);
@@ -1555,6 +1563,21 @@ int fp_listen(void* ep, const char* ip, int port) {
     epoll_ctl(e->epfd, EPOLL_CTL_ADD, fd, &ev);
     e->listeners.push_back(fd);
     return (int)ntohs(sa.sin_port);
+}
+
+// Bind a listener; returns the bound port or -1. Call before fp_start.
+int fp_listen(void* ep, const char* ip, int port) {
+    return fp_listen_impl((Engine*)ep, ip, port, 0);
+}
+
+// Like fp_listen, but SO_REUSEPORT: N per-core worker engines each
+// bind the SAME ip:port and the kernel distributes accepted
+// connections across them (the multi-core sharding seam — the first
+// worker binds port 0 to pick the port, the rest bind that concrete
+// port). The flag must be set on EVERY socket sharing the port, so
+// even the first worker of a shard group binds through this entry.
+int fp_listen_shared(void* ep, const char* ip, int port) {
+    return fp_listen_impl((Engine*)ep, ip, port, 1);
 }
 
 // 1 when the OpenSSL runtime could be dlopen'd (TLS termination /
@@ -1586,6 +1609,15 @@ int fp_listen_tls(void* ep, const char* ip, int port) {
     Engine* e = (Engine*)ep;
     if (e->tls_srv == nullptr) return -1;
     int got = fp_listen(ep, ip, port);
+    if (got >= 0) e->tls_listeners.insert(e->listeners.back());
+    return got;
+}
+
+// TLS + SO_REUSEPORT (see fp_listen_shared).
+int fp_listen_tls_shared(void* ep, const char* ip, int port) {
+    Engine* e = (Engine*)ep;
+    if (e->tls_srv == nullptr) return -1;
+    int got = fp_listen_shared(ep, ip, port);
     if (got >= 0) e->tls_listeners.insert(e->listeners.back());
     return got;
 }
@@ -1743,7 +1775,7 @@ long fp_stats_json(void* ep, char* buf, size_t cap) {
     s += ",";
     l5dtg::guard_json(e->guard, &s);
     s += ",";
-    l5dscore::stats_json(e->scorer_slab, e->score_stats, &s);
+    l5dscore::stats_json(*e->slab, e->score_stats, &s);
     s += "}";
     if (s.size() + 1 > cap) return -2;
     memcpy(buf, s.data(), s.size());
@@ -1837,7 +1869,23 @@ int fp_publish_weights(void* ep, const uint8_t* blob, size_t len,
                        "FEATURE_DIM");
         return -1;
     }
-    l5dscore::slab_install(&e->scorer_slab, std::move(m));
+    l5dscore::slab_install(e->slab, std::move(m));
+    return 0;
+}
+
+// Score/publish through an EXTERNAL weight slab (l5d_slab_create)
+// instead of the engine's embedded one — the multi-worker sharding
+// seam: every worker of one router attaches the same slab, so a single
+// publish (l5d_slab_publish, or fp_publish_weights on any one worker)
+// fans out to all cores atomically. slab == NULL restores the embedded
+// slab. Call BEFORE fp_start; the loop thread reads the pointer
+// unlocked (same contract as the TLS contexts). The caller owns the
+// external slab and must free it only after every attached engine has
+// shut down.
+int fp_attach_slab(void* ep, void* slab) {
+    Engine* e = (Engine*)ep;
+    if (e->thread_started) return -1;
+    e->slab = slab != nullptr ? (l5dscore::Slab*)slab : &e->scorer_slab;
     return 0;
 }
 

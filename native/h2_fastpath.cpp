@@ -158,8 +158,14 @@ struct Engine {
     size_t features_cap = 65536;
     uint64_t features_dropped = 0;
     // in-data-plane scorer: weight slab has its own (lock-free reader)
-    // sync; score_stats is guarded by mu like the feature buffer
+    // sync; score_stats is guarded by mu like the feature buffer.
+    // `slab` is the slab this engine scores/publishes through — its own
+    // embedded one by default, or (multi-worker sharding) one external
+    // process-wide slab shared READ-ONLY by every worker's epoll thread
+    // (fph2_attach_slab, called before fph2_start): one publish flips
+    // the active buffer for all workers atomically.
     l5dscore::Slab scorer_slab;
+    l5dscore::Slab* slab = &scorer_slab;
     l5dscore::ScoreStats score_stats;
     // tenant accounting + per-tenant quotas (guarded by mu); the
     // extraction mode and guard knobs are installed BEFORE fph2_start
@@ -588,7 +594,7 @@ void finish_stream(Engine* e, PStream* st, bool record) {
                 const float drift =
                     l5dscore::feat_drift_update(&rf, lat_ms);
                 if (rf.col >= 0 &&
-                    l5dscore::slab_has_weights(&e->scorer_slab)) {
+                    l5dscore::slab_has_weights(e->slab)) {
                     l5dscore::featurize(lat_ms, st->status,
                                         (float)st->req_b,
                                         (float)st->rsp_b, rf.col,
@@ -611,7 +617,7 @@ void finish_stream(Engine* e, PStream* st, bool record) {
         uint64_t score_ns = 0;
         if (have_feats) {
             const uint64_t t0 = l5dscore::now_ns();
-            if (l5dscore::slab_score(&e->scorer_slab, feats, &score)) {
+            if (l5dscore::slab_score(e->slab, feats, &score)) {
                 scored = 1;
                 score_ns = l5dscore::now_ns() - t0;
             }
@@ -2128,12 +2134,14 @@ int fph2_start(void* ep) {
     return 0;
 }
 
-int fph2_listen(void* ep, const char* ip, int port) {
-    Engine* e = (Engine*)ep;
+static int fph2_listen_impl(Engine* e, const char* ip, int port,
+                            int reuseport) {
     int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
     if (fd < 0) return -1;
     int one = 1;
     setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    if (reuseport)
+        setsockopt(fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one));
     sockaddr_in sa{};
     sa.sin_family = AF_INET;
     sa.sin_port = htons((uint16_t)port);
@@ -2153,6 +2161,17 @@ int fph2_listen(void* ep, const char* ip, int port) {
     epoll_ctl(e->epfd, EPOLL_CTL_ADD, fd, &ev);
     e->listeners.push_back(fd);
     return (int)ntohs(sa.sin_port);
+}
+
+int fph2_listen(void* ep, const char* ip, int port) {
+    return fph2_listen_impl((Engine*)ep, ip, port, 0);
+}
+
+// SO_REUSEPORT variant for multi-core sharding: N worker engines each
+// bind the SAME ip:port and the kernel distributes connections (see
+// fp_listen_shared in fastpath.cpp for the full contract).
+int fph2_listen_shared(void* ep, const char* ip, int port) {
+    return fph2_listen_impl((Engine*)ep, ip, port, 1);
 }
 
 // 1 when the OpenSSL runtime could be dlopen'd (TLS termination /
@@ -2184,6 +2203,15 @@ int fph2_listen_tls(void* ep, const char* ip, int port) {
     Engine* e = (Engine*)ep;
     if (e->tls_srv == nullptr) return -1;
     int got = fph2_listen(ep, ip, port);
+    if (got >= 0) e->tls_listeners.insert(e->listeners.back());
+    return got;
+}
+
+// TLS + SO_REUSEPORT (see fph2_listen_shared).
+int fph2_listen_tls_shared(void* ep, const char* ip, int port) {
+    Engine* e = (Engine*)ep;
+    if (e->tls_srv == nullptr) return -1;
+    int got = fph2_listen_shared(ep, ip, port);
     if (got >= 0) e->tls_listeners.insert(e->listeners.back());
     return got;
 }
@@ -2347,7 +2375,7 @@ long fph2_stats_json(void* ep, char* buf, size_t cap) {
     s += ",";
     l5dtg::guard_json(e->guard, &s);
     s += ",";
-    l5dscore::stats_json(e->scorer_slab, e->score_stats, &s);
+    l5dscore::stats_json(*e->slab, e->score_stats, &s);
     s += "}";
     if (s.size() + 1 > cap) return -2;
     memcpy(buf, s.data(), s.size());
@@ -2392,7 +2420,18 @@ int fph2_publish_weights(void* ep, const uint8_t* blob, size_t len,
                        "FEATURE_DIM");
         return -1;
     }
-    l5dscore::slab_install(&e->scorer_slab, std::move(m));
+    l5dscore::slab_install(e->slab, std::move(m));
+    return 0;
+}
+
+// Score/publish through an EXTERNAL shared weight slab — the
+// multi-worker sharding seam (see fp_attach_slab in fastpath.cpp for
+// the full contract). Call BEFORE fph2_start; NULL restores the
+// embedded slab.
+int fph2_attach_slab(void* ep, void* slab) {
+    Engine* e = (Engine*)ep;
+    if (e->thread_started) return -1;
+    e->slab = slab != nullptr ? (l5dscore::Slab*)slab : &e->scorer_slab;
     return 0;
 }
 

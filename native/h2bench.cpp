@@ -496,7 +496,8 @@ void load_handle_frame(Conn* c, LoadState* ls, uint8_t type, uint8_t flags,
 
 int run_load(const char* ip, int port, const char* authority, int conc,
              double seconds, int paysz, double rate_rps,
-             uint64_t* done_out, bool tls = false) {
+             uint64_t* done_out, bool tls = false,
+             int nconns_override = 0) {
     if (tls && g_tls_client == nullptr && !tls_client_init("h2"))
         return 1;
     // gRPC-framed echo message: 5-byte prefix + protobuf bytes field
@@ -517,7 +518,12 @@ int run_load(const char* ip, int port, const char* authority, int conc,
     h2::put_u32(&framed, (uint32_t)msg.size());
     framed += msg;
 
-    int nconns = std::max(1, conc / 16);
+    // --conns-per-worker spread: against an SO_REUSEPORT-sharded
+    // proxy the kernel balances per CONNECTION, so a loadgen that
+    // opens few fat conns can serialize onto one accept socket; the
+    // override forces enough conns to cover every worker
+    int nconns = nconns_override > 0 ? nconns_override
+                                     : std::max(1, conc / 16);
     int per_conn = std::max(1, conc / nconns);
 
     int epfd = epoll_create1(0);
@@ -726,13 +732,15 @@ struct H1Conn {
 };
 
 int run_h1_load(const char* ip, int port, const char* host, int conc,
-                double seconds, uint64_t* done_out, bool tls = false) {
+                double seconds, uint64_t* done_out, bool tls = false,
+                int nconns_override = 0) {
     if (tls && g_tls_client == nullptr && !tls_client_init("http/1.1"))
         return 1;
     char reqbuf[256];
     int reqlen = snprintf(reqbuf, sizeof(reqbuf),
                           "GET /bench HTTP/1.1\r\nHost: %s\r\n\r\n", host);
-    int nconns = std::max(1, conc / 16);
+    int nconns = nconns_override > 0 ? nconns_override
+                                     : std::max(1, conc / 16);
     int window = std::max(1, conc / nconns);
 
     int epfd = epoll_create1(0);
@@ -932,23 +940,45 @@ int main(int argc, char** argv) {
     signal(SIGINT, h2bench::on_sig);
     signal(SIGTERM, h2bench::on_sig);
     signal(SIGPIPE, SIG_IGN);
+    // --conns-per-worker N [--workers W]: force N*W client connections
+    // so a load run against an SO_REUSEPORT-sharded proxy spreads
+    // across every worker's accept socket (the kernel balances per
+    // connection). Flags are stripped before positional parsing.
+    int conns_per_worker = 0, workers = 1;
+    std::vector<char*> pos;
+    for (int i = 0; i < argc; i++) {
+        if (i + 1 < argc && strcmp(argv[i], "--conns-per-worker") == 0) {
+            conns_per_worker = atoi(argv[++i]);
+        } else if (i + 1 < argc && strcmp(argv[i], "--workers") == 0) {
+            workers = atoi(argv[++i]);
+        } else {
+            pos.push_back(argv[i]);
+        }
+    }
+    int nconns = conns_per_worker > 0
+        ? conns_per_worker * std::max(1, workers) : 0;
+    argc = (int)pos.size();
+    argv = pos.data();
     if (argc >= 3 && strcmp(argv[1], "serve") == 0)
         return h2bench::run_serve(atoi(argv[2]), nullptr);
     if (argc >= 7 && (strcmp(argv[1], "h1load") == 0 ||
                       strcmp(argv[1], "h1loadtls") == 0))
         return h2bench::run_h1_load(argv[2], atoi(argv[3]), argv[4],
                                     atoi(argv[5]), atof(argv[6]), nullptr,
-                                    strcmp(argv[1], "h1loadtls") == 0);
+                                    strcmp(argv[1], "h1loadtls") == 0,
+                                    nconns);
     if (argc >= 7 && (strcmp(argv[1], "load") == 0 ||
                       strcmp(argv[1], "loadtls") == 0))
         return h2bench::run_load(argv[2], atoi(argv[3]), argv[4],
                                  atoi(argv[5]), atof(argv[6]),
                                  argc > 7 ? atoi(argv[7]) : 128,
                                  argc > 8 ? atof(argv[8]) : 0.0, nullptr,
-                                 strcmp(argv[1], "loadtls") == 0);
+                                 strcmp(argv[1], "loadtls") == 0,
+                                 nconns);
     fprintf(stderr,
             "usage: h2bench serve <port> | h1load|h1loadtls <ip> <port> <host> <conc> <secs> | h2bench "
-            "load|loadtls <ip> <port> <authority> <conc> <secs> [paysz] [rate_rps]\n");
+            "load|loadtls <ip> <port> <authority> <conc> <secs> [paysz] [rate_rps]\n"
+            "       [--conns-per-worker N [--workers W]] forces N*W client conns (REUSEPORT spread)\n");
     return 2;
 }
 #endif  // H2BENCH_NO_MAIN

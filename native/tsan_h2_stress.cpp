@@ -26,6 +26,9 @@ extern "C" {
 void* fph2_create();
 int fph2_start(void* e);
 int fph2_listen(void* e, const char* ip, int port);
+int fph2_listen_shared(void* e, const char* ip, int port);
+int fph2_listen_tls_shared(void* e, const char* ip, int port);
+int fph2_attach_slab(void* e, void* slab);
 int fph2_set_route(void* e, const char* host, const char* endpoints);
 int fph2_remove_route(void* e, const char* host);
 long fph2_drain_misses(void* e, char* buf, size_t cap);
@@ -75,8 +78,10 @@ void* load_main(void* arg) {
     return nullptr;
 }
 
+constexpr int NWORKERS = 2;  // the engine under test is a shard group
+
 struct ChurnArgs {
-    void* engine = nullptr;
+    void* engines[NWORKERS] = {nullptr, nullptr};
     int serve_port = 0;
     std::atomic<int> stop{0};
     std::atomic<long> scored{0};    // drained rows the engine pre-scored
@@ -94,34 +99,47 @@ void* churn_main(void* arg) {
     char err[256];
     int i = 0;
     while (!a->stop.load(std::memory_order_relaxed)) {
-        // the whole Python-facing control surface, hammered
-        fph2_set_route(a->engine, "echoext", ep);
-        // scoring leg: the route-feature push rides every re-install
-        // (the Python controller's _push does the same), and weight
-        // blobs hot-swap mid-traffic — concurrent score + swap + drain
-        // is exactly the slab's seqlock contract under test
-        fph2_set_route_feature(a->engine, "echoext", 14, 1.0f);
+        // the whole Python-facing control surface, hammered —
+        // broadcast to every worker like the sharded wrapper does
+        for (int w = 0; w < NWORKERS; w++) {
+            fph2_set_route(a->engines[w], "echoext", ep);
+            // scoring leg: the route-feature push rides every
+            // re-install (the Python controller's _push does the
+            // same), and weight blobs hot-swap mid-traffic —
+            // concurrent score + swap + drain is exactly the slab's
+            // seqlock contract under test, now with BOTH workers'
+            // epoll threads reading the ONE shared slab
+            fph2_set_route_feature(a->engines[w], "echoext", 14, 1.0f);
+        }
         if (i % 4 == 0) {
             l5dscore::build_test_blob(&blob, (uint32_t)i, i % 2,
                                       (uint32_t)i);
-            if (fph2_publish_weights(a->engine, blob.data(), blob.size(),
+            // one publish through EITHER worker lands in the shared
+            // slab and fans out to all of them
+            if (fph2_publish_weights(a->engines[(i / 4) % NWORKERS],
+                                     blob.data(), blob.size(),
                                      err, sizeof(err)) == 0)
                 a->swaps.fetch_add(1);
         }
         if (i % 7 == 0) {
-            fph2_set_route(a->engine, "ghost", "127.0.0.1:1 ");
-            fph2_remove_route(a->engine, "ghost");
+            for (int w = 0; w < NWORKERS; w++) {
+                fph2_set_route(a->engines[w], "ghost", "127.0.0.1:1 ");
+                fph2_remove_route(a->engines[w], "ghost");
+            }
         }
         // per-tenant quota push/clear races the data plane's quota
         // reads in client_headers_complete
-        fph2_set_tenant_quota(a->engine,
-                              l5dtg::tenant_hash("echoext", 7),
-                              i % 2 ? 1024 : -1);
-        fph2_stats_json(a->engine, stats, 1 << 20);
-        fph2_drain_misses(a->engine, misses, 64 * 1024);
-        long n = fph2_drain_features(a->engine, feats, 4096);
-        for (long r = 0; r < n; r++)
-            if (feats[r * 9 + 7] > 0.5f) a->scored.fetch_add(1);
+        for (int w = 0; w < NWORKERS; w++)
+            fph2_set_tenant_quota(a->engines[w],
+                                  l5dtg::tenant_hash("echoext", 7),
+                                  i % 2 ? 1024 : -1);
+        for (int w = 0; w < NWORKERS; w++) {
+            fph2_stats_json(a->engines[w], stats, 1 << 20);
+            fph2_drain_misses(a->engines[w], misses, 64 * 1024);
+            long n = fph2_drain_features(a->engines[w], feats, 4096);
+            for (long r = 0; r < n; r++)
+                if (feats[r * 9 + 7] > 0.5f) a->scored.fetch_add(1);
+        }
         usleep(500);
         i++;
     }
@@ -189,12 +207,26 @@ int main() {
         return 2;
     }
 
-    void* eng = fph2_create();
-    int lport = fph2_listen(eng, "127.0.0.1", 0);
+    // the engine under test is a 2-worker shard group: shared ports
+    // (SO_REUSEPORT) + ONE shared weight slab read by both epoll
+    // threads (the multi-core topology, under the sanitizer)
+    void* engines[NWORKERS];
+    l5dscore::Slab shared_slab;
+    for (int w = 0; w < NWORKERS; w++) {
+        engines[w] = fph2_create();
+        fph2_attach_slab(engines[w], &shared_slab);
+    }
+    void* eng = engines[0];
+    int lport = fph2_listen_shared(eng, "127.0.0.1", 0);
     if (lport <= 0) {
         fprintf(stderr, "engine listen failed\n");
         return 2;
     }
+    for (int w = 1; w < NWORKERS; w++)
+        if (fph2_listen_shared(engines[w], "127.0.0.1", lport) <= 0) {
+            fprintf(stderr, "shared listen failed\n");
+            return 2;
+        }
     // TLS leg (cert provided by the runner + OpenSSL runtime loads):
     // h2c load -> front engine (TLS ORIGINATION, ALPN h2) -> this
     // engine's TLS listener (TERMINATION) -> echo server. Exercises the
@@ -206,15 +238,23 @@ int main() {
     int front_port = 0;
     if (tls_leg) {
         char err[256];
-        if (fph2_set_tls(eng, cert, key, "h2", err, sizeof(err)) != 0) {
-            fprintf(stderr, "fph2_set_tls: %s\n", err);
-            return 2;
-        }
-        int tls_port = fph2_listen_tls(eng, "127.0.0.1", 0);
+        for (int w = 0; w < NWORKERS; w++)
+            if (fph2_set_tls(engines[w], cert, key, "h2", err,
+                             sizeof(err)) != 0) {
+                fprintf(stderr, "fph2_set_tls: %s\n", err);
+                return 2;
+            }
+        int tls_port = fph2_listen_tls_shared(eng, "127.0.0.1", 0);
         if (tls_port <= 0) {
             fprintf(stderr, "tls listen failed\n");
             return 2;
         }
+        for (int w = 1; w < NWORKERS; w++)
+            if (fph2_listen_tls_shared(engines[w], "127.0.0.1",
+                                       tls_port) <= 0) {
+                fprintf(stderr, "shared tls listen failed\n");
+                return 2;
+            }
         front = fph2_create();
         if (fph2_set_client_tls(front, "h2", 0, nullptr, err,
                                 sizeof(err)) != 0) {
@@ -239,22 +279,25 @@ int main() {
     // tight preface budget for the slowloris thread, generous accept
     // throttle, small tenant LRU, and flood caps high enough that the
     // legit load never trips them
-    fph2_set_tenant(eng, 2, nullptr, 0);
-    fph2_set_guard(eng, /*header_ms=*/400, /*body_ms=*/400,
-                   /*accept_burst=*/100000, /*accept_window_ms=*/1000,
-                   /*max_hs_inflight=*/64, /*tenant_cap=*/16);
-    fph2_set_flood_guard(eng, /*max_streams=*/512, /*rst=*/100000,
-                         /*ping=*/100000, /*settings=*/100000,
-                         /*window_ms=*/1000);
-    fph2_start(eng);
+    for (int w = 0; w < NWORKERS; w++) {
+        fph2_set_tenant(engines[w], 2, nullptr, 0);
+        fph2_set_guard(engines[w], /*header_ms=*/400, /*body_ms=*/400,
+                       /*accept_burst=*/100000, /*accept_window_ms=*/1000,
+                       /*max_hs_inflight=*/64, /*tenant_cap=*/16);
+        fph2_set_flood_guard(engines[w], /*max_streams=*/512,
+                             /*rst=*/100000, /*ping=*/100000,
+                             /*settings=*/100000, /*window_ms=*/1000);
+        fph2_start(engines[w]);
+    }
 
     ChurnArgs ca;
-    ca.engine = eng;
+    for (int w = 0; w < NWORKERS; w++) ca.engines[w] = engines[w];
     ca.serve_port = sa.bound_port.load();
     // install the route up-front (the churn thread keeps re-installing)
     char ep[64];
     snprintf(ep, sizeof(ep), "127.0.0.1:%d ", sa.bound_port.load());
-    fph2_set_route(eng, "echoext", ep);
+    for (int w = 0; w < NWORKERS; w++)
+        fph2_set_route(engines[w], "echoext", ep);
     pthread_t churn_t;
     pthread_create(&churn_t, nullptr, churn_main, &ca);
 
@@ -284,7 +327,9 @@ int main() {
     ca.stop.store(1);
     pthread_join(churn_t, nullptr);
     if (front != nullptr) fph2_shutdown(front);
-    fph2_shutdown(eng);
+    // every worker joins its loop thread BEFORE the shared slab (a
+    // stack local) goes out of scope — mirrors the wrapper's close()
+    for (int w = 0; w < NWORKERS; w++) fph2_shutdown(engines[w]);
     h2bench::g_stop.store(1);
     pthread_join(serve_t, nullptr);
 

@@ -28,6 +28,9 @@ extern "C" {
 void* fp_create();
 int fp_start(void* ep);
 int fp_listen(void* ep, const char* ip, int port);
+int fp_listen_shared(void* ep, const char* ip, int port);
+int fp_listen_tls_shared(void* ep, const char* ip, int port);
+int fp_attach_slab(void* ep, void* slab);
 int fp_set_route(void* ep, const char* host, const char* endpoints);
 int fp_remove_route(void* ep, const char* host);
 long fp_drain_misses(void* ep, char* buf, size_t cap);
@@ -193,22 +196,50 @@ int main() {
     // of the memory-BIO pump run under the sanitizer; no TLS client
     // code needed. TLS contexts/listeners are installed BEFORE start()
     // (the wrapper's contract: the loop thread reads them unlocked).
-    void* ep = fp_create();
+    //
+    // Multi-worker leg: the engine under test is a TWO-worker shard
+    // group — both workers accept from the SAME ports (SO_REUSEPORT)
+    // and score through ONE shared weight slab, so every other leg
+    // (traffic, slowloris, churn, rotating-tenant LRU, quota pushes,
+    // weight hot-swaps, stats/feature drains) now runs against the
+    // sharded topology with two epoll threads reading the slab
+    // concurrently while the swapper publishes.
+    constexpr int NWORKERS = 2;
+    void* workers[NWORKERS];
+    l5dscore::Slab shared_slab;
+    for (int w = 0; w < NWORKERS; w++) {
+        workers[w] = fp_create();
+        fp_attach_slab(workers[w], &shared_slab);
+    }
+    void* ep = workers[0];  // publish/config entry point
     void* front = nullptr;
     const char* cert = getenv("L5D_STRESS_CERT");
     const char* key = getenv("L5D_STRESS_KEY");
     bool tls_leg = cert && key && fp_tls_runtime_available();
-    int proxy_port = fp_listen(ep, "127.0.0.1", 0);
+    int proxy_port = fp_listen_shared(ep, "127.0.0.1", 0);
     if (proxy_port <= 0) { fprintf(stderr, "fp_listen failed\n"); return 2; }
+    for (int w = 1; w < NWORKERS; w++)
+        if (fp_listen_shared(workers[w], "127.0.0.1", proxy_port) <= 0) {
+            fprintf(stderr, "shared listen failed\n");
+            return 2;
+        }
     int tls_port = 0, front_port = 0;
     if (tls_leg) {
         char err[256];
-        if (fp_set_tls(ep, cert, key, "http/1.1", err, sizeof(err)) != 0) {
-            fprintf(stderr, "fp_set_tls: %s\n", err);
-            return 2;
-        }
-        tls_port = fp_listen_tls(ep, "127.0.0.1", 0);
+        for (int w = 0; w < NWORKERS; w++)
+            if (fp_set_tls(workers[w], cert, key, "http/1.1", err,
+                           sizeof(err)) != 0) {
+                fprintf(stderr, "fp_set_tls: %s\n", err);
+                return 2;
+            }
+        tls_port = fp_listen_tls_shared(ep, "127.0.0.1", 0);
         if (tls_port <= 0) { fprintf(stderr, "tls listen failed\n"); return 2; }
+        for (int w = 1; w < NWORKERS; w++)
+            if (fp_listen_tls_shared(workers[w], "127.0.0.1",
+                                     tls_port) <= 0) {
+                fprintf(stderr, "shared tls listen failed\n");
+                return 2;
+            }
         front = fp_create();
         if (fp_set_client_tls(front, "http/1.1", 0, nullptr, err,
                               sizeof(err)) != 0) {
@@ -229,21 +260,29 @@ int main() {
     // generous accept throttle (the legit clients must keep flowing),
     // and a small tenant LRU so the rotating-tenant clients force
     // evictions under concurrent stats/feature drains
-    fp_set_tenant(ep, 1, "l5d-tenant", 0);
-    fp_set_guard(ep, /*header_ms=*/400, /*body_ms=*/400,
-                 /*accept_burst=*/100000, /*accept_window_ms=*/1000,
-                 /*max_hs_inflight=*/64, /*tenant_cap=*/16);
-    if (fp_start(ep) != 0) { fprintf(stderr, "fp_start failed\n"); return 2; }
+    for (int w = 0; w < NWORKERS; w++) {
+        fp_set_tenant(workers[w], 1, "l5d-tenant", 0);
+        fp_set_guard(workers[w], /*header_ms=*/400, /*body_ms=*/400,
+                     /*accept_burst=*/100000, /*accept_window_ms=*/1000,
+                     /*max_hs_inflight=*/64, /*tenant_cap=*/16);
+        if (fp_start(workers[w]) != 0) {
+            fprintf(stderr, "fp_start failed\n");
+            return 2;
+        }
+    }
 
     char endpoints[64];
     snprintf(endpoints, sizeof(endpoints), "127.0.0.1:%d", backend_port);
     for (int i = 0; i < 4; i++) {
         char host[32];
         snprintf(host, sizeof(host), "svc-%d", i);
-        fp_set_route(ep, host, endpoints);
-        // scoring leg: push each route's dst-hash feature column so
-        // the in-engine scorer featurizes its rows
-        fp_set_route_feature(ep, host, 14 + i, i % 2 ? -1.0f : 1.0f);
+        for (int w = 0; w < NWORKERS; w++) {
+            fp_set_route(workers[w], host, endpoints);
+            // scoring leg: push each route's dst-hash feature column so
+            // the in-engine scorer featurizes its rows
+            fp_set_route_feature(workers[w], host, 14 + i,
+                                 i % 2 ? -1.0f : 1.0f);
+        }
     }
     if (front != nullptr) {
         if (fp_start(front) != 0) {
@@ -262,51 +301,63 @@ int main() {
     // control-plane churn thread: install/remove ONE route while
     // traffic runs (svc-0..2 stay stable so their rows keep scoring
     // in-engine; svc-3 exercises the remove/re-add + feature-re-push
-    // path the Python controller's _push performs on every update)
+    // path the Python controller's _push performs on every update) —
+    // broadcast to every worker, exactly as the sharded wrapper does
     std::thread churn([&] {
         int gen = 0;
         while (!stop.load()) {
-            fp_remove_route(ep, "svc-3");
+            for (int w = 0; w < NWORKERS; w++)
+                fp_remove_route(workers[w], "svc-3");
             usleep(500);
-            fp_set_route(ep, "svc-3", endpoints);
-            fp_set_route_feature(ep, "svc-3", 17,
-                                 gen % 2 ? -1.0f : 1.0f);
+            for (int w = 0; w < NWORKERS; w++) {
+                fp_set_route(workers[w], "svc-3", endpoints);
+                fp_set_route_feature(workers[w], "svc-3", 17,
+                                     gen % 2 ? -1.0f : 1.0f);
+            }
             // per-tenant quota push/clear races the data plane's
             // quota reads (the TenantAdmission actuation path)
             unsigned int th = l5dtg::tenant_hash("t-3", 3);
-            fp_set_tenant_quota(ep, th, gen % 2 ? 1 : -1);
+            for (int w = 0; w < NWORKERS; w++)
+                fp_set_tenant_quota(workers[w], th, gen % 2 ? 1 : -1);
             gen++;
             usleep(1500);
         }
     });
 
     // weight-swap thread: alternating f32/int8 blobs hot-swap into
-    // the slab while the epoll thread scores concurrently — the
-    // double-buffer + reader-recheck protocol under sanitizer fire
+    // the SHARED slab while both workers' epoll threads score
+    // concurrently — the double-buffer + reader-recheck protocol with
+    // multi-core readers under sanitizer fire. One publish (through
+    // any worker) must fan out to every worker atomically.
     std::thread swapper([&] {
         std::vector<uint8_t> blob;
         char err[256];
         uint32_t gen = 0;
         while (!stop.load()) {
             l5dscore::build_test_blob(&blob, gen, (int)(gen % 2), gen);
-            if (fp_publish_weights(ep, blob.data(), blob.size(), err,
-                                   sizeof(err)) == 0)
+            if (fp_publish_weights(workers[gen % NWORKERS], blob.data(),
+                                   blob.size(), err, sizeof(err)) == 0)
                 weight_swaps.fetch_add(1);
             gen++;
             usleep(1000);
         }
     });
 
-    // drain thread: misses + stats + features, like the Python controller
+    // drain thread: misses + stats + features from EVERY worker, like
+    // the sharded Python controller's fan-in
     std::thread drain([&] {
         std::vector<char> buf(1 << 16);
         std::vector<float> feats(64 * 1024);
         while (!stop.load()) {
-            fp_drain_misses(ep, buf.data(), buf.size());
-            fp_stats_json(ep, buf.data(), buf.size());
-            long n = fp_drain_features(ep, feats.data(), 1024);
-            for (long r = 0; r < n; r++)
-                if (feats[r * 9 + 7] > 0.5f) scored_rows.fetch_add(1);
+            for (int w = 0; w < NWORKERS; w++) {
+                fp_drain_misses(workers[w], buf.data(), buf.size());
+                fp_stats_json(workers[w], buf.data(), buf.size());
+                long n = fp_drain_features(workers[w], feats.data(),
+                                           1024);
+                for (long r = 0; r < n; r++)
+                    if (feats[r * 9 + 7] > 0.5f)
+                        scored_rows.fetch_add(1);
+            }
             if (front != nullptr) {
                 fp_drain_misses(front, buf.data(), buf.size());
                 fp_stats_json(front, buf.data(), buf.size());
@@ -333,7 +384,9 @@ int main() {
     swapper.join();
     drain.join();
     if (front != nullptr) fp_shutdown(front);
-    fp_shutdown(ep);
+    // every worker joins its loop thread here, BEFORE the shared slab
+    // (a stack local) goes out of scope — mirrors the wrapper's close()
+    for (int w = 0; w < NWORKERS; w++) fp_shutdown(workers[w]);
     shutdown(lfd, SHUT_RDWR);
     close(lfd);
     backend.detach();

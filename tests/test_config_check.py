@@ -354,6 +354,59 @@ class TestTenantConfigRules:
         assert rules_of(check_text(cfg), "tenant-config") == []
 
 
+class TestFastpathWorkersRules:
+    @pytest.fixture(autouse=True)
+    def _pin_cores(self, monkeypatch):
+        # the rule compares against the HOST's core count; pin it so
+        # these fixtures behave identically on 1-core CI containers
+        # and 96-core build boxes
+        monkeypatch.setattr(os, "cpu_count", lambda: 8)
+
+    def test_workers_without_fastpath_fires(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  workers: 2\n"))
+        (f,) = rules_of(check_text(cfg), "fastpath-workers")
+        assert "fastPath" in f.message
+
+    def test_workers_above_hw_cores_warns(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  fastPath: true\n  workers: 16\n"))
+        (f,) = rules_of(check_text(cfg), "fastpath-workers")
+        assert f.severity == "warning"
+        assert "hardware cores" in f.message
+
+    def test_workers_out_of_range_fires(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  fastPath: true\n  workers: 9999\n"))
+        (f,) = rules_of(check_text(cfg), "fastpath-workers")
+        assert "1..64" in f.message
+
+    def test_floor_quota_rounds_to_zero_warns(self):
+        # floor 0.1 x engineBase 8 = 1 floor quota; split 2 ways -> 0
+        # per worker: a "floored" sick tenant is actually shed entirely
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  fastPath: true\n"
+            "  workers: 2\n"
+            "  tenantIdentifier: {kind: header}\n"
+            "  tenants: {floor: 0.1, engineBase: 8}\n"))
+        (f,) = rules_of(check_text(cfg), "fastpath-workers")
+        assert f.severity == "warning"
+        assert "ZERO per worker" in f.message
+
+    def test_healthy_workers_block_is_clean(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  fastPath: true\n"
+            "  workers: 2\n"
+            "  tenantIdentifier: {kind: header}\n"
+            "  tenants: {floor: 0.1, engineBase: 64}\n"))
+        assert rules_of(check_text(cfg), "fastpath-workers") == []
+
+    def test_workers_auto_is_clean(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  fastPath: true\n  workers: 0\n"))
+        assert rules_of(check_text(cfg), "fastpath-workers") == []
+
+
 class TestRegistryCrossCheck:
     def test_unknown_kind_fires_with_known_list(self):
         cfg = """

@@ -1022,10 +1022,14 @@ namers:
 
 @native_only
 class TestChaosMatrixNative:
-    def test_isolation_holds_during_weight_hot_swap(self):
+    @pytest.mark.parametrize("workers", [1, 2])
+    def test_isolation_holds_during_weight_hot_swap(self, workers):
         """Native leg: attacker quota-shed in the ENGINE while weight
         blobs hot-swap concurrently — the victim's success rate and
-        the engine's scoring pipeline both hold."""
+        the engine's scoring pipeline both hold. Runs at workers=1
+        (today's single engine) AND workers=2 (the SO_REUSEPORT shard
+        group: per-core tenant tables, the N-way quota split, and the
+        shared weight slab must not break the isolation loop)."""
 
         async def go():
             async def handle(reader, writer):
@@ -1042,12 +1046,15 @@ class TestChaosMatrixNative:
 
             srv = await asyncio.start_server(handle, "127.0.0.1", 0)
             bport = srv.sockets[0].getsockname()[1]
-            eng = native.FastPathEngine()
+            eng = native.FastPathEngine(workers=workers)
             eng.set_tenant("header", "l5d-tenant")
             port = eng.listen("127.0.0.1", 0)
             eng.start()
             eng.set_route("svc", [("127.0.0.1", bport)])
             eng.set_route_feature("svc", 14, 1.0)
+            # workers=2 splits this floor-division: 1 // 2 = 0 per
+            # worker — the attacker is shed entirely, the victim
+            # (quota-less) must still sail through on every core
             eng.set_tenant_quota(tenant_hash("attacker"), 1)
 
             swaps = 0

@@ -32,6 +32,7 @@ SEMANTIC_RULES = (
     "timeout-inversion", "retry-starved", "admission-deadline",
     "tls-missing-cert",
     "tenant-config",      # tenantIdentifier/tenants/connectionGuard wiring
+    "fastpath-workers",   # multi-core sharding knob wiring
     "scorer-config", "scorer-width",
     "override-unsafe",    # reactor-generated dtab overrides (control/)
 )

@@ -137,6 +137,7 @@ class RouterChecks:
             yield from self.check_timeouts_retries(rspec, where)
             yield from self.check_admission(rspec, where)
             yield from self.check_tenants(rspec, where)
+            yield from self.check_workers(rspec, where)
             yield from self.check_tls(rspec, where)
 
     def _router_spans(self) -> List[Tuple[int, int]]:
@@ -337,6 +338,10 @@ class RouterChecks:
                                           line=line)
                 tid = None
             if tid is not None and tid.kind == "sni":
+                # both data planes surface SNI now (the engines via
+                # SSL_get_servername, the asyncio servers via the
+                # sni_callback on TlsServerConfig contexts) — the only
+                # inert shape left is having no TLS listener at all
                 has_tls_server = any(s.tls is not None
                                      for s in rspec.servers or [])
                 if not has_tls_server:
@@ -347,14 +352,6 @@ class RouterChecks:
                         f"TLS, so no request ever carries a server "
                         f"name and every request is tenantless",
                         line=line)
-                elif not rspec.fastPath:
-                    yield self.source.finding(
-                        "tenant-config",
-                        f"{where}.tenantIdentifier: kind sni is only "
-                        f"extracted on fastPath TLS listeners — the "
-                        f"Python data plane does not surface the "
-                        f"server name",
-                        line=line, severity="warning")
         ts = rspec.tenants
         if ts is not None:
             line = self._anchor("tenants")
@@ -408,6 +405,78 @@ class RouterChecks:
                 yield self.source.finding(
                     "tenant-config", str(e),
                     line=self._anchor("connectionGuard"))
+
+    # -- multi-core sharding -----------------------------------------------
+    def check_workers(self, rspec: RouterSpec, where: str
+                      ) -> Iterator[Finding]:
+        """``workers`` (the multi-core native data plane knob) wiring:
+        it only exists on the native engines (fastPath), more shards
+        than hardware cores just context-switch, and a per-tenant
+        floor quota that rounds to ZERO after the N-way split sheds a
+        sick tenant entirely instead of flooring it."""
+        if rspec.workers is None:
+            return
+        line = self._anchor("workers")
+        try:
+            n = int(rspec.workers)
+        except (TypeError, ValueError):
+            yield self.source.finding(
+                "fastpath-workers",
+                f"{where}.workers must be an integer (0 = auto), got "
+                f"{rspec.workers!r}",
+                line=line)
+            return
+        if not rspec.fastPath:
+            yield self.source.finding(
+                "fastpath-workers",
+                f"{where}.workers requires fastPath: true — the sharded "
+                f"epoll workers ARE the native engines; the asyncio "
+                f"data plane is single-loop and the linker refuses "
+                f"this config at load",
+                line=line)
+            return
+        # the importable module constants ARE the linker's bounds (the
+        # native module imports without a toolchain; nothing builds)
+        from linkerd_tpu.native import FastPathEngine, auto_workers
+        max_workers = FastPathEngine.MAX_WORKERS
+        ncpu = os.cpu_count() or 1
+        if n < 0 or n > max_workers:
+            yield self.source.finding(
+                "fastpath-workers",
+                f"{where}.workers must be 0 (auto) or in "
+                f"1..{max_workers}, got {n} — the linker refuses this "
+                f"config at load",
+                line=line)
+            return
+        if n > ncpu:
+            yield self.source.finding(
+                "fastpath-workers",
+                f"{where}.workers: {n} exceeds the {ncpu} hardware "
+                f"cores on this host — extra workers add context "
+                f"switches and split the per-core pools thinner "
+                f"without adding parallelism (use workers: 0 for "
+                f"auto = min(4, cores))",
+                line=line, severity="warning")
+        resolved = auto_workers() if n == 0 else n
+        ts = rspec.tenants
+        if resolved > 1 and ts is not None \
+                and rspec.tenantIdentifier is not None:
+            try:
+                ts.validate(f"{where}.tenants")
+            except ConfigError:
+                return  # tenant-config already reports it
+            floor_quota = max(1, round(ts.floor * ts.engineBase))
+            if floor_quota // resolved == 0:
+                yield self.source.finding(
+                    "fastpath-workers",
+                    f"{where}.tenants: the floor quota "
+                    f"(floor {ts.floor} x engineBase {ts.engineBase} "
+                    f"= {floor_quota}) rounds to ZERO per worker "
+                    f"after the {resolved}-way split — a sick tenant "
+                    f"is shed entirely instead of floored; raise "
+                    f"engineBase to at least "
+                    f"{max(1, round(resolved / ts.floor))}",
+                    line=line, severity="warning")
 
     # -- TLS ---------------------------------------------------------------
     def check_tls(self, rspec: RouterSpec, where: str) -> Iterator[Finding]:

@@ -96,6 +96,18 @@ at the NATIVE tier, the victim's success rate stayed >= 0.99, and the
 ``rt/*/fastpath/tenant/*`` metrics agree with admin ``/tenants.json``:
 
     python tools/validator.py tenant
+
+And the multi-core validation: boot the REAL linkerd binary with a
+``fastPath: true`` router sharded across two SO_REUSEPORT workers
+(``workers: 2``), drive paced traffic over many distinct connections,
+and assert from live metrics that BOTH workers served requests
+(``rt/*/fastpath/worker/<i>/*`` only moves when that worker's epoll
+loop retired an exchange), that the merged route counters equal the sum
+of the per-worker counters (the merge-at-scrape rule), and that the
+scored fraction stayed 1.0 — the shared read-only weight slab reached
+every core:
+
+    python tools/validator.py cores
 """
 
 from __future__ import annotations
@@ -135,6 +147,7 @@ PORTS = {
     "native-score": {"linkerd": 32140, "admin": 32990, "a": 32801},
     "tenant": {"linkerd": 33140, "admin": 33990, "a": 33801,
                "b": 33802},
+    "cores":  {"linkerd": 34140, "admin": 34990, "a": 34801},
 }
 
 IFACE_YAML = {
@@ -1106,6 +1119,156 @@ admin:
         d_a.close()
 
 
+async def validate_cores() -> None:
+    """Boot the REAL linkerd binary with a fastPath router sharded
+    ``workers: 2`` and prove the multi-core data plane from live state:
+
+    - both workers served: ``rt/*/fastpath/worker/<i>/requests`` grew
+      for i = 0 AND 1 (each counter only moves when that worker's own
+      epoll loop retired an exchange — the kernel's SO_REUSEPORT
+      spread is real, not one hot socket);
+    - merge-at-scrape holds: the merged route counter equals the sum
+      of the per-worker request counters;
+    - the shared weight slab reached every core: zero ``unscored``
+      growth and ``anomaly/scored_total == anomaly/requests_total``
+      over the measured window (scored fraction 1.0).
+
+    Prints one ``CORES {json}`` line."""
+    from linkerd_tpu import native
+    if not native.ensure_built():
+        raise AssertionError(
+            "native toolchain unavailable — the cores validation proves "
+            "the sharded C++ engines served, so a missing toolchain is "
+            "a failure here, not a skip")
+
+    ports = PORTS["cores"]
+    work = tempfile.mkdtemp(prefix="l5d-validate-cores-")
+    disco = os.path.join(work, "disco")
+    os.makedirs(disco)
+    d_a = await downstream("A", ports["a"])
+    with open(os.path.join(disco, "web"), "w") as f:
+        f.write(f"127.0.0.1 {ports['a']}\n")
+
+    linkerd_yaml = os.path.join(work, "linkerd.yaml")
+    with open(linkerd_yaml, "w") as f:
+        f.write(f"""
+routers:
+- protocol: http
+  label: cores
+  fastPath: true
+  workers: 2
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers:
+  - port: {ports['linkerd']}
+namers:
+- kind: io.l5d.fs
+  rootDir: {disco}
+telemetry:
+- kind: io.l5d.jaxAnomaly
+  maxBatch: 256
+  trainEveryBatches: 0
+admin:
+  port: {ports['admin']}
+""")
+
+    def metrics(q: str) -> dict:
+        _, _, body = http(
+            "GET", f"http://127.0.0.1:{ports['admin']}"
+                   f"/admin/metrics.json?q={q}")
+        return json.loads(body)
+
+    def all_metrics() -> dict:
+        m = metrics("rt/cores/fastpath")
+        m.update(metrics("anomaly"))
+        return m
+
+    def route_ok() -> bool:
+        st, _, body = http(
+            "GET", f"http://127.0.0.1:{ports['linkerd']}/",
+            headers={"Host": "web"})
+        return st == 200 and body == b"A"
+
+    def one() -> None:
+        # urllib opens a FRESH connection per call: each request is a
+        # new 4-tuple, so the kernel's per-connection REUSEPORT hash
+        # keeps spreading across workers
+        st, _, _ = http(
+            "GET", f"http://127.0.0.1:{ports['linkerd']}/",
+            headers={"Host": "web"})
+        assert st == 200
+
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    linkerd = None
+    try:
+        linkerd = subprocess.Popen(
+            [sys.executable, "-m", "linkerd_tpu", linkerd_yaml],
+            env=env, cwd=work)
+        await wait_for(route_ok, 30, "cores route up")
+        # warm: let the startup weight export + route feature push land
+        for _ in range(20):
+            await asyncio.to_thread(one)
+        await wait_for(
+            lambda: metrics("rt/cores/fastpath/scorer").get(
+                "rt/cores/fastpath/scorer/weights", 0) == 1,
+            30, "weight blob published to the shard group")
+        await asyncio.sleep(1.2)  # settle the warmup into the counters
+        m0 = all_metrics()
+
+        n = 240
+        for i in range(n):
+            await asyncio.to_thread(one)
+            if i % 10 == 0:
+                await asyncio.sleep(0.01)  # paced-ish
+
+        def d(m, key):
+            return m.get(key, 0) - m0.get(key, 0)
+
+        def settled() -> bool:
+            m = all_metrics()
+            return (d(m, "rt/cores/fastpath/route/web/requests") >= n
+                    and d(m, "anomaly/scored_total")
+                    == d(m, "anomaly/requests_total")
+                    and d(m, "anomaly/requests_total") >= n)
+        await wait_for(settled, 20, "measured window drained + scored")
+
+        m1 = all_metrics()
+        per_worker = [
+            d(m1, f"rt/cores/fastpath/worker/{i}/requests")
+            for i in range(2)]
+        merged = d(m1, "rt/cores/fastpath/route/web/requests")
+        unscored = d(m1, "rt/cores/fastpath/scorer/unscored")
+        scored = d(m1, "anomaly/scored_total")
+        total = d(m1, "anomaly/requests_total")
+        assert all(w > 0 for w in per_worker), (
+            f"one worker served nothing: {per_worker} — the REUSEPORT "
+            f"spread is not reaching every core")
+        assert merged == sum(per_worker), (
+            f"merged route counter {merged} != sum of per-worker "
+            f"counters {per_worker} — the merge-at-scrape rule broke")
+        assert unscored == 0, \
+            f"{unscored} rows fell back to the JAX tier mid-window"
+        frac = scored / total if total else 0.0
+        assert frac == 1.0, \
+            f"scored fraction {frac} ({scored}/{total})"
+        print("CORES " + json.dumps({
+            "requests": n,
+            "per_worker_requests": per_worker,
+            "merged_requests": merged,
+            "engine_unscored": unscored,
+            "scored_fraction": frac,
+            "workers": 2,
+        }))
+    finally:
+        if linkerd is not None:
+            linkerd.send_signal(signal.SIGTERM)
+            try:
+                linkerd.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                linkerd.kill()
+        d_a.close()
+
+
 async def validate_tenant() -> None:
     """Boot the REAL linkerd binary with a fastPath router carrying
     the full tenant-isolation stack (tenantIdentifier + tenants quota
@@ -1537,6 +1700,10 @@ async def main() -> int:
     if args and args[0] == "tenant":
         await validate_tenant()
         print("VALIDATOR PASS (tenant)")
+        return 0
+    if args and args[0] == "cores":
+        await validate_cores()
+        print("VALIDATOR PASS (cores)")
         return 0
     protocols = args or ["mesh", "thrift", "http"]
     for protocol in protocols:
