@@ -933,6 +933,197 @@ def fault_auc_bench() -> dict:
     return asyncio.run(bench(80))
 
 
+def fleet_bench() -> dict:
+    """Fleet coordination, in-process and device-free: THREE real
+    linkers (each with the jaxAnomaly ``control.fleet`` block and a
+    stub scorer) bound through one real namerd, admin servers carrying
+    the gossip endpoint. Reports ``fleet_req_s`` (aggregate throughput
+    through all three instances) and ``fleet_shift_latency_ms``
+    (anomaly onset on a 2-of-3 quorum -> first request observed
+    shifted at the UNfaulted instance), for gossip and namerd-mediated
+    propagation."""
+    import asyncio
+    import tempfile
+
+    import numpy as np
+
+    from linkerd_tpu.admin.server import AdminServer
+    from linkerd_tpu.core import Dtab, Path
+    from linkerd_tpu.linker import load_linker
+    from linkerd_tpu.namer.fs import FsNamer
+    from linkerd_tpu.namerd import InMemoryDtabStore, Namerd
+    from linkerd_tpu.namerd.http_api import HttpControlService
+    from linkerd_tpu.protocol.http import Request, Response
+    from linkerd_tpu.protocol.http.client import HttpClient
+    from linkerd_tpu.protocol.http.server import HttpServer, serve
+    from linkerd_tpu.router.service import FnService
+    from linkerd_tpu.testing.fleet import free_port
+
+    N = 3
+
+    class _LevelScorer:
+        def __init__(self):
+            self.level = 0.0
+
+        async def score(self, x):
+            return np.full(len(x), self.level, np.float32)
+
+        async def fit(self, x, labels, mask):
+            return 0.0
+
+        def close(self):
+            pass
+
+    async def one_round(gossip: bool) -> dict:
+        async def body_of(name):
+            async def h(req):
+                return Response(200, body=name)
+            return h
+
+        back_a = await serve(FnService(await body_of(b"a")))
+        back_b = await serve(FnService(await body_of(b"b")))
+        work = tempfile.mkdtemp(prefix="l5d-bench-fleet-")
+        with open(os.path.join(work, "web"), "w") as f:
+            f.write(f"127.0.0.1 {back_a.bound_port}\n")
+        with open(os.path.join(work, "web-b"), "w") as f:
+            f.write(f"127.0.0.1 {back_b.bound_port}\n")
+        namerd = Namerd(
+            InMemoryDtabStore(
+                {"default": Dtab.read("/svc => /#/io.l5d.fs ;")}),
+            namers=[(Path.read("/io.l5d.fs"), FsNamer(work))])
+        ctl_srv = await HttpServer(HttpControlService(namerd)).start()
+        admin_ports = [free_port() for _ in range(N)]
+        linkers, scorers, drains, admins, clients = [], [], [], [], []
+        try:
+            for i in range(N):
+                peers = [f"127.0.0.1:{p}"
+                         for j, p in enumerate(admin_ports) if j != i]
+                peers_yaml = "".join(f"\n        - {p}" for p in peers)
+                linker = load_linker(f"""
+routers:
+- protocol: http
+  label: fleet-bench-{i}
+  servers: [{{port: 0}}]
+  interpreter:
+    kind: io.l5d.namerd.http
+    dst: /$/inet/127.0.0.1/{ctl_srv.bound_port}
+    namespace: default
+telemetry:
+- kind: io.l5d.jaxAnomaly
+  maxLingerMs: 1
+  trainEveryBatches: 0
+  scoreTtlSecs: 10
+  control:
+    intervalMs: 10
+    warmupBatches: 1
+    enterThreshold: 0.6
+    exitThreshold: 0.2
+    quorum: 2
+    cooldownS: 0.05
+    namespace: default
+    namerdAddress: 127.0.0.1:{ctl_srv.bound_port}
+    failover:
+      /svc/web: /svc/web-b
+    fleet:
+      instance: bench-{i}
+      generation: 1
+      quorum: 2
+      expectInstances: {N}
+      publishIntervalS: {0.05 if not gossip else 0.5}
+      stalenessTtlS: 5.0
+      gossip: {str(gossip).lower()}
+      gossipIntervalMs: 25
+      peers:{peers_yaml}
+""")
+                tele = linker.telemeters[0]
+                scorer = _LevelScorer()
+                tele._scorer = scorer
+                await linker.start()
+                admin = AdminServer(linker.metrics, port=admin_ports[i])
+                for path, handler in tele.admin_handlers():
+                    admin.add_handler(path, handler)
+                await admin.start()
+                drains.append(asyncio.ensure_future(tele.run()))
+                linkers.append(linker)
+                scorers.append(scorer)
+                admins.append(admin)
+                clients.append(HttpClient(
+                    "127.0.0.1", linker.routers[0].server_ports[0]))
+
+            async def one(i) -> bytes:
+                req = Request(uri="/")
+                req.headers.set("Host", "web")
+                return (await clients[i](req)).body
+
+            for i in range(N):
+                assert await one(i) == b"a"
+
+            # aggregate throughput: 4 closed-loop workers per instance
+            async def worker(i, stop_at):
+                n = 0
+                while time.perf_counter() < stop_at:
+                    await one(i)
+                    n += 1
+                return n
+
+            stop_at = time.perf_counter() + 2.0
+            counts = await asyncio.gather(
+                *(worker(i, stop_at) for i in range(N) for _ in range(4)))
+            req_s = sum(counts) / 2.0
+
+            # shift latency: anomaly onset on a 2/3 quorum -> first
+            # request through the UNFAULTED instance lands on web-b
+            async def pump():
+                while True:
+                    await asyncio.gather(*(one(i) for i in range(N)))
+                    await asyncio.sleep(0.004)
+
+            pump_task = asyncio.ensure_future(pump())
+            try:
+                t0 = time.perf_counter()
+                scorers[0].level = scorers[1].level = 0.9
+                shift_ms = None
+                while time.perf_counter() - t0 < 30.0:
+                    if await one(2) == b"b":
+                        shift_ms = (time.perf_counter() - t0) * 1e3
+                        break
+                    await asyncio.sleep(0.005)
+            finally:
+                pump_task.cancel()
+                await asyncio.gather(pump_task, return_exceptions=True)
+            return {"req_s": round(req_s, 1),
+                    "shift_ms": (round(shift_ms, 1)
+                                 if shift_ms is not None else None)}
+        finally:
+            for d in drains:
+                d.cancel()
+            await asyncio.gather(*drains, return_exceptions=True)
+            for c in clients:
+                await c.close()
+            for a in admins:
+                await a.close()
+            for lk in linkers:
+                await lk.close()
+            await ctl_srv.close()
+            await namerd.close()
+            await back_a.close()
+            await back_b.close()
+
+    async def drive() -> dict:
+        gossip = await one_round(gossip=True)
+        namerd_mediated = await one_round(gossip=False)
+        return {
+            "instances": N,
+            "fleet_req_s": gossip["req_s"],
+            "fleet_shift_latency_ms": gossip["shift_ms"],
+            "shift_ms_gossip": gossip["shift_ms"],
+            "shift_ms_namerd": namerd_mediated["shift_ms"],
+            "req_s_namerd_round": namerd_mediated["req_s"],
+        }
+
+    return asyncio.run(asyncio.wait_for(drive(), 180))
+
+
 def control_loop_bench() -> dict:
     """Reactive-control-loop actuation latency, in-process: a linker
     bound through a real namerd (HTTP control API + watches) with the
@@ -1319,6 +1510,15 @@ def main() -> None:
         detail["churn_conn_s"] = ti.get("churn_conn_s")
         detail["tenant_isolation"] = ti
 
+    def ph_fleet() -> None:
+        fl = fleet_bench()
+        # headline rows at the top level (the acceptance bar reads
+        # them); the full run stays under detail.fleet
+        detail["fleet_req_s"] = fl.get("fleet_req_s")
+        detail["fleet_shift_latency_ms"] = fl.get(
+            "fleet_shift_latency_ms")
+        detail["fleet"] = fl
+
     def ph_core_scaling() -> None:
         cs = core_scaling_bench()
         # headline rows at the top level (the acceptance bar reads
@@ -1345,6 +1545,7 @@ def main() -> None:
         # rc:124 mid-scorer must not lose the TLS claim.
         ("static_analysis", ph_static),
         ("race_analysis", ph_race),
+        ("fleet", ph_fleet),
         ("tenant_isolation", ph_tenant_isolation),
         ("native_score", ph_native_score),
         ("core_scaling", ph_core_scaling),

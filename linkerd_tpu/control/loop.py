@@ -20,11 +20,22 @@ metrics subtree, and admin surface stay coherent:
         exitThreshold: 0.3
         quorum: 3
         cooldownS: 2.0
+        fleet:                      # optional: fleet-coordinated mode
+          instance: l5d-a
+          expectInstances: 3
+          quorum: 2                 # K-of-N actuation gate
+          peers: [127.0.0.1:9991, 127.0.0.1:9992]  # peer admin ports
 
 Omitting ``failover``/``namespace`` disables the reactor; setting
 ``balancerWeighting``/``adaptiveAdmission`` false disables those
 actuators — each is independent, all share the metrics subtree
 (``control/*``) and ``/control.json``.
+
+With a ``fleet:`` block (linkerd_tpu/fleet/) the instance publishes its
+per-cluster anomaly digest through the namerd store (and optionally a
+peer gossip endpoint on the admin server) and the reactor actuates on
+the FLEET quorum level — K-of-N instances must independently observe an
+anomaly before any dtab shifts.
 """
 
 from __future__ import annotations
@@ -33,6 +44,8 @@ import asyncio
 import logging
 from dataclasses import dataclass
 from typing import Dict, Optional
+
+from linkerd_tpu.fleet.exchange import FleetConfig
 
 log = logging.getLogger(__name__)
 
@@ -70,6 +83,9 @@ class ControlConfig:
     # weights); no actuator may fire until this many batches have been
     # scored (and, with online training on, learned from)
     warmupBatches: int = 50
+    # fleet coordination (linkerd_tpu/fleet/): cross-instance score
+    # exchange + quorum-gated actuation; None = single-instance mode
+    fleet: Optional[FleetConfig] = None
 
     def mk(self, board, metrics, drift=None, namer_prefixes=None,
            ready_fn=None) -> "ControlLoop":
@@ -113,12 +129,33 @@ class ControlLoop:
                             base_weigher(hostport) if self._warmed
                             else 1.0)
         self.admission = None
+        self._drift = drift
         if cfg.adaptiveAdmission:
             from linkerd_tpu.control.admission import AdaptiveAdmission
             self.admission = AdaptiveAdmission(
                 board, drift=drift, threshold=cfg.admissionThreshold,
                 floor=cfg.admissionFloor, alpha=cfg.admissionAlpha,
                 metrics_node=self.node.scope("admission"))
+        # fleet exchange BEFORE the reactor: the reactor actuates on the
+        # exchange's quorum levels, so it needs the exchange at build
+        self.fleet = None
+        if cfg.fleet is not None:
+            store_client = None
+            if cfg.namerdAddress:
+                # the exchange gets its OWN HTTP client: its publish
+                # cadence must never serialize behind a reactor CAS (nor
+                # share a connection mid-teardown with it)
+                from linkerd_tpu.control.reactor import (
+                    NamerdHttpStoreClient,
+                )
+                store_client = NamerdHttpStoreClient(cfg.namerdAddress)
+            self.fleet = cfg.fleet.mk(
+                store_client, metrics_node=self.node.scope("fleet"))
+            # default doc source: the board's hottest dsts; replaced by
+            # the reactor's cluster view when a reactor is configured
+            self.fleet.set_source(
+                self._board_levels, extras_fn=self._fleet_extras,
+                warmed_fn=lambda: self._warmed)
         self.reactor = None
         self._reactor_prefixes = (list(namer_prefixes)
                                   if namer_prefixes is not None else None)
@@ -154,12 +191,49 @@ class ControlLoop:
             metrics_node=self.node.scope("reactor"),
             namer_prefixes=self._reactor_prefixes,
             verify=cfg.verifyOverrides,
-            store_timeout_s=cfg.storeTimeoutMs / 1e3)
+            store_timeout_s=cfg.storeTimeoutMs / 1e3,
+            fleet=self.fleet)
+        if self.fleet is not None:
+            # the exchange publishes the reactor's LOCAL cluster view
+            # (independent evidence — peers fold their own quorum), plus
+            # which overrides this instance believes it holds
+            reactor = self.reactor
+            self.fleet.set_source(
+                reactor.cluster_levels,
+                overrides_fn=lambda: sorted(reactor.active),
+                extras_fn=self._fleet_extras,
+                warmed_fn=lambda: self._warmed)
+
+    # -- fleet doc sources -------------------------------------------------
+    def _board_levels(self) -> Dict[str, float]:
+        """Doc levels when no reactor is configured: the hottest
+        effective per-dst scores (bounded — the doc is a digest)."""
+        eff = self.board.effective_scores()
+        top = sorted(eff.items(), key=lambda kv: -kv[1])[:16]
+        return {dst: lvl for dst, lvl in top}
+
+    def _fleet_extras(self) -> Dict[str, float]:
+        extras: Dict[str, float] = {}
+        if self._drift is not None:
+            try:
+                extras["drift"] = float(self._drift.score_shift())
+            except Exception:  # noqa: BLE001 — a cold drift monitor
+                # (no baseline yet) must not break doc publication
+                log.debug("fleet drift extra unavailable", exc_info=True)
+        if self.admission is not None:
+            extras["shed_rate"] = max(
+                0.0, 1.0 - float(getattr(self.admission, "factor", 1.0)))
+        return extras
 
     # -- assembly hooks (Linker) ------------------------------------------
-    def set_store_client(self, client) -> None:
+    def set_store_client(self, client, fleet_client=None) -> None:
         """Install a reactor store client (embedded namerd / tests);
-        the YAML path builds one from ``namerdAddress`` instead."""
+        the YAML path builds one from ``namerdAddress`` instead. The
+        fleet exchange (when configured) shares ``client`` unless a
+        dedicated ``fleet_client`` is given."""
+        if self.fleet is not None:
+            self.fleet.set_store_client(
+                fleet_client if fleet_client is not None else client)
         self._mk_reactor(client)
 
     def set_namer_prefixes(self, prefixes) -> None:
@@ -206,6 +280,12 @@ class ControlLoop:
         untrained model's scores are noise, and noise must not shift
         fleet traffic."""
         self._steps.incr()
+        if self.fleet is not None:
+            # the exchange runs pre-warmup too: an identity-only doc
+            # keeps this instance visible (and fenceable) in the fleet
+            # while its scorer trains; cluster levels only appear in
+            # the doc once warmed (FleetExchange.build_doc)
+            self.fleet.maybe_step()
         if not self._warmed:
             if not self._ready_fn():
                 return
@@ -228,6 +308,7 @@ class ControlLoop:
                 "balancer_weighting": self.weigher is not None,
                 "adaptive_admission": self.admission is not None,
                 "mesh_reactor": self.reactor is not None,
+                "fleet_exchange": self.fleet is not None,
             },
         }
         if self.weigher is not None:
@@ -245,6 +326,8 @@ class ControlLoop:
                               self._tenant_admissions]
         if self.reactor is not None:
             out["reactor"] = self.reactor.status()
+        if self.fleet is not None:
+            out["fleet"] = self.fleet.status()
         return out
 
     def close(self) -> None:
@@ -254,3 +337,5 @@ class ControlLoop:
         self.close()
         if self.reactor is not None:
             await self.reactor.aclose()
+        if self.fleet is not None:
+            await self.fleet.aclose()

@@ -35,7 +35,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from linkerd_tpu.core import Dtab, Path
 from linkerd_tpu.core.dtab import Dentry
 from linkerd_tpu.namerd.store import (
-    DtabStore, DtabVersionMismatch, VersionedDtab,
+    DtabNamespaceAlreadyExists, DtabNamespaceDoesNotExist, DtabStore,
+    DtabVersionMismatch, VersionedDtab,
 )
 from linkerd_tpu.control.state import SICK, HysteresisGovernor
 
@@ -45,6 +46,11 @@ log = logging.getLogger(__name__)
 class OverrideRejected(Exception):
     """The generated override failed l5dcheck verification; it was NOT
     published."""
+
+
+class OverrideFenced(Exception):
+    """A store write was refused because this instance was superseded
+    (fleet generation fencing) after the step began."""
 
 
 class LocalStoreClient:
@@ -64,6 +70,9 @@ class LocalStoreClient:
 
     async def cas(self, ns: str, dtab: Dtab, version: bytes) -> None:
         await self._store.update(ns, dtab, version)
+
+    async def create(self, ns: str, dtab: Dtab) -> None:
+        await self._store.create(ns, dtab)
 
     async def aclose(self) -> None:
         return
@@ -118,13 +127,70 @@ class NamerdHttpStoreClient:
         rsp = await self._ensure_client()(req)
         if rsp.status == 412:
             raise DtabVersionMismatch(ns)
+        if rsp.status == 404:
+            # the namespace vanished between fetch and cas (operator
+            # delete): the typed error lets retry loops re-create it
+            # instead of treating a recoverable race as a hard failure
+            raise DtabNamespaceDoesNotExist(ns)
         if rsp.status not in (200, 204):
             raise RuntimeError(
                 f"namerd PUT dtabs/{ns} failed: {rsp.status}")
 
+    async def create(self, ns: str, dtab: Dtab) -> None:
+        from linkerd_tpu.protocol.http.message import Request
+        req = Request(method="POST", uri=f"/api/1/dtabs/{ns}",
+                      body=dtab.show.encode())
+        req.headers.set("Content-Type", "application/dtab")
+        rsp = await self._ensure_client()(req)
+        if rsp.status == 409:
+            raise DtabNamespaceAlreadyExists(ns)
+        if rsp.status not in (200, 204):
+            raise RuntimeError(
+                f"namerd POST dtabs/{ns} failed: {rsp.status}")
+
     async def aclose(self) -> None:
         if self._client is not None:
             await self._client.close()
+
+
+async def cas_modify(client, ns: str, mutate: Callable[[Dtab], Dtab],
+                     retries: int = 8,
+                     create_if_missing: Optional[Dtab] = None,
+                     on_conflict: Optional[Callable[[], None]] = None
+                     ) -> Dtab:
+    """Read-modify-write a namespace under CAS with bounded
+    retry-on-conflict — the hardened path N concurrent writers (fleet
+    instances publishing score docs, racing reactors) converge through:
+    every round re-fetches the LATEST version and re-applies ``mutate``
+    to it, so a lost CAS can delay a write but never lose a concurrent
+    one. Returns the dtab this writer successfully wrote.
+
+    ``create_if_missing``: base dtab to create the namespace from when
+    it does not exist (creation itself is race-safe: a concurrent
+    create turns into one more retry round). ``on_conflict`` is called
+    once per lost CAS (conflict accounting)."""
+    last: Optional[Exception] = None
+    for _ in range(max(1, retries)):
+        vd = await client.fetch(ns)
+        if vd is None:
+            if create_if_missing is None:
+                raise DtabNamespaceDoesNotExist(ns)
+            out = mutate(create_if_missing)
+            try:
+                await client.create(ns, out)
+                return out
+            except DtabNamespaceAlreadyExists as e:
+                last = e  # a peer won the create: retry as an update
+                continue
+        try:
+            out = mutate(vd.dtab)
+            await client.cas(ns, out, vd.version)
+            return out
+        except (DtabVersionMismatch, DtabNamespaceDoesNotExist) as e:
+            last = e
+            if on_conflict is not None:
+                on_conflict()
+    raise DtabVersionMismatch(ns) from last
 
 
 def verify_override(base: Dtab, override: Dtab,
@@ -149,7 +215,8 @@ class MeshReactor:
                  namer_prefixes: Optional[Sequence[Path]] = None,
                  verify: bool = True,
                  verifier: Optional[Callable] = None,
-                 store_timeout_s: float = 3.0):
+                 store_timeout_s: float = 3.0,
+                 fleet=None):
         for cluster, target in failover.items():
             Path.read(cluster)  # raises on bad config up front
             Path.read(target)
@@ -158,6 +225,12 @@ class MeshReactor:
         self._ns = namespace
         self._failover = dict(failover)
         self._governor = governor or HysteresisGovernor()
+        # fleet mode (a FleetExchange): the governor observes the
+        # QUORUM level — the K-th highest level reported by fresh fleet
+        # instances, self included — instead of this router's view
+        # alone, and a superseded incarnation (a newer generation took
+        # over our instance id) never actuates or reverts again
+        self._fleet = fleet
         # None = unknown (remote namerd): verification skips
         # namer-reachability, keeps cycle/shadow analysis
         self._namer_prefixes = (list(namer_prefixes)
@@ -182,11 +255,13 @@ class MeshReactor:
             self._adopted = node.counter("overrides_adopted")
             self._conflicts = node.counter("cas_conflicts")
             self._errors = node.counter("errors")
+            self._fenced = node.counter("fenced_steps")
             node.gauge("active_overrides",
                        fn=lambda: float(len(self.active)))
         else:
             self._published = self._reverted = self._rejected_c = None
             self._adopted = self._conflicts = self._errors = None
+            self._fenced = None
 
     def set_tracer(self, tracer) -> None:
         self._tracer = tracer
@@ -210,12 +285,31 @@ class MeshReactor:
                 default=0.0)
         return levels
 
+    def actuation_levels(self) -> Dict[str, float]:
+        """The levels the governor actually observes: local cluster
+        levels, folded through the fleet quorum order-statistic when a
+        FleetExchange is attached (K-of-N instances must independently
+        report a level for it to count)."""
+        levels = self.cluster_levels()
+        if self._fleet is None:
+            return levels
+        return {cluster: self._fleet.quorum_level(cluster, lvl)
+                for cluster, lvl in levels.items()}
+
     # -- the loop body -----------------------------------------------------
     async def step(self, now: Optional[float] = None) -> None:
         """One evaluation pass: fold current levels into the governor
         and reconcile the published overrides with its verdicts."""
         async with self._lock:
-            levels = self.cluster_levels()
+            if self._fleet is not None and self._fleet.superseded:
+                # generation fence: a newer incarnation of this instance
+                # id is publishing — this process is a zombie whose
+                # stale view must never shift the mesh NOR revert its
+                # successor's override
+                if self._fenced is not None:
+                    self._fenced.incr()
+                return
+            levels = self.actuation_levels()
             for cluster, target in self._failover.items():
                 state = self._governor.observe(
                     cluster, levels.get(cluster, 0.0), now)
@@ -231,6 +325,13 @@ class MeshReactor:
                     # on the next step rather than looping hot here
                     if self._conflicts is not None:
                         self._conflicts.incr()
+                except OverrideFenced:
+                    # superseded between the step's entry check and the
+                    # write dispatch: the successor owns the mesh now
+                    if self._fenced is not None:
+                        self._fenced.incr()
+                    log.warning("control write for %s dropped: instance "
+                                "superseded mid-step", cluster)
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:  # noqa: BLE001 — one cluster's
@@ -241,13 +342,30 @@ class MeshReactor:
                     log.warning("control reactor step failed for %s: %r",
                                 cluster, e)
 
+    def _fence_blocked(self) -> bool:
+        """True when a newer incarnation of this instance has taken
+        over (fleet generation fencing). Checked at step entry AND
+        re-checked after every store await before a CAS goes out: the
+        supersede signal can arrive (gossip/namerd ingest) while this
+        step is parked on a fetch, and a zombie's write — publish or
+        revert — must not clobber its successor's."""
+        return self._fleet is not None and self._fleet.superseded
+
     async def _fetch(self) -> Optional[VersionedDtab]:
         return await asyncio.wait_for(self._client.fetch(self._ns),
                                       self._store_timeout_s)
 
     async def _cas(self, dtab: Dtab, version: bytes) -> None:
-        await asyncio.wait_for(self._client.cas(self._ns, dtab, version),
-                               self._store_timeout_s)
+        async def dispatch() -> None:
+            # fencing backstop at the last atomic instant before the
+            # write leaves: wait_for schedules this coroutine on a later
+            # loop iteration, and a gossip/exchange handler running in
+            # between may have ingested our supersede
+            if self._fence_blocked():
+                raise OverrideFenced(self._ns)
+            await self._client.cas(self._ns, dtab, version)
+
+        await asyncio.wait_for(dispatch(), self._store_timeout_s)
 
     async def _actuate(self, cluster: str, target: str,
                        level: float) -> None:
@@ -283,6 +401,12 @@ class MeshReactor:
                         "(not published): %s", cluster, reason)
                 self._span("reject", cluster, target, level)
                 return
+        if self._fence_blocked():
+            if self._fenced is not None:
+                self._fenced.incr()
+            log.warning("control override for %s NOT published: this "
+                        "instance was superseded mid-step", cluster)
+            return
         await self._cas(vd.dtab + override, vd.version)
         self.active[cluster] = override[0]
         self.rejected.pop(cluster, None)
@@ -294,6 +418,16 @@ class MeshReactor:
 
     async def _revert(self, cluster: str, level: float) -> None:
         vd = await self._fetch()
+        if self._fence_blocked():
+            # superseded while parked on the fetch: the dentry now
+            # belongs to our successor (same failover config publishes
+            # the same dentry) — removing it would un-shift the mesh
+            # the successor still believes shifted
+            if self._fenced is not None:
+                self._fenced.incr()
+            log.warning("control override for %s NOT reverted: this "
+                        "instance was superseded mid-step", cluster)
+            return
         dentry = self.active[cluster]
         if vd is not None and dentry in vd.dtab:
             pruned = Dtab(d for d in vd.dtab if d != dentry)
@@ -335,7 +469,7 @@ class MeshReactor:
 
     # -- observability -----------------------------------------------------
     def status(self) -> dict:
-        return {
+        out = {
             "namespace": self._ns,
             "failover": dict(self._failover),
             "levels": {c: round(v, 4)
@@ -345,6 +479,16 @@ class MeshReactor:
                                  for c, d in self.active.items()},
             "rejected": dict(self.rejected),
         }
+        if self._fleet is not None:
+            local = self.cluster_levels()
+            out["fleet_mode"] = True
+            out["fleet_levels"] = {
+                c: round(v, 4) for c, v in self.actuation_levels().items()}
+            out["fleet_sick_votes"] = {
+                c: self._fleet.sick_votes(c, local.get(c, 0.0),
+                                          self._governor.enter)
+                for c in self._failover}
+        return out
 
     async def aclose(self) -> None:
         await self._client.aclose()

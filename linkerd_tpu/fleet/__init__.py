@@ -1,0 +1,28 @@
+"""Fleet coordination: N linkerds + one namerd acting as ONE mesh.
+
+Everything through PR 12 is a single linkerd process: one router's
+scores drive one router's balancing, admission, and dtab overrides. The
+reference design's whole point is a *fleet* of linkerds coordinated by
+namerd, and Solyx AI Grid (PAPERS.md) shows telemetry-aware routing
+paying off precisely when evidence is aggregated *across* sites rather
+than acted on per-node. This package is that coordination layer:
+
+- ``doc``      — the per-instance anomaly digest (FleetDoc) and the
+  fleet-level view of every peer's digest (FleetView): staleness TTLs,
+  per-instance generation fencing, and the quorum order-statistic the
+  reactor actuates on.
+- ``exchange`` — FleetExchange: periodic CAS publication of the local
+  digest through the namerd store (durable, watchable) plus an optional
+  low-latency peer gossip round over the admin servers; both feed the
+  same FleetView.
+- ``gossip``   — the admin surface: ``/fleet.json`` (observability) and
+  ``/fleet/gossip.json`` (push/pull anti-entropy endpoint).
+- ``scorer_pool`` — the JAX scorer tier as a first-class service:
+  scorer replicas announced through a namer and load-balanced like any
+  other service.
+"""
+
+from linkerd_tpu.fleet.doc import FleetDoc, FleetView  # noqa: F401
+from linkerd_tpu.fleet.exchange import (  # noqa: F401
+    FleetConfig, FleetExchange,
+)

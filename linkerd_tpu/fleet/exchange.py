@@ -1,0 +1,373 @@
+"""FleetExchange: cross-instance score exchange over namerd + gossip.
+
+Two propagation paths feed one FleetView:
+
+- **namerd-mediated (durable)** — every ``publishIntervalS`` the local
+  digest is CAS-written into the ``namespace`` dtab namespace as one
+  dentry per instance (``/fleet/<instance> => /d/<hex-json>``), riding
+  the exact store/ETag machinery the MeshReactor publishes overrides
+  through. The same round-trip ingests every peer dentry found in the
+  namespace, so namerd alone gives eventual fleet-wide visibility with
+  no extra endpoints — and survives instance restarts (the doc is the
+  durable record a rejoining instance fences against).
+- **peer gossip (fast, optional)** — every ``gossipIntervalMs`` the
+  exchange POSTs its known docs to each peer's admin server
+  (``/fleet/gossip.json``) and ingests the docs the peer returns
+  (push-pull anti-entropy), giving sub-second propagation with namerd
+  as the fallback when peers are unreachable.
+
+Both paths are fire-and-forget tasks kicked from the control loop's
+tick (``maybe_step``): a slow namerd or dead peer costs one bounded
+round, never a wedged control loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import re
+import socket
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from linkerd_tpu.core import Dtab
+from linkerd_tpu.fleet.doc import FleetDoc, FleetView, valid_instance
+
+log = logging.getLogger(__name__)
+
+GOSSIP_PATH = "/fleet/gossip.json"
+
+
+@dataclass
+class FleetConfig:
+    """The ``fleet:`` block nested under the jaxAnomaly ``control:``
+    block (see ControlConfig)."""
+
+    # stable identity of this linkerd in the fleet; default: derived
+    # from hostname+pid (NOT stable across restarts — configure it
+    # explicitly wherever generation fencing across restarts matters)
+    instance: Optional[str] = None
+    # incarnation number; 0 = auto (wall-clock NANOSECONDS at startup —
+    # second granularity would hand a crash-looping supervisor restart
+    # an EQUAL generation and peers would fence the new incarnation's
+    # docs). Configure explicitly in tests/harnesses that need
+    # deterministic fencing.
+    generation: int = 0
+    # K of quorum-gated actuation: the governor only sees a cluster as
+    # sick when >= K fresh instances independently report it past the
+    # enter threshold. 0 = auto: majority of expectInstances when that
+    # is set, else 2 (one paranoid router must never shift the mesh).
+    quorum: int = 0
+    # fleet size hint for the auto quorum + l5dcheck sanity checks
+    expectInstances: int = 0
+    # namerd dtab namespace carrying the per-instance score docs
+    namespace: str = "fleet"
+    publishIntervalS: float = 1.0
+    # docs older than this (receiver's monotonic clock) carry no vote
+    stalenessTtlS: float = 5.0
+    # optional low-latency peer gossip over the admin servers
+    gossip: bool = True
+    peers: Optional[List[str]] = None  # peer ADMIN host:port addresses
+    gossipIntervalMs: int = 250
+
+    def effective_quorum(self) -> int:
+        if self.quorum > 0:
+            return self.quorum
+        if self.expectInstances > 0:
+            return self.expectInstances // 2 + 1
+        return 2
+
+    def resolve_instance(self) -> str:
+        if self.instance:
+            return self.instance
+        raw = f"l5d-{socket.gethostname()}-{os.getpid()}"
+        return re.sub(r"[^A-Za-z0-9._-]", "-", raw)[:64]
+
+    def mk(self, client, metrics_node=None) -> "FleetExchange":
+        return FleetExchange(self, client, metrics_node=metrics_node)
+
+
+class FleetExchange:
+    """See module docstring. ``client`` is a reactor-style store client
+    (fetch/cas/create, LocalStoreClient or NamerdHttpStoreClient) or
+    None for gossip-only operation."""
+
+    def __init__(self, cfg: FleetConfig, client, metrics_node=None):
+        if cfg.publishIntervalS <= 0:
+            raise ValueError("fleet.publishIntervalS must be > 0")
+        if cfg.stalenessTtlS <= 0:
+            raise ValueError("fleet.stalenessTtlS must be > 0")
+        if cfg.gossipIntervalMs <= 0:
+            raise ValueError("fleet.gossipIntervalMs must be > 0")
+        if cfg.quorum < 0:
+            raise ValueError("fleet.quorum must be >= 0 (0 = auto)")
+        instance = cfg.resolve_instance()
+        if not valid_instance(instance):
+            raise ValueError(
+                f"fleet.instance must match [A-Za-z0-9._-]{{1,64}}: "
+                f"{instance!r}")
+        self.cfg = cfg
+        self.quorum = cfg.effective_quorum()
+        generation = cfg.generation or time.time_ns()
+        self.view = FleetView(instance, generation,
+                              ttl_s=cfg.stalenessTtlS)
+        self._client = client
+        self._ns = cfg.namespace
+        self._seq = 0
+        # doc content sources, wired by the ControlLoop after the
+        # reactor exists (set_source); until then the doc is identity-only
+        self._levels_fn: Callable[[], Dict[str, float]] = lambda: {}
+        self._extras_fn: Optional[Callable[[], Dict[str, float]]] = None
+        self._overrides_fn: Callable[[], List[str]] = lambda: []
+        self._warmed_fn: Callable[[], bool] = lambda: True
+        # cadence state (monotonic); None = fire on the first tick
+        self._last_pub: Optional[float] = None
+        self._last_gossip: Optional[float] = None
+        self._publishing = False
+        self._gossiping = False
+        self._peer_clients: Dict[str, object] = {}
+        node = metrics_node
+        if node is not None:
+            self._published = node.counter("docs_published")
+            self._pub_conflicts = node.counter("publish_conflicts")
+            self._pub_failures = node.counter("publish_failures")
+            self._gossip_rounds = node.counter("gossip_rounds")
+            self._gossip_errors = node.counter("gossip_errors")
+            node.gauge("peers_fresh",
+                       fn=lambda: float(self.view.fresh_count()))
+            node.gauge("peers_known",
+                       fn=lambda: float(len(self.view.all_docs())))
+            node.gauge("superseded",
+                       fn=lambda: 1.0 if self.view.superseded else 0.0)
+            node.gauge("quorum", fn=lambda: float(self.quorum))
+        else:
+            self._published = self._pub_conflicts = None
+            self._pub_failures = None
+            self._gossip_rounds = self._gossip_errors = None
+
+    # -- wiring ------------------------------------------------------------
+    def set_source(self, levels_fn: Callable[[], Dict[str, float]],
+                   overrides_fn: Optional[Callable[[], List[str]]] = None,
+                   extras_fn: Optional[Callable[[], Dict[str, float]]] = None,
+                   warmed_fn: Optional[Callable[[], bool]] = None) -> None:
+        self._levels_fn = levels_fn
+        if overrides_fn is not None:
+            self._overrides_fn = overrides_fn
+        if extras_fn is not None:
+            self._extras_fn = extras_fn
+        if warmed_fn is not None:
+            self._warmed_fn = warmed_fn
+
+    def set_store_client(self, client) -> None:
+        self._client = client
+
+    # -- reactor-facing queries -------------------------------------------
+    @property
+    def superseded(self) -> bool:
+        return self.view.superseded
+
+    def quorum_level(self, cluster: str, local_level: float) -> float:
+        return self.view.quorum_level(cluster, local_level, self.quorum)
+
+    def sick_votes(self, cluster: str, local_level: float,
+                   threshold: float) -> int:
+        return self.view.sick_votes(cluster, local_level, threshold)
+
+    # -- doc construction --------------------------------------------------
+    def build_doc(self) -> FleetDoc:
+        self._seq += 1
+        clusters: Dict[str, Dict[str, float]] = {}
+        if self._warmed_fn():
+            # pre-warmup an untrained scorer's levels are noise: publish
+            # identity only, so this instance counts toward fleet size
+            # but never votes a cluster sick
+            extras = self._extras_fn() if self._extras_fn else {}
+            for cluster, level in self._levels_fn().items():
+                agg = {"level": round(float(level), 6)}
+                agg.update({k: round(float(v), 6)
+                            for k, v in extras.items()})
+                clusters[cluster] = agg
+        return FleetDoc(
+            instance=self.view.instance,
+            generation=self.view.generation,
+            seq=self._seq,
+            clusters=clusters,
+            overrides=sorted(self._overrides_fn()),
+            ts=time.time(),
+        )
+
+    def doc_objs(self) -> List[dict]:
+        """Own freshest doc + every known peer doc, as JSON objects (the
+        gossip payload; full anti-entropy so propagation is transitive
+        even when peers cannot reach each other directly)."""
+        docs = [self.build_doc()] + self.view.all_docs()
+        return [json.loads(d.to_json()) for d in docs]
+
+    def ingest_objs(self, objs: List[dict]) -> int:
+        """Ingest received doc objects (gossip push bodies / pull
+        responses); malformed entries are dropped and counted, never
+        raised — peer input is untrusted."""
+        accepted = 0
+        for obj in objs if isinstance(objs, list) else []:
+            try:
+                doc = FleetDoc.from_json(json.dumps(obj))
+            except (ValueError, TypeError):
+                if self._gossip_errors is not None:
+                    self._gossip_errors.incr()
+                continue
+            if self.view.ingest(doc):
+                accepted += 1
+        return accepted
+
+    # -- cadence -----------------------------------------------------------
+    def maybe_step(self, now: Optional[float] = None) -> None:
+        """Called from every control-loop tick: kick the namerd publish
+        and/or a gossip round when their cadence is due, as bounded
+        fire-and-forget tasks (the tick itself never blocks on I/O)."""
+        from linkerd_tpu.core.tasks import spawn
+        now = time.monotonic() if now is None else now
+        if (self._client is not None and not self._publishing
+                and (self._last_pub is None
+                     or now - self._last_pub >= self.cfg.publishIntervalS)):
+            self._publishing = True
+            self._last_pub = now
+            spawn(self._publish_once(), what="fleet-publish")
+        peers = self.cfg.peers or []
+        if (self.cfg.gossip and peers and not self._gossiping
+                and (self._last_gossip is None
+                     or now - self._last_gossip
+                     >= self.cfg.gossipIntervalMs / 1e3)):
+            self._gossiping = True
+            self._last_gossip = now
+            spawn(self._gossip_round(), what="fleet-gossip")
+
+    # -- namerd-mediated exchange -----------------------------------------
+    async def publish_once(self) -> bool:
+        """One synchronous publish+ingest round-trip (tests, bench, and
+        the admin-triggered refresh); returns True on success."""
+        if self._client is None:
+            return False
+        doc = self.build_doc()
+        prefix, dst = doc.to_dentry_parts()
+        own = Dtab.read(f"{prefix} => {dst} ;")[0]
+
+        def mutate(dtab: Dtab) -> Dtab:
+            kept = []
+            for d in dtab:
+                peer = FleetDoc.from_dentry_parts(d.prefix.show, d.dst.show)
+                if peer is not None:
+                    # the fetch IS the namerd-mediated peer watch
+                    self.view.ingest(peer)
+                    if peer.instance == self.view.instance:
+                        continue  # replaced by our fresh doc below
+                kept.append(d)
+            return Dtab(list(kept) + [own])
+
+        from linkerd_tpu.control.reactor import cas_modify
+
+        def conflict() -> None:
+            if self._pub_conflicts is not None:
+                self._pub_conflicts.incr()
+
+        await cas_modify(self._client, self._ns, mutate,
+                         create_if_missing=Dtab.empty(),
+                         on_conflict=conflict)
+        if self._published is not None:
+            self._published.incr()
+        return True
+
+    async def _publish_once(self) -> None:
+        try:
+            await asyncio.wait_for(self.publish_once(), 10.0)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — a failing store costs
+            # one publish round; gossip and the next tick carry on
+            if self._pub_failures is not None:
+                self._pub_failures.incr()
+            log.warning("fleet publish to namespace %r failed: %r",
+                        self._ns, e)
+        finally:
+            self._publishing = False
+
+    # -- gossip ------------------------------------------------------------
+    def _peer_client(self, peer: str):
+        client = self._peer_clients.get(peer)
+        if client is None:
+            from linkerd_tpu.protocol.http.client import HttpClient
+            host, _, port = peer.partition(":")
+            client = HttpClient(host, int(port or 9990))
+            self._peer_clients[peer] = client
+        return client
+
+    async def gossip_round(self) -> int:
+        """Push-pull with every configured peer; returns how many docs
+        the round newly accepted. Per-peer failures are counted and
+        logged at debug — a dead peer is normal fleet weather."""
+        from linkerd_tpu.protocol.http.message import Request
+        payload = json.dumps({"docs": self.doc_objs()}).encode()
+        accepted = 0
+        for peer in self.cfg.peers or []:
+            try:
+                req = Request(method="POST", uri=GOSSIP_PATH,
+                              body=payload)
+                req.headers.set("Content-Type", "application/json")
+                rsp = await asyncio.wait_for(
+                    self._peer_client(peer)(req), 2.0)
+                if rsp.status != 200:
+                    raise RuntimeError(f"gossip status {rsp.status}")
+                data = json.loads((rsp.body or b"{}").decode())
+                accepted += self.ingest_objs(data.get("docs") or [])
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — one dead peer must
+                # not stop the round for the rest of the fleet
+                if self._gossip_errors is not None:
+                    self._gossip_errors.incr()
+                log.debug("fleet gossip with %s failed: %r", peer, e)
+                # drop the cached client: a dead connection must not be
+                # reused for the next round
+                client = self._peer_clients.pop(peer, None)
+                if client is not None:
+                    from linkerd_tpu.core.tasks import spawn
+                    spawn(client.close(), what="fleet-gossip-client-close")
+        if self._gossip_rounds is not None:
+            self._gossip_rounds.incr()
+        return accepted
+
+    async def _gossip_round(self) -> None:
+        try:
+            await self.gossip_round()
+        finally:
+            self._gossiping = False
+
+    # -- observability -----------------------------------------------------
+    def status(self) -> dict:
+        out = self.view.status()
+        out.update({
+            "quorum": self.quorum,
+            "expect_instances": self.cfg.expectInstances or None,
+            "namespace": self._ns if self._client is not None else None,
+            "publish_interval_s": self.cfg.publishIntervalS,
+            "gossip": bool(self.cfg.gossip and (self.cfg.peers or [])),
+            "gossip_peers": list(self.cfg.peers or []),
+            "seq": self._seq,
+        })
+        return out
+
+    async def aclose(self) -> None:
+        for client in list(self._peer_clients.values()):
+            try:
+                await client.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                log.debug("fleet peer client close failed", exc_info=True)
+        self._peer_clients.clear()
+        client, self._client = self._client, None
+        if client is not None:
+            try:
+                await client.aclose()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                log.debug("fleet store client close failed", exc_info=True)

@@ -1,0 +1,44 @@
+"""Fleet admin surface: ``/fleet.json`` + the ``/fleet/gossip.json``
+push-pull endpoint.
+
+The gossip endpoint rides the admin server (it is control-plane
+traffic between trusted fleet members, the same trust domain as the
+rest of the admin surface): a POST body ``{"docs": [...]}`` is
+ingested and the response always carries this instance's full known
+doc set — one round trip is a bidirectional anti-entropy exchange.
+A plain GET is the pull-only half (debugging, curl).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import List, Tuple
+
+from linkerd_tpu.fleet.exchange import GOSSIP_PATH, FleetExchange
+
+log = logging.getLogger(__name__)
+
+
+def fleet_admin_handlers(exchange: FleetExchange) -> List[Tuple[str, object]]:
+    """Handlers for the linker admin server (same contract as
+    ``Telemeter.admin_handlers``)."""
+    from linkerd_tpu.admin.server import json_response
+
+    async def fleet_json(req):
+        return json_response(exchange.status())
+
+    async def gossip(req):
+        if req.method == "POST":
+            try:
+                data = json.loads((req.body or b"{}").decode("utf-8"))
+            except (ValueError, UnicodeDecodeError) as e:
+                return json_response(
+                    {"error": f"bad gossip body: {e}"}, status=400)
+            if not isinstance(data, dict):
+                return json_response(
+                    {"error": "gossip body must be an object"}, status=400)
+            exchange.ingest_objs(data.get("docs") or [])
+        return json_response({"docs": exchange.doc_objs()})
+
+    return [("/fleet.json", fleet_json), (GOSSIP_PATH, gossip)]

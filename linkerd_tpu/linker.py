@@ -527,6 +527,9 @@ class Linker:
         # per-router tenant state for /tenants.json:
         # [(label, TenantBoard, Optional[TenantAdmission])]
         self.tenant_views: List[Tuple[str, Any, Any]] = []
+        # namer lookup backing a path-form sidecarAddress (closed with
+        # the linker so its watch doesn't outlive the namers)
+        self._scorer_activity: Any = None
         try:
             self._build()
         except BaseException:
@@ -569,6 +572,19 @@ class Linker:
 
         for tcfg in instantiate_list("telemeter", self.spec.telemetry, "telemetry"):
             self.telemeters.append(tcfg.mk(self.metrics))
+        # a namer-path sidecarAddress (announced scorer replicas)
+        # resolves against the namers built above; fail assembly loudly
+        # when no namer covers it — a silent empty pool scores nothing
+        tele = self._anomaly_telemeter()
+        if (tele is not None and tele.cfg.sidecarAddress
+                and tele.cfg.sidecarAddress.startswith("/")):
+            from linkerd_tpu.fleet.scorer_pool import namer_scorer_activity
+            try:
+                self._scorer_activity = namer_scorer_activity(
+                    self.namers, tele.cfg.sidecarAddress)
+            except ValueError as e:
+                raise ConfigError(str(e))
+            tele.set_sidecar_activity(self._scorer_activity)
         # the control loop's reactor verifies generated overrides by
         # symbolic delegation over THESE namers' prefixes; a linker with
         # no local namers (remote namerd interpreter) passes None =
@@ -1931,6 +1947,11 @@ class Linker:
         for c in self._announcements:
             c.close()
         self._announcements.clear()
+        if self._scorer_activity is not None:
+            closer = getattr(self._scorer_activity, "close", None)
+            if closer is not None:
+                closer()
+            self._scorer_activity = None
         for r in self.routers:
             await r.close()
         for _, namer in self.namers:

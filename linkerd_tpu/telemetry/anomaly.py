@@ -713,7 +713,12 @@ class JaxAnomalyConfig:
     lineRate: bool = True
     maxLingerMs: float = 2.0
     scoreConcurrency: int = 2  # batches in flight (double-buffer depth)
-    sidecarAddress: Optional[str] = None  # host:port -> gRPC sidecar mode
+    # gRPC sidecar address: "host:port" (one pinned replica),
+    # "host:p1,host:p2" (static replica pool, load-balanced), or a
+    # namer path "/#/io.l5d.fs/l5d-scorer" — announced scorer replicas
+    # resolved through the linker's configured namers and load-balanced
+    # like any other service (linkerd_tpu/fleet/scorer_pool.py)
+    sidecarAddress: Optional[str] = None
     # sidecar tiering: "fallback" (default) serves every batch from the
     # in-process line-rate scorer and demotes the sidecar to a fallback
     # tier behind its breaker; "primary" keeps the sidecar as the one
@@ -828,6 +833,11 @@ class JaxAnomalyTelemeter(Telemeter):
         self._native_publishes = 0
         self._last_native_pub = 0.0   # monotonic; periodic re-export
         self._native_refreshing = False
+        # scorer replica pool (sidecarAddress as a list or namer path):
+        # held separately from the wrapped self._scorer so run() can
+        # start its membership watch and /model.json can report it
+        self._scorer_pool = None
+        self._sidecar_activity = None
         # span sink (the linker's BroadcastTracer): scorer-path spans —
         # per-request children of the originating trace plus one batch
         # span linking its constituents — flow to every tracer telemeter
@@ -1061,6 +1071,31 @@ class JaxAnomalyTelemeter(Telemeter):
             learning_rate=self.cfg.learningRate,
             recon_weight=self.cfg.reconWeight)
 
+    def set_sidecar_activity(self, activity) -> None:
+        """Install the namer lookup Activity backing a path-form
+        ``sidecarAddress`` (the Linker resolves the path against its
+        configured namers at assembly); the replica pool tracks it."""
+        self._sidecar_activity = activity
+        if self._scorer_pool is not None:
+            self._scorer_pool.attach_activity(activity)
+
+    def _mk_sidecar_client(self):
+        """One pinned GrpcScorerClient, or a ScorerReplicaPool for a
+        static list / namer path address (fleet/scorer_pool.py)."""
+        addr = self.cfg.sidecarAddress
+        from linkerd_tpu.telemetry.sidecar import GrpcScorerClient
+        if addr.startswith("/"):
+            from linkerd_tpu.fleet.scorer_pool import ScorerReplicaPool
+            self._scorer_pool = ScorerReplicaPool()
+            if self._sidecar_activity is not None:
+                self._scorer_pool.attach_activity(self._sidecar_activity)
+            return self._scorer_pool
+        if "," in addr:
+            from linkerd_tpu.fleet.scorer_pool import ScorerReplicaPool
+            self._scorer_pool = ScorerReplicaPool(addr.split(","))
+            return self._scorer_pool
+        return GrpcScorerClient(addr)
+
     def _ensure_scorer(self) -> Scorer:
         if self._scorer is None:
             if self.cfg.sidecarAddress:
@@ -1068,12 +1103,11 @@ class JaxAnomalyTelemeter(Telemeter):
                 from linkerd_tpu.telemetry.resilience import (
                     CircuitBreaker, ResilientScorer,
                 )
-                from linkerd_tpu.telemetry.sidecar import GrpcScorerClient
                 # the breaker + per-call deadline wrap OUTSIDE the
                 # client's own (compile-aware) gRPC deadlines: a hung
                 # sidecar costs one bounded call, then fails fast
                 resilient = ResilientScorer(
-                    GrpcScorerClient(self.cfg.sidecarAddress),
+                    self._mk_sidecar_client(),
                     call_timeout_s=self.cfg.scoreTimeoutMs / 1e3,
                     breaker=CircuitBreaker(
                         failures=self.cfg.breakerFailures,
@@ -1108,6 +1142,10 @@ class JaxAnomalyTelemeter(Telemeter):
 
     async def run(self) -> None:
         scorer = self._ensure_scorer()
+        if self._scorer_pool is not None:
+            # begin tracking announced scorer replicas (namer path mode;
+            # a no-op for static replica lists)
+            self._scorer_pool.start_watch()
         lc_cfg = self.cfg.lifecycle
         if self._lifecycle is not None and lc_cfg.restoreOnStart:
             # survive restarts: pull the last-good model before scoring
@@ -1140,6 +1178,12 @@ class JaxAnomalyTelemeter(Telemeter):
             if control_task is not None:
                 control_task.cancel()
                 await asyncio.gather(control_task, return_exceptions=True)
+            if self.control is not None and self.control.fleet is not None:
+                # the exchange's gossip/store HTTP clients die with the
+                # drain loop (nothing else awaits the control loop's
+                # teardown; the reactor's client keeps its historical
+                # process-lifetime scope)
+                await self.control.fleet.aclose()
 
     async def _maybe_lifecycle(self, last_cycle: float) -> float:
         lc_cfg = self.cfg.lifecycle
@@ -1588,6 +1632,11 @@ class JaxAnomalyTelemeter(Telemeter):
                 return json_response(st)
 
             handlers.append(("/control.json", control_json))
+            if self.control.fleet is not None:
+                # /fleet.json + the gossip push/pull endpoint ride the
+                # admin server alongside the rest of the control surface
+                from linkerd_tpu.fleet.gossip import fleet_admin_handlers
+                handlers.extend(fleet_admin_handlers(self.control.fleet))
         return handlers
 
     def model_state(self) -> dict:
@@ -1617,6 +1666,8 @@ class JaxAnomalyTelemeter(Telemeter):
         tier_fn = getattr(self._scorer, "tier_state", None)
         if tier_fn is not None:
             out["tiers"] = tier_fn()
+        if self._scorer_pool is not None:
+            out["scorer_pool"] = self._scorer_pool.status()
         if self._lifecycle is not None:
             out.update(self._lifecycle.status())
         return out

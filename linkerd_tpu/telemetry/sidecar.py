@@ -293,3 +293,60 @@ class GrpcScorerClient:
                 return
             from linkerd_tpu.core.tasks import spawn
             spawn(ch.close(), what="sidecar-channel-close")
+
+
+def main() -> None:
+    """``python -m linkerd_tpu.telemetry.sidecar`` — run a scorer
+    replica as a standalone process, optionally ANNOUNCED through the
+    fs announcer so linkerds resolve it like any other service
+    (``sidecarAddress: /#/io.l5d.fs/<name>``): the scorer tier becomes
+    a first-class, load-balanced fleet service instead of a pinned
+    host:port."""
+    import argparse
+    import signal
+
+    parser = argparse.ArgumentParser(
+        description="linkerd-tpu anomaly scorer replica")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--warmup-rows", type=int, default=0)
+    parser.add_argument(
+        "--announce-dir", default=None,
+        help="fs-announcer root dir (the fs namer's rootDir); when set "
+             "the replica registers itself under --announce-name and "
+             "withdraws on shutdown")
+    parser.add_argument("--announce-name", default="l5d-scorer")
+    args = parser.parse_args()
+
+    async def amain() -> None:
+        from linkerd_tpu.core import Path
+
+        sidecar = await ScorerSidecar(
+            host=args.host, port=args.port,
+            warmup_rows=args.warmup_rows).start()
+        announcement = None
+        if args.announce_dir:
+            from linkerd_tpu.announcer import FsAnnouncer
+            announcer = FsAnnouncer(args.announce_dir,
+                                    Path.read("/io.l5d.fs"))
+            announcement = announcer.announce(
+                args.host, sidecar.port, Path.read(f"/{args.announce_name}"))
+            log.info("scorer replica announced as %s in %s",
+                     args.announce_name, args.announce_dir)
+        print(f"SCORER_SIDECAR {args.host}:{sidecar.port}", flush=True)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        if announcement is not None:
+            announcement.close()
+        await sidecar.close()
+
+    import logging as _logging
+    _logging.basicConfig(level=_logging.INFO)
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

@@ -407,6 +407,67 @@ class TestFastpathWorkersRules:
         assert rules_of(check_text(cfg), "fastpath-workers") == []
 
 
+class TestFleetConfigRules:
+    def fleet(self, fleet_yaml, admin="admin: {port: 9990}\n"):
+        return (
+            "routers:\n- protocol: http\n"
+            "  dtab: |\n    /svc => /#/io.l5d.fs ;\n"
+            "  servers: [{port: 0}]\n"
+            "telemetry:\n- kind: io.l5d.jaxAnomaly\n"
+            "  control:\n"
+            "    namespace: default\n"
+            "    namerdAddress: 127.0.0.1:4180\n"
+            "    failover:\n"
+            "      /svc/web: /svc/web-b\n"
+            "    fleet:\n"
+            + "".join(f"      {line}\n"
+                      for line in fleet_yaml.splitlines())
+            + NAMERS + admin)
+
+    def test_quorum_above_fleet_size_fires(self):
+        cfg = self.fleet("quorum: 5\nexpectInstances: 3")
+        (f,) = rules_of(check_text(cfg), "fleet-config")
+        assert "never be met" in f.message
+
+    def test_quorum_of_one_with_actuation_warns(self):
+        cfg = self.fleet("quorum: 1\nexpectInstances: 3")
+        (f,) = rules_of(check_text(cfg), "fleet-config")
+        assert f.severity == "warning"
+        assert "defeats quorum gating" in f.message
+
+    def test_ttl_below_publish_interval_fires(self):
+        cfg = self.fleet("quorum: 2\npublishIntervalS: 2.0\n"
+                         "stalenessTtlS: 1.0")
+        (f,) = rules_of(check_text(cfg), "fleet-config")
+        assert "stale" in f.message or "expires" in f.message
+
+    def test_gossip_refresh_cadence_counts_toward_ttl(self):
+        # TTL below the publish interval but above the gossip cadence:
+        # gossiping peers refresh docs fast enough, no finding
+        cfg = self.fleet("quorum: 2\npublishIntervalS: 2.0\n"
+                         "stalenessTtlS: 1.0\n"
+                         "gossipIntervalMs: 250\n"
+                         "peers: [127.0.0.1:9991]")
+        assert rules_of(check_text(cfg), "fleet-config") == []
+
+    def test_gossip_peers_without_admin_block_warn(self):
+        cfg = self.fleet("quorum: 2\npeers: [127.0.0.1:9991]", admin="")
+        (f,) = rules_of(check_text(cfg), "fleet-config")
+        assert f.severity == "warning"
+        assert "admin" in f.message
+
+    def test_bad_instance_id_fires(self):
+        cfg = self.fleet("quorum: 2\ninstance: 'no/slash'")
+        (f,) = rules_of(check_text(cfg), "fleet-config")
+        assert "instance" in f.message
+
+    def test_healthy_fleet_block_is_clean(self):
+        cfg = self.fleet("instance: l5d-a\nquorum: 2\n"
+                         "expectInstances: 3\n"
+                         "peers: [127.0.0.1:9991, 127.0.0.1:9992]")
+        assert rules_of(check_text(cfg), "fleet-config") == []
+
+
 class TestRegistryCrossCheck:
     def test_unknown_kind_fires_with_known_list(self):
         cfg = """

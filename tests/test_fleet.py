@@ -1,0 +1,931 @@
+"""Fleet coordination tests (linkerd_tpu/fleet/ + MeshReactor fleet
+mode + the CAS machinery the exchange rides on).
+
+- FleetDoc/FleetView: wire roundtrip, dtab-dentry encoding, per-instance
+  generation fencing, staleness TTLs, the quorum order-statistic;
+- CAS conflict regression: concurrent LocalStoreClient AND
+  NamerdHttpStoreClient writers racing on ONE namespace converge
+  (retry-on-conflict, no lost update, ETag honored);
+- FleetExchange: namerd-mediated publish/ingest, gossip push-pull over
+  real admin handlers, hostile input dropped;
+- quorum-gated actuation: K-of-N evidence required to shift, reverts
+  when the quorum dissolves, stale peers lose their vote;
+- generation fencing: a restarted instance with a stale generation
+  never reverts its successor's override — including when the
+  supersede lands MID-step (DeterministicScheduler interleaving);
+- scorer replica pool: membership diffs, least-inflight pick, failover,
+  fs-announced replicas resolved through a real namer;
+- end to end on the REAL binaries: 3 linkerds + namerd, a fault seen by
+  1/3 instances shifts nothing, by 2/3 shifts exactly once fleet-wide,
+  and recovery reverts exactly (testing/fleet.py harness).
+"""
+
+import asyncio
+import json
+import time
+
+import numpy as np
+import pytest
+
+from linkerd_tpu.admin.server import AdminServer
+from linkerd_tpu.control.reactor import (
+    LocalStoreClient, MeshReactor, NamerdHttpStoreClient, cas_modify,
+)
+from linkerd_tpu.control.state import HysteresisGovernor
+from linkerd_tpu.core import Dtab, Path
+from linkerd_tpu.fleet.doc import FleetDoc, FleetView
+from linkerd_tpu.fleet.exchange import FleetConfig, FleetExchange
+from linkerd_tpu.fleet.gossip import fleet_admin_handlers
+from linkerd_tpu.fleet.scorer_pool import (
+    ScorerReplicaPool, namer_scorer_activity,
+)
+from linkerd_tpu.namerd import InMemoryDtabStore, Namerd
+from linkerd_tpu.namerd.http_api import HttpControlService
+from linkerd_tpu.namerd.store import DtabVersionMismatch
+from linkerd_tpu.protocol.http.server import HttpServer
+from linkerd_tpu.telemetry.metrics import MetricsTree
+
+
+def run(coro, timeout: float = 60):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+BASE_DTAB = "/svc => /#/io.l5d.fs ;"
+PREFIXES = [Path.read("/io.l5d.fs")]
+
+
+class _Board:
+    degraded = False
+
+    def __init__(self):
+        self.levels = {}
+
+    def effective_scores(self):
+        return dict(self.levels)
+
+
+def _doc(inst="peer", gen=1, seq=1, level=0.9, cluster="/svc/web",
+         overrides=()):
+    return FleetDoc(instance=inst, generation=gen, seq=seq,
+                    clusters={cluster: {"level": level}},
+                    overrides=list(overrides), ts=0.0)
+
+
+# ---- FleetDoc --------------------------------------------------------------
+
+
+class TestFleetDoc:
+    def test_json_roundtrip(self):
+        d = _doc(overrides=["/svc/web"])
+        d2 = FleetDoc.from_json(d.to_json())
+        assert d2.instance == "peer" and d2.generation == 1
+        assert d2.clusters["/svc/web"]["level"] == 0.9
+        assert d2.overrides == ["/svc/web"]
+
+    def test_dentry_roundtrip(self):
+        d = _doc(inst="l5d-0", level=0.42)
+        prefix, dst = d.to_dentry_parts()
+        assert prefix == "/fleet/l5d-0"
+        back = FleetDoc.from_dentry_parts(prefix, dst)
+        assert back is not None
+        assert back.clusters["/svc/web"]["level"] == 0.42
+
+    def test_dentry_rides_a_real_dtab(self):
+        d = _doc(inst="l5d-0")
+        prefix, dst = d.to_dentry_parts()
+        dtab = Dtab.read(f"{prefix} => {dst} ;")
+        parsed = FleetDoc.from_dentry_parts(
+            dtab[0].prefix.show, dtab[0].dst.show)
+        assert parsed is not None and parsed.instance == "l5d-0"
+
+    def test_non_fleet_dentry_ignored(self):
+        assert FleetDoc.from_dentry_parts("/svc/web", "/svc/web-b") is None
+        assert FleetDoc.from_dentry_parts("/fleet/x", "/svc/web-b") is None
+
+    def test_instance_prefix_mismatch_rejected(self):
+        d = _doc(inst="honest")
+        _, dst = d.to_dentry_parts()
+        # a doc claiming identity "honest" under someone else's prefix
+        assert FleetDoc.from_dentry_parts("/fleet/liar", dst) is None
+
+    def test_bad_docs_rejected(self):
+        with pytest.raises(ValueError):
+            FleetDoc.from_json("[1, 2]")
+        with pytest.raises(ValueError):
+            FleetDoc.from_json(json.dumps({"i": "bad/slash", "g": 1}))
+        with pytest.raises(ValueError):
+            FleetDoc.from_json(json.dumps({"i": "x", "c": [1]}))
+
+    def test_malformed_field_types_raise_valueerror_not_typeerror(self):
+        # ONE malformed-doc error type: a null/list-valued numeric
+        # field must surface as ValueError so every caller's except
+        # clause covers it (a TypeError once leaked through the dentry
+        # path and a single poison dentry would have broken every
+        # instance's publish round forever)
+        with pytest.raises(ValueError):
+            FleetDoc.from_json(json.dumps(
+                {"i": "x", "g": 1, "s": 1,
+                 "c": {"/svc/web": {"level": "abc"}}}))
+        with pytest.raises(ValueError):
+            FleetDoc.from_json(json.dumps({"i": "x", "g": [1]}))
+        # nulls coerce to 0 rather than poisoning the doc
+        d = FleetDoc.from_json(json.dumps(
+            {"i": "x", "g": None, "s": 1,
+             "c": {"/svc/web": {"level": None}}}))
+        assert d.generation == 0
+        assert d.clusters["/svc/web"]["level"] == 0.0
+
+    def test_poison_dentry_never_breaks_publish(self):
+        """A dentry whose payload decodes but fails doc validation is
+        treated as a non-fleet (operator) dentry: every instance's
+        publish round keeps working around it."""
+        async def go():
+            bad_json = json.dumps({"i": "x", "g": "not-an-int", "s": 1})
+            poison = (f"/fleet/x => /d/{bad_json.encode().hex()} ;")
+            store = InMemoryDtabStore({"fleet": Dtab.read(poison)})
+            ex = _exchange(store, "a")
+            ex.set_source(lambda: {"/svc/web": 0.5})
+            assert await ex.publish_once()
+            vd = await store.observe("fleet").to_future()
+            assert "/fleet/a" in vd.dtab.show
+            assert "/fleet/x" in vd.dtab.show  # left alone, not eaten
+
+        run(go())
+
+    def test_cluster_count_bounded(self):
+        clusters = {f"/svc/c{i}": {"level": 0.1} for i in range(500)}
+        d = FleetDoc.from_json(json.dumps(
+            {"i": "x", "g": 1, "s": 1, "c": clusters}))
+        assert len(d.clusters) <= 64
+
+
+# ---- FleetView -------------------------------------------------------------
+
+
+class TestFleetView:
+    def test_ordering_fences_stale_docs(self):
+        v = FleetView("me", 1)
+        assert v.ingest(_doc(gen=2, seq=5), now=0.0)
+        assert not v.ingest(_doc(gen=2, seq=5), now=0.0)  # dup
+        assert not v.ingest(_doc(gen=2, seq=4), now=0.0)  # older seq
+        assert not v.ingest(_doc(gen=1, seq=99), now=0.0)  # older gen
+        assert v.fenced == 2
+        assert v.ingest(_doc(gen=3, seq=1), now=0.0)
+
+    def test_own_newer_generation_supersedes(self):
+        v = FleetView("me", 1)
+        assert not v.ingest(_doc(inst="me", gen=1, seq=9), now=0.0)
+        assert not v.superseded  # own echo, same incarnation
+        v.ingest(_doc(inst="me", gen=2, seq=1), now=0.0)
+        assert v.superseded
+
+    def test_staleness_ttl_drops_votes(self):
+        v = FleetView("me", 1, ttl_s=1.0)
+        v.ingest(_doc(level=0.9), now=0.0)
+        assert v.quorum_level("/svc/web", 0.9, 2, now=0.5) == 0.9
+        # the peer's doc aged out: quorum of 2 can no longer be met
+        assert v.quorum_level("/svc/web", 0.9, 2, now=2.5) == 0.0
+
+    def test_quorum_order_statistic(self):
+        v = FleetView("me", 1)
+        v.ingest(_doc(inst="a", level=0.8), now=0.0)
+        v.ingest(_doc(inst="b", level=0.2), now=0.0)
+        # K-th highest of {local, a, b}
+        assert v.quorum_level("/svc/web", 0.9, 1, now=0.0) == 0.9
+        assert v.quorum_level("/svc/web", 0.9, 2, now=0.0) == 0.8
+        assert v.quorum_level("/svc/web", 0.9, 3, now=0.0) == 0.2
+        assert v.quorum_level("/svc/web", 0.9, 4, now=0.0) == 0.0
+
+    def test_peer_table_bounded_against_hostile_id_churn(self):
+        from linkerd_tpu.fleet.doc import MAX_PEERS
+        v = FleetView("me", 1, ttl_s=1.0)
+        for i in range(MAX_PEERS):
+            assert v.ingest(_doc(inst=f"p{i}"), now=0.0)
+        # table full of FRESH peers: a fabricated newcomer is rejected
+        assert not v.ingest(_doc(inst="intruder"), now=0.5)
+        assert v.rejected == 1
+        assert len(v.all_docs()) == MAX_PEERS
+        # once entries go stale, a legitimate newcomer displaces the
+        # stalest one instead of growing the table
+        assert v.ingest(_doc(inst="late-joiner"), now=5.0)
+        assert len(v.all_docs()) == MAX_PEERS
+        assert any(d.instance == "late-joiner" for d in v.all_docs())
+
+    def test_auto_generation_is_restart_monotonic(self):
+        # nanosecond auto-generations: two back-to-back incarnations
+        # (a crash-looping supervisor) must never collide
+        a = FleetExchange(FleetConfig(instance="x"), None)
+        b = FleetExchange(FleetConfig(instance="x"), None)
+        assert b.view.generation > a.view.generation
+
+    def test_unreported_cluster_carries_no_vote(self):
+        v = FleetView("me", 1)
+        v.ingest(_doc(cluster="/svc/other", level=0.99), now=0.0)
+        assert v.quorum_level("/svc/web", 0.9, 2, now=0.0) == 0.0
+        assert v.sick_votes("/svc/web", 0.9, 0.5, now=0.0) == 1
+
+
+# ---- CAS conflict regression (the machinery fleet exchange rides on) -------
+
+
+class TestCasConflictConvergence:
+    def test_local_writers_racing_converge_without_lost_update(self):
+        """Two LocalStoreClient writers race the same namespace: both
+        fetch the same version, one CAS loses — the retry loop must
+        re-apply its mutation onto the WINNER's dtab (no lost update)."""
+        async def go():
+            store = InMemoryDtabStore({"ns": Dtab.read(BASE_DTAB)})
+            gate = asyncio.Event()
+            fetched = 0
+
+            class _Gated(LocalStoreClient):
+                async def fetch(self, ns):
+                    nonlocal fetched
+                    vd = await super().fetch(ns)
+                    fetched += 1
+                    if fetched <= 2:
+                        # both writers hold the SAME version before
+                        # either writes: a guaranteed conflict
+                        if fetched == 2:
+                            gate.set()
+                        await gate.wait()
+                    return vd
+
+            conflicts = []
+
+            def writer(tag):
+                def mutate(dtab):
+                    dentry = Dtab.read(f"/w/{tag} => /x/{tag} ;")[0]
+                    return Dtab([d for d in dtab if d != dentry]
+                                + [dentry])
+                return cas_modify(_Gated(store), "ns", mutate,
+                                  on_conflict=lambda: conflicts.append(tag))
+
+            await asyncio.gather(writer("a"), writer("b"))
+            vd = await store.observe("ns").to_future()
+            assert "/w/a => /x/a" in vd.dtab.show
+            assert "/w/b => /x/b" in vd.dtab.show
+            assert "/svc => /#/io.l5d.fs" in vd.dtab.show
+            assert len(conflicts) >= 1  # the race actually happened
+
+        run(go())
+
+    def test_http_writers_racing_converge_and_etag_is_honored(self):
+        """The same race through the REAL namerd HTTP control API:
+        If-Match ETags must 412 the loser (never clobber), and the
+        retry loop must converge both writers."""
+        async def go():
+            namerd = Namerd(InMemoryDtabStore({"ns": Dtab.read(BASE_DTAB)}))
+            srv = await HttpServer(HttpControlService(namerd)).start()
+            addr = f"127.0.0.1:{srv.bound_port}"
+            c1, c2 = NamerdHttpStoreClient(addr), NamerdHttpStoreClient(addr)
+            try:
+                # ETag honored: a stale version must 412 -> typed error
+                vd = await c1.fetch("ns")
+                await c1.cas("ns", vd.dtab, vd.version)  # bumps version
+                with pytest.raises(DtabVersionMismatch):
+                    await c2.cas("ns", vd.dtab, vd.version)
+
+                # racing read-modify-write rounds from two HTTP clients
+                async def writer(client, tag):
+                    for i in range(5):
+                        def mutate(dtab, tag=tag, i=i):
+                            dentry = Dtab.read(
+                                f"/w/{tag}{i} => /x/{tag} ;")[0]
+                            return Dtab(list(dtab) + [dentry])
+                        await cas_modify(client, "ns", mutate)
+
+                await asyncio.gather(writer(c1, "a"), writer(c2, "b"))
+                vd = await c1.fetch("ns")
+                for tag in ("a", "b"):
+                    for i in range(5):
+                        assert f"/w/{tag}{i} => /x/{tag}" in vd.dtab.show, \
+                            f"lost update: {tag}{i}"
+            finally:
+                await c1.aclose()
+                await c2.aclose()
+                await srv.close()
+                await namerd.close()
+
+        run(go())
+
+    def test_create_race_converges(self):
+        """Two writers racing the CREATION of a namespace: one wins the
+        POST, the loser retries as an update — both dentries land."""
+        async def go():
+            store = InMemoryDtabStore()
+
+            async def writer(tag):
+                def mutate(dtab):
+                    return Dtab(list(dtab)
+                                + [Dtab.read(f"/w/{tag} => /x/{tag} ;")[0]])
+                await cas_modify(LocalStoreClient(store), "fresh", mutate,
+                                 create_if_missing=Dtab.empty())
+
+            await asyncio.gather(writer("a"), writer("b"))
+            vd = await store.observe("fresh").to_future()
+            assert "/w/a" in vd.dtab.show and "/w/b" in vd.dtab.show
+
+        run(go())
+
+
+# ---- FleetExchange ---------------------------------------------------------
+
+
+def _exchange(store, inst, gen=1, quorum=2, metrics=None, **kw):
+    cfg = FleetConfig(instance=inst, generation=gen, quorum=quorum, **kw)
+    node = (metrics.scope("control", "fleet")
+            if metrics is not None else None)
+    return cfg.mk(LocalStoreClient(store) if store is not None else None,
+                  metrics_node=node)
+
+
+class TestFleetExchange:
+    def test_publish_ingests_peers_through_namerd(self):
+        async def go():
+            store = InMemoryDtabStore()
+            m = MetricsTree()
+            ex_a = _exchange(store, "a", metrics=m)
+            ex_b = _exchange(store, "b")
+            ex_a.set_source(lambda: {"/svc/web": 0.9})
+            ex_b.set_source(lambda: {"/svc/web": 0.7})
+            await ex_a.publish_once()   # creates the namespace
+            await ex_b.publish_once()   # sees a's doc
+            await ex_a.publish_once()   # sees b's doc
+            assert ex_a.view.fresh_count() == 1
+            assert ex_b.view.fresh_count() == 1
+            assert ex_a.quorum_level("/svc/web", 0.9) == 0.7
+            vd = await store.observe("fleet").to_future()
+            assert len(vd.dtab) == 2  # one dentry per instance, no dups
+            flat = m.flatten()
+            assert flat["control/fleet/docs_published"] == 2
+            assert flat["control/fleet/peers_fresh"] == 1.0
+
+        run(go())
+
+    def test_republish_replaces_own_dentry(self):
+        async def go():
+            store = InMemoryDtabStore()
+            ex = _exchange(store, "a")
+            ex.set_source(lambda: {"/svc/web": 0.5})
+            for _ in range(4):
+                await ex.publish_once()
+            vd = await store.observe("fleet").to_future()
+            assert len(vd.dtab) == 1
+
+        run(go())
+
+    def test_operator_dentries_in_namespace_survive(self):
+        async def go():
+            store = InMemoryDtabStore(
+                {"fleet": Dtab.read("/ops => /#/io.l5d.fs/ops ;")})
+            ex = _exchange(store, "a")
+            await ex.publish_once()
+            vd = await store.observe("fleet").to_future()
+            assert "/ops => /#/io.l5d.fs/ops" in vd.dtab.show
+            assert "/fleet/a" in vd.dtab.show
+
+        run(go())
+
+    def test_gossip_round_exchanges_docs_both_ways(self):
+        async def go():
+            # instance b serves the admin gossip endpoint
+            ex_b = _exchange(None, "b")
+            ex_b.set_source(lambda: {"/svc/web": 0.8})
+            admin = AdminServer(MetricsTree(), port=0)
+            for p, h in fleet_admin_handlers(ex_b):
+                admin.add_handler(p, h)
+            await admin.start()
+            try:
+                cfg = FleetConfig(
+                    instance="a", generation=1, quorum=2,
+                    peers=[f"127.0.0.1:{admin.bound_port}"])
+                ex_a = FleetExchange(cfg, None)
+                ex_a.set_source(lambda: {"/svc/web": 0.6})
+                accepted = await ex_a.gossip_round()
+                assert accepted == 1
+                assert ex_a.quorum_level("/svc/web", 0.6) == 0.6
+                # the push half: b learned a's doc from the POST body
+                assert [d.instance for d in ex_b.view.all_docs()] == ["a"]
+                await ex_a.aclose()
+            finally:
+                await admin.close()
+
+        run(go())
+
+    def test_malformed_gossip_input_dropped_not_raised(self):
+        ex = _exchange(None, "a")
+        assert ex.ingest_objs([{"i": "bad/slash"}, 42, None,
+                               {"i": "ok", "g": 1, "s": 1}]) == 1
+        assert ex.ingest_objs("nope") == 0
+
+    def test_unwarmed_instance_publishes_identity_only(self):
+        ex = _exchange(None, "a")
+        ex.set_source(lambda: {"/svc/web": 0.99},
+                      warmed_fn=lambda: False)
+        doc = ex.build_doc()
+        assert doc.clusters == {}
+        assert doc.instance == "a"
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            FleetExchange(FleetConfig(instance="bad id!"), None)
+        with pytest.raises(ValueError):
+            FleetExchange(FleetConfig(instance="a", stalenessTtlS=0), None)
+        assert FleetConfig(expectInstances=5).effective_quorum() == 3
+        assert FleetConfig().effective_quorum() == 2
+        assert FleetConfig(quorum=4).effective_quorum() == 4
+
+
+# ---- quorum-gated actuation -------------------------------------------------
+
+
+def _fleet_reactor(store, board, exchange, quorum=1, dwell=0.0,
+                   metrics=None):
+    node = (metrics or MetricsTree()).scope("control", "reactor")
+    return MeshReactor(
+        board, LocalStoreClient(store), "default",
+        {"/svc/web": "/svc/web-b"},
+        governor=HysteresisGovernor(enter=0.6, exit=0.2, quorum=quorum,
+                                    dwell_s=dwell),
+        metrics_node=node, namer_prefixes=PREFIXES, fleet=exchange)
+
+
+class TestQuorumGatedActuation:
+    def test_minority_evidence_never_actuates(self):
+        async def go():
+            store = InMemoryDtabStore({"default": Dtab.read(BASE_DTAB)})
+            board = _Board()
+            ex = _exchange(store, "me", quorum=2)
+            r = _fleet_reactor(store, board, ex)
+            board.levels["/svc/web"] = 0.95  # only WE see it
+            for t in range(1, 20):
+                await r.step(now=float(t))
+            assert r.active == {}
+            vd = await store.observe("default").to_future()
+            assert "web-b" not in vd.dtab.show
+
+        run(go())
+
+    def test_quorum_evidence_actuates_and_reverts(self):
+        async def go():
+            store = InMemoryDtabStore({"default": Dtab.read(BASE_DTAB)})
+            board = _Board()
+            ex = _exchange(store, "me", quorum=2)
+            r = _fleet_reactor(store, board, ex)
+            board.levels["/svc/web"] = 0.95
+            ex.view.ingest(_doc(inst="peer", level=0.9))
+            await r.step(now=1.0)
+            assert "/svc/web" in r.active
+            # the peer recovers: quorum dissolves, revert
+            ex.view.ingest(_doc(inst="peer", seq=2, level=0.05))
+            board.levels["/svc/web"] = 0.1
+            await r.step(now=2.0)
+            assert r.active == {}
+            vd = await store.observe("default").to_future()
+            assert vd.dtab.show.strip() == Dtab.read(BASE_DTAB).show.strip()
+
+        run(go())
+
+    def test_stale_peer_loses_its_vote(self):
+        async def go():
+            store = InMemoryDtabStore({"default": Dtab.read(BASE_DTAB)})
+            board = _Board()
+            ex = _exchange(store, "me", quorum=2, stalenessTtlS=0.05)
+            r = _fleet_reactor(store, board, ex)
+            board.levels["/svc/web"] = 0.95
+            ex.view.ingest(_doc(inst="peer", level=0.9))
+            await asyncio.sleep(0.1)  # the peer's doc ages out
+            await r.step(now=1.0)
+            assert r.active == {}  # one live paranoid router: no shift
+
+        run(go())
+
+    def test_control_loop_wires_fleet_from_yaml(self, tmp_path):
+        from linkerd_tpu.linker import load_linker
+        linker = load_linker(f"""
+routers:
+- protocol: http
+  dtab: |
+    /svc => /#/io.l5d.fs ;
+  servers: [{{port: 0}}]
+namers:
+- kind: io.l5d.fs
+  rootDir: {tmp_path}
+telemetry:
+- kind: io.l5d.jaxAnomaly
+  control:
+    namespace: default
+    namerdAddress: 127.0.0.1:4180
+    failover:
+      /svc/web: /svc/web-b
+    fleet:
+      instance: l5d-a
+      quorum: 2
+      expectInstances: 3
+      peers: [127.0.0.1:9991]
+""")
+        tele = linker.telemeters[0]
+        assert tele.control.fleet is not None
+        assert tele.control.fleet.quorum == 2
+        assert tele.control.reactor._fleet is tele.control.fleet
+        paths = [p for p, _ in tele.admin_handlers()]
+        assert "/fleet.json" in paths
+        assert "/fleet/gossip.json" in paths
+        run(linker.close())
+
+
+# ---- generation fencing -----------------------------------------------------
+
+
+class TestGenerationFencing:
+    def test_superseded_instance_stops_actuating(self):
+        async def go():
+            store = InMemoryDtabStore({"default": Dtab.read(BASE_DTAB)})
+            board = _Board()
+            m = MetricsTree()
+            ex = _exchange(store, "me", quorum=1, metrics=m)
+            r = _fleet_reactor(store, board, ex,
+                               metrics=m)
+            board.levels["/svc/web"] = 0.95
+            ex.view.ingest(_doc(inst="me", gen=2))  # successor appeared
+            await r.step(now=1.0)
+            assert r.active == {}
+            assert m.flatten()["control/reactor/fenced_steps"] == 1
+
+        run(go())
+
+    def test_stale_generation_cannot_revert_successors_override(self):
+        """The satellite interleaving: the OLD incarnation enters its
+        revert (cluster looks healthy to it), parks on the store
+        fetch; the NEW incarnation's supersede signal can land at any
+        point around it. Invariant over every seeded interleaving: no
+        store write is ever DISPATCHED after the supersede was
+        ingested (a write that raced ahead of the supersede is
+        legitimate — fencing is about what happens once the signal is
+        known)."""
+        from linkerd_tpu.testing.schedules import explore
+
+        def mk(sched):
+            store = InMemoryDtabStore({"default": Dtab.read(
+                BASE_DTAB + " /svc/web => /svc/web-b ;")})
+            board = _Board()
+            ex = _exchange(store, "me", quorum=1)
+            writes_after_supersede = []
+
+            class _Gated(LocalStoreClient):
+                async def fetch(self, ns):
+                    await sched.point("fetch")
+                    return await super().fetch(ns)
+
+                async def cas(self, ns, dtab, version):
+                    writes_after_supersede.append(ex.superseded)
+                    await super().cas(ns, dtab, version)
+
+            r = _fleet_reactor(store, board, ex)
+            r._client = _Gated(store)
+            # the old incarnation believes it owns the override (it
+            # published it before "restarting")
+            r.active["/svc/web"] = Dtab.read("/svc/web => /svc/web-b ;")[0]
+            board.levels["/svc/web"] = 0.0  # looks healthy to the zombie
+
+            async def zombie_revert():
+                await r.step(now=100.0)
+
+            async def supersede():
+                await sched.point("supersede")
+                ex.view.ingest(_doc(inst="me", gen=2))
+
+            async def check():
+                await sched.point("check")
+                assert not any(writes_after_supersede), \
+                    "zombie dispatched a store write AFTER its " \
+                    "supersede was ingested"
+                vd = await store.observe("default").to_future()
+                if not writes_after_supersede:
+                    # no legitimate pre-supersede revert happened: the
+                    # successor's dentry must have survived
+                    assert "/svc/web => /svc/web-b" in vd.dtab.show
+                return True
+
+            return [zombie_revert(), supersede(), check()]
+
+        def invariant(results):
+            for res in results:
+                if isinstance(res, BaseException):
+                    raise AssertionError(repr(res))
+
+        failure = explore(mk, invariant, seeds=range(32), timeout=10.0)
+        assert failure is None, f"interleaving violated fencing: {failure}"
+
+    def test_supersede_landing_mid_revert_is_fenced(self):
+        """The exact worst-case order, pinned explicitly: the zombie's
+        step passes its entry fence check and parks on the store
+        fetch; the supersede lands; the fetch resumes — the re-check
+        must block the revert (no write, bookkeeping untouched)."""
+        from linkerd_tpu.testing.schedules import DeterministicScheduler
+
+        store = InMemoryDtabStore({"default": Dtab.read(
+            BASE_DTAB + " /svc/web => /svc/web-b ;")})
+        board = _Board()
+        ex = _exchange(store, "me", quorum=1)
+        sched = DeterministicScheduler(
+            order=["supersede", "fetch", "check"])
+        wrote = []
+
+        class _Gated(LocalStoreClient):
+            async def fetch(self, ns):
+                # the zombie parks HERE with its entry check already
+                # passed; "supersede" is released before this point is
+                await sched.point("fetch")
+                return await super().fetch(ns)
+
+            async def cas(self, ns, dtab, version):
+                wrote.append(dtab.show)
+                await super().cas(ns, dtab, version)
+
+        r = _fleet_reactor(store, board, ex)
+        r._client = _Gated(store)
+        r.active["/svc/web"] = Dtab.read("/svc/web => /svc/web-b ;")[0]
+        board.levels["/svc/web"] = 0.0
+
+        async def supersede():
+            await sched.point("supersede")
+            ex.view.ingest(_doc(inst="me", gen=2))
+
+        async def check():
+            await sched.point("check")
+            return True
+
+        sched.run_sync(r.step(now=100.0), supersede(), check())
+        assert wrote == []  # the revert never reached the store
+        vd = store.observe("default").current.value
+        assert "/svc/web => /svc/web-b" in vd.dtab.show
+        assert "/svc/web" in r.active  # bookkeeping untouched too
+
+
+# ---- scorer replica pool ----------------------------------------------------
+
+
+class _FakeReplica:
+    def __init__(self, addr, fail=False):
+        self.addr = addr
+        self.fail = fail
+        self.calls = 0
+        self.closed = False
+        self.last_timing = {"rpc_ms": 1.0}
+
+    async def score(self, x):
+        self.calls += 1
+        if self.fail:
+            raise RuntimeError(f"replica {self.addr} down")
+        return np.zeros(len(x), np.float32)
+
+    async def fit(self, x, labels, mask):
+        self.calls += 1
+        return 0.0
+
+    def close(self):
+        self.closed = True
+
+
+class TestScorerReplicaPool:
+    def test_load_spreads_across_replicas(self):
+        async def go():
+            made = {}
+
+            def mk(addr):
+                made[addr] = _FakeReplica(addr)
+                return made[addr]
+
+            pool = ScorerReplicaPool(["a:1", "b:2"], mk_client=mk)
+            for _ in range(10):
+                await pool.score(np.zeros((4, 3), np.float32))
+            assert made["a:1"].calls > 0 and made["b:2"].calls > 0
+
+        run(go())
+
+    def test_failover_to_healthy_replica(self):
+        async def go():
+            made = {}
+
+            def mk(addr):
+                made[addr] = _FakeReplica(addr, fail=addr.startswith("bad"))
+                return made[addr]
+
+            pool = ScorerReplicaPool(["bad:1", "ok:2"], mk_client=mk)
+            for _ in range(6):
+                out = await pool.score(np.zeros((2, 3), np.float32))
+                assert len(out) == 2
+            # dead replica was tried, healthy one carried every call
+            assert made["ok:2"].calls >= 6
+
+        run(go())
+
+    def test_all_replicas_down_raises(self):
+        async def go():
+            pool = ScorerReplicaPool(
+                ["bad:1", "bad:2"],
+                mk_client=lambda a: _FakeReplica(a, fail=True))
+            with pytest.raises(RuntimeError):
+                await pool.score(np.zeros((2, 3), np.float32))
+
+        run(go())
+
+    def test_membership_diff_keeps_surviving_clients(self):
+        made = {}
+
+        def mk(addr):
+            made[addr] = _FakeReplica(addr)
+            return made[addr]
+
+        pool = ScorerReplicaPool(["a:1", "b:2"], mk_client=mk)
+        keep = made["a:1"]
+        pool.set_addresses(["a:1", "c:3"])
+        assert pool.addresses() == ["a:1", "c:3"]
+        assert made["b:2"].closed
+        assert not keep.closed
+        # the surviving client object is the SAME instance (warm channel)
+        assert pool._replicas["a:1"].scorer is keep
+
+    def test_announced_replicas_resolve_through_real_namer(self, tmp_path):
+        """The announcer half: two scorer 'replicas' fs-announce into a
+        disco dir; the pool resolves /#/io.l5d.fs/l5d-scorer through a
+        real FsNamer and converges on both addresses."""
+        from linkerd_tpu.announcer import FsAnnouncer
+        from linkerd_tpu.namer.fs import FsNamer
+
+        async def go():
+            ann = FsAnnouncer(str(tmp_path), Path.read("/io.l5d.fs"))
+            a1 = ann.announce("127.0.0.1", 7001, Path.read("/l5d-scorer"))
+            ann.announce("127.0.0.1", 7002, Path.read("/l5d-scorer"))
+            namer = FsNamer(str(tmp_path), poll_interval=0.02)
+            act = namer_scorer_activity(
+                [(Path.read("/io.l5d.fs"), namer)], "/#/io.l5d.fs/l5d-scorer")
+            pool = ScorerReplicaPool(mk_client=_FakeReplica)
+            pool.attach_activity(act, poll_interval_s=0.02)
+            pool.start_watch()
+            try:
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline:
+                    if pool.addresses() == ["127.0.0.1:7001",
+                                            "127.0.0.1:7002"]:
+                        break
+                    await asyncio.sleep(0.02)
+                assert pool.addresses() == ["127.0.0.1:7001",
+                                            "127.0.0.1:7002"]
+                # a replica withdraws: the pool follows
+                a1.close()
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline:
+                    if pool.addresses() == ["127.0.0.1:7002"]:
+                        break
+                    await asyncio.sleep(0.02)
+                assert pool.addresses() == ["127.0.0.1:7002"]
+            finally:
+                pool.close()
+                act.close()
+                namer.close()
+
+        run(go())
+
+    def test_pool_over_real_grpc_sidecars_fails_over(self):
+        """Two REAL gRPC scorer sidecars behind the pool: both serve
+        score traffic; killing one fails calls over to the survivor
+        within the same call."""
+        pytest.importorskip("grpc")
+        from linkerd_tpu.telemetry.sidecar import ScorerSidecar
+
+        class _Stub:
+            async def score(self, x):
+                return np.full(len(x), 0.25, np.float32)
+
+            async def fit(self, x, labels, mask):
+                return 0.0
+
+            def close(self):
+                pass
+
+        async def go():
+            s1 = await ScorerSidecar(scorer=_Stub()).start()
+            s2 = await ScorerSidecar(scorer=_Stub()).start()
+            pool = ScorerReplicaPool(
+                [f"127.0.0.1:{s1.port}", f"127.0.0.1:{s2.port}"])
+            try:
+                x = np.zeros((8, 4), np.float32)
+                for _ in range(6):
+                    out = await pool.score(x)
+                    assert out.shape == (8,)
+                    assert float(out[0]) == 0.25
+                calls = {a: r.calls
+                         for a, r in pool._replicas.items()}
+                assert all(c > 0 for c in calls.values()), calls
+                await s1.close()
+                for _ in range(4):  # failover carries every call
+                    out = await pool.score(x)
+                    assert out.shape == (8,)
+            finally:
+                pool.close()
+                await s2.close()
+
+        run(go())
+
+    def test_unknown_namer_path_fails_loudly(self):
+        with pytest.raises(ValueError):
+            namer_scorer_activity([], "/#/io.l5d.nope/l5d-scorer")
+        with pytest.raises(ValueError):
+            namer_scorer_activity([], "/svc/l5d-scorer")
+
+    def test_telemeter_builds_pool_for_list_and_path_addresses(self):
+        from linkerd_tpu.telemetry.anomaly import (
+            JaxAnomalyConfig, JaxAnomalyTelemeter,
+        )
+        tele = JaxAnomalyTelemeter(
+            JaxAnomalyConfig(sidecarAddress="127.0.0.1:1,127.0.0.1:2"),
+            MetricsTree())
+        client = tele._mk_sidecar_client()
+        assert isinstance(client, ScorerReplicaPool)
+        assert client.addresses() == ["127.0.0.1:1", "127.0.0.1:2"]
+        client.close()
+        tele2 = JaxAnomalyTelemeter(
+            JaxAnomalyConfig(sidecarAddress="/#/io.l5d.fs/l5d-scorer"),
+            MetricsTree())
+        client2 = tele2._mk_sidecar_client()
+        assert isinstance(client2, ScorerReplicaPool)
+        assert client2.addresses() == []
+        client2.close()
+
+
+# ---- end to end on the real binaries ---------------------------------------
+
+
+class TestFleetEndToEnd:
+    def test_quorum_shift_and_exact_revert_on_real_binaries(self):
+        """3 linkerds + namerd as subprocesses (assembled binaries): a
+        fault observed by 1/3 instances shifts NOTHING; the same fault
+        observed by 2/3 triggers exactly ONE fleet-wide dtab shift
+        (peers adopt, zero flaps); recovery reverts the namespace to
+        exactly its base dtab."""
+        from linkerd_tpu.testing.fleet import FleetHarness, _http
+
+        async def go():
+            h = FleetHarness(n=3, quorum=2, warmup_batches=40)
+            await h.start()
+            try:
+                h.start_traffic(interval_s=0.02)
+                await h.warm(settle_s=3.0)
+
+                # phase 1: minority evidence -> no shift
+                h.primary.fault_insts = {h.instance_ids[0]}
+                await asyncio.sleep(6.0)
+                assert await h.fleet_metric_sum(
+                    "control/reactor/overrides_published") == 0, \
+                    "shifted on minority evidence"
+
+                # phase 2: quorum evidence -> exactly one fleet shift
+                h.primary.fault_insts = {h.instance_ids[0],
+                                         h.instance_ids[1]}
+                await h.wait_metric(
+                    "control/reactor/overrides_published", 1, 90)
+                # the shift is FLEET-wide: visible at the UNfaulted
+                # instance too
+                await h.wait_for(
+                    lambda: h._route_sync(2) == b"B", 20,
+                    "shift visible at the unfaulted instance")
+                assert await h.fleet_metric_sum(
+                    "control/reactor/overrides_published") == 1
+                # peers ADOPT the published dentry instead of stacking
+                # duplicates (their governors trip within the same
+                # evidence window; the count is cumulative, so a
+                # bounded wait observes it without racing them)
+                await h.wait_metric(
+                    "control/reactor/overrides_adopted", 1, 20)
+
+                # phase 3: recovery -> exact revert, zero flaps
+                h.primary.fault_insts = set()
+                await h.wait_metric(
+                    "control/reactor/overrides_reverted", 1, 90)
+                await h.wait_for(
+                    lambda: h._route_sync(0) == b"A", 20,
+                    "traffic back on the primary")
+                assert await h.fleet_metric_sum(
+                    "control/reactor/overrides_published") == 1, "flapped"
+
+                def namespace_is_base() -> bool:
+                    _, body = _http(
+                        "GET", h._namerd_url("/api/1/dtabs/default"))
+                    dentries = json.loads(body)
+                    return dentries == [
+                        {"prefix": "/svc", "dst": "/#/io.l5d.fs"}]
+
+                await h.wait_for(namespace_is_base, 10,
+                                 "namespace reverted to exactly base")
+
+                # the fleet saw each other: every instance ingested docs
+                for i in range(3):
+                    st = await h.admin_json(i, "/fleet.json")
+                    assert len(st["peers"]) == 2, st
+            finally:
+                await h.stop()
+
+        run(go(), timeout=240)

@@ -27,11 +27,12 @@ from tools.analysis.core import (  # noqa: F401 — re-exports
 )
 
 # The packages the race suite gates (the asyncio data plane + the
-# reactive control loop, whose reactor steps race its own run() tick).
+# reactive control loop, whose reactor steps race its own run() tick,
+# + the fleet exchange, whose gossip handler races the publish task).
 # Startup/assembly code may block and single-task freely.
 DEFAULT_SCOPE = ("linkerd_tpu/router", "linkerd_tpu/protocol",
                  "linkerd_tpu/telemetry", "linkerd_tpu/lifecycle",
-                 "linkerd_tpu/control")
+                 "linkerd_tpu/control", "linkerd_tpu/fleet")
 
 
 def run_race_analysis(scan_paths: Optional[Sequence[str]] = None,

@@ -35,6 +35,7 @@ SEMANTIC_RULES = (
     "fastpath-workers",   # multi-core sharding knob wiring
     "scorer-config", "scorer-width",
     "override-unsafe",    # reactor-generated dtab overrides (control/)
+    "fleet-config",       # fleet exchange / quorum-gated actuation wiring
 )
 
 

@@ -42,6 +42,10 @@ def check_telemetry(source: ConfigSource, spec: LinkerSpec
         if cfg.control is not None:
             yield from _check_control_cfg(source, cfg.control, spec,
                                           f"{where}.control")
+            if cfg.control.fleet is not None:
+                yield from _check_fleet_cfg(source, cfg.control,
+                                            spec,
+                                            f"{where}.control.fleet")
         if cfg.lifecycle is not None:
             yield from _check_lifecycle_cfg(source, cfg.lifecycle,
                                             f"{where}.lifecycle")
@@ -179,6 +183,69 @@ def _check_control_cfg(source: ConfigSource, ctl, spec: LinkerSpec,
                        f"failover {cluster} -> {target} uses a wildcard "
                        f"segment — overrides must name one concrete "
                        f"cluster", "failover")
+
+
+def _check_fleet_cfg(source: ConfigSource, ctl, spec: LinkerSpec,
+                     where: str) -> Iterator[Finding]:
+    """Fleet exchange / quorum wiring interlocks: a quorum that can
+    never be met silently pins the mesh healthy forever, a quorum of 1
+    with actuation enabled defeats the whole point of fleet gating, a
+    staleness TTL shorter than the doc refresh cadence makes every peer
+    doc stale on arrival, and a gossip endpoint needs the admin server
+    its peers are configured to reach."""
+    from linkerd_tpu.fleet.doc import valid_instance
+
+    fleet = ctl.fleet
+    if fleet.instance is not None and not valid_instance(fleet.instance):
+        yield _bad(source, "fleet-config", where,
+                   f"instance {fleet.instance!r} must match "
+                   f"[A-Za-z0-9._-]{{1,64}} (it becomes a dtab dentry "
+                   f"prefix segment)", "instance")
+    if fleet.quorum < 0 or fleet.expectInstances < 0:
+        yield _bad(source, "fleet-config", where,
+                   f"quorum/expectInstances must be >= 0 (0 = auto; got "
+                   f"quorum={fleet.quorum}, "
+                   f"expectInstances={fleet.expectInstances})", "quorum")
+        return
+    if (fleet.quorum > 0 and fleet.expectInstances > 0
+            and fleet.quorum > fleet.expectInstances):
+        yield _bad(source, "fleet-config", where,
+                   f"quorum ({fleet.quorum}) exceeds expectInstances "
+                   f"({fleet.expectInstances}) — the quorum can never "
+                   f"be met and no anomaly will ever actuate",
+                   "quorum")
+    if fleet.quorum == 1 and ctl.failover:
+        yield _bad(source, "fleet-config", where,
+                   "quorum: 1 with failover actuation enabled — any "
+                   "single instance shifts the whole mesh, which "
+                   "defeats quorum gating (use quorum >= 2, or drop "
+                   "the fleet block for single-instance behavior)",
+                   "quorum", severity="warning")
+    if fleet.publishIntervalS <= 0 or fleet.stalenessTtlS <= 0:
+        yield _bad(source, "fleet-config", where,
+                   f"publishIntervalS and stalenessTtlS must be > 0 "
+                   f"(got {fleet.publishIntervalS}, "
+                   f"{fleet.stalenessTtlS})", "publishIntervalS")
+        return
+    gossiping = bool(fleet.gossip and fleet.peers)
+    refresh_s = fleet.publishIntervalS
+    if gossiping and fleet.gossipIntervalMs > 0:
+        refresh_s = min(refresh_s, fleet.gossipIntervalMs / 1e3)
+    if fleet.stalenessTtlS < refresh_s:
+        yield _bad(source, "fleet-config", where,
+                   f"stalenessTtlS ({fleet.stalenessTtlS}) is shorter "
+                   f"than the doc refresh cadence ({refresh_s}s) — "
+                   f"every peer doc expires before its successor "
+                   f"arrives, so no peer ever carries a vote and the "
+                   f"quorum can never be met", "stalenessTtlS")
+    if gossiping and spec.admin is None:
+        yield _bad(source, "fleet-config", where,
+                   "gossip peers are configured but this linker has no "
+                   "admin: block — the gossip endpoint rides the admin "
+                   "server, and without an explicit admin port every "
+                   "fleet instance binds the default (colliding on one "
+                   "host, and unreachable at the address peers were "
+                   "given)", "peers", severity="warning")
 
 
 def _check_lifecycle_cfg(source: ConfigSource, lc, where: str

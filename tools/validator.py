@@ -108,6 +108,15 @@ scored fraction stayed 1.0 — the shared read-only weight slab reached
 every core:
 
     python tools/validator.py cores
+
+And the fleet validation: boot 3 REAL linkerd binaries + 1 namerd
+binary as a coordinated mesh (cross-instance score exchange through
+the namerd store + admin-server gossip, quorum-gated actuation), and
+assert that a fault visible to 1/3 instances shifts nothing, a fault
+visible to 2/3 triggers exactly one fleet-wide dtab shift (peers
+adopt; zero flaps), and recovery reverts the namespace exactly:
+
+    python tools/validator.py fleet
 """
 
 from __future__ import annotations
@@ -1447,6 +1456,71 @@ admin:
         d_boom.close()
 
 
+async def validate_fleet() -> None:
+    """Boot the REAL fleet — 3 linkerd binaries + 1 namerd binary
+    (testing/fleet.py harness) — and assert quorum-gated coordination
+    end to end: a fault visible to 1/3 instances shifts NOTHING; the
+    same fault visible to 2/3 triggers exactly ONE fleet-wide dtab
+    shift (peers adopt the published dentry, zero flaps); recovery
+    reverts the namespace to exactly its base dtab. Prints one
+    ``FLEET {json}`` line with the measured windows."""
+    from linkerd_tpu.testing.fleet import FleetHarness, _http
+
+    h = FleetHarness(n=3, quorum=2, warmup_batches=40)
+    await h.start()
+    try:
+        h.start_traffic(interval_s=0.02)
+        await h.warm(settle_s=3.0)
+        print("validator[fleet]: 3 linkerds + namerd up, scorers warm")
+
+        h.primary.fault_insts = {h.instance_ids[0]}
+        await asyncio.sleep(6.0)
+        pub = await h.fleet_metric_sum(
+            "control/reactor/overrides_published")
+        assert pub == 0, f"shifted on 1/3 evidence: {pub}"
+        print("validator[fleet]: fault on 1/3 instances -> no shift")
+
+        h.primary.fault_insts = {h.instance_ids[0], h.instance_ids[1]}
+        publish_s = await h.wait_metric(
+            "control/reactor/overrides_published", 1, 90)
+        t0 = time.time()
+        await h.wait_for(lambda: h._route_sync(2) == b"B", 20,
+                         "fleet-wide shift")
+        shift_s = publish_s + (time.time() - t0)
+        assert await h.fleet_metric_sum(
+            "control/reactor/overrides_published") == 1
+        adopt_s = await h.wait_metric(
+            "control/reactor/overrides_adopted", 1, 20)
+        print(f"validator[fleet]: quorum fault -> ONE publish in "
+              f"{publish_s:.2f}s, fleet-wide shift in {shift_s:.2f}s, "
+              f"peer adoption in {adopt_s:.2f}s")
+
+        h.primary.fault_insts = set()
+        revert_s = await h.wait_metric(
+            "control/reactor/overrides_reverted", 1, 90)
+        await h.wait_for(lambda: h._route_sync(0) == b"A", 20,
+                         "traffic back on the primary")
+        assert await h.fleet_metric_sum(
+            "control/reactor/overrides_published") == 1, "flapped!"
+
+        def namespace_is_base() -> bool:
+            _, body = _http("GET", h._namerd_url("/api/1/dtabs/default"))
+            return json.loads(body) == [
+                {"prefix": "/svc", "dst": "/#/io.l5d.fs"}]
+
+        await h.wait_for(namespace_is_base, 10, "exact namespace revert")
+        print(f"validator[fleet]: reverted exactly in {revert_s:.2f}s, "
+              f"zero flaps")
+        print("FLEET " + json.dumps({
+            "publish_s": round(publish_s, 2),
+            "shift_s": round(shift_s, 2),
+            "revert_s": round(revert_s, 2),
+            "publishes": 1,
+        }))
+    finally:
+        await h.stop()
+
+
 async def validate_trace() -> None:
     """Boot the REAL linkerd binary as a two-router chain with a zipkin
     exporter, drive one traced request, assert the exported spans form
@@ -1704,6 +1778,10 @@ async def main() -> int:
     if args and args[0] == "cores":
         await validate_cores()
         print("VALIDATOR PASS (cores)")
+        return 0
+    if args and args[0] == "fleet":
+        await validate_fleet()
+        print("VALIDATOR PASS (fleet)")
         return 0
     protocols = args or ["mesh", "thrift", "http"]
     for protocol in protocols:
