@@ -414,6 +414,224 @@ def native_score_bench() -> dict:
     return asyncio.run(asyncio.wait_for(drive(), 240))
 
 
+_SPECIALIST_CHILD = r"""
+import base64, json, os, sys
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from linkerd_tpu.models.features import FeatureVector, featurize_batch
+from linkerd_tpu.telemetry.anomaly import InProcessScorer
+from linkerd_tpu.lifecycle.export import export_weight_blob
+from linkerd_tpu.testing.faults import auc
+from linkerd_tpu import native
+import asyncio
+
+rng = np.random.default_rng(7)
+
+def rows(n, fault):
+    out = []
+    for _ in range(n):
+        lat = float(rng.lognormal(2.0, 0.4))
+        status = 200
+        if fault:
+            lat *= 1.6                      # subtle: latency inflation
+            if rng.random() < 0.08:
+                status = 503                # partial error rate
+        out.append(FeatureVector(latency_ms=lat, status=status,
+                                 dst_path="/svc/spec",
+                                 lat_drift_ms=lat - 7.5 if fault else 0.0))
+    return featurize_batch(out)
+
+async def train():
+    s = InProcessScorer(seed=1, learning_rate=3e-3)
+    try:
+        for _ in range(10):
+            xn = rows(64, False)
+            await s.fit(xn, np.zeros(64, np.float32),
+                        np.zeros(64, np.float32))
+        # a few labeled batches teach the classifier head
+        for _ in range(6):
+            half = np.concatenate([rows(32, False), rows(32, True)])
+            labels = np.concatenate([np.zeros(32), np.ones(32)]).astype(
+                np.float32)
+            await s.fit(half, labels, np.ones(64, np.float32))
+        return s.snapshot()
+    finally:
+        s.close()
+
+snap = asyncio.run(train())
+x = np.concatenate([rows(200, False), rows(200, True)])
+labels = [0.0] * 200 + [1.0] * 200
+out = {}
+for quant in ("f32", "int8", "int4"):
+    blob = export_weight_blob(snap, 1, quant)
+    scores = native.score_eval(blob, x)
+    out[quant] = {"fault_auc_subtle": round(
+        auc(labels, [float(v) for v in scores]), 4),
+        "blob_bytes": len(blob)}
+print(json.dumps(out))
+"""
+
+
+def specialist_bench() -> dict:
+    """Specialist-bank score-quality/latency frontier, device-free in
+    this process (the JAX half runs in a JAX_PLATFORMS=cpu subprocess
+    with its own timeout, so a wedged platform init costs this phase
+    only):
+
+    - per-quant-level (f32/int8/int4) ``native_score_p99_us`` measured
+      on a real 2-worker h1 engine serving a BANK whose specialist head
+      is selected by the route hash — the engine-side cost of the
+      frontier's latency axis;
+    - per-quant-level ``fault_auc_subtle``: a subprocess trains the
+      scorer on synthetic subtle faults (latency inflation + partial
+      error rate), exports all three quant levels, and the C evaluator
+      scores a held-out labeled set — the quality axis;
+    - ``delta_bytes`` vs ``full_bytes`` per quant (what a per-route
+      delta publish saves over re-shipping the bank);
+    - ``swap_full_ms`` / ``swap_delta_ms``: publish latency under the
+      same paced load (the hot-swap cost the reader-recheck protocol
+      must hide).
+    """
+    import asyncio
+    import subprocess
+    import sys
+
+    import numpy as np
+
+    from linkerd_tpu import native
+
+    if not native.available():
+        return {"error": "native lib unavailable"}
+
+    out: dict = {}
+    # quality axis: trained model -> per-quant AUC (subprocess)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _SPECIALIST_CHILD],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            capture_output=True, text=True, timeout=420,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if proc.returncode != 0:
+            out["auc_error"] = proc.stderr[-300:]
+        else:
+            quality = json.loads(proc.stdout.strip().splitlines()[-1])
+            out["per_quant"] = quality
+    except Exception as e:  # noqa: BLE001 — the latency axis below
+        out["auc_error"] = repr(e)  # still reports without the child
+
+    # size axis: full bank (8 heads) vs one-route delta, per quant
+    for quant in ("f32", "int8", "int4"):
+        full = native.score_test_bank(generation=1, quant=quant,
+                                      seed=3, n_heads=8)
+        delta = native.score_test_delta(1, 2, 1000, quant=quant, seed=4)
+        row = out.setdefault("per_quant", {}).setdefault(quant, {})
+        row["full_bank_bytes"] = len(full)
+        row["delta_bytes"] = len(delta)
+        row["delta_fraction"] = round(len(delta) / len(full), 4)
+
+    async def drive() -> None:
+        async def handle(r, w):
+            try:
+                while True:
+                    await r.readuntil(b"\r\n\r\n")
+                    w.write(b"HTTP/1.1 200 OK\r\n"
+                            b"Content-Length: 2\r\n\r\nok")
+                    await w.drain()
+            except Exception:  # noqa: BLE001 — client went away
+                pass
+
+        srv = await asyncio.start_server(handle, "127.0.0.1", 0)
+        bport = srv.sockets[0].getsockname()[1]
+        eng = native.FastPathEngine(workers=2)
+        port = eng.listen("127.0.0.1", 0)
+        eng.start()
+        eng.set_route("svc", [("127.0.0.1", bport)])
+        eng.set_route_feature("svc", 14, 1.0)
+        eng.set_route_hash("svc", 1000)  # the test banks' first head
+        rsp_len = len(b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nok")
+        req = b"GET / HTTP/1.1\r\nHost: svc\r\n\r\n"
+
+        async def paced(n: int, gap_s: float = 0.001) -> None:
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            try:
+                for _ in range(n):
+                    w.write(req)
+                    await w.drain()
+                    await r.readexactly(rsp_len)
+                    await asyncio.sleep(gap_s)
+            finally:
+                w.close()
+                try:
+                    await w.wait_closed()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        def hist_p99(hist, base) -> float:
+            total = sum(hist) - sum(base)
+            if total <= 0:
+                return None
+            acc = 0
+            for b, (c, c0) in enumerate(zip(hist, base)):
+                acc += c - c0
+                if acc >= 0.99 * total:
+                    return round(2 ** (b + 1) / 1e3, 2)
+            return None
+
+        try:
+            await paced(50, 0)  # warm route + upstream conns
+            gen = 10
+            for quant in ("f32", "int8", "int4"):
+                eng.publish_weights(native.score_test_bank(
+                    generation=gen, quant=quant, seed=3, n_heads=8))
+                base = list(eng.stats()["native_scorer"]
+                            ["score_ns_hist"])
+                await paced(300)
+                st = eng.stats()["native_scorer"]
+                row = out["per_quant"].setdefault(quant, {})
+                row["native_score_p99_us"] = hist_p99(
+                    st["score_ns_hist"], base)
+                gen += 10
+            # specialist selection really served the paced rows
+            st = eng.stats()["native_scorer"]
+            out["specialist_fraction"] = round(
+                st["specialist_scored"] / max(st["scored"], 1), 4)
+            # swap latency under the same paced load: full bank re-
+            # publish and a fenced one-route delta, timed while
+            # traffic flows
+            load = asyncio.ensure_future(paced(400))
+            full_ms, delta_ms = [], []
+            try:
+                for i in range(20):
+                    blob = native.score_test_bank(
+                        generation=gen + 2 * i, quant="int8", seed=3,
+                        n_heads=8)
+                    t0 = time.perf_counter()
+                    eng.publish_weights(blob)
+                    full_ms.append((time.perf_counter() - t0) * 1e3)
+                    d = native.score_test_delta(
+                        gen + 2 * i, gen + 2 * i + 1, 1000,
+                        quant="int8", seed=i)
+                    t0 = time.perf_counter()
+                    eng.publish_delta(d)
+                    delta_ms.append((time.perf_counter() - t0) * 1e3)
+                    await asyncio.sleep(0.02)
+            finally:
+                await load
+            out["swap_full_ms"] = round(float(np.mean(full_ms)), 3)
+            out["swap_delta_ms"] = round(float(np.mean(delta_ms)), 3)
+            out["swaps_timed"] = len(full_ms) + len(delta_ms)
+        finally:
+            eng.close()
+            srv.close()
+            await srv.wait_closed()
+
+    try:
+        asyncio.run(asyncio.wait_for(drive(), 240))
+    except Exception as e:  # noqa: BLE001 — partial results count
+        out["engine_error"] = repr(e)
+    return out
+
+
 def core_scaling_bench() -> dict:
     """Multi-core data-plane scaling, device-free: both native engines
     (h1 proxy + h2/gRPC) driven to closed-loop saturation at workers =
@@ -1519,6 +1737,19 @@ def main() -> None:
             "fleet_shift_latency_ms")
         detail["fleet"] = fl
 
+    def ph_specialist() -> None:
+        sp = specialist_bench()
+        # headline rows: the frontier's two axes at int4 (the newest
+        # quant level) + the delta-publish saving; the full per-quant
+        # table stays under detail.specialist
+        pq = sp.get("per_quant") or {}
+        i4 = pq.get("int4") or {}
+        detail["specialist_int4_p99_us"] = i4.get("native_score_p99_us")
+        detail["specialist_int4_auc"] = i4.get("fault_auc_subtle")
+        detail["specialist_delta_fraction"] = i4.get("delta_fraction")
+        detail["specialist_swap_delta_ms"] = sp.get("swap_delta_ms")
+        detail["specialist"] = sp
+
     def ph_core_scaling() -> None:
         cs = core_scaling_bench()
         # headline rows at the top level (the acceptance bar reads
@@ -1548,6 +1779,7 @@ def main() -> None:
         ("fleet", ph_fleet),
         ("tenant_isolation", ph_tenant_isolation),
         ("native_score", ph_native_score),
+        ("specialist", ph_specialist),
         ("core_scaling", ph_core_scaling),
         ("proxy", ph_proxy),
         ("grpc", ph_grpc),

@@ -68,6 +68,21 @@ class LocalStoreClient:
             return st.value
         return await act.to_future()
 
+    async def watch(self, ns: str):
+        """Standing watch on a namespace: yields each Dtab state as the
+        store publishes it (the in-process store's Activity stream —
+        the same push the namerd ifaces serve remotely). One call =
+        one open watch; the caller owns reconnect policy."""
+        from linkerd_tpu.core.activity import Failed, Ok, Pending
+        act = self._store.observe(ns)
+        async for st in act.changes():
+            if isinstance(st, Pending):
+                continue
+            if isinstance(st, Failed):
+                raise st.exc
+            if isinstance(st, Ok) and st.value is not None:
+                yield st.value.dtab
+
     async def cas(self, ns: str, dtab: Dtab, version: bytes) -> None:
         await self._store.update(ns, dtab, version)
 
@@ -147,6 +162,24 @@ class NamerdHttpStoreClient:
         if rsp.status not in (200, 204):
             raise RuntimeError(
                 f"namerd POST dtabs/{ns} failed: {rsp.status}")
+
+    async def watch(self, ns: str):
+        """Standing watch over ``/api/1/dtabs/<ns>?watch=true`` (the
+        chunked NDJSON stream the namerd HTTP iface already serves):
+        yields each Dtab state as namerd pushes it. One call = one open
+        connection; the caller owns reconnect policy."""
+        from urllib.parse import quote
+
+        from linkerd_tpu.interpreter.namerd_http import _watch_ndjson
+        uri = f"/api/1/dtabs/{quote(ns)}?watch=true"
+        async for data in _watch_ndjson(self._host, self._port, uri):
+            if data is None:
+                continue  # namespace does not exist (yet)
+            if isinstance(data, dict) and "error" in data:
+                raise RuntimeError(f"namerd dtab watch: {data['error']}")
+            dtab = Dtab.read(";".join(
+                f"{d['prefix']} => {d['dst']}" for d in data))
+            yield dtab
 
     async def aclose(self) -> None:
         if self._client is not None:

@@ -1,0 +1,84 @@
+"""Specialist model bank + continuous in-plane learning.
+
+One global autoencoder/MLP scores every route today, averaging over
+workloads instead of specializing per flow — the gap Taurus (per-packet
+ML in the data plane) and INSIGHT (per-flow in-network intelligence)
+identify as the end-state for in-network scoring. This package turns
+the one-shot ``nativeRefreshS`` re-export loop into a drift-triggered
+distillation pipeline producing a bank of small per-route specialist
+heads:
+
+    per-route score-shift (RouteDriftMonitor)
+        -> retrain-on-shift from the route's replay rows
+           (DistillationPipeline.distill_head: the online-trained
+           global model is the teacher/starting point; the candidate
+           fine-tunes on the route's own traffic with per-route
+           normalization stats)
+        -> shadow-gate through the existing PromotionGate on held-out
+           route rows (a poisoned candidate evaluates worse than the
+           serving model and is rejected, never published)
+        -> publish a per-route DELTA patch (lifecycle/export
+           ``L5DWTD01``) into the engines' double-buffered weight slab
+           — generation-fenced, reader-recheck flip, multi-worker
+           shared slab included — with a full ``L5DWTS02`` bank blob
+           as the fallback for engines that cannot take the patch.
+
+The native evaluator (``native/scorer.h``) selects a route's head by
+the FNV-1a route hash pushed alongside the feature column, falling
+back to the base model; rollback of a single route is one REMOVE delta
+that leaves every other head serving. Head lineage (which base
+checkpoint each head was distilled from, which delta CRC shipped it)
+rides the CheckpointStore manifest (``record_specialist``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from linkerd_tpu.distill.bank import HeadInfo, SpecialistBank
+from linkerd_tpu.distill.monitor import RouteDriftMonitor, RouteReplayWindow
+
+
+@dataclass
+class DistillConfig:
+    """YAML ``distill:`` block of the io.l5d.jaxAnomaly telemeter.
+
+    The drift trigger and the promotion gate interlock: a route
+    retrains when its live score distribution shifts more than
+    ``driftThreshold`` reference-sigmas from where it was anchored, and
+    the candidate head is promoted only when it does not regress
+    (loss/AUC tolerances, the same ``PromotionGate`` semantics the
+    global lifecycle uses) on the route's held-out rows.
+    """
+
+    maxHeads: int = 32           # specialist heads the bank may carry
+    driftThreshold: float = 1.0  # per-route score-shift trigger (sigmas)
+    minRouteRows: int = 64       # replay rows before a route may retrain
+    perRouteReplayRows: int = 512   # replay window per route, rows
+    retrainSteps: int = 8        # fine-tune steps per candidate
+    learningRate: float = 0.001
+    cooldownS: float = 30.0      # per-route floor between retrains
+    # candidate gate (PromotionGate semantics, scoped to one route)
+    aucTolerance: float = 0.02
+    lossTolerance: float = 0.10
+    minLabeled: int = 8
+    # bank blob encoding: f32 | int8 | int4; None inherits the
+    # telemeter's nativeQuant
+    quant: Optional[str] = None
+    # publish per-route delta patches (full-bank publish is always the
+    # fallback for a sink that rejects the patch); False always ships
+    # the full bank
+    deltaPublish: bool = True
+
+    def mk(self, node, gate=None, store=None,
+           quant: str = "f32") -> "DistillationPipeline":
+        from linkerd_tpu.distill.pipeline import DistillationPipeline
+        return DistillationPipeline(self, node, gate=gate, store=store,
+                                    default_quant=quant)
+
+
+__all__ = [
+    "DistillConfig", "HeadInfo", "RouteDriftMonitor",
+    "RouteReplayWindow", "SpecialistBank",
+]

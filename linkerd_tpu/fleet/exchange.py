@@ -6,10 +6,14 @@ Two propagation paths feed one FleetView:
   digest is CAS-written into the ``namespace`` dtab namespace as one
   dentry per instance (``/fleet/<instance> => /d/<hex-json>``), riding
   the exact store/ETag machinery the MeshReactor publishes overrides
-  through. The same round-trip ingests every peer dentry found in the
-  namespace, so namerd alone gives eventual fleet-wide visibility with
-  no extra endpoints — and survives instance restarts (the doc is the
-  durable record a rejoining instance fences against).
+  through. Peer ingest rides a STANDING WATCH on the namespace
+  (``start_watch``: the store client's dtab watch stream — the
+  in-process Activity locally, ``?watch=true`` NDJSON against a remote
+  namerd), so a peer's write reaches us when namerd applies it, not on
+  our next publish round; with no watch support the publish round-trip
+  ingests peers as before. Either way namerd alone gives fleet-wide
+  visibility with no extra endpoints — and survives instance restarts
+  (the doc is the durable record a rejoining instance fences against).
 - **peer gossip (fast, optional)** — every ``gossipIntervalMs`` the
   exchange POSTs its known docs to each peer's admin server
   (``/fleet/gossip.json``) and ingests the docs the peer returns
@@ -129,6 +133,15 @@ class FleetExchange:
         self._publishing = False
         self._gossiping = False
         self._peer_clients: Dict[str, object] = {}
+        # standing namerd watch on the fleet namespace (sub-interval
+        # push ingest; see start_watch). None until the first tick.
+        self._watch_task: Optional[asyncio.Task] = None
+        # monotonic instant of the last DELIVERED watch state: the
+        # publish round only skips its own peer ingest while the watch
+        # is actually delivering, not merely while its task is alive
+        # (a permanently failing stream must not disable namerd-
+        # mediated ingest)
+        self._last_watch_delivery: Optional[float] = None
         node = metrics_node
         if node is not None:
             self._published = node.counter("docs_published")
@@ -136,6 +149,7 @@ class FleetExchange:
             self._pub_failures = node.counter("publish_failures")
             self._gossip_rounds = node.counter("gossip_rounds")
             self._gossip_errors = node.counter("gossip_errors")
+            self._watch_updates = node.counter("watch_updates")
             node.gauge("peers_fresh",
                        fn=lambda: float(self.view.fresh_count()))
             node.gauge("peers_known",
@@ -143,10 +157,13 @@ class FleetExchange:
             node.gauge("superseded",
                        fn=lambda: 1.0 if self.view.superseded else 0.0)
             node.gauge("quorum", fn=lambda: float(self.quorum))
+            node.gauge("watching",
+                       fn=lambda: 1.0 if self.watching else 0.0)
         else:
             self._published = self._pub_conflicts = None
             self._pub_failures = None
             self._gossip_rounds = self._gossip_errors = None
+            self._watch_updates = None
 
     # -- wiring ------------------------------------------------------------
     def set_source(self, levels_fn: Callable[[], Dict[str, float]],
@@ -222,12 +239,81 @@ class FleetExchange:
                 accepted += 1
         return accepted
 
+    # -- standing namerd watch ---------------------------------------------
+    @property
+    def watching(self) -> bool:
+        return self._watch_task is not None and not self._watch_task.done()
+
+    def watch_healthy(self, now: Optional[float] = None) -> bool:
+        """True while the watch stream has DELIVERED a state within the
+        staleness TTL — the condition under which publish-time peer
+        ingest may stand down. A watch task stuck in its reconnect
+        backoff (namerd build without watch support, proxy stripping
+        the chunked stream) is alive but not healthy."""
+        if not self.watching or self._last_watch_delivery is None:
+            return False
+        now = time.monotonic() if now is None else now
+        return now - self._last_watch_delivery <= self.cfg.stalenessTtlS
+
+    def start_watch(self) -> bool:
+        """Begin the standing watch on the fleet namespace: the store
+        client's dtab watch stream pushes every peer-doc write to us
+        the moment namerd applies it (sub-interval propagation through
+        namerd, complementing gossip — which stays the primary fast
+        path — and replacing the old publish-time-only ingest). No-op
+        when the client has no watch support or a watch is already
+        running; reconnects with backoff, holding the last known view
+        (peer docs age out through the staleness TTL as usual)."""
+        if self._client is None or self.watching:
+            return self.watching
+        if getattr(self._client, "watch", None) is None:
+            return False
+        from linkerd_tpu.core.tasks import monitor
+        self._watch_task = asyncio.get_running_loop().create_task(
+            self._watch_loop(), name="fleet-ns-watch")
+        monitor(self._watch_task, what="fleet-ns-watch")
+        return True
+
+    def ingest_dtab(self, dtab: Dtab) -> int:
+        """Ingest every fleet doc found in a namespace dtab state
+        (operator dentries sharing the namespace are ignored); returns
+        how many docs were newly accepted."""
+        accepted = 0
+        for d in dtab:
+            peer = FleetDoc.from_dentry_parts(d.prefix.show, d.dst.show)
+            if peer is not None and self.view.ingest(peer):
+                accepted += 1
+        return accepted
+
+    async def _watch_loop(self) -> None:
+        backoff = 0.25
+        while True:
+            client = self._client
+            if client is None:
+                return  # aclose() detached the store client
+            try:
+                async for dtab in client.watch(self._ns):
+                    backoff = 0.25
+                    self._last_watch_delivery = time.monotonic()
+                    n = self.ingest_dtab(dtab)
+                    if n and self._watch_updates is not None:
+                        self._watch_updates.incr(n)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — reconnect with
+                # backoff; the view keeps serving its last known docs
+                log.debug("fleet namespace watch: %r", e)
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, 5.0)
+
     # -- cadence -----------------------------------------------------------
     def maybe_step(self, now: Optional[float] = None) -> None:
         """Called from every control-loop tick: kick the namerd publish
         and/or a gossip round when their cadence is due, as bounded
-        fire-and-forget tasks (the tick itself never blocks on I/O)."""
+        fire-and-forget tasks (the tick itself never blocks on I/O),
+        and make sure the standing namespace watch is running."""
         from linkerd_tpu.core.tasks import spawn
+        self.start_watch()
         now = time.monotonic() if now is None else now
         if (self._client is not None and not self._publishing
                 and (self._last_pub is None
@@ -254,13 +340,21 @@ class FleetExchange:
         prefix, dst = doc.to_dentry_parts()
         own = Dtab.read(f"{prefix} => {dst} ;")[0]
 
+        # with the standing namespace watch DELIVERING, ingest rides
+        # the watch stream (sub-interval push); the publish round only
+        # rewrites our own dentry. Without a watch — no client support,
+        # or a stream that is failing/reconnecting — the fetch stays
+        # the namerd-mediated peer ingest (ingest is seq-fenced, so the
+        # overlap while a watch warms up is idempotent).
+        ingest_here = not self.watch_healthy()
+
         def mutate(dtab: Dtab) -> Dtab:
             kept = []
             for d in dtab:
                 peer = FleetDoc.from_dentry_parts(d.prefix.show, d.dst.show)
                 if peer is not None:
-                    # the fetch IS the namerd-mediated peer watch
-                    self.view.ingest(peer)
+                    if ingest_here:
+                        self.view.ingest(peer)
                     if peer.instance == self.view.instance:
                         continue  # replaced by our fresh doc below
                 kept.append(d)
@@ -354,11 +448,16 @@ class FleetExchange:
             "publish_interval_s": self.cfg.publishIntervalS,
             "gossip": bool(self.cfg.gossip and (self.cfg.peers or [])),
             "gossip_peers": list(self.cfg.peers or []),
+            "watching": self.watching,
             "seq": self._seq,
         })
         return out
 
     async def aclose(self) -> None:
+        task, self._watch_task = self._watch_task, None
+        if task is not None:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
         for client in list(self._peer_clients.values()):
             try:
                 await client.close()

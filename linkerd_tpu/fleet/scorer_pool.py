@@ -183,6 +183,43 @@ class ScorerReplicaPool:
             raise last
         raise RuntimeError("scorer pool has no replicas")
 
+    # -- fleet model coordination ------------------------------------------
+    async def broadcast_restore(self, snap,
+                                per_call_timeout_s: float = 20.0) -> int:
+        """Push one ModelSnapshot to EVERY replica (not a balanced
+        pick): the fleet model-coordination path — when this linkerd
+        promotes a model, every announced fallback scorer restores the
+        same generation the in-plane bank serves. The pushes run
+        CONCURRENTLY with a per-replica timeout, so one hung replica
+        (black-holed address: grpc connects lazily and would otherwise
+        sit on its long RPC deadline) delays nothing and every healthy
+        peer still restores. Per-replica failures are logged and
+        skipped (a dead replica catches up on its next restore);
+        returns how many replicas restored."""
+        async def push(addr: str, rep: _Replica) -> bool:
+            rep.inflight += 1
+            rep.calls += 1
+            try:
+                await asyncio.wait_for(rep.scorer.restore(snap),
+                                       per_call_timeout_s)
+                return True
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — one dead replica
+                # must not block the fleet-wide model push
+                rep.failures += 1
+                rep.last_error = repr(e)
+                log.warning("fleet model push to scorer replica %s "
+                            "failed: %r", addr, e)
+                return False
+            finally:
+                rep.inflight -= 1
+
+        results = await asyncio.gather(
+            *(push(addr, rep)
+              for addr, rep in list(self._replicas.items())))
+        return sum(1 for ok in results if ok)
+
     # -- Scorer surface ----------------------------------------------------
     async def score(self, x: np.ndarray) -> np.ndarray:
         return await self._call("score", x)

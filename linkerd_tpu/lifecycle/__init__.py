@@ -25,7 +25,8 @@ from typing import Optional
 
 from linkerd_tpu.lifecycle.drift import DriftMonitor
 from linkerd_tpu.lifecycle.export import (
-    WEIGHT_MAGIC, blob_meta, export_weight_blob,
+    BANK_MAGIC, DELTA_MAGIC, WEIGHT_MAGIC, blob_meta, export_bank_blob,
+    export_delta_blob, export_weight_blob, route_hash,
 )
 from linkerd_tpu.lifecycle.promote import (
     Decision, EvalReport, GatePolicy, ModelLifecycleManager, PromotionGate,
@@ -70,10 +71,11 @@ class LifecycleConfig:
 
 
 __all__ = [
-    "CheckpointCorruptError", "CheckpointError", "CheckpointStore",
-    "Decision", "DriftMonitor", "EvalReport", "GatePolicy",
-    "LifecycleConfig", "ModelLifecycleManager", "ModelSnapshot",
-    "PromotionGate", "ReplayWindow", "WEIGHT_MAGIC", "blob_meta",
-    "decode_snapshot", "encode_snapshot", "evaluate_snapshot",
-    "export_weight_blob",
+    "BANK_MAGIC", "CheckpointCorruptError", "CheckpointError",
+    "CheckpointStore", "DELTA_MAGIC", "Decision", "DriftMonitor",
+    "EvalReport", "GatePolicy", "LifecycleConfig",
+    "ModelLifecycleManager", "ModelSnapshot", "PromotionGate",
+    "ReplayWindow", "WEIGHT_MAGIC", "blob_meta", "decode_snapshot",
+    "encode_snapshot", "evaluate_snapshot", "export_bank_blob",
+    "export_delta_blob", "export_weight_blob", "route_hash",
 ]

@@ -313,6 +313,28 @@ class CheckpointStore:
                 return
         raise CheckpointError(f"unknown checkpoint version {version}")
 
+    def record_specialist(self, route_hash: int,
+                          meta: Optional[Dict[str, Any]]) -> None:
+        """Manifest lineage for one specialist head: ``meta`` records
+        the dst path, head version, bank generation, the base
+        checkpoint it was distilled from, and the published delta's
+        CRC — or None to drop the entry (a single-route rollback).
+        The manifest is then the full story of WHICH per-route bits
+        the engines serve and where each head came from."""
+        spec = self._manifest.setdefault("specialists", {})
+        key = str(int(route_hash))
+        if meta is None:
+            if key not in spec:
+                return
+            del spec[key]
+        else:
+            spec[key] = meta
+        self._write_manifest()
+
+    def specialists(self) -> Dict[str, Any]:
+        """{route_hash: head lineage meta} from the manifest."""
+        return dict(self._manifest.get("specialists", {}))
+
     def mark(self, version: int, status: str) -> None:
         for e in self._manifest["versions"]:
             if e["version"] == version:
@@ -421,4 +443,5 @@ class CheckpointStore:
             "retain": self.retain,
             "versions": [dataclasses.asdict(e) for e in self._entries()],
             "pruned": list(self._manifest["pruned"]),
+            "specialists": self.specialists(),
         }

@@ -136,6 +136,12 @@ def _declare_scorer(cdll: ctypes.CDLL) -> None:
         ctypes.c_char_p, ctypes.c_size_t,
         ctypes.POINTER(ctypes.c_float), ctypes.c_long, ctypes.c_long,
         ctypes.POINTER(ctypes.c_float), ctypes.c_char_p, ctypes.c_size_t]
+    cdll.l5d_score_eval_route.restype = ctypes.c_long
+    cdll.l5d_score_eval_route.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_long, ctypes.c_long,
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_char_p, ctypes.c_size_t]
     cdll.l5d_score_eval_raw.restype = ctypes.c_long
     cdll.l5d_score_eval_raw.argtypes = [
         ctypes.c_char_p, ctypes.c_size_t,
@@ -149,10 +155,19 @@ def _declare_scorer(cdll: ctypes.CDLL) -> None:
     cdll.l5d_slab_publish.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
         ctypes.c_char_p, ctypes.c_size_t]
+    cdll.l5d_slab_publish_delta.restype = ctypes.c_int
+    cdll.l5d_slab_publish_delta.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_char_p, ctypes.c_size_t]
     cdll.l5d_slab_score.restype = ctypes.c_long
     cdll.l5d_slab_score.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_long,
         ctypes.POINTER(ctypes.c_float)]
+    cdll.l5d_slab_score_route.restype = ctypes.c_long
+    cdll.l5d_slab_score_route.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_long,
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32)]
     cdll.l5d_slab_stats.restype = ctypes.c_long
     cdll.l5d_slab_stats.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
@@ -162,8 +177,21 @@ def _declare_scorer(cdll: ctypes.CDLL) -> None:
     cdll.l5d_score_test_blob.argtypes = [
         ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32, ctypes.c_int,
         ctypes.c_uint32]
+    cdll.l5d_score_test_bank.restype = ctypes.c_long
+    cdll.l5d_score_test_bank.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32, ctypes.c_int,
+        ctypes.c_uint32, ctypes.c_uint32]
+    cdll.l5d_score_test_delta.restype = ctypes.c_long
+    cdll.l5d_score_test_delta.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32,
+        ctypes.c_uint32, ctypes.c_uint32, ctypes.c_int, ctypes.c_uint32,
+        ctypes.c_int]
     for prefix in ("fp", "fph2"):
         fn = getattr(cdll, prefix + "_publish_weights")
+        fn.restype = ctypes.c_int
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+                       ctypes.c_char_p, ctypes.c_size_t]
+        fn = getattr(cdll, prefix + "_publish_delta")
         fn.restype = ctypes.c_int
         fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
                        ctypes.c_char_p, ctypes.c_size_t]
@@ -171,6 +199,9 @@ def _declare_scorer(cdll: ctypes.CDLL) -> None:
         fn.restype = ctypes.c_int
         fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
                        ctypes.c_float]
+        fn = getattr(cdll, prefix + "_set_route_hash")
+        fn.restype = ctypes.c_int
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
         fn = getattr(cdll, prefix + "_set_tenant")
         fn.restype = ctypes.c_int
         fn.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
@@ -380,8 +411,11 @@ def _merge_worker_stats(snaps: List[dict], n_workers: int) -> dict:
     ns_snaps = [s["native_scorer"] for s in snaps
                 if s.get("native_scorer")]
     if ns_snaps:
-        ns = dict(ns_snaps[0])  # slab fields: shared, identical
+        ns = dict(ns_snaps[0])  # slab fields (version/crc/generation/
+        # heads/swaps/delta_swaps/retries): shared, identical
         ns["scored"] = sum(int(x.get("scored", 0)) for x in ns_snaps)
+        ns["specialist_scored"] = sum(
+            int(x.get("specialist_scored", 0)) for x in ns_snaps)
         ns["unscored"] = sum(int(x.get("unscored", 0)) for x in ns_snaps)
         hist = ns_snaps[0].get("score_ns_hist") or []
         for x in ns_snaps[1:]:
@@ -448,7 +482,9 @@ class FastPathEngine:
         self._fn_features = getattr(cdll, p + "_drain_features")
         self._fn_shutdown = getattr(cdll, p + "_shutdown")
         self._fn_publish = getattr(cdll, p + "_publish_weights")
+        self._fn_publish_delta = getattr(cdll, p + "_publish_delta")
         self._fn_route_feat = getattr(cdll, p + "_set_route_feature")
+        self._fn_route_hash = getattr(cdll, p + "_set_route_hash")
         self.workers = workers
         self._es = [getattr(cdll, p + "_create")()
                     for _ in range(workers)]
@@ -647,13 +683,27 @@ class FastPathEngine:
                 ok = False
         return ok
 
+    def set_route_hash(self, host: str, rhash: int) -> bool:
+        """Install a route's specialist-bank key (FNV-1a of the bound
+        dst path, ``lifecycle.export.route_hash``); call after
+        set_route. Until this lands the route's rows score on the
+        bank's base model. Returns False while the route does not
+        exist on some worker."""
+        ok = True
+        for h in self._es:
+            if self._fn_route_hash(h, self._key(host),
+                                   int(rhash) & 0xFFFFFFFF) != 0:
+                ok = False
+        return ok
+
     def publish_weights(self, blob: bytes) -> None:
         """Hot-swap the in-engine scorer's weights from a versioned
-        blob (lifecycle/export.export_weight_blob). Raises ValueError
-        on a rejected blob (bad magic/CRC/geometry); the data plane
-        never pauses — scoring flips to the new weights per-row. With
-        ``workers`` > 1 the publish goes ONCE into the shared slab and
-        every worker observes the new blob atomically."""
+        blob — a v1 model or a v2 specialist bank
+        (lifecycle/export.export_weight_blob / export_bank_blob).
+        Raises ValueError on a rejected blob (bad magic/CRC/geometry);
+        the data plane never pauses — scoring flips to the new weights
+        per-row. With ``workers`` > 1 the publish goes ONCE into the
+        shared slab and every worker observes the new blob atomically."""
         if self._closed:
             # a stale sink calling into a freed C++ engine would be a
             # native use-after-free, not a catchable Python error
@@ -668,6 +718,26 @@ class FastPathEngine:
         if rc != 0:
             raise ValueError(
                 f"weight blob rejected: "
+                f"{err.value.decode('latin-1') or 'unknown error'}")
+
+    def publish_delta(self, blob: bytes) -> None:
+        """Apply a per-route delta patch (``L5DWTD01``) to the ACTIVE
+        bank — generation-fenced: raises ValueError when the patch was
+        built against a different bank generation (the caller falls
+        back to a full publish), when it removes an absent head, or on
+        any corruption. One apply flips every worker (shared slab)."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        err = ctypes.create_string_buffer(256)
+        if self._slab is not None:
+            rc = self._lib.l5d_slab_publish_delta(
+                self._slab, blob, len(blob), err, len(err))
+        else:
+            rc = self._fn_publish_delta(self._e, blob, len(blob), err,
+                                        len(err))
+        if rc != 0:
+            raise ValueError(
+                f"delta blob rejected: "
                 f"{err.value.decode('latin-1') or 'unknown error'}")
 
     def remove_route(self, host: str) -> None:
@@ -946,6 +1016,31 @@ def score_eval_raw(blob: bytes, rows, cols, signs, drifts,
     return (scores, feats) if return_features else scores
 
 
+def score_eval_route(blob: bytes, route_hash: int, x):
+    """Score featurized rows through a bank blob's head for
+    ``route_hash`` (base model when the bank has no such head).
+    Returns (scores [n], specialist bool); ValueError on a rejected
+    blob; None when the native lib is unavailable."""
+    import numpy as np
+    cdll = lib()
+    if cdll is None:
+        return None
+    x = np.ascontiguousarray(x, np.float32)
+    out = np.zeros(len(x), np.float32)
+    spec = ctypes.c_int32(0)
+    err = ctypes.create_string_buffer(256)
+    n = cdll.l5d_score_eval_route(
+        blob, len(blob), int(route_hash) & 0xFFFFFFFF, _as_f32_ptr(x),
+        len(x), x.shape[1], _as_f32_ptr(out), ctypes.byref(spec), err,
+        len(err))
+    if n < 0:
+        raise ValueError(err.value.decode("latin-1"))
+    return out, bool(spec.value)
+
+
+_QUANT_CODES = {"f32": 0, "int8": 1, "int4": 2}
+
+
 def score_test_blob(version: int = 1, quant: str = "f32",
                     seed: int = 0) -> Optional[bytes]:
     """Deterministic valid weight blob from the C-side generator (the
@@ -956,9 +1051,45 @@ def score_test_blob(version: int = 1, quant: str = "f32",
         return None
     buf = ctypes.create_string_buffer(1 << 20)
     n = cdll.l5d_score_test_blob(buf, len(buf), int(version),
-                                 1 if quant == "int8" else 0, int(seed))
+                                 _QUANT_CODES[quant], int(seed))
     if n < 0:
         raise ValueError("test blob generation failed")
+    return buf.raw[:n]
+
+
+def score_test_bank(generation: int = 1, quant: str = "f32",
+                    seed: int = 0, n_heads: int = 2) -> Optional[bytes]:
+    """Deterministic valid v2 bank blob (seeded base + ``n_heads``
+    specialists keyed 1000+k). None = native unavailable."""
+    cdll = lib()
+    if cdll is None:
+        return None
+    buf = ctypes.create_string_buffer(16 << 20)
+    n = cdll.l5d_score_test_bank(buf, len(buf), int(generation),
+                                 _QUANT_CODES[quant], int(seed),
+                                 int(n_heads))
+    if n < 0:
+        raise ValueError("test bank generation failed")
+    return buf.raw[:n]
+
+
+def score_test_delta(base_gen: int, new_gen: int, route_hash: int,
+                     quant: str = "f32", seed: int = 0,
+                     remove: bool = False) -> Optional[bytes]:
+    """Deterministic valid delta patch: one seeded upsert (or remove)
+    at ``route_hash``, fenced base_gen -> new_gen. None = native
+    unavailable."""
+    cdll = lib()
+    if cdll is None:
+        return None
+    buf = ctypes.create_string_buffer(1 << 20)
+    n = cdll.l5d_score_test_delta(buf, len(buf), int(base_gen),
+                                  int(new_gen),
+                                  int(route_hash) & 0xFFFFFFFF,
+                                  _QUANT_CODES[quant], int(seed),
+                                  1 if remove else 0)
+    if n < 0:
+        raise ValueError("test delta generation failed")
     return buf.raw[:n]
 
 
@@ -989,6 +1120,37 @@ class ScoreSlab:
             raise ValueError(
                 f"weight blob rejected: "
                 f"{err.value.decode('latin-1') or 'unknown error'}")
+
+    def publish_delta(self, blob: bytes) -> None:
+        """Apply a generation-fenced per-route delta patch to the
+        active bank; ValueError on rejection (fence/corruption/absent
+        head) — the serving bank is untouched then."""
+        s = self._handle()
+        err = ctypes.create_string_buffer(256)
+        if self._lib.l5d_slab_publish_delta(s, blob, len(blob), err,
+                                            len(err)) != 0:
+            raise ValueError(
+                f"delta blob rejected: "
+                f"{err.value.decode('latin-1') or 'unknown error'}")
+
+    def score_route(self, x, route_hash: int):
+        """Score featurized rows with per-route head selection.
+        Returns (scores [n], specialist flags [n] int32) or None while
+        no weights are published."""
+        import numpy as np
+        s = self._handle()
+        x = np.ascontiguousarray(x, np.float32)
+        dim = int(self._lib.l5d_score_feature_dim())
+        if x.ndim != 2 or x.shape[1] != dim:
+            raise ValueError(
+                f"expected [n, {dim}] featurized rows, got {x.shape}")
+        out = np.zeros(len(x), np.float32)
+        spec = np.zeros(len(x), np.int32)
+        n = self._lib.l5d_slab_score_route(
+            s, int(route_hash) & 0xFFFFFFFF, _as_f32_ptr(x), len(x),
+            _as_f32_ptr(out),
+            spec.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return None if n < 0 else (out, spec)
 
     def score(self, x) -> Optional["object"]:
         """Score featurized f32 [n, FEATURE_DIM] rows; None while no

@@ -174,13 +174,20 @@ class FastPathController:
         # in-data-plane scoring: hand the engine's weight-slab publish
         # to every telemeter that exports native weight blobs — the
         # telemeter replays its last blob immediately, so an engine
-        # that starts after the initial export still gets weights
+        # that starts after the initial export still gets weights. The
+        # delta hook (per-route specialist patches) registers alongside
+        # when the engine has one; a telemeter that cannot use it
+        # simply ships full blobs.
         if hasattr(self.engine, "publish_weights"):
             sink = self.engine.publish_weights  # ONE bound method: the
+            delta_sink = getattr(self.engine, "publish_delta", None)
             for t in self.telemeters:           # unregister must remove
                 reg = getattr(t, "register_weight_sink", None)  # it
                 if reg is not None:
-                    reg(sink)
+                    try:
+                        reg(sink, delta_sink=delta_sink)
+                    except TypeError:  # pre-distill telemeter surface
+                        reg(sink)
                     self._weight_sink_regs.append((t, sink))
         from linkerd_tpu.core.tasks import monitor
         self._tasks = [
@@ -193,21 +200,32 @@ class FastPathController:
         ]
 
     def push_route_feature(self, host: str) -> None:
-        """Install the dst-path feature hash (column, sign) for a route
-        in the engine's in-data-plane scorer. The hash is computed over
-        the SAME ``{prefix}/{host}`` dst path the Python featurizer
-        resolves for this route (``_route_dst``), so engine-side and
-        Python-side features for one route land in the same column —
-        the native and JAX tiers score the same point."""
+        """Install the dst-path feature hash (column, sign) AND the
+        specialist-bank route hash for a route in the engine's
+        in-data-plane scorer. Both are computed over the SAME
+        ``{prefix}/{host}`` dst path the Python featurizer resolves for
+        this route (``_route_dst``), so engine-side and Python-side
+        features for one route land in the same column — and the
+        engine selects exactly the specialist head the distiller
+        promoted for this dst."""
         fn = getattr(self.engine, "set_route_feature", None)
         if fn is None:
             return  # stub engine (tests) or pre-scorer native lib
         from linkerd_tpu.models.features import path_hash_cols
-        col, sign = path_hash_cols(f"{self.prefix.show}/{host}")
+        dst = f"{self.prefix.show}/{host}"
+        col, sign = path_hash_cols(dst)
         try:
             fn(host, col, sign)
         except Exception:  # noqa: BLE001 — a rejecting engine must not
             log.exception("route feature push failed for %r", host)
+        hash_fn = getattr(self.engine, "set_route_hash", None)
+        if hash_fn is None:
+            return
+        from linkerd_tpu.lifecycle.export import route_hash
+        try:
+            hash_fn(host, route_hash(dst))
+        except Exception:  # noqa: BLE001 — same blast-radius contract
+            log.exception("route hash push failed for %r", host)
 
     def resolve(self, host: str) -> None:
         """Begin (or refresh) resolution for a host."""
@@ -363,18 +381,22 @@ class FastPathController:
         if ns and (ns.get("weights") or ns.get("unscored")):
             # in-data-plane scorer accounting under
             # rt/<label>/fastpath/scorer/*: the live proof of WHICH
-            # tier scored (validator native-score mode reads these)
+            # tier (and which bank generation / specialist head)
+            # scored (validator native-score mode reads these)
             scope = self._scope.scope("scorer")
             prev = self._last_scorer
-            for key in ("scored", "unscored", "swaps", "retries"):
+            keys = ("scored", "specialist_scored", "unscored", "swaps",
+                    "delta_swaps", "retries")
+            for key in keys:
                 delta = int(ns.get(key, 0)) - int(prev.get(key, 0))
                 if delta > 0:
                     scope.counter(key).incr(delta)
-            self._last_scorer = {k: int(ns.get(k, 0)) for k in
-                                 ("scored", "unscored", "swaps",
-                                  "retries")}
+            self._last_scorer = {k: int(ns.get(k, 0)) for k in keys}
             scope.gauge("weights").set(1.0 if ns.get("weights") else 0.0)
             scope.gauge("version").set(float(ns.get("version", 0)))
+            scope.gauge("generation").set(
+                float(ns.get("generation", 0)))
+            scope.gauge("heads").set(float(ns.get("heads", 0)))
         for host, s in snap.get("routes", {}).items():
             if "id" in s:
                 self._id_to_host[int(s["id"])] = host

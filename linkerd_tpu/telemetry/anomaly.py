@@ -33,6 +33,7 @@ import numpy as np
 from linkerd_tpu.config import register
 from linkerd_tpu.control.loop import ControlConfig
 from linkerd_tpu.core import Var
+from linkerd_tpu.distill import DistillConfig
 from linkerd_tpu.lifecycle import LifecycleConfig
 from linkerd_tpu.models.features import FEATURE_DIM, FeatureVector, featurize_batch
 from linkerd_tpu.protocol.http.message import Request, Response
@@ -733,7 +734,10 @@ class JaxAnomalyConfig:
     # rows the engine could not score; "off" keeps every row on the
     # JAX tier. Python-path (non-fastPath) rows always score on JAX.
     nativeTier: str = "primary"
-    nativeQuant: str = "f32"  # native blob weight encoding: f32 | int8
+    # native blob weight encoding: f32 | int8 | int4 (int4 packs two
+    # weights per byte — the smallest blobs/deltas; parity bound pinned
+    # by test alongside the f32/int8 bounds)
+    nativeQuant: str = "f32"
     # without a lifecycle: block there are no promote/rollback events
     # to chase, so the ONLINE-trained model is re-exported to the
     # engines on this cadence (seconds; 0 disables) — the native tier
@@ -755,6 +759,10 @@ class JaxAnomalyConfig:
     # admission, anomaly-triggered namerd dtab overrides (see
     # linkerd_tpu/control/)
     control: Optional["ControlConfig"] = None
+    # continuous in-plane learning: drift-triggered distillation of
+    # per-route specialist heads, shadow-gated and delta-published to
+    # the engines' weight bank (see linkerd_tpu/distill/)
+    distill: Optional["DistillConfig"] = None
 
     def mk(self, metrics: MetricsTree) -> "JaxAnomalyTelemeter":
         return JaxAnomalyTelemeter(self, metrics)
@@ -771,8 +779,14 @@ class JaxAnomalyTelemeter(Telemeter):
             raise ValueError("sidecarTier must be 'primary' or 'fallback'")
         if cfg.nativeTier not in ("primary", "off"):
             raise ValueError("nativeTier must be 'primary' or 'off'")
-        if cfg.nativeQuant not in ("f32", "int8"):
-            raise ValueError("nativeQuant must be 'f32' or 'int8'")
+        if cfg.nativeQuant not in ("f32", "int8", "int4"):
+            raise ValueError(
+                "nativeQuant must be 'f32', 'int8', or 'int4'")
+        if cfg.distill is not None \
+                and (cfg.distill.quant or "f32") not in ("f32", "int8",
+                                                         "int4"):
+            raise ValueError(
+                "distill.quant must be 'f32', 'int8', or 'int4'")
         if cfg.nativeRefreshS < 0:
             raise ValueError("nativeRefreshS must be >= 0")
         if cfg.maxLingerMs < 0:
@@ -821,6 +835,9 @@ class JaxAnomalyTelemeter(Telemeter):
         self._degraded.set(0.0)
         self._score_failures = self._node.counter("score_failures")
         self._dropped_batches = self._node.counter("dropped_batches")
+        # fleet model coordination: replicas restored per promote
+        self._fleet_model_pushes = self._node.counter(
+            "fleet_model_pushes")
         self._gauges: Dict[str, object] = {}
         self._batch_i = 0
         # native weight publication: the FastPath controllers register
@@ -828,6 +845,9 @@ class JaxAnomalyTelemeter(Telemeter):
         # CRC'd blob at startup and on every lifecycle promote/rollback
         # hot-swap, and the last blob is replayed to late registrations
         self._weight_sinks: List[Callable[[bytes], None]] = []
+        # full sink -> delta-patch sink (engines that can apply
+        # per-route L5DWTD01 patches register one alongside)
+        self._delta_sinks: Dict[Callable, Callable[[bytes], None]] = {}
         self._last_blob: Optional[bytes] = None
         self._native_blob_meta: Optional[dict] = None
         self._native_publishes = 0
@@ -860,6 +880,18 @@ class JaxAnomalyTelemeter(Telemeter):
                              fn=lambda: float(self._lifecycle.promotions))
             model_node.gauge("rollbacks",
                              fn=lambda: float(self._lifecycle.rollbacks))
+        # continuous in-plane learning: the drift-triggered distillation
+        # pipeline producing per-route specialist heads; None when the
+        # block is absent (zero overhead). Publishes ride the same
+        # weight sinks as the global refresh, preferring delta patches.
+        self.distill = None
+        if cfg.distill is not None:
+            self.distill = cfg.distill.mk(
+                self._node.scope("distill"),
+                store=(self._lifecycle.store
+                       if self._lifecycle is not None else None),
+                quant=cfg.nativeQuant)
+            self.distill.set_publisher(self.publish_bank_update)
         # reactive control loop (score-weighted balancing / adaptive
         # admission / mesh reactor); None when the block is absent. The
         # Linker registers balancers + admission filters into it during
@@ -893,12 +925,19 @@ class JaxAnomalyTelemeter(Telemeter):
         return min(1.0, self._native_scored.value / scored)
 
     # -- native tier: weight export + publication -------------------------
-    def register_weight_sink(self, sink: Callable[[bytes], None]) -> None:
+    def register_weight_sink(self, sink: Callable[[bytes], None],
+                             delta_sink: Optional[Callable[[bytes], None]]
+                             = None) -> None:
         """Install a native-engine publish callback (the FastPath
-        controller registers ``engine.publish_weights`` here). The last
-        exported blob is replayed immediately, so registration order
-        against the startup publish does not matter."""
+        controller registers ``engine.publish_weights`` here, plus
+        ``engine.publish_delta`` when the engine can apply per-route
+        patches). The last exported blob is replayed immediately, so
+        registration order against the startup publish does not
+        matter — a late engine starts from the full bank and is then
+        eligible for deltas (its generation matches)."""
         self._weight_sinks.append(sink)
+        if delta_sink is not None:
+            self._delta_sinks[sink] = delta_sink
         if self._last_blob is not None:
             self._publish_blob_to(sink, self._last_blob)
 
@@ -910,6 +949,39 @@ class JaxAnomalyTelemeter(Telemeter):
             self._weight_sinks.remove(sink)
         except ValueError:
             pass
+        self._delta_sinks.pop(sink, None)
+
+    def publish_bank_update(self, full: Optional[bytes],
+                            delta: Optional[bytes] = None) -> bool:
+        """Ship a specialist-bank update to every registered engine:
+        the delta patch where a sink can take it (generation-fenced in
+        the engine; a rejection falls back to the full bank, which
+        re-fences the engine for future deltas), the full blob
+        otherwise. Returns True when at least one sink took the delta
+        path. Called by the DistillationPipeline under its lock."""
+        from linkerd_tpu.lifecycle.export import blob_meta
+        used_delta = False
+        if full is not None:
+            self._last_blob = full
+            self._native_blob_meta = blob_meta(full)
+            self._native_publishes += 1
+            self._last_native_pub = time.monotonic()
+        for sink in list(self._weight_sinks):
+            dsink = self._delta_sinks.get(sink)
+            if delta is not None and dsink is not None:
+                try:
+                    dsink(delta)
+                    used_delta = True
+                    continue
+                except Exception:  # noqa: BLE001 — a fence-rejected
+                    # patch (engine restarted on an older generation)
+                    # falls back to the full bank below
+                    log.warning("native delta publish rejected; "
+                                "falling back to full bank",
+                                exc_info=True)
+            if full is not None:
+                self._publish_blob_to(sink, full)
+        return used_delta
 
     def _publish_blob_to(self, sink, blob: bytes) -> None:
         try:
@@ -933,9 +1005,7 @@ class JaxAnomalyTelemeter(Telemeter):
             # no host-side snapshot surface (stub scorer, sidecar-primary
             # wiring): the native tier stays off, rows fall back to JAX
             return False
-        from linkerd_tpu.lifecycle.export import (
-            blob_meta, export_weight_blob,
-        )
+        from linkerd_tpu.lifecycle.export import export_weight_blob
         try:
             snap = await asyncio.to_thread(snap_fn)  # l5d: ignore[jax-hotpath] — weight export is a fire-and-forget task on the nativeRefreshS (>=30s) cadence, never a per-batch hop; the device readback must NOT run on the event loop
             if version is None:
@@ -943,6 +1013,23 @@ class JaxAnomalyTelemeter(Telemeter):
                            if self._lifecycle is not None else None)
             if version is None:
                 version = int(getattr(scorer, "_step", 0) or 0)
+            if self.distill is not None:
+                # base model changed: export the FULL bank (new base +
+                # every promoted head, generation bumped) so a promote
+                # never wipes the specialists off the engines. Export
+                # AND sink fan-out stay under the pipeline lock: a
+                # retrain's delta landing between them would otherwise
+                # be clobbered by this (older-generation) full blob.
+                async with self.distill.lock:
+                    # quant=None: the pipeline's own quant governs (its
+                    # distill.quant override, else nativeQuant) — the
+                    # recurring full-bank exports must match the delta
+                    # publishes byte-encoding for byte-encoding
+                    blob = await asyncio.to_thread(  # l5d: ignore[jax-hotpath] — same cadence-bounded export task as below, off-loop
+                        self.distill.export_full, snap, int(version),
+                        None)
+                    self._finish_full_publish(blob, int(version))
+                return True
             blob = await asyncio.to_thread(  # l5d: ignore[jax-hotpath] — same cadence-bounded export task: flattening a few-thousand-param snapshot off-loop, not a dispatch-path hop
                 export_weight_blob, snap, int(version),
                 self.cfg.nativeQuant)
@@ -950,6 +1037,13 @@ class JaxAnomalyTelemeter(Telemeter):
             # stop scoring; the JAX tier serves everything meanwhile
             log.exception("native weight export failed")
             return False
+        self._finish_full_publish(blob, int(version))
+        return True
+
+    def _finish_full_publish(self, blob: bytes, version: int) -> None:
+        """Bookkeeping + sink fan-out for a full blob/bank export (sync
+        so the distill path can hold its lock across it)."""
+        from linkerd_tpu.lifecycle.export import blob_meta
         self._last_blob = blob
         self._native_blob_meta = blob_meta(blob)
         self._native_publishes += 1
@@ -966,7 +1060,6 @@ class JaxAnomalyTelemeter(Telemeter):
                 log.exception("native blob manifest record failed")
         for sink in list(self._weight_sinks):
             self._publish_blob_to(sink, blob)
-        return True
 
     def _maybe_refresh_native_weights(self, scorer: Scorer) -> None:
         """Periodic re-export of the ONLINE-trained model to the
@@ -997,6 +1090,34 @@ class JaxAnomalyTelemeter(Telemeter):
         from linkerd_tpu.core.tasks import monitor
         monitor(asyncio.create_task(go(), name="native-weight-refresh"),
                 what="native-weight-refresh")
+
+    def _maybe_distill(self, scorer: Scorer) -> None:
+        """Kick one drift-triggered specialist retrain when a route is
+        pending — fire-and-forget with the pipeline's own reentrancy
+        guard (one retrain at a time; a second trigger waits for the
+        next batch). Fine-tune + shadow-eval run off-loop inside the
+        pipeline; the drain path only pays the trigger scan."""
+        if self.distill is None or self.distill.busy:
+            return
+        snap_fn = getattr(scorer, "snapshot", None)
+        if snap_fn is None or asyncio.iscoroutinefunction(snap_fn):
+            return  # no host snapshot surface: nothing to distill from
+        if self.distill.pending_route() is None:
+            return
+        base_version = (self._lifecycle.serving_version
+                        if self._lifecycle is not None else None)
+
+        async def go() -> None:
+            try:
+                await self.distill.run_once(scorer,
+                                            base_version=base_version)
+            except Exception:  # noqa: BLE001 — a failed retrain must
+                # never stop scoring; the route keeps its serving head
+                log.exception("distillation cycle failed")
+
+        from linkerd_tpu.core.tasks import monitor
+        monitor(asyncio.create_task(go(), name="distill-retrain"),
+                what="distill-retrain")
 
     def native_tier_state(self) -> dict:
         """The /model.json + /control.json native-tier block: what blob
@@ -1285,10 +1406,47 @@ class JaxAnomalyTelemeter(Telemeter):
                 # must follow, or the engines keep scoring the old one
                 await self.refresh_native_weights(
                     version=self._lifecycle.serving_version)
+                # fleet model coordination: fan the promoted model out
+                # to every announced scorer replica (Snapshot/Restore
+                # RPCs) so fleet fallback scorers serve the same
+                # generation as the in-plane bank
+                self._maybe_push_fleet_model()
             return outcome
         except Exception:  # noqa: BLE001 — lifecycle failures must never
             log.exception("model lifecycle cycle failed")  # stop scoring
             return None
+
+    def _maybe_push_fleet_model(self) -> None:
+        """Fire-and-forget fleet model push: the serving checkpoint to
+        every scorer replica in the pool via the Snapshot/Restore
+        sidecar RPCs. Skipped when no pool (pinned/in-process-only
+        wiring) or no promoted checkpoint exists. A slow replica costs
+        one bounded background task, never the lifecycle cycle."""
+        if self._scorer_pool is None or self._lifecycle is None:
+            return
+        version = self._lifecycle.serving_version
+        if version is None:
+            return
+
+        async def go() -> None:
+            try:
+                _, snap = await asyncio.to_thread(
+                    self._lifecycle.store.load, version)
+                n = await asyncio.wait_for(
+                    self._scorer_pool.broadcast_restore(snap), 30.0)
+                if n:
+                    self._fleet_model_pushes.incr(n)
+                    log.info("fleet model push: v%s restored on %d "
+                             "scorer replica(s)", version, n)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — the fleet push is
+                # best-effort; replicas converge on a later promote
+                log.exception("fleet model push failed")
+
+        from linkerd_tpu.core.tasks import monitor
+        monitor(asyncio.create_task(go(), name="fleet-model-push"),
+                what="fleet-model-push")
 
     async def _drain_burst(self, scorer: Scorer,
                            max_batches: Optional[int] = None) -> int:
@@ -1484,6 +1642,23 @@ class JaxAnomalyTelemeter(Telemeter):
                 self._publish_route_means(
                     b["nat_dsts"], b["nat_inv"], scores[n_py:])
         self._publish_native_batch(ns)
+        if self.distill is not None and scores_all is not None \
+                and len(scores_all):
+            # per-route drift + replay feed: host-only bookkeeping,
+            # mirroring exactly how x_all was assembled (python rows,
+            # then JAX-scored native rows, then engine-scored rows)
+            dsts_all: List[str] = []
+            if scores is not None:
+                dsts_all.extend(fv.dst_path for fv in b["fvs"])
+                if b["nat_inv"] is not None and b["nat_dsts"]:
+                    nd = b["nat_dsts"]
+                    dsts_all.extend(nd[int(i)] for i in b["nat_inv"])
+            if k_ns:
+                nsd = ns["dsts"]
+                dsts_all.extend(nsd[int(i)] for i in ns["inv"])
+            if len(dsts_all) == len(scores_all):
+                self.distill.observe_batch(dsts_all, x_all, scores_all,
+                                           labels_all, mask_all)
         self._publish_gauges()
         self._batch_i += 1
         if (not holdout and self.cfg.trainEveryBatches
@@ -1507,6 +1682,7 @@ class JaxAnomalyTelemeter(Telemeter):
             else:
                 self._train_loss.set(loss)
                 self._maybe_refresh_native_weights(scorer)
+        self._maybe_distill(scorer)
         return n_scored
 
     def _publish_native_batch(self, ns: Optional[dict]) -> None:
@@ -1668,6 +1844,10 @@ class JaxAnomalyTelemeter(Telemeter):
             out["tiers"] = tier_fn()
         if self._scorer_pool is not None:
             out["scorer_pool"] = self._scorer_pool.status()
+        if self.distill is not None:
+            # the per-route bank view: generation, every specialist
+            # head's lineage, live drift shifts, pending retrains
+            out["distill"] = self.distill.state()
         if self._lifecycle is not None:
             out.update(self._lifecycle.status())
         return out

@@ -486,10 +486,10 @@ void maybe_pause_producer(Engine* e, Conn* consumer) {
 
 void push_feature(Engine* e, uint64_t route_id, uint64_t lat_us, int status,
                   uint64_t req_b, uint64_t rsp_b, float score, int scored,
-                  uint64_t score_ns, uint32_t tenant) {
+                  int specialist, uint64_t score_ns, uint32_t tenant) {
     std::lock_guard<std::mutex> g(e->mu);
     if (scored)
-        e->score_stats.record(score_ns);
+        e->score_stats.record(score_ns, specialist != 0);
     else
         e->score_stats.unscored++;
     // per-tenant aggregates ride the same mu hold as the feature push
@@ -1055,6 +1055,7 @@ void finish_exchange(Engine* e, Conn* up, bool upstream_reusable) {
     // protocol, so a weight publish never contends with request work
     float feats[l5dscore::FEATURE_DIM];
     bool have_feats = false;
+    uint32_t rhash = 0;
     {
         std::lock_guard<std::mutex> g(e->mu);
         for (auto& kv : e->routes) {
@@ -1072,24 +1073,30 @@ void finish_exchange(Engine* e, Conn* up, bool upstream_reusable) {
                         (float)client->rsp_bytes, rf.col, rf.sign,
                         drift, feats);
                     have_feats = true;
+                    rhash = rf.rhash;
                 }
                 break;
             }
         }
     }
     float score = 0.0f;
-    int scored = 0;
+    int scored = 0, specialist = 0;
     uint64_t score_ns = 0;
     if (have_feats) {
         const uint64_t t0 = l5dscore::now_ns();
-        if (l5dscore::slab_score(e->slab, feats, &score)) {
+        // per-route head select: the bank serves this route's
+        // specialist when one is published, the base model otherwise
+        const int rc = l5dscore::slab_score_route(
+            e->slab, rhash, rhash != 0, feats, &score);
+        if (rc >= 0) {
             scored = 1;
+            specialist = rc;
             score_ns = l5dscore::now_ns() - t0;
         }
     }
     push_feature(e, up->route_id, lat, up->rsp_status,
                  client->req_bytes, client->rsp_bytes,
-                 score, scored, score_ns, client->tenant);
+                 score, scored, specialist, score_ns, client->tenant);
     tenant_release(e, client);
     client->peer = nullptr;
     up->peer = nullptr;
@@ -1855,21 +1862,52 @@ int fp_set_route_feature(void* ep, const char* host, int col,
     return 0;
 }
 
-// Publish a weight blob into the double-buffered slab (hot-swap; the
-// data plane never pauses). Rejects blobs whose in_dim disagrees with
-// the engine featurizer's FEATURE_DIM.
+// Install a route's specialist-bank key (the FNV-1a hash of its bound
+// dst path, pushed from Python like the feature column). Until this
+// lands the route's rows score on the bank's base model (hash 0 never
+// selects a head). Call after fp_set_route.
+int fp_set_route_hash(void* ep, const char* host, unsigned int rhash) {
+    Engine* e = (Engine*)ep;
+    std::string key(host);
+    lower(key);
+    std::lock_guard<std::mutex> g(e->mu);
+    auto it = e->routes.find(key);
+    if (it == e->routes.end()) return -1;
+    it->second.feat.rhash = rhash;
+    return 0;
+}
+
+// Publish a weight blob (v1 model or v2 specialist bank) into the
+// double-buffered slab (hot-swap; the data plane never pauses).
+// Rejects blobs whose in_dim disagrees with the engine featurizer's
+// FEATURE_DIM.
 int fp_publish_weights(void* ep, const uint8_t* blob, size_t len,
                        char* err, size_t errcap) {
     Engine* e = (Engine*)ep;
-    l5dscore::Model m;
-    if (!l5dscore::parse_blob(blob, len, &m, err, errcap)) return -1;
-    if (m.in_dim != l5dscore::FEATURE_DIM) {
+    l5dscore::Bank b;
+    if (!l5dscore::parse_bank_blob(blob, len, &b, err, errcap))
+        return -1;
+    if (b.base.in_dim != l5dscore::FEATURE_DIM) {
         l5dscore::fail(err, errcap,
                        "weight blob in_dim does not match engine "
                        "FEATURE_DIM");
         return -1;
     }
-    l5dscore::slab_install(e->slab, std::move(m));
+    l5dscore::slab_install(e->slab, std::move(b));
+    return 0;
+}
+
+// Apply a per-route delta patch to the ACTIVE bank (generation-fenced;
+// same reader-recheck flip as a full publish — with a shared slab one
+// apply covers every worker). Rejected publishes leave the serving
+// bank untouched.
+int fp_publish_delta(void* ep, const uint8_t* blob, size_t len,
+                     char* err, size_t errcap) {
+    Engine* e = (Engine*)ep;
+    l5dscore::Delta d;
+    if (!l5dscore::parse_delta_blob(blob, len, &d, err, errcap))
+        return -1;
+    if (!l5dscore::slab_apply_delta(e->slab, d, err, errcap)) return -1;
     return 0;
 }
 

@@ -468,10 +468,10 @@ void drain_dirty(Engine* e) {
 
 void push_feature(Engine* e, uint64_t route_id, uint64_t lat_us, int status,
                   uint64_t req_b, uint64_t rsp_b, float score, int scored,
-                  uint64_t score_ns, uint32_t tenant) {
+                  int specialist, uint64_t score_ns, uint32_t tenant) {
     std::lock_guard<std::mutex> g(e->mu);
     if (scored)
-        e->score_stats.record(score_ns);
+        e->score_stats.record(score_ns, specialist != 0);
     else
         e->score_stats.unscored++;
     // per-tenant aggregates ride the same mu hold as the feature push
@@ -578,6 +578,7 @@ void finish_stream(Engine* e, PStream* st, bool record) {
     // OUTSIDE mu against the slab's own reader protocol
     float feats[l5dscore::FEATURE_DIM];
     bool have_feats = false;
+    uint32_t rhash = 0;
     {
         std::lock_guard<std::mutex> g(e->mu);
         if (st->tenant_counted) {
@@ -600,6 +601,7 @@ void finish_stream(Engine* e, PStream* st, bool record) {
                                         (float)st->rsp_b, rf.col,
                                         rf.sign, drift, feats);
                     have_feats = true;
+                    rhash = rf.rhash;
                 }
             }
             if (st->ep_ip)
@@ -613,17 +615,23 @@ void finish_stream(Engine* e, PStream* st, bool record) {
     }
     if (record) {
         float score = 0.0f;
-        int scored = 0;
+        int scored = 0, specialist = 0;
         uint64_t score_ns = 0;
         if (have_feats) {
             const uint64_t t0 = l5dscore::now_ns();
-            if (l5dscore::slab_score(e->slab, feats, &score)) {
+            // per-route head select: the bank serves this route's
+            // specialist when one is published, else the base model
+            const int rc = l5dscore::slab_score_route(
+                e->slab, rhash, rhash != 0, feats, &score);
+            if (rc >= 0) {
                 scored = 1;
+                specialist = rc;
                 score_ns = l5dscore::now_ns() - t0;
             }
         }
         push_feature(e, st->route_id, lat, st->status, st->req_b,
-                     st->rsp_b, score, scored, score_ns, st->tenant);
+                     st->rsp_b, score, scored, specialist, score_ns,
+                     st->tenant);
     }
     if (uc != nullptr && !uc->dead) dispatch_from_queue(e, uc);
 }
@@ -2394,8 +2402,9 @@ long fph2_drain_features(void* ep, float* buf, long cap_rows) {
     return n;
 }
 
-// See fp_set_route_feature / fp_publish_weights (fastpath.cpp) for the
-// contract; this is the h2 engine's identical control surface.
+// See fp_set_route_feature / fp_set_route_hash / fp_publish_weights /
+// fp_publish_delta (fastpath.cpp) for the contract; this is the h2
+// engine's identical control surface.
 int fph2_set_route_feature(void* ep, const char* host, int col,
                            float sign) {
     Engine* e = (Engine*)ep;
@@ -2409,18 +2418,40 @@ int fph2_set_route_feature(void* ep, const char* host, int col,
     return 0;
 }
 
+int fph2_set_route_hash(void* ep, const char* host, unsigned int rhash) {
+    Engine* e = (Engine*)ep;
+    std::string key(host);
+    lower(key);
+    std::lock_guard<std::mutex> g(e->mu);
+    auto it = e->routes.find(key);
+    if (it == e->routes.end()) return -1;
+    it->second.feat.rhash = rhash;
+    return 0;
+}
+
 int fph2_publish_weights(void* ep, const uint8_t* blob, size_t len,
                          char* err, size_t errcap) {
     Engine* e = (Engine*)ep;
-    l5dscore::Model m;
-    if (!l5dscore::parse_blob(blob, len, &m, err, errcap)) return -1;
-    if (m.in_dim != l5dscore::FEATURE_DIM) {
+    l5dscore::Bank b;
+    if (!l5dscore::parse_bank_blob(blob, len, &b, err, errcap))
+        return -1;
+    if (b.base.in_dim != l5dscore::FEATURE_DIM) {
         l5dscore::fail(err, errcap,
                        "weight blob in_dim does not match engine "
                        "FEATURE_DIM");
         return -1;
     }
-    l5dscore::slab_install(e->slab, std::move(m));
+    l5dscore::slab_install(e->slab, std::move(b));
+    return 0;
+}
+
+int fph2_publish_delta(void* ep, const uint8_t* blob, size_t len,
+                       char* err, size_t errcap) {
+    Engine* e = (Engine*)ep;
+    l5dscore::Delta d;
+    if (!l5dscore::parse_delta_blob(blob, len, &d, err, errcap))
+        return -1;
+    if (!l5dscore::slab_apply_delta(e->slab, d, err, errcap)) return -1;
     return 0;
 }
 

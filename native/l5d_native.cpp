@@ -230,36 +230,84 @@ unsigned int l5d_tenant_hash(const char* s, size_t n) {
 // pinned by tests/test_native_scorer.py).
 int l5d_score_feature_dim() { return l5dscore::FEATURE_DIM; }
 
-// Parse + validate a weight blob; writes a small JSON description.
-// Returns JSON length, or -1 invalid (err text in the buffer).
+// Parse + validate a weight blob (v1 model, v2 specialist bank, or a
+// delta patch — discriminated by magic); writes a small JSON
+// description. Returns JSON length, or -1 invalid (err text in buffer).
 long l5d_score_blob_info(const uint8_t* blob, size_t len, char* out,
                          size_t cap) {
-    l5dscore::Model m;
     char err[256];
-    if (!l5dscore::parse_blob(blob, len, &m, err, sizeof(err))) {
+    if (len >= 8 && memcmp(blob, "L5DWTD01", 8) == 0) {
+        l5dscore::Delta d;
+        if (!l5dscore::parse_delta_blob(blob, len, &d, err,
+                                        sizeof(err))) {
+            snprintf(out, cap, "%s", err);
+            return -1;
+        }
+        int n = snprintf(out, cap,
+                         "{\"format\":3,\"base_generation\":%u,"
+                         "\"new_generation\":%u,\"ops\":%d}",
+                         d.base_generation, d.new_generation,
+                         (int)d.ops.size());
+        return (long)n;
+    }
+    l5dscore::Bank b;
+    if (!l5dscore::parse_bank_blob(blob, len, &b, err, sizeof(err))) {
         snprintf(out, cap, "%s", err);
         return -1;
     }
+    const l5dscore::Model& m = b.base;
+    const int fmt = (len >= 8 && memcmp(blob, "L5DWTS02", 8) == 0)
+                        ? 2 : 1;
     int n = snprintf(out, cap,
-                     "{\"version\":%u,\"crc\":%u,\"quant\":%u,"
-                     "\"in_dim\":%d,\"n_enc\":%d,\"n_dec\":%d,"
-                     "\"n_cls\":%d,\"recon_weight\":%.6f}",
-                     m.version, m.crc, m.quant, m.in_dim, m.n_enc,
-                     m.n_dec, m.n_cls, (double)m.recon_weight);
+                     "{\"format\":%d,\"version\":%u,\"crc\":%u,"
+                     "\"quant\":%u,\"in_dim\":%d,\"n_enc\":%d,"
+                     "\"n_dec\":%d,\"n_cls\":%d,\"recon_weight\":%.6f,"
+                     "\"generation\":%u,\"heads\":%d}",
+                     fmt, m.version, m.crc, m.quant, m.in_dim, m.n_enc,
+                     m.n_dec, m.n_cls, (double)m.recon_weight,
+                     b.generation, (int)b.heads.size());
     return (long)n;
 }
 
 // Score n already-featurized rows (x: [n, dim] f32, dim must equal the
-// blob's in_dim). Returns n, or -1 on a bad blob / dim mismatch.
+// blob's in_dim). Accepts v1 blobs AND v2 banks (scored on the base
+// model). Returns n, or -1 on a bad blob / dim mismatch.
 long l5d_score_eval(const uint8_t* blob, size_t len, const float* x,
                     long n, long dim, float* out, char* err,
                     size_t errcap) {
-    l5dscore::Model m;
-    if (!l5dscore::parse_blob(blob, len, &m, err, errcap)) return -1;
+    l5dscore::Bank b;
+    if (!l5dscore::parse_bank_blob(blob, len, &b, err, errcap))
+        return -1;
+    const l5dscore::Model& m = b.base;
     if (dim != m.in_dim) {
         l5dscore::fail(err, errcap, "feature dim != blob in_dim");
         return -1;
     }
+    for (long i = 0; i < n; i++)
+        out[i] = l5dscore::eval_model(m, x + (size_t)i * m.in_dim);
+    return n;
+}
+
+// Score n featurized rows through the bank's head for `route_hash`
+// (base model when the bank carries no such head). `specialist_out`
+// (nullable) receives 1 when a head served. The engine-independent
+// parity surface for per-route bank selection.
+long l5d_score_eval_route(const uint8_t* blob, size_t len,
+                          unsigned int route_hash, const float* x,
+                          long n, long dim, float* out,
+                          int* specialist_out, char* err,
+                          size_t errcap) {
+    l5dscore::Bank b;
+    if (!l5dscore::parse_bank_blob(blob, len, &b, err, errcap))
+        return -1;
+    if (dim != b.base.in_dim) {
+        l5dscore::fail(err, errcap, "feature dim != blob in_dim");
+        return -1;
+    }
+    const l5dscore::Model* head = b.select(route_hash);
+    const l5dscore::Model& m = head != nullptr ? *head : b.base;
+    if (specialist_out != nullptr)
+        *specialist_out = head != nullptr ? 1 : 0;
     for (long i = 0; i < n; i++)
         out[i] = l5dscore::eval_model(m, x + (size_t)i * m.in_dim);
     return n;
@@ -299,18 +347,34 @@ void* l5d_slab_create() { return new l5dscore::Slab(); }
 
 int l5d_slab_publish(void* slab, const uint8_t* blob, size_t len,
                      char* err, size_t errcap) {
-    l5dscore::Model m;
-    if (!l5dscore::parse_blob(blob, len, &m, err, errcap)) return -1;
+    l5dscore::Bank b;
+    if (!l5dscore::parse_bank_blob(blob, len, &b, err, errcap))
+        return -1;
     // l5d_slab_score strides rows by FEATURE_DIM, so (like the
     // engines' publish) a valid blob with any other in_dim must be
     // rejected here — not read out of bounds at eval time
-    if (m.in_dim != l5dscore::FEATURE_DIM) {
+    if (b.base.in_dim != l5dscore::FEATURE_DIM) {
         l5dscore::fail(err, errcap,
                        "weight blob in_dim does not match featurizer "
                        "FEATURE_DIM");
         return -1;
     }
-    l5dscore::slab_install((l5dscore::Slab*)slab, std::move(m));
+    l5dscore::slab_install((l5dscore::Slab*)slab, std::move(b));
+    return 0;
+}
+
+// Apply a per-route delta patch to the slab's ACTIVE bank (same
+// double-buffered reader-recheck discipline as a full publish; one
+// flip covers every attached engine/worker). Rejected on a parse
+// failure, a generation-fence mismatch, or a remove of an absent head.
+int l5d_slab_publish_delta(void* slab, const uint8_t* blob, size_t len,
+                           char* err, size_t errcap) {
+    l5dscore::Delta d;
+    if (!l5dscore::parse_delta_blob(blob, len, &d, err, errcap))
+        return -1;
+    if (!l5dscore::slab_apply_delta((l5dscore::Slab*)slab, d, err,
+                                    errcap))
+        return -1;
     return 0;
 }
 
@@ -325,14 +389,36 @@ long l5d_slab_score(void* slab, const float* x, long n, float* out) {
     return n;
 }
 
+// Score n featurized rows via the slab with per-route head selection;
+// `specialist_out` (nullable, [n]) gets 1 where a head served. -1 = no
+// weights published.
+long l5d_slab_score_route(void* slab, unsigned int route_hash,
+                          const float* x, long n, float* out,
+                          int* specialist_out) {
+    l5dscore::Slab* s = (l5dscore::Slab*)slab;
+    for (long i = 0; i < n; i++) {
+        const int rc = l5dscore::slab_score_route(
+            s, route_hash, true, x + (size_t)i * l5dscore::FEATURE_DIM,
+            out + i);
+        if (rc < 0) return -1;
+        if (specialist_out != nullptr) specialist_out[i] = rc;
+    }
+    return n;
+}
+
 long l5d_slab_stats(void* slab, char* out, size_t cap) {
     l5dscore::Slab* s = (l5dscore::Slab*)slab;
     int n = snprintf(out, cap,
-                     "{\"version\":%u,\"crc\":%u,\"swaps\":%llu,"
+                     "{\"version\":%u,\"crc\":%u,\"generation\":%u,"
+                     "\"heads\":%u,\"swaps\":%llu,\"delta_swaps\":%llu,"
                      "\"retries\":%llu}",
                      s->version.load(std::memory_order_relaxed),
                      s->crc.load(std::memory_order_relaxed),
+                     s->generation.load(std::memory_order_relaxed),
+                     s->n_heads.load(std::memory_order_relaxed),
                      (unsigned long long)s->swaps.load(
+                         std::memory_order_relaxed),
+                     (unsigned long long)s->delta_swaps.load(
                          std::memory_order_relaxed),
                      (unsigned long long)s->retries.load(
                          std::memory_order_relaxed));
@@ -347,6 +433,30 @@ long l5d_score_test_blob(uint8_t* out, size_t cap, uint32_t version,
                          int quant, uint32_t seed) {
     std::vector<uint8_t> v;
     l5dscore::build_test_blob(&v, version, quant, seed);
+    if (v.size() > cap) return -2;
+    memcpy(out, v.data(), v.size());
+    return (long)v.size();
+}
+
+// Deterministic v2 bank blob: seeded base + n_heads specialists keyed
+// 1000+k (the heads' route hashes, ascending).
+long l5d_score_test_bank(uint8_t* out, size_t cap, uint32_t generation,
+                         int quant, uint32_t seed, uint32_t n_heads) {
+    std::vector<uint8_t> v;
+    l5dscore::build_test_bank_blob(&v, generation, quant, seed, n_heads);
+    if (v.size() > cap) return -2;
+    memcpy(out, v.data(), v.size());
+    return (long)v.size();
+}
+
+// Deterministic delta patch: one seeded upsert (or remove) at
+// route_hash, fenced on base_gen -> new_gen.
+long l5d_score_test_delta(uint8_t* out, size_t cap, uint32_t base_gen,
+                          uint32_t new_gen, uint32_t route_hash,
+                          int quant, uint32_t seed, int remove) {
+    std::vector<uint8_t> v;
+    l5dscore::build_test_delta_blob(&v, base_gen, new_gen, route_hash,
+                                    quant, seed, remove != 0);
     if (v.size() > cap) return -2;
     memcpy(out, v.data(), v.size());
     return (long)v.size();

@@ -11,21 +11,40 @@
 // a reader that raced a buffer flip retries instead of evaluating torn
 // weights (slab_score's recheck; `retries` counts them).
 //
-// Layout contract (must mirror lifecycle/export.py exactly):
+// Layout contract (must mirror lifecycle/export.py exactly). A "model
+// section" is the quant-tagged dense stack:
 //
-//   magic "L5DWTS01" | u32 version | u32 quant (0=f32, 1=int8)
+//   u32 version | u32 quant (0=f32, 1=int8, 2=int4)
 //   | u32 in_dim | u32 n_enc | u32 n_dec | u32 n_cls | f32 recon_weight
 //   | f32 mu[in_dim] | f32 var[in_dim]
 //   | per layer (enc..., dec..., cls...):
 //       u32 rows | u32 cols | f32 b[cols]
 //       | quant 0: f32 w[rows*cols]        (row-major, w[i][j] = in i -> out j)
 //       | quant 1: f32 scale[cols] | i8 w[rows*cols]
-//   | u32 crc32 (zlib polynomial, over everything before it)
+//       | quant 2: f32 scale[cols] | u8 packed[(rows*cols+1)/2]
+//                  (two 4-bit two's-complement weights per byte, low
+//                  nibble first, row-major order, values in [-7, 7])
 //
-// All fields little-endian. int8 weights dequantize per OUTPUT column
-// (w_f32 ≈ scale[j] * w_i8) and accumulate in f32 — the "int8 weights,
-// f32 accumulate" scheme, so quantization error stays a weight-rounding
-// effect and never compounds through the accumulation.
+// Three blob kinds share it, each CRC32-tailed (zlib polynomial, over
+// everything before the trailing u32), all fields little-endian:
+//
+//   "L5DWTS01" | <model section> | crc            (one global model)
+//   "L5DWTS02" | u32 generation | u32 n_heads
+//              | <model section>                  (the base model)
+//              | per head, route_hash ascending:
+//                  u32 route_hash | <model section>
+//              | crc                              (specialist bank)
+//   "L5DWTD01" | u32 base_generation | u32 new_generation | u32 n_ops
+//              | per op: u32 op (0=upsert, 1=remove) | u32 route_hash
+//                        | upsert: <model section>
+//              | crc                              (per-route delta patch)
+//
+// int8/int4 weights dequantize per OUTPUT column (w_f32 ≈ scale[j] *
+// w_q) and accumulate in f32 — quantization error stays a
+// weight-rounding effect and never compounds through the accumulation.
+// A delta patches the CURRENTLY ACTIVE bank: it is rejected unless its
+// base_generation matches, so a patch can never apply over the wrong
+// bank (an engine that restarted re-requests a full publish instead).
 
 #pragma once
 
@@ -50,7 +69,13 @@ constexpr int FEATURE_DIM = 36;
 constexpr int STATUS_ONEHOT_OFF = 1;
 constexpr int MAX_WIDTH = 1024;   // widest layer a blob may carry
 constexpr int MAX_LAYERS = 16;    // per group (enc/dec/cls)
+constexpr int MAX_HEADS = 256;    // specialist heads a bank may carry
+constexpr int MAX_DELTA_OPS = 64; // ops one delta patch may carry
 constexpr int SCORE_HIST_BUCKETS = 32;  // log2(ns) buckets
+
+constexpr uint32_t QUANT_F32 = 0;
+constexpr uint32_t QUANT_INT8 = 1;
+constexpr uint32_t QUANT_INT4 = 2;
 
 // ---- crc32 (zlib polynomial; must match Python zlib.crc32) -----------------
 
@@ -79,21 +104,45 @@ inline uint32_t crc32_of(const uint8_t* p, size_t n) {
 struct Layer {
     int rows = 0, cols = 0;
     std::vector<float> w;       // f32 weights (quant 0)
-    std::vector<int8_t> wq;     // int8 weights (quant 1)
-    std::vector<float> scale;   // per-output-column dequant (quant 1)
+    std::vector<int8_t> wq;     // int8 weights (quant 1; int4 unpacks
+                                // into the same [-7, 7] int8 storage)
+    std::vector<float> scale;   // per-output-column dequant (quant 1/2)
     std::vector<float> b;
 };
 
 struct Model {
     uint32_t version = 0;
-    uint32_t crc = 0;       // the blob's own trailing crc32
-    uint32_t quant = 0;     // 0 = f32, 1 = int8
+    uint32_t crc = 0;       // the enclosing blob's trailing crc32
+    uint32_t quant = 0;     // 0 = f32, 1 = int8, 2 = int4
     int in_dim = 0;
     int n_enc = 0, n_dec = 0, n_cls = 0;
     float recon_weight = 0.5f;
     std::vector<float> mu;
     std::vector<float> inv_std;  // precomputed 1/sqrt(var + 1e-2)
     std::vector<Layer> layers;   // enc..., dec..., cls...
+};
+
+// A specialist bank: the base (global) model plus per-route heads
+// selected by the route hash stamped on each engine route. Heads are
+// kept sorted by hash (the wire format requires ascending order), so
+// selection is one binary search per scored row. A v1 blob parses into
+// a headless bank whose generation is the model version.
+struct Bank {
+    Model base;
+    uint32_t generation = 0;
+    std::vector<std::pair<uint32_t, Model>> heads;  // sorted by hash
+
+    const Model* select(uint32_t route_hash) const {
+        size_t lo = 0, hi = heads.size();
+        while (lo < hi) {
+            const size_t mid = (lo + hi) / 2;
+            if (heads[mid].first < route_hash) lo = mid + 1;
+            else hi = mid;
+        }
+        if (lo < heads.size() && heads[lo].first == route_hash)
+            return &heads[lo].second;
+        return nullptr;
+    }
 };
 
 // bounds-checked little-endian reader
@@ -124,6 +173,12 @@ struct Cursor {
         off += n;
         return true;
     }
+    const uint8_t* raw(size_t n) {
+        if (!ok || off + n > len) { ok = false; return nullptr; }
+        const uint8_t* r = p + off;
+        off += n;
+        return r;
+    }
 };
 
 inline bool fail(char* err, size_t errcap, const char* msg) {
@@ -134,29 +189,21 @@ inline bool fail(char* err, size_t errcap, const char* msg) {
     return false;
 }
 
-// Parse + fully validate a weight blob. Geometry is checked end to end
-// (layer chain, bottleneck consistency, classifier output width 1) so a
-// published blob can never index out of bounds at eval time.
-inline bool parse_blob(const uint8_t* data, size_t len, Model* out,
-                       char* err, size_t errcap) {
-    if (len < 8 + 4 * 6 + 4 + 4)
-        return fail(err, errcap, "weight blob truncated");
-    if (memcmp(data, "L5DWTS01", 8) != 0)
-        return fail(err, errcap, "bad weight blob magic");
-    uint32_t crc_stored;
-    memcpy(&crc_stored, data + len - 4, 4);
-    if (crc32_of(data, len - 4) != crc_stored)
-        return fail(err, errcap, "weight blob crc mismatch");
-    Cursor c(data + 8, len - 8 - 4);
+// Parse + fully validate ONE model section (version through layers).
+// Geometry is checked end to end (layer chain, bottleneck consistency,
+// classifier output width 1) so a published section can never index
+// out of bounds at eval time.
+inline bool parse_model_section(Cursor* cp, Model* out, char* err,
+                                size_t errcap) {
+    Cursor& c = *cp;
     Model m;
-    m.crc = crc_stored;
     m.version = c.u32();
     m.quant = c.u32();
     uint32_t in_dim = c.u32();
     uint32_t n_enc = c.u32(), n_dec = c.u32(), n_cls = c.u32();
     m.recon_weight = c.f32();
     if (!c.ok) return fail(err, errcap, "weight blob header truncated");
-    if (m.quant > 1)
+    if (m.quant > QUANT_INT4)
         return fail(err, errcap, "unknown weight quantization");
     if (in_dim < 1 || in_dim > MAX_WIDTH)
         return fail(err, errcap, "weight blob in_dim out of range");
@@ -193,18 +240,31 @@ inline bool parse_blob(const uint8_t* data, size_t len, Model* out,
         if (!c.floats(&L.b, L.cols))
             return fail(err, errcap, "weight blob bias truncated");
         size_t n = (size_t)L.rows * L.cols;
-        if (m.quant == 0) {
+        if (m.quant == QUANT_F32) {
             if (!c.floats(&L.w, n))
                 return fail(err, errcap, "weight blob weights truncated");
-        } else {
+        } else if (m.quant == QUANT_INT8) {
             if (!c.floats(&L.scale, L.cols))
                 return fail(err, errcap, "weight blob scales truncated");
             if (!c.bytes(&L.wq, n))
                 return fail(err, errcap, "weight blob weights truncated");
+        } else {  // int4: two's-complement nibbles, low nibble first
+            if (!c.floats(&L.scale, L.cols))
+                return fail(err, errcap, "weight blob scales truncated");
+            const uint8_t* packed = c.raw((n + 1) / 2);
+            if (packed == nullptr)
+                return fail(err, errcap, "weight blob weights truncated");
+            L.wq.resize(n);
+            for (size_t i = 0; i < n; i++) {
+                const uint8_t nib = (i & 1) ? (packed[i / 2] >> 4)
+                                            : (packed[i / 2] & 0x0F);
+                L.wq[i] = (int8_t)(((int)(nib ^ 8u)) - 8);  // sign-extend
+                if (L.wq[i] < -7 || L.wq[i] > 7)
+                    return fail(err, errcap,
+                                "int4 weight outside [-7, 7]");
+            }
         }
     }
-    if (c.off != c.len)
-        return fail(err, errcap, "weight blob has trailing bytes");
     // geometry: enc chain from in_dim to the bottleneck, dec mirrors it
     // back to in_dim, cls maps the bottleneck to one logit
     int w = m.in_dim;
@@ -230,6 +290,141 @@ inline bool parse_blob(const uint8_t* data, size_t len, Model* out,
     if (w != 1)
         return fail(err, errcap, "classifier head must end at width 1");
     *out = std::move(m);
+    return true;
+}
+
+// crc + magic framing shared by all three blob kinds; returns the
+// payload Cursor on success.
+inline bool open_blob(const uint8_t* data, size_t len, const char* magic,
+                      uint32_t* crc_out, char* err, size_t errcap) {
+    if (len < 8 + 4)
+        return fail(err, errcap, "weight blob truncated");
+    if (memcmp(data, magic, 8) != 0)
+        return fail(err, errcap, "bad weight blob magic");
+    uint32_t crc_stored;
+    memcpy(&crc_stored, data + len - 4, 4);
+    if (crc32_of(data, len - 4) != crc_stored)
+        return fail(err, errcap, "weight blob crc mismatch");
+    *crc_out = crc_stored;
+    return true;
+}
+
+// v1 blob -> one Model (the pre-bank format; still the export shape
+// when no specialists exist).
+inline bool parse_blob(const uint8_t* data, size_t len, Model* out,
+                       char* err, size_t errcap) {
+    uint32_t crc = 0;
+    if (!open_blob(data, len, "L5DWTS01", &crc, err, errcap))
+        return false;
+    Cursor c(data + 8, len - 8 - 4);
+    Model m;
+    if (!parse_model_section(&c, &m, err, errcap)) return false;
+    if (c.off != c.len)
+        return fail(err, errcap, "weight blob has trailing bytes");
+    m.crc = crc;
+    *out = std::move(m);
+    return true;
+}
+
+// v2 bank blob -> base + sorted specialist heads. Accepts a v1 blob
+// too (headless bank, generation = model version): `L5DWTS01` readers
+// and writers keep working unchanged through this one entry point.
+inline bool parse_bank_blob(const uint8_t* data, size_t len, Bank* out,
+                            char* err, size_t errcap) {
+    if (len >= 8 && memcmp(data, "L5DWTS01", 8) == 0) {
+        Model m;
+        if (!parse_blob(data, len, &m, err, errcap)) return false;
+        Bank b;
+        b.generation = m.version;
+        b.base = std::move(m);
+        *out = std::move(b);
+        return true;
+    }
+    uint32_t crc = 0;
+    if (!open_blob(data, len, "L5DWTS02", &crc, err, errcap))
+        return false;
+    Cursor c(data + 8, len - 8 - 4);
+    Bank b;
+    b.generation = c.u32();
+    uint32_t n_heads = c.u32();
+    if (!c.ok) return fail(err, errcap, "bank blob header truncated");
+    if (n_heads > MAX_HEADS)
+        return fail(err, errcap, "bank blob head count out of range");
+    if (!parse_model_section(&c, &b.base, err, errcap)) return false;
+    b.base.crc = crc;
+    b.heads.reserve(n_heads);
+    uint32_t prev_hash = 0;
+    for (uint32_t k = 0; k < n_heads; k++) {
+        uint32_t rh = c.u32();
+        if (!c.ok) return fail(err, errcap, "bank blob head truncated");
+        if (k > 0 && rh <= prev_hash)
+            return fail(err, errcap,
+                        "bank blob heads not strictly ascending");
+        prev_hash = rh;
+        Model head;
+        if (!parse_model_section(&c, &head, err, errcap)) return false;
+        if (head.in_dim != b.base.in_dim)
+            return fail(err, errcap,
+                        "bank head in_dim differs from base");
+        head.crc = crc;
+        b.heads.emplace_back(rh, std::move(head));
+    }
+    if (c.off != c.len)
+        return fail(err, errcap, "bank blob has trailing bytes");
+    *out = std::move(b);
+    return true;
+}
+
+// ---- per-route delta patches -----------------------------------------------
+
+constexpr uint32_t DELTA_OP_UPSERT = 0;
+constexpr uint32_t DELTA_OP_REMOVE = 1;
+
+struct DeltaOp {
+    uint32_t op = DELTA_OP_UPSERT;
+    uint32_t route_hash = 0;
+    Model head;  // upsert only
+};
+
+struct Delta {
+    uint32_t base_generation = 0;
+    uint32_t new_generation = 0;
+    std::vector<DeltaOp> ops;
+};
+
+inline bool parse_delta_blob(const uint8_t* data, size_t len, Delta* out,
+                             char* err, size_t errcap) {
+    uint32_t crc = 0;
+    if (!open_blob(data, len, "L5DWTD01", &crc, err, errcap))
+        return false;
+    Cursor c(data + 8, len - 8 - 4);
+    Delta d;
+    d.base_generation = c.u32();
+    d.new_generation = c.u32();
+    uint32_t n_ops = c.u32();
+    if (!c.ok) return fail(err, errcap, "delta blob header truncated");
+    if (d.new_generation <= d.base_generation)
+        return fail(err, errcap,
+                    "delta new_generation must exceed base_generation");
+    if (n_ops < 1 || n_ops > MAX_DELTA_OPS)
+        return fail(err, errcap, "delta blob op count out of range");
+    d.ops.resize(n_ops);
+    for (uint32_t k = 0; k < n_ops; k++) {
+        DeltaOp& op = d.ops[k];
+        op.op = c.u32();
+        op.route_hash = c.u32();
+        if (!c.ok) return fail(err, errcap, "delta blob op truncated");
+        if (op.op == DELTA_OP_UPSERT) {
+            if (!parse_model_section(&c, &op.head, err, errcap))
+                return false;
+            op.head.crc = crc;
+        } else if (op.op != DELTA_OP_REMOVE) {
+            return fail(err, errcap, "unknown delta op");
+        }
+    }
+    if (c.off != c.len)
+        return fail(err, errcap, "delta blob has trailing bytes");
+    *out = std::move(d);
     return true;
 }
 
@@ -319,23 +514,31 @@ inline float eval_model(const Model& m, const float* x) {
 // lock; the (rare) publisher spin is bounded by one in-flight eval.
 struct Slab {
     std::mutex write_mu;  // serializes publishers only
-    Model bufs[2];
+    Bank bufs[2];
     std::atomic<int> active{-1};  // -1 = nothing published yet
     std::atomic<uint32_t> readers[2] = {{0}, {0}};
     std::atomic<uint64_t> swaps{0};
+    std::atomic<uint64_t> delta_swaps{0};
     std::atomic<uint64_t> retries{0};
     std::atomic<uint32_t> version{0};
     std::atomic<uint32_t> crc{0};
+    std::atomic<uint32_t> generation{0};
+    std::atomic<uint32_t> n_heads{0};
 };
 
 inline bool slab_has_weights(const Slab* s) {
     return s->active.load(std::memory_order_acquire) >= 0;
 }
 
-inline bool slab_score(Slab* s, const float* x, float* out) {
+// Score one row. use_head selects the route's specialist when the bank
+// carries one (falling back to the base model otherwise). Returns -1
+// when nothing is published, 0 when the base model scored, 1 when a
+// specialist head scored.
+inline int slab_score_route(Slab* s, uint32_t route_hash, bool use_head,
+                            const float* x, float* out) {
     for (;;) {
         const int idx = s->active.load(std::memory_order_acquire);
-        if (idx < 0) return false;
+        if (idx < 0) return -1;
         s->readers[idx].fetch_add(1, std::memory_order_acq_rel);
         if (s->active.load(std::memory_order_acquire) != idx) {
             // a publish flipped (or is flipping) this buffer under us:
@@ -344,14 +547,33 @@ inline bool slab_score(Slab* s, const float* x, float* out) {
             s->retries.fetch_add(1, std::memory_order_relaxed);
             continue;
         }
-        const float score = eval_model(s->bufs[idx], x);
+        const Bank& b = s->bufs[idx];
+        const Model* m = use_head ? b.select(route_hash) : nullptr;
+        const int specialist = m != nullptr ? 1 : 0;
+        const float score = eval_model(m != nullptr ? *m : b.base, x);
         s->readers[idx].fetch_sub(1, std::memory_order_release);
         *out = score;
-        return true;
+        return specialist;
     }
 }
 
-inline void slab_install(Slab* s, Model&& m) {
+inline bool slab_score(Slab* s, const float* x, float* out) {
+    return slab_score_route(s, 0, false, x, out) >= 0;
+}
+
+inline void slab_note_active(Slab* s, int target) {
+    // observability mirrors of the target buffer (relaxed: readers of
+    // these atomics are stats scrapes, not the eval path)
+    s->version.store(s->bufs[target].base.version,
+                     std::memory_order_relaxed);
+    s->crc.store(s->bufs[target].base.crc, std::memory_order_relaxed);
+    s->generation.store(s->bufs[target].generation,
+                        std::memory_order_relaxed);
+    s->n_heads.store((uint32_t)s->bufs[target].heads.size(),
+                     std::memory_order_relaxed);
+}
+
+inline void slab_install(Slab* s, Bank&& b) {
     std::lock_guard<std::mutex> g(s->write_mu);
     const int cur = s->active.load(std::memory_order_acquire);
     const int target = cur < 0 ? 0 : 1 - cur;
@@ -359,11 +581,80 @@ inline void slab_install(Slab* s, Model&& m) {
     // one row eval is microseconds)
     while (s->readers[target].load(std::memory_order_acquire) != 0)
         sched_yield();
-    s->bufs[target] = std::move(m);
-    s->version.store(s->bufs[target].version, std::memory_order_relaxed);
-    s->crc.store(s->bufs[target].crc, std::memory_order_relaxed);
+    s->bufs[target] = std::move(b);
+    slab_note_active(s, target);
     s->active.store(target, std::memory_order_release);
     s->swaps.fetch_add(1, std::memory_order_relaxed);
+}
+
+inline void slab_install(Slab* s, Model&& m) {
+    Bank b;
+    b.generation = m.version;
+    b.base = std::move(m);
+    slab_install(s, std::move(b));
+}
+
+// Apply a parsed per-route delta to the ACTIVE bank under the same
+// double-buffered reader-recheck discipline as a full publish: the
+// patched copy is built in the inactive buffer (drained of straggler
+// readers first), then one release-store flips every reader to it —
+// readers never observe a half-patched bank. Rejected (false, with a
+// reason) when nothing is published yet, the generation fence fails,
+// an upsert widens in_dim, or a remove names an absent head — a
+// misdirected rollback must be loud, not a silent no-op.
+inline bool slab_apply_delta(Slab* s, const Delta& d, char* err,
+                             size_t errcap) {
+    std::lock_guard<std::mutex> g(s->write_mu);
+    const int cur = s->active.load(std::memory_order_acquire);
+    if (cur < 0)
+        return fail(err, errcap, "delta publish with no bank installed");
+    if (s->bufs[cur].generation != d.base_generation)
+        return fail(err, errcap, "delta base generation mismatch");
+    const int target = 1 - cur;
+    while (s->readers[target].load(std::memory_order_acquire) != 0)
+        sched_yield();
+    Bank nb = s->bufs[cur];  // deep copy; models are small
+    for (const DeltaOp& op : d.ops) {
+        if (op.op == DELTA_OP_UPSERT) {
+            if (op.head.in_dim != nb.base.in_dim)
+                return fail(err, errcap,
+                            "delta head in_dim differs from base");
+            size_t lo = 0, hi = nb.heads.size();
+            while (lo < hi) {
+                const size_t mid = (lo + hi) / 2;
+                if (nb.heads[mid].first < op.route_hash) lo = mid + 1;
+                else hi = mid;
+            }
+            if (lo < nb.heads.size() &&
+                nb.heads[lo].first == op.route_hash) {
+                nb.heads[lo].second = op.head;
+            } else {
+                if (nb.heads.size() >= (size_t)MAX_HEADS)
+                    return fail(err, errcap, "bank is full");
+                nb.heads.insert(nb.heads.begin() + lo,
+                                {op.route_hash, op.head});
+            }
+        } else {  // remove
+            bool found = false;
+            for (size_t i = 0; i < nb.heads.size(); i++) {
+                if (nb.heads[i].first == op.route_hash) {
+                    nb.heads.erase(nb.heads.begin() + i);
+                    found = true;
+                    break;
+                }
+            }
+            if (!found)
+                return fail(err, errcap,
+                            "delta removes an absent head");
+        }
+    }
+    nb.generation = d.new_generation;
+    s->bufs[target] = std::move(nb);
+    slab_note_active(s, target);
+    s->active.store(target, std::memory_order_release);
+    s->swaps.fetch_add(1, std::memory_order_relaxed);
+    s->delta_swaps.fetch_add(1, std::memory_order_relaxed);
+    return true;
 }
 
 // ---- featurizer ------------------------------------------------------------
@@ -376,6 +667,8 @@ inline void slab_install(Slab* s, Model&& m) {
 struct RouteFeat {
     int col = -1;        // dst-path hash column (-1: not pushed yet)
     float sign = 0.0f;
+    uint32_t rhash = 0;  // specialist-bank route hash (0: not pushed —
+                         // rows score on the base model)
     bool ewma_init = false;
     float ewma = 0.0f;
     float dev = 0.25f;
@@ -426,15 +719,17 @@ inline void featurize(float lat_ms, int status, float req_b, float rsp_b,
 // ---- per-engine accounting -------------------------------------------------
 
 struct ScoreStats {  // guarded by the engine's mu
-    uint64_t scored = 0;    // rows scored in-engine
+    uint64_t scored = 0;      // rows scored in-engine
+    uint64_t specialist = 0;  // of those, rows a per-route head scored
     uint64_t unscored = 0;  // rows passed through (no weights / no feat)
     uint64_t ns_hist[SCORE_HIST_BUCKETS] = {0};
-    void record(uint64_t ns) {
+    void record(uint64_t ns, bool by_specialist = false) {
         int b = 0;
         uint64_t v = ns;
         while (v > 1 && b < SCORE_HIST_BUCKETS - 1) { v >>= 1; b++; }
         ns_hist[b]++;
         scored++;
+        if (by_specialist) specialist++;
     }
 };
 
@@ -448,19 +743,26 @@ inline uint64_t now_ns() {
 // engine mu for the ScoreStats half; slab fields are atomics).
 inline void stats_json(const Slab& slab, const ScoreStats& st,
                        std::string* s) {
-    char tmp[256];
+    char tmp[384];
     snprintf(tmp, sizeof(tmp),
              "\"native_scorer\":{\"weights\":%s,\"version\":%u,"
-             "\"crc\":%u,\"swaps\":%llu,\"retries\":%llu,"
-             "\"scored\":%llu,\"unscored\":%llu,\"score_ns_hist\":[",
+             "\"crc\":%u,\"generation\":%u,\"heads\":%u,"
+             "\"swaps\":%llu,\"delta_swaps\":%llu,\"retries\":%llu,"
+             "\"scored\":%llu,\"specialist_scored\":%llu,"
+             "\"unscored\":%llu,\"score_ns_hist\":[",
              slab.active.load(std::memory_order_acquire) >= 0
                  ? "true" : "false",
              slab.version.load(std::memory_order_relaxed),
              slab.crc.load(std::memory_order_relaxed),
+             slab.generation.load(std::memory_order_relaxed),
+             slab.n_heads.load(std::memory_order_relaxed),
              (unsigned long long)slab.swaps.load(std::memory_order_relaxed),
+             (unsigned long long)slab.delta_swaps.load(
+                 std::memory_order_relaxed),
              (unsigned long long)slab.retries.load(
                  std::memory_order_relaxed),
              (unsigned long long)st.scored,
+             (unsigned long long)st.specialist,
              (unsigned long long)st.unscored);
     *s += tmp;
     for (int i = 0; i < SCORE_HIST_BUCKETS; i++) {
@@ -484,13 +786,10 @@ inline void put_f32(std::vector<uint8_t>* v, float f) {
     v->insert(v->end(), p, p + 4);
 }
 
-// A small, valid blob with seeded pseudo-random weights; the stress
-// drivers publish alternating seeds while traffic scores concurrently.
-inline void build_test_blob(std::vector<uint8_t>* out, uint32_t version,
-                            int quant, uint32_t seed) {
-    out->clear();
-    const char magic[8] = {'L', '5', 'D', 'W', 'T', 'S', '0', '1'};
-    out->insert(out->end(), magic, magic + 8);
+// One model section with seeded pseudo-random weights (the shared body
+// of every deterministic test blob below).
+inline void put_test_section(std::vector<uint8_t>* out, uint32_t version,
+                             int quant, uint32_t seed) {
     const int in_dim = FEATURE_DIM;
     const int dims_enc[] = {in_dim, 32, 8};    // two enc layers
     const int dims_dec[] = {8, 32, in_dim};    // mirrored back
@@ -513,17 +812,76 @@ inline void build_test_blob(std::vector<uint8_t>* out, uint32_t version,
         put_u32(out, (uint32_t)rows);
         put_u32(out, (uint32_t)cols);
         for (int j = 0; j < cols; j++) put_f32(out, rnd());      // bias
-        if (quant == 0) {
+        if (quant == (int)QUANT_F32) {
             for (int i = 0; i < rows * cols; i++) put_f32(out, rnd());
-        } else {
+        } else if (quant == (int)QUANT_INT8) {
             for (int j = 0; j < cols; j++) put_f32(out, 0.01f);  // scale
             for (int i = 0; i < rows * cols; i++)
                 out->push_back((uint8_t)(int8_t)(int)(rnd() * 600.0f));
+        } else {  // int4: packed nibbles in [-7, 7], low nibble first
+            for (int j = 0; j < cols; j++) put_f32(out, 0.02f);  // scale
+            const int n = rows * cols;
+            for (int i = 0; i < n; i += 2) {
+                int a = (int)(rnd() * 60.0f);
+                int bql = (i + 1 < n) ? (int)(rnd() * 60.0f) : 0;
+                if (a < -7) a = -7;
+                if (a > 7) a = 7;
+                if (bql < -7) bql = -7;
+                if (bql > 7) bql = 7;
+                out->push_back((uint8_t)((a & 0x0F) |
+                                         ((bql & 0x0F) << 4)));
+            }
         }
     };
     for (int k = 0; k < 2; k++) layer(dims_enc[k], dims_enc[k + 1]);
     for (int k = 0; k < 2; k++) layer(dims_dec[k], dims_dec[k + 1]);
     for (int k = 0; k < 2; k++) layer(dims_cls[k], dims_cls[k + 1]);
+}
+
+// A small, valid v1 blob with seeded pseudo-random weights; the stress
+// drivers publish alternating seeds while traffic scores concurrently.
+inline void build_test_blob(std::vector<uint8_t>* out, uint32_t version,
+                            int quant, uint32_t seed) {
+    out->clear();
+    const char magic[8] = {'L', '5', 'D', 'W', 'T', 'S', '0', '1'};
+    out->insert(out->end(), magic, magic + 8);
+    put_test_section(out, version, quant, seed);
+    put_u32(out, crc32_of(out->data(), out->size()));
+}
+
+// A valid v2 bank blob: seeded base + n_heads specialists keyed
+// 1000+k (ascending, as the wire format requires).
+inline void build_test_bank_blob(std::vector<uint8_t>* out,
+                                 uint32_t generation, int quant,
+                                 uint32_t seed, uint32_t n_heads) {
+    out->clear();
+    const char magic[8] = {'L', '5', 'D', 'W', 'T', 'S', '0', '2'};
+    out->insert(out->end(), magic, magic + 8);
+    put_u32(out, generation);
+    put_u32(out, n_heads);
+    put_test_section(out, generation, quant, seed);
+    for (uint32_t k = 0; k < n_heads; k++) {
+        put_u32(out, 1000u + k);
+        put_test_section(out, generation, quant, seed + 17u * (k + 1));
+    }
+    put_u32(out, crc32_of(out->data(), out->size()));
+}
+
+// A valid delta patch upserting one seeded head at `route_hash` (the
+// stress drivers' delta leg; remove=true emits a remove op instead).
+inline void build_test_delta_blob(std::vector<uint8_t>* out,
+                                  uint32_t base_gen, uint32_t new_gen,
+                                  uint32_t route_hash, int quant,
+                                  uint32_t seed, bool remove = false) {
+    out->clear();
+    const char magic[8] = {'L', '5', 'D', 'W', 'T', 'D', '0', '1'};
+    out->insert(out->end(), magic, magic + 8);
+    put_u32(out, base_gen);
+    put_u32(out, new_gen);
+    put_u32(out, 1);
+    put_u32(out, remove ? DELTA_OP_REMOVE : DELTA_OP_UPSERT);
+    put_u32(out, route_hash);
+    if (!remove) put_test_section(out, new_gen, quant, seed);
     put_u32(out, crc32_of(out->data(), out->size()));
 }
 

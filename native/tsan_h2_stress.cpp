@@ -43,7 +43,10 @@ int fph2_set_client_tls(void* e, const char* alpn, int verify,
                         const char* ca_path, char* err, size_t errcap);
 int fph2_publish_weights(void* e, const unsigned char* blob, size_t len,
                          char* err, size_t errcap);
+int fph2_publish_delta(void* e, const unsigned char* blob, size_t len,
+                       char* err, size_t errcap);
 int fph2_set_route_feature(void* e, const char* host, int col, float sign);
+int fph2_set_route_hash(void* e, const char* host, unsigned int rhash);
 int fph2_set_tenant(void* e, int kind, const char* header, int segment);
 int fph2_set_tenant_quota(void* e, unsigned int hash, int limit);
 int fph2_set_guard(void* e, long header_budget_ms, long body_stall_ms,
@@ -103,22 +106,34 @@ void* churn_main(void* arg) {
         // broadcast to every worker like the sharded wrapper does
         for (int w = 0; w < NWORKERS; w++) {
             fph2_set_route(a->engines[w], "echoext", ep);
-            // scoring leg: the route-feature push rides every
-            // re-install (the Python controller's _push does the
-            // same), and weight blobs hot-swap mid-traffic —
-            // concurrent score + swap + drain is exactly the slab's
-            // seqlock contract under test, now with BOTH workers'
-            // epoll threads reading the ONE shared slab
+            // scoring leg: the route-feature + bank-key pushes ride
+            // every re-install (the Python controller's _push does the
+            // same), and weight banks hot-swap mid-traffic —
+            // concurrent score + head-select + swap + drain is exactly
+            // the slab's seqlock contract under test, now with BOTH
+            // workers' epoll threads reading the ONE shared slab
             fph2_set_route_feature(a->engines[w], "echoext", 14, 1.0f);
+            fph2_set_route_hash(a->engines[w], "echoext", 1000u);
         }
         if (i % 4 == 0) {
-            l5dscore::build_test_blob(&blob, (uint32_t)i, i % 2,
-                                      (uint32_t)i);
+            // bank publish (f32/int8/int4 rotating) + a fenced
+            // per-route DELTA patch on the hashed route — the
+            // distiller's publish path under sanitizer fire
+            const uint32_t gen = (uint32_t)(i / 4) * 2 + 1;
+            l5dscore::build_test_bank_blob(&blob, gen, i % 3,
+                                           (uint32_t)i, 1);
             // one publish through EITHER worker lands in the shared
             // slab and fans out to all of them
             if (fph2_publish_weights(a->engines[(i / 4) % NWORKERS],
                                      blob.data(), blob.size(),
                                      err, sizeof(err)) == 0)
+                a->swaps.fetch_add(1);
+            l5dscore::build_test_delta_blob(&blob, gen, gen + 1, 1000u,
+                                            i % 3, (uint32_t)i + 3,
+                                            /*remove=*/false);
+            if (fph2_publish_delta(a->engines[(i / 4 + 1) % NWORKERS],
+                                   blob.data(), blob.size(), err,
+                                   sizeof(err)) == 0)
                 a->swaps.fetch_add(1);
         }
         if (i % 7 == 0) {

@@ -45,7 +45,10 @@ int fp_set_client_tls(void* ep, const char* alpn, int verify,
                       const char* ca_path, char* err, size_t errcap);
 int fp_publish_weights(void* ep, const unsigned char* blob, size_t len,
                        char* err, size_t errcap);
+int fp_publish_delta(void* ep, const unsigned char* blob, size_t len,
+                     char* err, size_t errcap);
 int fp_set_route_feature(void* ep, const char* host, int col, float sign);
+int fp_set_route_hash(void* ep, const char* host, unsigned int rhash);
 int fp_set_tenant(void* ep, int kind, const char* header, int segment);
 int fp_set_tenant_quota(void* ep, unsigned int hash, int limit);
 int fp_set_guard(void* ep, long header_budget_ms, long body_stall_ms,
@@ -279,9 +282,12 @@ int main() {
         for (int w = 0; w < NWORKERS; w++) {
             fp_set_route(workers[w], host, endpoints);
             // scoring leg: push each route's dst-hash feature column so
-            // the in-engine scorer featurizes its rows
+            // the in-engine scorer featurizes its rows, and its
+            // specialist-bank key (the test banks below carry heads
+            // keyed 1000..1003) so head SELECTION runs under fire too
             fp_set_route_feature(workers[w], host, 14 + i,
                                  i % 2 ? -1.0f : 1.0f);
+            fp_set_route_hash(workers[w], host, 1000u + (unsigned)i);
         }
     }
     if (front != nullptr) {
@@ -313,6 +319,7 @@ int main() {
                 fp_set_route(workers[w], "svc-3", endpoints);
                 fp_set_route_feature(workers[w], "svc-3", 17,
                                      gen % 2 ? -1.0f : 1.0f);
+                fp_set_route_hash(workers[w], "svc-3", 1003u);
             }
             // per-tenant quota push/clear races the data plane's
             // quota reads (the TenantAdmission actuation path)
@@ -324,21 +331,40 @@ int main() {
         }
     });
 
-    // weight-swap thread: alternating f32/int8 blobs hot-swap into
-    // the SHARED slab while both workers' epoll threads score
-    // concurrently — the double-buffer + reader-recheck protocol with
-    // multi-core readers under sanitizer fire. One publish (through
-    // any worker) must fan out to every worker atomically.
+    // weight-swap thread: alternating f32/int8/int4 BANK blobs (base +
+    // specialist heads) hot-swap into the SHARED slab, each followed
+    // by a generation-fenced per-route DELTA patch (the distiller's
+    // publish path), while both workers' epoll threads score — and
+    // head-select — concurrently: the double-buffer + reader-recheck
+    // protocol with multi-core readers under sanitizer fire. One
+    // publish (through any worker) must fan out to every worker
+    // atomically.
     std::thread swapper([&] {
         std::vector<uint8_t> blob;
         char err[256];
-        uint32_t gen = 0;
+        uint32_t gen = 1;
         while (!stop.load()) {
-            l5dscore::build_test_blob(&blob, gen, (int)(gen % 2), gen);
+            const int quant = (int)(gen % 3);
+            l5dscore::build_test_bank_blob(&blob, gen, quant, gen, 2);
             if (fp_publish_weights(workers[gen % NWORKERS], blob.data(),
                                    blob.size(), err, sizeof(err)) == 0)
                 weight_swaps.fetch_add(1);
-            gen++;
+            // fenced delta: upsert a head for one of the live routes
+            // (1000..1003), then a remove of it on the next patch
+            l5dscore::build_test_delta_blob(
+                &blob, gen, gen + 1, 1000u + gen % 4, quant, gen + 7,
+                /*remove=*/false);
+            if (fp_publish_delta(workers[(gen + 1) % NWORKERS],
+                                 blob.data(), blob.size(), err,
+                                 sizeof(err)) == 0)
+                weight_swaps.fetch_add(1);
+            l5dscore::build_test_delta_blob(
+                &blob, gen + 1, gen + 2, 1000u + gen % 4, quant,
+                gen + 9, /*remove=*/true);
+            if (fp_publish_delta(workers[gen % NWORKERS], blob.data(),
+                                 blob.size(), err, sizeof(err)) == 0)
+                weight_swaps.fetch_add(1);
+            gen += 3;
             usleep(1000);
         }
     });

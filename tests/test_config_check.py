@@ -468,6 +468,72 @@ class TestFleetConfigRules:
         assert rules_of(check_text(cfg), "fleet-config") == []
 
 
+class TestDistillConfigRules:
+    def distill(self, distill_yaml, fast=True, native="primary",
+                quant="f32"):
+        fp = "  fastPath: true\n" if fast else ""
+        return (
+            "routers:\n- protocol: http\n"
+            + fp +
+            "  dtab: |\n    /svc => /#/io.l5d.fs ;\n"
+            "  servers: [{port: 0}]\n"
+            "telemetry:\n- kind: io.l5d.jaxAnomaly\n"
+            f"  nativeTier: {native}\n"
+            f"  nativeQuant: {quant}\n"
+            "  distill:\n"
+            + "".join(f"    {line}\n"
+                      for line in distill_yaml.splitlines())
+            + NAMERS)
+
+    def test_bad_knob_ranges_fire(self):
+        cfg = self.distill("maxHeads: 0\nretrainSteps: 0\n"
+                           "learningRate: 0\ncooldownS: -1")
+        msgs = [f.message for f in rules_of(check_text(cfg),
+                                            "distill-config")]
+        assert any("maxHeads" in m for m in msgs)
+        assert any("retrainSteps" in m for m in msgs)
+        assert any("learningRate" in m for m in msgs)
+        assert any("cooldownS" in m for m in msgs)
+
+    def test_head_count_above_native_capacity_fires(self):
+        cfg = self.distill("maxHeads: 500")
+        (f,) = rules_of(check_text(cfg), "distill-config")
+        assert "bank capacity" in f.message
+
+    def test_drift_trigger_in_noise_floor_warns(self):
+        cfg = self.distill("driftThreshold: 0.1")
+        (f,) = rules_of(check_text(cfg), "distill-config")
+        assert f.severity == "warning" and "noise" in f.message
+
+    def test_min_rows_above_replay_window_fires(self):
+        cfg = self.distill("minRouteRows: 1000\n"
+                           "perRouteReplayRows: 128")
+        (f,) = rules_of(check_text(cfg), "distill-config")
+        assert "perRouteReplayRows" in f.message
+
+    def test_int4_without_fastpath_warns(self):
+        cfg = self.distill("maxHeads: 8", fast=False, quant="int4")
+        got = rules_of(check_text(cfg), "distill-config")
+        assert any("int4" in f.message and f.severity == "warning"
+                   for f in got)
+
+    def test_delta_publish_without_native_tier_warns(self):
+        cfg = self.distill("maxHeads: 8", native="off")
+        (f,) = rules_of(check_text(cfg), "distill-config")
+        assert f.severity == "warning" and "nativeTier" in f.message
+
+    def test_delta_publish_without_fastpath_warns(self):
+        cfg = self.distill("maxHeads: 8", fast=False)
+        (f,) = rules_of(check_text(cfg), "distill-config")
+        assert f.severity == "warning" and "fastPath" in f.message
+
+    def test_healthy_distill_block_is_clean(self):
+        cfg = self.distill("maxHeads: 16\ndriftThreshold: 1.0\n"
+                           "minRouteRows: 64\nretrainSteps: 8",
+                           quant="int4")
+        assert rules_of(check_text(cfg), "distill-config") == []
+
+
 class TestRegistryCrossCheck:
     def test_unknown_kind_fires_with_known_list(self):
         cfg = """

@@ -437,6 +437,68 @@ class TestFleetExchange:
         assert FleetConfig(quorum=4).effective_quorum() == 4
 
 
+class TestNamerdWatchIngest:
+    """The standing-watch satellite: peer docs arrive through the
+    namespace watch stream the moment the store applies them, not on
+    this instance's next publish round (gossip stays the primary fast
+    path; the watch replaces publish-time-only namerd ingest)."""
+
+    def test_standing_watch_ingests_peer_writes(self):
+        async def go():
+            store = InMemoryDtabStore()
+            m = MetricsTree()
+            ex_a = _exchange(store, "a", metrics=m)
+            ex_b = _exchange(store, "b")
+            ex_b.set_source(lambda: {"/svc/web": 0.7})
+            await ex_b.publish_once()  # creates the ns with b's doc
+            assert ex_a.start_watch() is True
+            assert ex_a.watching
+            # the watch delivers the CURRENT state without a publishes
+            # round from a
+            for _ in range(200):
+                if ex_a.view.fresh_count() == 1:
+                    break
+                await asyncio.sleep(0.01)
+            assert ex_a.view.fresh_count() == 1
+            (doc,) = ex_a.view.all_docs()
+            first_seq = doc.seq
+            # a peer write mid-watch lands push-style too
+            await ex_b.publish_once()
+            for _ in range(200):
+                docs = ex_a.view.all_docs()
+                if docs and docs[0].seq > first_seq:
+                    break
+                await asyncio.sleep(0.01)
+            (doc,) = ex_a.view.all_docs()
+            assert doc.seq > first_seq
+            assert m.flatten()["control/fleet/watch_updates"] >= 2
+            assert m.flatten()["control/fleet/watching"] == 1.0
+            # publish-time ingest is OFF while the watch runs: a's own
+            # publish keeps working and the view stays consistent
+            ex_a.set_source(lambda: {"/svc/web": 0.9})
+            await ex_a.publish_once()
+            assert ex_a.view.fresh_count() == 1
+            await ex_a.aclose()
+            assert not ex_a.watching
+            await ex_b.aclose()
+
+        run(go())
+
+    def test_start_watch_without_client_support_is_noop(self):
+        async def go():
+            class NoWatch:
+                async def fetch(self, ns):
+                    return None
+
+            ex = FleetExchange(FleetConfig(instance="a", generation=1),
+                               NoWatch())
+            assert ex.start_watch() is False
+            assert not ex.watching
+            await ex.aclose()
+
+        run(go())
+
+
 # ---- quorum-gated actuation -------------------------------------------------
 
 
@@ -673,6 +735,7 @@ class _FakeReplica:
         self.fail = fail
         self.calls = 0
         self.closed = False
+        self.restored = None
         self.last_timing = {"rpc_ms": 1.0}
 
     async def score(self, x):
@@ -684,6 +747,13 @@ class _FakeReplica:
     async def fit(self, x, labels, mask):
         self.calls += 1
         return 0.0
+
+    async def restore(self, snap):
+        self.calls += 1
+        if self.fail:
+            raise RuntimeError(f"replica {self.addr} down")
+        self.restored = snap
+        return 0
 
     def close(self):
         self.closed = True
@@ -719,6 +789,28 @@ class TestScorerReplicaPool:
                 assert len(out) == 2
             # dead replica was tried, healthy one carried every call
             assert made["ok:2"].calls >= 6
+
+        run(go())
+
+    def test_broadcast_restore_reaches_every_replica(self):
+        """Fleet model coordination: a promote fans the snapshot out to
+        EVERY replica (Snapshot/Restore RPCs), one dead replica skipped
+        without blocking the rest."""
+        async def go():
+            made = {}
+
+            def mk(addr):
+                made[addr] = _FakeReplica(addr, fail=addr.startswith("bad"))
+                return made[addr]
+
+            pool = ScorerReplicaPool(["a:1", "bad:2", "c:3"],
+                                     mk_client=mk)
+            snap = object()
+            assert await pool.broadcast_restore(snap) == 2
+            assert made["a:1"].restored is snap
+            assert made["c:3"].restored is snap
+            assert made["bad:2"].restored is None
+            assert pool.status()["replicas"]["bad:2"]["failures"] == 1
 
         run(go())
 

@@ -43,7 +43,8 @@ from tools.analysis.core import (
 # promote-time weight export, which must stay host-side numpy on an
 # already-gathered snapshot (it runs next to the serving loop).
 ROOT_NAMES = {"score", "drain_once", "_score_and_publish",
-              "_publish_native_batch", "export_weight_blob"}
+              "_publish_native_batch", "export_weight_blob",
+              "export_bank_blob", "export_delta_blob"}
 
 FLAGGED_CALLS = {
     "jax.device_put": "per-call device_put on the score dispatch path; "

@@ -32,7 +32,8 @@ from tools.analysis.core import (  # noqa: F401 — re-exports
 # Startup/assembly code may block and single-task freely.
 DEFAULT_SCOPE = ("linkerd_tpu/router", "linkerd_tpu/protocol",
                  "linkerd_tpu/telemetry", "linkerd_tpu/lifecycle",
-                 "linkerd_tpu/control", "linkerd_tpu/fleet")
+                 "linkerd_tpu/control", "linkerd_tpu/fleet",
+                 "linkerd_tpu/distill")
 
 
 def run_race_analysis(scan_paths: Optional[Sequence[str]] = None,

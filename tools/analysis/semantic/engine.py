@@ -36,6 +36,7 @@ SEMANTIC_RULES = (
     "scorer-config", "scorer-width",
     "override-unsafe",    # reactor-generated dtab overrides (control/)
     "fleet-config",       # fleet exchange / quorum-gated actuation wiring
+    "distill-config",     # specialist-bank / distillation knob wiring
 )
 
 

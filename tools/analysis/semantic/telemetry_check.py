@@ -39,6 +39,9 @@ def check_telemetry(source: ConfigSource, spec: LinkerSpec
         except ConfigError:
             continue  # the registry cross-check already reported it
         yield from _check_anomaly_cfg(source, cfg, where)
+        if cfg.distill is not None:
+            yield from _check_distill_cfg(source, cfg, spec,
+                                          f"{where}.distill")
         if cfg.control is not None:
             yield from _check_control_cfg(source, cfg.control, spec,
                                           f"{where}.control")
@@ -113,6 +116,97 @@ def _check_anomaly_cfg(source: ConfigSource, cfg, where: str
                    f"breakerFailures must be >= 1 (got "
                    f"{cfg.breakerFailures})",
                    "breakerFailures")
+
+
+def _check_distill_cfg(source: ConfigSource, cfg, spec: LinkerSpec,
+                       where: str) -> Iterator[Finding]:
+    """Specialist-bank / distillation knob interlocks: knob ranges the
+    pipeline refuses at startup, a head count the native evaluator
+    cannot hold, a drift trigger below the score noise floor (retrain
+    churn), int4 with no fastPath router to serve it, and delta
+    publishing with the native tier off (specialists could never reach
+    a data plane)."""
+    d = cfg.distill
+    if d.maxHeads < 1:
+        yield _bad(source, "distill-config", where,
+                   f"maxHeads must be >= 1 (got {d.maxHeads})",
+                   "maxHeads")
+    else:
+        from linkerd_tpu.lifecycle.export import MAX_HEADS
+        if d.maxHeads > MAX_HEADS:
+            yield _bad(source, "distill-config", where,
+                       f"maxHeads ({d.maxHeads}) exceeds the native "
+                       f"evaluator's bank capacity ({MAX_HEADS}) — a "
+                       f"full bank would be a rejected publish",
+                       "maxHeads")
+    if d.driftThreshold <= 0:
+        yield _bad(source, "distill-config", where,
+                   f"driftThreshold must be > 0 (got "
+                   f"{d.driftThreshold})", "driftThreshold")
+    elif d.driftThreshold < 0.25:
+        yield _bad(source, "distill-config", where,
+                   f"driftThreshold {d.driftThreshold} sits inside the "
+                   f"score noise floor (~0.25 sigma) — routes would "
+                   f"retrain continuously and the gate would reject "
+                   f"most candidates (retrain churn, not learning)",
+                   "driftThreshold", severity="warning")
+    if d.minRouteRows < 8:
+        yield _bad(source, "distill-config", where,
+                   f"minRouteRows must be >= 8 (got {d.minRouteRows}) "
+                   f"— the pipeline refuses it at startup",
+                   "minRouteRows")
+    elif d.minRouteRows > d.perRouteReplayRows:
+        yield _bad(source, "distill-config", where,
+                   f"minRouteRows ({d.minRouteRows}) exceeds "
+                   f"perRouteReplayRows ({d.perRouteReplayRows}) — no "
+                   f"route can ever accumulate enough rows to retrain",
+                   "minRouteRows")
+    if d.retrainSteps < 1:
+        yield _bad(source, "distill-config", where,
+                   f"retrainSteps must be >= 1 (got {d.retrainSteps})",
+                   "retrainSteps")
+    if d.learningRate <= 0:
+        yield _bad(source, "distill-config", where,
+                   f"learningRate must be > 0 (got {d.learningRate})",
+                   "learningRate")
+    if d.cooldownS < 0:
+        yield _bad(source, "distill-config", where,
+                   f"cooldownS must be >= 0 (got {d.cooldownS})",
+                   "cooldownS")
+    if not (0.0 <= d.aucTolerance <= 1.0):
+        yield _bad(source, "distill-config", where,
+                   f"aucTolerance must be in [0, 1] (got "
+                   f"{d.aucTolerance})", "aucTolerance")
+    if d.lossTolerance < 0:
+        yield _bad(source, "distill-config", where,
+                   f"lossTolerance must be >= 0 (got "
+                   f"{d.lossTolerance})", "lossTolerance")
+    quant = d.quant or cfg.nativeQuant
+    if quant not in ("f32", "int8", "int4"):
+        yield _bad(source, "distill-config", where,
+                   f"quant must be f32/int8/int4 (got {quant!r})",
+                   "quant" if d.quant else "nativeQuant")
+    any_fastpath = any(bool(getattr(r, "fastPath", False))
+                       for r in (spec.routers or []))
+    if quant == "int4" and not any_fastpath:
+        yield _bad(source, "distill-config", where,
+                   "int4 quantization with no fastPath router: only "
+                   "the native engines evaluate quantized blobs — the "
+                   "JAX tier scores f32 regardless, so int4 buys "
+                   "nothing here and its quantization error is pure "
+                   "cost", "int4", severity="warning")
+    if cfg.nativeTier != "primary":
+        yield _bad(source, "distill-config", where,
+                   "distill with nativeTier: off — specialist heads "
+                   "are served by the in-plane evaluator; with the "
+                   "native tier off the bank is trained and gated but "
+                   "never scores a request",
+                   "nativeTier", severity="warning")
+    elif d.deltaPublish and not any_fastpath:
+        yield _bad(source, "distill-config", where,
+                   "deltaPublish with no fastPath router: there is no "
+                   "engine to patch — promoted heads only ever land in "
+                   "/model.json", "deltaPublish", severity="warning")
 
 
 def _check_control_cfg(source: ConfigSource, ctl, spec: LinkerSpec,
