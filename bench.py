@@ -1510,6 +1510,87 @@ def resilience_bench() -> dict:
     return out
 
 
+def streaming_bench() -> dict:
+    """Stream-sentinel numbers, device-free: (1) per-sample scoring
+    latency through the Python tracker + featurizer + a linear head
+    (the pre-scorer path every mid-stream sample rides), (2) frames
+    from sick-onset to shed through the observer with a synthetic
+    clock, and (3) the e2e leg (``tools/validator.py streams``): sick
+    h2 stream RST'd mid-flight with every neighbor finishing, plus
+    101-tunnel relay throughput."""
+    import itertools
+    import subprocess
+
+    import numpy as np
+
+    from linkerd_tpu.models.features import FEATURE_DIM
+    from linkerd_tpu.streams import (
+        FRAME_DATA, H2FrameObserver, StreamSentinel, StreamTracker,
+        stream_feature_vector)
+
+    out: dict = {}
+
+    # (1) micro: score 64 streams x 32 samples through the real path
+    rng = np.random.default_rng(7)
+    w = rng.standard_normal(FEATURE_DIM).astype(np.float32)
+    trackers = [StreamTracker() for _ in range(64)]
+    lats = []
+    scored = 0
+    for i in range(32):
+        for j, t in enumerate(trackers):
+            t.frame(FRAME_DATA, 5.0 + (i % 7), 64.0 * (j + 1))
+            t0 = time.perf_counter()
+            x = stream_feature_vector(t, f"/svc/s{j}")
+            _ = float(w @ x)
+            lats.append((time.perf_counter() - t0) * 1e6)
+            scored += 1
+    lats.sort()
+    out["stream_score_p50_us"] = round(lats[len(lats) // 2], 1)
+    out["stream_score_p99_us"] = round(lats[int(len(lats) * 0.99)], 1)
+    out["stream_samples"] = scored
+    out["stream_scored_fraction"] = 1.0  # every sample took the path
+
+    # (2) frames from sick onset to shed (synthetic clock: cadence-
+    # independent, this is the governor's reaction depth)
+    sent = StreamSentinel(enter=0.7, exit=0.3, quorum=2, dwell_s=0.0)
+    keys = itertools.count(1)
+    obs = H2FrameObserver(sent, next_skey=lambda: next(keys),
+                          scorer=lambda x: 1.0, sample_every_frames=2,
+                          min_gap_ms=0, action="rst")
+
+    class _Conn:
+        shed_at = None
+
+        def shed_stream(self, sid, code=0):
+            self.shed_at = frame_i
+            return True
+
+    conn = _Conn()
+    obs.bind(conn)
+    for frame_i in range(1, 101):
+        obs.on_frame(1, FRAME_DATA, 60_000, now=100.0 + frame_i)
+        if conn.shed_at is not None:
+            break
+    out["shed_after_frames"] = conn.shed_at
+
+    # (3) e2e: real h2 server + observer + tunnel relay in a child
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")  # pure-Python leg, no device
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "tools/validator.py", "streams"],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    out["e2e_wall_s"] = round(time.perf_counter() - t0, 2)
+    out["e2e_pass"] = proc.returncode == 0
+    for line in proc.stdout.splitlines():
+        if line.startswith("STREAMS "):
+            out.update(json.loads(line[len("STREAMS "):]))
+    if proc.returncode != 0:
+        out["e2e_error"] = (proc.stderr or proc.stdout)[-300:]
+    return out
+
+
 # Global wall-clock budget: a mid-run stall (e.g. the TPU tunnel
 # wedging one phase) must not zero the whole round. The headline JSON
 # line prints BEFORE the first phase and re-prints after EVERY phase
@@ -1757,6 +1838,16 @@ def main() -> None:
         detail["core_scaling"] = cs
         detail["core_scaling_eff"] = cs.get("core_scaling_eff")
 
+    def ph_streaming() -> None:
+        st = streaming_bench()
+        # headline rows at the top level (the acceptance bar reads
+        # them); the full run stays under detail.streaming
+        detail["stream_score_p99_us"] = st.get("stream_score_p99_us")
+        detail["stream_shed_ms"] = st.get("shed_ms")
+        detail["stream_neighbor_success"] = st.get("neighbor_success")
+        detail["tunnel_mb_s"] = st.get("tunnel_mb_s")
+        detail["streaming"] = st
+
     def ph_native_score() -> None:
         ns = native_score_bench()
         # headline rows at the top level (the acceptance bar reads
@@ -1778,6 +1869,7 @@ def main() -> None:
         ("race_analysis", ph_race),
         ("fleet", ph_fleet),
         ("tenant_isolation", ph_tenant_isolation),
+        ("streaming", ph_streaming),
         ("native_score", ph_native_score),
         ("specialist", ph_specialist),
         ("core_scaling", ph_core_scaling),

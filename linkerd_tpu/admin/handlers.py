@@ -161,6 +161,31 @@ def mk_tenants_handler(linker: "Linker"):
     return handler
 
 
+def mk_streams_handler(linker: "Linker"):
+    """``/streams.json`` — per-router stream-sentinel state: the
+    Python-plane sentinel table (h2 routers with ``streamScoring``) and
+    the native engine's in-plane stream table + tunnel counters
+    (fastPath routers), read live."""
+
+    async def handler(req: Request) -> Response:
+        out = {}
+        sentinels = dict(linker.stream_sentinels)
+        for r in linker.routers:
+            entry: dict = {}
+            ctl = getattr(r, "controller", None)
+            if ctl is not None:
+                entry = ctl.streams_snapshot()
+            sent = sentinels.get(r.label)
+            if sent is not None and "sentinel" not in entry:
+                entry["sentinel"] = sent.snapshot()
+                entry["enabled"] = True
+            if entry:
+                out[r.label] = entry
+        return json_response(out)
+
+    return handler
+
+
 def mk_config_check_handler(linker: "Linker"):
     """``/config-check.json`` — l5dcheck semantic verification of the
     live linker's parsed config (the same rules as ``python -m
@@ -364,6 +389,7 @@ def linkerd_admin_handlers(linker: "Linker") -> List[Tuple[str, Any]]:
         ("/anomaly.json", mk_anomaly_handler(linker)),
         ("/model.json", mk_model_handler(linker)),
         ("/tenants.json", mk_tenants_handler(linker)),
+        ("/streams.json", mk_streams_handler(linker)),
         ("/config-check.json", mk_config_check_handler(linker)),
         ("/identifier.json", mk_identifier_handler(linker)),
         ("/logging.json", logging_handler),

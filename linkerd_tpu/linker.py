@@ -248,16 +248,64 @@ class ConnectionGuardSpec:
     pingBurst: int = 256
     settingsBurst: int = 64
     floodWindowMs: int = 1000
+    # http only: budgets for 101-upgrade / CONNECT byte tunnels riding
+    # the native engine (tunnels escape the request slowloris budgets
+    # by design — these are their replacement). 0 disables.
+    tunnelIdleMs: int = 0
+    tunnelMaxBytes: int = 0
 
     def validate(self, where: str) -> None:
         for name in ("headerBudgetMs", "bodyStallMs", "acceptBurst",
                      "maxHandshakesInflight", "maxStreamsPerConnection",
-                     "rstBurst", "pingBurst", "settingsBurst"):
+                     "rstBurst", "pingBurst", "settingsBurst",
+                     "tunnelIdleMs", "tunnelMaxBytes"):
             if getattr(self, name) < 0:
                 raise ConfigError(f"{where}.{name} must be >= 0")
         if self.acceptWindowMs < 1 or self.floodWindowMs < 1:
             raise ConfigError(
                 f"{where}: window sizes must be >= 1 ms")
+
+
+@dataclass
+class StreamScoringSpec:
+    """Stream sentinel config (http + h2): incremental per-stream
+    featurization and mid-stream actuation for long-lived streams
+    (h2/gRPC streams, WebSocket upgrades, CONNECT tunnels). The native
+    engines sample each live stream's feature accumulator every
+    ``sampleEveryFrames`` frames (at most once per ``minGapMs``), score
+    it through the in-plane scorer (specialist head pinned at stream
+    open), and run a per-stream hysteresis governor — same
+    enter/exit/quorum/dwell semantics as every other actuator — that
+    sheds a SICK stream mid-flight when ``action: rst``."""
+
+    sampleEveryFrames: int = 8
+    minGapMs: int = 10
+    tableCap: int = 4096
+    enter: float = 0.8
+    exit: float = 0.5
+    quorum: int = 3
+    dwellMs: int = 1000
+    action: str = "rst"  # observe | rst
+
+    def validate(self, where: str) -> None:
+        if self.sampleEveryFrames < 1:
+            raise ConfigError(f"{where}.sampleEveryFrames must be >= 1")
+        if self.minGapMs < 0:
+            raise ConfigError(f"{where}.minGapMs must be >= 0")
+        if self.tableCap < 1:
+            raise ConfigError(f"{where}.tableCap must be >= 1")
+        if not 0.0 < self.exit < self.enter <= 1.0:
+            raise ConfigError(
+                f"{where}: thresholds must satisfy 0 < exit < enter "
+                f"<= 1 (got enter={self.enter}, exit={self.exit})")
+        if self.quorum < 1:
+            raise ConfigError(f"{where}.quorum must be >= 1")
+        if self.dwellMs < 0:
+            raise ConfigError(f"{where}.dwellMs must be >= 0")
+        if self.action not in ("observe", "rst"):
+            raise ConfigError(
+                f"{where}.action must be observe or rst "
+                f"(got {self.action!r})")
 
 
 @dataclass
@@ -339,8 +387,14 @@ class RouterSpec:
     tenants: Optional[TenantsSpec] = None
     # fastPath only: native connection-plane defenses (slowloris
     # budgets, accept throttle, handshake-churn backpressure, h2
-    # flood caps)
+    # flood caps, tunnel budgets)
     connectionGuard: Optional[ConnectionGuardSpec] = None
+    # http + h2: stream sentinel — incremental scoring and mid-stream
+    # actuation for long-lived streams/tunnels. Native in-plane on
+    # fastPath routers; the Python h2 data plane runs the same
+    # tracker/governor in-process (http Python path has no frame
+    # stream to sample — l5dcheck warns there)
+    streamScoring: Optional[StreamScoringSpec] = None
     # fastPath only: shard the native engine N-way — N per-core epoll
     # workers sharing the router's ports via SO_REUSEPORT, per-core
     # stats/tenant/guard slabs merged at scrape time, one shared
@@ -527,6 +581,10 @@ class Linker:
         # per-router tenant state for /tenants.json:
         # [(label, TenantBoard, Optional[TenantAdmission])]
         self.tenant_views: List[Tuple[str, Any, Any]] = []
+        # per-router Python-plane stream sentinels for /streams.json:
+        # [(label, StreamSentinel)] — fastPath routers surface theirs
+        # through FastPathController.streams_snapshot instead
+        self.stream_sentinels: List[Tuple[str, Any]] = []
         # namer lookup backing a path-form sidecarAddress (closed with
         # the linker so its watch doesn't outlive the namers)
         self._scorer_activity: Any = None
@@ -913,11 +971,39 @@ class Linker:
             label, server_filters, routing, server_stack,
             clear_filter=H2ClearContextFilter)
 
+        # stream sentinel on the Python h2 data plane: one shared
+        # governor/table per router, one frame observer per accepted
+        # connection (linkerd_tpu/streams — the native engines run the
+        # same machinery in-plane on fastPath routers)
+        mk_observer = None
+        if rspec.streamScoring is not None:
+            ss = rspec.streamScoring
+            ss.validate(f"{label}.streamScoring")
+            import itertools
+
+            from linkerd_tpu.streams import StreamSentinel
+            from linkerd_tpu.streams.observer import H2FrameObserver
+            sentinel = StreamSentinel(
+                enter=ss.enter, exit=ss.exit, quorum=ss.quorum,
+                dwell_s=ss.dwellMs / 1000.0, table_cap=ss.tableCap,
+                action=ss.action)
+            skeys = itertools.count(1)
+            self.metrics.scope("rt", label, "streams").gauge(
+                "count", fn=lambda s=sentinel: float(len(s)))
+            self.stream_sentinels.append((label, sentinel))
+
+            def mk_observer(_ss=ss, _sent=sentinel, _sk=skeys):
+                return H2FrameObserver(
+                    _sent, next_skey=lambda: next(_sk),
+                    sample_every_frames=_ss.sampleEveryFrames,
+                    min_gap_ms=_ss.minGapMs, action=_ss.action,
+                    dst_path=rspec.dstPrefix)
         servers = [
             H2Server(per_server_stack(s), s.ip, s.port,
                      max_concurrency=s.maxConcurrentRequests,
                      ssl_context=(s.tls.mk_context() if s.tls else None),
-                     h2_settings=h2_settings)
+                     h2_settings=h2_settings,
+                     stream_observer_factory=mk_observer)
             for s in (rspec.servers or [ServerSpec()])
         ]
         return Router(rspec, label, server_stack, binding, servers,
@@ -946,10 +1032,11 @@ class Linker:
                 f"{label}: admissionControl is only supported on "
                 f"http/h2 routers")
         if rspec.tenantIdentifier is not None or rspec.tenants is not None \
-                or rspec.connectionGuard is not None:
+                or rspec.connectionGuard is not None \
+                or rspec.streamScoring is not None:
             raise ConfigError(
-                f"{label}: tenantIdentifier/tenants/connectionGuard are "
-                f"only supported on http/h2 routers")
+                f"{label}: tenantIdentifier/tenants/connectionGuard/"
+                f"streamScoring are only supported on http/h2 routers")
 
         base_dtab = Dtab.read(rspec.dtab) if rspec.dtab else Dtab.empty()
         prefix = Path.read(rspec.dstPrefix)
@@ -1109,10 +1196,11 @@ class Linker:
                 f"{label}: admissionControl is only supported on "
                 f"http/h2 routers")
         if rspec.tenantIdentifier is not None or rspec.tenants is not None \
-                or rspec.connectionGuard is not None:
+                or rspec.connectionGuard is not None \
+                or rspec.streamScoring is not None:
             raise ConfigError(
-                f"{label}: tenantIdentifier/tenants/connectionGuard are "
-                f"only supported on http/h2 routers")
+                f"{label}: tenantIdentifier/tenants/connectionGuard/"
+                f"streamScoring are only supported on http/h2 routers")
         if rspec.thriftProtocol not in ("binary", "compact"):
             raise ConfigError(
                 f"{label}.thriftProtocol must be binary or compact, "
@@ -1621,6 +1709,16 @@ class Linker:
                     ping_burst=guard.pingBurst,
                     settings_burst=guard.settingsBurst,
                     window_ms=guard.floodWindowMs)
+                if guard.tunnelIdleMs or guard.tunnelMaxBytes:
+                    # h2 carries no byte tunnels (CONNECT/101 are an
+                    # h1 shape); the knobs are inert here
+                    log.warning(
+                        "%s.connectionGuard: tunnelIdleMs/"
+                        "tunnelMaxBytes are ignored on h2 routers",
+                        label)
+            elif guard.tunnelIdleMs or guard.tunnelMaxBytes:
+                engine.set_tunnel_guard(idle_ms=guard.tunnelIdleMs,
+                                        max_bytes=guard.tunnelMaxBytes)
         elif rspec.tenants is not None:
             # no guard block, but the operator DID bound tenant
             # cardinality: the engine table must honor it (defaults
@@ -1637,12 +1735,31 @@ class Linker:
                 rspec, label, tid_spec)
             if tenant_admission is not None:
                 tenant_admission.register_engine(engine)
+        sentinel = None
+        if rspec.streamScoring is not None:
+            ss = rspec.streamScoring
+            ss.validate(f"{label}.streamScoring")
+            engine.set_stream_cfg(
+                enabled=True,
+                sample_every_frames=ss.sampleEveryFrames,
+                min_gap_ms=ss.minGapMs, table_cap=ss.tableCap,
+                enter=ss.enter, exit=ss.exit, quorum=ss.quorum,
+                dwell_ms=ss.dwellMs, action=ss.action)
+            # the native plane actuates in-flight (RST / trailers);
+            # the Python sentinel mirrors the drained sample rows for
+            # the admin view and any drain/quota escalation — observe
+            # mode so sick streams are never shot twice
+            from linkerd_tpu.streams import StreamSentinel
+            sentinel = StreamSentinel(
+                enter=ss.enter, exit=ss.exit, quorum=ss.quorum,
+                dwell_s=ss.dwellMs / 1000.0, table_cap=ss.tableCap,
+                action="observe")
         ports = [engine.listen_tls(s.ip, s.port) if s.tls is not None
                  else engine.listen(s.ip, s.port) for s in specs]
         ctl = FastPathController(
             engine, interpreter, base_dtab, prefix, label, self.metrics,
             telemeters=self.telemeters, tenant_board=tenant_board,
-            tenant_admission=tenant_admission)
+            tenant_admission=tenant_admission, stream_sentinel=sentinel)
         return _FastPathRouter(rspec, label, ctl, ports,
                                interpreter=interpreter)
 

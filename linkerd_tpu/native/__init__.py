@@ -212,11 +212,33 @@ def _declare_scorer(cdll: ctypes.CDLL) -> None:
         fn = getattr(cdll, prefix + "_set_guard")
         fn.restype = ctypes.c_int
         fn.argtypes = [ctypes.c_void_p] + [ctypes.c_long] * 6
+        # stream sentinel: per-stream scoring cadence/hysteresis,
+        # /streams.json snapshot, and the mid-stream RST queue
+        fn = getattr(cdll, prefix + "_set_stream_cfg")
+        fn.restype = ctypes.c_int
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_long, ctypes.c_long,
+                       ctypes.c_long, ctypes.c_long, ctypes.c_double,
+                       ctypes.c_double, ctypes.c_long, ctypes.c_long,
+                       ctypes.c_long]
+        fn = getattr(cdll, prefix + "_streams_json")
+        fn.restype = ctypes.c_long
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+        fn = getattr(cdll, prefix + "_rst_stream")
+        fn.restype = ctypes.c_int
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    cdll.fp_set_tunnel_guard.restype = ctypes.c_int  # h1-only budgets
+    cdll.fp_set_tunnel_guard.argtypes = \
+        [ctypes.c_void_p, ctypes.c_long, ctypes.c_long]
     cdll.fph2_set_flood_guard.restype = ctypes.c_int
     cdll.fph2_set_flood_guard.argtypes = \
         [ctypes.c_void_p] + [ctypes.c_long] * 5
     cdll.l5d_tenant_hash.restype = ctypes.c_uint32
     cdll.l5d_tenant_hash.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    cdll.l5d_stream_accum.restype = ctypes.c_long
+    cdll.l5d_stream_accum.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float), ctypes.c_long,
+        ctypes.POINTER(ctypes.c_float)]
 
 
 def _declare_tls(cdll: ctypes.CDLL, prefix: str) -> None:
@@ -449,10 +471,13 @@ class FastPathEngine:
     unmerged stats shape."""
 
     # engine feature-row width: route_id, latency_ms, status, req_b,
-    # rsp_b, ts_s, score, scored, tenant (score/scored are the
-    # in-data-plane scorer's output; scored == 0.0 rows fall back to
-    # the JAX tier; tenant is the 24-bit-folded tenant hash, 0 = none)
-    FEATURE_DIM = 9
+    # rsp_b, ts_s, score, scored, tenant, kind, stream, frame_seq
+    # (score/scored are the in-data-plane scorer's output; scored ==
+    # 0.0 rows fall back to the JAX tier; tenant is the 24-bit-folded
+    # tenant hash, 0 = none; kind 0 = request, 1 = h2 stream sample,
+    # 2 = tunnel sample; stream is the 24-bit stream key for kind > 0
+    # rows, frame_seq the frame count at sample time)
+    FEATURE_DIM = 12
     _PREFIX = "fp"  # C symbol prefix; the h2 engine overrides to "fph2"
     # ALPN preference list the engine's TLS contexts advertise/offer
     _ALPN = "http/1.1"
@@ -670,6 +695,99 @@ class FastPathEngine:
                     int(max_hs_inflight), int(tenant_cap))
             if rc != 0:
                 raise ValueError("guard config rejected")
+
+    STREAM_ACTIONS = {"observe": 0, "rst": 1}
+
+    def set_stream_cfg(self, enabled: bool = True,
+                       sample_every_frames: int = 8,
+                       min_gap_ms: int = 10, table_cap: int = 4096,
+                       enter: float = 0.8, exit: float = 0.5,
+                       quorum: int = 3, dwell_ms: int = 1000,
+                       action: str = "rst") -> None:
+        """Stream-sentinel knobs (call before start()): per-stream
+        scoring cadence (every N frames, min gap between samples), the
+        bounded stream-table cap, and the native hysteresis governor
+        mirroring control.state.HysteresisGovernor (0 < exit < enter
+        <= 1, quorum consecutive samples, dwell after a transition).
+        ``action`` "rst" sheds a sick stream in-engine (h2: RST_STREAM
+        / gRPC UNAVAILABLE trailers; h1: tunnel close); "observe" only
+        records transitions."""
+        assert not self._started
+        a = self.STREAM_ACTIONS.get(action)
+        if a is None:
+            raise ValueError(f"unknown stream action {action!r}")
+        fn = getattr(self._lib, self._PREFIX + "_set_stream_cfg")
+        for h in self._es:
+            rc = fn(h, 1 if enabled else 0, int(sample_every_frames),
+                    int(min_gap_ms), int(table_cap), float(enter),
+                    float(exit), int(quorum), int(dwell_ms), a)
+            if rc != 0:
+                raise ValueError("stream config rejected")
+
+    def streams(self) -> dict:
+        """Stream-table snapshot (/streams.json shape). Multi-worker
+        engines carry per-worker snapshots under ``workers`` — stream
+        keys are per-worker sequences, so by_stream maps must not be
+        merged across workers — with engine-wide counters summed."""
+        import json
+        fn = getattr(self._lib, self._PREFIX + "_streams_json")
+
+        def one(h) -> dict:
+            for _ in range(6):
+                n = fn(h, self._stats_buf, len(self._stats_buf))
+                if n == -2:
+                    if len(self._stats_buf) >= 64 << 20:
+                        return {}
+                    self._stats_buf = ctypes.create_string_buffer(
+                        len(self._stats_buf) * 4)
+                    continue
+                if n < 0:
+                    return {}
+                return json.loads(self._stats_buf.value.decode("latin-1"))
+            return {}
+
+        if self.workers == 1:
+            return one(self._e)
+        snaps = [one(h) for h in self._es]
+        out: dict = {"enabled": any(s.get("enabled") for s in snaps)}
+        for k in ("count", "evicted", "sick_transitions", "rst_sent",
+                  "tunnels_opened", "tunnel_idle_closed",
+                  "tunnel_bytes_closed"):
+            out[k] = sum(int(s.get(k, 0)) for s in snaps)
+        out["workers"] = snaps
+        return out
+
+    def rst_stream(self, skey: int, worker: Optional[int] = None) -> None:
+        """Queue a mid-stream shed by 24-bit stream key (the ``stream``
+        column of kind > 0 feature rows): the engine's loop thread
+        RSTs the h2 stream (gRPC UNAVAILABLE trailers when possible)
+        or closes the tunnel. Keys are per-worker sequences — pass
+        ``worker`` when the engine is sharded; a broadcast would shed
+        whatever stream holds that key on EVERY worker."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        fn = getattr(self._lib, self._PREFIX + "_rst_stream")
+        handles = self._es if worker is None \
+            else [self._es[int(worker)]]
+        for h in handles:
+            fn(h, int(skey) & 0xFFFFFF)
+
+    def set_tunnel_guard(self, idle_ms: int = 0,
+                         max_bytes: int = 0) -> None:
+        """Byte-tunnel budgets (h1 engine only; call before start()):
+        zero-activity window and lifetime byte cap for CONNECT /
+        101-upgrade tunnels. 0 disables the individual cap. Enforced
+        even when stream scoring is off — these are connection-plane
+        defenses like the slowloris budgets."""
+        assert not self._started
+        if self._PREFIX != "fp":
+            raise RuntimeError(
+                "tunnel budgets are an h1-engine knob (h2 streams are "
+                "bounded by the flood guard and response timeout)")
+        for h in self._es:
+            if self._lib.fp_set_tunnel_guard(h, int(idle_ms),
+                                             int(max_bytes)) != 0:
+                raise ValueError("tunnel guard config rejected")
 
     def set_route_feature(self, host: str, col: int, sign: float) -> bool:
         """Install the dst-path feature-hash (column, sign) for a route
@@ -941,6 +1059,33 @@ def tenant_hash_native(tenant_id: bytes) -> Optional[int]:
     return int(cdll.l5d_tenant_hash(tenant_id, len(tenant_id)))
 
 
+def stream_accum(kinds, gaps_ms, sizes):
+    """Drive the engines' per-frame stream accumulator
+    (l5dstream::accum_frame) over a whole frame trace — the parity
+    surface for linkerd_tpu.streams.tracker.StreamTracker, which must
+    reproduce the float32 EWMA arithmetic bit-for-bit. ``kinds`` are
+    ints (0 DATA / 1 WINDOW_UPDATE / 2 anomaly), ``gaps_ms``/``sizes``
+    per-frame floats. Returns f32 [9]: [gap_ewma_ms, gap_dev_ms,
+    bpf_ewma, bpf_dev, frames, data_frames, wu_frames, anomalies,
+    bytes]; None = native unavailable; ValueError on a bad kind."""
+    import numpy as np
+    cdll = lib()
+    if cdll is None:
+        return None
+    k = np.ascontiguousarray(kinds, np.int32)
+    g = np.ascontiguousarray(gaps_ms, np.float32)
+    s = np.ascontiguousarray(sizes, np.float32)
+    if not (len(k) == len(g) == len(s)):
+        raise ValueError("kinds/gaps/sizes length mismatch")
+    out = np.zeros(9, np.float32)
+    rc = cdll.l5d_stream_accum(
+        k.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        _as_f32_ptr(g), _as_f32_ptr(s), len(k), _as_f32_ptr(out))
+    if rc != 0:
+        raise ValueError("bad frame kind in trace")
+    return out
+
+
 # -- in-data-plane scorer (engine-independent surface) ------------------------
 
 
@@ -988,7 +1133,7 @@ def score_eval(blob: bytes, x) -> Optional["object"]:
 
 def score_eval_raw(blob: bytes, rows, cols, signs, drifts,
                    return_features: bool = False):
-    """Score RAW engine rows (f32 [n, 9] FeatureRow layout) through the
+    """Score RAW engine rows (f32 [n, 12] FeatureRow layout) through the
     in-engine featurizer, with per-row dst-hash (cols/signs) and
     pre-update drift supplied by the caller — the parity surface for the
     C featurizer. Returns scores [n] (and features [n, FEATURE_DIM]
@@ -1156,7 +1301,7 @@ class ScoreSlab:
         """Score featurized f32 [n, FEATURE_DIM] rows; None while no
         weights are published. Rejects wrong-width input up front — the
         C side strides by FEATURE_DIM unchecked (an engine-row-shaped
-        [n, 9] array would read out of bounds)."""
+        [n, 12] array would read out of bounds)."""
         import numpy as np
         s = self._handle()
         x = np.ascontiguousarray(x, np.float32)

@@ -8,8 +8,9 @@ connection, re-established on failure.
 from __future__ import annotations
 
 import asyncio
-from typing import Optional
+from typing import List, Optional
 
+from linkerd_tpu.core.tasks import spawn
 from linkerd_tpu.protocol.h2.connection import H2Connection
 from linkerd_tpu.protocol.h2.messages import H2Request, H2Response
 from linkerd_tpu.router.service import Service, Status
@@ -32,6 +33,9 @@ class H2Client(Service[H2Request, H2Response]):
         self._h2_settings = dict(h2_settings or {})
         self._conn: Optional[H2Connection] = None
         self._connecting: Optional[asyncio.Future] = None
+        # GOAWAY drain: replaced connections park here until their
+        # in-flight streams (at/below the peer's last_stream_id) finish
+        self._draining: List[H2Connection] = []
         self._closed = False
         self.pending = 0  # live balancer instrumentation
 
@@ -39,10 +43,36 @@ class H2Client(Service[H2Request, H2Response]):
     def status(self) -> Status:
         return Status.CLOSED if self._closed else Status.OPEN
 
+    def _retire(self, conn: H2Connection) -> None:
+        """Park a GOAWAY'd/closed conn for drain instead of leaking it.
+
+        The engine already failed only streams above last_stream_id; the
+        rest finish on the old socket while new requests ride a fresh
+        conn. A watcher closes the parked conn once it empties (GOAWAY
+        drain — not abort — per the reference's SingletonPool rebuild)."""
+        self._draining.append(conn)
+
+        async def _watch() -> None:
+            try:
+                while conn.active_streams and not conn.is_closed:
+                    await asyncio.sleep(0.05)
+                await conn.close()
+            finally:
+                if conn in self._draining:
+                    self._draining.remove(conn)
+
+        spawn(_watch(), what="h2-client-goaway-drain")
+
     async def _get_conn(self) -> H2Connection:
-        if self._conn is not None and not self._conn.is_closed \
-                and not self._conn.goaway_received:  # l5d: ignore[await-atomicity] — singleton dedup: concurrent connects serialize on _connecting, and the _closed re-check below covers the only concurrent writer (close)
-            return self._conn
+        cur = self._conn  # l5d: ignore[await-atomicity] — singleton dedup: concurrent connects serialize on _connecting, and the _closed re-check below covers the only concurrent writer (close)
+        if cur is not None and not cur.is_closed \
+                and not cur.goaway_received:
+            return cur
+        if cur is not None:
+            # GOAWAY'd/dead singleton: retire it for drain (synchronous
+            # pop — no await between the read above and here)
+            self._conn = None
+            self._retire(cur)
         if self._connecting is not None:
             return await asyncio.shield(self._connecting)
         loop = asyncio.get_running_loop()
@@ -107,4 +137,7 @@ class H2Client(Service[H2Request, H2Response]):
         # closes its own socket), not re-cache over our teardown
         conn, self._conn = self._conn, None
         if conn is not None:
+            await conn.close()
+        draining, self._draining = self._draining, []
+        for conn in draining:
             await conn.close()

@@ -79,11 +79,17 @@ class H2Connection:
                  max_header_list: int = MAX_HEADER_LIST,
                  max_concurrent_streams: Optional[int] = None,
                  preface_consumed: bool = False,
-                 initial_data: bytes = b""):
+                 initial_data: bytes = b"",
+                 observer=None):
         self._reader = reader
         self._writer = writer
         self.is_client = is_client
         self._handler = handler
+        # stream sentinel (server side): an H2FrameObserver fed every
+        # DATA / WINDOW_UPDATE / RST so long-lived streams are scored
+        # mid-flight (linkerd_tpu/streams); None = no stream scoring
+        self._observer = observer.bind(self) if observer is not None \
+            else None
         # server side: the listener already consumed the client preface
         # while sniffing prior-knowledge h2c vs an h1 Upgrade
         # (ref: ServerUpgradeHandler.scala channelRead); bytes it
@@ -213,6 +219,8 @@ class H2Connection:
             except Exception as e:  # noqa: BLE001 — already closing, but
                 log.debug("h2 read loop exit on close: %r", e)  # be loud-ish
         self._fail_all(StreamReset(frames.CANCEL, "connection closed"))
+        if self._observer is not None:
+            self._observer.close()
         for t in list(self._handler_tasks):
             t.cancel()
         # Always close the transport, even if the read loop already marked
@@ -398,6 +406,24 @@ class H2Connection:
                 pass
         st.recv_stream.reset(code)
         self._streams.pop(st.id, None)
+        if self._observer is not None:
+            self._observer.on_close(st.id)
+
+    def shed_stream(self, sid: int,
+                    code: int = frames.ENHANCE_YOUR_CALM) -> bool:
+        """Mid-stream actuation entry point (stream sentinel): RST a
+        live stream without touching the connection. Returns False when
+        the stream is already gone."""
+        st = self._streams.get(sid)
+        if st is None or self._closed:
+            return False
+        if st.pump_task is not None:
+            st.pump_task.cancel()
+        if st.response_fut is not None and not st.response_fut.done():
+            st.response_fut.set_exception(
+                StreamReset(code, "stream shed"))
+        self._rst(st, code)
+        return True
 
     async def _notify_windows(self) -> None:
         async with self._window_cond:
@@ -532,10 +558,18 @@ class H2Connection:
                 st = self._streams.get(fh.stream_id)
                 if st is not None:
                     st.send_window += inc
+                    if self._observer is not None:
+                        self._observer.on_frame(
+                            st.id, 1, 0)  # FRAME_WINDOW_UPDATE
             await self._notify_windows()
         elif t == frames.RST_STREAM:
             code = int.from_bytes(payload[:4], "big")
             st = self._streams.pop(fh.stream_id, None)
+            if st is not None and self._observer is not None:
+                # a peer reset is the anomaly signal itself; fold it in
+                # before the slot is retired
+                self._observer.on_frame(st.id, 2, 0)  # FRAME_ANOMALY
+                self._observer.on_close(st.id)
             if st is not None:
                 st.reset_sent = True  # no further sends on this stream
                 st.recv_stream.reset(code, f"peer RST ({code:#x})")
@@ -579,8 +613,21 @@ class H2Connection:
             return
         st.recv_window -= flow
         if st.recv_window < 0 or self._recv_window < 0:
+            if self._observer is not None:
+                # flow-control violation is a stream anomaly (the
+                # feature the sentinel keys hostile senders on)
+                self._observer.on_frame(st.id, 2, 0)  # FRAME_ANOMALY
             raise H2ProtocolError(frames.FLOW_CONTROL_ERROR,
                                   "peer overran window")
+        if self._observer is not None:
+            self._observer.on_frame(st.id, 0, flow)  # FRAME_DATA
+            if st.id not in self._streams:
+                # the sentinel shed this stream mid-sample: return the
+                # connection credit this frame consumed (it will never
+                # be offered, so release() can't) and stop delivering
+                if flow:
+                    self._conn_credit(flow)
+                return
         sid = st.id
 
         def credit(n: int, _sid: int = sid) -> None:
@@ -724,6 +771,8 @@ class H2Connection:
         if st.recv_closed and st.send_closed:
             self._streams.pop(st.id, None)
             self._wake_slot()
+            if self._observer is not None:
+                self._observer.on_close(st.id)
 
     def _wake_slot(self) -> None:
         while self._slot_waiters:

@@ -27,8 +27,12 @@ class H2Server:
                  host: str = "127.0.0.1", port: int = 0,
                  ssl_context=None,
                  max_concurrency: Optional[int] = None,
-                 h2_settings: Optional[dict] = None):
+                 h2_settings: Optional[dict] = None,
+                 stream_observer_factory=None):
         self.service = service
+        # stream sentinel (streamScoring): one fresh H2FrameObserver
+        # per accepted connection, sharing the router's sentinel
+        self._mk_observer = stream_observer_factory
         self.host = host
         self.port = port
         if ssl_context is not None:
@@ -114,7 +118,9 @@ class H2Server:
                             **self._h2_settings,
                             handler=handler,
                             preface_consumed=True,
-                            initial_data=surplus)
+                            initial_data=surplus,
+                            observer=(self._mk_observer()
+                                      if self._mk_observer else None))
         self._conns.add(conn)
         try:
             if upgraded is not None:

@@ -129,6 +129,16 @@ class HttpClient(Service[Request, Response]):
             self.pending -= 1
             raise
         self.pending -= 1
+        if rsp.status == 101 or (req.method == "CONNECT"
+                                 and 200 <= rsp.status < 300):
+            # protocol switch: the connection IS the tunnel now. Hand
+            # the raw streams to the server edge for byte relay; the
+            # conn never returns to the pool (tunnel_done releases its
+            # slot when the relay ends).
+            rsp.ctx["tunnel"] = (conn.reader, conn.writer)
+            rsp.ctx["tunnel_done"] = lambda: self._checkin(
+                conn, reusable=False)
+            return rsp
         reusable = (
             (rsp.headers.get("connection") or "").lower() != "close"
             and (req.headers.get("connection") or "").lower() != "close"

@@ -249,7 +249,11 @@ async def read_response(reader: asyncio.StreamReader, request_method: str = "GET
         raise HttpCodecError(f"bad status: {parts[1]!r}") from None
     reason = parts[2] if len(parts) > 2 else ""
     headers = await _read_headers(reader)
-    if request_method == "HEAD" or status in (204, 304) or 100 <= status < 200:
+    if request_method == "HEAD" or status in (204, 304) \
+            or 100 <= status < 200 \
+            or (request_method == "CONNECT" and 200 <= status < 300):
+        # a 2xx to CONNECT switches to tunnel mode: what follows the
+        # header block is tunnel payload, never a response body
         body = b""
     else:
         framing = _body_framing(headers)

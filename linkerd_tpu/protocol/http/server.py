@@ -122,6 +122,13 @@ class HttpServer:
                 else:
                     rsp = await self._dispatch(req)
 
+                if rsp.ctx.get("tunnel") is not None:
+                    # protocol switch (101 Upgrade / CONNECT 2xx): relay
+                    # raw bytes between client and upstream; terminal
+                    # for this connection either way
+                    await self._serve_tunnel(req, rsp, reader, writer)
+                    return
+
                 conn_close = (
                     (req.headers.get("connection") or "").lower() == "close"
                     or req.version == "HTTP/1.0"
@@ -159,6 +166,65 @@ class HttpServer:
                 writer.close()
             except (OSError, RuntimeError):  # transport already detached
                 pass
+
+    async def _serve_tunnel(self, req: Request, rsp: Response,
+                            reader: asyncio.StreamReader,
+                            writer: asyncio.StreamWriter) -> None:
+        """Byte-relay a switched connection (WebSocket 101 / CONNECT).
+
+        ``rsp.ctx["tunnel"]`` holds the upstream (reader, writer) pair the
+        HttpClient handed over instead of pooling; ``tunnel_done`` releases
+        its pool slot when the relay ends. The relay runs until either side
+        hits EOF or errors; first exit tears down both directions."""
+        up_reader, up_writer = rsp.ctx["tunnel"]
+        done = rsp.ctx.get("tunnel_done")
+        conn_tokens = {t.strip().lower()
+                       for t in (req.headers.get("connection") or "").split(",")
+                       if t.strip()}
+        legit = (rsp.status == 101 and "upgrade" in conn_tokens) or (
+            req.method == "CONNECT" and 200 <= rsp.status < 300)
+        try:
+            if not legit:
+                # a switch the client never asked for (stray 101) is a
+                # protocol violation upstream: surface a gateway error
+                # rather than relaying bytes the client can't frame
+                codec.write_response(writer, Response(
+                    status=502, body=b"unsolicited protocol switch"))
+                await writer.drain()
+                return
+            codec.write_response(writer, rsp)
+            await writer.drain()
+
+            async def pump(src: asyncio.StreamReader,
+                           dst: asyncio.StreamWriter) -> None:
+                while True:
+                    chunk = await src.read(65536)
+                    if not chunk:
+                        break
+                    dst.write(chunk)
+                    await dst.drain()
+
+            up = asyncio.ensure_future(pump(reader, up_writer))
+            down = asyncio.ensure_future(pump(up_reader, writer))
+            try:
+                await asyncio.wait({up, down},
+                                   return_when=asyncio.FIRST_COMPLETED)
+            finally:
+                for t in (up, down):
+                    t.cancel()
+                for t in (up, down):
+                    try:
+                        await t
+                    except (asyncio.CancelledError, ConnectionError,
+                            OSError):
+                        pass
+        finally:
+            try:
+                up_writer.close()
+            except (OSError, RuntimeError):  # transport already detached
+                pass
+            if done is not None:
+                done()
 
     def _maybe_compress(self, req: Request, rsp: Response) -> None:
         """gzip the response in place when the configured level, the

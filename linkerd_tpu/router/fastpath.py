@@ -121,7 +121,7 @@ class FastPathController:
                  label: str, metrics, telemeters=(),
                  miss_poll_s: float = 0.01, stats_poll_s: float = 1.0,
                  max_hosts: int = 10_000, tenant_board=None,
-                 tenant_admission=None):
+                 tenant_admission=None, stream_sentinel=None):
         self.engine = engine
         self.interpreter = interpreter
         self.dtab = base_dtab
@@ -152,6 +152,13 @@ class FastPathController:
         self.tenant_admission = tenant_admission
         self._last_tenants: Dict[str, Dict[str, float]] = {}
         self._last_guard: Dict[str, int] = {}
+        # stream sentinel: the Python-side mirror of the engines'
+        # in-plane stream governor. Stream/tunnel sample rows (row kind
+        # > 0) drained off the feature ring feed it, keeping the
+        # Python table — and any drain/quota escalation the native
+        # plane delegates up — in sync with what the engines shed.
+        self.stream_sentinel = stream_sentinel
+        self._last_streams: Dict[str, int] = {}
         # metrics-tree cardinality bound: the engine's tenant table is
         # LRU-bounded, but the metrics tree never forgets a scope —
         # under tenant-id churn each stats tick would otherwise mint
@@ -325,6 +332,52 @@ class FastPathController:
         if self.tenant_admission is not None:
             self.tenant_admission.step()
 
+    _STREAM_KEYS = ("evicted", "sick_transitions", "rst_sent",
+                    "tunnels_opened", "tunnel_idle_closed",
+                    "tunnel_bytes_closed")
+
+    def _export_streams(self) -> None:
+        """Engine stream-table counters → rt/*/fastpath/streams/*: the
+        live proof the stream sentinel is sampling (count gauge) and
+        actuating (rst_sent / tunnel-budget closes as counters)."""
+        fn = getattr(self.engine, "streams", None)
+        if fn is None:
+            return  # stub engine (tests) or pre-stream native lib
+        try:
+            snap = fn()
+        except Exception:  # noqa: BLE001 — scrape failure must not
+            log.exception("fastpath streams scrape failed")  # kill loop
+            return
+        if not snap or not snap.get("enabled"):
+            return
+        scope = self._scope.scope("streams")
+        scope.gauge("count").set(float(snap.get("count", 0)))
+        prev = self._last_streams
+        for key in self._STREAM_KEYS:
+            delta = int(snap.get(key, 0)) - int(prev.get(key, 0))
+            if delta > 0:
+                scope.counter(key).incr(delta)
+        self._last_streams = {k: int(snap.get(k, 0))
+                              for k in self._STREAM_KEYS}
+
+    def streams_snapshot(self) -> dict:
+        """/streams.json body: the engine's in-plane stream table plus
+        (when wired) the Python sentinel's view, under one document."""
+        out: dict = {"enabled": False}
+        fn = getattr(self.engine, "streams", None)
+        if fn is not None:
+            try:
+                eng = fn()
+            except Exception:  # noqa: BLE001
+                eng = {"error": "stream scrape failed"}
+            out["engine"] = eng
+            out["enabled"] = bool(eng.get("enabled")) \
+                if isinstance(eng, dict) else False
+        if self.stream_sentinel is not None:
+            out["sentinel"] = self.stream_sentinel.snapshot()
+            out["enabled"] = True
+        return out
+
     _WORKER_KEYS = ("requests", "accepted", "scored", "unscored",
                     "features_dropped")
 
@@ -367,6 +420,7 @@ class FastPathController:
         snap = self.engine.stats()
         self._export_tenants(snap)
         self._export_workers(snap)
+        self._export_streams()
         tls = snap.get("tls")
         if tls and (tls.get("enabled") or tls.get("client_enabled")):
             scope = self._scope.scope("tls")
@@ -438,13 +492,21 @@ class FastPathController:
             elif getattr(t, "ring", None) is not None \
                     and hasattr(t, "board"):
                 legacy_rings.append(t.ring)
+        from linkerd_tpu.telemetry.linerate import NATIVE_COL_KIND
         if not sinks:
             # no native consumer: the legacy per-row path drains the
-            # engine itself
+            # engine itself. Stream/tunnel sample rows go to the
+            # sentinel, not the request-shaped FeatureVector feed.
+            stream_rows = []
             for row in self.engine.drain_features():
+                if len(row) > NATIVE_COL_KIND and row[NATIVE_COL_KIND] > 0.5:
+                    stream_rows.append(row)
+                    continue
                 fv = self._legacy_fv(row)
                 for ring in legacy_rings:
                     ring.append((fv, None))
+            if stream_rows and self.stream_sentinel is not None:
+                self.stream_sentinel.ingest_rows(stream_rows)
             return
         primary, extras = sinks[0], sinks[1:]
         for t in sinks:
@@ -502,11 +564,24 @@ class FastPathController:
                 t.native_ring.drop(short)
             if copied or short:
                 t.native_committed(copied, dropped=short)
+        # stream/tunnel sample rows also feed the Python sentinel (the
+        # ring consumers route on the kind column themselves; the
+        # sentinel needs its own look for drain/quota escalation)
+        if self.stream_sentinel is not None:
+            for block in drained_views:
+                if block.shape[1] > NATIVE_COL_KIND:
+                    srows = block[block[:, NATIVE_COL_KIND] > 0.5]
+                    if len(srows):
+                        self.stream_sentinel.ingest_rows(srows)
         # legacy telemeters consume the SAME drained block (the engine
-        # was already emptied above)
+        # was already emptied above); stream rows stay out of the
+        # request-shaped FeatureVector feed
         if legacy_rings:
             for block in drained_views:
                 for row in block:
+                    if (len(row) > NATIVE_COL_KIND
+                            and row[NATIVE_COL_KIND] > 0.5):
+                        continue
                     fv = self._legacy_fv(row)
                     for r in legacy_rings:
                         r.append((fv, None))

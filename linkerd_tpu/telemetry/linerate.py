@@ -279,16 +279,30 @@ class RingDispatcher:
 
 
 # engine row: route_id, lat_ms, status, req_b, rsp_b, ts, score,
-# scored, tenant. score/scored are the in-data-plane scorer's output
-# (native/scorer.h): scored == 1.0 rows arrive pre-scored from the
-# engine; 0.0 rows (no weight blob published, route hash not pushed
-# yet, nativeTier: off) fall back to the JAX tier in the micro-batcher.
-# tenant is the 24-bit-folded FNV-1a tenant hash (0 = no tenant) the
-# engine extracted per its tenantIdentifier config.
-NATIVE_ROW_WIDTH = 9
+# scored, tenant, kind, stream, frame_seq. score/scored are the
+# in-data-plane scorer's output (native/scorer.h): scored == 1.0 rows
+# arrive pre-scored from the engine; 0.0 rows (no weight blob
+# published, route hash not pushed yet, nativeTier: off) fall back to
+# the JAX tier in the micro-batcher. tenant is the 24-bit-folded
+# FNV-1a tenant hash (0 = no tenant) the engine extracted per its
+# tenantIdentifier config. kind (native/stream_track.h row kinds) is
+# 0 for request rows, 1 for h2 stream samples, 2 for tunnel samples;
+# kind > 0 rows carry the 24-bit stream-lifetime key in `stream` and
+# the frame count at sample time in `frame_seq`, and repeat per
+# stream — the training path must keep them out of request-shaped
+# aggregation (the micro-batcher routes them to the stream sentinel).
+NATIVE_ROW_WIDTH = 12
 NATIVE_COL_SCORE = 6
 NATIVE_COL_SCORED = 7
 NATIVE_COL_TENANT = 8
+NATIVE_COL_KIND = 9
+NATIVE_COL_STREAM = 10
+NATIVE_COL_SEQ = 11
+
+# row kinds (mirror native/stream_track.h)
+NATIVE_KIND_REQUEST = 0.0
+NATIVE_KIND_STREAM = 1.0
+NATIVE_KIND_TUNNEL = 2.0
 
 
 class NativeFeatureRing:

@@ -46,6 +46,7 @@
 #include <vector>
 
 #include "scorer.h"
+#include "stream_track.h"
 #include "tenant_guard.h"
 #include "tls_engine.h"
 
@@ -122,6 +123,10 @@ struct FeatureRow {
     float score, scored;
     // tenant hash folded to 24 bits (f32-integer-exact); 0 = no tenant
     float tenant;
+    // stream-lifetime key: kind (0 request / 2 tunnel sample), 24-bit
+    // stream key (0 = not a stream row), frame seq at sample time —
+    // tunnel rows repeat the same key with a growing frame_seq
+    float kind, stream, frame_seq;
 };
 
 enum class BodyKind { NONE, LENGTH, CHUNKED, EOF_DELIM };
@@ -363,10 +368,19 @@ struct Engine {
     l5dtg::TenantExtract tenant_ex;
     l5dtg::GuardCfg guard_cfg;
     l5dtg::GuardStats guard;
+    // tunnel sentinel: cfg installed BEFORE fp_start (loop reads it
+    // unlocked, like guard_cfg); the table and the pending-close queue
+    // (Python-side actuation) are guarded by mu
+    l5dstream::StreamCfg stream_cfg;
+    l5dstream::StreamTable stream_tab;
+    std::vector<uint32_t> pending_rst;
 
     // loop-thread-only state
     std::unordered_map<int, Conn*> conns;
     std::vector<int> listeners;
+    // loop-thread-only tunnel-key index (Python closes by key)
+    std::unordered_map<uint32_t, Conn*> by_skey;
+    uint32_t next_skey = 1;
     std::unordered_map<std::string, std::vector<Conn*>> parked;
     // TLS: contexts are installed from Python BEFORE fp_start (the
     // wrapper asserts), so the loop thread reads them without locking;
@@ -395,11 +409,23 @@ struct Engine {
 struct Conn {
     enum class Kind { CLIENT, UPSTREAM };
     enum class St {
-        READ_HEAD, WAIT_ROUTE, FORWARD_BODY, READ_RSP, IDLE, CLOSED,
+        READ_HEAD, WAIT_ROUTE, FORWARD_BODY, READ_RSP, TUNNEL, IDLE,
+        CLOSED,
     };
     Kind kind = Kind::CLIENT;
     St st = St::READ_HEAD;
     int fd = -1;
+    // byte-tunnel sentinel state (client conns; set at tunnel entry):
+    // per-read feature accumulation, native hysteresis, the 24-bit
+    // stream key tunnel feature rows carry, and the specialist head
+    // pinned when the tunnel's route dispatched
+    l5dstream::StreamAccum acc;
+    l5dstream::StreamGov gov;
+    uint32_t skey = 0;  // 0 = not a tracked tunnel
+    uint32_t srhash = 0;
+    uint64_t last_frame_us = 0;
+    uint64_t tunnel_bytes = 0;
+    bool upgrade_req = false;  // request carried Connection: upgrade
     std::string in;
     std::string out;
     std::string req_stash;  // staged request bytes while routing/connecting
@@ -486,14 +512,17 @@ void maybe_pause_producer(Engine* e, Conn* consumer) {
 
 void push_feature(Engine* e, uint64_t route_id, uint64_t lat_us, int status,
                   uint64_t req_b, uint64_t rsp_b, float score, int scored,
-                  int specialist, uint64_t score_ns, uint32_t tenant) {
+                  int specialist, uint64_t score_ns, uint32_t tenant,
+                  int kind = l5dstream::ROW_REQUEST, uint32_t skey = 0,
+                  uint32_t fseq = 0) {
     std::lock_guard<std::mutex> g(e->mu);
     if (scored)
         e->score_stats.record(score_ns, specialist != 0);
     else
         e->score_stats.unscored++;
     // per-tenant aggregates ride the same mu hold as the feature push
-    if (tenant)
+    // (request rows only — a tunnel's tenant slot settles at close)
+    if (tenant && kind == l5dstream::ROW_REQUEST)
         e->tenants.observe(tenant, status, score, scored != 0, now_us());
     if (e->features.size() >= e->features_cap) {
         e->features_dropped++;
@@ -509,6 +538,9 @@ void push_feature(Engine* e, uint64_t route_id, uint64_t lat_us, int status,
     r.score = score;
     r.scored = scored ? 1.0f : 0.0f;
     r.tenant = l5dtg::tenant_feature(tenant);
+    r.kind = (float)kind;
+    r.stream = (float)skey;
+    r.frame_seq = (float)fseq;
     e->features.push_back(r);
 }
 
@@ -704,6 +736,13 @@ void conn_close(Engine* e, Conn* c) {
     bool was_wait_route = (c->st == Conn::St::WAIT_ROUTE);
     c->st = Conn::St::CLOSED;
     tenant_release(e, c);
+    if (c->skey != 0) {
+        e->by_skey.erase(c->skey);
+        std::lock_guard<std::mutex> g(e->mu);
+        l5dstream::StreamStats* ss = e->stream_tab.peek(c->skey);
+        if (ss != nullptr && ss->inflight > 0) ss->inflight--;
+        c->skey = 0;
+    }
     if (c->hs_pending) {
         c->hs_pending = false;
         if (e->hs_inflight > 0) e->hs_inflight--;
@@ -903,13 +942,23 @@ bool try_start_request(Engine* e, Conn* client) {
     }
     const std::string* host = get_header(h, "host");
     std::string key = host ? *host : "";
+    // CONNECT carries the target in authority-form (host:port); fall
+    // back to it when no Host header rode along
+    if (key.empty() && h.method == "CONNECT") key = h.uri;
     size_t colon = key.find(':');
     if (colon != std::string::npos) key.resize(colon);
     lower(key);
 
     const std::string* conn_hdr = get_header(h, "connection");
-    bool close_req = conn_hdr != nullptr &&
-        conn_hdr->find("close") != std::string::npos;
+    bool close_req = false;
+    bool upgrade_req = false;
+    if (conn_hdr != nullptr) {
+        std::string cv = *conn_hdr;
+        lower(cv);
+        close_req = cv.find("close") != std::string::npos;
+        upgrade_req = cv.find("upgrade") != std::string::npos;
+    }
+    client->upgrade_req = upgrade_req;
 
     client->req_method = h.method;
     client->req_body = bt;
@@ -1111,6 +1160,164 @@ void finish_exchange(Engine* e, Conn* up, bool upstream_reusable) {
     process_client_buffer(e, client);
 }
 
+// ---- stream sentinel: byte tunnels ----------------------------------------
+// A 101 upgrade (WebSocket) or CONNECT answer switches the client/
+// upstream pair into TUNNEL: bytes relay opaquely, but every read is a
+// "frame" for the client conn's StreamAccum, sampled on the configured
+// cadence through the same scorer slab as request rows. A sick tunnel
+// (native hysteresis: enter/exit, quorum, dwell) is closed outright —
+// there is no stream-level RST in h1, the conn IS the stream.
+
+// Score one tunnel sample; returns +1 on a healthy->sick transition.
+int tunnel_sample(Engine* e, Conn* c, uint64_t now) {
+    c->gov.last_sample_frames = c->acc.frames;
+    c->gov.last_sample_us = now;
+    float score = 0.0f;
+    int scored = 0, specialist = 0;
+    uint64_t score_ns = 0;
+    if (l5dscore::slab_has_weights(e->slab)) {
+        float feats[l5dscore::FEATURE_DIM];
+        l5dscore::featurize_stream(c->acc.gap_ewma_ms, c->acc.bpf_ewma,
+                                   (float)c->acc.bytes, c->acc.gap_dev_ms,
+                                   c->acc.anomalies, -1, 0.0f, feats);
+        const uint64_t t0 = l5dscore::now_ns();
+        // specialist head pinned at tunnel entry: srhash frozen so one
+        // stream is judged by one model for its whole life
+        const int rc = l5dscore::slab_score_route(
+            e->slab, c->srhash, c->srhash != 0, feats, &score);
+        if (rc >= 0) {
+            scored = 1;
+            specialist = rc;
+            score_ns = l5dscore::now_ns() - t0;
+        }
+    }
+    int trans = scored
+        ? l5dstream::gov_observe(e->stream_cfg, &c->gov, score, now) : 0;
+    push_feature(e, c->route_id,
+                 (uint64_t)(c->acc.gap_ewma_ms * 1000.0f),
+                 c->gov.sick ? 503 : 0, c->tunnel_bytes, c->acc.bytes,
+                 score, scored, specialist, score_ns, c->tenant,
+                 l5dstream::ROW_TUNNEL, c->skey, c->acc.frames);
+    {
+        std::lock_guard<std::mutex> g(e->mu);
+        e->stream_tab.observe(c->skey, l5dstream::ROW_TUNNEL, score,
+                              scored != 0, c->acc, c->gov.sick, now);
+        if (trans > 0) e->stream_tab.sick_transitions++;
+    }
+    return trans;
+}
+
+// Account one tunnel read (either direction) against the client conn's
+// accumulator; enforces the byte cap, samples on cadence, and sheds the
+// tunnel on a sick transition. Returns false if the conn was freed
+// (the close cascades to the upstream leg via conn_close).
+bool tunnel_note(Engine* e, Conn* c, float bytes) {
+    uint64_t now = now_us();
+    float gap_ms = c->last_frame_us == 0
+        ? 0.0f : (float)(now - c->last_frame_us) / 1000.0f;
+    c->last_frame_us = now;
+    l5dstream::accum_frame(&c->acc, l5dstream::FRAME_DATA, gap_ms, bytes);
+    c->tunnel_bytes += (uint64_t)bytes;
+    if (e->stream_cfg.tunnel_max_bytes != 0 &&
+        c->tunnel_bytes > e->stream_cfg.tunnel_max_bytes) {
+        {
+            std::lock_guard<std::mutex> g(e->mu);
+            e->stream_tab.tunnel_bytes_closed++;
+        }
+        conn_close(e, c);
+        return false;
+    }
+    if (c->skey == 0) return true;  // scoring disabled at tunnel entry
+    if (l5dstream::sample_due(e->stream_cfg, c->acc, c->gov, now)) {
+        int trans = tunnel_sample(e, c, now);
+        if (trans > 0 && e->stream_cfg.action != 0) {
+            {
+                std::lock_guard<std::mutex> g(e->mu);
+                e->stream_tab.rst_sent++;
+            }
+            conn_close(e, c);
+            return false;
+        }
+    }
+    return true;
+}
+
+// Switch a paired client/upstream into byte-tunnel mode and relay any
+// bytes already buffered on either side. Returns false if a conn was
+// freed mid-entry.
+bool enter_tunnel(Engine* e, Conn* client, Conn* up) {
+    client->st = Conn::St::TUNNEL;
+    up->st = Conn::St::TUNNEL;
+    client->deadline_us = 0;
+    up->deadline_us = 0;  // tunnels outlive the exchange timeout
+    client->body_progress_us = 0;
+    client->hdr_start_us = 0;
+    client->close_after = true;  // a tunneled conn never re-enters h1
+    uint64_t now = now_us();
+    client->last_frame_us = now;
+    client->tunnel_bytes = 0;
+    if (e->stream_cfg.enabled) {
+        uint32_t k = 0;
+        for (int tries = 0; tries < 4 && k == 0; tries++) {
+            uint32_t cand = l5dstream::fold_key(e->next_skey++);
+            if (e->by_skey.count(cand) == 0) k = cand;
+        }
+        if (k != 0) {
+            client->skey = k;
+            e->by_skey[k] = client;
+            std::lock_guard<std::mutex> g(e->mu);
+            l5dstream::StreamStats* ss = e->stream_tab.get(k, now);
+            ss->inflight = 1;
+            ss->kind = l5dstream::ROW_TUNNEL;
+            e->stream_tab.tunnels_opened++;
+            // pin the route's current specialist head for the
+            // tunnel's whole life
+            for (auto& kv : e->routes)
+                if (kv.second.id == client->route_id) {
+                    client->srhash = kv.second.feat.rhash;
+                    break;
+                }
+        }
+    }
+    if (!up->in.empty()) {
+        size_t nb = up->in.size();
+        wbuf(client)->append(up->in);
+        up->in.clear();
+        if (!flush_out(e, client)) return false;
+        if (!tunnel_note(e, client, (float)nb)) return false;
+    }
+    if (!client->in.empty()) {
+        size_t nb = client->in.size();
+        wbuf(up)->append(client->in);
+        client->in.clear();
+        if (!flush_out(e, up)) return false;
+        if (!tunnel_note(e, client, (float)nb)) return false;
+    }
+    return true;
+}
+
+// Python-side actuation: keys queued by fp_rst_stream are resolved on
+// the loop thread against by_skey and their tunnels closed.
+void drain_pending_rst(Engine* e) {
+    std::vector<uint32_t> keys;
+    {
+        std::lock_guard<std::mutex> g(e->mu);
+        if (e->pending_rst.empty()) return;
+        keys.swap(e->pending_rst);
+    }
+    for (uint32_t k : keys) {
+        auto it = e->by_skey.find(k);
+        if (it == e->by_skey.end()) continue;
+        Conn* c = it->second;
+        if (c->st != Conn::St::TUNNEL) continue;
+        {
+            std::lock_guard<std::mutex> g(e->mu);
+            e->stream_tab.rst_sent++;
+        }
+        conn_close(e, c);
+    }
+}
+
 // TCP EOF (or TLS close-notify) from an upstream: completes an
 // EOF-delimited response, otherwise tears the exchange down. On a TLS
 // conn only an authenticated close-notify may complete an
@@ -1170,6 +1377,21 @@ void on_upstream_readable(Engine* e, Conn* up) {
             return;
         }
         if (up->tls == nullptr) up->in.append(buf, (size_t)n);
+        if (up->st == Conn::St::TUNNEL) {
+            size_t nb = up->in.size();
+            if (nb > 0) {
+                wbuf(client)->append(up->in);
+                up->in.clear();
+                if (!flush_out(e, client)) return;
+                maybe_pause_producer(e, client);
+                if (!tunnel_note(e, client, (float)nb)) return;
+            }
+            if (tls_rc == 1) {
+                conn_close(e, up);
+                return;
+            }
+            continue;
+        }
         while (!up->rsp_head_parsed) {
             if (up->in.find("\r\n\r\n") == std::string::npos) {
                 if (up->in.size() > MAX_HEAD) {
@@ -1199,6 +1421,16 @@ void on_upstream_readable(Engine* e, Conn* up) {
             up->rsp_status = h.status;
             up->rsp_eof_delim = (bt.kind == BodyKind::EOF_DELIM);
             client->rsp_body = bt;
+            // upgrade passthrough: a 101 the client asked for, or a
+            // successful CONNECT answer, switches the pair into an
+            // opaque byte tunnel (still frame-featurized)
+            if ((h.status == 101 && client->upgrade_req) ||
+                (client->req_method == "CONNECT" && h.status >= 200 &&
+                 h.status < 300)) {
+                if (!flush_out(e, client)) return;
+                if (!enter_tunnel(e, client, up)) return;
+                goto more;  // next reads take the TUNNEL branch
+            }
         }
         if (!up->in.empty()) {
             long take = client->rsp_body.feed(up->in.data(), up->in.size());
@@ -1259,6 +1491,25 @@ void on_client_readable(Engine* e, Conn* c) {
             if (!flush_out(e, c)) return;
         } else {
             c->in.append(buf, (size_t)n);
+        }
+        if (c->st == Conn::St::TUNNEL) {
+            if (c->peer == nullptr) {
+                conn_close(e, c);
+                return;
+            }
+            size_t nb = c->in.size();
+            if (nb > 0) {
+                wbuf(c->peer)->append(c->in);
+                c->in.clear();
+                if (!flush_out(e, c->peer)) return;
+                maybe_pause_producer(e, c->peer);
+                if (!tunnel_note(e, c, (float)nb)) return;
+            }
+            if (tls_rc == 1) {
+                conn_close(e, c);
+                return;
+            }
+            continue;
         }
         if (c->st == Conn::St::FORWARD_BODY && c->peer != nullptr) {
             long take = c->req_body.feed(c->in.data(), c->in.size());
@@ -1398,6 +1649,18 @@ void sweep_timeouts(Engine* e) {
             e->guard.body_stall_closed.fetch_add(
                 1, std::memory_order_relaxed);
             expired.push_back(c);
+        } else if (c->kind == Conn::Kind::CLIENT &&
+                   c->st == Conn::St::TUNNEL &&
+                   e->stream_cfg.tunnel_idle_us != 0 &&
+                   c->last_frame_us != 0 &&
+                   now - c->last_frame_us > e->stream_cfg.tunnel_idle_us) {
+            // a byte tunnel with zero activity past its idle budget is
+            // shed (tunnels escape the exchange timeout by design)
+            {
+                std::lock_guard<std::mutex> g(e->mu);
+                e->stream_tab.tunnel_idle_closed++;
+            }
+            expired.push_back(c);
         }
     }
     // endpoint churn orphans pooled IDLE conns: a route update that
@@ -1515,6 +1778,7 @@ void* loop_main(void* arg) {
                 else on_upstream_readable(e, c);
             }
         }
+        drain_pending_rst(e);
         sweep_timeouts(e);
     }
     return nullptr;
@@ -1791,14 +2055,15 @@ long fp_stats_json(void* ep, char* buf, size_t cap) {
 }
 
 // Each row: [route_id, latency_ms, status, req_bytes, rsp_bytes, ts_s,
-// score, scored, tenant]
+// score, scored, tenant, kind, stream, frame_seq]
 long fp_drain_features(void* ep, float* buf, long cap_rows) {
     Engine* e = (Engine*)ep;
     std::lock_guard<std::mutex> g(e->mu);
     long n = (long)e->features.size();
     if (n > cap_rows) n = cap_rows;
+    constexpr long W = sizeof(FeatureRow) / sizeof(float);
     for (long i = 0; i < n; i++)
-        memcpy(buf + i * 9, &e->features[(size_t)i], sizeof(FeatureRow));
+        memcpy(buf + i * W, &e->features[(size_t)i], sizeof(FeatureRow));
     e->features.erase(e->features.begin(), e->features.begin() + n);
     return n;
 }
@@ -1924,6 +2189,76 @@ int fp_attach_slab(void* ep, void* slab) {
     Engine* e = (Engine*)ep;
     if (e->thread_started) return -1;
     e->slab = slab != nullptr ? (l5dscore::Slab*)slab : &e->scorer_slab;
+    return 0;
+}
+
+// Stream-sentinel knobs (call BEFORE fp_start). Thresholds mirror
+// control.state.HysteresisGovernor: 0 < exit < enter <= 1, quorum
+// consecutive samples, dwell after each transition. action: 0 =
+// observe only, 1 = shed the sick tunnel.
+int fp_set_stream_cfg(void* ep, long enabled, long sample_every,
+                      long min_gap_ms, long table_cap, double enter,
+                      double exitv, long quorum, long dwell_ms,
+                      long action) {
+    Engine* e = (Engine*)ep;
+    if (e->thread_started) return -1;
+    if (sample_every < 1 || min_gap_ms < 0 || table_cap < 1 ||
+        quorum < 1 || dwell_ms < 0 || action < 0 || action > 1)
+        return -1;
+    if (enabled != 0 &&
+        !(0.0 < exitv && exitv < enter && enter <= 1.0))
+        return -1;
+    e->stream_cfg.enabled = enabled != 0;
+    e->stream_cfg.sample_every = (uint32_t)sample_every;
+    e->stream_cfg.sample_min_gap_us = (uint64_t)min_gap_ms * 1000;
+    e->stream_cfg.enter = enter;
+    e->stream_cfg.exit_ = exitv;
+    e->stream_cfg.quorum = (int)quorum;
+    e->stream_cfg.dwell_us = (uint64_t)dwell_ms * 1000;
+    e->stream_cfg.action = (int)action;
+    std::lock_guard<std::mutex> g(e->mu);
+    e->stream_tab.cap = (size_t)table_cap;
+    return 0;
+}
+
+// Tunnel guard budgets (call BEFORE fp_start); 0 disables the
+// individual cap. Enforced even when stream scoring is off — they are
+// connection-plane defenses like the slowloris budgets.
+int fp_set_tunnel_guard(void* ep, long idle_ms, long max_bytes) {
+    Engine* e = (Engine*)ep;
+    if (e->thread_started) return -1;
+    if (idle_ms < 0 || max_bytes < 0) return -1;
+    e->stream_cfg.tunnel_idle_us = (uint64_t)idle_ms * 1000;
+    e->stream_cfg.tunnel_max_bytes = (uint64_t)max_bytes;
+    return 0;
+}
+
+long fp_streams_json(void* ep, char* buf, size_t cap) {
+    Engine* e = (Engine*)ep;
+    std::string s;
+    {
+        std::lock_guard<std::mutex> g(e->mu);
+        l5dstream::streams_json(e->stream_tab,
+                                e->stream_cfg.enabled != 0, &s);
+    }
+    if (s.size() + 1 > cap) return -2;
+    memcpy(buf, s.data(), s.size());
+    buf[s.size()] = 0;
+    return (long)s.size();
+}
+
+// Queue a tunnel close by stream key (Python-side actuation); the loop
+// thread resolves it against by_skey on its next pass.
+int fp_rst_stream(void* ep, unsigned int skey) {
+    Engine* e = (Engine*)ep;
+    if (skey == 0) return -1;
+    {
+        std::lock_guard<std::mutex> g(e->mu);
+        e->pending_rst.push_back(skey);
+    }
+    uint64_t v = 1;
+    ssize_t r = ::write(e->wakefd, &v, sizeof(v));
+    (void)r;
     return 0;
 }
 

@@ -50,6 +50,7 @@
 
 #include "h2_core.h"
 #include "scorer.h"
+#include "stream_track.h"
 #include "tenant_guard.h"
 #include "tls_engine.h"
 
@@ -134,6 +135,11 @@ struct FeatureRow {
     float score, scored;
     // tenant hash folded to 24 bits (f32-integer-exact); 0 = no tenant
     float tenant;
+    // stream-lifetime key: kind (0 request / 1 stream sample / 2 tunnel
+    // sample), 24-bit stream key (0 = not a stream row), frame seq at
+    // sample time — mid-stream rows repeat the same key with a growing
+    // frame_seq so Python consumers can track a stream over its life
+    float kind, stream, frame_seq;
 };
 
 struct PStream;
@@ -175,10 +181,19 @@ struct Engine {
     l5dtg::TenantExtract tenant_ex;
     l5dtg::GuardCfg guard_cfg;
     l5dtg::GuardStats guard;
+    // stream sentinel: cfg is installed BEFORE fph2_start (loop reads
+    // it unlocked, like guard_cfg); the table and the pending-RST
+    // queue (Python-side actuation) are guarded by mu
+    l5dstream::StreamCfg stream_cfg;
+    l5dstream::StreamTable stream_tab;
+    std::vector<uint32_t> pending_rst;
 
     // loop-thread-only
     std::unordered_map<int, H2Conn*> conns;
     std::vector<int> listeners;
+    // loop-thread-only stream-key index (Python RSTs address by key)
+    std::unordered_map<uint32_t, PStream*> by_skey;
+    uint32_t next_skey = 1;
     std::unordered_map<std::string, std::vector<PStream*>> parked;
     // write coalescing: conns with pending frames, flushed once per
     // epoll round (true only while the loop thread runs — outside it,
@@ -311,6 +326,18 @@ struct PStream {
     // this stream (our advertised initial window + grants − DATA seen);
     // negative = the peer overran our window -> FLOW_CONTROL_ERROR
     int64_t c_recv_win = 0, u_recv_win = 0;
+    // stream sentinel: per-frame feature accumulation, native
+    // hysteresis state, the 24-bit stream key feature rows carry, and
+    // the specialist head pinned at first dispatch (srhash) — the
+    // stream keeps scoring on the head it opened with even if the
+    // route's hash is repointed mid-life
+    l5dstream::StreamAccum acc;
+    l5dstream::StreamGov gov;
+    uint32_t skey = 0;  // 0 = stream tracking off
+    uint32_t srhash = 0;
+    bool sr_pinned = false;
+    bool is_grpc = false;
+    uint64_t last_frame_us = 0;
     bool parked = false;
     uint64_t park_deadline_us = 0;
     // finished: unlinked from both conns, awaiting graveyard free. Every
@@ -468,14 +495,18 @@ void drain_dirty(Engine* e) {
 
 void push_feature(Engine* e, uint64_t route_id, uint64_t lat_us, int status,
                   uint64_t req_b, uint64_t rsp_b, float score, int scored,
-                  int specialist, uint64_t score_ns, uint32_t tenant) {
+                  int specialist, uint64_t score_ns, uint32_t tenant,
+                  int kind = l5dstream::ROW_REQUEST, uint32_t skey = 0,
+                  uint32_t fseq = 0) {
     std::lock_guard<std::mutex> g(e->mu);
     if (scored)
         e->score_stats.record(score_ns, specialist != 0);
     else
         e->score_stats.unscored++;
     // per-tenant aggregates ride the same mu hold as the feature push
-    if (tenant)
+    // (request rows only — a stream's tenant slot is settled when the
+    // stream finishes, not per sample)
+    if (tenant && kind == l5dstream::ROW_REQUEST)
         e->tenants.observe(tenant, status, score, scored != 0, now_us());
     if (e->features.size() >= e->features_cap) {
         e->features_dropped++;
@@ -491,6 +522,9 @@ void push_feature(Engine* e, uint64_t route_id, uint64_t lat_us, int status,
     r.score = score;
     r.scored = scored ? 1.0f : 0.0f;
     r.tenant = l5dtg::tenant_feature(tenant);
+    r.kind = (float)kind;
+    r.stream = (float)skey;
+    r.frame_seq = (float)fseq;
     e->features.push_back(r);
 }
 
@@ -548,6 +582,12 @@ void finish_stream(Engine* e, PStream* st, bool record) {
     if (st->closed) return;
     st->closed = true;
     e->stream_graveyard.push_back(st);
+    if (st->skey != 0) {
+        e->by_skey.erase(st->skey);
+        std::lock_guard<std::mutex> g(e->mu);
+        l5dstream::StreamStats* ss = e->stream_tab.peek(st->skey);
+        if (ss != nullptr && ss->inflight > 0) ss->inflight--;
+    }
     if (st->parked) {
         unregister_parked(e, st);
         st->parked = false;
@@ -634,6 +674,111 @@ void finish_stream(Engine* e, PStream* st, bool record) {
                      st->tenant);
     }
     if (uc != nullptr && !uc->dead) dispatch_from_queue(e, uc);
+}
+
+// ---- stream sentinel (in-plane mid-stream scoring + actuation) ----
+
+// Shed a sick stream: gRPC streams get proper UNAVAILABLE trailers
+// (grpc-status 14 — the client sees a clean, retryable status) when
+// the response channel is still usable; everything else gets
+// RST_STREAM. The upstream leg is always CANCELed.
+void shed_stream(Engine* e, PStream* st, const char* why) {
+    if (st->closed) return;
+    {
+        std::lock_guard<std::mutex> g(e->mu);
+        e->stream_tab.rst_sent++;
+    }
+    if (st->cc != nullptr && !st->cc->dead) {
+        if (st->is_grpc && !st->rsp_end_sent) {
+            std::vector<Hdr> tr;
+            if (!st->rsp_started)  // trailers-only response
+                tr.push_back({":status", "200"});
+            tr.push_back({"grpc-status", "14"});  // UNAVAILABLE
+            tr.push_back({"grpc-message", why});
+            write_headers(st->cc, st->cid, tr, true);
+            st->rsp_end_sent = true;
+        } else {
+            h2::write_rst(wbuf(st->cc), st->cid, h2::ENHANCE_YOUR_CALM);
+        }
+        queue_flush(e, st->cc);
+    }
+    if (st->uc != nullptr && st->uid && !st->uc->dead) {
+        h2::write_rst(wbuf(st->uc), st->uid, h2::CANCEL);
+        queue_flush(e, st->uc);
+    }
+    if (st->status == 0) st->status = 503;
+    finish_stream(e, st, true);
+}
+
+// Score one mid-stream sample and run the native hysteresis governor.
+// The dense forward runs OUTSIDE mu against the slab reader protocol,
+// same as the request path in finish_stream.
+void sample_stream(Engine* e, PStream* st, uint64_t now) {
+    st->gov.last_sample_frames = st->acc.frames;
+    st->gov.last_sample_us = now;
+    float score = 0.0f;
+    int scored = 0, specialist = 0;
+    uint64_t score_ns = 0;
+    if (l5dscore::slab_has_weights(e->slab)) {
+        float feats[l5dscore::FEATURE_DIM];
+        l5dscore::featurize_stream(
+            st->acc.gap_ewma_ms, st->acc.bpf_ewma, (float)st->acc.bytes,
+            st->acc.gap_dev_ms, st->acc.anomalies, -1, 0.0f, feats);
+        const uint64_t t0 = l5dscore::now_ns();
+        const int rc = l5dscore::slab_score_route(
+            e->slab, st->srhash, st->srhash != 0, feats, &score);
+        if (rc >= 0) {
+            scored = 1;
+            specialist = rc;
+            score_ns = l5dscore::now_ns() - t0;
+        }
+    }
+    const int trans = scored
+        ? l5dstream::gov_observe(e->stream_cfg, &st->gov, score, now)
+        : 0;
+    push_feature(e, st->route_id,
+                 (uint64_t)(st->acc.gap_ewma_ms * 1000.0f),
+                 st->gov.sick ? 503 : 0, st->req_b, st->rsp_b, score,
+                 scored, specialist, score_ns, st->tenant,
+                 l5dstream::ROW_STREAM, st->skey, st->acc.frames);
+    {
+        std::lock_guard<std::mutex> g(e->mu);
+        e->stream_tab.observe(st->skey, l5dstream::ROW_STREAM, score,
+                              scored != 0, st->acc, st->gov.sick, now);
+        if (trans > 0) e->stream_tab.sick_transitions++;
+    }
+    if (trans > 0 && e->stream_cfg.action != 0)
+        shed_stream(e, st, "stream shed by sentinel");
+}
+
+// One frame observed on a tracked stream: accumulate the feature
+// deltas and sample/score on the configured cadence. May finish the
+// stream (actuation) — callers must re-check st->closed.
+void note_frame(Engine* e, PStream* st, int kind, size_t nbytes) {
+    if (st->skey == 0 || st->closed) return;
+    const uint64_t now = now_us();
+    const float gap_ms = st->last_frame_us != 0
+        ? (float)(now - st->last_frame_us) / 1000.0f : 0.0f;
+    st->last_frame_us = now;
+    l5dstream::accum_frame(&st->acc, kind, gap_ms, (float)nbytes);
+    if (l5dstream::sample_due(e->stream_cfg, st->acc, st->gov, now))
+        sample_stream(e, st, now);
+}
+
+// Python-side actuation: RST requests queue under mu and drain here on
+// the loop thread (fph2_rst_stream wakes the loop via the eventfd).
+void drain_pending_rst(Engine* e) {
+    std::vector<uint32_t> keys;
+    {
+        std::lock_guard<std::mutex> g(e->mu);
+        if (e->pending_rst.empty()) return;
+        keys.swap(e->pending_rst);
+    }
+    for (uint32_t k : keys) {
+        auto it = e->by_skey.find(k);
+        if (it != e->by_skey.end())
+            shed_stream(e, it->second, "stream shed by sentinel");
+    }
 }
 
 // ---- flow-control grants (we only re-open our receive windows when the
@@ -890,6 +1035,12 @@ bool dispatch_stream(Engine* e, PStream* st) {
                 ip_be = ep.ip_be;
                 port = ep.port;
                 ep.inflight++;
+                // specialist-head pinning: the stream scores on the
+                // head its route served at open, for its whole life
+                if (st->skey != 0 && !st->sr_pinned) {
+                    st->srhash = r.feat.rhash;
+                    st->sr_pinned = true;
+                }
                 if (ep.conn != nullptr && !ep.conn->draining &&
                     !ep.conn->closing && !ep.conn->dead)
                     uc = ep.conn;
@@ -1281,6 +1432,25 @@ void client_headers_complete(Engine* e, H2Conn* c) {
     st->req_hdrs = std::move(hs);
     for (auto& h : st->req_hdrs) st->req_b += h.first.size()
                                      + h.second.size();
+    // stream sentinel: enroll the stream under a fresh 24-bit key; the
+    // specialist head pins at first dispatch (sr_pinned)
+    if (e->stream_cfg.enabled) {
+        const std::string* ct = find_hdr(st->req_hdrs, "content-type");
+        st->is_grpc = ct != nullptr &&
+            ct->compare(0, 16, "application/grpc") == 0;
+        uint32_t k = l5dstream::fold_key(e->next_skey++);
+        for (int tries = 0;
+             e->by_skey.count(k) != 0 && tries < 4; tries++)
+            k = l5dstream::fold_key(e->next_skey++);
+        st->skey = k;
+        st->last_frame_us = st->t_start_us;
+        e->by_skey[k] = st;
+        std::lock_guard<std::mutex> g(e->mu);
+        l5dstream::StreamStats* ss =
+            e->stream_tab.get(k, st->t_start_us);
+        ss->inflight = 1;
+        ss->kind = l5dstream::ROW_STREAM;
+    }
     c->streams[sid] = st;
     if (dispatch_stream(e, st)) return;
     // no route yet: surface the miss and park (same dance as the h1
@@ -1404,6 +1574,8 @@ void handle_client_frame(Engine* e, H2Conn* c, uint8_t type, uint8_t flags,
         st->c_recv_win -= (int64_t)len;
         if (st->c_recv_win < 0) {
             // stream-level overrun: RST this stream, spare the conn
+            note_frame(e, st, l5dstream::FRAME_ANOMALY, 0);
+            if (st->closed) return;  // sentinel already shed it
             h2::write_rst(wbuf(c), sid, h2::FLOW_CONTROL_ERROR);
             queue_flush(e, c);
             if (st->uc != nullptr && st->uid) {
@@ -1446,7 +1618,8 @@ void handle_client_frame(Engine* e, H2Conn* c, uint8_t type, uint8_t flags,
         }
         pump_upstream(e, st);
         if (!c->dead) {
-            stream_grant(e, st, true);
+            if (!st->closed) note_frame(e, st, l5dstream::FRAME_DATA, n);
+            if (!st->closed) stream_grant(e, st, true);
             conn_grant(e, c);
         }
         break;
@@ -1468,8 +1641,11 @@ void handle_client_frame(Engine* e, H2Conn* c, uint8_t type, uint8_t flags,
         } else {
             auto it = c->streams.find(sid);
             if (it != c->streams.end()) {
-                it->second->c_swin += inc;
-                pump_client(e, it->second);
+                PStream* st = it->second;
+                st->c_swin += inc;
+                pump_client(e, st);
+                if (!c->dead && !st->closed)
+                    note_frame(e, st, l5dstream::FRAME_WINDOW_UPDATE, 0);
             }
         }
         break;
@@ -1506,6 +1682,8 @@ void handle_client_frame(Engine* e, H2Conn* c, uint8_t type, uint8_t flags,
         auto it = c->streams.find(sid);
         if (it != c->streams.end()) {
             PStream* st = it->second;
+            note_frame(e, st, l5dstream::FRAME_ANOMALY, 0);
+            if (st->closed) break;  // sentinel already shed it
             if (st->uc != nullptr && st->uid) {
                 h2::write_rst(wbuf(st->uc), st->uid, h2::CANCEL);
                 queue_flush(e, st->uc);
@@ -1606,7 +1784,10 @@ void handle_upstream_frame(Engine* e, H2Conn* c, uint8_t type,
         c->buffered += n;
         if (flags & h2::FLAG_END_STREAM) st->c_pend_end = true;
         pump_client(e, st);
-        if (!c->dead) conn_grant(e, c);
+        if (!c->dead) {
+            if (!st->closed) note_frame(e, st, l5dstream::FRAME_DATA, n);
+            conn_grant(e, c);
+        }
         break;
     }
     case h2::WINDOW_UPDATE: {
@@ -2107,6 +2288,7 @@ void* loop_main(void* arg) {
             if ((ev & (EPOLLIN | EPOLLRDHUP)) && !c->dead)
                 on_readable(e, c);
         }
+        drain_pending_rst(e);
         sweep(e);
         // ONE coalesced flush per wakeup: every frame produced this
         // round (requests, grants, PING acks, synth responses) leaves
@@ -2397,7 +2579,8 @@ long fph2_drain_features(void* ep, float* buf, long cap_rows) {
     long n = (long)e->features.size();
     if (n > cap_rows) n = cap_rows;
     for (long i = 0; i < n; i++)
-        memcpy(buf + i * 9, &e->features[(size_t)i], sizeof(FeatureRow));
+        memcpy(buf + i * (sizeof(FeatureRow) / sizeof(float)),
+               &e->features[(size_t)i], sizeof(FeatureRow));
     e->features.erase(e->features.begin(), e->features.begin() + n);
     return n;
 }
@@ -2515,6 +2698,65 @@ int fph2_set_flood_guard(void* ep, long max_streams, long rst_burst,
     e->guard_cfg.ping_burst = (uint32_t)ping_burst;
     e->guard_cfg.settings_burst = (uint32_t)settings_burst;
     e->guard_cfg.flood_window_us = (uint64_t)window_ms * 1000;
+    return 0;
+}
+
+// Stream sentinel config: sampling cadence + native hysteresis knobs
+// (enter/exit/quorum/dwell mirror control.state.HysteresisGovernor) +
+// actuation mode (0 observe, 1 RST). Call BEFORE fph2_start, like the
+// guard knobs — the loop thread reads the cfg unlocked.
+int fph2_set_stream_cfg(void* ep, long enabled, long sample_every,
+                        long min_gap_ms, long table_cap, double enter,
+                        double exitv, long quorum, long dwell_ms,
+                        long action) {
+    Engine* e = (Engine*)ep;
+    if (e->thread_started) return -1;
+    if (sample_every < 1 || min_gap_ms < 0 || table_cap < 1 ||
+        quorum < 1 || dwell_ms < 0 || action < 0 || action > 1)
+        return -1;
+    if (enabled && !(0.0 < exitv && exitv < enter && enter <= 1.0))
+        return -1;
+    e->stream_cfg.enabled = enabled ? 1 : 0;
+    e->stream_cfg.sample_every = (uint32_t)sample_every;
+    e->stream_cfg.sample_min_gap_us = (uint64_t)min_gap_ms * 1000;
+    e->stream_cfg.enter = enter;
+    e->stream_cfg.exit_ = exitv;
+    e->stream_cfg.quorum = (int)quorum;
+    e->stream_cfg.dwell_us = (uint64_t)dwell_ms * 1000;
+    e->stream_cfg.action = (int)action;
+    std::lock_guard<std::mutex> g(e->mu);
+    e->stream_tab.cap = (size_t)table_cap;
+    return 0;
+}
+
+// /streams.json: the bounded stream table + actuation counters.
+long fph2_streams_json(void* ep, char* buf, size_t cap) {
+    Engine* e = (Engine*)ep;
+    std::string s;
+    {
+        std::lock_guard<std::mutex> g(e->mu);
+        l5dstream::streams_json(e->stream_tab,
+                                e->stream_cfg.enabled != 0, &s);
+    }
+    if (s.size() + 1 > cap) return -2;
+    memcpy(buf, s.data(), s.size());
+    buf[s.size()] = 0;
+    return (long)s.size();
+}
+
+// Python-side mid-stream actuation: queue an RST for the stream with
+// this 24-bit key (as carried in feature-row column 10) and wake the
+// loop. Unknown/already-finished keys are a no-op.
+int fph2_rst_stream(void* ep, unsigned int skey) {
+    Engine* e = (Engine*)ep;
+    if (skey == 0) return -1;
+    {
+        std::lock_guard<std::mutex> g(e->mu);
+        e->pending_rst.push_back((uint32_t)skey);
+    }
+    uint64_t v = 1;
+    ssize_t r = ::write(e->wakefd, &v, sizeof(v));
+    (void)r;
     return 0;
 }
 

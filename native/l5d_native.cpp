@@ -16,6 +16,7 @@
 
 #include "huffman_table.h"  // generated from hpack.py: HUFF_CODES/HUFF_BITS
 #include "scorer.h"         // in-data-plane anomaly scorer (l5dscore::)
+#include "stream_track.h"   // per-stream accumulation (l5dstream::)
 #include "tenant_guard.h"   // tenant hashing (l5dtg::)
 
 namespace {
@@ -313,7 +314,7 @@ long l5d_score_eval_route(const uint8_t* blob, size_t len,
     return n;
 }
 
-// Score n RAW engine rows ([n, 9] f32 FeatureRow layout; only columns
+// Score n RAW engine rows ([n, 12] f32 FeatureRow layout; only columns
 // 1..4 are read) through the in-engine featurizer: per-row dst-hash
 // (cols/signs) and pre-update drift come from the caller, so tests can
 // drive the exact per-route state the engines hold. feat_out (nullable,
@@ -331,7 +332,7 @@ long l5d_score_eval_raw(const uint8_t* blob, size_t len,
     }
     float feats[l5dscore::FEATURE_DIM];
     for (long i = 0; i < n; i++) {
-        const float* r = rows + (size_t)i * 9;
+        const float* r = rows + (size_t)i * 12;
         l5dscore::featurize(r[1], (int)r[2], r[3], r[4], cols[i],
                             signs[i], drifts[i], feats);
         if (feat_out != nullptr)
@@ -447,6 +448,34 @@ long l5d_score_test_bank(uint8_t* out, size_t cap, uint32_t generation,
     if (v.size() > cap) return -2;
     memcpy(out, v.data(), v.size());
     return (long)v.size();
+}
+
+// Drive l5dstream::accum_frame over a frame trace — the parity anchor
+// for linkerd_tpu.streams.tracker.StreamTracker (the Python h2 path
+// must reproduce the engines' per-frame float32 arithmetic
+// bit-for-bit, like the featurizer parity test). kinds[i] is
+// FRAME_DATA/FRAME_WINDOW_UPDATE/FRAME_ANOMALY, gaps_ms/sizes the
+// per-frame inter-arrival gap and DATA payload size. out must hold 9
+// floats: [gap_ewma_ms, gap_dev_ms, bpf_ewma, bpf_dev, frames,
+// data_frames, wu_frames, anomalies, bytes].
+long l5d_stream_accum(const int* kinds, const float* gaps_ms,
+                      const float* sizes, long n, float* out) {
+    if (n < 0) return -1;
+    l5dstream::StreamAccum a;
+    for (long i = 0; i < n; i++) {
+        if (kinds[i] < 0 || kinds[i] > 2) return -1;
+        l5dstream::accum_frame(&a, kinds[i], gaps_ms[i], sizes[i]);
+    }
+    out[0] = a.gap_ewma_ms;
+    out[1] = a.gap_dev_ms;
+    out[2] = a.bpf_ewma;
+    out[3] = a.bpf_dev;
+    out[4] = (float)a.frames;
+    out[5] = (float)a.data_frames;
+    out[6] = (float)a.wu_frames;
+    out[7] = (float)a.anomalies;
+    out[8] = (float)a.bytes;
+    return 0;
 }
 
 // Deterministic delta patch: one seeded upsert (or remove) at

@@ -716,6 +716,21 @@ inline void featurize(float lat_ms, int status, float req_b, float rsp_b,
     x[32] = s * log1pf(ad);
 }
 
+// One mid-stream sample -> FEATURE_DIM model features, reusing the
+// request layout with stream-lifetime semantics: x[0] carries the
+// inter-frame gap EWMA where a request row carries latency, req_b the
+// bytes-per-frame EWMA, rsp_b the cumulative byte count, the drift
+// slot the gap deviation, and the status one-hot flags anomaly frames
+// (5xx class) vs nominal cadence (2xx class). Mirrored by
+// linkerd_tpu.streams.sentinel for the Python-path fallback scorer.
+inline void featurize_stream(float gap_ewma_ms, float bpf_ewma,
+                             float total_bytes, float gap_dev_ms,
+                             uint32_t anomalies, int col, float sign,
+                             float* x) {
+    featurize(gap_ewma_ms, anomalies > 0 ? 500 : 200, bpf_ewma,
+              total_bytes, col, sign, gap_dev_ms, x);
+}
+
 // ---- per-engine accounting -------------------------------------------------
 
 struct ScoreStats {  // guarded by the engine's mu

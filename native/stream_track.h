@@ -1,0 +1,297 @@
+// stream_track.h — per-stream incremental featurization + bounded
+// stream table for the native engines.
+//
+// Everything the engines scored before this header was request-shaped:
+// one feature row at exchange/stream completion. Long-lived h2/gRPC
+// streams, WebSocket upgrades, and CONNECT tunnels carry most of their
+// bytes AFTER the opening exchange, so they need a scoring key with
+// stream lifetime. Both epoll engines embed the same two pieces:
+//
+// - StreamAccum: per-frame feature deltas (inter-frame gap EWMA +
+//   deviation, bytes-per-DATA-frame EWMA + deviation, WINDOW_UPDATE
+//   cadence, reset/flow-control anomaly count) in pure float32
+//   arithmetic, mirrored BIT-IDENTICALLY by
+//   linkerd_tpu.streams.tracker.StreamTracker (pinned by the parity
+//   test; no FMA contraction on the default x86-64 flags).
+//
+// - StreamTable: bounded per-stream aggregates keyed by a 24-bit
+//   stream key (float32-integer-exact, rides the feature row), with
+//   the same amortized stalest-quarter LRU as tenant_guard.h's
+//   TenantTable — hostile stream churn buys eviction work, never
+//   memory. Live streams (inflight) are never evicted.
+//
+// Sampling cadence, hysteresis thresholds (enter/exit/quorum/dwell,
+// the native mirror of control.state.HysteresisGovernor), and the
+// actuation mode arrive from Python BEFORE start() via
+// fp_set_stream_cfg / fph2_set_stream_cfg.
+
+#pragma once
+
+#include <math.h>
+#include <stdint.h>
+#include <stdio.h>
+
+#include <algorithm>
+#include <string>
+#include <unordered_map>
+#include <utility>
+#include <vector>
+
+namespace l5dstream {
+
+// Feature-row kinds (row column 9). Request rows are 0 so the widened
+// format stays backward-readable: old rows zero-fill the new columns.
+constexpr int ROW_REQUEST = 0;
+constexpr int ROW_STREAM = 1;  // h2 stream sample
+constexpr int ROW_TUNNEL = 2;  // CONNECT / 101-upgrade byte tunnel
+
+// Frame kinds fed to accum_frame.
+constexpr int FRAME_DATA = 0;
+constexpr int FRAME_WINDOW_UPDATE = 1;
+constexpr int FRAME_ANOMALY = 2;  // RST / flow-control violation
+
+// Stream keys ride feature rows folded to 24 bits so the value stays
+// exact in float32; 0 is reserved for "not a stream row".
+inline uint32_t fold_key(uint32_t k) {
+    uint32_t f = k & 0xFFFFFFu;
+    return f == 0 ? 1u : f;
+}
+
+// ---- per-frame accumulation ------------------------------------------------
+
+// All EWMAs use alpha = 1/8 in plain float32 (mult then add, never
+// fused): the Python mirror reproduces every intermediate rounding.
+struct StreamAccum {
+    float gap_ewma_ms = 0.0f;  // inter-frame gap EWMA
+    float gap_dev_ms = 0.0f;   // mean-abs-deviation EWMA of the gap
+    float bpf_ewma = 0.0f;     // bytes per DATA frame EWMA
+    float bpf_dev = 0.0f;      // mean-abs-deviation EWMA of bytes/frame
+    uint32_t frames = 0;       // every frame (DATA/WU/anomaly)
+    uint32_t data_frames = 0;
+    uint32_t wu_frames = 0;    // WINDOW_UPDATE cadence
+    uint32_t anomalies = 0;    // resets + flow-control violations
+    uint64_t bytes = 0;        // DATA payload bytes
+};
+
+inline void accum_frame(StreamAccum* a, int kind, float gap_ms,
+                        float bytes) {
+    a->frames++;
+    if (a->frames == 1) {
+        a->gap_ewma_ms = gap_ms;
+    } else {
+        const float d = gap_ms - a->gap_ewma_ms;
+        a->gap_ewma_ms += 0.125f * d;
+        a->gap_dev_ms += 0.125f * (fabsf(d) - a->gap_dev_ms);
+    }
+    if (kind == FRAME_DATA) {
+        a->data_frames++;
+        a->bytes += (uint64_t)bytes;
+        if (a->data_frames == 1) {
+            a->bpf_ewma = bytes;
+        } else {
+            const float db = bytes - a->bpf_ewma;
+            a->bpf_ewma += 0.125f * db;
+            a->bpf_dev += 0.125f * (fabsf(db) - a->bpf_dev);
+        }
+    } else if (kind == FRAME_WINDOW_UPDATE) {
+        a->wu_frames++;
+    } else {
+        a->anomalies++;
+    }
+}
+
+// ---- sampling + actuation config -------------------------------------------
+
+struct StreamCfg {
+    int enabled = 0;
+    uint32_t sample_every = 8;        // frames between score samples
+    uint64_t sample_min_gap_us = 10'000;
+    size_t table_cap = 4096;
+    // native hysteresis (control.state.HysteresisGovernor mirror):
+    // score EWMA >= enter for `quorum` consecutive samples -> SICK;
+    // <= exit for `quorum` consecutive samples -> healthy again.
+    // dwell_us is the minimum hold after any transition.
+    double enter = 0.8;
+    double exit_ = 0.5;
+    int quorum = 3;
+    uint64_t dwell_us = 1'000'000;
+    int action = 1;  // 0 = observe only, 1 = RST/close the sick stream
+    // tunnel guard budgets (h1 engine byte tunnels): zero-activity
+    // window and lifetime byte cap; 0 disables the individual cap.
+    uint64_t tunnel_idle_us = 0;
+    uint64_t tunnel_max_bytes = 0;
+};
+
+// Per-stream hysteresis state embedded in each engine's stream object.
+struct StreamGov {
+    float score_ewma = 0.0f;
+    int streak = 0;
+    bool sick = false;
+    uint64_t transition_us = 0;
+    uint32_t last_sample_frames = 0;
+    uint64_t last_sample_us = 0;
+};
+
+// True when this sample is due (cadence + min-gap both satisfied).
+inline bool sample_due(const StreamCfg& cfg, const StreamAccum& a,
+                       const StreamGov& g, uint64_t now) {
+    if (a.frames < g.last_sample_frames + cfg.sample_every) return false;
+    return now - g.last_sample_us >= cfg.sample_min_gap_us;
+}
+
+// Feed one score observation; returns +1 on a healthy->sick
+// transition, -1 on sick->healthy, 0 otherwise. Same split-threshold /
+// consecutive-quorum / dwell semantics as HysteresisGovernor.observe.
+inline int gov_observe(const StreamCfg& cfg, StreamGov* g, float score,
+                       uint64_t now) {
+    g->score_ewma += 0.25f * (score - g->score_ewma);
+    const double level = (double)g->score_ewma;
+    const bool held =
+        g->transition_us != 0 && now - g->transition_us < cfg.dwell_us;
+    if (!g->sick) {
+        if (level >= cfg.enter) g->streak++;
+        else g->streak = 0;
+        if (g->streak >= cfg.quorum && !held) {
+            g->sick = true;
+            g->streak = 0;
+            g->transition_us = now;
+            return 1;
+        }
+    } else {
+        if (level <= cfg.exit_) g->streak++;
+        else g->streak = 0;
+        if (g->streak >= cfg.quorum && !held) {
+            g->sick = false;
+            g->streak = 0;
+            g->transition_us = now;
+            return -1;
+        }
+    }
+    return 0;
+}
+
+// ---- bounded stream table --------------------------------------------------
+
+struct StreamStats {
+    uint64_t samples = 0;
+    uint64_t scored = 0;
+    double score_ewma = 0.0;
+    uint32_t frames = 0;
+    uint64_t bytes = 0;
+    int kind = ROW_STREAM;
+    bool sick = false;
+    int inflight = 0;  // 1 while the stream/tunnel is live
+    uint64_t last_seen_us = 0;
+};
+
+// Same amortized stalest-quarter eviction as l5dtg::TenantTable;
+// callers hold the engine mu.
+struct StreamTable {
+    std::unordered_map<uint32_t, StreamStats> map;
+    size_t cap = 4096;
+    uint64_t evicted = 0;
+    // engine-wide actuation counters (mu-held like the map)
+    uint64_t sick_transitions = 0;
+    uint64_t rst_sent = 0;
+    uint64_t tunnels_opened = 0;
+    uint64_t tunnel_idle_closed = 0;
+    uint64_t tunnel_bytes_closed = 0;
+
+    StreamStats* get(uint32_t k, uint64_t now_us) {
+        auto it = map.find(k);
+        if (it != map.end()) {
+            it->second.last_seen_us = now_us;
+            return &it->second;
+        }
+        if (map.size() >= cap) evict(now_us);
+        StreamStats& ss = map[k];
+        ss.last_seen_us = now_us;
+        return &ss;
+    }
+
+    StreamStats* peek(uint32_t k) {
+        auto it = map.find(k);
+        return it == map.end() ? nullptr : &it->second;
+    }
+
+    void observe(uint32_t k, int kind, float score, bool scored,
+                 const StreamAccum& a, bool sick, uint64_t now_us) {
+        StreamStats* ss = get(k, now_us);
+        ss->samples++;
+        ss->kind = kind;
+        ss->frames = a.frames;
+        ss->bytes = a.bytes;
+        ss->sick = sick;
+        if (scored) {
+            ss->scored++;
+            ss->score_ewma += 0.1 * ((double)score - ss->score_ewma);
+        }
+    }
+
+    void evict(uint64_t now_us) {
+        std::vector<std::pair<uint64_t, uint32_t>> ages;
+        ages.reserve(map.size());
+        for (auto& kv : map)
+            if (kv.second.inflight <= 0)
+                ages.push_back({kv.second.last_seen_us, kv.first});
+        if (ages.empty()) return;
+        size_t k = ages.size() / 4;
+        if (k == 0) k = 1;
+        std::nth_element(ages.begin(), ages.begin() + (long)(k - 1),
+                         ages.end());
+        uint64_t cutoff = ages[k - 1].first;
+        size_t dropped = 0;
+        for (auto it = map.begin(); it != map.end() && dropped < k;) {
+            if (it->second.inflight <= 0 &&
+                it->second.last_seen_us <= cutoff) {
+                it = map.erase(it);
+                dropped++;
+            } else {
+                ++it;
+            }
+        }
+        evicted += dropped;
+        (void)now_us;
+    }
+};
+
+// ---- stats JSON ------------------------------------------------------------
+
+// Full `{"streams":{...}}` document for /streams.json (caller holds
+// the engine mu for the table).
+inline void streams_json(const StreamTable& t, bool enabled,
+                         std::string* s) {
+    char tmp[320];
+    snprintf(tmp, sizeof(tmp),
+             "{\"enabled\":%s,\"count\":%zu,\"evicted\":%llu,"
+             "\"sick_transitions\":%llu,\"rst_sent\":%llu,"
+             "\"tunnels_opened\":%llu,\"tunnel_idle_closed\":%llu,"
+             "\"tunnel_bytes_closed\":%llu,\"by_stream\":{",
+             enabled ? "true" : "false", t.map.size(),
+             (unsigned long long)t.evicted,
+             (unsigned long long)t.sick_transitions,
+             (unsigned long long)t.rst_sent,
+             (unsigned long long)t.tunnels_opened,
+             (unsigned long long)t.tunnel_idle_closed,
+             (unsigned long long)t.tunnel_bytes_closed);
+    *s += tmp;
+    bool first = true;
+    for (auto& kv : t.map) {
+        snprintf(tmp, sizeof(tmp),
+                 "%s\"%u\":{\"kind\":%d,\"samples\":%llu,"
+                 "\"scored\":%llu,\"score_ewma\":%.6f,\"frames\":%u,"
+                 "\"bytes\":%llu,\"sick\":%s,\"live\":%s}",
+                 first ? "" : ",", kv.first, kv.second.kind,
+                 (unsigned long long)kv.second.samples,
+                 (unsigned long long)kv.second.scored,
+                 kv.second.score_ewma, kv.second.frames,
+                 (unsigned long long)kv.second.bytes,
+                 kv.second.sick ? "true" : "false",
+                 kv.second.inflight > 0 ? "true" : "false");
+        *s += tmp;
+        first = false;
+    }
+    *s += "}}";
+}
+
+}  // namespace l5dstream

@@ -55,6 +55,12 @@ int fph2_set_guard(void* e, long header_budget_ms, long body_stall_ms,
 int fph2_set_flood_guard(void* e, long max_streams, long rst_burst,
                          long ping_burst, long settings_burst,
                          long window_ms);
+int fph2_set_stream_cfg(void* e, long enabled, long sample_every,
+                        long min_gap_ms, long table_cap, double enter,
+                        double exitv, long quorum, long dwell_ms,
+                        long action);
+long fph2_streams_json(void* e, char* buf, size_t cap);
+int fph2_rst_stream(void* e, unsigned int skey);
 }
 
 namespace {
@@ -89,6 +95,7 @@ struct ChurnArgs {
     std::atomic<int> stop{0};
     std::atomic<long> scored{0};    // drained rows the engine pre-scored
     std::atomic<long> swaps{0};     // weight publishes that landed
+    std::atomic<long> stream_rows{0};  // ROW_STREAM samples drained
 };
 
 void* churn_main(void* arg) {
@@ -97,7 +104,7 @@ void* churn_main(void* arg) {
     snprintf(ep, sizeof(ep), "127.0.0.1:%d ", a->serve_port);
     char* stats = new char[1 << 20];
     char* misses = new char[64 * 1024];
-    float* feats = new float[4096 * 9];  // FeatureRow is 9 floats wide
+    float* feats = new float[4096 * 12];  // FeatureRow is 12 floats wide
     std::vector<uint8_t> blob;
     char err[256];
     int i = 0;
@@ -148,12 +155,23 @@ void* churn_main(void* arg) {
             fph2_set_tenant_quota(a->engines[w],
                                   l5dtg::tenant_hash("echoext", 7),
                                   i % 2 ? 1024 : -1);
+        // stream-sentinel leg: the mid-stream actuation queue (keys
+        // resolve against live streams on the loop thread — skeys are
+        // sequential so low keys DO hit in-flight gRPC streams) plus
+        // the streams.json snapshot racing the stream table
+        if (i % 16 == 0)
+            for (int w = 0; w < NWORKERS; w++)
+                fph2_rst_stream(a->engines[w],
+                                (unsigned)(i / 16 % 2048) + 1);
         for (int w = 0; w < NWORKERS; w++) {
             fph2_stats_json(a->engines[w], stats, 1 << 20);
+            fph2_streams_json(a->engines[w], stats, 1 << 20);
             fph2_drain_misses(a->engines[w], misses, 64 * 1024);
             long n = fph2_drain_features(a->engines[w], feats, 4096);
-            for (long r = 0; r < n; r++)
-                if (feats[r * 9 + 7] > 0.5f) a->scored.fetch_add(1);
+            for (long r = 0; r < n; r++) {
+                if (feats[r * 12 + 7] > 0.5f) a->scored.fetch_add(1);
+                if (feats[r * 12 + 9] > 0.5f) a->stream_rows.fetch_add(1);
+            }
         }
         usleep(500);
         i++;
@@ -302,6 +320,16 @@ int main() {
         fph2_set_flood_guard(engines[w], /*max_streams=*/512,
                              /*rst=*/100000, /*ping=*/100000,
                              /*settings=*/100000, /*window_ms=*/1000);
+        // stream sentinel ON with a tiny table (forces LRU eviction
+        // under stream churn) and action=1; enter is set high so legit
+        // echo streams rarely trip organically — the deterministic
+        // mid-stream RST pressure comes from the churn thread's
+        // fph2_rst_stream leg
+        fph2_set_stream_cfg(engines[w], /*enabled=*/1,
+                            /*sample_every=*/2, /*min_gap_ms=*/0,
+                            /*table_cap=*/64, /*enter=*/0.95,
+                            /*exit=*/0.5, /*quorum=*/4, /*dwell_ms=*/0,
+                            /*action=*/1);
         fph2_start(engines[w]);
     }
 
@@ -350,9 +378,10 @@ int main() {
 
     fprintf(stderr,
             "h2 stress: %llu requests proxied (%llu via TLS), "
-            "%ld rows scored in-engine across %ld weight swaps\n",
+            "%ld rows scored in-engine across %ld weight swaps, "
+            "%ld stream samples\n",
             (unsigned long long)total, (unsigned long long)tls_total,
-            ca.scored.load(), ca.swaps.load());
+            ca.scored.load(), ca.swaps.load(), ca.stream_rows.load());
     if (total < 500) {
         fprintf(stderr, "too little traffic flowed (%llu)\n",
                 (unsigned long long)total);
@@ -366,6 +395,11 @@ int main() {
     if (ca.scored.load() < 50 || ca.swaps.load() < 10) {
         fprintf(stderr, "scoring leg starved (scored=%ld swaps=%ld)\n",
                 ca.scored.load(), ca.swaps.load());
+        return 3;
+    }
+    if (ca.stream_rows.load() < 10) {
+        fprintf(stderr, "stream-sentinel leg starved (stream_rows=%ld)\n",
+                ca.stream_rows.load());
         return 3;
     }
     return 0;

@@ -54,6 +54,13 @@ int fp_set_tenant_quota(void* ep, unsigned int hash, int limit);
 int fp_set_guard(void* ep, long header_budget_ms, long body_stall_ms,
                  long accept_burst, long accept_window_ms,
                  long max_hs_inflight, long tenant_cap);
+int fp_set_stream_cfg(void* ep, long enabled, long sample_every,
+                      long min_gap_ms, long table_cap, double enter,
+                      double exitv, long quorum, long dwell_ms,
+                      long action);
+int fp_set_tunnel_guard(void* ep, long idle_ms, long max_bytes);
+long fp_streams_json(void* ep, char* buf, size_t cap);
+int fp_rst_stream(void* ep, unsigned int skey);
 }
 
 namespace {
@@ -64,6 +71,7 @@ std::atomic<long> tls_responses{0};  // via the front-engine TLS chain
 std::atomic<long> errors{0};
 std::atomic<long> scored_rows{0};    // drained rows the engine pre-scored
 std::atomic<long> weight_swaps{0};   // weight publishes that landed
+std::atomic<long> tunnel_trips{0};   // CONNECT-tunnel round trips
 
 // Minimal blocking HTTP/1.1 backend: fixed 200 response per request.
 void backend_loop(int lfd) {
@@ -168,6 +176,50 @@ void slowloris_loop(int proxy_port) {
     }
 }
 
+// CONNECT-tunnel client: opens a byte tunnel through the engine to the
+// backend (which answers the CONNECT head like any request head:
+// 200 + body) and trades bytes through it — the TUNNEL relay,
+// per-read featurization, stream-table churn, and mid-tunnel close
+// paths under fire. Inside the tunnel the backend still speaks its
+// one-response-per-blank-line protocol, so every ping gets opaque
+// bytes relayed back.
+void tunnel_loop(int proxy_port) {
+    while (!stop.load()) {
+        int fd = socket(AF_INET, SOCK_STREAM, 0);
+        sockaddr_in addr{};
+        addr.sin_family = AF_INET;
+        addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+        addr.sin_port = htons(proxy_port);
+        if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+            close(fd);
+            usleep(1000);
+            continue;
+        }
+        char buf[2048];
+        struct timeval tv{1, 0};
+        setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+        const char conreq[] =
+            "CONNECT svc-0:80 HTTP/1.1\r\nHost: svc-0\r\n\r\n";
+        if (write(fd, conreq, sizeof(conreq) - 1) < 0) {
+            close(fd);
+            continue;
+        }
+        if (read(fd, buf, sizeof(buf)) <= 0) {  // the backend's 200
+            close(fd);
+            continue;
+        }
+        for (int i = 0; i < 20 && !stop.load(); i++) {
+            const char ping[] = "ping\r\n\r\n";
+            if (write(fd, ping, sizeof(ping) - 1) < 0) break;
+            // a short read just means the engine shed the tunnel
+            // mid-stream (rst leg / sentinel): reconnect and go again
+            if (read(fd, buf, sizeof(buf)) <= 0) break;
+            tunnel_trips.fetch_add(1);
+        }
+        close(fd);
+    }
+}
+
 // Connection-churn attacker: connect + immediately close, at rate —
 // the accept-throttle and fresh-conn bookkeeping under fire.
 void churn_flood_loop(int proxy_port) {
@@ -268,6 +320,16 @@ int main() {
         fp_set_guard(workers[w], /*header_ms=*/400, /*body_ms=*/400,
                      /*accept_burst=*/100000, /*accept_window_ms=*/1000,
                      /*max_hs_inflight=*/64, /*tenant_cap=*/16);
+        // stream sentinel ON with a tiny table (LRU eviction under
+        // tunnel churn); enter is high so the tunnel clients rarely
+        // trip organically — deterministic mid-stream closes come from
+        // the drain thread's fp_rst_stream leg and the idle budget
+        fp_set_stream_cfg(workers[w], /*enabled=*/1, /*sample_every=*/2,
+                          /*min_gap_ms=*/0, /*table_cap=*/64,
+                          /*enter=*/0.95, /*exit=*/0.5, /*quorum=*/4,
+                          /*dwell_ms=*/0, /*action=*/1);
+        fp_set_tunnel_guard(workers[w], /*idle_ms=*/2000,
+                            /*max_bytes=*/0);
         if (fp_start(workers[w]) != 0) {
             fprintf(stderr, "fp_start failed\n");
             return 2;
@@ -374,14 +436,22 @@ int main() {
     std::thread drain([&] {
         std::vector<char> buf(1 << 16);
         std::vector<float> feats(64 * 1024);
+        long iter = 0;
         while (!stop.load()) {
+            // stream-sentinel leg: skeys are sequential, so low keys
+            // DO resolve to live tunnels — mid-stream close under fire
+            if (iter % 8 == 0)
+                for (int w = 0; w < NWORKERS; w++)
+                    fp_rst_stream(workers[w],
+                                  (unsigned)(iter / 8 % 512) + 1);
             for (int w = 0; w < NWORKERS; w++) {
                 fp_drain_misses(workers[w], buf.data(), buf.size());
                 fp_stats_json(workers[w], buf.data(), buf.size());
+                fp_streams_json(workers[w], buf.data(), buf.size());
                 long n = fp_drain_features(workers[w], feats.data(),
                                            1024);
                 for (long r = 0; r < n; r++)
-                    if (feats[r * 9 + 7] > 0.5f)
+                    if (feats[r * 12 + 7] > 0.5f)
                         scored_rows.fetch_add(1);
             }
             if (front != nullptr) {
@@ -390,6 +460,7 @@ int main() {
                 fp_drain_features(front, feats.data(), 1024);
             }
             usleep(2000);
+            iter++;
         }
     });
 
@@ -398,6 +469,8 @@ int main() {
         clients.emplace_back(client_loop, proxy_port, i, &responses);
     clients.emplace_back(slowloris_loop, proxy_port);
     clients.emplace_back(churn_flood_loop, proxy_port);
+    clients.emplace_back(tunnel_loop, proxy_port);
+    clients.emplace_back(tunnel_loop, proxy_port);
     if (tls_leg)  // the TLS chain: front (originate) -> ep (terminate)
         for (int i = 0; i < 2; i++)
             clients.emplace_back(client_loop, front_port, i,
@@ -419,10 +492,16 @@ int main() {
 
     fprintf(stderr, "tsan_stress: %ld responses (%ld via TLS), "
             "%ld errors, %ld rows scored in-engine across %ld weight "
-            "swaps\n", responses.load(), tls_responses.load(),
-            errors.load(), scored_rows.load(), weight_swaps.load());
+            "swaps, %ld tunnel round-trips\n", responses.load(),
+            tls_responses.load(), errors.load(), scored_rows.load(),
+            weight_swaps.load(), tunnel_trips.load());
     if (responses.load() < 100) {
         fprintf(stderr, "tsan_stress: too little traffic flowed\n");
+        return 1;
+    }
+    if (tunnel_trips.load() < 20) {
+        fprintf(stderr, "tsan_stress: tunnel leg starved (%ld)\n",
+                tunnel_trips.load());
         return 1;
     }
     if (tls_leg && tls_responses.load() < 50) {

@@ -354,6 +354,59 @@ class TestTenantConfigRules:
         assert rules_of(check_text(cfg), "tenant-config") == []
 
 
+class TestStreamConfigRules:
+    def test_bad_thresholds_fire(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  fastPath: true\n"
+            "  streamScoring: {enter: 0.3, exit: 0.5}\n"))
+        (f,) = rules_of(check_text(cfg), "stream-config")
+        assert "exit < enter" in f.message
+
+    def test_scoring_on_python_h1_warns(self):
+        # the asyncio h1 plane byte-relays tunnels opaquely: there is
+        # no frame stream for the sentinel to sample without fastPath
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  streamScoring: {action: observe}\n"))
+        (f,) = rules_of(check_text(cfg), "stream-config")
+        assert f.severity == "warning"
+        assert "fastPath" in f.message
+
+    def test_scoring_on_python_h2_is_clean(self):
+        # the h2 asyncio plane has a real frame observer
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  streamScoring: {action: rst}\n"
+        )).replace("protocol: http", "protocol: h2")
+        assert rules_of(check_text(cfg), "stream-config") == []
+
+    def test_tunnel_budgets_on_h2_warn(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  fastPath: true\n"
+            "  connectionGuard: {tunnelIdleMs: 1000}\n"
+        )).replace("protocol: http", "protocol: h2")
+        (f,) = rules_of(check_text(cfg), "stream-config")
+        assert f.severity == "warning"
+        assert "inert" in f.message
+
+    def test_unbudgeted_tunnels_with_scoring_warn(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  fastPath: true\n"
+            "  streamScoring: {action: rst}\n"
+            "  connectionGuard: {headerBudgetMs: 5000}\n"))
+        (f,) = rules_of(check_text(cfg), "stream-config")
+        assert f.severity == "warning"
+        assert "tunnel" in f.message
+
+    def test_healthy_stream_block_is_clean(self):
+        cfg = linker("/svc => /#/io.l5d.fs ;", extra=(
+            "  fastPath: true\n"
+            "  streamScoring: {enter: 0.85, exit: 0.5, quorum: 3}\n"
+            "  connectionGuard:\n"
+            "    headerBudgetMs: 10000\n"
+            "    tunnelIdleMs: 60000\n"
+            "    tunnelMaxBytes: 1073741824\n"))
+        assert rules_of(check_text(cfg), "stream-config") == []
+
+
 class TestFastpathWorkersRules:
     @pytest.fixture(autouse=True)
     def _pin_cores(self, monkeypatch):

@@ -98,7 +98,7 @@ class TestH2FastPathEngine:
                 assert stats["requests"] == 33
                 assert stats["success"] == 33
                 rows = eng.drain_features()
-                assert rows.shape == (33, 9)
+                assert rows.shape == (33, 12)
                 assert (rows[:, 2] == 200).all()  # status column
             finally:
                 await h2c.close()

@@ -417,10 +417,10 @@ class TestLineRateBatcher:
             tele.set_native_route_resolver(lambda rid: f"/fp/route-{rid}")
             views = tele.native_ring.produce_views(4)
             views[0][:] = np.array([
-                [1, 50.0, 200, 0, 0, 1.0, 0, 0, 0],
-                [1, 60.0, 200, 0, 0, 1.1, 0, 0, 0],
-                [2, 900.0, 500, 0, 0, 1.2, 0, 0, 0],
-                [2, 950.0, 500, 0, 0, 1.3, 0, 0, 0],
+                [1, 50.0, 200, 0, 0, 1.0, 0, 0, 0, 0, 0, 0],
+                [1, 60.0, 200, 0, 0, 1.1, 0, 0, 0, 0, 0, 0],
+                [2, 900.0, 500, 0, 0, 1.2, 0, 0, 0, 0, 0, 0],
+                [2, 950.0, 500, 0, 0, 1.3, 0, 0, 0, 0, 0, 0],
             ], np.float32)
             tele.native_ring.commit(4)
             tele.native_committed(4)
@@ -455,8 +455,8 @@ class TestLineRateBatcher:
             tele.set_native_route_resolver(lambda rid: "/fp/nat")
             v = tele.native_ring.produce_views(2)
             v[0][:] = np.array(
-                [[9, 1.0, 200, 0, 0, 1.0, 0, 0, 0],
-                 [9, 2.0, 200, 0, 0, 1.1, 0, 0, 0]], np.float32)
+                [[9, 1.0, 200, 0, 0, 1.0, 0, 0, 0, 0, 0, 0],
+                 [9, 2.0, 200, 0, 0, 1.1, 0, 0, 0, 0, 0, 0]], np.float32)
             tele.native_ring.commit(2)
             n = await tele.drain_once()
             assert n == 3
@@ -633,8 +633,8 @@ class TestFastpathNativeFeed:
                 JaxAnomalyConfig(trainEveryBatches=0), mt,
                 scorer=self._StubScorer())
             eng = self._StubEngine(
-                [[5, 12.0, 200, 10, 20, 1.0, 0.0, 0.0, 0.0],
-                 [5, 14.0, 500, 10, 20, 1.1, 0.0, 0.0, 0.0]])
+                [[5, 12.0, 200, 10, 20, 1.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0],
+                 [5, 14.0, 500, 10, 20, 1.1, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0]])
             ctl = self._mk_controller(eng, tele)
             ctl._id_to_host[5] = "web"
             ctl._forward_features()
@@ -655,7 +655,8 @@ class TestFastpathNativeFeed:
             tele = JaxAnomalyTelemeter(
                 JaxAnomalyConfig(trainEveryBatches=0, ringCapacity=4),
                 mt, scorer=self._StubScorer())
-            rows = [[1, float(i), 200, 0, 0, 1.0, 0.0, 0.0, 0.0]
+            rows = [[1, float(i), 200, 0, 0, 1.0, 0.0, 0.0, 0.0, 0.0, 0.0,
+                     0.0]
                     for i in range(10)]
             ctl = self._mk_controller(self._StubEngine(rows), tele)
             ctl._forward_features()
@@ -683,7 +684,8 @@ class TestFastpathNativeFeed:
             teles = [JaxAnomalyTelemeter(
                 JaxAnomalyConfig(trainEveryBatches=0), m,
                 scorer=self._StubScorer()) for m in mts]
-            rows = [[3, float(i), 200, 0, 0, 1.0, 0.0, 0.0, 0.0]
+            rows = [[3, float(i), 200, 0, 0, 1.0, 0.0, 0.0, 0.0, 0.0, 0.0,
+                     0.0]
                     for i in range(6)]
             eng = self._StubEngine(rows)
             from linkerd_tpu.core import Dtab, Path

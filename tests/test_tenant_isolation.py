@@ -491,7 +491,7 @@ class TestNativeTenantExtraction:
                     assert st == 200
                 await asyncio.sleep(0.05)
                 rows = eng.drain_features()
-                assert rows.shape[1] == 9
+                assert rows.shape[1] == 12
                 got = set(float(x) for x in rows[:, 8])
                 want = {tenant_feature(tenant_hash(t))
                         for t in ("alice", "bob", "T-42")}

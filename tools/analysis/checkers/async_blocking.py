@@ -73,7 +73,8 @@ class AsyncBlockingChecker(Checker):
     description = ("blocking call (sleep / sync IO / subprocess) reachable "
                    "inside async def in the data-plane packages")
     scope = ("linkerd_tpu/router", "linkerd_tpu/protocol",
-             "linkerd_tpu/grpc", "linkerd_tpu/telemetry")
+             "linkerd_tpu/grpc", "linkerd_tpu/telemetry",
+             "linkerd_tpu/streams")
 
     def check(self, src: SourceFile, project: Project) -> Iterator[Finding]:
         funcs = list(walk_functions(src.tree))

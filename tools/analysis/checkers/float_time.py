@@ -47,7 +47,7 @@ class FloatTimeChecker(Checker):
     # test scaffolding may report wall time freely
     scope = ("linkerd_tpu/router", "linkerd_tpu/protocol",
              "linkerd_tpu/telemetry", "linkerd_tpu/core",
-             "linkerd_tpu/grpc")
+             "linkerd_tpu/grpc", "linkerd_tpu/streams")
 
     def check(self, src: SourceFile, project: Project) -> Iterator[Finding]:
         # module body + every function (lambdas included: their bodies

@@ -39,7 +39,7 @@ class StreamReleaseChecker(Checker):
     description = ("frame pulled from an h2/gRPC stream is neither "
                    "release()d nor passed onward on every path")
     scope = ("linkerd_tpu/protocol/h2", "linkerd_tpu/grpc",
-             "linkerd_tpu/router")
+             "linkerd_tpu/router", "linkerd_tpu/streams")
 
     def check(self, src: SourceFile, project: Project) -> Iterator[Finding]:
         for node in ast.walk(src.tree):

@@ -73,7 +73,8 @@ class SwallowedExceptionChecker(Checker):
     description = ("bare or Exception-broad handler on the serving path "
                    "with no log, metric, or re-raise")
     scope = ("linkerd_tpu/router", "linkerd_tpu/protocol",
-             "linkerd_tpu/grpc", "linkerd_tpu/telemetry")
+             "linkerd_tpu/grpc", "linkerd_tpu/telemetry",
+             "linkerd_tpu/streams")
 
     def check(self, src: SourceFile, project: Project) -> Iterator[Finding]:
         for node in ast.walk(src.tree):

@@ -33,7 +33,7 @@ from tools.analysis.core import (  # noqa: F401 — re-exports
 DEFAULT_SCOPE = ("linkerd_tpu/router", "linkerd_tpu/protocol",
                  "linkerd_tpu/telemetry", "linkerd_tpu/lifecycle",
                  "linkerd_tpu/control", "linkerd_tpu/fleet",
-                 "linkerd_tpu/distill")
+                 "linkerd_tpu/distill", "linkerd_tpu/streams")
 
 
 def run_race_analysis(scan_paths: Optional[Sequence[str]] = None,

@@ -42,7 +42,8 @@ class RaceChecker(Checker):
     """Base for race rules: iterates class models per source file."""
 
     scope = ("linkerd_tpu/router", "linkerd_tpu/protocol",
-             "linkerd_tpu/telemetry", "linkerd_tpu/lifecycle")
+             "linkerd_tpu/telemetry", "linkerd_tpu/lifecycle",
+             "linkerd_tpu/streams")
 
     def check(self, src: SourceFile, project: Project) -> Iterator[Finding]:
         for cm in extract_classes(src.tree):

@@ -37,6 +37,7 @@ SEMANTIC_RULES = (
     "override-unsafe",    # reactor-generated dtab overrides (control/)
     "fleet-config",       # fleet exchange / quorum-gated actuation wiring
     "distill-config",     # specialist-bank / distillation knob wiring
+    "stream-config",      # stream sentinel / tunnel budget wiring
 )
 
 

@@ -137,6 +137,7 @@ class RouterChecks:
             yield from self.check_timeouts_retries(rspec, where)
             yield from self.check_admission(rspec, where)
             yield from self.check_tenants(rspec, where)
+            yield from self.check_streams(rspec, where)
             yield from self.check_workers(rspec, where)
             yield from self.check_tls(rspec, where)
 
@@ -405,6 +406,68 @@ class RouterChecks:
                 yield self.source.finding(
                     "tenant-config", str(e),
                     line=self._anchor("connectionGuard"))
+
+    # -- stream sentinel ---------------------------------------------------
+    def check_streams(self, rspec: RouterSpec, where: str
+                      ) -> Iterator[Finding]:
+        """``streamScoring`` / tunnel-budget wiring: knob ranges, the
+        protocols the sentinel actually rides (http/h2; on the Python
+        h1 plane there is no frame stream to sample), and tunnel-budget
+        vs connectionGuard coherence (tunnels escape the slowloris
+        budgets by design — stream-aware configs should budget them)."""
+        ss = rspec.streamScoring
+        if ss is not None:
+            line = self._anchor("streamScoring")
+            try:
+                ss.validate(f"{where}.streamScoring")
+            except ConfigError as e:
+                yield self.source.finding("stream-config", str(e),
+                                          line=line)
+                return
+            if rspec.protocol not in ("http", "h2"):
+                yield self.source.finding(
+                    "stream-config",
+                    f"{where}.streamScoring is only supported on http/h2 "
+                    f"routers (got protocol {rspec.protocol!r}) — the "
+                    f"linker refuses this config at load",
+                    line=line)
+                return
+            if rspec.protocol == "http" and not rspec.fastPath:
+                yield self.source.finding(
+                    "stream-config",
+                    f"{where}.streamScoring on an http router needs "
+                    f"fastPath: true — the asyncio h1 plane has no "
+                    f"frame stream to sample (tunnels are byte-relayed "
+                    f"opaquely), so the sentinel would track nothing",
+                    line=line, severity="warning")
+        guard = rspec.connectionGuard
+        if guard is None:
+            return
+        tunnels_budgeted = (guard.tunnelIdleMs > 0
+                            or guard.tunnelMaxBytes > 0)
+        if tunnels_budgeted and rspec.protocol == "h2":
+            yield self.source.finding(
+                "stream-config",
+                f"{where}.connectionGuard: tunnelIdleMs/tunnelMaxBytes "
+                f"only apply to http routers (101-upgrade and CONNECT "
+                f"byte tunnels ride the h1 engine) — on h2 the budgets "
+                f"are inert",
+                line=self._anchor("tunnelIdleMs", "tunnelMaxBytes",
+                                  "connectionGuard"),
+                severity="warning")
+        if (ss is not None and rspec.fastPath
+                and rspec.protocol == "http" and not tunnels_budgeted
+                and (guard.headerBudgetMs > 0 or guard.bodyStallMs > 0)):
+            yield self.source.finding(
+                "stream-config",
+                f"{where}.connectionGuard: slowloris budgets are on but "
+                f"tunnels are unbudgeted (tunnelIdleMs and "
+                f"tunnelMaxBytes both 0) — an upgraded/CONNECT "
+                f"connection escapes the header/body budgets by design, "
+                f"so a stream-aware router should cap tunnel idle time "
+                f"or bytes",
+                line=self._anchor("connectionGuard"),
+                severity="warning")
 
     # -- multi-core sharding -----------------------------------------------
     def check_workers(self, rspec: RouterSpec, where: str

@@ -1521,6 +1521,149 @@ async def validate_fleet() -> None:
         await h.stop()
 
 
+async def validate_streams() -> None:
+    """In-process e2e for the stream sentinel: an h2 server with the
+    frame observer bound scores every stream mid-flight; ONE sick
+    stream (oversized DATA frames) must be detected and RST'd with
+    ENHANCE_YOUR_CALM while 10 healthy neighbors complete untouched
+    (success >= 0.99), and an h1 Upgrade tunnel must relay bytes both
+    ways through the front. Prints one ``STREAMS {json}`` line
+    (bench.py folds it into detail.streaming)."""
+    import itertools
+
+    import numpy as np
+
+    from linkerd_tpu.protocol.h2.client import H2Client
+    from linkerd_tpu.protocol.h2.frames import ENHANCE_YOUR_CALM
+    from linkerd_tpu.protocol.h2.messages import H2Request, H2Response
+    from linkerd_tpu.protocol.h2.server import H2Server
+    from linkerd_tpu.protocol.h2.stream import (DataFrame, H2Stream,
+                                                StreamReset)
+    from linkerd_tpu.protocol.http.client import HttpClient
+    from linkerd_tpu.protocol.http.server import HttpServer
+    from linkerd_tpu.router.service import FnService
+    from linkerd_tpu.streams import H2FrameObserver, StreamSentinel
+
+    sent = StreamSentinel(enter=0.7, exit=0.3, quorum=2, dwell_s=0.0)
+    keys = itertools.count(1)
+    big = np.log1p(10_000.0)  # x[8] = log1p(bytes/frame EWMA)
+
+    def factory():
+        return H2FrameObserver(
+            sent, next_skey=lambda: next(keys),
+            scorer=lambda x: 1.0 if x[8] > big else 0.0,
+            sample_every_frames=2, min_gap_ms=0, action="rst")
+
+    async def handler(req: H2Request) -> H2Response:
+        body, _ = await req.stream.read_all()
+        return H2Response(status=200, body=b"%d" % len(body))
+
+    server = await H2Server(FnService(handler),
+                            stream_observer_factory=factory).start()
+    client = H2Client("127.0.0.1", server.bound_port)
+
+    async def one(payload: bytes, frames: int) -> bool:
+        src = H2Stream()
+        task = asyncio.ensure_future(client(H2Request(
+            method="POST", path="/s", authority="v", stream=src)))
+        for _ in range(frames):
+            src.offer(DataFrame(payload))
+            await asyncio.sleep(0.001)
+        src.offer(DataFrame(b"", eos=True))
+        rsp = await task
+        body, _ = await rsp.stream.read_all()
+        return rsp.status == 200
+
+    try:
+        healthy = [one(b"x" * 64, 24) for _ in range(10)]
+        t0 = time.time()
+        sick = asyncio.ensure_future(one(b"y" * 60_000, 24))
+        oks = await asyncio.gather(*healthy)
+        try:
+            await sick
+            raise AssertionError("sick stream completed unshed")
+        except StreamReset as e:
+            assert e.error_code == ENHANCE_YOUR_CALM, hex(e.error_code)
+            shed_ms = (time.time() - t0) * 1000.0
+        success = sum(oks) / len(oks)
+        assert success >= 0.99, f"neighbor success {success:.2f} < 0.99"
+        assert sent.sick_transitions == 1, sent.sick_transitions
+        snap = sent.snapshot()
+        samples = sum(e["samples"] for e in snap["by_stream"].values())
+        scored = sum(e["scored"] for e in snap["by_stream"].values())
+        assert samples > 0 and scored == samples, \
+            f"scored {scored}/{samples} stream samples"
+        print(f"validator[streams]: sick stream shed in {shed_ms:.0f}ms "
+              f"mid-flight, {len(oks)} neighbors all finished "
+              f"({scored}/{samples} samples scored)")
+    finally:
+        await client.close()
+        await server.close()
+
+    # h1 Upgrade tunnel: the front must relay post-101 bytes both ways
+    async def on_conn(reader, writer):
+        data = b""
+        while b"\r\n\r\n" not in data:
+            chunk = await reader.read(1024)
+            if not chunk:
+                writer.close()
+                return
+            data += chunk
+        writer.write(b"HTTP/1.1 101 Switching Protocols\r\n"
+                     b"Upgrade: echo\r\nConnection: Upgrade\r\n\r\n")
+        await writer.drain()
+        got = 0
+        while True:
+            chunk = await reader.read(65536)
+            if not chunk:
+                break
+            got += len(chunk)
+            if got >= tunnel_bytes:
+                writer.write(b"done")
+                await writer.drain()
+                break
+        writer.close()
+
+    tunnel_bytes = 4 * 1024 * 1024
+    upstream = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+    up_port = upstream.sockets[0].getsockname()[1]
+    h1_client = HttpClient("127.0.0.1", up_port)
+    front = await HttpServer(h1_client).start()
+    try:
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", front.bound_port)
+        writer.write(b"GET /ws HTTP/1.1\r\nHost: x\r\n"
+                     b"Connection: Upgrade\r\nUpgrade: echo\r\n\r\n")
+        await writer.drain()
+        head = b""
+        while b"\r\n\r\n" not in head:
+            head += await reader.read(1024)
+        assert b"101" in head.split(b"\r\n")[0], head
+        t0 = time.time()
+        chunk = b"z" * 65536
+        for _ in range(tunnel_bytes // len(chunk)):
+            writer.write(chunk)
+            await writer.drain()
+        ack = await asyncio.wait_for(reader.read(16), 10)
+        wall = time.time() - t0
+        assert ack.startswith(b"done"), ack
+        tunnel_mb_s = tunnel_bytes / wall / 1e6
+        writer.close()
+        print(f"validator[streams]: 101 tunnel relayed "
+              f"{tunnel_bytes >> 20}MB at {tunnel_mb_s:.0f}MB/s")
+    finally:
+        await front.close()
+        await h1_client.close()
+        upstream.close()
+
+    print("STREAMS " + json.dumps({
+        "shed_ms": round(shed_ms, 1),
+        "neighbor_success": success,
+        "stream_samples_scored": scored,
+        "tunnel_mb_s": round(tunnel_mb_s, 1),
+    }))
+
+
 async def validate_trace() -> None:
     """Boot the REAL linkerd binary as a two-router chain with a zipkin
     exporter, drive one traced request, assert the exported spans form
@@ -1782,6 +1925,10 @@ async def main() -> int:
     if args and args[0] == "fleet":
         await validate_fleet()
         print("VALIDATOR PASS (fleet)")
+        return 0
+    if args and args[0] == "streams":
+        await validate_streams()
+        print("VALIDATOR PASS (streams)")
         return 0
     protocols = args or ["mesh", "thrift", "http"]
     for protocol in protocols:
