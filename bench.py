@@ -1121,6 +1121,26 @@ def race_analysis_bench() -> dict:
     }
 
 
+def seam_check_bench() -> dict:
+    """l5dseam wall time over the live C++/Python seam — gated in
+    tier-1 (tests/test_seam_analysis.py::TestRepoSeam) like the other
+    analyzers; both planes are re-tokenized from scratch each run, so
+    this entry catches the C tokenizer or the binding interpreter
+    regressing into a slow path as the engines grow."""
+    from tools.analysis.seam import run_seam_analysis, seam_rule_ids
+
+    t0 = time.perf_counter()
+    findings = run_seam_analysis()
+    wall_s = time.perf_counter() - t0
+    unsuppressed = [f for f in findings if not f.suppressed]
+    return {
+        "wall_s": round(wall_s, 3),
+        "findings_unsuppressed": len(unsuppressed),
+        "findings_suppressed": len(findings) - len(unsuppressed),
+        "rules": len(seam_rule_ids()),
+    }
+
+
 def semantic_check_bench() -> dict:
     """l5dcheck wall time over every in-repo YAML fixture (via
     ``tools/validator.py config``) — the semantic gate runs in tier-1,
@@ -1789,6 +1809,9 @@ def main() -> None:
     def ph_race() -> None:
         detail["race_analysis"] = race_analysis_bench()
 
+    def ph_seam() -> None:
+        detail["seam_check"] = seam_check_bench()
+
     def ph_semantic() -> None:
         detail["semantic_check"] = semantic_check_bench()
 
@@ -1867,6 +1890,7 @@ def main() -> None:
         # rc:124 mid-scorer must not lose the TLS claim.
         ("static_analysis", ph_static),
         ("race_analysis", ph_race),
+        ("seam_check", ph_seam),
         ("fleet", ph_fleet),
         ("tenant_isolation", ph_tenant_isolation),
         ("streaming", ph_streaming),

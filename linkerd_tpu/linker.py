@@ -1389,11 +1389,15 @@ class Linker:
                 f"fastPath: true (the native engine has no Python "
                 f"per-request hook to enforce it)")
         for i, srv in enumerate(rspec.servers or []):
-            if srv.timeoutMs is not None:
+            if srv.timeoutMs is not None and rspec.protocol != "h2":
+                # the h2 engine exposes fph2_set_response_timeout_ms
+                # (plumbed in _mk_fastpath_router); the h1 engine has
+                # no per-response timeout setter, so reject rather
+                # than silently drop the knob
                 raise ConfigError(
                     f"{label}.servers[{i}].timeoutMs is not supported "
-                    f"with fastPath: true (the engine applies its own "
-                    f"timeouts)")
+                    f"with fastPath: true on http/1.1 (the engine "
+                    f"applies its own timeouts); h2 fastPath honors it")
             if srv.compressionLevel:
                 raise ConfigError(
                     f"{label}.servers[{i}].compressionLevel is not "
@@ -1754,6 +1758,13 @@ class Linker:
                 enter=ss.enter, exit=ss.exit, quorum=ss.quorum,
                 dwell_s=ss.dwellMs / 1000.0, table_cap=ss.tableCap,
                 action="observe")
+        if rspec.protocol == "h2":
+            timeouts = [s.timeoutMs for s in (rspec.servers or [])
+                        if s.timeoutMs is not None]
+            if timeouts:
+                # the engine timeout is per-engine, not per-listener:
+                # the strictest server bound wins
+                engine.set_response_timeout_ms(min(timeouts))
         ports = [engine.listen_tls(s.ip, s.port) if s.tls is not None
                  else engine.listen(s.ip, s.port) for s in specs]
         ctl = FastPathController(

@@ -448,6 +448,7 @@ class FastPathController:
             self._last_scorer = {k: int(ns.get(k, 0)) for k in keys}
             scope.gauge("weights").set(1.0 if ns.get("weights") else 0.0)
             scope.gauge("version").set(float(ns.get("version", 0)))
+            scope.gauge("crc").set(float(ns.get("crc", 0)))
             scope.gauge("generation").set(
                 float(ns.get("generation", 0)))
             scope.gauge("heads").set(float(ns.get("heads", 0)))

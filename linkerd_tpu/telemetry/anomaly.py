@@ -608,7 +608,7 @@ class InProcessScorer(Scorer):
 
             def step(staging: np.ndarray):
                 # per-device shard feed; the assembled array is donated
-                xd = shard_batch(mesh, staging)  # l5d: ignore[jax-hotpath] — per-shard async placement of the persistent staging buffer, not a per-call full-batch copy
+                xd = shard_batch(mesh, staging)
                 return self._scorer(params, xd, mu_d, var_d)
         else:
             dev = self._devices[0]

@@ -1,0 +1,19 @@
+"""Drifted config plane for the seam-analyzer fixtures: the scrape map
+still expects the old name of a stat the C side renamed, and the
+window knob is documented below but plumbed to nothing."""
+import json
+
+_STAT_KEYS = ("scored", "dropped")
+
+
+def configure(eng, cfg: dict) -> None:
+    # limit: max rows per scoring window (engine-effective)
+    if cfg.get("limit") is not None:
+        eng.set_limit(int(cfg["limit"]))
+    # window: scoring window in ms (engine-effective)
+
+
+def scrape(eng, gauges: dict) -> None:
+    ns = json.loads(eng.stats_json() or b"{}")
+    for k in _STAT_KEYS:
+        gauges[k] = float(ns.get(k, 0))

@@ -1,0 +1,38 @@
+// The good/ fixture tree with every seam contract violated once —
+// checked in (not generated) so the analyzer is provably catching
+// drift in files a human can read, with no compiler and no .so:
+//   - FEATURE_DIM bumped to 16 while pybind.py still says 8
+//   - fp_flush exported with no ctypes declaration
+//   - fp_reset likewise, but waived with a justified suppression
+//   - fp_set_window exported + wrapped but called by no config path
+//   - the emitter renamed "dropped" -> "drops"; the scrape map did not
+// pybind.py adds its own drift: fp_push arity, fp_set_limit width,
+// and a binding for fp_gc, which no longer exists here.
+#pragma once
+
+#define FEATURE_DIM 16
+#define FRAME_DATA 0
+
+extern "C" {
+
+void* fp_create(long rows);
+
+void fp_destroy(void* h);
+
+long fp_push(void* h, const char* buf, size_t len);
+
+int fp_set_limit(void* h, long limit);
+
+int fp_set_window(void* h, long ms);
+
+int fp_flush(void* h);
+
+int fp_reset(void* h);  // l5d: ignore[abi-signature] — kept for an out-of-tree caller; bound lazily there
+
+long fp_stats_json(void* h, char* out, long cap) {
+    (void)h;
+    return snprintf(out, cap,
+                    "{\"scored\": %ld, \"drops\": %ld}", 0L, 0L);
+}
+
+}  // extern "C"

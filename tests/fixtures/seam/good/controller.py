@@ -1,0 +1,17 @@
+"""Mini config plane for the seam-analyzer fixtures (never imported —
+l5dseam scans it as the knob corpus and the stats scrape map)."""
+import json
+
+_STAT_KEYS = ("scored", "dropped")
+
+
+def configure(eng, cfg: dict) -> None:
+    # limit: max rows per scoring window (engine-effective)
+    if cfg.get("limit") is not None:
+        eng.set_limit(int(cfg["limit"]))
+
+
+def scrape(eng, gauges: dict) -> None:
+    ns = json.loads(eng.stats_json() or b"{}")
+    for k in _STAT_KEYS:
+        gauges[k] = float(ns.get(k, 0))

@@ -1,0 +1,28 @@
+// Mini native engine for the seam-analyzer fixtures. Never compiled:
+// l5dseam reads it the way a reviewer would, with no .so load. The
+// tree mirrors the real seam in miniature — an extern "C" ABI, two
+// mirrored constants, a JSON stats emitter, and one engine setter —
+// and is contract-clean: the drift/ sibling is this tree with every
+// rule violated once.
+#pragma once
+
+#define FEATURE_DIM 8
+#define FRAME_DATA 0
+
+extern "C" {
+
+void* fp_create(long rows);
+
+void fp_destroy(void* h);
+
+long fp_push(void* h, const char* buf, size_t len);
+
+int fp_set_limit(void* h, long limit);
+
+long fp_stats_json(void* h, char* out, long cap) {
+    (void)h;
+    return snprintf(out, cap,
+                    "{\"scored\": %ld, \"dropped\": %ld}", 0L, 0L);
+}
+
+}  // extern "C"

@@ -1,0 +1,39 @@
+"""Mini ctypes table for the seam-analyzer fixtures (never imported —
+l5dseam reads the declaration table statically)."""
+from ctypes import CDLL, c_char_p, c_int, c_long, c_size_t, c_void_p
+
+FEATURE_DIM = 8
+FRAME_DATA = 0
+
+
+def declare(cdll: CDLL) -> None:
+    cdll.fp_create.argtypes = [c_long]
+    cdll.fp_create.restype = c_void_p
+    cdll.fp_destroy.argtypes = [c_void_p]
+    cdll.fp_destroy.restype = None
+    cdll.fp_push.argtypes = [c_void_p, c_char_p, c_size_t]
+    cdll.fp_push.restype = c_long
+    cdll.fp_set_limit.argtypes = [c_void_p, c_long]
+    cdll.fp_set_limit.restype = c_int
+    cdll.fp_stats_json.argtypes = [c_void_p, c_char_p, c_long]
+    cdll.fp_stats_json.restype = c_long
+
+
+class Engine:
+    def __init__(self, lib: CDLL, rows: int):
+        self._lib = lib
+        self._h = lib.fp_create(rows)
+
+    def push(self, buf: bytes) -> int:
+        return self._lib.fp_push(self._h, buf, len(buf))
+
+    def set_limit(self, limit: int) -> int:
+        return self._lib.fp_set_limit(self._h, int(limit))
+
+    def stats_json(self) -> bytes:
+        buf = bytes(4096)
+        n = self._lib.fp_stats_json(self._h, buf, len(buf))
+        return buf[:max(n, 0)]
+
+    def close(self) -> None:
+        self._lib.fp_destroy(self._h)

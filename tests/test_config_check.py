@@ -739,6 +739,32 @@ class TestSuppressions:
         sup = [f for f in got if f.rule == "suppression"]
         assert len(sup) == 1 and "unknown semantic rule" in sup[0].message
 
+    def test_stale_justified_waiver_is_flagged(self):
+        # /svc/web comes LAST so nothing shadows it: the waiver
+        # excuses nothing
+        got = check_text(linker(
+            "/svc => /#/io.l5d.fs ;\n"
+            "/svc/web => /#/io.l5d.fs/v1 ;"
+            "  # l5d: ignore[dtab-shadowed] — canary, re-enabled"
+            " via header dtab"))
+        stale = [f for f in got if f.rule == "stale-suppression"]
+        assert len(stale) == 1, got
+        assert "dtab-shadowed" in stale[0].message
+
+    def test_live_waiver_is_not_stale(self):
+        got = check_text(linker(self.BAD_DTAB.format(
+            comment="  # l5d: ignore[dtab-shadowed] — canary, "
+                    "re-enabled via header dtab")))
+        assert not [f for f in got if f.rule == "stale-suppression"]
+
+    def test_unjustified_waiver_is_not_double_flagged(self):
+        got = check_text(linker(
+            "/svc/web => /#/io.l5d.fs/v1 ;"
+            "  # l5d: ignore[dtab-shadowed]\n"
+            "/svc => /#/io.l5d.fs ;"))
+        assert [f for f in got if f.rule == "suppression"]
+        assert not [f for f in got if f.rule == "stale-suppression"]
+
 
 class TestCheckData:
     def test_parsed_dict_path_works(self):

@@ -1,0 +1,269 @@
+"""l5dseam self-tests: every seam rule fires on the checked-in drifted
+fixture tree, stays quiet on the matching clean tree, C-comment
+suppressions work (and require justification), and the real tree's
+seam is contract-clean (the tier-1 gate).
+
+The fixture trees under ``tests/fixtures/seam/`` are the real seam in
+miniature — an ``extern "C"`` header, a ctypes table, a config plane —
+checked in rather than generated so the drift the analyzer must catch
+is reviewable by eye. ``drift/`` is ``good/`` with every contract
+violated once; the mini manifest below points the rules at them.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+from tools.analysis.seam import (
+    ConstPair, Knob, SeamManifest, Site, run_seam_analysis, seam_rule_ids,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "seam")
+GOOD = os.path.join(FIXTURES, "good")
+DRIFT = os.path.join(FIXTURES, "drift")
+
+
+def mini_manifest(declare_frame_data=True, window_knob=False):
+    """The fixture trees' declared contract. The drift tree leaves
+    FRAME_DATA undeclared (near-miss bait) and documents a window knob
+    it never plumbs."""
+    pairs = [ConstPair(
+        "FEATURE_DIM",
+        (Site("py-const", "pybind.py", "FEATURE_DIM"),
+         Site("c-const", "native/engine.h", "FEATURE_DIM")))]
+    if declare_frame_data:
+        pairs.append(ConstPair(
+            "FRAME_DATA",
+            (Site("py-const", "pybind.py", "FRAME_DATA"),
+             Site("c-const", "native/engine.h", "FRAME_DATA"))))
+    knobs = [Knob("engine.limit", "controller.py",
+                  r"limit: max rows", ("set_limit",))]
+    if window_knob:
+        knobs.append(Knob("engine.window", "controller.py",
+                          r"window: scoring window", ("set_window",)))
+    return SeamManifest(
+        abi_sources=("native/engine.h",),
+        binding="pybind.py",
+        const_pairs=tuple(pairs),
+        near_miss_c=("native/engine.h",),
+        near_miss_py_roots=("pybind.py",),
+        emitters=(("native/engine.h", "fp_stats_json"),),
+        scrape_files=("controller.py",),
+        knob_scope=("controller.py",),
+        knobs=tuple(knobs),
+    )
+
+
+def drift_findings(rule=None):
+    out = run_seam_analysis(
+        repo_root=DRIFT,
+        manifest=mini_manifest(declare_frame_data=False,
+                               window_knob=True))
+    return [f for f in out if rule is None or f.rule == rule]
+
+
+class TestGoodTree:
+    def test_clean_tree_has_zero_findings(self):
+        out = run_seam_analysis(repo_root=GOOD, manifest=mini_manifest())
+        assert out == [], "\n" + "\n".join(f.show() for f in out)
+
+    def test_rule_filter_runs_only_that_rule(self):
+        out = run_seam_analysis(
+            repo_root=DRIFT,
+            manifest=mini_manifest(declare_frame_data=False,
+                                   window_knob=True),
+            rules=["stats-contract"])
+        assert out and all(f.rule == "stats-contract" for f in out)
+
+    def test_rule_ids_are_the_four_rules(self):
+        assert seam_rule_ids() == ["abi-signature", "const-parity",
+                                   "knob-plumbing", "stats-contract"]
+
+
+class TestAbiSignature:
+    def test_width_drift_is_caught(self):
+        got = [f for f in drift_findings("abi-signature")
+               if "type-width mismatch" in f.message]
+        assert len(got) == 1, got
+        assert "fp_set_limit" in got[0].message
+        assert "i32" in got[0].message and "i64" in got[0].message
+        assert got[0].path == "pybind.py"
+
+    def test_arity_drift_is_caught(self):
+        got = [f for f in drift_findings("abi-signature")
+               if "arity mismatch" in f.message]
+        assert len(got) == 1 and "fp_push" in got[0].message, got
+        assert "2 argument(s)" in got[0].message
+        assert "3" in got[0].message
+
+    def test_unbound_export_is_caught(self):
+        got = [f for f in drift_findings("abi-signature")
+               if "no ctypes declaration" in f.message
+               and not f.suppressed]
+        assert len(got) == 1 and "fp_flush" in got[0].message, got
+        assert got[0].path == "native/engine.h"
+
+    def test_binding_to_removed_symbol_is_caught(self):
+        got = [f for f in drift_findings("abi-signature")
+               if "removed or renamed" in f.message]
+        assert len(got) == 1 and "fp_gc" in got[0].message, got
+
+    def test_justified_c_suppression_waives(self):
+        got = [f for f in drift_findings("abi-signature")
+               if "fp_reset" in f.message]
+        assert len(got) == 1 and got[0].suppressed, got
+        assert "out-of-tree caller" in got[0].justification
+
+    def test_matching_widths_stay_quiet(self):
+        out = run_seam_analysis(repo_root=GOOD, manifest=mini_manifest(),
+                                rules=["abi-signature"])
+        assert out == []
+
+
+class TestConstParity:
+    def test_mirrored_constant_drift_is_caught(self):
+        got = [f for f in drift_findings("const-parity")
+               if "disagrees across the seam" in f.message]
+        assert len(got) == 1 and "FEATURE_DIM" in got[0].message, got
+        assert "8" in got[0].message and "16" in got[0].message
+
+    def test_undeclared_mirror_is_a_near_miss(self):
+        got = [f for f in drift_findings("const-parity")
+               if "undeclared mirror" in f.message]
+        assert len(got) == 1 and "FRAME_DATA" in got[0].message, got
+        # same value on both sides today — flagged anyway, because the
+        # manifest is what makes tomorrow's drift visible
+        assert "values currently agree" in got[0].message
+
+    def test_manifest_rot_is_a_finding_not_a_skip(self):
+        pairs = (ConstPair(
+            "GONE",
+            (Site("py-const", "pybind.py", "GONE"),
+             Site("c-const", "native/engine.h", "GONE"))),)
+        out = run_seam_analysis(
+            repo_root=GOOD,
+            manifest=SeamManifest(
+                abi_sources=("native/engine.h",), binding="pybind.py",
+                const_pairs=pairs),
+            rules=["const-parity"])
+        assert len(out) == 2, out
+        assert all("extraction failed" in f.message for f in out)
+
+
+class TestStatsContract:
+    def test_renamed_stat_is_caught_in_both_directions(self):
+        got = drift_findings("stats-contract")
+        dead = [f for f in got if "scraped nowhere" in f.message]
+        ghost = [f for f in got if "emitted by no engine" in f.message]
+        assert len(dead) == 1 and "'drops'" in dead[0].message, got
+        assert dead[0].path == "native/engine.h"
+        assert len(ghost) == 1 and "'dropped'" in ghost[0].message, got
+        assert ghost[0].path == "controller.py"
+
+    def test_agreeing_contract_stays_quiet(self):
+        out = run_seam_analysis(repo_root=GOOD, manifest=mini_manifest(),
+                                rules=["stats-contract"])
+        assert out == []
+
+
+class TestKnobPlumbing:
+    def test_unplumbed_setter_is_a_dead_knob(self):
+        got = [f for f in drift_findings("knob-plumbing")
+               if "dead knob" in f.message]
+        assert len(got) == 1 and "fp_set_window" in got[0].message, got
+        assert got[0].path == "pybind.py"
+
+    def test_documented_surface_reaching_no_setter_is_inert(self):
+        got = [f for f in drift_findings("knob-plumbing")
+               if "silently inert" in f.message]
+        assert len(got) == 1 and "engine.window" in got[0].message, got
+        assert got[0].path == "controller.py"
+
+    def test_plumbed_knob_stays_quiet(self):
+        out = run_seam_analysis(repo_root=GOOD, manifest=mini_manifest(),
+                                rules=["knob-plumbing"])
+        assert out == []
+
+
+class TestSuppressionMeta:
+    def test_drift_tree_finding_census(self):
+        # the full drifted sweep: 11 findings, exactly one waived
+        out = drift_findings()
+        assert len(out) == 11, "\n" + "\n".join(f.show() for f in out)
+        assert sum(1 for f in out if f.suppressed) == 1
+
+    def test_c_suppression_requires_justification(self, tmp_path):
+        shutil.copytree(DRIFT, tmp_path / "t")
+        hdr = tmp_path / "t" / "native" / "engine.h"
+        hdr.write_text(hdr.read_text().replace(
+            "// l5d: ignore[abi-signature] — kept for an out-of-tree "
+            "caller; bound lazily there",
+            "// l5d: ignore[abi-signature]"))
+        out = run_seam_analysis(
+            repo_root=str(tmp_path / "t"),
+            manifest=mini_manifest(declare_frame_data=False,
+                                   window_knob=True))
+        bare = [f for f in out if f.rule == "suppression"
+                and "without justification" in f.message]
+        assert len(bare) == 1 and bare[0].path == "native/engine.h", out
+        # and the waiver no longer waives: fp_reset is unsuppressed
+        reset = [f for f in out if "fp_reset" in f.message]
+        assert len(reset) == 1 and not reset[0].suppressed
+
+    def test_c_suppression_for_unknown_rule_is_reported(self, tmp_path):
+        shutil.copytree(DRIFT, tmp_path / "t")
+        hdr = tmp_path / "t" / "native" / "engine.h"
+        hdr.write_text(hdr.read_text().replace(
+            "ignore[abi-signature] — kept",
+            "ignore[abi-sig] — kept"))
+        out = run_seam_analysis(
+            repo_root=str(tmp_path / "t"),
+            manifest=mini_manifest(declare_frame_data=False,
+                                   window_knob=True))
+        unknown = [f for f in out if f.rule == "suppression"
+                   and "unknown seam rule" in f.message]
+        assert len(unknown) == 1 and "abi-sig" in unknown[0].message
+
+
+class TestCli:
+    def run_cli(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.analysis", "seam", *args],
+            cwd=REPO, capture_output=True, text=True)
+
+    def test_seam_json_mode_is_machine_readable(self):
+        p = self.run_cli("--format", "json")
+        doc = json.loads(p.stdout)
+        assert doc["mode"] == "seam"
+        assert set(doc) >= {"wall_s", "unsuppressed", "suppressed_count"}
+
+    def test_seam_rejects_paths(self):
+        p = self.run_cli("linkerd_tpu")
+        assert p.returncode == 2
+        assert "takes no paths" in p.stderr
+
+    def test_list_rules_names_all_four(self):
+        p = self.run_cli("--list-rules")
+        assert p.returncode == 0
+        for rule in seam_rule_ids():
+            assert rule in p.stdout
+
+
+class TestRepoSeam:
+    def test_repo_seam_has_zero_unsuppressed_findings(self):
+        """The tier-1 gate: the live tree's C++/Python seam is
+        contract-clean. A finding here is a real cross-plane bug or a
+        missing manifest entry — fix the code or declare the contract,
+        don't relax this test."""
+        out = run_seam_analysis(repo_root=REPO)
+        unsuppressed = [f for f in out if not f.suppressed]
+        assert unsuppressed == [], "\n" + "\n".join(
+            f.show() for f in unsuppressed)
+
+    def test_every_repo_seam_suppression_is_justified(self):
+        for f in run_seam_analysis(repo_root=REPO):
+            if f.suppressed:
+                assert f.justification, f.show()

@@ -482,6 +482,79 @@ class TestSuppressions:
         assert len(leaks) == 1 and leaks[0].suppressed
 
 
+class TestStaleSuppressions:
+    """The stale-suppression meta-rule: a justified waiver that no
+    longer silences anything is itself a finding — it would hide the
+    next regression on that line."""
+
+    FIXED = """
+        import asyncio
+        def go(loop, coro):
+            t = loop.create_task(coro)  {comment}
+            return t
+    """
+
+    def test_stale_justified_waiver_is_flagged(self, tmp_path):
+        # the task IS held: the waiver excuses nothing
+        root = mk_repo(tmp_path, {"linkerd_tpu/x.py": self.FIXED.format(
+            comment="# l5d: ignore[task-leak] — daemon owns its "
+                    "lifetime")})
+        out = run_analysis(["linkerd_tpu"], repo_root=root)
+        stale = [f for f in out if f.rule == "stale-suppression"]
+        assert len(stale) == 1, out
+        assert "no longer silences" in stale[0].message
+        assert "task-leak" in stale[0].message
+
+    def test_live_waiver_is_not_stale(self, tmp_path):
+        root = mk_repo(tmp_path, {
+            "linkerd_tpu/x.py": TestSuppressions.LEAK.format(
+                comment="# l5d: ignore[task-leak] — daemon owns its "
+                        "lifetime")})
+        out = run_analysis(["linkerd_tpu"], repo_root=root)
+        assert not [f for f in out if f.rule == "stale-suppression"]
+
+    def test_rule_filtered_runs_skip_the_stale_check(self, tmp_path):
+        # with --rule only a subset of checkers runs, so "nothing
+        # fired" is not evidence of staleness
+        root = mk_repo(tmp_path, {"linkerd_tpu/x.py": self.FIXED.format(
+            comment="# l5d: ignore[task-leak] — daemon owns its "
+                    "lifetime")})
+        out = run_analysis(["linkerd_tpu"], repo_root=root,
+                           rules=["task-leak"])
+        assert not [f for f in out if f.rule == "stale-suppression"]
+
+    def test_unjustified_waiver_is_not_double_flagged(self, tmp_path):
+        # the bare ignore is already a suppression finding; stale on
+        # top would be noise
+        root = mk_repo(tmp_path, {"linkerd_tpu/x.py": self.FIXED.format(
+            comment="# l5d: ignore[task-leak]")})
+        out = run_analysis(["linkerd_tpu"], repo_root=root)
+        assert [f for f in out if f.rule == "suppression"]
+        assert not [f for f in out if f.rule == "stale-suppression"]
+
+    def test_foreign_suite_waivers_are_left_alone(self, tmp_path):
+        # a waiver naming a race/seam rule is the other analyzer's to
+        # judge — l5dlint never ran those checkers
+        root = mk_repo(tmp_path, {"linkerd_tpu/x.py": self.FIXED.format(
+            comment="# l5d: ignore[await-atomicity] — probe is "
+                    "read-only")})
+        out = run_analysis(["linkerd_tpu"], repo_root=root)
+        assert not [f for f in out if f.rule == "stale-suppression"]
+
+    def test_stale_finding_is_itself_suppressible(self, tmp_path):
+        root = mk_repo(tmp_path, {"linkerd_tpu/x.py": textwrap.dedent("""
+            import asyncio
+            def go(loop, coro):
+                # l5d: ignore[stale-suppression] — kept while the refactor lands
+                t = loop.create_task(coro)  # l5d: ignore[task-leak] — daemon owns it
+                return t
+        """)})
+        out = run_analysis(["linkerd_tpu"], repo_root=root)
+        stale = [f for f in out if f.rule == "stale-suppression"]
+        assert len(stale) == 1 and stale[0].suppressed
+        assert "refactor" in stale[0].justification
+
+
 class TestMetricsScope:
     def test_slashed_name_fires(self, tmp_path):
         got = findings_of(tmp_path, {

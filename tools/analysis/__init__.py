@@ -12,6 +12,8 @@ Rules (see tools/analysis/checkers/ and COMPONENTS.md §2.6):
 - ``float-time``          wall-clock time.time() in duration/deadline math
 - ``metrics-scope``       slashed metric names bypassing MetricsTree.scope
 - ``suppression``         (meta) ignores must carry a justification
+- ``stale-suppression``   (meta) justified waivers that no longer
+                          silence any finding (full runs only)
 
 Run: ``python -m tools.analysis [paths] [--rule r1,r2] [--format json]``.
 Semantic verification of linker/namerd YAML (l5dcheck, see
@@ -20,13 +22,21 @@ Semantic verification of linker/namerd YAML (l5dcheck, see
 Await-atomicity race analysis of the asyncio data plane (l5drace, see
 ``tools/analysis/race`` and COMPONENTS.md §2.9):
 ``python -m tools.analysis race [paths...]``.
-All three modes take ``--changed`` (analyze only files differing from
+Cross-plane C++/Python contract analysis (l5dseam, see
+``tools/analysis/seam`` and COMPONENTS.md §2.20):
+``python -m tools.analysis seam`` (whole-seam; takes no paths).
+All four modes take ``--changed`` (analyze only files differing from
 ``git merge-base HEAD main`` — the pre-commit hook mode, see
-``tools/hooks/``).
-Suppress inline with ``# l5d: ignore[rule] — why it is safe``.
+``tools/hooks/``; for seam this means the full sweep iff any
+seam-relevant file changed, since the drift is between files).
+Suppress inline with ``# l5d: ignore[rule] — why it is safe``
+(``// l5d: ignore[rule] — why`` in C sources for seam rules).
 """
 
 from tools.analysis.core import (  # noqa: F401
     Checker, Finding, Project, SourceFile, all_checkers, race_checkers,
     race_rule_ids, rule_ids, run_analysis,
+)
+from tools.analysis.seam import (  # noqa: F401
+    run_seam_analysis, seam_rule_ids,
 )

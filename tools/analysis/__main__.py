@@ -1,15 +1,18 @@
 """CLI for the static-analysis suite.
 
-Three modes::
+Four modes::
 
     python -m tools.analysis [lint] [paths] [--rule ...] [--format json]
     python -m tools.analysis check <config.yml...>      [--format json]
     python -m tools.analysis race  [paths]              [--format json]
+    python -m tools.analysis seam                       [--format json]
 
 ``lint`` (the default) runs the l5dlint AST rules over python sources;
 ``check`` runs l5dcheck semantic verification over linker/namerd YAML;
 ``race`` runs l5drace await-atomicity/lock-discipline analysis over the
-asyncio data plane.
+asyncio data plane; ``seam`` runs l5dseam cross-plane contract analysis
+over the C++/Python boundary (ABI signatures, mirrored constants, the
+stats contract, knob plumbing).
 
 ``--changed`` (any mode) restricts the run to files that differ from
 ``git merge-base HEAD main`` (plus untracked files) — fast enough for
@@ -163,7 +166,8 @@ def _lint(args) -> int:
         return rc
     paths = args.paths or ["linkerd_tpu"]
     header = {"mode": "lint", "paths": paths,
-              "rules": rules or rule_ids() + ["suppression"]}
+              "rules": rules or rule_ids() + ["suppression",
+                                              "stale-suppression"]}
     if args.changed:
         paths = _restrict_to_changed(paths, (".py",), "l5dlint")
         if paths is None:
@@ -259,10 +263,44 @@ def _check(args) -> int:
         args.show_suppressed, header, "l5dcheck")
 
 
+def _seam(args) -> int:
+    from tools.analysis.seam import run_seam_analysis, seam_rule_ids
+
+    rc, rules = _parse_rules(args, seam_rule_ids())
+    if rc:
+        return rc
+    if args.paths:
+        # the contract is cross-file by nature (a C header vs a ctypes
+        # table vs the linker): per-path runs would silently skip half
+        # of every pair, so the mode always analyzes the whole seam
+        print("seam mode analyzes the whole seam; it takes no paths",
+              file=sys.stderr)
+        return 2
+    header = {"mode": "seam", "paths": ["native", "linkerd_tpu"],
+              "rules": rules or seam_rule_ids() + ["suppression"]}
+    if args.changed:
+        # any seam-relevant change reruns the FULL analysis (the drift
+        # is precisely between files, one of which didn't change)
+        picked = _restrict_to_changed(
+            ["native", "linkerd_tpu", "tools/analysis/seam"],
+            (".py", ".h", ".hpp", ".c", ".cc", ".cpp"), "l5dseam")
+        if picked is None:
+            return _noop("l5dseam", args.as_json, header)
+    t0 = time.perf_counter()
+    try:
+        findings = run_seam_analysis(repo_root=_REPO, rules=rules)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    return _report(
+        findings, time.perf_counter() - t0, args.as_json,
+        args.show_suppressed, header, "l5dseam")
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     mode = "lint"
-    if argv and argv[0] in ("lint", "check", "race"):
+    if argv and argv[0] in ("lint", "check", "race", "seam"):
         mode = argv.pop(0)
     args = _mk_parser().parse_args(argv)
     if args.as_json or args.format == "json":
@@ -277,6 +315,10 @@ def main(argv=None) -> int:
             from tools.analysis import race_checkers
             for c in sorted(race_checkers(), key=lambda c: c.rule):
                 print(f"{c.rule:20s} {c.description}")
+        elif mode == "seam":
+            from tools.analysis.seam import seam_rule_descriptions
+            for rule, desc in seam_rule_descriptions():
+                print(f"{rule:20s} {desc}")
         else:
             for c in sorted(all_checkers(), key=lambda c: c.rule):
                 print(f"{c.rule:20s} {c.description}")
@@ -288,6 +330,8 @@ def main(argv=None) -> int:
         return _check(args)
     if mode == "race":
         return _race(args)
+    if mode == "seam":
+        return _seam(args)
     return _lint(args)
 
 

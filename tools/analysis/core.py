@@ -333,6 +333,7 @@ def run_analysis(scan_paths: Sequence[str], repo_root: Optional[str] = None,
                 if rules is None or c.rule in rules]
     findings: List[Finding] = []
     by_rel = {src.rel: src for src in project.sources}
+    used: set = set()  # (rel, suppression line) that silenced something
     for src in project.sources:
         if src.parse_error:
             findings.append(Finding("parse", src.rel, 0, 0, src.parse_error))
@@ -344,13 +345,18 @@ def run_analysis(scan_paths: Sequence[str], repo_root: Optional[str] = None,
                 if sup is not None and sup.justified:
                     f.suppressed = True
                     f.justification = sup.justification
+                    used.add((f.path, sup.line))
             findings.append(f)
     # meta-rule: every suppression carries a justification and actually
     # names a real rule (stale ids rot silently otherwise). Race-rule
-    # suppressions live in the same .py files, so they are "known" here
-    # even though the race suite runs as its own mode.
+    # and seam-rule suppressions live in the same .py files, so they
+    # are "known" here even though those suites run as their own modes.
     if rules is None or "suppression" in rules:
-        known = set(rule_ids()) | set(race_rule_ids()) | {"parse"}
+        from tools.analysis.seam import seam_rule_ids  # lazy — seam
+        # imports core, so a module-level import would be circular
+        lint_rules = set(rule_ids())
+        known = (lint_rules | set(race_rule_ids()) | set(seam_rule_ids())
+                 | {"parse", "stale-suppression"})
         for src in project.sources:
             for sup in src.suppressions.values():
                 if not sup.justified:
@@ -364,5 +370,35 @@ def run_analysis(scan_paths: Sequence[str], repo_root: Optional[str] = None,
                             "suppression", src.rel, sup.line, 0,
                             f"suppression names unknown rule {r!r} "
                             f"(known: {sorted(known)})"))
+    # stale-suppression meta-rule: a justified waiver that no longer
+    # silences anything is debt — the code it excused was fixed or
+    # deleted, and the ignore now hides FUTURE regressions at that
+    # line. Judged only on full runs (a --rule subset would see every
+    # other-rule waiver as unused), and only for waivers whose rules
+    # all belong to THIS suite (race/seam waivers are exercised by
+    # their own modes, which this run cannot observe).
+    if rules is None:
+        for src in project.sources:
+            if src.parse_error:
+                continue  # no checker ran; usage unknowable
+            for sup in src.suppressions.values():
+                if not sup.justified:
+                    continue  # already flagged above
+                named = set(sup.rules)
+                if not named or not named <= lint_rules:
+                    continue
+                if (src.rel, sup.line) not in used:
+                    f = Finding(
+                        "stale-suppression", src.rel, sup.line, 0,
+                        f"suppression for {sorted(named)} no longer "
+                        f"silences any finding — the excused code was "
+                        f"fixed or moved; delete the ignore (it would "
+                        f"hide future regressions here)")
+                    stale_sup = src.suppression_for(
+                        "stale-suppression", sup.line)
+                    if stale_sup is not None and stale_sup.justified:
+                        f.suppressed = True
+                        f.justification = stale_sup.justification
+                    findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings

@@ -1,0 +1,118 @@
+"""l5dseam — cross-plane contract analysis for the C++/Python seam.
+
+The data plane spans two languages that must agree bit-for-bit: the
+native engines (``native/*.{h,cpp}``) behind a hand-maintained ctypes
+table (``linkerd_tpu/native/__init__.py``), mirrored constants, a
+stat-name contract, and config knobs that must reach ``fp_*``/
+``fph2_*`` setters. Every one of those invariants drifts silently —
+wrong argtype width corrupts arguments, a renamed stat reads 0 forever,
+an unplumbed knob is inert config. l5dseam checks them statically, with
+no compiler and no ``.so`` load:
+
+- ``abi-signature``   extern "C" signature vs ctypes argtypes/restype:
+                      arity, per-argument width class, return width,
+                      unbound exports, bindings to removed symbols
+- ``const-parity``    the declared manifest of mirrored constant pairs
+                      (row widths, column indices, kind enums, blob
+                      magics, hash primes, EWMA alphas) extracted from
+                      both planes and compared; name-identical
+                      constants NOT in the manifest are near-miss
+                      findings
+- ``stats-contract``  stat keys the engines emit vs the controller
+                      scrape map: emitted-but-never-scraped and
+                      scraped-but-never-emitted
+- ``knob-plumbing``   config surfaces documented engine-effective must
+                      reach their engine setter from a config path;
+                      setters no config path invokes are dead knobs
+
+Run: ``python -m tools.analysis seam [--format json] [--changed]``.
+The contract being cross-file, ``--changed`` runs the full analysis
+when any seam-relevant file changed and no-ops otherwise.
+
+Suppressions reuse the l5dlint grammar — ``# l5d: ignore[rule] — why``
+in python, ``// l5d: ignore[rule] — why`` in C — and MUST carry a
+justification. The declared contract itself lives in
+``tools/analysis/seam/manifest.py``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+from tools.analysis.core import Finding, suppression_at  # noqa: F401
+from tools.analysis.seam.manifest import (  # noqa: F401 — re-exports
+    DEFAULT_MANIFEST, ConstPair, Knob, SeamManifest, Site,
+)
+
+SEAM_RULES = ("abi-signature", "const-parity", "knob-plumbing",
+              "stats-contract")
+
+_C_SUFFIXES = (".h", ".hpp", ".c", ".cc", ".cpp")
+
+
+def seam_rule_ids() -> List[str]:
+    return sorted(SEAM_RULES)
+
+
+def seam_rule_descriptions() -> List[tuple]:
+    return [
+        ("abi-signature", "extern \"C\" signature vs ctypes "
+                          "argtypes/restype drift (arity, width, "
+                          "unbound/removed symbols)"),
+        ("const-parity", "mirrored constants disagree across the seam; "
+                         "undeclared name-identical mirrors"),
+        ("knob-plumbing", "engine-effective config that reaches no "
+                          "fp/fph2 setter; setters no config path "
+                          "invokes"),
+        ("stats-contract", "engine stats never scraped; scraped stats "
+                           "no engine emits"),
+    ]
+
+
+def run_seam_analysis(repo_root: Optional[str] = None,
+                      rules: Optional[Sequence[str]] = None,
+                      manifest: Optional[SeamManifest] = None
+                      ) -> List[Finding]:
+    """Run the seam suite; returns ALL findings (suppressed ones
+    flagged). ``manifest`` defaults to the live tree's declared
+    contract; tests inject mini manifests over fixture trees."""
+    from tools.analysis.seam.rules import RULE_FNS, SeamProject
+
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+    proj = SeamProject(repo_root, manifest or DEFAULT_MANIFEST)
+    findings: List[Finding] = []
+    for rule, fn in RULE_FNS:
+        if rules is None or rule in rules:
+            findings.extend(fn(proj))
+    for f in findings:
+        sup = None
+        if f.path.endswith(_C_SUFFIXES) and f.path in proj._c:
+            sup = proj.c(f.path).suppression_for(f.rule, f.line)
+        elif f.path.endswith(".py") and f.path in proj._py:
+            sup = proj.py(f.path).suppression_for(f.rule, f.line)
+        if sup is not None and sup.justified:
+            f.suppressed = True
+            f.justification = sup.justification
+    # meta: C-side suppressions are invisible to l5dlint (it scans only
+    # python), so seam itself enforces justification + known rule ids
+    # for `// l5d: ignore[...]` comments in the sources it read.
+    if rules is None:
+        known = set(SEAM_RULES)
+        for rel in sorted(proj._c):
+            for sup in proj.c(rel).suppressions.values():
+                if not sup.justified:
+                    findings.append(Finding(
+                        "suppression", rel, sup.line, 0,
+                        "suppression without justification: write "
+                        "'// l5d: ignore[rule] — why it is safe'"))
+                for r in sup.rules:
+                    if r not in known:
+                        findings.append(Finding(
+                            "suppression", rel, sup.line, 0,
+                            f"suppression names unknown seam rule {r!r} "
+                            f"(known: {sorted(known)})"))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -1,0 +1,374 @@
+"""Dependency-free C/C++ tokenizer for the seam analyzer.
+
+Just enough C to read the native plane's public surface without a
+compiler: ``extern "C"`` export signatures, ``#define``/``constexpr``
+constants, struct field layouts, and the stat-name string literals a
+JSON emitter writes. The scanner works on two sanitized views of the
+source produced in one pass:
+
+- ``clean``  — comments blanked (strings intact): stat-key extraction,
+  ``extern "C"`` detection, constant values that are string literals.
+- ``code``   — comments AND string/char contents blanked (quotes kept):
+  brace matching and signature parsing, immune to ``{``/``;`` inside
+  the JSON format strings the emitters are full of.
+
+Both views are byte-for-byte position-aligned with the original text,
+so a match offset in either converts directly to a line number.
+
+Suppressions reuse the l5dlint grammar with C comment syntax::
+
+    long legacy_entry(int x);  // l5d: ignore[abi-signature] — why
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tools.analysis.core import Suppression
+
+# `// l5d: ignore[rule-a,rule-b] — why this is deliberate`
+_C_SUPPRESS_RE = re.compile(
+    r"//\s*l5d:\s*ignore\[([a-zA-Z0-9_,\- ]+)\]\s*(?:[—:-]+\s*(\S.*))?")
+
+_TYPE_KEYWORDS = frozenset((
+    "void", "char", "short", "int", "long", "float", "double", "bool",
+    "signed", "unsigned", "const", "size_t", "ssize_t",
+    "int8_t", "int16_t", "int32_t", "int64_t",
+    "uint8_t", "uint16_t", "uint32_t", "uint64_t",
+))
+
+# canonical width classes shared with pybind.py — a C type and a ctypes
+# declaration agree iff they map to the same token
+CANON_C = {
+    "void": "void",
+    "void*": "ptr",
+    "char*": "bytes", "unsigned char*": "bytes",
+    "signed char*": "bytes", "uint8_t*": "bytes", "int8_t*": "bytes",
+    "float*": "f32*", "double*": "f64*",
+    "int*": "i32*", "int32_t*": "i32*",
+    "unsigned int*": "u32*", "uint32_t*": "u32*",
+    "long*": "i64*", "int64_t*": "i64*", "size_t*": "u64*",
+    "char": "i8", "bool": "i8", "signed char": "i8", "int8_t": "i8",
+    "unsigned char": "u8", "uint8_t": "u8",
+    "short": "i16", "int16_t": "i16",
+    "unsigned short": "u16", "uint16_t": "u16",
+    "int": "i32", "int32_t": "i32",
+    "unsigned": "u32", "unsigned int": "u32", "uint32_t": "u32",
+    # LP64 (the only ABI the native build targets): long == 64 bit
+    "long": "i64", "long long": "i64", "int64_t": "i64", "ssize_t": "i64",
+    "unsigned long": "u64", "unsigned long long": "u64",
+    "uint64_t": "u64", "size_t": "u64",
+    "float": "f32", "double": "f64",
+}
+
+
+@dataclass
+class CDecl:
+    """One exported (non-static) function inside ``extern "C"``."""
+    name: str
+    ret: str                 # canonical width token (or raw spelling)
+    params: Tuple[str, ...]  # canonical width tokens, declaration order
+    line: int
+
+
+def sanitize(text: str) -> Tuple[str, str]:
+    """(clean, code) views — see module docstring."""
+    n = len(text)
+    a = list(text)  # comments blanked
+    b = list(text)  # comments + string/char contents blanked
+    i = 0
+
+    def blank(buf, j):
+        if buf[j] != "\n":
+            buf[j] = " "
+
+    while i < n:
+        c = text[i]
+        if c == "/" and i + 1 < n and text[i + 1] == "/":
+            while i < n and text[i] != "\n":
+                a[i] = b[i] = " "
+                i += 1
+        elif c == "/" and i + 1 < n and text[i + 1] == "*":
+            a[i] = b[i] = a[i + 1] = b[i + 1] = " "
+            i += 2
+            while i < n:
+                if text[i] == "*" and i + 1 < n and text[i + 1] == "/":
+                    a[i] = b[i] = a[i + 1] = b[i + 1] = " "
+                    i += 2
+                    break
+                blank(a, i)
+                blank(b, i)
+                i += 1
+        elif c in "\"'":
+            quote = c
+            i += 1
+            while i < n:
+                if text[i] == "\\" and i + 1 < n:
+                    blank(b, i)
+                    blank(b, i + 1)
+                    i += 2
+                    continue
+                if text[i] == quote:
+                    i += 1
+                    break
+                if text[i] == "\n":  # unterminated literal: bail out
+                    break
+                blank(b, i)
+                i += 1
+        else:
+            i += 1
+    return "".join(a), "".join(b)
+
+
+def line_of(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1
+
+
+def match_brace(code: str, open_i: int) -> int:
+    """Index of the ``}`` matching ``code[open_i] == '{'`` (string-safe
+    because ``code`` has string contents blanked)."""
+    depth = 0
+    for i in range(open_i, len(code)):
+        if code[i] == "{":
+            depth += 1
+        elif code[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return i
+    return len(code) - 1
+
+
+class CSource:
+    """One native source file: sanitized views + inline suppressions."""
+
+    def __init__(self, abspath: str, rel: str, text: str):
+        self.abspath = abspath
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.clean, self.code = sanitize(text)
+        self.suppressions: Dict[int, Suppression] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _C_SUPPRESS_RE.search(line)
+            if m:
+                rules = tuple(r.strip() for r in m.group(1).split(",")
+                              if r.strip())
+                self.suppressions[i] = Suppression(
+                    i, rules, (m.group(2) or "").strip())
+
+    @classmethod
+    def load(cls, repo_root: str, rel: str) -> "CSource":
+        absp = os.path.join(repo_root, rel)
+        with open(absp, "r", encoding="utf-8") as fh:
+            return cls(absp, rel, fh.read())
+
+    def suppression_for(self, rule: str, line: int) -> Optional[Suppression]:
+        """C flavor of core.suppression_at: own line, or a comment-only
+        line directly above."""
+        for ln in (line, line - 1):
+            sup = self.suppressions.get(ln)
+            if sup and rule in sup.rules:
+                if ln == line - 1:
+                    above = (self.lines[ln - 1].strip()
+                             if 1 <= ln <= len(self.lines) else "")
+                    if not above.startswith("//"):
+                        continue
+                return sup
+        return None
+
+    # -- exported ABI ----------------------------------------------------
+    def extern_c_spans(self) -> List[Tuple[int, int]]:
+        spans = []
+        for m in re.finditer(r'extern\s+"C"\s*', self.clean):
+            open_i = self.code.find("{", m.end() - 1)
+            if open_i < 0 or self.code[m.end():open_i].strip():
+                continue  # extern "C" on a single declaration, not a block
+            spans.append((open_i, match_brace(self.code, open_i)))
+        return spans
+
+    def exports(self) -> List[CDecl]:
+        decls: List[CDecl] = []
+        for o, c in self.extern_c_spans():
+            seg_start = i = o + 1
+            while i < c:
+                ch = self.code[i]
+                if ch == "{":
+                    decl = self._parse_signature(seg_start, i)
+                    if decl:
+                        decls.append(decl)
+                    i = match_brace(self.code, i) + 1
+                    seg_start = i
+                elif ch == ";":
+                    decl = self._parse_signature(seg_start, i)
+                    if decl:
+                        decls.append(decl)
+                    i += 1
+                    seg_start = i
+                else:
+                    i += 1
+        return decls
+
+    def _parse_signature(self, start: int, end: int) -> Optional[CDecl]:
+        header = self.code[start:end]
+        # drop preprocessor lines (a #if inside the block is not a decl)
+        header = "\n".join(ln for ln in header.split("\n")
+                           if not ln.lstrip().startswith("#")).strip()
+        if not header or "(" not in header:
+            return None
+        first = header.split(None, 1)[0]
+        if first in ("typedef", "using", "struct", "class", "enum",
+                     "namespace", "template"):
+            return None
+        pre, _, rest = header.partition("(")
+        if re.search(r"\bstatic\b", pre) or re.search(r"\binline\b", pre):
+            return None  # internal helper, not part of the ABI
+        m = re.search(r"([A-Za-z_]\w*)\s*$", pre)
+        if not m:
+            return None
+        name = m.group(1)
+        ret = pre[:m.start()].strip()
+        if not ret:
+            return None  # no return type => not a function definition
+        params_str = rest.rsplit(")", 1)[0].strip()
+        params: List[str] = []
+        if params_str and params_str != "void":
+            for p in params_str.split(","):
+                params.append(canon_c_type(_param_type(p.strip())))
+        line = line_of(self.code, start + self.code[start:end].find(name))
+        return CDecl(name, canon_c_type(ret), tuple(params), line)
+
+    # -- constants -------------------------------------------------------
+    def constants(self) -> Dict[str, Tuple[object, int]]:
+        """NAME -> (value, line) for #define / constexpr definitions.
+        Values parse to int/float/str when the literal allows, else the
+        raw spelling."""
+        out: Dict[str, Tuple[object, int]] = {}
+        for m in re.finditer(
+                r"^[ \t]*#[ \t]*define[ \t]+([A-Za-z_]\w*)[ \t]+(\S[^\n]*)",
+                self.clean, re.M):
+            out[m.group(1)] = (parse_c_value(m.group(2).strip()),
+                               line_of(self.clean, m.start(1)))
+        for m in re.finditer(
+                r"\bconstexpr\s+(?:const\s+)?(?:\w+\s+)*?([A-Za-z_]\w*)"
+                r"\s*=\s*([^;]+);", self.clean):
+            out[m.group(1)] = (parse_c_value(m.group(2).strip()),
+                               line_of(self.clean, m.start(1)))
+        return out
+
+    # -- emitter stat keys ----------------------------------------------
+    def function_body(self, name: str) -> Optional[Tuple[str, int]]:
+        """(body-with-strings-intact, start_line) of the definition of
+        ``name``, or None."""
+        for m in re.finditer(r"\b%s\s*\(" % re.escape(name), self.code):
+            paren = m.end() - 1
+            depth, i = 0, paren
+            while i < len(self.code):
+                if self.code[i] == "(":
+                    depth += 1
+                elif self.code[i] == ")":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                i += 1
+            j = i + 1
+            while j < len(self.code) and self.code[j] in " \t\n\r":
+                j += 1
+            if j < len(self.code) and self.code[j] == "{":
+                close = match_brace(self.code, j)
+                return self.clean[j:close + 1], line_of(self.code, m.start())
+        return None
+
+    def emitted_keys(self, func: str) -> List[Tuple[str, int]]:
+        """JSON keys written by emitter ``func``: every ``\\"name\\":``
+        escape inside its body's string literals."""
+        found = self.function_body(func)
+        if found is None:
+            return []
+        body, start_line = found
+        keys = []
+        for m in re.finditer(r'\\"([A-Za-z_]\w*)\\"\s*:', body):
+            keys.append((m.group(1), start_line + body.count("\n", 0,
+                                                             m.start())))
+        return keys
+
+    # -- struct layout ---------------------------------------------------
+    def struct_fields(self, struct: str) -> List[Tuple[str, str]]:
+        """(type, name) per field of ``struct``, declaration order,
+        multi-declarator lines expanded."""
+        m = re.search(r"\bstruct\s+%s\s*\{" % re.escape(struct), self.code)
+        if not m:
+            return []
+        open_i = m.end() - 1
+        body = self.code[open_i + 1:match_brace(self.code, open_i)]
+        fields: List[Tuple[str, str]] = []
+        for stmt in body.split(";"):
+            stmt = stmt.strip()
+            fm = re.match(
+                r"((?:unsigned\s+|signed\s+|const\s+)*[A-Za-z_]\w*"
+                r"(?:\s*\*)?)\s+([A-Za-z_]\w*(?:\s*,\s*[A-Za-z_]\w*)*)"
+                r"(?:\s*=\s*[^,]*)?$", stmt)
+            if not fm:
+                continue
+            ftype = canon_c_type(fm.group(1))
+            for name in fm.group(2).split(","):
+                fields.append((ftype, name.strip()))
+        return fields
+
+    def float_fields(self, struct: str) -> List[str]:
+        return [n for t, n in self.struct_fields(struct) if t == "f32"]
+
+
+def _param_type(param: str) -> str:
+    """Strip the (optional) parameter name off a declarator."""
+    p = param.strip()
+    if p.endswith("*") or p.endswith("&"):
+        return p
+    m = re.search(r"([A-Za-z_]\w*)\s*$", p)
+    if m and m.group(1) not in _TYPE_KEYWORDS and (
+            m.start() > 0 or "*" in p):
+        return p[:m.start()].strip()
+    return p
+
+
+def canon_c_type(t: str) -> str:
+    """'const char *' -> 'bytes', 'unsigned  int' -> 'u32', unknown
+    spellings normalize but pass through raw."""
+    t = re.sub(r"\bconst\b", " ", t)
+    t = re.sub(r"\bvolatile\b", " ", t)
+    stars = t.count("*")
+    t = t.replace("*", " ").replace("&", " ")
+    base = " ".join(t.split())
+    key = base + "*" * stars
+    if key in CANON_C:
+        return CANON_C[key]
+    if stars and base + "*" in CANON_C:
+        return "ptr"  # double+ indirection: plain pointer width
+    return key
+
+
+_NUM_RE = re.compile(
+    r"^[+-]?(0[xX][0-9a-fA-F]+|\d+\.\d*|\.\d+|\d+)([uUlLfF]*)$")
+
+
+def parse_c_value(raw: str) -> object:
+    """'36' -> 36, '0.125f' -> 0.125, '2166136261u' -> 2166136261,
+    '\"L5DWTS01\"' -> 'L5DWTS01'; anything else stays a string."""
+    s = raw.strip()
+    if len(s) >= 2 and s[0] == '"' and s[-1] == '"':
+        return s[1:-1]
+    m = _NUM_RE.match(s)
+    if m:
+        lit = m.group(1)
+        if lit.lower().startswith("0x"):
+            return int(lit, 16)
+        if "." in lit or "f" in m.group(2).lower() and "." in lit:
+            return float(lit)
+        if "." in lit:
+            return float(lit)
+        if "f" in m.group(2).lower():
+            return float(lit)
+        return int(lit)
+    return s

@@ -1,0 +1,322 @@
+"""The declared cross-plane contract the seam rules verify.
+
+The manifest is data, not code: every mirrored constant pair, stat
+passthrough, and engine-effective config knob is declared here with
+extraction sites on both planes. A pair that stops extracting (file
+moved, constant renamed) is itself a finding — manifest rot must not
+pass as a clean tree. Tests inject a mini manifest pointing at fixture
+trees; the live tree uses ``DEFAULT_MANIFEST``.
+
+Site kinds:
+
+- ``py-const``               first ``NAME = <literal>`` (module level, or
+                             inside ``cls`` when given); unwraps
+                             ``np.float32(x)``; bytes compare as ascii
+- ``py-dict-max``            max value of a literal ``NAME = {...: int}``
+- ``py-regex``               first match of ``pattern`` (one capture
+                             group) over the file text
+- ``c-const``                ``#define`` / ``constexpr`` NAME
+- ``c-regex``                first match of ``pattern`` over the
+                             comment-stripped source (optionally scoped
+                             to function ``func``'s body)
+- ``c-struct-float-count``   number of float fields of struct ``name``
+- ``c-struct-field-index``   index of ``field`` among the float fields
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Tuple
+
+
+@dataclass(frozen=True)
+class Site:
+    kind: str
+    path: str
+    name: str = ""      # constant/struct name, or the regex pattern
+    field: str = ""     # c-struct-field-index: the field
+    cls: str = ""       # py-const / py-dict-max: enclosing class
+    func: str = ""      # c-regex: restrict to this function's body
+
+
+@dataclass(frozen=True)
+class ConstPair:
+    name: str
+    sites: Tuple[Site, ...]
+    note: str = ""
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One config surface documented as engine-effective: loading a
+    config that sets it MUST reach the named engine wrapper methods."""
+    label: str
+    anchor_path: str     # where the surface is defined (spec dataclass)
+    anchor_re: str       # regex locating the anchor line in that file
+    methods: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class SeamManifest:
+    # ABI: the C sources compiled into libl5d_native.so (native/build.py)
+    # and the ctypes table binding them.
+    abi_sources: Tuple[str, ...]
+    binding: str
+    const_pairs: Tuple[ConstPair, ...] = ()
+    # near-miss scan: C constant names (len >= 4, SHOUT_CASE) defined in
+    # these C files AND as module constants anywhere under these python
+    # roots must appear in const_pairs or near_miss_allow.
+    near_miss_c: Tuple[str, ...] = ()
+    near_miss_py_roots: Tuple[str, ...] = ()
+    near_miss_allow: Dict[str, str] = field(default_factory=dict)
+    # stats: emitter functions on the C side; python files whose string
+    # literals count as "scraped"; keys served verbatim (documented why)
+    emitters: Tuple[Tuple[str, str], ...] = ()
+    scrape_files: Tuple[str, ...] = ()
+    stats_passthrough: Dict[str, str] = field(default_factory=dict)
+    # knobs: python roots that count as "a config path" (the linker,
+    # control plane, and controller — NOT the binding itself)
+    knob_scope: Tuple[str, ...] = ()
+    knobs: Tuple[Knob, ...] = ()
+
+
+def _col(idx_name: str, field_name: str) -> ConstPair:
+    """A FeatureRow column index mirrored as a linerate NATIVE_COL_*."""
+    return ConstPair(
+        idx_name,
+        (Site("py-const", "linkerd_tpu/telemetry/linerate.py", idx_name),
+         Site("c-struct-field-index", "native/fastpath.cpp",
+              "FeatureRow", field=field_name),
+         Site("c-struct-field-index", "native/h2_fastpath.cpp",
+              "FeatureRow", field=field_name)),
+        note="feature-row column layout (training decode <-> engine)")
+
+
+def _row_kind(py_name: str, c_name: str) -> ConstPair:
+    return ConstPair(
+        py_name,
+        (Site("py-const", "linkerd_tpu/telemetry/linerate.py", py_name),
+         Site("py-const", "linkerd_tpu/streams/tracker.py", c_name),
+         Site("c-const", "native/stream_track.h", c_name)),
+        note="feature-row kind tag (column NATIVE_COL_KIND)")
+
+
+def _scorer_const(name: str) -> ConstPair:
+    return ConstPair(
+        name,
+        (Site("py-const", "linkerd_tpu/lifecycle/export.py", name),
+         Site("c-const", "native/scorer.h", name)),
+        note="weight-blob wire format (exporter <-> native scorer)")
+
+
+def _h2_flag(name: str) -> ConstPair:
+    return ConstPair(
+        name,
+        (Site("py-const", "linkerd_tpu/protocol/h2/frames.py", name),
+         Site("c-const", "native/h2_core.h", name)),
+        note="h2 frame flag bit (python framer <-> native engine)")
+
+
+_MAGIC_RE = r'open_blob\([^,]+,\s*[^,]+,\s*"(\w+)"'
+_FNV_OFFSET_PY = r"^\s*h = (\d+)$"
+_FNV_PRIME_PY = r"h = \(h \* (\d+)\)"
+
+CONST_PAIRS: Tuple[ConstPair, ...] = (
+    ConstPair(
+        "FEATURE_DIM",
+        (Site("py-const", "linkerd_tpu/models/features.py",
+              "FEATURE_DIM"),
+         Site("c-const", "native/scorer.h", "FEATURE_DIM")),
+        note="scoring feature vector width (encoder <-> native scorer)"),
+    ConstPair(
+        "STATUS_ONEHOT_OFF",
+        (Site("py-const", "linkerd_tpu/models/features.py",
+              "STATUS_ONEHOT_OFF"),
+         Site("c-const", "native/scorer.h", "STATUS_ONEHOT_OFF")),
+        note="status one-hot block offset inside the feature vector"),
+    ConstPair(
+        "NATIVE_ROW_WIDTH",
+        (Site("py-const", "linkerd_tpu/telemetry/linerate.py",
+              "NATIVE_ROW_WIDTH"),
+         Site("py-const", "linkerd_tpu/native/__init__.py",
+              "FEATURE_DIM", cls="FastPathEngine"),
+         Site("c-struct-float-count", "native/fastpath.cpp",
+              "FeatureRow"),
+         Site("c-struct-float-count", "native/h2_fastpath.cpp",
+              "FeatureRow")),
+        note="engine feature-row float width (drain_features stride)"),
+    _col("NATIVE_COL_SCORE", "score"),
+    _col("NATIVE_COL_SCORED", "scored"),
+    _col("NATIVE_COL_TENANT", "tenant"),
+    _col("NATIVE_COL_KIND", "kind"),
+    _col("NATIVE_COL_STREAM", "stream"),
+    _col("NATIVE_COL_SEQ", "frame_seq"),
+    _row_kind("NATIVE_KIND_REQUEST", "ROW_REQUEST"),
+    _row_kind("NATIVE_KIND_STREAM", "ROW_STREAM"),
+    _row_kind("NATIVE_KIND_TUNNEL", "ROW_TUNNEL"),
+    ConstPair(
+        "FRAME_DATA",
+        (Site("py-const", "linkerd_tpu/streams/tracker.py",
+              "FRAME_DATA"),
+         Site("c-const", "native/stream_track.h", "FRAME_DATA")),
+        note="frame kind fed to stream accumulators"),
+    ConstPair(
+        "FRAME_WINDOW_UPDATE",
+        (Site("py-const", "linkerd_tpu/streams/tracker.py",
+              "FRAME_WINDOW_UPDATE"),
+         Site("c-const", "native/stream_track.h",
+              "FRAME_WINDOW_UPDATE")),
+        note="frame kind fed to stream accumulators"),
+    ConstPair(
+        "FRAME_ANOMALY",
+        (Site("py-const", "linkerd_tpu/streams/tracker.py",
+              "FRAME_ANOMALY"),
+         Site("c-const", "native/stream_track.h", "FRAME_ANOMALY")),
+        note="frame kind fed to stream accumulators"),
+    ConstPair(
+        "WEIGHT_MAGIC",
+        (Site("py-const", "linkerd_tpu/lifecycle/export.py",
+              "WEIGHT_MAGIC"),
+         Site("c-regex", "native/scorer.h", _MAGIC_RE,
+              func="parse_blob")),
+        note="single-model weight blob magic"),
+    ConstPair(
+        "BANK_MAGIC",
+        (Site("py-const", "linkerd_tpu/lifecycle/export.py",
+              "BANK_MAGIC"),
+         Site("c-regex", "native/scorer.h", _MAGIC_RE,
+              func="parse_bank_blob")),
+        note="specialist-bank blob magic"),
+    ConstPair(
+        "DELTA_MAGIC",
+        (Site("py-const", "linkerd_tpu/lifecycle/export.py",
+              "DELTA_MAGIC"),
+         Site("c-regex", "native/scorer.h", _MAGIC_RE,
+              func="parse_delta_blob")),
+        note="delta-patch blob magic"),
+    _scorer_const("QUANT_F32"),
+    _scorer_const("QUANT_INT8"),
+    _scorer_const("QUANT_INT4"),
+    _scorer_const("DELTA_OP_UPSERT"),
+    _scorer_const("DELTA_OP_REMOVE"),
+    _scorer_const("MAX_HEADS"),
+    _scorer_const("MAX_DELTA_OPS"),
+    ConstPair(
+        "FNV_OFFSET_BASIS",
+        (Site("py-regex", "linkerd_tpu/router/tenancy.py",
+              _FNV_OFFSET_PY),
+         Site("py-regex", "linkerd_tpu/lifecycle/export.py",
+              _FNV_OFFSET_PY),
+         Site("c-regex", "native/tenant_guard.h",
+              r"uint32_t h = (\d+)u;")),
+        note="FNV-1a offset basis: tenant + route-head hashing"),
+    ConstPair(
+        "FNV_PRIME",
+        (Site("py-regex", "linkerd_tpu/router/tenancy.py",
+              _FNV_PRIME_PY),
+         Site("py-regex", "linkerd_tpu/lifecycle/export.py",
+              _FNV_PRIME_PY),
+         Site("c-regex", "native/tenant_guard.h", r"h \*= (\d+)u;")),
+        note="FNV-1a prime: tenant + route-head hashing"),
+    ConstPair(
+        "STREAM_GAP_ALPHA",
+        (Site("py-const", "linkerd_tpu/streams/tracker.py", "_ALPHA"),
+         Site("c-regex", "native/stream_track.h",
+              r"gap_ewma_ms \+= ([0-9.]+)f \* d;")),
+        note="stream accumulator EWMA smoothing (score parity)"),
+    ConstPair(
+        "STREAM_SCORE_ALPHA",
+        (Site("py-const", "linkerd_tpu/streams/sentinel.py",
+              "_SCORE_ALPHA"),
+         Site("c-regex", "native/stream_track.h",
+              r"score_ewma \+= ([0-9.]+)f \* \(score")),
+        note="hysteresis-governor score EWMA (native gov_observe)"),
+    ConstPair(
+        "TENANT_KIND_MAX",
+        (Site("py-dict-max", "linkerd_tpu/native/__init__.py",
+              "TENANT_KINDS", cls="FastPathEngine"),
+         Site("c-regex", "native/fastpath.cpp",
+              r"kind < 0 \|\| kind > (\d+)"),
+         Site("c-regex", "native/h2_fastpath.cpp",
+              r"kind < 0 \|\| kind > (\d+)")),
+        note="tenant-extraction kind enum upper bound (set_tenant)"),
+    ConstPair(
+        "STREAM_ACTION_MAX",
+        (Site("py-dict-max", "linkerd_tpu/native/__init__.py",
+              "STREAM_ACTIONS", cls="FastPathEngine"),
+         Site("c-regex", "native/fastpath.cpp",
+              r"action < 0 \|\| action > (\d+)"),
+         Site("c-regex", "native/h2_fastpath.cpp",
+              r"action < 0 \|\| action > (\d+)")),
+        note="stream-scoring action enum upper bound (set_stream_cfg)"),
+    _h2_flag("FLAG_END_STREAM"),
+    _h2_flag("FLAG_ACK"),
+    _h2_flag("FLAG_END_HEADERS"),
+    _h2_flag("FLAG_PADDED"),
+    _h2_flag("FLAG_PRIORITY"),
+)
+
+# by_stream per-entry detail: FastPathController.streams_snapshot serves
+# the engine's streams_json document verbatim on /streams.json; python
+# merges only the top-level counters (_STREAM_KEYS), so the detail keys
+# never appear as scrape literals and that is by design.
+_PASSTHROUGH_WHY = ("served verbatim via /streams.json "
+                    "(FastPathController.streams_snapshot)")
+
+_KNOBS: Tuple[Knob, ...] = (
+    Knob("router.servers[].tls", "linkerd_tpu/linker.py",
+         r"class ServerSpec", ("set_tls", "listen_tls")),
+    Knob("router.client.tls", "linkerd_tpu/linker.py",
+         r"def _fastpath_client_tls", ("set_client_tls",)),
+    Knob("router.tenantIdentifier", "linkerd_tpu/linker.py",
+         r"tenantIdentifier", ("set_tenant",)),
+    Knob("router.tenants quotas", "linkerd_tpu/linker.py",
+         r"class TenantsSpec", ("set_tenant_quota",)),
+    Knob("router.connectionGuard", "linkerd_tpu/linker.py",
+         r"class ConnectionGuardSpec",
+         ("set_guard", "set_flood_guard", "set_tunnel_guard")),
+    Knob("router.streamScoring", "linkerd_tpu/linker.py",
+         r"class StreamScoringSpec", ("set_stream_cfg",)),
+    Knob("router.servers[].timeoutMs (h2 fastPath)",
+         "linkerd_tpu/linker.py", r"timeoutMs: Optional\[int\]",
+         ("set_response_timeout_ms",)),
+    Knob("namer-driven routing (dtab resolution)",
+         "linkerd_tpu/router/fastpath.py", r"class FastPathController",
+         ("set_route", "remove_route")),
+    Knob("model publish (weights / delta)",
+         "linkerd_tpu/router/fastpath.py", r"class FastPathController",
+         ("publish_weights", "publish_delta")),
+)
+
+DEFAULT_MANIFEST = SeamManifest(
+    abi_sources=("native/l5d_native.cpp", "native/fastpath.cpp",
+                 "native/h2_fastpath.cpp"),
+    binding="linkerd_tpu/native/__init__.py",
+    const_pairs=CONST_PAIRS,
+    near_miss_c=("native/fastpath.cpp", "native/h2_fastpath.cpp",
+                 "native/l5d_native.cpp", "native/scorer.h",
+                 "native/stream_track.h", "native/tenant_guard.h",
+                 "native/h2_core.h", "native/tls_engine.h",
+                 "native/tls_shim.h"),
+    near_miss_py_roots=("linkerd_tpu",),
+    near_miss_allow={},
+    emitters=(("native/fastpath.cpp", "fp_stats_json"),
+              ("native/h2_fastpath.cpp", "fph2_stats_json"),
+              ("native/tenant_guard.h", "tenants_json"),
+              ("native/tenant_guard.h", "guard_json"),
+              ("native/scorer.h", "stats_json"),
+              ("native/stream_track.h", "streams_json")),
+    scrape_files=("linkerd_tpu/router/fastpath.py",
+                  "linkerd_tpu/native/__init__.py"),
+    stats_passthrough={
+        "kind": _PASSTHROUGH_WHY, "samples": _PASSTHROUGH_WHY,
+        "frames": _PASSTHROUGH_WHY, "bytes": _PASSTHROUGH_WHY,
+        "sick": _PASSTHROUGH_WHY, "live": _PASSTHROUGH_WHY,
+        "by_stream": _PASSTHROUGH_WHY,
+    },
+    knob_scope=("linkerd_tpu/linker.py", "linkerd_tpu/router",
+                "linkerd_tpu/control", "linkerd_tpu/lifecycle",
+                "linkerd_tpu/streams", "linkerd_tpu/fleet",
+                "linkerd_tpu/distill"),
+    knobs=_KNOBS,
+)

@@ -1,0 +1,469 @@
+"""Python-side extraction for the seam analyzer.
+
+Reads the hand-maintained ctypes table in ``linkerd_tpu/native`` the
+way the interpreter would — without importing it (importing triggers a
+native build). A tiny abstract interpreter walks every module-level
+function and executes just enough python to recover the declaration
+table:
+
+- ``cdll.fp_create.argtypes = [...]`` / ``.restype = X``
+- ``fn = getattr(cdll, prefix + "_set_tls"); fn.argtypes = [...]``
+- ``for prefix in ("fp", "fph2"): ...`` loops, unrolled
+- helper inlining (``_declare_tls(cdll, "fp")``) with constant args
+- list arithmetic (``[c_void_p] + [c_long] * 6``)
+
+Also extracts: the wrapper-method -> C-symbol map (for knob plumbing),
+scrape-key tuples (for the stats contract), and module/class constants
+(for const parity).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+# canonical width classes shared with ctok.CANON_C
+CANON_CTYPES = {
+    "c_void_p": "ptr",
+    "c_char_p": "bytes",
+    "c_size_t": "u64", "c_ssize_t": "i64",
+    "c_int": "i32", "c_int32": "i32",
+    "c_uint": "u32", "c_uint32": "u32",
+    "c_long": "i64", "c_longlong": "i64", "c_int64": "i64",
+    "c_ulong": "u64", "c_ulonglong": "u64", "c_uint64": "u64",
+    "c_float": "f32", "c_double": "f64",
+    "c_bool": "i8", "c_char": "i8", "c_byte": "i8", "c_ubyte": "u8",
+    "c_short": "i16", "c_ushort": "u16",
+    "c_int8": "i8", "c_uint8": "u8", "c_int16": "i16", "c_uint16": "u16",
+}
+
+_POINTER_CANON = {
+    "f32": "f32*", "f64": "f64*", "i32": "i32*", "u32": "u32*",
+    "i64": "i64*", "u64": "u64*", "i8": "bytes", "u8": "bytes",
+}
+
+_HANDLE = object()    # a ctypes.CDLL handle
+_UNKNOWN = object()   # anything the interpreter cannot model
+
+_UNRESOLVED = "<unresolved>"
+
+
+@dataclass
+class _Sym:
+    """A ``getattr(cdll, name)`` result: a handle to one export."""
+    name: str
+
+
+@dataclass
+class Binding:
+    symbol: str
+    argtypes: Optional[object]  # list of tokens | _UNRESOLVED | None
+    restype: Optional[str]      # token | None = never declared
+    line: int
+
+
+def _callee(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def ctype_token(node: ast.AST) -> Optional[str]:
+    """'ctypes.c_long' / 'c_long' / 'POINTER(c_float)' / None-constant
+    -> canonical width token; anything else -> None."""
+    name = None
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    if name is not None:
+        return CANON_CTYPES.get(name)
+    if isinstance(node, ast.Call) and _callee(node) == "POINTER" \
+            and node.args:
+        inner = ctype_token(node.args[0])
+        if inner is None:
+            return None
+        return _POINTER_CANON.get(inner, inner + "*")
+    if isinstance(node, ast.Constant) and node.value is None:
+        return "void"
+    return None
+
+
+class _TableReader:
+    """The abstract interpreter over one binding module."""
+
+    def __init__(self, tree: ast.Module):
+        self.funcs: Dict[str, ast.FunctionDef] = {
+            n.name: n for n in tree.body
+            if isinstance(n, ast.FunctionDef)}
+        self.bindings: Dict[str, Binding] = {}
+        # module body statements may declare too (rare but legal)
+        self._exec(tree.body, {}, 0)
+        for fn in self.funcs.values():
+            env: Dict[str, object] = {}
+            for a in fn.args.args:
+                ann = ast.dump(a.annotation) if a.annotation else ""
+                env[a.arg] = (_HANDLE if "CDLL" in ann
+                              or a.arg in ("cdll", "lib") else _UNKNOWN)
+            self._exec(fn.body, env, 0)
+
+    # -- statement walk --------------------------------------------------
+    def _exec(self, body, env: Dict[str, object], depth: int) -> None:
+        for stmt in body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                self._assign(stmt, env)
+            elif isinstance(stmt, ast.Expr) \
+                    and isinstance(stmt.value, ast.Call):
+                self._inline(stmt.value, env, depth)
+            elif isinstance(stmt, ast.For):
+                self._unroll(stmt, env, depth)
+            elif isinstance(stmt, ast.If):
+                self._exec(stmt.body, env, depth)
+                self._exec(stmt.orelse, env, depth)
+            elif isinstance(stmt, ast.Try):
+                self._exec(stmt.body, env, depth)
+                for h in stmt.handlers:
+                    self._exec(h.body, env, depth)
+                self._exec(stmt.orelse, env, depth)
+                self._exec(stmt.finalbody, env, depth)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._exec(stmt.body, env, depth)
+
+    def _assign(self, stmt: ast.Assign, env: Dict[str, object]) -> None:
+        t = stmt.targets[0]
+        if isinstance(t, ast.Attribute) and t.attr in ("argtypes",
+                                                       "restype"):
+            sym = self._symbol_of(t.value, env)
+            if sym is None:
+                return
+            b = self.bindings.setdefault(
+                sym, Binding(sym, None, None, stmt.lineno))
+            if t.attr == "argtypes":
+                if b.argtypes is None:
+                    b.argtypes = self._eval_types(stmt.value, env)
+                    if b.argtypes is None:
+                        b.argtypes = _UNRESOLVED
+            else:
+                if b.restype is None:
+                    b.restype = ctype_token(stmt.value) or _UNRESOLVED
+        elif isinstance(t, ast.Name):
+            env[t.id] = self._eval(stmt.value, env)
+
+    def _symbol_of(self, node: ast.AST,
+                   env: Dict[str, object]) -> Optional[str]:
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and env.get(node.value.id) is _HANDLE:
+            return node.attr
+        if isinstance(node, ast.Name):
+            v = env.get(node.id)
+            if isinstance(v, _Sym):
+                return v.name
+        return None
+
+    # -- expression eval -------------------------------------------------
+    def _eval(self, node: ast.AST, env: Dict[str, object]) -> object:
+        if isinstance(node, ast.Constant):
+            return node.value
+        if isinstance(node, ast.Name):
+            return env.get(node.id, _UNKNOWN)
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            a = self._eval(node.left, env)
+            b = self._eval(node.right, env)
+            if isinstance(a, str) and isinstance(b, str):
+                return a + b
+            return _UNKNOWN
+        if isinstance(node, ast.Call):
+            callee = _callee(node)
+            if callee == "getattr" and len(node.args) >= 2:
+                base = self._eval(node.args[0], env)
+                name = self._eval(node.args[1], env)
+                if base is _HANDLE and isinstance(name, str):
+                    return _Sym(name)
+            elif callee in ("CDLL", "PyDLL", "WinDLL"):
+                return _HANDLE  # `cdll = ctypes.CDLL(path)` in lib()
+        return _UNKNOWN
+
+    def _eval_types(self, node: ast.AST,
+                    env: Dict[str, object]) -> Optional[List[str]]:
+        if isinstance(node, (ast.List, ast.Tuple)):
+            out = []
+            for e in node.elts:
+                tok = ctype_token(e)
+                if tok is None:
+                    return None
+                out.append(tok)
+            return out
+        if isinstance(node, ast.BinOp):
+            if isinstance(node.op, ast.Add):
+                a = self._eval_types(node.left, env)
+                b = self._eval_types(node.right, env)
+                if a is not None and b is not None:
+                    return a + b
+                return None
+            if isinstance(node.op, ast.Mult):
+                for lst, num in ((node.left, node.right),
+                                 (node.right, node.left)):
+                    types = self._eval_types(lst, env)
+                    if types is not None \
+                            and isinstance(num, ast.Constant) \
+                            and isinstance(num.value, int):
+                        return types * num.value
+        return None
+
+    # -- control flow ----------------------------------------------------
+    def _inline(self, call: ast.Call, env: Dict[str, object],
+                depth: int) -> None:
+        name = call.func.id if isinstance(call.func, ast.Name) else None
+        fn = self.funcs.get(name or "")
+        if fn is None or depth >= 6 or call.keywords:
+            return
+        params = [a.arg for a in fn.args.args]
+        if len(call.args) > len(params):
+            return
+        env2 = {p: _UNKNOWN for p in params}
+        for p, arg in zip(params, call.args):
+            env2[p] = self._eval(arg, env)
+        self._exec(fn.body, env2, depth + 1)
+
+    def _unroll(self, stmt: ast.For, env: Dict[str, object],
+                depth: int) -> None:
+        if not isinstance(stmt.target, ast.Name) \
+                or not isinstance(stmt.iter, (ast.Tuple, ast.List)):
+            self._exec(stmt.body, env, depth)
+            return
+        for elt in stmt.iter.elts:
+            env[stmt.target.id] = self._eval(elt, env)
+            self._exec(stmt.body, env, depth)
+
+
+def read_bindings(tree: ast.Module) -> Dict[str, Binding]:
+    """symbol -> Binding for every ``argtypes``/``restype`` declaration
+    the interpreter can reach."""
+    return _TableReader(tree).bindings
+
+
+# -- wrapper map (knob plumbing) ---------------------------------------------
+
+_SYM_PREFIX_RE = re.compile(r"^(fp|fph2|l5d)_")
+
+
+def wrapper_map(tree: ast.Module) -> Dict[str, Tuple[str, int]]:
+    """C symbol -> (python wrapper callable, line). A wrapper is any
+    function/method whose body reaches the symbol:
+
+    - directly: ``self._lib.fp_shutdown(...)`` or any ``.fp_x``/
+      ``.fph2_x``/``.l5d_x`` attribute access
+    - by getattr: ``getattr(self._lib, "fp_x")`` or the
+      ``self._PREFIX + "_suffix"`` idiom (including the local alias
+      form ``p = self._PREFIX; getattr(cdll, p + "_x")``), expanded
+      over every ``_PREFIX`` value assigned in the module — the
+      over-approximation is harmless because callers filter against
+      the real export list
+    - through a bound handle: ``self._fn_x = getattr(cdll, p + "_x")``
+      in one method, ``self._fn_x(...)`` in another; the wrapper is
+      the method that *loads* the handle, not the one that binds it
+    """
+    prefixes = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "_PREFIX" \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            prefixes.add(node.value.value)
+    out: Dict[str, Tuple[str, int]] = {}
+
+    def resolve_name(arg: ast.AST, prefix_vars) -> List[str]:
+        """The symbol name(s) a getattr name-expression denotes."""
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return [arg.value]
+        if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add) \
+                and isinstance(arg.right, ast.Constant) \
+                and isinstance(arg.right.value, str):
+            left = arg.left
+            is_prefix = (
+                (isinstance(left, ast.Attribute)
+                 and left.attr == "_PREFIX")
+                or (isinstance(left, ast.Name)
+                    and left.id in prefix_vars))
+            if is_prefix:
+                return [p + arg.right.value for p in sorted(prefixes)]
+        return []
+
+    def scan_scope(methods: List[ast.AST]) -> None:
+        handle_attrs: Dict[str, List[str]] = {}
+        direct: List[Tuple[ast.AST, str]] = []
+        for fn in methods:
+            prefix_vars = set()
+            decl_nodes = set()   # `X` in `X.argtypes = ...` stores
+            local_syms: Dict[str, List[str]] = {}
+            assigns = [n for n in ast.walk(fn)
+                       if isinstance(n, ast.Assign)
+                       and len(n.targets) == 1]
+            for node in assigns:
+                t, v = node.targets[0], node.value
+                if isinstance(t, ast.Name) \
+                        and isinstance(v, ast.Attribute) \
+                        and v.attr == "_PREFIX":
+                    prefix_vars.add(t.id)
+                elif isinstance(t, ast.Attribute) \
+                        and t.attr in ("argtypes", "restype"):
+                    decl_nodes.add(id(t.value))
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Attribute) \
+                        and isinstance(node.ctx, ast.Load) \
+                        and _SYM_PREFIX_RE.match(node.attr) \
+                        and id(node) not in decl_nodes:
+                    direct.append((fn, node.attr))
+                elif isinstance(node, ast.Call) \
+                        and _callee(node) == "getattr" \
+                        and len(node.args) >= 2:
+                    syms = resolve_name(node.args[1], prefix_vars)
+                    if not syms:
+                        continue
+                    bound = next((a for a in assigns
+                                  if a.value is node), None)
+                    if bound is None:
+                        direct.extend((fn, s) for s in syms)
+                    elif isinstance(bound.targets[0], ast.Attribute):
+                        attr = bound.targets[0].attr
+                        handle_attrs.setdefault(attr, []).extend(syms)
+                    elif isinstance(bound.targets[0], ast.Name):
+                        # `fn = getattr(cdll, ...)`: a wrapper only if
+                        # the local is later CALLED — argtypes/restype
+                        # stores alone are the declaration idiom
+                        local_syms.setdefault(
+                            bound.targets[0].id, []).extend(syms)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Name) \
+                        and node.func.id in local_syms:
+                    direct.extend((fn, s)
+                                  for s in local_syms[node.func.id])
+        for fn, sym in direct:
+            out.setdefault(sym, (fn.name, fn.lineno))
+        for fn in methods:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Attribute) \
+                        and isinstance(node.ctx, ast.Load) \
+                        and node.attr in handle_attrs:
+                    for sym in handle_attrs[node.attr]:
+                        out.setdefault(sym, (fn.name, fn.lineno))
+
+    module_fns = [n for n in tree.body
+                  if isinstance(n, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef))]
+    scan_scope(module_fns)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            scan_scope([n for n in node.body
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))])
+    return out
+
+
+# -- scrape keys (stats contract) --------------------------------------------
+
+_KEYS_NAME_RE = re.compile(r"_?[A-Z0-9_]*KEYS$")
+
+
+def _str_tuple(node: ast.AST) -> Optional[List[str]]:
+    if isinstance(node, (ast.Tuple, ast.List)) and node.elts and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in node.elts):
+        return [e.value for e in node.elts]
+    return None
+
+
+def _loop_var_indexes(stmt: ast.For) -> bool:
+    """True when the loop variable is used as a lookup key in the body
+    (``d[k]``, ``.get(k, ...)``, ``gauge(k)``) — the scrape idiom, as
+    opposed to e.g. string-building loops over symbol prefixes."""
+    if not isinstance(stmt.target, ast.Name):
+        return False
+    var = stmt.target.id
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.slice, ast.Name) \
+                and node.slice.id == var:
+            return True
+        if isinstance(node, ast.Call) and any(
+                isinstance(a, ast.Name) and a.id == var
+                for a in node.args):
+            return True
+    return False
+
+
+def scrape_keys(tree: ast.Module) -> Dict[str, int]:
+    """Stat names the controller scrapes: elements of ``*_KEYS`` tuple
+    constants plus tuples iterated by for loops whose variable keys a
+    lookup (the inline ``for k in ("scored", ...): ...get(k)`` idiom).
+    key -> first line."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        vals = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and _KEYS_NAME_RE.search(node.targets[0].id):
+            vals = _str_tuple(node.value)
+        elif isinstance(node, ast.For) and _loop_var_indexes(node):
+            vals = _str_tuple(node.iter)
+        if vals:
+            for v in vals:
+                out.setdefault(v, node.lineno)
+    return out
+
+
+# -- constants (const parity) ------------------------------------------------
+
+def _const_value(node: ast.AST) -> object:
+    """Constant | np.float32(c) | float(c)/int(c) | tuple | dict of
+    constants -> python value; else _UNKNOWN."""
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Call) and len(node.args) == 1 \
+            and _callee(node) in ("float32", "float64", "float", "int",
+                                  "uint32", "int32", "np_float32"):
+        return _const_value(node.args[0])
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = tuple(_const_value(e) for e in node.elts)
+        return _UNKNOWN if _UNKNOWN in vals else vals
+    if isinstance(node, ast.Dict):
+        out = {}
+        for k, v in zip(node.keys, node.values):
+            kv, vv = _const_value(k), _const_value(v)
+            if kv is _UNKNOWN or vv is _UNKNOWN:
+                return _UNKNOWN
+            out[kv] = vv
+        return out
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _const_value(node.operand)
+        return -v if isinstance(v, (int, float)) else _UNKNOWN
+    return _UNKNOWN
+
+
+def module_constant(tree: ast.Module, name: str,
+                    cls: str = "") -> Optional[Tuple[object, int]]:
+    """(value, line) of the first ``name = <literal>`` assignment —
+    module level, or inside class ``cls`` when given."""
+    scope: ast.AST = tree
+    if cls:
+        scope = next((n for n in ast.walk(tree)
+                      if isinstance(n, ast.ClassDef) and n.name == cls),
+                     None)
+        if scope is None:
+            return None
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == name:
+            v = _const_value(node.value)
+            if v is not _UNKNOWN:
+                return v, node.lineno
+    return None

@@ -1,0 +1,423 @@
+"""The four seam rules: abi-signature, const-parity, stats-contract,
+knob-plumbing. Each is a generator over a SeamProject; the runner in
+``__init__`` applies suppressions (python AND C comment syntax) on top.
+
+Design stance shared by all four: extraction failure is a finding, not
+a silent skip. A manifest site that stops matching, an emitter that
+vanished, or a binding file that went unparseable means the contract is
+no longer being checked — which is exactly the state the analyzer
+exists to prevent.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from tools.analysis.core import Finding, SourceFile
+from tools.analysis.seam import ctok
+from tools.analysis.seam.ctok import CSource
+from tools.analysis.seam.manifest import SeamManifest, Site
+from tools.analysis.seam import pybind
+
+_C_SUFFIXES = (".h", ".hpp", ".c", ".cc", ".cpp")
+
+
+class SeamProject:
+    """Lazily-loaded sources on both sides of the seam."""
+
+    def __init__(self, repo_root: str, manifest: SeamManifest):
+        self.repo_root = os.path.abspath(repo_root)
+        self.manifest = manifest
+        self._c: Dict[str, CSource] = {}
+        self._py: Dict[str, SourceFile] = {}
+
+    def _abs(self, rel: str) -> str:
+        absp = os.path.join(self.repo_root, rel)
+        if not os.path.exists(absp):
+            # same stance as core.Project: a typo'd path must not pass
+            # the gate as a clean empty tree
+            raise FileNotFoundError(f"seam scan path does not exist: {absp}")
+        return absp
+
+    def c(self, rel: str) -> CSource:
+        if rel not in self._c:
+            absp = self._abs(rel)
+            with open(absp, "r", encoding="utf-8") as fh:
+                self._c[rel] = CSource(absp, rel, fh.read())
+        return self._c[rel]
+
+    def py(self, rel: str) -> SourceFile:
+        if rel not in self._py:
+            absp = self._abs(rel)
+            with open(absp, "r", encoding="utf-8") as fh:
+                self._py[rel] = SourceFile(absp, rel, fh.read())
+        return self._py[rel]
+
+    def py_files_under(self, roots) -> List[str]:
+        out = []
+        for root in roots:
+            absp = self._abs(root)
+            if os.path.isfile(absp):
+                out.append(root)
+                continue
+            for base, dirs, files in os.walk(absp):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git")]
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        out.append(os.path.relpath(
+                            os.path.join(base, name), self.repo_root))
+        return sorted(set(out))
+
+    # -- shared ABI context (abi-signature + knob-plumbing) --------------
+    def exports(self) -> Dict[str, Tuple[str, ctok.CDecl]]:
+        if not hasattr(self, "_exports"):
+            table: Dict[str, Tuple[str, ctok.CDecl]] = {}
+            for rel in self.manifest.abi_sources:
+                for d in self.c(rel).exports():
+                    table.setdefault(d.name, (rel, d))
+            self._exports = table
+        return self._exports
+
+    def bindings(self) -> Dict[str, pybind.Binding]:
+        if not hasattr(self, "_bindings"):
+            tree = self.py(self.manifest.binding).tree
+            self._bindings = (pybind.read_bindings(tree)
+                              if tree is not None else {})
+        return self._bindings
+
+
+# -- abi-signature -----------------------------------------------------------
+
+def check_abi(proj: SeamProject) -> Iterator[Finding]:
+    m = proj.manifest
+    binding_src = proj.py(m.binding)
+    if binding_src.tree is None:
+        yield Finding("abi-signature", m.binding, 0, 0,
+                      f"binding module does not parse: "
+                      f"{binding_src.parse_error}")
+        return
+    exports = proj.exports()
+    bindings = proj.bindings()
+    if not exports:
+        yield Finding("abi-signature", m.abi_sources[0], 0, 0,
+                      'no extern "C" exports found across '
+                      f'{list(m.abi_sources)} — the ABI extraction is '
+                      f'broken or the sources moved')
+        return
+    for name, (rel, d) in sorted(exports.items()):
+        b = bindings.get(name)
+        if b is None:
+            yield Finding(
+                "abi-signature", rel, d.line, 0,
+                f"exported symbol {name!r} has no ctypes declaration in "
+                f"{m.binding} — an undeclared symbol makes ctypes guess "
+                f"c_int for every argument and the return at call time")
+            continue
+        if b.argtypes is None:
+            yield Finding(
+                "abi-signature", m.binding, b.line, 0,
+                f"binding for {name!r} never sets argtypes (C declares "
+                f"{len(d.params)} parameter(s))")
+        elif b.argtypes != pybind._UNRESOLVED:
+            if len(b.argtypes) != len(d.params):
+                yield Finding(
+                    "abi-signature", m.binding, b.line, 0,
+                    f"arity mismatch for {name!r}: ctypes declares "
+                    f"{len(b.argtypes)} argument(s) "
+                    f"({', '.join(b.argtypes) or 'none'}) but {rel}:"
+                    f"{d.line} declares {len(d.params)} "
+                    f"({', '.join(d.params) or 'none'})")
+            else:
+                for i, (ct, cc) in enumerate(zip(b.argtypes, d.params)):
+                    if ct != cc:
+                        yield Finding(
+                            "abi-signature", m.binding, b.line, 0,
+                            f"type-width mismatch for {name!r} arg "
+                            f"{i}: ctypes declares {ct} but {rel}:"
+                            f"{d.line} declares {cc}")
+        # an undeclared restype defaults to c_int in ctypes
+        ret = b.restype if b.restype is not None else "i32"
+        if ret != pybind._UNRESOLVED and ret != d.ret:
+            declared = (b.restype if b.restype is not None
+                        else "nothing (ctypes defaults to c_int -> i32)")
+            yield Finding(
+                "abi-signature", m.binding, b.line, 0,
+                f"return-width mismatch for {name!r}: ctypes declares "
+                f"{declared} but {rel}:{d.line} returns {d.ret}")
+    for name, b in sorted(bindings.items()):
+        if name not in exports:
+            yield Finding(
+                "abi-signature", m.binding, b.line, 0,
+                f"ctypes binding declares {name!r} but no extern \"C\" "
+                f"export in {list(m.abi_sources)} defines it — the "
+                f"symbol was removed or renamed on the C side")
+
+
+# -- const-parity ------------------------------------------------------------
+
+def _norm(v: object) -> object:
+    """Comparison key: numerics compare as float, bytes as ascii str."""
+    if isinstance(v, bool):
+        return float(v)
+    if isinstance(v, (int, float)):
+        return float(v)
+    if isinstance(v, bytes):
+        try:
+            return v.decode("ascii")
+        except UnicodeDecodeError:
+            return repr(v)
+    return v
+
+
+def _extract_site(proj: SeamProject, site: Site):
+    """(value, rel, line) or an error string."""
+    p = site.path
+    if site.kind == "py-const":
+        src = proj.py(p)
+        if src.tree is None:
+            return f"{p} does not parse"
+        got = pybind.module_constant(src.tree, site.name, cls=site.cls)
+        if got is None:
+            where = f"class {site.cls} of {p}" if site.cls else p
+            return f"no literal assignment to {site.name!r} in {where}"
+        return got[0], p, got[1]
+    if site.kind == "py-dict-max":
+        src = proj.py(p)
+        if src.tree is None:
+            return f"{p} does not parse"
+        got = pybind.module_constant(src.tree, site.name, cls=site.cls)
+        if got is None or not isinstance(got[0], dict) or not got[0]:
+            return f"no literal dict {site.name!r} in {p}"
+        vals = [v for v in got[0].values() if isinstance(v, (int, float))]
+        if not vals:
+            return f"dict {site.name!r} in {p} has no numeric values"
+        return max(vals), p, got[1]
+    if site.kind == "py-regex":
+        src = proj.py(p)
+        mm = re.search(site.name, src.text, re.M)
+        if not mm:
+            return f"pattern {site.name!r} matches nothing in {p}"
+        return (ctok.parse_c_value(mm.group(1)), p,
+                ctok.line_of(src.text, mm.start(1)))
+    if site.kind == "c-const":
+        consts = proj.c(p).constants()
+        if site.name not in consts:
+            return f"no #define/constexpr {site.name!r} in {p}"
+        v, line = consts[site.name]
+        return v, p, line
+    if site.kind == "c-regex":
+        csrc = proj.c(p)
+        text, base_line = csrc.clean, 1
+        if site.func:
+            body = csrc.function_body(site.func)
+            if body is None:
+                return f"no function {site.func!r} in {p}"
+            text, base_line = body
+        mm = re.search(site.name, text, re.M)
+        if not mm:
+            where = f"{site.func}() in {p}" if site.func else p
+            return f"pattern {site.name!r} matches nothing in {where}"
+        line = (base_line + text.count("\n", 0, mm.start(1))
+                if site.func else ctok.line_of(text, mm.start(1)))
+        return ctok.parse_c_value(mm.group(1)), p, line
+    if site.kind == "c-struct-float-count":
+        fields = proj.c(p).float_fields(site.name)
+        if not fields:
+            return f"struct {site.name!r} has no float fields in {p}"
+        mm = re.search(r"\bstruct\s+%s\s*\{" % re.escape(site.name),
+                       proj.c(p).code)
+        return len(fields), p, ctok.line_of(proj.c(p).code, mm.start())
+    if site.kind == "c-struct-field-index":
+        fields = proj.c(p).float_fields(site.name)
+        if site.field not in fields:
+            return (f"struct {site.name!r} in {p} has no float field "
+                    f"{site.field!r} (fields: {fields})")
+        mm = re.search(r"\bstruct\s+%s\s*\{" % re.escape(site.name),
+                       proj.c(p).code)
+        return (fields.index(site.field), p,
+                ctok.line_of(proj.c(p).code, mm.start()))
+    return f"unknown site kind {site.kind!r}"
+
+
+_SHOUT_RE = re.compile(r"^[A-Z][A-Z0-9_]{3,}$")
+
+
+def check_consts(proj: SeamProject) -> Iterator[Finding]:
+    m = proj.manifest
+    declared_names = set()
+    for pair in m.const_pairs:
+        declared_names.add(pair.name)
+        extracted = []
+        broken = False
+        for site in pair.sites:
+            if site.kind in ("py-const", "c-const"):
+                declared_names.add(site.name)
+            got = _extract_site(proj, site)
+            if isinstance(got, str):
+                yield Finding(
+                    "const-parity", site.path, 1, 0,
+                    f"manifest pair {pair.name!r}: extraction failed — "
+                    f"{got}; fix the code or the seam manifest "
+                    f"(tools/analysis/seam/manifest.py)")
+                broken = True
+                continue
+            extracted.append(got)
+        if broken or len(extracted) < 2:
+            continue
+        keys = {repr(_norm(v)) for v, _, _ in extracted}
+        if len(keys) > 1:
+            spread = "; ".join(f"{rel}:{line} = {v!r}"
+                               for v, rel, line in extracted)
+            v0, rel0, line0 = extracted[0]
+            yield Finding(
+                "const-parity", rel0, line0, 0,
+                f"mirrored constant {pair.name!r} disagrees across the "
+                f"seam: {spread}" + (f" ({pair.note})" if pair.note
+                                     else ""))
+    # near-miss scan: name-identical constants on both planes that the
+    # manifest does not declare rot silently the day one side changes.
+    c_consts: Dict[str, Tuple[object, str, int]] = {}
+    for rel in m.near_miss_c:
+        for name, (v, line) in proj.c(rel).constants().items():
+            if _SHOUT_RE.match(name):
+                c_consts.setdefault(name, (v, rel, line))
+    if not c_consts:
+        return
+    for py_rel in proj.py_files_under(m.near_miss_py_roots):
+        src = proj.py(py_rel)
+        for name, (cv, c_rel, c_line) in c_consts.items():
+            if name in declared_names or name in m.near_miss_allow:
+                continue
+            mm = re.search(r"^%s\s*=\s*(.+?)\s*(?:#.*)?$" % name,
+                           src.text, re.M)
+            if not mm:
+                continue
+            pv = ctok.parse_c_value(mm.group(1))
+            line = ctok.line_of(src.text, mm.start())
+            same = repr(_norm(pv)) == repr(_norm(cv))
+            detail = ("values currently agree"
+                      if same else
+                      f"and they DISAGREE (python {pv!r} vs C {cv!r})")
+            yield Finding(
+                "const-parity", py_rel, line, 0,
+                f"undeclared mirror: {name!r} is defined here and as a "
+                f"constant in {c_rel}:{c_line} ({detail}) — declare "
+                f"the pair in tools/analysis/seam/manifest.py so drift "
+                f"is caught, or rename one side")
+
+
+# -- stats-contract ----------------------------------------------------------
+
+def check_stats(proj: SeamProject) -> Iterator[Finding]:
+    m = proj.manifest
+    emitted: Dict[str, Tuple[str, int]] = {}
+    for rel, func in m.emitters:
+        keys = proj.c(rel).emitted_keys(func)
+        if not keys:
+            yield Finding(
+                "stats-contract", rel, 1, 0,
+                f"manifest emitter {func!r} emits no JSON keys in {rel} "
+                f"(function missing or renamed) — fix the seam manifest")
+            continue
+        for k, line in keys:
+            emitted.setdefault(k, (rel, line))
+    scrape_texts = [(p, proj.py(p).text) for p in m.scrape_files]
+    for key in sorted(emitted):
+        if key in m.stats_passthrough:
+            continue
+        rel, line = emitted[key]
+        pat = re.compile(r"""['"]%s['"]""" % re.escape(key))
+        if not any(pat.search(text) for _, text in scrape_texts):
+            yield Finding(
+                "stats-contract", rel, line, 0,
+                f"engine stat {key!r} is emitted here but scraped "
+                f"nowhere in {list(m.scrape_files)} — a dead metric the "
+                f"admin plane silently drops (scrape it, or declare it "
+                f"in stats_passthrough with a reason)")
+    for p in m.scrape_files:
+        src = proj.py(p)
+        if src.tree is None:
+            continue
+        for key, line in sorted(pybind.scrape_keys(src.tree).items()):
+            if key not in emitted:
+                yield Finding(
+                    "stats-contract", p, line, 0,
+                    f"scraped stat {key!r} is emitted by no engine "
+                    f"emitter ({', '.join(f for _, f in m.emitters)}) — "
+                    f"the gauge reads 0 forever (renamed on the C "
+                    f"side?)")
+
+
+# -- knob-plumbing -----------------------------------------------------------
+
+_SETTER_RE = re.compile(r"^(fp|fph2)_(set_\w+)$")
+
+
+def _knob_corpus(proj: SeamProject) -> List[Tuple[str, str]]:
+    m = proj.manifest
+    out = []
+    for rel in proj.py_files_under(m.knob_scope):
+        if rel.replace(os.sep, "/") == m.binding:
+            continue
+        out.append((rel, proj.py(rel).text))
+    return out
+
+
+def check_knobs(proj: SeamProject) -> Iterator[Finding]:
+    m = proj.manifest
+    binding_src = proj.py(m.binding)
+    if binding_src.tree is None:
+        return  # abi-signature already reports the parse failure
+    wmap = pybind.wrapper_map(binding_src.tree)
+    corpus = _knob_corpus(proj)
+
+    def called(method: str) -> bool:
+        pat = re.compile(r"\b%s\b" % re.escape(method))
+        return any(pat.search(text) for _, text in corpus)
+
+    for name, (rel, d) in sorted(proj.exports().items()):
+        if not _SETTER_RE.match(name):
+            continue
+        wrapper = wmap.get(name)
+        if wrapper is None:
+            yield Finding(
+                "knob-plumbing", rel, d.line, 0,
+                f"engine setter {name!r} has no python wrapper in "
+                f"{m.binding} — no config path can ever reach it")
+        elif not called(wrapper[0]):
+            yield Finding(
+                "knob-plumbing", m.binding, wrapper[1], 0,
+                f"engine setter {name!r} (wrapper .{wrapper[0]}()) is "
+                f"invoked by no config path under {list(m.knob_scope)} "
+                f"— a dead knob: either plumb the config surface that "
+                f"documents it, or remove the setter")
+    for knob in m.knobs:
+        anchor_src = proj.py(knob.anchor_path)
+        am = re.search(knob.anchor_re, anchor_src.text, re.M)
+        if am is None:
+            yield Finding(
+                "knob-plumbing", knob.anchor_path, 1, 0,
+                f"knob {knob.label!r}: anchor pattern "
+                f"{knob.anchor_re!r} matches nothing in "
+                f"{knob.anchor_path} — fix the seam manifest")
+            continue
+        line = ctok.line_of(anchor_src.text, am.start())
+        for method in knob.methods:
+            if not called(method):
+                yield Finding(
+                    "knob-plumbing", knob.anchor_path, line, 0,
+                    f"config surface {knob.label!r} is documented as "
+                    f"engine-effective but .{method}() is called from "
+                    f"no config path under {list(m.knob_scope)} — the "
+                    f"knob is silently inert")
+
+
+RULE_FNS = (
+    ("abi-signature", check_abi),
+    ("const-parity", check_consts),
+    ("stats-contract", check_stats),
+    ("knob-plumbing", check_knobs),
+)

@@ -77,11 +77,11 @@ def _run(source: ConfigSource, data: Any = None) -> List[Finding]:
             data = parse_config(source.text)
         except ConfigError as e:
             findings.append(source.finding("config-parse", str(e)))
-            return _apply_suppressions(source, findings)
+            return _apply_suppressions(source, findings, stale_check=False)
     if not isinstance(data, dict):
         findings.append(source.finding(
             "config-parse", "config must be a mapping"))
-        return _apply_suppressions(source, findings)
+        return _apply_suppressions(source, findings, stale_check=False)
 
     if "routers" in data:
         findings.extend(_check_linker(source, data))
@@ -97,14 +97,16 @@ def _run(source: ConfigSource, data: Any = None) -> List[Finding]:
     return findings
 
 
-def _apply_suppressions(source: ConfigSource,
-                        findings: List[Finding]) -> List[Finding]:
+def _apply_suppressions(source: ConfigSource, findings: List[Finding],
+                        stale_check: bool = True) -> List[Finding]:
+    used = set()  # suppression lines that silenced something
     for f in findings:
         sup = source.suppression_for(f.rule, f.line)
         if sup is not None and sup.justified:
             f.suppressed = True
             f.justification = sup.justification
-    known = set(SEMANTIC_RULES) | {"suppression"}
+            used.add(sup.line)
+    known = set(SEMANTIC_RULES) | {"suppression", "stale-suppression"}
     for sup in source.suppressions.values():
         if not sup.justified:
             findings.append(Finding(
@@ -117,6 +119,28 @@ def _apply_suppressions(source: ConfigSource,
                     "suppression", source.rel, sup.line, 0,
                     f"suppression names unknown semantic rule {r!r} "
                     f"(known: {sorted(known)})"))
+    # stale-suppression: a justified waiver silencing nothing is debt.
+    # Skipped when the document failed parsing (stale_check=False —
+    # most rules never ran, so "unused" is unknowable).
+    if stale_check:
+        for sup in source.suppressions.values():
+            if not sup.justified or sup.line in used:
+                continue
+            named = set(sup.rules)
+            if not named or not named <= set(SEMANTIC_RULES):
+                continue
+            f = Finding(
+                "stale-suppression", source.rel, sup.line, 0,
+                f"suppression for {sorted(named)} no longer silences "
+                f"any finding — the excused config was fixed or "
+                f"removed; delete the ignore (it would hide future "
+                f"regressions here)")
+            stale_sup = source.suppression_for(
+                "stale-suppression", sup.line)
+            if stale_sup is not None and stale_sup.justified:
+                f.suppressed = True
+                f.justification = stale_sup.justification
+            findings.append(f)
     return findings
 
 

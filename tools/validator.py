@@ -29,6 +29,12 @@ And the l5drace await-atomicity/lock-discipline analysis
 
     python tools/validator.py race [path ...]
 
+And the l5dseam cross-plane contract sweep (tools/analysis/seam) over
+the C++/Python boundary — ABI widths, mirrored constants, the stats
+scrape map, knob plumbing (whole-seam, takes no paths):
+
+    python tools/validator.py seam
+
 And the l5dcheck semantic config verification (tools/analysis/semantic)
 over linker/namerd YAML — defaults to every fixture under tests/configs/
 and examples/ when no files are given:
@@ -1876,12 +1882,30 @@ def validate_race(paths) -> int:
     return rc
 
 
+def validate_seam() -> int:
+    """Run the cross-plane seam sweep; exit 0 only when the C++/Python
+    boundary carries zero unsuppressed contract findings (ABI widths,
+    mirrored constants, stats scrape map, knob plumbing)."""
+    from tools.analysis.__main__ import main as analysis_main
+
+    rc = analysis_main(["seam"])
+    if rc == 0:
+        print("VALIDATOR PASS (seam)")
+    return rc
+
+
 async def main() -> int:
     args = sys.argv[1:]
     if args and args[0] == "lint":
         return validate_lint(args[1:])
     if args and args[0] == "race":
         return validate_race(args[1:])
+    if args and args[0] == "seam":
+        if len(args) > 1:
+            print("validator[seam]: the seam sweep takes no paths (the "
+                  "contract is whole-seam)", file=sys.stderr)
+            return 64
+        return validate_seam()
     if args and args[0] == "config":
         return validate_config(args[1:])
     if args and args[0] == "ckpt":
