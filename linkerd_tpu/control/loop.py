@@ -42,9 +42,11 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from dataclasses import dataclass
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
+from linkerd_tpu.core import Dtab
 from linkerd_tpu.fleet.exchange import FleetConfig
 
 log = logging.getLogger(__name__)
@@ -70,6 +72,21 @@ class ControlConfig:
     namespace: Optional[str] = None
     namerdAddress: Optional[str] = None
     failover: Optional[Dict[str, str]] = None
+    # hierarchical failover: sick cluster -> {peer region -> target};
+    # the reactor shifts to the healthiest FRESH peer region's target
+    # (fleet.region + region digests required), falling back to the
+    # local ``failover`` entry when every peer region is stale or sick
+    regionFailover: Optional[Dict[str, Dict[str, str]]] = None
+    # partition tolerance: when the namerd store is unreachable, book
+    # overrides in-process (LocalOverrideBook -> the routers' local
+    # dtab seam) so a cut-off instance keeps actuating on the quorum
+    # it can still see, and publish the book on heal
+    localActuation: bool = True
+    # failover binds are pre-warmed and re-touched on this cadence so
+    # a partition-time booked override lands on an ALREADY-BOUND path
+    # (new namerd binds fail mid-partition; warm ones hold last-good
+    # state through the interpreter's bind activity)
+    prewarmIntervalS: float = 120.0
     enterThreshold: float = 0.7
     exitThreshold: float = 0.3
     quorum: int = 3
@@ -159,10 +176,24 @@ class ControlLoop:
         self.reactor = None
         self._reactor_prefixes = (list(namer_prefixes)
                                   if namer_prefixes is not None else None)
-        if cfg.failover:
+        if cfg.regionFailover and (
+                cfg.fleet is None or not cfg.fleet.region):
+            raise ValueError(
+                "control.regionFailover requires a fleet block with a "
+                "region (cross-region targets are chosen from peer "
+                "region digests)")
+        # the partition-time override book, shared between the reactor
+        # (writer) and every router's RoutingService (readers via
+        # local_dtab_for)
+        self.local_book = None
+        if cfg.localActuation and (cfg.failover or cfg.regionFailover):
+            from linkerd_tpu.control.reactor import LocalOverrideBook
+            self.local_book = LocalOverrideBook()
+        if cfg.failover or cfg.regionFailover:
             if not cfg.namespace:
                 raise ValueError(
-                    "control.failover requires control.namespace")
+                    "control.failover/regionFailover requires "
+                    "control.namespace")
             if cfg.namerdAddress:
                 from linkerd_tpu.control.reactor import (
                     NamerdHttpStoreClient,
@@ -178,6 +209,11 @@ class ControlLoop:
                     "is injected (set_store_client)")
         self._balancers: list = []
         self._tenant_admissions: list = []
+        # failover-bind prewarmers registered by the Linker's routers
+        # (one per router; called for every failover pair so partition-
+        # time booked overrides route through already-warm binds)
+        self._prewarmers: list = []
+        self._last_prewarm: Optional[float] = None
 
     def _mk_reactor(self, client) -> None:
         from linkerd_tpu.control.reactor import MeshReactor
@@ -192,7 +228,9 @@ class ControlLoop:
             namer_prefixes=self._reactor_prefixes,
             verify=cfg.verifyOverrides,
             store_timeout_s=cfg.storeTimeoutMs / 1e3,
-            fleet=self.fleet)
+            fleet=self.fleet,
+            region_failover=cfg.regionFailover,
+            local_book=self.local_book)
         if self.fleet is not None:
             # the exchange publishes the reactor's LOCAL cluster view
             # (independent evidence — peers fold their own quorum), plus
@@ -260,6 +298,51 @@ class ControlLoop:
         """Track a ScoreWeightedBalancer for /control.json weights."""
         self._balancers.append(bal)
 
+    def register_prewarm(self, fn) -> None:
+        """Register a router's failover-bind prewarmer: a callable
+        ``fn(cluster, target)`` that binds ``cluster`` with the single
+        override dentry ``cluster => target`` — the exact binding-cache
+        key a partition-time booked override produces at request time.
+        Warmed at startup and re-touched every ``prewarmIntervalS`` so
+        the ServiceCache idle TTL never evicts it."""
+        self._prewarmers.append(fn)
+
+    def local_dtab_for(self, path) -> Dtab:
+        """The RoutingService seam: partition-time booked overrides
+        that apply to ``path`` (empty almost always — one dict probe
+        on the request path)."""
+        if self.local_book is None:
+            return Dtab.empty()
+        return self.local_book.dtab_for(path)
+
+    def failover_pairs(self) -> List[Tuple[str, str]]:
+        """Every (cluster, target) this loop could ever actuate —
+        local failover plus all cross-region targets."""
+        pairs = [(c, t) for c, t in (self.cfg.failover or {}).items()]
+        for cluster, per_region in (self.cfg.regionFailover or {}).items():
+            for target in per_region.values():
+                pairs.append((cluster, target))
+        return pairs
+
+    def prewarm_failover_binds(self) -> int:
+        """Warm (or re-touch) every failover bind through every
+        registered router; returns how many binds were touched."""
+        self._last_prewarm = time.monotonic()
+        if self.local_book is None or not self._prewarmers:
+            return 0
+        warmed = 0
+        for fn in self._prewarmers:
+            for cluster, target in self.failover_pairs():
+                try:
+                    fn(cluster, target)
+                    warmed += 1
+                except Exception:  # noqa: BLE001 — a failed prewarm
+                    # means that bind starts cold; it must never break
+                    # the control tick
+                    log.debug("failover bind prewarm failed for "
+                              "%s => %s", cluster, target, exc_info=True)
+        return warmed
+
     def set_tracer(self, tracer) -> None:
         if self.reactor is not None:
             self.reactor.set_tracer(tracer)
@@ -286,6 +369,11 @@ class ControlLoop:
             # while its scorer trains; cluster levels only appear in
             # the doc once warmed (FleetExchange.build_doc)
             self.fleet.maybe_step()
+        if (self._prewarmers and self.local_book is not None
+                and (self._last_prewarm is None
+                     or time.monotonic() - self._last_prewarm
+                     >= self.cfg.prewarmIntervalS)):
+            self.prewarm_failover_binds()
         if not self._warmed:
             if not self._ready_fn():
                 return
@@ -328,6 +416,8 @@ class ControlLoop:
             out["reactor"] = self.reactor.status()
         if self.fleet is not None:
             out["fleet"] = self.fleet.status()
+        if self.local_book is not None:
+            out["local_book"] = self.local_book.status()
         return out
 
     def close(self) -> None:

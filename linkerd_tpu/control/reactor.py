@@ -236,6 +236,63 @@ def verify_override(base: Dtab, override: Dtab,
             if not f.suppressed]
 
 
+class LocalOverrideBook:
+    """In-process override dentries consulted per-request by this
+    linker's routers (the ``local_dtab_fn`` seam on RoutingService).
+
+    The namerd store is the fleet-wide actuation path; the book is the
+    PARTITION fallback: when the reactor cannot reach the store, a
+    region-local quorum verdict still shifts THIS instance's traffic by
+    appending the override to the request's local dtab — and the dentry
+    is published to the store on heal (exactly once: the actuate path's
+    adopt-if-present absorbs the race with fleet peers healing
+    simultaneously). Booked dentries are filtered per destination path
+    so an override for ``/svc/web`` never perturbs the binding cache
+    key of an unrelated service."""
+
+    def __init__(self):
+        self._dentries: Dict[str, Dentry] = {}  # cluster -> dentry
+        self.version = 0  # bumped on every change (cheap staleness probe)
+
+    def __len__(self) -> int:
+        return len(self._dentries)
+
+    def __contains__(self, cluster: str) -> bool:
+        return cluster in self._dentries
+
+    def set(self, cluster: str, dentry: Dentry) -> None:
+        if self._dentries.get(cluster) != dentry:
+            self._dentries[cluster] = dentry
+            self.version += 1
+
+    def drop(self, cluster: str) -> Optional[Dentry]:
+        dentry = self._dentries.pop(cluster, None)
+        if dentry is not None:
+            self.version += 1
+        return dentry
+
+    def clear(self) -> None:
+        if self._dentries:
+            self._dentries.clear()
+            self.version += 1
+
+    def clusters(self) -> List[str]:
+        return list(self._dentries)
+
+    def dtab_for(self, path: Path) -> Dtab:
+        """The booked dentries that can affect ``path`` (the dentry's
+        prefix is a prefix of the destination); empty for everything
+        else, so unrelated services keep their cached binds."""
+        if not self._dentries:
+            return Dtab.empty()
+        matched = [d for d in self._dentries.values()
+                   if d.prefix.matches(path)]
+        return Dtab(matched) if matched else Dtab.empty()
+
+    def status(self) -> dict:
+        return {c: d.show for c, d in sorted(self._dentries.items())}
+
+
 class MeshReactor:
     """See module docstring. Drive with periodic ``step()`` calls (the
     ControlLoop does); every step is serialized under one lock so an
@@ -249,14 +306,29 @@ class MeshReactor:
                  verify: bool = True,
                  verifier: Optional[Callable] = None,
                  store_timeout_s: float = 3.0,
-                 fleet=None):
+                 fleet=None,
+                 region_failover: Optional[Dict[str, Dict[str, str]]] = None,
+                 local_book: Optional[LocalOverrideBook] = None,
+                 heal_probe_interval_s: float = 0.5):
         for cluster, target in failover.items():
             Path.read(cluster)  # raises on bad config up front
             Path.read(target)
+        for cluster, per_region in (region_failover or {}).items():
+            Path.read(cluster)
+            for target in per_region.values():
+                Path.read(target)
         self._board = board
         self._client = client
         self._ns = namespace
         self._failover = dict(failover)
+        # cluster -> {peer region -> target path}: cross-region shifts,
+        # chosen per actuation from the healthiest FRESH peer digest
+        # (fleet/regions.py); requires a fleet exchange with a region
+        self._region_failover = {c: dict(m)
+                                 for c, m in (region_failover or {}).items()}
+        # every cluster the governor watches, local or cross-region
+        self._watched = sorted(set(self._failover)
+                               | set(self._region_failover))
         self._governor = governor or HysteresisGovernor()
         # fleet mode (a FleetExchange): the governor observes the
         # QUORUM level — the K-th highest level reported by fresh fleet
@@ -280,6 +352,16 @@ class MeshReactor:
         # verbatim on revert; an operator's own edits are never touched)
         self.active: Dict[str, Dentry] = {}
         self.rejected: Dict[str, str] = {}  # cluster -> last reject reason
+        # partition-tolerant local actuation (see LocalOverrideBook):
+        # cluster -> dentry actuated ONLY in this process, pending
+        # store publication on heal
+        self._book = local_book
+        self.booked: Dict[str, Dentry] = {}
+        self._partitioned = False
+        self._partitioned_at: Optional[float] = None
+        self._heal_probe_interval_s = heal_probe_interval_s
+        self._last_probe: Optional[float] = None
+        self.last_heal_reconcile_ms: Optional[float] = None
         node = metrics_node
         if node is not None:
             self._published = node.counter("overrides_published")
@@ -289,12 +371,23 @@ class MeshReactor:
             self._conflicts = node.counter("cas_conflicts")
             self._errors = node.counter("errors")
             self._fenced = node.counter("fenced_steps")
+            self._local_acts = node.counter("local_actuations")
+            self._local_revs = node.counter("local_reverts")
+            self._heals = node.counter("heal_reconciles")
+            self._probes = node.counter("partition_probes")
+            self._xregion = node.counter("xregion_overrides")
             node.gauge("active_overrides",
                        fn=lambda: float(len(self.active)))
+            node.gauge("booked_overrides",
+                       fn=lambda: float(len(self.booked)))
+            node.gauge("partitioned",
+                       fn=lambda: 1.0 if self._partitioned else 0.0)
         else:
             self._published = self._reverted = self._rejected_c = None
             self._adopted = self._conflicts = self._errors = None
             self._fenced = None
+            self._local_acts = self._local_revs = self._heals = None
+            self._probes = self._xregion = None
 
     def set_tracer(self, tracer) -> None:
         self._tracer = tracer
@@ -307,10 +400,10 @@ class MeshReactor:
         the governor's dwell keeps an active override from snapping
         back the instant the scorer dies."""
         if getattr(self._board, "degraded", False):
-            return {c: 0.0 for c in self._failover}
+            return {c: 0.0 for c in self._watched}
         eff = self._board.effective_scores()
         levels: Dict[str, float] = {}
-        for cluster in self._failover:
+        for cluster in self._watched:
             prefix = cluster.rstrip("/") + "/"
             levels[cluster] = max(
                 (s for d, s in eff.items()
@@ -329,10 +422,47 @@ class MeshReactor:
         return {cluster: self._fleet.quorum_level(cluster, lvl)
                 for cluster, lvl in levels.items()}
 
+    def _target_for(self, cluster: str) -> Tuple[Optional[str],
+                                                 Optional[str]]:
+        """Resolve the failover target for a SICK cluster: the
+        healthiest FRESH peer region with a configured cross-region
+        target wins (the hierarchical shift the digests exist for);
+        the local failover target is the fallback — which is exactly
+        what a WAN-partitioned region degrades to, since its peer
+        digests go stale. Returns (target, region); region is None for
+        a local target, and (None, None) when nothing applies."""
+        if self._fleet is not None and cluster in self._region_failover:
+            per_region = self._region_failover[cluster]
+            # candidacy bar is ENTER (the sickness threshold), not
+            # exit: exit is the deliberately tight revert bar, and
+            # healthy scorer levels oscillate right below it under
+            # load — gating candidacy there makes the cross-region
+            # choice flap with noise while the region is nowhere near
+            # sick. Healthiest-first ordering still prefers the
+            # calmest region among the candidates.
+            for region in self._fleet.healthy_peer_regions(
+                    cluster, self._governor.enter):
+                target = per_region.get(region)
+                if target is not None:
+                    return target, region
+        target = self._failover.get(cluster)
+        return (target, None) if target is not None else (None, None)
+
     # -- the loop body -----------------------------------------------------
     async def step(self, now: Optional[float] = None) -> None:
         """One evaluation pass: fold current levels into the governor
-        and reconcile the published overrides with its verdicts."""
+        and reconcile the published overrides with its verdicts.
+
+        Store connectivity loss (OSError / timeout) flips the reactor
+        into PARTITION mode: actuations land in the LocalOverrideBook
+        (this instance's routers apply them per-request), reverts of
+        booked overrides are free, and store traffic throttles down to
+        one short probe per ``heal_probe_interval_s``. A successful
+        probe heals: the fetched namespace state is ingested into the
+        fleet view FIRST (so generation/region fences are current —
+        a zombie drops its book without writing), then still-SICK
+        booked clusters publish through the normal actuate path, whose
+        adopt-if-present makes the fleet-wide publish exactly-once."""
         async with self._lock:
             if self._fleet is not None and self._fleet.superseded:
                 # generation fence: a newer incarnation of this instance
@@ -341,18 +471,52 @@ class MeshReactor:
                 # successor's override
                 if self._fenced is not None:
                     self._fenced.incr()
+                self._drop_book()
                 return
+            mono = time.monotonic()
+            store_ok = True
+            healed_at: Optional[float] = None
+            if self._partitioned:
+                if (self._last_probe is not None
+                        and mono - self._last_probe
+                        < self._heal_probe_interval_s):
+                    store_ok = False  # throttle: no store traffic yet
+                else:
+                    self._last_probe = mono
+                    store_ok = await self._probe_heal()
+                    if store_ok:
+                        healed_at = time.monotonic()
+            booked_before = len(self.booked)
             levels = self.actuation_levels()
-            for cluster, target in self._failover.items():
+            for cluster in self._watched:
                 state = self._governor.observe(
                     cluster, levels.get(cluster, 0.0), now)
+                level = levels.get(cluster, 0.0)
                 try:
                     if state == SICK and cluster not in self.active:
-                        await self._actuate(cluster, target,
-                                            levels.get(cluster, 0.0))
-                    elif state != SICK and cluster in self.active:
-                        await self._revert(cluster,
-                                           levels.get(cluster, 0.0))
+                        target, region = (self._target_for(cluster)
+                                          if cluster not in self.booked
+                                          else (None, None))
+                        if cluster in self.booked:
+                            if store_ok:
+                                # heal: publish the booked override
+                                # (adopt-if-present = exactly once)
+                                dentry = self.booked[cluster]
+                                await self._actuate(
+                                    cluster, dentry.dst.show, level)
+                                self._unbook(cluster, quiet=True)
+                        elif target is None:
+                            pass  # nothing configured / no healthy peer
+                        elif store_ok:
+                            await self._actuate(cluster, target, level,
+                                                region=region)
+                        else:
+                            self._book_override(cluster, target, level)
+                    elif state != SICK:
+                        if cluster in self.booked:
+                            self._unbook(cluster, level=level)
+                        if cluster in self.active and store_ok:
+                            await self._revert(cluster, level)
                 except DtabVersionMismatch:
                     # a concurrent write won the CAS; re-fetch and retry
                     # on the next step rather than looping hot here
@@ -365,6 +529,18 @@ class MeshReactor:
                         self._fenced.incr()
                     log.warning("control write for %s dropped: instance "
                                 "superseded mid-step", cluster)
+                except (OSError, asyncio.TimeoutError) as e:
+                    # the store is unreachable, not wrong: enter
+                    # partition mode and actuate locally — a cut-off
+                    # region keeps protecting its own traffic on the
+                    # region-local quorum it can still see
+                    self._note_partition(e)
+                    store_ok = False
+                    if (state == SICK and cluster not in self.active
+                            and cluster not in self.booked):
+                        target, _ = self._target_for(cluster)
+                        if target is not None:
+                            self._book_override(cluster, target, level)
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:  # noqa: BLE001 — one cluster's
@@ -374,15 +550,104 @@ class MeshReactor:
                         self._errors.incr()
                     log.warning("control reactor step failed for %s: %r",
                                 cluster, e)
+            if healed_at is not None and booked_before:
+                self.last_heal_reconcile_ms = round(
+                    (time.monotonic() - healed_at) * 1e3, 3)
 
     def _fence_blocked(self) -> bool:
         """True when a newer incarnation of this instance has taken
-        over (fleet generation fencing). Checked at step entry AND
-        re-checked after every store await before a CAS goes out: the
-        supersede signal can arrive (gossip/namerd ingest) while this
-        step is parked on a fetch, and a zombie's write — publish or
-        revert — must not clobber its successor's."""
-        return self._fleet is not None and self._fleet.superseded
+        over (fleet generation fencing), OR this instance led its
+        region and a successor leader's newer-generation digest has
+        been observed (region fencing — a healed zombie region must
+        not revert the successor's override). Checked at step entry
+        AND re-checked after every store await before a CAS goes out:
+        the supersede signal can arrive (gossip/namerd ingest) while
+        this step is parked on a fetch, and a zombie's write — publish
+        or revert — must not clobber its successor's."""
+        if self._fleet is None:
+            return False
+        return (self._fleet.superseded
+                or getattr(self._fleet, "region_fenced", False))
+
+    # -- partition-tolerant local actuation --------------------------------
+    def _note_partition(self, exc: Exception) -> None:
+        if not self._partitioned:
+            self._partitioned = True
+            self._partitioned_at = time.monotonic()
+            self._last_probe = time.monotonic()
+            log.warning("control store unreachable (%r): PARTITION mode — "
+                        "actuating locally on the quorum this instance "
+                        "can still see", exc)
+
+    async def _probe_heal(self) -> bool:
+        """One short-timeout store fetch while partitioned. Success
+        heals: the fetched state is folded into the fleet view BEFORE
+        anything is written, so the fences reflect what happened on
+        the far side of the cut — a superseded zombie finds out HERE
+        and drops its book instead of publishing it."""
+        if self._probes is not None:
+            self._probes.incr()
+        try:
+            vd = await asyncio.wait_for(
+                self._client.fetch(self._ns),
+                min(1.0, self._store_timeout_s))
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — still cut off; probe again
+            # after the throttle interval (any failure mode counts:
+            # the probe's job is reachability, not correctness)
+            return False
+        healed_after = (time.monotonic() - self._partitioned_at
+                        if self._partitioned_at is not None else 0.0)
+        self._partitioned = False
+        self._partitioned_at = None
+        if self._fleet is not None and vd is not None:
+            self._fleet.ingest_dtab(vd.dtab)
+        if self._heals is not None:
+            self._heals.incr()
+        log.warning("control store reachable again after %.1fs: "
+                    "reconciling %d booked override(s)",
+                    healed_after, len(self.booked))
+        if self._fence_blocked():
+            # we are the zombie side of the partition: the successor's
+            # state (ingested above) owns the mesh — drop the book
+            # without a single store write
+            if self._fenced is not None:
+                self._fenced.incr()
+            self._drop_book()
+        return True
+
+    def _book_override(self, cluster: str, target: str,
+                       level: float) -> None:
+        if self._book is None:
+            return
+        dentry = Dtab.read(f"{cluster} => {target} ;")[0]
+        self._book.set(cluster, dentry)
+        self.booked[cluster] = dentry
+        if self._local_acts is not None:
+            self._local_acts.incr()
+        log.warning("control override BOOKED locally (store partitioned): "
+                    "%s => %s (level=%.3f)", cluster, target, level)
+        self._span("book", cluster, target, level)
+
+    def _unbook(self, cluster: str, level: float = 0.0,
+                quiet: bool = False) -> None:
+        dentry = self.booked.pop(cluster, None)
+        if self._book is not None:
+            self._book.drop(cluster)
+        if dentry is None or quiet:
+            return
+        if self._local_revs is not None:
+            self._local_revs.incr()
+        log.warning("control override UNBOOKED (local revert): %s "
+                    "(level=%.3f)", cluster, level)
+        self._span("unbook", cluster, dentry.dst.show, level)
+
+    def _drop_book(self) -> None:
+        for cluster in list(self.booked):
+            self._unbook(cluster, quiet=True)
+        if self._book is not None:
+            self._book.clear()
 
     async def _fetch(self) -> Optional[VersionedDtab]:
         return await asyncio.wait_for(self._client.fetch(self._ns),
@@ -401,23 +666,41 @@ class MeshReactor:
         await asyncio.wait_for(dispatch(), self._store_timeout_s)
 
     async def _actuate(self, cluster: str, target: str,
-                       level: float) -> None:
+                       level: float,
+                       region: Optional[str] = None) -> None:
         vd = await self._fetch()
         if vd is None:
             raise RuntimeError(
                 f"dtab namespace {self._ns!r} does not exist")
+        if self._fence_blocked():
+            # checked BEFORE the adopt branch too: a fenced zombie must
+            # not even ADOPT the successor's dentry — adoption records
+            # ownership in ``active``, and ownership is a claim to
+            # revert later
+            if self._fenced is not None:
+                self._fenced.incr()
+            log.warning("control override for %s NOT published: this "
+                        "instance was superseded mid-step", cluster)
+            return
         override = Dtab.read(f"{cluster} => {target} ;")
-        if override[0] in vd.dtab:
-            # a fleet peer's reactor (same failover config) already
-            # published this exact dentry: ADOPT it instead of stacking
-            # a duplicate — reverts stay idempotent and the namespace
-            # never accumulates N copies from N linkerds
-            self.active[cluster] = override[0]
+        existing = next((d for d in vd.dtab
+                         if d.prefix == override[0].prefix), None)
+        if existing is not None:
+            # a fleet peer's reactor already holds an override for this
+            # cluster: ADOPT the peer's dentry instead of stacking a
+            # second one — even when its target differs from the one we
+            # computed (region digest views diverge under WAN staleness:
+            # the peer saw the cross-region target fresh while we did
+            # not, or vice versa; stacking two dentries for one prefix
+            # would let publish ORDER pick the serving target and double
+            # the flap count). Recording the dentry actually in the
+            # namespace keeps every adopter's revert exact.
+            self.active[cluster] = existing
             self.rejected.pop(cluster, None)
             if self._adopted is not None:
                 self._adopted.incr()
             log.info("control override ADOPTED (already published by a "
-                     "peer): %s => %s (ns=%s)", cluster, target, self._ns)
+                     "peer): %s (ns=%s)", existing.show, self._ns)
             return
         if self._verify:
             problems = self._verifier(vd.dtab, override,
@@ -434,19 +717,19 @@ class MeshReactor:
                         "(not published): %s", cluster, reason)
                 self._span("reject", cluster, target, level)
                 return
-        if self._fence_blocked():
-            if self._fenced is not None:
-                self._fenced.incr()
-            log.warning("control override for %s NOT published: this "
-                        "instance was superseded mid-step", cluster)
-            return
+        # (no await between the post-fetch fence check above and here;
+        # the _cas dispatch re-checks at the last atomic instant)
         await self._cas(vd.dtab + override, vd.version)
         self.active[cluster] = override[0]
         self.rejected.pop(cluster, None)
         if self._published is not None:
             self._published.incr()
+        if region is not None and self._xregion is not None:
+            self._xregion.incr()
         log.warning("control override PUBLISHED: %s => %s "
-                    "(ns=%s, level=%.3f)", cluster, target, self._ns, level)
+                    "(ns=%s, level=%.3f%s)", cluster, target, self._ns,
+                    level,
+                    f", cross-region -> {region}" if region else "")
         self._span("publish", cluster, target, level)
 
     async def _revert(self, cluster: str, level: float) -> None:
@@ -512,6 +795,14 @@ class MeshReactor:
                                  for c, d in self.active.items()},
             "rejected": dict(self.rejected),
         }
+        if self._region_failover:
+            out["region_failover"] = {c: dict(m) for c, m
+                                      in self._region_failover.items()}
+        if self._book is not None:
+            out["partitioned"] = self._partitioned
+            out["booked_overrides"] = {c: d.show
+                                       for c, d in self.booked.items()}
+            out["last_heal_reconcile_ms"] = self.last_heal_reconcile_ms
         if self._fleet is not None:
             local = self.cluster_levels()
             out["fleet_mode"] = True

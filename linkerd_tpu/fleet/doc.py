@@ -37,6 +37,12 @@ from typing import Dict, List, Optional
 # bounded so a hostile doc cannot mint unbounded metric/namespace keys
 _INSTANCE_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
 
+# region ids are DNS-label-ish and deliberately narrower than instance
+# ids: they become dtab path segments, metric keys, AND config map keys
+# (control.regionFailover), so the grammar is shared by the doc layer,
+# the region digest layer (fleet/regions.py), and l5dcheck
+_REGION_RE = re.compile(r"^[a-z][a-z0-9-]{0,31}$")
+
 # per-cluster aggregate fields a doc may carry (everything else is
 # dropped on decode: the wire doc is peer input, not trusted state)
 CLUSTER_FIELDS = ("level", "drift", "err_rate", "shed_rate")
@@ -55,6 +61,10 @@ def valid_instance(instance: str) -> bool:
     return bool(_INSTANCE_RE.match(instance or ""))
 
 
+def valid_region(region: str) -> bool:
+    return bool(_REGION_RE.match(region or ""))
+
+
 @dataclass
 class FleetDoc:
     """One instance's published digest (see module docstring)."""
@@ -69,6 +79,11 @@ class FleetDoc:
     # wall-clock stamp, informational only (humans reading /fleet.json);
     # freshness decisions use the receiver's monotonic ingest instant
     ts: float = 0.0
+    # region membership ("" = regionless flat fleet, the pre-region
+    # wire format): in region mode only SAME-REGION docs vote in the
+    # intra-region quorum; cross-region evidence rides region digests
+    # (fleet/regions.py), never raw peer docs
+    region: str = ""
 
     def ordering(self) -> tuple:
         return (self.generation, self.seq)
@@ -80,10 +95,13 @@ class FleetDoc:
         return float(agg.get("level", 0.0))
 
     def to_json(self) -> str:
-        return json.dumps({
+        data = {
             "i": self.instance, "g": self.generation, "s": self.seq,
             "c": self.clusters, "o": self.overrides, "t": self.ts,
-        }, separators=(",", ":"), sort_keys=True)
+        }
+        if self.region:
+            data["r"] = self.region
+        return json.dumps(data, separators=(",", ":"), sort_keys=True)
 
     @staticmethod
     def from_json(text: str) -> "FleetDoc":
@@ -93,6 +111,10 @@ class FleetDoc:
         instance = data.get("i")
         if not isinstance(instance, str) or not valid_instance(instance):
             raise ValueError(f"bad fleet doc instance id: {instance!r}")
+        region = data.get("r") or ""
+        if region and (not isinstance(region, str)
+                       or not valid_region(region)):
+            raise ValueError(f"bad fleet doc region id: {region!r}")
         clusters_in = data.get("c") or {}
         if not isinstance(clusters_in, dict):
             raise ValueError("fleet doc clusters must be a mapping")
@@ -115,6 +137,7 @@ class FleetDoc:
                 clusters=clusters,
                 overrides=[str(o) for o in overrides[:MAX_CLUSTERS]],
                 ts=float(data.get("t") or 0.0),
+                region=region,
             )
         except TypeError as e:
             # null/list-valued numeric fields: ONE malformed-doc error
@@ -165,14 +188,23 @@ class FleetView:
     """Every known peer's latest doc + the quorum/staleness logic."""
 
     def __init__(self, instance: str, generation: int,
-                 ttl_s: float = 5.0):
+                 ttl_s: float = 5.0, region: str = ""):
         if not valid_instance(instance):
             raise ValueError(
                 f"fleet instance id must match [A-Za-z0-9._-]{{1,64}}, "
                 f"got {instance!r}")
+        if region and not valid_region(region):
+            raise ValueError(
+                f"fleet region id must match [a-z][a-z0-9-]{{0,31}}, "
+                f"got {region!r}")
         self.instance = instance
         self.generation = int(generation)
         self.ttl_s = ttl_s
+        # own region ("" = flat fleet): quorum_level / sick_votes count
+        # only same-region peers, so a WAN neighbour's doc that leaks
+        # in through the shared namespace can neither satisfy nor
+        # starve the INTRA-region quorum
+        self.region = region
         # True once a NEWER generation under our own id was observed:
         # this process is a zombie and must never actuate again
         self.superseded = False
@@ -216,10 +248,14 @@ class FleetView:
         self._peers.pop(instance, None)
 
     # -- queries ----------------------------------------------------------
-    def fresh_docs(self, now: Optional[float] = None) -> List[FleetDoc]:
+    def fresh_docs(self, now: Optional[float] = None,
+                   region: Optional[str] = None) -> List[FleetDoc]:
+        """Fresh peer docs; ``region`` restricts to that region's docs
+        (None = every region, the flat-fleet behavior)."""
         now = time.monotonic() if now is None else now
         return [e.doc for e in self._peers.values()
-                if now - e.received_at <= self.ttl_s]
+                if now - e.received_at <= self.ttl_s
+                and (region is None or e.doc.region == region)]
 
     def all_docs(self) -> List[FleetDoc]:
         return [e.doc for e in self._peers.values()]
@@ -227,13 +263,19 @@ class FleetView:
     def fresh_count(self, now: Optional[float] = None) -> int:
         return len(self.fresh_docs(now))
 
+    def _voting_docs(self, now: Optional[float]) -> List[FleetDoc]:
+        """The docs that may vote in OUR quorum: same-region only when
+        this view is regional (cross-region evidence must ride region
+        digests, which cannot fabricate instance-level votes)."""
+        return self.fresh_docs(now, region=self.region or None)
+
     def quorum_level(self, cluster: str, local_level: float,
                      quorum: int, now: Optional[float] = None) -> float:
         """K-th highest level reported for ``cluster`` by fresh
         instances, self included (see module docstring). Fewer than K
         fresh reporters => 0.0 (a partial fleet can never trip)."""
         levels = [float(local_level)]
-        for doc in self.fresh_docs(now):
+        for doc in self._voting_docs(now):
             lvl = doc.level_of(cluster)
             if lvl is not None:
                 levels.append(lvl)
@@ -249,7 +291,7 @@ class FleetView:
         """How many fresh instances (self included) report the cluster
         at or above ``threshold`` — the /fleet.json-facing count."""
         votes = 1 if local_level >= threshold else 0
-        for doc in self.fresh_docs(now):
+        for doc in self._voting_docs(now):
             lvl = doc.level_of(cluster)
             if lvl is not None and lvl >= threshold:
                 votes += 1
@@ -259,6 +301,7 @@ class FleetView:
         now = time.monotonic() if now is None else now
         return {
             "instance": self.instance,
+            "region": self.region or None,
             "generation": self.generation,
             "superseded": self.superseded,
             "ttl_s": self.ttl_s,
@@ -269,6 +312,7 @@ class FleetView:
                 inst: {
                     "generation": e.doc.generation,
                     "seq": e.doc.seq,
+                    "region": e.doc.region or None,
                     "age_s": round(now - e.received_at, 3),
                     "fresh": now - e.received_at <= self.ttl_s,
                     "clusters": {c: round(a.get("level", 0.0), 4)

@@ -38,7 +38,9 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
 from linkerd_tpu.core import Dtab
-from linkerd_tpu.fleet.doc import FleetDoc, FleetView, valid_instance
+from linkerd_tpu.fleet.doc import (FleetDoc, FleetView, valid_instance,
+                                   valid_region)
+from linkerd_tpu.fleet.regions import RegionDigest, RegionView
 
 log = logging.getLogger(__name__)
 
@@ -76,6 +78,19 @@ class FleetConfig:
     gossip: bool = True
     peers: Optional[List[str]] = None  # peer ADMIN host:port addresses
     gossipIntervalMs: int = 250
+    # hierarchical tier (fleet/regions.py). None = flat single-region
+    # fleet, exactly the pre-region behaviour. When set: quorum voting
+    # is region-local, and the region leader publishes a RegionDigest
+    # dentry every digestIntervalS for peer regions to observe.
+    region: Optional[str] = None
+    # WAN staleness TTL for PEER-REGION digests (receiver-monotonic;
+    # deliberately larger than stalenessTtlS — WAN weather is slower
+    # than rack weather)
+    wanTtlS: float = 15.0
+    # region-leader digest roll-up cadence; must stay below wanTtlS or
+    # peer regions see us flicker stale between publishes (l5dcheck
+    # region-config enforces the margin)
+    digestIntervalS: float = 2.0
 
     def effective_quorum(self) -> int:
         if self.quorum > 0:
@@ -108,6 +123,14 @@ class FleetExchange:
             raise ValueError("fleet.gossipIntervalMs must be > 0")
         if cfg.quorum < 0:
             raise ValueError("fleet.quorum must be >= 0 (0 = auto)")
+        if cfg.region is not None and not valid_region(cfg.region):
+            raise ValueError(
+                f"fleet.region must match [a-z][a-z0-9-]{{0,31}}: "
+                f"{cfg.region!r}")
+        if cfg.wanTtlS <= 0:
+            raise ValueError("fleet.wanTtlS must be > 0")
+        if cfg.digestIntervalS <= 0:
+            raise ValueError("fleet.digestIntervalS must be > 0")
         instance = cfg.resolve_instance()
         if not valid_instance(instance):
             raise ValueError(
@@ -117,7 +140,21 @@ class FleetExchange:
         self.quorum = cfg.effective_quorum()
         generation = cfg.generation or time.time_ns()
         self.view = FleetView(instance, generation,
-                              ttl_s=cfg.stalenessTtlS)
+                              ttl_s=cfg.stalenessTtlS,
+                              region=cfg.region or "")
+        # hierarchical tier: digests per peer region, fenced + WAN-TTL'd
+        self.regions: Optional[RegionView] = (
+            RegionView(cfg.region, wan_ttl_s=cfg.wanTtlS)
+            if cfg.region is not None else None)
+        # digest publish identity: generation starts at the instance
+        # generation (restarts mint new incarnations naturally) and is
+        # bumped past any stored digest on CAS takeover
+        self._digest_gen = generation
+        self._digest_seq = 0
+        # True after this instance successfully published a region
+        # digest — the precondition for the zombie-leader fence (an
+        # instance that never led cannot be a zombie leader)
+        self._led_region = False
         self._client = client
         self._ns = cfg.namespace
         self._seq = 0
@@ -130,8 +167,10 @@ class FleetExchange:
         # cadence state (monotonic); None = fire on the first tick
         self._last_pub: Optional[float] = None
         self._last_gossip: Optional[float] = None
+        self._last_digest: Optional[float] = None
         self._publishing = False
         self._gossiping = False
+        self._digesting = False
         self._peer_clients: Dict[str, object] = {}
         # standing namerd watch on the fleet namespace (sub-interval
         # push ingest; see start_watch). None until the first tick.
@@ -150,6 +189,9 @@ class FleetExchange:
             self._gossip_rounds = node.counter("gossip_rounds")
             self._gossip_errors = node.counter("gossip_errors")
             self._watch_updates = node.counter("watch_updates")
+            self._digests_published = node.counter("digests_published")
+            self._digest_conflicts = node.counter("digest_conflicts")
+            self._digest_failures = node.counter("digest_failures")
             node.gauge("peers_fresh",
                        fn=lambda: float(self.view.fresh_count()))
             node.gauge("peers_known",
@@ -159,11 +201,20 @@ class FleetExchange:
             node.gauge("quorum", fn=lambda: float(self.quorum))
             node.gauge("watching",
                        fn=lambda: 1.0 if self.watching else 0.0)
+            if self.regions is not None:
+                node.gauge("region_leader",
+                           fn=lambda: 1.0 if self.is_region_leader else 0.0)
+                node.gauge("regions_fresh",
+                           fn=lambda: float(len(self.regions.fresh())))
+                node.gauge("region_fenced",
+                           fn=lambda: 1.0 if self.region_fenced else 0.0)
         else:
             self._published = self._pub_conflicts = None
             self._pub_failures = None
             self._gossip_rounds = self._gossip_errors = None
             self._watch_updates = None
+            self._digests_published = self._digest_conflicts = None
+            self._digest_failures = None
 
     # -- wiring ------------------------------------------------------------
     def set_source(self, levels_fn: Callable[[], Dict[str, float]],
@@ -193,6 +244,39 @@ class FleetExchange:
                    threshold: float) -> int:
         return self.view.sick_votes(cluster, local_level, threshold)
 
+    @property
+    def region_fenced(self) -> bool:
+        """True when this instance led its region and a successor's
+        newer-generation digest has been observed: a healed zombie
+        leader must not write (publish digests or revert overrides)
+        until it legitimately re-takes the region (fresh quorum + CAS
+        takeover in publish_digest_once clears the latch)."""
+        return self.regions is not None and self.regions.superseded_leader
+
+    @property
+    def is_region_leader(self) -> bool:
+        """Deterministic region leadership: the lowest instance id among
+        self + FRESH same-region peers. Every instance computes the same
+        answer from the same fresh set; a dead leader's docs go stale
+        and leadership moves without any election round."""
+        if self.regions is None:
+            return False
+        peers = self.view.fresh_docs(region=self.cfg.region)
+        return all(self.view.instance <= d.instance for d in peers)
+
+    def healthy_peer_regions(self, cluster: str, below: float) -> List[str]:
+        """Peer regions whose FRESH digest reports ``cluster`` below
+        ``below`` — cross-region failover candidates, healthiest first
+        (empty when flat fleet or all peers stale/sick)."""
+        if self.regions is None:
+            return []
+        return self.regions.healthy_regions(cluster, below)
+
+    def region_level(self, region: str, cluster: str) -> Optional[float]:
+        if self.regions is None:
+            return None
+        return self.regions.region_level(region, cluster)
+
     # -- doc construction --------------------------------------------------
     def build_doc(self) -> FleetDoc:
         self._seq += 1
@@ -214,6 +298,7 @@ class FleetExchange:
             clusters=clusters,
             overrides=sorted(self._overrides_fn()),
             ts=time.time(),
+            region=self.cfg.region or "",
         )
 
     def doc_objs(self) -> List[dict]:
@@ -274,15 +359,34 @@ class FleetExchange:
         monitor(self._watch_task, what="fleet-ns-watch")
         return True
 
+    def _ingest_digest(self, rd: RegionDigest) -> bool:
+        """Fold one region digest into the RegionView; an OWN-region
+        digest under a different leader while we led latches the
+        zombie fence (observe_supersede)."""
+        if self.regions is None:
+            return False
+        accepted = self.regions.ingest(rd)
+        if rd.region == self.regions.region:
+            self.regions.observe_supersede(
+                self.view.instance, was_leader=self._led_region)
+        return accepted
+
     def ingest_dtab(self, dtab: Dtab) -> int:
-        """Ingest every fleet doc found in a namespace dtab state
-        (operator dentries sharing the namespace are ignored); returns
-        how many docs were newly accepted."""
+        """Ingest every fleet doc AND region digest found in a
+        namespace dtab state (operator dentries sharing the namespace
+        are ignored); returns how many entries were newly accepted."""
         accepted = 0
         for d in dtab:
             peer = FleetDoc.from_dentry_parts(d.prefix.show, d.dst.show)
-            if peer is not None and self.view.ingest(peer):
-                accepted += 1
+            if peer is not None:
+                if self.view.ingest(peer):
+                    accepted += 1
+                continue
+            if self.regions is not None:
+                rd = RegionDigest.from_dentry_parts(
+                    d.prefix.show, d.dst.show)
+                if rd is not None and self._ingest_digest(rd):
+                    accepted += 1
         return accepted
 
     async def _watch_loop(self) -> None:
@@ -329,6 +433,14 @@ class FleetExchange:
             self._gossiping = True
             self._last_gossip = now
             spawn(self._gossip_round(), what="fleet-gossip")
+        if (self.regions is not None and self._client is not None
+                and not self._digesting
+                and (self._last_digest is None
+                     or now - self._last_digest
+                     >= self.cfg.digestIntervalS)):
+            self._digesting = True
+            self._last_digest = now
+            spawn(self._publish_digest_once(), what="fleet-digest")
 
     # -- namerd-mediated exchange -----------------------------------------
     async def publish_once(self) -> bool:
@@ -357,6 +469,11 @@ class FleetExchange:
                         self.view.ingest(peer)
                     if peer.instance == self.view.instance:
                         continue  # replaced by our fresh doc below
+                elif ingest_here and self.regions is not None:
+                    rd = RegionDigest.from_dentry_parts(
+                        d.prefix.show, d.dst.show)
+                    if rd is not None:
+                        self._ingest_digest(rd)
                 kept.append(d)
             return Dtab(list(kept) + [own])
 
@@ -386,6 +503,129 @@ class FleetExchange:
                         self._ns, e)
         finally:
             self._publishing = False
+
+    # -- region digest roll-up (hierarchical tier) -------------------------
+    def live_region_count(self) -> int:
+        """Self + fresh same-region peers: the region's live population
+        as this instance sees it."""
+        return 1 + len(self.view.fresh_docs(region=self.cfg.region))
+
+    def build_region_digest(self) -> Optional[RegionDigest]:
+        """Roll the region-local quorum order-statistics up into one
+        digest, or None when this instance must not publish one:
+
+        - not the region leader (lowest fresh same-region instance id);
+        - no LIVE quorum (self + fresh same-region peers < K): an
+          isolated instance mints no cross-region evidence — a
+          partitioned singleton must look STALE to peer regions, never
+          "healthy with zero reporters".
+        """
+        if self.regions is None or not self.is_region_leader:
+            return None
+        peers = self.view.fresh_docs(region=self.cfg.region)
+        if 1 + len(peers) < self.quorum:
+            return None
+        local = self._levels_fn() if self._warmed_fn() else {}
+        names = set(local)
+        for d in peers:
+            names.update(d.clusters)
+        clusters: Dict[str, Dict[str, float]] = {}
+        overrides = set(self._overrides_fn())
+        for cluster in sorted(names):
+            level = self.view.quorum_level(
+                cluster, local.get(cluster, 0.0), self.quorum)
+            n = sum(1 for d in peers if cluster in d.clusters)
+            if cluster in local:
+                n += 1
+            clusters[cluster] = {"level": round(float(level), 6),
+                                 "n": float(n)}
+        for d in peers:
+            overrides.update(d.overrides)
+        self._digest_seq += 1
+        return RegionDigest(
+            region=self.regions.region,
+            leader=self.view.instance,
+            generation=self._digest_gen,
+            seq=self._digest_seq,
+            clusters=clusters,
+            overrides=sorted(overrides),
+            ts=time.time(),
+        )
+
+    async def publish_digest_once(self) -> bool:
+        """One region-digest CAS round (leader only; see
+        build_region_digest for the publish gates). A stored own-region
+        digest with ordering >= ours — a successor (or our own pre-cut
+        incarnation) got there first — forces a generation TAKEOVER:
+        we bump past it so the new digest fences the old line, and a
+        successful publish proves legitimate leadership, clearing the
+        zombie-leader latch."""
+        if self._client is None or self.regions is None:
+            return False
+        digest = self.build_region_digest()
+        if digest is None:
+            return False
+
+        def mutate(dtab: Dtab) -> Dtab:
+            nonlocal digest
+            kept = []
+            for d in dtab:
+                rd = RegionDigest.from_dentry_parts(d.prefix.show,
+                                                    d.dst.show)
+                if rd is not None and rd.region == digest.region:
+                    self.regions.ingest(rd)
+                    if rd.ordering() >= digest.ordering():
+                        if (rd.leader != digest.leader
+                                and self._digest_conflicts is not None):
+                            self._digest_conflicts.incr()
+                        self._digest_gen = max(self._digest_gen,
+                                               rd.generation + 1)
+                        digest = RegionDigest(
+                            region=digest.region, leader=digest.leader,
+                            generation=self._digest_gen, seq=digest.seq,
+                            clusters=digest.clusters,
+                            overrides=digest.overrides, ts=digest.ts)
+                    continue  # replaced by our fresh digest below
+                if rd is not None:
+                    self._ingest_digest(rd)
+                kept.append(d)
+            prefix, dst = digest.to_dentry_parts()
+            own = Dtab.read(f"{prefix} => {dst} ;")[0]
+            return Dtab(list(kept) + [own])
+
+        from linkerd_tpu.control.reactor import cas_modify
+
+        def conflict() -> None:
+            if self._digest_conflicts is not None:
+                self._digest_conflicts.incr()
+
+        await cas_modify(self._client, self._ns, mutate,
+                         create_if_missing=Dtab.empty(),
+                         on_conflict=conflict)
+        # the store now carries OUR digest: record it locally so the
+        # fencing table is current, mark that we have led, and clear
+        # the zombie latch — this publish required fresh quorum and won
+        # the CAS, which is exactly what legitimate leadership means
+        self.regions.ingest(digest)
+        self._led_region = True
+        self.regions.superseded_leader = False
+        if self._digests_published is not None:
+            self._digests_published.incr()
+        return True
+
+    async def _publish_digest_once(self) -> None:
+        try:
+            await asyncio.wait_for(self.publish_digest_once(), 10.0)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — a failing store costs
+            # one digest round; the next cadence tick retries
+            if self._digest_failures is not None:
+                self._digest_failures.incr()
+            log.warning("region digest publish to namespace %r failed: %r",
+                        self._ns, e)
+        finally:
+            self._digesting = False
 
     # -- gossip ------------------------------------------------------------
     def _peer_client(self, peer: str):
@@ -451,6 +691,15 @@ class FleetExchange:
             "watching": self.watching,
             "seq": self._seq,
         })
+        if self.regions is not None:
+            out["region_tier"] = {
+                "leader": self.is_region_leader,
+                "led": self._led_region,
+                "fenced": self.region_fenced,
+                "live": self.live_region_count(),
+                "digest_interval_s": self.cfg.digestIntervalS,
+                **self.regions.status(),
+            }
         return out
 
     async def aclose(self) -> None:

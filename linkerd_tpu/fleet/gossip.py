@@ -28,6 +28,11 @@ def fleet_admin_handlers(exchange: FleetExchange) -> List[Tuple[str, object]]:
     async def fleet_json(req):
         return json_response(exchange.status())
 
+    async def regions_json(req):
+        # the hierarchical tier alone: digest table, leadership, fence
+        st = exchange.status()
+        return json_response(st.get("region_tier") or {"region": None})
+
     async def gossip(req):
         if req.method == "POST":
             try:
@@ -41,4 +46,5 @@ def fleet_admin_handlers(exchange: FleetExchange) -> List[Tuple[str, object]]:
             exchange.ingest_objs(data.get("docs") or [])
         return json_response({"docs": exchange.doc_objs()})
 
-    return [("/fleet.json", fleet_json), (GOSSIP_PATH, gossip)]
+    return [("/fleet.json", fleet_json), ("/regions.json", regions_json),
+            (GOSSIP_PATH, gossip)]

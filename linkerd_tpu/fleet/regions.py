@@ -1,0 +1,316 @@
+"""RegionDigest + RegionView: the hierarchical (WAN) tier of the fleet.
+
+PR 13's FleetDoc exchange is one flat gossip/namerd domain — right for a
+rack, wrong for a planet. This module adds the second tier: every region
+runs its flat intra-region fleet exactly as before, and the region's
+*leader* (deterministically the lowest fresh instance id) rolls the
+region-local quorum order-statistics up into one compact **RegionDigest**
+— one CAS'd dentry per region in the same namerd ``fleet`` namespace
+(``/region/<region> => /d/<hex-json>``). Regions observe each other ONLY
+through digests: cross-region evidence never rides raw instance docs, so
+WAN weather degrades a region to "stale digest", never to "N phantom
+quorum voters".
+
+Safety invariants owned here (mirroring fleet/doc.py):
+
+- **hostile-input validation** — a digest is peer input; malformed,
+  oversized, or out-of-grammar digests raise ONE error type
+  (``ValueError``) on decode and cost exactly the bad dentry, never a
+  poisoned publish round (``RegionDigest.from_dentry_parts`` returns
+  None for anything that is not a well-formed region digest).
+- **receiver-monotonic WAN staleness** — a digest older than
+  ``wan_ttl_s`` by the RECEIVER's monotonic clock carries no weight.
+  Cross-region wall clocks are never compared, so asymmetric WAN
+  latency (or a region whose clock drifts) can delay failover but never
+  fabricate freshness.
+- **(generation, seq) fencing per region** — digests are ordered by the
+  publishing leader's ``(generation, seq)``; an older incarnation's
+  digests are discarded. A healed zombie leader (cut off while a
+  successor took over the region) observes the successor's digest under
+  its own region id with a NEWER generation and marks itself
+  ``superseded_leader`` — it may never publish digests again, and the
+  reactor folds the same signal into its write fence so a zombie region
+  can never revert a successor's override.
+- **bounded tables** — at most ``MAX_REGIONS`` regions are tracked; a
+  fabricated region id must buy eviction of an already-stale entry (or
+  rejection), never unbounded memory.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from linkerd_tpu.fleet.doc import MAX_CLUSTERS, valid_instance, valid_region
+
+# hard bound on tracked regions: the planet has few regions; a hostile
+# digest stream minting fresh region ids must hit a wall
+MAX_REGIONS = 16
+
+# per-cluster aggregate fields a digest may carry ("level" is the
+# region's intra-region quorum order-statistic, "n" how many fresh
+# same-region instances reported); everything else is dropped on decode
+DIGEST_FIELDS = ("level", "n")
+
+
+@dataclass
+class RegionDigest:
+    """One region's published roll-up (see module docstring)."""
+
+    region: str
+    leader: str      # instance id that minted this digest
+    generation: int  # the leader's incarnation (fencing, with seq)
+    seq: int
+    # cluster path -> {level: region quorum level, n: fresh reporters}
+    clusters: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    # clusters whose override the region believes active (reconcile aid)
+    overrides: List[str] = field(default_factory=list)
+    # wall-clock stamp, informational only; freshness decisions use the
+    # receiver's monotonic ingest instant
+    ts: float = 0.0
+
+    def ordering(self) -> tuple:
+        return (self.generation, self.seq)
+
+    def level_of(self, cluster: str) -> Optional[float]:
+        agg = self.clusters.get(cluster)
+        if agg is None:
+            return None
+        return float(agg.get("level", 0.0))
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "r": self.region, "l": self.leader, "g": self.generation,
+            "s": self.seq, "c": self.clusters, "o": self.overrides,
+            "t": self.ts,
+        }, separators=(",", ":"), sort_keys=True)
+
+    @staticmethod
+    def from_json(text: str) -> "RegionDigest":
+        data = json.loads(text)
+        if not isinstance(data, dict):
+            raise ValueError("region digest must be a JSON object")
+        region = data.get("r")
+        if not isinstance(region, str) or not valid_region(region):
+            raise ValueError(f"bad region digest id: {region!r}")
+        leader = data.get("l")
+        if not isinstance(leader, str) or not valid_instance(leader):
+            raise ValueError(f"bad region digest leader: {leader!r}")
+        def num(container: dict, key: str, default: float = 0.0):
+            # strictly typed (no `or`-coercion): a falsy wrong-typed
+            # field ([], {}, "") is still hostile input and must raise
+            # the ONE error type, not silently decode to a default
+            v = container.get(key)
+            if v is None:
+                return default
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise ValueError(
+                    f"bad region digest field {key!r}: {v!r}")
+            return v
+
+        clusters_in = data.get("c")
+        clusters_in = {} if clusters_in is None else clusters_in
+        if not isinstance(clusters_in, dict):
+            raise ValueError("region digest clusters must be a mapping")
+        try:
+            clusters: Dict[str, Dict[str, float]] = {}
+            for cluster, agg in list(clusters_in.items())[:MAX_CLUSTERS]:
+                if not isinstance(cluster, str) \
+                        or not isinstance(agg, dict):
+                    raise ValueError(
+                        f"bad region digest cluster entry: {cluster!r}")
+                clusters[cluster] = {
+                    k: float(num(agg, k)) for k in DIGEST_FIELDS}
+            overrides = data.get("o")
+            overrides = [] if overrides is None else overrides
+            if not isinstance(overrides, list):
+                raise ValueError("region digest overrides must be a list")
+            return RegionDigest(
+                region=region,
+                leader=leader,
+                generation=int(num(data, "g", 0)),
+                seq=int(num(data, "s", 0)),
+                clusters=clusters,
+                overrides=[str(o) for o in overrides[:MAX_CLUSTERS]],
+                ts=float(num(data, "t", 0.0)),
+            )
+        except TypeError as e:
+            # belt and braces: ONE malformed-digest error type, same
+            # contract as FleetDoc.from_json
+            raise ValueError(f"bad region digest field types: {e}") from e
+
+    # -- dtab encoding ----------------------------------------------------
+    # One dentry per region in the fleet namespace, next to the
+    # per-instance docs: ``/region/<region> => /d/<hex-of-json>``.
+    # FleetDoc's decoder returns None for these (prefix segment differs)
+    # and vice versa, so the two tiers share the namespace without
+    # ever mistaking each other's dentries.
+
+    PREFIX_SEG = "region"
+    DATA_SEG = "d"
+
+    def to_dentry_parts(self) -> tuple:
+        payload = self.to_json().encode("utf-8").hex()
+        return (f"/{self.PREFIX_SEG}/{self.region}",
+                f"/{self.DATA_SEG}/{payload}")
+
+    @staticmethod
+    def from_dentry_parts(prefix: str, dst: str
+                          ) -> Optional["RegionDigest"]:
+        """Decode one store dentry; None when it is not a region digest
+        (instance docs and operator dentries are left alone)."""
+        psegs = [s for s in prefix.split("/") if s]
+        dsegs = [s for s in dst.split("/") if s]
+        if (len(psegs) != 2 or psegs[0] != RegionDigest.PREFIX_SEG
+                or len(dsegs) != 2 or dsegs[0] != RegionDigest.DATA_SEG):
+            return None
+        try:
+            digest = RegionDigest.from_json(
+                bytes.fromhex(dsegs[1]).decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            return None
+        if digest.region != psegs[1]:
+            return None  # a digest must live under its own region prefix
+        return digest
+
+
+@dataclass
+class _Entry:
+    digest: RegionDigest
+    received_at: float  # receiver-side monotonic ingest instant
+
+
+class RegionView:
+    """Latest digest per region + the WAN staleness/fencing logic.
+
+    ``region`` is the OWN region id (own-region digests are tracked for
+    leadership fencing but excluded from peer-region queries)."""
+
+    def __init__(self, region: str, wan_ttl_s: float = 15.0):
+        if not valid_region(region):
+            raise ValueError(
+                f"region id must match [a-z][a-z0-9-]{{0,31}}, "
+                f"got {region!r}")
+        if wan_ttl_s <= 0:
+            raise ValueError("wan_ttl_s must be > 0")
+        self.region = region
+        self.wan_ttl_s = wan_ttl_s
+        self._regions: Dict[str, _Entry] = {}
+        self.ingested = 0
+        self.fenced = 0
+        self.rejected = 0  # table full of FRESH regions: newcomer dropped
+        # True once a digest for OUR region carried a newer generation
+        # under a DIFFERENT leader: this process led a zombie region and
+        # must never publish digests (or revert overrides) again. Set
+        # only while this instance believes itself leader — see
+        # FleetExchange.
+        self.superseded_leader = False
+
+    # -- ingest (synchronous: atomic under asyncio) -----------------------
+    def ingest(self, digest: RegionDigest,
+               now: Optional[float] = None) -> bool:
+        """Fold one received digest in; returns True when it advanced
+        the view (False: fenced as stale or rejected by the bounded
+        region table)."""
+        now = time.monotonic() if now is None else now
+        cur = self._regions.get(digest.region)
+        if cur is not None \
+                and digest.ordering() <= cur.digest.ordering():
+            if digest.ordering() < cur.digest.ordering():
+                self.fenced += 1
+            return False
+        if cur is None and len(self._regions) >= MAX_REGIONS:
+            stale = [r for r, e in self._regions.items()
+                     if now - e.received_at > self.wan_ttl_s]
+            if not stale:
+                self.rejected += 1
+                return False
+            del self._regions[min(
+                stale, key=lambda r: self._regions[r].received_at)]
+        self._regions[digest.region] = _Entry(digest, now)
+        self.ingested += 1
+        return True
+
+    def observe_supersede(self, own_instance: str,
+                          was_leader: bool) -> None:
+        """Called after ingest by the publisher: a newer-generation
+        digest for OUR region under a different leader while WE were
+        leading means a successor took the region over (we were cut off
+        or replaced) — zombie leaders never publish again."""
+        cur = self._regions.get(self.region)
+        if (was_leader and cur is not None
+                and cur.digest.leader != own_instance):
+            self.superseded_leader = True
+
+    # -- queries ----------------------------------------------------------
+    def get(self, region: str) -> Optional[RegionDigest]:
+        """Latest known digest for a region regardless of freshness
+        (fencing decisions want the newest ordering seen, stale or not)."""
+        e = self._regions.get(region)
+        return e.digest if e is not None else None
+
+    def fresh(self, now: Optional[float] = None) -> List[RegionDigest]:
+        now = time.monotonic() if now is None else now
+        return [e.digest for e in self._regions.values()
+                if now - e.received_at <= self.wan_ttl_s]
+
+    def fresh_peer_regions(self, now: Optional[float] = None
+                           ) -> List[str]:
+        return sorted(d.region for d in self.fresh(now)
+                      if d.region != self.region)
+
+    def region_level(self, region: str, cluster: str,
+                     now: Optional[float] = None) -> Optional[float]:
+        """The region's rolled-up quorum level for ``cluster``; None
+        when the region's digest is unknown or WAN-stale (an unreachable
+        region is UNKNOWN, never healthy)."""
+        now = time.monotonic() if now is None else now
+        e = self._regions.get(region)
+        if e is None or now - e.received_at > self.wan_ttl_s:
+            return None
+        lvl = e.digest.level_of(cluster)
+        return 0.0 if lvl is None else lvl
+
+    def healthy_regions(self, cluster: str, below: float,
+                        now: Optional[float] = None) -> List[str]:
+        """Peer regions with a FRESH digest whose rolled-up level for
+        ``cluster`` is strictly below ``below`` — the candidate targets
+        for a cross-region shift, ordered healthiest-first (level, then
+        region id, so every instance picks the same one)."""
+        now = time.monotonic() if now is None else now
+        out = []
+        for d in self.fresh(now):
+            if d.region == self.region:
+                continue
+            lvl = d.level_of(cluster)
+            lvl = 0.0 if lvl is None else lvl
+            if lvl < below:
+                out.append((lvl, d.region))
+        return [r for _, r in sorted(out)]
+
+    def status(self, now: Optional[float] = None) -> dict:
+        now = time.monotonic() if now is None else now
+        return {
+            "region": self.region,
+            "wan_ttl_s": self.wan_ttl_s,
+            "ingested": self.ingested,
+            "fenced": self.fenced,
+            "rejected": self.rejected,
+            "superseded_leader": self.superseded_leader,
+            "regions": {
+                r: {
+                    "leader": e.digest.leader,
+                    "generation": e.digest.generation,
+                    "seq": e.digest.seq,
+                    "age_s": round(now - e.received_at, 3),
+                    "fresh": now - e.received_at <= self.wan_ttl_s,
+                    "clusters": {
+                        c: round(a.get("level", 0.0), 4)
+                        for c, a in e.digest.clusters.items()},
+                    "overrides": list(e.digest.overrides),
+                }
+                for r, e in sorted(self._regions.items())
+            },
+        }

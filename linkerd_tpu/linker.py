@@ -948,7 +948,7 @@ class Linker:
             idle_ttl=float(cache_cfg.get("idleTtlSecs", 600.0)),
             bind_timeout=rspec.bindingTimeoutMs / 1e3)
 
-        routing = RoutingService(identifier, binding)
+        routing = self._mk_routing(identifier, binding, base_dtab)
         server_filters: List[Any] = [
             StageTimerFilter(metrics, "rt", label),
             H2StreamStatsFilter(metrics, "rt", label, "server"),
@@ -1152,7 +1152,7 @@ class Linker:
             capacity=int(cache_cfg.get("capacity", 1000)),
             idle_ttl=float(cache_cfg.get("idleTtlSecs", 600.0)),
             bind_timeout=rspec.bindingTimeoutMs / 1e3)
-        routing = RoutingService(identifier, binding)
+        routing = self._mk_routing(identifier, binding, base_dtab)
         server_filters: List[Any] = [
             StageTimerFilter(metrics, "rt", label),
             MuxStatsFilter(metrics.scope("rt", label, "server"))]
@@ -1314,7 +1314,7 @@ class Linker:
             capacity=int(cache_cfg.get("capacity", 1000)),
             idle_ttl=float(cache_cfg.get("idleTtlSecs", 600.0)),
             bind_timeout=rspec.bindingTimeoutMs / 1e3)
-        routing = RoutingService(identifier, binding)
+        routing = self._mk_routing(identifier, binding, base_dtab)
         server_filters: List[Any] = [
             StageTimerFilter(metrics, "rt", label),
             ThriftStatsFilter(metrics.scope("rt", label, "server"))]
@@ -1933,7 +1933,7 @@ class Linker:
             idle_ttl=float(cache_cfg.get("idleTtlSecs", 600.0)),
             bind_timeout=rspec.bindingTimeoutMs / 1e3)
 
-        routing = RoutingService(identifier, binding)
+        routing = self._mk_routing(identifier, binding, base_dtab)
         # Stats outermost so they observe ErrorResponder's mapped statuses;
         # anomaly feature recorders tap the same final view. The stage
         # timer sits just inside the trace filter so span tags see the
@@ -2042,6 +2042,31 @@ class Linker:
         tele = self._anomaly_telemeter()
         return getattr(tele, "control", None) if tele is not None else None
 
+    def _mk_routing(self, identifier, binding, base_dtab):
+        """Build a router's RoutingService, wired into the control
+        loop's partition-time override book when one exists: booked
+        overrides reach requests through the local-dtab seam, and the
+        failover binds they would route through are registered for
+        prewarming (a bind that first opens DURING a store partition
+        cannot resolve; a warm one holds its last-good state)."""
+        ctl = self._anomaly_control()
+        if ctl is None or getattr(ctl, "local_book", None) is None:
+            return RoutingService(identifier, binding)
+
+        def prewarm(cluster: str, target: str,
+                    _binding=binding, _base=base_dtab) -> None:
+            # the EXACT DstPath a booked `cluster => target` override
+            # produces at request time (single-entry book): same path,
+            # same base dtab, same single-dentry local dtab — so the
+            # prewarmed ServiceCache entry is the one requests hit
+            _binding.path_service(DstPath(
+                Path.read(cluster), _base,
+                Dtab.read(f"{cluster} => {target} ;")))
+
+        ctl.register_prewarm(prewarm)
+        return RoutingService(identifier, binding,
+                              local_dtab_fn=ctl.local_dtab_for)
+
     def _mk_balancer(self, kind: str, addr, endpoint_factory):
         """mk_balancer + the control loop's score weighting when
         configured: replicas trending anomalous are multiplicatively
@@ -2059,6 +2084,11 @@ class Linker:
     async def start(self) -> "Linker":
         for r in self.routers:
             await r.start()
+        # warm the failover binds while the store is reachable (the
+        # control loop re-touches them on its prewarm cadence)
+        ctl = self._anomaly_control()
+        if ctl is not None:
+            ctl.prewarm_failover_binds()
         # announce bound servers (ref: Main.announce, Main.scala:97-130)
         from linkerd_tpu.announcer import match_announcer
         for r in self.routers:

@@ -36,9 +36,17 @@ DTAB_HEADER = "l5d-dtab"  # per-request dtab override (ref: LinkerdHeaders.scala
 
 
 class RoutingService(Service[Request, Response]):
-    def __init__(self, identifier: Identifier, binding: DstBindingFactory):
+    def __init__(self, identifier: Identifier, binding: DstBindingFactory,
+                 local_dtab_fn: Optional[
+                     Callable[[Path], Dtab]] = None):
         self._identifier = identifier
         self._binding = binding
+        # control-plane seam: per-request extra local dtab for the
+        # identified path (the reactor's LocalOverrideBook — partition-
+        # time overrides that cannot reach the namerd store). Returning
+        # an empty dtab leaves the request untouched, including its
+        # binding-cache key.
+        self._local_dtab_fn = local_dtab_fn
 
     async def __call__(self, req: Request) -> Response:
         with staged(req, "identification"):
@@ -50,6 +58,12 @@ class RoutingService(Service[Request, Response]):
             # identifier answered directly (istio redirect responses —
             # ref IstioIdentifierBase.redirectRequest)
             return dst
+        if self._local_dtab_fn is not None:
+            extra = self._local_dtab_fn(dst.path)
+            if len(extra):
+                import dataclasses
+                dst = dataclasses.replace(
+                    dst, local_dtab=dst.local_dtab + extra)
         req.ctx["dst"] = dst
         # binding + service stages are attributed inside DynBoundService
         # (the pending-bind wait and the dispatch through the bound tree)

@@ -411,3 +411,232 @@ interfaces:
             await asyncio.sleep(0.1)
         raise AssertionError(
             f"timed out waiting for fleet {name} >= {want}")
+
+
+# ---- hierarchical (2-region) topology --------------------------------------
+
+
+class WanProxy:
+    """A TCP forwarder standing in for one region's WAN uplink to the
+    control plane. ``partition()`` closes the listener AND severs every
+    established flow (in-flight watch streams die, new connects are
+    refused — exactly what a cut link looks like to the far side);
+    ``heal()`` re-listens on the same port."""
+
+    def __init__(self, target_port: int):
+        self.target_port = target_port
+        self.port = free_port()
+        self.partitioned = False
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._pipes: Set[asyncio.Task] = set()
+
+    async def start(self) -> "WanProxy":
+        self._server = await asyncio.start_server(
+            self._on_conn, "127.0.0.1", self.port)
+        return self
+
+    async def _on_conn(self, reader, writer) -> None:
+        if self.partitioned:
+            writer.close()
+            return
+        try:
+            up_reader, up_writer = await asyncio.open_connection(
+                "127.0.0.1", self.target_port)
+        except OSError:
+            writer.close()
+            return
+
+        async def pipe(rd, wr) -> None:
+            try:
+                while True:
+                    data = await rd.read(65536)
+                    if not data:
+                        break
+                    wr.write(data)
+                    await wr.drain()
+            except (OSError, asyncio.CancelledError):
+                pass
+            finally:
+                try:
+                    wr.close()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+
+        loop = asyncio.get_running_loop()
+        for rd, wr in ((reader, up_writer), (up_reader, writer)):
+            t = loop.create_task(pipe(rd, wr))
+            self._pipes.add(t)
+            t.add_done_callback(self._pipes.discard)
+
+    async def partition(self) -> None:
+        self.partitioned = True
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        for t in list(self._pipes):
+            t.cancel()
+        if self._pipes:
+            await asyncio.gather(*self._pipes, return_exceptions=True)
+        self._pipes.clear()
+
+    async def heal(self) -> None:
+        self.partitioned = False
+        if self._server is None:
+            self._server = await asyncio.start_server(
+                self._on_conn, "127.0.0.1", self.port)
+
+    async def close(self) -> None:
+        await self.partition()
+        self.partitioned = False
+
+
+class RegionFleetHarness(FleetHarness):
+    """Two-region fleet on real binaries: ``east`` = instances 0..k-1
+    behind one WanProxy to namerd (their WAN uplink — interpreter,
+    store client, and fleet watch all ride it), ``west`` = the rest,
+    plus namerd itself, reached directly. Three downstream clusters:
+
+    - ``web``     — the primary every instance routes to (faultable);
+    - ``web-b``   — the LOCAL failover replica set;
+    - ``web-west``— west's replica set, east's cross-region target
+      (and symmetrically, ``web-b`` is west's cross-region target in
+      east).
+
+    Gossip peers never cross the region boundary; cross-region evidence
+    moves ONLY through region digests in the namerd ``fleet``
+    namespace, so cutting the WanProxy is a true WAN partition: east
+    keeps its intra-region quorum (gossip) and loses the store, the
+    digests, and nothing else."""
+
+    def __init__(self, east: int = 2, west: int = 1,
+                 wan_ttl_s: float = 3.0,
+                 digest_interval_s: float = 0.5,
+                 store_timeout_ms: int = 800,
+                 **kw):
+        kw.setdefault("quorum", 2)
+        super().__init__(n=east + west, **kw)
+        self.east = east
+        self.west = west
+        self.wan_ttl_s = wan_ttl_s
+        self.digest_interval_s = digest_interval_s
+        self.store_timeout_ms = store_timeout_ms
+        self.west_cluster = FaultableCluster("W")
+        self.wan = WanProxy(self.namerd_port)
+
+    # -- topology ----------------------------------------------------------
+    def region_of(self, i: int) -> str:
+        return "east" if i < self.east else "west"
+
+    def region_insts(self, region: str) -> List[int]:
+        return [i for i in range(self.n) if self.region_of(i) == region]
+
+    def _region_quorum(self, region: str) -> int:
+        # intra-region quorum = majority of the region's instances
+        return len(self.region_insts(region)) // 2 + 1
+
+    def _namerd_port_for(self, i: int) -> int:
+        return self.wan.port if self.region_of(i) == "east" \
+            else self.namerd_port
+
+    def linkerd_yaml(self, i: int) -> str:
+        region = self.region_of(i)
+        peers = [f"127.0.0.1:{self.admin_ports[j]}"
+                 for j in self.region_insts(region) if j != i]
+        peers_yaml = "".join(f"\n        - {p}" for p in peers)
+        xtarget = ("/svc/web-west" if region == "east" else "/svc/web-b")
+        xregion = "west" if region == "east" else "east"
+        namerd = self._namerd_port_for(i)
+        return f"""
+routers:
+- protocol: http
+  label: fleet{i}
+  interpreter:
+    kind: io.l5d.namerd.http
+    dst: /$/inet/127.0.0.1/{namerd}
+    namespace: default
+  servers:
+  - port: {self.router_ports[i]}
+telemetry:
+- kind: io.l5d.jaxAnomaly
+  maxLingerMs: 2
+  scoreTtlSecs: 30
+  control:
+    intervalMs: 50
+    warmupBatches: {self.warmup_batches}
+    enterThreshold: {self.enter}
+    exitThreshold: {self.exit}
+    quorum: {self.governor_quorum}
+    cooldownS: {self.cooldown_s}
+    namespace: default
+    namerdAddress: 127.0.0.1:{namerd}
+    storeTimeoutMs: {self.store_timeout_ms}
+    failover:
+      /svc/web: /svc/web-b
+    regionFailover:
+      /svc/web:
+        {xregion}: {xtarget}
+    fleet:
+      instance: {self.instance_ids[i]}
+      generation: {self.generation}
+      quorum: {self._region_quorum(region)}
+      expectInstances: {self.n}
+      namespace: fleet
+      publishIntervalS: {self.publish_interval_s}
+      stalenessTtlS: {self.staleness_ttl_s}
+      gossip: {str(self.gossip).lower()}
+      gossipIntervalMs: {self.gossip_interval_ms}
+      region: {region}
+      wanTtlS: {self.wan_ttl_s}
+      digestIntervalS: {self.digest_interval_s}
+      peers:{peers_yaml if peers else " []"}
+admin:
+  port: {self.admin_ports[i]}
+"""
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self, route_timeout_s: float = 90.0
+                    ) -> "RegionFleetHarness":
+        await self.west_cluster.start()
+        await self.wan.start()
+
+        # the base start() materializes disco/web + disco/web-b; west's
+        # replica set must exist before any linkerd binds it
+        disco = os.path.join(self.work, "disco")
+        os.makedirs(disco, exist_ok=True)
+
+        def write_west() -> None:
+            with open(os.path.join(disco, "web-west"), "w") as f:
+                f.write(f"127.0.0.1 {self.west_cluster.port}\n")
+
+        await asyncio.to_thread(write_west)
+        await super().start(route_timeout_s=route_timeout_s)
+        return self
+
+    async def stop(self) -> None:
+        await super().stop()
+        await self.west_cluster.close()
+        await self.wan.close()
+
+    # -- scenario controls -------------------------------------------------
+    async def partition_east(self) -> None:
+        """Cut east's WAN uplink: east loses namerd (store, digests,
+        new binds); east's intra-region gossip and its already-bound
+        routes keep working."""
+        await self.wan.partition()
+
+    async def heal_east(self) -> None:
+        await self.wan.heal()
+
+    async def region_status(self, i: int) -> dict:
+        return await self.admin_json(i, "/regions.json")
+
+    async def flap_count(self) -> float:
+        """Fleet-wide override PUBLISHES — the flap budget a scenario
+        asserts against (each injected wave should cost exactly one).
+        Reverts are deliberately not counted: every adopter increments
+        ``overrides_reverted`` on recovery even though only the first
+        revert writes the namespace, so publish count is the honest
+        measure of namespace churn."""
+        return await self.fleet_metric_sum(
+            "control/reactor/overrides_published")

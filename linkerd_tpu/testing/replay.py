@@ -1,0 +1,241 @@
+"""Million-user replay: synthetic multi-region traffic mixes driven
+through a real-binary RegionFleetHarness.
+
+The "millions of users" claim is about *shape*, not raw socket count:
+what breaks hierarchical control planes is the traffic WEATHER — diurnal
+ramps that move every score at once, regional failure waves that flip a
+quorum, tenant hot-spots that concentrate load — while the fleet keeps
+actuating without flaps. This module replays exactly those shapes as a
+deterministic segment schedule (each segment sets per-region rate
+multipliers, fault sets, and the WAN partition state) and reports the
+control-plane outcomes that matter:
+
+- ``fleet_req_s``          — fleet-wide successfully-routed request rate;
+- ``cross_region_shift_latency_ms`` — fault start -> cross-region
+  override published;
+- ``heal_reconcile_ms``    — WAN heal -> booked overrides reconciled;
+- ``flap_count``           — total override writes (publish + revert);
+  a clean run is exactly one shift and one revert per injected wave.
+
+Users are modeled, not spawned: each request carries a synthetic user id
+drawn from a Zipf-like tenant mix (hot-spot segments skew it), and the
+schedule's rates are per-instance pacing — the fleet sees the same
+per-score-window shapes a million-user population produces, at a socket
+count a CI box can pay for. Device-free by construction: everything is
+asyncio + real linkerd/namerd subprocesses on CPU.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from linkerd_tpu.testing.fleet import RegionFleetHarness
+
+log = logging.getLogger(__name__)
+
+USER_HEADER = "x-replay-user"
+
+
+@dataclass
+class ReplaySegment:
+    """One slice of synthetic weather: who sends how much, what is
+    broken, and whether east's WAN uplink is up."""
+
+    name: str
+    duration_s: float
+    # per-region pacing multiplier over the base rate (1.0 = base;
+    # 0.2 = night trickle; 3.0 = peak). Regions absent default to 1.0.
+    rates: Dict[str, float] = field(default_factory=dict)
+    # instance ids that observe the primary cluster faulting;
+    # None = "every east instance" (resolved by the runner)
+    fault_insts: Optional[Set[str]] = field(default_factory=set)
+    partition_east: bool = False
+    # tenant hot-spot skew: 0.0 = uniform users, 1.0 = a single tenant
+    # sends nearly everything
+    hotspot: float = 0.0
+
+
+def diurnal_mix(base: float = 1.0) -> List[ReplaySegment]:
+    """The standing mix: a compressed day with a regional failure wave
+    and a WAN partition riding the peak, then recovery."""
+    return [
+        ReplaySegment("night", 2.0, rates={"east": 0.3 * base,
+                                           "west": 0.3 * base}),
+        ReplaySegment("morning-ramp", 2.0, rates={"east": 1.0 * base,
+                                                  "west": 0.7 * base}),
+        ReplaySegment("peak-hotspot", 2.0, rates={"east": 2.0 * base,
+                                                  "west": 1.5 * base},
+                      hotspot=0.8),
+        ReplaySegment("east-failure-wave", 6.0,
+                      rates={"east": 2.0 * base, "west": 1.5 * base},
+                      fault_insts=None),  # filled by the runner: all east
+        ReplaySegment("recovery", 4.0, rates={"east": 1.0 * base,
+                                              "west": 1.0 * base}),
+    ]
+
+
+def partition_mix(base: float = 1.0) -> List[ReplaySegment]:
+    """The full partition-tolerance drill, two waves:
+
+    wave 1 (WAN up): an east-wide fault publishes ONE cross-region
+    failover dentry, recovery reverts it exactly;
+    wave 2 (WAN cut FIRST, then the same fault): east books a LOCAL
+    override on region-local quorum — zero store writes — and the heal
+    reconciles the book with exactly one store publish."""
+    return [
+        ReplaySegment("steady", 2.0),
+        ReplaySegment("east-fault", 8.0, fault_insts=None),
+        ReplaySegment("recovery-1", 6.0),
+        ReplaySegment("partitioned", 2.0, partition_east=True),
+        ReplaySegment("east-fault-partitioned", 8.0, fault_insts=None,
+                      partition_east=True),
+        ReplaySegment("heal-fault-held", 6.0, fault_insts=None),
+        ReplaySegment("recovery-2", 6.0),
+    ]
+
+
+class ReplayRunner:
+    """Drives a RegionFleetHarness through a segment schedule and
+    collects the control-plane outcome rows."""
+
+    def __init__(self, harness: RegionFleetHarness,
+                 base_interval_s: float = 0.02,
+                 users: int = 1_000_000):
+        self.h = harness
+        self.base_interval_s = base_interval_s
+        self.users = users
+        self.rows: List[dict] = []
+        self._user_seq = 0
+
+    # -- synthetic users ---------------------------------------------------
+    def _user_id(self, hotspot: float) -> str:
+        """Zipf-flavored synthetic user id: with probability ``hotspot``
+        the request belongs to tenant 0 (the hot key); otherwise it
+        cycles the long tail. Deterministic — replays are replays."""
+        self._user_seq += 1
+        if hotspot > 0.0 and (self._user_seq % 100) < hotspot * 100:
+            return "user-0"
+        return f"user-{self._user_seq % self.users}"
+
+    # -- one segment -------------------------------------------------------
+    async def _drive_segment(self, seg: ReplaySegment) -> dict:
+        h = self.h
+        stop = asyncio.Event()
+        ok = [0]
+        sent = [0]
+
+        async def pump(i: int, interval: float) -> None:
+            from linkerd_tpu.testing.fleet import FAULT_HEADER, _http
+            while not stop.is_set():
+                sent[0] += 1
+                hdrs = {"Host": "web",
+                        FAULT_HEADER: h.instance_ids[i],
+                        USER_HEADER: self._user_id(seg.hotspot)}
+
+                def one() -> bytes:
+                    _, body = _http(
+                        "GET",
+                        f"http://127.0.0.1:{h.router_ports[i]}/",
+                        headers=hdrs, timeout=5.0)
+                    return body
+
+                try:
+                    if (await asyncio.to_thread(one)) in (b"A", b"B",
+                                                          b"W"):
+                        ok[0] += 1
+                except Exception:  # noqa: BLE001 — faulted responses
+                    pass           # still move features
+                await asyncio.sleep(interval)
+
+        tasks = []
+        loop = asyncio.get_running_loop()
+        for i in range(h.n):
+            mult = seg.rates.get(h.region_of(i), 1.0)
+            if mult <= 0:
+                continue
+            interval = self.base_interval_s / mult
+            tasks.append(loop.create_task(
+                pump(i, interval), name=f"replay-{seg.name}-{i}"))
+        t0 = time.monotonic()
+        await asyncio.sleep(seg.duration_s)
+        stop.set()
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        elapsed = time.monotonic() - t0
+        return {
+            "segment": seg.name,
+            "duration_s": round(elapsed, 3),
+            "requests": sent[0],
+            "routed_ok": ok[0],
+            "fleet_req_s": round(ok[0] / elapsed, 2) if elapsed else 0.0,
+        }
+
+    # -- latency watchers --------------------------------------------------
+    async def _first_hit_ms(self, names: List[str],
+                            t0: float) -> float:
+        """Polls admin metrics (never the data path) until the summed
+        counters first reach 1; returns elapsed ms since ``t0``."""
+        while True:
+            total = 0.0
+            for nm in names:
+                total += await self.h.fleet_metric_sum(nm)
+            if total >= 1:
+                return round((time.monotonic() - t0) * 1e3, 1)
+            await asyncio.sleep(0.25)
+
+    @staticmethod
+    async def _settle(task: Optional[asyncio.Task]) -> Optional[float]:
+        if task is None:
+            return None
+        if not task.done():
+            task.cancel()
+        try:
+            return await task
+        except asyncio.CancelledError:
+            return None
+
+    # -- the schedule ------------------------------------------------------
+    async def run(self, segments: List[ReplaySegment]) -> List[dict]:
+        h = self.h
+        east_ids = {h.instance_ids[i] for i in h.region_insts("east")}
+        loop = asyncio.get_running_loop()
+        shift_task: Optional[asyncio.Task] = None
+        heal_task: Optional[asyncio.Task] = None
+        for seg in segments:
+            faults = (east_ids if seg.fault_insts is None
+                      else set(seg.fault_insts))
+            faulted_before = bool(h.primary.fault_insts)
+            h.primary.fault_insts = faults
+            if faults and not faulted_before and shift_task is None:
+                # first wave: fault onset -> first override actuated
+                # (store publish when the WAN is up, local book when cut)
+                shift_task = loop.create_task(self._first_hit_ms(
+                    ["control/reactor/overrides_published",
+                     "control/reactor/local_actuations"],
+                    time.monotonic()), name="replay-shift-watch")
+            partitioned_before = h.wan.partitioned
+            if seg.partition_east and not partitioned_before:
+                await h.partition_east()
+            elif not seg.partition_east and partitioned_before:
+                await h.heal_east()
+                if heal_task is None:
+                    heal_task = loop.create_task(self._first_hit_ms(
+                        ["control/reactor/heal_reconciles"],
+                        time.monotonic()), name="replay-heal-watch")
+            row = await self._drive_segment(seg)
+            self.rows.append(row)
+            log.info("replay segment %s: %s", seg.name, row)
+        self.rows.append({
+            "segment": "summary",
+            "cross_region_shift_latency_ms": await self._settle(
+                shift_task),
+            "heal_reconcile_ms": await self._settle(heal_task),
+            "flap_count": await h.flap_count(),
+            "modeled_users": self.users,
+        })
+        return self.rows

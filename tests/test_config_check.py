@@ -521,6 +521,96 @@ class TestFleetConfigRules:
         assert rules_of(check_text(cfg), "fleet-config") == []
 
 
+class TestRegionConfigRules:
+    def region(self, fleet_yaml,
+               region_failover="regionFailover:\n"
+                               "      /svc/web:\n"
+                               "        west: /svc/web-west"):
+        rf = "".join(f"    {line}\n"
+                     for line in region_failover.splitlines()) \
+            if region_failover else ""
+        return (
+            "routers:\n- protocol: http\n"
+            "  dtab: |\n    /svc => /#/io.l5d.fs ;\n"
+            "  servers: [{port: 0}]\n"
+            "telemetry:\n- kind: io.l5d.jaxAnomaly\n"
+            "  control:\n"
+            "    namespace: default\n"
+            "    namerdAddress: 127.0.0.1:4180\n"
+            "    failover:\n"
+            "      /svc/web: /svc/web-b\n"
+            + rf +
+            "    fleet:\n"
+            + "".join(f"      {line}\n"
+                      for line in fleet_yaml.splitlines())
+            + NAMERS + "admin: {port: 9990}\n")
+
+    def test_bad_region_grammar_fires(self):
+        cfg = self.region("quorum: 2\nregion: 'East'")
+        (f,) = rules_of(check_text(cfg), "region-config")
+        assert "region 'East'" in f.message
+
+    def test_quorum_above_region_size_fires(self):
+        cfg = self.region("quorum: 3\nregion: east\n"
+                          "peers: [127.0.0.1:9991]")
+        (f,) = rules_of(check_text(cfg), "region-config")
+        assert "region" in f.message and "quorum" in f.message.lower()
+
+    def test_wan_ttl_below_digest_cadence_fires(self):
+        cfg = self.region("quorum: 2\nregion: east\n"
+                          "peers: [127.0.0.1:9991]\n"
+                          "wanTtlS: 1.0\ndigestIntervalS: 2.0")
+        (f,) = rules_of(check_text(cfg), "region-config")
+        assert "expires before its successor" in f.message
+
+    def test_self_shift_fires(self):
+        cfg = self.region(
+            "quorum: 2\nregion: east\npeers: [127.0.0.1:9991]",
+            region_failover="regionFailover:\n"
+                            "      /svc/web:\n"
+                            "        east: /svc/web-b")
+        (f,) = rules_of(check_text(cfg), "region-config")
+        assert "OWN region" in f.message
+
+    def test_bad_target_region_grammar_fires(self):
+        cfg = self.region(
+            "quorum: 2\nregion: east\npeers: [127.0.0.1:9991]",
+            region_failover="regionFailover:\n"
+                            "      /svc/web:\n"
+                            "        WEST: /svc/web-west")
+        (f,) = rules_of(check_text(cfg), "region-config")
+        assert "'WEST'" in f.message and "never fires" in f.message
+
+    def test_gossip_peers_crossing_region_warn(self):
+        # 3 peers + this instance > expectInstances (the region's
+        # size): the peer list must cross the region boundary
+        cfg = self.region("quorum: 2\nregion: east\n"
+                          "expectInstances: 3\n"
+                          "peers: [127.0.0.1:9991, 127.0.0.1:9992, "
+                          "127.0.0.1:9993]")
+        (f,) = rules_of(check_text(cfg), "region-config")
+        assert f.severity == "warning"
+        assert "cross the region boundary" in f.message
+
+    def test_region_failover_without_region_fires(self):
+        cfg = self.region("quorum: 2\nexpectInstances: 3")
+        (f,) = rules_of(check_text(cfg), "region-config")
+        assert "no region:" in f.message
+
+    def test_clean_region_block_is_quiet(self):
+        cfg = self.region("quorum: 2\nregion: east\n"
+                          "expectInstances: 3\n"
+                          "peers: [127.0.0.1:9991, 127.0.0.1:9992]\n"
+                          "wanTtlS: 15.0\ndigestIntervalS: 2.0")
+        assert rules_of(check_text(cfg), "region-config") == []
+
+    def test_flat_fleet_stays_out_of_region_scope(self):
+        # no region, no regionFailover: the rule must not fire at all
+        cfg = self.region("quorum: 2\nexpectInstances: 3",
+                          region_failover=None)
+        assert rules_of(check_text(cfg), "region-config") == []
+
+
 class TestDistillConfigRules:
     def distill(self, distill_yaml, fast=True, native="primary",
                 quant="f32"):

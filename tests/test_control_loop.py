@@ -553,7 +553,12 @@ class TestMeshReactor:
             t0 = time.monotonic()
             await r.step(now=1.0)  # must return, not hang
             assert time.monotonic() - t0 < 2.0
-            assert metrics.flatten()["control/reactor/errors"] == 1
+            # a hung store is classified as CONNECTIVITY loss, not a
+            # generic error: the reactor enters partition mode (where a
+            # LocalOverrideBook, when configured, keeps actuating)
+            flat = metrics.flatten()
+            assert flat["control/reactor/errors"] == 0
+            assert flat["control/reactor/partitioned"] == 1.0
             assert r.active == {}
 
         run(go())

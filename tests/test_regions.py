@@ -1,0 +1,733 @@
+"""Hierarchical region tier tests (linkerd_tpu/fleet/regions.py + the
+FleetExchange digest roll-up + MeshReactor partition tolerance).
+
+- RegionDigest hostile inputs: malformed / oversized / duplicate-region
+  dentries raise ONE error type (ValueError) on decode and cost exactly
+  one vote — never a poisoned publish round (mirrors the FleetDoc
+  hardening contract);
+- RegionView: (generation, seq) fencing, receiver-monotonic WAN
+  staleness, the bounded region table against hostile id churn, the
+  zombie-leader latch;
+- digest exchange in-process: leader-only roll-up gated on live quorum,
+  CAS generation takeover, peer regions ingesting digests through the
+  shared fleet namespace, the region fence clearing only on legitimate
+  re-publish;
+- partition -> local-actuate -> heal -> reconcile ordering on the
+  reactor, including DeterministicScheduler-pinned interleavings: the
+  booked override publishes exactly once on heal (adopt-if-present
+  absorbs a successor racing the same dentry), and a healed zombie
+  region drops its book without a single store write — it can never
+  revert a successor's override.
+- end to end on the REAL binaries: 2 regions x 3 linkerds + namerd with
+  east's WAN riding a cuttable proxy — cross-region failover publishes
+  exactly once and reverts exactly; a WAN partition books a LOCAL
+  override on region-local quorum with zero store writes; heal
+  reconciles the book with exactly one publish; zero flaps end to end
+  (testing/fleet.py RegionFleetHarness).
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from linkerd_tpu.control.reactor import LocalOverrideBook, LocalStoreClient, MeshReactor
+from linkerd_tpu.control.state import HysteresisGovernor
+from linkerd_tpu.core import Dtab, Path
+from linkerd_tpu.fleet.doc import FleetDoc
+from linkerd_tpu.fleet.exchange import FleetConfig
+from linkerd_tpu.fleet.regions import (
+    DIGEST_FIELDS, MAX_REGIONS, RegionDigest, RegionView,
+)
+from linkerd_tpu.namerd import InMemoryDtabStore
+from linkerd_tpu.telemetry.metrics import MetricsTree
+
+
+def run(coro, timeout: float = 60):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+BASE_DTAB = "/svc => /#/io.l5d.fs ;"
+PREFIXES = [Path.read("/io.l5d.fs")]
+
+
+class _Board:
+    degraded = False
+
+    def __init__(self):
+        self.levels = {}
+
+    def effective_scores(self):
+        return dict(self.levels)
+
+
+def _digest(region="west", leader="w0", gen=1, seq=1, level=0.1,
+            cluster="/svc/web", overrides=()):
+    return RegionDigest(region=region, leader=leader, generation=gen,
+                        seq=seq,
+                        clusters={cluster: {"level": level, "n": 1.0}},
+                        overrides=list(overrides), ts=0.0)
+
+
+def _doc(inst="e1", gen=1, seq=1, level=0.9, cluster="/svc/web",
+         region="east"):
+    return FleetDoc(instance=inst, generation=gen, seq=seq,
+                    clusters={cluster: {"level": level}},
+                    overrides=[], ts=0.0, region=region)
+
+
+def _exchange(store, inst, gen=1, quorum=1, region="east",
+              metrics=None, **kw):
+    cfg = FleetConfig(instance=inst, generation=gen, quorum=quorum,
+                      region=region, wanTtlS=5.0, digestIntervalS=0.5,
+                      **kw)
+    node = (metrics.scope("control", "fleet")
+            if metrics is not None else None)
+    return cfg.mk(LocalStoreClient(store) if store is not None else None,
+                  metrics_node=node)
+
+
+class _CuttableClient(LocalStoreClient):
+    """LocalStoreClient with a WAN switch: while ``cut``, every store
+    op raises OSError (connectivity loss, not store corruption)."""
+
+    def __init__(self, store):
+        super().__init__(store)
+        self.cut = False
+        self.writes = []
+
+    async def fetch(self, ns):
+        if self.cut:
+            raise OSError("wan down")
+        return await super().fetch(ns)
+
+    async def cas(self, ns, dtab, version):
+        if self.cut:
+            raise OSError("wan down")
+        self.writes.append(dtab.show)
+        await super().cas(ns, dtab, version)
+
+
+def _region_reactor(store, board, exchange, metrics=None,
+                    client=None, book=None):
+    node = (metrics or MetricsTree()).scope("control", "reactor")
+    return MeshReactor(
+        board, client or LocalStoreClient(store), "default",
+        {"/svc/web": "/svc/web-b"},
+        governor=HysteresisGovernor(enter=0.6, exit=0.2, quorum=1,
+                                    dwell_s=0.0),
+        metrics_node=node, namer_prefixes=PREFIXES, fleet=exchange,
+        region_failover={"/svc/web": {"west": "/svc/web-west"}},
+        local_book=book, heal_probe_interval_s=0.0)
+
+
+# ---- RegionDigest hostile inputs -------------------------------------------
+
+
+class TestRegionDigestHostileInputs:
+    def test_json_roundtrip(self):
+        d = _digest(overrides=["/svc/web"])
+        got = RegionDigest.from_json(d.to_json())
+        assert got == d
+
+    def test_dentry_rides_a_real_dtab(self):
+        d = _digest(region="east", leader="e0")
+        prefix, dst = d.to_dentry_parts()
+        dtab = Dtab.read(BASE_DTAB + f" {prefix} => {dst} ;")
+        found = [RegionDigest.from_dentry_parts(e.prefix.show,
+                                                e.dst.show)
+                 for e in dtab]
+        assert found == [None, d]
+
+    def test_instance_docs_and_digests_never_cross_decode(self):
+        # the two tiers share the fleet namespace: each decoder must
+        # return None for the other's dentries, never mis-parse
+        doc = _doc()
+        dp, dd = doc.to_dentry_parts()
+        assert RegionDigest.from_dentry_parts(dp, dd) is None
+        dig = _digest()
+        gp, gd = dig.to_dentry_parts()
+        assert FleetDoc.from_dentry_parts(gp, gd) is None
+
+    def test_digest_must_live_under_its_own_region_prefix(self):
+        d = _digest(region="west")
+        _, dst = d.to_dentry_parts()
+        assert RegionDigest.from_dentry_parts("/region/east", dst) is None
+
+    def test_garbage_payload_is_not_a_digest(self):
+        assert RegionDigest.from_dentry_parts("/region/east",
+                                              "/d/zzzz") is None
+        assert RegionDigest.from_dentry_parts("/region/east",
+                                              "/d/00ff") is None
+
+    @pytest.mark.parametrize("payload", [
+        "[]",                                     # not an object
+        '{"r": "East", "l": "e0"}',               # region grammar
+        '{"r": "east", "l": "no/slash"}',         # leader grammar
+        '{"r": "east", "l": "e0", "c": []}',      # clusters not a map
+        '{"r": "east", "l": "e0", "c": {"/svc/web": 3}}',
+        '{"r": "east", "l": "e0", "o": {}}',      # overrides not a list
+        '{"r": "east", "l": "e0", "g": []}',      # list-valued numeric
+        '{"r": "east", "l": "e0", "t": []}',
+        '{"r": "east", "l": "e0", '
+        '"c": {"/svc/web": {"level": []}}}',
+    ])
+    def test_malformed_digests_raise_one_error_type(self, payload):
+        # the single-error-type contract: peer input failures are
+        # ValueError, never TypeError/KeyError leaking out of decode
+        with pytest.raises(ValueError):
+            RegionDigest.from_json(payload)
+
+    def test_oversized_digest_bounded_on_decode(self):
+        from linkerd_tpu.fleet.doc import MAX_CLUSTERS
+        d = RegionDigest(
+            region="east", leader="e0", generation=1, seq=1,
+            clusters={f"/svc/c{i}": {"level": 0.1, "n": 1.0}
+                      for i in range(MAX_CLUSTERS * 3)},
+            overrides=[f"/svc/c{i}" for i in range(MAX_CLUSTERS * 3)])
+        got = RegionDigest.from_json(d.to_json())
+        assert len(got.clusters) == MAX_CLUSTERS
+        assert len(got.overrides) == MAX_CLUSTERS
+
+    def test_unknown_aggregate_fields_dropped(self):
+        got = RegionDigest.from_json(
+            '{"r": "east", "l": "e0", "g": 1, "s": 1, '
+            '"c": {"/svc/web": {"level": 0.5, "evil": 9e99}}}')
+        assert set(got.clusters["/svc/web"]) == set(DIGEST_FIELDS)
+
+    def test_poison_digest_dentry_never_breaks_publish_round(self):
+        # a hostile/corrupt digest dentry in the namespace costs
+        # exactly itself: the leader's publish round still succeeds
+        async def go():
+            store = InMemoryDtabStore(
+                {"fleet": Dtab.read("/region/east => /d/zzzz ;")})
+            ex = _exchange(store, "e0")
+            assert await ex.publish_digest_once()
+            vd = store.observe("fleet").current.value
+            shown = vd.dtab.show
+            assert "/region/east => /d/zzzz" in shown  # left alone
+            decoded = [RegionDigest.from_dentry_parts(d.prefix.show,
+                                                      d.dst.show)
+                       for d in vd.dtab]
+            good = [d for d in decoded if d is not None]
+            assert [d.leader for d in good] == ["e0"]
+
+        run(go())
+
+
+# ---- RegionView ------------------------------------------------------------
+
+
+class TestRegionView:
+    def test_region_grammar_enforced(self):
+        with pytest.raises(ValueError):
+            RegionView("East")
+        with pytest.raises(ValueError):
+            RegionView("east", wan_ttl_s=0.0)
+
+    def test_ordering_fences_stale_digests(self):
+        v = RegionView("east", wan_ttl_s=10.0)
+        assert v.ingest(_digest(gen=2, seq=5), now=0.0)
+        assert not v.ingest(_digest(gen=2, seq=4), now=1.0)  # older seq
+        assert not v.ingest(_digest(gen=1, seq=99), now=1.0)  # older gen
+        assert v.fenced == 2
+        assert v.get("west").seq == 5
+        assert v.ingest(_digest(gen=3, seq=1), now=1.0)  # new incarnation
+
+    def test_duplicate_region_dentries_cost_one_vote(self):
+        # two dentries for one region in a single ingest pass: the
+        # newest ordering wins, the duplicate is fenced — one region,
+        # one vote, never two
+        v = RegionView("east", wan_ttl_s=10.0)
+        v.ingest(_digest(gen=1, seq=2, level=0.1), now=0.0)
+        v.ingest(_digest(gen=1, seq=1, level=0.9), now=0.0)
+        assert len(v.fresh(now=0.0)) == 1
+        assert v.region_level("west", "/svc/web", now=0.0) == 0.1
+
+    def test_wan_staleness_is_receiver_monotonic(self):
+        v = RegionView("east", wan_ttl_s=5.0)
+        # a sender-side ts from the far future buys nothing: freshness
+        # is the RECEIVER's ingest instant
+        d = _digest()
+        d.ts = 9e12
+        v.ingest(d, now=0.0)
+        assert v.region_level("west", "/svc/web", now=4.9) == 0.1
+        assert v.region_level("west", "/svc/web", now=5.1) is None
+        assert v.fresh_peer_regions(now=5.1) == []
+
+    def test_unknown_region_is_unknown_never_healthy(self):
+        v = RegionView("east")
+        assert v.region_level("west", "/svc/web", now=0.0) is None
+        assert v.healthy_regions("/svc/web", below=0.5, now=0.0) == []
+
+    def test_bounded_table_against_hostile_region_churn(self):
+        v = RegionView("east", wan_ttl_s=5.0)
+        for i in range(MAX_REGIONS):
+            assert v.ingest(_digest(region=f"r{i}", leader="w0"),
+                            now=0.0)
+        # table full of FRESH regions: a minted newcomer is rejected
+        assert not v.ingest(_digest(region="minted"), now=1.0)
+        assert v.rejected == 1
+        # once an entry goes stale the newcomer buys its slot
+        assert v.ingest(_digest(region="minted"), now=6.0)
+        assert len(v._regions) == MAX_REGIONS
+
+    def test_zombie_leader_latch(self):
+        v = RegionView("east")
+        v.ingest(_digest(region="east", leader="successor", gen=9),
+                 now=0.0)
+        v.observe_supersede("e0", was_leader=False)
+        assert not v.superseded_leader  # never led: cannot be a zombie
+        v.observe_supersede("e0", was_leader=True)
+        assert v.superseded_leader
+
+    def test_healthy_regions_sorted_healthiest_first(self):
+        v = RegionView("east")
+        v.ingest(_digest(region="west", level=0.3), now=0.0)
+        v.ingest(_digest(region="apac", leader="a0", level=0.1),
+                 now=0.0)
+        v.ingest(_digest(region="emea", leader="m0", level=0.9),
+                 now=0.0)
+        v.ingest(_digest(region="east", leader="e0", level=0.0),
+                 now=0.0)  # own region: never a cross-region target
+        assert v.healthy_regions("/svc/web", below=0.5,
+                                 now=0.0) == ["apac", "west"]
+
+
+# ---- digest exchange in-process --------------------------------------------
+
+
+class TestRegionExchange:
+    def test_leader_rolls_up_and_peer_region_ingests(self):
+        async def go():
+            store = InMemoryDtabStore({})
+            e0 = _exchange(store, "e0", quorum=2)
+            e0.set_source(lambda: {"/svc/web": 0.2},
+                          warmed_fn=lambda: True)
+            # no fresh same-region peer yet: live quorum unmet, no
+            # digest — an isolated instance mints no cross-region
+            # evidence
+            assert e0.build_region_digest() is None
+            e0.view.ingest(_doc(inst="e1", level=0.8))
+            assert e0.is_region_leader  # e0 < e1
+            assert await e0.publish_digest_once()
+
+            w0 = _exchange(store, "w0", region="west")
+            assert await w0.publish_once()  # ingests digests en route
+            assert w0.regions.get("east") is not None
+            # east's rolled-up level for web = 2nd-highest of
+            # {e0: 0.2, e1: 0.8} = 0.2 -> east is a healthy target
+            assert w0.region_level("east", "/svc/web") == \
+                pytest.approx(0.2)
+            assert w0.healthy_peer_regions("/svc/web",
+                                           below=0.5) == ["east"]
+
+        run(go())
+
+    def test_follower_never_publishes_digest(self):
+        async def go():
+            store = InMemoryDtabStore({})
+            e1 = _exchange(store, "e1", quorum=2)
+            e1.view.ingest(_doc(inst="e0"))  # e0 < e1: e0 leads
+            assert not e1.is_region_leader
+            assert not await e1.publish_digest_once()
+            assert await LocalStoreClient(store).fetch("fleet") is None
+
+        run(go())
+
+    def test_cas_takeover_bumps_generation_past_stored_digest(self):
+        async def go():
+            store = InMemoryDtabStore({})
+            prefix, dst = _digest(region="east", leader="e9", gen=50,
+                                  seq=3).to_dentry_parts()
+            from linkerd_tpu.control.reactor import cas_modify
+            client = LocalStoreClient(store)
+            await cas_modify(
+                client, "fleet",
+                lambda d: Dtab.read(f"{prefix} => {dst} ;"),
+                create_if_missing=Dtab.empty())
+            e0 = _exchange(store, "e0", gen=1)
+            assert await e0.publish_digest_once()
+            got = e0.regions.get("east")
+            assert got.leader == "e0"
+            assert got.generation == 51  # fenced PAST the stored line
+            # and the store carries exactly one east digest: ours
+            vd = store.observe("fleet").current.value
+            digests = [RegionDigest.from_dentry_parts(d.prefix.show,
+                                                      d.dst.show)
+                       for d in vd.dtab]
+            digests = [d for d in digests if d is not None]
+            assert [(d.leader, d.generation) for d in digests] == \
+                [("e0", 51)]
+
+        run(go())
+
+    def test_region_fence_latches_and_clears_only_on_republish(self):
+        async def go():
+            store = InMemoryDtabStore({})
+            e1 = _exchange(store, "e1", gen=1)
+            e1._led_region = True  # this instance HAS led the region
+            # a successor's newer-generation digest arrives (store
+            # ingest path) while we believe we lead: zombie latch
+            prefix, dst = _digest(region="east", leader="zz", gen=10,
+                                  seq=1).to_dentry_parts()
+            e1.ingest_dtab(Dtab.read(f"{prefix} => {dst} ;"))
+            assert e1.region_fenced
+            # legitimate re-take: fresh quorum + CAS takeover (the
+            # successor's dentry is in the store, so the publish bumps
+            # past generation 10) clears the latch
+            from linkerd_tpu.control.reactor import cas_modify
+            await cas_modify(
+                LocalStoreClient(store), "fleet",
+                lambda d: Dtab.read(f"{prefix} => {dst} ;"),
+                create_if_missing=Dtab.empty())
+            assert await e1.publish_digest_once()
+            assert not e1.region_fenced
+            assert e1.regions.get("east").generation == 11
+
+        run(go())
+
+
+# ---- partition -> local-actuate -> heal -> reconcile -----------------------
+
+
+class TestPartitionHealOrdering:
+    def test_partition_books_heal_publishes_exactly_once(self):
+        """The full ordering on one reactor: WAN cut + SICK books a
+        LOCAL override (zero store writes), routers see it through the
+        LocalOverrideBook, heal publishes the booked dentry exactly
+        once and empties the book."""
+        async def go():
+            store = InMemoryDtabStore({"default": Dtab.read(BASE_DTAB)})
+            board = _Board()
+            metrics = MetricsTree()
+            ex = _exchange(store, "e0")
+            client = _CuttableClient(store)
+            book = LocalOverrideBook()
+            r = _region_reactor(store, board, ex, metrics=metrics,
+                                client=client, book=book)
+
+            client.cut = True
+            board.levels["/svc/web"] = 0.95
+            for t in range(1, 8):
+                await r.step(now=float(t))
+            flat = metrics.flatten()
+            assert client.writes == []  # NOT ONE write while cut
+            assert flat["control/reactor/local_actuations"] == 1
+            assert flat["control/reactor/partitioned"] == 1.0
+            assert "/svc/web" in r.booked
+            # the data plane actuation: requests for the sick cluster
+            # pick up the booked dentry, unrelated paths never do
+            assert len(book.dtab_for(Path.read("/svc/web/GET"))) == 1
+            assert len(book.dtab_for(Path.read("/svc/other"))) == 0
+            vd = store.observe("default").current.value
+            assert "web-b" not in vd.dtab.show
+
+            client.cut = False
+            await r.step(now=10.0)
+            flat = metrics.flatten()
+            assert flat["control/reactor/heal_reconciles"] == 1
+            assert flat["control/reactor/overrides_published"] == 1
+            assert r.booked == {} and len(book) == 0
+            assert r.last_heal_reconcile_ms is not None
+            vd = store.observe("default").current.value
+            assert vd.dtab.show.count("/svc/web => /svc/web-b") == 1
+
+            # recovery: the published override reverts exactly
+            board.levels["/svc/web"] = 0.05
+            for t in range(11, 15):
+                await r.step(now=float(t))
+            flat = metrics.flatten()
+            assert flat["control/reactor/overrides_reverted"] == 1
+            assert flat["control/reactor/overrides_published"] == 1
+            vd = store.observe("default").current.value
+            assert vd.dtab.show == Dtab.read(BASE_DTAB).show
+
+        run(go())
+
+
+    def test_divergent_target_adopts_the_peers_dentry(self):
+        """Two reactors trip for the SAME cluster with DIFFERENT
+        targets (region digest views diverge under WAN staleness: the
+        peer saw west fresh and published cross-region, we did not and
+        chose the local failover). The second actuator must ADOPT the
+        peer's dentry — never stack a second dentry for the prefix,
+        which would let publish order pick the serving target — and
+        its revert must remove the ADOPTED dentry exactly."""
+        async def go():
+            peer = Dtab.read(BASE_DTAB + " /svc/web => /svc/web-west ;")
+            store = InMemoryDtabStore({"default": peer})
+            board = _Board()
+            metrics = MetricsTree()
+            r = _region_reactor(store, board, _exchange(store, "e1"),
+                                metrics=metrics)
+
+            board.levels["/svc/web"] = 0.95
+            for t in range(1, 4):
+                await r.step(now=float(t))
+            flat = metrics.flatten()
+            assert flat["control/reactor/overrides_adopted"] == 1
+            assert flat.get("control/reactor/overrides_published", 0) == 0
+            assert r.active["/svc/web"].show == "/svc/web => /svc/web-west"
+            vd = store.observe("default").current.value
+            assert vd.dtab.show.count("/svc/web =>") == 1  # ONE dentry
+            assert "web-b" not in vd.dtab.show
+
+            board.levels["/svc/web"] = 0.05
+            for t in range(5, 9):
+                await r.step(now=float(t))
+            vd = store.observe("default").current.value
+            assert vd.dtab.show == Dtab.read(BASE_DTAB).show
+
+        run(go())
+
+    def test_heal_racing_successor_publish_adopts_not_duplicates(self):
+        """Pinned interleaving: the heal probe's fetch returns the
+        PRE-takeover namespace; a fleet peer publishes the same
+        failover dentry in the gap before our booked publish fetches.
+        Adopt-if-present must absorb it — one dentry in the store, our
+        publish count stays zero."""
+        from linkerd_tpu.testing.schedules import DeterministicScheduler
+
+        store = InMemoryDtabStore({"default": Dtab.read(BASE_DTAB)})
+        board = _Board()
+        metrics = MetricsTree()
+        ex = _exchange(store, "e0")
+        book = LocalOverrideBook()
+        sched = DeterministicScheduler(
+            order=["fetch-1", "peer-publish", "fetch-2"])
+
+        class _Gated(_CuttableClient):
+            def __init__(self, store):
+                super().__init__(store)
+                self.fetches = 0
+
+            async def fetch(self, ns):
+                self.fetches += 1
+                await sched.point(f"fetch-{self.fetches}")
+                return await super().fetch(ns)
+
+        client = _Gated(store)
+        r = _region_reactor(store, board, ex, metrics=metrics,
+                            client=client, book=book)
+        # partitioned with a booked override, now healing
+        r._partitioned = True
+        r._partitioned_at = 0.0
+        r.booked["/svc/web"] = Dtab.read(
+            "/svc/web => /svc/web-b ;")[0]
+        book.set("/svc/web", r.booked["/svc/web"])
+        board.levels["/svc/web"] = 0.95
+
+        async def peer_publish():
+            await sched.point("peer-publish")
+            peer = LocalStoreClient(store)
+            vd = await peer.fetch("default")
+            await peer.cas("default",
+                           vd.dtab + Dtab.read(
+                               "/svc/web => /svc/web-b ;"),
+                           vd.version)
+            return True
+
+        sched.run_sync(r.step(now=50.0), peer_publish())
+        flat = metrics.flatten()
+        assert flat["control/reactor/heal_reconciles"] == 1
+        assert flat["control/reactor/overrides_adopted"] == 1
+        assert flat["control/reactor/overrides_published"] == 0
+        assert r.booked == {} and len(book) == 0
+        vd = store.observe("default").current.value
+        assert vd.dtab.show.count("/svc/web => /svc/web-b") == 1
+
+    def test_healed_zombie_region_never_reverts_successors_override(self):
+        """The zombie-region pin: this instance led east, got cut off
+        with a booked override, and a successor took the region over
+        (newer-generation digest + its own override in the store). On
+        heal the fetched state is ingested BEFORE any write — the
+        region fence latches, the book drops, and the zombie makes
+        ZERO store writes, now or on later steps."""
+        async def go():
+            successor_dtab = (
+                BASE_DTAB + " /svc/web => /svc/web-b ; "
+                + "%s => %s ;" % _digest(
+                    region="east", leader="zz", gen=99,
+                    seq=1).to_dentry_parts())
+            store = InMemoryDtabStore(
+                {"default": Dtab.read(successor_dtab)})
+            board = _Board()
+            metrics = MetricsTree()
+            ex = _exchange(store, "e0")
+            ex._led_region = True  # we led east before the cut
+            client = _CuttableClient(store)
+            book = LocalOverrideBook()
+            r = _region_reactor(store, board, ex, metrics=metrics,
+                                client=client, book=book)
+            r._partitioned = True
+            r._partitioned_at = 0.0
+            r.booked["/svc/web"] = Dtab.read(
+                "/svc/web => /svc/web-b ;")[0]
+            book.set("/svc/web", r.booked["/svc/web"])
+            board.levels["/svc/web"] = 0.95
+
+            for t in range(50, 56):
+                await r.step(now=float(t))
+            assert ex.region_fenced  # the successor's digest latched it
+            assert client.writes == []  # NOT ONE write, ever
+            assert r.booked == {} and len(book) == 0
+            assert r.active == {}  # nothing to revert with, either
+            vd = store.observe("default").current.value
+            assert Dtab.read(successor_dtab).show == vd.dtab.show
+            # ... and the healthy verdict cannot revert the successor's
+            # override either (the classic zombie failure mode)
+            board.levels["/svc/web"] = 0.0
+            for t in range(60, 64):
+                await r.step(now=float(t))
+            assert client.writes == []
+            vd = store.observe("default").current.value
+            assert "/svc/web => /svc/web-b" in vd.dtab.show
+
+        run(go())
+
+
+# ---- end to end on the real binaries ---------------------------------------
+
+
+class TestRegionEndToEnd:
+    def test_partition_local_actuation_heal_and_xregion_failover(self):
+        """2 regions x 3 linkerds + namerd as subprocesses, east's
+        store/digest traffic riding a cuttable WAN proxy. The drill:
+
+        1. east-quorum fault, WAN up: exactly ONE cross-region publish
+           (east's traffic lands on west's replica set), exact revert
+           on recovery;
+        2. WAN cut, same fault: east books a LOCAL override on its
+           region-local quorum — traffic shifts to the local replica
+           set with ZERO store writes;
+        3. heal: the booked override publishes to the store exactly
+           once (adopt-if-present absorbs the second east instance),
+           recovery reverts to the exact base namespace.
+
+        Two publishes total across the whole drill = zero flaps.
+        Governor values are the measured ones from the flat fleet e2e
+        (see TestFleetEndToEnd in test_fleet.py for the diagnosis):
+        warmup 300 / enter 0.6 / exit 0.45 / streak 20."""
+        from linkerd_tpu.testing.fleet import RegionFleetHarness, _http
+
+        async def go():
+            # wan_ttl_s 8.0: under full-suite CPU contention the 0.5s
+            # digest roll-up can lag multiple cycles; with the default
+            # 3.0s TTL west's digest goes momentarily stale at the
+            # moment east's governor trips, and the reactor (correctly)
+            # falls back to the LOCAL failover instead of cross-region.
+            # The test wants the cross-region path, so give the WAN an
+            # honest-to-load freshness horizon.
+            h = RegionFleetHarness(east=2, west=1, wan_ttl_s=8.0,
+                                   warmup_batches=300,
+                                   governor_quorum=20, enter=0.6,
+                                   exit=0.45)
+            await h.start()
+            try:
+                h.start_traffic(interval_s=0.02)
+                await h.warm(settle_s=3.0)
+                east = [h.instance_ids[i] for i in h.region_insts("east")]
+
+                def west_fresh() -> bool:
+                    # sync: wait_for runs predicates in a worker thread
+                    for i in h.region_insts("east"):
+                        _, body = _http("GET", "http://127.0.0.1:"
+                                        f"{h.admin_ports[i]}/regions.json")
+                        w = json.loads(body).get("regions", {}).get("west")
+                        if not (w and w["fresh"]):
+                            return False
+                    return True
+
+                # -- 1. cross-region failover, WAN up -------------------
+                # don't inject until every east instance sees a FRESH
+                # west digest — whichever reactor trips first must have
+                # the cross-region target in view
+                await h.wait_for(west_fresh, 30,
+                                 "west digest fresh at both east insts")
+                h.primary.fault_insts = set(east)
+                await h.wait_metric(
+                    "control/reactor/overrides_published", 1, 90)
+                await h.wait_for(lambda: h._route_sync(0) == b"W", 30,
+                                 "east traffic on west's replica set")
+                assert await h.fleet_metric_sum(
+                    "control/reactor/xregion_overrides") == 1
+                assert await h.flap_count() == 1
+
+                h.primary.fault_insts = set()
+                await h.wait_metric(
+                    "control/reactor/overrides_reverted", 1, 90)
+                await h.wait_for(lambda: h._route_sync(0) == b"A", 30,
+                                 "east traffic back on the primary")
+                assert await h.flap_count() == 1  # revert, not re-publish
+                await asyncio.sleep(3.0)  # governor streaks drain
+
+                # -- 2. WAN cut + fault: LOCAL actuation ----------------
+                await h.partition_east()
+                await asyncio.sleep(h.wan_ttl_s + 1.0)  # digests stale
+                h.primary.fault_insts = set(east)
+                await h.wait_metric(
+                    "control/reactor/local_actuations", 1, 90)
+                await h.wait_for(lambda: h._route_sync(0) == b"B", 30,
+                                 "east traffic on the LOCAL replica set")
+                assert await h.flap_count() == 1  # NOT ONE store write
+
+                # -- 3. heal: booked publish exactly once ---------------
+                rev0 = await h.fleet_metric_sum(
+                    "control/reactor/overrides_reverted")
+                await h.heal_east()
+                await h.wait_metric(
+                    "control/reactor/heal_reconciles", 1, 60)
+                await h.wait_metric(
+                    "control/reactor/overrides_published", 2, 60)
+                assert await h.flap_count() == 2
+
+                h.primary.fault_insts = set()
+                await h.wait_metric("control/reactor/overrides_reverted",
+                                    rev0 + 1, 90)
+                await h.wait_for(lambda: h._route_sync(0) == b"A", 30,
+                                 "east traffic back on the primary")
+                assert await h.flap_count() == 2  # zero flaps end to end
+
+                def namespace_is_base() -> bool:
+                    _, body = _http(
+                        "GET", h._namerd_url("/api/1/dtabs/default"))
+                    return json.loads(body) == [
+                        {"prefix": "/svc", "dst": "/#/io.l5d.fs"}]
+
+                await h.wait_for(namespace_is_base, 10,
+                                 "exact namespace revert")
+
+                # the region tier saw itself: every instance knows its
+                # region, east observed west's digest and vice versa
+                for i in range(h.n):
+                    st = await h.region_status(i)
+                    assert st["region"] == h.region_of(i), st
+                    peer = "west" if h.region_of(i) == "east" else "east"
+                    assert peer in st["regions"], st
+            finally:
+                await h.stop()
+
+        run(go(), timeout=420)
+
+
+# ---- static-gate coverage ---------------------------------------------------
+
+
+class TestStaticGateCoverage:
+    def test_region_tier_is_inside_the_race_gate_scope(self):
+        # the tier-1 race gate (test_race_analysis.TestRepoGate) scans
+        # DEFAULT_SCOPE; the region tier must never drop out of it
+        import os
+
+        from tools.analysis.core import Project
+        from tools.analysis.race import DEFAULT_SCOPE
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        project = Project(repo, [p for p in DEFAULT_SCOPE
+                                 if os.path.exists(os.path.join(repo, p))])
+        rels = {s.rel for s in project.sources}
+        assert "linkerd_tpu/fleet/regions.py" in rels
+        assert "linkerd_tpu/control/reactor.py" in rels
+        assert "linkerd_tpu/fleet/exchange.py" in rels

@@ -49,6 +49,11 @@ def check_telemetry(source: ConfigSource, spec: LinkerSpec
                 yield from _check_fleet_cfg(source, cfg.control,
                                             spec,
                                             f"{where}.control.fleet")
+            if (cfg.control.fleet is not None
+                    or getattr(cfg.control, "regionFailover", None)):
+                yield from _check_region_cfg(source, cfg.control,
+                                             spec,
+                                             f"{where}.control.fleet")
         if cfg.lifecycle is not None:
             yield from _check_lifecycle_cfg(source, cfg.lifecycle,
                                             f"{where}.lifecycle")
@@ -340,6 +345,99 @@ def _check_fleet_cfg(source: ConfigSource, ctl, spec: LinkerSpec,
                    "fleet instance binds the default (colliding on one "
                    "host, and unreachable at the address peers were "
                    "given)", "peers", severity="warning")
+
+
+def _check_region_cfg(source: ConfigSource, ctl, spec: LinkerSpec,
+                      where: str) -> Iterator[Finding]:
+    """Hierarchical-region wiring interlocks (fleet/regions.py): a
+    malformed region id poisons every digest dentry it would name, a
+    region-local quorum larger than the region can never be met, a WAN
+    TTL below the digest roll-up cadence makes every peer-region digest
+    stale on arrival (cross-region failover silently never fires), a
+    regionFailover entry targeting its OWN region shifts a sick
+    cluster's traffic to the same blast radius it is fleeing, and
+    cross-region evidence must ride digests — regionFailover without a
+    region has no digest to read."""
+    from linkerd_tpu.fleet.doc import valid_region
+
+    fleet = ctl.fleet
+    region = getattr(fleet, "region", None) if fleet is not None \
+        else None
+    rf = getattr(ctl, "regionFailover", None) or {}
+    if region is None:
+        if rf:
+            yield _bad(source, "region-config", where,
+                       "regionFailover is configured but the fleet "
+                       "block has no region: — cross-region targets "
+                       "are picked from peer-REGION digests, and a "
+                       "region-less fleet neither publishes nor reads "
+                       "them, so no cross-region failover ever fires",
+                       "regionFailover")
+        return
+    if not valid_region(region):
+        yield _bad(source, "region-config", where,
+                   f"region {region!r} must match "
+                   f"[a-z][a-z0-9-]{{0,31}} (it becomes a digest "
+                   f"dentry prefix segment in the fleet namespace)",
+                   "region")
+        return
+    quorum = fleet.effective_quorum()
+    region_size = 1 + len(fleet.peers or [])
+    if fleet.gossip and fleet.peers and quorum > region_size:
+        yield _bad(source, "region-config", where,
+                   f"quorum ({quorum}) exceeds this region's instance "
+                   f"count ({region_size} = this instance + "
+                   f"{len(fleet.peers)} gossip peers) — in region mode "
+                   f"quorum voting is region-LOCAL, so during a WAN "
+                   f"partition the cut-off region can never reach "
+                   f"quorum and stops actuating exactly when it must "
+                   f"not", "quorum")
+    if (fleet.gossip and fleet.peers
+            and fleet.expectInstances > 0
+            and len(fleet.peers) + 1 > fleet.expectInstances):
+        yield _bad(source, "region-config", where,
+                   f"{len(fleet.peers)} gossip peers + this instance "
+                   f"exceed expectInstances ({fleet.expectInstances}) "
+                   f"— in region mode expectInstances is the REGION's "
+                   f"size, so the peer list must cross the region "
+                   f"boundary; cross-region evidence rides digests "
+                   f"(one bounded dentry per region), never gossip — "
+                   f"WAN gossip reintroduces the O(instances) "
+                   f"cross-region chatter the region tier exists to "
+                   f"remove", "peers", severity="warning")
+    if fleet.wanTtlS <= 0 or fleet.digestIntervalS <= 0:
+        yield _bad(source, "region-config", where,
+                   f"wanTtlS and digestIntervalS must be > 0 (got "
+                   f"{fleet.wanTtlS}, {fleet.digestIntervalS})",
+                   "wanTtlS")
+    elif fleet.wanTtlS < fleet.digestIntervalS:
+        yield _bad(source, "region-config", where,
+                   f"wanTtlS ({fleet.wanTtlS}) is below the digest "
+                   f"roll-up cadence ({fleet.digestIntervalS}s) — "
+                   f"every peer-region digest expires before its "
+                   f"successor arrives, so cross-region failover can "
+                   f"never pick a target and regions silently degrade "
+                   f"to flat fleets", "wanTtlS")
+    for path, targets in rf.items():
+        if not isinstance(targets, dict):
+            continue
+        for target_region in targets:
+            if target_region == region:
+                yield _bad(source, "region-config", where,
+                           f"regionFailover for {path!r} targets its "
+                           f"OWN region ({region!r}) — a self-shift "
+                           f"moves a sick cluster's traffic into the "
+                           f"same blast radius it is fleeing; point it "
+                           f"at a peer region's replica set (local "
+                           f"fallback belongs in control.failover)",
+                           "regionFailover")
+            elif not valid_region(target_region):
+                yield _bad(source, "region-config", where,
+                           f"regionFailover for {path!r} names target "
+                           f"region {target_region!r}, which does not "
+                           f"match [a-z][a-z0-9-]{{0,31}} — no digest "
+                           f"can ever name it, so this entry never "
+                           f"fires", "regionFailover")
 
 
 def _check_lifecycle_cfg(source: ConfigSource, lc, where: str

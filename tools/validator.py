@@ -1527,6 +1527,125 @@ async def validate_fleet() -> None:
         await h.stop()
 
 
+async def validate_regions() -> None:
+    """Boot the REAL hierarchical fleet — 2 regions x 3 linkerd
+    binaries + 1 namerd, east's store/digest traffic riding a WanProxy
+    — and assert the partition-tolerance contract end to end:
+
+    1. a region-quorum fault with the WAN up publishes exactly ONE
+       cross-region failover dentry (east's traffic shifts to west's
+       replica set) and reverts exactly once on recovery;
+    2. the same fault with east's WAN CUT books a LOCAL override on
+       region-local quorum (zero store writes) and east's traffic
+       shifts to the local replica set while cut off;
+    3. healing the WAN reconciles the book: the booked override is
+       published to the store exactly once (adopt-if-present absorbs
+       the second east instance), and recovery reverts it exactly
+       once — zero flaps across the whole drill, exact namespace
+       revert at the end.
+
+    Prints one ``REGIONS {json}`` line with the measured windows."""
+    from linkerd_tpu.testing.fleet import RegionFleetHarness, _http
+
+    # stabilized governor values (measured in the flat fleet e2e): the
+    # untrained scorer spikes past enter=0.5 during warm-up and drains
+    # slowly after recovery — enter/exit at 0.6/0.45 with a 20-step
+    # streak keeps both out of the governor
+    h = RegionFleetHarness(east=2, west=1, warmup_batches=300,
+                           governor_quorum=20, enter=0.6, exit=0.45)
+    await h.start()
+    try:
+        h.start_traffic(interval_s=0.02)
+        await h.warm(settle_s=3.0)
+        east = [h.instance_ids[i] for i in h.region_insts("east")]
+        print("validator[regions]: 2-region fleet up "
+              f"(east={east}, west={h.instance_ids[h.east:]})")
+
+        # -- 1. cross-region failover, WAN up ---------------------------
+        h.primary.fault_insts = set(east)
+        publish_s = await h.wait_metric(
+            "control/reactor/overrides_published", 1, 90)
+        t0 = time.time()
+        await h.wait_for(lambda: h._route_sync(0) == b"W", 30,
+                         "east traffic on west's replica set")
+        shift_s = publish_s + (time.time() - t0)
+        assert await h.fleet_metric_sum(
+            "control/reactor/xregion_overrides") == 1, "not cross-region"
+        assert await h.fleet_metric_sum(
+            "control/reactor/overrides_published") == 1, "flapped!"
+        print(f"validator[regions]: east quorum fault -> ONE "
+              f"cross-region publish in {publish_s:.2f}s, east shifted "
+              f"to west in {shift_s:.2f}s")
+
+        h.primary.fault_insts = set()
+        revert_s = await h.wait_metric(
+            "control/reactor/overrides_reverted", 1, 90)
+        await h.wait_for(lambda: h._route_sync(0) == b"A", 30,
+                         "east traffic back on the primary")
+        print(f"validator[regions]: recovery -> exact revert in "
+              f"{revert_s:.2f}s")
+        await asyncio.sleep(3.0)  # governor dwell drains before round 2
+
+        # -- 2. same fault, WAN cut: local actuation continues ----------
+        await h.partition_east()
+        await asyncio.sleep(h.wan_ttl_s + 1.0)  # west digest goes stale
+        h.primary.fault_insts = set(east)
+        book_s = await h.wait_metric(
+            "control/reactor/local_actuations", 1, 90)
+        await h.wait_for(lambda: h._route_sync(0) == b"B", 30,
+                         "east traffic on the LOCAL replica set")
+        assert await h.fleet_metric_sum(
+            "control/reactor/overrides_published") == 1, \
+            "store write during partition"
+        print(f"validator[regions]: WAN cut + quorum fault -> LOCAL "
+              f"book in {book_s:.2f}s, east shifted locally, zero "
+              f"store writes")
+
+        # -- 3. heal: booked override publishes exactly once ------------
+        await h.heal_east()
+        heal_t0 = time.time()
+        await h.wait_metric("control/reactor/heal_reconciles", 1, 60)
+        await h.wait_metric("control/reactor/overrides_published", 2, 60)
+        heal_s = time.time() - heal_t0
+        assert await h.fleet_metric_sum(
+            "control/reactor/overrides_published") == 2, "flapped!"
+        print(f"validator[regions]: heal -> booked override published "
+              f"exactly once in {heal_s:.2f}s")
+
+        # adopters increment overrides_reverted too, so the wave-2
+        # revert is a DELTA over whatever wave 1 left behind
+        rev0 = await h.fleet_metric_sum(
+            "control/reactor/overrides_reverted")
+        h.primary.fault_insts = set()
+        await h.wait_metric("control/reactor/overrides_reverted",
+                            rev0 + 1, 90)
+        await h.wait_for(lambda: h._route_sync(0) == b"A", 30,
+                         "east traffic back on the primary")
+        assert await h.fleet_metric_sum(
+            "control/reactor/overrides_published") == 2, "flapped!"
+
+        def namespace_is_base() -> bool:
+            _, body = _http("GET", h._namerd_url("/api/1/dtabs/default"))
+            return json.loads(body) == [
+                {"prefix": "/svc", "dst": "/#/io.l5d.fs"}]
+
+        await h.wait_for(namespace_is_base, 10, "exact namespace revert")
+        flaps = await h.flap_count()
+        assert flaps == 2, f"flap budget blown: {flaps} publishes != 2"
+        print("validator[regions]: reverted exactly, 2 publishes "
+              "across the whole drill (zero flaps)")
+        print("REGIONS " + json.dumps({
+            "xregion_publish_s": round(publish_s, 2),
+            "xregion_shift_s": round(shift_s, 2),
+            "revert_s": round(revert_s, 2),
+            "local_book_s": round(book_s, 2),
+            "heal_reconcile_s": round(heal_s, 2),
+            "publishes": 2,
+        }))
+    finally:
+        await h.stop()
+
+
 async def validate_streams() -> None:
     """In-process e2e for the stream sentinel: an h2 server with the
     frame observer bound scores every stream mid-flight; ONE sick
@@ -1949,6 +2068,10 @@ async def main() -> int:
     if args and args[0] == "fleet":
         await validate_fleet()
         print("VALIDATOR PASS (fleet)")
+        return 0
+    if args and args[0] == "regions":
+        await validate_regions()
+        print("VALIDATOR PASS (regions)")
         return 0
     if args and args[0] == "streams":
         await validate_streams()
