@@ -1,18 +1,20 @@
 """CLI for the static-analysis suite.
 
-Four modes::
+Five modes::
 
     python -m tools.analysis [lint] [paths] [--rule ...] [--format json]
     python -m tools.analysis check <config.yml...>      [--format json]
     python -m tools.analysis race  [paths]              [--format json]
     python -m tools.analysis seam                       [--format json]
+    python -m tools.analysis native                     [--format json]
 
 ``lint`` (the default) runs the l5dlint AST rules over python sources;
 ``check`` runs l5dcheck semantic verification over linker/namerd YAML;
 ``race`` runs l5drace await-atomicity/lock-discipline analysis over the
 asyncio data plane; ``seam`` runs l5dseam cross-plane contract analysis
 over the C++/Python boundary (ABI signatures, mirrored constants, the
-stats contract, knob plumbing).
+stats contract, knob plumbing); ``native`` runs l5dnat memory-ordering/
+fd-lifecycle/event-loop-discipline analysis over the C++ engines.
 
 ``--changed`` (any mode) restricts the run to files that differ from
 ``git merge-base HEAD main`` (plus untracked files) — fast enough for
@@ -297,10 +299,45 @@ def _seam(args) -> int:
         args.show_suppressed, header, "l5dseam")
 
 
+def _nat(args) -> int:
+    from tools.analysis.native import nat_rule_ids, run_native_analysis
+
+    rc, rules = _parse_rules(args, nat_rule_ids())
+    if rc:
+        return rc
+    if args.paths:
+        # orderings drift between functions and fd ownership between
+        # files: per-path runs would vouch for code they never read,
+        # so the mode always analyzes the whole native tree
+        print("native mode analyzes the whole native tree; it takes "
+              "no paths", file=sys.stderr)
+        return 2
+    header = {"mode": "native", "paths": ["native"],
+              "rules": rules or nat_rule_ids() + ["suppression",
+                                                  "stale-suppression"]}
+    if args.changed:
+        # any native-relevant change reruns the FULL sweep (same
+        # contract as seam: the violated invariant is cross-function)
+        picked = _restrict_to_changed(
+            ["native", "tools/analysis/native", "tools/analysis/seam"],
+            (".py", ".h", ".hpp", ".c", ".cc", ".cpp"), "l5dnat")
+        if picked is None:
+            return _noop("l5dnat", args.as_json, header)
+    t0 = time.perf_counter()
+    try:
+        findings = run_native_analysis(repo_root=_REPO, rules=rules)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    return _report(
+        findings, time.perf_counter() - t0, args.as_json,
+        args.show_suppressed, header, "l5dnat")
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     mode = "lint"
-    if argv and argv[0] in ("lint", "check", "race", "seam"):
+    if argv and argv[0] in ("lint", "check", "race", "seam", "native"):
         mode = argv.pop(0)
     args = _mk_parser().parse_args(argv)
     if args.as_json or args.format == "json":
@@ -319,6 +356,10 @@ def main(argv=None) -> int:
             from tools.analysis.seam import seam_rule_descriptions
             for rule, desc in seam_rule_descriptions():
                 print(f"{rule:20s} {desc}")
+        elif mode == "native":
+            from tools.analysis.native import nat_rule_descriptions
+            for rule, desc in nat_rule_descriptions():
+                print(f"{rule:20s} {desc}")
         else:
             for c in sorted(all_checkers(), key=lambda c: c.rule):
                 print(f"{c.rule:20s} {c.description}")
@@ -332,6 +373,8 @@ def main(argv=None) -> int:
         return _race(args)
     if mode == "seam":
         return _seam(args)
+    if mode == "native":
+        return _nat(args)
     return _lint(args)
 
 

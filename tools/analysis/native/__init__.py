@@ -1,0 +1,125 @@
+"""l5dnat — memory-ordering, fd-lifecycle, and event-loop-discipline
+static analysis for the native engines.
+
+The C++ data plane's only correctness tooling so far is dynamic
+(TSan/ASan stress legs): it exercises whatever schedules the box
+happens to produce. l5dnat is the static side — five rules that
+encode the invariants the engines follow by convention, checked on
+every source line with no compiler and no ``.so`` load:
+
+- ``atomics-ordering``  slab publish/recheck/refcount ordering
+- ``bounded-table``     peer-keyed maps show a cap + eviction per TU
+- ``errno-discipline``  EINTR next to EAGAIN; errno read pre-clobber
+- ``fd-lifecycle``      fds reach close on every early-return edge
+- ``loop-blocking``     nothing blocking reachable from epoll roots
+
+Run: ``python -m tools.analysis native [--format json] [--changed]``.
+Orderings drift *between* functions and ownership *between* files, so
+``--changed`` runs the full sweep when any native-relevant file
+changed and no-ops otherwise (same contract as l5dseam).
+
+Suppressions reuse the C flavor of the l5dlint grammar —
+``// l5d: ignore[rule] — why`` — and MUST carry a justification; the
+meta-check here also flags unknown rule ids and *stale* waivers that
+no longer suppress anything (parity with l5dseam/l5dlint).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+from tools.analysis.core import Finding
+
+NAT_RULES = ("atomics-ordering", "bounded-table", "errno-discipline",
+             "fd-lifecycle", "loop-blocking")
+
+
+def nat_rule_ids() -> List[str]:
+    return sorted(NAT_RULES)
+
+
+def nat_rule_descriptions() -> List[tuple]:
+    return [
+        ("atomics-ordering", "relaxed ordering on publish/recheck/"
+                             "refcount atomics; plain cross-thread "
+                             "stop flags; volatile-as-sync"),
+        ("bounded-table", "peer-keyed map with no cap constant or "
+                          "eviction call in its translation unit"),
+        ("errno-discipline", "EAGAIN handled without EINTR; accept "
+                             "loops that drop EINTR; errno read after "
+                             "a clobbering call"),
+        ("fd-lifecycle", "socket/accept4/epoll/timerfd/eventfd "
+                         "results that miss close on an early-return "
+                         "edge"),
+        ("loop-blocking", "blocking calls reachable from the epoll "
+                          "callback roots (on_*/handle_event/"
+                          "loop_main)"),
+    ]
+
+
+def run_native_analysis(repo_root: Optional[str] = None,
+                        rules: Optional[Sequence[str]] = None,
+                        scan: Optional[List[str]] = None
+                        ) -> List[Finding]:
+    """Run the native suite; returns ALL findings (suppressed ones
+    flagged). ``scan`` narrows the file set (tests point it at fixture
+    trees); the default is every C/C++ source under ``native/``."""
+    from tools.analysis.native.rules import RULE_FNS, NatProject
+
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+    proj = NatProject(repo_root, scan)
+    findings: List[Finding] = []
+    for rule, fn in RULE_FNS:
+        if rules is None or rule in rules:
+            findings.extend(fn(proj))
+    used = set()
+    for f in findings:
+        sup = proj.c(f.path).suppression_for(f.rule, f.line)
+        if sup is not None and sup.justified:
+            f.suppressed = True
+            f.justification = sup.justification
+            used.add((f.path, sup.line))
+    # meta: justification required, rule ids must be known, and a
+    # justified waiver that silences nothing is itself a finding —
+    # C-side parity with l5dlint's stale-suppression rule. The known
+    # set spans both C-side analyzers because seam and nat read the
+    # same native sources.
+    if rules is None:
+        from tools.analysis.seam import SEAM_RULES
+        known = (set(NAT_RULES) | set(SEAM_RULES)
+                 | {"suppression", "stale-suppression"})
+        for rel in sorted(proj.scan):
+            src = proj.c(rel)
+            for sup in src.suppressions.values():
+                if not sup.justified:
+                    findings.append(Finding(
+                        "suppression", rel, sup.line, 0,
+                        "suppression without justification: write "
+                        "'// l5d: ignore[rule] — why it is safe'"))
+                for r in sup.rules:
+                    if r not in known:
+                        findings.append(Finding(
+                            "suppression", rel, sup.line, 0,
+                            f"suppression names unknown rule {r!r} "
+                            f"(known: {sorted(known)})"))
+                nat_only = [r for r in sup.rules if r in NAT_RULES]
+                if (sup.justified and nat_only
+                        and not any(r not in NAT_RULES
+                                    for r in sup.rules)
+                        and (rel, sup.line) not in used):
+                    stale = Finding(
+                        "stale-suppression", rel, sup.line, 0,
+                        f"suppression for {nat_only} no longer "
+                        f"matches any finding: the code moved or the "
+                        f"rule was satisfied — delete the waiver")
+                    ssup = src.suppression_for("stale-suppression",
+                                               sup.line)
+                    if ssup is not None and ssup.justified:
+                        stale.suppressed = True
+                        stale.justification = ssup.justification
+                    findings.append(stale)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

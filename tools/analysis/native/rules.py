@@ -1,0 +1,641 @@
+"""l5dnat rule implementations.
+
+Five rules over the native C++ data plane, all built on the ctok
+statement walker (``tools/analysis/seam/ctok.py``) — no compiler, no
+libclang, position-exact findings:
+
+- ``atomics-ordering``  the double-buffered-slab discipline: publish
+  flips are release stores, reader-recheck loads acquire, refcount
+  decrements that can free acq_rel; plain ``bool``/``int`` stop flags
+  in thread-spawning TUs and ``volatile``-as-synchronization are raw
+  cross-thread reads and flagged too.
+- ``fd-lifecycle``      every ``socket``/``accept4``/``epoll_create1``/
+  ``timerfd_create``/``eventfd`` result reaches ``close`` on every
+  early-return edge of the owning function, or escapes into a tracked
+  struct field / callee that assumes ownership. Path-sensitive over
+  the CStmt tree with an OPEN/CLOSED/INVALID abstract state.
+- ``loop-blocking``     nothing blocking is reachable (project-wide
+  call graph by callee name) from the epoll roots ``on_*`` /
+  ``handle_event`` / ``loop_main``: sleeps, DNS, ``system``, poll
+  with -1 timeout always; read/write/connect-class syscalls unless
+  the file shows nonblocking evidence (SOCK_NONBLOCK, O_NONBLOCK,
+  MSG_DONTWAIT, memory BIOs).
+- ``bounded-table``     map members keyed or valued by peer-controlled
+  input (tenant/source/stream/session/peer/conn/addr...) must sit in
+  a translation unit that shows BOTH a cap constant and an eviction
+  call — the invariant tenant_guard.h / stream_track.h follow by hand.
+- ``errno-discipline``  hot-loop syscalls that distinguish EAGAIN must
+  also handle EINTR; accept loops must retry EINTR; ``errno`` must be
+  read before an intervening call can clobber it (path-aware walk,
+  optimistic at merges to stay quiet on sibling-branch calls).
+
+Scope: all ``.h/.hpp/.c/.cc/.cpp`` under ``native/`` — bench and
+stress drivers included, because a leaky driver voids the sanitizer
+legs the engines' claims rest on.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from tools.analysis.core import Finding
+from tools.analysis.seam.ctok import CFunc, CSource, CStmt, line_of
+
+C_SUFFIXES = (".h", ".hpp", ".c", ".cc", ".cpp")
+
+# `ns::name(` — a namespace/class-qualified call is a project function,
+# never the libc syscall of the same name. Masking the qualifier (and
+# its `::`) with word characters keeps offsets stable while making the
+# following identifier fail the "not preceded by \w" lookbehind. A bare
+# global-qualified `::name(` survives the mask: that IS the syscall.
+_NS_QUAL_RE = re.compile(r"[A-Za-z_]\w*\s*::\s*(?=[A-Za-z_])")
+
+
+def _mask_quals(text: str) -> str:
+    return _NS_QUAL_RE.sub(lambda m: "Q" * (m.end() - m.start()), text)
+
+
+class NatProject:
+    """Lazy-loading view of the native C/C++ tree.
+
+    A missing or empty scan set raises: "zero findings over zero
+    files" must never read as a clean bill of health."""
+
+    def __init__(self, repo_root: str,
+                 scan: Optional[List[str]] = None):
+        self.repo_root = repo_root
+        if scan is None:
+            base = os.path.join(repo_root, "native")
+            scan = []
+            if os.path.isdir(base):
+                for dirpath, _dirs, files in os.walk(base):
+                    for fname in sorted(files):
+                        if fname.endswith(C_SUFFIXES):
+                            rel = os.path.relpath(
+                                os.path.join(dirpath, fname), repo_root)
+                            scan.append(rel.replace(os.sep, "/"))
+        self.scan = sorted(scan)
+        if not self.scan:
+            raise FileNotFoundError(
+                f"l5dnat: no C/C++ sources to scan under "
+                f"{repo_root!r} (expected native/*.{{h,cpp}})")
+        self._c: Dict[str, CSource] = {}
+
+    def c(self, rel: str) -> CSource:
+        if rel not in self._c:
+            self._c[rel] = CSource.load(self.repo_root, rel)
+        return self._c[rel]
+
+    def sources(self) -> Iterator[Tuple[str, CSource]]:
+        for rel in self.scan:
+            yield rel, self.c(rel)
+
+
+# ---------------------------------------------------------------------------
+# atomics-ordering
+# ---------------------------------------------------------------------------
+
+# `name.load(...)` / `name[i].store(...)` — member ops on std::atomic
+_ATOMIC_OP_RE = re.compile(
+    r"\b([A-Za-z_]\w*)\s*(?:\[[^\]\n]*\])?\s*\.\s*"
+    r"(load|store|exchange|fetch_add|fetch_sub|fetch_or|fetch_and)"
+    r"\s*\(")
+
+# atomics whose names mark them as the slab/ownership synchronization
+# points: the publish flag, reader refcounts. Stats counters (relaxed
+# by design) deliberately do NOT match.
+_SYNC_NAME_RE = re.compile(r"active|refcount|readers", re.IGNORECASE)
+
+# a plain (non-atomic) flag named like a cross-thread stop signal
+_PLAIN_FLAG_RE = re.compile(
+    r"^[ \t]*(?:volatile[ \t]+)?(?:bool|int)[ \t]+"
+    r"(running|stop_flag|stopping|shutting_down|quit|halt)"
+    r"[ \t]*(?:=[^;\n]*)?;", re.MULTILINE)
+
+_THREADS_RE = re.compile(r"\bstd::thread\b|\bpthread_create\b")
+
+
+def _paren_args(text: str, open_i: int) -> str:
+    depth = 0
+    for i in range(open_i, len(text)):
+        if text[i] == "(":
+            depth += 1
+        elif text[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return text[open_i + 1:i]
+    return text[open_i + 1:]
+
+
+def rule_atomics_ordering(proj: NatProject) -> Iterator[Finding]:
+    for rel, src in proj.sources():
+        clean = src.clean
+        for m in _ATOMIC_OP_RE.finditer(clean):
+            name, op = m.group(1), m.group(2)
+            if not _SYNC_NAME_RE.search(name):
+                continue
+            args = _paren_args(clean, clean.index("(", m.end() - 1))
+            line = line_of(clean, m.start(1))
+            if "memory_order_relaxed" in args:
+                if op == "store":
+                    why = ("a publish flip must be a release store so "
+                           "slab writes happen-before the flag")
+                elif op == "load":
+                    why = ("a reader-recheck load must be acquire so "
+                           "the slab read happens-after the publish")
+                elif op in ("fetch_sub", "fetch_add"):
+                    why = ("a refcount update that can gate a free "
+                           "must be acq_rel")
+                else:
+                    why = "this atomic orders the slab lifecycle"
+                yield Finding(
+                    "atomics-ordering", rel, line, 0,
+                    f"memory_order_relaxed on '{name}.{op}': {why}")
+            elif (op == "fetch_sub"
+                  and "memory_order_acquire" in args
+                  and "memory_order_acq_rel" not in args):
+                yield Finding(
+                    "atomics-ordering", rel, line, 0,
+                    f"'{name}.fetch_sub' with acquire only: a "
+                    f"decrement that can free needs acq_rel (release "
+                    f"the critical section, acquire prior releases)")
+        if _THREADS_RE.search(clean):
+            for m in _PLAIN_FLAG_RE.finditer(clean):
+                yield Finding(
+                    "atomics-ordering", rel,
+                    line_of(clean, m.start(1)), 0,
+                    f"plain {'volatile ' if 'volatile' in m.group(0) else ''}"
+                    f"flag '{m.group(1)}' in a thread-spawning TU: "
+                    f"cross-thread stop flags must be std::atomic "
+                    f"(volatile is not synchronization)")
+
+
+# ---------------------------------------------------------------------------
+# fd-lifecycle
+# ---------------------------------------------------------------------------
+
+_FD_SYSCALLS = ("socket", "accept4", "accept", "epoll_create1",
+                "timerfd_create", "eventfd")
+
+_FD_ACQ_RE = re.compile(
+    r"(?:\b(?:int|auto)\s+)?([A-Za-z_]\w*)\s*=\s*(?:::\s*)?"
+    r"(" + "|".join(_FD_SYSCALLS) + r")\s*\(")
+
+# callees that use an fd without taking ownership of it
+_FD_NONXFER = frozenset((
+    "close", "setsockopt", "getsockopt", "fcntl", "ioctl", "bind",
+    "listen", "connect", "getsockname", "getpeername", "read",
+    "write", "recv", "send", "recvfrom", "sendto", "sendmsg",
+    "recvmsg", "shutdown", "snprintf", "fprintf", "printf", "perror",
+    "htons", "htonl", "ntohs", "ntohl", "memset", "memcpy", "strlen",
+    "sizeof", "accept", "accept4", "socket", "epoll_create1",
+    "timerfd_create", "eventfd", "epoll_wait", "timerfd_settime",
+    "assert",
+))
+
+_CALLEE_RE = re.compile(r"\b([A-Za-z_]\w*)\s*\(")
+
+# abstract states for one tracked fd variable
+_NONE, _OPEN, _CLOSED, _INVALID = "none", "open", "closed", "invalid"
+
+
+def _cond_fd_test(cond: str, var: str) -> Optional[str]:
+    """'invalid' if the condition being true implies ``var`` holds no
+    fd (error check), 'valid' for the success check, else None."""
+    if re.search(rf"\b{re.escape(var)}\s*(?:<\s*0|==\s*-1)\b", cond):
+        return "invalid"
+    if re.search(rf"\b{re.escape(var)}\s*(?:>=?\s*0|!=\s*-1)\b", cond):
+        return "valid"
+    return None
+
+
+def _merge(states: List[Optional[str]]) -> Optional[str]:
+    live = [s for s in states if s is not None]
+    if not live:
+        return None
+    for want in (_OPEN, _CLOSED, _INVALID, _NONE):
+        if want in live:
+            return want
+    return live[0]
+
+
+class _FdWalker:
+    """Interpret one function body for one fd-producing assignment.
+
+    ``acq`` is the (line, var) of the acquisition statement; the walk
+    starts in state NONE, flips to OPEN at that statement, and reports
+    a finding at every ``return`` (and at function fall-off) reached
+    while still OPEN. Escapes — the variable stored anywhere, returned,
+    or passed to a callee outside the no-transfer set — count as
+    ownership transfer and end tracking (CLOSED)."""
+
+    def __init__(self, rel: str, fn: CFunc, var: str, acq_line: int):
+        self.rel = rel
+        self.fn = fn
+        self.var = var
+        self.acq_line = acq_line
+        self.findings: List[Finding] = []
+        self.fail_flags: set = set()  # bools bound to `var < 0`
+        self._var_re = re.compile(rf"\b{re.escape(var)}\b")
+        self._flag_bind_re = re.compile(
+            rf"\b(?:bool\s+)?([A-Za-z_]\w*)\s*=\s*{re.escape(var)}"
+            rf"\s*(?:<\s*0|==\s*-1)\b")
+        self._close_re = re.compile(
+            rf"\bclose\s*\(\s*{re.escape(var)}\s*\)")
+        self._store_re = re.compile(
+            rf"[\w\]\)]\s*(?:->|\.)?\s*[\w\[\]]*\s*=\s*"
+            rf"{re.escape(var)}\s*[;,)\]]")
+        self._ret_var_re = re.compile(
+            rf"\breturn\s+(?:\(\s*)?{re.escape(var)}\b")
+
+    def _cond_test(self, cond: str) -> Optional[str]:
+        t = _cond_fd_test(cond, self.var)
+        if t is not None:
+            return t
+        stripped = cond.strip()
+        for flag in self.fail_flags:
+            if stripped == flag:
+                return "invalid"
+            if stripped in (f"!{flag}", f"! {flag}"):
+                return "valid"
+        return None
+
+    # -- statement-level effects ------------------------------------
+    def _apply_text(self, st: CStmt, state: str) -> str:
+        text = st.text
+        fm = self._flag_bind_re.search(text)
+        if fm:
+            # `bool fail = fd < 0;` — the flag now carries the fd's
+            # validity; conditions on it branch like `fd < 0` does
+            self.fail_flags.add(fm.group(1))
+            return state
+        if state != _OPEN:
+            if (st.line == self.acq_line
+                    and _FD_ACQ_RE.search(text)
+                    and self._var_re.search(text)):
+                return _OPEN
+            return state
+        if self._close_re.search(text):
+            return _CLOSED
+        if self._store_re.search(text):
+            return _CLOSED  # stored into a struct field: tracked
+        if self._var_re.search(text):
+            for cm in _CALLEE_RE.finditer(st.ctext or text):
+                if cm.group(1) not in _FD_NONXFER:
+                    return _CLOSED  # passed to an owning callee
+        return state
+
+    def _walk_seq(self, stmts: List[CStmt],
+                  state: Optional[str]) -> Optional[str]:
+        for st in stmts:
+            if state is None:
+                return None
+            state = self._walk_node(st, state)
+        return state
+
+    def _walk_node(self, st: CStmt, state: str) -> Optional[str]:
+        if st.kind == "stmt":
+            return self._apply_text(st, state)
+        if st.kind == "return":
+            state = self._apply_text(st, state)
+            if state == _OPEN and not self._ret_var_re.search(st.text):
+                self.findings.append(Finding(
+                    "fd-lifecycle", self.rel, st.line, 0,
+                    f"'{self.var}' (from line {self.acq_line} in "
+                    f"{self.fn.name}) is still open at this return: "
+                    f"close it on the early-return edge or hand it to "
+                    f"an owner"))
+            return None
+        if st.kind in ("break", "continue"):
+            return None  # conservatively ends this path
+        if st.kind == "if":
+            state = self._apply_text(st, state)
+            test = self._cond_test(st.text) if state == _OPEN else None
+            then_in = _INVALID if test == "invalid" else state
+            else_in = _INVALID if test == "valid" else state
+            t = self._walk_seq(st.body, then_in)
+            e = self._walk_seq(st.orelse, else_in) if st.orelse else else_in
+            return _merge([t, e])
+        if st.kind in ("loop", "switch", "block"):
+            inner = self._apply_text(st, state)
+            out = self._walk_seq(st.body, inner)
+            if st.kind == "block":
+                return out
+            # loop/switch body may or may not run; prefer CLOSED to
+            # stay quiet on close-inside-loop teardown patterns
+            cands = [s for s in (out, inner) if s is not None]
+            if _CLOSED in cands:
+                return _CLOSED
+            return _merge([out, inner])
+        return self._apply_text(st, state)
+
+    def run(self, tree: List[CStmt]) -> List[Finding]:
+        exit_state = self._walk_seq(tree, _NONE)
+        if exit_state == _OPEN:
+            last = tree[-1].line if tree else self.fn.line
+            self.findings.append(Finding(
+                "fd-lifecycle", self.rel, last, 0,
+                f"'{self.var}' (from line {self.acq_line}) is still "
+                f"open when {self.fn.name} falls off its end"))
+        return self.findings
+
+
+def rule_fd_lifecycle(proj: NatProject) -> Iterator[Finding]:
+    for rel, src in proj.sources():
+        for fn in src.functions():
+            tree = src.statements(fn)
+            acqs: List[Tuple[int, str]] = []
+            for root in tree:
+                for st in root.walk():
+                    if st.kind not in ("stmt", "if", "loop"):
+                        continue
+                    m = _FD_ACQ_RE.search(st.text)
+                    if not m:
+                        continue
+                    pre = st.text[:m.start(1)].rstrip()
+                    if pre.endswith((">", ".")):
+                        continue  # member target: tracked struct field
+                    acqs.append((st.line, m.group(1)))
+            for acq_line, var in acqs:
+                walker = _FdWalker(rel, fn, var, acq_line)
+                for f in walker.run(tree):
+                    yield f
+
+
+# ---------------------------------------------------------------------------
+# loop-blocking
+# ---------------------------------------------------------------------------
+
+_ROOT_RE = re.compile(r"^(?:on_[a-z0-9_]+|handle_event|loop_main)$")
+
+_UNCOND_BLOCK_RE = re.compile(
+    r"(?<![\w.>])(sleep|usleep|nanosleep|system|getaddrinfo|"
+    r"gethostbyname|popen)\s*\(")
+
+_FD_BLOCK_RE = re.compile(
+    r"(?<![\w.>])(read|write|recv|send|recvfrom|sendto|recvmsg|"
+    r"sendmsg|connect|accept|accept4|SSL_do_handshake|SSL_read|"
+    r"SSL_write)\s*\(")
+
+_WAIT_BLOCK_RE = re.compile(r"(?<![\w.>])(poll|epoll_wait|ppoll)\s*\(")
+
+_NONBLOCK_EVIDENCE_RE = re.compile(
+    r"SOCK_NONBLOCK|O_NONBLOCK|EFD_NONBLOCK|TFD_NONBLOCK|"
+    r"MSG_DONTWAIT|BIO_s_mem|BIO_new_mem_buf|mem_bio")
+
+
+def _fn_bodies(proj: NatProject) -> Dict[str, List[Tuple[str, CFunc]]]:
+    """name -> [(rel, fn)] across the project (same-name statics in
+    different TUs merge; reachability is the union, which only widens
+    the scan)."""
+    table: Dict[str, List[Tuple[str, CFunc]]] = {}
+    for rel, src in proj.sources():
+        for fn in src.functions():
+            table.setdefault(fn.name, []).append((rel, fn))
+    return table
+
+
+def rule_loop_blocking(proj: NatProject) -> Iterator[Finding]:
+    table = _fn_bodies(proj)
+    # call graph by callee name, restricted to project-defined names
+    reach: List[str] = [n for n in table if _ROOT_RE.match(n)]
+    seen = set(reach)
+    edges: Dict[str, set] = {}
+    for name, defs in table.items():
+        callees = set()
+        for rel, fn in defs:
+            body = proj.c(rel).code[fn.body_start:fn.body_end]
+            for m in _CALLEE_RE.finditer(body):
+                if m.group(1) in table and m.group(1) != name:
+                    callees.add(m.group(1))
+        edges[name] = callees
+    while reach:
+        n = reach.pop()
+        for c in edges.get(n, ()):
+            if c not in seen:
+                seen.add(c)
+                reach.append(c)
+
+    for name in sorted(seen):
+        for rel, fn in table[name]:
+            src = proj.c(rel)
+            body = _mask_quals(src.code[fn.body_start:fn.body_end])
+            base = fn.body_start
+            for m in _UNCOND_BLOCK_RE.finditer(body):
+                yield Finding(
+                    "loop-blocking", rel,
+                    line_of(src.code, base + m.start(1)), 0,
+                    f"blocking call '{m.group(1)}' in '{name}', "
+                    f"reachable from an epoll callback root: the "
+                    f"event loop stalls every connection it owns")
+            for m in _WAIT_BLOCK_RE.finditer(body):
+                args = _paren_args(body, body.index("(", m.end(1)))
+                parts = [a.strip() for a in args.split(",")]
+                if parts and parts[-1] in ("-1", "- 1"):
+                    yield Finding(
+                        "loop-blocking", rel,
+                        line_of(src.code, base + m.start(1)), 0,
+                        f"'{m.group(1)}' with -1 timeout in '{name}': "
+                        f"an unbounded wait inside a callback wedges "
+                        f"the loop")
+            if not _NONBLOCK_EVIDENCE_RE.search(src.clean):
+                for m in _FD_BLOCK_RE.finditer(body):
+                    yield Finding(
+                        "loop-blocking", rel,
+                        line_of(src.code, base + m.start(1)), 0,
+                        f"'{m.group(1)}' in '{name}' with no "
+                        f"nonblocking evidence in this file "
+                        f"(SOCK_NONBLOCK/O_NONBLOCK/MSG_DONTWAIT/"
+                        f"memory BIO): a slow peer blocks the loop")
+
+
+# ---------------------------------------------------------------------------
+# bounded-table
+# ---------------------------------------------------------------------------
+
+_MAP_DECL_RE = re.compile(r"\bstd::(?:unordered_map|map)\s*<")
+
+_PEER_KEY_RE = re.compile(
+    r"tenant|source|stream|session|peer|client|remote|conn|skey|"
+    r"addr\b|\bip\b", re.IGNORECASE)
+
+_CAP_EVIDENCE_RE = re.compile(r"\bcap\b|\bMAX_[A-Z0-9_]+\b|\bkMax\w+")
+_EVICT_EVIDENCE_RE = re.compile(r"\bevict\w*\s*\(|[.>]\s*erase\s*\(")
+
+
+def _match_angle(text: str, open_i: int) -> int:
+    depth = 0
+    for i in range(open_i, len(text)):
+        ch = text[i]
+        if ch == "<":
+            depth += 1
+        elif ch == ">":
+            # `->`/`>>` inside template args: `>>` closes two levels
+            if i > 0 and text[i - 1] == "-":
+                continue
+            depth -= 1
+            if depth == 0:
+                return i
+    return len(text) - 1
+
+
+def rule_bounded_table(proj: NatProject) -> Iterator[Finding]:
+    for rel, src in proj.sources():
+        clean = src.clean
+        has_cap = bool(_CAP_EVIDENCE_RE.search(clean))
+        has_evict = bool(_EVICT_EVIDENCE_RE.search(clean))
+        for m in _MAP_DECL_RE.finditer(clean):
+            close = _match_angle(clean, m.end() - 1)
+            template_args = clean[m.end():close]
+            tail = clean[close + 1:close + 160]
+            dm = re.match(
+                r"\s*(\**)\s*&?\s*([A-Za-z_]\w*)\s*(?:=[^;]*|\{[^;]*)?;",
+                tail)
+            if not dm:
+                continue  # a parameter, typedef rhs, or expression
+            if dm.group(1):
+                continue  # pointer to a map owned elsewhere
+            name = dm.group(2)
+            if not (_PEER_KEY_RE.search(name)
+                    or _PEER_KEY_RE.search(template_args)):
+                continue
+            missing = []
+            if not has_cap:
+                missing.append("cap constant (cap / MAX_* / kMax*)")
+            if not has_evict:
+                missing.append("eviction call (evict*/erase)")
+            if missing:
+                yield Finding(
+                    "bounded-table", rel,
+                    line_of(clean, m.start()), 0,
+                    f"map '{name}' is keyed/valued by peer-controlled "
+                    f"input but this translation unit shows no "
+                    f"{' and no '.join(missing)}: an attacker who "
+                    f"controls the key grows it without bound")
+
+
+# ---------------------------------------------------------------------------
+# errno-discipline
+# ---------------------------------------------------------------------------
+
+_SYSCALL_NAMES = frozenset((
+    "recv", "send", "read", "write", "recvfrom", "sendto", "recvmsg",
+    "sendmsg", "accept4", "accept", "connect", "socket", "bind",
+    "listen", "open", "epoll_wait", "epoll_ctl", "epoll_create1",
+    "eventfd", "timerfd_create", "timerfd_settime", "fcntl",
+    "setsockopt", "getsockopt", "getsockname", "getpeername", "close",
+    "ioctl", "poll", "ppoll", "kill", "sigaction", "clock_gettime",
+))
+
+_SYSCALL_SET_RE = re.compile(
+    r"(?<![\w.>])(" + "|".join(sorted(_SYSCALL_NAMES, key=len,
+                                      reverse=True)) + r")\s*\(")
+
+_ANY_CALL_RE = re.compile(r"\b([A-Za-z_]\w*)\s*\(")
+
+# callables that never touch errno (or aren't calls at all)
+_ERRNO_PURE = frozenset((
+    "sizeof", "strlen", "strcmp", "strncmp", "memcmp", "htons",
+    "htonl", "ntohs", "ntohl", "move", "size", "empty", "data",
+    "c_str", "load", "store", "fetch_add", "fetch_sub", "if", "while",
+    "for", "switch", "return", "assert", "defined", "min", "max",
+    "WIFEXITED", "WEXITSTATUS",
+))
+
+_ERRNO_READ_RE = re.compile(r"\berrno\b")
+_ACCEPT_RE = re.compile(r"(?<![\w.>])(accept4?)\s*\(")
+
+
+class _ErrnoWalker:
+    """errno validity over one function body: a syscall statement makes
+    errno meaningful; any other call may clobber it; reading errno
+    while clobbered is a finding. Merges are optimistic (valid if any
+    inbound path is valid) — the rule hunts the straight-line
+    syscall → call → errno pattern, not every interleaving."""
+
+    def __init__(self, rel: str, fn: CFunc):
+        self.rel = rel
+        self.fn = fn
+        self.findings: List[Finding] = []
+
+    def _effects(self, st: CStmt, valid: bool) -> bool:
+        text = st.ctext or st.text
+        has_errno = bool(_ERRNO_READ_RE.search(text))
+        # syscall detection from the qualifier-masked view: `::recv(`
+        # is the syscall, `l5dtls::recv(` / `s.recv(` are not
+        has_syscall = bool(_SYSCALL_SET_RE.search(_mask_quals(text)))
+        # clobber detection from the raw view: ANY other call (member,
+        # namespaced, project helper) may scribble on errno
+        callees = [c for c in _ANY_CALL_RE.findall(text)
+                   if c not in _ERRNO_PURE]
+        has_clobber = any(c not in _SYSCALL_NAMES for c in callees)
+        if has_errno and not valid and not has_syscall:
+            self.findings.append(Finding(
+                "errno-discipline", self.rel, st.line, 0,
+                f"errno read in {self.fn.name} after an intervening "
+                f"call that may clobber it: save errno first or "
+                f"re-order the check"))
+        if has_syscall:
+            return True
+        if has_clobber:
+            return False
+        return valid
+
+    def _walk_seq(self, stmts: List[CStmt], valid: bool) -> bool:
+        for st in stmts:
+            valid = self._walk_node(st, valid)
+        return valid
+
+    def _walk_node(self, st: CStmt, valid: bool) -> bool:
+        if st.kind in ("stmt", "return", "break", "continue"):
+            return self._effects(st, valid)
+        valid = self._effects(st, valid)  # condition / header
+        t = self._walk_seq(st.body, valid)
+        e = self._walk_seq(st.orelse, valid) if st.orelse else valid
+        if st.kind == "if":
+            return t or e
+        return t or valid  # loop/switch/block: body may not run
+
+
+def rule_errno_discipline(proj: NatProject) -> Iterator[Finding]:
+    for rel, src in proj.sources():
+        for fn in src.functions():
+            body_code = _mask_quals(src.code[fn.body_start:fn.body_end])
+            base = fn.body_start
+            # (a) EAGAIN distinguished but EINTR never handled
+            m = re.search(r"\bEAGAIN\b|\bEWOULDBLOCK\b", body_code)
+            if m and not re.search(r"\bEINTR\b", body_code):
+                yield Finding(
+                    "errno-discipline", rel,
+                    line_of(src.code, base + m.start()), 0,
+                    f"{fn.name} distinguishes EAGAIN/EWOULDBLOCK but "
+                    f"never handles EINTR: a signal turns a healthy "
+                    f"socket into a spurious error path")
+            # (b) accept/accept4 error path without EINTR retry
+            elif not re.search(r"\bEINTR\b", body_code):
+                am = _ACCEPT_RE.search(body_code)
+                if am and re.search(
+                        r"<\s*0|==\s*-1",
+                        body_code[am.end():am.end() + 200]):
+                    yield Finding(
+                        "errno-discipline", rel,
+                        line_of(src.code, base + am.start(1)), 0,
+                        f"'{am.group(1)}' in {fn.name} checks for "
+                        f"failure but never retries EINTR: signal "
+                        f"arrival drops the pending connection")
+            # (c) errno read after a clobbering call
+            walker = _ErrnoWalker(rel, fn)
+            walker._walk_seq(src.statements(fn), True)
+            for f in walker.findings:
+                yield f
+
+
+RULE_FNS = (
+    ("atomics-ordering", rule_atomics_ordering),
+    ("bounded-table", rule_bounded_table),
+    ("errno-discipline", rule_errno_discipline),
+    ("fd-lifecycle", rule_fd_lifecycle),
+    ("loop-blocking", rule_loop_blocking),
+)

@@ -100,7 +100,11 @@ def run_seam_analysis(repo_root: Optional[str] = None,
     # python), so seam itself enforces justification + known rule ids
     # for `// l5d: ignore[...]` comments in the sources it read.
     if rules is None:
-        known = set(SEAM_RULES)
+        # l5dnat reads the same native sources, so its waivers (and
+        # the C-side meta ids) are legitimate here too
+        from tools.analysis.native import NAT_RULES
+        known = (set(SEAM_RULES) | set(NAT_RULES)
+                 | {"suppression", "stale-suppression"})
         for rel in sorted(proj._c):
             for sup in proj.c(rel).suppressions.values():
                 if not sup.justified:

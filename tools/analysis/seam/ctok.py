@@ -74,6 +74,50 @@ class CDecl:
     line: int
 
 
+@dataclass
+class CFunc:
+    """One function DEFINITION (any linkage, methods included): the
+    unit the native analyzer's path-sensitive rules walk."""
+    name: str
+    line: int        # line of the name
+    params: str      # raw parameter-list text (clean view)
+    body_start: int  # offset of the body '{'
+    body_end: int    # offset of the matching '}'
+
+
+@dataclass
+class CStmt:
+    """One node of the statement-level tree ``parse_statements``
+    extracts from a function body.
+
+    kinds: ``stmt`` (plain statement; ``text`` is its clean source),
+    ``if`` (``text`` is the condition, ``body`` the then-branch,
+    ``orelse`` the else-branch — an ``else if`` chain nests as a
+    single-element orelse), ``loop`` (for/while/do; ``text`` is the
+    header), ``switch``, ``block`` (bare ``{}``), ``return``,
+    ``break``, ``continue``.
+    """
+    kind: str
+    line: int
+    text: str = ""   # clean view (strings intact)
+    ctext: str = ""  # code view (string contents blanked): call scans
+    body: List["CStmt"] = None  # type: ignore[assignment]
+    orelse: List["CStmt"] = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.body is None:
+            self.body = []
+        if self.orelse is None:
+            self.orelse = []
+
+    def walk(self):
+        yield self
+        for child in self.body:
+            yield from child.walk()
+        for child in self.orelse:
+            yield from child.walk()
+
+
 def sanitize(text: str) -> Tuple[str, str]:
     """(clean, code) views — see module docstring."""
     n = len(text)
@@ -141,6 +185,17 @@ def match_brace(code: str, open_i: int) -> int:
     return len(code) - 1
 
 
+# identifiers that look like ``name (`` but head a statement, not a
+# function definition
+_NON_FN_KEYWORDS = frozenset((
+    "if", "for", "while", "switch", "catch", "do", "else", "return",
+    "sizeof", "alignof", "alignas", "decltype", "new", "delete",
+    "defined", "constexpr", "static_assert", "noexcept", "throw",
+))
+
+_POST_PAREN_SPECIFIERS = ("const", "noexcept", "override", "final")
+
+
 class CSource:
     """One native source file: sanitized views + inline suppressions."""
 
@@ -150,6 +205,8 @@ class CSource:
         self.text = text
         self.lines = text.splitlines()
         self.clean, self.code = sanitize(text)
+        self._functions: Optional[List[CFunc]] = None
+        self._stmt_trees: Dict[str, List[CStmt]] = {}
         self.suppressions: Dict[int, Suppression] = {}
         for i, line in enumerate(self.lines, start=1):
             m = _C_SUPPRESS_RE.search(line)
@@ -178,6 +235,87 @@ class CSource:
                         continue
                 return sup
         return None
+
+    # -- function extraction ---------------------------------------------
+    def functions(self) -> List[CFunc]:
+        """Every function DEFINITION in the file (free functions and
+        inline methods alike), found by brace-matching ``name (args)
+        [specifiers] {`` in the string-blanked view. Declarations,
+        calls, lambdas and control statements don't match: a call can
+        never be directly followed by ``{`` in valid C++."""
+        if self._functions is not None:
+            return self._functions
+        code = self.code
+        n = len(code)
+        out: List[CFunc] = []
+        for m in re.finditer(r"\b([A-Za-z_]\w*)\s*\(", code):
+            name = m.group(1)
+            if name in _NON_FN_KEYWORDS:
+                continue
+            # matching close paren of the parameter list
+            i, depth = m.end() - 1, 0
+            while i < n:
+                if code[i] == "(":
+                    depth += 1
+                elif code[i] == ")":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                i += 1
+            if i >= n:
+                continue
+            params = self.clean[m.end():i]
+            # skip trailing specifiers; accept a ctor-initializer list
+            k, ok = i + 1, False
+            while k < n:
+                ch = code[k]
+                if ch in " \t\n\r":
+                    k += 1
+                    continue
+                word = re.match(r"[A-Za-z_]\w*", code[k:])
+                if word and word.group(0) in _POST_PAREN_SPECIFIERS:
+                    k += word.end()
+                    continue
+                if ch == ":":  # ctor init list: scan to the body brace
+                    k += 1
+                    pdepth = 0
+                    while k < n:
+                        c2 = code[k]
+                        if c2 == "(":
+                            pdepth += 1
+                        elif c2 == ")":
+                            pdepth -= 1
+                        elif c2 == "{" and pdepth == 0:
+                            ok = True
+                            break
+                        elif c2 == ";":
+                            break
+                        k += 1
+                    break
+                if ch == "{":
+                    ok = True
+                break
+            if not ok:
+                continue
+            close = match_brace(code, k)
+            out.append(CFunc(name, line_of(code, m.start(1)), params,
+                             k, close))
+        self._functions = out
+        return out
+
+    def function(self, name: str) -> Optional[CFunc]:
+        for fn in self.functions():
+            if fn.name == name:
+                return fn
+        return None
+
+    def statements(self, fn: CFunc) -> List[CStmt]:
+        """Statement tree of ``fn``'s body (cached per function)."""
+        key = f"{fn.name}:{fn.body_start}"
+        if key not in self._stmt_trees:
+            self._stmt_trees[key] = parse_statements(
+                self.clean, self.code, fn.body_start + 1, fn.body_end)
+        return self._stmt_trees[key]
 
     # -- exported ABI ----------------------------------------------------
     def extern_c_spans(self) -> List[Tuple[int, int]]:
@@ -319,6 +457,169 @@ class CSource:
 
     def float_fields(self, struct: str) -> List[str]:
         return [n for t, n in self.struct_fields(struct) if t == "f32"]
+
+
+def _match_paren(code: str, open_i: int) -> int:
+    depth = 0
+    for i in range(open_i, len(code)):
+        if code[i] == "(":
+            depth += 1
+        elif code[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return i
+    return len(code) - 1
+
+
+def parse_statements(clean: str, code: str, start: int,
+                     end: int) -> List[CStmt]:
+    """Parse ``code[start:end]`` (a brace-matched function body) into a
+    CStmt tree. Structure comes from the string-blanked ``code`` view;
+    statement text is sliced from the position-aligned ``clean`` view,
+    so string literals survive into the text the rules inspect.
+
+    The parser is deliberately partial — no expressions, no
+    declarator grammar — but it is structure-exact for the subset the
+    native engines use: if/else chains, for/while/do loops, switch
+    bodies, bare blocks, return/break/continue, and plain statements
+    (brace initializers and lambdas ride inside a plain statement's
+    text)."""
+
+    def skip_ws(i: int) -> int:
+        while i < end:
+            ch = code[i]
+            if ch in " \t\n\r;":
+                i += 1
+            elif ch == "#":  # preprocessor line: not a statement
+                while i < end and code[i] != "\n":
+                    i += 1
+            else:
+                break
+        return i
+
+    def consume_plain(i: int) -> Tuple[int, int]:
+        """(stop, next) for a plain statement starting at i: scan to
+        the ``;`` at bracket depth 0 (brace initializers and lambda
+        bodies are part of the statement)."""
+        depth = 0
+        j = i
+        while j < end:
+            ch = code[j]
+            if ch in "({[":
+                depth += 1
+            elif ch in ")}]":
+                depth -= 1
+                if depth < 0:
+                    return j, j  # unbalanced: bail at the stray closer
+            elif ch == ";" and depth == 0:
+                return j, j + 1
+            j += 1
+        return end, end
+
+    def parse_one(i: int) -> Tuple[Optional[CStmt], int]:
+        i = skip_ws(i)
+        if i >= end:
+            return None, end
+        line = line_of(code, i)
+        ch = code[i]
+        if ch == "{":
+            close = match_brace(code, i)
+            node = CStmt("block", line,
+                         body=parse_range(i + 1, min(close, end)))
+            return node, close + 1
+        if ch == "}":
+            return None, i + 1
+        m = re.match(r"[A-Za-z_]\w*", code[i:])
+        word = m.group(0) if m else ""
+        if word == "if":
+            j = code.find("(", i, end)
+            if j < 0:
+                return CStmt("stmt", line, clean[i:i + 2]), i + 2
+            cp = _match_paren(code, j)
+            node = CStmt("if", line, clean[j + 1:cp].strip(),
+                         code[j + 1:cp].strip())
+            body_node, nxt = parse_one(cp + 1)
+            node.body = (body_node.body if body_node is not None
+                         and body_node.kind == "block"
+                         else ([body_node] if body_node else []))
+            k = skip_ws(nxt)
+            em = re.match(r"else\b", code[k:end])
+            if em:
+                else_node, nxt = parse_one(k + 4)
+                node.orelse = (else_node.body if else_node is not None
+                               and else_node.kind == "block"
+                               else ([else_node] if else_node else []))
+            return node, nxt
+        if word in ("while", "for", "switch"):
+            j = code.find("(", i, end)
+            if j < 0:
+                return CStmt("stmt", line, word), i + len(word)
+            cp = _match_paren(code, j)
+            kind = "switch" if word == "switch" else "loop"
+            node = CStmt(kind, line, clean[j + 1:cp].strip(),
+                         code[j + 1:cp].strip())
+            body_node, nxt = parse_one(cp + 1)
+            node.body = (body_node.body if body_node is not None
+                         and body_node.kind == "block"
+                         else ([body_node] if body_node else []))
+            return node, nxt
+        if word == "do":
+            body_node, nxt = parse_one(i + 2)
+            node = CStmt("loop", line, "do")
+            node.body = (body_node.body if body_node is not None
+                         and body_node.kind == "block"
+                         else ([body_node] if body_node else []))
+            # trailing `while (...);`
+            k = skip_ws(nxt)
+            if re.match(r"while\b", code[k:end]):
+                j = code.find("(", k, end)
+                if j >= 0:
+                    cp = _match_paren(code, j)
+                    node.text = clean[j + 1:cp].strip()
+                    node.ctext = code[j + 1:cp].strip()
+                    nxt = cp + 1
+            return node, nxt
+        if word in ("return", "break", "continue", "goto"):
+            stop, nxt = consume_plain(i)
+            kind = "stmt" if word == "goto" else word
+            return CStmt(kind, line, clean[i:stop].strip(),
+                         code[i:stop].strip()), nxt
+        if word in ("case", "default"):
+            # consume the label through its ':' (skipping '::')
+            j = i + len(word)
+            while j < end:
+                if code[j] == ":" and j + 1 < end and code[j + 1] == ":":
+                    j += 2
+                elif code[j] == ":":
+                    return None, j + 1
+                elif code[j] in ";{}":
+                    return None, j
+                else:
+                    j += 1
+            return None, end
+        stop, nxt = consume_plain(i)
+        text = clean[i:stop].strip()
+        if not text:
+            return None, nxt
+        return CStmt("stmt", line, text, code[i:stop].strip()), nxt
+
+    def parse_range(i: int, stop: int) -> List[CStmt]:
+        nonlocal end
+        saved, end = end, stop
+        out: List[CStmt] = []
+        guard = 0
+        while i < stop and guard < 100000:
+            guard += 1
+            node, nxt = parse_one(i)
+            if node is not None:
+                out.append(node)
+            if nxt <= i:
+                nxt = i + 1
+            i = nxt
+        end = saved
+        return out
+
+    return parse_range(start, end)
 
 
 def _param_type(param: str) -> str:
