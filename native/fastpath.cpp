@@ -602,6 +602,8 @@ bool flush_out(Engine* e, Conn* c) {
                            MSG_NOSIGNAL);
         if (n > 0) {
             c->out.erase(0, (size_t)n);
+        } else if (n < 0 && errno == EINTR) {
+            continue;  // signal during send: the conn is healthy, retry
         } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
             break;
         } else {
@@ -1342,6 +1344,7 @@ void on_upstream_readable(Engine* e, Conn* up) {
     for (;;) {
         ssize_t n = ::recv(up->fd, buf, sizeof(buf), 0);
         if (n < 0) {
+            if (errno == EINTR) continue;  // signal, not a dead conn
             if (errno == EAGAIN || errno == EWOULDBLOCK) return;
             conn_close(e, up);
             return;
@@ -1462,6 +1465,7 @@ void on_client_readable(Engine* e, Conn* c) {
     for (;;) {
         ssize_t n = ::recv(c->fd, buf, sizeof(buf), 0);
         if (n < 0) {
+            if (errno == EINTR) continue;  // signal, not a dead conn
             if (errno == EAGAIN || errno == EWOULDBLOCK) return;
             conn_close(e, c);
             return;
@@ -1561,7 +1565,10 @@ void on_listener(Engine* e, int lfd) {
         sockaddr_in peer{};
         socklen_t plen = sizeof(peer);
         int fd = ::accept4(lfd, (sockaddr*)&peer, &plen, SOCK_NONBLOCK);
-        if (fd < 0) return;
+        if (fd < 0) {
+            if (errno == EINTR) continue;  // don't drop the pending conn
+            return;
+        }
         uint64_t now = now_us();
         // per-source accept throttle: a churn-flooding source is shed
         // at accept, before it can consume a handshake or conn slot

@@ -147,7 +147,9 @@ struct PStream;
 struct Engine {
     int epfd = -1;
     int wakefd = -1;
-    bool shutting_down = false;  // teardown: no replays / new upstreams
+    // teardown: no replays / new upstreams. Deliberately NOT atomic:
+    // l5d: ignore[atomics-ordering] — written only after pthread_join of the loop thread; never read concurrently
+    bool shutting_down = false;
     // response HEADERS must start within this window once dispatched
     // (the h1 engine's EXCHANGE_TIMEOUT analog); streaming bodies are
     // unbounded. Atomic: set from the control thread.
@@ -404,6 +406,8 @@ bool flush_out(Engine* e, H2Conn* c) {
                            MSG_NOSIGNAL);
         if (n > 0) {
             c->out.erase(0, (size_t)n);
+        } else if (n < 0 && errno == EINTR) {
+            continue;  // signal during send: the conn is healthy, retry
         } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
             break;
         } else {
@@ -1959,6 +1963,7 @@ void on_readable(Engine* e, H2Conn* c) {
         if (c->dead) return;
         ssize_t n = ::recv(c->fd, buf, sizeof(buf), 0);
         if (n < 0) {
+            if (errno == EINTR) continue;  // signal, not a dead conn
             if (errno == EAGAIN || errno == EWOULDBLOCK) return;
             conn_close(e, c);
             return;
@@ -2002,7 +2007,10 @@ void on_listener(Engine* e, int lfd) {
         sockaddr_in peer{};
         socklen_t plen = sizeof(peer);
         int fd = ::accept4(lfd, (sockaddr*)&peer, &plen, SOCK_NONBLOCK);
-        if (fd < 0) return;
+        if (fd < 0) {
+            if (errno == EINTR) continue;  // don't drop the pending conn
+            return;
+        }
         uint64_t now = now_us();
         // per-source accept throttle: churn floods are shed at accept
         if (peer.sin_family == AF_INET &&
