@@ -109,6 +109,8 @@ bool tls_flush_bytes(int fd, l5dtls::Sess* t, std::string* plain_out,
         ssize_t n = ::send(fd, cipher_out->data(), cipher_out->size(),
                            MSG_NOSIGNAL);
         if (n > 0) cipher_out->erase(0, (size_t)n);
+        else if (n < 0 && errno == EINTR)
+            continue;  // signal during send: retry
         else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK))
             break;
         else
@@ -138,6 +140,8 @@ bool flush_conn(int epfd, Conn* c) {
                            MSG_NOSIGNAL);
         if (n > 0) {
             c->out.erase(0, (size_t)n);
+        } else if (n < 0 && errno == EINTR) {
+            continue;  // signal during send: retry
         } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
             break;
         } else {
@@ -260,6 +264,7 @@ int run_serve(int port, std::atomic<int>* bound_out) {
     if (bind(lfd, (sockaddr*)&sa, sizeof(sa)) < 0 ||
         listen(lfd, 1024) < 0) {
         perror("bind");
+        ::close(lfd);
         return 1;
     }
     socklen_t sl = sizeof(sa);
@@ -274,6 +279,7 @@ int run_serve(int port, std::atomic<int>* bound_out) {
     ev.events = EPOLLIN;
     ev.data.fd = lfd;
     epoll_ctl(epfd, EPOLL_CTL_ADD, lfd, &ev);
+    // l5d: ignore[bounded-table] — keyed by OUR accept4 fds, not peer input; population = live conns, bounded by the process fd limit
     std::unordered_map<int, Conn*> conns;
     ServeStats stats;
     epoll_event evs[128];
@@ -285,7 +291,10 @@ int run_serve(int port, std::atomic<int>* bound_out) {
                 for (;;) {
                     int cfd = ::accept4(lfd, nullptr, nullptr,
                                         SOCK_NONBLOCK);
-                    if (cfd < 0) break;
+                    if (cfd < 0) {
+                        if (errno == EINTR) continue;
+                        break;
+                    }
                     set_nodelay(cfd);
                     Conn* c = new Conn();
                     c->fd = cfd;
@@ -320,6 +329,8 @@ int run_serve(int port, std::atomic<int>* bound_out) {
                     ssize_t r = ::recv(fd, buf, sizeof(buf), 0);
                     if (r > 0) {
                         c->in.append(buf, (size_t)r);
+                    } else if (r < 0 && errno == EINTR) {
+                        continue;
                     } else if (r < 0 && (errno == EAGAIN ||
                                          errno == EWOULDBLOCK)) {
                         break;
@@ -527,8 +538,10 @@ int run_load(const char* ip, int port, const char* authority, int conc,
     int per_conn = std::max(1, conc / nconns);
 
     int epfd = epoll_create1(0);
+    // l5d: ignore[bounded-table] — keyed by our own connect() fds; exactly nconns entries, from the -c flag, not peer input
     std::unordered_map<int, Conn*> conns;
     std::vector<LoadState> states((size_t)nconns);
+    // l5d: ignore[bounded-table] — parallel to conns above: nconns entries keyed by our own fds
     std::unordered_map<int, size_t> conn_state;
     uint64_t deadline = now_us() + (uint64_t)(seconds * 1e6);
 
@@ -540,6 +553,13 @@ int run_load(const char* ip, int port, const char* authority, int conc,
         inet_pton(AF_INET, ip, &sa.sin_addr);
         if (::connect(fd, (sockaddr*)&sa, sizeof(sa)) < 0) {
             perror("connect");
+            ::close(fd);
+            ::close(epfd);
+            for (auto& kv : conns) {
+                ::close(kv.first);
+                l5dtls::free_session(kv.second->tls);
+                delete kv.second;
+            }
             return 1;
         }
         set_nodelay(fd);
@@ -553,6 +573,14 @@ int run_load(const char* ip, int port, const char* authority, int conc,
                                          /*verify=*/false, nullptr);
             if (c->tls == nullptr) {
                 fprintf(stderr, "h2bench: TLS session alloc failed\n");
+                ::close(fd);
+                delete c;
+                ::close(epfd);
+                for (auto& kv : conns) {
+                    ::close(kv.first);
+                    l5dtls::free_session(kv.second->tls);
+                    delete kv.second;
+                }
                 return 1;
             }
         }
@@ -643,6 +671,8 @@ int run_load(const char* ip, int port, const char* authority, int conc,
                         } else {
                             c->in.append(buf, (size_t)r);
                         }
+                    } else if (r < 0 && errno == EINTR) {
+                        continue;
                     } else if (r < 0 && (errno == EAGAIN ||
                                          errno == EWOULDBLOCK)) {
                         break;
@@ -744,6 +774,7 @@ int run_h1_load(const char* ip, int port, const char* host, int conc,
     int window = std::max(1, conc / nconns);
 
     int epfd = epoll_create1(0);
+    // l5d: ignore[bounded-table] — keyed by our own connect() fds; exactly nconns entries, from the -c flag, not peer input
     std::unordered_map<int, H1Conn*> conns;
     uint64_t done = 0, errors = 0;
     std::vector<uint32_t> lat;
@@ -757,6 +788,13 @@ int run_h1_load(const char* ip, int port, const char* host, int conc,
         inet_pton(AF_INET, ip, &sa.sin_addr);
         if (::connect(fd, (sockaddr*)&sa, sizeof(sa)) < 0) {
             perror("connect");
+            ::close(fd);
+            ::close(epfd);
+            for (auto& kv : conns) {
+                ::close(kv.first);
+                l5dtls::free_session(kv.second->tls);
+                delete kv.second;
+            }
             return 1;
         }
         set_nodelay(fd);
@@ -769,6 +807,14 @@ int run_h1_load(const char* ip, int port, const char* host, int conc,
                                          /*verify=*/false, nullptr);
             if (c->tls == nullptr) {
                 fprintf(stderr, "h2bench: TLS session alloc failed\n");
+                ::close(fd);
+                delete c;
+                ::close(epfd);
+                for (auto& kv : conns) {
+                    ::close(kv.first);
+                    l5dtls::free_session(kv.second->tls);
+                    delete kv.second;
+                }
                 return 1;
             }
         }
@@ -802,6 +848,8 @@ int run_h1_load(const char* ip, int port, const char* host, int conc,
             ssize_t n = ::send(c->fd, c->out.data(), c->out.size(),
                                MSG_NOSIGNAL);
             if (n > 0) c->out.erase(0, (size_t)n);
+            else if (n < 0 && errno == EINTR)
+                continue;  // signal during send: retry
             else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK))
                 break;
             else
@@ -850,6 +898,8 @@ int run_h1_load(const char* ip, int port, const char* host, int conc,
                         } else {
                             c->in.append(buf, (size_t)r);
                         }
+                    } else if (r < 0 && errno == EINTR) {
+                        continue;
                     } else if (r < 0 && (errno == EAGAIN ||
                                          errno == EWOULDBLOCK)) {
                         break;

@@ -8,8 +8,11 @@
 // a clean exit with no TSan report is the pass criterion.
 
 #include <arpa/inet.h>
+#include <errno.h>
 #include <netinet/in.h>
 #include <pthread.h>
+#include <signal.h>
+#include <time.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
@@ -72,26 +75,57 @@ std::atomic<long> errors{0};
 std::atomic<long> scored_rows{0};    // drained rows the engine pre-scored
 std::atomic<long> weight_swaps{0};   // weight publishes that landed
 std::atomic<long> tunnel_trips{0};   // CONNECT-tunnel round trips
+std::atomic<long> storm_sent{0};     // SIGUSR1s delivered by the storm leg
+
+// The signal-storm leg (below) delivers SIGUSR1 without SA_RESTART, so
+// ANY thread's blocking syscall can return EINTR mid-run. The harness
+// legs must ride through that themselves — a storm-interrupted read is
+// not a dead connection — or the traffic floors fail for the wrong
+// reason.
+ssize_t xread(int fd, void* buf, size_t n) {
+    for (;;) {
+        ssize_t r = read(fd, buf, n);
+        if (r < 0 && errno == EINTR) continue;
+        return r;
+    }
+}
+
+ssize_t xwrite(int fd, const void* buf, size_t n) {
+    for (;;) {
+        ssize_t r = write(fd, buf, n);
+        if (r < 0 && errno == EINTR) continue;
+        return r;
+    }
+}
+
+long now_ms() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (long)ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
 
 // Minimal blocking HTTP/1.1 backend: fixed 200 response per request.
 void backend_loop(int lfd) {
     while (!stop.load()) {
         int fd = accept(lfd, nullptr, nullptr);
-        if (fd < 0) return;
+        if (fd < 0) {
+            if (errno == EINTR) continue;  // storm hit, not shutdown
+            return;
+        }
         std::thread([fd] {
             char buf[4096];
             std::string acc;
             const char rsp[] =
                 "HTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nok";
             while (!stop.load()) {
-                ssize_t n = read(fd, buf, sizeof(buf));
+                ssize_t n = xread(fd, buf, sizeof(buf));
                 if (n <= 0) break;
                 acc.append(buf, n);
                 // one response per request head seen
                 size_t pos;
                 while ((pos = acc.find("\r\n\r\n")) != std::string::npos) {
                     acc.erase(0, pos + 4);
-                    if (write(fd, rsp, sizeof(rsp) - 1) < 0) {
+                    if (xwrite(fd, rsp, sizeof(rsp) - 1) < 0) {
                         break;
                     }
                 }
@@ -109,7 +143,10 @@ int listen_on(int* port_out) {
     addr.sin_family = AF_INET;
     addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
     addr.sin_port = 0;
-    if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0) return -1;
+    if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+        close(fd);
+        return -1;
+    }
     socklen_t len = sizeof(addr);
     getsockname(fd, (sockaddr*)&addr, &len);
     *port_out = ntohs(addr.sin_port);
@@ -142,8 +179,8 @@ void client_loop(int proxy_port, int idx, std::atomic<long>* counter) {
                           idx % 4, seq % 37);
         char buf[2048];
         for (int i = 0; i < 50 && !stop.load(); i++) {
-            if (write(fd, req, rn) < 0) { errors.fetch_add(1); break; }
-            ssize_t n = read(fd, buf, sizeof(buf));
+            if (xwrite(fd, req, rn) < 0) { errors.fetch_add(1); break; }
+            ssize_t n = xread(fd, buf, sizeof(buf));
             if (n <= 0) { errors.fetch_add(1); break; }
             counter->fetch_add(1);
         }
@@ -171,7 +208,7 @@ void slowloris_loop(int proxy_port) {
         char buf[256];
         struct timeval tv{2, 0};
         setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
-        while (read(fd, buf, sizeof(buf)) > 0) {}
+        while (xread(fd, buf, sizeof(buf)) > 0) {}
         close(fd);
     }
 }
@@ -200,20 +237,20 @@ void tunnel_loop(int proxy_port) {
         setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
         const char conreq[] =
             "CONNECT svc-0:80 HTTP/1.1\r\nHost: svc-0\r\n\r\n";
-        if (write(fd, conreq, sizeof(conreq) - 1) < 0) {
+        if (xwrite(fd, conreq, sizeof(conreq) - 1) < 0) {
             close(fd);
             continue;
         }
-        if (read(fd, buf, sizeof(buf)) <= 0) {  // the backend's 200
+        if (xread(fd, buf, sizeof(buf)) <= 0) {  // the backend's 200
             close(fd);
             continue;
         }
         for (int i = 0; i < 20 && !stop.load(); i++) {
             const char ping[] = "ping\r\n\r\n";
-            if (write(fd, ping, sizeof(ping) - 1) < 0) break;
+            if (xwrite(fd, ping, sizeof(ping) - 1) < 0) break;
             // a short read just means the engine shed the tunnel
             // mid-stream (rst leg / sentinel): reconnect and go again
-            if (read(fd, buf, sizeof(buf)) <= 0) break;
+            if (xread(fd, buf, sizeof(buf)) <= 0) break;
             tunnel_trips.fetch_add(1);
         }
         close(fd);
@@ -464,6 +501,26 @@ int main() {
         }
     });
 
+    // signal-storm leg: a no-op SIGUSR1 handler installed WITHOUT
+    // SA_RESTART, then a thread peppering the whole process with it.
+    // Every blocking syscall in every thread — including the engines'
+    // epoll/recv/send/accept4 loops — now sees spurious EINTR, which
+    // is exactly the regression pin for the engines' EINTR-retry
+    // paths: drop one of those `errno == EINTR` branches and this leg
+    // turns interrupts into dropped conns and the traffic floors fail.
+    struct sigaction storm_sa {};
+    storm_sa.sa_handler = [](int) {};
+    sigemptyset(&storm_sa.sa_mask);
+    storm_sa.sa_flags = 0;  // deliberately NOT SA_RESTART
+    sigaction(SIGUSR1, &storm_sa, nullptr);
+    std::thread storm([] {
+        while (!stop.load()) {
+            kill(getpid(), SIGUSR1);
+            storm_sent.fetch_add(1);
+            usleep(3000);
+        }
+    });
+
     std::vector<std::thread> clients;
     for (int i = 0; i < 4; i++)
         clients.emplace_back(client_loop, proxy_port, i, &responses);
@@ -476,8 +533,13 @@ int main() {
             clients.emplace_back(client_loop, front_port, i,
                                  &tls_responses);
 
-    sleep(5);
+    // sleep(5) would return in milliseconds under the storm; pace on
+    // the monotonic clock instead (usleep early-returns are fine, the
+    // loop re-checks elapsed time)
+    const long t0 = now_ms();
+    while (now_ms() - t0 < 5000) usleep(20000);
     stop.store(true);
+    storm.join();
     for (auto& t : clients) t.join();
     churn.join();
     swapper.join();
@@ -492,9 +554,10 @@ int main() {
 
     fprintf(stderr, "tsan_stress: %ld responses (%ld via TLS), "
             "%ld errors, %ld rows scored in-engine across %ld weight "
-            "swaps, %ld tunnel round-trips\n", responses.load(),
-            tls_responses.load(), errors.load(), scored_rows.load(),
-            weight_swaps.load(), tunnel_trips.load());
+            "swaps, %ld tunnel round-trips, %ld storm signals\n",
+            responses.load(), tls_responses.load(), errors.load(),
+            scored_rows.load(), weight_swaps.load(),
+            tunnel_trips.load(), storm_sent.load());
     if (responses.load() < 100) {
         fprintf(stderr, "tsan_stress: too little traffic flowed\n");
         return 1;
@@ -506,6 +569,11 @@ int main() {
     }
     if (tls_leg && tls_responses.load() < 50) {
         fprintf(stderr, "tsan_stress: too little TLS traffic flowed\n");
+        return 1;
+    }
+    if (storm_sent.load() < 200) {
+        fprintf(stderr, "tsan_stress: signal storm starved (%ld)\n",
+                storm_sent.load());
         return 1;
     }
     if (scored_rows.load() < 50 || weight_swaps.load() < 100) {
