@@ -1141,6 +1141,27 @@ def seam_check_bench() -> dict:
     }
 
 
+def native_analysis_bench() -> dict:
+    """l5dnat wall time over the live native tree — gated in tier-1
+    (tests/test_native_analysis.py::TestRepoNat) like the other
+    analyzers; every C++ source is re-tokenized and every function
+    body re-walked each run, so this entry catches the statement
+    walker or the path-sensitive fd interpreter regressing into a
+    slow path as the engines grow."""
+    from tools.analysis.native import nat_rule_ids, run_native_analysis
+
+    t0 = time.perf_counter()
+    findings = run_native_analysis()
+    wall_s = time.perf_counter() - t0
+    unsuppressed = [f for f in findings if not f.suppressed]
+    return {
+        "wall_s": round(wall_s, 3),
+        "findings_unsuppressed": len(unsuppressed),
+        "findings_suppressed": len(findings) - len(unsuppressed),
+        "rules": len(nat_rule_ids()),
+    }
+
+
 def semantic_check_bench() -> dict:
     """l5dcheck wall time over every in-repo YAML fixture (via
     ``tools/validator.py config``) — the semantic gate runs in tier-1,
@@ -1864,6 +1885,9 @@ def main() -> None:
     def ph_seam() -> None:
         detail["seam_check"] = seam_check_bench()
 
+    def ph_native_analysis() -> None:
+        detail["native_analysis"] = native_analysis_bench()
+
     def ph_semantic() -> None:
         detail["semantic_check"] = semantic_check_bench()
 
@@ -1954,6 +1978,7 @@ def main() -> None:
         ("static_analysis", ph_static),
         ("race_analysis", ph_race),
         ("seam_check", ph_seam),
+        ("native_analysis", ph_native_analysis),
         ("fleet", ph_fleet),
         ("multi_region", ph_multi_region),
         ("tenant_isolation", ph_tenant_isolation),

@@ -1,0 +1,91 @@
+// Drift twin of the good miniature engine: each l5dnat rule is
+// violated EXACTLY once, at a line a test can pin —
+//   atomics-ordering  relaxed store on the publish flag
+//   fd-lifecycle      fd still open at the connect-failure return
+//   errno-discipline  errno read after log_drop may have clobbered it
+//   loop-blocking     usleep under the epoll root on_readable
+// (bounded-table drifts in tables.h) — plus ONE justified suppression
+// on the scan-counter load, which must count as suppressed, not fixed.
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netinet/in.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+
+#include "tables.h"
+
+namespace {
+
+std::atomic<int> g_active{0};
+std::atomic<unsigned> g_scan_active{0};
+
+SessionTable g_sessions;
+
+void log_drop(int fd) {
+    (void)fd;
+}
+
+void publish_generation(int gen) {
+    // DRIFT: relaxed publish — slab writes may surface after the flag
+    g_active.store(gen, std::memory_order_relaxed);
+}
+
+int read_generation() {
+    return g_active.load(std::memory_order_acquire);
+}
+
+unsigned scan_count() {
+    // l5d: ignore[atomics-ordering] — scan-only telemetry read; staleness is fine, the next tick re-reads
+    return g_scan_active.load(std::memory_order_relaxed);
+}
+
+int connect_upstream(unsigned peer_key) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) {
+        return -1;
+    }
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = htons(8080);
+    if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+        // DRIFT: early return leaks fd — no close on this edge
+        return -1;
+    }
+    g_sessions.insert(peer_key, "dialed");
+    return fd;
+}
+
+ssize_t pump_once(int fd, char* buf, size_t cap) {
+    ssize_t n = recv(fd, buf, cap, MSG_DONTWAIT);
+    if (n < 0) {
+        log_drop(fd);
+        // DRIFT: log_drop may have clobbered errno before this read
+        if (errno == EINTR) {
+            return 0;
+        }
+        return -1;
+    }
+    return n;
+}
+
+void on_readable(int fd) {
+    char buf[512];
+    ssize_t n = pump_once(fd, buf, sizeof(buf));
+    if (n > 0) {
+        // DRIFT: blocking sleep inside the epoll callback root
+        usleep(50);
+        publish_generation(read_generation() + 1);
+    }
+}
+
+}  // namespace
+
+int engine_tick(int fd) {
+    on_readable(fd);
+    return (int)scan_count();
+}

@@ -1,0 +1,15 @@
+// Drift twin of the bounded session table: the peer-keyed map has
+// NEITHER a cap constant NOR an eviction call in this translation
+// unit — a peer who controls the key grows it without bound.
+#pragma once
+
+#include <string>
+#include <unordered_map>
+
+struct SessionTable {
+    std::unordered_map<unsigned, std::string> sessions;
+
+    void insert(unsigned key, const char* v) {
+        sessions[key] = v;
+    }
+};

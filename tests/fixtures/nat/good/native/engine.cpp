@@ -1,0 +1,91 @@
+// Miniature event-loop engine, CORRECT on every l5dnat axis: release
+// publish / acquire recheck, fds closed on every early-return edge,
+// no blocking calls under the epoll roots, errno saved before any
+// call can clobber it. The drift twin violates each rule exactly once.
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netinet/in.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+
+#include "tables.h"
+
+namespace {
+
+// publish flag for the double-buffered table: writers flip with
+// release, the loop thread rechecks with acquire
+std::atomic<int> g_active{0};
+std::atomic<unsigned> g_scan_active{0};
+
+SessionTable g_sessions;
+
+void log_drop(int fd) {
+    (void)fd;
+}
+
+void publish_generation(int gen) {
+    g_active.store(gen, std::memory_order_release);
+}
+
+int read_generation() {
+    return g_active.load(std::memory_order_acquire);
+}
+
+unsigned scan_count() {
+    return g_scan_active.load(std::memory_order_acquire);
+}
+
+// Dial the upstream; the fd is closed on EVERY failure edge before
+// the early return, and ownership transfers to the caller on success.
+int connect_upstream(unsigned peer_key) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) {
+        return -1;
+    }
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = htons(8080);
+    if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+        close(fd);
+        return -1;
+    }
+    g_sessions.insert(peer_key, "dialed");
+    return fd;
+}
+
+// One nonblocking pump: errno is SAVED before the logging call that
+// may clobber it, then the saved copy is consulted.
+ssize_t pump_once(int fd, char* buf, size_t cap) {
+    ssize_t n = recv(fd, buf, cap, MSG_DONTWAIT);
+    if (n < 0) {
+        int saved = errno;
+        log_drop(fd);
+        if (saved == EINTR) {
+            return 0;
+        }
+        return -1;
+    }
+    return n;
+}
+
+// epoll callback root: everything reachable from here is
+// nonblocking (MSG_DONTWAIT above); no sleeps, no DNS, no system().
+void on_readable(int fd) {
+    char buf[512];
+    ssize_t n = pump_once(fd, buf, sizeof(buf));
+    if (n > 0) {
+        publish_generation(read_generation() + 1);
+    }
+}
+
+}  // namespace
+
+int engine_tick(int fd) {
+    on_readable(fd);
+    return (int)scan_count();
+}

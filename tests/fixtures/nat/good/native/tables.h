@@ -1,0 +1,21 @@
+// Peer-keyed session table, BOUNDED: a cap constant and an eviction
+// call live in the same translation unit as the map — the invariant
+// the live tree's tenant_guard.h / stream_track.h follow by hand.
+#pragma once
+
+#include <cstddef>
+#include <string>
+#include <unordered_map>
+
+struct SessionTable {
+    static constexpr size_t kMaxSessions = 1024;
+
+    std::unordered_map<unsigned, std::string> sessions;
+
+    void insert(unsigned key, const char* v) {
+        if (sessions.size() >= kMaxSessions) {
+            sessions.erase(sessions.begin());
+        }
+        sessions[key] = v;
+    }
+};

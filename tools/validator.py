@@ -35,6 +35,14 @@ scrape map, knob plumbing (whole-seam, takes no paths):
 
     python tools/validator.py seam
 
+And the l5dnat native static sweep (tools/analysis/native) over the
+C++ engines — atomics ordering, fd lifecycle, event-loop discipline,
+bounded tables, errno hygiene — plus a planted-violation smoke that
+proves the rules still catch a relaxed publish flip (whole-tree,
+takes no paths):
+
+    python tools/validator.py nat
+
 And the l5dcheck semantic config verification (tools/analysis/semantic)
 over linker/namerd YAML — defaults to every fixture under tests/configs/
 and examples/ when no files are given:
@@ -2013,6 +2021,48 @@ def validate_seam() -> int:
     return rc
 
 
+def validate_nat() -> int:
+    """Run the native static sweep, then prove the analyzer still has
+    teeth: plant a relaxed publish flip into a scratch copy of the
+    scorer and require l5dnat to catch it. A sweep that passes because
+    the rules rotted is worse than no sweep."""
+    import shutil
+    import tempfile
+
+    from tools.analysis.__main__ import main as analysis_main
+    from tools.analysis.native import run_native_analysis
+
+    rc = analysis_main(["native"])
+    if rc != 0:
+        return rc
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with tempfile.TemporaryDirectory(prefix="l5dnat_smoke_") as tmp:
+        shutil.copytree(os.path.join(repo, "native"),
+                        os.path.join(tmp, "native"))
+        scorer = os.path.join(tmp, "native", "scorer.h")
+        with open(scorer, encoding="utf-8") as fh:
+            text = fh.read()
+        planted = "s->active.store(target, std::memory_order_release);"
+        if planted not in text:
+            print("validator[nat]: scorer.h publish flip not found — "
+                  "update the smoke plant site", file=sys.stderr)
+            return 1
+        with open(scorer, "w", encoding="utf-8") as fh:
+            fh.write(text.replace(
+                planted,
+                "s->active.store(target, std::memory_order_relaxed);"))
+        caught = [f for f in run_native_analysis(repo_root=tmp)
+                  if f.rule == "atomics-ordering" and not f.suppressed
+                  and "active.store" in f.message]
+        if not caught:
+            print("validator[nat]: planted relaxed publish flip was "
+                  "NOT caught — the atomics-ordering rule rotted",
+                  file=sys.stderr)
+            return 1
+    print("VALIDATOR PASS (nat)")
+    return 0
+
+
 async def main() -> int:
     args = sys.argv[1:]
     if args and args[0] == "lint":
@@ -2025,6 +2075,13 @@ async def main() -> int:
                   "contract is whole-seam)", file=sys.stderr)
             return 64
         return validate_seam()
+    if args and args[0] == "nat":
+        if len(args) > 1:
+            print("validator[nat]: the native sweep takes no paths "
+                  "(ownership and ordering are whole-tree)",
+                  file=sys.stderr)
+            return 64
+        return validate_nat()
     if args and args[0] == "config":
         return validate_config(args[1:])
     if args and args[0] == "ckpt":
