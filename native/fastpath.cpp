@@ -163,15 +163,26 @@ struct BodyTracker {
             switch (cstate) {
             case C::SIZE:
                 if (c == '\n') {
-                    size_t sc = linebuf.find(';');
-                    std::string hexs = sc == std::string::npos
-                        ? linebuf : linebuf.substr(0, sc);
-                    while (!hexs.empty() && (hexs.back() == '\r' ||
-                                             hexs.back() == ' '))
-                        hexs.pop_back();
-                    char* end = nullptr;
-                    unsigned long long sz = strtoull(hexs.c_str(), &end, 16);
-                    if (end == hexs.c_str()) return -1;
+                    // parse the size in place: the old substr+strtoull
+                    // pattern heap-allocated twice per chunk header
+                    size_t sl = linebuf.find(';');
+                    if (sl == std::string::npos) sl = linebuf.size();
+                    while (sl > 0 && (linebuf[sl - 1] == '\r' ||
+                                      linebuf[sl - 1] == ' '))
+                        sl--;
+                    uint64_t sz = 0;
+                    size_t d = 0;
+                    for (; d < sl; d++) {
+                        char h = linebuf[d];
+                        int v;
+                        if (h >= '0' && h <= '9') v = h - '0';
+                        else if (h >= 'a' && h <= 'f') v = h - 'a' + 10;
+                        else if (h >= 'A' && h <= 'F') v = h - 'A' + 10;
+                        else break;
+                        if (sz > (UINT64_MAX >> 4)) return -1;
+                        sz = (sz << 4) | (uint64_t)v;
+                    }
+                    if (d == 0) return -1;
                     linebuf.clear();
                     if (sz == 0) cstate = C::TRAILER;
                     else { remaining = sz; cstate = C::DATA; }
@@ -199,9 +210,11 @@ struct BodyTracker {
                 break;
             case C::TRAILER:
                 if (c == '\n') {
-                    std::string line = linebuf;
+                    // end-of-trailers test in place (no per-line copy)
+                    bool last = linebuf.empty() ||
+                        (linebuf.size() == 1 && linebuf[0] == '\r');
                     linebuf.clear();
-                    if (line.empty() || line == "\r") cstate = C::DONE;
+                    if (last) cstate = C::DONE;
                 } else {
                     if (linebuf.size() > 8192) return -1;
                     linebuf.push_back(c);
@@ -225,6 +238,24 @@ struct ParsedHead {
 
 void lower(std::string& s) {
     for (auto& c : s) if (c >= 'A' && c <= 'Z') c += 32;
+}
+
+// Case-insensitive ASCII substring probe with zero copies. Header-value
+// token tests ("chunked", "close", "upgrade") run on every request; the
+// old copy+lower() pattern paid a heap allocation per probe.
+bool ihas(const std::string& hay, const char* needle) {
+    const size_t nn = strlen(needle);
+    if (nn == 0 || hay.size() < nn) return nn == 0;
+    for (size_t i = 0; i + nn <= hay.size(); i++) {
+        size_t j = 0;
+        for (; j < nn; j++) {
+            char a = hay[i + j];
+            if (a >= 'A' && a <= 'Z') a += 32;
+            if (a != needle[j]) break;
+        }
+        if (j == nn) return true;
+    }
+    return false;
 }
 
 bool parse_head(const std::string& buf, bool is_response, ParsedHead* out) {
@@ -285,9 +316,7 @@ const std::string* get_header(const ParsedHead& h, const char* name) {
 bool request_body(const ParsedHead& h, BodyTracker* t) {
     const std::string* te = get_header(h, "transfer-encoding");
     if (te) {
-        std::string v = *te;
-        lower(v);
-        if (v.find("chunked") == std::string::npos) return false;
+        if (!ihas(*te, "chunked")) return false;
         if (get_header(h, "content-length")) return false;  // smuggling
         t->kind = BodyKind::CHUNKED;
         return true;
@@ -314,9 +343,7 @@ bool response_body(const ParsedHead& h, const std::string& req_method,
     }
     const std::string* te = get_header(h, "transfer-encoding");
     if (te) {
-        std::string v = *te;
-        lower(v);
-        if (v.find("chunked") == std::string::npos) return false;
+        if (!ihas(*te, "chunked")) return false;
         t->kind = BodyKind::CHUNKED;
         return true;
     }
@@ -400,6 +427,16 @@ struct Engine {
     // loop-thread-only defense state
     l5dtg::SourceTable sources;
     uint32_t hs_inflight = 0;  // accept-leg TLS handshakes in flight
+    // write coalescing (h2's discipline ported to h1): conns with bytes
+    // staged this wakeup, flushed once per epoll round. defer_ok is
+    // false outside the loop's run window so startup/teardown writes
+    // degrade to immediate flushes.
+    bool defer_ok = false;
+    std::vector<Conn*> dirty;
+    // one clock read per wakeup: loop_main stamps this right after
+    // epoll_wait returns; every loop-thread timestamp consumer reads
+    // the stamp (loop_now) instead of issuing its own clock_gettime
+    uint64_t now_cache_us = now_us();
     // feature timestamps are relative to engine creation:
     // float32 seconds-since-boot quantizes to >60ms after
     // ~12 days of uptime, breaking inter-arrival math
@@ -453,6 +490,7 @@ struct Conn {
     uint64_t hdr_start_us = 0;
     uint64_t body_progress_us = 0;
     bool hs_pending = false;  // counted in Engine::hs_inflight
+    bool flush_queued = false;  // sitting in Engine::dirty
 
     // upstream conns
     uint32_t ep_ip_be = 0;
@@ -481,6 +519,11 @@ size_t outsz(const Conn* c) {
     return c->out.size()
         + (c->tls != nullptr ? c->tls->plain_out.size() : 0);
 }
+
+// The loop thread's clock: one clock_gettime per wakeup (the loop_main
+// stamp), not one per timestamp consumer. Hot-path code reads the
+// stamp; cold/control-plane code keeps calling now_us() directly.
+uint64_t loop_now(Engine* e) { return e->now_cache_us; }
 
 void ep_mod(Engine* e, Conn* c) {
     epoll_event ev{};
@@ -523,7 +566,7 @@ void push_feature(Engine* e, uint64_t route_id, uint64_t lat_us, int status,
     // per-tenant aggregates ride the same mu hold as the feature push
     // (request rows only — a tunnel's tenant slot settles at close)
     if (tenant && kind == l5dstream::ROW_REQUEST)
-        e->tenants.observe(tenant, status, score, scored != 0, now_us());
+        e->tenants.observe(tenant, status, score, scored != 0, loop_now(e));
     if (e->features.size() >= e->features_cap) {
         e->features_dropped++;
         return;
@@ -534,7 +577,7 @@ void push_feature(Engine* e, uint64_t route_id, uint64_t lat_us, int status,
     r.status = (float)status;
     r.req_bytes = (float)req_b;
     r.rsp_bytes = (float)rsp_b;
-    r.ts_s = (float)((double)(now_us() - e->t0_us) / 1e6);
+    r.ts_s = (float)((double)(loop_now(e) - e->t0_us) / 1e6);
     r.score = score;
     r.scored = scored ? 1.0f : 0.0f;
     r.tenant = l5dtg::tenant_feature(tenant);
@@ -558,13 +601,18 @@ void tenant_release(Engine* e, Conn* c) {
 // release its slot in the accept-leg churn-backpressure counter.
 void hs_complete(Engine* e, Conn* c) {
     c->tls->hs_deadline_us = 0;
+    // accept-leg conns cache their SNI here, once per handshake —
+    // tenant extraction used to call server_sni() (shim call + string
+    // alloc) on EVERY request of a keep-alive conn
+    if (c->tls->sess->is_server && c->tls->sni.empty())
+        c->tls->sni = l5dtls::server_sni(c->tls->sess);
     if (c->hs_pending) {
         c->hs_pending = false;
         if (e->hs_inflight > 0) e->hs_inflight--;
         // the header budget starts now that the handshake is done
         if (e->guard_cfg.header_budget_us != 0 && !c->served_one &&
             c->hdr_start_us == 0)
-            c->hdr_start_us = now_us();
+            c->hdr_start_us = loop_now(e);
     }
 }
 
@@ -642,6 +690,46 @@ bool flush_out(Engine* e, Conn* c) {
     return true;
 }
 
+// Mark a conn for the end-of-wakeup flush pass: every byte a wakeup
+// produces for a socket (pipelined responses, relay chunks, handshake
+// records) leaves in ONE send() — and for TLS conns one record batch —
+// instead of one per append site. Outside the loop's run window it
+// degrades to an immediate flush so teardown writes reach the wire.
+void queue_flush(Engine* e, Conn* c) {
+    if (!e->defer_ok) {
+        flush_out(e, c);
+        return;
+    }
+    if (!c->flush_queued) {
+        c->flush_queued = true;
+        e->dirty.push_back(c);
+    }
+}
+
+// h1 frees conns inline (no graveyard), so every free must null out a
+// pending dirty slot — drain_dirty's cursor must never touch a freed
+// conn (a flush can cascade into closing the conn's PEER, which may
+// itself be queued).
+void purge_dirty(Engine* e, Conn* c) {
+    if (!c->flush_queued) return;
+    c->flush_queued = false;
+    for (auto& p : e->dirty)
+        if (p == c) { p = nullptr; break; }
+}
+
+void drain_dirty(Engine* e) {
+    // index loop over the live vector: flush_out may cascade closes
+    // (nulling entries anywhere) and queue new conns (growing the tail)
+    for (size_t i = 0; i < e->dirty.size(); i++) {
+        Conn* c = e->dirty[i];
+        if (c == nullptr) continue;
+        e->dirty[i] = nullptr;
+        c->flush_queued = false;
+        flush_out(e, c);
+    }
+    e->dirty.clear();
+}
+
 // Queue a synthesized response. Returns false if the conn was freed.
 bool send_simple(Engine* e, Conn* c, int status, const char* reason,
                  const char* extra_hdr, const std::string& body,
@@ -680,7 +768,7 @@ void tls_wrap_upstream(Engine* e, Conn* up, const std::string& host) {
     up->tls = new l5dtls::TlsIo();
     up->tls->sess = s;
     up->tls->sni = host;
-    up->tls->hs_deadline_us = now_us() + TLS_HS_TIMEOUT_US;
+    up->tls->hs_deadline_us = loop_now(e) + TLS_HS_TIMEOUT_US;
 }
 
 void unregister_parked(Engine* e, Conn* c) {
@@ -708,7 +796,7 @@ void release_upstream(Engine* e, Conn* up, bool reusable) {
                         up->st = Conn::St::IDLE;
                         up->in.clear();
                         up->deadline_us = 0;
-                        up->idle_since_us = now_us();
+                        up->idle_since_us = loop_now(e);
                         up->rsp_head_parsed = false;
                         if (up->paused) {
                             up->paused = false;
@@ -730,6 +818,7 @@ void release_upstream(Engine* e, Conn* up, bool reusable) {
         e->conns.erase(up->fd);
         ::close(up->fd);
     }
+    purge_dirty(e, up);
     delete up;
 }
 
@@ -780,6 +869,7 @@ void conn_close(Engine* e, Conn* c) {
             }
         }
     }
+    purge_dirty(e, c);
     delete c;
 }
 
@@ -801,16 +891,16 @@ void attach_upstream(Engine* e, Conn* client, Conn* up) {
     up->rsp_eof_delim = false;
     up->rsp_status = 0;
     up->in.clear();
-    up->deadline_us = now_us() + EXCHANGE_TIMEOUT_US;
+    up->deadline_us = loop_now(e) + EXCHANGE_TIMEOUT_US;
     client->st = client->req_body.done()
         ? Conn::St::READ_RSP : Conn::St::FORWARD_BODY;
     // zero-progress-body budget starts when we begin waiting for body
     client->body_progress_us =
-        client->st == Conn::St::FORWARD_BODY ? now_us() : 0;
+        client->st == Conn::St::FORWARD_BODY ? loop_now(e) : 0;
     client->deadline_us = 0;
     wbuf(up)->append(client->req_stash);
     client->req_stash.clear();
-    flush_out(e, up);
+    queue_flush(e, up);
 }
 
 // Dispatch the staged request on `client` (mu NOT held). On failure the
@@ -955,10 +1045,8 @@ bool try_start_request(Engine* e, Conn* client) {
     bool close_req = false;
     bool upgrade_req = false;
     if (conn_hdr != nullptr) {
-        std::string cv = *conn_hdr;
-        lower(cv);
-        close_req = cv.find("close") != std::string::npos;
-        upgrade_req = cv.find("upgrade") != std::string::npos;
+        close_req = ihas(*conn_hdr, "close");
+        upgrade_req = ihas(*conn_hdr, "upgrade");
     }
     client->upgrade_req = upgrade_req;
 
@@ -966,7 +1054,7 @@ bool try_start_request(Engine* e, Conn* client) {
     client->req_body = bt;
     client->rsp_body = BodyTracker{};
     client->route_key = key;
-    client->t_start_us = now_us();
+    client->t_start_us = loop_now(e);
     client->req_bytes = h.head_len;
     client->rsp_bytes = 0;
     client->close_after = close_req || h.version == "HTTP/1.0";
@@ -1018,7 +1106,8 @@ bool try_start_request(Engine* e, Conn* client) {
         break;
     case 3:
         if (client->tls != nullptr) {
-            std::string sni = l5dtls::server_sni(client->tls->sess);
+            // SNI cached at handshake completion (hs_complete)
+            const std::string& sni = client->tls->sni;
             if (!sni.empty())
                 client->tenant = l5dtg::tenant_hash(sni.data(),
                                                     sni.size());
@@ -1065,7 +1154,7 @@ bool try_start_request(Engine* e, Conn* client) {
     }
     if (!have_route) {
         client->st = Conn::St::WAIT_ROUTE;
-        client->deadline_us = now_us() + ROUTE_WAIT_TIMEOUT_US;
+        client->deadline_us = loop_now(e) + ROUTE_WAIT_TIMEOUT_US;
         return false;  // parked; nothing further until a route arrives
     }
     // 0 => synthesized response, conn ready for the next buffered request
@@ -1099,7 +1188,7 @@ void finish_exchange(Engine* e, Conn* up, bool upstream_reusable) {
         release_upstream(e, up, false);
         return;
     }
-    uint64_t lat = now_us() - client->t_start_us;
+    uint64_t lat = loop_now(e) - client->t_start_us;
     // in-data-plane scoring: feature prep (hash col + drift EWMA)
     // rides the SAME mu hold and route scan as the stats record; the
     // dense forward runs OUTSIDE mu against the slab's own reader
@@ -1154,7 +1243,7 @@ void finish_exchange(Engine* e, Conn* up, bool upstream_reusable) {
     release_upstream(e, up, upstream_reusable);
     if (client->close_after) {
         client->close_when_flushed = true;
-        flush_out(e, client);
+        queue_flush(e, client);
         return;
     }
     client->st = Conn::St::READ_HEAD;
@@ -1214,7 +1303,7 @@ int tunnel_sample(Engine* e, Conn* c, uint64_t now) {
 // tunnel on a sick transition. Returns false if the conn was freed
 // (the close cascades to the upstream leg via conn_close).
 bool tunnel_note(Engine* e, Conn* c, float bytes) {
-    uint64_t now = now_us();
+    uint64_t now = loop_now(e);
     float gap_ms = c->last_frame_us == 0
         ? 0.0f : (float)(now - c->last_frame_us) / 1000.0f;
     c->last_frame_us = now;
@@ -1255,7 +1344,7 @@ bool enter_tunnel(Engine* e, Conn* client, Conn* up) {
     client->body_progress_us = 0;
     client->hdr_start_us = 0;
     client->close_after = true;  // a tunneled conn never re-enters h1
-    uint64_t now = now_us();
+    uint64_t now = loop_now(e);
     client->last_frame_us = now;
     client->tunnel_bytes = 0;
     if (e->stream_cfg.enabled) {
@@ -1285,14 +1374,14 @@ bool enter_tunnel(Engine* e, Conn* client, Conn* up) {
         size_t nb = up->in.size();
         wbuf(client)->append(up->in);
         up->in.clear();
-        if (!flush_out(e, client)) return false;
+        queue_flush(e, client);
         if (!tunnel_note(e, client, (float)nb)) return false;
     }
     if (!client->in.empty()) {
         size_t nb = client->in.size();
         wbuf(up)->append(client->in);
         client->in.clear();
-        if (!flush_out(e, up)) return false;
+        queue_flush(e, up);
         if (!tunnel_note(e, client, (float)nb)) return false;
     }
     return true;
@@ -1301,6 +1390,7 @@ bool enter_tunnel(Engine* e, Conn* client, Conn* up) {
 // Python-side actuation: keys queued by fp_rst_stream are resolved on
 // the loop thread against by_skey and their tunnels closed.
 void drain_pending_rst(Engine* e) {
+    // l5d: ignore[hot-alloc] — default-constructed vector allocates nothing; swap() steals the queued buffer, and RST actuation is control-plane cadence, not per-request
     std::vector<uint32_t> keys;
     {
         std::lock_guard<std::mutex> g(e->mu);
@@ -1368,7 +1458,7 @@ void on_upstream_readable(Engine* e, Conn* up) {
                 tls_account(e, up, false);
             }
             // handshake records / staged request plaintext
-            if (!flush_out(e, up)) return;
+            queue_flush(e, up);
         }
         Conn* client = up->peer;
         if (client == nullptr) {
@@ -1385,7 +1475,7 @@ void on_upstream_readable(Engine* e, Conn* up) {
             if (nb > 0) {
                 wbuf(client)->append(up->in);
                 up->in.clear();
-                if (!flush_out(e, client)) return;
+                queue_flush(e, client);
                 maybe_pause_producer(e, client);
                 if (!tunnel_note(e, client, (float)nb)) return;
             }
@@ -1417,7 +1507,7 @@ void on_upstream_readable(Engine* e, Conn* up) {
             client->rsp_bytes += h.head_len;
             up->in.erase(0, h.head_len);
             if (h.status >= 100 && h.status < 200 && h.status != 101) {
-                if (!flush_out(e, client)) return;
+                queue_flush(e, client);
                 continue;  // informational: next head follows
             }
             up->rsp_head_parsed = true;
@@ -1430,7 +1520,7 @@ void on_upstream_readable(Engine* e, Conn* up) {
             if ((h.status == 101 && client->upgrade_req) ||
                 (client->req_method == "CONNECT" && h.status >= 200 &&
                  h.status < 300)) {
-                if (!flush_out(e, client)) return;
+                queue_flush(e, client);
                 if (!enter_tunnel(e, client, up)) return;
                 goto more;  // next reads take the TUNNEL branch
             }
@@ -1445,7 +1535,7 @@ void on_upstream_readable(Engine* e, Conn* up) {
             client->rsp_bytes += (uint64_t)take;
             up->in.erase(0, (size_t)take);
         }
-        if (!flush_out(e, client)) return;  // client freed; peers unlinked
+        queue_flush(e, client);
         if (client->rsp_body.done()) {
             bool reusable = up->in.empty() && !up->rsp_eof_delim;
             finish_exchange(e, up, reusable);
@@ -1492,7 +1582,7 @@ void on_client_readable(Engine* e, Conn* c) {
                 tls_account(e, c, false);
             }
             // handshake records / resumption tickets
-            if (!flush_out(e, c)) return;
+            queue_flush(e, c);
         } else {
             c->in.append(buf, (size_t)n);
         }
@@ -1505,7 +1595,7 @@ void on_client_readable(Engine* e, Conn* c) {
             if (nb > 0) {
                 wbuf(c->peer)->append(c->in);
                 c->in.clear();
-                if (!flush_out(e, c->peer)) return;
+                queue_flush(e, c->peer);
                 maybe_pause_producer(e, c->peer);
                 if (!tunnel_note(e, c, (float)nb)) return;
             }
@@ -1524,8 +1614,8 @@ void on_client_readable(Engine* e, Conn* c) {
             wbuf(c->peer)->append(c->in.data(), (size_t)take);
             c->req_bytes += (uint64_t)take;
             c->in.erase(0, (size_t)take);
-            if (take > 0) c->body_progress_us = now_us();
-            if (!flush_out(e, c->peer)) return;
+            if (take > 0) c->body_progress_us = loop_now(e);
+            queue_flush(e, c->peer);
             maybe_pause_producer(e, c->peer);  // c produces into peer->out
             if (c->req_body.done()) {
                 c->st = Conn::St::READ_RSP;
@@ -1543,7 +1633,7 @@ void on_client_readable(Engine* e, Conn* c) {
             if (c->in.empty() && c->served_one)
                 c->hdr_start_us = 0;
             else if (c->hdr_start_us == 0)
-                c->hdr_start_us = now_us();
+                c->hdr_start_us = loop_now(e);
         }
         // WAIT_ROUTE / READ_RSP: extra bytes buffer in c->in (pipelining),
         // bounded — a client shoveling data while parked is abusive
@@ -1569,7 +1659,7 @@ void on_listener(Engine* e, int lfd) {
             if (errno == EINTR) continue;  // don't drop the pending conn
             return;
         }
-        uint64_t now = now_us();
+        uint64_t now = loop_now(e);
         // per-source accept throttle: a churn-flooding source is shed
         // at accept, before it can consume a handshake or conn slot
         if (peer.sin_family == AF_INET &&
@@ -1621,7 +1711,7 @@ void on_listener(Engine* e, int lfd) {
 }
 
 void sweep_timeouts(Engine* e) {
-    uint64_t now = now_us();
+    uint64_t now = loop_now(e);
     if (now - e->last_sweep_us < 500'000) return;
     e->last_sweep_us = now;
     std::vector<Conn*> expired;
@@ -1731,8 +1821,12 @@ void sweep_timeouts(Engine* e) {
 void* loop_main(void* arg) {
     Engine* e = (Engine*)arg;
     epoll_event evs[MAX_EVENTS];
+    e->defer_ok = true;  // producers may now coalesce writes
     while (e->running.load(std::memory_order_relaxed)) {
         int n = epoll_wait(e->epfd, evs, MAX_EVENTS, 250);
+        // ONE clock read per wakeup: everything this round timestamps
+        // (deadlines, latency, feature rows) reads this stamp
+        e->now_cache_us = now_us();
         for (int i = 0; i < n; i++) {
             int fd = evs[i].data.fd;
             uint32_t ev = evs[i].events;
@@ -1740,6 +1834,7 @@ void* loop_main(void* arg) {
                 uint64_t v;
                 ssize_t r = ::read(e->wakefd, &v, sizeof(v));
                 (void)r;
+                // l5d: ignore[hot-alloc] — wakefd branch: runs only on a control-plane route-update wakeup, not per request
                 std::vector<std::string> hosts;
                 {
                     std::lock_guard<std::mutex> g(e->mu);
@@ -1787,7 +1882,12 @@ void* loop_main(void* arg) {
         }
         drain_pending_rst(e);
         sweep_timeouts(e);
+        // ONE coalesced flush per wakeup: every write this round
+        // produced leaves in a single send() batch per conn
+        drain_dirty(e);
     }
+    drain_dirty(e);         // teardown bytes still flush
+    e->defer_ok = false;    // shutdown-path writes go straight out
     return nullptr;
 }
 

@@ -201,6 +201,7 @@ struct Engine {
     // epoll round (true only while the loop thread runs — outside it,
     // queue_flush degrades to an immediate flush)
     std::vector<H2Conn*> dirty;
+    std::vector<H2Conn*> dirty_scratch;  // drain_dirty's batch buffer
     bool defer_ok = false;
     // TLS (installed from Python BEFORE fph2_start; loop-thread reads)
     l5dtls::Ctx* tls_srv = nullptr;
@@ -218,6 +219,10 @@ struct Engine {
     // loop-thread-only defense state
     l5dtg::SourceTable sources;
     uint32_t hs_inflight = 0;  // accept-leg TLS handshakes in flight
+    // one clock read per wakeup: loop_main stamps this right after
+    // epoll_wait returns; every loop-thread timestamp consumer reads
+    // the stamp (loop_now) instead of issuing its own clock_gettime
+    uint64_t now_cache_us = now_us();
     // feature timestamps are relative to engine creation:
     // float32 seconds-since-boot quantizes to >60ms after
     // ~12 days of uptime, breaking inter-arrival math
@@ -278,6 +283,11 @@ size_t outsz(const H2Conn* c) {
     return c->out.size()
         + (c->tls != nullptr ? c->tls->plain_out.size() : 0);
 }
+
+// The loop thread's clock: one clock_gettime per wakeup (the loop_main
+// stamp), not one per timestamp consumer. Hot-path code reads the
+// stamp; cold/control-plane code keeps calling now_us() directly.
+uint64_t loop_now(Engine* e) { return e->now_cache_us; }
 
 struct PStream {
     H2Conn* cc = nullptr;
@@ -378,6 +388,11 @@ void tls_account(Engine* e, H2Conn* c, bool failed) {
 // release its slot in the accept-leg churn-backpressure counter.
 void hs_complete(Engine* e, H2Conn* c) {
     c->tls->hs_deadline_us = 0;
+    // accept-leg conns cache their SNI here, once per handshake —
+    // tenant extraction used to call server_sni() (shim call + string
+    // alloc) on EVERY request stream of the conn
+    if (c->tls->sess->is_server && c->tls->sni.empty())
+        c->tls->sni = l5dtls::server_sni(c->tls->sess);
     if (c->hs_pending) {
         c->hs_pending = false;
         if (e->hs_inflight > 0) e->hs_inflight--;
@@ -465,14 +480,17 @@ void pump_client(Engine* e, PStream* st);
 // more conns dirty, hence the bounded rounds + plain-flush tail.
 void drain_dirty(Engine* e) {
     for (int round = 0; round < 8 && !e->dirty.empty(); round++) {
-        std::vector<H2Conn*> batch;
-        batch.swap(e->dirty);
-        for (H2Conn* c : batch) {
+        // swap through a persistent scratch: the batch buffer used to
+        // be a local vector, one heap allocation per wakeup
+        e->dirty_scratch.clear();
+        std::swap(e->dirty, e->dirty_scratch);
+        for (H2Conn* c : e->dirty_scratch) {
             c->flush_queued = false;
             if (c->dead) continue;
             size_t before = outsz(c);
             if (!flush_out(e, c)) continue;
             if (before > OUT_HIGH && outsz(c) < OUT_HIGH) {
+                // l5d: ignore[hot-alloc] — runs only on an OUT_HIGH→below watermark crossing (backpressure release), not in the steady state
                 std::vector<PStream*> sts;
                 sts.reserve(c->streams.size());
                 for (auto& kv : c->streams) sts.push_back(kv.second);
@@ -488,9 +506,9 @@ void drain_dirty(Engine* e) {
         }
     }
     while (!e->dirty.empty()) {  // close cascades only: flush, no pump
-        std::vector<H2Conn*> batch;
-        batch.swap(e->dirty);
-        for (H2Conn* c : batch) {
+        e->dirty_scratch.clear();
+        std::swap(e->dirty, e->dirty_scratch);
+        for (H2Conn* c : e->dirty_scratch) {
             c->flush_queued = false;
             if (!c->dead) flush_out(e, c);
         }
@@ -511,7 +529,7 @@ void push_feature(Engine* e, uint64_t route_id, uint64_t lat_us, int status,
     // (request rows only — a stream's tenant slot is settled when the
     // stream finishes, not per sample)
     if (tenant && kind == l5dstream::ROW_REQUEST)
-        e->tenants.observe(tenant, status, score, scored != 0, now_us());
+        e->tenants.observe(tenant, status, score, scored != 0, loop_now(e));
     if (e->features.size() >= e->features_cap) {
         e->features_dropped++;
         return;
@@ -522,7 +540,7 @@ void push_feature(Engine* e, uint64_t route_id, uint64_t lat_us, int status,
     r.status = (float)status;
     r.req_bytes = (float)req_b;
     r.rsp_bytes = (float)rsp_b;
-    r.ts_s = (float)((double)(now_us() - e->t0_us) / 1e6);
+    r.ts_s = (float)((double)(loop_now(e) - e->t0_us) / 1e6);
     r.score = score;
     r.scored = scored ? 1.0f : 0.0f;
     r.tenant = l5dtg::tenant_feature(tenant);
@@ -616,7 +634,7 @@ void finish_stream(Engine* e, PStream* st, bool record) {
                 }
         }
     }
-    uint64_t lat = now_us() - st->t_start_us;
+    uint64_t lat = loop_now(e) - st->t_start_us;
     // in-data-plane scoring: feature prep (hash col + drift EWMA) rides
     // the same mu hold as the route stats; the dense forward runs
     // OUTSIDE mu against the slab's own reader protocol
@@ -760,7 +778,7 @@ void sample_stream(Engine* e, PStream* st, uint64_t now) {
 // stream (actuation) — callers must re-check st->closed.
 void note_frame(Engine* e, PStream* st, int kind, size_t nbytes) {
     if (st->skey == 0 || st->closed) return;
-    const uint64_t now = now_us();
+    const uint64_t now = loop_now(e);
     const float gap_ms = st->last_frame_us != 0
         ? (float)(now - st->last_frame_us) / 1000.0f : 0.0f;
     st->last_frame_us = now;
@@ -772,6 +790,7 @@ void note_frame(Engine* e, PStream* st, int kind, size_t nbytes) {
 // Python-side actuation: RST requests queue under mu and drain here on
 // the loop thread (fph2_rst_stream wakes the loop via the eventfd).
 void drain_pending_rst(Engine* e) {
+    // l5d: ignore[hot-alloc] — default-constructed vector allocates nothing; swap() steals the queued buffer, and RST actuation is control-plane cadence, not per-request
     std::vector<uint32_t> keys;
     {
         std::lock_guard<std::mutex> g(e->mu);
@@ -956,7 +975,7 @@ H2Conn* mk_upstream(Engine* e, const std::string& route_key,
             c->tls = new l5dtls::TlsIo();
             c->tls->sess = s;
             c->tls->sni = route_key;
-            c->tls->hs_deadline_us = now_us() + TLS_HS_TIMEOUT_US;
+            c->tls->hs_deadline_us = loop_now(e) + TLS_HS_TIMEOUT_US;
         }
     }
     // client preface + our SETTINGS + a big connection window
@@ -1231,7 +1250,7 @@ void conn_error(Engine* e, H2Conn* c, uint32_t code) {
 bool flood_ok(Engine* e, H2Conn* c, uint32_t* counter, uint32_t cap,
               bool rapid_reset) {
     if (cap == 0) return true;
-    uint64_t now = now_us();
+    uint64_t now = loop_now(e);
     if (now - c->flood_window_start_us > e->guard_cfg.flood_window_us) {
         c->flood_window_start_us = now;
         c->rst_count = c->ping_count = c->settings_count = 0;
@@ -1386,7 +1405,8 @@ void client_headers_complete(Engine* e, H2Conn* c) {
     }
     case 3:
         if (c->tls != nullptr) {
-            std::string sni = l5dtls::server_sni(c->tls->sess);
+            // SNI cached at handshake completion (hs_complete)
+            const std::string& sni = c->tls->sni;
             if (!sni.empty())
                 tenant = l5dtg::tenant_hash(sni.data(), sni.size());
         }
@@ -1399,7 +1419,7 @@ void client_headers_complete(Engine* e, H2Conn* c) {
         bool over = false;
         {
             std::lock_guard<std::mutex> g(e->mu);
-            l5dtg::TenantStats* ts = e->tenants.get(tenant, now_us());
+            l5dtg::TenantStats* ts = e->tenants.get(tenant, loop_now(e));
             int q = e->quotas.limit_of(tenant);
             if (q >= 0 && ts->inflight >= q) {
                 ts->shed++;
@@ -1422,7 +1442,7 @@ void client_headers_complete(Engine* e, H2Conn* c) {
     st->route_key = key;
     st->tenant = tenant;
     st->tenant_counted = tenant_counted;
-    st->t_start_us = now_us();
+    st->t_start_us = loop_now(e);
     // zero-progress-body budget: armed only while the request body is
     // still open (cleared when END_STREAM is seen)
     if (!(flags & h2::FLAG_END_STREAM) &&
@@ -1460,7 +1480,7 @@ void client_headers_complete(Engine* e, H2Conn* c) {
     // no route yet: surface the miss and park (same dance as the h1
     // engine's WAIT_ROUTE, fastpath.cpp)
     st->parked = true;
-    st->park_deadline_us = now_us() + ROUTE_WAIT_TIMEOUT_US;
+    st->park_deadline_us = loop_now(e) + ROUTE_WAIT_TIMEOUT_US;
     e->parked[key].push_back(st);
     {
         std::lock_guard<std::mutex> g(e->mu);
@@ -1538,7 +1558,7 @@ void handle_client_frame(Engine* e, H2Conn* c, uint8_t type, uint8_t flags,
         } else {
             c->s.in_headers = true;
             // slowloris: an open CONTINUATION sequence has a budget
-            c->hb_start_us = now_us();
+            c->hb_start_us = loop_now(e);
         }
         break;
     }
@@ -1598,7 +1618,7 @@ void handle_client_frame(Engine* e, H2Conn* c, uint8_t type, uint8_t flags,
         st->c_runacked += len;
         st->req_b += n;
         if (st->body_progress_us != 0 && n > 0)
-            st->body_progress_us = now_us();
+            st->body_progress_us = loop_now(e);
         st->u_pend.append((const char*)(p + off), n);
         c->buffered += n;
         if (st->retain_valid) {
@@ -2011,7 +2031,7 @@ void on_listener(Engine* e, int lfd) {
             if (errno == EINTR) continue;  // don't drop the pending conn
             return;
         }
-        uint64_t now = now_us();
+        uint64_t now = loop_now(e);
         // per-source accept throttle: churn floods are shed at accept
         if (peer.sin_family == AF_INET &&
             !e->sources.allow(peer.sin_addr.s_addr, e->guard_cfg, now)) {
@@ -2073,7 +2093,7 @@ void on_listener(Engine* e, int lfd) {
 }
 
 void sweep(Engine* e) {
-    uint64_t now = now_us();
+    uint64_t now = loop_now(e);
     if (now - e->last_sweep_us < 500'000) return;
     e->last_sweep_us = now;
     // TLS handshake budget: a peer still mid-handshake past its window
@@ -2232,6 +2252,9 @@ void* loop_main(void* arg) {
     e->defer_ok = true;  // frame producers may now coalesce writes
     while (e->running.load(std::memory_order_relaxed)) {
         int n = epoll_wait(e->epfd, evs, MAX_EVENTS, 250);
+        // ONE clock read per wakeup: everything this round
+        // timestamps (deadlines, latency, features) reads this
+        e->now_cache_us = now_us();
         for (int i = 0; i < n; i++) {
             int fd = evs[i].data.fd;
             uint32_t ev = evs[i].events;
@@ -2239,6 +2262,7 @@ void* loop_main(void* arg) {
                 uint64_t v;
                 ssize_t r = ::read(e->wakefd, &v, sizeof(v));
                 (void)r;
+                // l5d: ignore[hot-alloc] — wakefd branch: runs only on a control-plane route-update wakeup, not per request
                 std::vector<std::string> hosts;
                 {
                     std::lock_guard<std::mutex> g(e->mu);
@@ -2281,6 +2305,7 @@ void* loop_main(void* arg) {
                 if (!flush_out(e, c)) continue;
                 if (outsz(c) < before) {
                     // room freed: resume streams stalled on OUT_HIGH
+                    // l5d: ignore[hot-alloc] — runs only when a blocked EPOLLOUT flush frees buffer room (backpressure release), not in the steady state
                     std::vector<PStream*> sts;
                     for (auto& kv : c->streams) sts.push_back(kv.second);
                     for (PStream* st : sts) {

@@ -151,6 +151,7 @@ inline Api& api() {
             if (!p) {
                 all = false;
                 if (a.err.empty())
+                    // l5d: ignore[hot-alloc] — one-shot dlopen symbol loader; api() runs its resolver exactly once per process, never on the event path
                     a.err = std::string("missing symbol ") + name;
             }
             return p;

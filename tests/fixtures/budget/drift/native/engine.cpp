@@ -1,0 +1,79 @@
+// tests/fixtures/budget/drift — the good miniature engine with
+// exactly ONE violation of each l5dbudget rule planted at a
+// `// DRIFT:` marker (the test suite pins rule ids to these lines),
+// plus one JUSTIFIED waiver the census must count as suppressed.
+// Must stay `g++ -fsyntax-only` clean — the census test compiles it.
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <mutex>
+#include <string>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <time.h>
+
+struct Conn {
+    int fd;
+    char buf[512];
+    size_t len;
+};
+
+static std::mutex g_mu;
+static uint64_t g_stat;
+
+uint64_t now_us() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (uint64_t)ts.tv_sec * 1000000ull +
+           (uint64_t)ts.tv_nsec / 1000;
+}
+
+std::string parse_head(Conn* c) {
+    std::string head(c->buf, c->len);
+    return head;
+}
+
+void relay(Conn* c, const char* p, size_t n) {
+    memcpy(c->buf, p, n);
+}
+
+void push_stat(uint64_t v) {
+    std::lock_guard<std::mutex> g(g_mu);
+    g_stat = v;
+}
+
+void note_frame(uint64_t v) {
+    // DRIFT: hot-lock — a second acquisition on a path that declares
+    // exactly one lock site
+    std::lock_guard<std::mutex> g(g_mu);
+    g_stat += v;
+}
+
+void on_readable(Conn* c) {
+    ssize_t r = recv(c->fd, c->buf, sizeof(c->buf), 0);
+    if (r <= 0) return;
+    c->len = (size_t)r;
+    parse_head(c);
+    relay(c, c->buf, c->len);
+    // DRIFT: hot-alloc — per-event string churn outside the
+    // accounted set
+    std::string shadow(c->buf, c->len);
+    // DRIFT: copy-budget — bulk copy outside the accounted set
+    memmove(c->buf, shadow.data(), shadow.size());
+    // DRIFT: syscall-budget — fcntl is not in the declared budget
+    fcntl(c->fd, F_GETFL);
+    // l5d: ignore[syscall-budget] — fixture: a justified waiver the census must count as suppressed, not silent
+    shutdown(c->fd, SHUT_RDWR);
+    send(c->fd, c->buf, c->len, 0);
+    push_stat(now_us());
+    note_frame(c->len);
+}
+
+void loop_main(int epfd, Conn* conns) {
+    struct epoll_event evs[64];
+    for (;;) {
+        int n = epoll_wait(epfd, evs, 64, 100);
+        for (int i = 0; i < n; i++)
+            on_readable(&conns[evs[i].data.fd]);
+    }
+}

@@ -1,0 +1,63 @@
+// tests/fixtures/budget/good — a miniature engine that stays INSIDE
+// its declared budget. The test suite walks it with a mini manifest
+// (one path, roots=loop_main, wrappers now_us->clock_gettime): every
+// syscall site declared, the one heap allocation accounted
+// (parse_head in alloc_ok), the one bulk copy accounted (relay in
+// copy_ok), and one lock site against a budget of one. Must stay
+// `g++ -fsyntax-only` clean — the fixture census test compiles it.
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <time.h>
+
+struct Conn {
+    int fd;
+    char buf[512];
+    size_t len;
+};
+
+static std::mutex g_mu;
+static uint64_t g_stat;
+
+uint64_t now_us() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (uint64_t)ts.tv_sec * 1000000ull +
+           (uint64_t)ts.tv_nsec / 1000;
+}
+
+std::string parse_head(Conn* c) {
+    std::string head(c->buf, c->len);
+    return head;
+}
+
+void relay(Conn* c, const char* p, size_t n) {
+    memcpy(c->buf, p, n);
+}
+
+void push_stat(uint64_t v) {
+    std::lock_guard<std::mutex> g(g_mu);
+    g_stat = v;
+}
+
+void on_readable(Conn* c) {
+    ssize_t r = recv(c->fd, c->buf, sizeof(c->buf), 0);
+    if (r <= 0) return;
+    c->len = (size_t)r;
+    parse_head(c);
+    relay(c, c->buf, c->len);
+    send(c->fd, c->buf, c->len, 0);
+    push_stat(now_us());
+}
+
+void loop_main(int epfd, Conn* conns) {
+    struct epoll_event evs[64];
+    for (;;) {
+        int n = epoll_wait(epfd, evs, 64, 100);
+        for (int i = 0; i < n; i++)
+            on_readable(&conns[evs[i].data.fd]);
+    }
+}

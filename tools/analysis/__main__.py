@@ -1,12 +1,13 @@
 """CLI for the static-analysis suite.
 
-Five modes::
+Six modes::
 
     python -m tools.analysis [lint] [paths] [--rule ...] [--format json]
     python -m tools.analysis check <config.yml...>      [--format json]
     python -m tools.analysis race  [paths]              [--format json]
     python -m tools.analysis seam                       [--format json]
     python -m tools.analysis native                     [--format json]
+    python -m tools.analysis budget                     [--format json]
 
 ``lint`` (the default) runs the l5dlint AST rules over python sources;
 ``check`` runs l5dcheck semantic verification over linker/namerd YAML;
@@ -14,7 +15,9 @@ Five modes::
 asyncio data plane; ``seam`` runs l5dseam cross-plane contract analysis
 over the C++/Python boundary (ABI signatures, mirrored constants, the
 stats contract, knob plumbing); ``native`` runs l5dnat memory-ordering/
-fd-lifecycle/event-loop-discipline analysis over the C++ engines.
+fd-lifecycle/event-loop-discipline analysis over the C++ engines;
+``budget`` runs l5dbudget hot-path cost accounting (syscall/alloc/lock/
+copy sites per engine entrypoint vs the checked-in budget manifest).
 
 ``--changed`` (any mode) restricts the run to files that differ from
 ``git merge-base HEAD main`` (plus untracked files) — fast enough for
@@ -334,10 +337,48 @@ def _nat(args) -> int:
         args.show_suppressed, header, "l5dnat")
 
 
+def _budget(args) -> int:
+    from tools.analysis.budget import (
+        budget_rule_ids, run_budget_analysis)
+
+    rc, rules = _parse_rules(args, budget_rule_ids())
+    if rc:
+        return rc
+    if args.paths:
+        # a budget is a property of a whole callgraph path, never of
+        # one file: per-path runs would vouch for reachable cost they
+        # never walked, so the mode always analyzes the whole manifest
+        print("budget mode analyzes the whole manifest; it takes no "
+              "paths", file=sys.stderr)
+        return 2
+    header = {"mode": "budget", "paths": ["native"],
+              "rules": rules or budget_rule_ids() + [
+                  "suppression", "stale-suppression"]}
+    if args.changed:
+        # any budget-relevant change reruns the FULL sweep (same
+        # contract as seam/nat: the blown budget is cross-function)
+        picked = _restrict_to_changed(
+            ["native", "tools/analysis/budget", "tools/analysis/native",
+             "tools/analysis/seam"],
+            (".py", ".h", ".hpp", ".c", ".cc", ".cpp"), "l5dbudget")
+        if picked is None:
+            return _noop("l5dbudget", args.as_json, header)
+    t0 = time.perf_counter()
+    try:
+        findings = run_budget_analysis(repo_root=_REPO, rules=rules)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    return _report(
+        findings, time.perf_counter() - t0, args.as_json,
+        args.show_suppressed, header, "l5dbudget")
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     mode = "lint"
-    if argv and argv[0] in ("lint", "check", "race", "seam", "native"):
+    if argv and argv[0] in ("lint", "check", "race", "seam", "native",
+                            "budget"):
         mode = argv.pop(0)
     args = _mk_parser().parse_args(argv)
     if args.as_json or args.format == "json":
@@ -360,6 +401,10 @@ def main(argv=None) -> int:
             from tools.analysis.native import nat_rule_descriptions
             for rule, desc in nat_rule_descriptions():
                 print(f"{rule:20s} {desc}")
+        elif mode == "budget":
+            from tools.analysis.budget import budget_rule_descriptions
+            for rule, desc in budget_rule_descriptions():
+                print(f"{rule:20s} {desc}")
         else:
             for c in sorted(all_checkers(), key=lambda c: c.rule):
                 print(f"{c.rule:20s} {c.description}")
@@ -375,6 +420,8 @@ def main(argv=None) -> int:
         return _seam(args)
     if mode == "native":
         return _nat(args)
+    if mode == "budget":
+        return _budget(args)
     return _lint(args)
 
 

@@ -1,0 +1,161 @@
+"""l5dbudget — hot-path cost accounting for the native engines.
+
+ROADMAP item 2 ("zero-syscall hot path", "a syscalls-per-request stat
+proving the batching") needs the per-event cost envelope to be a
+tracked contract, not folklore. l5dbudget is the sixth analyzer: it
+walks the callgraph from every declared engine entrypoint (accept,
+request/serve, feature-drain, weight-publish, TLS handshake — both
+engines) and diffs what the path can reach against the checked-in
+budget manifest (``tools/analysis/budget/manifest.py``):
+
+- ``syscall-budget``  unaccounted syscall site, or more sites than the
+  path's declared per-event budget; manifest rot included
+- ``hot-alloc``       per-event heap allocation outside the declared
+  arena/accounted set
+- ``hot-lock``        lock acquisition beyond the declared budget
+  (0 == the path is declared lock-free)
+- ``copy-budget``     bulk copy outside the accounted set
+
+Run: ``python -m tools.analysis budget [--format json] [--changed]``.
+Budgets are cross-function by construction, so ``--changed`` runs the
+full sweep when any budget-relevant file changed and no-ops otherwise
+(same contract as l5dseam/l5dnat).
+
+The static profile's ``per_event`` sums are reconciled against a
+measured syscalls-per-request run by ``tools/validator.py budget``
+(LD_PRELOAD counter, no strace needed) — the static number must
+predict the measured one within the manifest's declared tolerance.
+
+Suppressions reuse the C flavor of the l5dlint grammar —
+``// l5d: ignore[rule] — why`` — justification mandatory, stale
+waivers flagged, unknown-rule ids checked against all six analyzers.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+from tools.analysis.core import Finding
+
+BUDGET_RULES = ("copy-budget", "hot-alloc", "hot-lock",
+                "syscall-budget")
+
+
+def budget_rule_ids() -> List[str]:
+    return sorted(BUDGET_RULES)
+
+
+def budget_rule_descriptions() -> List[tuple]:
+    return [
+        ("copy-budget", "bulk copy (memcpy/memmove/append/assign) on "
+                        "a hot path outside the manifest's accounted "
+                        "set"),
+        ("hot-alloc", "per-event heap allocation (new/malloc/"
+                      "std::string/vector growth/substr) outside the "
+                      "declared arena set"),
+        ("hot-lock", "lock acquisition beyond the path's declared "
+                     "budget (0 declared == lock-free path)"),
+        ("syscall-budget", "syscall site the path's budget does not "
+                           "account for, or more sites than declared; "
+                           "manifest rot is a finding too"),
+    ]
+
+
+def run_budget_analysis(repo_root: Optional[str] = None,
+                        rules: Optional[Sequence[str]] = None,
+                        scan: Optional[List[str]] = None,
+                        manifest=None) -> List[Finding]:
+    """Run the budget suite; returns ALL findings (suppressed ones
+    flagged). ``scan``/``manifest`` let tests point the sweep at
+    fixture trees; the default scan set is exactly the files the
+    manifest's paths declare."""
+    from tools.analysis.budget.manifest import DEFAULT_MANIFEST
+    from tools.analysis.budget.rules import run_rules
+    from tools.analysis.native.rules import NatProject
+
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+    manifest = manifest or DEFAULT_MANIFEST
+    if scan is None:
+        want = sorted({rel for b in manifest.paths for rel in b.files})
+        scan = [rel for rel in want
+                if os.path.exists(os.path.join(repo_root, rel))]
+        if not scan:
+            raise FileNotFoundError(
+                f"l5dbudget: none of the manifest's declared files "
+                f"exist under {repo_root!r}")
+    proj = NatProject(repo_root, scan)
+    findings = run_rules(proj, manifest=manifest, rules=rules)
+    used = set()
+    for f in findings:
+        sup = proj.c(f.path).suppression_for(f.rule, f.line)
+        if sup is not None and sup.justified:
+            f.suppressed = True
+            f.justification = sup.justification
+            used.add((f.path, sup.line))
+    # meta parity with seam/nat: justification required, rule ids must
+    # belong to SOME analyzer (all six share the native sources), and a
+    # justified budget waiver that silences nothing is itself a
+    # finding. Waivers for other analyzers' rules are never judged
+    # stale here — their own modes exercise them.
+    if rules is None:
+        from tools.analysis.native import NAT_RULES
+        from tools.analysis.seam import SEAM_RULES
+        known = (set(BUDGET_RULES) | set(NAT_RULES) | set(SEAM_RULES)
+                 | {"suppression", "stale-suppression"})
+        for rel in sorted(proj.scan):
+            src = proj.c(rel)
+            for sup in src.suppressions.values():
+                if not sup.justified:
+                    findings.append(Finding(
+                        "suppression", rel, sup.line, 0,
+                        "suppression without justification: write "
+                        "'// l5d: ignore[rule] — why it is safe'"))
+                for r in sup.rules:
+                    if r not in known:
+                        findings.append(Finding(
+                            "suppression", rel, sup.line, 0,
+                            f"suppression names unknown rule {r!r} "
+                            f"(known: {sorted(known)})"))
+                budget_only = [r for r in sup.rules
+                               if r in BUDGET_RULES]
+                if (sup.justified and budget_only
+                        and not any(r not in BUDGET_RULES
+                                    for r in sup.rules)
+                        and (rel, sup.line) not in used):
+                    stale = Finding(
+                        "stale-suppression", rel, sup.line, 0,
+                        f"suppression for {budget_only} no longer "
+                        f"matches any finding: the code moved or the "
+                        f"budget was met — delete the waiver")
+                    ssup = src.suppression_for("stale-suppression",
+                                               sup.line)
+                    if ssup is not None and ssup.justified:
+                        stale.suppressed = True
+                        stale.justification = ssup.justification
+                    findings.append(stale)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def budget_static_profiles(repo_root: Optional[str] = None,
+                           manifest=None) -> dict:
+    """Per-path static cost profiles (syscall sites by name, alloc/
+    lock/copy counts, declared per-event expectation) — the numbers
+    ``validator.py budget`` and the bench baseline row reconcile
+    against."""
+    from tools.analysis.budget.manifest import DEFAULT_MANIFEST
+    from tools.analysis.budget.rules import static_profiles
+    from tools.analysis.native.rules import NatProject
+
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+    manifest = manifest or DEFAULT_MANIFEST
+    want = sorted({rel for b in manifest.paths for rel in b.files})
+    scan = [rel for rel in want
+            if os.path.exists(os.path.join(repo_root, rel))]
+    proj = NatProject(repo_root, scan)
+    return static_profiles(proj, manifest=manifest)

@@ -1,0 +1,357 @@
+"""The budget manifest: the declared per-event cost envelope of every
+native hot path, as data.
+
+ROADMAP item 2 wants a zero-syscall hot path and "a syscalls-per-
+request stat proving the batching". This manifest is the contract both
+halves of l5dbudget diff against:
+
+- the STATIC half (``rules.py``) walks the callgraph from each path's
+  declared roots and checks that every syscall site, heap-allocation
+  site, lock acquisition, and bulk-copy site it can reach is accounted
+  for here (or carries a justified inline waiver);
+- the MEASURED half (``tools/validator.py budget``) runs the real
+  engine under paced load with an LD_PRELOAD syscall counter and
+  checks that measured syscalls-per-request lands within ``tolerance``
+  of the ``per_event`` sum declared here.
+
+Because the manifest is data, *rot is itself a finding*: a root that
+stopped existing, a declared syscall the path no longer reaches, an
+``alloc_ok`` function that went away — each one fires, so the manifest
+can only describe the tree as it is.
+
+Path shape
+----------
+A :class:`PathBudget` names the files the path's functions live in
+(callgraph edges never leave this set), the root functions that enter
+the path, and optional ``stop`` functions where traversal ends because
+another path accounts for them (e.g. the request path stops at
+``on_listener`` — that is the accept path's job). ``wrappers`` maps
+tiny project functions that exist only to make one syscall (``now_us``
+-> ``clock_gettime``) onto that syscall, so every *call site* of the
+wrapper is budgeted as a site of the underlying syscall — this is what
+made the pre-fix "16 clock_gettime sites per wakeup" visible
+statically.
+
+``Syscall.kind`` classifies the sites: ``direct`` (runs once when the
+statement runs), ``loop`` (inside a bounded drain loop), ``batched``
+(amortized across events by coalescing — e.g. one flush per wakeup).
+``per_event`` is the declared *dynamic* rate per request used by the
+measured cross-check; loop-bounded sites declare their typical trip
+count, batched ones a sub-1 amortized rate.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+KIND_DIRECT = "direct"
+KIND_LOOP = "loop"
+KIND_BATCHED = "batched"
+
+
+@dataclass(frozen=True)
+class Syscall:
+    """Allowance for one syscall on one path: at most ``max_sites``
+    static call sites, contributing ``per_event`` dynamic calls per
+    event to the measured expectation."""
+    name: str
+    max_sites: int
+    per_event: float
+    kind: str = KIND_DIRECT
+
+
+@dataclass(frozen=True)
+class PathBudget:
+    """The declared cost envelope of one engine entrypoint."""
+    name: str                       # e.g. "h1-request"
+    files: Tuple[str, ...]          # TU + headers the path lives in
+    roots: Tuple[str, ...]          # functions that enter the path
+    syscalls: Tuple[Syscall, ...]   # accounted syscall sites
+    stop: Tuple[str, ...] = ()      # accounted by another path
+    wrappers: Tuple[Tuple[str, str], ...] = ()  # (project fn, syscall)
+    max_lock_sites: int = 0         # 0 == declared lock-free
+    alloc_ok: Tuple[str, ...] = ()  # functions whose allocs are accounted
+    copy_ok: Tuple[str, ...] = ()   # functions whose copies are accounted
+    hot: bool = True                # per-event path: alloc/copy enforced
+
+    def allowance(self, name: str) -> Optional[Syscall]:
+        for s in self.syscalls:
+            if s.name == name:
+                return s
+        return None
+
+
+@dataclass(frozen=True)
+class MeasuredCheck:
+    """Reconciliation contract for ``validator.py budget``: measured
+    syscalls-per-request for ``engine`` must land within a factor of
+    ``tolerance`` of the ``per_event`` sum over ``paths``."""
+    engine: str                 # "h1" | "h2"
+    paths: Tuple[str, ...]      # PathBudget names summed into expect
+    tolerance: float            # multiplicative band: [exp/tol, exp*tol]
+
+
+@dataclass(frozen=True)
+class BudgetManifest:
+    paths: Tuple[PathBudget, ...]
+    measured: Tuple[MeasuredCheck, ...] = ()
+
+    def path(self, name: str) -> Optional[PathBudget]:
+        for p in self.paths:
+            if p.name == name:
+                return p
+        return None
+
+
+# ---------------------------------------------------------------------------
+# helper constructors (keep the big literal below readable)
+# ---------------------------------------------------------------------------
+
+def _sc(name: str, max_sites: int, per_event: float,
+        kind: str = KIND_DIRECT) -> Syscall:
+    return Syscall(name, max_sites, per_event, kind)
+
+
+_H1_FILES = ("native/fastpath.cpp", "native/tls_shim.h",
+             "native/tls_engine.h", "native/scorer.h",
+             "native/stream_track.h", "native/tenant_guard.h")
+_H2_FILES = ("native/h2_fastpath.cpp", "native/h2_core.h",
+             "native/tls_shim.h", "native/tls_engine.h",
+             "native/scorer.h", "native/stream_track.h",
+             "native/tenant_guard.h")
+
+# both engines route every timestamp through now_us() (cached per
+# wakeup: the loop stamps Engine::now_cache_us right after epoll_wait
+# and hot code reads loop_now()) or l5dscore::now_ns() (the score-
+# latency brackets around eval_model). Every *call site* of either
+# wrapper is budgeted as a clock_gettime site — this is what made the
+# pre-fix "16 clock_gettime sites per wakeup" visible statically.
+_TIME_WRAP = (("now_us", "clock_gettime"),
+              ("now_ns", "clock_gettime"))
+
+# the TLS boundary is its own path (memory-BIO pump, no syscalls of
+# its own); the request paths stop at it
+_TLS_STOPS = ("ingest", "encrypt_pending", "account_handshake")
+
+
+# ---------------------------------------------------------------------------
+# the declared envelope
+# ---------------------------------------------------------------------------
+
+DEFAULT_MANIFEST = BudgetManifest(
+    paths=(
+        # ---------------- h1 (proxy) engine --------------------------
+        PathBudget(
+            name="h1-request",
+            files=_H1_FILES,
+            roots=("loop_main", "on_client_readable",
+                   "on_upstream_readable"),
+            stop=("on_listener", "sweep_timeouts") + _TLS_STOPS,
+            wrappers=_TIME_WRAP,
+            syscalls=(
+                _sc("epoll_wait", 1, 1.0),          # amortized by batch
+                _sc("read", 1, 0.0),                # wakefd drain only
+                _sc("recv", 2, 2.0, KIND_LOOP),     # client + upstream
+                # three flush_out drains + the TLS fatal-alert blurt;
+                # coalesced to one send per dirty conn per wakeup
+                _sc("send", 4, 2.0, KIND_BATCHED),
+                _sc("epoll_ctl", 4, 0.5),           # ep_mod/ep_add
+                _sc("close", 3, 0.1),               # teardown edges
+                _sc("socket", 1, 0.05),             # pooled upstream dial
+                _sc("connect", 1, 0.05),
+                _sc("setsockopt", 1, 0.05),         # TCP_NODELAY on dial
+                _sc("getsockopt", 1, 0.05),         # connect-done check
+                # now_us body + now_ns body + the per-wakeup loop
+                # stamp; the qualified l5dscore::now_ns() brackets
+                # around eval_model resolve to the counted body site
+                _sc("clock_gettime", 3, 1.0),
+            ),
+            # slab swap/recheck, feature ring, tenant table, route park,
+            # session cache, scorer blob — counted, pinned, all short
+            # critical sections
+            max_lock_sites=18,
+            alloc_ok=(
+                "parse_head",          # header vector per request
+                "try_start_request",   # route key + staged head
+                "dispatch",            # fresh Conn when pool is cold
+                "tls_wrap_upstream",   # TLS session per fresh dial
+                "unpark_route",        # swap-steal of the parked list
+                "evict",               # cap-triggered table trims
+                "new_session",         # per-handshake session object
+                "server_sni",          # cached once per handshake
+            ),
+            copy_ok=(
+                "try_start_request",   # staged outbound head build
+                "on_upstream_readable",  # relay into client buffer
+                "on_client_readable",  # relay into upstream buffer
+                "eval_model",          # feature-row staging for scorer
+            ),
+        ),
+        PathBudget(
+            name="h1-accept",
+            files=_H1_FILES,
+            roots=("on_listener",),
+            stop=("process_client_buffer",) + _TLS_STOPS,
+            wrappers=_TIME_WRAP,
+            syscalls=(
+                _sc("accept4", 1, 1.0, KIND_LOOP),
+                _sc("epoll_ctl", 1, 1.0),
+                _sc("close", 3, 0.1),    # throttle/register error edges
+                _sc("setsockopt", 1, 1.0),
+            ),
+            max_lock_sites=0,       # accept gate is atomics-only
+            alloc_ok=("on_listener",  # Conn + listener bookkeeping
+                      "allow",        # cap-triggered age eviction
+                      "new_session"),  # TLS accept session
+            copy_ok=(),
+        ),
+        PathBudget(
+            name="h1-feature-drain",
+            files=_H1_FILES,
+            roots=("fp_drain_features",),
+            syscalls=(),
+            max_lock_sites=1,       # the feature-ring mutex
+            hot=False,
+        ),
+        PathBudget(
+            name="h1-weight-publish",
+            files=_H1_FILES,
+            roots=("fp_publish_weights", "fp_publish_delta"),
+            syscalls=(),
+            max_lock_sites=2,       # slab install + delta apply
+            hot=False,
+        ),
+        PathBudget(
+            name="h1-tls-handshake",
+            files=("native/fastpath.cpp", "native/tls_shim.h",
+                   "native/tls_engine.h"),
+            roots=("hs_complete", "ingest", "encrypt_pending",
+                   "account_handshake"),
+            wrappers=_TIME_WRAP,
+            syscalls=(),            # memory-BIO pump: zero syscalls
+            max_lock_sites=0,       # the shim is lock-free by design
+            alloc_ok=("hs_complete",   # one-time SNI cache fill
+                      "server_sni"),   # the string it caches
+            copy_ok=("pump",),         # BIO staging assign
+        ),
+
+        # ---------------- h2 (gRPC) engine ---------------------------
+        PathBudget(
+            name="h2-serve",
+            files=_H2_FILES,
+            roots=("loop_main", "on_readable"),
+            stop=("on_listener", "sweep") + _TLS_STOPS,
+            wrappers=_TIME_WRAP,
+            # h2 multiplexes up to MAX_STREAMS requests per connection,
+            # so per-request dynamic rates sit far below one: a single
+            # recv carries several HEADERS frames and one drain_dirty
+            # send flushes every stream that completed this wakeup.
+            # per_event here is the per-REQUEST amortized rate at
+            # closed-loop saturation (the measured leg's shape).
+            syscalls=(
+                _sc("epoll_wait", 1, 0.05),
+                _sc("read", 1, 0.0),                # wakefd drain only
+                _sc("recv", 1, 0.3, KIND_LOOP),
+                _sc("send", 4, 0.15, KIND_BATCHED),  # drain_dirty flush
+                _sc("epoll_ctl", 3, 0.01),
+                _sc("close", 2, 0.005),
+                _sc("socket", 1, 0.002),
+                _sc("connect", 1, 0.002),
+                _sc("setsockopt", 1, 0.002),
+                _sc("getsockopt", 1, 0.002),
+                # now_us body + now_ns body + the per-wakeup loop
+                # stamp; the qualified l5dscore::now_ns() brackets
+                # around eval_model resolve to the counted body site
+                _sc("clock_gettime", 3, 0.08),
+            ),
+            max_lock_sites=16,
+            alloc_ok=(
+                "encode",                    # hpack key staging
+                "client_headers_complete",   # header vector + stream
+                "upstream_headers_complete",
+                "handle_client_frame",       # DATA/ctrl frame staging
+                "handle_upstream_frame",
+                "synth_response",            # local error replies
+                "shed_stream",               # overload RST bookkeeping
+                "mk_upstream",               # fresh upstream when cold
+                "unpark_route",              # swap-steal of parked list
+                "conn_close",                # teardown RST/flush lists
+                "apply_settings",            # SETTINGS resume list
+                "evict",                     # cap-triggered table trims
+                "new_session",               # per-handshake session
+                "server_sni",                # cached once per handshake
+                "static_full",               # hpack static tables:
+                "static_name",               # function-local static init
+            ),
+            copy_ok=(
+                "write_settings",        # SETTINGS frame build
+                "decode",                # hpack literal extraction
+                "handle_client_frame",   # DATA relay into buffers
+                "handle_upstream_frame",
+                "on_readable",           # wire ingest append
+                "eval_model",            # feature-row staging
+            ),
+        ),
+        PathBudget(
+            name="h2-accept",
+            files=_H2_FILES,
+            roots=("on_listener",),
+            # teardown cascades belong to h2-serve's budget
+            stop=("conn_close",) + _TLS_STOPS,
+            wrappers=_TIME_WRAP,
+            syscalls=(
+                _sc("accept4", 1, 1.0, KIND_LOOP),
+                _sc("epoll_ctl", 2, 1.0),
+                _sc("close", 3, 0.1),
+                _sc("setsockopt", 1, 1.0),
+                # the SETTINGS preface drains through flush_out
+                _sc("send", 3, 1.0, KIND_BATCHED),
+            ),
+            max_lock_sites=2,            # tenant guard accept gate
+            alloc_ok=("on_listener", "allow", "new_session",
+                      "server_sni"),
+            copy_ok=("write_settings",),
+        ),
+        PathBudget(
+            name="h2-feature-drain",
+            files=_H2_FILES,
+            roots=("fph2_drain_features",),
+            syscalls=(),
+            max_lock_sites=1,
+            hot=False,
+        ),
+        PathBudget(
+            name="h2-weight-publish",
+            files=_H2_FILES,
+            roots=("fph2_publish_weights", "fph2_publish_delta"),
+            syscalls=(),
+            max_lock_sites=2,
+            hot=False,
+        ),
+        PathBudget(
+            name="h2-tls-handshake",
+            files=("native/h2_fastpath.cpp", "native/tls_shim.h",
+                   "native/tls_engine.h"),
+            roots=("hs_complete", "ingest", "encrypt_pending",
+                   "account_handshake"),
+            wrappers=_TIME_WRAP,
+            syscalls=(),            # memory-BIO pump: zero syscalls
+            max_lock_sites=0,
+            alloc_ok=("hs_complete", "server_sni"),
+            copy_ok=("pump",),
+        ),
+    ),
+    measured=(
+        # cleartext paced load; accepts amortize to ~0 over persistent
+        # connections, so the request/serve path is the expectation.
+        # The counter counts libc syscall-WRAPPER calls (clock_gettime
+        # usually resolves to the vDSO and never traps — it is still a
+        # budgeted call site), which is exactly what the static profile
+        # models.
+        MeasuredCheck(engine="h1", paths=("h1-request",), tolerance=2.5),
+        # the h2 amortization point moves with how hard the loadgen
+        # batches streams, so its band is wider than h1's
+        MeasuredCheck(engine="h2", paths=("h2-serve",), tolerance=4.0),
+    ),
+)

@@ -1,0 +1,375 @@
+"""l5dbudget rule implementations.
+
+Four rules over the native hot paths, built on the ctok statement
+walker and the same project-function callgraph discipline as l5dnat
+(``rule_loop_blocking``): BFS by callee name from each manifest path's
+declared roots, restricted to functions defined in the path's declared
+files, stopping at functions another path accounts for.
+
+- ``syscall-budget``  a syscall site the path's manifest entry does
+  not name, or more sites for a named syscall than its ``max_sites``;
+  plus manifest rot (a root that stopped existing, a declared syscall
+  the path never reaches, a wrapper that no longer wraps).
+- ``hot-alloc``       a heap-allocation site (new/malloc/std::string/
+  std::vector construction, substr, to_string) in a reachable function
+  on a hot path that is neither in the path's ``alloc_ok`` set nor
+  waived inline.
+- ``hot-lock``        a mutex acquisition on a path whose manifest
+  entry declares fewer lock sites than the walk finds (0 == declared
+  lock-free). Atomic RMWs are profiled but not finding-generating —
+  stats counters are everywhere and relaxed by design.
+- ``copy-budget``     a bulk-copy site (memcpy/memmove/.append/.assign)
+  in a reachable function outside the path's ``copy_ok`` set.
+
+Sites are classified ``direct`` vs ``loop`` (inside a loop statement of
+their function) for the profile; wrapper calls (``now_us`` ->
+``clock_gettime``) count as sites of the underlying syscall, which is
+what makes "a timestamp read per touch" visible statically even though
+the engines route every read through one helper.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.analysis.core import Finding
+from tools.analysis.native.rules import (
+    _CALLEE_RE, _mask_quals, NatProject)
+from tools.analysis.budget.manifest import (
+    DEFAULT_MANIFEST, BudgetManifest, PathBudget)
+from tools.analysis.seam.ctok import CFunc, CSource, line_of
+
+# syscalls the budget accounts for: everything the engines' event loops
+# can touch. Detection runs on the qualifier-masked code view, so
+# `l5dtls::shutdown(` is a project call while bare `shutdown(` /
+# `::shutdown(` is the syscall.
+SYSCALL_NAMES = (
+    "accept", "accept4", "bind", "clock_gettime", "close", "connect",
+    "epoll_create1", "epoll_ctl", "epoll_wait", "eventfd", "fcntl",
+    "getpeername", "getsockname", "getsockopt", "listen", "poll",
+    "ppoll", "read", "readv", "recv", "recvfrom", "recvmsg", "send",
+    "sendmsg", "sendto", "setsockopt", "shutdown", "sigaction",
+    "socket", "timerfd_create", "timerfd_settime", "write", "writev",
+)
+
+_SYSCALL_RE = re.compile(
+    r"(?<![\w.>])(" + "|".join(sorted(SYSCALL_NAMES, key=len,
+                                      reverse=True)) + r")\s*\(")
+
+_ALLOC_RES = (
+    re.compile(r"(?<![\w.>])new\s+[A-Za-z_(]"),
+    re.compile(r"(?<![\w.>])(?:malloc|calloc|realloc|strdup)\s*\("),
+    re.compile(r"\bstd\s*::\s*string\s+[A-Za-z_]\w*\s*[=({]"),
+    re.compile(r"\bstd\s*::\s*string\s*\("),
+    re.compile(r"\bstd\s*::\s*(?:vector|deque|list|unordered_map|map)"
+               r"\s*<[^;{)]{0,80}>\s+[A-Za-z_]\w*\s*[;=({]"),
+    re.compile(r"\.\s*substr\s*\("),
+    re.compile(r"\bstd\s*::\s*to_string\s*\("),
+)
+
+_LOCK_RE = re.compile(
+    r"\bstd\s*::\s*(?:lock_guard|unique_lock|scoped_lock)\s*<|"
+    r"\bpthread_mutex_lock\s*\(|"
+    r"\.\s*lock\s*\(\s*\)")
+
+_RMW_RE = re.compile(
+    r"\.\s*(?:fetch_add|fetch_sub|fetch_or|fetch_and|exchange|"
+    r"compare_exchange_weak|compare_exchange_strong)\s*\(")
+
+_COPY_RES = (
+    re.compile(r"(?<![\w.>])(?:memcpy|memmove)\s*\("),
+    re.compile(r"\.\s*(?:append|assign)\s*\("),
+)
+
+
+class PathWalk:
+    """The reachable-function set and cost sites of one PathBudget."""
+
+    def __init__(self, proj: NatProject, budget: PathBudget):
+        self.proj = proj
+        self.budget = budget
+        self.missing_roots: List[str] = []
+        # name -> [(rel, fn)] over the path's declared files only
+        self.table: Dict[str, List[Tuple[str, CFunc]]] = {}
+        scanned = set(proj.scan)
+        for rel in budget.files:
+            if rel not in scanned:
+                continue
+            for fn in proj.c(rel).functions():
+                self.table.setdefault(fn.name, []).append((rel, fn))
+        self.reached = self._bfs()
+        self._loops: Dict[Tuple[str, str], List[Tuple[int, int]]] = {}
+        # site lists: (rel, line, fn name, token, classification)
+        self.syscalls: List[Tuple[str, int, str, str, str]] = []
+        self.allocs: List[Tuple[str, int, str, str]] = []
+        self.locks: List[Tuple[str, int, str, str]] = []
+        self.rmws: List[Tuple[str, int, str]] = []
+        self.copies: List[Tuple[str, int, str, str]] = []
+        self._collect()
+
+    # -- callgraph ---------------------------------------------------
+    def _bfs(self) -> Set[str]:
+        stop = set(self.budget.stop)
+        work: List[str] = []
+        for root in self.budget.roots:
+            if root in self.table:
+                work.append(root)
+            else:
+                self.missing_roots.append(root)
+        seen: Set[str] = set(work)
+        while work:
+            name = work.pop()
+            for rel, fn in self.table[name]:
+                body = self.proj.c(rel).code[fn.body_start:fn.body_end]
+                for m in _CALLEE_RE.finditer(body):
+                    callee = m.group(1)
+                    if (callee in self.table and callee not in seen
+                            and callee not in stop):
+                        seen.add(callee)
+                        work.append(callee)
+        return seen
+
+    def _loop_spans(self, rel: str, fn: CFunc) -> List[Tuple[int, int]]:
+        key = (rel, fn.name)
+        if key not in self._loops:
+            spans: List[Tuple[int, int]] = []
+            try:
+                tree = self.proj.c(rel).statements(fn)
+            except Exception:  # noqa: BLE001 — classification only
+                tree = []
+            for root in tree:
+                for st in root.walk():
+                    if st.kind == "loop":
+                        last = max((s.line for s in st.walk()),
+                                   default=st.line)
+                        spans.append((st.line, last))
+            self._loops[key] = spans
+        return self._loops[key]
+
+    def _klass(self, rel: str, fn: CFunc, line: int) -> str:
+        for lo, hi in self._loop_spans(rel, fn):
+            if lo <= line <= hi:
+                return "loop"
+        return "direct"
+
+    # -- site collection ---------------------------------------------
+    def _collect(self) -> None:
+        wrappers = dict(self.budget.wrappers)
+        wrap_re = None
+        if wrappers:
+            wrap_re = re.compile(
+                r"(?<![\w.>])(" + "|".join(
+                    re.escape(w) for w in wrappers) + r")\s*\(")
+        for name in sorted(self.reached):
+            for rel, fn in self.table[name]:
+                src = self.proj.c(rel)
+                body = src.code[fn.body_start:fn.body_end]
+                masked = _mask_quals(body)
+                base = fn.body_start
+                for m in _SYSCALL_RE.finditer(masked):
+                    line = line_of(src.code, base + m.start(1))
+                    self.syscalls.append(
+                        (rel, line, name, m.group(1),
+                         self._klass(rel, fn, line)))
+                if wrap_re is not None and name not in wrappers:
+                    for m in wrap_re.finditer(masked):
+                        line = line_of(src.code, base + m.start(1))
+                        self.syscalls.append(
+                            (rel, line, name, wrappers[m.group(1)],
+                             self._klass(rel, fn, line)))
+                for alloc_re in _ALLOC_RES:
+                    for m in alloc_re.finditer(body):
+                        # a `static` local initializes once per process,
+                        # not per event — that is not hot churn
+                        ls = body.rfind("\n", 0, m.start()) + 1
+                        if re.search(r"\bstatic\b", body[ls:m.start()]):
+                            continue
+                        line = line_of(src.code, base + m.start())
+                        tok = body[m.start():m.end()].split("(")[0]
+                        self.allocs.append(
+                            (rel, line, name, " ".join(tok.split())))
+                for m in _LOCK_RE.finditer(body):
+                    line = line_of(src.code, base + m.start())
+                    tok = " ".join(
+                        body[m.start():m.end()].rstrip("<(").split())
+                    self.locks.append((rel, line, name, tok))
+                for m in _RMW_RE.finditer(body):
+                    line = line_of(src.code, base + m.start())
+                    self.rmws.append((rel, line, name))
+                for copy_re in _COPY_RES:
+                    for m in copy_re.finditer(body):
+                        line = line_of(src.code, base + m.start())
+                        tok = " ".join(
+                            body[m.start():m.end()].rstrip("(").split())
+                        self.copies.append((rel, line, name, tok))
+
+    # -- profile -----------------------------------------------------
+    def profile(self) -> dict:
+        """Static cost profile of this path, for the measured
+        cross-check and the bench baseline row."""
+        per_name: Dict[str, int] = {}
+        for _rel, _line, _fn, sname, _k in self.syscalls:
+            per_name[sname] = per_name.get(sname, 0) + 1
+        return {
+            "path": self.budget.name,
+            "reached_functions": len(self.reached),
+            "syscall_sites": {k: per_name[k] for k in sorted(per_name)},
+            "expected_per_event": round(
+                sum(s.per_event for s in self.budget.syscalls), 2),
+            "alloc_sites": len(self.allocs),
+            "lock_sites": len(self.locks),
+            "atomic_rmw_sites": len(self.rmws),
+            "copy_sites": len(self.copies),
+        }
+
+
+def _anchor(proj: NatProject, budget: PathBudget) -> str:
+    """The file manifest-rot findings attach to: the path's TU (first
+    declared file present in the scan set)."""
+    for rel in budget.files:
+        if rel in proj.scan:
+            return rel
+    return budget.files[0]
+
+
+def walk_path(proj: NatProject, budget: PathBudget) -> PathWalk:
+    return PathWalk(proj, budget)
+
+
+def path_findings(proj: NatProject,
+                  budget: PathBudget) -> Iterator[Finding]:
+    walk = PathWalk(proj, budget)
+    anchor = _anchor(proj, budget)
+    for root in walk.missing_roots:
+        yield Finding(
+            "syscall-budget", anchor, 1, 0,
+            f"manifest rot: path '{budget.name}' declares root "
+            f"'{root}' but no such function exists in "
+            f"{', '.join(budget.files)} — update the budget manifest")
+    if walk.missing_roots and not walk.reached:
+        return
+
+    # syscall-budget: unaccounted names, then per-name site caps
+    per_name: Dict[str, List[Tuple[str, int, str, str]]] = {}
+    for rel, line, fnname, sname, klass in sorted(walk.syscalls):
+        per_name.setdefault(sname, []).append((rel, line, fnname, klass))
+    for sname in sorted(per_name):
+        sites = per_name[sname]
+        allowance = budget.allowance(sname)
+        if allowance is None:
+            for rel, line, fnname, klass in sites:
+                yield Finding(
+                    "syscall-budget", rel, line, 0,
+                    f"unaccounted syscall site: '{sname}' ({klass}) in "
+                    f"'{fnname}' on path '{budget.name}' — budget it "
+                    f"in the manifest, batch it, or waive it")
+        elif len(sites) > allowance.max_sites:
+            for rel, line, fnname, klass in sites[allowance.max_sites:]:
+                yield Finding(
+                    "syscall-budget", rel, line, 0,
+                    f"path '{budget.name}' exceeds its declared "
+                    f"'{sname}' budget: {len(sites)} sites > "
+                    f"{allowance.max_sites} declared (this one: "
+                    f"{klass} in '{fnname}')")
+    for s in budget.syscalls:
+        if s.max_sites > 0 and s.name not in per_name:
+            yield Finding(
+                "syscall-budget", anchor, 1, 0,
+                f"manifest rot: path '{budget.name}' budgets "
+                f"'{s.name}' ({s.max_sites} sites) but the walk "
+                f"reaches none — tighten the manifest")
+    for wrapper, sname in budget.wrappers:
+        if wrapper in walk.table:
+            for rel, fn in walk.table[wrapper]:
+                body = _mask_quals(
+                    proj.c(rel).code[fn.body_start:fn.body_end])
+                if not re.search(
+                        r"(?<![\w.>])" + re.escape(sname) + r"\s*\(",
+                        body):
+                    yield Finding(
+                        "syscall-budget", rel, fn.line, 0,
+                        f"manifest rot: '{wrapper}' is declared a "
+                        f"'{sname}' wrapper on path '{budget.name}' "
+                        f"but its body no longer calls it")
+
+    # hot-lock: more acquisitions than declared (0 == lock-free)
+    if len(walk.locks) > budget.max_lock_sites:
+        for rel, line, fnname, tok in sorted(
+                walk.locks)[budget.max_lock_sites:]:
+            if budget.max_lock_sites == 0:
+                why = (f"lock acquisition ({tok}) in '{fnname}' on "
+                       f"path '{budget.name}', which is declared "
+                       f"lock-free")
+            else:
+                why = (f"path '{budget.name}' exceeds its declared "
+                       f"lock budget: {len(walk.locks)} acquisition "
+                       f"sites > {budget.max_lock_sites} declared "
+                       f"(this one: {tok} in '{fnname}')")
+            yield Finding("hot-lock", rel, line, 0, why)
+    elif budget.max_lock_sites > 0 and not walk.locks:
+        yield Finding(
+            "hot-lock", _anchor(proj, budget), 1, 0,
+            f"manifest rot: path '{budget.name}' budgets "
+            f"{budget.max_lock_sites} lock sites but the walk finds "
+            f"none — declare it lock-free")
+
+    if budget.hot:
+        # hot-alloc: per-event heap churn outside the accounted set
+        alloc_ok = set(budget.alloc_ok)
+        for rel, line, fnname, tok in sorted(walk.allocs):
+            if fnname not in alloc_ok:
+                yield Finding(
+                    "hot-alloc", rel, line, 0,
+                    f"per-event heap allocation ({tok}) in '{fnname}' "
+                    f"on path '{budget.name}': reuse a scratch "
+                    f"buffer, account the function in alloc_ok, or "
+                    f"waive the site")
+        for fnname in sorted(alloc_ok):
+            if fnname not in walk.reached:
+                yield Finding(
+                    "hot-alloc", anchor, 1, 0,
+                    f"manifest rot: alloc_ok names '{fnname}' but "
+                    f"path '{budget.name}' never reaches it")
+        # copy-budget: bulk copies outside the accounted set
+        copy_ok = set(budget.copy_ok)
+        for rel, line, fnname, tok in sorted(walk.copies):
+            if fnname not in copy_ok:
+                yield Finding(
+                    "copy-budget", rel, line, 0,
+                    f"unaccounted bulk copy ({tok}) in '{fnname}' on "
+                    f"path '{budget.name}': account the function in "
+                    f"copy_ok or waive the site")
+        for fnname in sorted(copy_ok):
+            if fnname not in walk.reached:
+                yield Finding(
+                    "copy-budget", anchor, 1, 0,
+                    f"manifest rot: copy_ok names '{fnname}' but "
+                    f"path '{budget.name}' never reaches it")
+
+
+def run_rules(proj: NatProject,
+              manifest: Optional[BudgetManifest] = None,
+              rules=None) -> List[Finding]:
+    """All budget findings over the manifest's paths, deduplicated by
+    (rule, file, line) across overlapping paths."""
+    manifest = manifest or DEFAULT_MANIFEST
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, str, int, str]] = set()
+    for budget in manifest.paths:
+        for f in path_findings(proj, budget):
+            if rules is not None and f.rule not in rules:
+                continue
+            key = (f.rule, f.path, f.line, f.message)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(f)
+    return findings
+
+
+def static_profiles(proj: NatProject,
+                    manifest: Optional[BudgetManifest] = None) -> dict:
+    """Per-path static cost profiles keyed by path name."""
+    manifest = manifest or DEFAULT_MANIFEST
+    return {b.name: PathWalk(proj, b).profile() for b in manifest.paths}

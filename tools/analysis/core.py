@@ -354,10 +354,11 @@ def run_analysis(scan_paths: Sequence[str], repo_root: Optional[str] = None,
     if rules is None or "suppression" in rules:
         from tools.analysis.seam import seam_rule_ids  # lazy — seam
         # imports core, so a module-level import would be circular
+        from tools.analysis.budget import budget_rule_ids
         from tools.analysis.native import nat_rule_ids
         lint_rules = set(rule_ids())
         known = (lint_rules | set(race_rule_ids()) | set(seam_rule_ids())
-                 | set(nat_rule_ids())
+                 | set(nat_rule_ids()) | set(budget_rule_ids())
                  | {"parse", "stale-suppression"})
         for src in project.sources:
             for sup in src.suppressions.values():

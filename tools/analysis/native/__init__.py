@@ -88,8 +88,9 @@ def run_native_analysis(repo_root: Optional[str] = None,
     # set spans both C-side analyzers because seam and nat read the
     # same native sources.
     if rules is None:
+        from tools.analysis.budget import BUDGET_RULES
         from tools.analysis.seam import SEAM_RULES
-        known = (set(NAT_RULES) | set(SEAM_RULES)
+        known = (set(NAT_RULES) | set(SEAM_RULES) | set(BUDGET_RULES)
                  | {"suppression", "stale-suppression"})
         for rel in sorted(proj.scan):
             src = proj.c(rel)

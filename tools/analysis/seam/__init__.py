@@ -100,10 +100,11 @@ def run_seam_analysis(repo_root: Optional[str] = None,
     # python), so seam itself enforces justification + known rule ids
     # for `// l5d: ignore[...]` comments in the sources it read.
     if rules is None:
-        # l5dnat reads the same native sources, so its waivers (and
-        # the C-side meta ids) are legitimate here too
+        # l5dnat and l5dbudget read the same native sources, so their
+        # waivers (and the C-side meta ids) are legitimate here too
+        from tools.analysis.budget import BUDGET_RULES
         from tools.analysis.native import NAT_RULES
-        known = (set(SEAM_RULES) | set(NAT_RULES)
+        known = (set(SEAM_RULES) | set(NAT_RULES) | set(BUDGET_RULES)
                  | {"suppression", "stale-suppression"})
         for rel in sorted(proj._c):
             for sup in proj.c(rel).suppressions.values():

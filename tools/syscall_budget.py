@@ -1,0 +1,255 @@
+"""Measured syscalls-per-request for the native engines — the dynamic
+half of l5dbudget.
+
+The static analyzer (``tools/analysis/budget``) proves which syscall
+sites each engine hot path can reach; this module closes the loop by
+running the REAL assembled engine under paced load with an LD_PRELOAD
+syscall counter (``tools/syscount_preload.c`` — strace is not in the
+image) and reconciling measured syscalls-per-request against the
+``per_event`` expectation declared in the budget manifest, within the
+manifest's declared tolerance.
+
+Process shape
+-------------
+``measure()`` compiles the preload shim and re-execs this module as a
+child (``--child``) with ``LD_PRELOAD`` set. The child immediately
+strips ``LD_PRELOAD`` from its environment so its own children — the
+echo backend and the h2bench load generator — run uninstrumented;
+only the engine loop threads inside the child itself are counted (the
+shim scopes counting to threads that call ``epoll_wait``). The child
+prints one JSON line: raw counts, per-request rates, and the request
+total from the loadgen's own ``reqs`` report.
+
+CLI: ``python -m tools.syscall_budget [h1|h2] [--workers N]`` runs a
+measurement and prints the reconciliation verdict.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from typing import Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SHIM_SRC = os.path.join(REPO, "tools", "syscount_preload.c")
+
+
+def build_preload(outdir: str) -> str:
+    """Compile the LD_PRELOAD counter; returns the .so path."""
+    out = os.path.join(outdir, "libl5d_syscount.so")
+    subprocess.check_call(
+        ["gcc", "-O2", "-shared", "-fPIC", "-Wall", SHIM_SRC,
+         "-o", out, "-ldl"],
+        cwd=REPO)
+    return out
+
+
+def static_expectation(engine: str, manifest=None) -> dict:
+    """The manifest's declared per-request syscall expectation for one
+    engine: the per_event sum over the paths its MeasuredCheck names,
+    plus the tolerance band the measurement must land in."""
+    from tools.analysis.budget.manifest import DEFAULT_MANIFEST
+    mf = manifest or DEFAULT_MANIFEST
+    for mc in mf.measured:
+        if mc.engine == engine:
+            expect = 0.0
+            per_name: dict = {}
+            for pname in mc.paths:
+                pb = mf.path(pname)
+                if pb is None:
+                    continue
+                for s in pb.syscalls:
+                    expect += s.per_event
+                    per_name[s.name] = (per_name.get(s.name, 0.0)
+                                        + s.per_event)
+            return {"engine": engine, "paths": list(mc.paths),
+                    "expect_per_request": round(expect, 3),
+                    "per_name": {k: round(v, 3)
+                                 for k, v in sorted(per_name.items())},
+                    "tolerance": mc.tolerance,
+                    "band": [round(expect / mc.tolerance, 3),
+                             round(expect * mc.tolerance, 3)]}
+    raise KeyError(f"no MeasuredCheck for engine {engine!r}")
+
+
+def reconcile(engine: str, measured: dict, manifest=None) -> dict:
+    """Verdict: does measured syscalls-per-request land inside the
+    declared tolerance band?"""
+    exp = static_expectation(engine, manifest)
+    got = measured.get("total_per_request")
+    lo, hi = exp["band"]
+    ok = (got is not None and lo <= got <= hi)
+    return {"engine": engine, "ok": bool(ok),
+            "measured_per_request": got,
+            "expect_per_request": exp["expect_per_request"],
+            "tolerance": exp["tolerance"], "band": exp["band"],
+            "reqs": measured.get("reqs"),
+            "loop_threads": measured.get("loop_threads"),
+            "per_request": measured.get("per_request")}
+
+
+def measure(engine: str = "h1", duration: float = 3.0, conc: int = 64,
+            workers: int = 1, shim: Optional[str] = None) -> dict:
+    """Run the instrumented child; returns its JSON measurement (or a
+    dict with an ``error`` key)."""
+    with tempfile.TemporaryDirectory(prefix="l5dsyscount-") as td:
+        try:
+            shim_path = shim or build_preload(td)
+        except (OSError, subprocess.SubprocessError) as e:
+            return {"error": f"shim build failed: {e}"}
+        env = dict(os.environ)
+        env["LD_PRELOAD"] = shim_path
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            r = subprocess.run(
+                [sys.executable, "-m", "tools.syscall_budget",
+                 "--child", engine, str(duration), str(conc),
+                 str(workers)],
+                cwd=REPO, env=env, capture_output=True, text=True,
+                timeout=duration * 2 + 180)
+        except subprocess.TimeoutExpired:
+            return {"error": "measurement child timed out"}
+        lines = [ln for ln in (r.stdout or "").splitlines()
+                 if ln.strip()]
+        if r.returncode != 0 or not lines:
+            return {"error": "measurement child failed",
+                    "stderr": (r.stderr or "")[-2000:]}
+        try:
+            return json.loads(lines[-1])
+        except ValueError:
+            return {"error": f"bad child output: {lines[-1][:200]}"}
+
+
+# ------------------------------------------------------------- child
+
+def _snapshot_api():
+    """ctypes bindings to the preloaded shim (global namespace)."""
+    import ctypes
+    lib = ctypes.CDLL(None)
+    lib.l5d_syscount_n.restype = ctypes.c_int
+    lib.l5d_syscount_name.restype = ctypes.c_char_p
+    lib.l5d_syscount_name.argtypes = [ctypes.c_int]
+    lib.l5d_syscount_get.restype = ctypes.c_ulong
+    lib.l5d_syscount_get.argtypes = [ctypes.c_int]
+    lib.l5d_syscount_reset.restype = None
+    lib.l5d_syscount_loop_threads.restype = ctypes.c_int
+    return lib
+
+
+def _child(engine: str, duration: float, conc: int, workers: int) -> int:
+    # children (echo backend, loadgen) must run uninstrumented: their
+    # own epoll loops would otherwise be counted as "engine" threads
+    os.environ.pop("LD_PRELOAD", None)
+    try:
+        lib = _snapshot_api()
+        names = [lib.l5d_syscount_name(i).decode()
+                 for i in range(lib.l5d_syscount_n())]
+    except (OSError, AttributeError):
+        print(json.dumps({"error": "syscount shim not preloaded"}))
+        return 1
+
+    sys.path.insert(0, REPO)
+    from linkerd_tpu import native
+    if not native.ensure_built():
+        print(json.dumps({"error": "native lib unavailable"}))
+        return 1
+    from benchmarks.common import Proc, build_h2bench
+    h2b = build_h2bench()
+
+    procs = []
+    eng = None
+    try:
+        if engine == "h1":
+            echo = Proc(["-m", "benchmarks.serve_echo"])
+            procs.append(echo)
+            eps = [("127.0.0.1", echo.wait_ready()["port"])]
+            eng = native.FastPathEngine(workers=workers)
+            authority, mode, extra = "svc", "h1load", []
+        else:
+            serve = subprocess.Popen([h2b, "serve", "0"],
+                                     stdout=subprocess.PIPE, text=True)
+            procs.append(serve)
+            sport = json.loads(serve.stdout.readline())["listening"]
+            eps = [("127.0.0.1", sport)]
+            eng = native.H2FastPathEngine(workers=workers)
+            authority, mode, extra = "echo", "load", ["128", "0"]
+        port = eng.listen("127.0.0.1", 0)
+        eng.start()
+        eng.set_route(authority, eps)
+
+        def loadgen(dur: float) -> dict:
+            p = subprocess.run(
+                [h2b, mode, "127.0.0.1", str(port), authority,
+                 str(conc), str(dur), *extra],
+                capture_output=True, text=True, timeout=dur + 60)
+            lns = [ln for ln in (p.stdout or "").splitlines()
+                   if ln.strip()]
+            if p.returncode != 0 or not lns:
+                raise RuntimeError(
+                    f"loadgen failed: {(p.stderr or '')[-500:]}")
+            return json.loads(lns[-1])
+
+        loadgen(0.8)                       # warm the upstream pools
+        lib.l5d_syscount_reset()
+        rep = loadgen(duration)
+        counts = {names[i]: lib.l5d_syscount_get(i)
+                  for i in range(len(names))}
+        reqs = int(rep.get("reqs") or 0)
+        if reqs <= 0:
+            print(json.dumps({"error": "loadgen reported zero requests",
+                              "report": rep}))
+            return 1
+        total = sum(counts.values())
+        out = {
+            "engine": engine, "workers": workers, "reqs": reqs,
+            "rps": rep.get("rps"), "errors": rep.get("errors"),
+            "loop_threads": lib.l5d_syscount_loop_threads(),
+            "counts": counts,
+            "per_request": {k: round(v / reqs, 4)
+                            for k, v in sorted(counts.items()) if v},
+            "total_per_request": round(total / reqs, 4),
+        }
+        print(json.dumps(out))
+        return 0
+    finally:
+        if eng is not None:
+            eng.close()
+        for p in procs:
+            if isinstance(p, Proc):
+                p.stop()
+            else:
+                p.terminate()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+
+def main(argv) -> int:
+    if argv and argv[0] == "--child":
+        eng, dur, conc, w = argv[1], float(argv[2]), int(argv[3]), \
+            int(argv[4])
+        return _child(eng, dur, conc, w)
+    engine = argv[0] if argv else "h1"
+    workers = 1
+    if "--workers" in argv:
+        workers = int(argv[argv.index("--workers") + 1])
+    m = measure(engine, workers=workers)
+    if "error" in m:
+        print(json.dumps(m))
+        return 1
+    v = reconcile(engine, m)
+    print(json.dumps(v, indent=2))
+    return 0 if v["ok"] else 1
+
+
+if __name__ == "__main__":
+    # script-path invocation (python tools/syscall_budget.py) puts
+    # tools/ on sys.path, not the repo root the imports need
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    raise SystemExit(main(sys.argv[1:]))

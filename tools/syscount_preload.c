@@ -1,0 +1,144 @@
+/* syscount_preload.c — LD_PRELOAD syscall counter for the MEASURED
+ * half of l5dbudget (tools/analysis/budget).
+ *
+ * The static half of the analyzer proves how many syscall SITES each
+ * engine hot path can reach; this shim closes the loop dynamically by
+ * counting how many syscalls the assembled engine actually makes per
+ * request under load, so `tools/validator.py budget` can reconcile
+ * measured against declared (`BudgetManifest.measured`).
+ *
+ * strace is not available in the runtime image, so the counter
+ * interposes the libc syscall WRAPPERS instead — which is also the
+ * more faithful model: the static profile budgets wrapper call sites
+ * (clock_gettime usually resolves to the vDSO and never traps, but it
+ * is still a budgeted site).
+ *
+ * Scoping: only ENGINE LOOP THREADS are counted. A thread opts in the
+ * first time it calls epoll_wait — exactly the signature of an engine
+ * event loop — so the Python driver's own socket/clock traffic never
+ * pollutes the numbers. The harness (tools/syscall_budget.py)
+ * additionally strips LD_PRELOAD from child processes (echo backend,
+ * loadgen), so their epoll loops are never even instrumented.
+ *
+ * This file deliberately lives OUTSIDE native/: the l5dnat and l5dseam
+ * analyzers sweep every C/C++ source under native/, and this shim is
+ * measurement harness, not data plane.
+ *
+ * Snapshot API (reached via ctypes.CDLL(None) — the preloaded object
+ * sits in the global namespace):
+ *   int           l5d_syscount_n(void);
+ *   const char*   l5d_syscount_name(int i);
+ *   unsigned long l5d_syscount_get(int i);
+ *   void          l5d_syscount_reset(void);
+ *   int           l5d_syscount_loop_threads(void);
+ *
+ * No system headers for the wrapped functions are included on purpose:
+ * every wrapper uses a generic six-register-argument signature (SysV
+ * x86-64 / AArch64 pass the first six integer args in registers, and
+ * none of the wrapped calls take more), so there is no prototype to
+ * conflict with.
+ */
+
+#define _GNU_SOURCE
+#include <dlfcn.h>
+
+#define N_SC 15
+
+static const char* g_names[N_SC] = {
+    "accept4",       /* 0 */
+    "clock_gettime", /* 1 */
+    "close",         /* 2 */
+    "connect",       /* 3 */
+    "epoll_ctl",     /* 4 */
+    "epoll_wait",    /* 5 */
+    "fcntl",         /* 6 */
+    "getsockopt",    /* 7 */
+    "read",          /* 8 */
+    "recv",          /* 9 */
+    "send",          /* 10 */
+    "setsockopt",    /* 11 */
+    "shutdown",      /* 12 */
+    "socket",        /* 13 */
+    "write",         /* 14 */
+};
+
+static unsigned long g_counts[N_SC];
+static void* g_real[N_SC];
+static int g_loop_threads;
+static __thread int t_is_loop;
+
+typedef long (*l5d_fn6)(long, long, long, long, long, long);
+
+static l5d_fn6 real_fn(int i) {
+    void* p = __atomic_load_n(&g_real[i], __ATOMIC_ACQUIRE);
+    if (p == 0) {
+        p = dlsym(RTLD_NEXT, g_names[i]);
+        __atomic_store_n(&g_real[i], p, __ATOMIC_RELEASE);
+    }
+    return (l5d_fn6)p;
+}
+
+static void bump(int i) {
+    if (t_is_loop)
+        __atomic_fetch_add(&g_counts[i], 1UL, __ATOMIC_RELAXED);
+}
+
+/* ---------------------------------------------------- snapshot API */
+
+int l5d_syscount_n(void) { return N_SC; }
+
+const char* l5d_syscount_name(int i) {
+    return (i >= 0 && i < N_SC) ? g_names[i] : "";
+}
+
+unsigned long l5d_syscount_get(int i) {
+    if (i < 0 || i >= N_SC) return 0;
+    return __atomic_load_n(&g_counts[i], __ATOMIC_RELAXED);
+}
+
+void l5d_syscount_reset(void) {
+    for (int i = 0; i < N_SC; i++)
+        __atomic_store_n(&g_counts[i], 0UL, __ATOMIC_RELAXED);
+}
+
+int l5d_syscount_loop_threads(void) {
+    return __atomic_load_n(&g_loop_threads, __ATOMIC_RELAXED);
+}
+
+/* ------------------------------------------------------- wrappers */
+
+#define L5D_WRAP(idx, name)                                         \
+    long name(long a, long b, long c, long d, long e, long f) {     \
+        l5d_fn6 fn = real_fn(idx);                                  \
+        if (fn == 0) return -1;                                     \
+        bump(idx);                                                  \
+        return fn(a, b, c, d, e, f);                                \
+    }
+
+L5D_WRAP(0, accept4)
+L5D_WRAP(1, clock_gettime)
+L5D_WRAP(2, close)
+L5D_WRAP(3, connect)
+L5D_WRAP(4, epoll_ctl)
+L5D_WRAP(6, fcntl)
+L5D_WRAP(7, getsockopt)
+L5D_WRAP(8, read)
+L5D_WRAP(9, recv)
+L5D_WRAP(10, send)
+L5D_WRAP(11, setsockopt)
+L5D_WRAP(12, shutdown)
+L5D_WRAP(13, socket)
+L5D_WRAP(14, write)
+
+/* epoll_wait is the loop-thread signature: the first call marks the
+ * calling thread as an engine loop and enables counting for it. */
+long epoll_wait(long a, long b, long c, long d, long e, long f) {
+    l5d_fn6 fn = real_fn(5);
+    if (fn == 0) return -1;
+    if (!t_is_loop) {
+        t_is_loop = 1;
+        __atomic_fetch_add(&g_loop_threads, 1, __ATOMIC_RELAXED);
+    }
+    bump(5);
+    return fn(a, b, c, d, e, f);
+}

@@ -43,6 +43,16 @@ takes no paths):
 
     python tools/validator.py nat
 
+And the l5dbudget hot-path cost sweep (tools/analysis/budget) over the
+C++ engines — syscall sites, heap allocations, lock acquisitions, and
+bulk copies per declared entrypoint vs the checked-in budget manifest —
+plus a planted-violation smoke AND a measured cross-check that runs the
+assembled engines under load with an LD_PRELOAD syscall counter and
+reconciles syscalls-per-request against the manifest's declared
+expectation (whole-tree, takes no paths):
+
+    python tools/validator.py budget
+
 And the l5dcheck semantic config verification (tools/analysis/semantic)
 over linker/namerd YAML — defaults to every fixture under tests/configs/
 and examples/ when no files are given:
@@ -2063,6 +2073,79 @@ def validate_nat() -> int:
     return 0
 
 
+def validate_budget() -> int:
+    """Three-legged budget gate. (1) static: the live tree must carry
+    zero unsuppressed l5dbudget findings. (2) smoke: plant an
+    undeclared syscall and a hot allocation into a scratch copy of the
+    h1 loop and require the analyzer to catch both — a sweep that
+    passes because the rules rotted is worse than no sweep. (3)
+    measured: run BOTH assembled engines under closed-loop load with
+    the LD_PRELOAD syscall counter and require syscalls-per-request
+    inside the manifest's declared tolerance band."""
+    import json
+    import shutil
+    import tempfile
+
+    from tools.analysis.__main__ import main as analysis_main
+    from tools.analysis.budget import run_budget_analysis
+
+    rc = analysis_main(["budget"])
+    if rc != 0:
+        return rc
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with tempfile.TemporaryDirectory(prefix="l5dbudget_smoke_") as tmp:
+        shutil.copytree(os.path.join(repo, "native"),
+                        os.path.join(tmp, "native"))
+        fp = os.path.join(tmp, "native", "fastpath.cpp")
+        with open(fp, encoding="utf-8") as fh:
+            text = fh.read()
+        anchor = "e->now_cache_us = now_us();"
+        if anchor not in text:
+            print("validator[budget]: loop stamp anchor not found in "
+                  "fastpath.cpp — update the smoke plant site",
+                  file=sys.stderr)
+            return 1
+        with open(fp, "w", encoding="utf-8") as fh:
+            fh.write(text.replace(
+                anchor,
+                anchor + " ::fcntl(0, 3);"
+                " std::string planted_probe = \"x\";", 1))
+        got = [f for f in run_budget_analysis(repo_root=tmp)
+               if not f.suppressed]
+        rules = {f.rule for f in got
+                 if "fcntl" in f.message or "planted_probe" in f.message}
+        if "syscall-budget" not in rules:
+            print("validator[budget]: planted undeclared fcntl was NOT "
+                  "caught — the syscall-budget rule rotted",
+                  file=sys.stderr)
+            return 1
+        if "hot-alloc" not in rules:
+            print("validator[budget]: planted hot allocation was NOT "
+                  "caught — the hot-alloc rule rotted", file=sys.stderr)
+            return 1
+
+    from tools.syscall_budget import measure, reconcile
+    for engine in ("h1", "h2"):
+        m = measure(engine)
+        if "error" in m:
+            print(f"validator[budget]: {engine} measurement failed: "
+                  f"{m['error']}", file=sys.stderr)
+            return 1
+        v = reconcile(engine, m)
+        print(f"validator[budget]: {engine} measured "
+              f"{v['measured_per_request']} syscalls/request, declared "
+              f"{v['expect_per_request']} (band {v['band']}, "
+              f"{v['reqs']} reqs)")
+        if not v["ok"]:
+            print(f"validator[budget]: {engine} measured rate is "
+                  f"OUTSIDE the declared band: {json.dumps(v)}",
+                  file=sys.stderr)
+            return 1
+    print("VALIDATOR PASS (budget)")
+    return 0
+
+
 async def main() -> int:
     args = sys.argv[1:]
     if args and args[0] == "lint":
@@ -2082,6 +2165,12 @@ async def main() -> int:
                   file=sys.stderr)
             return 64
         return validate_nat()
+    if args and args[0] == "budget":
+        if len(args) > 1:
+            print("validator[budget]: the budget sweep takes no paths "
+                  "(the cost envelope is whole-tree)", file=sys.stderr)
+            return 64
+        return validate_budget()
     if args and args[0] == "config":
         return validate_config(args[1:])
     if args and args[0] == "ckpt":
